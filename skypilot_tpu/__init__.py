@@ -1,0 +1,54 @@
+"""skypilot_tpu: a TPU-native orchestration + training/serving framework.
+
+Capability parity with SkyPilot (see SURVEY.md), built TPU-first:
+- Task/Resources/Dag spec with first-class TPU slice topology.
+- Cost/availability optimizer ranking TPU slices against GPU VMs.
+- GCP provisioner gang-launching slices (TPU + queued-resources APIs) with
+  cross-zone/region failover.
+- Host-side agent runtime (job queue, logs, autostop) launching the same
+  program on every slice host with a jax.distributed bootstrap.
+- Managed jobs with preemption recovery; autoscaled serving.
+- In-tree JAX/pjit/Pallas model layer (train + inference engines).
+
+Public SDK mirrors ``sky.*`` (reference ``sky/__init__.py``): imports are
+lazy so `import skypilot_tpu` stays fast and never pulls jax.
+"""
+from typing import Any
+
+__version__ = '0.1.0'
+
+from skypilot_tpu.dag import Dag
+from skypilot_tpu.resources import Resources
+from skypilot_tpu.task import Task
+
+_LAZY_SDK = {
+    # name -> (module, attr)
+    'launch': ('skypilot_tpu.execution', 'launch'),
+    'exec': ('skypilot_tpu.execution', 'exec_cmd'),
+    'optimize': ('skypilot_tpu.optimizer', 'optimize'),
+    'status': ('skypilot_tpu.core', 'status'),
+    'start': ('skypilot_tpu.core', 'start'),
+    'stop': ('skypilot_tpu.core', 'stop'),
+    'down': ('skypilot_tpu.core', 'down'),
+    'autostop': ('skypilot_tpu.core', 'autostop'),
+    'queue': ('skypilot_tpu.core', 'queue'),
+    'cancel': ('skypilot_tpu.core', 'cancel'),
+    'tail_logs': ('skypilot_tpu.core', 'tail_logs'),
+    'cost_report': ('skypilot_tpu.core', 'cost_report'),
+    'jobs': ('skypilot_tpu.jobs', None),
+    'serve': ('skypilot_tpu.serve', None),
+}
+
+
+def __getattr__(name: str) -> Any:
+    if name in _LAZY_SDK:
+        import importlib
+        module_name, attr = _LAZY_SDK[name]
+        module = importlib.import_module(module_name)
+        value = module if attr is None else getattr(module, attr)
+        globals()[name] = value
+        return value
+    raise AttributeError(f'module {__name__!r} has no attribute {name!r}')
+
+
+__all__ = ['Dag', 'Resources', 'Task', '__version__'] + list(_LAZY_SDK)

@@ -1,0 +1,30 @@
+"""Service catalog: instance types × regions × zones × prices.
+
+Functional parity with reference ``sky/clouds/service_catalog/__init__.py``
+(``list_accelerators`` ``:60``, ``get_hourly_cost`` ``:195``, ``get_tpus``
+``:340``) with a much smaller surface: one provider (GCP), one checked-in CSV,
+stdlib csv instead of pandas (the catalog is small; a DataFrame buys nothing).
+"""
+from skypilot_tpu.catalog.catalog import (
+    CatalogEntry,
+    get_catalog,
+    get_hourly_cost,
+    get_instance_type_for_cpus,
+    get_tpus,
+    instance_type_exists,
+    list_accelerators,
+    validate_region_zone,
+    zones_for_accelerator,
+)
+
+__all__ = [
+    'CatalogEntry',
+    'get_catalog',
+    'get_hourly_cost',
+    'get_instance_type_for_cpus',
+    'get_tpus',
+    'instance_type_exists',
+    'list_accelerators',
+    'validate_region_zone',
+    'zones_for_accelerator',
+]

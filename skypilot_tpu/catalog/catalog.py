@@ -1,0 +1,157 @@
+"""In-memory catalog over the checked-in CSV data."""
+from __future__ import annotations
+
+import csv
+import dataclasses
+import functools
+import os
+from typing import Dict, List, Optional, Tuple
+
+from skypilot_tpu import exceptions
+
+_DATA_DIR = os.path.join(os.path.dirname(__file__), 'data')
+
+
+@dataclasses.dataclass(frozen=True)
+class CatalogEntry:
+    instance_type: str
+    accelerator_name: Optional[str]
+    accelerator_count: int
+    vcpus: float
+    memory_gib: float
+    price: float          # on-demand $/hr (whole slice for TPUs)
+    spot_price: float
+    region: str
+    zone: str
+
+    @property
+    def is_tpu(self) -> bool:
+        return (self.accelerator_name or '').startswith('tpu-')
+
+    def hourly_cost(self, use_spot: bool) -> float:
+        return self.spot_price if use_spot else self.price
+
+
+@functools.lru_cache(maxsize=None)
+def get_catalog(cloud: str = 'gcp') -> Tuple[CatalogEntry, ...]:
+    path = os.path.join(_DATA_DIR, f'{cloud.lower()}.csv')
+    if not os.path.exists(path):
+        raise exceptions.NoCloudAccessError(
+            f'No catalog data for cloud {cloud!r} at {path}.')
+    entries: List[CatalogEntry] = []
+    with open(path, newline='', encoding='utf-8') as f:
+        for row in csv.DictReader(f):
+            entries.append(CatalogEntry(
+                instance_type=row['InstanceType'],
+                accelerator_name=row['AcceleratorName'] or None,
+                accelerator_count=int(float(row['AcceleratorCount'])
+                                      ) if row['AcceleratorCount'] else 0,
+                vcpus=float(row['vCPUs']),
+                memory_gib=float(row['MemoryGiB']),
+                price=float(row['Price']),
+                spot_price=float(row['SpotPrice']),
+                region=row['Region'],
+                zone=row['AvailabilityZone'],
+            ))
+    return tuple(entries)
+
+
+def list_accelerators(cloud: str = 'gcp',
+                      name_filter: Optional[str] = None,
+                      require_price: bool = False
+                      ) -> Dict[str, List[CatalogEntry]]:
+    """accelerator name -> entries (dedup by (name, count, region))."""
+    del require_price  # all entries are priced
+    out: Dict[str, List[CatalogEntry]] = {}
+    for e in get_catalog(cloud):
+        if e.accelerator_name is None:
+            continue
+        if name_filter and name_filter.lower() not in e.accelerator_name.lower():
+            continue
+        out.setdefault(e.accelerator_name, []).append(e)
+    return out
+
+
+def get_tpus(cloud: str = 'gcp') -> Dict[str, List[CatalogEntry]]:
+    """Reference ``service_catalog.get_tpus`` (``__init__.py:340``)."""
+    return {name: entries
+            for name, entries in list_accelerators(cloud).items()
+            if name.startswith('tpu-')}
+
+
+def zones_for_accelerator(accelerator_name: str,
+                          count: int = 1,
+                          region: Optional[str] = None,
+                          cloud: str = 'gcp') -> List[CatalogEntry]:
+    """All zone-level entries offering the accelerator, cheapest first."""
+    entries = [e for e in get_catalog(cloud)
+               if e.accelerator_name == accelerator_name
+               and e.accelerator_count >= count
+               and (region is None or e.region == region)]
+    return sorted(entries, key=lambda e: (e.price, e.zone))
+
+
+def get_instance_type_for_cpus(cpus: Optional[float] = None,
+                               memory_gib: Optional[float] = None,
+                               at_least: bool = True,
+                               region: Optional[str] = None,
+                               cloud: str = 'gcp'
+                               ) -> Optional[CatalogEntry]:
+    """Cheapest CPU-only instance meeting the cpu/memory requirement."""
+    best: Optional[CatalogEntry] = None
+    for e in get_catalog(cloud):
+        if e.accelerator_name is not None:
+            continue
+        if region is not None and e.region != region:
+            continue
+        if cpus is not None:
+            if at_least and e.vcpus < cpus:
+                continue
+            if not at_least and e.vcpus != cpus:
+                continue
+        if memory_gib is not None and e.memory_gib < memory_gib:
+            continue
+        if best is None or e.price < best.price:
+            best = e
+    return best
+
+
+def instance_type_exists(instance_type: str, cloud: str = 'gcp') -> bool:
+    return any(e.instance_type == instance_type for e in get_catalog(cloud))
+
+
+def get_hourly_cost(instance_type: str,
+                    use_spot: bool = False,
+                    region: Optional[str] = None,
+                    zone: Optional[str] = None,
+                    accelerator_name: Optional[str] = None,
+                    cloud: str = 'gcp') -> float:
+    """$/hr for an instance type (TPUs: pass accelerator_name, whole slice)."""
+    for e in get_catalog(cloud):
+        if e.instance_type != instance_type:
+            continue
+        if accelerator_name and e.accelerator_name != accelerator_name:
+            continue
+        if region and e.region != region:
+            continue
+        if zone and e.zone != zone:
+            continue
+        return e.hourly_cost(use_spot)
+    raise exceptions.InvalidResourcesError(
+        f'No catalog entry for {instance_type} '
+        f'(accel={accelerator_name}, region={region}, zone={zone}).')
+
+
+def validate_region_zone(region: Optional[str], zone: Optional[str],
+                         cloud: str = 'gcp') -> None:
+    if region is None and zone is None:
+        return
+    for e in get_catalog(cloud):
+        if region is not None and e.region != region:
+            continue
+        if zone is not None and e.zone != zone:
+            continue
+        return
+    raise exceptions.InvalidResourcesError(
+        f'Region/zone not found in {cloud} catalog: '
+        f'region={region!r} zone={zone!r}')

@@ -1,0 +1,129 @@
+"""Generate the static GCP catalog CSV.
+
+Plays the role of the reference's catalog data fetchers
+(``sky/clouds/service_catalog/data_fetchers/fetch_gcp.py`` — which hard-codes
+TPU availability tables at ``:73-92``). We have zero egress, so the tables are
+checked in; prices are approximations of GCP list prices (the optimizer only
+needs correct *relative* ordering and the failover loop needs real
+region/zone shapes).
+
+Run:  python -m skypilot_tpu.catalog.data_gen
+"""
+from __future__ import annotations
+
+import csv
+import os
+
+from skypilot_tpu.accelerators import TPU_GENERATIONS
+
+# generation -> (price per chip-hour on-demand, zones)
+_TPU_AVAILABILITY = {
+    'v2': (1.125, ['us-central1-b', 'us-central1-c', 'us-central1-f',
+                   'europe-west4-a', 'asia-east1-c']),
+    'v3': (2.00, ['us-central1-a', 'us-central1-b', 'europe-west4-a']),
+    'v4': (3.22, ['us-central2-b']),
+    'v5e': (1.20, ['us-central1-a', 'us-west4-a', 'us-east1-c', 'us-east5-a',
+                   'europe-west4-b', 'asia-southeast1-b']),
+    'v5p': (4.20, ['us-east5-a', 'us-central1-a', 'europe-west4-b']),
+    'v6e': (2.70, ['us-east5-b', 'us-east1-d', 'europe-west4-a',
+                   'asia-northeast1-b']),
+}
+_SPOT_DISCOUNT = 0.43  # spot price ~= 43% of on-demand
+
+# Slice sizes offered per generation (in the generation's naming unit).
+_TPU_SLICE_SIZES = {
+    'v2': [8, 32, 128, 256, 512],
+    'v3': [8, 32, 128, 256, 512, 1024],
+    'v4': [8, 16, 32, 64, 128, 256, 512, 1024, 2048],
+    'v5e': [1, 4, 8, 16, 32, 64, 128, 256],
+    'v5p': [8, 16, 32, 64, 128, 256, 512, 1024],
+    'v6e': [1, 4, 8, 16, 32, 64, 128, 256],
+}
+
+# TPU-VM host shapes (vCPU / GiB per host), approximating GCP machine specs.
+_TPU_HOST_SHAPE = {
+    'v2': (96, 335), 'v3': (96, 335), 'v4': (240, 407),
+    'v5e': (112, 192), 'v5p': (208, 448), 'v6e': (180, 720),
+}
+
+# GPU + CPU VMs: (instance_type, accel_name, accel_count, vcpus, mem, price,
+#                 regions)
+_GPU_VMS = [
+    ('a2-highgpu-1g', 'A100', 1, 12, 85, 3.67),
+    ('a2-highgpu-4g', 'A100', 4, 48, 340, 14.69),
+    ('a2-highgpu-8g', 'A100', 8, 96, 680, 29.39),
+    ('a2-ultragpu-8g', 'A100-80GB', 8, 96, 1360, 40.22),
+    ('a3-highgpu-8g', 'H100', 8, 208, 1872, 88.25),
+    ('g2-standard-4', 'L4', 1, 4, 16, 0.71),
+    ('g2-standard-48', 'L4', 4, 48, 192, 3.99),
+    ('n1-standard-8+T4', 'T4', 1, 8, 30, 0.73),
+    ('n1-standard-8+V100', 'V100', 1, 8, 30, 2.86),
+]
+_GPU_REGIONS = ['us-central1-a', 'us-central1-b', 'us-east1-c',
+                'europe-west4-a', 'asia-east1-a']
+
+_CPU_VMS = [
+    ('n2-standard-2', 2, 8, 0.097),
+    ('n2-standard-4', 4, 16, 0.194),
+    ('n2-standard-8', 8, 32, 0.388),
+    ('n2-standard-16', 16, 64, 0.777),
+    ('n2-standard-32', 32, 128, 1.554),
+    ('n2-highmem-8', 8, 64, 0.524),
+    ('e2-standard-4', 4, 16, 0.134),
+    ('e2-standard-8', 8, 32, 0.268),
+]
+_CPU_REGIONS = ['us-central1-a', 'us-central1-b', 'us-central2-b',
+                'us-east1-c', 'us-east5-a', 'us-east5-b', 'us-west4-a',
+                'europe-west4-a', 'europe-west4-b', 'asia-east1-a',
+                'asia-southeast1-b', 'asia-northeast1-b']
+
+FIELDS = ['InstanceType', 'AcceleratorName', 'AcceleratorCount', 'vCPUs',
+          'MemoryGiB', 'Price', 'SpotPrice', 'Region', 'AvailabilityZone']
+
+
+def generate_rows():
+    rows = []
+    # TPUs: InstanceType is the synthetic 'TPU-VM' (reference prices TPU-VM
+    # hosts at zero and bills the accelerator:
+    # sky/clouds/service_catalog/gcp_catalog.py:222-244). We instead fold the
+    # whole slice cost into the accelerator price and expose host shape.
+    for gen_name, (chip_price, zones) in _TPU_AVAILABILITY.items():
+        gen = TPU_GENERATIONS[gen_name]
+        vcpus, mem = _TPU_HOST_SHAPE[gen_name]
+        for size in _TPU_SLICE_SIZES[gen_name]:
+            chips = size // gen.cores_per_chip if gen.names_by_cores else size
+            if chips < 1:
+                continue
+            name = f'tpu-{gen_name}-{size}'
+            price = chip_price * chips
+            spot = round(price * _SPOT_DISCOUNT, 4)
+            hosts = max(1, chips // gen.chips_per_host)
+            for zone in zones:
+                region = zone.rsplit('-', 1)[0]
+                rows.append(['TPU-VM', name, 1, vcpus * hosts, mem * hosts,
+                             round(price, 4), spot, region, zone])
+    for (itype, acc, cnt, vcpus, mem, price) in _GPU_VMS:
+        for zone in _GPU_REGIONS:
+            region = zone.rsplit('-', 1)[0]
+            rows.append([itype, acc, cnt, vcpus, mem, price,
+                         round(price * _SPOT_DISCOUNT, 4), region, zone])
+    for (itype, vcpus, mem, price) in _CPU_VMS:
+        for zone in _CPU_REGIONS:
+            region = zone.rsplit('-', 1)[0]
+            rows.append([itype, '', '', vcpus, mem, price,
+                         round(price * _SPOT_DISCOUNT, 4), region, zone])
+    return rows
+
+
+def main():
+    out = os.path.join(os.path.dirname(__file__), 'data', 'gcp.csv')
+    os.makedirs(os.path.dirname(out), exist_ok=True)
+    with open(out, 'w', newline='', encoding='utf-8') as f:
+        w = csv.writer(f)
+        w.writerow(FIELDS)
+        w.writerows(generate_rows())
+    print(f'wrote {out}')
+
+
+if __name__ == '__main__':
+    main()

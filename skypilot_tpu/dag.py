@@ -1,0 +1,148 @@
+"""DAG of Tasks with a thread-local ambient context.
+
+Functional parity with reference ``sky/dag.py`` (``Dag`` at ``sky/dag.py:11``,
+``_DagContext`` at ``:80``). Like the reference, managed-job pipelines only
+support chain DAGs; the general graph is kept for the optimizer's ILP path.
+"""
+from __future__ import annotations
+
+import threading
+from typing import List, Optional
+
+from skypilot_tpu import exceptions
+
+
+class Dag:
+    """A graph of Tasks. Use as a context manager to collect tasks:
+
+        with Dag() as dag:
+            t1 = Task(...)
+            t2 = Task(...)
+            t1 >> t2
+    """
+
+    def __init__(self, name: Optional[str] = None):
+        self.name = name
+        self.tasks: List = []
+        # adjacency: edges[i] = set of task indices that depend on tasks[i]
+        self._edges: List[tuple] = []  # (upstream_task, downstream_task)
+
+    # ---------------- graph ops ----------------
+    def add(self, task) -> None:
+        if task not in self.tasks:
+            self.tasks.append(task)
+            task._dag = self
+
+    def remove(self, task) -> None:
+        self._edges = [(u, v) for (u, v) in self._edges
+                       if u is not task and v is not task]
+        self.tasks.remove(task)
+
+    def add_edge(self, op1, op2) -> None:
+        self.add(op1)
+        self.add(op2)
+        self._edges.append((op1, op2))
+
+    def edges(self) -> List[tuple]:
+        return list(self._edges)
+
+    def successors(self, task) -> List:
+        return [v for (u, v) in self._edges if u is task]
+
+    def predecessors(self, task) -> List:
+        return [u for (u, v) in self._edges if v is task]
+
+    def get_graph(self):
+        """NetworkX DiGraph view (lazy import, like the reference)."""
+        import networkx as nx  # lazy: heavy import
+        g = nx.DiGraph()
+        g.add_nodes_from(self.tasks)
+        g.add_edges_from(self._edges)
+        return g
+
+    # ---------------- validation / shape ----------------
+    def is_chain(self) -> bool:
+        if len(self.tasks) <= 1:
+            return True
+        order = self.topological_order()
+        for i, t in enumerate(order):
+            succ = self.successors(t)
+            if i < len(order) - 1:
+                if succ != [order[i + 1]]:
+                    return False
+            elif succ:
+                return False
+        return True
+
+    def topological_order(self) -> List:
+        indeg = {id(t): 0 for t in self.tasks}
+        for (_, v) in self._edges:
+            indeg[id(v)] += 1
+        ready = [t for t in self.tasks if indeg[id(t)] == 0]
+        out: List = []
+        while ready:
+            t = ready.pop(0)
+            out.append(t)
+            for v in self.successors(t):
+                indeg[id(v)] -= 1
+                if indeg[id(v)] == 0:
+                    ready.append(v)
+        if len(out) != len(self.tasks):
+            raise exceptions.InvalidDagError('DAG has a cycle.')
+        return out
+
+    def validate(self) -> None:
+        self.topological_order()
+
+    def __len__(self) -> int:
+        return len(self.tasks)
+
+    def __enter__(self) -> 'Dag':
+        push_dag(self)
+        return self
+
+    def __exit__(self, *args) -> None:
+        pop_dag()
+
+    def __repr__(self) -> str:
+        return f'Dag(name={self.name!r}, tasks={len(self.tasks)})'
+
+
+class _DagContext(threading.local):
+    """Thread-local stack of active DAGs (reference ``sky/dag.py:80``)."""
+
+    def __init__(self):
+        super().__init__()
+        self._stack: List[Dag] = []
+
+    def push(self, dag: Dag) -> None:
+        self._stack.append(dag)
+
+    def pop(self) -> Dag:
+        return self._stack.pop()
+
+    def current(self) -> Optional[Dag]:
+        return self._stack[-1] if self._stack else None
+
+
+_context = _DagContext()
+
+
+def push_dag(dag: Dag) -> None:
+    _context.push(dag)
+
+
+def pop_dag() -> Dag:
+    return _context.pop()
+
+
+def get_current_dag() -> Optional[Dag]:
+    return _context.current()
+
+
+def _current_dag_add_edge(t1, t2) -> None:
+    dag = get_current_dag()
+    if dag is None:
+        raise exceptions.InvalidDagError(
+            'Task >> Task requires an active `with Dag():` context.')
+    dag.add_edge(t1, t2)

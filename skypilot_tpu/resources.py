@@ -1,0 +1,414 @@
+"""Resources: an immutable request/filter for compute.
+
+Functional parity with reference ``sky/resources.py`` (class ``Resources``,
+``sky/resources.py:31``), re-designed TPU-first:
+
+- ``accelerators: tpu-v5e-16`` resolves to a :class:`TpuTopology` — hosts per
+  slice and chips per host are first-class (the reference bolts this on via
+  ``num_ips_per_node``).
+- ``accelerator_args`` carries TPU runtime knobs (``runtime_version``,
+  ``reserved``, ``best_effort`` queueing) like the reference's
+  ``tpu_vm``/``runtime_version`` args (``sky/resources.py:545``). There is no
+  ``tpu_vm: False`` legacy path: TPU-VM is the only architecture.
+- Multiple candidates are an ordered list on the Task (``any_of`` /
+  ``ordered``), matching reference semantics for failover preference.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, List, Optional, Set, Union
+
+from skypilot_tpu import accelerators as accel_lib
+from skypilot_tpu import exceptions
+
+_DEFAULT_DISK_SIZE_GB = 256
+
+
+class Resources:
+    """An immutable compute requirement.
+
+    Examples:
+        Resources(accelerators='tpu-v5e-8')
+        Resources(cloud='gcp', accelerators={'A100': 8}, use_spot=True)
+        Resources(cpus='8+', memory='32+')
+    """
+
+    # Version for pickled handles shipped to controllers (reference:
+    # ``Resources._VERSION = 20``, sky/resources.py:47).
+    _VERSION = 1
+
+    def __init__(
+        self,
+        cloud: Optional[str] = None,
+        instance_type: Optional[str] = None,
+        accelerators: Union[None, str, Dict[str, int]] = None,
+        accelerator_args: Optional[Dict[str, Any]] = None,
+        cpus: Union[None, int, float, str] = None,
+        memory: Union[None, int, float, str] = None,
+        use_spot: Optional[bool] = None,
+        spot_recovery: Optional[str] = None,
+        region: Optional[str] = None,
+        zone: Optional[str] = None,
+        image_id: Optional[str] = None,
+        disk_size: Optional[int] = None,
+        disk_tier: Optional[str] = None,
+        ports: Optional[List[Union[int, str]]] = None,
+        labels: Optional[Dict[str, str]] = None,
+        job_recovery: Optional[str] = None,
+        _is_image_managed: Optional[bool] = None,
+    ):
+        self._cloud = cloud.lower() if cloud else None
+        self._instance_type = instance_type
+        self._use_spot_specified = use_spot is not None
+        self._use_spot = bool(use_spot) if use_spot is not None else False
+        if isinstance(job_recovery, dict):
+            job_recovery = job_recovery.get('strategy')
+        self._spot_recovery = (spot_recovery or job_recovery or None)
+        if self._spot_recovery is not None:
+            self._spot_recovery = str(self._spot_recovery).upper()
+        self._region = region
+        self._zone = zone
+        self._image_id = image_id
+        self._disk_size = int(disk_size) if disk_size else _DEFAULT_DISK_SIZE_GB
+        self._disk_tier = disk_tier
+        self._ports = [str(p) for p in ports] if ports else None
+        self._labels = dict(labels) if labels else None
+        self._accelerator_args: Optional[Dict[str, Any]] = (
+            dict(accelerator_args) if accelerator_args else None)
+
+        self._set_cpus(cpus)
+        self._set_memory(memory)
+        self._set_accelerators(accelerators)
+
+    # ---------------- parsing helpers ----------------
+    def _set_cpus(self, cpus: Union[None, int, float, str]) -> None:
+        # '8' exact, '8+' at-least. Stored as (count, is_at_least).
+        self._cpus: Optional[float] = None
+        self._cpus_at_least = False
+        if cpus is None:
+            return
+        s = str(cpus)
+        if s.endswith('+'):
+            self._cpus_at_least = True
+            s = s[:-1]
+        try:
+            self._cpus = float(s)
+        except ValueError:
+            raise exceptions.InvalidResourcesError(
+                f'Invalid cpus spec: {cpus!r}') from None
+        if self._cpus <= 0:
+            raise exceptions.InvalidResourcesError(
+                f'cpus must be positive: {cpus!r}')
+
+    def _set_memory(self, memory: Union[None, int, float, str]) -> None:
+        self._memory: Optional[float] = None
+        self._memory_at_least = False
+        if memory is None:
+            return
+        s = str(memory)
+        if s.endswith('+'):
+            self._memory_at_least = True
+            s = s[:-1]
+        try:
+            self._memory = float(s)
+        except ValueError:
+            raise exceptions.InvalidResourcesError(
+                f'Invalid memory spec: {memory!r}') from None
+        if self._memory <= 0:
+            raise exceptions.InvalidResourcesError(
+                f'memory must be positive: {memory!r}')
+
+    def _set_accelerators(
+            self, accelerators: Union[None, str, Dict[str, int]]) -> None:
+        """Normalize to {name: count}; resolve TPU topology.
+
+        Reference: ``sky/resources.py:545`` ``_set_accelerators``.
+        """
+        self._accelerators: Optional[Dict[str, int]] = None
+        self._tpu: Optional[accel_lib.TpuTopology] = None
+        if accelerators is None:
+            return
+        if isinstance(accelerators, str):
+            if ':' in accelerators:
+                name, _, cnt = accelerators.partition(':')
+                try:
+                    accelerators = {name: int(cnt)}
+                except ValueError:
+                    raise exceptions.InvalidResourcesError(
+                        f'Invalid accelerator count in {name}:{cnt!r}'
+                    ) from None
+            else:
+                accelerators = {accelerators: 1}
+        if len(accelerators) != 1:
+            raise exceptions.InvalidResourcesError(
+                'Exactly one accelerator type may be requested, got: '
+                f'{accelerators}')
+        name, count = next(iter(accelerators.items()))
+        name = accel_lib.canonicalize_accelerator_name(name)
+        if accel_lib.is_tpu(name):
+            self._tpu = accel_lib.parse_tpu(name)
+            # For TPUs the count suffix already encodes the slice size.
+            if count not in (1, self._tpu.num_chips):
+                raise exceptions.InvalidResourcesError(
+                    f'TPU slice {name!r} already encodes its size; got '
+                    f'conflicting count {count}.')
+            self._accelerators = {self._tpu.name: 1}
+            if self._cloud is None:
+                self._cloud = 'gcp'
+            elif self._cloud != 'gcp':
+                raise exceptions.InvalidResourcesError(
+                    f'TPUs are only available on GCP, got cloud={self._cloud!r}')
+        else:
+            self._accelerators = {name: int(count)}
+
+    # ---------------- properties ----------------
+    @property
+    def cloud(self) -> Optional[str]:
+        return self._cloud
+
+    @property
+    def instance_type(self) -> Optional[str]:
+        return self._instance_type
+
+    @property
+    def accelerators(self) -> Optional[Dict[str, int]]:
+        return dict(self._accelerators) if self._accelerators else None
+
+    @property
+    def accelerator_args(self) -> Optional[Dict[str, Any]]:
+        return dict(self._accelerator_args) if self._accelerator_args else None
+
+    @property
+    def tpu(self) -> Optional[accel_lib.TpuTopology]:
+        """Resolved TPU topology, or None for CPU/GPU requests."""
+        return self._tpu
+
+    @property
+    def is_tpu(self) -> bool:
+        return self._tpu is not None
+
+    @property
+    def tpu_runtime_version(self) -> Optional[str]:
+        if not self.is_tpu:
+            return None
+        args = self._accelerator_args or {}
+        return args.get('runtime_version',
+                        self._tpu.gen.default_runtime_version)
+
+    @property
+    def cpus(self) -> Optional[str]:
+        if self._cpus is None:
+            return None
+        return f'{self._cpus:g}' + ('+' if self._cpus_at_least else '')
+
+    @property
+    def memory(self) -> Optional[str]:
+        if self._memory is None:
+            return None
+        return f'{self._memory:g}' + ('+' if self._memory_at_least else '')
+
+    @property
+    def use_spot(self) -> bool:
+        return self._use_spot
+
+    @property
+    def use_spot_specified(self) -> bool:
+        return self._use_spot_specified
+
+    @property
+    def spot_recovery(self) -> Optional[str]:
+        return self._spot_recovery
+
+    @property
+    def region(self) -> Optional[str]:
+        return self._region
+
+    @property
+    def zone(self) -> Optional[str]:
+        return self._zone
+
+    @property
+    def image_id(self) -> Optional[str]:
+        return self._image_id
+
+    @property
+    def disk_size(self) -> int:
+        return self._disk_size
+
+    @property
+    def disk_tier(self) -> Optional[str]:
+        return self._disk_tier
+
+    @property
+    def ports(self) -> Optional[List[str]]:
+        return list(self._ports) if self._ports else None
+
+    @property
+    def labels(self) -> Optional[Dict[str, str]]:
+        return dict(self._labels) if self._labels else None
+
+    # ---------------- behaviors ----------------
+    def copy(self, **override) -> 'Resources':
+        """Return a copy with fields overridden (reference ``copy()``)."""
+        fields: Dict[str, Any] = dict(
+            cloud=self._cloud,
+            instance_type=self._instance_type,
+            accelerators=self.accelerators,
+            accelerator_args=self.accelerator_args,
+            cpus=self.cpus,
+            memory=self.memory,
+            use_spot=self._use_spot if self._use_spot_specified else None,
+            spot_recovery=self._spot_recovery,
+            region=self._region,
+            zone=self._zone,
+            image_id=self._image_id,
+            disk_size=self._disk_size,
+            disk_tier=self._disk_tier,
+            ports=self.ports,
+            labels=self.labels,
+        )
+        fields.update(override)
+        return Resources(**fields)
+
+    def less_demanding_than(self, other: 'Resources') -> bool:
+        """True if ``other`` can serve a request for ``self``.
+
+        Used for cluster reuse checks (``sky exec`` on an existing cluster).
+        """
+        if self._cloud is not None and self._cloud != other._cloud:
+            return False
+        if self._region is not None and self._region != other._region:
+            return False
+        if self._zone is not None and self._zone != other._zone:
+            return False
+        if self._use_spot_specified and self._use_spot != other._use_spot:
+            return False
+        if self._accelerators is not None:
+            if other._accelerators is None:
+                return False
+            for name, cnt in self._accelerators.items():
+                if other._accelerators.get(name, 0) < cnt:
+                    return False
+        if self._instance_type is not None and (
+                self._instance_type != other._instance_type):
+            return False
+        if self._cpus is not None:
+            if other._cpus is None or other._cpus < self._cpus:
+                return False
+        if self._memory is not None:
+            if other._memory is None or other._memory < self._memory:
+                return False
+        if self._disk_size > other._disk_size:
+            return False
+        return True
+
+    def get_required_chips(self) -> int:
+        return self._tpu.num_chips if self._tpu else 0
+
+    # ---------------- serialization ----------------
+    @classmethod
+    def from_yaml_config(cls, config: Optional[Dict[str, Any]]) -> 'Resources':
+        if config is None:
+            return cls()
+        config = dict(config)
+        if 'any_of' in config or 'ordered' in config:
+            raise exceptions.InvalidResourcesError(
+                'Multi-candidate resources (any_of/ordered) must be parsed '
+                'with Resources.from_yaml_config_list().')
+        known = {
+            'cloud', 'instance_type', 'accelerators', 'accelerator_args',
+            'cpus', 'memory', 'use_spot', 'spot_recovery', 'job_recovery',
+            'region', 'zone', 'image_id', 'disk_size', 'disk_tier', 'ports',
+            'labels',
+        }
+        unknown = set(config) - known
+        if unknown:
+            raise exceptions.InvalidResourcesError(
+                f'Unknown resources fields: {sorted(unknown)}')
+        return cls(**{k: v for k, v in config.items() if k in known})
+
+    @classmethod
+    def from_yaml_config_list(
+            cls, config: Optional[Dict[str, Any]]) -> List['Resources']:
+        """Expand ``any_of``/``ordered`` into an ordered candidate list.
+
+        Reference semantics: ``ordered`` preserves user preference order for
+        failover; ``any_of`` means cost-optimal order (optimizer sorts).
+        """
+        if config is None:
+            return [cls()]
+        for key in ('any_of', 'ordered'):
+            if key in config:
+                base = {k: v for k, v in config.items()
+                        if k not in ('any_of', 'ordered')}
+                out = []
+                for sub in config[key]:
+                    merged = dict(base)
+                    merged.update(sub)
+                    out.append(cls.from_yaml_config(merged))
+                return out
+        return [cls.from_yaml_config(config)]
+
+    def to_yaml_config(self) -> Dict[str, Any]:
+        cfg: Dict[str, Any] = {}
+        if self._cloud:
+            cfg['cloud'] = self._cloud
+        if self._instance_type:
+            cfg['instance_type'] = self._instance_type
+        if self._accelerators:
+            name, cnt = next(iter(self._accelerators.items()))
+            cfg['accelerators'] = name if cnt == 1 else f'{name}:{cnt}'
+        if self._accelerator_args:
+            cfg['accelerator_args'] = dict(self._accelerator_args)
+        if self.cpus:
+            cfg['cpus'] = self.cpus
+        if self.memory:
+            cfg['memory'] = self.memory
+        if self._use_spot_specified:
+            cfg['use_spot'] = self._use_spot
+        if self._spot_recovery:
+            cfg['spot_recovery'] = self._spot_recovery
+        if self._region:
+            cfg['region'] = self._region
+        if self._zone:
+            cfg['zone'] = self._zone
+        if self._image_id:
+            cfg['image_id'] = self._image_id
+        if self._disk_size != _DEFAULT_DISK_SIZE_GB:
+            cfg['disk_size'] = self._disk_size
+        if self._disk_tier:
+            cfg['disk_tier'] = self._disk_tier
+        if self._ports:
+            cfg['ports'] = list(self._ports)
+        if self._labels:
+            cfg['labels'] = dict(self._labels)
+        return cfg
+
+    # ---------------- dunder ----------------
+    def __repr__(self) -> str:
+        parts = []
+        if self._cloud:
+            parts.append(self._cloud)
+        if self._instance_type:
+            parts.append(self._instance_type)
+        if self._accelerators:
+            name, cnt = next(iter(self._accelerators.items()))
+            parts.append(name if cnt == 1 else f'{name}:{cnt}')
+        if self._use_spot:
+            parts.append('[spot]')
+        if self._region:
+            parts.append(f'region={self._region}')
+        if self._zone:
+            parts.append(f'zone={self._zone}')
+        if not parts:
+            parts.append('default')
+        return f'Resources({", ".join(parts)})'
+
+    def __eq__(self, other: object) -> bool:
+        if not isinstance(other, Resources):
+            return NotImplemented
+        return self.to_yaml_config() == other.to_yaml_config()
+
+    def __hash__(self) -> int:
+        import json
+        return hash(json.dumps(self.to_yaml_config(), sort_keys=True))

@@ -1,0 +1,235 @@
+"""Task: a unit of work = run + setup + resources + files + env.
+
+Functional parity with reference ``sky/task.py`` (``Task`` at
+``sky/task.py:171``, ``from_yaml_config`` at ``:347``). TPU-first differences:
+
+- ``num_nodes`` means *CPU VM count* for CPU clusters. For TPU tasks the host
+  count comes from the slice topology (``Resources.tpu.num_hosts``) — the
+  slice IS the gang, you don't pick node counts separately.
+- Env interpolation supports ``$VAR``/``${VAR}`` from ``envs`` at YAML load.
+"""
+from __future__ import annotations
+
+import os
+import re
+from typing import Any, Callable, Dict, List, Optional, Union
+
+import yaml
+
+from skypilot_tpu import exceptions
+from skypilot_tpu import resources as resources_lib
+
+_VALID_NAME_RE = re.compile(r'^[a-zA-Z0-9][a-zA-Z0-9._-]*$')
+
+CommandOrGenerator = Union[None, str, Callable[[int, List[str]], Optional[str]]]
+
+
+class Task:
+    """A coarse-grained unit of work submitted to the framework."""
+
+    def __init__(
+        self,
+        name: Optional[str] = None,
+        *,
+        setup: Optional[str] = None,
+        run: CommandOrGenerator = None,
+        envs: Optional[Dict[str, str]] = None,
+        workdir: Optional[str] = None,
+        num_nodes: Optional[int] = None,
+        file_mounts: Optional[Dict[str, str]] = None,
+        storage_mounts: Optional[Dict[str, Any]] = None,
+    ):
+        self.name = name
+        if name is not None and not _VALID_NAME_RE.match(name):
+            raise exceptions.InvalidTaskError(f'Invalid task name: {name!r}')
+        self.setup = setup
+        self.run = run
+        self.envs = dict(envs) if envs else {}
+        self.workdir = workdir
+        self.num_nodes = int(num_nodes) if num_nodes else 1
+        # dst path on cluster -> src (local path or bucket URI)
+        self.file_mounts: Dict[str, str] = dict(file_mounts) if file_mounts else {}
+        # dst path -> storage config dict (resolved to Storage objects lazily
+        # to keep the spec layer import-light)
+        self.storage_mounts: Dict[str, Any] = (
+            dict(storage_mounts) if storage_mounts else {})
+        self._resources: List[resources_lib.Resources] = [
+            resources_lib.Resources()]
+        self._resources_ordered = False
+        # Managed-jobs fields
+        self.max_restarts_on_errors = 0
+        # DAG wiring (populated by Dag)
+        self._dag = None
+
+    # ---------------- resources ----------------
+    @property
+    def resources(self) -> List[resources_lib.Resources]:
+        return list(self._resources)
+
+    @property
+    def resources_ordered(self) -> bool:
+        """True when the candidate list order is a strict user preference."""
+        return self._resources_ordered
+
+    def set_resources(
+        self,
+        resources: Union[resources_lib.Resources,
+                         List[resources_lib.Resources]],
+        ordered: bool = False,
+    ) -> 'Task':
+        if isinstance(resources, resources_lib.Resources):
+            resources = [resources]
+        if not resources:
+            raise exceptions.InvalidTaskError('Empty resources list.')
+        self._resources = list(resources)
+        self._resources_ordered = ordered
+        self._validate_topology()
+        return self
+
+    @property
+    def best_resources(self) -> resources_lib.Resources:
+        """The first candidate (after optimization, the chosen one)."""
+        return self._resources[0]
+
+    def _validate_topology(self) -> None:
+        for res in self._resources:
+            if res.is_tpu and self.num_nodes > 1:
+                raise exceptions.InvalidTaskError(
+                    'TPU tasks take their host count from the slice topology '
+                    f'({res.tpu}); do not set num_nodes (got '
+                    f'{self.num_nodes}). Use a larger slice instead.')
+
+    def num_hosts(self, resources: Optional[resources_lib.Resources] = None
+                  ) -> int:
+        """Hosts the run command executes on, for the chosen resources."""
+        res = resources or self.best_resources
+        if res.is_tpu:
+            return res.tpu.num_hosts
+        return self.num_nodes
+
+    # ---------------- env ----------------
+    def update_envs(self, envs: Dict[str, str]) -> 'Task':
+        self.envs.update(envs)
+        return self
+
+    # ---------------- YAML ----------------
+    @classmethod
+    def from_yaml_config(cls, config: Dict[str, Any]) -> 'Task':
+        from skypilot_tpu.utils import schemas
+        config = dict(config or {})
+        schemas.validate(config, schemas.TASK_SCHEMA, 'task')
+        envs = config.get('envs') or {}
+        if not isinstance(envs, dict):
+            raise exceptions.InvalidTaskError('envs must be a mapping.')
+        envs = {str(k): '' if v is None else str(v) for k, v in envs.items()}
+        config = _interpolate_envs(config, envs)
+
+        file_mounts = {}
+        storage_mounts = {}
+        for dst, src in (config.get('file_mounts') or {}).items():
+            if isinstance(src, dict):
+                storage_mounts[dst] = src
+            else:
+                file_mounts[dst] = src
+
+        task = cls(
+            name=config.get('name'),
+            setup=config.get('setup'),
+            run=config.get('run'),
+            envs=envs,
+            workdir=config.get('workdir'),
+            num_nodes=config.get('num_nodes'),
+            file_mounts=file_mounts,
+            storage_mounts=storage_mounts,
+        )
+        res_cfg = config.get('resources')
+        ordered = bool(res_cfg) and 'ordered' in res_cfg
+        task.set_resources(
+            resources_lib.Resources.from_yaml_config_list(res_cfg),
+            ordered=ordered)
+        # 'service' section is parsed by serve layer; keep it attached.
+        task.service = config.get('service')
+        return task
+
+    @classmethod
+    def from_yaml(cls, path: str) -> 'Task':
+        with open(os.path.expanduser(path), 'r', encoding='utf-8') as f:
+            config = yaml.safe_load(f)
+        if config is None:
+            config = {}
+        if not isinstance(config, dict):
+            raise exceptions.InvalidTaskError(
+                f'YAML at {path} must be a mapping, got {type(config)}')
+        return cls.from_yaml_config(config)
+
+    def to_yaml_config(self) -> Dict[str, Any]:
+        cfg: Dict[str, Any] = {}
+        if self.name:
+            cfg['name'] = self.name
+        if self.workdir:
+            cfg['workdir'] = self.workdir
+        if self.num_nodes != 1:
+            cfg['num_nodes'] = self.num_nodes
+        if len(self._resources) == 1:
+            res_cfg = self._resources[0].to_yaml_config()
+        else:
+            key = 'ordered' if self._resources_ordered else 'any_of'
+            res_cfg = {key: [r.to_yaml_config() for r in self._resources]}
+        if res_cfg:
+            cfg['resources'] = res_cfg
+        if self.envs:
+            cfg['envs'] = dict(self.envs)
+        mounts: Dict[str, Any] = {}
+        mounts.update(self.file_mounts)
+        mounts.update(self.storage_mounts)
+        if mounts:
+            cfg['file_mounts'] = mounts
+        if self.setup:
+            cfg['setup'] = self.setup
+        if self.run is not None and isinstance(self.run, str):
+            cfg['run'] = self.run
+        if getattr(self, 'service', None):
+            cfg['service'] = self.service
+        return cfg
+
+    def to_yaml(self) -> str:
+        return yaml.safe_dump(self.to_yaml_config(), sort_keys=False)
+
+    # ---------------- DAG sugar ----------------
+    def __rshift__(self, other: 'Task') -> 'Task':
+        """``a >> b``: add edge a->b in the ambient DAG context.
+
+        Reference: ``sky/task.py:1186``.
+        """
+        from skypilot_tpu import dag as dag_lib
+        dag_lib._current_dag_add_edge(self, other)
+        return other
+
+    def __repr__(self) -> str:
+        name = self.name or '<unnamed>'
+        return f'Task({name}, resources={self._resources[0]!r})'
+
+
+_ENV_RE = re.compile(r'\$(\w+)|\$\{(\w+)\}')
+
+
+def _interpolate_envs(obj: Any, envs: Dict[str, str]) -> Any:
+    """Substitute $VAR / ${VAR} from envs in all string values except run/setup
+    scripts (those get the env injected at execution time instead)."""
+    def sub(s: str) -> str:
+        def repl(m: re.Match) -> str:
+            key = m.group(1) or m.group(2)
+            return envs.get(key, m.group(0))
+        return _ENV_RE.sub(repl, s)
+
+    def walk(o: Any, key_path: tuple) -> Any:
+        if isinstance(o, dict):
+            return {k: walk(v, key_path + (k,)) for k, v in o.items()}
+        if isinstance(o, list):
+            return [walk(v, key_path) for v in o]
+        if isinstance(o, str) and key_path and key_path[0] not in (
+                'run', 'setup', 'envs'):
+            return sub(o)
+        return o
+
+    return walk(obj, ())

@@ -1,0 +1,183 @@
+"""JSON schemas for task YAML / resources / config validation.
+
+Functional parity with reference ``sky/utils/schemas.py`` (987 LoC of JSON
+schema). We validate with ``jsonschema`` at YAML load; the dataclasses also
+validate, so the schema focuses on early, readable errors.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import jsonschema
+
+from skypilot_tpu import exceptions
+
+
+def _resources_fields() -> Dict[str, Any]:
+    return {
+        'cloud': {'type': 'string'},
+        'instance_type': {'type': 'string'},
+        'accelerators': {
+            'anyOf': [{'type': 'string'},
+                      {'type': 'object',
+                       'additionalProperties': {'type': 'integer'}}]
+        },
+        'accelerator_args': {'type': 'object'},
+        'cpus': {'anyOf': [{'type': 'string'}, {'type': 'number'}]},
+        'memory': {'anyOf': [{'type': 'string'}, {'type': 'number'}]},
+        'use_spot': {'type': 'boolean'},
+        'spot_recovery': {'type': 'string'},
+        'job_recovery': {'anyOf': [{'type': 'string'}, {'type': 'object'}]},
+        'region': {'type': 'string'},
+        'zone': {'type': 'string'},
+        'image_id': {'type': 'string'},
+        'disk_size': {'type': 'integer'},
+        'disk_tier': {'type': 'string',
+                      'enum': ['low', 'medium', 'high', 'best']},
+        'ports': {'type': 'array',
+                  'items': {'anyOf': [{'type': 'integer'},
+                                      {'type': 'string'}]}},
+        'labels': {'type': 'object',
+                   'additionalProperties': {'type': 'string'}},
+    }
+
+
+RESOURCES_SCHEMA: Dict[str, Any] = {
+    'type': 'object',
+    'additionalProperties': False,
+    'properties': {
+        **_resources_fields(),
+        'any_of': {'type': 'array',
+                   'items': {'type': 'object',
+                             'properties': _resources_fields(),
+                             'additionalProperties': False}},
+        'ordered': {'type': 'array',
+                    'items': {'type': 'object',
+                              'properties': _resources_fields(),
+                              'additionalProperties': False}},
+    },
+}
+
+STORAGE_SCHEMA: Dict[str, Any] = {
+    'type': 'object',
+    'additionalProperties': False,
+    'properties': {
+        'name': {'type': 'string'},
+        'source': {'anyOf': [{'type': 'string'},
+                             {'type': 'array', 'items': {'type': 'string'}}]},
+        'store': {'type': 'string', 'enum': ['gcs', 's3', 'r2', 'azure']},
+        'mode': {'type': 'string', 'enum': ['MOUNT', 'COPY',
+                                            'mount', 'copy']},
+        'persistent': {'type': 'boolean'},
+    },
+}
+
+SERVICE_SCHEMA: Dict[str, Any] = {
+    'type': 'object',
+    'additionalProperties': False,
+    'required': ['readiness_probe'],
+    'properties': {
+        'readiness_probe': {
+            'anyOf': [
+                {'type': 'string'},
+                {'type': 'object',
+                 'additionalProperties': False,
+                 'properties': {
+                     'path': {'type': 'string'},
+                     'initial_delay_seconds': {'type': 'number'},
+                     'timeout_seconds': {'type': 'number'},
+                     'post_data': {'anyOf': [{'type': 'string'},
+                                             {'type': 'object'}]},
+                 }},
+            ]
+        },
+        'replica_policy': {
+            'type': 'object',
+            'additionalProperties': False,
+            'properties': {
+                'min_replicas': {'type': 'integer', 'minimum': 0},
+                'max_replicas': {'type': 'integer', 'minimum': 0},
+                'target_qps_per_replica': {'type': 'number'},
+                'upscale_delay_seconds': {'type': 'number'},
+                'downscale_delay_seconds': {'type': 'number'},
+                'base_ondemand_fallback_replicas': {'type': 'integer'},
+                'dynamic_ondemand_fallback': {'type': 'boolean'},
+            },
+        },
+        'replicas': {'type': 'integer', 'minimum': 0},
+    },
+}
+
+TASK_SCHEMA: Dict[str, Any] = {
+    'type': 'object',
+    'additionalProperties': False,
+    'properties': {
+        'name': {'type': 'string'},
+        'workdir': {'type': 'string'},
+        'num_nodes': {'type': 'integer', 'minimum': 1},
+        'resources': RESOURCES_SCHEMA,
+        'envs': {'type': 'object'},
+        'file_mounts': {
+            'type': 'object',
+            'additionalProperties': {
+                'anyOf': [{'type': 'string'}, STORAGE_SCHEMA]
+            },
+        },
+        'setup': {'type': 'string'},
+        'run': {'type': 'string'},
+        'service': SERVICE_SCHEMA,
+    },
+}
+
+CONFIG_SCHEMA: Dict[str, Any] = {
+    'type': 'object',
+    'additionalProperties': False,
+    'properties': {
+        'jobs': {
+            'type': 'object',
+            'additionalProperties': False,
+            'properties': {
+                'controller': {
+                    'type': 'object',
+                    'properties': {'resources': RESOURCES_SCHEMA},
+                },
+            },
+        },
+        'serve': {
+            'type': 'object',
+            'additionalProperties': False,
+            'properties': {
+                'controller': {
+                    'type': 'object',
+                    'properties': {'resources': RESOURCES_SCHEMA},
+                },
+            },
+        },
+        'gcp': {
+            'type': 'object',
+            'additionalProperties': False,
+            'properties': {
+                'project_id': {'type': 'string'},
+                'vpc_name': {'type': 'string'},
+                'use_internal_ips': {'type': 'boolean'},
+                'ssh_proxy_command': {'type': 'string'},
+                'labels': {'type': 'object'},
+                'reserved': {'type': 'boolean'},
+                'queued_resource_timeout_seconds': {'type': 'number'},
+            },
+        },
+        'local': {'type': 'object'},
+        'admin_policy': {'type': 'string'},
+        'allowed_clouds': {'type': 'array', 'items': {'type': 'string'}},
+    },
+}
+
+
+def validate(config: Dict[str, Any], schema: Dict[str, Any],
+             what: str = 'task') -> None:
+    try:
+        jsonschema.validate(config, schema)
+    except jsonschema.ValidationError as e:
+        path = '.'.join(str(p) for p in e.absolute_path) or '<root>'
+        raise exceptions.InvalidTaskError(
+            f'Invalid {what} YAML at {path}: {e.message}') from None

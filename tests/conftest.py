@@ -1,0 +1,22 @@
+"""Test configuration.
+
+Multi-chip logic is tested on a virtual 8-device CPU mesh (the approach
+SURVEY.md §4 recommends over the reference's monkeypatched-catalog-only
+strategy): env vars must be set before jax initializes its backends.
+"""
+import os
+
+os.environ.setdefault('JAX_PLATFORMS', 'cpu')
+prev = os.environ.get('XLA_FLAGS', '')
+if 'xla_force_host_platform_device_count' not in prev:
+    os.environ['XLA_FLAGS'] = (
+        prev + ' --xla_force_host_platform_device_count=8').strip()
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture()
+def tmp_state_dir(tmp_path, monkeypatch):
+    """Isolate global sqlite state per test."""
+    monkeypatch.setenv('SKYTPU_STATE_DIR', str(tmp_path / 'state'))
+    yield tmp_path / 'state'

@@ -1,0 +1,227 @@
+"""Spec-layer tests: accelerators, Resources, Task, Dag.
+
+Modeled on reference tests/unit_tests/test_resources.py + test_dag.py.
+"""
+import pytest
+
+from skypilot_tpu import Dag, Resources, Task, exceptions
+from skypilot_tpu import accelerators as accel_lib
+
+
+class TestTpuParsing:
+
+    def test_v5e_single_host(self):
+        t = accel_lib.parse_tpu('tpu-v5e-8')
+        assert t.num_chips == 8
+        assert t.num_hosts == 1
+        assert t.chips_per_host == 8
+        assert t.name == 'tpu-v5e-8'
+        assert not t.is_pod
+
+    def test_v5e_pod(self):
+        t = accel_lib.parse_tpu('tpu-v5e-64')
+        assert t.num_chips == 64
+        assert t.num_hosts == 8
+        assert t.is_pod
+
+    def test_v4_names_by_cores(self):
+        t = accel_lib.parse_tpu('tpu-v4-8')
+        assert t.num_chips == 4
+        assert t.num_cores == 8
+        assert t.num_hosts == 1
+
+    def test_v5p_pod(self):
+        t = accel_lib.parse_tpu('tpu-v5p-64')
+        assert t.num_chips == 32
+        assert t.num_hosts == 8
+
+    def test_v5litepod_alias(self):
+        t = accel_lib.parse_tpu('tpu-v5litepod-16')
+        assert t.name == 'tpu-v5e-16'
+        assert t.num_hosts == 2
+
+    def test_v6e(self):
+        t = accel_lib.parse_tpu('tpu-v6e-16')
+        assert t.num_chips == 16
+        assert t.num_hosts == 2
+
+    def test_accelerator_api_type(self):
+        assert accel_lib.parse_tpu('tpu-v5e-8').accelerator_type == 'v5litepod-8'
+        assert accel_lib.parse_tpu('tpu-v4-8').accelerator_type == 'v4-8'
+
+    def test_bad_names(self):
+        with pytest.raises(exceptions.InvalidResourcesError):
+            accel_lib.parse_tpu('tpu-v9-8')
+        with pytest.raises(exceptions.InvalidResourcesError):
+            accel_lib.parse_tpu('gpu-a100')
+        with pytest.raises(exceptions.InvalidResourcesError):
+            accel_lib.parse_tpu('tpu-v4-7')  # not multiple of cores/chip
+
+    def test_mesh_factorization(self):
+        assert accel_lib.parse_tpu('tpu-v5e-16').mesh_shape_2d() == (4, 4)
+        assert accel_lib.parse_tpu('tpu-v5e-8').mesh_shape_2d() == (2, 4)
+
+
+class TestResources:
+
+    def test_tpu_resources(self):
+        r = Resources(accelerators='tpu-v5e-8')
+        assert r.is_tpu
+        assert r.cloud == 'gcp'
+        assert r.tpu.num_chips == 8
+        assert r.accelerators == {'tpu-v5e-8': 1}
+
+    def test_tpu_wrong_cloud(self):
+        with pytest.raises(exceptions.InvalidResourcesError):
+            Resources(cloud='aws', accelerators='tpu-v5e-8')
+
+    def test_gpu_resources(self):
+        r = Resources(accelerators={'A100': 8}, use_spot=True)
+        assert not r.is_tpu
+        assert r.accelerators == {'A100': 8}
+        assert r.use_spot
+
+    def test_gpu_string_count(self):
+        r = Resources(accelerators='a100:4')
+        assert r.accelerators == {'A100': 4}
+
+    def test_cpus_at_least(self):
+        r = Resources(cpus='8+')
+        assert r.cpus == '8+'
+
+    def test_copy_override(self):
+        r = Resources(accelerators='tpu-v5e-8', region='us-central1')
+        r2 = r.copy(use_spot=True)
+        assert r2.use_spot and r2.region == 'us-central1' and r2.is_tpu
+        assert not r.use_spot
+
+    def test_yaml_roundtrip(self):
+        r = Resources(accelerators='tpu-v5p-16', use_spot=True,
+                      zone='us-east5-a', disk_size=512)
+        r2 = Resources.from_yaml_config(r.to_yaml_config())
+        assert r == r2
+
+    def test_any_of_list(self):
+        lst = Resources.from_yaml_config_list({
+            'use_spot': True,
+            'any_of': [{'accelerators': 'tpu-v5e-8'},
+                       {'accelerators': 'A100:8'}],
+        })
+        assert len(lst) == 2
+        assert lst[0].is_tpu and lst[0].use_spot
+        assert lst[1].accelerators == {'A100': 8} and lst[1].use_spot
+
+    def test_less_demanding(self):
+        want = Resources(accelerators='tpu-v5e-8')
+        have = Resources(accelerators='tpu-v5e-8', region='us-central1')
+        assert want.less_demanding_than(have)
+        assert not Resources(accelerators='tpu-v5e-16').less_demanding_than(have)
+
+
+class TestTask:
+
+    def test_from_yaml_config(self):
+        task = Task.from_yaml_config({
+            'name': 'train',
+            'resources': {'accelerators': 'tpu-v5e-16'},
+            'envs': {'MODEL': 'llama3-8b'},
+            'run': 'python train.py --model $MODEL',
+        })
+        assert task.name == 'train'
+        assert task.best_resources.tpu.num_chips == 16
+        assert task.num_hosts() == 2
+
+    def test_env_interpolation_in_non_script_fields(self):
+        task = Task.from_yaml_config({
+            'envs': {'BUCKET': 'gs://ckpts'},
+            'file_mounts': {'/ckpt': {'source': '$BUCKET', 'mode': 'MOUNT'}},
+        })
+        assert task.storage_mounts['/ckpt']['source'] == 'gs://ckpts'
+
+    def test_tpu_task_rejects_num_nodes(self):
+        with pytest.raises(exceptions.InvalidTaskError):
+            Task.from_yaml_config({
+                'num_nodes': 2,
+                'resources': {'accelerators': 'tpu-v5e-8'},
+            })
+
+    def test_cpu_task_num_nodes(self):
+        task = Task.from_yaml_config({'num_nodes': 4, 'run': 'hostname'})
+        assert task.num_hosts() == 4
+
+    def test_unknown_field_rejected(self):
+        with pytest.raises(exceptions.InvalidTaskError):
+            Task.from_yaml_config({'runs': 'typo'})
+
+    def test_yaml_roundtrip(self):
+        cfg = {
+            'name': 'serve',
+            'resources': {'accelerators': 'tpu-v5e-8', 'use_spot': True},
+            'run': 'python serve.py',
+        }
+        task = Task.from_yaml_config(cfg)
+        task2 = Task.from_yaml_config(task.to_yaml_config())
+        assert task2.name == 'serve'
+        assert task2.best_resources == task.best_resources
+
+
+class TestDag:
+
+    def test_chain(self):
+        with Dag() as dag:
+            a = Task(name='a')
+            b = Task(name='b')
+            c = Task(name='c')
+            dag.add(a)
+            a >> b >> c
+        assert dag.is_chain()
+        assert [t.name for t in dag.topological_order()] == ['a', 'b', 'c']
+
+    def test_not_chain(self):
+        with Dag() as dag:
+            a, b, c = Task(name='a'), Task(name='b'), Task(name='c')
+            a >> b
+            a >> c
+        assert not dag.is_chain()
+
+    def test_cycle_detection(self):
+        with Dag() as dag:
+            a, b = Task(name='a'), Task(name='b')
+            a >> b
+            b >> a
+        with pytest.raises(exceptions.InvalidDagError):
+            dag.validate()
+
+
+class TestCatalog:
+
+    def test_tpu_entries(self):
+        from skypilot_tpu import catalog
+        tpus = catalog.get_tpus()
+        assert 'tpu-v5e-8' in tpus
+        assert 'tpu-v5p-128' in tpus
+
+    def test_zones_sorted_by_price(self):
+        from skypilot_tpu import catalog
+        entries = catalog.zones_for_accelerator('tpu-v5e-8')
+        assert entries
+        prices = [e.price for e in entries]
+        assert prices == sorted(prices)
+
+    def test_spot_cheaper(self):
+        from skypilot_tpu import catalog
+        e = catalog.zones_for_accelerator('tpu-v5e-8')[0]
+        assert e.spot_price < e.price
+
+    def test_cpu_instance_pick(self):
+        from skypilot_tpu import catalog
+        e = catalog.get_instance_type_for_cpus(cpus=8)
+        assert e is not None
+        assert e.vcpus >= 8
+        assert e.accelerator_name is None
+
+    def test_hourly_cost_tpu(self):
+        from skypilot_tpu import catalog
+        cost = catalog.get_hourly_cost('TPU-VM',
+                                       accelerator_name='tpu-v5e-8')
+        assert cost > 0
