@@ -1,0 +1,356 @@
+"""Continuous-batching inference engine (JetStream-class serving core).
+
+The reference serves models by launching external engines (vLLM/SGLang/
+JetStream recipes under ``llm/``); this is the in-tree TPU engine those
+recipes become. Design:
+
+- **Slot-based continuous batching**: a fixed decode batch of ``max_batch``
+  slots over one batched KV cache ([layers, slots, max_seq, kv_heads, d],
+  per-slot lengths). Finished slots are immediately refilled from the queue
+  — the decode step shape never changes, so XLA compiles exactly two
+  programs (prefill per length-bucket, decode) and the MXU sees a fixed
+  [slots, 1] batch every step.
+- **Prefill/decode split**: prefill runs per-request at bucketed lengths
+  (powers of two — bounded compile count), writes its KV rows into the
+  slot; decode advances all active slots one token per step.
+- **Sampling**: greedy / temperature / top-k, jitted with the decode step.
+- **Sharding**: with a mesh, params shard by their logical axes (tp for
+  serving) and the KV cache by ``cache_logical_axes`` — batch over data
+  axes, kv heads over tp.
+
+The cache-capacity contract (llama.forward docstring) is enforced here:
+requests whose prompt+max_new_tokens exceed ``max_seq`` are rejected, and
+decode stops at capacity.
+"""
+from __future__ import annotations
+
+import dataclasses
+import functools
+import queue
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from skypilot_tpu.models import llama
+from skypilot_tpu.models.configs import ModelConfig
+from skypilot_tpu.parallel import mesh as mesh_lib
+
+
+@dataclasses.dataclass
+class Request:
+    request_id: int
+    prompt: List[int]
+    max_new_tokens: int
+    temperature: float = 0.0
+    top_k: int = 0
+    eos_id: Optional[int] = None
+    # filled by the engine
+    output: List[int] = dataclasses.field(default_factory=list)
+    submit_time: float = 0.0
+    first_token_time: Optional[float] = None
+    finish_time: Optional[float] = None
+
+    @property
+    def ttft_ms(self) -> Optional[float]:
+        if self.first_token_time is None:
+            return None
+        return (self.first_token_time - self.submit_time) * 1e3
+
+
+def _bucket_len(n: int, minimum: int = 64) -> int:
+    b = minimum
+    while b < n:
+        b *= 2
+    return b
+
+
+class InferenceEngine:
+    """Synchronous engine core: callers drive ``step()``; the serve layer
+    wraps it in an HTTP loop."""
+
+    def __init__(self, cfg: ModelConfig, params: Optional[Any] = None,
+                 *, max_batch: int = 8, max_seq: int = 1024,
+                 mesh: Optional[Any] = None, rng_seed: int = 0,
+                 attn_impl: str = 'auto'):
+        self.cfg = cfg
+        self.max_batch = max_batch
+        self.max_seq = max_seq
+        self.mesh = mesh
+        self.attn_impl = attn_impl
+        self._rng = jax.random.PRNGKey(rng_seed)
+
+        if params is None:
+            params = llama.init_params(jax.random.PRNGKey(0), cfg)
+        if mesh is not None:
+            shardings = mesh_lib.tree_shardings(
+                llama.param_logical_axes(cfg), mesh)
+            params = jax.device_put(params, shardings)
+        self.params = params
+
+        self.cache = llama.KVCache.create(cfg, batch=max_batch,
+                                          max_seq=max_seq)
+        if mesh is not None:
+            cache_sh = mesh_lib.tree_shardings(
+                jax.tree.map(lambda a: a,
+                             llama.cache_logical_axes(),
+                             is_leaf=lambda x: isinstance(x, tuple)),
+                mesh)
+            self.cache = jax.device_put(self.cache, cache_sh)
+
+        # slot bookkeeping (host side)
+        self._slots: List[Optional[Request]] = [None] * max_batch
+        self._queue: 'queue.Queue[Request]' = queue.Queue()
+        self._next_id = 0
+        self._finished: Dict[int, Request] = {}
+        # Host mirror of per-slot state; device cache.length is authoritative
+        # for attention masking.
+        self._slot_len = np.zeros(max_batch, np.int64)
+        self._cur_token = np.zeros(max_batch, np.int32)
+
+        self._decode_fn = self._build_decode()
+        self._prefill_fns: Dict[int, Any] = {}
+
+    # ------------------------------------------------------------------
+    # Compiled steps
+    # ------------------------------------------------------------------
+    def _build_decode(self):
+        """Multi-step decode: ``horizon`` steps fused into one on-device
+        lax.scan per host sync. Decode through the PJRT tunnel costs ~90ms
+        per host round trip; fusing N steps amortizes it to ~nothing and is
+        the same trick a production engine uses to hide dispatch latency."""
+        cfg, attn_impl = self.cfg, self.attn_impl
+
+        @functools.partial(jax.jit, donate_argnums=(1,),
+                           static_argnames=('horizon',))
+        def decode_steps(params, cache, tokens, rng, temps, topks, active,
+                         horizon):
+            def one_step(carry, step_rng):
+                cache, tokens = carry
+                logits, new_cache = llama.forward(
+                    params, tokens[:, None], cfg, cache=cache,
+                    attn_impl=attn_impl)
+                logits = logits[:, 0]                 # [slots, vocab]
+                next_greedy = jnp.argmax(logits, -1).astype(jnp.int32)
+                scaled = logits / jnp.maximum(temps, 1e-6)[:, None]
+                thr = _topk_threshold(scaled, topks)
+                masked = jnp.where(scaled >= thr, scaled, -jnp.inf)
+                sampled = jax.random.categorical(
+                    step_rng, masked).astype(jnp.int32)
+                nxt = jnp.where(temps > 0, sampled, next_greedy)
+                return (new_cache, nxt), nxt
+
+            rngs = jax.random.split(rng, horizon)
+            (cache, _), toks = jax.lax.scan(one_step, (cache, tokens), rngs)
+            # inactive slots don't advance their cache length
+            new_len = jnp.where(active, cache.length,
+                                cache.length - horizon)
+            cache = cache._replace(length=new_len)
+            return toks.T, cache                      # [slots, horizon]
+
+        return decode_steps
+
+    def _get_prefill(self, bucket: int, n: int):
+        """Batched prefill: n prompts (padded to one bucket) in one device
+        call that computes KV, scatters it into the requested slots of the
+        big cache, and returns the first sampled token per prompt. One host
+        round trip per admit cycle instead of three per request."""
+        key = (bucket, n)
+        if key in self._prefill_fns:
+            return self._prefill_fns[key]
+        cfg, attn_impl = self.cfg, self.attn_impl
+
+        @functools.partial(jax.jit, donate_argnums=(1,))
+        def prefill(params, big_cache, tokens, true_lens, slots):
+            """tokens [n, bucket]; true_lens [n]; slots [n] target rows."""
+            cache = llama.KVCache.create(cfg, batch=n, max_seq=bucket)
+            logits, cache2 = llama.forward(params, tokens, cfg, cache=cache,
+                                           attn_impl=attn_impl)
+            last = jnp.take_along_axis(
+                logits, (true_lens - 1)[:, None, None], axis=1)[:, 0]
+            next_tokens = jnp.argmax(last, -1).astype(jnp.int32)
+            # Scatter KV rows + lengths into the slot cache.
+            ck = big_cache.k.at[:, slots, :bucket].set(
+                cache2.k.astype(big_cache.k.dtype))
+            cv = big_cache.v.at[:, slots, :bucket].set(
+                cache2.v.astype(big_cache.v.dtype))
+            length = big_cache.length.at[slots].set(true_lens)
+            return next_tokens, llama.KVCache(k=ck, v=cv, length=length)
+
+        self._prefill_fns[key] = prefill
+        return prefill
+
+    # ------------------------------------------------------------------
+    # Public API
+    # ------------------------------------------------------------------
+    def add_request(self, prompt: List[int], max_new_tokens: int = 128,
+                    temperature: float = 0.0, top_k: int = 0,
+                    eos_id: Optional[int] = None) -> int:
+        if len(prompt) + max_new_tokens > self.max_seq:
+            raise ValueError(
+                f'prompt ({len(prompt)}) + max_new_tokens ({max_new_tokens}) '
+                f'exceeds engine max_seq ({self.max_seq})')
+        if not prompt:
+            raise ValueError('empty prompt')
+        req = Request(request_id=self._next_id, prompt=list(prompt),
+                      max_new_tokens=max_new_tokens, temperature=temperature,
+                      top_k=top_k, eos_id=eos_id, submit_time=time.time())
+        self._next_id += 1
+        self._queue.put(req)
+        return req.request_id
+
+    def has_work(self) -> bool:
+        return (not self._queue.empty()
+                or any(r is not None for r in self._slots))
+
+    @property
+    def num_active(self) -> int:
+        return sum(r is not None for r in self._slots)
+
+    def step(self, horizon: int = 1) -> List[Tuple[int, int, bool]]:
+        """Admit waiting requests into free slots (prefill), then run up to
+        ``horizon`` fused decode steps (one host sync). Returns
+        [(request_id, token, finished), ...] in emission order. Tokens a
+        slot produces after its EOS/max_new_tokens within the horizon are
+        discarded host-side."""
+        self._admit()
+        return self._decode(horizon)
+
+    # ------------------------------------------------------------------
+    _PREFILL_N_BUCKETS = (1, 2, 4, 8, 16, 32)
+
+    def _admit(self) -> None:
+        """Admit as many queued requests as free slots allow, prefilling
+        them in one batched device call."""
+        free = [s for s in range(self.max_batch) if self._slots[s] is None]
+        batch: List[Tuple[int, Request]] = []
+        for slot in free:
+            try:
+                batch.append((slot, self._queue.get_nowait()))
+            except queue.Empty:
+                break
+        if not batch:
+            return
+        # Pad request count to a compiled bucket (extra rows re-prefill the
+        # first request into its own slot — harmless duplicate writes).
+        n = 1
+        for b in self._PREFILL_N_BUCKETS:
+            if b >= len(batch):
+                n = b
+                break
+        else:
+            n = self._PREFILL_N_BUCKETS[-1]
+        bucket = min(_bucket_len(max(len(r.prompt) for _, r in batch)),
+                     self.max_seq)
+        prefill = self._get_prefill(bucket, n)
+
+        tokens = np.zeros((n, bucket), np.int32)
+        true_lens = np.zeros(n, np.int32)
+        slots = np.zeros(n, np.int32)
+        for i in range(n):
+            slot, req = batch[min(i, len(batch) - 1)]
+            tokens[i, :len(req.prompt)] = req.prompt
+            true_lens[i] = len(req.prompt)
+            slots[i] = slot
+        next_tokens, self.cache = prefill(
+            self.params, self.cache, jnp.asarray(tokens),
+            jnp.asarray(true_lens), jnp.asarray(slots))
+        next_tokens = np.asarray(next_tokens)
+        now = time.time()
+        for i, (slot, req) in enumerate(batch):
+            token = int(next_tokens[i])
+            req.first_token_time = now
+            req.output.append(token)
+            self._slots[slot] = req
+            self._slot_len[slot] = len(req.prompt)
+            self._cur_token[slot] = token
+            self._maybe_finish(slot, token)
+
+    _HORIZON_BUCKETS = (1, 2, 4, 8, 16, 32, 64)
+
+    def _decode(self, horizon: int = 1) -> List[Tuple[int, int, bool]]:
+        active = np.array([r is not None for r in self._slots])
+        if not active.any():
+            return []
+        # Cap the horizon by remaining KV capacity of active slots (+1 for
+        # the token written during the step), then round down to a compiled
+        # bucket to bound program count.
+        cap = int(self.max_seq - 1 -
+                  max(self._slot_len[s] for s in range(self.max_batch)
+                      if self._slots[s] is not None))
+        horizon = max(1, min(horizon, cap))
+        for b in reversed(self._HORIZON_BUCKETS):
+            if b <= horizon:
+                horizon = b
+                break
+
+        temps = np.array([r.temperature if r else 0.0 for r in self._slots],
+                         np.float32)
+        topks = np.array([r.top_k if r else 0 for r in self._slots],
+                         np.int32)
+        self._rng, rng = jax.random.split(self._rng)
+        toks, self.cache = self._decode_fn(
+            self.params, self.cache, jnp.asarray(self._cur_token), rng,
+            jnp.asarray(temps), jnp.asarray(topks), jnp.asarray(active),
+            horizon)
+        toks = np.asarray(toks)                       # [slots, horizon]
+
+        events: List[Tuple[int, int, bool]] = []
+        for slot, req in enumerate(self._slots):
+            if req is None:
+                continue
+            for i in range(horizon):
+                token = int(toks[slot, i])
+                req.output.append(token)
+                self._cur_token[slot] = token
+                self._slot_len[slot] += 1
+                finished = self._maybe_finish(slot, token)
+                events.append((req.request_id, token, finished))
+                if finished:
+                    break
+        return events
+
+    def _maybe_finish(self, slot: int, token: int) -> bool:
+        req = self._slots[slot]
+        done = (len(req.output) >= req.max_new_tokens
+                or (req.eos_id is not None and token == req.eos_id)
+                or len(req.prompt) + len(req.output) >= self.max_seq)
+        if done:
+            req.finish_time = time.time()
+            self._finished[req.request_id] = req
+            self._slots[slot] = None
+            self._slot_len[slot] = 0
+        return done
+
+    def get_finished(self, request_id: int) -> Optional[Request]:
+        return self._finished.get(request_id)
+
+    def run_to_completion(self, horizon: int = 32) -> Dict[int, Request]:
+        """Drive until queue + slots drain. Returns finished requests."""
+        while self.has_work():
+            self.step(horizon)
+        return dict(self._finished)
+
+
+def _topk_threshold(logits: jax.Array, topks: jax.Array) -> jax.Array:
+    """Per-row value of the k-th largest logit ([slots,1]); rows with k<=0
+    get -inf (no top-k filtering)."""
+    sorted_desc = -jnp.sort(-logits, axis=-1)
+    idx = jnp.clip(topks - 1, 0, logits.shape[-1] - 1)
+    thr = jnp.take_along_axis(sorted_desc, idx[:, None], axis=-1)
+    return jnp.where(topks[:, None] > 0, thr, -jnp.inf)
+
+
+@functools.partial(jax.jit, donate_argnums=(0,), static_argnames=('slot',))
+def _splice_slot(cache: llama.KVCache, k: jax.Array, v: jax.Array,
+                 slot: int, plen) -> llama.KVCache:
+    """Write prefilled KV [L, 1, bucket, h, d] into batched cache row
+    ``slot`` and set its length to plen."""
+    ck = jax.lax.dynamic_update_slice(
+        cache.k, k.astype(cache.k.dtype), (0, slot, 0, 0, 0))
+    cv = jax.lax.dynamic_update_slice(
+        cache.v, v.astype(cache.v.dtype), (0, slot, 0, 0, 0))
+    length = cache.length.at[slot].set(jnp.asarray(plen, jnp.int32))
+    return llama.KVCache(k=ck, v=cv, length=length)

@@ -1,0 +1,106 @@
+"""Model configurations for the in-tree model layer.
+
+The reference ships *recipes* that launch external frameworks
+(``llm/llama-3/llama3.yaml``, ``llm/mixtral/``); we ship the engines in-tree
+(SURVEY.md §2.3), so model configs are first-class here.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import jax.numpy as jnp
+
+
+@dataclasses.dataclass(frozen=True)
+class ModelConfig:
+    """A decoder-only transformer configuration (Llama-family)."""
+    name: str
+    vocab_size: int
+    dim: int                    # model/embedding width
+    n_layers: int
+    n_heads: int
+    n_kv_heads: int             # < n_heads => grouped-query attention
+    ffn_dim: int                # SwiGLU hidden width
+    max_seq_len: int = 8192
+    rope_theta: float = 500000.0
+    norm_eps: float = 1e-5
+    dtype: jnp.dtype = jnp.bfloat16
+    # MoE fields (None => dense FFN)
+    n_experts: Optional[int] = None
+    n_experts_per_token: int = 2
+    # Remat policy for training: 'none' | 'block' (checkpoint each layer)
+    remat: str = 'block'
+
+    @property
+    def head_dim(self) -> int:
+        return self.dim // self.n_heads
+
+    @property
+    def is_moe(self) -> bool:
+        return self.n_experts is not None
+
+    @property
+    def num_params(self) -> int:
+        """Approximate parameter count (embeddings + blocks + head)."""
+        d, f, v = self.dim, self.ffn_dim, self.vocab_size
+        kv_dim = self.n_kv_heads * self.head_dim
+        attn = d * d + 2 * d * kv_dim + d * d   # wq, wk, wv, wo
+        ffn = 3 * d * f
+        if self.is_moe:
+            ffn *= self.n_experts
+            ffn += d * self.n_experts           # router
+        per_layer = attn + ffn + 2 * d          # + 2 norms
+        return v * d * 2 + self.n_layers * per_layer + d
+
+    def flops_per_token(self, training: bool = False) -> float:
+        """~2*N matmul FLOPs per token fwd (6*N with backward)."""
+        n = self.num_params
+        if self.is_moe:
+            # only active experts count
+            d, f = self.dim, self.ffn_dim
+            dense_ffn = 3 * d * f * self.n_layers
+            n = n - dense_ffn * self.n_experts + dense_ffn * self.n_experts_per_token
+        return (6.0 if training else 2.0) * n
+
+
+# --- Presets ---------------------------------------------------------------
+def _cfg(**kw) -> ModelConfig:
+    return ModelConfig(**kw)
+
+
+LLAMA3_8B = _cfg(name='llama3-8b', vocab_size=128256, dim=4096, n_layers=32,
+                 n_heads=32, n_kv_heads=8, ffn_dim=14336)
+
+LLAMA3_70B = _cfg(name='llama3-70b', vocab_size=128256, dim=8192, n_layers=80,
+                  n_heads=64, n_kv_heads=8, ffn_dim=28672)
+
+LLAMA2_7B = _cfg(name='llama2-7b', vocab_size=32000, dim=4096, n_layers=32,
+                 n_heads=32, n_kv_heads=32, ffn_dim=11008, rope_theta=10000.0,
+                 max_seq_len=4096)
+
+# ~1.1B-param config that fits one 16GB v5e chip in bf16 with room for a KV
+# cache — the single-chip flagship for bench.py / __graft_entry__.entry().
+LLAMA3_1B = _cfg(name='llama3-1b', vocab_size=128256, dim=2048, n_layers=16,
+                 n_heads=32, n_kv_heads=8, ffn_dim=8192)
+
+MIXTRAL_8X7B = _cfg(name='mixtral-8x7b', vocab_size=32000, dim=4096,
+                    n_layers=32, n_heads=32, n_kv_heads=8, ffn_dim=14336,
+                    rope_theta=1000000.0, n_experts=8, n_experts_per_token=2)
+
+# Tiny configs for CPU-mesh tests.
+TINY = _cfg(name='tiny', vocab_size=256, dim=64, n_layers=2, n_heads=4,
+            n_kv_heads=2, ffn_dim=128, max_seq_len=128, remat='none')
+
+TINY_MOE = _cfg(name='tiny-moe', vocab_size=256, dim=64, n_layers=2, n_heads=4,
+                n_kv_heads=2, ffn_dim=128, max_seq_len=128, n_experts=4,
+                n_experts_per_token=2, remat='none')
+
+PRESETS = {c.name: c for c in [
+    LLAMA3_8B, LLAMA3_70B, LLAMA2_7B, LLAMA3_1B, MIXTRAL_8X7B, TINY, TINY_MOE]}
+
+
+def get_config(name: str) -> ModelConfig:
+    if name not in PRESETS:
+        raise ValueError(f'Unknown model {name!r}. Known: {sorted(PRESETS)}')
+    return PRESETS[name]

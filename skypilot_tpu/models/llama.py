@@ -1,0 +1,325 @@
+"""Llama-family decoder-only transformer, TPU-first.
+
+Replaces the reference's *recipe* approach (``llm/llama-3/llama3.yaml``
+launches vLLM; ``examples/tpu/v6e/`` launches HF+PyTorch/XLA) with an in-tree
+engine designed for XLA:
+
+- Pure-functional: params are a pytree; every entry has a parallel tuple of
+  logical axis names (``param_logical_axes``) mapped to mesh axes by
+  ``skypilot_tpu.parallel.mesh`` rules — FSDP/TP/SP/EP are sharding rules,
+  not code paths.
+- ``lax.scan`` over stacked layer params: one compiled block regardless of
+  depth (fast compiles, constant-size HLO), with optional per-layer
+  rematerialization (``jax.checkpoint``) for training.
+- bf16 activations/params, fp32 attention logits + softmax, fp32 norms —
+  the standard TPU numerics recipe.
+- GQA + RoPE + SwiGLU; MoE FFN is delegated to ``models.moe`` when
+  ``cfg.is_moe`` (Mixtral-class, expert-parallel over the mesh).
+"""
+from __future__ import annotations
+
+import functools
+from typing import Any, Dict, NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from skypilot_tpu.models.configs import ModelConfig
+from skypilot_tpu.ops.attention import attention
+
+Params = Dict[str, Any]
+
+
+# --------------------------------------------------------------------------
+# Initialization
+# --------------------------------------------------------------------------
+def _dense_init(key, shape, dtype, fan_in):
+    scale = fan_in ** -0.5
+    return (jax.random.normal(key, shape, jnp.float32) * scale).astype(dtype)
+
+
+def init_params(rng: jax.Array, cfg: ModelConfig) -> Params:
+    """Initialize parameters. Layer params are stacked on a leading
+    ``layers`` axis for lax.scan."""
+    d, hd = cfg.dim, cfg.head_dim
+    n_h, n_kv, f, L = cfg.n_heads, cfg.n_kv_heads, cfg.ffn_dim, cfg.n_layers
+    keys = jax.random.split(rng, 8)
+
+    def stack_init(key, shape, fan_in):
+        ks = jax.random.split(key, L)
+        return jnp.stack([_dense_init(k, shape, cfg.dtype, fan_in)
+                          for k in ks])
+
+    params: Params = {
+        'embed': _dense_init(keys[0], (cfg.vocab_size, d), cfg.dtype, d),
+        'unembed': _dense_init(keys[1], (d, cfg.vocab_size), cfg.dtype, d),
+        'final_norm': jnp.ones((d,), jnp.float32),
+        'layers': {
+            'attn_norm': jnp.ones((L, d), jnp.float32),
+            'ffn_norm': jnp.ones((L, d), jnp.float32),
+            'wq': stack_init(keys[2], (d, n_h, hd), d),
+            'wk': stack_init(keys[3], (d, n_kv, hd), d),
+            'wv': stack_init(keys[4], (d, n_kv, hd), d),
+            'wo': stack_init(keys[5], (n_h, hd, d), n_h * hd),
+        },
+    }
+    if cfg.is_moe:
+        from skypilot_tpu.models import moe
+        params['layers'].update(moe.init_moe_params(keys[6], cfg))
+    else:
+        k1, k2, k3 = jax.random.split(keys[6], 3)
+        params['layers'].update({
+            'w_gate': stack_init(k1, (d, f), d),
+            'w_up': stack_init(k2, (d, f), d),
+            'w_down': stack_init(k3, (f, d), f),
+        })
+    return params
+
+
+def param_logical_axes(cfg: ModelConfig) -> Params:
+    """Same structure as ``init_params``, with logical-axis tuples as leaves.
+
+    The leading scan axis is 'layers' (never sharded)."""
+    axes: Params = {
+        'embed': ('vocab', 'embed'),
+        'unembed': ('embed', 'vocab'),
+        'final_norm': ('norm',),
+        'layers': {
+            'attn_norm': ('layers', 'norm'),
+            'ffn_norm': ('layers', 'norm'),
+            'wq': ('layers', 'embed', 'heads', 'head_dim'),
+            'wk': ('layers', 'embed', 'kv_heads', 'head_dim'),
+            'wv': ('layers', 'embed', 'kv_heads', 'head_dim'),
+            'wo': ('layers', 'heads', 'head_dim', 'embed'),
+        },
+    }
+    if cfg.is_moe:
+        from skypilot_tpu.models import moe
+        axes['layers'].update(moe.moe_logical_axes(cfg))
+    else:
+        axes['layers'].update({
+            'w_gate': ('layers', 'embed', 'mlp'),
+            'w_up': ('layers', 'embed', 'mlp'),
+            'w_down': ('layers', 'mlp', 'embed'),
+        })
+    return axes
+
+
+# --------------------------------------------------------------------------
+# KV cache
+# --------------------------------------------------------------------------
+class KVCache(NamedTuple):
+    """Decode cache. k/v: [layers, batch, max_seq, kv_heads, head_dim];
+    length: [batch] valid entries per sequence (supports continuous
+    batching where sequences are at different positions)."""
+    k: jax.Array
+    v: jax.Array
+    length: jax.Array
+
+    @classmethod
+    def create(cls, cfg: ModelConfig, batch: int, max_seq: int) -> 'KVCache':
+        shape = (cfg.n_layers, batch, max_seq, cfg.n_kv_heads, cfg.head_dim)
+        return cls(k=jnp.zeros(shape, cfg.dtype),
+                   v=jnp.zeros(shape, cfg.dtype),
+                   length=jnp.zeros((batch,), jnp.int32))
+
+
+def cache_logical_axes() -> KVCache:
+    return KVCache(k=('layers', 'batch', None, 'kv_heads', 'head_dim'),
+                   v=('layers', 'batch', None, 'kv_heads', 'head_dim'),
+                   length=('batch',))
+
+
+def _write_kv(cache_k: jax.Array, new_k: jax.Array,
+              start: jax.Array) -> jax.Array:
+    """Insert new_k [b, s, h, d] into cache_k [b, S, h, d] at per-sequence
+    offsets start [b]."""
+
+    def one(c, n, s):
+        return lax.dynamic_update_slice(c, n, (s, 0, 0))
+
+    return jax.vmap(one)(cache_k, new_k, start)
+
+
+# --------------------------------------------------------------------------
+# Building blocks
+# --------------------------------------------------------------------------
+def rms_norm(x: jax.Array, w: jax.Array, eps: float) -> jax.Array:
+    xf = x.astype(jnp.float32)
+    var = jnp.mean(jnp.square(xf), axis=-1, keepdims=True)
+    return (xf * lax.rsqrt(var + eps) * w).astype(x.dtype)
+
+
+def rope(x: jax.Array, positions: jax.Array, theta: float) -> jax.Array:
+    """Rotary embedding. x: [b, s, h, d], positions: [b, s]."""
+    d = x.shape[-1]
+    freqs = theta ** (-jnp.arange(0, d, 2, dtype=jnp.float32) / d)
+    angles = positions[..., None].astype(jnp.float32) * freqs  # [b, s, d/2]
+    cos = jnp.cos(angles)[:, :, None, :]
+    sin = jnp.sin(angles)[:, :, None, :]
+    x1, x2 = jnp.split(x.astype(jnp.float32), 2, axis=-1)
+    out = jnp.concatenate([x1 * cos - x2 * sin, x1 * sin + x2 * cos],
+                          axis=-1)
+    return out.astype(x.dtype)
+
+
+def _in_mesh_context() -> bool:
+    """True when a `with mesh:` context is active. jax has no public
+    predicate for this; probe the known private locations and fail open
+    (no constraint) so a jax upgrade degrades perf, not correctness."""
+    try:
+        from jax._src import mesh as mesh_src
+        return not mesh_src.thread_resources.env.physical_mesh.empty
+    except Exception:
+        try:
+            from jax.interpreters import pxla
+            return not pxla.thread_resources.env.physical_mesh.empty
+        except Exception:
+            return False
+
+
+def _shard(x: jax.Array, *logical_axes: Optional[str]) -> jax.Array:
+    """Activation sharding constraint via logical axes; no-op outside a mesh
+    context (pure single-device runs, CPU unit tests)."""
+    if not _in_mesh_context():
+        return x
+    from skypilot_tpu.parallel.mesh import spec_for
+    return lax.with_sharding_constraint(x, spec_for(logical_axes))
+
+
+def _ffn(layer: Params, x: jax.Array, cfg: ModelConfig) -> jax.Array:
+    gate = jnp.einsum('bsd,df->bsf', x, layer['w_gate'])
+    up = jnp.einsum('bsd,df->bsf', x, layer['w_up'])
+    h = jax.nn.silu(gate.astype(jnp.float32)).astype(x.dtype) * up
+    h = _shard(h, 'batch', 'seq', 'mlp')
+    return jnp.einsum('bsf,fd->bsd', h, layer['w_down'])
+
+
+def _attn_block(layer: Params, x: jax.Array, cfg: ModelConfig,
+                positions: jax.Array,
+                cache_kv: Optional[Tuple[jax.Array, jax.Array]],
+                cache_len: Optional[jax.Array],
+                attn_impl: str):
+    """Returns (out, new_cache_kv). Cache arrays are per-layer [b,S,h,d]."""
+    q = jnp.einsum('bsd,dhk->bshk', x, layer['wq'])
+    k = jnp.einsum('bsd,dhk->bshk', x, layer['wk'])
+    v = jnp.einsum('bsd,dhk->bshk', x, layer['wv'])
+    q = _shard(q, 'batch', 'seq', 'heads', 'head_dim')
+    q = rope(q, positions, cfg.rope_theta)
+    k = rope(k, positions, cfg.rope_theta)
+
+    if cache_kv is None:
+        out = attention(q, k, v, causal=True, impl=attn_impl)
+        new_cache = None
+    else:
+        ck, cv = cache_kv
+        ck = _write_kv(ck, k, cache_len)
+        cv = _write_kv(cv, v, cache_len)
+        new_len = cache_len + x.shape[1]
+        out = attention(q, ck, cv, causal=True, q_offset=cache_len,
+                        kv_len=new_len, impl=attn_impl)
+        new_cache = (ck, cv)
+    out = _shard(out, 'batch', 'seq', 'heads', 'head_dim')
+    out = jnp.einsum('bshk,hkd->bsd', out, layer['wo'])
+    return out, new_cache
+
+
+def _layer_fn(layer: Params, x: jax.Array, cfg: ModelConfig,
+              positions: jax.Array,
+              cache_kv, cache_len, attn_impl: str):
+    h = rms_norm(x, layer['attn_norm'], cfg.norm_eps)
+    attn_out, new_cache = _attn_block(layer, h, cfg, positions, cache_kv,
+                                      cache_len, attn_impl)
+    x = x + attn_out
+    h = rms_norm(x, layer['ffn_norm'], cfg.norm_eps)
+    if cfg.is_moe:
+        from skypilot_tpu.models import moe
+        ffn_out, aux = moe.moe_ffn(layer, h, cfg)
+    else:
+        ffn_out = _ffn(layer, h, cfg)
+        aux = jnp.zeros((), jnp.float32)
+    x = x + ffn_out
+    x = _shard(x, 'batch', 'seq', 'embed')
+    return x, new_cache, aux
+
+
+# --------------------------------------------------------------------------
+# Forward
+# --------------------------------------------------------------------------
+def forward(
+    params: Params,
+    tokens: jax.Array,                 # [b, s] int32
+    cfg: ModelConfig,
+    *,
+    cache: Optional[KVCache] = None,
+    attn_impl: str = 'auto',
+    return_aux: bool = False,
+):
+    """Run the model. Without a cache: training/eval full-sequence causal
+    attention; positions are [0..s). With a cache: prefill/decode — tokens
+    are appended at each sequence's current length and the cache is updated.
+
+    Cache-capacity contract: callers must never append past ``max_seq`` —
+    ``lax.dynamic_update_slice`` clamps rather than errors inside jit, so an
+    overflow silently corrupts the last cache slot. The inference engine
+    enforces this by construction (it evicts/rejects before overflow).
+
+    Returns (logits [b, s, vocab], new_cache or None), plus the mean MoE
+    load-balancing aux loss when ``return_aux`` (0 for dense models).
+    """
+    x = params['embed'][tokens]  # [b, s, d] - gather
+    x = _shard(x, 'batch', 'seq', 'embed')
+    b, s = tokens.shape
+
+    if cache is None:
+        positions = jnp.broadcast_to(jnp.arange(s)[None, :], (b, s))
+        cache_len = None
+    else:
+        positions = cache.length[:, None] + jnp.arange(s)[None, :]
+        cache_len = cache.length
+
+    layer_params = params['layers']
+
+    def body(carry, layer_and_cache):
+        x = carry
+        layer, layer_cache = layer_and_cache
+        return _layer_fn(layer, x, cfg, positions, layer_cache, cache_len,
+                         attn_impl)
+
+    if cfg.remat == 'block':
+        body = jax.checkpoint(body,
+                              policy=jax.checkpoint_policies.nothing_saveable)
+
+    if cache is None:
+        def scan_body(carry, layer):
+            out, _, aux = body(carry, (layer, None))
+            return out, aux
+
+        x, aux_layers = lax.scan(scan_body, x, layer_params)
+        new_cache = None
+    else:
+        def scan_body(carry, layer_and_kv):
+            layer, ck, cv = layer_and_kv
+            out, new_kv, aux = body(carry, (layer, (ck, cv)))
+            return out, (new_kv, aux)
+
+        x, ((new_k, new_v), aux_layers) = lax.scan(
+            scan_body, x, (layer_params, cache.k, cache.v))
+        new_cache = KVCache(k=new_k, v=new_v, length=cache.length + s)
+
+    x = rms_norm(x, params['final_norm'], cfg.norm_eps)
+    logits = jnp.einsum('bsd,dv->bsv', x, params['unembed'],
+                        preferred_element_type=jnp.float32)
+    logits = _shard(logits, 'batch', 'seq', 'vocab')
+    if return_aux:
+        return logits, new_cache, jnp.mean(aux_layers)
+    return logits, new_cache
+
+
+@functools.partial(jax.jit, static_argnames=('cfg',))
+def greedy_logits(params: Params, tokens: jax.Array,
+                  cfg: ModelConfig) -> jax.Array:
+    """Convenience: jitted logits-only forward (no cache)."""
+    logits, _ = forward(params, tokens, cfg)
+    return logits

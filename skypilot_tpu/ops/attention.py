@@ -1,0 +1,105 @@
+"""Attention ops: reference jnp implementation + dispatcher.
+
+The dispatcher routes to the Pallas flash-attention kernel on TPU for long
+sequences (see ``skypilot_tpu/ops/flash_attention.py``) and falls back to the
+XLA einsum path elsewhere (CPU tests, tiny shapes, decode).
+
+Shapes follow the [batch, seq, heads, head_dim] convention throughout.
+GQA: kv heads are broadcast to query heads here (the kernel keeps them
+folded to save bandwidth).
+"""
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+
+def _repeat_kv(x: jax.Array, n_rep: int) -> jax.Array:
+    """[b, s, kv_heads, d] -> [b, s, kv_heads * n_rep, d]."""
+    if n_rep == 1:
+        return x
+    b, s, h, d = x.shape
+    return jnp.broadcast_to(x[:, :, :, None, :], (b, s, h, n_rep, d)
+                            ).reshape(b, s, h * n_rep, d)
+
+
+def reference_attention(
+    q: jax.Array,                      # [b, sq, h, d]
+    k: jax.Array,                      # [b, skv, hkv, d]
+    v: jax.Array,                      # [b, skv, hkv, d]
+    *,
+    causal: bool = True,
+    q_offset: Optional[jax.Array] = None,   # position of q[0] within kv seq
+    kv_len: Optional[jax.Array] = None,     # valid kv length (decode masking)
+    scale: Optional[float] = None,
+) -> jax.Array:
+    """Plain XLA attention in fp32 accumulation.
+
+    GQA is computed in grouped form ([b, s, hkv, group, d] einsums) so kv is
+    never materialized at query-head width — in decode the kv cache read IS
+    the bandwidth bill, a 4x broadcast would quadruple it.
+
+    ``q_offset``/``kv_len`` support the decode path: q positions are
+    ``q_offset + [0..sq)``, kv positions beyond ``kv_len`` are masked out.
+    """
+    b, sq, h, d = q.shape
+    hkv = k.shape[2]
+    group = h // hkv
+    scale = scale if scale is not None else d ** -0.5
+    qg = q.reshape(b, sq, hkv, group, d)
+
+    logits = jnp.einsum('bqhgd,bkhd->bhgqk', qg, k,
+                        preferred_element_type=jnp.float32) * scale
+
+    skv = k.shape[1]
+    kv_pos = jnp.arange(skv)[None, None, None, None, :]        # [1,1,1,1,k]
+    mask = jnp.ones((1, 1, 1, sq, skv), dtype=bool)
+    if causal:
+        q_pos = jnp.arange(sq)[None, None, None, :, None]      # [1,1,1,q,1]
+        if q_offset is not None:
+            q_pos = q_pos + jnp.reshape(q_offset, (-1, 1, 1, 1, 1))
+        mask = mask & (kv_pos <= q_pos)
+    if kv_len is not None:
+        mask = mask & (kv_pos < jnp.reshape(kv_len, (-1, 1, 1, 1, 1)))
+    logits = jnp.where(mask, logits, -1e30)
+
+    probs = jax.nn.softmax(logits, axis=-1).astype(v.dtype)
+    out = jnp.einsum('bhgqk,bkhd->bqhgd', probs, v)
+    return out.reshape(b, sq, h, d)
+
+
+@functools.partial(jax.jit, static_argnames=('causal', 'impl'))
+def attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    causal: bool = True,
+    q_offset: Optional[jax.Array] = None,
+    kv_len: Optional[jax.Array] = None,
+    impl: str = 'auto',
+) -> jax.Array:
+    """Dispatching attention entry point used by the models.
+
+    impl: 'auto' | 'xla' | 'flash'. 'auto' picks flash on TPU when the
+    shape fits the kernel's tiling (training-style full-sequence causal
+    attention); decode (sq==1) always uses the XLA path, which fuses into
+    a single-pass softmax anyway.
+    """
+    use_flash = False
+    if impl == 'flash':
+        use_flash = True
+    elif impl == 'auto':
+        sq = q.shape[1]
+        on_tpu = jax.default_backend() == 'tpu'
+        use_flash = (on_tpu and causal and sq >= 256 and sq % 128 == 0
+                     and q.shape[-1] % 128 == 0 and q_offset is None
+                     and kv_len is None)
+    if use_flash:
+        from skypilot_tpu.ops import flash_attention
+        return flash_attention.flash_attention(q, k, v, causal=causal)
+    return reference_attention(q, k, v, causal=causal, q_offset=q_offset,
+                               kv_len=kv_len)

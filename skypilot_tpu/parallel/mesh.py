@@ -1,0 +1,154 @@
+"""Device mesh + logical-axis sharding rules.
+
+This is the TPU-native replacement for the reference's delegated parallelism
+(SURVEY.md §2.3/§2.4): instead of exporting torchrun/NCCL env vars for an
+external framework, the in-tree engines shard over a `jax.sharding.Mesh` and
+let XLA insert ICI/DCN collectives.
+
+Axes (any may be size 1):
+  slice : outer data-parallel axis across pod slices (DCN; multislice)
+  dp    : data parallel (pure replication of params)
+  fsdp  : fully-sharded data parallel (params sharded, gathered per layer)
+  sp    : sequence/context parallel (ring attention partitions the sequence)
+  tp    : tensor parallel (heads/mlp sharded; collectives per layer)
+  ep    : expert parallel (MoE experts sharded)
+
+``ep`` is folded over ``fsdp×sp`` at use-site (MoE layers reshape), keeping
+the physical mesh 5-D and collectives on ICI neighbors.
+"""
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Any, Dict, Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+MESH_AXES = ('slice', 'dp', 'fsdp', 'sp', 'tp')
+
+
+@dataclasses.dataclass(frozen=True)
+class MeshSpec:
+    """Logical mesh shape. Product must equal the device count."""
+    dp: int = 1
+    fsdp: int = 1
+    sp: int = 1
+    tp: int = 1
+    num_slices: int = 1
+
+    @property
+    def shape(self) -> Tuple[int, ...]:
+        return (self.num_slices, self.dp, self.fsdp, self.sp, self.tp)
+
+    @property
+    def num_devices(self) -> int:
+        return math.prod(self.shape)
+
+    @classmethod
+    def auto(cls, num_devices: int, *, num_slices: int = 1,
+             tp: Optional[int] = None, sp: int = 1) -> 'MeshSpec':
+        """Default: everything not TP/SP goes to FSDP (ZeRO-3-style), the
+        dominant TPU training layout. TP defaults to 1 within reason — FSDP
+        over fast ICI usually wins until per-chip batch gets tiny."""
+        per_slice = num_devices // num_slices
+        if num_devices % num_slices:
+            raise ValueError(f'{num_devices} devices not divisible into '
+                             f'{num_slices} slices')
+        tp = tp or 1
+        if per_slice % (tp * sp):
+            raise ValueError(f'tp*sp={tp * sp} must divide per-slice device '
+                             f'count {per_slice}')
+        return cls(dp=1, fsdp=per_slice // (tp * sp), sp=sp, tp=tp,
+                   num_slices=num_slices)
+
+
+def make_mesh(spec: MeshSpec,
+              devices: Optional[Sequence[jax.Device]] = None) -> Mesh:
+    """Build the 5-D mesh. Axis order puts `tp` innermost so tensor-parallel
+    collectives ride nearest-neighbor ICI links; `slice` outermost so only
+    the pure-DP gradient all-reduce crosses DCN (multislice)."""
+    if devices is None:
+        devices = jax.devices()
+    if len(devices) != spec.num_devices:
+        raise ValueError(
+            f'MeshSpec {spec.shape} needs {spec.num_devices} devices, '
+            f'got {len(devices)}')
+    arr = np.asarray(devices).reshape(spec.shape)
+    return Mesh(arr, MESH_AXES)
+
+
+# --- Logical axis rules ----------------------------------------------------
+# logical axis -> mesh axis (str), tuple of mesh axes, or None (replicated).
+LogicalRules = Dict[str, Any]
+
+# Default rules (MaxText-style): params shard embed-dim over fsdp and
+# heads/mlp over tp; activations shard batch over all data axes and sequence
+# over sp.
+DEFAULT_RULES: LogicalRules = {
+    'batch': ('slice', 'dp', 'fsdp'),
+    'seq': 'sp',
+    'embed': 'fsdp',
+    'heads': 'tp',
+    'kv_heads': 'tp',
+    'head_dim': None,
+    'mlp': 'tp',
+    'vocab': 'tp',
+    'expert': ('fsdp', 'sp'),   # ep folded over fsdp×sp
+    'norm': None,
+    'layers': None,
+}
+
+
+def spec_for(logical_axes: Sequence[Optional[str]],
+             rules: Optional[LogicalRules] = None) -> PartitionSpec:
+    """Map a tuple of logical axis names to a PartitionSpec."""
+    rules = rules or DEFAULT_RULES
+    parts = []
+    used = set()
+    for ax in logical_axes:
+        if ax is None:
+            parts.append(None)
+            continue
+        if ax not in rules:
+            raise ValueError(f'No sharding rule for logical axis {ax!r}')
+        mesh_ax = rules[ax]
+        # Drop mesh axes already used by an earlier dimension (a mesh axis
+        # may shard at most one tensor dimension).
+        if mesh_ax is None:
+            parts.append(None)
+        elif isinstance(mesh_ax, (tuple, list)):
+            keep = tuple(a for a in mesh_ax if a not in used)
+            used.update(keep)
+            parts.append(keep if keep else None)
+        else:
+            if mesh_ax in used:
+                parts.append(None)
+            else:
+                used.add(mesh_ax)
+                parts.append(mesh_ax)
+    while parts and parts[-1] is None:
+        parts.pop()
+    return PartitionSpec(*parts)
+
+
+def tree_shardings(logical_tree: Any, mesh: Mesh,
+                   rules: Optional[LogicalRules] = None) -> Any:
+    """Map a pytree of logical-axis tuples to a pytree of NamedShardings."""
+    return jax.tree.map(
+        lambda axes: NamedSharding(mesh, spec_for(axes, rules)),
+        logical_tree,
+        is_leaf=lambda x: isinstance(x, tuple),
+    )
+
+
+def batch_sharding(mesh: Mesh,
+                   rules: Optional[LogicalRules] = None) -> NamedSharding:
+    """Sharding for [batch, seq] token arrays."""
+    return NamedSharding(mesh, spec_for(('batch', 'seq'), rules))
+
+
+def data_axis_size(mesh: Mesh) -> int:
+    """Global data-parallel degree (batch must be divisible by this)."""
+    return (mesh.shape['slice'] * mesh.shape['dp'] * mesh.shape['fsdp'])

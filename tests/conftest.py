@@ -2,15 +2,21 @@
 
 Multi-chip logic is tested on a virtual 8-device CPU mesh (the approach
 SURVEY.md §4 recommends over the reference's monkeypatched-catalog-only
-strategy): env vars must be set before jax initializes its backends.
+strategy). The kernel environment pins ``JAX_PLATFORMS=axon`` (real TPU via a
+tunnel) and a sitecustomize registers that backend, so setting the env var is
+not enough — we also override via jax.config before any backend initializes.
 """
 import os
 
-os.environ.setdefault('JAX_PLATFORMS', 'cpu')
+os.environ['JAX_PLATFORMS'] = 'cpu'
 prev = os.environ.get('XLA_FLAGS', '')
 if 'xla_force_host_platform_device_count' not in prev:
     os.environ['XLA_FLAGS'] = (
         prev + ' --xla_force_host_platform_device_count=8').strip()
+
+import jax  # noqa: E402
+
+jax.config.update('jax_platforms', 'cpu')
 
 import pytest  # noqa: E402
 
