@@ -1,0 +1,67 @@
+"""Pluggable admin policy applied to every request before execution.
+
+Role of reference ``sky/admin_policy.py`` + ``admin_policy_utils.apply``
+(``sky/execution.py:172-180``): the config key ``admin_policy`` names a
+``module.path:ClassName`` whose ``validate_and_mutate(UserRequest)``
+returns a mutated request or raises to reject.
+"""
+from __future__ import annotations
+
+import dataclasses
+import importlib
+from typing import Optional
+
+from skypilot_tpu import config as config_lib
+from skypilot_tpu import exceptions
+from skypilot_tpu.dag import Dag
+
+
+@dataclasses.dataclass
+class UserRequest:
+    dag: Dag
+    config: dict
+
+
+@dataclasses.dataclass
+class MutatedUserRequest:
+    dag: Dag
+    config: dict
+
+
+class AdminPolicy:
+    """Subclass and point the ``admin_policy`` config key at it."""
+
+    @classmethod
+    def validate_and_mutate(cls, request: UserRequest
+                            ) -> MutatedUserRequest:
+        raise NotImplementedError
+
+
+def _load_policy() -> Optional[type]:
+    spec = config_lib.get_nested(('admin_policy',))
+    if not spec:
+        return None
+    module_path, _, class_name = spec.partition(':')
+    if not class_name:
+        module_path, _, class_name = spec.rpartition('.')
+    try:
+        module = importlib.import_module(module_path)
+        return getattr(module, class_name)
+    except (ImportError, AttributeError) as e:
+        raise exceptions.UserRequestRejectedByPolicy(
+            f'Cannot load admin policy {spec!r}: {e}') from e
+
+
+def apply(dag: Dag) -> Dag:
+    policy = _load_policy()
+    if policy is None:
+        return dag
+    request = UserRequest(dag=dag, config=config_lib.to_dict())
+    try:
+        mutated = policy.validate_and_mutate(request)
+    except exceptions.UserRequestRejectedByPolicy:
+        raise
+    except Exception as e:  # pylint: disable=broad-except
+        raise exceptions.UserRequestRejectedByPolicy(
+            f'Admin policy rejected the request: {e}') from e
+    return mutated.dag

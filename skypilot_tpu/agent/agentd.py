@@ -1,0 +1,98 @@
+"""The head-host agent daemon (skylet equivalent).
+
+Role of reference ``sky/skylet/skylet.py:17-33`` + ``events.py``: a tick
+loop running periodic events — job scheduling, status reconciliation, and
+autostop. Started detached by the provisioner's post-setup; the pidfile +
+heartbeat let the client check agent liveness cheaply.
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+import traceback
+
+from skypilot_tpu.agent import autostop_lib
+from skypilot_tpu.agent import constants
+from skypilot_tpu.agent import job_lib
+
+
+class Event:
+    interval_seconds: float = 20.0
+
+    def __init__(self) -> None:
+        self._last = 0.0
+
+    def maybe_run(self, now: float) -> None:
+        # Fast test ticks shorten every event's period too.
+        interval = min(self.interval_seconds, constants.agent_tick_seconds())
+        if now - self._last >= interval:
+            self._last = now
+            try:
+                self.run()
+            except Exception:  # pylint: disable=broad-except
+                traceback.print_exc()
+
+    def run(self) -> None:
+        raise NotImplementedError
+
+
+class JobSchedulerEvent(Event):
+    """Reconcile job statuses and schedule the next queued job."""
+    interval_seconds = 0.0          # every tick
+
+    def run(self) -> None:
+        job_lib.update_status()
+        job_lib.schedule_step()
+
+
+class AutostopEvent(Event):
+    """Stop/terminate the cluster when idle past the threshold
+    (reference ``sky/skylet/events.py:93``)."""
+    interval_seconds = 5.0
+
+    def run(self) -> None:
+        if not autostop_lib.should_autostop():
+            return
+        cfg = autostop_lib.get_autostop_config()
+        with open(constants.cluster_info_path(), encoding='utf-8') as f:
+            info = json.load(f)
+        provider = info['provider_name']
+        cluster_name = info['cluster_name']
+        region = info['region']
+        print(f'[agentd] autostop: cluster idle >= {cfg.idle_minutes}m, '
+              f'{"terminating" if cfg.to_down else "stopping"} '
+              f'{cluster_name}', flush=True)
+        from skypilot_tpu import provision
+        if cfg.to_down:
+            provision.terminate_instances(provider, region, cluster_name)
+        else:
+            provision.stop_instances(provider, region, cluster_name)
+        # Disable further autostop checks; the cluster is going away.
+        autostop_lib.set_autostop(-1)
+
+
+def main() -> None:
+    agent_dir = constants.agent_dir()
+    with open(constants.agentd_pid_path(), 'w', encoding='utf-8') as f:
+        f.write(str(os.getpid()))
+    print(f'[agentd] started in {agent_dir} (pid {os.getpid()})',
+          flush=True)
+    events = [JobSchedulerEvent(), AutostopEvent()]
+    tick = constants.agent_tick_seconds()
+    while True:
+        now = time.time()
+        for event in events:
+            event.maybe_run(now)
+        with open(constants.agentd_heartbeat_path(), 'w',
+                  encoding='utf-8') as f:
+            f.write(str(now))
+        time.sleep(tick)
+
+
+if __name__ == '__main__':
+    try:
+        main()
+    except KeyboardInterrupt:
+        sys.exit(0)

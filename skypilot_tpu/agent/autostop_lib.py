@@ -1,0 +1,63 @@
+"""Autostop bookkeeping on the head host.
+
+Role of reference ``sky/skylet/autostop_lib.py`` (config + last-active
+tracking; ``AutostopCodeGen`` ``:105`` becomes an RPC op here). The agentd
+AutostopEvent consumes this and tears the cluster down via the provision
+API from the head (reference ``sky/skylet/events.py:93``).
+"""
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import time
+from typing import Optional
+
+from skypilot_tpu.agent import constants
+from skypilot_tpu.agent import job_lib
+
+
+@dataclasses.dataclass
+class AutostopConfig:
+    idle_minutes: int = -1          # -1 = disabled
+    to_down: bool = False           # terminate instead of stop
+    set_at: float = 0.0
+
+    @property
+    def enabled(self) -> bool:
+        return self.idle_minutes >= 0
+
+
+def get_autostop_config() -> AutostopConfig:
+    path = constants.autostop_config_path()
+    if not os.path.exists(path):
+        return AutostopConfig()
+    with open(path, encoding='utf-8') as f:
+        d = json.load(f)
+    return AutostopConfig(**d)
+
+
+def set_autostop(idle_minutes: int, to_down: bool = False) -> None:
+    cfg = AutostopConfig(idle_minutes=idle_minutes, to_down=to_down,
+                         set_at=time.time())
+    with open(constants.autostop_config_path(), 'w', encoding='utf-8') as f:
+        json.dump(dataclasses.asdict(cfg), f)
+
+
+def idle_seconds() -> Optional[float]:
+    """Seconds since the cluster went idle; None while busy."""
+    if not job_lib.is_cluster_idle():
+        return None
+    cfg = get_autostop_config()
+    anchor = max(job_lib.last_activity_time(), cfg.set_at)
+    if anchor <= 0:
+        anchor = cfg.set_at or time.time()
+    return time.time() - anchor
+
+
+def should_autostop() -> bool:
+    cfg = get_autostop_config()
+    if not cfg.enabled:
+        return False
+    idle = idle_seconds()
+    return idle is not None and idle >= cfg.idle_minutes * 60

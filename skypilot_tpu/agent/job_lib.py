@@ -1,0 +1,247 @@
+"""Per-cluster job table + FIFO scheduler (runs on the head host).
+
+Role of reference ``sky/skylet/job_lib.py`` (``JobStatus`` ``:118``,
+``FIFOScheduler`` ``:194,266``, ``update_job_status`` ``:555``). TPU-first
+simplification: a slice is exclusively owned by one program at a time, so
+the scheduler runs jobs strictly serially (the reference's resource-slot
+logic degenerates to FIFO-of-one on TPUs anyway).
+
+The driver for a scheduled job is ``python -m skypilot_tpu.agent.driver``
+launched as a detached daemon; its pid is recorded for liveness-based
+status reconciliation (dead driver + non-terminal status = FAILED_DRIVER).
+"""
+from __future__ import annotations
+
+import enum
+import json
+import os
+import sqlite3
+import sys
+import time
+from typing import Any, Dict, List, Optional
+
+import filelock
+
+from skypilot_tpu.agent import constants
+from skypilot_tpu.utils import subprocess_utils
+
+
+class JobStatus(enum.Enum):
+    """Job lifecycle. Terminal: SUCCEEDED / FAILED / FAILED_DRIVER /
+    FAILED_SETUP / CANCELLED."""
+    INIT = 'INIT'
+    PENDING = 'PENDING'
+    STARTING = 'STARTING'
+    RUNNING = 'RUNNING'
+    SUCCEEDED = 'SUCCEEDED'
+    FAILED = 'FAILED'
+    FAILED_SETUP = 'FAILED_SETUP'
+    FAILED_DRIVER = 'FAILED_DRIVER'
+    CANCELLED = 'CANCELLED'
+
+    def is_terminal(self) -> bool:
+        return self in _TERMINAL
+
+    @classmethod
+    def nonterminal_values(cls) -> List[str]:
+        return [s.value for s in cls if not s.is_terminal()]
+
+
+_TERMINAL = {JobStatus.SUCCEEDED, JobStatus.FAILED, JobStatus.FAILED_SETUP,
+             JobStatus.FAILED_DRIVER, JobStatus.CANCELLED}
+
+
+def _conn() -> sqlite3.Connection:
+    path = constants.jobs_db_path()
+    conn = sqlite3.connect(path, timeout=10)
+    conn.execute("""\
+        CREATE TABLE IF NOT EXISTS jobs (
+            job_id INTEGER PRIMARY KEY AUTOINCREMENT,
+            name TEXT,
+            username TEXT,
+            submitted_at REAL,
+            status TEXT,
+            run_timestamp TEXT,
+            start_at REAL,
+            end_at REAL,
+            resources TEXT,
+            driver_pid INTEGER,
+            spec TEXT)""")
+    conn.commit()
+    return conn
+
+
+def _scheduler_lock() -> filelock.FileLock:
+    return filelock.FileLock(
+        os.path.join(constants.agent_dir(), '.scheduler.lock'))
+
+
+# ------------------------------------------------------------------ CRUD
+def add_job(name: str, username: str, run_timestamp: str,
+            resources_str: str, spec: Dict[str, Any]) -> int:
+    """Queue a job (status PENDING); returns job_id."""
+    conn = _conn()
+    with conn:
+        cur = conn.execute(
+            'INSERT INTO jobs (name, username, submitted_at, status, '
+            'run_timestamp, resources, spec) VALUES (?,?,?,?,?,?,?)',
+            (name, username, time.time(), JobStatus.PENDING.value,
+             run_timestamp, resources_str, json.dumps(spec)))
+        job_id = cur.lastrowid
+    conn.close()
+    return int(job_id)
+
+
+def get_job(job_id: int) -> Optional[Dict[str, Any]]:
+    conn = _conn()
+    row = conn.execute(
+        'SELECT job_id, name, username, submitted_at, status, '
+        'run_timestamp, start_at, end_at, resources, driver_pid, spec '
+        'FROM jobs WHERE job_id=?', (job_id,)).fetchone()
+    conn.close()
+    return _row_to_record(row) if row else None
+
+
+def _row_to_record(row) -> Dict[str, Any]:
+    return {
+        'job_id': row[0], 'name': row[1], 'username': row[2],
+        'submitted_at': row[3], 'status': JobStatus(row[4]),
+        'run_timestamp': row[5], 'start_at': row[6], 'end_at': row[7],
+        'resources': row[8], 'driver_pid': row[9],
+        'spec': json.loads(row[10]) if row[10] else None,
+    }
+
+
+def get_jobs(statuses: Optional[List[JobStatus]] = None
+             ) -> List[Dict[str, Any]]:
+    conn = _conn()
+    q = ('SELECT job_id, name, username, submitted_at, status, '
+         'run_timestamp, start_at, end_at, resources, driver_pid, spec '
+         'FROM jobs')
+    args: tuple = ()
+    if statuses:
+        q += (' WHERE status IN (' +
+              ','.join('?' * len(statuses)) + ')')
+        args = tuple(s.value for s in statuses)
+    q += ' ORDER BY job_id DESC'
+    rows = conn.execute(q, args).fetchall()
+    conn.close()
+    return [_row_to_record(r) for r in rows]
+
+
+def set_status(job_id: int, status: JobStatus,
+               driver_pid: Optional[int] = None) -> None:
+    conn = _conn()
+    now = time.time()
+    with conn:
+        sets = ['status=?']
+        args: List[Any] = [status.value]
+        if status == JobStatus.RUNNING:
+            sets.append('start_at=COALESCE(start_at, ?)')
+            args.append(now)
+        if status.is_terminal():
+            sets.append('end_at=COALESCE(end_at, ?)')
+            args.append(now)
+        if driver_pid is not None:
+            sets.append('driver_pid=?')
+            args.append(driver_pid)
+        args.append(job_id)
+        conn.execute(f'UPDATE jobs SET {", ".join(sets)} WHERE job_id=?',
+                     args)
+    conn.close()
+
+
+def get_status(job_id: int) -> Optional[JobStatus]:
+    record = get_job(job_id)
+    return record['status'] if record else None
+
+
+# ------------------------------------------------------------- scheduler
+def schedule_step() -> None:
+    """FIFO: if nothing is starting/running, launch the oldest PENDING
+    job's driver as a detached process (reference
+    ``FIFOScheduler.schedule_step`` ``sky/skylet/job_lib.py:266``)."""
+    with _scheduler_lock():
+        active = get_jobs([JobStatus.STARTING, JobStatus.RUNNING,
+                           JobStatus.INIT])
+        if active:
+            return
+        pending = get_jobs([JobStatus.PENDING])
+        if not pending:
+            return
+        job = pending[-1]          # ORDER BY job_id DESC -> last is oldest
+        job_id = job['job_id']
+        log_dir = constants.job_log_dir(job['run_timestamp'])
+        os.makedirs(log_dir, exist_ok=True)
+        pid = subprocess_utils.launch_daemon(
+            [sys.executable, '-m', 'skypilot_tpu.agent.driver',
+             str(job_id)],
+            log_path=os.path.join(log_dir, constants.DRIVER_LOG),
+            env=dict(os.environ))
+        set_status(job_id, JobStatus.STARTING, driver_pid=pid)
+
+
+def update_status() -> None:
+    """Reconcile: a dead driver with a non-terminal job means the driver
+    crashed (reference ``update_job_status`` pid-liveness logic)."""
+    for job in get_jobs([JobStatus.STARTING, JobStatus.RUNNING]):
+        pid = job['driver_pid']
+        if not subprocess_utils.pid_is_alive(pid):
+            # Re-read under the truth that drivers set terminal status
+            # right before exiting — avoid racing a normal exit.
+            current = get_status(job['job_id'])
+            if current is not None and not current.is_terminal():
+                set_status(job['job_id'], JobStatus.FAILED_DRIVER)
+
+
+def cancel_job(job_id: int) -> bool:
+    """Kill the driver tree (drivers own the whole remote process group)."""
+    job = get_job(job_id)
+    if job is None:
+        return False
+    if job['status'].is_terminal():
+        return False
+    if job['driver_pid']:
+        subprocess_utils.kill_process_tree(job['driver_pid'])
+    set_status(job_id, JobStatus.CANCELLED)
+    schedule_step()
+    return True
+
+
+def cancel_all() -> List[int]:
+    cancelled = []
+    for job in get_jobs():
+        if not job['status'].is_terminal():
+            if cancel_job(job['job_id']):
+                cancelled.append(job['job_id'])
+    return cancelled
+
+
+def is_cluster_idle() -> bool:
+    """No non-terminal jobs (autostop predicate,
+    reference ``job_lib.is_cluster_idle`` ``sky/skylet/job_lib.py:717``)."""
+    return not get_jobs([JobStatus.INIT, JobStatus.PENDING,
+                         JobStatus.STARTING, JobStatus.RUNNING])
+
+
+def last_activity_time() -> float:
+    """Most recent of: any job's end/start/submit time; 0 if no jobs."""
+    latest = 0.0
+    for job in get_jobs():
+        for key in ('submitted_at', 'start_at', 'end_at'):
+            v = job[key]
+            if v:
+                latest = max(latest, v)
+    return latest
+
+
+def format_job_table(jobs: List[Dict[str, Any]]) -> str:
+    header = f'{"ID":<4}{"NAME":<16}{"SUBMITTED":<20}{"STATUS":<14}'
+    lines = [header]
+    for j in jobs:
+        sub = time.strftime('%Y-%m-%d %H:%M:%S',
+                            time.localtime(j['submitted_at']))
+        lines.append(
+            f'{j["job_id"]:<4}{(j["name"] or "-")[:15]:<16}{sub:<20}'
+            f'{j["status"].value:<14}')
+    return '\n'.join(lines)

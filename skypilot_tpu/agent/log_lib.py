@@ -1,0 +1,108 @@
+"""Log capture and tailing on the head host.
+
+Role of reference ``sky/skylet/log_lib.py`` (``run_with_log`` ``:138``,
+``tail_logs`` ``:386``). Per-job logs live under
+``$SKYTPU_AGENT_DIR/logs/<run_timestamp>/rank-<i>.log`` — one file per
+slice host, mirroring the reference's per-rank naming.
+"""
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+import time
+from typing import Dict, Iterator, List, Optional
+
+from skypilot_tpu.agent import constants
+from skypilot_tpu.agent import job_lib
+
+
+def run_with_log(cmd: List[str],
+                 log_path: str,
+                 *,
+                 env: Optional[Dict[str, str]] = None,
+                 cwd: Optional[str] = None,
+                 stream_logs: bool = False,
+                 shell: bool = False) -> int:
+    """Run cmd, teeing combined stdout/stderr to log_path. Returns rc."""
+    os.makedirs(os.path.dirname(os.path.abspath(log_path)), exist_ok=True)
+    with open(log_path, 'ab') as log_file:
+        proc = subprocess.Popen(cmd, shell=shell, env=env, cwd=cwd,
+                                stdout=subprocess.PIPE,
+                                stderr=subprocess.STDOUT)
+        assert proc.stdout is not None
+        for line in iter(proc.stdout.readline, b''):
+            log_file.write(line)
+            log_file.flush()
+            if stream_logs:
+                sys.stdout.buffer.write(line)
+                sys.stdout.flush()
+        proc.wait()
+    return proc.returncode
+
+
+def _job_log_paths(run_timestamp: str) -> List[str]:
+    log_dir = constants.job_log_dir(run_timestamp)
+    if not os.path.isdir(log_dir):
+        return []
+    return sorted(
+        os.path.join(log_dir, f) for f in os.listdir(log_dir)
+        if f.startswith('rank-'))
+
+
+def read_job_logs(job_id: int, tail: int = 0) -> str:
+    """Concatenated per-rank logs (rank-prefixed when multi-host)."""
+    job = job_lib.get_job(job_id)
+    if job is None:
+        return f'Job {job_id} not found.\n'
+    paths = _job_log_paths(job['run_timestamp'])
+    chunks = []
+    multi = len(paths) > 1
+    for path in paths:
+        rank = os.path.basename(path)[len('rank-'):-len('.log')]
+        try:
+            with open(path, encoding='utf-8', errors='replace') as f:
+                lines = f.readlines()
+        except FileNotFoundError:
+            continue
+        if tail:
+            lines = lines[-tail:]
+        prefix = f'({rank}) ' if multi else ''
+        chunks.extend(prefix + line for line in lines)
+    return ''.join(chunks)
+
+
+def tail_job_logs(job_id: int, *, follow: bool = True,
+                  poll_interval: float = 0.2) -> Iterator[str]:
+    """Yield log lines; with follow, keep yielding until the job reaches a
+    terminal state and files stop growing."""
+    job = job_lib.get_job(job_id)
+    if job is None:
+        yield f'Job {job_id} not found.\n'
+        return
+    run_timestamp = job['run_timestamp']
+    offsets: Dict[str, int] = {}
+    # Wait for the driver to create the log dir (job may still be PENDING).
+    while True:
+        paths = _job_log_paths(run_timestamp)
+        new_output = False
+        for path in paths:
+            rank = os.path.basename(path)[len('rank-'):-len('.log')]
+            prefix = f'({rank}) ' if len(paths) > 1 else ''
+            try:
+                with open(path, encoding='utf-8', errors='replace') as f:
+                    f.seek(offsets.get(path, 0))
+                    chunk = f.read()
+                    offsets[path] = f.tell()
+            except FileNotFoundError:
+                continue
+            if chunk:
+                new_output = True
+                for line in chunk.splitlines(keepends=True):
+                    yield prefix + line
+        status = job_lib.get_status(job_id)
+        if not follow:
+            return
+        if status is not None and status.is_terminal() and not new_output:
+            return
+        time.sleep(poll_interval)

@@ -1,0 +1,112 @@
+"""Agent RPC: a small JSON-over-stdio protocol the client drives via the
+command runner.
+
+This replaces the reference's "codegen RPC" (generating Python source and
+exec-ing it on the head, e.g. ``JobLibCodeGen`` ``sky/skylet/job_lib.py:930``)
+with a fixed command surface: the client runs
+``python -m skypilot_tpu.agent.rpc '<json-request>'`` on the head and parses
+the single JSON response line after :data:`PAYLOAD_PREFIX`. The ``tail`` op
+instead streams raw log lines (the client passes the stream through).
+
+Ops: queue_job, job_status, job_table, cancel, cancel_all, logs, tail,
+set_autostop, autostop_config, is_idle, agent_health.
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+from typing import Any, Dict
+
+from skypilot_tpu.agent import autostop_lib
+from skypilot_tpu.agent import constants
+from skypilot_tpu.agent import job_lib
+from skypilot_tpu.agent import log_lib
+from skypilot_tpu.utils import subprocess_utils
+
+PAYLOAD_PREFIX = 'SKYTPU_RPC_PAYLOAD:'
+
+
+def _ok(**kwargs) -> Dict[str, Any]:
+    return {'ok': True, **kwargs}
+
+
+def _job_record_to_json(job: Dict[str, Any]) -> Dict[str, Any]:
+    out = dict(job)
+    out['status'] = job['status'].value
+    return out
+
+
+def handle(request: Dict[str, Any]) -> Dict[str, Any]:
+    op = request.get('op')
+    if op == 'queue_job':
+        job_id = job_lib.add_job(
+            name=request.get('name') or 'task',
+            username=request.get('username') or 'unknown',
+            run_timestamp=request['run_timestamp'],
+            resources_str=request.get('resources') or '',
+            spec=request['spec'])
+        job_lib.schedule_step()
+        return _ok(job_id=job_id)
+    if op == 'job_status':
+        status = job_lib.get_status(int(request['job_id']))
+        return _ok(status=status.value if status else None)
+    if op == 'job_table':
+        jobs = [_job_record_to_json(j) for j in job_lib.get_jobs()]
+        return _ok(jobs=jobs)
+    if op == 'cancel':
+        cancelled = job_lib.cancel_job(int(request['job_id']))
+        return _ok(cancelled=cancelled)
+    if op == 'cancel_all':
+        return _ok(cancelled=job_lib.cancel_all())
+    if op == 'logs':
+        text = log_lib.read_job_logs(int(request['job_id']),
+                                     tail=int(request.get('tail', 0)))
+        return _ok(logs=text)
+    if op == 'set_autostop':
+        autostop_lib.set_autostop(int(request['idle_minutes']),
+                                  bool(request.get('to_down', False)))
+        return _ok()
+    if op == 'autostop_config':
+        cfg = autostop_lib.get_autostop_config()
+        return _ok(idle_minutes=cfg.idle_minutes, to_down=cfg.to_down)
+    if op == 'is_idle':
+        return _ok(idle=job_lib.is_cluster_idle())
+    if op == 'agent_health':
+        pid = None
+        try:
+            with open(constants.agentd_pid_path(), encoding='utf-8') as f:
+                pid = int(f.read().strip())
+        except (FileNotFoundError, ValueError):
+            pass
+        alive = subprocess_utils.pid_is_alive(pid)
+        return _ok(agentd_alive=alive, agentd_pid=pid,
+                   num_nonterminal_jobs=len(job_lib.get_jobs(
+                       [job_lib.JobStatus.PENDING, job_lib.JobStatus.STARTING,
+                        job_lib.JobStatus.RUNNING])))
+    raise ValueError(f'Unknown RPC op: {op!r}')
+
+
+def main() -> None:
+    raw = sys.argv[1] if len(sys.argv) > 1 else sys.stdin.read()
+    request = json.loads(raw)
+    if request.get('op') == 'tail':
+        # Streaming op: raw lines straight to stdout, no JSON envelope.
+        for line in log_lib.tail_job_logs(
+                int(request['job_id']),
+                follow=bool(request.get('follow', True))):
+            sys.stdout.write(line)
+            sys.stdout.flush()
+        status = job_lib.get_status(int(request['job_id']))
+        if status is not None:
+            print(f'\n[job {request["job_id"]}] {status.value}')
+        return
+    try:
+        response = handle(request)
+    except Exception as e:  # pylint: disable=broad-except
+        response = {'ok': False, 'error': f'{type(e).__name__}: {e}'}
+    print(PAYLOAD_PREFIX + json.dumps(response))
+
+
+if __name__ == '__main__':
+    main()

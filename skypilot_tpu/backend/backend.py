@@ -1,0 +1,59 @@
+"""Backend ABC: the cluster lifecycle interface.
+
+Role of reference ``sky/backends/backend.py:30`` (``Backend`` with
+provision/sync_workdir/sync_file_mounts/setup/execute/post_execute/
+teardown and a typed ``ResourceHandle``).
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, Generic, Optional, TypeVar
+
+from skypilot_tpu.task import Task
+
+
+class ResourceHandle:
+    """Opaque, pickleable pointer to launched resources."""
+
+    def get_cluster_name(self) -> str:
+        raise NotImplementedError
+
+
+_HandleT = TypeVar('_HandleT', bound=ResourceHandle)
+
+
+class Backend(Generic[_HandleT]):
+    NAME = 'backend'
+
+    # --- lifecycle ---
+    def provision(self,
+                  task: Task,
+                  to_provision: Optional[Any],
+                  *,
+                  cluster_name: str,
+                  dryrun: bool = False,
+                  retry_until_up: bool = False) -> Optional[_HandleT]:
+        raise NotImplementedError
+
+    def sync_workdir(self, handle: _HandleT, workdir: str) -> None:
+        raise NotImplementedError
+
+    def sync_file_mounts(self, handle: _HandleT,
+                         file_mounts: Optional[Dict[str, str]],
+                         storage_mounts: Optional[Dict[str, Any]]) -> None:
+        raise NotImplementedError
+
+    def setup(self, handle: _HandleT, task: Task,
+              detach_setup: bool = False) -> None:
+        raise NotImplementedError
+
+    def execute(self, handle: _HandleT, task: Task,
+                detach_run: bool = True,
+                dryrun: bool = False) -> Optional[int]:
+        """Submit the task; returns job_id (None for dryrun)."""
+        raise NotImplementedError
+
+    def post_execute(self, handle: _HandleT, down: bool) -> None:
+        del handle, down
+
+    def teardown(self, handle: _HandleT, terminate: bool) -> None:
+        raise NotImplementedError

@@ -1,0 +1,87 @@
+"""Backend helpers: cluster status refresh + reconciliation.
+
+Role of reference ``sky/backends/backend_utils.py`` (status refresh via
+runtime health + cloud query, ``refresh_cluster_status_handle``;
+INIT/UP/STOPPED transition rules per
+``sky/design_docs/cluster_status.md``). Instead of parsing ``ray status``
+we ask the head agent for health over the RPC.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Tuple
+
+from skypilot_tpu import exceptions
+from skypilot_tpu import global_state
+from skypilot_tpu import provision
+from skypilot_tpu import tpu_logging
+from skypilot_tpu.provision import common as provision_common
+from skypilot_tpu.provision import provisioner
+
+logger = tpu_logging.init_logger(__name__)
+
+
+def refresh_cluster_status(
+        cluster_name: str,
+        *,
+        force: bool = False) -> Tuple[Optional[Dict[str, Any]],
+                                      Optional[Any]]:
+    """Reconcile recorded status with cloud truth + agent health.
+
+    Returns (record, handle); (None, None) if the cluster no longer
+    exists anywhere (row removed)."""
+    record = global_state.get_cluster_from_name(cluster_name)
+    if record is None:
+        return None, None
+    handle = record['handle']
+    if handle is None:
+        return record, None
+    del force  # one-shot reconcile; cache hints are future work
+
+    info = handle.cluster_info
+    statuses = provision.query_instances(info.provider_name, info.region,
+                                         cluster_name)
+    if not statuses:
+        # Cloud says gone (terminated out-of-band or autodowned).
+        logger.debug(f'Cluster {cluster_name} not found at provider; '
+                     'removing from state.')
+        global_state.remove_cluster(cluster_name, terminate=True)
+        return None, None
+
+    values = set(statuses.values())
+    if values == {provision_common.STATUS_STOPPED}:
+        new_status = global_state.ClusterStatus.STOPPED
+    elif values == {provision_common.STATUS_RUNNING}:
+        new_status = (global_state.ClusterStatus.UP
+                      if _agent_healthy(handle)
+                      else global_state.ClusterStatus.INIT)
+    else:
+        new_status = global_state.ClusterStatus.INIT
+    if new_status != record['status']:
+        if new_status == global_state.ClusterStatus.STOPPED:
+            global_state.remove_cluster(cluster_name, terminate=False)
+        else:
+            global_state.update_cluster_status(cluster_name, new_status)
+        record = global_state.get_cluster_from_name(cluster_name)
+    return record, handle
+
+
+def _agent_healthy(handle) -> bool:
+    try:
+        runner = handle.head_runner()
+        resp = provisioner.agent_request(runner, {'op': 'agent_health'})
+        return bool(resp.get('agentd_alive'))
+    except Exception:  # pylint: disable=broad-except
+        return False
+
+
+def check_cluster_available(cluster_name: str):
+    """Return a handle for an UP cluster or raise."""
+    record, handle = refresh_cluster_status(cluster_name)
+    if record is None or handle is None:
+        raise exceptions.ClusterDoesNotExist(
+            f'Cluster {cluster_name!r} does not exist.')
+    if record['status'] != global_state.ClusterStatus.UP:
+        raise exceptions.ClusterNotUpError(
+            f'Cluster {cluster_name!r} is {record["status"].value}, '
+            'not UP.')
+    return handle

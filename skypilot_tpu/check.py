@@ -1,0 +1,37 @@
+"""Credentials probe: which clouds are usable (reference ``sky/check.py:19``,
+``get_cached_enabled_clouds_or_refresh`` ``:164``)."""
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Tuple
+
+from skypilot_tpu import clouds as clouds_lib
+from skypilot_tpu import config as config_lib
+from skypilot_tpu import global_state
+
+
+def check(quiet: bool = False) -> List[str]:
+    """Probe every registered cloud; cache and return the enabled list."""
+    allowed: Optional[List[str]] = config_lib.get_nested(
+        ('allowed_clouds',))
+    results: Dict[str, Tuple[bool, Optional[str]]] = {}
+    for name, cls in sorted(clouds_lib.CLOUD_REGISTRY.items()):
+        if allowed is not None and name not in [a.lower() for a in allowed]:
+            continue
+        try:
+            results[name] = cls.check_credentials()
+        except Exception as e:  # pylint: disable=broad-except
+            results[name] = (False, f'{type(e).__name__}: {e}')
+    enabled = [name for name, (ok, _) in results.items() if ok]
+    global_state.set_enabled_clouds(enabled)
+    if not quiet:
+        for name, (ok, reason) in results.items():
+            mark = 'enabled' if ok else f'disabled: {reason}'
+            print(f'  {name}: {mark}')
+    return enabled
+
+
+def get_cached_enabled_clouds_or_refresh() -> List[str]:
+    enabled = global_state.get_enabled_clouds()
+    if not enabled:
+        enabled = check(quiet=True)
+    return enabled

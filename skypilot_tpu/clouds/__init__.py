@@ -1,0 +1,12 @@
+"""Cloud registry (role of reference ``sky/clouds/__init__.py`` +
+``cloud_registry.py``). Importing this package registers all clouds."""
+from skypilot_tpu.clouds.cloud import (CLOUD_REGISTRY, Cloud,
+                                       CloudImplementationFeatures, Zone,
+                                       from_name, register)
+from skypilot_tpu.clouds.gcp import GCP
+from skypilot_tpu.clouds.local import Local
+
+__all__ = [
+    'CLOUD_REGISTRY', 'Cloud', 'CloudImplementationFeatures', 'GCP',
+    'Local', 'Zone', 'from_name', 'register',
+]

@@ -1,0 +1,118 @@
+"""Cloud abstraction: capability flags, feasibility, pricing, provisioning
+config generation, credentials.
+
+Role of reference ``sky/clouds/cloud.py:117`` (``Cloud`` ABC,
+``CloudImplementationFeatures`` ``:29``,
+``get_feasible_launchable_resources`` ``:372``,
+``make_deploy_resources_variables`` ``:280`` — here
+:meth:`make_provision_config`, emitting the provisioner's dataclass
+directly instead of Jinja template vars).
+"""
+from __future__ import annotations
+
+import dataclasses
+import enum
+from typing import Dict, Iterator, List, Optional, Tuple, TYPE_CHECKING
+
+from skypilot_tpu.provision import common as provision_common
+
+if TYPE_CHECKING:
+    from skypilot_tpu.resources import Resources
+
+
+class CloudImplementationFeatures(enum.Enum):
+    """Capabilities a cloud may not support; requirement checks raise
+    NotSupportedError early (reference ``sky/clouds/cloud.py:29``)."""
+    STOP = 'stop'
+    AUTOSTOP = 'autostop'
+    SPOT_INSTANCE = 'spot_instance'
+    MULTI_NODE = 'multi_node'
+    STORAGE_MOUNTING = 'storage_mounting'
+    OPEN_PORTS = 'open_ports'
+    CUSTOM_DISK_TIER = 'custom_disk_tier'
+
+
+@dataclasses.dataclass(frozen=True)
+class Zone:
+    name: str
+    region: str
+
+
+class Cloud:
+    """Base class; subclasses register via :func:`register`."""
+
+    NAME = 'abstract'
+    # provision dispatch key (module under skypilot_tpu.provision.<name>)
+    PROVISIONER = 'abstract'
+
+    # ------------------------------------------------ capabilities
+    @classmethod
+    def unsupported_features(cls) -> Dict[CloudImplementationFeatures, str]:
+        """feature -> human reason, for features this cloud lacks."""
+        return {}
+
+    @classmethod
+    def check_features(cls, requested: List[CloudImplementationFeatures]
+                       ) -> Optional[str]:
+        unsupported = cls.unsupported_features()
+        for feature in requested:
+            if feature in unsupported:
+                return f'{cls.NAME}: {unsupported[feature]}'
+        return None
+
+    # ------------------------------------------------ feasibility
+    def get_feasible_launchable_resources(
+            self, resources: 'Resources',
+            num_nodes: int = 1) -> Tuple[List['Resources'], List[str]]:
+        """Concrete launchable candidates for a (possibly partial) request.
+
+        Returns (candidates, fuzzy_hints). Each candidate has
+        instance_type/region resolved (zone left open for the zone loop
+        unless the user pinned one)."""
+        raise NotImplementedError
+
+    def zones_provision_loop(self, resources: 'Resources'
+                             ) -> Iterator[Zone]:
+        """Zones to attempt, cheapest/preferred first (reference
+        ``_yield_zones``)."""
+        raise NotImplementedError
+
+    # ------------------------------------------------ pricing
+    def instance_type_to_hourly_cost(self, resources: 'Resources',
+                                     use_spot: bool) -> float:
+        raise NotImplementedError
+
+    def get_egress_cost(self, num_gigabytes: float) -> float:
+        return 0.0
+
+    # ------------------------------------------------ provisioning
+    def make_provision_config(self, resources: 'Resources', num_nodes: int,
+                              cluster_name: str
+                              ) -> provision_common.ProvisionConfig:
+        """The deploy-variables step: Resources -> ProvisionConfig."""
+        raise NotImplementedError
+
+    # ------------------------------------------------ credentials
+    @classmethod
+    def check_credentials(cls) -> Tuple[bool, Optional[str]]:
+        """(ok, reason-if-not)."""
+        raise NotImplementedError
+
+    def __repr__(self) -> str:
+        return self.NAME
+
+
+CLOUD_REGISTRY: Dict[str, type] = {}
+
+
+def register(cls: type) -> type:
+    CLOUD_REGISTRY[cls.NAME.lower()] = cls
+    return cls
+
+
+def from_name(name: str) -> Cloud:
+    key = name.lower()
+    if key not in CLOUD_REGISTRY:
+        raise ValueError(
+            f'Unknown cloud {name!r}; known: {sorted(CLOUD_REGISTRY)}')
+    return CLOUD_REGISTRY[key]()

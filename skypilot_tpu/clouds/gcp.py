@@ -1,0 +1,243 @@
+"""GCP cloud: TPU slices (first-class), GPU VMs, CPU VMs.
+
+Role of reference ``sky/clouds/gcp.py`` (feasibility ``:460-651``, TPU
+specifics: stop unsupported for TPU pods ``:193-200``,
+``need_cleanup_after_preemption_or_failure`` for TPU VMs ``:935-944``).
+TPU-first redesign: a slice is one logical node with ``num_hosts`` hosts
+(no ``num_ips_per_node`` hack); ``make_provision_config`` emits the
+queued-resources/TPU-VM node config directly.
+"""
+from __future__ import annotations
+
+import os
+import subprocess
+from typing import Iterator, List, Optional, Tuple, TYPE_CHECKING
+
+from skypilot_tpu import catalog
+from skypilot_tpu import config as config_lib
+from skypilot_tpu import exceptions
+from skypilot_tpu.clouds import cloud as cloud_lib
+from skypilot_tpu.provision import common as provision_common
+
+if TYPE_CHECKING:
+    from skypilot_tpu.resources import Resources
+
+_DEFAULT_TPU_VM_IMAGE_CPUS = 8
+
+
+@cloud_lib.register
+class GCP(cloud_lib.Cloud):
+    NAME = 'gcp'
+    PROVISIONER = 'gcp'
+
+    @classmethod
+    def unsupported_features(cls):
+        return {
+            cloud_lib.CloudImplementationFeatures.CUSTOM_DISK_TIER:
+                'disk tiers are not configurable for TPU VMs',
+        }
+
+    @classmethod
+    def check_stop_supported(cls, resources: 'Resources'
+                             ) -> Optional[str]:
+        """TPU pods (multi-host slices) cannot be stopped, only deleted
+        (reference ``sky/clouds/gcp.py:193-200``)."""
+        if resources.is_tpu and resources.tpu.is_pod:
+            return ('TPU pod slices do not support stop; use down '
+                    '(terminate) instead.')
+        return None
+
+    # ------------------------------------------------ feasibility
+    def get_feasible_launchable_resources(
+            self, resources: 'Resources',
+            num_nodes: int = 1) -> Tuple[List['Resources'], List[str]]:
+        if resources.is_tpu:
+            return self._feasible_tpu(resources)
+        if resources.accelerators:
+            return self._feasible_gpu(resources)
+        return self._feasible_cpu(resources)
+
+    def _feasible_tpu(self, resources: 'Resources'
+                      ) -> Tuple[List['Resources'], List[str]]:
+        tpu = resources.tpu
+        entries = catalog.zones_for_accelerator(
+            tpu.name, region=resources.region, cloud='gcp')
+        if resources.zone is not None:
+            entries = [e for e in entries if e.zone == resources.zone]
+        if not entries:
+            hints = [
+                name for name in catalog.get_tpus()
+                if name.startswith(f'tpu-{tpu.generation}')
+            ]
+            return [], hints[:8]
+        # One candidate per region (zone chosen by the zone loop).
+        seen_regions = set()
+        out = []
+        for e in entries:
+            if e.region in seen_regions:
+                continue
+            seen_regions.add(e.region)
+            out.append(resources.copy(
+                instance_type=e.instance_type, region=e.region))
+        return out, []
+
+    def _feasible_gpu(self, resources: 'Resources'
+                      ) -> Tuple[List['Resources'], List[str]]:
+        (name, count), = resources.accelerators.items()
+        matches = [
+            e for e in catalog.get_catalog('gcp')
+            if e.accelerator_name == name and e.accelerator_count == count
+            and (resources.region is None or e.region == resources.region)
+            and (resources.zone is None or e.zone == resources.zone)
+        ]
+        if not matches:
+            hints = sorted({
+                e.accelerator_name for e in catalog.get_catalog('gcp')
+                if e.accelerator_name
+                and name.lower().split('-')[0] in e.accelerator_name.lower()
+            })
+            return [], hints[:8]
+        best_by_region = {}
+        for e in matches:
+            cur = best_by_region.get(e.region)
+            if cur is None or e.price < cur.price:
+                best_by_region[e.region] = e
+        out = [
+            resources.copy(instance_type=e.instance_type, region=e.region)
+            for e in sorted(best_by_region.values(), key=lambda e: e.price)
+        ]
+        return out, []
+
+    def _feasible_cpu(self, resources: 'Resources'
+                      ) -> Tuple[List['Resources'], List[str]]:
+        cpus = memory = None
+        at_least = True
+        if resources.cpus:
+            at_least = resources.cpus.endswith('+')
+            cpus = float(resources.cpus.rstrip('+'))
+        if resources.memory:
+            memory = float(resources.memory.rstrip('+'))
+        if resources.instance_type:
+            if not catalog.instance_type_exists(resources.instance_type):
+                return [], []
+            return [resources.copy()], []
+        entry = catalog.get_instance_type_for_cpus(
+            cpus, memory, at_least=at_least, region=resources.region)
+        if entry is None:
+            return [], []
+        return [resources.copy(instance_type=entry.instance_type,
+                               region=resources.region or entry.region)], []
+
+    def zones_provision_loop(self, resources: 'Resources'
+                             ) -> Iterator[cloud_lib.Zone]:
+        if resources.zone is not None:
+            yield cloud_lib.Zone(resources.zone,
+                                 resources.region or 'unknown')
+            return
+        if resources.is_tpu:
+            entries = catalog.zones_for_accelerator(
+                resources.tpu.name, region=resources.region)
+        elif resources.accelerators:
+            (name, count), = resources.accelerators.items()
+            entries = catalog.zones_for_accelerator(
+                name, count=count, region=resources.region)
+        else:
+            entries = [e for e in catalog.get_catalog('gcp')
+                       if e.instance_type == resources.instance_type
+                       and (resources.region is None
+                            or e.region == resources.region)]
+        seen = set()
+        for e in entries:
+            if e.zone in seen:
+                continue
+            seen.add(e.zone)
+            yield cloud_lib.Zone(e.zone, e.region)
+
+    # ------------------------------------------------ pricing
+    def instance_type_to_hourly_cost(self, resources: 'Resources',
+                                     use_spot: bool) -> float:
+        accel = None
+        if resources.is_tpu:
+            accel = resources.tpu.name
+        elif resources.accelerators:
+            accel, = resources.accelerators.keys()
+        return catalog.get_hourly_cost(
+            resources.instance_type, use_spot=use_spot,
+            region=resources.region, accelerator_name=accel)
+
+    def get_egress_cost(self, num_gigabytes: float) -> float:
+        # GCP inter-continent egress, $/GB (reference egress model,
+        # ``sky/optimizer.py:77-106`` / ``sky/clouds/gcp.py``).
+        if num_gigabytes <= 0:
+            return 0.0
+        return 0.12 * num_gigabytes
+
+    # ------------------------------------------------ provisioning
+    def make_provision_config(self, resources: 'Resources', num_nodes: int,
+                              cluster_name: str
+                              ) -> provision_common.ProvisionConfig:
+        provider_config = {
+            'project_id': config_lib.get_nested(('gcp', 'project_id')),
+            'vpc_name': config_lib.get_nested(('gcp', 'vpc_name')),
+        }
+        accel_args = resources.accelerator_args or {}
+        node_config = {
+            'use_spot': resources.use_spot,
+            'disk_size_gb': resources.disk_size,
+            'labels': resources.labels or {},
+        }
+        if resources.is_tpu:
+            tpu = resources.tpu
+            node_config.update({
+                'kind': 'tpu_vm',
+                'accelerator': tpu.name,
+                'accelerator_type': tpu.accelerator_type,
+                'runtime_version': resources.tpu_runtime_version,
+                'hosts_per_node': tpu.num_hosts,
+                'chips_per_host': tpu.chips_per_host,
+                'reserved': bool(accel_args.get(
+                    'reserved',
+                    config_lib.get_nested(('gcp', 'reserved'), False))),
+                'best_effort': bool(accel_args.get('best_effort', False)),
+            })
+        else:
+            node_config.update({
+                'kind': 'gce',
+                'machine_type': resources.instance_type,
+                'hosts_per_node': 1,
+                'chips_per_host': 0,
+                'image_id': resources.image_id,
+            })
+            if resources.accelerators:
+                (name, count), = resources.accelerators.items()
+                node_config['guest_accelerators'] = {name: count}
+        return provision_common.ProvisionConfig(
+            provider_config=provider_config,
+            node_config=node_config,
+            count=num_nodes,
+            tags={'skytpu-cluster-name': cluster_name},
+            ports_to_open=resources.ports or [])
+
+    # ------------------------------------------------ credentials
+    @classmethod
+    def check_credentials(cls) -> Tuple[bool, Optional[str]]:
+        if os.environ.get('GOOGLE_APPLICATION_CREDENTIALS'):
+            return True, None
+        try:
+            proc = subprocess.run(
+                ['gcloud', 'auth', 'list',
+                 '--filter=status:ACTIVE', '--format=value(account)'],
+                capture_output=True, text=True, timeout=10, check=False)
+            if proc.returncode == 0 and proc.stdout.strip():
+                return True, None
+            return False, 'No active gcloud account; run `gcloud auth login`.'
+        except (FileNotFoundError, subprocess.TimeoutExpired):
+            return False, ('gcloud CLI not found and '
+                           'GOOGLE_APPLICATION_CREDENTIALS not set.')
+
+
+def need_cleanup_after_preemption_or_failure(
+        resources: 'Resources') -> bool:
+    """Preempted TPU VMs leave debris that must be deleted explicitly
+    (reference ``sky/clouds/gcp.py:935-944``)."""
+    return resources.is_tpu

@@ -1,0 +1,88 @@
+"""Local cloud: hermetic dev/test substrate over the local provisioner.
+
+The reference's closest analog is ``LocalDockerBackend``
+(``sky/backends/local_docker_backend.py:47``), which bypasses the
+optimizer; here local is a real Cloud so the ENTIRE pipeline (optimizer →
+failover → provisioner → agent) runs hermetically. It is only feasible
+when explicitly requested (``cloud: local``), so it never shadows real
+clouds in optimization.
+
+"TPU slices" on the local cloud simulate topology: a tpu-v5e-16 request
+becomes 2 node dirs (hosts) with the full rank/coordinator env contract —
+multi-host logic is exercised for real, compute is whatever the local
+machine runs.
+"""
+from __future__ import annotations
+
+from typing import Iterator, List, Optional, Tuple, TYPE_CHECKING
+
+from skypilot_tpu.clouds import cloud as cloud_lib
+from skypilot_tpu.provision import common as provision_common
+
+if TYPE_CHECKING:
+    from skypilot_tpu.resources import Resources
+
+ZONES = ('local-a', 'local-b', 'local-c')
+REGION = 'local'
+
+
+@cloud_lib.register
+class Local(cloud_lib.Cloud):
+    NAME = 'local'
+    PROVISIONER = 'local'
+
+    @classmethod
+    def unsupported_features(cls):
+        return {
+            cloud_lib.CloudImplementationFeatures.OPEN_PORTS:
+                'local clusters have no cloud firewall',
+            cloud_lib.CloudImplementationFeatures.CUSTOM_DISK_TIER:
+                'local disks are what they are',
+        }
+
+    def get_feasible_launchable_resources(
+            self, resources: 'Resources',
+            num_nodes: int = 1) -> Tuple[List['Resources'], List[str]]:
+        # Only feasible when the user pinned cloud=local.
+        if resources.cloud != 'local':
+            return [], []
+        return [resources.copy(instance_type='local',
+                               region=REGION)], []
+
+    def zones_provision_loop(self, resources: 'Resources'
+                             ) -> Iterator[cloud_lib.Zone]:
+        if resources.zone is not None:
+            yield cloud_lib.Zone(resources.zone, REGION)
+            return
+        for z in ZONES:
+            yield cloud_lib.Zone(z, REGION)
+
+    def instance_type_to_hourly_cost(self, resources: 'Resources',
+                                     use_spot: bool) -> float:
+        del resources, use_spot
+        return 0.0
+
+    def make_provision_config(self, resources: 'Resources', num_nodes: int,
+                              cluster_name: str
+                              ) -> provision_common.ProvisionConfig:
+        node_config = {
+            'use_spot': resources.use_spot,
+            'hosts_per_node': 1,
+            'chips_per_host': 0,
+        }
+        if resources.is_tpu:
+            tpu = resources.tpu
+            node_config.update({
+                'accelerator': tpu.name,
+                'hosts_per_node': tpu.num_hosts,
+                'chips_per_host': tpu.chips_per_host,
+            })
+        return provision_common.ProvisionConfig(
+            provider_config={},
+            node_config=node_config,
+            count=num_nodes,
+            tags={'skytpu-cluster-name': cluster_name})
+
+    @classmethod
+    def check_credentials(cls) -> Tuple[bool, Optional[str]]:
+        return True, None

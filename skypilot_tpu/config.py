@@ -1,0 +1,86 @@
+"""Layered user config (role of reference ``sky/skypilot_config.py:84``).
+
+Optional ``~/.skytpu/config.yaml`` (override path via ``SKYTPU_CONFIG``),
+jsonschema-validated, read through dotted-path ``get_nested``. Infra knobs
+live here (controller resources, gcp project/network, autostop defaults),
+never in task YAML.
+"""
+from __future__ import annotations
+
+import copy
+import os
+import threading
+from typing import Any, Dict, Iterable, Optional, Tuple
+
+import yaml
+
+from skypilot_tpu import tpu_logging
+from skypilot_tpu.utils import schemas
+
+logger = tpu_logging.init_logger(__name__)
+
+ENV_VAR = 'SKYTPU_CONFIG'
+_DEFAULT_PATH = '~/.skytpu/config.yaml'
+
+_lock = threading.Lock()
+_config: Optional[Dict[str, Any]] = None
+_loaded_path: Optional[str] = None
+
+
+def _config_path() -> str:
+    return os.path.expanduser(os.environ.get(ENV_VAR, _DEFAULT_PATH))
+
+
+def _load() -> Dict[str, Any]:
+    global _config, _loaded_path
+    path = _config_path()
+    with _lock:
+        if _config is not None and _loaded_path == path:
+            return _config
+        config: Dict[str, Any] = {}
+        if os.path.exists(path):
+            with open(path, encoding='utf-8') as f:
+                loaded = yaml.safe_load(f)
+            if loaded:
+                schemas.validate(loaded, schemas.CONFIG_SCHEMA,
+                                 f'config file {path}: ')
+                config = loaded
+        _config = config
+        _loaded_path = path
+        return _config
+
+
+def loaded() -> bool:
+    return bool(_load())
+
+
+def get_nested(keys: Iterable[str], default_value: Any = None) -> Any:
+    """config.get_nested(('gcp', 'project_id'), None)"""
+    cur: Any = _load()
+    for key in keys:
+        if not isinstance(cur, dict) or key not in cur:
+            return default_value
+        cur = cur[key]
+    return copy.deepcopy(cur)
+
+
+def set_nested(keys: Tuple[str, ...], value: Any) -> Dict[str, Any]:
+    """Return a copy of the config with keys set (does not persist)."""
+    config = copy.deepcopy(_load())
+    cur = config
+    for key in keys[:-1]:
+        cur = cur.setdefault(key, {})
+    cur[keys[-1]] = value
+    return config
+
+
+def to_dict() -> Dict[str, Any]:
+    return copy.deepcopy(_load())
+
+
+def reload() -> None:
+    """Drop the cache (tests point SKYTPU_CONFIG at a new file)."""
+    global _config, _loaded_path
+    with _lock:
+        _config = None
+        _loaded_path = None

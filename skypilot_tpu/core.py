@@ -1,0 +1,155 @@
+"""Core SDK ops: status/start/stop/down/autostop/queue/cancel/logs/cost.
+
+Role of reference ``sky/core.py`` (``status`` ``:41``, ``stop`` ``:396``,
+``down`` ``:456``, ``autostop`` ``:491``, ``queue`` ``:600``, ``cancel``
+``:662``, ``tail_logs`` ``:750``, ``cost_report`` ``:213``).
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+from skypilot_tpu import clouds as clouds_lib
+from skypilot_tpu import exceptions
+from skypilot_tpu import global_state
+from skypilot_tpu import tpu_logging
+from skypilot_tpu.backend import backend_utils
+from skypilot_tpu.backend import tpu_backend
+from skypilot_tpu.provision import provisioner
+
+logger = tpu_logging.init_logger(__name__)
+
+
+def status(cluster_names: Optional[List[str]] = None,
+           refresh: bool = False) -> List[Dict[str, Any]]:
+    """Cluster records (optionally reconciled against the cloud)."""
+    records = global_state.get_clusters()
+    if cluster_names:
+        records = [r for r in records if r['name'] in cluster_names]
+    if refresh:
+        refreshed = []
+        for record in records:
+            new_record, _ = backend_utils.refresh_cluster_status(
+                record['name'])
+            if new_record is not None:
+                refreshed.append(new_record)
+        records = refreshed
+    return records
+
+
+def _get_handle(cluster_name: str):
+    record = global_state.get_cluster_from_name(cluster_name)
+    if record is None or record['handle'] is None:
+        raise exceptions.ClusterDoesNotExist(
+            f'Cluster {cluster_name!r} does not exist.')
+    return record['handle']
+
+
+def start(cluster_name: str,
+          idle_minutes_to_autostop: Optional[int] = None,
+          retry_until_up: bool = False) -> Any:
+    """Restart a STOPPED cluster (reference ``sky.start``)."""
+    from skypilot_tpu import execution
+    from skypilot_tpu.task import Task
+    record = global_state.get_cluster_from_name(cluster_name)
+    if record is None or record['handle'] is None:
+        raise exceptions.ClusterDoesNotExist(
+            f'Cluster {cluster_name!r} does not exist.')
+    handle = record['handle']
+    task = Task(name='start')
+    task.set_resources(handle.launched_resources)
+    _, new_handle = execution.launch(
+        task, cluster_name=cluster_name,
+        idle_minutes_to_autostop=idle_minutes_to_autostop,
+        retry_until_up=retry_until_up,
+        stream_logs=False)
+    return new_handle
+
+
+def stop(cluster_name: str) -> None:
+    handle = _get_handle(cluster_name)
+    backend = tpu_backend.TpuVmBackend()
+    backend.teardown(handle, terminate=False)
+
+
+def down(cluster_name: str) -> None:
+    handle = _get_handle(cluster_name)
+    backend = tpu_backend.TpuVmBackend()
+    backend.teardown(handle, terminate=True)
+
+
+def autostop(cluster_name: str, idle_minutes: int,
+             down: bool = False) -> None:  # pylint: disable=redefined-outer-name
+    handle = backend_utils.check_cluster_available(cluster_name)
+    backend = tpu_backend.TpuVmBackend()
+    backend.set_autostop(handle, idle_minutes, down=down)
+
+
+def queue(cluster_name: str) -> List[Dict[str, Any]]:
+    handle = backend_utils.check_cluster_available(cluster_name)
+    backend = tpu_backend.TpuVmBackend()
+    return backend.get_job_queue(handle)
+
+
+def cancel(cluster_name: str,
+           job_id: Optional[int] = None,
+           all: bool = False) -> List[int]:  # pylint: disable=redefined-builtin
+    if job_id is None and not all:
+        raise ValueError('Specify job_id or all=True.')
+    handle = backend_utils.check_cluster_available(cluster_name)
+    backend = tpu_backend.TpuVmBackend()
+    return backend.cancel_jobs(handle, None if all else job_id)
+
+
+def tail_logs(cluster_name: str, job_id: int,
+              follow: bool = True) -> None:
+    handle = backend_utils.check_cluster_available(cluster_name)
+    backend = tpu_backend.TpuVmBackend()
+    backend.tail_logs(handle, job_id, follow=follow)
+
+
+def job_status(cluster_name: str, job_id: int) -> Optional[str]:
+    handle = backend_utils.check_cluster_available(cluster_name)
+    backend = tpu_backend.TpuVmBackend()
+    return backend.get_job_status(handle, job_id)
+
+
+def cost_report() -> List[Dict[str, Any]]:
+    """Per-cluster cost from recorded usage intervals × catalog price
+    (reference ``sky/core.py:213`` + usage intervals
+    ``sky/global_user_state.py:469``)."""
+    out = []
+    rows = global_state.get_clusters() + global_state.get_cluster_history()
+    seen = set()
+    for record in rows:
+        name = record['name']
+        if name in seen:
+            continue
+        seen.add(name)
+        launched = record.get('launched_resources')
+        hours = global_state.get_cluster_usage_hours(name)
+        cost_per_hr = 0.0
+        if launched:
+            try:
+                from skypilot_tpu.resources import Resources
+                res = Resources.from_yaml_config(launched)
+                cloud = clouds_lib.from_name(res.cloud or 'gcp')
+                cost_per_hr = cloud.instance_type_to_hourly_cost(
+                    res, res.use_spot)
+            except Exception:  # pylint: disable=broad-except
+                logger.debug(f'cost lookup failed for {name}',
+                             exc_info=True)
+        out.append({
+            'name': name,
+            'duration_hours': hours,
+            'cost_per_hour': cost_per_hr,
+            'total_cost': hours * cost_per_hr,
+            'resources': launched,
+        })
+    return out
+
+
+def cluster_is_idle(cluster_name: str) -> bool:
+    handle = backend_utils.check_cluster_available(cluster_name)
+    resp = provisioner.agent_request(handle.head_runner(),
+                                     {'op': 'is_idle'})
+    return bool(resp['idle'])

@@ -1,0 +1,28 @@
+"""Download commands for cloud URIs used as file_mounts sources.
+
+Role of reference ``sky/cloud_stores.py`` (561 LoC of per-store
+CloudStorage classes): given ``gs://...``/``s3://...``/``https://...``,
+emit the shell command that fetches it onto a cluster host.
+"""
+from __future__ import annotations
+
+import shlex
+
+
+def make_download_command(src: str, dst: str) -> str:
+    """Shell command to download src URI to dst path on a host."""
+    q_dst = shlex.quote(dst)
+    q_src = shlex.quote(src)
+    mkdir = f'mkdir -p $(dirname {q_dst})'
+    if src.startswith('gs://'):
+        return (f'{mkdir} && (gsutil -m cp -r {q_src} {q_dst} || '
+                f'gcloud storage cp -r {q_src} {q_dst})')
+    if src.startswith('s3://'):
+        return f'{mkdir} && aws s3 cp --recursive {q_src} {q_dst}'
+    if src.startswith('r2://'):
+        path = src[len('r2://'):]
+        return (f'{mkdir} && aws s3 cp --recursive s3://{shlex.quote(path)} '
+                f'{q_dst} --endpoint-url "$R2_ENDPOINT"')
+    if src.startswith(('https://', 'http://')):
+        return f'{mkdir} && curl -fsSL {q_src} -o {q_dst}'
+    raise ValueError(f'Unsupported URI scheme: {src}')

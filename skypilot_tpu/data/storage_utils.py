@@ -1,0 +1,48 @@
+"""Execute storage mounts on a provisioned cluster.
+
+Role of reference ``_execute_storage_mounts``
+(``sky/backends/cloud_vm_ray_backend.py:4832``): for each
+``path -> Storage``, ensure the bucket exists + source is synced, then on
+every host either download (COPY) or mount (MOUNT) at the path.
+"""
+from __future__ import annotations
+
+import os
+from typing import Any, Dict
+
+from skypilot_tpu import tpu_logging
+from skypilot_tpu.data import storage as storage_lib
+from skypilot_tpu.utils import subprocess_utils
+
+logger = tpu_logging.init_logger(__name__)
+
+
+def resolve_storage(value: Any) -> storage_lib.Storage:
+    if isinstance(value, storage_lib.Storage):
+        return value
+    if isinstance(value, dict):
+        return storage_lib.Storage.from_yaml_config(value)
+    raise ValueError(f'Cannot resolve storage spec: {value!r}')
+
+
+def execute_storage_mounts(handle,
+                           storage_mounts: Dict[str, Any]) -> None:
+    resolved = {path: resolve_storage(cfg)
+                for path, cfg in storage_mounts.items()}
+    for storage in resolved.values():
+        storage.sync_to_stores()
+
+    runners = handle.runners()
+
+    def mount_on_host(runner) -> None:
+        for path, storage in resolved.items():
+            store = storage.primary_store
+            if storage.mode == storage_lib.StorageMode.COPY:
+                cmd = store.make_download_command(path)
+            else:
+                cmd = store.make_mount_command(path)
+            runner.check_run(cmd, log_path=os.devnull)
+
+    subprocess_utils.run_in_parallel(mount_on_host, runners)
+    logger.debug(f'Storage mounts ready on {len(runners)} host(s): '
+                 f'{list(resolved)}')

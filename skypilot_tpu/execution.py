@@ -1,0 +1,153 @@
+"""Execution pipeline: the OPTIMIZE→PROVISION→SYNC→SETUP→EXEC stage
+machine behind ``launch`` / ``exec``.
+
+Role of reference ``sky/execution.py`` (``Stage`` ``:31``, ``_execute``
+``:95``, ``launch`` ``:368``, ``exec`` ``:553``).
+"""
+from __future__ import annotations
+
+import enum
+from typing import Any, List, Optional, Tuple, Union
+
+from skypilot_tpu import admin_policy as admin_policy_lib
+from skypilot_tpu import exceptions
+from skypilot_tpu import optimizer as optimizer_lib
+from skypilot_tpu import tpu_logging
+from skypilot_tpu.backend import tpu_backend
+from skypilot_tpu.dag import Dag
+from skypilot_tpu.task import Task
+from skypilot_tpu.utils import common_utils
+
+logger = tpu_logging.init_logger(__name__)
+
+
+class Stage(enum.Enum):
+    OPTIMIZE = enum.auto()
+    PROVISION = enum.auto()
+    SYNC_WORKDIR = enum.auto()
+    SYNC_FILE_MOUNTS = enum.auto()
+    SETUP = enum.auto()
+    EXEC = enum.auto()
+    DOWN = enum.auto()
+
+
+def _to_dag(task_or_dag: Union[Task, Dag]) -> Dag:
+    if isinstance(task_or_dag, Dag):
+        return task_or_dag
+    dag = Dag(name=task_or_dag.name)
+    dag.add(task_or_dag)
+    return dag
+
+
+def _execute(
+    dag: Dag,
+    *,
+    cluster_name: Optional[str],
+    stages: Optional[List[Stage]],
+    dryrun: bool,
+    detach_run: bool,
+    idle_minutes_to_autostop: Optional[int],
+    down: bool,
+    retry_until_up: bool,
+    no_setup: bool,
+) -> Tuple[Optional[int], Optional[Any]]:
+    if len(dag) != 1:
+        raise exceptions.NotSupportedError(
+            'launch/exec support single-task dags; use jobs.launch for '
+            'pipelines.')
+    dag = admin_policy_lib.apply(dag)
+    task = dag.topological_order()[0]
+    if cluster_name is None:
+        cluster_name = common_utils.generate_cluster_name()
+    common_utils.check_cluster_name_is_valid(cluster_name)
+    stages = stages or list(Stage)
+
+    backend = tpu_backend.TpuVmBackend()
+    handle = None
+    job_id = None
+
+    if Stage.OPTIMIZE in stages:
+        optimizer_lib.optimize(dag, quiet=tpu_logging.is_silent())
+    if Stage.PROVISION in stages:
+        handle = backend.provision(task, task.best_resources,
+                                   cluster_name=cluster_name,
+                                   dryrun=dryrun,
+                                   retry_until_up=retry_until_up)
+        if dryrun:
+            logger.info('Dryrun finished (optimize + plan only).')
+            return None, None
+    else:
+        from skypilot_tpu.backend import backend_utils
+        handle = backend_utils.check_cluster_available(cluster_name)
+
+    assert handle is not None
+    if Stage.SYNC_WORKDIR in stages and task.workdir:
+        backend.sync_workdir(handle, task.workdir)
+    if Stage.SYNC_FILE_MOUNTS in stages and (task.file_mounts
+                                             or task.storage_mounts):
+        backend.sync_file_mounts(handle, task.file_mounts,
+                                 task.storage_mounts)
+    if Stage.SETUP in stages and not no_setup:
+        backend.setup(handle, task)
+    if idle_minutes_to_autostop is not None:
+        backend.set_autostop(handle, idle_minutes_to_autostop, down=down)
+    if Stage.EXEC in stages:
+        try:
+            job_id = backend.execute(handle, task, detach_run=detach_run)
+        finally:
+            backend.post_execute(handle, down)
+    if Stage.DOWN in stages and down and idle_minutes_to_autostop is None:
+        backend.teardown(handle, terminate=True)
+    return job_id, handle
+
+
+def launch(
+    task: Union[Task, Dag],
+    cluster_name: Optional[str] = None,
+    *,
+    dryrun: bool = False,
+    detach_run: bool = True,
+    stream_logs: bool = False,
+    idle_minutes_to_autostop: Optional[int] = None,
+    down: bool = False,
+    retry_until_up: bool = False,
+    no_setup: bool = False,
+) -> Tuple[Optional[int], Optional[Any]]:
+    """Provision (or reuse) a cluster and run the task on it.
+
+    Returns (job_id, handle). Reference ``sky.launch``
+    (``sky/execution.py:368``)."""
+    job_id, handle = _execute(
+        _to_dag(task),
+        cluster_name=cluster_name,
+        stages=None,
+        dryrun=dryrun,
+        detach_run=detach_run and not stream_logs,
+        idle_minutes_to_autostop=idle_minutes_to_autostop,
+        down=down,
+        retry_until_up=retry_until_up,
+        no_setup=no_setup,
+    )
+    return job_id, handle
+
+
+def exec_cmd(  # pylint: disable=redefined-builtin
+    task: Union[Task, Dag],
+    cluster_name: str,
+    *,
+    detach_run: bool = True,
+    dryrun: bool = False,
+) -> Tuple[Optional[int], Optional[Any]]:
+    """Run a task on an existing UP cluster: skips provision/setup
+    (reference ``sky.exec`` ``sky/execution.py:553``)."""
+    return _execute(
+        _to_dag(task),
+        cluster_name=cluster_name,
+        stages=[Stage.SYNC_WORKDIR, Stage.SYNC_FILE_MOUNTS, Stage.EXEC],
+        dryrun=dryrun,
+        detach_run=detach_run,
+        idle_minutes_to_autostop=None,
+        down=False,
+        retry_until_up=False,
+        no_setup=True,
+    )

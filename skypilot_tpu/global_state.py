@@ -1,0 +1,355 @@
+"""Client-side global state: SQLite DB of clusters / storage / enabled clouds.
+
+Role of reference ``sky/global_user_state.py`` (``create_table``
+``sky/global_user_state.py:34``, ``add_or_update_cluster`` ``:148``). The DB
+lives under the state dir (``SKYTPU_STATE_DIR``, default ``~/.skytpu``), so
+tests isolate state by pointing the env var at a tmp dir.
+
+Cluster handles are stored as pickles with a ``_VERSION`` guard (reference
+versioned-pickle idea for client/controller skew).
+"""
+from __future__ import annotations
+
+import enum
+import json
+import os
+import pickle
+import sqlite3
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+from skypilot_tpu.utils import common_utils
+
+
+class ClusterStatus(enum.Enum):
+    """Cluster lifecycle (reference ``sky/status_lib.py`` semantics:
+    INIT = partially provisioned / unknown; UP = runtime healthy;
+    STOPPED = instances stopped, disk kept)."""
+    INIT = 'INIT'
+    UP = 'UP'
+    STOPPED = 'STOPPED'
+
+    def colored(self) -> str:
+        color = {'INIT': '\x1b[33m', 'UP': '\x1b[32m',
+                 'STOPPED': '\x1b[90m'}[self.value]
+        return f'{color}{self.value}\x1b[0m'
+
+
+class StorageStatus(enum.Enum):
+    INIT = 'INIT'
+    UPLOAD_FAILED = 'UPLOAD_FAILED'
+    READY = 'READY'
+
+
+_lock = threading.Lock()
+_conn_cache: Dict[str, sqlite3.Connection] = {}
+
+
+def _db_path() -> str:
+    return os.path.join(common_utils.state_dir(), 'state.db')
+
+
+def _get_conn() -> sqlite3.Connection:
+    """One connection per (path, thread-shared with check_same_thread off,
+    guarded by _lock for writes)."""
+    path = _db_path()
+    with _lock:
+        conn = _conn_cache.get(path)
+        if conn is None:
+            os.makedirs(os.path.dirname(path), exist_ok=True)
+            conn = sqlite3.connect(path, check_same_thread=False)
+            conn.execute('PRAGMA journal_mode=WAL')
+            _create_tables(conn)
+            _conn_cache[path] = conn
+        return conn
+
+
+def _create_tables(conn: sqlite3.Connection) -> None:
+    cur = conn.cursor()
+    cur.execute("""\
+        CREATE TABLE IF NOT EXISTS clusters (
+            name TEXT PRIMARY KEY,
+            launched_at INTEGER,
+            handle BLOB,
+            last_use TEXT,
+            status TEXT,
+            autostop INTEGER DEFAULT -1,
+            to_down INTEGER DEFAULT 0,
+            owner TEXT DEFAULT null,
+            cluster_hash TEXT DEFAULT null,
+            launched_resources TEXT DEFAULT null,
+            usage_intervals BLOB DEFAULT null)""")
+    cur.execute("""\
+        CREATE TABLE IF NOT EXISTS cluster_history (
+            cluster_hash TEXT PRIMARY KEY,
+            name TEXT,
+            num_nodes INTEGER,
+            requested_resources TEXT,
+            launched_resources TEXT,
+            usage_intervals BLOB)""")
+    cur.execute("""\
+        CREATE TABLE IF NOT EXISTS storage (
+            name TEXT PRIMARY KEY,
+            launched_at INTEGER,
+            handle BLOB,
+            last_use TEXT,
+            status TEXT)""")
+    cur.execute("""\
+        CREATE TABLE IF NOT EXISTS enabled_clouds (
+            cloud TEXT PRIMARY KEY)""")
+    cur.execute("""\
+        CREATE TABLE IF NOT EXISTS config (
+            key TEXT PRIMARY KEY,
+            value TEXT)""")
+    conn.commit()
+
+
+# ---------------------------------------------------------------- clusters
+def add_or_update_cluster(cluster_name: str,
+                          cluster_handle: Any,
+                          requested_resources: Optional[Any] = None,
+                          ready: bool = False,
+                          is_launch: bool = True) -> None:
+    """Upsert a cluster row (reference ``add_or_update_cluster``)."""
+    status = ClusterStatus.UP if ready else ClusterStatus.INIT
+    handle_blob = pickle.dumps(cluster_handle)
+    now = int(time.time())
+    conn = _get_conn()
+    with _lock:
+        row = conn.execute(
+            'SELECT usage_intervals, launched_at FROM clusters WHERE name=?',
+            (cluster_name,)).fetchone()
+        usage_intervals: List = []
+        launched_at = now
+        if row is not None:
+            usage_intervals = pickle.loads(row[0]) if row[0] else []
+            launched_at = row[1] or now
+        if is_launch:
+            if not usage_intervals or usage_intervals[-1][1] is not None:
+                usage_intervals.append([now, None])
+        launched_resources = None
+        handle_res = getattr(cluster_handle, 'launched_resources', None)
+        if handle_res is not None:
+            launched_resources = json.dumps(handle_res.to_yaml_config())
+        conn.execute(
+            """INSERT INTO clusters
+               (name, launched_at, handle, last_use, status, autostop,
+                owner, cluster_hash, launched_resources, usage_intervals)
+               VALUES (?,?,?,?,?,
+                       COALESCE((SELECT autostop FROM clusters WHERE name=?),
+                                -1),
+                       ?,?,?,?)
+               ON CONFLICT(name) DO UPDATE SET
+                 handle=excluded.handle, last_use=excluded.last_use,
+                 status=excluded.status,
+                 launched_resources=excluded.launched_resources,
+                 usage_intervals=excluded.usage_intervals""",
+            (cluster_name, launched_at, handle_blob, _last_use(), status.value,
+             cluster_name, common_utils.get_user_hash(),
+             getattr(cluster_handle, 'cluster_hash', None),
+             launched_resources, pickle.dumps(usage_intervals)))
+        conn.commit()
+
+
+def _last_use() -> str:
+    import sys
+    if not sys.argv:
+        return 'api'
+    parts = [os.path.basename(sys.argv[0])] + sys.argv[1:]
+    return ' '.join(parts)[:200]
+
+
+def update_cluster_status(cluster_name: str,
+                          status: ClusterStatus) -> None:
+    conn = _get_conn()
+    with _lock:
+        conn.execute('UPDATE clusters SET status=? WHERE name=?',
+                     (status.value, cluster_name))
+        conn.commit()
+
+
+def update_last_use(cluster_name: str) -> None:
+    conn = _get_conn()
+    with _lock:
+        conn.execute('UPDATE clusters SET last_use=? WHERE name=?',
+                     (_last_use(), cluster_name))
+        conn.commit()
+
+
+def remove_cluster(cluster_name: str, terminate: bool) -> None:
+    """On stop: close the usage interval, keep row as STOPPED. On
+    terminate: archive usage to cluster_history and drop the row."""
+    conn = _get_conn()
+    now = int(time.time())
+    with _lock:
+        row = conn.execute(
+            'SELECT usage_intervals, cluster_hash, launched_resources, handle '
+            'FROM clusters WHERE name=?', (cluster_name,)).fetchone()
+        if row is None:
+            return
+        usage_intervals = pickle.loads(row[0]) if row[0] else []
+        if usage_intervals and usage_intervals[-1][1] is None:
+            usage_intervals[-1][1] = now
+        if terminate:
+            cluster_hash = row[1] or cluster_name
+            handle = pickle.loads(row[3]) if row[3] else None
+            num_nodes = getattr(handle, 'num_nodes', None)
+            conn.execute(
+                """INSERT OR REPLACE INTO cluster_history
+                   (cluster_hash, name, num_nodes, requested_resources,
+                    launched_resources, usage_intervals)
+                   VALUES (?,?,?,?,?,?)""",
+                (cluster_hash, cluster_name, num_nodes, None, row[2],
+                 pickle.dumps(usage_intervals)))
+            conn.execute('DELETE FROM clusters WHERE name=?', (cluster_name,))
+        else:
+            conn.execute(
+                'UPDATE clusters SET status=?, usage_intervals=? '
+                'WHERE name=?',
+                (ClusterStatus.STOPPED.value, pickle.dumps(usage_intervals),
+                 cluster_name))
+        conn.commit()
+
+
+def get_cluster_from_name(
+        cluster_name: str) -> Optional[Dict[str, Any]]:
+    conn = _get_conn()
+    row = conn.execute(
+        'SELECT name, launched_at, handle, last_use, status, autostop, '
+        'to_down, owner, launched_resources, usage_intervals '
+        'FROM clusters WHERE name=?', (cluster_name,)).fetchone()
+    if row is None:
+        return None
+    return _cluster_row_to_record(row)
+
+
+def _cluster_row_to_record(row) -> Dict[str, Any]:
+    return {
+        'name': row[0],
+        'launched_at': row[1],
+        'handle': pickle.loads(row[2]) if row[2] else None,
+        'last_use': row[3],
+        'status': ClusterStatus(row[4]),
+        'autostop': row[5],
+        'to_down': bool(row[6]),
+        'owner': row[7],
+        'launched_resources': json.loads(row[8]) if row[8] else None,
+        'usage_intervals': pickle.loads(row[9]) if row[9] else [],
+    }
+
+
+def get_clusters() -> List[Dict[str, Any]]:
+    conn = _get_conn()
+    rows = conn.execute(
+        'SELECT name, launched_at, handle, last_use, status, autostop, '
+        'to_down, owner, launched_resources, usage_intervals '
+        'FROM clusters ORDER BY launched_at DESC').fetchall()
+    return [_cluster_row_to_record(r) for r in rows]
+
+
+def get_handle_from_cluster_name(cluster_name: str) -> Optional[Any]:
+    record = get_cluster_from_name(cluster_name)
+    return record['handle'] if record else None
+
+
+def set_cluster_autostop(cluster_name: str, idle_minutes: int,
+                         to_down: bool = False) -> None:
+    conn = _get_conn()
+    with _lock:
+        conn.execute(
+            'UPDATE clusters SET autostop=?, to_down=? WHERE name=?',
+            (idle_minutes, int(to_down), cluster_name))
+        conn.commit()
+
+
+def get_cluster_usage_hours(cluster_name_or_hash: str) -> float:
+    """Total up-hours from usage intervals (live + history)."""
+    conn = _get_conn()
+    now = int(time.time())
+    total = 0.0
+    for table, col in (('clusters', 'name'),
+                       ('cluster_history', 'cluster_hash'),
+                       ('cluster_history', 'name')):
+        rows = conn.execute(
+            f'SELECT usage_intervals FROM {table} WHERE {col}=?',
+            (cluster_name_or_hash,)).fetchall()
+        for (blob,) in rows:
+            if not blob:
+                continue
+            for start, end in pickle.loads(blob):
+                total += ((end or now) - start) / 3600.0
+        if total:
+            break
+    return total
+
+
+def get_cluster_history() -> List[Dict[str, Any]]:
+    conn = _get_conn()
+    rows = conn.execute(
+        'SELECT cluster_hash, name, num_nodes, launched_resources, '
+        'usage_intervals FROM cluster_history').fetchall()
+    out = []
+    for row in rows:
+        out.append({
+            'cluster_hash': row[0],
+            'name': row[1],
+            'num_nodes': row[2],
+            'launched_resources': json.loads(row[3]) if row[3] else None,
+            'usage_intervals': pickle.loads(row[4]) if row[4] else [],
+        })
+    return out
+
+
+# ---------------------------------------------------------------- storage
+def add_or_update_storage(storage_name: str, storage_handle: Any,
+                          storage_status: StorageStatus) -> None:
+    conn = _get_conn()
+    with _lock:
+        conn.execute(
+            'INSERT OR REPLACE INTO storage VALUES (?,?,?,?,?)',
+            (storage_name, int(time.time()), pickle.dumps(storage_handle),
+             _last_use(), storage_status.value))
+        conn.commit()
+
+
+def remove_storage(storage_name: str) -> None:
+    conn = _get_conn()
+    with _lock:
+        conn.execute('DELETE FROM storage WHERE name=?', (storage_name,))
+        conn.commit()
+
+
+def get_storage() -> List[Dict[str, Any]]:
+    conn = _get_conn()
+    rows = conn.execute(
+        'SELECT name, launched_at, handle, last_use, status '
+        'FROM storage').fetchall()
+    return [{
+        'name': r[0], 'launched_at': r[1],
+        'handle': pickle.loads(r[2]) if r[2] else None,
+        'last_use': r[3], 'status': StorageStatus(r[4]),
+    } for r in rows]
+
+
+def get_storage_from_name(storage_name: str) -> Optional[Dict[str, Any]]:
+    for record in get_storage():
+        if record['name'] == storage_name:
+            return record
+    return None
+
+
+# ---------------------------------------------------------------- clouds
+def set_enabled_clouds(clouds: List[str]) -> None:
+    conn = _get_conn()
+    with _lock:
+        conn.execute('DELETE FROM enabled_clouds')
+        conn.executemany('INSERT INTO enabled_clouds VALUES (?)',
+                         [(c,) for c in clouds])
+        conn.commit()
+
+
+def get_enabled_clouds() -> List[str]:
+    conn = _get_conn()
+    return [r[0] for r in
+            conn.execute('SELECT cloud FROM enabled_clouds').fetchall()]

@@ -212,18 +212,22 @@ class InferenceEngine:
     def step(self, horizon: int = 1) -> List[Tuple[int, int, bool]]:
         """Admit waiting requests into free slots (prefill), then run up to
         ``horizon`` fused decode steps (one host sync). Returns
-        [(request_id, token, finished), ...] in emission order. Tokens a
-        slot produces after its EOS/max_new_tokens within the horizon are
-        discarded host-side."""
-        self._admit()
-        return self._decode(horizon)
+        [(request_id, token, finished), ...] in emission order — including
+        the prefill (first) token of each newly admitted request, so
+        streaming consumers see requests that finish during admission.
+        Tokens a slot produces after its EOS/max_new_tokens within the
+        horizon are discarded host-side (not emitted, not in ``output``)."""
+        events = self._admit()
+        events.extend(self._decode(horizon))
+        return events
 
     # ------------------------------------------------------------------
     _PREFILL_N_BUCKETS = (1, 2, 4, 8, 16, 32)
 
-    def _admit(self) -> None:
+    def _admit(self) -> List[Tuple[int, int, bool]]:
         """Admit as many queued requests as free slots allow, prefilling
-        them in one batched device call."""
+        them in one batched device call. Returns the prefill-token events
+        [(request_id, token, finished), ...] for the admitted requests."""
         free = [s for s in range(self.max_batch) if self._slots[s] is None]
         batch: List[Tuple[int, Request]] = []
         for slot in free:
@@ -232,7 +236,7 @@ class InferenceEngine:
             except queue.Empty:
                 break
         if not batch:
-            return
+            return []
         # Pad request count to a compiled bucket (extra rows re-prefill the
         # first request into its own slot — harmless duplicate writes).
         n = 1
@@ -259,6 +263,7 @@ class InferenceEngine:
             jnp.asarray(true_lens), jnp.asarray(slots))
         next_tokens = np.asarray(next_tokens)
         now = time.time()
+        events: List[Tuple[int, int, bool]] = []
         for i, (slot, req) in enumerate(batch):
             token = int(next_tokens[i])
             req.first_token_time = now
@@ -266,7 +271,9 @@ class InferenceEngine:
             self._slots[slot] = req
             self._slot_len[slot] = len(req.prompt)
             self._cur_token[slot] = token
-            self._maybe_finish(slot, token)
+            finished = self._maybe_finish(slot, token)
+            events.append((req.request_id, token, finished))
+        return events
 
     _HORIZON_BUCKETS = (1, 2, 4, 8, 16, 32, 64)
 
@@ -341,16 +348,3 @@ def _topk_threshold(logits: jax.Array, topks: jax.Array) -> jax.Array:
     idx = jnp.clip(topks - 1, 0, logits.shape[-1] - 1)
     thr = jnp.take_along_axis(sorted_desc, idx[:, None], axis=-1)
     return jnp.where(topks[:, None] > 0, thr, -jnp.inf)
-
-
-@functools.partial(jax.jit, donate_argnums=(0,), static_argnames=('slot',))
-def _splice_slot(cache: llama.KVCache, k: jax.Array, v: jax.Array,
-                 slot: int, plen) -> llama.KVCache:
-    """Write prefilled KV [L, 1, bucket, h, d] into batched cache row
-    ``slot`` and set its length to plen."""
-    ck = jax.lax.dynamic_update_slice(
-        cache.k, k.astype(cache.k.dtype), (0, slot, 0, 0, 0))
-    cv = jax.lax.dynamic_update_slice(
-        cache.v, v.astype(cache.v.dtype), (0, slot, 0, 0, 0))
-    length = cache.length.at[slot].set(jnp.asarray(plen, jnp.int32))
-    return llama.KVCache(k=ck, v=cv, length=length)

@@ -86,9 +86,15 @@ def moe_ffn(layer: Params, x: jax.Array, cfg: ModelConfig):
 
 def load_balancing_loss(router_logits: jax.Array, topk_idx: jax.Array,
                         n_experts: int) -> jax.Array:
-    """Auxiliary load-balancing loss (Switch-Transformer style)."""
+    """Auxiliary load-balancing loss (Switch/Mixtral top-k formulation).
+
+    ``frac_tokens`` counts every one of the k assignments per token (divided
+    by k so it still sums to 1), so imbalance among non-first-choice
+    assignments is penalized too.
+    """
     probs = jax.nn.softmax(router_logits, axis=-1)             # [b,s,E]
+    k = topk_idx.shape[-1]
     frac_tokens = jnp.mean(
-        jax.nn.one_hot(topk_idx[..., 0], n_experts), axis=(0, 1))
+        jax.nn.one_hot(topk_idx, n_experts).sum(axis=2), axis=(0, 1)) / k
     frac_probs = jnp.mean(probs, axis=(0, 1))
     return n_experts * jnp.sum(frac_tokens * frac_probs)

@@ -367,6 +367,18 @@ def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
     q: [b, sq, hq, d]; k/v: [b, skv, hkv, d] (GQA: hkv divides hq).
     Returns [b, sq, hq, d].
     """
+    b, sq, hq, d = q.shape
+    _, skv, hkv, _ = k.shape
+    if hq % hkv != 0:
+        raise ValueError(f'GQA requires hkv ({hkv}) to divide hq ({hq})')
+    if causal and sq != skv:
+        raise ValueError(
+            f'causal flash kernel assumes sq == skv (got {sq} vs {skv}); '
+            'use ops.attention with q_offset for cached prefill/decode')
+    if sq % block_q != 0 or skv % block_k != 0:
+        raise ValueError(
+            f'seq lengths must be divisible by block sizes: sq={sq} '
+            f'(block_q={block_q}), skv={skv} (block_k={block_k})')
     if scale is None:
         scale = q.shape[-1] ** -0.5
     qt = q.transpose(0, 2, 1, 3)

@@ -1,0 +1,205 @@
+"""Cost/time optimizer: pick the best launchable resources per task.
+
+Role of reference ``sky/optimizer.py`` (``optimize`` ``:110``,
+``_fill_in_launchable_resources`` ``:1257``, chain DP ``:411``, egress
+model ``:77-106``). Differences: chains use DP with egress edge costs;
+general DAGs use per-task greedy (the reference's ILP needs pulp, and its
+jobs pipelines only support chains anyway — ``sky/dag.py`` docstring).
+
+The failover loop re-runs ``optimize`` with ``blocked_resources`` grown
+from provisioning errors (reference ``provision_with_retries``
+``sky/backends/cloud_vm_ray_backend.py:1979-2152``).
+"""
+from __future__ import annotations
+
+import enum
+from typing import Dict, Iterable, List, Optional, Tuple
+
+from skypilot_tpu import clouds as clouds_lib
+from skypilot_tpu import exceptions
+from skypilot_tpu import global_state
+from skypilot_tpu import tpu_logging
+from skypilot_tpu.dag import Dag
+from skypilot_tpu.resources import Resources
+from skypilot_tpu.task import Task
+
+logger = tpu_logging.init_logger(__name__)
+
+
+class OptimizeTarget(enum.Enum):
+    COST = 'cost'
+    TIME = 'time'
+
+
+def _enabled_clouds() -> List[str]:
+    enabled = global_state.get_enabled_clouds()
+    if not enabled:
+        # Local is always available; gcp joins after `check` caches it.
+        enabled = ['local', 'gcp']
+    return enabled
+
+
+def resources_blocked(candidate: Resources,
+                      blocked: Iterable[Resources]) -> bool:
+    """True if any blocked entry covers the candidate: every field the
+    blocked entry pins must match (unset fields are wildcards) — the
+    blocklist semantics of the reference failover loop."""
+    for b in blocked:
+        if b.cloud is not None and b.cloud != candidate.cloud:
+            continue
+        if b.region is not None and b.region != candidate.region:
+            continue
+        if b.zone is not None and b.zone != candidate.zone:
+            continue
+        if (b.instance_type is not None
+                and b.instance_type != candidate.instance_type):
+            continue
+        if b.accelerators is not None and (
+                b.accelerators != candidate.accelerators):
+            continue
+        if b.use_spot_specified and b.use_spot != candidate.use_spot:
+            continue
+        return True
+    return False
+
+
+def fill_in_launchable_resources(
+    task: Task,
+    blocked_resources: Optional[Iterable[Resources]] = None,
+) -> List[Tuple[Resources, float]]:
+    """Enumerate concrete (resources, $/hr) candidates for a task across
+    enabled clouds, cheapest first (stable for user-ordered lists)."""
+    blocked = list(blocked_resources or [])
+    enabled = _enabled_clouds()
+    out: List[Tuple[Resources, float]] = []
+    hints: List[str] = []
+    for res in task.resources:
+        target_clouds = ([res.cloud] if res.cloud is not None else
+                         [c for c in enabled if c != 'local'])
+        for cloud_name in target_clouds:
+            if cloud_name not in enabled:
+                raise exceptions.NoCloudAccessError(
+                    f'Cloud {cloud_name!r} requested but not enabled. '
+                    f"Run `skytpu check`. Enabled: {enabled}")
+            cloud = clouds_lib.from_name(cloud_name)
+            feasible, fuzzy = cloud.get_feasible_launchable_resources(
+                res, num_nodes=task.num_nodes)
+            hints.extend(fuzzy)
+            for cand in feasible:
+                if resources_blocked(cand, blocked):
+                    continue
+                cost = cloud.instance_type_to_hourly_cost(
+                    cand, cand.use_spot) * task.num_nodes
+                out.append((cand, cost))
+    if task.resources_ordered:
+        # Keep user preference order: candidates from earlier entries first.
+        return out
+    return sorted(out, key=lambda rc: rc[1])
+
+
+def _estimate_cost(task: Task, resources_cost_per_hr: float,
+                   minimize: OptimizeTarget) -> float:
+    hours = max(task.estimated_time_hours, 1e-6)
+    if minimize == OptimizeTarget.TIME:
+        return hours
+    return resources_cost_per_hr * hours
+
+
+def optimize(dag: Dag,
+             minimize: OptimizeTarget = OptimizeTarget.COST,
+             blocked_resources: Optional[Iterable[Resources]] = None,
+             quiet: bool = True) -> Dag:
+    """Assign ``best_resources`` to every task of the dag.
+
+    Chains get DP with egress edge costs; non-chains greedy per task.
+    Raises ResourcesUnavailableError when a task has no candidates."""
+    tasks = dag.topological_order()
+    per_task: Dict[Task, List[Tuple[Resources, float]]] = {}
+    for task in tasks:
+        candidates = fill_in_launchable_resources(task, blocked_resources)
+        if not candidates:
+            raise exceptions.ResourcesUnavailableError(
+                f'No launchable resources satisfy task {task.name!r} '
+                f'request(s): {task.resources} '
+                f'(blocked: {list(blocked_resources or [])})')
+        per_task[task] = candidates
+
+    if dag.is_chain() and len(tasks) > 1:
+        _optimize_chain_dp(tasks, per_task, minimize)
+    else:
+        for task in tasks:
+            if task.resources_ordered:
+                task.set_best_resources(per_task[task][0][0])
+            else:
+                best = min(per_task[task],
+                           key=lambda rc: _estimate_cost(
+                               task, rc[1], minimize))
+                task.set_best_resources(best[0])
+
+    if not quiet:
+        print(format_plan(dag, per_task))
+    return dag
+
+
+def _egress_cost(src: Resources, dst: Resources, gigabytes: float) -> float:
+    """Egress between consecutive chain tasks (reference
+    ``sky/optimizer.py:77-106``): free within a cloud, billed across."""
+    if gigabytes <= 0 or src.cloud == dst.cloud:
+        return 0.0
+    cloud = clouds_lib.from_name(src.cloud or 'gcp')
+    return cloud.get_egress_cost(gigabytes)
+
+
+def _optimize_chain_dp(tasks: List[Task],
+                       per_task: Dict[Task, List[Tuple[Resources, float]]],
+                       minimize: OptimizeTarget) -> None:
+    """DP over the chain (reference ``_optimize_by_dp``
+    ``sky/optimizer.py:411``)."""
+    # dp[i][j] = min total cost ending with task i on candidate j
+    dp: List[List[float]] = []
+    parent: List[List[int]] = []
+    first = per_task[tasks[0]]
+    dp.append([_estimate_cost(tasks[0], c, minimize) for _, c in first])
+    parent.append([-1] * len(first))
+    for i in range(1, len(tasks)):
+        prev_task, cur_task = tasks[i - 1], tasks[i]
+        cur = per_task[cur_task]
+        row: List[float] = []
+        prow: List[int] = []
+        for res, cost_hr in cur:
+            best_val, best_j = float('inf'), -1
+            for j, (pres, _) in enumerate(per_task[prev_task]):
+                val = dp[i - 1][j] + _egress_cost(
+                    pres, res, prev_task.estimated_outputs_gb)
+                if val < best_val:
+                    best_val, best_j = val, j
+            row.append(best_val + _estimate_cost(cur_task, cost_hr,
+                                                 minimize))
+            prow.append(best_j)
+        dp.append(row)
+        parent.append(prow)
+    # Backtrack.
+    j = min(range(len(dp[-1])), key=lambda jj: dp[-1][jj])
+    for i in range(len(tasks) - 1, -1, -1):
+        tasks[i].set_best_resources(per_task[tasks[i]][j][0])
+        j = parent[i][j]
+
+
+def format_plan(dag: Dag,
+                per_task: Optional[Dict[Task, List]] = None) -> str:
+    """Human-readable optimization table (reference comparison table)."""
+    lines = ['Optimizer plan:']
+    header = (f'  {"TASK":<18}{"RESOURCES":<40}{"$/HR":<10}'
+              f'{"EST. COST":<10}')
+    lines.append(header)
+    for task in dag.topological_order():
+        res = task.best_resources
+        try:
+            cloud = clouds_lib.from_name(res.cloud or 'gcp')
+            cost_hr = cloud.instance_type_to_hourly_cost(res, res.use_spot)
+        except Exception:  # pylint: disable=broad-except
+            cost_hr = 0.0
+        est = cost_hr * task.estimated_time_hours * task.num_nodes
+        lines.append(f'  {(task.name or "-")[:17]:<18}{str(res)[:39]:<40}'
+                     f'{cost_hr:<10.2f}{est:<10.2f}')
+    return '\n'.join(lines)

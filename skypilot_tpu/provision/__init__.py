@@ -1,0 +1,84 @@
+"""Provisioner API: function dispatch routed by provider name.
+
+Role of reference ``sky/provision/__init__.py:32``
+(``_route_to_cloud_impl``): each provider module under
+``skypilot_tpu.provision.<name>.instance`` implements the op functions;
+callers use ``provision.<op>(provider_name, ...)``.
+"""
+from __future__ import annotations
+
+import functools
+import importlib
+from typing import Callable, Dict, Optional
+
+from skypilot_tpu.provision.common import (ClusterInfo, HostInfo,
+                                           ProvisionConfig, ProvisionRecord,
+                                           get_command_runners)
+
+__all__ = [
+    'ClusterInfo', 'HostInfo', 'ProvisionConfig', 'ProvisionRecord',
+    'get_command_runners', 'run_instances', 'wait_instances',
+    'stop_instances', 'terminate_instances', 'query_instances',
+    'get_cluster_info',
+]
+
+
+def _impl(provider_name: str):
+    return importlib.import_module(
+        f'skypilot_tpu.provision.{provider_name.lower()}.instance')
+
+
+def _route(fn: Callable) -> Callable:
+    @functools.wraps(fn)
+    def wrapper(provider_name: str, *args, **kwargs):
+        impl = _impl(provider_name)
+        op = getattr(impl, fn.__name__, None)
+        if op is None:
+            raise NotImplementedError(
+                f'{provider_name} provisioner has no op {fn.__name__}')
+        return op(*args, **kwargs)
+    return wrapper
+
+
+@_route
+def run_instances(provider_name: str, region: str, zone: Optional[str],
+                  cluster_name: str,
+                  config: ProvisionConfig) -> ProvisionRecord:
+    """Create (or resume) the cluster's instances in one zone.
+
+    All-or-nothing gang semantics: on partial failure the impl must clean
+    up what it created and raise a ProvisionError subclass carrying the
+    blocklist scope."""
+    raise AssertionError  # dispatched
+
+
+@_route
+def wait_instances(provider_name: str, region: str, cluster_name: str,
+                   state: str) -> None:
+    """Block until every instance reaches ``state`` (e.g. RUNNING)."""
+    raise AssertionError
+
+
+@_route
+def stop_instances(provider_name: str, region: str,
+                   cluster_name: str) -> None:
+    raise AssertionError
+
+
+@_route
+def terminate_instances(provider_name: str, region: str,
+                        cluster_name: str) -> None:
+    raise AssertionError
+
+
+@_route
+def query_instances(provider_name: str, region: str, cluster_name: str
+                    ) -> Dict[str, str]:
+    """instance_id -> status (common.STATUS_*); {} if cluster is gone."""
+    raise AssertionError
+
+
+@_route
+def get_cluster_info(provider_name: str, region: str, cluster_name: str
+                     ) -> ClusterInfo:
+    raise AssertionError

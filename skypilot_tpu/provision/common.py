@@ -1,0 +1,136 @@
+"""Provisioner data model shared by client, provisioner, and head agent.
+
+Role of reference ``sky/provision/common.py`` (ProvisionConfig /
+ProvisionRecord / ClusterInfo dataclasses). TPU-first difference: one
+logical node may be a multi-host slice — hosts are first-class here
+(``ClusterInfo.hosts`` is the flat per-host list with ranks), instead of the
+reference's ``num_ips_per_node`` bolt-on
+(``sky/backends/cloud_vm_ray_backend.py:2550``).
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, List, Optional
+
+# Instance status strings (cloud-agnostic).
+STATUS_PENDING = 'PENDING'
+STATUS_RUNNING = 'RUNNING'
+STATUS_STOPPED = 'STOPPED'
+STATUS_TERMINATED = 'TERMINATED'
+
+
+@dataclasses.dataclass
+class HostInfo:
+    """One host (one VM / one TPU-VM worker) of the cluster."""
+    instance_id: str
+    rank: int                      # stable global host rank, 0 = head
+    internal_ip: str
+    external_ip: Optional[str] = None
+    ssh_port: int = 22
+    # Local provisioner: the directory acting as this host's HOME.
+    node_dir: Optional[str] = None
+    tags: Dict[str, str] = dataclasses.field(default_factory=dict)
+
+    def to_dict(self) -> Dict[str, Any]:
+        return dataclasses.asdict(self)
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> 'HostInfo':
+        return cls(**d)
+
+
+@dataclasses.dataclass
+class ClusterInfo:
+    """Everything needed to reach and run on a provisioned cluster."""
+    cluster_name: str
+    provider_name: str             # 'local' | 'gcp'
+    region: str
+    zone: Optional[str]
+    hosts: List[HostInfo]
+    head_instance_id: str
+    # chips visible to each host (TPU: 4 for v4/v5p hosts, 8 for v5e/v6e).
+    chips_per_host: int = 0
+    accelerator: Optional[str] = None   # e.g. 'tpu-v5e-16'
+    ssh_user: Optional[str] = None
+    ssh_private_key: Optional[str] = None
+    ssh_proxy_command: Optional[str] = None
+    provider_config: Dict[str, Any] = dataclasses.field(default_factory=dict)
+    # Docker is out of scope for TPU VMs; kept for parity of the data model.
+    docker_image: Optional[str] = None
+
+    @property
+    def num_hosts(self) -> int:
+        return len(self.hosts)
+
+    def head_host(self) -> HostInfo:
+        for h in self.hosts:
+            if h.instance_id == self.head_instance_id:
+                return h
+        raise ValueError(f'head instance {self.head_instance_id} not in '
+                         f'host list of {self.cluster_name}')
+
+    def worker_ips(self) -> List[str]:
+        return [h.internal_ip for h in
+                sorted(self.hosts, key=lambda h: h.rank)]
+
+    def to_dict(self) -> Dict[str, Any]:
+        d = dataclasses.asdict(self)
+        return d
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> 'ClusterInfo':
+        d = dict(d)
+        d['hosts'] = [HostInfo.from_dict(h) for h in d.get('hosts', [])]
+        return cls(**d)
+
+
+@dataclasses.dataclass
+class ProvisionConfig:
+    """Input to ``run_instances`` for one (cluster, zone) attempt."""
+    provider_config: Dict[str, Any]
+    node_config: Dict[str, Any]          # accelerator/machine/disk/image...
+    count: int                           # logical nodes (slices)
+    tags: Dict[str, str] = dataclasses.field(default_factory=dict)
+    resume_stopped_nodes: bool = True
+    ports_to_open: List[str] = dataclasses.field(default_factory=list)
+
+
+@dataclasses.dataclass
+class ProvisionRecord:
+    """Output of ``run_instances``."""
+    provider_name: str
+    cluster_name: str
+    region: str
+    zone: Optional[str]
+    head_instance_id: str
+    created_instance_ids: List[str] = dataclasses.field(default_factory=list)
+    resumed_instance_ids: List[str] = dataclasses.field(default_factory=list)
+
+    def is_instance_just_booted(self, instance_id: str) -> bool:
+        return (instance_id in self.created_instance_ids
+                or instance_id in self.resumed_instance_ids)
+
+
+def get_command_runners(cluster_info: ClusterInfo) -> List[Any]:
+    """Build one CommandRunner per host, ordered by rank.
+
+    Used by the client backend and by the head-side job driver (which runs
+    the user program on every host of the slice)."""
+    from skypilot_tpu.utils import command_runner as cr
+
+    runners: List[Any] = []
+    for host in sorted(cluster_info.hosts, key=lambda h: h.rank):
+        if cluster_info.provider_name == 'local':
+            assert host.node_dir, f'local host {host.instance_id} missing dir'
+            runners.append(cr.LocalProcessRunner(host.instance_id,
+                                                 host.node_dir))
+        else:
+            ip = host.external_ip or host.internal_ip
+            runners.append(cr.SSHCommandRunner(
+                ip,
+                ssh_user=cluster_info.ssh_user or 'skytpu',
+                ssh_private_key=(cluster_info.ssh_private_key
+                                 or '~/.skytpu/keys/skytpu.pem'),
+                ssh_proxy_command=cluster_info.ssh_proxy_command,
+                node_id=host.instance_id))
+    return runners

@@ -1,0 +1,204 @@
+"""Local provisioner: "instances" are directories + local processes.
+
+The hermetic substrate SURVEY §4 calls for (the reference has no in-repo
+fake cloud; its tests monkeypatch catalogs instead). A cluster is a
+directory under ``{state_dir}/local_clusters/<name>/`` with one ``node-<i>``
+dir per host; each dir acts as that host's HOME (see
+``LocalProcessRunner``). Multi-host TPU slices are simulated by multiple
+node dirs, so the rank/coordinator env contract is exercised for real.
+
+Failure injection: tests register a hook via :func:`set_failure_injector`
+to simulate stockouts/quota/preemption per zone — driving the same
+failover loop real clouds do.
+"""
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import time
+from typing import Callable, Dict, List, Optional
+
+import filelock
+
+from skypilot_tpu import exceptions
+from skypilot_tpu.provision import common
+from skypilot_tpu.utils import common_utils, subprocess_utils
+
+_META = 'meta.json'
+
+# test hook: fn(cluster_name, region, zone, config) -> None (may raise)
+_failure_injector: Optional[Callable] = None
+
+
+def set_failure_injector(fn: Optional[Callable]) -> None:
+    global _failure_injector
+    _failure_injector = fn
+
+
+def _clusters_root() -> str:
+    d = os.path.join(common_utils.state_dir(), 'local_clusters')
+    os.makedirs(d, exist_ok=True)
+    return d
+
+
+def _cluster_dir(cluster_name: str) -> str:
+    return os.path.join(_clusters_root(), cluster_name)
+
+
+def _meta_path(cluster_name: str) -> str:
+    return os.path.join(_cluster_dir(cluster_name), _META)
+
+
+def _lock(cluster_name: str) -> filelock.FileLock:
+    return filelock.FileLock(
+        os.path.join(_clusters_root(), f'.{cluster_name}.lock'))
+
+
+def _load_meta(cluster_name: str) -> Optional[dict]:
+    try:
+        with open(_meta_path(cluster_name), encoding='utf-8') as f:
+            return json.load(f)
+    except FileNotFoundError:
+        return None
+
+
+def _save_meta(cluster_name: str, meta: dict) -> None:
+    os.makedirs(_cluster_dir(cluster_name), exist_ok=True)
+    with open(_meta_path(cluster_name), 'w', encoding='utf-8') as f:
+        json.dump(meta, f, indent=1)
+
+
+def run_instances(region: str, zone: Optional[str], cluster_name: str,
+                  config: common.ProvisionConfig) -> common.ProvisionRecord:
+    if _failure_injector is not None:
+        _failure_injector(cluster_name, region, zone, config)
+    num_hosts = config.count * int(
+        config.node_config.get('hosts_per_node', 1))
+    with _lock(cluster_name):
+        meta = _load_meta(cluster_name)
+        created: List[str] = []
+        resumed: List[str] = []
+        if meta is None:
+            meta = {
+                'cluster_name': cluster_name,
+                'region': region,
+                'zone': zone,
+                'status': common.STATUS_RUNNING,
+                'num_hosts': num_hosts,
+                'node_config': config.node_config,
+                'created_at': time.time(),
+            }
+            for i in range(num_hosts):
+                node_dir = os.path.join(_cluster_dir(cluster_name),
+                                        f'node-{i}')
+                os.makedirs(node_dir, exist_ok=True)
+                created.append(f'{cluster_name}-node-{i}')
+        else:
+            if meta['num_hosts'] != num_hosts:
+                raise exceptions.ResourcesMismatchError(
+                    f'Cluster {cluster_name} exists with '
+                    f'{meta["num_hosts"]} hosts, requested {num_hosts}.')
+            if meta['status'] == common.STATUS_STOPPED:
+                resumed = [f'{cluster_name}-node-{i}'
+                           for i in range(num_hosts)]
+            meta['status'] = common.STATUS_RUNNING
+        _save_meta(cluster_name, meta)
+    return common.ProvisionRecord(
+        provider_name='local', cluster_name=cluster_name, region=region,
+        zone=zone, head_instance_id=f'{cluster_name}-node-0',
+        created_instance_ids=created, resumed_instance_ids=resumed)
+
+
+def wait_instances(region: str, cluster_name: str, state: str) -> None:
+    del region, state  # local instances are synchronous
+    if _load_meta(cluster_name) is None:
+        raise exceptions.ClusterDoesNotExist(cluster_name)
+
+
+def _collect_agent_pids(cluster_name: str) -> List[int]:
+    cdir = _cluster_dir(cluster_name)
+    pids: List[int] = []
+    if not os.path.isdir(cdir):
+        return pids
+    for node in sorted(os.listdir(cdir)):
+        if not node.startswith('node-'):
+            continue
+        pid_path = os.path.join(cdir, node, '.skytpu_agent', 'agentd.pid')
+        try:
+            with open(pid_path, encoding='utf-8') as f:
+                pids.append(int(f.read().strip()))
+        except (FileNotFoundError, NotADirectoryError, ValueError):
+            continue
+    return pids
+
+
+def _kill_pids(pids: List[int]) -> None:
+    """Kill agent daemon trees, killing our own tree LAST — autostop runs
+    this from the agentd itself (a cluster stopping itself must finish its
+    state mutation before dying)."""
+    import os as os_mod
+    me = os_mod.getpid()
+    own = []
+    for pid in pids:
+        if pid == me:
+            own.append(pid)
+            continue
+        subprocess_utils.kill_process_tree(pid)
+    for pid in own:
+        subprocess_utils.kill_process_tree(pid)
+
+
+def stop_instances(region: str, cluster_name: str) -> None:
+    del region
+    with _lock(cluster_name):
+        meta = _load_meta(cluster_name)
+        if meta is None:
+            return
+        pids = _collect_agent_pids(cluster_name)
+        meta['status'] = common.STATUS_STOPPED
+        _save_meta(cluster_name, meta)
+    _kill_pids(pids)
+
+
+def terminate_instances(region: str, cluster_name: str) -> None:
+    del region
+    with _lock(cluster_name):
+        if _load_meta(cluster_name) is None:
+            return
+        pids = _collect_agent_pids(cluster_name)
+        shutil.rmtree(_cluster_dir(cluster_name), ignore_errors=True)
+    _kill_pids(pids)
+
+
+def query_instances(region: str, cluster_name: str) -> Dict[str, str]:
+    del region
+    meta = _load_meta(cluster_name)
+    if meta is None:
+        return {}
+    return {f'{cluster_name}-node-{i}': meta['status']
+            for i in range(meta['num_hosts'])}
+
+
+def get_cluster_info(region: str, cluster_name: str) -> common.ClusterInfo:
+    meta = _load_meta(cluster_name)
+    if meta is None:
+        raise exceptions.ClusterDoesNotExist(cluster_name)
+    hosts = []
+    for i in range(meta['num_hosts']):
+        hosts.append(common.HostInfo(
+            instance_id=f'{cluster_name}-node-{i}',
+            rank=i,
+            internal_ip='127.0.0.1',
+            node_dir=os.path.join(_cluster_dir(cluster_name), f'node-{i}')))
+    node_config = meta.get('node_config', {})
+    return common.ClusterInfo(
+        cluster_name=cluster_name,
+        provider_name='local',
+        region=meta['region'],
+        zone=meta.get('zone'),
+        hosts=hosts,
+        head_instance_id=f'{cluster_name}-node-0',
+        chips_per_host=int(node_config.get('chips_per_host', 0)),
+        accelerator=node_config.get('accelerator'),
+    )

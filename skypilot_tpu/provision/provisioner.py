@@ -1,0 +1,137 @@
+"""Cloud-agnostic provisioning orchestration.
+
+Role of reference ``sky/provision/provisioner.py`` (``bulk_provision``
+``:100``, ``wait_for_ssh`` ``:348``, ``post_provision_runtime_setup``
+``:631``): one retryable entry that creates instances in a zone, waits for
+them, pushes the runtime onto every host in parallel, and starts the head
+agent. Raises :class:`exceptions.ProvisionError` subclasses the failover
+loop can blocklist on.
+"""
+from __future__ import annotations
+
+import json
+import os
+import shlex
+import sys
+import tempfile
+import time
+from typing import Dict, Optional
+
+from skypilot_tpu import exceptions
+from skypilot_tpu import provision
+from skypilot_tpu import tpu_logging
+from skypilot_tpu.agent import rpc as agent_rpc
+from skypilot_tpu.provision import common
+from skypilot_tpu.utils import subprocess_utils
+
+logger = tpu_logging.init_logger(__name__)
+
+_AGENT_READY_TIMEOUT = float(os.environ.get('SKYTPU_AGENT_READY_TIMEOUT',
+                                            '60'))
+
+
+def bulk_provision(provider_name: str,
+                   region: str,
+                   zone: Optional[str],
+                   cluster_name: str,
+                   config: common.ProvisionConfig) -> common.ClusterInfo:
+    """Provision one cluster attempt in one zone, end to end.
+
+    Steps: run_instances -> wait RUNNING -> get_cluster_info ->
+    runtime setup on all hosts -> start agentd on head -> wait agent ready.
+    """
+    start = time.time()
+    record = provision.run_instances(provider_name, region, zone,
+                                     cluster_name, config)
+    provision.wait_instances(provider_name, region, cluster_name,
+                             common.STATUS_RUNNING)
+    cluster_info = provision.get_cluster_info(provider_name, region,
+                                              cluster_name)
+    logger.debug(
+        f'Provisioned {cluster_info.num_hosts} host(s) for '
+        f'{cluster_name} in {zone or region} '
+        f'({time.time() - start:.1f}s); setting up runtime.')
+    post_provision_runtime_setup(cluster_info)
+    return cluster_info
+
+
+def post_provision_runtime_setup(
+        cluster_info: common.ClusterInfo) -> None:
+    """Push cluster_info to every host, start agentd on the head.
+
+    (Reference ``_post_provision_setup``: internal file mounts + ray
+    head/workers + skylet. No Ray here — the slice is the gang; only the
+    head runs a daemon.)"""
+    runners = common.get_command_runners(cluster_info)
+    info_json = json.dumps(cluster_info.to_dict())
+
+    with tempfile.NamedTemporaryFile('w', suffix='.json',
+                                     delete=False) as f:
+        f.write(info_json)
+        tmp_path = f.name
+    try:
+        def push(runner) -> None:
+            runner.run('mkdir -p ~/.skytpu_agent ~/sky_workdir',
+                       log_path=os.devnull)
+            runner.rsync(tmp_path, '~/.skytpu_agent/cluster_info.json',
+                         up=True)
+        subprocess_utils.run_in_parallel(push, runners)
+    finally:
+        os.unlink(tmp_path)
+
+    head = runners[0]
+    start_agent_cmd = (
+        'if [ -f ~/.skytpu_agent/agentd.pid ] && '
+        'kill -0 $(cat ~/.skytpu_agent/agentd.pid) 2>/dev/null; then '
+        '  echo "agentd already running"; '
+        'else '
+        f'  setsid {shlex.quote(sys.executable)} -m '
+        'skypilot_tpu.agent.agentd >> ~/.skytpu_agent/agentd.log 2>&1 '
+        '< /dev/null & '
+        'fi')
+    head.run(start_agent_cmd, log_path=os.devnull)
+    _wait_agent_ready(head)
+
+
+def _wait_agent_ready(head_runner) -> None:
+    deadline = time.time() + _AGENT_READY_TIMEOUT
+    last_err = ''
+    while time.time() < deadline:
+        try:
+            resp = agent_request(head_runner, {'op': 'agent_health'})
+            if resp.get('agentd_alive'):
+                return
+            last_err = f'agentd not alive yet: {resp}'
+        except exceptions.CommandError as e:
+            last_err = str(e)
+        time.sleep(0.2)
+    raise exceptions.ProvisionError(
+        f'Head agent failed to become ready in {_AGENT_READY_TIMEOUT}s: '
+        f'{last_err}')
+
+
+def agent_request(head_runner, request: Dict) -> Dict:
+    """Send one RPC to the head agent via the command runner; return the
+    parsed payload. Raises CommandError / ProvisionError on failure."""
+    cmd = (f'{shlex.quote(sys.executable)} -m skypilot_tpu.agent.rpc '
+           f'{shlex.quote(json.dumps(request))}')
+    out = head_runner.check_run(cmd)
+    for line in out.splitlines():
+        if line.startswith(agent_rpc.PAYLOAD_PREFIX):
+            payload = json.loads(line[len(agent_rpc.PAYLOAD_PREFIX):])
+            if not payload.get('ok'):
+                raise exceptions.ProvisionError(
+                    f'Agent RPC {request.get("op")} failed: '
+                    f'{payload.get("error")}')
+            return payload
+    raise exceptions.ProvisionError(
+        f'Agent RPC {request.get("op")}: no payload in output:\n'
+        f'{out[-1000:]}')
+
+
+def teardown_cluster(provider_name: str, region: str, cluster_name: str,
+                     terminate: bool) -> None:
+    if terminate:
+        provision.terminate_instances(provider_name, region, cluster_name)
+    else:
+        provision.stop_instances(provider_name, region, cluster_name)

@@ -55,7 +55,6 @@ class Resources:
         ports: Optional[List[Union[int, str]]] = None,
         labels: Optional[Dict[str, str]] = None,
         job_recovery: Optional[str] = None,
-        _is_image_managed: Optional[bool] = None,
     ):
         self._cloud = cloud.lower() if cloud else None
         self._instance_type = instance_type
@@ -155,7 +154,8 @@ class Resources:
             self._accelerators = {self._tpu.name: 1}
             if self._cloud is None:
                 self._cloud = 'gcp'
-            elif self._cloud != 'gcp':
+            elif self._cloud not in ('gcp', 'local'):
+                # 'local' simulates slice topology for hermetic tests.
                 raise exceptions.InvalidResourcesError(
                     f'TPUs are only available on GCP, got cloud={self._cloud!r}')
         else:
@@ -292,11 +292,22 @@ class Resources:
         if self._instance_type is not None and (
                 self._instance_type != other._instance_type):
             return False
+        # '8' (exact) only matches exactly-8; '8+' (at-least) matches >= 8.
         if self._cpus is not None:
-            if other._cpus is None or other._cpus < self._cpus:
+            if other._cpus is None:
+                return False
+            if self._cpus_at_least:
+                if other._cpus < self._cpus:
+                    return False
+            elif other._cpus != self._cpus:
                 return False
         if self._memory is not None:
-            if other._memory is None or other._memory < self._memory:
+            if other._memory is None:
+                return False
+            if self._memory_at_least:
+                if other._memory < self._memory:
+                    return False
+            elif other._memory != self._memory:
                 return False
         if self._disk_size > other._disk_size:
             return False

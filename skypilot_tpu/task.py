@@ -58,6 +58,12 @@ class Task:
         self._resources_ordered = False
         # Managed-jobs fields
         self.max_restarts_on_errors = 0
+        # Optimizer outputs / estimates
+        self._best_resources: Optional[resources_lib.Resources] = None
+        self.estimated_time_hours: float = 1.0
+        self.estimated_outputs_gb: float = 0.0
+        # Service spec (sky serve), parsed from the YAML 'service' section.
+        self.service: Optional[Any] = None
         # DAG wiring (populated by Dag)
         self._dag = None
 
@@ -88,8 +94,14 @@ class Task:
 
     @property
     def best_resources(self) -> resources_lib.Resources:
-        """The first candidate (after optimization, the chosen one)."""
+        """The optimizer's pick, falling back to the first candidate."""
+        if self._best_resources is not None:
+            return self._best_resources
         return self._resources[0]
+
+    def set_best_resources(self,
+                           resources: resources_lib.Resources) -> None:
+        self._best_resources = resources
 
     def _validate_topology(self) -> None:
         for res in self._resources:

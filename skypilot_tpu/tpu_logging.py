@@ -1,0 +1,75 @@
+"""Logging setup (role of reference ``sky/sky_logging.py``).
+
+Env-tunable:
+- ``SKYTPU_DEBUG=1``    -> DEBUG level + timestamps.
+- ``SKYTPU_MINIMIZE_LOGGING=1`` -> WARNING level (controllers set this).
+"""
+from __future__ import annotations
+
+import contextlib
+import logging
+import os
+import sys
+import threading
+
+_FORMAT = '%(levelname).1s %(asctime)s %(name)s:%(lineno)d] %(message)s'
+_SIMPLE_FORMAT = '%(message)s'
+_DATE_FORMAT = '%m-%d %H:%M:%S'
+
+_lock = threading.Lock()
+_initialized = False
+
+
+def _debug_enabled() -> bool:
+    return os.environ.get('SKYTPU_DEBUG', '0') == '1'
+
+
+def _minimize() -> bool:
+    return os.environ.get('SKYTPU_MINIMIZE_LOGGING', '0') == '1'
+
+
+def _root() -> logging.Logger:
+    return logging.getLogger('skytpu')
+
+
+def _setup() -> None:
+    global _initialized
+    with _lock:
+        if _initialized:
+            return
+        root = _root()
+        root.propagate = False
+        handler = logging.StreamHandler(sys.stdout)
+        if _debug_enabled():
+            root.setLevel(logging.DEBUG)
+            handler.setFormatter(logging.Formatter(_FORMAT, _DATE_FORMAT))
+        else:
+            root.setLevel(logging.WARNING if _minimize() else logging.INFO)
+            handler.setFormatter(logging.Formatter(_SIMPLE_FORMAT))
+        root.addHandler(handler)
+        _initialized = True
+
+
+def init_logger(name: str) -> logging.Logger:
+    _setup()
+    if name.startswith('skypilot_tpu'):
+        name = 'skytpu' + name[len('skypilot_tpu'):]
+    elif not name.startswith('skytpu'):
+        name = f'skytpu.{name}'
+    return logging.getLogger(name)
+
+
+@contextlib.contextmanager
+def silent():
+    """Temporarily raise the level to ERROR (quiet internal launches)."""
+    root = _root()
+    prev = root.level
+    root.setLevel(logging.ERROR)
+    try:
+        yield
+    finally:
+        root.setLevel(prev)
+
+
+def is_silent() -> bool:
+    return _root().level >= logging.ERROR

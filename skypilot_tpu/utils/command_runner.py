@@ -1,0 +1,235 @@
+"""Command runners: uniform run/rsync over local processes or SSH.
+
+Role of reference ``sky/utils/command_runner.py:168`` (``SSHCommandRunner``
+``:426``). Two implementations:
+
+- :class:`LocalProcessRunner` — a "node" is a directory on this machine
+  (HOME is pointed there), used by the local provisioner so the whole
+  orchestration stack runs hermetically in tests and on dev boxes.
+- :class:`SSHCommandRunner` — OpenSSH with ControlMaster multiplexing +
+  rsync, used for real TPU-VM hosts.
+"""
+from __future__ import annotations
+
+import os
+import shlex
+import shutil
+import subprocess
+import tempfile
+from typing import Dict, List, Optional, Tuple, Union
+
+from skypilot_tpu import exceptions
+from skypilot_tpu import tpu_logging
+
+logger = tpu_logging.init_logger(__name__)
+
+RunResult = Union[int, Tuple[int, str, str]]
+
+
+def _env_prefix(env: Optional[Dict[str, str]]) -> str:
+    if not env:
+        return ''
+    exports = ' && '.join(
+        f'export {k}={shlex.quote(str(v))}' for k, v in env.items())
+    return exports + ' && '
+
+
+class CommandRunner:
+    """Abstract runner for one node."""
+
+    def __init__(self, node_id: str):
+        self.node_id = node_id
+
+    def run(self,
+            cmd: str,
+            *,
+            env: Optional[Dict[str, str]] = None,
+            log_path: str = os.devnull,
+            stream_logs: bool = False,
+            require_outputs: bool = False,
+            cwd: Optional[str] = None,
+            timeout: Optional[float] = None) -> RunResult:
+        raise NotImplementedError
+
+    def rsync(self, source: str, target: str, *, up: bool) -> None:
+        """Sync source->target. ``up=True``: local source to node target."""
+        raise NotImplementedError
+
+    def check_run(self, cmd: str, **kwargs) -> str:
+        """Run; raise CommandError on failure; return stdout."""
+        rc, stdout, stderr = self.run(cmd, require_outputs=True, **kwargs)
+        if rc != 0:
+            raise exceptions.CommandError(rc, cmd, stderr[-2000:])
+        return stdout
+
+    @staticmethod
+    def _popen(args: List[str], *, shell: bool, env, cwd, log_path: str,
+               stream_logs: bool, require_outputs: bool,
+               timeout: Optional[float]) -> RunResult:
+        stdout_chunks: List[str] = []
+        stderr_chunks: List[str] = []
+        os.makedirs(os.path.dirname(os.path.abspath(log_path)) or '.',
+                    exist_ok=True)
+        with open(log_path, 'a', encoding='utf-8') as log_file:
+            proc = subprocess.Popen(
+                args, shell=shell, env=env, cwd=cwd,
+                stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True)
+            try:
+                out, err = proc.communicate(timeout=timeout)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+                out, err = proc.communicate()
+                log_file.write(out or '')
+                log_file.write(err or '')
+                return (124, out or '', (err or '') + '\n[timeout]') \
+                    if require_outputs else 124
+            if out:
+                log_file.write(out)
+                stdout_chunks.append(out)
+                if stream_logs:
+                    print(out, end='')
+            if err:
+                log_file.write(err)
+                stderr_chunks.append(err)
+                if stream_logs:
+                    print(err, end='')
+        rc = proc.returncode
+        if require_outputs:
+            return rc, ''.join(stdout_chunks), ''.join(stderr_chunks)
+        return rc
+
+
+class LocalProcessRunner(CommandRunner):
+    """Runs commands as local subprocesses with HOME pointed at the node
+    dir, so per-node files (``~/.skytpu_agent``, workdir, logs) are
+    isolated exactly like distinct VMs."""
+
+    def __init__(self, node_id: str, node_dir: str):
+        super().__init__(node_id)
+        self.node_dir = os.path.abspath(node_dir)
+        os.makedirs(self.node_dir, exist_ok=True)
+
+    def _node_env(self, extra: Optional[Dict[str, str]]) -> Dict[str, str]:
+        env = dict(os.environ)
+        env['HOME'] = self.node_dir
+        env['SKYTPU_AGENT_DIR'] = os.path.join(self.node_dir, '.skytpu_agent')
+        # The "VM" must see the same skypilot_tpu package as the client
+        # (real hosts get it via the runtime sync; local nodes via path).
+        repo_root = os.path.dirname(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))))
+        prev_pp = env.get('PYTHONPATH', '')
+        if repo_root not in prev_pp.split(os.pathsep):
+            env['PYTHONPATH'] = (f'{repo_root}{os.pathsep}{prev_pp}'
+                                 if prev_pp else repo_root)
+        if extra:
+            env.update({k: str(v) for k, v in extra.items()})
+        return env
+
+    def run(self, cmd, *, env=None, log_path=os.devnull, stream_logs=False,
+            require_outputs=False, cwd=None, timeout=None) -> RunResult:
+        full_env = self._node_env(env)
+        return self._popen(
+            ['bash', '-c', cmd], shell=False, env=full_env,
+            cwd=cwd or self.node_dir, log_path=log_path,
+            stream_logs=stream_logs, require_outputs=require_outputs,
+            timeout=timeout)
+
+    def rsync(self, source: str, target: str, *, up: bool) -> None:
+        if up:
+            src = os.path.expanduser(source)
+            dst = target
+            if dst.startswith('~'):
+                dst = os.path.join(self.node_dir, dst.lstrip('~/'))
+        else:
+            src = source
+            if src.startswith('~'):
+                src = os.path.join(self.node_dir, src.lstrip('~/'))
+            src = os.path.expanduser(src)
+            dst = os.path.expanduser(target)
+        dst = os.path.abspath(dst)
+        os.makedirs(os.path.dirname(dst), exist_ok=True)
+        if os.path.isdir(src):
+            shutil.copytree(src, dst, dirs_exist_ok=True, symlinks=True)
+        elif os.path.exists(src):
+            shutil.copy2(src, dst)
+        else:
+            raise exceptions.CommandError(1, f'rsync {source}',
+                                          f'source not found: {src}')
+
+
+class SSHCommandRunner(CommandRunner):
+    """OpenSSH runner with connection multiplexing (ControlMaster), the
+    same transport strategy as the reference (``command_runner.py:426``)."""
+
+    def __init__(self,
+                 ip: str,
+                 ssh_user: str,
+                 ssh_private_key: str,
+                 *,
+                 port: int = 22,
+                 ssh_proxy_command: Optional[str] = None,
+                 node_id: Optional[str] = None):
+        super().__init__(node_id or ip)
+        self.ip = ip
+        self.port = port
+        self.ssh_user = ssh_user
+        self.ssh_private_key = os.path.expanduser(ssh_private_key)
+        self.ssh_proxy_command = ssh_proxy_command
+        self._control_dir = os.path.join(
+            tempfile.gettempdir(), f'skytpu-ssh-{os.getuid()}')
+        os.makedirs(self._control_dir, exist_ok=True)
+
+    def _ssh_options(self) -> List[str]:
+        opts = [
+            '-i', self.ssh_private_key,
+            '-o', 'StrictHostKeyChecking=no',
+            '-o', 'UserKnownHostsFile=/dev/null',
+            '-o', 'IdentitiesOnly=yes',
+            '-o', 'GlobalKnownHostsFile=/dev/null',
+            '-o', 'ConnectTimeout=30',
+            '-o', 'ServerAliveInterval=5',
+            '-o', 'ServerAliveCountMax=3',
+            '-o', f'ControlPath={self._control_dir}/%C',
+            '-o', 'ControlMaster=auto',
+            '-o', 'ControlPersist=120s',
+            '-o', 'LogLevel=ERROR',
+            '-p', str(self.port),
+        ]
+        if self.ssh_proxy_command:
+            opts += ['-o', f'ProxyCommand={self.ssh_proxy_command}']
+        return opts
+
+    def ssh_base_command(self) -> List[str]:
+        return ['ssh'] + self._ssh_options() + [
+            f'{self.ssh_user}@{self.ip}']
+
+    def run(self, cmd, *, env=None, log_path=os.devnull, stream_logs=False,
+            require_outputs=False, cwd=None, timeout=None) -> RunResult:
+        remote_cmd = _env_prefix(env) + cmd
+        if cwd:
+            remote_cmd = f'cd {shlex.quote(cwd)} && {remote_cmd}'
+        args = self.ssh_base_command() + [
+            f'bash --login -c {shlex.quote(remote_cmd)}']
+        return self._popen(
+            args, shell=False, env=None, cwd=None, log_path=log_path,
+            stream_logs=stream_logs, require_outputs=require_outputs,
+            timeout=timeout)
+
+    def rsync(self, source: str, target: str, *, up: bool) -> None:
+        ssh_cmd = ' '.join(['ssh'] + [shlex.quote(o)
+                                      for o in self._ssh_options()])
+        rsync_cmd = [
+            'rsync', '-a', '--delete-missing-args',
+            '--exclude', '.git',
+            '-e', ssh_cmd,
+        ]
+        remote = f'{self.ssh_user}@{self.ip}:{target}'
+        if up:
+            rsync_cmd += [os.path.expanduser(source), remote]
+        else:
+            rsync_cmd += [remote, os.path.expanduser(target)]
+        proc = subprocess.run(rsync_cmd, capture_output=True, text=True,
+                              check=False)
+        if proc.returncode != 0:
+            raise exceptions.CommandError(
+                proc.returncode, ' '.join(rsync_cmd), proc.stderr[-2000:])

@@ -1,0 +1,129 @@
+"""Small shared helpers (role of reference ``sky/utils/common_utils.py``)."""
+from __future__ import annotations
+
+import functools
+import getpass
+import hashlib
+import json
+import os
+import re
+import socket
+import time
+import uuid
+from typing import Any, Dict, Optional
+
+_USER_HASH_FILE = None  # resolved lazily against the state dir
+_USER_HASH_LENGTH = 8
+
+_CLUSTER_NAME_RE = re.compile(r'^[a-z]([-a-z0-9]{0,62}[a-z0-9])?$')
+
+
+def state_dir() -> str:
+    """Client-side state directory (SQLite DB, keys, generated files)."""
+    d = os.environ.get('SKYTPU_STATE_DIR',
+                       os.path.expanduser('~/.skytpu'))
+    os.makedirs(d, exist_ok=True)
+    return d
+
+
+def get_user_hash() -> str:
+    """Stable per-user hash; persisted so controllers can impersonate the
+    submitting user (reference: ``common_utils.get_user_hash``)."""
+    env = os.environ.get('SKYTPU_USER_ID')
+    if env:
+        return env
+    path = os.path.join(state_dir(), 'user_hash')
+    if os.path.exists(path):
+        with open(path, encoding='utf-8') as f:
+            h = f.read().strip()
+        if h:
+            return h
+    h = hashlib.md5(
+        f'{getpass.getuser()}+{uuid.getnode()}'.encode()).hexdigest()
+    h = h[:_USER_HASH_LENGTH]
+    with open(path, 'w', encoding='utf-8') as f:
+        f.write(h)
+    return h
+
+
+def get_cleaned_username() -> str:
+    try:
+        return re.sub(r'[^a-z0-9-]', '-', getpass.getuser().lower())
+    except Exception:  # pylint: disable=broad-except
+        return 'unknown'
+
+
+def check_cluster_name_is_valid(name: Optional[str]) -> None:
+    if name is None:
+        return
+    if not _CLUSTER_NAME_RE.match(name):
+        raise ValueError(
+            f'Cluster name {name!r} is invalid: must match '
+            f'{_CLUSTER_NAME_RE.pattern} (lowercase RFC1035, <=64 chars).')
+
+
+def generate_cluster_name(prefix: str = 'sky') -> str:
+    return f'{prefix}-{get_cleaned_username()}-{uuid.uuid4().hex[:4]}'
+
+
+def make_run_timestamp() -> str:
+    return 'sky-' + time.strftime('%Y-%m-%d-%H-%M-%S-%f', time.localtime())
+
+
+def read_last_n_lines(path: str, n: int) -> str:
+    try:
+        with open(path, 'r', encoding='utf-8', errors='replace') as f:
+            return ''.join(f.readlines()[-n:])
+    except FileNotFoundError:
+        return ''
+
+
+def dump_json(value: Any) -> str:
+    return json.dumps(value, sort_keys=True, separators=(',', ':'))
+
+
+def load_json(s: Optional[str]) -> Any:
+    if not s:
+        return None
+    return json.loads(s)
+
+
+def find_free_port(start: int = 10000) -> int:
+    """Find a free TCP port on localhost (local provisioner, serve LB)."""
+    for port in range(start, start + 2000):
+        with socket.socket(socket.AF_INET, socket.SOCK_STREAM) as s:
+            try:
+                s.bind(('127.0.0.1', port))
+                return port
+            except OSError:
+                continue
+    raise RuntimeError('No free port found')
+
+
+def retry(n: int = 3, delay: float = 1.0, backoff: float = 2.0,
+          exceptions=(Exception,)):
+    """Retry decorator with exponential backoff."""
+    def deco(fn):
+        @functools.wraps(fn)
+        def wrapper(*args, **kwargs):
+            d = delay
+            for i in range(n):
+                try:
+                    return fn(*args, **kwargs)
+                except exceptions:
+                    if i == n - 1:
+                        raise
+                    time.sleep(d)
+                    d *= backoff
+        return wrapper
+    return deco
+
+
+def format_float(x: float, precision: int = 2) -> str:
+    if x >= 1000:
+        return f'{x:,.0f}'
+    return f'{x:.{precision}f}'
+
+
+def fields_to_dict(obj: Any, fields) -> Dict[str, Any]:
+    return {f: getattr(obj, f) for f in fields}

@@ -1,0 +1,104 @@
+"""Subprocess helpers: parallel map, process-tree kill.
+
+Role of reference ``sky/utils/subprocess_utils.py`` +
+``sky/skylet/subprocess_daemon.py`` (orphan reaping is handled by the agent
+driver holding the process group instead of a separate daemon).
+"""
+from __future__ import annotations
+
+import concurrent.futures
+import os
+import signal
+import subprocess
+import time
+from typing import Any, Callable, List, Optional, Sequence
+
+import psutil
+
+
+def get_parallel_threads(requested: Optional[int] = None) -> int:
+    cpu = os.cpu_count() or 4
+    n = requested if requested is not None else max(4, cpu - 1)
+    return max(1, n)
+
+
+def run_in_parallel(fn: Callable, args: Sequence[Any],
+                    num_threads: Optional[int] = None) -> List[Any]:
+    """Map fn over args with a thread pool; preserves order, propagates the
+    first exception."""
+    args = list(args)
+    if not args:
+        return []
+    if len(args) == 1:
+        return [fn(args[0])]
+    with concurrent.futures.ThreadPoolExecutor(
+            max_workers=get_parallel_threads(num_threads)) as pool:
+        return list(pool.map(fn, args))
+
+
+def kill_process_tree(pid: int, include_parent: bool = True,
+                      sig: int = signal.SIGTERM,
+                      timeout: float = 5.0) -> None:
+    """TERM then KILL the whole tree rooted at pid."""
+    try:
+        parent = psutil.Process(pid)
+    except psutil.NoSuchProcess:
+        return
+    procs = parent.children(recursive=True)
+    if include_parent:
+        procs.append(parent)
+    for p in procs:
+        try:
+            p.send_signal(sig)
+        except psutil.NoSuchProcess:
+            pass
+    _, alive = psutil.wait_procs(procs, timeout=timeout)
+    for p in alive:
+        try:
+            p.kill()
+        except psutil.NoSuchProcess:
+            pass
+
+
+def kill_children_processes(parent_pid: Optional[int] = None) -> None:
+    kill_process_tree(parent_pid or os.getpid(), include_parent=False)
+
+
+def pid_is_alive(pid: Optional[int]) -> bool:
+    if pid is None or pid <= 0:
+        return False
+    try:
+        proc = psutil.Process(pid)
+        return proc.status() != psutil.STATUS_ZOMBIE
+    except psutil.NoSuchProcess:
+        return False
+
+
+def launch_daemon(cmd: List[str], log_path: str,
+                  env: Optional[dict] = None,
+                  cwd: Optional[str] = None) -> int:
+    """Start a detached daemon process (own session), stdout+stderr to
+    log_path. Returns pid."""
+    os.makedirs(os.path.dirname(os.path.abspath(log_path)), exist_ok=True)
+    with open(log_path, 'ab') as log:
+        proc = subprocess.Popen(
+            cmd,
+            stdout=log,
+            stderr=subprocess.STDOUT,
+            stdin=subprocess.DEVNULL,
+            start_new_session=True,
+            env=env,
+            cwd=cwd,
+        )
+    return proc.pid
+
+
+def wait_for(predicate: Callable[[], bool], timeout: float,
+             interval: float = 0.1) -> bool:
+    """Poll predicate until true or timeout. Returns final value."""
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if predicate():
+            return True
+        time.sleep(interval)
+    return predicate()

@@ -1,0 +1,223 @@
+"""End-to-end tests of the launch spine on the local provisioner.
+
+This is the hermetic coverage SURVEY §4 calls for (improving on the
+reference, whose offline tests stop at dryrun/codegen assertions): a real
+launch → agent → job → logs → teardown cycle with no cloud.
+"""
+import os
+import time
+
+import pytest
+
+import skypilot_tpu as sky
+from skypilot_tpu import core, execution, exceptions, global_state
+from skypilot_tpu.task import Task
+
+pytestmark = pytest.mark.usefixtures('tmp_state_dir', 'fast_agent')
+
+TERMINAL = ('SUCCEEDED', 'FAILED', 'FAILED_DRIVER', 'CANCELLED')
+
+
+@pytest.fixture()
+def fast_agent(monkeypatch):
+    monkeypatch.setenv('SKYTPU_AGENT_TICK', '0.1')
+    monkeypatch.setenv('SKYTPU_AGENT_READY_TIMEOUT', '30')
+
+
+def _wait_job(cluster: str, job_id: int, timeout: float = 30.0) -> str:
+    deadline = time.time() + timeout
+    status = None
+    while time.time() < deadline:
+        status = core.job_status(cluster, job_id)
+        if status in TERMINAL:
+            return status
+        time.sleep(0.15)
+    return status or 'TIMEOUT'
+
+
+def _launch(task, cluster, **kwargs):
+    return execution.launch(task, cluster_name=cluster, **kwargs)
+
+
+def test_launch_end_to_end_single_node():
+    task = Task(name='t1', run='echo out-$((21*2))')
+    task.set_resources(sky.Resources(cloud='local', cpus='1+'))
+    job_id, handle = _launch(task, 'spine-basic')
+    try:
+        assert job_id == 1
+        assert handle.num_hosts == 1
+        assert _wait_job('spine-basic', job_id) == 'SUCCEEDED'
+        from skypilot_tpu.backend import tpu_backend
+        logs = tpu_backend.TpuVmBackend().get_job_logs(handle, job_id)
+        assert 'out-42' in logs
+        queue = core.queue('spine-basic')
+        assert queue[0]['job_id'] == job_id
+        assert queue[0]['status'] == 'SUCCEEDED'
+    finally:
+        core.down('spine-basic')
+    assert core.status() == []
+
+
+def test_multihost_slice_env_contract():
+    """A local tpu-v5e-16 'slice' = 2 hosts; every rank gets the gang env
+    (the contract jax.distributed.initialize consumes)."""
+    task = Task(name='gang', run=(
+        'echo "R=$SKYTPU_NODE_RANK N=$SKYTPU_NUM_NODES '
+        'C=$SKYTPU_NUM_CHIPS_PER_NODE COORD=$SKYTPU_COORDINATOR_ADDRESS '
+        'IPS=$(echo "$SKYTPU_NODE_IPS" | tr \'\\n\' \',\')"'))
+    task.set_resources(sky.Resources(cloud='local',
+                                     accelerators='tpu-v5e-16'))
+    job_id, handle = _launch(task, 'spine-gang')
+    try:
+        assert handle.num_hosts == 2
+        assert _wait_job('spine-gang', job_id) == 'SUCCEEDED'
+        from skypilot_tpu.backend import tpu_backend
+        logs = tpu_backend.TpuVmBackend().get_job_logs(handle, job_id)
+        assert 'R=0 N=2 C=8' in logs
+        assert 'R=1 N=2 C=8' in logs
+        assert 'COORD=127.0.0.1:8476' in logs
+        assert 'IPS=127.0.0.1,127.0.0.1,' in logs
+    finally:
+        core.down('spine-gang')
+
+
+def test_exec_reuses_cluster_and_fifo_order():
+    task = Task(name='first', run='sleep 0.3; echo first-done')
+    task.set_resources(sky.Resources(cloud='local'))
+    job1, handle = _launch(task, 'spine-exec')
+    try:
+        task2 = Task(name='second', run='echo second-done')
+        task2.set_resources(sky.Resources(cloud='local'))
+        job2, handle2 = execution.exec_cmd(task2, 'spine-exec')
+        assert handle2.cluster_name == handle.cluster_name
+        assert job2 == job1 + 1
+        assert _wait_job('spine-exec', job2) == 'SUCCEEDED'
+        # FIFO: second ran after first finished.
+        jobs = {j['job_id']: j for j in core.queue('spine-exec')}
+        assert jobs[job1]['status'] == 'SUCCEEDED'
+        assert jobs[job2]['start_at'] >= jobs[job1]['end_at']
+    finally:
+        core.down('spine-exec')
+
+
+def test_setup_runs_before_job_and_failure_is_reported():
+    task = Task(name='s', setup='echo marker > ~/setup_done.txt',
+                run='cat ~/setup_done.txt')
+    task.set_resources(sky.Resources(cloud='local'))
+    job_id, handle = _launch(task, 'spine-setup')
+    try:
+        assert _wait_job('spine-setup', job_id) == 'SUCCEEDED'
+        from skypilot_tpu.backend import tpu_backend
+        logs = tpu_backend.TpuVmBackend().get_job_logs(handle, job_id)
+        assert 'marker' in logs
+    finally:
+        core.down('spine-setup')
+
+    bad = Task(name='bad', setup='exit 3', run='echo never')
+    bad.set_resources(sky.Resources(cloud='local'))
+    with pytest.raises(exceptions.CommandError):
+        _launch(bad, 'spine-setup-bad')
+    core.down('spine-setup-bad')
+
+
+def test_workdir_and_file_mounts(tmp_path):
+    wd = tmp_path / 'wd'
+    wd.mkdir()
+    (wd / 'data.txt').write_text('workdir-data')
+    extra = tmp_path / 'extra.txt'
+    extra.write_text('mounted-file')
+    task = Task(name='wd', run='cat data.txt && cat ~/extra/extra.txt',
+                workdir=str(wd),
+                file_mounts={'~/extra/extra.txt': str(extra)})
+    task.set_resources(sky.Resources(cloud='local'))
+    job_id, handle = _launch(task, 'spine-wd')
+    try:
+        assert _wait_job('spine-wd', job_id) == 'SUCCEEDED'
+        from skypilot_tpu.backend import tpu_backend
+        logs = tpu_backend.TpuVmBackend().get_job_logs(handle, job_id)
+        assert 'workdir-data' in logs
+        assert 'mounted-file' in logs
+    finally:
+        core.down('spine-wd')
+
+
+def test_cancel_running_job():
+    task = Task(name='long', run='sleep 60')
+    task.set_resources(sky.Resources(cloud='local'))
+    job_id, _ = _launch(task, 'spine-cancel')
+    try:
+        deadline = time.time() + 20
+        while time.time() < deadline:
+            if core.job_status('spine-cancel', job_id) == 'RUNNING':
+                break
+            time.sleep(0.1)
+        cancelled = core.cancel('spine-cancel', job_id)
+        assert cancelled == [job_id]
+        assert core.job_status('spine-cancel', job_id) == 'CANCELLED'
+    finally:
+        core.down('spine-cancel')
+
+
+def test_resources_mismatch_on_reuse():
+    task = Task(name='small', run='echo hi')
+    task.set_resources(sky.Resources(cloud='local'))
+    _launch(task, 'spine-mismatch')
+    try:
+        big = Task(name='big', run='echo hi')
+        big.set_resources(sky.Resources(cloud='local',
+                                        accelerators='tpu-v5e-16'))
+        with pytest.raises(exceptions.ResourcesMismatchError):
+            _launch(big, 'spine-mismatch')
+    finally:
+        core.down('spine-mismatch')
+
+
+def test_autostop_down_terminates_idle_cluster():
+    task = Task(name='quick', run='echo done')
+    task.set_resources(sky.Resources(cloud='local'))
+    job_id, _ = _launch(task, 'spine-auto',
+                        idle_minutes_to_autostop=0, down=True)
+    assert _wait_job('spine-auto', job_id) == 'SUCCEEDED'
+    deadline = time.time() + 30
+    gone = False
+    while time.time() < deadline:
+        records = core.status(['spine-auto'], refresh=True)
+        if not records:
+            gone = True
+            break
+        time.sleep(0.3)
+    assert gone, 'autostop --down did not terminate the idle cluster'
+
+
+def test_stop_and_restart_cycle():
+    task = Task(name='cyc', run='echo alive')
+    task.set_resources(sky.Resources(cloud='local'))
+    job_id, _ = _launch(task, 'spine-stop')
+    assert _wait_job('spine-stop', job_id) == 'SUCCEEDED'
+    core.stop('spine-stop')
+    records = core.status(['spine-stop'])
+    assert records[0]['status'] == global_state.ClusterStatus.STOPPED
+    # Relaunch restarts the stopped cluster and runs a new job.
+    task2 = Task(name='cyc2', run='echo alive-again')
+    task2.set_resources(sky.Resources(cloud='local'))
+    job2, handle = _launch(task2, 'spine-stop')
+    try:
+        assert _wait_job('spine-stop', job2) == 'SUCCEEDED'
+        from skypilot_tpu.backend import tpu_backend
+        logs = tpu_backend.TpuVmBackend().get_job_logs(handle, job2)
+        assert 'alive-again' in logs
+    finally:
+        core.down('spine-stop')
+
+
+def test_usage_intervals_and_cost_report():
+    task = Task(name='cost', run='echo ok')
+    task.set_resources(sky.Resources(cloud='local'))
+    job_id, _ = _launch(task, 'spine-cost')
+    assert _wait_job('spine-cost', job_id) == 'SUCCEEDED'
+    core.down('spine-cost')
+    report = core.cost_report()
+    names = [r['name'] for r in report]
+    assert 'spine-cost' in names
+    row = report[names.index('spine-cost')]
+    assert row['duration_hours'] > 0

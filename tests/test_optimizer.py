@@ -1,0 +1,195 @@
+"""Optimizer + failover tests (reference pattern:
+``tests/test_optimizer_dryruns.py``) — all offline against the checked-in
+catalog and the local provisioner's failure injector."""
+import time
+
+import pytest
+
+import skypilot_tpu as sky
+from skypilot_tpu import core, exceptions, execution, optimizer
+from skypilot_tpu.dag import Dag
+from skypilot_tpu.provision.local import instance as local_instance
+from skypilot_tpu.task import Task
+
+pytestmark = pytest.mark.usefixtures('tmp_state_dir', 'fast_agent')
+
+
+@pytest.fixture()
+def fast_agent(monkeypatch):
+    monkeypatch.setenv('SKYTPU_AGENT_TICK', '0.1')
+    monkeypatch.setenv('SKYTPU_AGENT_READY_TIMEOUT', '30')
+
+
+@pytest.fixture(autouse=True)
+def clear_injector():
+    yield
+    local_instance.set_failure_injector(None)
+
+
+def _single_task_dag(resources, name='t', **task_kwargs):
+    task = Task(name=name, run='echo hi', **task_kwargs)
+    if isinstance(resources, list):
+        task.set_resources(resources)
+    else:
+        task.set_resources(resources)
+    dag = Dag()
+    dag.add(task)
+    return dag, task
+
+
+def test_optimize_picks_cheapest_tpu_region():
+    dag, task = _single_task_dag(sky.Resources(accelerators='tpu-v5e-8'))
+    optimizer.optimize(dag)
+    best = task.best_resources
+    assert best.cloud == 'gcp'
+    assert best.instance_type is not None
+    assert best.region is not None
+
+
+def test_optimize_tpu_vs_gpu_cost_comparison():
+    """any_of candidates: the optimizer must pick the cheaper one."""
+    tpu = sky.Resources(accelerators='tpu-v5e-8')
+    gpu = sky.Resources(cloud='gcp', accelerators={'A100': 8})
+    dag, task = _single_task_dag([tpu, gpu])
+    optimizer.optimize(dag)
+    from skypilot_tpu import clouds as clouds_lib
+    gcp = clouds_lib.from_name('gcp')
+    chosen = task.best_resources
+    chosen_cost = gcp.instance_type_to_hourly_cost(chosen, False)
+    # Compare against both candidates' cheapest concrete prices.
+    costs = []
+    for cand in (tpu, gpu):
+        feas, _ = gcp.get_feasible_launchable_resources(cand)
+        costs.extend(gcp.instance_type_to_hourly_cost(f, False)
+                     for f in feas)
+    assert chosen_cost == pytest.approx(min(costs))
+
+
+def test_ordered_resources_respect_preference():
+    expensive = sky.Resources(accelerators='tpu-v5p-8')
+    cheap = sky.Resources(accelerators='tpu-v5e-8')
+    dag, task = _single_task_dag([expensive, cheap])
+    task._resources_ordered = True  # pylint: disable=protected-access
+    optimizer.optimize(dag)
+    assert task.best_resources.accelerators == {'tpu-v5p-8': 1}
+
+
+def test_spot_is_cheaper_than_ondemand():
+    dag_od, t_od = _single_task_dag(
+        sky.Resources(accelerators='tpu-v5e-8'))
+    dag_spot, t_spot = _single_task_dag(
+        sky.Resources(accelerators='tpu-v5e-8', use_spot=True))
+    optimizer.optimize(dag_od)
+    optimizer.optimize(dag_spot)
+    from skypilot_tpu import clouds as clouds_lib
+    gcp = clouds_lib.from_name('gcp')
+    od = gcp.instance_type_to_hourly_cost(t_od.best_resources, False)
+    spot = gcp.instance_type_to_hourly_cost(t_spot.best_resources, True)
+    assert spot < od
+
+
+def test_unknown_accelerator_raises():
+    with pytest.raises(exceptions.InvalidResourcesError):
+        sky.Resources(accelerators='tpu-v9-8')
+
+
+def test_no_feasible_resources_raises():
+    dag, _ = _single_task_dag(
+        sky.Resources(accelerators='tpu-v5e-8', zone='mars-central1-a'))
+    with pytest.raises(exceptions.ResourcesUnavailableError):
+        optimizer.optimize(dag)
+
+
+def test_blocked_resources_exclude_zone_and_region():
+    res = sky.Resources(accelerators='tpu-v5e-8')
+    dag, task = _single_task_dag(res)
+    optimizer.optimize(dag)
+    first_region = task.best_resources.region
+    blocked = [sky.Resources(cloud='gcp', region=first_region)]
+    dag2, task2 = _single_task_dag(res)
+    optimizer.optimize(dag2, blocked_resources=blocked)
+    assert task2.best_resources.region != first_region
+
+
+def test_chain_dp_assigns_all_tasks():
+    with Dag() as dag:
+        a = Task(name='a', run='echo a')
+        a.set_resources(sky.Resources(accelerators='tpu-v5e-8'))
+        b = Task(name='b', run='echo b')
+        b.set_resources(sky.Resources(cpus='4+'))
+        a >> b
+    optimizer.optimize(dag)
+    assert a.best_resources.instance_type is not None
+    assert b.best_resources.instance_type is not None
+
+
+def test_zone_failover_on_injected_stockout():
+    """Zone local-a stocked out -> the retry loop lands in local-b."""
+    failed_zones = []
+
+    def injector(cluster_name, region, zone, config):
+        del cluster_name, region, config
+        if zone == 'local-a':
+            failed_zones.append(zone)
+            raise exceptions.InsufficientCapacityError(
+                f'simulated stockout in {zone}')
+
+    local_instance.set_failure_injector(injector)
+    task = Task(name='fo', run='echo failover-ok')
+    task.set_resources(sky.Resources(cloud='local'))
+    job_id, handle = execution.launch(task, cluster_name='opt-failover')
+    try:
+        assert failed_zones == ['local-a']
+        assert handle.cluster_info.zone == 'local-b'
+        deadline = time.time() + 30
+        while time.time() < deadline:
+            if core.job_status('opt-failover', job_id) == 'SUCCEEDED':
+                break
+            time.sleep(0.15)
+        assert core.job_status('opt-failover', job_id) == 'SUCCEEDED'
+    finally:
+        core.down('opt-failover')
+
+
+def test_all_zones_stocked_out_raises_unavailable():
+    def injector(cluster_name, region, zone, config):
+        del cluster_name, region, config
+        raise exceptions.InsufficientCapacityError(
+            f'simulated stockout in {zone}')
+
+    local_instance.set_failure_injector(injector)
+    task = Task(name='fo2', run='echo hi')
+    task.set_resources(sky.Resources(cloud='local'))
+    with pytest.raises(exceptions.ResourcesUnavailableError):
+        execution.launch(task, cluster_name='opt-stockout')
+
+
+def test_queued_resource_timeout_is_failover_signal():
+    """QueuedResourceTimeout (TPU-specific) behaves like a stockout."""
+    calls = []
+
+    def injector(cluster_name, region, zone, config):
+        del cluster_name, region, config
+        calls.append(zone)
+        if len(calls) == 1:
+            raise exceptions.QueuedResourceTimeoutError(
+                'queued too long in ' + zone)
+
+    local_instance.set_failure_injector(injector)
+    task = Task(name='q', run='echo ok')
+    task.set_resources(sky.Resources(cloud='local'))
+    _, handle = execution.launch(task, cluster_name='opt-queued')
+    try:
+        assert len(calls) == 2
+        assert handle.cluster_info.zone == 'local-b'
+    finally:
+        core.down('opt-queued')
+
+
+def test_dryrun_provisions_nothing():
+    task = Task(name='dry', run='echo hi')
+    task.set_resources(sky.Resources(cloud='local'))
+    job_id, handle = execution.launch(task, cluster_name='opt-dry',
+                                      dryrun=True)
+    assert job_id is None and handle is None
+    assert core.status() == []
