@@ -75,7 +75,13 @@ def run_job(job_id: int) -> int:
                                 constants.RANK_LOG_FMT.format(rank=rank))
         cmd = run_cmd
         if workdir:
-            cmd = f'cd {shlex.quote(workdir)} && {cmd}'
+            # Quote the path but keep ~ expandable by the remote shell
+            # (shlex.quote('~/x') would suppress tilde expansion).
+            if workdir.startswith('~/'):
+                quoted = '"$HOME"/' + shlex.quote(workdir[2:])
+            else:
+                quoted = shlex.quote(workdir)
+            cmd = f'cd {quoted} && {cmd}'
         rc = runner.run(cmd, env=env, log_path=log_path)
         return rc if isinstance(rc, int) else rc[0]
 
