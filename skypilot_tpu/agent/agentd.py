@@ -65,12 +65,21 @@ class AutostopEvent(Event):
               f'{"terminating" if cfg.to_down else "stopping"} '
               f'{cluster_name}', flush=True)
         from skypilot_tpu import provision
-        if cfg.to_down:
-            provision.terminate_instances(provider, region, cluster_name)
-        else:
-            provision.stop_instances(provider, region, cluster_name)
-        # Disable further autostop checks; the cluster is going away.
+        # Disable autostop BEFORE acting: stop_instances kills this very
+        # process tree, and a stale autostop.json on the persisted node
+        # would re-stop the cluster right after a restart. Re-arm if the
+        # cloud call fails so a transient error doesn't permanently
+        # disable autostop on an idle (billing) cluster.
         autostop_lib.set_autostop(-1)
+        try:
+            if cfg.to_down:
+                provision.terminate_instances(provider, region,
+                                              cluster_name)
+            else:
+                provision.stop_instances(provider, region, cluster_name)
+        except Exception:
+            autostop_lib.set_autostop(cfg.idle_minutes, cfg.to_down)
+            raise
 
 
 def main() -> None:

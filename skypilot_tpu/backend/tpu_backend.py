@@ -119,29 +119,44 @@ class RetryingProvisioner:
                         f'{cluster_name}; retrying from scratch in 10s '
                         '(--retry-until-up).')
                     blocked = []
+                    rounds = 0
                     time.sleep(10)
                     continue
                 raise
-            if rounds > self.max_optimize_rounds:
+            if rounds > self.max_optimize_rounds and not retry_until_up:
                 raise exceptions.ResourcesUnavailableError(
                     f'Exceeded {self.max_optimize_rounds} optimize/failover '
                     f'rounds for {cluster_name}; giving up. Blocked: '
                     f'{blocked}')
             to_provision = task.best_resources
             try:
-                return self._retry_zones(task, to_provision, cluster_name)
+                return self._retry_zones(task, to_provision, cluster_name,
+                                         blocked)
             except FailoverError as e:
-                blocked = e.blocked
+                blocked.extend(e.blocked)
                 logger.info(
                     f'Failing over {cluster_name}: re-optimizing with '
                     f'{len(blocked)} blocked resource filter(s).')
 
     def _retry_zones(self, task: Task, to_provision: Resources,
-                     cluster_name: str) -> provision_common.ClusterInfo:
+                     cluster_name: str,
+                     already_blocked: List[Resources]
+                     ) -> provision_common.ClusterInfo:
         cloud = clouds_lib.from_name(to_provision.cloud or 'gcp')
         blocked: List[Resources] = []
-        zone_iter = list(cloud.zones_provision_loop(to_provision))
+        zone_iter = [
+            z for z in cloud.zones_provision_loop(to_provision)
+            if not optimizer_lib.resources_blocked(
+                Resources(cloud=cloud.NAME, region=z.region, zone=z.name),
+                already_blocked)
+        ]
         if not zone_iter:
+            # Every zone of this choice is already blocked (or none
+            # exist): escalate to region scope so re-optimization moves
+            # to a different region instead of re-picking this one.
+            if to_provision.region is not None:
+                raise FailoverError([Resources(cloud=cloud.NAME,
+                                               region=to_provision.region)])
             raise FailoverError([to_provision.copy(zone=None)])
         for zone in zone_iter:
             attempt = to_provision.copy(region=zone.region, zone=zone.name)
@@ -173,6 +188,22 @@ class RetryingProvisioner:
                 if getattr(e, 'no_failover', False):
                     raise exceptions.ResourcesUnavailableError(
                         str(e), no_failover=True) from e
+        # All remaining zones of this choice failed. Zone-scoped entries
+        # alone would never match the optimizer's region-level candidates,
+        # so also blocklist each region whose zones are now exhausted.
+        all_blocked = already_blocked + blocked
+        for region in {z.region for z in zone_iter}:
+            region_res = Resources(cloud=cloud.NAME, region=region)
+            if optimizer_lib.resources_blocked(region_res, all_blocked):
+                continue  # already covered by a region/cloud-scope entry
+            region_zones = [
+                z for z in cloud.zones_provision_loop(to_provision)
+                if z.region == region]
+            if all(optimizer_lib.resources_blocked(
+                    Resources(cloud=cloud.NAME, region=z.region,
+                              zone=z.name), all_blocked)
+                   for z in region_zones):
+                blocked.append(region_res)
         raise FailoverError(blocked)
 
 
@@ -367,12 +398,12 @@ class TpuVmBackend(backend_lib.Backend[TpuVmResourceHandle]):
                   follow: bool = True) -> None:
         import json as json_lib
         import shlex
-        import sys
         req = {'op': 'tail', 'job_id': job_id, 'follow': follow}
-        cmd = (f'{shlex.quote(sys.executable)} -m skypilot_tpu.agent.rpc '
+        runner = handle.head_runner()
+        cmd = (f'{shlex.quote(runner.remote_python)} '
+               f'-m skypilot_tpu.agent.rpc '
                f'{shlex.quote(json_lib.dumps(req))}')
-        handle.head_runner().run(cmd, stream_logs=True,
-                                 log_path=os.devnull)
+        runner.run(cmd, stream_logs=True, log_path=os.devnull)
 
     def get_job_logs(self, handle: TpuVmResourceHandle, job_id: int,
                      tail: int = 0) -> str:

@@ -89,14 +89,30 @@ def _execute(
                                  task.storage_mounts)
     if Stage.SETUP in stages and not no_setup:
         backend.setup(handle, task)
-    if idle_minutes_to_autostop is not None:
-        backend.set_autostop(handle, idle_minutes_to_autostop, down=down)
-    if Stage.EXEC in stages:
-        try:
-            job_id = backend.execute(handle, task, detach_run=detach_run)
-        finally:
-            backend.post_execute(handle, down)
-    if Stage.DOWN in stages and down and idle_minutes_to_autostop is None:
+    if down and idle_minutes_to_autostop is None:
+        # `down` means "tear down after the job queue drains", not "tear
+        # down now" — with a detached job an immediate teardown would
+        # kill the job it just submitted. Autostop-at-idle implements
+        # the intended semantics.
+        idle_minutes_to_autostop = 0
+    try:
+        if Stage.EXEC in stages:
+            try:
+                job_id = backend.execute(handle, task,
+                                         detach_run=detach_run)
+            finally:
+                backend.post_execute(handle, down)
+    finally:
+        # Armed AFTER the job is queued: with idle=0 an earlier arm could
+        # tear the cluster down before queue_job lands on the agent. The
+        # finally makes sure a failed submission still leaves the
+        # user-requested autostop armed rather than a forever-idle
+        # cluster.
+        if idle_minutes_to_autostop is not None:
+            backend.set_autostop(handle, idle_minutes_to_autostop,
+                                 down=down)
+    if Stage.DOWN in stages and down and Stage.EXEC not in stages:
+        # Explicit DOWN stage with nothing submitted: tear down now.
         backend.teardown(handle, terminate=True)
     return job_id, handle
 

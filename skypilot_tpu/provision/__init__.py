@@ -24,8 +24,20 @@ __all__ = [
 
 
 def _impl(provider_name: str):
-    return importlib.import_module(
-        f'skypilot_tpu.provision.{provider_name.lower()}.instance')
+    mod = f'skypilot_tpu.provision.{provider_name.lower()}.instance'
+    try:
+        return importlib.import_module(mod)
+    except ModuleNotFoundError as e:
+        # Only the provisioner module itself being absent means "no such
+        # provider"; a missing third-party dependency imported inside it
+        # is an environment error the user must see as-is.
+        if e.name is None or not mod.startswith(e.name):
+            raise
+        from skypilot_tpu import exceptions
+        err = exceptions.ProvisionError(
+            f'No provisioner implementation for {provider_name!r}: {e}')
+        err.blocklist_scope = 'cloud'
+        raise err from e
 
 
 def _route(fn: Callable) -> Callable:

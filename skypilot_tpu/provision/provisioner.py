@@ -12,7 +12,6 @@ from __future__ import annotations
 import json
 import os
 import shlex
-import sys
 import tempfile
 import time
 from typing import Dict, Optional
@@ -85,7 +84,7 @@ def post_provision_runtime_setup(
         'kill -0 $(cat ~/.skytpu_agent/agentd.pid) 2>/dev/null; then '
         '  echo "agentd already running"; '
         'else '
-        f'  setsid {shlex.quote(sys.executable)} -m '
+        f'  setsid {shlex.quote(head.remote_python)} -m '
         'skypilot_tpu.agent.agentd >> ~/.skytpu_agent/agentd.log 2>&1 '
         '< /dev/null & '
         'fi')
@@ -113,7 +112,8 @@ def _wait_agent_ready(head_runner) -> None:
 def agent_request(head_runner, request: Dict) -> Dict:
     """Send one RPC to the head agent via the command runner; return the
     parsed payload. Raises CommandError / ProvisionError on failure."""
-    cmd = (f'{shlex.quote(sys.executable)} -m skypilot_tpu.agent.rpc '
+    cmd = (f'{shlex.quote(head_runner.remote_python)} '
+           f'-m skypilot_tpu.agent.rpc '
            f'{shlex.quote(json.dumps(request))}')
     out = head_runner.check_run(cmd)
     for line in out.splitlines():

@@ -15,7 +15,9 @@ import os
 import shlex
 import shutil
 import subprocess
+import sys
 import tempfile
+import threading
 from typing import Dict, List, Optional, Tuple, Union
 
 from skypilot_tpu import exceptions
@@ -36,6 +38,11 @@ def _env_prefix(env: Optional[Dict[str, str]]) -> str:
 
 class CommandRunner:
     """Abstract runner for one node."""
+
+    # Interpreter to use for skypilot_tpu commands ON the node. Local
+    # nodes share this process's interpreter; remote hosts must not see
+    # the client's sys.executable (venv paths don't exist there).
+    remote_python: str = 'python3'
 
     def __init__(self, node_id: str):
         self.node_id = node_id
@@ -66,36 +73,60 @@ class CommandRunner:
     def _popen(args: List[str], *, shell: bool, env, cwd, log_path: str,
                stream_logs: bool, require_outputs: bool,
                timeout: Optional[float]) -> RunResult:
-        stdout_chunks: List[str] = []
-        stderr_chunks: List[str] = []
+        """Run, teeing output to the log file (and stdout when
+        ``stream_logs``) line-by-line as it is produced — tail/follow
+        consumers must see output while the command is still running."""
         os.makedirs(os.path.dirname(os.path.abspath(log_path)) or '.',
                     exist_ok=True)
+        chunks: Dict[str, List[str]] = {'out': [], 'err': []}
         with open(log_path, 'a', encoding='utf-8') as log_file:
             proc = subprocess.Popen(
                 args, shell=shell, env=env, cwd=cwd,
                 stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True)
+            write_lock = threading.Lock()
+
+            def pump(stream, key: str) -> None:
+                for line in iter(stream.readline, ''):
+                    with write_lock:
+                        chunks[key].append(line)
+                        try:
+                            log_file.write(line)
+                            log_file.flush()
+                        except ValueError:
+                            # A backgrounded grandchild can hold the pipe
+                            # open past join(timeout); the log file is
+                            # closed by then.
+                            pass
+                    if stream_logs:
+                        print(line, end='', flush=True)
+                stream.close()
+
+            pumps = [
+                threading.Thread(target=pump, args=(proc.stdout, 'out'),
+                                 daemon=True),
+                threading.Thread(target=pump, args=(proc.stderr, 'err'),
+                                 daemon=True),
+            ]
+            for t in pumps:
+                t.start()
+            timed_out = False
             try:
-                out, err = proc.communicate(timeout=timeout)
+                proc.wait(timeout=timeout)
             except subprocess.TimeoutExpired:
+                timed_out = True
                 proc.kill()
-                out, err = proc.communicate()
-                log_file.write(out or '')
-                log_file.write(err or '')
-                return (124, out or '', (err or '') + '\n[timeout]') \
-                    if require_outputs else 124
-            if out:
-                log_file.write(out)
-                stdout_chunks.append(out)
-                if stream_logs:
-                    print(out, end='')
-            if err:
-                log_file.write(err)
-                stderr_chunks.append(err)
-                if stream_logs:
-                    print(err, end='')
+                proc.wait()
+            for t in pumps:
+                t.join(timeout=5)
+            with write_lock:
+                out = ''.join(chunks['out'])
+                err = ''.join(chunks['err'])
+        if timed_out:
+            return (124, out, err + '\n[timeout]') if require_outputs \
+                else 124
         rc = proc.returncode
         if require_outputs:
-            return rc, ''.join(stdout_chunks), ''.join(stderr_chunks)
+            return rc, out, err
         return rc
 
 
@@ -103,6 +134,8 @@ class LocalProcessRunner(CommandRunner):
     """Runs commands as local subprocesses with HOME pointed at the node
     dir, so per-node files (``~/.skytpu_agent``, workdir, logs) are
     isolated exactly like distinct VMs."""
+
+    remote_python = sys.executable
 
     def __init__(self, node_id: str, node_dir: str):
         super().__init__(node_id)

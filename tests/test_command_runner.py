@@ -1,0 +1,66 @@
+"""Command-runner unit tests: incremental streaming, timeout, node env."""
+import os
+import sys
+import time
+
+from skypilot_tpu.utils import command_runner
+
+
+def test_local_runner_streams_logs_incrementally(tmp_path):
+    """Output must reach the log file while the command still runs
+    (tail/follow depends on it), not after communicate() returns."""
+    node = tmp_path / 'node'
+    log = tmp_path / 'run.log'
+    runner = command_runner.LocalProcessRunner('n0', str(node))
+
+    import threading
+    seen_early = {}
+
+    def watch():
+        deadline = time.time() + 5
+        while time.time() < deadline:
+            if log.exists() and 'first-line' in log.read_text():
+                seen_early['t'] = time.time()
+                return
+            time.sleep(0.02)
+
+    watcher = threading.Thread(target=watch)
+    watcher.start()
+    t0 = time.time()
+    rc = runner.run('echo first-line; sleep 1.2; echo second-line',
+                    log_path=str(log))
+    elapsed = time.time() - t0
+    watcher.join()
+    assert rc == 0
+    assert elapsed >= 1.0
+    assert 'first-line' in log.read_text()
+    assert 'second-line' in log.read_text()
+    # The first line was visible well before the command finished.
+    assert 't' in seen_early, 'first line never appeared while running'
+    assert seen_early['t'] - t0 < 1.0
+
+
+def test_local_runner_timeout_returns_124(tmp_path):
+    runner = command_runner.LocalProcessRunner('n0', str(tmp_path / 'n'))
+    rc, out, err = runner.run('echo before; sleep 30',
+                              require_outputs=True, timeout=0.5)
+    assert rc == 124
+    assert 'before' in out
+    assert '[timeout]' in err
+
+
+def test_local_runner_home_isolation(tmp_path):
+    runner = command_runner.LocalProcessRunner('n0', str(tmp_path / 'n'))
+    rc, out, _ = runner.run('echo $HOME', require_outputs=True)
+    assert rc == 0
+    assert out.strip() == str(tmp_path / 'n')
+
+
+def test_remote_python_contract(tmp_path):
+    """Local nodes reuse this interpreter; SSH hosts must not see the
+    client's venv path."""
+    local = command_runner.LocalProcessRunner('n0', str(tmp_path / 'n'))
+    assert local.remote_python == sys.executable
+    ssh = command_runner.SSHCommandRunner('1.2.3.4', 'user',
+                                          os.devnull)
+    assert ssh.remote_python == 'python3'
