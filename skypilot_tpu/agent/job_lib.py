@@ -157,28 +157,47 @@ def get_status(job_id: int) -> Optional[JobStatus]:
 
 
 # ------------------------------------------------------------- scheduler
+def _cluster_is_exclusive() -> bool:
+    """TPU slices are exclusively owned by one program at a time (the
+    chips are); CPU clusters (e.g. the jobs/serve controllers) multiplex
+    jobs — the TPU-first degeneration of the reference's resource-slot
+    scheduler (``sky/skylet/job_lib.py:194``)."""
+    try:
+        with open(constants.cluster_info_path(), encoding='utf-8') as f:
+            info = json.load(f)
+        return int(info.get('chips_per_host') or 0) > 0
+    except (FileNotFoundError, ValueError, json.JSONDecodeError):
+        return True
+
+
+def _max_parallel_jobs() -> int:
+    if _cluster_is_exclusive():
+        return 1
+    return int(os.environ.get('SKYTPU_AGENT_MAX_PARALLEL_JOBS', '16'))
+
+
 def schedule_step() -> None:
-    """FIFO: if nothing is starting/running, launch the oldest PENDING
-    job's driver as a detached process (reference
-    ``FIFOScheduler.schedule_step`` ``sky/skylet/job_lib.py:266``)."""
+    """Launch PENDING jobs' drivers as detached processes, oldest first,
+    up to the cluster's concurrency (1 on TPU slices — strict FIFO;
+    reference ``FIFOScheduler.schedule_step`` ``sky/skylet/job_lib.py:266``)."""
     with _scheduler_lock():
-        active = get_jobs([JobStatus.STARTING, JobStatus.RUNNING,
-                           JobStatus.INIT])
-        if active:
+        slots = _max_parallel_jobs() - len(
+            get_jobs([JobStatus.STARTING, JobStatus.RUNNING,
+                      JobStatus.INIT]))
+        if slots <= 0:
             return
         pending = get_jobs([JobStatus.PENDING])
-        if not pending:
-            return
-        job = pending[-1]          # ORDER BY job_id DESC -> last is oldest
-        job_id = job['job_id']
-        log_dir = constants.job_log_dir(job['run_timestamp'])
-        os.makedirs(log_dir, exist_ok=True)
-        pid = subprocess_utils.launch_daemon(
-            [sys.executable, '-m', 'skypilot_tpu.agent.driver',
-             str(job_id)],
-            log_path=os.path.join(log_dir, constants.DRIVER_LOG),
-            env=dict(os.environ))
-        set_status(job_id, JobStatus.STARTING, driver_pid=pid)
+        # ORDER BY job_id DESC -> iterate reversed for oldest-first.
+        for job in list(reversed(pending))[:slots]:
+            job_id = job['job_id']
+            log_dir = constants.job_log_dir(job['run_timestamp'])
+            os.makedirs(log_dir, exist_ok=True)
+            pid = subprocess_utils.launch_daemon(
+                [sys.executable, '-m', 'skypilot_tpu.agent.driver',
+                 str(job_id)],
+                log_path=os.path.join(log_dir, constants.DRIVER_LOG),
+                env=dict(os.environ))
+            set_status(job_id, JobStatus.STARTING, driver_pid=pid)
 
 
 def update_status() -> None:

@@ -1,0 +1,8 @@
+"""Managed jobs: preemption-recovering jobs under a controller cluster
+(reference ``sky/jobs/``)."""
+from skypilot_tpu.jobs.core import (cancel, job_status, launch, logs, queue,
+                                    tail_logs)
+from skypilot_tpu.jobs.state import ManagedJobStatus
+
+__all__ = ['launch', 'queue', 'job_status', 'cancel', 'logs', 'tail_logs',
+           'ManagedJobStatus']

@@ -1,0 +1,221 @@
+"""Managed-jobs controller: one process per managed job, running ON the
+jobs-controller cluster (as an ordinary agent job, so it gets logs/queue
+for free — SURVEY key idea #2).
+
+Role of reference ``sky/jobs/controller.py`` (``JobsController`` ``:50``,
+``_run_one_task`` ``:116``, ``run`` ``:369``): launch the task cluster via
+a recovery strategy, then poll the task's job status; distinguish *user
+failure* (job FAILED on a healthy cluster) from *preemption* (cluster gone
+or unreachable, or driver died) and recover the latter by relaunching —
+the checkpoint contract (a MOUNT-mode bucket, or any stable path the task
+resumes from) makes recovery resume-not-restart.
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import time
+import traceback
+from typing import Optional
+
+from skypilot_tpu import core
+from skypilot_tpu import exceptions
+from skypilot_tpu import global_state
+from skypilot_tpu import tpu_logging
+from skypilot_tpu.jobs import recovery_strategy
+from skypilot_tpu.jobs import scheduler
+from skypilot_tpu.jobs import state
+from skypilot_tpu.task import Task
+
+logger = tpu_logging.init_logger(__name__)
+
+# Task-job poll period (reference polls every ~30s; env-overridable so
+# tests run fast).
+JOB_STATUS_CHECK_GAP_SECONDS = float(
+    os.environ.get('SKYTPU_JOBS_POLL', '15'))
+
+_AGENT_TERMINAL_FAILED = ('FAILED',)
+_AGENT_FAILED_SETUP = ('FAILED_SETUP',)
+# FAILED_DRIVER means the head agent's driver died — host-level trouble,
+# treated as preemption (relaunch), not user failure.
+_AGENT_PREEMPTION_STATUSES = ('FAILED_DRIVER',)
+
+
+def _best_effort_down(cluster_name: str) -> None:
+    """Teardown after a terminal task status must not change the job's
+    outcome — a cloud 5xx here would otherwise turn SUCCEEDED into
+    FAILED_CONTROLLER."""
+    try:
+        core.down(cluster_name)
+    except Exception as e:  # pylint: disable=broad-except
+        logger.warning(f'Teardown of {cluster_name} failed (job outcome '
+                       f'unchanged): {type(e).__name__}: {e}')
+
+
+class JobsController:
+
+    def __init__(self, job_id: int):
+        self.job_id = job_id
+        record = state.get_job(job_id)
+        if record is None:
+            raise exceptions.JobNotFoundError(
+                f'managed job {job_id} not in state db')
+        self.record = record
+        dag_config = record['dag_config']
+        self.tasks = [Task.from_yaml_config(tc)
+                      for tc in dag_config['tasks']]
+        self.name = record['name']
+
+    # ------------------------------------------------------------ naming
+    def task_cluster_name(self, task_idx: int) -> str:
+        base = f'{self.name}-{self.job_id}'
+        if len(self.tasks) > 1:
+            base += f'-{task_idx}'
+        return base
+
+    # ------------------------------------------------------------ cancel
+    def _check_cancel(self) -> None:
+        if state.cancel_requested(self.job_id):
+            raise exceptions.ServeUserTerminatedError('cancel requested')
+
+    # ------------------------------------------------------------ monitor
+    def _job_status_or_preemption(self, cluster_name: str,
+                                  agent_job_id: int) -> Optional[str]:
+        """Returns the agent job status, or None on *preemption* (cluster
+        unreachable / gone / not UP). Reference discrimination logic:
+        ``sky/jobs/controller.py:209-330``."""
+        try:
+            return core.job_status(cluster_name, agent_job_id)
+        except Exception as e:  # pylint: disable=broad-except
+            logger.info(f'Status poll on {cluster_name} failed '
+                        f'({type(e).__name__}: {e}); checking cluster '
+                        'health.')
+        # The poll failed — consult cloud truth before declaring
+        # preemption (transient SSH hiccups must not trigger relaunch).
+        from skypilot_tpu.backend import backend_utils
+        try:
+            record, _ = backend_utils.refresh_cluster_status(cluster_name)
+        except Exception:  # pylint: disable=broad-except
+            return None
+        if record is None or record['status'] != \
+                global_state.ClusterStatus.UP:
+            return None
+        # Cluster looks UP; retry the poll once before giving up on it.
+        try:
+            return core.job_status(cluster_name, agent_job_id)
+        except Exception:  # pylint: disable=broad-except
+            return None
+
+    def _run_one_task(self, task_idx: int, task: Task) -> bool:
+        """Launch + monitor + recover one task. True = SUCCEEDED."""
+        cluster_name = self.task_cluster_name(task_idx)
+        strategy = recovery_strategy.StrategyExecutor.make(
+            cluster_name, task)
+
+        state.set_status(self.job_id, state.ManagedJobStatus.STARTING)
+        with scheduler.launch_slot(self.job_id):
+            agent_job_id = strategy.launch()
+        state.set_task_cluster(self.job_id, task_idx, cluster_name,
+                               agent_job_id)
+        state.set_status(self.job_id, state.ManagedJobStatus.RUNNING)
+
+        while True:
+            self._check_cancel()
+            status = self._job_status_or_preemption(cluster_name,
+                                                    agent_job_id)
+            if status == 'SUCCEEDED':
+                _best_effort_down(cluster_name)
+                return True
+            if status in _AGENT_TERMINAL_FAILED:
+                state.set_status(
+                    self.job_id, state.ManagedJobStatus.FAILED,
+                    failure_reason=self._failure_tail(cluster_name,
+                                                      agent_job_id))
+                _best_effort_down(cluster_name)
+                return False
+            if status in _AGENT_FAILED_SETUP:
+                state.set_status(
+                    self.job_id, state.ManagedJobStatus.FAILED_SETUP,
+                    failure_reason=self._failure_tail(cluster_name,
+                                                      agent_job_id))
+                _best_effort_down(cluster_name)
+                return False
+            if status == 'CANCELLED':
+                # Cancelled out-of-band on the task cluster: honor it.
+                state.set_status(self.job_id,
+                                 state.ManagedJobStatus.CANCELLED)
+                _best_effort_down(cluster_name)
+                return False
+            if status is None or status in _AGENT_PREEMPTION_STATUSES:
+                logger.info(
+                    f'Preemption/failure of {cluster_name} detected '
+                    f'(status={status}); recovering.')
+                state.set_recovering(self.job_id)
+                with scheduler.launch_slot(self.job_id):
+                    agent_job_id = strategy.recover()
+                state.set_task_cluster(self.job_id, task_idx,
+                                       cluster_name, agent_job_id)
+                state.set_recovered(self.job_id)
+                continue
+            # PENDING/STARTING/RUNNING: keep polling.
+            time.sleep(JOB_STATUS_CHECK_GAP_SECONDS)
+
+    def _failure_tail(self, cluster_name: str, agent_job_id: int) -> str:
+        try:
+            from skypilot_tpu.backend import tpu_backend
+            handle = global_state.get_handle_from_cluster_name(cluster_name)
+            if handle is None:
+                return ''
+            backend = tpu_backend.TpuVmBackend()
+            return backend.get_job_logs(handle, agent_job_id, tail=20)
+        except Exception:  # pylint: disable=broad-except
+            return ''
+
+    # ------------------------------------------------------------ run
+    def run(self) -> None:
+        """Run the task chain (reference ``JobsController.run`` ``:369``)."""
+        final: Optional[state.ManagedJobStatus] = None
+        reason: Optional[str] = None
+        try:
+            for task_idx, task in enumerate(self.tasks):
+                self._check_cancel()
+                if not self._run_one_task(task_idx, task):
+                    return          # terminal status already recorded
+            final = state.ManagedJobStatus.SUCCEEDED
+        except exceptions.ServeUserTerminatedError:
+            self._cleanup_current_cluster()
+            final = state.ManagedJobStatus.CANCELLED
+        except exceptions.ManagedJobReachedMaxRetriesError as e:
+            final = state.ManagedJobStatus.FAILED_NO_RESOURCE
+            reason = str(e)
+        except Exception:  # pylint: disable=broad-except
+            traceback.print_exc()
+            self._cleanup_current_cluster()
+            final = state.ManagedJobStatus.FAILED_CONTROLLER
+            reason = traceback.format_exc()
+        finally:
+            if final is not None:
+                state.set_status(self.job_id, final,
+                                 failure_reason=reason)
+
+    def _cleanup_current_cluster(self) -> None:
+        record = state.get_job(self.job_id)
+        if record and record['cluster_name']:
+            _best_effort_down(record['cluster_name'])
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser()
+    parser.add_argument('--job-id', type=int, required=True)
+    args = parser.parse_args()
+    state.set_status(args.job_id, state.ManagedJobStatus.SUBMITTED)
+    controller = JobsController(args.job_id)
+    controller.run()
+    # Controllers exit 0 even when the *job* failed: the controller itself
+    # did its work; the managed-job status carries the outcome.
+    sys.exit(0)
+
+
+if __name__ == '__main__':
+    main()

@@ -1,0 +1,157 @@
+"""Managed jobs client API: ``jobs.launch/queue/cancel/logs``.
+
+Role of reference ``sky/jobs/core.py`` (``launch`` ``:39``): wrap the user
+dag, ensure the jobs-controller cluster is up (an ordinary cluster — the
+whole stack recursively, SURVEY key idea #2), and queue a controller
+process there via the jobs RPC.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Union
+
+from skypilot_tpu import config as config_lib
+from skypilot_tpu import exceptions
+from skypilot_tpu import execution
+from skypilot_tpu import global_state
+from skypilot_tpu import tpu_logging
+from skypilot_tpu.dag import Dag
+from skypilot_tpu.resources import Resources
+from skypilot_tpu.task import Task
+from skypilot_tpu.utils import common_utils
+
+logger = tpu_logging.init_logger(__name__)
+
+CONTROLLER_CLUSTER_NAME = 'skytpu-jobs-controller'
+
+
+def _to_dag(task_or_dag: Union[Task, Dag]) -> Dag:
+    if isinstance(task_or_dag, Dag):
+        return task_or_dag
+    dag = Dag(name=task_or_dag.name)
+    dag.add(task_or_dag)
+    return dag
+
+
+def _controller_resources(dag: Dag) -> Resources:
+    """Controller sizing: config override, else a small CPU VM on the same
+    cloud as the first task (so local tasks get a local controller —
+    reference ``controller_utils.get_controller_resources``)."""
+    cfg = config_lib.get_nested(('jobs', 'controller', 'resources'), None)
+    if cfg:
+        return Resources.from_yaml_config(dict(cfg))
+    first = dag.topological_order()[0]
+    cloud = None
+    for res in first.resources:
+        if res.cloud:
+            cloud = res.cloud
+            break
+    return Resources(cloud=cloud or 'gcp', cpus='4+')
+
+
+def _ensure_controller(dag: Dag) -> Any:
+    """Launch (or reuse) the controller cluster; returns its handle."""
+    record = global_state.get_cluster_from_name(CONTROLLER_CLUSTER_NAME)
+    if record is not None and record['handle'] is not None:
+        from skypilot_tpu.backend import backend_utils
+        rec, handle = backend_utils.refresh_cluster_status(
+            CONTROLLER_CLUSTER_NAME)
+        if (rec is not None and handle is not None
+                and rec['status'] == global_state.ClusterStatus.UP):
+            return handle
+    controller_task = Task(name='jobs-controller')
+    controller_task.set_resources(_controller_resources(dag))
+    _, handle = execution.launch(controller_task,
+                                 cluster_name=CONTROLLER_CLUSTER_NAME,
+                                 detach_run=True, stream_logs=False)
+    return handle
+
+
+def _controller_request(handle, request: Dict[str, Any]) -> Dict[str, Any]:
+    from skypilot_tpu.provision import provisioner
+    return provisioner.agent_request(handle.head_runner(), request,
+                                     module='skypilot_tpu.jobs.rpc',
+                                     error_cls=exceptions.ApiError)
+
+
+def _get_controller_handle() -> Any:
+    record = global_state.get_cluster_from_name(CONTROLLER_CLUSTER_NAME)
+    if record is None or record['handle'] is None:
+        raise exceptions.ClusterNotUpError(
+            'No jobs controller is running (no managed jobs launched '
+            'yet, or it was torn down).')
+    return record['handle']
+
+
+# ------------------------------------------------------------------- API
+def launch(task_or_dag: Union[Task, Dag],
+           name: Optional[str] = None) -> int:
+    """Submit a managed job; returns the managed job id.
+
+    The job runs under a controller that recovers it from preemptions
+    (reference ``sky.jobs.launch`` ``sky/jobs/core.py:39``)."""
+    dag = _to_dag(task_or_dag)
+    if not dag.is_chain():
+        raise exceptions.InvalidDagError(
+            'Managed jobs support chain dags only (reference parity).')
+    tasks = dag.topological_order()
+    for t in tasks:
+        if t.run is not None and not isinstance(t.run, str):
+            raise exceptions.InvalidTaskError(
+                'Managed-job tasks must have string run commands.')
+    dag_config = {
+        'name': name or dag.name or tasks[0].name or 'managed',
+        'tasks': [t.to_yaml_config() for t in tasks],
+    }
+    handle = _ensure_controller(dag)
+    resp = _controller_request(handle, {
+        'op': 'queue',
+        'name': dag_config['name'],
+        'username': common_utils.get_cleaned_username(),
+        'run_timestamp': common_utils.make_run_timestamp(),
+        'dag_config': dag_config,
+    })
+    job_id = int(resp['job_id'])
+    logger.info(f'Managed job {job_id} ({dag_config["name"]}) submitted.')
+    return job_id
+
+
+def queue(refresh: bool = False) -> List[Dict[str, Any]]:
+    """Managed-job table (reference ``sky jobs queue``)."""
+    del refresh
+    handle = _get_controller_handle()
+    return _controller_request(handle, {'op': 'job_table'})['jobs']
+
+
+def job_status(job_id: int) -> Optional[str]:
+    handle = _get_controller_handle()
+    return _controller_request(
+        handle, {'op': 'job_status', 'job_id': job_id})['status']
+
+
+def cancel(job_id: int) -> bool:
+    """Request cancellation; the controller tears the task cluster down
+    (reference signal-based cancel ``sky/jobs/controller.py:446``)."""
+    handle = _get_controller_handle()
+    return _controller_request(
+        handle, {'op': 'cancel', 'job_id': job_id})['cancelled']
+
+
+def logs(job_id: int, tail: int = 0) -> str:
+    """Controller-process log for the job (launch/monitor/recovery
+    trace)."""
+    handle = _get_controller_handle()
+    return _controller_request(
+        handle, {'op': 'logs', 'job_id': job_id, 'tail': tail})['logs']
+
+
+def tail_logs(job_id: int, follow: bool = True) -> None:
+    """Stream the controller log for a managed job."""
+    from skypilot_tpu.backend import tpu_backend
+    handle = _get_controller_handle()
+    backend = tpu_backend.TpuVmBackend()
+    for j in backend.get_job_queue(handle):
+        if j['name'] == f'controller-{job_id}':
+            backend.tail_logs(handle, j['job_id'], follow=follow)
+            return
+    raise exceptions.JobNotFoundError(
+        f'No controller job found for managed job {job_id}.')

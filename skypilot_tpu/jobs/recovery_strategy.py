@@ -1,0 +1,185 @@
+"""Recovery strategies: how a managed job (re)launches its task cluster.
+
+Role of reference ``sky/jobs/recovery_strategy.py`` (``StrategyExecutor``
+``:46``, ``FailoverStrategyExecutor`` ``:388``,
+``EagerFailoverStrategyExecutor`` ``:471``). The launch path already
+failovers across zones/regions internally (the backend's blocklist +
+re-optimize loop), so the strategy layer decides only what to do *after a
+preemption*: retry in place first (FAILOVER) or immediately move on
+(EAGER_NEXT_REGION).
+"""
+from __future__ import annotations
+
+import time
+from typing import Dict, Optional, Type
+
+from skypilot_tpu import core
+from skypilot_tpu import exceptions
+from skypilot_tpu import execution
+from skypilot_tpu import global_state
+from skypilot_tpu import tpu_logging
+from skypilot_tpu.task import Task
+
+logger = tpu_logging.init_logger(__name__)
+
+RECOVERY_STRATEGIES: Dict[str, Type['StrategyExecutor']] = {}
+DEFAULT_RECOVERY_STRATEGY = 'FAILOVER'
+
+# Max consecutive launch attempts before the job is declared
+# FAILED_NO_RESOURCE (each attempt itself failovers across all candidate
+# zones/regions; reference ``_MAX_RETRY_CNT`` semantics).
+MAX_LAUNCH_RETRIES = 3
+LAUNCH_RETRY_GAP_SECONDS = 5.0
+
+
+def _register(name: str):
+    def deco(cls):
+        RECOVERY_STRATEGIES[name] = cls
+        cls.NAME = name
+        return cls
+    return deco
+
+
+class StrategyExecutor:
+    """Launch/recover the cluster for one task of a managed job."""
+
+    NAME = 'base'
+
+    def __init__(self, cluster_name: str, task: Task,
+                 retry_until_up: bool = False):
+        self.cluster_name = cluster_name
+        self.task = task
+        self.retry_until_up = retry_until_up
+
+    @classmethod
+    def make(cls, cluster_name: str, task: Task) -> 'StrategyExecutor':
+        name = None
+        for res in task.resources:
+            if res.spot_recovery is not None:
+                name = str(res.spot_recovery).upper()
+                break
+        name = name or DEFAULT_RECOVERY_STRATEGY
+        if name not in RECOVERY_STRATEGIES:
+            raise exceptions.InvalidTaskError(
+                f'Unknown recovery strategy {name!r}; available: '
+                f'{sorted(RECOVERY_STRATEGIES)}')
+        return RECOVERY_STRATEGIES[name](cluster_name, task)
+
+    # ------------------------------------------------------------ launch
+    def launch(self) -> int:
+        """First launch. Returns the agent job id on the task cluster."""
+        job_id = self._launch_with_retries()
+        if job_id is None:
+            raise exceptions.ManagedJobReachedMaxRetriesError(
+                f'Failed to launch {self.cluster_name} after '
+                f'{MAX_LAUNCH_RETRIES} attempts (each attempt tried every '
+                'candidate zone/region).')
+        return job_id
+
+    def recover(self) -> int:
+        """Relaunch after a preemption; returns the new agent job id."""
+        raise NotImplementedError
+
+    # ------------------------------------------------------------ helpers
+    def _launch_once(self) -> Optional[int]:
+        try:
+            job_id, _ = execution.launch(
+                self.task, cluster_name=self.cluster_name,
+                detach_run=True, stream_logs=False,
+                retry_until_up=False)
+            return job_id
+        except (exceptions.ResourcesUnavailableError,
+                exceptions.ProvisionError) as e:
+            logger.warning(f'Launch attempt for {self.cluster_name} '
+                           f'failed: {e}')
+            return None
+
+    def _launch_with_retries(self,
+                             max_retries: int = MAX_LAUNCH_RETRIES
+                             ) -> Optional[int]:
+        gap = LAUNCH_RETRY_GAP_SECONDS
+        attempts = 0
+        while True:
+            attempts += 1
+            job_id = self._launch_once()
+            if job_id is not None:
+                return job_id
+            if not self.retry_until_up and attempts >= max_retries:
+                return None
+            logger.info(f'Retrying launch of {self.cluster_name} in '
+                        f'{gap:.0f}s (attempt {attempts}).')
+            time.sleep(gap)
+            gap = min(gap * 2, 300)
+
+    def _terminate_cluster(self) -> None:
+        """Best-effort teardown of the (possibly half-dead) task cluster."""
+        try:
+            record = global_state.get_cluster_from_name(self.cluster_name)
+            if record is not None:
+                core.down(self.cluster_name)
+        except Exception as e:  # pylint: disable=broad-except
+            logger.debug(f'teardown of {self.cluster_name} during recovery '
+                         f'failed (continuing): {e}')
+
+    def _resubmit_on_existing(self) -> Optional[int]:
+        """If the cluster still exists and is UP (e.g. only the job died,
+        or a same-cluster restart succeeded), re-exec the task on it."""
+        from skypilot_tpu.backend import backend_utils
+        try:
+            record, handle = backend_utils.refresh_cluster_status(
+                self.cluster_name)
+        except Exception:  # pylint: disable=broad-except
+            return None
+        if record is None or handle is None:
+            return None
+        if record['status'] != global_state.ClusterStatus.UP:
+            return None
+        try:
+            # Cancel any still-running copy first: a false-positive
+            # preemption (transient poll failure) must not end up with two
+            # concurrent copies of the task contending for the chips.
+            from skypilot_tpu.backend import tpu_backend
+            tpu_backend.TpuVmBackend().cancel_jobs(handle, None)
+            job_id, _ = execution.exec_cmd(self.task, self.cluster_name,
+                                           detach_run=True)
+            return job_id
+        except Exception as e:  # pylint: disable=broad-except
+            logger.debug(f'resubmit on existing {self.cluster_name} '
+                         f'failed: {e}')
+            return None
+
+
+@_register('FAILOVER')
+class FailoverStrategyExecutor(StrategyExecutor):
+    """Try the same cluster first (the preemption may be transient /
+    partial), then terminate and relaunch — the relaunch itself walks the
+    zone→region→cloud failover (reference ``FailoverStrategyExecutor``
+    ``sky/jobs/recovery_strategy.py:388``)."""
+
+    def recover(self) -> int:
+        job_id = self._resubmit_on_existing()
+        if job_id is not None:
+            return job_id
+        self._terminate_cluster()
+        job_id = self._launch_with_retries()
+        if job_id is None:
+            raise exceptions.ManagedJobReachedMaxRetriesError(
+                f'Recovery of {self.cluster_name} exhausted all candidate '
+                'resources.')
+        return job_id
+
+
+@_register('EAGER_NEXT_REGION')
+class EagerFailoverStrategyExecutor(StrategyExecutor):
+    """Skip the same-cluster retry: terminate immediately and relaunch
+    elsewhere. Best when same-zone re-preemption is likely (reference
+    ``EagerFailoverStrategyExecutor`` ``:471``)."""
+
+    def recover(self) -> int:
+        self._terminate_cluster()
+        job_id = self._launch_with_retries()
+        if job_id is None:
+            raise exceptions.ManagedJobReachedMaxRetriesError(
+                f'Recovery of {self.cluster_name} exhausted all candidate '
+                'resources.')
+        return job_id

@@ -1,0 +1,38 @@
+"""Controller-side launch-slot scheduler.
+
+Role of reference ``sky/jobs/scheduler.py`` (``:71``, slot caps
+``:249-268``): provisioning a cluster is the expensive, bursty phase of a
+managed job — cap how many controller processes may be launching at once
+so a wave of submissions doesn't fork-bomb the controller host. Monitoring
+(ALIVE) is cheap and uncapped.
+"""
+from __future__ import annotations
+
+import contextlib
+import os
+import time
+
+from skypilot_tpu.jobs import state
+
+
+def max_parallel_launches() -> int:
+    return int(os.environ.get('SKYTPU_JOBS_MAX_PARALLEL_LAUNCHES', '8'))
+
+
+@contextlib.contextmanager
+def launch_slot(job_id: int, poll_seconds: float = 0.5):
+    """Block until a launch slot is free, hold it for the with-body.
+
+    Slot accounting lives in the state DB (schedule_state LAUNCHING),
+    guarded by the DB file lock so concurrent controllers serialize."""
+    while True:
+        with state.db_lock():
+            if state.count_in_launch_phase() < max_parallel_launches():
+                state.set_schedule_state(job_id,
+                                         state.ScheduleState.LAUNCHING)
+                break
+        time.sleep(poll_seconds)
+    try:
+        yield
+    finally:
+        state.set_schedule_state(job_id, state.ScheduleState.ALIVE)

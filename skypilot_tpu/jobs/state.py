@@ -1,0 +1,234 @@
+"""Managed-job state machine + SQLite table (lives on the controller).
+
+Role of reference ``sky/jobs/state.py`` (``ManagedJobStatus`` ``:186``,
+``ManagedJobScheduleState`` ``:312``): one row per managed job, written by
+the controller process and read by the client via the jobs RPC. TPU-first
+simplification: one DB file under the controller host's HOME; pipeline
+(chain-dag) jobs advance ``task_idx`` through the same row.
+"""
+from __future__ import annotations
+
+import enum
+import json
+import os
+import sqlite3
+import time
+from typing import Any, Dict, List, Optional
+
+import filelock
+
+
+class ManagedJobStatus(enum.Enum):
+    """Managed-job lifecycle (reference ``sky/jobs/state.py:186``).
+
+    Terminal: SUCCEEDED / FAILED / FAILED_SETUP / FAILED_NO_RESOURCE /
+    FAILED_CONTROLLER / CANCELLED.
+    """
+    PENDING = 'PENDING'            # queued, controller not started yet
+    SUBMITTED = 'SUBMITTED'        # controller process scheduled
+    STARTING = 'STARTING'          # provisioning the task cluster
+    RUNNING = 'RUNNING'            # task job running
+    RECOVERING = 'RECOVERING'      # preemption detected; relaunching
+    SUCCEEDED = 'SUCCEEDED'
+    FAILED = 'FAILED'              # user code failed
+    FAILED_SETUP = 'FAILED_SETUP'
+    FAILED_NO_RESOURCE = 'FAILED_NO_RESOURCE'   # exhausted all candidates
+    FAILED_CONTROLLER = 'FAILED_CONTROLLER'     # controller crashed
+    CANCELLING = 'CANCELLING'
+    CANCELLED = 'CANCELLED'
+
+    def is_terminal(self) -> bool:
+        return self in _TERMINAL
+
+
+_TERMINAL = {ManagedJobStatus.SUCCEEDED, ManagedJobStatus.FAILED,
+             ManagedJobStatus.FAILED_SETUP,
+             ManagedJobStatus.FAILED_NO_RESOURCE,
+             ManagedJobStatus.FAILED_CONTROLLER,
+             ManagedJobStatus.CANCELLED}
+
+
+class ScheduleState(enum.Enum):
+    """Controller-process scheduling state (reference
+    ``ManagedJobScheduleState`` ``sky/jobs/state.py:312``): caps how many
+    controller processes may be inside their launch phase at once."""
+    WAITING = 'WAITING'            # queued for a launch slot
+    LAUNCHING = 'LAUNCHING'        # holds a launch slot
+    ALIVE = 'ALIVE'                # running/monitoring (slot released)
+    DONE = 'DONE'
+
+
+def jobs_dir() -> str:
+    d = os.environ.get('SKYTPU_MANAGED_JOBS_DIR',
+                       os.path.expanduser('~/.skytpu_managed_jobs'))
+    os.makedirs(d, exist_ok=True)
+    return d
+
+
+def _db_path() -> str:
+    return os.path.join(jobs_dir(), 'state.db')
+
+
+def db_lock() -> filelock.FileLock:
+    return filelock.FileLock(os.path.join(jobs_dir(), '.state.lock'))
+
+
+def _conn() -> sqlite3.Connection:
+    conn = sqlite3.connect(_db_path(), timeout=10)
+    conn.execute("""\
+        CREATE TABLE IF NOT EXISTS managed_jobs (
+            job_id INTEGER PRIMARY KEY AUTOINCREMENT,
+            name TEXT,
+            dag_config TEXT,
+            status TEXT,
+            schedule_state TEXT,
+            task_idx INTEGER DEFAULT 0,
+            num_tasks INTEGER DEFAULT 1,
+            cluster_name TEXT,
+            agent_job_id INTEGER,
+            run_timestamp TEXT,
+            submitted_at REAL,
+            start_at REAL,
+            end_at REAL,
+            last_recovered_at REAL,
+            recovery_count INTEGER DEFAULT 0,
+            failure_reason TEXT,
+            cancel_requested INTEGER DEFAULT 0)""")
+    conn.commit()
+    return conn
+
+
+_FIELDS = ('job_id', 'name', 'dag_config', 'status', 'schedule_state',
+           'task_idx', 'num_tasks', 'cluster_name', 'agent_job_id',
+           'run_timestamp', 'submitted_at', 'start_at', 'end_at',
+           'last_recovered_at', 'recovery_count', 'failure_reason',
+           'cancel_requested')
+
+
+def _row_to_record(row) -> Dict[str, Any]:
+    rec = dict(zip(_FIELDS, row))
+    rec['status'] = ManagedJobStatus(rec['status'])
+    rec['schedule_state'] = ScheduleState(rec['schedule_state'])
+    rec['dag_config'] = (json.loads(rec['dag_config'])
+                         if rec['dag_config'] else None)
+    rec['cancel_requested'] = bool(rec['cancel_requested'])
+    return rec
+
+
+def add_job(name: str, dag_config: Dict[str, Any], num_tasks: int,
+            run_timestamp: str) -> int:
+    conn = _conn()
+    with conn:
+        cur = conn.execute(
+            'INSERT INTO managed_jobs (name, dag_config, status, '
+            'schedule_state, num_tasks, run_timestamp, submitted_at) '
+            'VALUES (?,?,?,?,?,?,?)',
+            (name, json.dumps(dag_config), ManagedJobStatus.PENDING.value,
+             ScheduleState.WAITING.value, num_tasks, run_timestamp,
+             time.time()))
+        job_id = cur.lastrowid
+    conn.close()
+    return int(job_id)
+
+
+def get_job(job_id: int) -> Optional[Dict[str, Any]]:
+    conn = _conn()
+    row = conn.execute(
+        f'SELECT {", ".join(_FIELDS)} FROM managed_jobs WHERE job_id=?',
+        (job_id,)).fetchone()
+    conn.close()
+    return _row_to_record(row) if row else None
+
+
+def get_jobs(statuses: Optional[List[ManagedJobStatus]] = None
+             ) -> List[Dict[str, Any]]:
+    conn = _conn()
+    q = f'SELECT {", ".join(_FIELDS)} FROM managed_jobs'
+    args: tuple = ()
+    if statuses:
+        q += ' WHERE status IN (' + ','.join('?' * len(statuses)) + ')'
+        args = tuple(s.value for s in statuses)
+    q += ' ORDER BY job_id DESC'
+    rows = conn.execute(q, args).fetchall()
+    conn.close()
+    return [_row_to_record(r) for r in rows]
+
+
+def _update(job_id: int, **cols: Any) -> None:
+    conn = _conn()
+    with conn:
+        sets = ', '.join(f'{k}=?' for k in cols)
+        conn.execute(f'UPDATE managed_jobs SET {sets} WHERE job_id=?',
+                     (*cols.values(), job_id))
+    conn.close()
+
+
+def set_status(job_id: int, status: ManagedJobStatus,
+               failure_reason: Optional[str] = None) -> None:
+    cols: Dict[str, Any] = {'status': status.value}
+    if status == ManagedJobStatus.RUNNING:
+        record = get_job(job_id)
+        if record and record['start_at'] is None:
+            cols['start_at'] = time.time()
+    if status.is_terminal():
+        cols['end_at'] = time.time()
+        cols['schedule_state'] = ScheduleState.DONE.value
+    if failure_reason is not None:
+        cols['failure_reason'] = failure_reason[-2000:]
+    _update(job_id, **cols)
+
+
+def get_status(job_id: int) -> Optional[ManagedJobStatus]:
+    record = get_job(job_id)
+    return record['status'] if record else None
+
+
+def set_schedule_state(job_id: int, state: ScheduleState) -> None:
+    _update(job_id, schedule_state=state.value)
+
+
+def count_in_launch_phase() -> int:
+    """Jobs currently holding a launch slot (LAUNCHING)."""
+    conn = _conn()
+    n = conn.execute(
+        'SELECT COUNT(*) FROM managed_jobs WHERE schedule_state=?',
+        (ScheduleState.LAUNCHING.value,)).fetchone()[0]
+    conn.close()
+    return int(n)
+
+
+def set_task_cluster(job_id: int, task_idx: int, cluster_name: str,
+                     agent_job_id: Optional[int]) -> None:
+    _update(job_id, task_idx=task_idx, cluster_name=cluster_name,
+            agent_job_id=agent_job_id)
+
+
+def set_recovering(job_id: int) -> None:
+    record = get_job(job_id)
+    _update(job_id, status=ManagedJobStatus.RECOVERING.value,
+            recovery_count=(record['recovery_count'] + 1 if record else 1))
+
+
+def set_recovered(job_id: int) -> None:
+    _update(job_id, status=ManagedJobStatus.RUNNING.value,
+            last_recovered_at=time.time())
+
+
+def request_cancel(job_id: int) -> bool:
+    record = get_job(job_id)
+    if record is None or record['status'].is_terminal():
+        return False
+    _update(job_id, cancel_requested=1)
+    return True
+
+
+def cancel_requested(job_id: int) -> bool:
+    record = get_job(job_id)
+    return bool(record and record['cancel_requested'])
+
+
+def record_to_json(record: Dict[str, Any]) -> Dict[str, Any]:
+    out = dict(record)
+    out['status'] = record['status'].value
+    out['schedule_state'] = record['schedule_state'].value
+    return out

@@ -109,23 +109,27 @@ def _wait_agent_ready(head_runner) -> None:
         f'{last_err}')
 
 
-def agent_request(head_runner, request: Dict) -> Dict:
-    """Send one RPC to the head agent via the command runner; return the
-    parsed payload. Raises CommandError / ProvisionError on failure."""
+def agent_request(head_runner, request: Dict,
+                  module: str = 'skypilot_tpu.agent.rpc',
+                  error_cls: type = exceptions.ProvisionError) -> Dict:
+    """Send one JSON RPC to a head-side module via the command runner;
+    return the parsed payload. The same wire protocol serves the agent RPC
+    and the jobs/serve controller RPCs — pass ``module``/``error_cls``.
+    Raises CommandError / ``error_cls`` on failure."""
     cmd = (f'{shlex.quote(head_runner.remote_python)} '
-           f'-m skypilot_tpu.agent.rpc '
+           f'-m {module} '
            f'{shlex.quote(json.dumps(request))}')
     out = head_runner.check_run(cmd)
     for line in out.splitlines():
         if line.startswith(agent_rpc.PAYLOAD_PREFIX):
             payload = json.loads(line[len(agent_rpc.PAYLOAD_PREFIX):])
             if not payload.get('ok'):
-                raise exceptions.ProvisionError(
-                    f'Agent RPC {request.get("op")} failed: '
+                raise error_cls(
+                    f'RPC {module}:{request.get("op")} failed: '
                     f'{payload.get("error")}')
             return payload
-    raise exceptions.ProvisionError(
-        f'Agent RPC {request.get("op")}: no payload in output:\n'
+    raise error_cls(
+        f'RPC {module}:{request.get("op")}: no payload in output:\n'
         f'{out[-1000:]}')
 
 

@@ -67,7 +67,12 @@ def generate_cluster_name(prefix: str = 'sky') -> str:
 
 
 def make_run_timestamp() -> str:
-    return 'sky-' + time.strftime('%Y-%m-%d-%H-%M-%S-%f', time.localtime())
+    # time.strftime has no %f; append microseconds by hand so two
+    # submissions in the same second get distinct log dirs.
+    now = time.time()
+    micros = int((now % 1) * 1e6)
+    return ('sky-' + time.strftime('%Y-%m-%d-%H-%M-%S',
+                                   time.localtime(now)) + f'-{micros:06d}')
 
 
 def read_last_n_lines(path: str, n: int) -> str:

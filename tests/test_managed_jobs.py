@@ -1,0 +1,170 @@
+"""Managed jobs end-to-end on the local provisioner: controller-on-a-
+cluster, preemption recovery with the checkpoint contract, cancel.
+
+This is the hermetic version of the reference's managed-job smoke tests
+(``tests/smoke_tests/test_managed_job.py``), which terminate real VMs
+out-of-band to force recovery — here we terminate the local task cluster
+out-of-band the same way.
+"""
+import os
+import time
+
+import pytest
+
+import skypilot_tpu as sky
+from skypilot_tpu import global_state
+from skypilot_tpu import jobs
+from skypilot_tpu.provision.local import instance as local_instance
+from skypilot_tpu.task import Task
+
+pytestmark = pytest.mark.usefixtures('tmp_state_dir', 'fast_jobs')
+
+TERMINAL = ('SUCCEEDED', 'FAILED', 'FAILED_SETUP', 'FAILED_NO_RESOURCE',
+            'FAILED_CONTROLLER', 'CANCELLED')
+
+
+@pytest.fixture()
+def fast_jobs(monkeypatch):
+    monkeypatch.setenv('SKYTPU_AGENT_TICK', '0.1')
+    monkeypatch.setenv('SKYTPU_AGENT_READY_TIMEOUT', '30')
+    monkeypatch.setenv('SKYTPU_JOBS_POLL', '0.2')
+
+
+def _wait_managed(job_id: int, timeout: float = 90.0) -> str:
+    deadline = time.time() + timeout
+    status = None
+    while time.time() < deadline:
+        status = jobs.job_status(job_id)
+        if status in TERMINAL:
+            return status
+        time.sleep(0.2)
+    return status or 'TIMEOUT'
+
+
+def _down_controller():
+    from skypilot_tpu import core
+    try:
+        core.down(jobs.core.CONTROLLER_CLUSTER_NAME)
+    except Exception:
+        pass
+
+
+def _local_task(name: str, run: str, envs=None) -> Task:
+    task = Task(name=name, run=run, envs=envs or {})
+    task.set_resources(sky.Resources(cloud='local', cpus='1+'))
+    return task
+
+
+def test_managed_job_end_to_end(tmp_path):
+    out = tmp_path / 'out.txt'
+    task = _local_task('mj', f'echo done-$((6*7)) > {out}')
+    try:
+        job_id = jobs.launch(task, name='mj')
+        assert job_id == 1
+        assert _wait_managed(job_id) == 'SUCCEEDED'
+        assert out.read_text().strip() == 'done-42'
+        table = jobs.queue()
+        rec = [r for r in table if r['job_id'] == job_id][0]
+        assert rec['status'] == 'SUCCEEDED'
+        assert rec['recovery_count'] == 0
+        # The task cluster was cleaned up by the controller.
+        assert global_state.get_cluster_from_name('mj-1') is None
+        # Controller log shows the lifecycle.
+        log_text = jobs.logs(job_id)
+        assert 'mj-1' in log_text
+    finally:
+        _down_controller()
+
+
+def test_managed_job_recovery_resumes_from_checkpoint(tmp_path):
+    """Kill the task cluster mid-run; the controller must detect the
+    preemption, relaunch, and the task must RESUME (not restart) from its
+    checkpoint — steps 1..8 each appear exactly once."""
+    ckpt = tmp_path / 'bucket'
+    ckpt.mkdir()
+    progress = ckpt / 'progress'
+    # Resumable "training": continues from the last checkpointed step.
+    run = (
+        'i=1; '
+        'if [ -f "$CKPT_DIR/progress" ]; then '
+        '  i=$(( $(tail -1 "$CKPT_DIR/progress") + 1 )); fi; '
+        'while [ $i -le 8 ]; do '
+        '  echo $i >> "$CKPT_DIR/progress"; i=$((i+1)); sleep 0.4; '
+        'done')
+    task = _local_task('train', run, envs={'CKPT_DIR': str(ckpt)})
+    try:
+        job_id = jobs.launch(task, name='train')
+        cluster_name = f'train-{job_id}'
+
+        # Wait for some progress, then preempt out-of-band.
+        deadline = time.time() + 60
+        while time.time() < deadline:
+            if progress.exists() and \
+                    len(progress.read_text().split()) >= 2:
+                break
+            time.sleep(0.1)
+        assert progress.exists(), 'task never started writing steps'
+        local_instance.terminate_instances('local', cluster_name)
+
+        assert _wait_managed(job_id, timeout=120) == 'SUCCEEDED'
+        steps = [int(s) for s in progress.read_text().split()]
+        assert steps == list(range(1, 9)), (
+            f'steps re-ran or were skipped after recovery: {steps}')
+        rec = [r for r in jobs.queue() if r['job_id'] == job_id][0]
+        assert rec['recovery_count'] >= 1
+    finally:
+        _down_controller()
+
+
+def test_managed_job_cancel(tmp_path):
+    task = _local_task('cj', 'sleep 120')
+    try:
+        job_id = jobs.launch(task, name='cj')
+        deadline = time.time() + 60
+        while time.time() < deadline:
+            if jobs.job_status(job_id) == 'RUNNING':
+                break
+            time.sleep(0.2)
+        assert jobs.job_status(job_id) == 'RUNNING'
+        assert jobs.cancel(job_id)
+        assert _wait_managed(job_id) == 'CANCELLED'
+        # Task cluster torn down by the controller.
+        deadline = time.time() + 30
+        while time.time() < deadline:
+            if global_state.get_cluster_from_name(f'cj-{job_id}') is None:
+                break
+            time.sleep(0.2)
+        assert global_state.get_cluster_from_name(f'cj-{job_id}') is None
+    finally:
+        _down_controller()
+
+
+def test_managed_job_pipeline_chain(tmp_path):
+    """Two-task chain: task B starts only after task A succeeds."""
+    out = tmp_path / 'chain.txt'
+    a = _local_task('a', f'echo A >> {out}')
+    b = _local_task('b', f'echo B >> {out}')
+    with sky.Dag(name='pipe') as dag:
+        dag.add(a)
+        dag.add(b)
+        dag.add_edge(a, b)
+    try:
+        job_id = jobs.launch(dag, name='pipe')
+        assert _wait_managed(job_id, timeout=120) == 'SUCCEEDED'
+        assert out.read_text().split() == ['A', 'B']
+    finally:
+        _down_controller()
+
+
+def test_managed_job_user_failure_is_not_recovered(tmp_path):
+    """User-code failure (non-zero exit on a healthy cluster) must fail
+    the job, not trigger recovery (reference discrimination:
+    FAILED vs cluster-gone)."""
+    task = _local_task('bad', 'exit 3')
+    try:
+        job_id = jobs.launch(task, name='bad')
+        assert _wait_managed(job_id) == 'FAILED'
+        rec = [r for r in jobs.queue() if r['job_id'] == job_id][0]
+        assert rec['recovery_count'] == 0
+    finally:
+        _down_controller()
