@@ -90,8 +90,22 @@ def main() -> None:
           flush=True)
     events = [JobSchedulerEvent(), AutostopEvent()]
     tick = constants.agent_tick_seconds()
+    info_missing_ticks = 0
     while True:
         now = time.time()
+        # cluster_info.json is rsynced before agentd starts; if it stays
+        # gone the cluster was torn down under us (teardown can miss an
+        # agentd whose pidfile it never saw) — exit instead of ticking
+        # forever against a deleted directory, which agent_dir()'s
+        # makedirs would otherwise silently recreate.
+        if os.path.exists(constants.cluster_info_path()):
+            info_missing_ticks = 0
+        else:
+            info_missing_ticks += 1
+            if info_missing_ticks >= 3:
+                print('[agentd] cluster_info.json gone; cluster torn down '
+                      '— exiting.', flush=True)
+                return
         for event in events:
             event.maybe_run(now)
         with open(constants.agentd_heartbeat_path(), 'w',

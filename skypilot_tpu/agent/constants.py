@@ -25,6 +25,36 @@ ENV_NUM_SLICES = 'SKYTPU_NUM_SLICES'
 
 JAX_COORDINATOR_PORT = 8476
 
+# ---- control-plane vs data-plane environment ----
+# Accelerator-runtime env vars that control-plane processes (agentd, RPC
+# subprocesses, job drivers) must NOT see: site hooks key off them to
+# import jax and initialize the TPU PJRT runtime, which costs seconds of
+# startup per process and can claim the chip. Control-plane commands run
+# with these cleared and stashed under SKYTPU_SAVED_<var>; the job driver
+# restores them into the *user job's* env (the job is the data plane — it
+# does need the chip).
+ENV_SAVED_PREFIX = 'SKYTPU_SAVED_'
+ACCELERATOR_RUNTIME_ENV_VARS = ('PALLAS_AXON_POOL_IPS',)
+
+
+def control_plane_env_prefix() -> str:
+    """Shell prefix clearing accelerator-runtime env for one command,
+    stashing original values for the driver to restore into user jobs."""
+    parts = []
+    for var in ACCELERATOR_RUNTIME_ENV_VARS:
+        parts.append(f'{ENV_SAVED_PREFIX}{var}="${{{var}-}}"')
+        parts.append(f'{var}=')
+    return ' '.join(parts) + ' '
+
+
+def restore_accelerator_env(env: dict) -> None:
+    """Give a user job back the accelerator-runtime vars the control
+    plane stashed (no-op if nothing was stashed or the var is live)."""
+    for var in ACCELERATOR_RUNTIME_ENV_VARS:
+        saved = os.environ.get(ENV_SAVED_PREFIX + var)
+        if saved and not os.environ.get(var) and var not in env:
+            env[var] = saved
+
 # ---- agent filesystem layout (under $SKYTPU_AGENT_DIR) ----
 
 

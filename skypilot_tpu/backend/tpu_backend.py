@@ -400,7 +400,9 @@ class TpuVmBackend(backend_lib.Backend[TpuVmResourceHandle]):
         import shlex
         req = {'op': 'tail', 'job_id': job_id, 'follow': follow}
         runner = handle.head_runner()
-        cmd = (f'{shlex.quote(runner.remote_python)} '
+        from skypilot_tpu.agent import constants as agent_constants
+        cmd = (f'{agent_constants.control_plane_env_prefix()}'
+               f'{shlex.quote(runner.remote_python)} '
                f'-m skypilot_tpu.agent.rpc '
                f'{shlex.quote(json_lib.dumps(req))}')
         runner.run(cmd, stream_logs=True, log_path=os.devnull)

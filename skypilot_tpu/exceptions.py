@@ -134,6 +134,10 @@ class ServiceNotFoundError(SkyTpuError):
     """Named service not found."""
 
 
+class InvalidServiceSpecError(SkyTpuError):
+    """Malformed ``service:`` section in a task YAML."""
+
+
 # --- Storage ---------------------------------------------------------------
 class StorageError(SkyTpuError):
     """Base class for storage errors."""

@@ -117,6 +117,11 @@ def wait_instances(region: str, cluster_name: str, state: str) -> None:
 
 
 def _collect_agent_pids(cluster_name: str) -> List[int]:
+    """Pids whose trees a real cloud's VM-terminate would take down: the
+    agentd AND every live job driver. Drivers are launched detached by
+    whichever process ran ``schedule_step`` (often a short-lived RPC
+    shell), so they reparent to init and are NOT under the agentd tree —
+    they must be killed via the pids recorded in the node's jobs db."""
     cdir = _cluster_dir(cluster_name)
     pids: List[int] = []
     if not os.path.isdir(cdir):
@@ -124,13 +129,31 @@ def _collect_agent_pids(cluster_name: str) -> List[int]:
     for node in sorted(os.listdir(cdir)):
         if not node.startswith('node-'):
             continue
-        pid_path = os.path.join(cdir, node, '.skytpu_agent', 'agentd.pid')
+        agent_dir = os.path.join(cdir, node, '.skytpu_agent')
         try:
-            with open(pid_path, encoding='utf-8') as f:
+            with open(os.path.join(agent_dir, 'agentd.pid'),
+                      encoding='utf-8') as f:
                 pids.append(int(f.read().strip()))
         except (FileNotFoundError, NotADirectoryError, ValueError):
-            continue
+            pass
+        pids.extend(_live_driver_pids(os.path.join(agent_dir, 'jobs.db')))
     return pids
+
+
+def _live_driver_pids(jobs_db: str) -> List[int]:
+    import sqlite3
+    if not os.path.exists(jobs_db):
+        return []
+    try:
+        conn = sqlite3.connect(jobs_db, timeout=5)
+        rows = conn.execute(
+            'SELECT driver_pid FROM jobs WHERE driver_pid IS NOT NULL '
+            "AND status IN ('INIT','PENDING','STARTING','RUNNING')"
+        ).fetchall()
+        conn.close()
+    except sqlite3.Error:
+        return []
+    return [int(r[0]) for r in rows if r[0]]
 
 
 def _kill_pids(pids: List[int]) -> None:

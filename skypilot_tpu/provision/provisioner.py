@@ -19,6 +19,7 @@ from typing import Dict, Optional
 from skypilot_tpu import exceptions
 from skypilot_tpu import provision
 from skypilot_tpu import tpu_logging
+from skypilot_tpu.agent import constants as agent_constants
 from skypilot_tpu.agent import rpc as agent_rpc
 from skypilot_tpu.provision import common
 from skypilot_tpu.utils import subprocess_utils
@@ -84,7 +85,8 @@ def post_provision_runtime_setup(
         'kill -0 $(cat ~/.skytpu_agent/agentd.pid) 2>/dev/null; then '
         '  echo "agentd already running"; '
         'else '
-        f'  setsid {shlex.quote(head.remote_python)} -m '
+        f'  {agent_constants.control_plane_env_prefix()}'
+        f'setsid {shlex.quote(head.remote_python)} -m '
         'skypilot_tpu.agent.agentd >> ~/.skytpu_agent/agentd.log 2>&1 '
         '< /dev/null & '
         'fi')
@@ -116,7 +118,8 @@ def agent_request(head_runner, request: Dict,
     return the parsed payload. The same wire protocol serves the agent RPC
     and the jobs/serve controller RPCs — pass ``module``/``error_cls``.
     Raises CommandError / ``error_cls`` on failure."""
-    cmd = (f'{shlex.quote(head_runner.remote_python)} '
+    cmd = (f'{agent_constants.control_plane_env_prefix()}'
+           f'{shlex.quote(head_runner.remote_python)} '
            f'-m {module} '
            f'{shlex.quote(json.dumps(request))}')
     out = head_runner.check_run(cmd)

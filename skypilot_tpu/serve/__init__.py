@@ -1,0 +1,26 @@
+"""SkyServe-equivalent: autoscaled model serving on TPU slices.
+
+Lazy exports (importing the engine pulls jax; the spec layer must not).
+"""
+from typing import Any
+
+_LAZY = {
+    'up': ('skypilot_tpu.serve.core', 'up'),
+    'down': ('skypilot_tpu.serve.core', 'down'),
+    'status': ('skypilot_tpu.serve.core', 'status'),
+    'tail_logs': ('skypilot_tpu.serve.core', 'tail_logs'),
+    'SkyServiceSpec': ('skypilot_tpu.serve.service_spec', 'SkyServiceSpec'),
+}
+
+
+def __getattr__(name: str) -> Any:
+    if name in _LAZY:
+        import importlib
+        module_name, attr = _LAZY[name]
+        value = getattr(importlib.import_module(module_name), attr)
+        globals()[name] = value
+        return value
+    raise AttributeError(f'module {__name__!r} has no attribute {name!r}')
+
+
+__all__ = list(_LAZY)

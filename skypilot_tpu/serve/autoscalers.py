@@ -1,0 +1,225 @@
+"""Autoscalers: replica-count decisions from request telemetry.
+
+Role of reference ``sky/serve/autoscalers.py`` (``Autoscaler`` ``:115``,
+``RequestRateAutoscaler`` ``:431``, ``FallbackRequestRateAutoscaler``
+``:546``): the controller feeds request timestamps (reported by the load
+balancer) and current replica states in; scaling decisions come out.
+Hysteresis: a scale-up/-down target must persist for
+``upscale_delay_seconds`` / ``downscale_delay_seconds`` of consecutive
+evaluations before it is acted on — QPS spikes don't thrash whole TPU
+slices.
+
+Pure, clock-injectable logic (no I/O) so tests drive it with synthetic
+timestamps (reference pattern: ``tests/test_serve_autoscaler.py``).
+"""
+from __future__ import annotations
+
+import dataclasses
+import enum
+import math
+import time
+import typing
+from typing import Any, Dict, List, Optional
+
+if typing.TYPE_CHECKING:
+    from skypilot_tpu.serve.service_spec import SkyServiceSpec
+
+
+class DecisionOperator(enum.Enum):
+    SCALE_UP = 'scale_up'
+    SCALE_DOWN = 'scale_down'
+
+
+@dataclasses.dataclass
+class ScalingDecision:
+    operator: DecisionOperator
+    # SCALE_UP: {'use_spot': bool}; SCALE_DOWN: {'replica_id': int}
+    target: Dict[str, Any]
+
+
+# Minimal view of a replica the autoscaler needs (the controller builds
+# these from serve_state rows; tests build them directly).
+@dataclasses.dataclass
+class ReplicaView:
+    replica_id: int
+    is_ready: bool
+    is_spot: bool
+    is_terminal: bool = False     # preempted/failed: replaced, not counted
+
+
+class Autoscaler:
+    """Base: fixed replica count (no QPS signal)."""
+
+    def __init__(self, spec: 'SkyServiceSpec') -> None:
+        self.spec = spec
+        self.target_num_replicas = spec.min_replicas
+        self.latest_version: int = 1
+
+    def update_spec(self, spec: 'SkyServiceSpec', version: int) -> None:
+        """Service update: new spec takes effect on the next evaluation."""
+        self.spec = spec
+        self.latest_version = version
+        self.target_num_replicas = min(
+            max(self.target_num_replicas, spec.min_replicas),
+            spec.max_replicas if spec.max_replicas is not None
+            else spec.min_replicas)
+
+    def collect_request_information(
+            self, request_timestamps: List[float]) -> None:
+        del request_timestamps
+
+    def evaluate_scaling(
+            self, replicas: List[ReplicaView],
+            now: Optional[float] = None) -> List[ScalingDecision]:
+        alive = [r for r in replicas if not r.is_terminal]
+        decisions: List[ScalingDecision] = []
+        for _ in range(self.target_num_replicas - len(alive)):
+            decisions.append(ScalingDecision(
+                DecisionOperator.SCALE_UP, {'use_spot': self._use_spot()}))
+        if len(alive) > self.target_num_replicas:
+            for rep in self._downscale_candidates(
+                    alive, len(alive) - self.target_num_replicas):
+                decisions.append(ScalingDecision(
+                    DecisionOperator.SCALE_DOWN,
+                    {'replica_id': rep.replica_id}))
+        return decisions
+
+    def _use_spot(self) -> bool:
+        return False
+
+    @staticmethod
+    def _downscale_candidates(alive: List[ReplicaView],
+                              count: int) -> List[ReplicaView]:
+        """Prefer killing not-ready replicas, then highest ids (newest)."""
+        return sorted(alive, key=lambda r: (r.is_ready, -r.replica_id))[:count]
+
+    @classmethod
+    def from_spec(cls, spec: 'SkyServiceSpec') -> 'Autoscaler':
+        if spec.autoscaling_enabled:
+            if spec.base_ondemand_fallback_replicas > 0 or \
+                    spec.dynamic_ondemand_fallback:
+                return FallbackRequestRateAutoscaler(spec)
+            return RequestRateAutoscaler(spec)
+        return Autoscaler(spec)
+
+
+class RequestRateAutoscaler(Autoscaler):
+    """QPS-driven: target = ceil(qps / target_qps_per_replica), bounded to
+    [min_replicas, max_replicas], applied only after the hysteresis delay
+    (reference ``sky/serve/autoscalers.py:431``, hysteresis ``:348``)."""
+
+    QPS_WINDOW_SECONDS = 60.0
+
+    def __init__(self, spec: 'SkyServiceSpec') -> None:
+        super().__init__(spec)
+        self._request_timestamps: List[float] = []
+        # Hysteresis is wall-clock-based (first moment the raw target
+        # breached the current one), NOT eval-count-based: the controller
+        # tick is configurable (SKYTPU_SERVE_TICK), and counting evals
+        # would silently rescale the configured delays with it.
+        self._upscale_breach_since: Optional[float] = None
+        self._downscale_breach_since: Optional[float] = None
+
+    # ------------------------------------------------------------- signal
+    def collect_request_information(
+            self, request_timestamps: List[float]) -> None:
+        self._request_timestamps.extend(request_timestamps)
+
+    def _trim_window(self, now: float) -> None:
+        cutoff = now - self.QPS_WINDOW_SECONDS
+        self._request_timestamps = [
+            t for t in self._request_timestamps if t >= cutoff]
+
+    def current_qps(self, now: Optional[float] = None) -> float:
+        now = time.time() if now is None else now
+        self._trim_window(now)
+        return len(self._request_timestamps) / self.QPS_WINDOW_SECONDS
+
+    # ------------------------------------------------------------ evaluate
+    def _raw_target(self, now: float) -> int:
+        qps = self.current_qps(now)
+        assert self.spec.target_qps_per_replica is not None
+        target = math.ceil(qps / self.spec.target_qps_per_replica)
+        lo = self.spec.min_replicas
+        hi = self.spec.max_replicas
+        return min(max(target, lo), hi if hi is not None else lo)
+
+    def _update_target(self, now: float) -> None:
+        raw = self._raw_target(now)
+        if raw > self.target_num_replicas:
+            self._downscale_breach_since = None
+            if self._upscale_breach_since is None:
+                self._upscale_breach_since = now
+            if (now - self._upscale_breach_since
+                    >= self.spec.upscale_delay_seconds):
+                self.target_num_replicas = raw
+                self._upscale_breach_since = None
+        elif raw < self.target_num_replicas:
+            self._upscale_breach_since = None
+            if self._downscale_breach_since is None:
+                self._downscale_breach_since = now
+            if (now - self._downscale_breach_since
+                    >= self.spec.downscale_delay_seconds):
+                self.target_num_replicas = raw
+                self._downscale_breach_since = None
+        else:
+            self._upscale_breach_since = None
+            self._downscale_breach_since = None
+
+    def evaluate_scaling(
+            self, replicas: List[ReplicaView],
+            now: Optional[float] = None) -> List[ScalingDecision]:
+        now = time.time() if now is None else now
+        self._update_target(now)
+        return super().evaluate_scaling(replicas, now)
+
+    def _use_spot(self) -> bool:
+        # Plain request-rate autoscaler follows the task's own use_spot.
+        return False
+
+
+class FallbackRequestRateAutoscaler(RequestRateAutoscaler):
+    """Spot + on-demand mix (reference ``:546``): keep
+    ``base_ondemand_fallback_replicas`` on-demand replicas as ballast; the
+    remainder of the target runs on preemptible capacity. With
+    ``dynamic_ondemand_fallback``, a preempted spot replica is temporarily
+    backfilled on-demand (decided by the controller passing terminal spot
+    replicas here)."""
+
+    def evaluate_scaling(
+            self, replicas: List[ReplicaView],
+            now: Optional[float] = None) -> List[ScalingDecision]:
+        now = time.time() if now is None else now
+        self._update_target(now)
+        alive = [r for r in replicas if not r.is_terminal]
+        base = min(self.spec.base_ondemand_fallback_replicas,
+                   self.target_num_replicas)
+        want_od = base
+        want_spot = self.target_num_replicas - base
+        have_od = sum(1 for r in alive if not r.is_spot)
+        have_spot = sum(1 for r in alive if r.is_spot)
+        if self.spec.dynamic_ondemand_fallback:
+            # Backfill not-yet-ready spot capacity (preempted or still
+            # provisioning) with temporary on-demand replicas; they are
+            # scaled back down as spot replicas turn READY.
+            ready_spot = sum(1 for r in alive if r.is_spot and r.is_ready)
+            want_od = min(self.target_num_replicas,
+                          base + max(0, want_spot - ready_spot))
+
+        decisions: List[ScalingDecision] = []
+        for _ in range(want_od - have_od):
+            decisions.append(ScalingDecision(DecisionOperator.SCALE_UP,
+                                             {'use_spot': False}))
+        for _ in range(want_spot - have_spot):
+            decisions.append(ScalingDecision(DecisionOperator.SCALE_UP,
+                                             {'use_spot': True}))
+        for kind_spot, excess in ((False, have_od - want_od),
+                                  (True, have_spot - want_spot)):
+            if excess <= 0:
+                continue
+            pool = [r for r in alive if r.is_spot == kind_spot]
+            for rep in self._downscale_candidates(pool, excess):
+                decisions.append(ScalingDecision(
+                    DecisionOperator.SCALE_DOWN,
+                    {'replica_id': rep.replica_id}))
+        return decisions

@@ -1,0 +1,191 @@
+"""Per-service controller: autoscaler loop + replica manager + a small
+HTTP API the load balancer syncs against.
+
+Role of reference ``sky/serve/controller.py`` (``SkyServeController``
+``:36``, ``_run_autoscaler`` ``:64``): periodically evaluate the
+autoscaler against current replica states and apply the scaling
+decisions; expose ``/controller/load_balancer_sync`` so the LB can push
+request timestamps and pull ready replica URLs (reference uses FastAPI;
+stdlib http.server here — no extra deps on the controller cluster).
+"""
+from __future__ import annotations
+
+import http.server
+import json
+import os
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+from skypilot_tpu import tpu_logging
+from skypilot_tpu.serve import autoscalers
+from skypilot_tpu.serve import replica_managers
+from skypilot_tpu.serve import serve_state
+from skypilot_tpu.serve.service_spec import SkyServiceSpec
+
+logger = tpu_logging.init_logger(__name__)
+
+
+def _tick() -> float:
+    return float(os.environ.get('SKYTPU_SERVE_TICK', '10'))
+
+
+class ServeController:
+
+    def __init__(self, service_name: str, spec: SkyServiceSpec,
+                 task_config: Dict[str, Any], port: int,
+                 reserved_ports: Optional[set] = None):
+        self.service_name = service_name
+        self.spec = spec
+        self.port = port
+        self.replica_manager = replica_managers.ReplicaManager(
+            service_name, spec, task_config,
+            reserved_ports=(reserved_ports or set()) | {port})
+        self.autoscaler = autoscalers.Autoscaler.from_spec(spec)
+        self._stop = threading.Event()      # stops the autoscaler loop
+        self._done = threading.Event()      # teardown fully finished
+        self._httpd: Optional[http.server.ThreadingHTTPServer] = None
+        self._threads: List[threading.Thread] = []
+
+    # ---------------------------------------------------------- scaling
+    def _replica_views(self) -> List[autoscalers.ReplicaView]:
+        views = []
+        for info in self.replica_manager.replicas():
+            views.append(autoscalers.ReplicaView(
+                replica_id=info.replica_id,
+                is_ready=(info.status == serve_state.ReplicaStatus.READY),
+                is_spot=info.is_spot,
+                is_terminal=info.status.is_terminal()))
+        return views
+
+    def _autoscaler_step(self) -> None:
+        decisions = self.autoscaler.evaluate_scaling(self._replica_views())
+        for d in decisions:
+            if d.operator == autoscalers.DecisionOperator.SCALE_UP:
+                if self.replica_manager.in_launch_backoff():
+                    continue      # recent launch failure; retry later
+                self.replica_manager.scale_up(
+                    use_spot=bool(d.target.get('use_spot')))
+            else:
+                self.replica_manager.scale_down(d.target['replica_id'])
+
+    def _update_service_status(self) -> None:
+        record = serve_state.get_service(self.service_name)
+        if record is None or record['status'] in (
+                serve_state.ServiceStatus.SHUTTING_DOWN,):
+            return
+        infos = self.replica_manager.replicas()
+        n_ready = sum(1 for i in infos
+                      if i.status == serve_state.ReplicaStatus.READY)
+        if n_ready > 0:
+            status = serve_state.ServiceStatus.READY
+        elif infos:
+            status = serve_state.ServiceStatus.REPLICA_INIT
+        else:
+            status = serve_state.ServiceStatus.NO_REPLICA
+        if status != record['status']:
+            serve_state.set_service_status(self.service_name, status)
+
+    def _loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                self.replica_manager.probe_all()
+                self._autoscaler_step()
+                self._update_service_status()
+            except Exception:  # pylint: disable=broad-except
+                logger.exception('controller loop error')
+            self._stop.wait(_tick())
+
+    # ------------------------------------------------------------- HTTP
+    def _make_handler(controller):  # noqa: N805
+        class Handler(http.server.BaseHTTPRequestHandler):
+
+            def log_message(self, *args):  # quiet
+                del args
+
+            def _json(self, code: int, payload: Dict[str, Any]) -> None:
+                body = json.dumps(payload).encode()
+                self.send_response(code)
+                self.send_header('Content-Type', 'application/json')
+                self.send_header('Content-Length', str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):  # noqa: N802
+                if self.path == '/controller/ready':
+                    self._json(200, {'ready': True})
+                elif self.path == '/controller/status':
+                    self._json(200, controller.status_payload())
+                else:
+                    self._json(404, {'error': f'no route {self.path}'})
+
+            def do_POST(self):  # noqa: N802
+                length = int(self.headers.get('Content-Length', 0))
+                try:
+                    payload = json.loads(self.rfile.read(length) or b'{}')
+                except json.JSONDecodeError:
+                    self._json(400, {'error': 'bad json'})
+                    return
+                if self.path == '/controller/load_balancer_sync':
+                    ts = payload.get('request_timestamps', [])
+                    controller.autoscaler.collect_request_information(ts)
+                    self._json(200, {
+                        'ready_replica_urls':
+                            controller.replica_manager.ready_urls()})
+                elif self.path == '/controller/terminate':
+                    threading.Thread(target=controller.terminate,
+                                     daemon=True).start()
+                    self._json(200, {'terminating': True})
+                else:
+                    self._json(404, {'error': f'no route {self.path}'})
+
+        return Handler
+
+    def status_payload(self) -> Dict[str, Any]:
+        return {
+            'service_name': self.service_name,
+            'target_num_replicas': self.autoscaler.target_num_replicas,
+            'replicas': [{
+                'replica_id': i.replica_id,
+                'cluster_name': i.cluster_name,
+                'status': i.status.value,
+                'url': i.url,
+                'version': i.version,
+                'is_spot': i.is_spot,
+            } for i in self.replica_manager.replicas()],
+        }
+
+    # ---------------------------------------------------------- lifecycle
+    def start(self) -> None:
+        handler = self._make_handler()
+        self._httpd = http.server.ThreadingHTTPServer(
+            ('127.0.0.1', self.port), handler)
+        t_http = threading.Thread(target=self._httpd.serve_forever,
+                                  daemon=True)
+        t_loop = threading.Thread(target=self._loop, daemon=True)
+        t_http.start()
+        t_loop.start()
+        self._threads = [t_http, t_loop]
+        logger.info(f'Serve controller for {self.service_name} on port '
+                    f'{self.port}.')
+
+    def terminate(self) -> None:
+        serve_state.set_service_status(
+            self.service_name, serve_state.ServiceStatus.SHUTTING_DOWN)
+        # Order matters: stop the autoscaler loop and refuse new launches
+        # BEFORE tearing replicas down, or the loop relaunches replicas
+        # that terminate_all never snapshotted (leaked clusters).
+        self._stop.set()
+        self.replica_manager.shutdown()
+        self.replica_manager.terminate_all()
+        if self._httpd is not None:
+            self._httpd.shutdown()
+        serve_state.remove_service(self.service_name)
+        # Last: releases wait() — the service process must stay alive
+        # until the teardown above completed (terminate() usually runs on
+        # a daemon thread that dies with the process).
+        self._done.set()
+
+    def wait(self) -> None:
+        while not self._done.is_set():
+            time.sleep(0.2)

@@ -1,0 +1,191 @@
+"""SkyServe client API: ``serve.up/down/status/tail_logs``.
+
+Role of reference ``sky/serve/core.py`` (``up`` ``:136``, ``update``
+``:362``, ``down`` ``:525``): ensure the serve-controller cluster (an
+ordinary cluster — the whole stack recursively, SURVEY key idea #2), then
+drive the serve RPC on its head. The service's replicas are themselves
+ordinary clusters launched by the controller process.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Union
+
+from skypilot_tpu import config as config_lib
+from skypilot_tpu import exceptions
+from skypilot_tpu import execution
+from skypilot_tpu import global_state
+from skypilot_tpu import tpu_logging
+from skypilot_tpu.dag import Dag
+from skypilot_tpu.resources import Resources
+from skypilot_tpu.serve.service_spec import SkyServiceSpec
+from skypilot_tpu.task import Task
+from skypilot_tpu.utils import common_utils
+
+logger = tpu_logging.init_logger(__name__)
+
+CONTROLLER_CLUSTER_NAME = 'skytpu-serve-controller'
+
+
+def _to_task(task_or_dag: Union[Task, Dag]) -> Task:
+    if isinstance(task_or_dag, Dag):
+        tasks = task_or_dag.topological_order()
+        if len(tasks) != 1:
+            raise exceptions.InvalidDagError(
+                'A service is a single task, not a pipeline.')
+        return tasks[0]
+    return task_or_dag
+
+
+def _controller_resources(task: Task) -> Resources:
+    cfg = config_lib.get_nested(('serve', 'controller', 'resources'), None)
+    if cfg:
+        return Resources.from_yaml_config(dict(cfg))
+    cloud = None
+    for res in task.resources:
+        if res.cloud:
+            cloud = res.cloud
+            break
+    return Resources(cloud=cloud or 'gcp', cpus='4+')
+
+
+def _ensure_controller(task: Task) -> Any:
+    record = global_state.get_cluster_from_name(CONTROLLER_CLUSTER_NAME)
+    if record is not None and record['handle'] is not None:
+        from skypilot_tpu.backend import backend_utils
+        rec, handle = backend_utils.refresh_cluster_status(
+            CONTROLLER_CLUSTER_NAME)
+        if (rec is not None and handle is not None
+                and rec['status'] == global_state.ClusterStatus.UP):
+            return handle
+    controller_task = Task(name='serve-controller')
+    controller_task.set_resources(_controller_resources(task))
+    _, handle = execution.launch(controller_task,
+                                 cluster_name=CONTROLLER_CLUSTER_NAME,
+                                 detach_run=True, stream_logs=False)
+    return handle
+
+
+def _controller_request(handle, request: Dict[str, Any]) -> Dict[str, Any]:
+    from skypilot_tpu.provision import provisioner
+    return provisioner.agent_request(handle.head_runner(), request,
+                                     module='skypilot_tpu.serve.rpc',
+                                     error_cls=exceptions.ApiError)
+
+
+def _get_controller_handle() -> Any:
+    record = global_state.get_cluster_from_name(CONTROLLER_CLUSTER_NAME)
+    if record is None or record['handle'] is None:
+        raise exceptions.ClusterNotUpError(
+            'No serve controller is running (no services up).')
+    return record['handle']
+
+
+# ------------------------------------------------------------------- API
+def up(task_or_dag: Union[Task, Dag],
+       service_name: Optional[str] = None) -> Dict[str, Any]:
+    """Spin up a service; returns {'name', 'endpoint'}.
+
+    Reference ``sky.serve.up`` (``sky/serve/core.py:136``)."""
+    task = _to_task(task_or_dag)
+    if task.service is None:
+        raise exceptions.InvalidServiceSpecError(
+            'Task has no `service:` section; cannot `serve up`.')
+    spec = SkyServiceSpec.from_yaml_config(task.service)   # validate early
+    del spec
+    if service_name is None:
+        service_name = task.name or common_utils.generate_cluster_name(
+            prefix='service')
+    common_utils.check_cluster_name_is_valid(service_name)
+
+    handle = _ensure_controller(task)
+    resp = _controller_request(handle, {
+        'op': 'up',
+        'service_name': service_name,
+        'task_config': task.to_yaml_config(),
+        'username': common_utils.get_cleaned_username(),
+        'run_timestamp': common_utils.make_run_timestamp(),
+    })
+    if not resp.get('ok'):
+        raise exceptions.ApiError(resp.get('error', 'serve up failed'))
+    head_ip = handle.cluster_info.hosts[0].internal_ip
+    endpoint = f'http://{head_ip}:{resp["lb_port"]}'
+    logger.info(f'Service {service_name!r} submitted; endpoint: {endpoint}')
+    return {'name': service_name, 'endpoint': endpoint}
+
+
+def status(service_names: Optional[List[str]] = None
+           ) -> List[Dict[str, Any]]:
+    """Service table incl. per-replica rows (reference ``sky serve
+    status``)."""
+    handle = _get_controller_handle()
+    resp = _controller_request(handle, {
+        'op': 'status', 'service_names': service_names})
+    if not resp.get('ok'):
+        raise exceptions.ApiError(resp.get('error', 'serve status failed'))
+    head_ip = handle.cluster_info.hosts[0].internal_ip
+    services = resp['services']
+    for svc in services:
+        svc['endpoint'] = f'http://{head_ip}:{svc["lb_port"]}'
+    return services
+
+
+def down(service_name: str, purge: bool = False) -> None:
+    """Tear down a service: replicas, then controller/LB processes
+    (reference ``sky.serve.down`` ``sky/serve/core.py:525``). With
+    ``purge``, transport failures (controller cluster down/unreachable —
+    the main reason purge exists) fall back to best-effort local cleanup
+    of replica clusters instead of raising."""
+    try:
+        handle = _get_controller_handle()
+        resp = _controller_request(handle, {
+            'op': 'down', 'service_name': service_name})
+    except Exception as e:  # pylint: disable=broad-except
+        if not purge:
+            raise
+        logger.warning(f'Controller unreachable ({type(e).__name__}: {e}); '
+                       f'purging {service_name!r} locally.')
+        _purge_replica_clusters(service_name)
+        return
+    if not resp.get('ok'):
+        if not purge:
+            raise exceptions.ApiError(resp.get('error', 'serve down failed'))
+        logger.warning(f'serve down reported failure '
+                       f'({resp.get("error")}); purging '
+                       f'{service_name!r} locally.')
+        _purge_replica_clusters(service_name)
+
+
+def _purge_replica_clusters(service_name: str) -> None:
+    """Best-effort teardown of clusters named like this service's
+    replicas, using the client-side cluster table.
+
+    Scope caveat: replicas are launched BY the controller process, so on
+    a remote controller host their records live in ITS state DB, not the
+    client's — this purge can only clean what the client can see (on the
+    local provider that is everything, since the state dir is shared).
+    Clusters it cannot see must be cleaned from the controller host or
+    the cloud console; we log the limitation rather than claim success."""
+    logger.warning('Purge uses the client-side cluster table; replica '
+                   'clusters recorded only on the (unreachable) '
+                   'controller host are not covered.')
+    from skypilot_tpu import core as sky_core
+    prefix = f'{service_name}-replica-'
+    for record in global_state.get_clusters():
+        if record['name'].startswith(prefix):
+            try:
+                sky_core.down(record['name'])
+            except Exception:  # pylint: disable=broad-except
+                pass
+
+
+def tail_logs(service_name: str, follow: bool = True) -> None:
+    """Stream the service process log (controller+LB trace)."""
+    from skypilot_tpu.backend import tpu_backend
+    handle = _get_controller_handle()
+    backend = tpu_backend.TpuVmBackend()
+    for j in backend.get_job_queue(handle):
+        if j['name'] == f'service-{service_name}':
+            backend.tail_logs(handle, j['job_id'], follow=follow)
+            return
+    raise exceptions.ServiceNotFoundError(
+        f'No service process found for {service_name!r}.')

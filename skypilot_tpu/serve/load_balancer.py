@@ -1,0 +1,160 @@
+"""Load balancer: the service's public endpoint; proxies requests to
+ready replicas.
+
+Role of reference ``SkyServeLoadBalancer`` (``sky/serve/load_balancer.py:
+22``): every ``_sync_with_controller`` period (``:72``) it POSTs the
+request timestamps collected since the last sync to the controller (the
+autoscaler's QPS signal) and receives the current ready-replica URLs;
+requests are proxied per the load-balancing policy. Reference stack is
+FastAPI+httpx; stdlib http.server + urllib here (the LB does one stream
+per request — threads suffice).
+"""
+from __future__ import annotations
+
+import http.server
+import json
+import os
+import threading
+import time
+from typing import List, Optional
+import urllib.error
+import urllib.request
+
+from skypilot_tpu import tpu_logging
+from skypilot_tpu.serve import load_balancing_policies as lb_policies
+
+logger = tpu_logging.init_logger(__name__)
+
+_HOP_HEADERS = {'connection', 'keep-alive', 'transfer-encoding', 'host',
+                'content-length'}
+
+
+def _sync_period() -> float:
+    return float(os.environ.get('SKYTPU_LB_SYNC', '3'))
+
+
+class SkyServeLoadBalancer:
+
+    def __init__(self, controller_url: str, port: int,
+                 policy_name: str = 'round_robin'):
+        self.controller_url = controller_url.rstrip('/')
+        self.port = port
+        self.policy = lb_policies.make_policy(policy_name)
+        self._request_timestamps: List[float] = []
+        self._ts_lock = threading.Lock()
+        self._stop = threading.Event()
+        self._httpd: Optional[http.server.ThreadingHTTPServer] = None
+
+    # ------------------------------------------------------------- sync
+    def _sync_once(self) -> None:
+        with self._ts_lock:
+            timestamps, self._request_timestamps = \
+                self._request_timestamps, []
+        body = json.dumps({'request_timestamps': timestamps}).encode()
+        req = urllib.request.Request(
+            self.controller_url + '/controller/load_balancer_sync',
+            data=body, headers={'Content-Type': 'application/json'})
+        try:
+            with urllib.request.urlopen(req, timeout=5) as resp:
+                payload = json.loads(resp.read())
+            self.policy.set_ready_replicas(
+                payload.get('ready_replica_urls', []))
+        except Exception as e:  # pylint: disable=broad-except
+            # Keep serving the last known replica set; re-queue the
+            # timestamps so the QPS signal survives controller restarts —
+            # but only those still inside the autoscaler's QPS window, or
+            # memory grows unboundedly across a long controller outage.
+            cutoff = time.time() - 60.0
+            with self._ts_lock:
+                self._request_timestamps = (
+                    [t for t in timestamps if t >= cutoff]
+                    + self._request_timestamps)
+            logger.warning(f'LB sync with controller failed: '
+                           f'{type(e).__name__}: {e}')
+
+    def _sync_loop(self) -> None:
+        while not self._stop.is_set():
+            self._sync_once()
+            self._stop.wait(_sync_period())
+
+    # ------------------------------------------------------------- proxy
+    def _make_handler(lb):  # noqa: N805
+        class Handler(http.server.BaseHTTPRequestHandler):
+            protocol_version = 'HTTP/1.1'
+
+            def log_message(self, *args):
+                del args
+
+            def _proxy(self, method: str) -> None:
+                with lb._ts_lock:
+                    lb._request_timestamps.append(time.time())
+                url = lb.policy.select_replica()
+                if url is None:
+                    body = json.dumps({
+                        'error': 'No ready replicas. '
+                                 'Use "sky serve status" to check.'
+                    }).encode()
+                    self.send_response(503)
+                    self.send_header('Content-Type', 'application/json')
+                    self.send_header('Content-Length', str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+                    return
+                length = int(self.headers.get('Content-Length', 0))
+                data = self.rfile.read(length) if length else None
+                headers = {k: v for k, v in self.headers.items()
+                           if k.lower() not in _HOP_HEADERS}
+                req = urllib.request.Request(url + self.path, data=data,
+                                             headers=headers, method=method)
+                lb.policy.pre_execute(url)
+                try:
+                    with urllib.request.urlopen(req, timeout=120) as resp:
+                        body = resp.read()
+                        self.send_response(resp.status)
+                        for k, v in resp.headers.items():
+                            if k.lower() not in _HOP_HEADERS:
+                                self.send_header(k, v)
+                        self.send_header('Content-Length', str(len(body)))
+                        self.end_headers()
+                        self.wfile.write(body)
+                except urllib.error.HTTPError as e:
+                    body = e.read()
+                    self.send_response(e.code)
+                    self.send_header('Content-Length', str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+                except Exception as e:  # pylint: disable=broad-except
+                    body = json.dumps({
+                        'error': f'replica unreachable: '
+                                 f'{type(e).__name__}: {e}'}).encode()
+                    self.send_response(502)
+                    self.send_header('Content-Type', 'application/json')
+                    self.send_header('Content-Length', str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+                finally:
+                    lb.policy.post_execute(url)
+
+            def do_GET(self):  # noqa: N802
+                self._proxy('GET')
+
+            def do_POST(self):  # noqa: N802
+                self._proxy('POST')
+
+        return Handler
+
+    # ---------------------------------------------------------- lifecycle
+    def start(self) -> None:
+        handler = self._make_handler()
+        self._httpd = http.server.ThreadingHTTPServer(
+            ('0.0.0.0', self.port), handler)
+        threading.Thread(target=self._httpd.serve_forever,
+                         daemon=True).start()
+        threading.Thread(target=self._sync_loop, daemon=True).start()
+        logger.info(f'Load balancer on port {self.port} → '
+                    f'{self.controller_url}')
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._httpd is not None:
+            self._httpd.shutdown()

@@ -1,0 +1,89 @@
+"""Load-balancing policies (reference
+``sky/serve/load_balancing_policies.py``: ``RoundRobinPolicy`` ``:89``,
+``LeastLoadPolicy`` ``:115``). Pure selection logic over the ready-replica
+URL list the LB syncs from the controller."""
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional
+
+
+class LoadBalancingPolicy:
+
+    def __init__(self) -> None:
+        self.ready_replicas: List[str] = []
+        self._lock = threading.Lock()
+
+    def set_ready_replicas(self, urls: List[str]) -> None:
+        with self._lock:
+            if set(urls) != set(self.ready_replicas):
+                self._on_replicas_changed(urls)
+            self.ready_replicas = list(urls)
+
+    def _on_replicas_changed(self, urls: List[str]) -> None:
+        del urls
+
+    def select_replica(self) -> Optional[str]:
+        raise NotImplementedError
+
+    def pre_execute(self, url: str) -> None:
+        """Called when a request is dispatched to ``url``."""
+        del url
+
+    def post_execute(self, url: str) -> None:
+        """Called when the request to ``url`` completes."""
+        del url
+
+
+class RoundRobinPolicy(LoadBalancingPolicy):
+
+    def __init__(self) -> None:
+        super().__init__()
+        self._index = 0
+
+    def _on_replicas_changed(self, urls: List[str]) -> None:
+        self._index = 0
+
+    def select_replica(self) -> Optional[str]:
+        with self._lock:
+            if not self.ready_replicas:
+                return None
+            url = self.ready_replicas[self._index % len(self.ready_replicas)]
+            self._index += 1
+            return url
+
+
+class LeastLoadPolicy(LoadBalancingPolicy):
+    """Route to the replica with the fewest in-flight requests."""
+
+    def __init__(self) -> None:
+        super().__init__()
+        self._inflight: Dict[str, int] = {}
+
+    def select_replica(self) -> Optional[str]:
+        with self._lock:
+            if not self.ready_replicas:
+                return None
+            return min(self.ready_replicas,
+                       key=lambda u: self._inflight.get(u, 0))
+
+    def pre_execute(self, url: str) -> None:
+        with self._lock:
+            self._inflight[url] = self._inflight.get(url, 0) + 1
+
+    def post_execute(self, url: str) -> None:
+        with self._lock:
+            self._inflight[url] = max(0, self._inflight.get(url, 0) - 1)
+
+
+POLICIES = {
+    'round_robin': RoundRobinPolicy,
+    'least_load': LeastLoadPolicy,
+}
+
+
+def make_policy(name: str) -> LoadBalancingPolicy:
+    if name not in POLICIES:
+        raise ValueError(f'Unknown load balancing policy: {name!r}; '
+                         f'choose from {sorted(POLICIES)}')
+    return POLICIES[name]()

@@ -1,0 +1,411 @@
+"""Replica manager: launches/terminates/probes replica clusters.
+
+Role of reference ``SkyPilotReplicaManager``
+(``sky/serve/replica_managers.py:608``): every replica is an ordinary
+cluster launched through the full stack (``sky/serve/replica_managers.py:
+58-170`` does ``sky.launch`` in a subprocess; here a thread —
+``execution.launch`` is already process-safe via per-cluster locks).
+Readiness probing (``:1026``) is an HTTP GET/POST against
+``http://<head_ip>:<replica_port><readiness_path>``; preemption handling
+(``:782``) maps cluster-gone to PREEMPTED so the autoscaler replaces it.
+
+TPU-first: a replica is a whole slice; its head IP is the slice's worker-0
+and the in-tree model server (multi-controller JAX) listens there. On the
+local provider each replica gets its own port (many replicas share one
+host) — injected as ``SKYTPU_REPLICA_PORT`` either way.
+"""
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+import typing
+from typing import Dict, List, Optional
+import urllib.error
+import urllib.request
+
+from skypilot_tpu import core
+from skypilot_tpu import exceptions
+from skypilot_tpu import execution
+from skypilot_tpu import global_state
+from skypilot_tpu import tpu_logging
+from skypilot_tpu.serve import serve_state
+from skypilot_tpu.task import Task
+from skypilot_tpu.utils import common_utils
+
+if typing.TYPE_CHECKING:
+    from skypilot_tpu.serve.service_spec import SkyServiceSpec
+
+logger = tpu_logging.init_logger(__name__)
+
+_PROBE_FAILURE_GRACE = 3          # consecutive probe failures → NOT_READY
+_PROBE_FAILURE_TERMINATE = 9      # consecutive failures → replace replica
+_MAX_RETAINED_FAILED = 3          # FAILED rows kept for debugging
+_LAUNCH_BACKOFF_CAP = 300.0
+
+
+def _launch_backoff_base() -> float:
+    return float(os.environ.get('SKYTPU_SERVE_LAUNCH_BACKOFF', '5'))
+
+
+class ReplicaInfo:
+    """In-memory mirror of one replica row + probe bookkeeping."""
+
+    def __init__(self, replica_id: int, cluster_name: str, version: int,
+                 is_spot: bool, port: int):
+        self.replica_id = replica_id
+        self.cluster_name = cluster_name
+        self.version = version
+        self.is_spot = is_spot
+        self.port = port
+        self.status = serve_state.ReplicaStatus.PENDING
+        self.url: Optional[str] = None
+        self.consecutive_failures = 0
+        self.first_probe_time: Optional[float] = None
+
+
+class ReplicaManager:
+
+    def __init__(self, service_name: str, spec: 'SkyServiceSpec',
+                 task_config: dict, version: int = 1,
+                 reserved_ports: Optional[set] = None):
+        self.service_name = service_name
+        self.spec = spec
+        self.task_config = task_config
+        self.version = version
+        self._reserved_ports = set(reserved_ports or ())
+        self._replicas: Dict[int, ReplicaInfo] = {}
+        self._next_replica_id = 1
+        # RLock: _persist checks membership under the lock and is called
+        # both with and without it held.
+        self._lock = threading.RLock()
+        self._shutdown = False
+        self._launch_failures = 0
+        self._backoff_until = 0.0
+
+    # ------------------------------------------------------------- update
+    def update_version(self, spec: 'SkyServiceSpec', task_config: dict,
+                       version: int) -> None:
+        """Blue-green-lite (reference ``:1172``): new replicas launch with
+        the new task; old-version replicas are drained by the controller
+        once enough new-version replicas are ready."""
+        self.spec = spec
+        self.task_config = task_config
+        self.version = version
+
+    # ------------------------------------------------------------- launch
+    def _replica_cluster_name(self, replica_id: int) -> str:
+        return f'{self.service_name}-replica-{replica_id}'
+
+    def _replica_task(self, info: ReplicaInfo) -> Task:
+        task = Task.from_yaml_config(dict(self.task_config))
+        envs = dict(task.envs or {})
+        envs['SKYTPU_REPLICA_PORT'] = str(info.port)
+        envs['SKYTPU_SERVE_REPLICA_ID'] = str(info.replica_id)
+        envs['SKYTPU_SERVE_SERVICE'] = self.service_name
+        task.update_envs(envs)
+        if info.is_spot:
+            task.set_resources([r.copy(use_spot=True)
+                                for r in task.resources])
+        return task
+
+    def scale_up(self, use_spot: bool = False) -> Optional[int]:
+        """Start one replica launch in the background; returns its id
+        (None once the manager is shutting down)."""
+        with self._lock:
+            if self._shutdown:
+                return None
+            replica_id = self._next_replica_id
+            self._next_replica_id += 1
+            port = self._pick_port(replica_id)
+            info = ReplicaInfo(replica_id,
+                               self._replica_cluster_name(replica_id),
+                               self.version, use_spot, port)
+            info.status = serve_state.ReplicaStatus.PROVISIONING
+            self._replicas[replica_id] = info
+        self._persist(info)
+        threading.Thread(target=self._launch_replica,
+                         args=(info,), daemon=True).start()
+        return replica_id
+
+    def shutdown(self) -> None:
+        """Refuse further scale_up; in-flight launches will self-clean."""
+        with self._lock:
+            self._shutdown = True
+
+    def in_launch_backoff(self) -> bool:
+        """True while recent launch failures put new launches on hold
+        (exponential backoff so a persistent failure — quota, bad image —
+        doesn't spin up a doomed launch every controller tick)."""
+        with self._lock:
+            return time.time() < self._backoff_until
+
+    def _pick_port(self, replica_id: int) -> int:
+        """Fixed spec port on real clouds (distinct head IPs); a free local
+        port per replica on the local provider (shared host). Ports
+        recorded by OTHER services (allocated but possibly unbound) are
+        excluded via the shared serve-state table."""
+        cloud = (self.task_config.get('resources') or {}).get('cloud')
+        if cloud != 'local':
+            return self.spec.replica_port
+        taken = self._reserved_ports | {
+            r.port for r in self._replicas.values()}
+        taken |= serve_state.allocated_ports()
+        start = 10000
+        while True:
+            port = common_utils.find_free_port(start)
+            if port not in taken:
+                return port
+            start = port + 1
+
+    def _launch_replica(self, info: ReplicaInfo) -> None:
+        task = self._replica_task(info)
+        try:
+            execution.launch(task, cluster_name=info.cluster_name,
+                             detach_run=True, retry_until_up=False)
+        except Exception as e:  # pylint: disable=broad-except
+            logger.warning(f'Replica {info.replica_id} launch failed: '
+                           f'{type(e).__name__}: {e}')
+            self._record_launch_result(info, failed=True)
+            return
+        # A concurrent scale_down/shutdown may have removed this replica
+        # while the launch was in flight; the fresh cluster is then
+        # orphaned — tear it down instead of resurrecting the DB row.
+        with self._lock:
+            abandoned = (self._shutdown
+                         or self._replicas.get(info.replica_id) is not info
+                         or info.status !=
+                         serve_state.ReplicaStatus.PROVISIONING)
+        if abandoned:
+            logger.info(f'Replica {info.replica_id} was removed during '
+                        'launch; tearing its cluster down.')
+            try:
+                core.down(info.cluster_name)
+            except Exception:  # pylint: disable=broad-except
+                pass
+            with self._lock:
+                self._replicas.pop(info.replica_id, None)
+            serve_state.remove_replica(self.service_name, info.replica_id)
+            return
+        handle = global_state.get_handle_from_cluster_name(info.cluster_name)
+        if handle is None:
+            self._record_launch_result(info, failed=True)
+            return
+        head_ip = handle.cluster_info.hosts[0].internal_ip
+        with self._lock:
+            # Re-check under the lock: a scale_down between the abandoned
+            # check above and here must not have its SHUTTING_DOWN status
+            # clobbered back to STARTING.
+            if info.status != serve_state.ReplicaStatus.PROVISIONING:
+                return
+            info.url = f'http://{head_ip}:{info.port}'
+            info.status = serve_state.ReplicaStatus.STARTING
+            info.first_probe_time = time.time()
+            self._persist(info)
+        self._record_launch_result(info, failed=False)
+
+    def _record_launch_result(self, info: ReplicaInfo, failed: bool) -> None:
+        if not failed:
+            # NOTE: launch success only clears the backoff once the
+            # replica actually turns READY (probe_all) — a cluster that
+            # provisions fine but whose app never answers must still
+            # back off, or it churns whole slices forever.
+            return
+        info.status = serve_state.ReplicaStatus.FAILED
+        self._persist(info)
+        try:      # a launch can fail after partially creating the cluster
+            core.down(info.cluster_name)
+        except exceptions.ClusterDoesNotExist:
+            pass
+        except Exception as e:  # pylint: disable=broad-except
+            logger.warning(f'Cleanup of failed replica '
+                           f'{info.cluster_name} failed: {e}')
+        self._bump_backoff()
+
+    def _bump_backoff(self) -> None:
+        """One more replica died before ever serving: extend the
+        exponential launch backoff and prune old FAILED rows."""
+        with self._lock:
+            self._launch_failures += 1
+            delay = min(
+                _launch_backoff_base() * (2 ** (self._launch_failures - 1)),
+                _LAUNCH_BACKOFF_CAP)
+            self._backoff_until = time.time() + delay
+            # Keep only the newest few FAILED rows (status/debugging);
+            # older ones would otherwise accumulate one per retry forever.
+            failed_ids = sorted(
+                rid for rid, r in self._replicas.items()
+                if r.status == serve_state.ReplicaStatus.FAILED)
+            prune = failed_ids[:-_MAX_RETAINED_FAILED]
+            for rid in prune:
+                self._replicas.pop(rid, None)
+        for rid in prune:
+            serve_state.remove_replica(self.service_name, rid)
+
+    # ------------------------------------------------------------ teardown
+    def scale_down(self, replica_id: int, status: Optional[
+            serve_state.ReplicaStatus] = None) -> None:
+        """Terminate a replica cluster (async; cluster teardown is slow)."""
+        with self._lock:
+            info = self._replicas.get(replica_id)
+            if info is None:
+                return
+            info.status = status or serve_state.ReplicaStatus.SHUTTING_DOWN
+        self._persist(info)
+
+        def _down():
+            try:
+                core.down(info.cluster_name)
+            except exceptions.ClusterDoesNotExist:
+                pass
+            except Exception as e:  # pylint: disable=broad-except
+                logger.warning(f'Teardown of {info.cluster_name} failed: '
+                               f'{type(e).__name__}: {e}')
+            with self._lock:     # atomic with _persist's membership check
+                self._replicas.pop(replica_id, None)
+                serve_state.remove_replica(self.service_name, replica_id)
+
+        threading.Thread(target=_down, daemon=True).start()
+
+    def terminate_all(self) -> None:
+        with self._lock:
+            ids = list(self._replicas)
+        threads = []
+        for rid in ids:
+            info = self._replicas.get(rid)
+            if info is None:
+                continue
+            t = threading.Thread(target=self._sync_down, args=(info,))
+            t.start()
+            threads.append(t)
+        for t in threads:
+            t.join()
+
+    def _sync_down(self, info: ReplicaInfo) -> None:
+        try:
+            core.down(info.cluster_name)
+        except Exception:  # pylint: disable=broad-except
+            pass
+        with self._lock:
+            self._replicas.pop(info.replica_id, None)
+            serve_state.remove_replica(self.service_name, info.replica_id)
+
+    # ------------------------------------------------------------- probing
+    def _probe_one(self, info: ReplicaInfo) -> bool:
+        assert info.url is not None
+        url = info.url + self.spec.readiness_path
+        try:
+            if self.spec.post_data is not None:
+                data = json.dumps(self.spec.post_data).encode()
+                req = urllib.request.Request(
+                    url, data=data,
+                    headers={'Content-Type': 'application/json'})
+            else:
+                req = urllib.request.Request(url)
+            with urllib.request.urlopen(
+                    req, timeout=self.spec.readiness_timeout_seconds) as r:
+                return 200 <= r.status < 300
+        except Exception:  # pylint: disable=broad-except
+            return False
+
+    def _check_preempted(self, info: ReplicaInfo) -> bool:
+        """Cluster-gone (or not UP) while we thought it was running =
+        preemption (reference ``_handle_preemption`` ``:782``)."""
+        record = global_state.get_cluster_from_name(info.cluster_name)
+        if record is None:
+            return True
+        from skypilot_tpu.backend import backend_utils
+        try:
+            rec, _ = backend_utils.refresh_cluster_status(info.cluster_name)
+        except Exception:  # pylint: disable=broad-except
+            return False          # transient; keep probing
+        return rec is None or rec['status'] != global_state.ClusterStatus.UP
+
+    def probe_all(self) -> None:
+        """One probe sweep (reference ``_probe_all_replicas`` ``:1026``)."""
+        with self._lock:
+            infos = list(self._replicas.values())
+        for info in infos:
+            if info.status not in (serve_state.ReplicaStatus.STARTING,
+                                   serve_state.ReplicaStatus.READY,
+                                   serve_state.ReplicaStatus.NOT_READY):
+                continue
+            # Cluster existence is ground truth, checked BEFORE the HTTP
+            # probe: a terminated replica's address can keep answering (IP
+            # reuse on clouds; surviving process on the local provider).
+            if self._check_preempted(info):
+                logger.info(f'Replica {info.replica_id} preempted.')
+                info.status = serve_state.ReplicaStatus.PREEMPTED
+                self._persist(info)
+                self.scale_down(info.replica_id,
+                                serve_state.ReplicaStatus.PREEMPTED)
+                continue
+            if self._probe_one(info):
+                info.consecutive_failures = 0
+                if info.status != serve_state.ReplicaStatus.READY:
+                    logger.info(f'Replica {info.replica_id} is READY at '
+                                f'{info.url}.')
+                    with self._lock:     # a replica serves: reset backoff
+                        self._launch_failures = 0
+                        self._backoff_until = 0.0
+                info.status = serve_state.ReplicaStatus.READY
+                self._persist(info)
+                continue
+            # Probe failed on a live cluster.
+            if info.status == serve_state.ReplicaStatus.STARTING:
+                elapsed = time.time() - (info.first_probe_time or 0)
+                if elapsed > self.spec.initial_delay_seconds:
+                    logger.warning(
+                        f'Replica {info.replica_id} failed to become ready '
+                        f'within {self.spec.initial_delay_seconds}s.')
+                    info.status = serve_state.ReplicaStatus.FAILED_PROBE
+                    self._persist(info)
+                    self.scale_down(info.replica_id,
+                                    serve_state.ReplicaStatus.FAILED_PROBE)
+                    # The cluster came up but the app never served — the
+                    # relaunch loop must back off, not churn slices.
+                    self._bump_backoff()
+                continue
+            info.consecutive_failures += 1
+            if info.consecutive_failures >= _PROBE_FAILURE_TERMINATE:
+                # The app on a still-UP cluster is persistently dead
+                # (crashed server, wedged process). NOT_READY is neither
+                # ready nor terminal, so without this the autoscaler
+                # counts it alive forever and never replaces it.
+                logger.warning(
+                    f'Replica {info.replica_id} failed '
+                    f'{info.consecutive_failures} consecutive probes; '
+                    'terminating it for replacement.')
+                info.status = serve_state.ReplicaStatus.FAILED_PROBE
+                self._persist(info)
+                self.scale_down(info.replica_id,
+                                serve_state.ReplicaStatus.FAILED_PROBE)
+                self._bump_backoff()
+            elif info.consecutive_failures >= _PROBE_FAILURE_GRACE:
+                info.status = serve_state.ReplicaStatus.NOT_READY
+                self._persist(info)
+
+    # ------------------------------------------------------------- queries
+    def replicas(self) -> List[ReplicaInfo]:
+        with self._lock:
+            return list(self._replicas.values())
+
+    def ready_urls(self) -> List[str]:
+        with self._lock:
+            return [r.url for r in self._replicas.values()
+                    if r.status == serve_state.ReplicaStatus.READY
+                    and r.url is not None]
+
+    def _persist(self, info: ReplicaInfo) -> None:
+        """Write the replica row — only while the replica is still
+        tracked. Held under the manager lock so a concurrent
+        scale_down's pop+row-delete can't interleave with this write and
+        leave a phantom row for an untracked replica."""
+        with self._lock:
+            if self._replicas.get(info.replica_id) is not info:
+                return
+            serve_state.add_or_update_replica(
+                self.service_name, info.replica_id, info.cluster_name,
+                info.status, info.url, info.version, info.is_spot,
+                port=info.port)

@@ -1,0 +1,285 @@
+"""Serve-side SQLite state: services + replicas (lives on the serve
+controller cluster's head, like the managed-jobs DB).
+
+Role of reference ``sky/serve/serve_state.py`` (557 LoC): one row per
+service (spec, status, version, LB/controller ports) and one per replica
+(cluster name, status, version). Written by the per-service controller
+process, read by the serve RPC for client queries.
+"""
+from __future__ import annotations
+
+import enum
+import json
+import os
+import sqlite3
+import time
+from typing import Any, Dict, List, Optional
+
+import filelock
+
+
+class ServiceStatus(enum.Enum):
+    """Reference ``sky/serve/serve_state.py`` ServiceStatus."""
+    CONTROLLER_INIT = 'CONTROLLER_INIT'
+    REPLICA_INIT = 'REPLICA_INIT'     # replicas launching, none ready yet
+    READY = 'READY'                   # >=1 ready replica
+    NO_REPLICA = 'NO_REPLICA'         # up, but zero replicas at the moment
+    SHUTTING_DOWN = 'SHUTTING_DOWN'
+    FAILED = 'FAILED'
+    CONTROLLER_FAILED = 'CONTROLLER_FAILED'
+
+    def is_terminal(self) -> bool:
+        return self in (ServiceStatus.FAILED,
+                        ServiceStatus.CONTROLLER_FAILED)
+
+
+class ReplicaStatus(enum.Enum):
+    """Reference ``sky/serve/serve_state.py`` ReplicaStatus lifecycle."""
+    PENDING = 'PENDING'               # scale-up decided, launch not started
+    PROVISIONING = 'PROVISIONING'     # cluster launching
+    STARTING = 'STARTING'             # cluster up, probe not yet passing
+    READY = 'READY'
+    NOT_READY = 'NOT_READY'           # probe failing; grace period
+    SHUTTING_DOWN = 'SHUTTING_DOWN'
+    PREEMPTED = 'PREEMPTED'
+    FAILED = 'FAILED'
+    FAILED_PROBE = 'FAILED_PROBE'     # never became ready in time
+
+    def is_terminal(self) -> bool:
+        return self in (ReplicaStatus.PREEMPTED, ReplicaStatus.FAILED,
+                        ReplicaStatus.FAILED_PROBE)
+
+
+def serve_dir() -> str:
+    d = os.environ.get('SKYTPU_SERVE_DIR',
+                       os.path.expanduser('~/.skytpu_serve'))
+    os.makedirs(d, exist_ok=True)
+    return d
+
+
+def _db_path() -> str:
+    return os.path.join(serve_dir(), 'serve.db')
+
+
+_LOCKS: Dict[str, filelock.FileLock] = {}
+
+
+def db_lock() -> filelock.FileLock:
+    """Per-path singleton: FileLock is only reentrant on the SAME
+    instance, and callers nest (e.g. the up RPC wraps add_service)."""
+    path = os.path.join(serve_dir(), '.serve.lock')
+    if path not in _LOCKS:
+        _LOCKS[path] = filelock.FileLock(path)
+    return _LOCKS[path]
+
+
+def _conn() -> sqlite3.Connection:
+    conn = sqlite3.connect(_db_path(), timeout=10)
+    conn.execute("""\
+        CREATE TABLE IF NOT EXISTS services (
+            name TEXT PRIMARY KEY,
+            status TEXT,
+            version INTEGER DEFAULT 1,
+            task_config TEXT,
+            controller_port INTEGER,
+            lb_port INTEGER,
+            agent_job_id INTEGER,
+            submitted_at REAL,
+            failure_reason TEXT)""")
+    conn.execute("""\
+        CREATE TABLE IF NOT EXISTS replicas (
+            service_name TEXT,
+            replica_id INTEGER,
+            cluster_name TEXT,
+            status TEXT,
+            url TEXT,
+            version INTEGER,
+            is_spot INTEGER DEFAULT 0,
+            launched_at REAL,
+            port INTEGER,
+            PRIMARY KEY (service_name, replica_id))""")
+    conn.commit()
+    return conn
+
+
+# ---------------------------------------------------------------- services
+def allocated_ports() -> set:
+    """Every controller/LB/replica port recorded for any service —
+    allocated even if the owning process hasn't bound it yet (a bind
+    test alone cannot see those)."""
+    conn = _conn()
+    rows = conn.execute(
+        'SELECT controller_port, lb_port FROM services').fetchall()
+    ports = {p for row in rows for p in row if p}
+    rows = conn.execute('SELECT port FROM replicas').fetchall()
+    ports |= {r[0] for r in rows if r[0]}
+    return ports
+
+
+def add_service(name: str, task_config: Dict[str, Any],
+                controller_port: int, lb_port: int,
+                agent_job_id: Optional[int] = None) -> bool:
+    """False if a live service with this name already exists. A row in a
+    terminal state (FAILED/CONTROLLER_FAILED — kept so status can show
+    the failure reason) is replaced, so a fixed task can be re-upped
+    under the same name without a manual `serve down` first."""
+    with db_lock():
+        conn = _conn()
+        row = conn.execute('SELECT status FROM services WHERE name=?',
+                           (name,)).fetchone()
+        if row is not None:
+            if not ServiceStatus(row[0]).is_terminal():
+                return False
+            conn.execute('DELETE FROM services WHERE name=?', (name,))
+            conn.execute('DELETE FROM replicas WHERE service_name=?',
+                         (name,))
+        conn.execute(
+            'INSERT INTO services (name, status, version, task_config, '
+            'controller_port, lb_port, agent_job_id, submitted_at) '
+            'VALUES (?,?,?,?,?,?,?,?)',
+            (name, ServiceStatus.CONTROLLER_INIT.value, 1,
+             json.dumps(task_config), controller_port, lb_port,
+             agent_job_id, time.time()))
+        conn.commit()
+        return True
+
+
+def set_service_status(name: str, status: ServiceStatus,
+                       failure_reason: Optional[str] = None) -> None:
+    with db_lock():
+        conn = _conn()
+        if failure_reason is not None:
+            conn.execute(
+                'UPDATE services SET status=?, failure_reason=? '
+                'WHERE name=?', (status.value, failure_reason, name))
+        else:
+            conn.execute('UPDATE services SET status=? WHERE name=?',
+                         (status.value, name))
+        conn.commit()
+
+
+def set_service_version(name: str, version: int,
+                        task_config: Dict[str, Any]) -> None:
+    with db_lock():
+        conn = _conn()
+        conn.execute(
+            'UPDATE services SET version=?, task_config=? WHERE name=?',
+            (version, json.dumps(task_config), name))
+        conn.commit()
+
+
+def set_service_agent_job(name: str, agent_job_id: int) -> None:
+    with db_lock():
+        conn = _conn()
+        conn.execute('UPDATE services SET agent_job_id=? WHERE name=?',
+                     (agent_job_id, name))
+        conn.commit()
+
+
+def remove_service(name: str) -> None:
+    with db_lock():
+        conn = _conn()
+        conn.execute('DELETE FROM services WHERE name=?', (name,))
+        conn.execute('DELETE FROM replicas WHERE service_name=?', (name,))
+        conn.commit()
+
+
+def get_service(name: str) -> Optional[Dict[str, Any]]:
+    conn = _conn()
+    row = conn.execute(
+        'SELECT name, status, version, task_config, controller_port, '
+        'lb_port, agent_job_id, submitted_at, failure_reason '
+        'FROM services WHERE name=?', (name,)).fetchone()
+    return _service_row(row) if row else None
+
+
+def get_services() -> List[Dict[str, Any]]:
+    conn = _conn()
+    rows = conn.execute(
+        'SELECT name, status, version, task_config, controller_port, '
+        'lb_port, agent_job_id, submitted_at, failure_reason '
+        'FROM services ORDER BY name').fetchall()
+    return [_service_row(r) for r in rows]
+
+
+def _service_row(row) -> Dict[str, Any]:
+    return {
+        'name': row[0],
+        'status': ServiceStatus(row[1]),
+        'version': row[2],
+        'task_config': json.loads(row[3]) if row[3] else None,
+        'controller_port': row[4],
+        'lb_port': row[5],
+        'agent_job_id': row[6],
+        'submitted_at': row[7],
+        'failure_reason': row[8],
+    }
+
+
+# ---------------------------------------------------------------- replicas
+def add_or_update_replica(service_name: str, replica_id: int,
+                          cluster_name: str, status: ReplicaStatus,
+                          url: Optional[str], version: int,
+                          is_spot: bool = False,
+                          port: Optional[int] = None) -> None:
+    with db_lock():
+        conn = _conn()
+        conn.execute(
+            'INSERT INTO replicas (service_name, replica_id, cluster_name, '
+            'status, url, version, is_spot, launched_at, port) '
+            'VALUES (?,?,?,?,?,?,?,?,?) '
+            'ON CONFLICT (service_name, replica_id) DO UPDATE SET '
+            'cluster_name=excluded.cluster_name, status=excluded.status, '
+            'url=excluded.url, version=excluded.version, '
+            'is_spot=excluded.is_spot, port=excluded.port',
+            (service_name, replica_id, cluster_name, status.value, url,
+             version, int(is_spot), time.time(), port))
+        conn.commit()
+
+
+def set_replica_status(service_name: str, replica_id: int,
+                       status: ReplicaStatus) -> None:
+    with db_lock():
+        conn = _conn()
+        conn.execute(
+            'UPDATE replicas SET status=? WHERE service_name=? AND '
+            'replica_id=?', (status.value, service_name, replica_id))
+        conn.commit()
+
+
+def remove_replica(service_name: str, replica_id: int) -> None:
+    with db_lock():
+        conn = _conn()
+        conn.execute(
+            'DELETE FROM replicas WHERE service_name=? AND replica_id=?',
+            (service_name, replica_id))
+        conn.commit()
+
+
+def get_replicas(service_name: str) -> List[Dict[str, Any]]:
+    conn = _conn()
+    rows = conn.execute(
+        'SELECT replica_id, cluster_name, status, url, version, is_spot, '
+        'launched_at FROM replicas WHERE service_name=? ORDER BY replica_id',
+        (service_name,)).fetchall()
+    return [{
+        'replica_id': r[0],
+        'cluster_name': r[1],
+        'status': ReplicaStatus(r[2]),
+        'url': r[3],
+        'version': r[4],
+        'is_spot': bool(r[5]),
+        'launched_at': r[6],
+    } for r in rows]
+
+
+def service_to_json(record: Dict[str, Any]) -> Dict[str, Any]:
+    out = dict(record)
+    out['status'] = record['status'].value
+    return out
+
+
+def replica_to_json(record: Dict[str, Any]) -> Dict[str, Any]:
+    out = dict(record)
+    out['status'] = record['status'].value
+    return out

@@ -1,0 +1,214 @@
+"""Replica-side model server: HTTP front end on the in-tree
+InferenceEngine (the piece the reference delegates to vLLM/JetStream
+recipes — here it ships in-tree, SURVEY §7 step 8).
+
+Endpoints:
+- ``GET /readiness`` — 200 once the engine has compiled its first step
+  (the serve readiness-probe target).
+- ``POST /generate`` — ``{"prompt": [ids...], "max_new_tokens": N,
+  "temperature": t, "top_k": k}`` → ``{"tokens": [...], "ttft_ms": ...}``.
+- ``GET /metrics`` — queue depth / active slots / counters.
+
+One background thread drives ``engine.step()`` continuously (the engine
+core is synchronous); HTTP handler threads enqueue requests and wait on
+per-request events. Run on every replica slice via the service task's
+``run`` command:  ``python -m skypilot_tpu.serve.server --model llama3-1b``.
+"""
+from __future__ import annotations
+
+import argparse
+import http.server
+import json
+import os
+import threading
+from typing import Any, Dict, Optional
+
+from skypilot_tpu import tpu_logging
+
+logger = tpu_logging.init_logger(__name__)
+
+
+class ModelServer:
+
+    def __init__(self, cfg_name: str = 'tiny', *, max_batch: int = 8,
+                 max_seq: int = 1024, port: int = 8081):
+        self.cfg_name = cfg_name
+        self.max_batch = max_batch
+        self.max_seq = max_seq
+        self.port = port
+        self.engine = None            # set once loaded
+        self._error: Optional[str] = None   # fatal engine failure
+        self._ready = threading.Event()
+        self._work = threading.Event()
+        self._lock = threading.Lock()  # engine mutation
+        self._finished_events: Dict[int, threading.Event] = {}
+        self._requests_served = 0
+        self._httpd: Optional[http.server.ThreadingHTTPServer] = None
+
+    # ------------------------------------------------------------- engine
+    def _load_engine(self) -> None:
+        from skypilot_tpu.inference.engine import InferenceEngine
+        from skypilot_tpu.models import configs
+        cfg = configs.get_config(self.cfg_name)
+        engine = InferenceEngine(cfg, max_batch=self.max_batch,
+                                 max_seq=self.max_seq)
+        # Warmup: compile prefill+decode before declaring readiness.
+        engine.add_request([1, 2, 3], max_new_tokens=2)
+        engine.run_to_completion(horizon=4)
+        self.engine = engine
+        self._ready.set()
+        logger.info(f'Engine ready: model={self.cfg_name} '
+                    f'max_batch={self.max_batch} max_seq={self.max_seq}')
+
+    def _engine_loop(self) -> None:
+        try:
+            self._load_engine()
+        except Exception as e:  # pylint: disable=broad-except
+            self._fatal(e)
+            return
+        while True:
+            try:
+                self._work.wait()
+                with self._lock:
+                    has_work = self.engine.has_work()
+                    if has_work:
+                        events = self.engine.step(horizon=8)
+                    else:
+                        self._work.clear()
+                        events = []
+                for rid, _, finished in events:
+                    if finished and rid in self._finished_events:
+                        self._finished_events[rid].set()
+            except Exception as e:  # pylint: disable=broad-except
+                self._fatal(e)
+                return
+
+    def _fatal(self, e: Exception) -> None:
+        """Engine died: drop readiness (the serve probe then pulls this
+        replica out of rotation) and wake every waiting request so handler
+        threads return errors instead of blocking forever."""
+        logger.exception(f'Engine loop died: {type(e).__name__}: {e}')
+        self._error = f'{type(e).__name__}: {e}'
+        self._ready.clear()
+        with self._lock:
+            for ev in self._finished_events.values():
+                ev.set()
+
+    def submit(self, prompt, max_new_tokens: int, temperature: float,
+               top_k: int, eos_id: Optional[int]) -> Dict[str, Any]:
+        if self._error is not None:
+            raise RuntimeError(f'engine failed: {self._error}')
+        done = threading.Event()
+        with self._lock:
+            rid = self.engine.add_request(
+                prompt, max_new_tokens=max_new_tokens,
+                temperature=temperature, top_k=top_k, eos_id=eos_id)
+            self._finished_events[rid] = done
+        self._work.set()
+        done.wait()
+        if self._error is not None:   # woken by _fatal, not completion
+            raise RuntimeError(f'engine failed: {self._error}')
+        with self._lock:
+            req = self.engine.get_finished(rid)
+            del self._finished_events[rid]
+            self._requests_served += 1
+        return {
+            'request_id': rid,
+            'tokens': req.output,
+            'ttft_ms': req.ttft_ms,
+        }
+
+    # --------------------------------------------------------------- HTTP
+    def _make_handler(server):  # noqa: N805
+        class Handler(http.server.BaseHTTPRequestHandler):
+
+            def log_message(self, *args):
+                del args
+
+            def _json(self, code: int, payload: Dict[str, Any]) -> None:
+                body = json.dumps(payload).encode()
+                self.send_response(code)
+                self.send_header('Content-Type', 'application/json')
+                self.send_header('Content-Length', str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):  # noqa: N802
+                if self.path == '/readiness':
+                    if server._error is not None:
+                        self._json(503, {'status': 'failed',
+                                         'error': server._error})
+                    elif server._ready.is_set():
+                        self._json(200, {'status': 'ready',
+                                         'model': server.cfg_name})
+                    else:
+                        self._json(503, {'status': 'loading'})
+                elif self.path == '/metrics':
+                    eng = server.engine
+                    self._json(200, {
+                        'requests_served': server._requests_served,
+                        'active_slots': eng.num_active if eng else 0,
+                        'max_batch': server.max_batch,
+                    })
+                else:
+                    self._json(404, {'error': f'no route {self.path}'})
+
+            def do_POST(self):  # noqa: N802
+                if self.path != '/generate':
+                    self._json(404, {'error': f'no route {self.path}'})
+                    return
+                if not server._ready.is_set():
+                    self._json(503, {'status': 'loading'})
+                    return
+                length = int(self.headers.get('Content-Length', 0))
+                try:
+                    payload = json.loads(self.rfile.read(length))
+                    prompt = payload['prompt']
+                    result = server.submit(
+                        prompt,
+                        max_new_tokens=int(
+                            payload.get('max_new_tokens', 128)),
+                        temperature=float(payload.get('temperature', 0.0)),
+                        top_k=int(payload.get('top_k', 0)),
+                        eos_id=payload.get('eos_id'))
+                    self._json(200, result)
+                except (KeyError, ValueError, json.JSONDecodeError) as e:
+                    self._json(400, {'error': f'{type(e).__name__}: {e}'})
+                except RuntimeError as e:
+                    self._json(500, {'error': str(e)})
+
+        return Handler
+
+    def start(self, block: bool = True) -> None:
+        threading.Thread(target=self._engine_loop, daemon=True).start()
+        handler = self._make_handler()
+        self._httpd = http.server.ThreadingHTTPServer(('0.0.0.0', self.port),
+                                                      handler)
+        logger.info(f'Model server listening on :{self.port}')
+        if block:
+            self._httpd.serve_forever()
+        else:
+            threading.Thread(target=self._httpd.serve_forever,
+                             daemon=True).start()
+
+    def stop(self) -> None:
+        if self._httpd is not None:
+            self._httpd.shutdown()
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser()
+    parser.add_argument('--model', default='tiny')
+    parser.add_argument('--max-batch', type=int, default=8)
+    parser.add_argument('--max-seq', type=int, default=1024)
+    parser.add_argument('--port', type=int,
+                        default=int(os.environ.get('SKYTPU_REPLICA_PORT',
+                                                   '8081')))
+    args = parser.parse_args()
+    server = ModelServer(args.model, max_batch=args.max_batch,
+                         max_seq=args.max_seq, port=args.port)
+    server.start(block=True)
+
+
+if __name__ == '__main__':
+    main()

@@ -1,0 +1,68 @@
+"""Per-service process: runs controller + load balancer for one service.
+
+Role of reference ``sky/serve/service.py`` (``_start`` ``:133`` forks
+``run_controller`` + ``run_load_balancer``): submitted as an ordinary
+agent job named ``service-<name>`` on the serve-controller cluster, so it
+gets logs/liveness from the agent runtime for free (SURVEY key idea #2).
+"""
+from __future__ import annotations
+
+import argparse
+import sys
+import time
+import traceback
+
+from skypilot_tpu import tpu_logging
+from skypilot_tpu.serve import controller as controller_lib
+from skypilot_tpu.serve import load_balancer as lb_lib
+from skypilot_tpu.serve import serve_state
+from skypilot_tpu.serve.service_spec import SkyServiceSpec
+
+logger = tpu_logging.init_logger(__name__)
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser()
+    parser.add_argument('--service-name', required=True)
+    args = parser.parse_args()
+
+    record = serve_state.get_service(args.service_name)
+    if record is None:
+        print(f'service {args.service_name} not in serve state db',
+              file=sys.stderr)
+        sys.exit(1)
+    task_config = record['task_config']
+    spec = SkyServiceSpec.from_yaml_config(task_config['service'])
+
+    try:
+        # LB binds first: replica ports are allocated by the controller
+        # loop, which must see the controller+LB ports as taken.
+        lb = lb_lib.SkyServeLoadBalancer(
+            controller_url=f'http://127.0.0.1:{record["controller_port"]}',
+            port=record['lb_port'],
+            policy_name=spec.load_balancing_policy)
+        lb.start()
+        controller = controller_lib.ServeController(
+            args.service_name, spec, task_config,
+            port=record['controller_port'],
+            reserved_ports={record['controller_port'], record['lb_port']})
+        controller.start()
+        serve_state.set_service_status(
+            args.service_name, serve_state.ServiceStatus.NO_REPLICA)
+    except Exception:  # pylint: disable=broad-except
+        serve_state.set_service_status(
+            args.service_name, serve_state.ServiceStatus.CONTROLLER_FAILED,
+            failure_reason=traceback.format_exc())
+        raise
+
+    try:
+        controller.wait()
+    finally:
+        lb.stop()
+    # terminate() removed the service row; exiting 0 lets the agent mark
+    # the service job SUCCEEDED.
+    logger.info(f'Service {args.service_name} terminated.')
+
+
+if __name__ == '__main__':
+    main()

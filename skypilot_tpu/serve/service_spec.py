@@ -1,0 +1,126 @@
+"""Service spec: the ``service:`` section of a task YAML.
+
+Role of reference ``SkyServiceSpec`` (``sky/serve/service_spec.py:18``):
+readiness probe + replica policy (fixed count or QPS autoscaling with
+optional spot/on-demand mix). TPU-first notes: replicas are whole TPU
+slices, so scaling granularity is a slice; the replica port is where the
+in-tree model server (``skypilot_tpu.serve.server``) listens.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Optional
+
+from skypilot_tpu import exceptions
+from skypilot_tpu.utils import schemas
+
+
+@dataclasses.dataclass
+class SkyServiceSpec:
+    """Validated service section."""
+    readiness_path: str
+    initial_delay_seconds: float = 60.0
+    readiness_timeout_seconds: float = 15.0
+    post_data: Optional[Any] = None
+    min_replicas: int = 1
+    max_replicas: Optional[int] = None      # None => fixed at min_replicas
+    target_qps_per_replica: Optional[float] = None
+    upscale_delay_seconds: float = 300.0
+    downscale_delay_seconds: float = 1200.0
+    base_ondemand_fallback_replicas: int = 0
+    dynamic_ondemand_fallback: bool = False
+    replica_port: int = 8081
+    load_balancing_policy: str = 'round_robin'
+
+    def __post_init__(self):
+        if not self.readiness_path.startswith('/'):
+            raise exceptions.InvalidServiceSpecError(
+                f'readiness path must start with "/": {self.readiness_path}')
+        if self.max_replicas is not None and \
+                self.max_replicas < self.min_replicas:
+            raise exceptions.InvalidServiceSpecError(
+                f'max_replicas ({self.max_replicas}) < min_replicas '
+                f'({self.min_replicas})')
+        if self.autoscaling_enabled and self.target_qps_per_replica is None:
+            raise exceptions.InvalidServiceSpecError(
+                'replica_policy with max_replicas > min_replicas requires '
+                'target_qps_per_replica')
+        if self.target_qps_per_replica is not None and \
+                self.target_qps_per_replica <= 0:
+            raise exceptions.InvalidServiceSpecError(
+                'target_qps_per_replica must be positive')
+
+    @property
+    def autoscaling_enabled(self) -> bool:
+        return (self.max_replicas is not None
+                and self.max_replicas > self.min_replicas)
+
+    @classmethod
+    def from_yaml_config(cls, config: Dict[str, Any]) -> 'SkyServiceSpec':
+        schemas.validate(config, schemas.SERVICE_SCHEMA, 'service')
+        probe = config['readiness_probe']
+        if isinstance(probe, str):
+            probe = {'path': probe}
+        policy = config.get('replica_policy')
+        fields: Dict[str, Any] = {
+            'readiness_path': probe.get('path', '/'),
+            'initial_delay_seconds': float(
+                probe.get('initial_delay_seconds', 60.0)),
+            'readiness_timeout_seconds': float(
+                probe.get('timeout_seconds', 15.0)),
+            'post_data': probe.get('post_data'),
+            'replica_port': int(config.get('port', 8081)),
+            'load_balancing_policy': config.get('load_balancing_policy',
+                                                'round_robin'),
+        }
+        if policy is not None and 'replicas' in config:
+            raise exceptions.InvalidServiceSpecError(
+                'Give either replicas (fixed) or replica_policy, not both.')
+        if policy is not None:
+            fields.update(
+                min_replicas=int(policy.get('min_replicas', 1)),
+                max_replicas=(int(policy['max_replicas'])
+                              if 'max_replicas' in policy else None),
+                target_qps_per_replica=policy.get('target_qps_per_replica'),
+                upscale_delay_seconds=float(
+                    policy.get('upscale_delay_seconds', 300.0)),
+                downscale_delay_seconds=float(
+                    policy.get('downscale_delay_seconds', 1200.0)),
+                base_ondemand_fallback_replicas=int(
+                    policy.get('base_ondemand_fallback_replicas', 0)),
+                dynamic_ondemand_fallback=bool(
+                    policy.get('dynamic_ondemand_fallback', False)),
+            )
+        else:
+            fields['min_replicas'] = int(config.get('replicas', 1))
+        return cls(**fields)
+
+    def to_yaml_config(self) -> Dict[str, Any]:
+        probe: Dict[str, Any] = {
+            'path': self.readiness_path,
+            'initial_delay_seconds': self.initial_delay_seconds,
+            'timeout_seconds': self.readiness_timeout_seconds,
+        }
+        if self.post_data is not None:
+            probe['post_data'] = self.post_data
+        cfg: Dict[str, Any] = {
+            'readiness_probe': probe,
+            'port': self.replica_port,
+            'load_balancing_policy': self.load_balancing_policy,
+        }
+        if self.autoscaling_enabled or self.target_qps_per_replica:
+            cfg['replica_policy'] = {
+                'min_replicas': self.min_replicas,
+                'max_replicas': (self.max_replicas
+                                 if self.max_replicas is not None
+                                 else self.min_replicas),
+                'target_qps_per_replica': self.target_qps_per_replica,
+                'upscale_delay_seconds': self.upscale_delay_seconds,
+                'downscale_delay_seconds': self.downscale_delay_seconds,
+                'base_ondemand_fallback_replicas':
+                    self.base_ondemand_fallback_replicas,
+                'dynamic_ondemand_fallback': self.dynamic_ondemand_fallback,
+            }
+        else:
+            cfg['replicas'] = self.min_replicas
+        return cfg

@@ -93,9 +93,15 @@ def load_json(s: Optional[str]) -> Any:
     return json.loads(s)
 
 
-def find_free_port(start: int = 10000) -> int:
-    """Find a free TCP port on localhost (local provisioner, serve LB)."""
+def find_free_port(start: int = 10000, exclude=()) -> int:
+    """Find a free TCP port on localhost (local provisioner, serve LB).
+
+    ``exclude``: ports already allocated but possibly not yet bound
+    (e.g. recorded in a state DB for a process that starts later) —
+    a bind test alone cannot see those."""
     for port in range(start, start + 2000):
+        if port in exclude:
+            continue
         with socket.socket(socket.AF_INET, socket.SOCK_STREAM) as s:
             try:
                 s.bind(('127.0.0.1', port))

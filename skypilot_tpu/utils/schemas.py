@@ -105,6 +105,11 @@ SERVICE_SCHEMA: Dict[str, Any] = {
             },
         },
         'replicas': {'type': 'integer', 'minimum': 0},
+        'port': {'type': 'integer', 'minimum': 1, 'maximum': 65535},
+        'load_balancing_policy': {
+            'type': 'string',
+            'enum': ['round_robin', 'least_load'],
+        },
     },
 }
 

@@ -9,6 +9,12 @@ not enough — we also override via jax.config before any backend initializes.
 import os
 
 os.environ['JAX_PLATFORMS'] = 'cpu'
+# The kernel env's sitecustomize imports jax + registers the axon TPU
+# backend in EVERY python process when this var is set (~5s/process).
+# Tests run on the virtual CPU mesh; dropping it here keeps the test
+# process AND every subprocess it spawns (agentd, RPCs, job drivers) on
+# the fast path.
+os.environ.pop('PALLAS_AXON_POOL_IPS', None)
 prev = os.environ.get('XLA_FLAGS', '')
 if 'xla_force_host_platform_device_count' not in prev:
     os.environ['XLA_FLAGS'] = (

@@ -1,0 +1,181 @@
+"""SkyServe end-to-end on the local provisioner: controller-on-a-cluster,
+replicas-as-clusters behind the LB, readiness gating, autoscaler
+replacement of preempted replicas, teardown.
+
+Hermetic version of the reference's ``tests/smoke_tests/test_sky_serve.py``
+(which launches real clouds); replica preemption is forced by terminating
+the replica's local cluster out-of-band, as the reference's smoke tests do
+with cloud CLIs.
+"""
+import json
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+import skypilot_tpu as sky
+from skypilot_tpu import global_state
+from skypilot_tpu import serve
+from skypilot_tpu.task import Task
+
+pytestmark = pytest.mark.usefixtures('tmp_state_dir', 'fast_serve')
+
+
+@pytest.fixture()
+def fast_serve(monkeypatch):
+    monkeypatch.setenv('SKYTPU_AGENT_TICK', '0.1')
+    monkeypatch.setenv('SKYTPU_AGENT_READY_TIMEOUT', '30')
+    monkeypatch.setenv('SKYTPU_SERVE_TICK', '0.5')
+    monkeypatch.setenv('SKYTPU_LB_SYNC', '0.5')
+
+
+# A replica server that answers the readiness probe and echoes its
+# replica id — enough to verify LB fan-out without loading a model.
+_REPLICA_SERVER = r'''
+import http.server, json, os
+
+class H(http.server.BaseHTTPRequestHandler):
+    def log_message(self, *a):
+        pass
+    def _send(self):
+        body = json.dumps(
+            {"replica": os.environ.get("SKYTPU_SERVE_REPLICA_ID")}).encode()
+        self.send_response(200)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+    do_GET = do_POST = lambda self: self._send()
+
+port = int(os.environ["SKYTPU_REPLICA_PORT"])
+http.server.ThreadingHTTPServer(("127.0.0.1", port), H).serve_forever()
+'''
+
+
+def _service_task(tmp_path, n_replicas=2, policy=None) -> Task:
+    script = tmp_path / 'replica_server.py'
+    script.write_text(_REPLICA_SERVER)
+    service = {
+        'readiness_probe': {'path': '/readiness',
+                            'initial_delay_seconds': 20},
+    }
+    if policy is not None:
+        service['replica_policy'] = policy
+    else:
+        service['replicas'] = n_replicas
+    task = Task(name='echo', run=f'python {script}')
+    task.service = service
+    task.set_resources(sky.Resources(cloud='local', cpus='1+'))
+    return task
+
+
+def _wait_ready(name: str, n_ready: int = 1, timeout: float = 60.0):
+    deadline = time.time() + timeout
+    last = None
+    while time.time() < deadline:
+        try:
+            svcs = serve.status([name])
+        except Exception:
+            svcs = []
+        if svcs:
+            last = svcs[0]
+            ready = [r for r in last['replicas'] if r['status'] == 'READY']
+            if last['status'] == 'READY' and len(ready) >= n_ready:
+                return last
+        time.sleep(0.3)
+    raise AssertionError(f'service never became READY: {last}')
+
+
+def _get(url: str, timeout: float = 10.0) -> dict:
+    with urllib.request.urlopen(url, timeout=timeout) as r:
+        return json.loads(r.read())
+
+
+def _down_all():
+    try:
+        for svc in serve.status():
+            try:
+                serve.down(svc['name'])
+            except Exception:
+                pass
+    except Exception:
+        pass
+    from skypilot_tpu import core
+    try:
+        core.down(serve.core.CONTROLLER_CLUSTER_NAME)
+    except Exception:
+        pass
+
+
+def test_serve_up_two_replicas_lb_and_down(tmp_path):
+    task = _service_task(tmp_path, n_replicas=2)
+    try:
+        result = serve.up(task, service_name='echo')
+        assert result['name'] == 'echo'
+        svc = _wait_ready('echo', n_ready=2)
+        assert len(svc['replicas']) == 2
+
+        # LB proxies to both replicas (round robin).
+        seen = set()
+        for _ in range(6):
+            seen.add(_get(result['endpoint'] + '/hello')['replica'])
+        assert seen == {'1', '2'}
+
+        # Replica clusters exist as ordinary clusters.
+        assert global_state.get_cluster_from_name(
+            'echo-replica-1') is not None
+
+        serve.down('echo')
+        deadline = time.time() + 30
+        while time.time() < deadline:
+            if not serve.status(['echo']):
+                break
+            time.sleep(0.3)
+        assert serve.status(['echo']) == []
+        # Replica clusters are gone.
+        deadline = time.time() + 15
+        while time.time() < deadline:
+            if global_state.get_cluster_from_name(
+                    'echo-replica-1') is None:
+                break
+            time.sleep(0.3)
+        assert global_state.get_cluster_from_name('echo-replica-1') is None
+    finally:
+        _down_all()
+
+
+def test_serve_recovers_preempted_replica(tmp_path):
+    task = _service_task(tmp_path, n_replicas=1)
+    try:
+        serve.up(task, service_name='rec')
+        _wait_ready('rec', n_ready=1)
+
+        # Preempt: terminate the replica cluster out-of-band.
+        from skypilot_tpu import core
+        core.down('rec-replica-1')
+
+        # Controller must notice and launch a replacement replica.
+        deadline = time.time() + 60
+        replacement = None
+        while time.time() < deadline:
+            svcs = serve.status(['rec'])
+            if svcs:
+                ready = [r for r in svcs[0]['replicas']
+                         if r['status'] == 'READY'
+                         and r['replica_id'] != 1]
+                if ready:
+                    replacement = ready[0]
+                    break
+            time.sleep(0.3)
+        assert replacement is not None, 'no replacement replica appeared'
+        assert replacement['replica_id'] == 2
+    finally:
+        _down_all()
+
+
+def test_serve_rejects_task_without_service():
+    task = Task(name='nosvc', run='true')
+    task.set_resources(sky.Resources(cloud='local', cpus='1+'))
+    from skypilot_tpu import exceptions
+    with pytest.raises(exceptions.InvalidServiceSpecError):
+        serve.up(task, service_name='nosvc')

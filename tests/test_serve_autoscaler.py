@@ -1,0 +1,279 @@
+"""Autoscaler + LB-policy unit tests: synthetic request timestamps and
+replica views in, scaling decisions out (reference pattern:
+``tests/test_serve_autoscaler.py``). No clusters, no clock sleeps."""
+import pytest
+
+from skypilot_tpu.serve import autoscalers
+from skypilot_tpu.serve import load_balancing_policies as lb_policies
+from skypilot_tpu.serve.autoscalers import DecisionOperator, ReplicaView
+from skypilot_tpu.serve.service_spec import SkyServiceSpec
+
+
+def _spec(**kw):
+    defaults = dict(readiness_path='/readiness', min_replicas=1,
+                    max_replicas=4, target_qps_per_replica=1.0,
+                    upscale_delay_seconds=20.0,
+                    downscale_delay_seconds=40.0)
+    defaults.update(kw)
+    return SkyServiceSpec(**defaults)
+
+
+def _views(n_ready, n_starting=0, spot=False, start_id=1):
+    views = []
+    rid = start_id
+    for _ in range(n_ready):
+        views.append(ReplicaView(rid, True, spot))
+        rid += 1
+    for _ in range(n_starting):
+        views.append(ReplicaView(rid, False, spot))
+        rid += 1
+    return views
+
+
+def _mk(spec):
+    return autoscalers.Autoscaler.from_spec(spec)
+
+
+class TestFixedAutoscaler:
+
+    def test_fixed_replicas_launches_min(self):
+        spec = SkyServiceSpec(readiness_path='/x', min_replicas=3)
+        asc = autoscalers.Autoscaler.from_spec(spec)
+        assert type(asc) is autoscalers.Autoscaler
+        decisions = asc.evaluate_scaling([])
+        assert len(decisions) == 3
+        assert all(d.operator == DecisionOperator.SCALE_UP
+                   for d in decisions)
+
+    def test_replaces_terminal_replicas(self):
+        spec = SkyServiceSpec(readiness_path='/x', min_replicas=2)
+        asc = autoscalers.Autoscaler.from_spec(spec)
+        views = [ReplicaView(1, True, False),
+                 ReplicaView(2, False, False, is_terminal=True)]
+        decisions = asc.evaluate_scaling(views)
+        assert len(decisions) == 1
+        assert decisions[0].operator == DecisionOperator.SCALE_UP
+
+
+class TestRequestRateAutoscaler:
+
+    def test_upscale_needs_sustained_load(self):
+        asc = _mk(_spec())
+        # ~3 QPS over the window → raw target 3, but only after the
+        # breach persists for upscale_delay_seconds (20s) of wall clock.
+        now = 1000.0
+        asc.collect_request_information(
+            [now - i * 0.3 for i in range(180)])
+        assert asc.evaluate_scaling(_views(1), now=now) == []  # breach t0
+        decisions = asc.evaluate_scaling(_views(1), now=now + 20.0)
+        assert len(decisions) == 2          # target moved to 3, have 1
+
+    def test_upscale_hysteresis_blocks_single_spike(self):
+        spec = _spec(upscale_delay_seconds=60.0)
+        asc = _mk(spec)
+        asc._raw_target = lambda now: 3     # sustained high demand
+        assert asc.evaluate_scaling(_views(1), now=1000.0) == []
+        assert asc.evaluate_scaling(_views(1), now=1030.0) == []
+        # Breach has now persisted 60s: scale.
+        decisions = asc.evaluate_scaling(_views(1), now=1060.0)
+        assert len(decisions) == 2
+
+    def test_upscale_hysteresis_resets_when_breach_clears(self):
+        spec = _spec(upscale_delay_seconds=60.0)
+        asc = _mk(spec)
+        asc._raw_target = lambda now: 3
+        assert asc.evaluate_scaling(_views(1), now=1000.0) == []
+        asc._raw_target = lambda now: 1     # spike ended
+        assert asc.evaluate_scaling(_views(1), now=1030.0) == []
+        asc._raw_target = lambda now: 3     # new spike: clock restarts
+        assert asc.evaluate_scaling(_views(1), now=1060.0) == []
+        assert asc.evaluate_scaling(_views(1), now=1090.0) == []
+        assert len(asc.evaluate_scaling(_views(1), now=1120.0)) == 2
+
+    def test_downscale_slower_than_upscale(self):
+        spec = _spec(upscale_delay_seconds=20.0,
+                     downscale_delay_seconds=40.0)
+        asc = _mk(spec)
+        asc._raw_target = lambda now: 3
+        asc.evaluate_scaling(_views(3), now=1000.0)
+        asc.evaluate_scaling(_views(3), now=1020.0)
+        assert asc.target_num_replicas == 3
+        # Traffic stops: raw target drops to 1, but only after 40s.
+        asc._raw_target = lambda now: 1
+        assert asc.evaluate_scaling(_views(3), now=1100.0) == []
+        assert asc.evaluate_scaling(_views(3), now=1120.0) == []  # 20s < 40
+        decisions = asc.evaluate_scaling(_views(3), now=1140.0)
+        assert len(decisions) == 2
+        assert all(d.operator == DecisionOperator.SCALE_DOWN
+                   for d in decisions)
+        # Newest replicas are the downscale victims.
+        assert sorted(d.target['replica_id'] for d in decisions) == [2, 3]
+
+    def test_bounded_by_max_replicas(self):
+        asc = _mk(_spec(max_replicas=2))
+        now = 1000.0
+        asc.collect_request_information([now - i * 0.05 for i in range(
+            1000)])                                   # ~17 qps
+        assert asc.evaluate_scaling(_views(1), now=now) == []   # breach t0
+        decisions = asc.evaluate_scaling(_views(1), now=now + 20.0)
+        assert len(decisions) == 1                    # capped at 2 total
+
+    def test_window_expires_old_requests(self):
+        asc = _mk(_spec())
+        now = 1000.0
+        asc.collect_request_information(
+            [now - 120 - i for i in range(300)])      # all outside window
+        assert asc.current_qps(now=now) == 0.0
+
+    def test_qps_zero_scales_to_min(self):
+        asc = _mk(_spec(min_replicas=1, max_replicas=4,
+                        downscale_delay_seconds=20.0))
+        asc.target_num_replicas = 4
+        assert asc.evaluate_scaling(_views(4), now=1000.0) == []
+        decisions = asc.evaluate_scaling(_views(4), now=1020.0)
+        assert len(decisions) == 3
+        assert {d.operator for d in decisions} == \
+            {DecisionOperator.SCALE_DOWN}
+
+    def test_update_spec_rebounds_target(self):
+        asc = _mk(_spec(min_replicas=1, max_replicas=4))
+        asc.target_num_replicas = 4
+        asc.update_spec(_spec(min_replicas=1, max_replicas=2), version=2)
+        assert asc.target_num_replicas == 2
+        assert asc.latest_version == 2
+
+
+class TestFallbackAutoscaler:
+
+    def test_base_ondemand_plus_spot(self):
+        spec = _spec(min_replicas=3, max_replicas=6,
+                     base_ondemand_fallback_replicas=1)
+        asc = _mk(spec)
+        assert isinstance(asc, autoscalers.FallbackRequestRateAutoscaler)
+        decisions = asc.evaluate_scaling([], now=1000.0)
+        ups = [d.target['use_spot'] for d in decisions
+               if d.operator == DecisionOperator.SCALE_UP]
+        assert sorted(ups) == [False, True, True]
+
+    def test_preempted_spot_replaced_by_spot(self):
+        spec = _spec(min_replicas=2, max_replicas=4,
+                     base_ondemand_fallback_replicas=1)
+        asc = _mk(spec)
+        views = [ReplicaView(1, True, False),
+                 ReplicaView(2, False, True, is_terminal=True)]  # preempted
+        decisions = asc.evaluate_scaling(views, now=1000.0)
+        assert len(decisions) == 1
+        assert decisions[0].target['use_spot'] is True
+
+    def test_dynamic_fallback_backfills_preempted_spot_with_ondemand(self):
+        spec = _spec(min_replicas=2, max_replicas=4,
+                     dynamic_ondemand_fallback=True)
+        asc = _mk(spec)
+        # Both spot replicas preempted → relaunch spot AND backfill
+        # on-demand so the service keeps serving during the spot drought.
+        views = [ReplicaView(1, False, True, is_terminal=True),
+                 ReplicaView(2, False, True, is_terminal=True)]
+        decisions = asc.evaluate_scaling(views, now=1000.0)
+        ups = sorted(d.target['use_spot'] for d in decisions
+                     if d.operator == DecisionOperator.SCALE_UP)
+        assert ups == [False, False, True, True]
+
+    def test_dynamic_fallback_drains_ondemand_when_spot_ready(self):
+        spec = _spec(min_replicas=2, max_replicas=4,
+                     dynamic_ondemand_fallback=True,
+                     downscale_delay_seconds=20.0)
+        asc = _mk(spec)
+        # Spot recovered (2 ready); the 2 backfill on-demand replicas
+        # are now excess and must drain.
+        views = [ReplicaView(1, True, True), ReplicaView(2, True, True),
+                 ReplicaView(3, True, False), ReplicaView(4, True, False)]
+        decisions = asc.evaluate_scaling(views, now=1000.0)
+        downs = [d for d in decisions
+                 if d.operator == DecisionOperator.SCALE_DOWN]
+        assert {d.target['replica_id'] for d in downs} == {3, 4}
+        assert not [d for d in decisions
+                    if d.operator == DecisionOperator.SCALE_UP]
+
+    def test_excess_spot_downscaled_keeps_ondemand_base(self):
+        spec = _spec(min_replicas=1, max_replicas=4,
+                     base_ondemand_fallback_replicas=1,
+                     downscale_delay_seconds=20.0)
+        asc = _mk(spec)
+        views = [ReplicaView(1, True, False), ReplicaView(2, True, True),
+                 ReplicaView(3, True, True)]
+        decisions = asc.evaluate_scaling(views, now=1000.0)
+        downs = [d for d in decisions
+                 if d.operator == DecisionOperator.SCALE_DOWN]
+        assert {d.target['replica_id'] for d in downs} == {2, 3}
+
+
+class TestLoadBalancingPolicies:
+
+    def test_round_robin_cycles(self):
+        p = lb_policies.make_policy('round_robin')
+        p.set_ready_replicas(['a', 'b', 'c'])
+        assert [p.select_replica() for _ in range(4)] == \
+            ['a', 'b', 'c', 'a']
+
+    def test_round_robin_empty(self):
+        p = lb_policies.make_policy('round_robin')
+        assert p.select_replica() is None
+
+    def test_least_load_prefers_idle(self):
+        p = lb_policies.make_policy('least_load')
+        p.set_ready_replicas(['a', 'b'])
+        p.pre_execute('a')
+        assert p.select_replica() == 'b'
+        p.pre_execute('b')
+        p.pre_execute('b')
+        assert p.select_replica() == 'a'
+        p.post_execute('b')
+        p.post_execute('b')
+        p.post_execute('a')
+        assert p.select_replica() in ('a', 'b')
+
+    def test_unknown_policy_raises(self):
+        with pytest.raises(ValueError):
+            lb_policies.make_policy('bogus')
+
+
+class TestServiceSpec:
+
+    def test_from_yaml_minimal(self):
+        spec = SkyServiceSpec.from_yaml_config(
+            {'readiness_probe': '/health', 'replicas': 2})
+        assert spec.readiness_path == '/health'
+        assert spec.min_replicas == 2
+        assert not spec.autoscaling_enabled
+
+    def test_from_yaml_policy_roundtrip(self):
+        cfg = {
+            'readiness_probe': {'path': '/readiness',
+                                'initial_delay_seconds': 10},
+            'replica_policy': {'min_replicas': 1, 'max_replicas': 3,
+                               'target_qps_per_replica': 2.5},
+            'port': 9000,
+            'load_balancing_policy': 'least_load',
+        }
+        spec = SkyServiceSpec.from_yaml_config(cfg)
+        assert spec.autoscaling_enabled
+        assert spec.replica_port == 9000
+        spec2 = SkyServiceSpec.from_yaml_config(spec.to_yaml_config())
+        assert spec2 == spec
+
+    def test_autoscaling_requires_qps_target(self):
+        from skypilot_tpu import exceptions
+        with pytest.raises(exceptions.InvalidServiceSpecError):
+            SkyServiceSpec.from_yaml_config({
+                'readiness_probe': '/x',
+                'replica_policy': {'min_replicas': 1, 'max_replicas': 3},
+            })
+
+    def test_replicas_and_policy_conflict(self):
+        from skypilot_tpu import exceptions
+        with pytest.raises(exceptions.InvalidServiceSpecError):
+            SkyServiceSpec.from_yaml_config({
+                'readiness_probe': '/x',
+                'replicas': 2,
+                'replica_policy': {'min_replicas': 1},
+            })
