@@ -117,38 +117,39 @@ class InferenceEngine:
     # Compiled steps
     # ------------------------------------------------------------------
     def _build_decode(self):
-        """Multi-step decode: ``horizon`` steps fused into one on-device
-        lax.scan per host sync. Decode through the PJRT tunnel costs ~90ms
-        per host round trip; fusing N steps amortizes it to ~nothing and is
-        the same trick a production engine uses to hide dispatch latency."""
-        cfg, attn_impl = self.cfg, self.attn_impl
+        """Multi-step decode: ``horizon`` steps fused into one program per
+        host sync (llama.decode_horizon's ring-buffer loop). Decode through
+        the PJRT tunnel costs ~100ms per host round trip; fusing N steps
+        amortizes it, the same trick a production engine uses to hide
+        dispatch latency. ``sample`` is STATIC: the all-greedy program
+        skips the top-k/temperature machinery entirely (a full-vocab sort
+        per step otherwise)."""
+        cfg = self.cfg
 
         @functools.partial(jax.jit, donate_argnums=(1,),
-                           static_argnames=('horizon',))
+                           static_argnames=('horizon', 'sample'))
         def decode_steps(params, cache, tokens, rng, temps, topks, active,
-                         horizon):
-            def one_step(carry, step_rng):
-                cache, tokens = carry
-                logits, new_cache = llama.forward(
-                    params, tokens[:, None], cfg, cache=cache,
-                    attn_impl=attn_impl)
-                logits = logits[:, 0]                 # [slots, vocab]
-                next_greedy = jnp.argmax(logits, -1).astype(jnp.int32)
-                scaled = logits / jnp.maximum(temps, 1e-6)[:, None]
-                thr = _topk_threshold(scaled, topks)
-                masked = jnp.where(scaled >= thr, scaled, -jnp.inf)
-                sampled = jax.random.categorical(
-                    step_rng, masked).astype(jnp.int32)
-                nxt = jnp.where(temps > 0, sampled, next_greedy)
-                return (new_cache, nxt), nxt
-
-            rngs = jax.random.split(rng, horizon)
-            (cache, _), toks = jax.lax.scan(one_step, (cache, tokens), rngs)
+                         horizon, sample):
+            if sample:
+                def sample_fn(logits, step_rng):
+                    next_greedy = jnp.argmax(logits, -1).astype(jnp.int32)
+                    scaled = logits / jnp.maximum(temps, 1e-6)[:, None]
+                    thr = _topk_threshold(scaled, topks)
+                    masked = jnp.where(scaled >= thr, scaled, -jnp.inf)
+                    sampled = jax.random.categorical(
+                        step_rng, masked).astype(jnp.int32)
+                    return jnp.where(temps > 0, sampled, next_greedy)
+                rngs = jax.random.split(rng, horizon)
+            else:
+                sample_fn, rngs = None, None
+            toks, cache = llama.decode_horizon(
+                params, cache, tokens, cfg, horizon=horizon,
+                sample_fn=sample_fn, rngs=rngs)
             # inactive slots don't advance their cache length
             new_len = jnp.where(active, cache.length,
                                 cache.length - horizon)
             cache = cache._replace(length=new_len)
-            return toks.T, cache                      # [slots, horizon]
+            return toks, cache                        # [slots, horizon]
 
         return decode_steps
 
@@ -275,7 +276,7 @@ class InferenceEngine:
             events.append((req.request_id, token, finished))
         return events
 
-    _HORIZON_BUCKETS = (1, 2, 4, 8, 16, 32, 64)
+    _HORIZON_BUCKETS = (1, 2, 4, 8, 16, 32, 64, 128)
 
     def _decode(self, horizon: int = 1) -> List[Tuple[int, int, bool]]:
         active = np.array([r is not None for r in self._slots])
@@ -297,11 +298,12 @@ class InferenceEngine:
                          np.float32)
         topks = np.array([r.top_k if r else 0 for r in self._slots],
                          np.int32)
+        sample = bool((temps > 0).any())
         self._rng, rng = jax.random.split(self._rng)
         toks, self.cache = self._decode_fn(
             self.params, self.cache, jnp.asarray(self._cur_token), rng,
             jnp.asarray(temps), jnp.asarray(topks), jnp.asarray(active),
-            horizon)
+            horizon, sample)
         toks = np.asarray(toks)                       # [slots, horizon]
 
         events: List[Tuple[int, int, bool]] = []

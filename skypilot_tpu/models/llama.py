@@ -26,7 +26,8 @@ import jax.numpy as jnp
 from jax import lax
 
 from skypilot_tpu.models.configs import ModelConfig
-from skypilot_tpu.ops.attention import attention
+from skypilot_tpu.ops.attention import (attention, cached_attention,
+                                        ring_decode_attention)
 
 Params = Dict[str, Any]
 
@@ -131,17 +132,6 @@ def cache_logical_axes() -> KVCache:
                    length=('batch',))
 
 
-def _write_kv(cache_k: jax.Array, new_k: jax.Array,
-              start: jax.Array) -> jax.Array:
-    """Insert new_k [b, s, h, d] into cache_k [b, S, h, d] at per-sequence
-    offsets start [b]."""
-
-    def one(c, n, s):
-        return lax.dynamic_update_slice(c, n, (s, 0, 0))
-
-    return jax.vmap(one)(cache_k, new_k, start)
-
-
 # --------------------------------------------------------------------------
 # Building blocks
 # --------------------------------------------------------------------------
@@ -196,42 +186,24 @@ def _ffn(layer: Params, x: jax.Array, cfg: ModelConfig) -> jax.Array:
     return jnp.einsum('bsf,fd->bsd', h, layer['w_down'])
 
 
-def _attn_block(layer: Params, x: jax.Array, cfg: ModelConfig,
-                positions: jax.Array,
-                cache_kv: Optional[Tuple[jax.Array, jax.Array]],
-                cache_len: Optional[jax.Array],
-                attn_impl: str):
-    """Returns (out, new_cache_kv). Cache arrays are per-layer [b,S,h,d]."""
-    q = jnp.einsum('bsd,dhk->bshk', x, layer['wq'])
-    k = jnp.einsum('bsd,dhk->bshk', x, layer['wk'])
-    v = jnp.einsum('bsd,dhk->bshk', x, layer['wv'])
+def _layer_core(layer: Params, x: jax.Array, cfg: ModelConfig,
+                positions: jax.Array, attn_fn):
+    """One transformer layer, parameterized by the attention op so every
+    path (training full-sequence, prefill/decode against a cache, the
+    fused serving loop) shares ONE copy of the layer math. ``attn_fn``
+    maps roped (q, k, v) to the attention output.
+
+    Returns (x, (k, v) new kv rows, moe aux loss)."""
+    h = rms_norm(x, layer['attn_norm'], cfg.norm_eps)
+    q = jnp.einsum('bsd,dhk->bshk', h, layer['wq'])
+    k = jnp.einsum('bsd,dhk->bshk', h, layer['wk'])
+    v = jnp.einsum('bsd,dhk->bshk', h, layer['wv'])
     q = _shard(q, 'batch', 'seq', 'heads', 'head_dim')
     q = rope(q, positions, cfg.rope_theta)
     k = rope(k, positions, cfg.rope_theta)
-
-    if cache_kv is None:
-        out = attention(q, k, v, causal=True, impl=attn_impl)
-        new_cache = None
-    else:
-        ck, cv = cache_kv
-        ck = _write_kv(ck, k, cache_len)
-        cv = _write_kv(cv, v, cache_len)
-        new_len = cache_len + x.shape[1]
-        out = attention(q, ck, cv, causal=True, q_offset=cache_len,
-                        kv_len=new_len, impl=attn_impl)
-        new_cache = (ck, cv)
+    out = attn_fn(q, k, v)
     out = _shard(out, 'batch', 'seq', 'heads', 'head_dim')
-    out = jnp.einsum('bshk,hkd->bsd', out, layer['wo'])
-    return out, new_cache
-
-
-def _layer_fn(layer: Params, x: jax.Array, cfg: ModelConfig,
-              positions: jax.Array,
-              cache_kv, cache_len, attn_impl: str):
-    h = rms_norm(x, layer['attn_norm'], cfg.norm_eps)
-    attn_out, new_cache = _attn_block(layer, h, cfg, positions, cache_kv,
-                                      cache_len, attn_impl)
-    x = x + attn_out
+    x = x + jnp.einsum('bshk,hkd->bsd', out, layer['wo'])
     h = rms_norm(x, layer['ffn_norm'], cfg.norm_eps)
     if cfg.is_moe:
         from skypilot_tpu.models import moe
@@ -241,7 +213,27 @@ def _layer_fn(layer: Params, x: jax.Array, cfg: ModelConfig,
         aux = jnp.zeros((), jnp.float32)
     x = x + ffn_out
     x = _shard(x, 'batch', 'seq', 'embed')
-    return x, new_cache, aux
+    return x, (k, v), aux
+
+
+def _layer_fn(layer: Params, x: jax.Array, cfg: ModelConfig,
+              positions: jax.Array,
+              cache_kv, cache_len, attn_impl: str):
+    if cache_kv is None:
+        def attn_fn(q, k, v):
+            return attention(q, k, v, causal=True, impl=attn_impl)
+    else:
+        # Two-block attention: the cache is read-only here (forward
+        # scatters the new rows once, after the layer scan) — a decode
+        # step's cache traffic is one streaming read + an s-token write,
+        # not a full rewrite through scan carries.
+        ck, cv = cache_kv
+
+        def attn_fn(q, k, v):
+            return cached_attention(q, k, v, ck, cv, cache_len)
+
+    x, new_kv, aux = _layer_core(layer, x, cfg, positions, attn_fn)
+    return x, (None if cache_kv is None else new_kv), aux
 
 
 # --------------------------------------------------------------------------
@@ -299,13 +291,34 @@ def forward(
         x, aux_layers = lax.scan(scan_body, x, layer_params)
         new_cache = None
     else:
-        def scan_body(carry, layer_and_kv):
-            layer, ck, cv = layer_and_kv
+        # The cache is a loop INVARIANT (closed over, indexed per layer),
+        # not a scan input/output: routing it through xs/ys makes XLA
+        # restack the entire [L, b, S, h, d] cache every call — for
+        # decode that turns a ~MB token write into a ~GB cache rewrite.
+        cache_k, cache_v = cache.k, cache.v
+
+        def scan_body(carry, layer_and_idx):
+            layer, li = layer_and_idx
+            ck = lax.dynamic_index_in_dim(cache_k, li, axis=0,
+                                          keepdims=False)
+            cv = lax.dynamic_index_in_dim(cache_v, li, axis=0,
+                                          keepdims=False)
             out, new_kv, aux = body(carry, (layer, (ck, cv)))
             return out, (new_kv, aux)
 
-        x, ((new_k, new_v), aux_layers) = lax.scan(
-            scan_body, x, (layer_params, cache.k, cache.v))
+        x, ((k_rows, v_rows), aux_layers) = lax.scan(
+            scan_body, x, (layer_params, jnp.arange(cfg.n_layers)))
+        # One scatter of the new token rows across all layers.
+        # k_rows: [L, b, s, kv_heads, d]; per batch row, write the
+        # [L, s, kv_heads, d] block at that sequence's offset.
+
+        def write(c, n, start):
+            return lax.dynamic_update_slice(c, n, (0, start, 0, 0))
+
+        new_k = jax.vmap(write, in_axes=(1, 1, 0), out_axes=1)(
+            cache_k, k_rows.astype(cache_k.dtype), cache.length)
+        new_v = jax.vmap(write, in_axes=(1, 1, 0), out_axes=1)(
+            cache_v, v_rows.astype(cache_v.dtype), cache.length)
         new_cache = KVCache(k=new_k, v=new_v, length=cache.length + s)
 
     x = rms_norm(x, params['final_norm'], cfg.norm_eps)
@@ -315,6 +328,89 @@ def forward(
     if return_aux:
         return logits, new_cache, jnp.mean(aux_layers)
     return logits, new_cache
+
+
+def decode_horizon(
+    params: Params,
+    cache: KVCache,
+    tokens: jax.Array,                 # [b] current token per sequence
+    cfg: ModelConfig,
+    *,
+    horizon: int,
+    sample_fn=None,                    # (logits [b, vocab], rng) -> [b] int32
+    rngs: Optional[jax.Array] = None,  # [horizon] keys when sample_fn set
+):
+    """``horizon`` fused autoregressive decode steps in one program.
+
+    The perf-critical serving loop. The main cache is a loop INVARIANT:
+    its attention mask depends only on the horizon-start lengths, so XLA
+    streams it read-only each step instead of re-materializing it through
+    the scan carry (which costs ~a full cache rewrite per step). Rows
+    produced during the horizon live in a small [layers, b, horizon] ring
+    written at a uniform offset (plain dynamic_update_slice, in-place);
+    one scatter merges the ring into the cache at the end.
+
+    Returns (tokens [b, horizon], new_cache with length = length+horizon);
+    callers with inactive slots correct their lengths afterwards.
+    """
+    b = tokens.shape[0]
+    n_layers, n_kv, hd = cfg.n_layers, cfg.n_kv_heads, cfg.head_dim
+    len0 = cache.length
+    cache_k, cache_v = cache.k, cache.v
+    layer_params = params['layers']
+    ring_k = jnp.zeros((n_layers, b, horizon, n_kv, hd), cache_k.dtype)
+    ring_v = jnp.zeros_like(ring_k)
+    if rngs is None:
+        rngs = jnp.zeros((horizon, 2), jnp.uint32)      # unused filler
+
+    def one_step(carry, step_in):
+        ring_k, ring_v, tok = carry
+        i, rng = step_in
+        x = params['embed'][tok[:, None]]               # [b, 1, d]
+        positions = (len0 + i)[:, None]
+
+        def layer_body(xc, layer_and_idx):
+            layer, li = layer_and_idx
+            ck = lax.dynamic_index_in_dim(cache_k, li, 0, keepdims=False)
+            cv = lax.dynamic_index_in_dim(cache_v, li, 0, keepdims=False)
+            rk = lax.dynamic_index_in_dim(ring_k, li, 0, keepdims=False)
+            rv = lax.dynamic_index_in_dim(ring_v, li, 0, keepdims=False)
+
+            def attn_fn(q, k, v):
+                return ring_decode_attention(q, k, v, ck, cv, len0,
+                                             rk, rv, i)
+
+            xc, new_kv, _ = _layer_core(layer, xc, cfg, positions, attn_fn)
+            return xc, new_kv
+
+        x, (k_rows, v_rows) = lax.scan(
+            layer_body, x, (layer_params, jnp.arange(n_layers)))
+        ring_k = lax.dynamic_update_slice(
+            ring_k, k_rows.astype(ring_k.dtype), (0, 0, i, 0, 0))
+        ring_v = lax.dynamic_update_slice(
+            ring_v, v_rows.astype(ring_v.dtype), (0, 0, i, 0, 0))
+
+        x = rms_norm(x, params['final_norm'], cfg.norm_eps)
+        logits = jnp.einsum('bsd,dv->bsv', x, params['unembed'],
+                            preferred_element_type=jnp.float32)[:, 0]
+        if sample_fn is None:
+            nxt = jnp.argmax(logits, -1).astype(jnp.int32)
+        else:
+            nxt = sample_fn(logits, rng)
+        return (ring_k, ring_v, nxt), nxt
+
+    (ring_k, ring_v, _), toks = lax.scan(
+        one_step, (ring_k, ring_v, tokens),
+        (jnp.arange(horizon), rngs))
+
+    def write(c, n, start):            # c [L,S,h,d] <- n [L,H,h,d] @ start
+        return lax.dynamic_update_slice(c, n, (0, start, 0, 0))
+
+    new_k = jax.vmap(write, in_axes=(1, 1, 0), out_axes=1)(
+        cache_k, ring_k, len0)
+    new_v = jax.vmap(write, in_axes=(1, 1, 0), out_axes=1)(
+        cache_v, ring_v, len0)
+    return toks.T, KVCache(k=new_k, v=new_v, length=len0 + horizon)
 
 
 @functools.partial(jax.jit, static_argnames=('cfg',))

@@ -71,6 +71,112 @@ def reference_attention(
     return out.reshape(b, sq, h, d)
 
 
+def cached_attention(
+    q: jax.Array,                      # [b, s, h, d] new-token queries
+    k_new: jax.Array,                  # [b, s, hkv, d] new-token keys
+    v_new: jax.Array,                  # [b, s, hkv, d]
+    cache_k: jax.Array,                # [b, S, hkv, d] cache WITHOUT new rows
+    cache_v: jax.Array,                # [b, S, hkv, d]
+    cache_len: jax.Array,              # [b] valid cache entries
+    *,
+    scale: Optional[float] = None,
+) -> jax.Array:
+    """Decode/prefill attention against a KV cache without materializing
+    the concatenated [cache; new] sequence.
+
+    Two score blocks share one numerically-stable softmax: the cache block
+    (positions < cache_len; all strictly precede the new tokens, so only
+    the length mask applies) and the new-token block (standard causal
+    within the s new positions). The cache is only READ here — the caller
+    scatters the new rows in afterwards — so a decode step's cache traffic
+    is one streaming read plus an s-token write, not a full rewrite.
+    fp32 logits/softmax; GQA stays in grouped form (no kv broadcast)."""
+    b, s, h, d = q.shape
+    hkv = k_new.shape[2]
+    group = h // hkv
+    scale = scale if scale is not None else d ** -0.5
+    qg = q.reshape(b, s, hkv, group, d)
+
+    lc = jnp.einsum('bqhgd,bkhd->bhgqk', qg, cache_k,
+                    preferred_element_type=jnp.float32) * scale
+    ls = jnp.einsum('bqhgd,bkhd->bhgqk', qg, k_new,
+                    preferred_element_type=jnp.float32) * scale
+
+    S = cache_k.shape[1]
+    kv_pos = jnp.arange(S)[None, None, None, None, :]
+    lc = jnp.where(kv_pos < jnp.reshape(cache_len, (-1, 1, 1, 1, 1)),
+                   lc, -1e30)
+    q_pos = jnp.arange(s)[None, None, None, :, None]
+    new_pos = jnp.arange(s)[None, None, None, None, :]
+    ls = jnp.where(new_pos <= q_pos, ls, -1e30)
+
+    m = jnp.maximum(jnp.max(lc, -1, keepdims=True),
+                    jnp.max(ls, -1, keepdims=True))
+    ec = jnp.exp(lc - m)
+    es = jnp.exp(ls - m)
+    denom = jnp.sum(ec, -1, keepdims=True) + jnp.sum(es, -1, keepdims=True)
+    out = jnp.einsum('bhgqk,bkhd->bqhgd', (ec / denom).astype(cache_v.dtype),
+                     cache_v)
+    out = out + jnp.einsum('bhgqk,bkhd->bqhgd',
+                           (es / denom).astype(v_new.dtype), v_new)
+    return out.reshape(b, s, h, d)
+
+
+def ring_decode_attention(
+    q: jax.Array,                      # [b, 1, h, d] current-token queries
+    k_self: jax.Array,                 # [b, 1, hkv, d] current-token keys
+    v_self: jax.Array,                 # [b, 1, hkv, d]
+    cache_k: jax.Array,                # [b, S, hkv, d] read-only main cache
+    cache_v: jax.Array,
+    cache_len: jax.Array,              # [b] valid main-cache entries (fixed
+                                       #     for the whole fused horizon)
+    ring_k: jax.Array,                 # [b, H, hkv, d] this horizon's rows
+    ring_v: jax.Array,
+    ring_len: jax.Array,               # scalar: rows < ring_len are valid
+    *,
+    scale: Optional[float] = None,
+) -> jax.Array:
+    """Single-token decode attention over three blocks sharing one
+    softmax: the main cache (read-only inside a fused multi-step decode —
+    its mask depends only on the horizon-start lengths), the ring of rows
+    produced by the previous steps of this horizon, and the current
+    token. Keeping the main cache out of the loop carry is the point:
+    XLA then streams it instead of re-materializing it every step."""
+    b, _, h, d = q.shape
+    hkv = k_self.shape[2]
+    group = h // hkv
+    scale = scale if scale is not None else d ** -0.5
+    qg = q.reshape(b, 1, hkv, group, d)
+
+    lc = jnp.einsum('bqhgd,bkhd->bhgqk', qg, cache_k,
+                    preferred_element_type=jnp.float32) * scale
+    lr = jnp.einsum('bqhgd,bkhd->bhgqk', qg, ring_k,
+                    preferred_element_type=jnp.float32) * scale
+    lself = jnp.einsum('bqhgd,bqhd->bhgq', qg, k_self,
+                       preferred_element_type=jnp.float32)[..., None] * scale
+
+    S = cache_k.shape[1]
+    pos = jnp.arange(S)[None, None, None, None, :]
+    lc = jnp.where(pos < jnp.reshape(cache_len, (-1, 1, 1, 1, 1)), lc, -1e30)
+    rpos = jnp.arange(ring_k.shape[1])[None, None, None, None, :]
+    lr = jnp.where(rpos < ring_len, lr, -1e30)
+
+    m = jnp.maximum(jnp.max(lc, -1, keepdims=True),
+                    jnp.max(lr, -1, keepdims=True))
+    m = jnp.maximum(m, lself)
+    ec, er, es = jnp.exp(lc - m), jnp.exp(lr - m), jnp.exp(lself - m)
+    denom = (jnp.sum(ec, -1, keepdims=True) +
+             jnp.sum(er, -1, keepdims=True) + es)
+    out = jnp.einsum('bhgqk,bkhd->bqhgd',
+                     (ec / denom).astype(cache_v.dtype), cache_v)
+    out = out + jnp.einsum('bhgqk,bkhd->bqhgd',
+                           (er / denom).astype(ring_v.dtype), ring_v)
+    w_self = (es / denom)[..., 0].transpose(0, 3, 1, 2)   # [b, 1, hkv, g]
+    out = out + w_self.astype(v_self.dtype)[..., None] * \
+        v_self[:, :, :, None, :]
+    return out.reshape(b, 1, h, d)
+
+
 @functools.partial(jax.jit, static_argnames=('causal', 'impl'))
 def attention(
     q: jax.Array,

@@ -45,7 +45,7 @@ class TestForward:
         logits_f, _ = llama.forward(tiny_params, full, cfg)
         np.testing.assert_allclose(np.asarray(logits_d[:, -1]),
                                    np.asarray(logits_f[:, -1]),
-                                   rtol=2e-2, atol=2e-2)
+                                   rtol=3e-2, atol=3e-2)
         np.testing.assert_array_equal(np.asarray(cache.length), [6, 6])
 
     def test_ragged_cache_positions(self, tiny_params):
@@ -77,10 +77,10 @@ class TestForward:
             tiny_params, jnp.array([seq_b + [8]], jnp.int32), cfg)
         np.testing.assert_allclose(np.asarray(logits[0, -1]),
                                    np.asarray(ref_a[0, -1]),
-                                   rtol=2e-2, atol=2e-2)
+                                   rtol=3e-2, atol=3e-2)
         np.testing.assert_allclose(np.asarray(logits[1, -1]),
                                    np.asarray(ref_b[0, -1]),
-                                   rtol=2e-2, atol=2e-2)
+                                   rtol=3e-2, atol=3e-2)
         np.testing.assert_array_equal(np.asarray(cache.length), [6, 4])
 
     def test_moe_forward(self):

@@ -126,14 +126,16 @@ def test_serve_up_two_replicas_lb_and_down(tmp_path):
             'echo-replica-1') is not None
 
         serve.down('echo')
-        deadline = time.time() + 30
+        # Generous deadline: teardown joins two replica-cluster downs and
+        # process-tree kills, which slow down on a contended host.
+        deadline = time.time() + 60
         while time.time() < deadline:
             if not serve.status(['echo']):
                 break
             time.sleep(0.3)
         assert serve.status(['echo']) == []
         # Replica clusters are gone.
-        deadline = time.time() + 15
+        deadline = time.time() + 30
         while time.time() < deadline:
             if global_state.get_cluster_from_name(
                     'echo-replica-1') is None:
