@@ -1,0 +1,470 @@
+"""Command-line interface: ``skytpu`` / ``python -m skypilot_tpu.cli``.
+
+Role of reference ``sky/cli.py`` (5.5k LoC of click commands): the same
+verb surface — launch/exec/status/start/stop/down/autostop/queue/logs/
+cancel/check/cost-report/optimize, plus the ``jobs`` and ``serve``
+subcommand groups and the accelerator-catalog browser (``show-tpus``,
+the TPU-first counterpart of ``sky show-gpus`` ``sky/cli.py:3085``).
+Every command is a thin shell over the SDK in ``skypilot_tpu.core`` /
+``execution`` / ``jobs.core`` / ``serve.core`` — the CLI owns parsing,
+confirmation prompts, and table rendering only.
+"""
+from __future__ import annotations
+
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+import click
+
+import skypilot_tpu as sky
+from skypilot_tpu import exceptions
+from skypilot_tpu.task import Task
+
+
+# ------------------------------------------------------------------ helpers
+def _fmt_table(rows: List[List[str]], headers: List[str]) -> str:
+    widths = [len(h) for h in headers]
+    for row in rows:
+        for i, cell in enumerate(row):
+            widths[i] = max(widths[i], len(str(cell)))
+    fmt = '  '.join(f'{{:<{w}}}' for w in widths)
+    lines = [fmt.format(*headers)]
+    lines += [fmt.format(*[str(c) for c in row]) for row in rows]
+    return '\n'.join(lines)
+
+
+def _fmt_age(ts: Optional[float]) -> str:
+    if not ts:
+        return '-'
+    secs = max(0, time.time() - ts)
+    for unit, div in (('d', 86400), ('h', 3600), ('m', 60)):
+        if secs >= div:
+            return f'{int(secs // div)}{unit} ago'
+    return f'{int(secs)}s ago'
+
+
+def _load_task(entrypoint: Optional[str],
+               env: Tuple[str, ...] = (),
+               name: Optional[str] = None) -> Task:
+    """YAML path -> Task; no entrypoint -> empty (provision-only) task.
+
+    --env overrides are merged into the YAML's ``envs:`` BEFORE the Task
+    is constructed, so ``${VAR}`` interpolation anywhere in the config
+    (resources, workdir, file_mounts — not just run/setup) sees the
+    overridden values."""
+    overrides = {}
+    for item in env:
+        if '=' not in item:
+            raise click.UsageError(f'--env must be KEY=VALUE, got {item!r}')
+        k, v = item.split('=', 1)
+        overrides[k] = v
+    if entrypoint is None:
+        task = Task(name=name or 'sky-cmd')
+        if overrides:
+            task.update_envs(overrides)
+    else:
+        import os
+
+        import yaml
+        with open(os.path.expanduser(entrypoint), encoding='utf-8') as f:
+            config = yaml.safe_load(f) or {}
+        if overrides:
+            envs = dict(config.get('envs') or {})
+            envs.update(overrides)
+            config['envs'] = envs
+        task = Task.from_yaml_config(config)
+    if name:
+        task.name = name
+    return task
+
+
+def _confirm(message: str, yes: bool) -> None:
+    if not yes:
+        click.confirm(message, abort=True)
+
+
+@click.group()
+@click.version_option(sky.__version__, '--version', '-v')
+def cli():
+    """skypilot_tpu: run, manage, and serve workloads on TPU slices."""
+
+
+# ----------------------------------------------------------------- clusters
+@cli.command()
+@click.argument('entrypoint', required=False, type=click.Path(exists=True))
+@click.option('--cluster', '-c', default=None, help='Cluster name.')
+@click.option('--dryrun', is_flag=True, help='Print the plan; launch nothing.')
+@click.option('--yes', '-y', is_flag=True, help='Skip confirmation.')
+@click.option('--detach-run', '-d', is_flag=True,
+              help='Submit and return; do not stream job logs.')
+@click.option('--idle-minutes-to-autostop', '-i', type=int, default=None,
+              help='Autostop after this many idle minutes.')
+@click.option('--down', is_flag=True,
+              help='Autostop tears the cluster DOWN instead of stopping.')
+@click.option('--retry-until-up', is_flag=True,
+              help='Keep retrying across zones/regions until provisioned.')
+@click.option('--no-setup', is_flag=True, help='Skip the setup phase.')
+@click.option('--env', multiple=True, metavar='KEY=VALUE',
+              help='Override task env vars (repeatable).')
+def launch(entrypoint, cluster, dryrun, yes, detach_run,
+           idle_minutes_to_autostop, down, retry_until_up, no_setup, env):
+    """Launch a task YAML on a new or existing cluster."""
+    task = _load_task(entrypoint, env)
+    if not dryrun:
+        _confirm(f'Launching task on cluster {cluster or "<new>"}. Proceed?',
+                 yes)
+    job_id, handle = sky.launch(
+        task, cluster_name=cluster, dryrun=dryrun,
+        detach_run=detach_run, stream_logs=not detach_run,
+        idle_minutes_to_autostop=idle_minutes_to_autostop, down=down,
+        retry_until_up=retry_until_up, no_setup=no_setup)
+    if dryrun:
+        return
+    if job_id is not None:
+        click.echo(f'Job submitted (id: {job_id}) on cluster '
+                   f'{handle.cluster_name}.')
+
+
+@cli.command(name='exec')
+@click.argument('entrypoint', type=click.Path(exists=True))
+@click.option('--cluster', '-c', required=True, help='Target cluster.')
+@click.option('--detach-run', '-d', is_flag=True)
+@click.option('--env', multiple=True, metavar='KEY=VALUE')
+def exec_(entrypoint, cluster, detach_run, env):
+    """Run a task on an existing cluster (skips provision/setup)."""
+    task = _load_task(entrypoint, env)
+    job_id, _ = getattr(sky, 'exec')(task, cluster,
+                                     detach_run=detach_run)
+    click.echo(f'Job submitted (id: {job_id}) on cluster {cluster}.')
+
+
+@cli.command()
+@click.argument('clusters', nargs=-1)
+@click.option('--refresh', '-r', is_flag=True,
+              help='Reconcile against the cloud before printing.')
+def status(clusters, refresh):
+    """Show clusters (reference ``sky status``)."""
+    records = sky.status(list(clusters) or None, refresh=refresh)
+    if not records:
+        click.echo('No existing clusters.')
+        return
+    rows = []
+    for r in records:
+        handle = r.get('handle')
+        res = (str(handle.launched_resources)
+               if handle is not None and
+               getattr(handle, 'launched_resources', None) is not None
+               else '-')
+        autostop = f"{r['autostop']}m" if r.get('autostop', -1) >= 0 else '-'
+        rows.append([r['name'], _fmt_age(r.get('launched_at')), res,
+                     r['status'].value, autostop])
+    click.echo(_fmt_table(rows, ['NAME', 'LAUNCHED', 'RESOURCES', 'STATUS',
+                                 'AUTOSTOP']))
+
+
+@cli.command()
+@click.argument('cluster')
+@click.option('--idle-minutes-to-autostop', '-i', type=int, default=None)
+@click.option('--retry-until-up', is_flag=True)
+def start(cluster, idle_minutes_to_autostop, retry_until_up):
+    """Restart a stopped cluster."""
+    sky.start(cluster, idle_minutes_to_autostop=idle_minutes_to_autostop,
+              retry_until_up=retry_until_up)
+    click.echo(f'Cluster {cluster} started.')
+
+
+@cli.command()
+@click.argument('clusters', nargs=-1)
+@click.option('--all', '-a', 'stop_all', is_flag=True)
+@click.option('--yes', '-y', is_flag=True)
+def stop(clusters, stop_all, yes):
+    """Stop cluster(s) (preserves disk; billing stops for TPU time)."""
+    names = _select_clusters(clusters, stop_all, 'stop')
+    _confirm(f'Stopping {len(names)} cluster(s): {", ".join(names)}. '
+             'Proceed?', yes)
+    for name in names:
+        sky.stop(name)
+        click.echo(f'Cluster {name} stopped.')
+
+
+@cli.command()
+@click.argument('clusters', nargs=-1)
+@click.option('--all', '-a', 'down_all', is_flag=True)
+@click.option('--yes', '-y', is_flag=True)
+def down(clusters, down_all, yes):
+    """Tear down cluster(s)."""
+    names = _select_clusters(clusters, down_all, 'down')
+    _confirm(f'Tearing down {len(names)} cluster(s): {", ".join(names)}. '
+             'Proceed?', yes)
+    for name in names:
+        sky.down(name)
+        click.echo(f'Cluster {name} terminated.')
+
+
+_CONTROLLER_CLUSTERS = ('skytpu-jobs-controller', 'skytpu-serve-controller')
+
+
+def _select_clusters(clusters, select_all: bool, verb: str) -> List[str]:
+    if select_all:
+        # Control-plane clusters are excluded from --all (killing them
+        # orphans managed jobs / serve state); name them explicitly to
+        # act on them — same contract as the reference's `sky down -a`.
+        names = [r['name'] for r in sky.status()
+                 if r['name'] not in _CONTROLLER_CLUSTERS]
+        if not names:
+            click.echo('No existing clusters.')
+            raise SystemExit(0)
+        return names
+    if not clusters:
+        raise click.UsageError(f'Specify cluster(s) to {verb}, or --all.')
+    return list(clusters)
+
+
+@cli.command()
+@click.argument('cluster')
+@click.option('--idle-minutes', '-i', type=int, default=5,
+              help='Idle minutes before autostop.')
+@click.option('--down', is_flag=True,
+              help='Tear down instead of stopping when idle.')
+@click.option('--cancel', is_flag=True, help='Disable autostop.')
+def autostop(cluster, idle_minutes, down, cancel):
+    """Arm (or cancel) idle autostop on a cluster."""
+    sky.autostop(cluster, -1 if cancel else idle_minutes, down=down)
+    if cancel:
+        click.echo(f'Autostop cancelled on {cluster}.')
+    else:
+        click.echo(f'{cluster}: autostop after {idle_minutes} idle '
+                   f'minute(s) ({"down" if down else "stop"}).')
+
+
+@cli.command()
+@click.argument('cluster')
+def queue(cluster):
+    """Show a cluster's job queue."""
+    jobs = sky.queue(cluster)
+    if not jobs:
+        click.echo(f'No jobs on {cluster}.')
+        return
+    rows = [[j['job_id'], j.get('name') or '-',
+             _fmt_age(j.get('submitted_at')), j['status']]
+            for j in jobs]
+    click.echo(_fmt_table(rows, ['ID', 'NAME', 'SUBMITTED', 'STATUS']))
+
+
+@cli.command()
+@click.argument('cluster')
+@click.argument('job_id', type=int)
+@click.option('--no-follow', is_flag=True, help='Print and exit.')
+def logs(cluster, job_id, no_follow):
+    """Tail a job's logs."""
+    sky.tail_logs(cluster, job_id, follow=not no_follow)
+
+
+@cli.command()
+@click.argument('cluster')
+@click.argument('job_ids', nargs=-1, type=int)
+@click.option('--all', '-a', 'cancel_all', is_flag=True)
+@click.option('--yes', '-y', is_flag=True)
+def cancel(cluster, job_ids, cancel_all, yes):
+    """Cancel job(s) on a cluster."""
+    if not cancel_all and not job_ids:
+        raise click.UsageError('Specify job id(s) or --all.')
+    _confirm(f'Cancelling {"ALL jobs" if cancel_all else str(job_ids)} on '
+             f'{cluster}. Proceed?', yes)
+    if cancel_all:
+        sky.cancel(cluster, all=True)
+    else:
+        for jid in job_ids:
+            sky.cancel(cluster, jid)
+    click.echo('Cancelled.')
+
+
+@cli.command(name='cost-report')
+def cost_report():
+    """Estimated cost per (live or historical) cluster."""
+    report = sky.cost_report()
+    if not report:
+        click.echo('No clusters.')
+        return
+    rows = [[r['name'],
+             f"{r.get('duration_hours', 0):.2f}h",
+             f"${r.get('cost_per_hour', 0):.2f}",
+             f"${r.get('total_cost', 0):.2f}"] for r in report]
+    click.echo(_fmt_table(rows, ['NAME', 'DURATION', '$/HR', 'TOTAL COST']))
+
+
+@cli.command()
+def check():
+    """Probe cloud credentials; list enabled clouds."""
+    from skypilot_tpu import check as check_lib
+    enabled = check_lib.check()
+    if enabled:
+        click.echo('Enabled clouds: ' + ', '.join(enabled))
+    else:
+        click.echo('No clouds enabled.')
+
+
+@cli.command(name='show-tpus')
+@click.option('--cloud', default='gcp')
+@click.option('--all', '-a', 'show_all', is_flag=True,
+              help='Include GPU/CPU instance types.')
+def show_tpus(cloud, show_all):
+    """Browse the accelerator catalog (TPU-first ``sky show-gpus``)."""
+    from skypilot_tpu.catalog import catalog
+    entries = catalog.get_catalog(cloud)
+    rows = []
+    for e in entries:
+        if not show_all and not e.is_tpu:
+            continue
+        rows.append([e.instance_type, e.accelerator_name or '-',
+                     e.accelerator_count or '-', e.region,
+                     f'${e.price:.2f}',
+                     f'${e.spot_price:.2f}' if e.spot_price else '-'])
+    if not rows:
+        click.echo('No catalog entries.')
+        return
+    click.echo(_fmt_table(
+        rows, ['INSTANCE', 'ACCELERATOR', 'COUNT', 'REGION', '$/HR',
+               'SPOT $/HR']))
+
+
+@cli.command()
+@click.argument('entrypoint', type=click.Path(exists=True))
+@click.option('--env', multiple=True, metavar='KEY=VALUE')
+def optimize(entrypoint, env):
+    """Print the optimizer's plan for a task YAML without launching."""
+    task = _load_task(entrypoint, env)
+    sky.launch(task, dryrun=True)
+
+
+# --------------------------------------------------------------------- jobs
+@cli.group()
+def jobs():
+    """Managed jobs: launch-with-recovery on preemptible capacity."""
+
+
+@jobs.command(name='launch')
+@click.argument('entrypoint', type=click.Path(exists=True))
+@click.option('--name', '-n', default=None)
+@click.option('--yes', '-y', is_flag=True)
+@click.option('--env', multiple=True, metavar='KEY=VALUE')
+def jobs_launch(entrypoint, name, yes, env):
+    """Submit a managed job (controller monitors + recovers it)."""
+    task = _load_task(entrypoint, env, name=name)
+    _confirm('Submitting managed job. Proceed?', yes)
+    job_id = sky.jobs.launch(task, name=name)
+    click.echo(f'Managed job submitted (id: {job_id}).')
+
+
+@jobs.command(name='queue')
+def jobs_queue():
+    """List managed jobs."""
+    try:
+        records = sky.jobs.queue()
+    except exceptions.ClusterNotUpError:
+        records = []                      # no controller -> no jobs yet
+    if not records:
+        click.echo('No managed jobs.')
+        return
+    rows = [[r['job_id'], r.get('name') or '-',
+             _fmt_age(r.get('submitted_at')), r['status'],
+             r.get('recovery_count', 0)] for r in records]
+    click.echo(_fmt_table(rows, ['ID', 'NAME', 'SUBMITTED', 'STATUS',
+                                 'RECOVERIES']))
+
+
+@jobs.command(name='cancel')
+@click.argument('job_id', type=int)
+@click.option('--yes', '-y', is_flag=True)
+def jobs_cancel(job_id, yes):
+    """Cancel a managed job (tears its task cluster down)."""
+    _confirm(f'Cancelling managed job {job_id}. Proceed?', yes)
+    ok = sky.jobs.cancel(job_id)
+    click.echo('Cancelled.' if ok else 'Job not found or already terminal.')
+
+
+@jobs.command(name='logs')
+@click.argument('job_id', type=int)
+@click.option('--no-follow', is_flag=True)
+def jobs_logs(job_id, no_follow):
+    """Stream a managed job's controller log."""
+    if no_follow:
+        click.echo(sky.jobs.logs(job_id))
+    else:
+        sky.jobs.tail_logs(job_id, follow=True)
+
+
+# -------------------------------------------------------------------- serve
+@cli.group()
+def serve():
+    """Autoscaled serving: replicas behind a load balancer."""
+
+
+@serve.command(name='up')
+@click.argument('entrypoint', type=click.Path(exists=True))
+@click.option('--service-name', '-n', default=None)
+@click.option('--yes', '-y', is_flag=True)
+@click.option('--env', multiple=True, metavar='KEY=VALUE')
+def serve_up(entrypoint, service_name, yes, env):
+    """Spin up a service from a task YAML with a ``service:`` section."""
+    task = _load_task(entrypoint, env)
+    _confirm(f'Starting service {service_name or task.name!r}. Proceed?',
+             yes)
+    result = sky.serve.up(task, service_name=service_name)
+    click.echo(f"Service {result['name']!r} endpoint: {result['endpoint']}")
+
+
+@serve.command(name='status')
+@click.argument('service_names', nargs=-1)
+def serve_status(service_names):
+    """Show services and their replicas."""
+    try:
+        services = sky.serve.status(list(service_names) or None)
+    except exceptions.ClusterNotUpError:
+        services = []                     # no controller -> no services
+    if not services:
+        click.echo('No services.')
+        return
+    rows = [[s['name'], s['status'], s.get('version', 1),
+             sum(1 for r in s['replicas'] if r['status'] == 'READY'),
+             len(s['replicas']), s['endpoint']] for s in services]
+    click.echo(_fmt_table(rows, ['NAME', 'STATUS', 'VERSION', 'READY',
+                                 'REPLICAS', 'ENDPOINT']))
+    for s in services:
+        if not s['replicas']:
+            continue
+        click.echo(f"\nReplicas of {s['name']}:")
+        rrows = [[r['replica_id'], r['cluster_name'], r['status'],
+                  r.get('url') or '-'] for r in s['replicas']]
+        click.echo(_fmt_table(rrows, ['ID', 'CLUSTER', 'STATUS', 'URL']))
+
+
+@serve.command(name='down')
+@click.argument('service_name')
+@click.option('--purge', '-p', is_flag=True,
+              help='Best-effort cleanup even if the controller is gone.')
+@click.option('--yes', '-y', is_flag=True)
+def serve_down(service_name, purge, yes):
+    """Tear down a service and its replicas."""
+    _confirm(f'Tearing down service {service_name!r}. Proceed?', yes)
+    sky.serve.down(service_name, purge=purge)
+    click.echo(f'Service {service_name!r} torn down.')
+
+
+@serve.command(name='logs')
+@click.argument('service_name')
+@click.option('--no-follow', is_flag=True)
+def serve_logs(service_name, no_follow):
+    """Stream a service's controller/LB log."""
+    sky.serve.tail_logs(service_name, follow=not no_follow)
+
+
+def main() -> None:
+    try:
+        cli(standalone_mode=True)
+    except exceptions.SkyTpuError as e:       # pragma: no cover - passthru
+        raise SystemExit(f'Error: {e}')
+
+
+if __name__ == '__main__':
+    main()

@@ -1,0 +1,161 @@
+"""CLI tests: click commands driven through CliRunner against the local
+provisioner (hermetic counterpart of the reference's CLI smoke tests;
+command surface per ``sky/cli.py``)."""
+import time
+
+import pytest
+from click.testing import CliRunner
+
+from skypilot_tpu import cli
+
+pytestmark = pytest.mark.usefixtures('tmp_state_dir', 'fast_agent')
+
+
+@pytest.fixture()
+def fast_agent(monkeypatch):
+    monkeypatch.setenv('SKYTPU_AGENT_TICK', '0.1')
+    monkeypatch.setenv('SKYTPU_AGENT_READY_TIMEOUT', '30')
+
+
+@pytest.fixture()
+def runner():
+    return CliRunner()
+
+
+@pytest.fixture()
+def task_yaml(tmp_path):
+    p = tmp_path / 'task.yaml'
+    p.write_text(
+        'name: clitask\n'
+        'resources:\n'
+        '  cloud: local\n'
+        '  cpus: 1+\n'
+        f'run: echo cli-out-$((40+2)) > {tmp_path}/out.txt\n')
+    return str(p)
+
+
+def _ok(result):
+    assert result.exit_code == 0, result.output
+    return result.output
+
+
+class TestBasics:
+
+    def test_help_lists_commands(self, runner):
+        out = _ok(runner.invoke(cli.cli, ['--help']))
+        for cmd in ('launch', 'status', 'queue', 'logs', 'down', 'jobs',
+                    'serve', 'show-tpus', 'check', 'cost-report'):
+            assert cmd in out
+
+    def test_version(self, runner):
+        assert '0.1.0' in _ok(runner.invoke(cli.cli, ['--version']))
+
+    def test_status_empty(self, runner):
+        assert 'No existing clusters' in _ok(
+            runner.invoke(cli.cli, ['status']))
+
+    def test_jobs_queue_without_controller(self, runner):
+        assert 'No managed jobs' in _ok(
+            runner.invoke(cli.cli, ['jobs', 'queue']))
+
+    def test_serve_status_without_controller(self, runner):
+        assert 'No services' in _ok(
+            runner.invoke(cli.cli, ['serve', 'status']))
+
+    def test_show_tpus(self, runner):
+        out = _ok(runner.invoke(cli.cli, ['show-tpus']))
+        assert 'tpu-v5litepod-8' in out or 'tpu-v' in out
+
+    def test_check(self, runner):
+        out = _ok(runner.invoke(cli.cli, ['check']))
+        assert 'local: enabled' in out
+
+    def test_down_requires_target(self, runner):
+        result = runner.invoke(cli.cli, ['down'])
+        assert result.exit_code != 0
+        assert '--all' in result.output
+
+    def test_env_validation(self, runner, task_yaml):
+        result = runner.invoke(
+            cli.cli, ['launch', task_yaml, '--dryrun', '--env', 'NOEQUALS'])
+        assert result.exit_code != 0
+        assert 'KEY=VALUE' in result.output
+
+    def test_env_override_interpolates_outside_run(self, tmp_path):
+        """--env must take effect before ${VAR} interpolation, so it can
+        steer fields like workdir, not just the run script's env."""
+        (tmp_path / 'wd-b').mkdir()
+        p = tmp_path / 'envtask.yaml'
+        p.write_text(
+            'name: envtask\n'
+            'envs:\n'
+            '  WD: wd-a\n'
+            f'workdir: {tmp_path}/${{WD}}\n'
+            'run: echo hi\n')
+        task = cli._load_task(str(p), env=('WD=wd-b',))
+        assert task.workdir == f'{tmp_path}/wd-b'
+
+    def test_all_excludes_controller_clusters(self, runner, monkeypatch):
+        import skypilot_tpu as sky
+        # Patch the sky-module bindings (the lazy SDK caches resolved
+        # attrs in skypilot_tpu's globals, which is what cli calls).
+        monkeypatch.setattr(
+            sky, 'status',
+            lambda *a, **k: [{'name': 'skytpu-jobs-controller'},
+                             {'name': 'skytpu-serve-controller'},
+                             {'name': 'usercluster'}], raising=False)
+        downed = []
+        monkeypatch.setattr(sky, 'down', downed.append, raising=False)
+        out = _ok(runner.invoke(cli.cli, ['down', '--all', '-y']))
+        assert downed == ['usercluster'], out
+
+    def test_all_with_no_clusters_is_noop(self, runner):
+        out = _ok(runner.invoke(cli.cli, ['down', '--all', '-y']))
+        assert 'No existing clusters' in out
+
+
+class TestLifecycle:
+
+    def test_launch_dryrun(self, runner, task_yaml):
+        out = _ok(runner.invoke(cli.cli, ['launch', task_yaml, '--dryrun']))
+        assert 'Optimizer plan' in out
+
+    def test_launch_status_queue_logs_down(self, runner, task_yaml,
+                                           tmp_path):
+        out = _ok(runner.invoke(
+            cli.cli, ['launch', task_yaml, '-c', 'clic', '-y', '-d']))
+        assert 'Job submitted (id: 1)' in out
+
+        out = _ok(runner.invoke(cli.cli, ['status']))
+        assert 'clic' in out and 'UP' in out
+
+        deadline = time.time() + 45
+        while time.time() < deadline:
+            out = _ok(runner.invoke(cli.cli, ['queue', 'clic']))
+            if 'SUCCEEDED' in out:
+                break
+            time.sleep(0.5)
+        assert 'SUCCEEDED' in out
+        assert (tmp_path / 'out.txt').read_text().strip() == 'cli-out-42'
+
+        out = _ok(runner.invoke(
+            cli.cli, ['logs', 'clic', '1', '--no-follow']))
+        assert 'cli-out' in out or 'SUCCEEDED' in out
+
+        out = _ok(runner.invoke(cli.cli, ['cost-report']))
+        assert 'clic' in out
+
+        out = _ok(runner.invoke(cli.cli, ['down', 'clic', '-y']))
+        assert 'terminated' in out
+        assert 'No existing clusters' in _ok(
+            runner.invoke(cli.cli, ['status']))
+
+    def test_autostop_arm_and_cancel(self, runner, task_yaml):
+        _ok(runner.invoke(
+            cli.cli, ['launch', task_yaml, '-c', 'autoc', '-y', '-d']))
+        out = _ok(runner.invoke(
+            cli.cli, ['autostop', 'autoc', '-i', '30']))
+        assert 'autostop after 30' in out
+        out = _ok(runner.invoke(cli.cli, ['autostop', 'autoc', '--cancel']))
+        assert 'cancelled' in out
+        _ok(runner.invoke(cli.cli, ['down', 'autoc', '-y']))
