@@ -46,10 +46,6 @@ class ResourcesMismatchError(SkyTpuError):
     """Requested resources do not match an existing cluster's resources."""
 
 
-class QuotaExceededError(SkyTpuError):
-    """Cloud quota prevents provisioning in a region; blocklist the region."""
-
-
 class NoCloudAccessError(SkyTpuError):
     """No cloud is enabled/credentialed."""
 
@@ -60,6 +56,14 @@ class ProvisionError(SkyTpuError):
 
     #: Scope the failover should blocklist: 'zone' | 'region' | 'cloud'.
     blocklist_scope: str = 'zone'
+
+
+class QuotaExceededError(ProvisionError):
+    """Cloud quota prevents provisioning in a region; blocklist the region.
+
+    A ProvisionError subclass so the failover loop catches and
+    blocklists it rather than crashing the launch."""
+    blocklist_scope = 'region'
 
 
 class InsufficientCapacityError(ProvisionError):

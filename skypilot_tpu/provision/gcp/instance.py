@@ -1,0 +1,477 @@
+"""GCP provisioner ops: TPU slices (nodes + queued-resources) and GCE VMs.
+
+Role of reference ``sky/provision/gcp/instance_utils.py`` (TPU VM path
+``:1191-1607``): create/query/delete with gang semantics. TPU-first
+redesign notes:
+
+- A logical node is a whole TPU slice (possibly multi-host); the node's
+  ``networkEndpoints`` become per-host ``HostInfo`` rows with global
+  ranks (slice-major, worker-minor).
+- On-demand/reserved slices go through ``nodes.create``; spot /
+  best-effort capacity goes through the async queued-resources flow:
+  create → ACCEPTED → PROVISIONING → ACTIVE, with FAILED/SUSPENDED and
+  the "queued too long" timeout both surfaced as blocklist-scoped
+  provision errors so the failover loop moves to the next zone.
+- All-or-nothing: partial creations are cleaned up before the error
+  propagates (``run_instances`` contract in provision/__init__.py).
+
+Everything is driven through the injectable-transport REST clients in
+``tpu_client`` — unit tests script the cloud's behavior per request.
+"""
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import Any, Dict, List, Optional
+
+from skypilot_tpu import exceptions
+from skypilot_tpu import tpu_logging
+from skypilot_tpu.provision import common
+from skypilot_tpu.provision.gcp import tpu_client as tc
+from skypilot_tpu.utils import common_utils
+
+logger = tpu_logging.init_logger(__name__)
+
+_LABEL_CLUSTER = 'skytpu-cluster'
+
+
+# Per-cluster placement (project/zone/kind), written by run_instances and
+# read by every later op — the dispatch API (provision/__init__.py) is
+# (region, cluster_name)-shaped, so placement must be provider state, the
+# same pattern as the local provider's meta.json.
+def _placement_dir() -> str:
+    d = os.path.join(common_utils.state_dir(), 'gcp_clusters')
+    os.makedirs(d, exist_ok=True)
+    return d
+
+
+def _placement_path(cluster_name: str) -> str:
+    return os.path.join(_placement_dir(), f'{cluster_name}.json')
+
+
+def _save_placement(cluster_name: str, project: str, zone: str) -> None:
+    with open(_placement_path(cluster_name), 'w', encoding='utf-8') as f:
+        json.dump({'project': project, 'zone': zone}, f)
+
+
+def _load_placement(cluster_name: str) -> Optional[Dict[str, str]]:
+    try:
+        with open(_placement_path(cluster_name), encoding='utf-8') as f:
+            return json.load(f)
+    except FileNotFoundError:
+        return None
+
+
+def _drop_placement(cluster_name: str) -> None:
+    try:
+        os.remove(_placement_path(cluster_name))
+    except FileNotFoundError:
+        pass
+
+# TPU node state -> cloud-agnostic status.
+_TPU_STATE_MAP = {
+    'CREATING': common.STATUS_PENDING,
+    'STARTING': common.STATUS_PENDING,
+    'RESTARTING': common.STATUS_PENDING,
+    'REPAIRING': common.STATUS_PENDING,
+    'READY': common.STATUS_RUNNING,
+    'STOPPING': common.STATUS_STOPPED,
+    'STOPPED': common.STATUS_STOPPED,
+    'DELETING': common.STATUS_TERMINATED,
+    'PREEMPTED': common.STATUS_TERMINATED,
+    'TERMINATED': common.STATUS_TERMINATED,
+}
+_GCE_STATE_MAP = {
+    'PROVISIONING': common.STATUS_PENDING,
+    'STAGING': common.STATUS_PENDING,
+    'RUNNING': common.STATUS_RUNNING,
+    'STOPPING': common.STATUS_STOPPED,
+    'SUSPENDED': common.STATUS_STOPPED,
+    'TERMINATED': common.STATUS_STOPPED,   # GCE TERMINATED == stopped VM
+}
+
+_QR_ACTIVE = 'ACTIVE'
+_QR_DEAD = ('FAILED', 'SUSPENDED', 'SUSPENDING')
+
+
+def _project(config_or_none: Optional[Dict[str, Any]]) -> str:
+    project = (config_or_none or {}).get('project_id')
+    if not project:
+        raise exceptions.NoCloudAccessError(
+            'GCP project_id is not configured (set gcp.project_id in '
+            '~/.skytpu/config.yaml).')
+    return str(project)
+
+
+def _node_name(cluster_name: str, idx: int) -> str:
+    return f'{cluster_name}-{idx}'
+
+
+def _qr_name(cluster_name: str, idx: int) -> str:
+    return f'{cluster_name}-qr-{idx}'
+
+
+def _tpu_node_body(cluster_name: str, cfg: common.ProvisionConfig
+                   ) -> Dict[str, Any]:
+    node_config = cfg.node_config
+    body: Dict[str, Any] = {
+        'acceleratorType': node_config['accelerator_type'],
+        'runtimeVersion': node_config.get('runtime_version',
+                                          'tpu-ubuntu2204-base'),
+        'labels': {
+            _LABEL_CLUSTER: cluster_name,
+            **(node_config.get('labels') or {}),
+            **cfg.tags,
+        },
+    }
+    if node_config.get('use_spot'):
+        body['schedulingConfig'] = {'preemptible': True}
+    if node_config.get('reserved'):
+        body['schedulingConfig'] = {'reserved': True}
+    return body
+
+
+# --------------------------------------------------------------------- ops
+def run_instances(region: str, zone: Optional[str], cluster_name: str,
+                  config: common.ProvisionConfig) -> common.ProvisionRecord:
+    if zone is None:
+        raise exceptions.ProvisionError('GCP provisioning requires a zone.')
+    _save_placement(cluster_name, _project(config.provider_config), zone)
+    kind = config.node_config.get('kind', 'tpu_vm')
+    if kind == 'tpu_vm':
+        return _run_tpu(region, zone, cluster_name, config)
+    return _run_gce(region, zone, cluster_name, config)
+
+
+def _run_tpu(region: str, zone: str, cluster_name: str,
+             config: common.ProvisionConfig) -> common.ProvisionRecord:
+    client = tc.TpuClient(_project(config.provider_config))
+    node_config = config.node_config
+    created: List[str] = []
+    resumed: List[str] = []
+
+    # Reconcile existing nodes: resume STOPPED ones (single-host slices
+    # only — pods can't stop), and DELETE dead ones (PREEMPTED/FAILED/
+    # TERMINATED are still listed by the API but hold no capacity — they
+    # must be recreated, not skipped).
+    _DEAD_STATES = ('PREEMPTED', 'TERMINATED', 'DELETING', 'FAILED')
+    existing = _cluster_nodes(client, zone, cluster_name)
+    for name in list(existing):
+        node = existing[name]
+        state = node.get('state')
+        if state == 'STOPPED' and config.resume_stopped_nodes:
+            op = client.start_node(zone, name)
+            client.wait_operation(op, zone=zone, timeout=600)
+            resumed.append(name)
+        elif state in _DEAD_STATES:
+            logger.info(f'Node {name} is {state}; recreating.')
+            client.delete_node(zone, name)
+            existing.pop(name)
+
+    use_qr = bool(node_config.get('use_spot')
+                  or node_config.get('best_effort'))
+    try:
+        for i in range(config.count):
+            name = _node_name(cluster_name, i)
+            if name in existing:
+                continue
+            # Record BEFORE waiting: a create op that fails mid-wait can
+            # leave a half-made node this attempt must clean up.
+            created.append(name)
+            if use_qr:
+                _create_via_queued_resource(client, zone, cluster_name,
+                                            i, config)
+            else:
+                op = client.create_node(zone, name,
+                                        _tpu_node_body(cluster_name, config))
+                client.wait_operation(op, zone=zone, timeout=1800)
+    except exceptions.SkyTpuError:
+        # Gang semantics: a partially-created slice group is useless —
+        # clean up what this attempt made, then let failover move on.
+        for name in created:
+            try:
+                client.delete_node(zone, name)
+            except exceptions.SkyTpuError:
+                pass
+        for i in range(config.count):
+            try:
+                client.delete_queued_resource(zone,
+                                              _qr_name(cluster_name, i))
+            except exceptions.SkyTpuError:
+                pass
+        raise
+
+    return common.ProvisionRecord(
+        provider_name='gcp', cluster_name=cluster_name, region=region,
+        zone=zone, head_instance_id=_node_name(cluster_name, 0),
+        created_instance_ids=created, resumed_instance_ids=resumed)
+
+
+def _create_via_queued_resource(client: tc.TpuClient, zone: str,
+                                cluster_name: str, idx: int,
+                                config: common.ProvisionConfig) -> None:
+    """The async spot path: create the QR, then poll until ACTIVE,
+    failing over on FAILED/SUSPENDED or on sitting queued too long
+    (reference ``instance_utils.py`` queued-resources flow)."""
+    node_config = config.node_config
+    node_name = _node_name(cluster_name, idx)
+    qr_name = _qr_name(cluster_name, idx)
+    body = {
+        'tpu': {
+            'nodeSpec': [{
+                'parent': f'projects/{client.project}/locations/{zone}',
+                'nodeId': node_name,
+                'node': _tpu_node_body(cluster_name, config),
+            }],
+        },
+    }
+    if node_config.get('use_spot'):
+        body['spot'] = {}
+    if node_config.get('best_effort'):
+        body.setdefault('queueingPolicy', {})
+    client.create_queued_resource(zone, qr_name, body)
+
+    deadline = time.time() + tc.queued_resource_timeout()
+    while True:
+        qr = client.get_queued_resource(zone, qr_name)
+        state = ((qr or {}).get('state') or {}).get('state', 'UNKNOWN')
+        if state == _QR_ACTIVE:
+            return
+        if state in _QR_DEAD:
+            client.delete_queued_resource(zone, qr_name)
+            err: exceptions.SkyTpuError = \
+                exceptions.InsufficientCapacityError(
+                    f'Queued resource {qr_name} ended {state} in {zone}.')
+            err.blocklist_scope = 'zone'
+            raise err
+        if time.time() > deadline:
+            # Queued too long: abandon this zone and fail over.
+            client.delete_queued_resource(zone, qr_name)
+            err = exceptions.QueuedResourceTimeoutError(
+                f'Queued resource {qr_name} not ACTIVE after '
+                f'{tc.queued_resource_timeout():.0f}s in {zone} '
+                f'(last state: {state}).')
+            err.blocklist_scope = 'zone'
+            raise err
+        time.sleep(tc.poll_interval())
+
+
+def _gce_body(cluster_name: str, name: str,
+              config: common.ProvisionConfig) -> Dict[str, Any]:
+    node_config = config.node_config
+    machine = node_config.get('machine_type', 'n2-standard-8')
+    body: Dict[str, Any] = {
+        'name': name,
+        'machineType': f'zones/_/machineTypes/{machine}',
+        'labels': {_LABEL_CLUSTER: cluster_name,
+                   **(node_config.get('labels') or {}), **config.tags},
+        'disks': [{
+            'boot': True,
+            'initializeParams': {
+                'diskSizeGb': node_config.get('disk_size_gb', 256),
+                'sourceImage': node_config.get(
+                    'image_id',
+                    'projects/debian-cloud/global/images/family/debian-12'),
+            },
+        }],
+        'networkInterfaces': [{
+            'network': (config.provider_config or {}).get(
+                'vpc_name') or 'global/networks/default',
+        }],
+    }
+    if node_config.get('use_spot'):
+        body['scheduling'] = {'provisioningModel': 'SPOT',
+                              'instanceTerminationAction': 'DELETE'}
+    accels = node_config.get('guest_accelerators') or {}
+    if accels:
+        (accel_name, count), = accels.items()
+        body['guestAccelerators'] = [{
+            'acceleratorType': f'zones/_/acceleratorTypes/{accel_name}',
+            'acceleratorCount': count,
+        }]
+        body['scheduling'] = dict(body.get('scheduling', {}),
+                                  onHostMaintenance='TERMINATE')
+    return body
+
+
+def _run_gce(region: str, zone: str, cluster_name: str,
+             config: common.ProvisionConfig) -> common.ProvisionRecord:
+    client = tc.ComputeClient(_project(config.provider_config))
+    created: List[str] = []
+    resumed: List[str] = []
+    existing = {i['name']: i for i in client.list_instances(zone)
+                if (i.get('labels') or {}).get(_LABEL_CLUSTER)
+                == cluster_name}
+    for name, inst in existing.items():
+        if not config.resume_stopped_nodes:
+            continue
+        status = inst.get('status')
+        if status == 'TERMINATED':       # GCE TERMINATED == stopped VM
+            client.start_instance(zone, name)
+            resumed.append(name)
+        elif status == 'SUSPENDED':      # suspended VMs need resume
+            client.resume_instance(zone, name)
+            resumed.append(name)
+    try:
+        for i in range(config.count):
+            name = _node_name(cluster_name, i)
+            if name in existing:
+                continue
+            client.insert_instance(zone, _gce_body(cluster_name, name,
+                                                   config))
+            created.append(name)
+    except exceptions.SkyTpuError:
+        for name in created:
+            try:
+                client.delete_instance(zone, name)
+            except exceptions.SkyTpuError:
+                pass
+        raise
+    return common.ProvisionRecord(
+        provider_name='gcp', cluster_name=cluster_name, region=region,
+        zone=zone, head_instance_id=_node_name(cluster_name, 0),
+        created_instance_ids=created, resumed_instance_ids=resumed)
+
+
+# ----------------------------------------------------------------- queries
+def _cluster_nodes(client: tc.TpuClient, zone: str,
+                   cluster_name: str) -> Dict[str, Dict[str, Any]]:
+    return {n['name'].rsplit('/', 1)[-1]: n
+            for n in client.list_nodes(zone)
+            if (n.get('labels') or {}).get(_LABEL_CLUSTER) == cluster_name}
+
+
+def _placed(cluster_name: str) -> Optional[Dict[str, str]]:
+    return _load_placement(cluster_name)
+
+
+def query_instances(region: str, cluster_name: str) -> Dict[str, str]:
+    placement = _placed(cluster_name)
+    if placement is None:
+        return {}
+    project, zone = placement['project'], placement['zone']
+    out: Dict[str, str] = {}
+    tpu = tc.TpuClient(project)
+    for name, node in _cluster_nodes(tpu, zone, cluster_name).items():
+        out[name] = _TPU_STATE_MAP.get(node.get('state', ''),
+                                       common.STATUS_PENDING)
+    gce = tc.ComputeClient(project)
+    for inst in gce.list_instances(zone):
+        if (inst.get('labels') or {}).get(_LABEL_CLUSTER) != cluster_name:
+            continue
+        out[inst['name']] = _GCE_STATE_MAP.get(inst.get('status', ''),
+                                               common.STATUS_PENDING)
+    return out
+
+
+def wait_instances(region: str, cluster_name: str, state: str,
+                   timeout: float = 1800) -> None:
+    deadline = time.time() + timeout
+    while True:
+        statuses = query_instances(region, cluster_name)
+        if statuses and all(s == state for s in statuses.values()):
+            return
+        if time.time() > deadline:
+            err = exceptions.ProvisionError(
+                f'{cluster_name}: instances not {state} after '
+                f'{timeout:.0f}s (statuses: {statuses}).')
+            err.blocklist_scope = 'zone'
+            raise err
+        time.sleep(tc.poll_interval())
+
+
+def stop_instances(region: str, cluster_name: str) -> None:
+    placement = _placed(cluster_name)
+    if placement is None:
+        return
+    project, zone = placement['project'], placement['zone']
+    tpu = tc.TpuClient(project)
+    for name in _cluster_nodes(tpu, zone, cluster_name):
+        op = tpu.stop_node(zone, name)
+        tpu.wait_operation(op, zone=zone, timeout=600)
+    gce = tc.ComputeClient(project)
+    for inst in gce.list_instances(zone):
+        if (inst.get('labels') or {}).get(_LABEL_CLUSTER) == cluster_name:
+            gce.stop_instance(zone, inst['name'])
+
+
+def terminate_instances(region: str, cluster_name: str) -> None:
+    placement = _placed(cluster_name)
+    if placement is None:
+        return
+    project, zone = placement['project'], placement['zone']
+    tpu = tc.TpuClient(project)
+    # Queued resources first: a pending QR would re-create its node.
+    for qr in tpu.list_queued_resources(zone):
+        qr_name = qr['name'].rsplit('/', 1)[-1]
+        if qr_name.startswith(f'{cluster_name}-qr-'):
+            tpu.delete_queued_resource(zone, qr_name)
+    for name in _cluster_nodes(tpu, zone, cluster_name):
+        tpu.delete_node(zone, name)
+    gce = tc.ComputeClient(project)
+    for inst in gce.list_instances(zone):
+        if (inst.get('labels') or {}).get(_LABEL_CLUSTER) == cluster_name:
+            gce.delete_instance(zone, inst['name'])
+    _drop_placement(cluster_name)
+
+
+def get_cluster_info(region: str, cluster_name: str) -> common.ClusterInfo:
+    placement = _placed(cluster_name)
+    if placement is None:
+        raise exceptions.ClusterDoesNotExist(cluster_name)
+    project, zone = placement['project'], placement['zone']
+    tpu = tc.TpuClient(project)
+    nodes = _cluster_nodes(tpu, zone, cluster_name)
+    hosts: List[common.HostInfo] = []
+    accelerator = None
+    chips_per_host = 0
+    if nodes:
+        rank = 0
+        for node_idx in sorted(nodes):
+            node = nodes[node_idx]
+            accelerator = node.get('acceleratorType', accelerator)
+            endpoints = node.get('networkEndpoints') or []
+            for worker_idx, ep in enumerate(endpoints):
+                hosts.append(common.HostInfo(
+                    instance_id=f'{node_idx}-w{worker_idx}',
+                    rank=rank,
+                    internal_ip=ep.get('ipAddress', ''),
+                    external_ip=(ep.get('accessConfig') or {}).get(
+                        'externalIp'),
+                ))
+                rank += 1
+        chips = {'v2': 4, 'v3': 4, 'v4': 4, 'v5p': 4,
+                 'v5litepod': 8, 'v6e': 8}
+        for gen, c in chips.items():
+            if accelerator and accelerator.startswith(gen):
+                chips_per_host = c
+    else:
+        gce = tc.ComputeClient(project)
+        rank = 0
+        for inst in sorted(gce.list_instances(zone),
+                           key=lambda i: i['name']):
+            if (inst.get('labels') or {}).get(_LABEL_CLUSTER) != \
+                    cluster_name:
+                continue
+            nic = (inst.get('networkInterfaces') or [{}])[0]
+            access = (nic.get('accessConfigs') or [{}])[0]
+            hosts.append(common.HostInfo(
+                instance_id=inst['name'], rank=rank,
+                internal_ip=nic.get('networkIP', ''),
+                external_ip=access.get('natIP')))
+            rank += 1
+    if not hosts:
+        raise exceptions.ClusterDoesNotExist(cluster_name)
+    return common.ClusterInfo(
+        cluster_name=cluster_name,
+        provider_name='gcp',
+        region=region,
+        zone=zone,
+        hosts=hosts,
+        head_instance_id=hosts[0].instance_id,
+        chips_per_host=chips_per_host,
+        accelerator=accelerator,
+        ssh_user='skytpu',
+        provider_config={'project_id': project, 'zone': zone},
+    )

@@ -1,0 +1,310 @@
+"""GCP TPU provisioner tests against a scripted fake transport.
+
+Hermetic counterpart of the reference's googleapiclient-mocked tests for
+``sky/provision/gcp/instance_utils.py:1191-1607``: the fake cloud keeps
+node/queued-resource state in memory and can inject stockouts, quota
+errors, queued-forever, and preemption per zone.
+"""
+import re
+
+import pytest
+
+from skypilot_tpu import exceptions
+from skypilot_tpu.provision import common
+from skypilot_tpu.provision.gcp import instance as gcp_instance
+from skypilot_tpu.provision.gcp import tpu_client as tc
+
+pytestmark = pytest.mark.usefixtures('tmp_state_dir', 'fast_gcp')
+
+
+@pytest.fixture()
+def fast_gcp(monkeypatch):
+    monkeypatch.setenv('SKYTPU_GCP_POLL', '0.01')
+    monkeypatch.setenv('SKYTPU_GCP_QR_TIMEOUT', '0.3')
+
+
+class FakeGcp:
+    """In-memory TPU + Compute API with per-zone behavior injection."""
+
+    def __init__(self):
+        self.nodes = {}              # (zone, id) -> node dict
+        self.qrs = {}                # (zone, id) -> qr dict
+        self.instances = {}          # (zone, name) -> gce dict
+        self.fail_create = {}        # zone -> (status, payload)
+        self.qr_script = {}          # zone -> list of states to emit
+        self.requests = []
+
+    def transport(self, method, url, body):
+        self.requests.append((method, url))
+        m = re.search(r'/locations/([^/]+)/nodes\?nodeId=([^&]+)', url)
+        if m and method == 'POST':
+            zone, node_id = m.groups()
+            if zone in self.fail_create:
+                return self.fail_create[zone]
+            self.nodes[(zone, node_id)] = {
+                'name': f'projects/p/locations/{zone}/nodes/{node_id}',
+                'state': 'READY',
+                'acceleratorType': body.get('acceleratorType', 'v5e-8'),
+                'labels': body.get('labels', {}),
+                'networkEndpoints': [
+                    {'ipAddress': f'10.0.{len(self.nodes)}.{i}'}
+                    for i in range(2)],
+            }
+            return 200, {'name': f'operations/op-{node_id}', 'done': True}
+        m = re.search(r'/locations/([^/]+)/nodes/([^/:?]+)$', url)
+        if m:
+            zone, node_id = m.groups()
+            node = self.nodes.get((zone, node_id))
+            if method == 'GET':
+                return (200, node) if node else (404, {})
+            if method == 'DELETE':
+                if node is None:
+                    return 404, {}
+                del self.nodes[(zone, node_id)]
+                return 200, {'name': 'operations/del', 'done': True}
+        m = re.search(r'/locations/([^/]+)/nodes/([^/]+):(stop|start)$', url)
+        if m:
+            zone, node_id, verb = m.groups()
+            node = self.nodes[(zone, node_id)]
+            node['state'] = 'STOPPED' if verb == 'stop' else 'READY'
+            return 200, {'name': 'operations/sv', 'done': True}
+        m = re.search(r'/locations/([^/]+)/nodes$', url)
+        if m and method == 'GET':
+            zone = m.group(1)
+            return 200, {'nodes': [n for (z, _), n in self.nodes.items()
+                                   if z == zone]}
+        m = re.search(
+            r'/locations/([^/]+)/queuedResources\?queuedResourceId=([^&]+)',
+            url)
+        if m and method == 'POST':
+            zone, qr_id = m.groups()
+            if zone in self.fail_create:
+                return self.fail_create[zone]
+            script = list(self.qr_script.get(zone, ['ACTIVE']))
+            self.qrs[(zone, qr_id)] = {
+                'name': f'projects/p/locations/{zone}/queuedResources/'
+                        f'{qr_id}',
+                'script': script,
+                'body': body,
+            }
+            return 200, {'name': f'operations/qr-{qr_id}', 'done': True}
+        m = re.search(r'/locations/([^/]+)/queuedResources/([^/?]+)', url)
+        if m:
+            zone, qr_id = m.groups()
+            qr = self.qrs.get((zone, qr_id))
+            if method == 'GET':
+                if qr is None:
+                    return 404, {}
+                state = (qr['script'].pop(0) if len(qr['script']) > 1
+                         else qr['script'][0])
+                if state == 'ACTIVE':
+                    # QR turning ACTIVE materializes its node.
+                    spec = qr['body']['tpu']['nodeSpec'][0]
+                    node_id = spec['nodeId']
+                    if (zone, node_id) not in self.nodes:
+                        node = dict(spec['node'])
+                        node.update({
+                            'name': f'projects/p/locations/{zone}/nodes/'
+                                    f'{node_id}',
+                            'state': 'READY',
+                            'acceleratorType': node.get(
+                                'acceleratorType', 'v5e-8'),
+                            'networkEndpoints': [
+                                {'ipAddress': f'10.1.0.{i}'}
+                                for i in range(2)],
+                        })
+                        self.nodes[(zone, node_id)] = node
+                return 200, {'state': {'state': state}}
+            if method == 'DELETE':
+                if qr is None:
+                    return 404, {}
+                del self.qrs[(zone, qr_id)]
+                return 200, {'name': 'operations/qrdel', 'done': True}
+        m = re.search(r'/locations/([^/]+)/queuedResources$', url)
+        if m and method == 'GET':
+            zone = m.group(1)
+            return 200, {'queuedResources': [
+                q for (z, _), q in self.qrs.items() if z == zone]}
+        if '/zones/' in url and url.endswith('/instances') and \
+                method == 'GET':
+            zone = url.split('/zones/')[1].split('/')[0]
+            return 200, {'items': [i for (z, _), i in
+                                   self.instances.items() if z == zone]}
+        if re.search(r'operations/', url):
+            return 200, {'name': url.rsplit('/', 1)[-1], 'done': True}
+        raise AssertionError(f'unhandled fake request: {method} {url}')
+
+
+@pytest.fixture()
+def fake():
+    gcp = FakeGcp()
+    tc.set_transport_factory(lambda: gcp.transport)
+    yield gcp
+    tc.set_transport_factory(None)
+
+
+def _config(use_spot=False, count=1):
+    return common.ProvisionConfig(
+        provider_config={'project_id': 'proj'},
+        node_config={
+            'kind': 'tpu_vm',
+            'accelerator': 'tpu-v5e-16',
+            'accelerator_type': 'v5litepod-16',
+            'runtime_version': 'tpu-ubuntu2204-base',
+            'hosts_per_node': 2,
+            'chips_per_host': 8,
+            'use_spot': use_spot,
+            'labels': {},
+        },
+        count=count)
+
+
+class TestOnDemand:
+
+    def test_create_query_info_terminate(self, fake):
+        record = gcp_instance.run_instances('us-central1', 'us-central1-a',
+                                            'c1', _config())
+        assert record.created_instance_ids == ['c1-0']
+        assert record.head_instance_id == 'c1-0'
+
+        statuses = gcp_instance.query_instances('us-central1', 'c1')
+        assert statuses == {'c1-0': common.STATUS_RUNNING}
+
+        info = gcp_instance.get_cluster_info('us-central1', 'c1')
+        assert info.num_hosts == 2                    # 2 workers per slice
+        assert [h.rank for h in info.hosts] == [0, 1]
+        assert info.chips_per_host == 8               # v5litepod
+        assert info.accelerator == 'v5litepod-16'
+
+        gcp_instance.terminate_instances('us-central1', 'c1')
+        assert gcp_instance.query_instances('us-central1', 'c1') == {}
+
+    def test_multislice_creates_n_nodes(self, fake):
+        record = gcp_instance.run_instances('us-central1', 'us-central1-a',
+                                            'ms', _config(count=2))
+        assert record.created_instance_ids == ['ms-0', 'ms-1']
+        info = gcp_instance.get_cluster_info('us-central1', 'ms')
+        assert info.num_hosts == 4                    # 2 slices x 2 workers
+
+    def test_stockout_maps_to_zone_scoped_error(self, fake):
+        fake.fail_create['us-central1-a'] = (
+            409, {'error': {'message':
+                            'There is no more capacity in the zone'}})
+        with pytest.raises(exceptions.InsufficientCapacityError) as ei:
+            gcp_instance.run_instances('us-central1', 'us-central1-a',
+                                       'so', _config())
+        assert ei.value.blocklist_scope == 'zone'
+
+    def test_quota_maps_to_region_scoped_error(self, fake):
+        fake.fail_create['us-central1-a'] = (
+            429, {'error': {'message': 'Quota exceeded for TPU v5e cores'}})
+        with pytest.raises(exceptions.QuotaExceededError) as ei:
+            gcp_instance.run_instances('us-central1', 'us-central1-a',
+                                       'qt', _config())
+        assert ei.value.blocklist_scope == 'region'
+
+    def test_partial_failure_cleans_up(self, fake):
+        """Gang semantics: node 0 creates, node 1 stockouts -> node 0 is
+        deleted before the error propagates."""
+        real = fake.transport
+
+        def flaky(method, url, body):
+            if 'nodes?nodeId=pf-1' in url:
+                return 409, {'error': {'message': 'out of capacity'}}
+            return real(method, url, body)
+        tc.set_transport_factory(lambda: flaky)
+        with pytest.raises(exceptions.InsufficientCapacityError):
+            gcp_instance.run_instances('us-central1', 'us-central1-a',
+                                       'pf', _config(count=2))
+        assert ('us-central1-a', 'pf-0') not in fake.nodes
+
+    def test_dead_node_is_recreated_on_relaunch(self, fake):
+        gcp_instance.run_instances('us-central1', 'us-central1-a', 'dn',
+                                   _config())
+        fake.nodes[('us-central1-a', 'dn-0')]['state'] = 'PREEMPTED'
+        record = gcp_instance.run_instances('us-central1', 'us-central1-a',
+                                            'dn', _config())
+        assert record.created_instance_ids == ['dn-0']
+        statuses = gcp_instance.query_instances('us-central1', 'dn')
+        assert statuses == {'dn-0': common.STATUS_RUNNING}
+
+
+class TestQueuedResources:
+
+    def test_spot_goes_active_via_qr(self, fake):
+        fake.qr_script['us-central1-a'] = [
+            'ACCEPTED', 'PROVISIONING', 'ACTIVE']
+        record = gcp_instance.run_instances('us-central1', 'us-central1-a',
+                                            'sp', _config(use_spot=True))
+        assert record.created_instance_ids == ['sp-0']
+        statuses = gcp_instance.query_instances('us-central1', 'sp')
+        assert statuses == {'sp-0': common.STATUS_RUNNING}
+        # The QR request carried the spot flag.
+        qr_posts = [u for m, u in fake.requests
+                    if m == 'POST' and 'queuedResources?' in u]
+        assert len(qr_posts) == 1
+
+    def test_qr_failed_state_fails_over(self, fake):
+        fake.qr_script['us-central1-a'] = ['ACCEPTED', 'FAILED', 'FAILED']
+        with pytest.raises(exceptions.InsufficientCapacityError) as ei:
+            gcp_instance.run_instances('us-central1', 'us-central1-a',
+                                       'qf', _config(use_spot=True))
+        assert ei.value.blocklist_scope == 'zone'
+        assert not fake.qrs                  # QR deleted on failure
+
+    def test_queued_too_long_times_out_and_cleans_up(self, fake):
+        fake.qr_script['us-central1-a'] = ['ACCEPTED', 'ACCEPTED']
+        with pytest.raises(exceptions.QueuedResourceTimeoutError) as ei:
+            gcp_instance.run_instances('us-central1', 'us-central1-a',
+                                       'ql', _config(use_spot=True))
+        assert ei.value.blocklist_scope == 'zone'
+        assert not fake.qrs                  # abandoned QR deleted
+
+    def test_preempted_node_reported_terminated(self, fake):
+        gcp_instance.run_instances('us-central1', 'us-central1-a', 'pr',
+                                   _config())
+        fake.nodes[('us-central1-a', 'pr-0')]['state'] = 'PREEMPTED'
+        statuses = gcp_instance.query_instances('us-central1', 'pr')
+        assert statuses == {'pr-0': common.STATUS_TERMINATED}
+
+    def test_terminate_deletes_pending_qrs_first(self, fake):
+        fake.qr_script['us-central1-a'] = ['ACCEPTED', 'PROVISIONING',
+                                           'ACTIVE']
+        gcp_instance.run_instances('us-central1', 'us-central1-a', 'td',
+                                   _config(use_spot=True))
+        gcp_instance.terminate_instances('us-central1', 'td')
+        assert not fake.qrs
+        assert not [k for k in fake.nodes if k[1].startswith('td-')]
+
+
+class TestLifecycle:
+
+    def test_stop_and_query(self, fake):
+        gcp_instance.run_instances('us-central1', 'us-central1-a', 'st',
+                                   _config())
+        gcp_instance.stop_instances('us-central1', 'st')
+        statuses = gcp_instance.query_instances('us-central1', 'st')
+        assert statuses == {'st-0': common.STATUS_STOPPED}
+
+    def test_resume_stopped_node(self, fake):
+        gcp_instance.run_instances('us-central1', 'us-central1-a', 're',
+                                   _config())
+        gcp_instance.stop_instances('us-central1', 're')
+        record = gcp_instance.run_instances('us-central1', 'us-central1-a',
+                                            're', _config())
+        assert record.resumed_instance_ids == ['re-0']
+        assert record.created_instance_ids == []
+        statuses = gcp_instance.query_instances('us-central1', 're')
+        assert statuses == {'re-0': common.STATUS_RUNNING}
+
+    def test_wait_instances_reaches_running(self, fake):
+        gcp_instance.run_instances('us-central1', 'us-central1-a', 'wi',
+                                   _config())
+        gcp_instance.wait_instances('us-central1', 'wi',
+                                    common.STATUS_RUNNING, timeout=5)
+
+    def test_ops_on_unknown_cluster_are_safe(self, fake):
+        assert gcp_instance.query_instances('r', 'nope') == {}
+        gcp_instance.terminate_instances('r', 'nope')
+        with pytest.raises(exceptions.ClusterDoesNotExist):
+            gcp_instance.get_cluster_info('r', 'nope')
