@@ -29,6 +29,9 @@ class ModelConfig:
     # MoE fields (None => dense FFN)
     n_experts: Optional[int] = None
     n_experts_per_token: int = 2
+    # Expert buffer size = tokens * k / E * this factor (GShard capacity;
+    # tokens routed past a full expert are dropped to the residual path).
+    moe_capacity_factor: float = 1.25
     # Remat policy for training: 'none' | 'block' (checkpoint each layer)
     remat: str = 'block'
 

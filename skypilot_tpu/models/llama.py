@@ -83,7 +83,7 @@ def param_logical_axes(cfg: ModelConfig) -> Params:
 
     The leading scan axis is 'layers' (never sharded)."""
     axes: Params = {
-        'embed': ('vocab', 'embed'),
+        'embed': ('vocab_in', 'embed'),
         'unembed': ('embed', 'vocab'),
         'final_norm': ('norm',),
         'layers': {

@@ -4,18 +4,18 @@ The reference only *launches* MoE models via recipes (``llm/mixtral/``); the
 expert parallelism itself lives in the launched framework. Here it is
 in-tree: experts are sharded over the mesh's expert axis (the ``'expert'``
 logical axis maps to ``('fsdp','sp')`` by default — see
-``parallel.mesh.DEFAULT_RULES``) so each device holds ``E/ep`` experts, and
-routing uses a dense masked dispatch that XLA turns into a single batched
-einsum per projection.
+``parallel.mesh.DEFAULT_RULES``) so each device holds ``E/ep`` experts.
 
-Round-1 note: dense dispatch computes every expert on every token (masked to
-zero for unrouted pairs). This keeps the HLO static-shaped and MXU-friendly
-and parallelizes over the expert axis, at k/E efficiency vs ideal top-k
-dispatch; a capacity-based ragged dispatch (GShard-style) is the planned
-optimization.
+Dispatch is GShard-style capacity-based top-k: each expert processes a
+fixed [capacity, d] buffer (capacity = tokens*k/E*capacity_factor), so
+per-step expert FLOPs scale with k/E instead of computing every expert on
+every token. Shapes stay static (XLA/MXU-friendly); tokens routed past a
+full expert buffer are dropped for that choice and ride the residual
+connection (standard GShard semantics).
 """
 from __future__ import annotations
 
+import math
 from typing import Any, Dict
 
 import jax
@@ -54,34 +54,99 @@ def moe_logical_axes(cfg: ModelConfig) -> Params:
     }
 
 
+def expert_capacity(num_tokens: int, cfg: ModelConfig) -> int:
+    """Per-expert buffer size for a dispatch group: tokens*k/E scaled by
+    the capacity factor, never below k (tiny groups must still fit one
+    token's k choices)."""
+    ideal = num_tokens * cfg.n_experts_per_token / cfg.n_experts
+    return max(cfg.n_experts_per_token,
+               int(math.ceil(ideal * cfg.moe_capacity_factor)))
+
+
+# Tokens are dispatched within fixed-size groups (GShard G×S layout): the
+# one-hot dispatch tensor is [groups, GROUP, k, E, C] with C ∝ GROUP, so
+# its memory is linear in total tokens instead of quadratic.
+_MOE_GROUP_SIZE = 512
+
+
 def moe_ffn(layer: Params, x: jax.Array, cfg: ModelConfig):
-    """Top-k routed SwiGLU experts.
+    """Capacity-based top-k routed SwiGLU experts (GShard dispatch).
 
     x: [b, s, d] -> ([b, s, d], aux_loss scalar). The aux loss is the
     Switch-style load-balancing term; the trainer adds it to the CE loss
-    with ``TrainConfig.moe_aux_weight``."""
+    with ``TrainConfig.moe_aux_weight``.
+
+    Each expert computes a fixed [capacity, d] buffer; the dispatch and
+    combine are one-hot einsums, so the HLO stays static-shaped while
+    expert FLOPs scale with k/E (vs the all-experts dense fallback).
+    Assignments that overflow an expert's buffer are dropped (their
+    combine weight is zero — the token's residual passes through).
+    """
     k = cfg.n_experts_per_token
     E = cfg.n_experts
+    b, s, d = x.shape
+    T = b * s
+    xt = x.reshape(T, d)
 
-    router_logits = jnp.einsum('bsd,de->bse', x, layer['router'],
+    router_logits = jnp.einsum('td,de->te', xt, layer['router'],
                                preferred_element_type=jnp.float32)
     # Top-k routing weights, renormalized over the selected experts
     # (Mixtral convention).
-    topk_vals, topk_idx = jax.lax.top_k(router_logits, k)      # [b,s,k]
-    topk_w = jax.nn.softmax(topk_vals, axis=-1)                # [b,s,k]
-    # Dense combine weights [b, s, E]: zero for unrouted experts.
-    onehot = jax.nn.one_hot(topk_idx, E, dtype=jnp.float32)    # [b,s,k,E]
-    combine = jnp.einsum('bsk,bske->bse', topk_w, onehot)
+    topk_vals, topk_idx = jax.lax.top_k(router_logits, k)      # [T, k]
+    topk_w = jax.nn.softmax(topk_vals, axis=-1)                # [T, k]
+    aux = load_balancing_loss(router_logits.reshape(b, s, E),
+                              topk_idx.reshape(b, s, k), E)
 
-    # Dense expert compute, sharded over the expert axis.
-    gate = jnp.einsum('bsd,edf->ebsf', x, layer['moe_gate'])
-    up = jnp.einsum('bsd,edf->ebsf', x, layer['moe_up'])
+    # Pad T up to a multiple of the group size; padded tokens carry zero
+    # routing weight so they never claim a buffer slot's output.
+    group = min(_MOE_GROUP_SIZE, T)
+    pad = (-T) % group
+    Tp = T + pad
+    G = Tp // group
+    C = expert_capacity(group, cfg)
+    if pad:
+        xt = jnp.pad(xt, ((0, pad), (0, 0)))
+        topk_idx = jnp.pad(topk_idx, ((0, pad), (0, 0)))
+        topk_w = jnp.pad(topk_w, ((0, pad), (0, 0)))   # zeros: no weight
+
+    # Slot assignment per group: each (token, choice) pair's running
+    # count within its expert is its buffer position; pairs at position
+    # >= capacity (and padding) drop to the residual path.
+    assign = jax.nn.one_hot(topk_idx, E, dtype=jnp.int32)   # [Tp, k, E]
+    assign = assign.reshape(G, group * k, E)                # token-major
+    position = jnp.cumsum(assign, axis=1) * assign - assign
+    slot = position.sum(-1)                                 # [G, group*k]
+    valid = topk_w.reshape(G, group * k) > 0
+    kept = (slot < C) & valid
+
+    # dispatch [G, group, k, E, C]: one-hot of (expert, slot) per pair.
+    slot_oh = jax.nn.one_hot(slot, C, dtype=x.dtype) * \
+        kept[..., None].astype(x.dtype)
+    dispatch = (assign.astype(x.dtype)[..., None] *
+                slot_oh[..., None, :]).reshape(G, group, k, E, C)
+    dispatch_mask = dispatch.sum(2)                         # [G,group,E,C]
+    combine = jnp.einsum('gtk,gtkec->gtec',
+                         topk_w.reshape(G, group, k).astype(x.dtype),
+                         dispatch)
+
+    # Gather expert buffers, compute, scatter back — sharded over the
+    # expert axis, batched over groups.
+    xg = xt.reshape(G, group, d)
+    expert_in = jnp.einsum('gtec,gtd->gecd', dispatch_mask, xg)
+    expert_in = _shard_moe(expert_in, None, 'expert', None, 'embed')
+    gate = jnp.einsum('gecd,edf->gecf', expert_in, layer['moe_gate'])
+    up = jnp.einsum('gecd,edf->gecf', expert_in, layer['moe_up'])
     h = jax.nn.silu(gate.astype(jnp.float32)).astype(x.dtype) * up
-    expert_out = jnp.einsum('ebsf,efd->ebsd', h, layer['moe_down'])
-    out = jnp.einsum('ebsd,bse->bsd', expert_out,
-                     combine.astype(expert_out.dtype))
-    aux = load_balancing_loss(router_logits, topk_idx, E)
-    return out, aux
+    h = _shard_moe(h, None, 'expert', None, 'mlp')
+    expert_out = jnp.einsum('gecf,efd->gecd', h, layer['moe_down'])
+    out = jnp.einsum('gtec,gecd->gtd', combine, expert_out)
+    out = out.reshape(Tp, d)[:T]
+    return out.reshape(b, s, d), aux
+
+
+def _shard_moe(val: jax.Array, *logical_axes) -> jax.Array:
+    from skypilot_tpu.models.llama import _shard
+    return _shard(val, *logical_axes)
 
 
 def load_balancing_loss(router_logits: jax.Array, topk_idx: jax.Array,

@@ -10,7 +10,6 @@ folded to save bandwidth).
 """
 from __future__ import annotations
 
-import functools
 from typing import Optional
 
 import jax
@@ -177,7 +176,6 @@ def ring_decode_attention(
     return out.reshape(b, 1, h, d)
 
 
-@functools.partial(jax.jit, static_argnames=('causal', 'impl'))
 def attention(
     q: jax.Array,
     k: jax.Array,
@@ -190,11 +188,28 @@ def attention(
 ) -> jax.Array:
     """Dispatching attention entry point used by the models.
 
-    impl: 'auto' | 'xla' | 'flash'. 'auto' picks flash on TPU when the
-    shape fits the kernel's tiling (training-style full-sequence causal
-    attention); decode (sq==1) always uses the XLA path, which fuses into
-    a single-pass softmax anyway.
+    impl: 'auto' | 'xla' | 'flash' | 'ring'. 'auto' picks flash on TPU
+    when the shape fits the kernel's tiling (training-style full-sequence
+    causal attention); decode (sq==1) always uses the XLA path, which
+    fuses into a single-pass softmax anyway. 'ring' shards the sequence
+    over the sp mesh axis.
+
+    Deliberately NOT wrapped in jax.jit: the 'ring' dispatch reads the
+    ambient mesh context at trace time, and a jit cache here is not keyed
+    on that context — a cached no-mesh trace would silently serve the
+    non-ring path inside a mesh. Callers jit the surrounding computation.
     """
+    if impl == 'ring':
+        # Sequence-parallel exact attention over the sp mesh axis
+        # (training/prefill; decode never shards its single query).
+        assert q_offset is None and kv_len is None, (
+            'ring attention is a full-sequence path; decode masking '
+            'args are not supported')
+        from skypilot_tpu.ops import ring_attention as ring
+        mesh = ring.current_mesh()
+        if mesh is not None and mesh.shape.get('sp', 1) > 1:
+            return ring.ring_attention(q, k, v, mesh, causal=causal)
+        return reference_attention(q, k, v, causal=causal)
     use_flash = False
     if impl == 'flash':
         use_flash = True

@@ -1,0 +1,121 @@
+"""Ring attention: exact attention over a sequence sharded across the
+``sp`` mesh axis (long-context / context parallelism).
+
+SURVEY §5 mandate (absent from the reference, which delegates long-context
+to external engines): each sp-rank holds one contiguous block of the
+sequence; KV blocks rotate around the ring via ``lax.ppermute`` (ICI
+neighbor hops) while a numerically-stable blockwise softmax accumulates —
+the same online (m, l, acc) recurrence as flash attention, so the full
+[s, s] score matrix never materializes and per-device memory stays
+O(s_local). After sp_size hops every rank has attended to the whole
+sequence exactly once.
+
+Causal masking uses global positions (rank * s_local + local offset).
+Blocks strictly in the future contribute nothing (fully masked); they are
+still computed — a ~2x FLOPs overhead at large sp that a
+skip-and-rebalance (striped/zigzag ring) variant can remove later.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental.shard_map import shard_map
+
+_NEG_INF = -1e30
+
+
+def _ring_body(q: jax.Array, k: jax.Array, v: jax.Array, *,
+               axis_name: str, axis_size: int, causal: bool,
+               scale: float) -> jax.Array:
+    """Per-shard computation (runs under shard_map).
+
+    q: [b, s, h, d]; k, v: [b, s, hkv, d] — the LOCAL sequence blocks.
+    """
+    b, s, h, d = q.shape
+    hkv = k.shape[2]
+    group = h // hkv
+    qg = (q.astype(jnp.float32) * scale).reshape(b, s, hkv, group, d)
+
+    my_rank = lax.axis_index(axis_name)
+    q_pos = my_rank * s + jnp.arange(s)                 # global q positions
+
+    m = jnp.full((b, hkv, group, s, 1), _NEG_INF, jnp.float32)
+    l = jnp.zeros((b, hkv, group, s, 1), jnp.float32)
+    acc = jnp.zeros((b, s, hkv, group, d), jnp.float32)
+
+    perm = [(i, (i + 1) % axis_size) for i in range(axis_size)]
+
+    def step(carry, step_idx):
+        m, l, acc, k_blk, v_blk = carry
+        # After `step_idx` forward rotations we hold the block that
+        # originated at rank (my_rank - step_idx).
+        blk_rank = (my_rank - step_idx) % axis_size
+        logits = jnp.einsum('bqhgd,bkhd->bhgqk', qg,
+                            k_blk.astype(jnp.float32))
+        if causal:
+            k_pos = blk_rank * s + jnp.arange(s)
+            mask = k_pos[None, None, None, None, :] <= \
+                q_pos[None, None, None, :, None]
+            logits = jnp.where(mask, logits, _NEG_INF)
+        m_new = jnp.maximum(m, jnp.max(logits, -1, keepdims=True))
+        p = jnp.exp(logits - m_new)
+        corr = jnp.exp(m - m_new)
+        l_new = l * corr + jnp.sum(p, -1, keepdims=True)
+        acc_new = acc * corr.transpose(0, 3, 1, 2, 4) + jnp.einsum(
+            'bhgqk,bkhd->bqhgd', p, v_blk.astype(jnp.float32))
+        k_nxt = lax.ppermute(k_blk, axis_name, perm)
+        v_nxt = lax.ppermute(v_blk, axis_name, perm)
+        return (m_new, l_new, acc_new, k_nxt, v_nxt), None
+
+    (m, l, acc, _, _), _ = lax.scan(
+        step, (m, l, acc, k, v), jnp.arange(axis_size))
+    out = acc / jnp.maximum(l, 1e-30).transpose(0, 3, 1, 2, 4)
+    return out.reshape(b, s, h, d).astype(q.dtype)
+
+
+def ring_attention(
+    q: jax.Array,                      # [b, S, h, d] global (sharded) arrays
+    k: jax.Array,                      # [b, S, hkv, d]
+    v: jax.Array,
+    mesh: jax.sharding.Mesh,
+    *,
+    causal: bool = True,
+    scale: Optional[float] = None,
+    axis_name: str = 'sp',
+    rules=None,
+) -> jax.Array:
+    """Exact attention with the sequence dimension sharded over
+    ``axis_name``. Call inside (or outside) jit with a mesh whose
+    ``axis_name`` size divides the sequence length."""
+    from skypilot_tpu.parallel.mesh import spec_for
+    sp = mesh.shape[axis_name]
+    scale = scale if scale is not None else q.shape[-1] ** -0.5
+    if sp == 1:
+        from skypilot_tpu.ops.attention import reference_attention
+        return reference_attention(q, k, v, causal=causal, scale=scale)
+    qspec = spec_for(('batch', 'seq', 'heads', 'head_dim'), rules)
+    kspec = spec_for(('batch', 'seq', 'kv_heads', 'head_dim'), rules)
+    fn = shard_map(
+        functools.partial(_ring_body, axis_name=axis_name, axis_size=sp,
+                          causal=causal, scale=scale),
+        mesh=mesh,
+        in_specs=(qspec, kspec, kspec),
+        out_specs=qspec,
+        check_rep=False,
+    )
+    return fn(q, k, v)
+
+
+def current_mesh() -> Optional[jax.sharding.Mesh]:
+    """The active `with mesh:` context, if any (no public jax API; same
+    probe as llama._in_mesh_context — fails open to None)."""
+    try:
+        from jax._src import mesh as mesh_src
+        env_mesh = mesh_src.thread_resources.env.physical_mesh
+        return None if env_mesh.empty else env_mesh
+    except Exception:  # pylint: disable=broad-except
+        return None

@@ -95,6 +95,10 @@ DEFAULT_RULES: LogicalRules = {
     'head_dim': None,
     'mlp': 'tp',
     'vocab': 'tp',
+    # Input embedding table: vocab dim unsharded (a tp-sharded table turns
+    # the token gather into an SPMD full-rematerialization; the table's
+    # memory is carried by the fsdp-sharded embed dim instead).
+    'vocab_in': None,
     'expert': ('fsdp', 'sp'),   # ep folded over fsdp×sp
     'norm': None,
     'layers': None,
@@ -103,8 +107,12 @@ DEFAULT_RULES: LogicalRules = {
 
 def spec_for(logical_axes: Sequence[Optional[str]],
              rules: Optional[LogicalRules] = None) -> PartitionSpec:
-    """Map a tuple of logical axis names to a PartitionSpec."""
-    rules = rules or DEFAULT_RULES
+    """Map a tuple of logical axis names to a PartitionSpec.
+
+    Custom ``rules`` are OVERRIDES merged onto DEFAULT_RULES, so a user
+    dict doesn't break when the model layer introduces a new logical
+    axis (e.g. 'vocab_in'); unknown axes still raise (typo guard)."""
+    rules = {**DEFAULT_RULES, **rules} if rules else DEFAULT_RULES
     parts = []
     used = set()
     for ax in logical_axes:

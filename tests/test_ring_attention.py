@@ -1,0 +1,102 @@
+"""Ring attention on the virtual 8-device CPU mesh: exactness vs the
+single-device reference, GQA, causal/non-causal, and the trainer
+integration the SURVEY §5 long-context mandate asks for."""
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from skypilot_tpu.models import configs
+from skypilot_tpu.ops.attention import reference_attention
+from skypilot_tpu.ops.ring_attention import ring_attention
+from skypilot_tpu.parallel import mesh as mesh_lib
+from skypilot_tpu.train.trainer import TrainConfig, Trainer
+
+
+def _mesh(sp: int, dp: int = 1) -> jax.sharding.Mesh:
+    spec = mesh_lib.MeshSpec(dp=dp, fsdp=8 // (sp * dp), sp=sp, tp=1)
+    return mesh_lib.make_mesh(spec)
+
+
+def _rand_qkv(b=4, s=32, h=4, hkv=4, d=16, dtype=jnp.float32):
+    ks = jax.random.split(jax.random.PRNGKey(0), 3)
+    q = jax.random.normal(ks[0], (b, s, h, d), dtype)
+    k = jax.random.normal(ks[1], (b, s, hkv, d), dtype)
+    v = jax.random.normal(ks[2], (b, s, hkv, d), dtype)
+    return q, k, v
+
+
+@pytest.mark.parametrize('sp', [2, 4])
+@pytest.mark.parametrize('causal', [True, False])
+def test_matches_reference(sp, causal):
+    mesh = _mesh(sp)
+    q, k, v = _rand_qkv()
+    ref = reference_attention(q, k, v, causal=causal)
+    with mesh:
+        out = jax.jit(lambda q, k, v: ring_attention(
+            q, k, v, mesh, causal=causal))(q, k, v)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_gqa_grouped_heads():
+    mesh = _mesh(sp=4)
+    q, k, v = _rand_qkv(h=8, hkv=2)
+    ref = reference_attention(q, k, v, causal=True)
+    with mesh:
+        out = ring_attention(q, k, v, mesh, causal=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_sp1_falls_back_to_reference():
+    mesh = _mesh(sp=1)
+    q, k, v = _rand_qkv()
+    with mesh:
+        out = ring_attention(q, k, v, mesh, causal=True)
+    ref = reference_attention(q, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_sharded_inputs_inside_jit():
+    """The real call pattern: sharded global arrays, ring inside jit."""
+    mesh = _mesh(sp=4, dp=2)
+    q, k, v = _rand_qkv(b=4, s=64)
+    qs = jax.device_put(q, jax.sharding.NamedSharding(
+        mesh, mesh_lib.spec_for(('batch', 'seq', 'heads', 'head_dim'))))
+    ref = reference_attention(q, k, v, causal=True)
+    with mesh:
+        out = jax.jit(lambda q, k, v: ring_attention(
+            q, k, v, mesh, causal=True))(qs, k, v)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+
+
+class TestTrainerIntegration:
+
+    def _loss_after_step(self, attn_impl: str, sp: int) -> float:
+        cfg = dataclasses.replace(configs.TINY, remat='none')
+        trainer = Trainer(
+            cfg,
+            mesh_spec=mesh_lib.MeshSpec(dp=1, fsdp=8 // (sp * 2), sp=sp,
+                                        tp=2),
+            train_config=TrainConfig(warmup_steps=1, total_steps=4,
+                                     attn_impl=attn_impl))
+        state = trainer.init(jax.random.PRNGKey(0))
+        rng = np.random.RandomState(0)
+        data = rng.randint(0, 250, size=(8, 33))
+        batch = {'inputs': jnp.asarray(data[:, :-1], jnp.int32),
+                 'targets': jnp.asarray(data[:, 1:], jnp.int32)}
+        _, metrics = trainer.step(state, batch)
+        return float(metrics['loss'])
+
+    def test_ring_training_matches_xla_attention(self):
+        """Same data, same init: ring-attention loss == xla-path loss.
+        This is the 'seq: sp rule backed by a real kernel path' check —
+        the trainer accepts sp>1 with exact attention semantics."""
+        loss_ring = self._loss_after_step('ring', sp=2)
+        loss_xla = self._loss_after_step('xla', sp=2)
+        assert abs(loss_ring - loss_xla) < 2e-2, (loss_ring, loss_xla)
