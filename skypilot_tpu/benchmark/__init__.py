@@ -1,0 +1,9 @@
+"""Benchmark subsystem: fan a task out over candidate resources and
+compare duration/cost (reference ``sky/benchmark/``)."""
+from skypilot_tpu.benchmark.benchmark_utils import (get_benchmark,
+                                                    launch_benchmark,
+                                                    list_benchmarks, summary,
+                                                    teardown)
+
+__all__ = ['get_benchmark', 'launch_benchmark', 'list_benchmarks',
+           'summary', 'teardown']

@@ -1,0 +1,136 @@
+"""Benchmark subsystem: launch one task across candidate resources and
+compare duration/cost.
+
+Role of reference ``sky/benchmark/benchmark_utils.py`` + ``sky bench``:
+fan the same task out to N single-candidate clusters, then aggregate
+per-candidate wall time, price, and (when the task wrote one via the
+callbacks' TimerCallback) steps/sec into a comparison table. State is a
+JSON record per benchmark under ``{state_dir}/benchmarks/``.
+"""
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import Any, Dict, List, Optional
+
+from skypilot_tpu import tpu_logging
+from skypilot_tpu.resources import Resources
+from skypilot_tpu.task import Task
+from skypilot_tpu.utils import common_utils
+
+logger = tpu_logging.init_logger(__name__)
+
+
+def _bench_dir() -> str:
+    d = os.path.join(common_utils.state_dir(), 'benchmarks')
+    os.makedirs(d, exist_ok=True)
+    return d
+
+
+def _bench_path(name: str) -> str:
+    return os.path.join(_bench_dir(), f'{name}.json')
+
+
+def _save(name: str, record: Dict[str, Any]) -> None:
+    with open(_bench_path(name), 'w', encoding='utf-8') as f:
+        json.dump(record, f, indent=1)
+
+
+def get_benchmark(name: str) -> Optional[Dict[str, Any]]:
+    try:
+        with open(_bench_path(name), encoding='utf-8') as f:
+            return json.load(f)
+    except FileNotFoundError:
+        return None
+
+
+def list_benchmarks() -> List[str]:
+    return sorted(p[:-5] for p in os.listdir(_bench_dir())
+                  if p.endswith('.json'))
+
+
+def launch_benchmark(task: Task, candidates: List[Resources],
+                     name: str) -> List[str]:
+    """Launch ``task`` once per candidate resource on clusters
+    ``{name}-{i}``; returns the cluster names. Clusters stay up until
+    ``teardown`` so logs/artifacts can be inspected."""
+    from skypilot_tpu import execution
+    if get_benchmark(name) is not None:
+        raise ValueError(f'Benchmark {name!r} already exists; tear it '
+                         'down first.')
+    # The record is persisted BEFORE the first launch and re-saved after
+    # each one: a mid-loop launch failure must leave already-provisioned
+    # clusters discoverable by `bench show`/`bench down`, not orphaned.
+    record = {'name': name, 'task_name': task.name, 'entries': [],
+              'created_at': time.time()}
+    _save(name, record)
+    clusters = []
+    for i, res in enumerate(candidates):
+        cluster = f'{name}-{i}'
+        bench_task = Task.from_yaml_config(task.to_yaml_config())
+        bench_task.set_resources(res)
+        try:
+            job_id, _ = execution.launch(bench_task, cluster_name=cluster,
+                                         detach_run=True,
+                                         stream_logs=False)
+        except Exception:
+            logger.warning(
+                f'Benchmark candidate {i} ({res}) failed to launch; '
+                f'{len(clusters)} earlier candidate(s) remain up — '
+                f'inspect with `bench show {name}`, clean up with '
+                f'`bench down {name}`.')
+            raise
+        record['entries'].append({
+            'cluster': cluster,
+            'resources': str(res),
+            'job_id': job_id,
+            'launched_at': time.time(),
+        })
+        _save(name, record)
+        clusters.append(cluster)
+    return clusters
+
+
+def summary(name: str) -> List[Dict[str, Any]]:
+    """Per-candidate status/duration/cost rows (reference
+    ``sky bench show``)."""
+    from skypilot_tpu import core
+    record = get_benchmark(name)
+    if record is None:
+        raise ValueError(f'No benchmark named {name!r}.')
+    try:
+        report = {r['name']: r for r in core.cost_report()}
+    except Exception:  # pylint: disable=broad-except
+        report = {}
+    rows = []
+    for entry in record['entries']:
+        row = dict(entry)
+        row.update(status='UNKNOWN', duration_s=None, cost=None)
+        try:
+            jobs = core.queue(entry['cluster'])
+            job = next(j for j in jobs if j['job_id'] == entry['job_id'])
+            row['status'] = job['status']
+            start, end = job.get('start_at'), job.get('end_at')
+            if start:
+                row['duration_s'] = round((end or time.time()) - start, 2)
+        except Exception as e:  # pylint: disable=broad-except
+            row['status'] = f'UNREACHABLE ({type(e).__name__})'
+        if entry['cluster'] in report:
+            row['cost'] = round(report[entry['cluster']]['total_cost'], 4)
+        rows.append(row)
+    return rows
+
+
+def teardown(name: str) -> None:
+    from skypilot_tpu import core
+    record = get_benchmark(name)
+    if record is None:
+        return
+    for entry in record['entries']:
+        try:
+            core.down(entry['cluster'])
+        except Exception as e:  # pylint: disable=broad-except
+            logger.warning(f'bench teardown of {entry["cluster"]} failed: '
+                           f'{e}')
+    os.remove(_bench_path(name))

@@ -1,0 +1,6 @@
+"""Training callbacks (reference ``sky-callback``): step timing the
+benchmark subsystem and users consume."""
+from skypilot_tpu.callbacks.base import (BaseCallback, CallbackList,
+                                         TimerCallback)
+
+__all__ = ['BaseCallback', 'CallbackList', 'TimerCallback']

@@ -1,0 +1,104 @@
+"""Training callbacks: step instrumentation the benchmark subsystem (and
+users) consume.
+
+Role of the reference's ``sky-callback`` package (a pip-installable
+shim apps call per step so ``sky bench`` can estimate time/cost): here
+the in-tree Trainer owns the loop, so callbacks are first-class — a
+``CallbackList`` gets on_step_begin/end and writes a summary file
+(`benchmark_summary.json`) that ``skypilot_tpu.benchmark`` reads, the
+same contract the reference's callback uploads to the benchmark bucket.
+"""
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import Any, Dict, List, Optional
+
+SUMMARY_FILE = 'benchmark_summary.json'
+
+
+class BaseCallback:
+
+    def on_step_begin(self, step: int) -> None:
+        pass
+
+    def on_step_end(self, step: int, metrics: Optional[Dict[str, Any]]
+                    ) -> None:
+        pass
+
+    def on_train_end(self) -> None:
+        pass
+
+
+class TimerCallback(BaseCallback):
+    """Records per-step wall time; writes a rolling summary JSON with
+    total steps, mean step seconds, and estimated steps/sec."""
+
+    def __init__(self, log_dir: Optional[str] = None,
+                 write_every: int = 10):
+        self.log_dir = log_dir or os.environ.get('SKYTPU_BENCHMARK_DIR',
+                                                 '.')
+        self.write_every = write_every
+        self._t0: Optional[float] = None
+        self._first_step_time: Optional[float] = None
+        self._steps = 0
+        self._total = 0.0
+        self._last_metrics: Dict[str, Any] = {}
+
+    def on_step_begin(self, step: int) -> None:
+        self._t0 = time.time()
+        if self._first_step_time is None:
+            self._first_step_time = self._t0
+
+    def on_step_end(self, step: int, metrics: Optional[Dict[str, Any]]
+                    ) -> None:
+        if self._t0 is None:
+            return
+        self._steps += 1
+        self._total += time.time() - self._t0
+        if metrics:
+            self._last_metrics = {
+                k: float(v) for k, v in metrics.items()
+                if isinstance(v, (int, float)) or hasattr(v, 'item')}
+        if self._steps % self.write_every == 0:
+            self.write_summary()
+
+    def on_train_end(self) -> None:
+        self.write_summary()
+
+    def summary(self) -> Dict[str, Any]:
+        mean = self._total / self._steps if self._steps else 0.0
+        return {
+            'num_steps': self._steps,
+            'mean_step_seconds': mean,
+            'steps_per_second': 1.0 / mean if mean else 0.0,
+            'started_at': self._first_step_time,
+            'last_metrics': self._last_metrics,
+        }
+
+    def write_summary(self) -> str:
+        os.makedirs(self.log_dir, exist_ok=True)
+        path = os.path.join(self.log_dir, SUMMARY_FILE)
+        with open(path, 'w', encoding='utf-8') as f:
+            json.dump(self.summary(), f, indent=1)
+        return path
+
+
+class CallbackList:
+
+    def __init__(self, callbacks: Optional[List[BaseCallback]] = None):
+        self.callbacks = list(callbacks or [])
+
+    def on_step_begin(self, step: int) -> None:
+        for cb in self.callbacks:
+            cb.on_step_begin(step)
+
+    def on_step_end(self, step: int,
+                    metrics: Optional[Dict[str, Any]] = None) -> None:
+        for cb in self.callbacks:
+            cb.on_step_end(step, metrics)
+
+    def on_train_end(self) -> None:
+        for cb in self.callbacks:
+            cb.on_train_end()

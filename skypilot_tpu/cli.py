@@ -394,6 +394,69 @@ def jobs_logs(job_id, no_follow):
         sky.jobs.tail_logs(job_id, follow=True)
 
 
+# -------------------------------------------------------------------- bench
+@cli.group()
+def bench():
+    """Benchmark a task across candidate resources (``sky bench``)."""
+
+
+@bench.command(name='launch')
+@click.argument('entrypoint', type=click.Path(exists=True))
+@click.option('--benchmark', '-b', 'bench_name', required=True)
+@click.option('--candidate', 'candidates', multiple=True, required=True,
+              metavar='YAML_DICT',
+              help='Candidate resources as YAML, e.g. '
+                   '"{cloud: gcp, tpu: v5e-8}" (repeatable).')
+@click.option('--yes', '-y', is_flag=True)
+@click.option('--env', multiple=True, metavar='KEY=VALUE')
+def bench_launch(entrypoint, bench_name, candidates, yes, env):
+    """Launch the task once per candidate resource."""
+    import yaml as yaml_lib
+
+    from skypilot_tpu import Resources
+    from skypilot_tpu.benchmark import benchmark_utils
+    task = _load_task(entrypoint, env)
+    res = [Resources.from_yaml_config(yaml_lib.safe_load(c))
+           for c in candidates]
+    _confirm(f'Launching benchmark {bench_name!r} on {len(res)} '
+             'candidate(s). Proceed?', yes)
+    clusters = benchmark_utils.launch_benchmark(task, res, bench_name)
+    click.echo(f'Benchmark {bench_name!r} launched on: '
+               f'{", ".join(clusters)}')
+
+
+@bench.command(name='show')
+@click.argument('bench_name')
+def bench_show(bench_name):
+    """Show per-candidate status/duration/cost."""
+    from skypilot_tpu.benchmark import benchmark_utils
+    rows = benchmark_utils.summary(bench_name)
+    table = [[r['cluster'], r['resources'], r['status'],
+              f"{r['duration_s']:.1f}s" if r['duration_s'] else '-',
+              f"${r['cost']:.4f}" if r['cost'] else '-'] for r in rows]
+    click.echo(_fmt_table(table, ['CLUSTER', 'RESOURCES', 'STATUS',
+                                  'DURATION', 'COST']))
+
+
+@bench.command(name='down')
+@click.argument('bench_name')
+@click.option('--yes', '-y', is_flag=True)
+def bench_down(bench_name, yes):
+    """Tear down a benchmark's clusters."""
+    from skypilot_tpu.benchmark import benchmark_utils
+    _confirm(f'Tearing down benchmark {bench_name!r}. Proceed?', yes)
+    benchmark_utils.teardown(bench_name)
+    click.echo(f'Benchmark {bench_name!r} removed.')
+
+
+@bench.command(name='list')
+def bench_list():
+    """List benchmarks."""
+    from skypilot_tpu.benchmark import benchmark_utils
+    names = benchmark_utils.list_benchmarks()
+    click.echo('\n'.join(names) if names else 'No benchmarks.')
+
+
 # -------------------------------------------------------------------- serve
 @cli.group()
 def serve():
@@ -460,6 +523,10 @@ def serve_logs(service_name, no_follow):
 
 
 def main() -> None:
+    import sys
+
+    from skypilot_tpu.usage import usage_lib
+    usage_lib.record('cli', argv=sys.argv[1:2])   # command name only
     try:
         cli(standalone_mode=True)
     except exceptions.SkyTpuError as e:       # pragma: no cover - passthru

@@ -17,6 +17,7 @@ from skypilot_tpu.backend import tpu_backend
 from skypilot_tpu.dag import Dag
 from skypilot_tpu.task import Task
 from skypilot_tpu.utils import common_utils
+from skypilot_tpu.utils import timeline
 
 logger = tpu_logging.init_logger(__name__)
 
@@ -67,12 +68,14 @@ def _execute(
     job_id = None
 
     if Stage.OPTIMIZE in stages:
-        optimizer_lib.optimize(dag, quiet=tpu_logging.is_silent())
+        with timeline.Event('optimize'):
+            optimizer_lib.optimize(dag, quiet=tpu_logging.is_silent())
     if Stage.PROVISION in stages:
-        handle = backend.provision(task, task.best_resources,
-                                   cluster_name=cluster_name,
-                                   dryrun=dryrun,
-                                   retry_until_up=retry_until_up)
+        with timeline.Event('provision', cluster=cluster_name):
+            handle = backend.provision(task, task.best_resources,
+                                       cluster_name=cluster_name,
+                                       dryrun=dryrun,
+                                       retry_until_up=retry_until_up)
         if dryrun:
             logger.info('Dryrun finished (optimize + plan only).')
             return None, None
@@ -82,13 +85,16 @@ def _execute(
 
     assert handle is not None
     if Stage.SYNC_WORKDIR in stages and task.workdir:
-        backend.sync_workdir(handle, task.workdir)
+        with timeline.Event('sync_workdir'):
+            backend.sync_workdir(handle, task.workdir)
     if Stage.SYNC_FILE_MOUNTS in stages and (task.file_mounts
                                              or task.storage_mounts):
-        backend.sync_file_mounts(handle, task.file_mounts,
-                                 task.storage_mounts)
+        with timeline.Event('sync_file_mounts'):
+            backend.sync_file_mounts(handle, task.file_mounts,
+                                     task.storage_mounts)
     if Stage.SETUP in stages and not no_setup:
-        backend.setup(handle, task)
+        with timeline.Event('setup', cluster=cluster_name):
+            backend.setup(handle, task)
     if down and idle_minutes_to_autostop is None:
         # `down` means "tear down after the job queue drains", not "tear
         # down now" — with a detached job an immediate teardown would
@@ -98,8 +104,9 @@ def _execute(
     try:
         if Stage.EXEC in stages:
             try:
-                job_id = backend.execute(handle, task,
-                                         detach_run=detach_run)
+                with timeline.Event('exec', cluster=cluster_name):
+                    job_id = backend.execute(handle, task,
+                                             detach_run=detach_run)
             finally:
                 backend.post_execute(handle, down)
     finally:

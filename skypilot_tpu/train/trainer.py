@@ -174,6 +174,29 @@ class Trainer:
         with self.mesh:
             return self._step_jit(state, batch)
 
+    def fit(self, state: TrainState, data_iter, num_steps: int,
+            callbacks=None) -> TrainState:
+        """Drive ``num_steps`` steps with callback instrumentation
+        (``skypilot_tpu.callbacks``) — the hook the benchmark subsystem
+        reads step timing from."""
+        from skypilot_tpu.callbacks.base import BaseCallback, CallbackList
+        if isinstance(callbacks, CallbackList):
+            cbs = callbacks
+        elif isinstance(callbacks, BaseCallback):
+            cbs = CallbackList([callbacks])
+        else:
+            cbs = CallbackList(callbacks)
+        for _ in range(num_steps):
+            batch = next(data_iter)
+            step_no = int(state.step)
+            cbs.on_step_begin(step_no)
+            state, metrics = self.step(state, batch)
+            # Block so the timer measures compute, not dispatch.
+            metrics = {k: float(v) for k, v in metrics.items()}
+            cbs.on_step_end(step_no, metrics)
+        cbs.on_train_end()
+        return state
+
     # ---------------- checkpointing ----------------
     def save_checkpoint(self, path: str, state: TrainState) -> None:
         """Orbax checkpoint (async-capable); the managed-jobs recovery

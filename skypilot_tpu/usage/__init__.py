@@ -1,0 +1,6 @@
+"""Usage telemetry (reference ``sky/usage/``): local-first event spool
+with an optional push endpoint; opt out with
+SKYTPU_DISABLE_USAGE_COLLECTION=1."""
+from skypilot_tpu.usage.usage_lib import disabled, entries, record
+
+__all__ = ['disabled', 'entries', 'record']

@@ -1,0 +1,96 @@
+"""Usage telemetry: local-first event recording with an optional push
+endpoint.
+
+Role of reference ``sky/usage/usage_lib.py`` (messages assembled per
+command and POSTed to a Loki collector, opt-out via env): here events
+spool to ``{state_dir}/usage/usage.jsonl`` always-local-first; if
+``usage.endpoint`` is configured they are also POSTed (best-effort,
+never blocking a command on telemetry). Opt out entirely with
+``SKYTPU_DISABLE_USAGE_COLLECTION=1``.
+"""
+from __future__ import annotations
+
+import json
+import os
+import time
+import uuid
+from typing import Any, Dict, List, Optional
+
+from skypilot_tpu import config as config_lib
+from skypilot_tpu.utils import common_utils
+
+_run_id: Optional[str] = None
+
+
+def disabled() -> bool:
+    return os.environ.get('SKYTPU_DISABLE_USAGE_COLLECTION', '0') == '1'
+
+
+def run_id() -> str:
+    global _run_id
+    if _run_id is None:
+        _run_id = str(uuid.uuid4())[:8]
+    return _run_id
+
+
+def _spool_path() -> str:
+    d = os.path.join(common_utils.state_dir(), 'usage')
+    os.makedirs(d, exist_ok=True)
+    return os.path.join(d, 'usage.jsonl')
+
+
+def record(event: str, **fields: Any) -> None:
+    """Append one usage event; never raises into the caller."""
+    if disabled():
+        return
+    entry = {
+        'time': time.time(),
+        'run_id': run_id(),
+        'event': event,
+        'user': common_utils.get_cleaned_username(),
+        **{k: v for k, v in fields.items() if v is not None},
+    }
+    try:
+        with open(_spool_path(), 'a', encoding='utf-8') as f:
+            f.write(json.dumps(entry) + '\n')
+    except OSError:
+        return
+    _maybe_push(entry)
+
+
+def _maybe_push(entry: Dict[str, Any]) -> None:
+    endpoint = config_lib.get_nested(('usage', 'endpoint'), None)
+    if not endpoint:
+        return
+
+    def _post():
+        try:
+            import urllib.request
+            req = urllib.request.Request(
+                endpoint, data=json.dumps(entry).encode(),
+                headers={'Content-Type': 'application/json'})
+            urllib.request.urlopen(req, timeout=2)
+        except Exception:  # pylint: disable=broad-except
+            pass               # telemetry must never break a command
+
+    # Fire-and-forget: a slow/unreachable collector must not stall the
+    # command path.
+    import threading
+    threading.Thread(target=_post, daemon=True).start()
+
+
+def entries(limit: int = 0) -> List[Dict[str, Any]]:
+    try:
+        with open(_spool_path(), encoding='utf-8') as f:
+            lines = f.readlines()
+    except FileNotFoundError:
+        return []
+    if limit:
+        lines = lines[-limit:]
+    out = []
+    for line in lines:
+        try:
+            out.append(json.loads(line))
+        except json.JSONDecodeError:
+            continue
+    return out

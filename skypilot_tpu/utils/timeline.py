@@ -1,0 +1,97 @@
+"""Timeline profiling: chrome://tracing event capture for control-plane
+operations.
+
+Role of reference ``sky/utils/timeline.py`` (Event context manager +
+``@event`` decorator, JSON trace written per run): instrument the slow
+stages (optimize, provision, setup, sync, exec) so "why was my launch
+slow" is answerable from a trace. Enabled by pointing
+``SKYTPU_TIMELINE_FILE`` at a path; events are buffered in-process and
+flushed atexit (and on save()).
+"""
+from __future__ import annotations
+
+import atexit
+import functools
+import json
+import os
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+_events: List[Dict[str, Any]] = []
+_lock = threading.Lock()
+_registered = False
+
+
+def enabled() -> bool:
+    return bool(os.environ.get('SKYTPU_TIMELINE_FILE'))
+
+
+class Event:
+    """``with Event('provision'):`` records a complete (ph=X) slice."""
+
+    def __init__(self, name: str, **args: Any):
+        self.name = name
+        self.args = args
+        self._t0 = 0.0
+
+    def __enter__(self) -> 'Event':
+        self._t0 = time.time()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        if not enabled():
+            return
+        global _registered
+        ev = {
+            'name': self.name,
+            'ph': 'X',                            # complete event
+            'ts': self._t0 * 1e6,                 # microseconds
+            'dur': (time.time() - self._t0) * 1e6,
+            'pid': os.getpid(),
+            'tid': threading.get_ident() % 10000,
+        }
+        if self.args:
+            ev['args'] = {k: str(v) for k, v in self.args.items()}
+        with _lock:
+            _events.append(ev)
+            if not _registered:
+                atexit.register(save)
+                _registered = True
+
+
+def event(name_or_fn=None):
+    """Decorator: ``@timeline.event`` or ``@timeline.event('name')``."""
+
+    def deco(fn: Callable, name: Optional[str] = None):
+        label = name or f'{fn.__module__}.{fn.__qualname__}'
+
+        @functools.wraps(fn)
+        def wrapper(*a, **kw):
+            with Event(label):
+                return fn(*a, **kw)
+        return wrapper
+
+    if callable(name_or_fn):
+        return deco(name_or_fn)
+    return lambda fn: deco(fn, name_or_fn)
+
+
+def save(path: Optional[str] = None) -> Optional[str]:
+    """Write buffered events as a Chrome trace; returns the path."""
+    path = path or os.environ.get('SKYTPU_TIMELINE_FILE')
+    if not path:
+        return None
+    with _lock:
+        events = list(_events)
+    if not events:
+        return None
+    os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+    with open(path, 'w', encoding='utf-8') as f:
+        json.dump({'traceEvents': events, 'displayTimeUnit': 'ms'}, f)
+    return path
+
+
+def clear() -> None:
+    with _lock:
+        _events.clear()
