@@ -169,9 +169,46 @@ def _in_mesh_context() -> bool:
             return False
 
 
+_pp_probe_warned = False
+
+
+def _pp_mesh():
+    """The ambient mesh iff its pp axis is > 1 (else None).
+
+    Probes jax's private thread_resources (no public ambient-mesh API);
+    warns ONCE if the probe breaks on a jax upgrade — silently disabled
+    pipelining with pp-sharded layer params would otherwise degrade to
+    a full layer-stack all-gather per step with no visible signal."""
+    global _pp_probe_warned
+    try:
+        from jax._src import mesh as mesh_src
+        env_mesh = mesh_src.thread_resources.env.physical_mesh
+        if env_mesh.empty:
+            return None
+        return env_mesh if env_mesh.shape.get('pp', 1) > 1 else None
+    except Exception:  # pylint: disable=broad-except
+        if not _pp_probe_warned:
+            _pp_probe_warned = True
+            import warnings
+            warnings.warn(
+                'skypilot_tpu: ambient-mesh probe failed (jax internals '
+                'changed?); pipeline parallelism is DISABLED and pp-'
+                'sharded params will be all-gathered every step.')
+        return None
+
+
+import threading as _threading
+
+_manual_region = _threading.local()
+
+
 def _shard(x: jax.Array, *logical_axes: Optional[str]) -> jax.Array:
     """Activation sharding constraint via logical axes; no-op outside a mesh
-    context (pure single-device runs, CPU unit tests)."""
+    context (pure single-device runs, CPU unit tests) and inside manual
+    shard_map regions (the pipeline body — mixing with_sharding_constraint
+    into a partially-manual region trips XLA internal checks)."""
+    if getattr(_manual_region, 'active', False):
+        return x
     if not _in_mesh_context():
         return x
     from skypilot_tpu.parallel.mesh import spec_for
@@ -284,11 +321,47 @@ def forward(
                               policy=jax.checkpoint_policies.nothing_saveable)
 
     if cache is None:
-        def scan_body(carry, layer):
-            out, _, aux = body(carry, (layer, None))
-            return out, aux
+        pp_mesh = _pp_mesh()
+        if pp_mesh is not None:
+            # Pipeline-parallel layer stack (GPipe over the pp axis);
+            # each stage scans its local layers. MoE aux loss is not
+            # plumbed through the pipeline yet.
+            if cfg.is_moe:
+                raise NotImplementedError(
+                    'pipeline parallelism with MoE layers is not '
+                    'supported yet (aux loss not plumbed)')
+            from skypilot_tpu.parallel.pipeline import pipeline_layers
 
-        x, aux_layers = lax.scan(scan_body, x, layer_params)
+            def stage_fn(stage_params, x_mb):
+                # Positions rebuilt at microbatch shape (the closed-over
+                # `positions` is full-batch; rows are identical without a
+                # cache).
+                mb_pos = jnp.broadcast_to(jnp.arange(s)[None, :],
+                                          (x_mb.shape[0], s))
+
+                def layer_body(carry, layer):
+                    _manual_region.active = True
+                    try:
+                        out, _, _ = _layer_fn(layer, carry, cfg, mb_pos,
+                                              None, None, attn_impl)
+                    finally:
+                        _manual_region.active = False
+                    return out, None
+                if cfg.remat == 'block':
+                    layer_body = jax.checkpoint(
+                        layer_body,
+                        policy=jax.checkpoint_policies.nothing_saveable)
+                out, _ = lax.scan(layer_body, x_mb, stage_params)
+                return out
+
+            x = pipeline_layers(layer_params, x, stage_fn, pp_mesh)
+            aux_layers = jnp.zeros((1,), jnp.float32)
+        else:
+            def scan_body(carry, layer):
+                out, _, aux = body(carry, (layer, None))
+                return out, aux
+
+            x, aux_layers = lax.scan(scan_body, x, layer_params)
         new_cache = None
     else:
         # The cache is a loop INVARIANT (closed over, indexed per layer),

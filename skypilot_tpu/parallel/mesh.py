@@ -7,6 +7,8 @@ let XLA insert ICI/DCN collectives.
 
 Axes (any may be size 1):
   slice : outer data-parallel axis across pod slices (DCN; multislice)
+  pp    : pipeline parallel (layer stack split into stages; GPipe
+          microbatching in parallel/pipeline.py)
   dp    : data parallel (pure replication of params)
   fsdp  : fully-sharded data parallel (params sharded, gathered per layer)
   sp    : sequence/context parallel (ring attention partitions the sequence)
@@ -14,7 +16,7 @@ Axes (any may be size 1):
   ep    : expert parallel (MoE experts sharded)
 
 ``ep`` is folded over ``fsdp×sp`` at use-site (MoE layers reshape), keeping
-the physical mesh 5-D and collectives on ICI neighbors.
+the physical mesh 6-D and collectives on ICI neighbors.
 """
 from __future__ import annotations
 
@@ -26,7 +28,7 @@ import jax
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
-MESH_AXES = ('slice', 'dp', 'fsdp', 'sp', 'tp')
+MESH_AXES = ('slice', 'pp', 'dp', 'fsdp', 'sp', 'tp')
 
 
 @dataclasses.dataclass(frozen=True)
@@ -37,10 +39,12 @@ class MeshSpec:
     sp: int = 1
     tp: int = 1
     num_slices: int = 1
+    pp: int = 1
 
     @property
     def shape(self) -> Tuple[int, ...]:
-        return (self.num_slices, self.dp, self.fsdp, self.sp, self.tp)
+        return (self.num_slices, self.pp, self.dp, self.fsdp, self.sp,
+                self.tp)
 
     @property
     def num_devices(self) -> int:
@@ -101,7 +105,8 @@ DEFAULT_RULES: LogicalRules = {
     'vocab_in': None,
     'expert': ('fsdp', 'sp'),   # ep folded over fsdp×sp
     'norm': None,
-    'layers': None,
+    # Layer stack sharded over pipeline stages (no-op at pp=1).
+    'layers': 'pp',
 }
 
 

@@ -1,0 +1,99 @@
+"""Pipeline parallelism: GPipe microbatch schedule over the ``pp`` mesh
+axis.
+
+SURVEY §2.3 row "Pipeline (PP)": the reference delegates PP to launched
+frameworks (DeepSpeed recipes); here it is a first-class op. The layer
+stack's leading axis is sharded over ``pp`` (rule ``layers: pp``), so
+each stage holds L/P contiguous layers; microbatched activations flow
+stage-to-stage via ``lax.ppermute`` (nearest-neighbor ICI hops) in a
+``jax.shard_map`` that is manual over ONLY the pp axis — fsdp/tp/sp
+sharding inside each stage remains compiler-managed (``axis_names``).
+
+Schedule: plain GPipe — M microbatches drain through P stages in
+M + P - 1 ticks; the (P-1)/M bubble shrinks as M grows. Activations for
+the backward pass are kept by scan autodiff (remat of the stage body
+applies as usual via the model's remat policy).
+"""
+from __future__ import annotations
+
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+
+def pipeline_layers(
+    layer_params: Any,                # pytree; leaves [L, ...] over pp
+    x: jax.Array,                     # [batch, seq, d] activations
+    stage_fn: Callable[[Any, jax.Array], jax.Array],
+    mesh: jax.sharding.Mesh,
+    *,
+    num_microbatches: Optional[int] = None,
+    axis_name: str = 'pp',
+) -> jax.Array:
+    """Apply the full layer stack to ``x`` through the pipeline.
+
+    ``stage_fn(stage_params, x_mb)`` applies ONE stage's local layers to
+    one microbatch (it sees leaves with leading axis L/P)."""
+    pp = mesh.shape[axis_name]
+    if pp == 1:
+        return stage_fn(layer_params, x)
+    batch = x.shape[0]
+    n_micro = num_microbatches or pp
+    if batch % n_micro:
+        raise ValueError(f'batch {batch} not divisible into '
+                         f'{n_micro} microbatches')
+
+    param_specs = jax.tree.map(lambda _: P(axis_name), layer_params)
+    # The shard_map boundary rides fp32: replicated (P()) inputs get a
+    # psum over pp in the TRANSPOSE (cotangent accumulation), and a bf16
+    # all-reduce inside a partially-manual shard_map trips an XLA-CPU
+    # internal check. Stage compute still runs in the model dtype.
+    x_dtype = x.dtype
+
+    def body(params_local, x_full):
+        x_full = x_full.astype(x_dtype)
+        rank = lax.axis_index(axis_name)
+        mbs = x_full.reshape(n_micro, batch // n_micro, *x_full.shape[1:])
+        outputs = jnp.zeros_like(mbs)
+        recv = jnp.zeros_like(mbs[0])
+        fwd = [(i, (i + 1) % pp) for i in range(pp)]
+
+        def tick(carry, t):
+            recv, outputs = carry
+            # Stage `rank` processes microbatch (t - rank) at tick t.
+            mb_idx = jnp.clip(t - rank, 0, n_micro - 1)
+            active = (t - rank >= 0) & (t - rank < n_micro)
+            x_in = jnp.where(rank == 0,
+                             mbs[jnp.clip(t, 0, n_micro - 1)], recv)
+            y = stage_fn(params_local, x_in)
+            # Last stage banks its finished microbatch.
+            prev = lax.dynamic_index_in_dim(outputs, mb_idx, 0,
+                                            keepdims=False)
+            banked = jnp.where(active & (rank == pp - 1), y, prev)
+            outputs = lax.dynamic_update_index_in_dim(outputs, banked,
+                                                      mb_idx, 0)
+            recv = lax.ppermute(y, axis_name, fwd)
+            return (recv, outputs), None
+
+        (recv, outputs), _ = lax.scan(
+            tick, (recv, outputs), jnp.arange(n_micro + pp - 1))
+        del recv
+        # Only the last stage holds real outputs; broadcast to the ring
+        # so downstream (final norm / unembed / loss) is replicated over
+        # pp. The psum rides fp32: a bf16 all-reduce inside a
+        # partially-manual shard_map trips an XLA-CPU internal check
+        # ("Invalid binary instruction opcode copy").
+        outputs = jnp.where(rank == pp - 1, outputs,
+                            jnp.zeros_like(outputs))
+        outputs = lax.psum(outputs.astype(jnp.float32), axis_name)
+        return outputs.reshape(x_full.shape)
+
+    fn = jax.shard_map(body, mesh=mesh,
+                       in_specs=(param_specs, P()),
+                       out_specs=P(),
+                       axis_names={axis_name},
+                       check_vma=False)
+    return fn(layer_params, x.astype(jnp.float32)).astype(x_dtype)
