@@ -6,6 +6,7 @@ from typing import Any
 
 _LAZY = {
     'up': ('skypilot_tpu.serve.core', 'up'),
+    'update': ('skypilot_tpu.serve.core', 'update'),
     'down': ('skypilot_tpu.serve.core', 'down'),
     'status': ('skypilot_tpu.serve.core', 'status'),
     'tail_logs': ('skypilot_tpu.serve.core', 'tail_logs'),

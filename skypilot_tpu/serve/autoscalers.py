@@ -45,6 +45,7 @@ class ReplicaView:
     is_ready: bool
     is_spot: bool
     is_terminal: bool = False     # preempted/failed: replaced, not counted
+    version: int = 1              # service version this replica runs
 
 
 class Autoscaler:
@@ -71,7 +72,12 @@ class Autoscaler:
     def evaluate_scaling(
             self, replicas: List[ReplicaView],
             now: Optional[float] = None) -> List[ScalingDecision]:
-        alive = [r for r in replicas if not r.is_terminal]
+        # Blue-green: only latest-version replicas count toward the
+        # target, so an update launches replacements while the old
+        # version keeps serving (the controller drains old replicas once
+        # enough new ones are READY).
+        alive = [r for r in replicas if not r.is_terminal
+                 and r.version == self.latest_version]
         decisions: List[ScalingDecision] = []
         for _ in range(self.target_num_replicas - len(alive)):
             decisions.append(ScalingDecision(
@@ -191,7 +197,8 @@ class FallbackRequestRateAutoscaler(RequestRateAutoscaler):
             now: Optional[float] = None) -> List[ScalingDecision]:
         now = time.time() if now is None else now
         self._update_target(now)
-        alive = [r for r in replicas if not r.is_terminal]
+        alive = [r for r in replicas if not r.is_terminal
+                 and r.version == self.latest_version]
         base = min(self.spec.base_ondemand_fallback_replicas,
                    self.target_num_replicas)
         want_od = base

@@ -55,7 +55,8 @@ class ServeController:
                 replica_id=info.replica_id,
                 is_ready=(info.status == serve_state.ReplicaStatus.READY),
                 is_spot=info.is_spot,
-                is_terminal=info.status.is_terminal()))
+                is_terminal=info.status.is_terminal(),
+                version=info.version))
         return views
 
     def _autoscaler_step(self) -> None:
@@ -68,6 +69,43 @@ class ServeController:
                     use_spot=bool(d.target.get('use_spot')))
             else:
                 self.replica_manager.scale_down(d.target['replica_id'])
+        self._drain_old_versions()
+
+    def _drain_old_versions(self) -> None:
+        """Blue-green completion (reference ``replica_managers.py:1172``):
+        once enough latest-version replicas are READY, old-version
+        replicas are terminated."""
+        latest = self.replica_manager.version
+        infos = self.replica_manager.replicas()
+        ready_new = sum(
+            1 for i in infos if i.version == latest
+            and i.status == serve_state.ReplicaStatus.READY)
+        if ready_new < self.autoscaler.target_num_replicas:
+            return
+        for info in infos:
+            if info.version < latest and not info.status.is_terminal() \
+                    and info.status != serve_state.ReplicaStatus.\
+                    SHUTTING_DOWN:
+                logger.info(f'Draining replica {info.replica_id} '
+                            f'(v{info.version} < v{latest}).')
+                self.replica_manager.scale_down(info.replica_id)
+
+    def apply_update(self) -> None:
+        """Reload spec/task from serve state after an `update` RPC bumped
+        the version; new replicas launch with the new task."""
+        record = serve_state.get_service(self.service_name)
+        if record is None:
+            return
+        version = record['version']
+        if version == self.replica_manager.version:
+            return
+        spec = SkyServiceSpec.from_yaml_config(
+            record['task_config']['service'])
+        self.spec = spec
+        self.replica_manager.update_version(spec, record['task_config'],
+                                            version)
+        self.autoscaler.update_spec(spec, version)
+        logger.info(f'Service {self.service_name} updated to v{version}.')
 
     def _update_service_status(self) -> None:
         record = serve_state.get_service(self.service_name)
@@ -89,6 +127,10 @@ class ServeController:
     def _loop(self) -> None:
         while not self._stop.is_set():
             try:
+                # Version reconciliation every tick: the update RPC's
+                # POST is only a nudge — if it was missed, the DB version
+                # must not stay permanently ahead of the running service.
+                self.apply_update()
                 self.replica_manager.probe_all()
                 self._autoscaler_step()
                 self._update_service_status()
@@ -132,6 +174,14 @@ class ServeController:
                     self._json(200, {
                         'ready_replica_urls':
                             controller.replica_manager.ready_urls()})
+                elif self.path == '/controller/update':
+                    try:
+                        controller.apply_update()
+                        self._json(200, {
+                            'version': controller.replica_manager.version})
+                    except Exception as e:  # pylint: disable=broad-except
+                        self._json(400, {'error': f'{type(e).__name__}: '
+                                                  f'{e}'})
                 elif self.path == '/controller/terminate':
                     threading.Thread(target=controller.terminate,
                                      daemon=True).start()

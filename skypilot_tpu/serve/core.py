@@ -113,6 +113,29 @@ def up(task_or_dag: Union[Task, Dag],
     return {'name': service_name, 'endpoint': endpoint}
 
 
+def update(task_or_dag: Union[Task, Dag], service_name: str
+           ) -> Dict[str, Any]:
+    """Blue-green-lite service update (reference ``sky.serve.update``
+    ``sky/serve/core.py:362``): new replicas launch with the new task;
+    old-version replicas drain once enough new ones are READY."""
+    task = _to_task(task_or_dag)
+    if task.service is None:
+        raise exceptions.InvalidServiceSpecError(
+            'Task has no `service:` section; cannot `serve update`.')
+    SkyServiceSpec.from_yaml_config(task.service)      # validate early
+    handle = _get_controller_handle()
+    resp = _controller_request(handle, {
+        'op': 'update',
+        'service_name': service_name,
+        'task_config': task.to_yaml_config(),
+    })
+    if not resp.get('ok'):
+        raise exceptions.ApiError(resp.get('error', 'serve update failed'))
+    logger.info(f'Service {service_name!r} updating to '
+                f'v{resp["version"]}.')
+    return {'name': service_name, 'version': resp['version']}
+
+
 def status(service_names: Optional[List[str]] = None
            ) -> List[Dict[str, Any]]:
     """Service table incl. per-replica rows (reference ``sky serve

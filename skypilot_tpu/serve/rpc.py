@@ -118,6 +118,31 @@ def handle(request: Dict[str, Any]) -> Dict[str, Any]:
         if names:
             services = [s for s in services if s['name'] in names]
         return _ok(services=services)
+    if op == 'update':
+        name = request['service_name']
+        svc = serve_state.get_service(name)
+        if svc is None:
+            return {'ok': False, 'error': f'Service {name!r} not found.'}
+        from skypilot_tpu.serve.service_spec import SkyServiceSpec
+        task_config = request['task_config']
+        SkyServiceSpec.from_yaml_config(task_config['service'])  # validate
+        version = serve_state.bump_service_version(name, task_config)
+        if version is None:
+            return {'ok': False, 'error': f'Service {name!r} not found.'}
+        # The POST is only a NUDGE: the committed version is the source
+        # of truth and the controller reconciles it every tick, so a
+        # missed nudge must not be reported as a failed update (a retry
+        # would double-bump the version).
+        try:
+            req = urllib.request.Request(
+                f'http://127.0.0.1:{svc["controller_port"]}'
+                '/controller/update', data=b'{}',
+                headers={'Content-Type': 'application/json'})
+            with urllib.request.urlopen(req, timeout=10):
+                pass
+        except Exception:  # pylint: disable=broad-except
+            pass
+        return _ok(version=version)
     if op == 'down':
         name = request['service_name']
         svc = serve_state.get_service(name)

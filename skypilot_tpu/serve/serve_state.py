@@ -168,6 +168,25 @@ def set_service_version(name: str, version: int,
         conn.commit()
 
 
+def bump_service_version(name: str,
+                         task_config: Dict[str, Any]) -> Optional[int]:
+    """Atomically read-increment-write the service version (two
+    concurrent updates must get distinct versions, not both N+1).
+    Returns the new version, or None if the service doesn't exist."""
+    with db_lock():
+        conn = _conn()
+        row = conn.execute('SELECT version FROM services WHERE name=?',
+                           (name,)).fetchone()
+        if row is None:
+            return None
+        version = row[0] + 1
+        conn.execute(
+            'UPDATE services SET version=?, task_config=? WHERE name=?',
+            (version, json.dumps(task_config), name))
+        conn.commit()
+        return version
+
+
 def set_service_agent_job(name: str, agent_job_id: int) -> None:
     with db_lock():
         conn = _conn()

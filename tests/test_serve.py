@@ -40,7 +40,8 @@ class H(http.server.BaseHTTPRequestHandler):
         pass
     def _send(self):
         body = json.dumps(
-            {"replica": os.environ.get("SKYTPU_SERVE_REPLICA_ID")}).encode()
+            {"replica": os.environ.get("SKYTPU_SERVE_REPLICA_ID"),
+             "msg": os.environ.get("MSG", "")}).encode()
         self.send_response(200)
         self.send_header("Content-Length", str(len(body)))
         self.end_headers()
@@ -115,10 +116,14 @@ def test_serve_up_two_replicas_lb_and_down(tmp_path):
         svc = _wait_ready('echo', n_ready=2)
         assert len(svc['replicas']) == 2
 
-        # LB proxies to both replicas (round robin).
+        # LB proxies to both replicas (round robin). The LB learns a
+        # newly-READY replica at its next controller sync, so poll past
+        # that propagation window rather than sampling instantly.
         seen = set()
-        for _ in range(6):
+        deadline = time.time() + 20
+        while time.time() < deadline and seen != {'1', '2'}:
             seen.add(_get(result['endpoint'] + '/hello')['replica'])
+            time.sleep(0.2)
         assert seen == {'1', '2'}
 
         # Replica clusters exist as ordinary clusters.
@@ -171,6 +176,54 @@ def test_serve_recovers_preempted_replica(tmp_path):
             time.sleep(0.3)
         assert replacement is not None, 'no replacement replica appeared'
         assert replacement['replica_id'] == 2
+    finally:
+        _down_all()
+
+
+def test_serve_update_blue_green(tmp_path):
+    """serve.update rolls the service to a new task version: replacement
+    replicas launch with the new env, old-version replicas drain once
+    the new ones are READY, and the LB serves the new behavior."""
+    task = _service_task(tmp_path, n_replicas=1)
+    task.update_envs({'MSG': 'v1'})
+    try:
+        result = serve.up(task, service_name='upd')
+        _wait_ready('upd', n_ready=1)
+        assert _get(result['endpoint'] + '/x')['msg'] == 'v1'
+
+        new_task = _service_task(tmp_path, n_replicas=1)
+        new_task.update_envs({'MSG': 'v2'})
+        out = serve.update(new_task, 'upd')
+        assert out['version'] == 2
+
+        deadline = time.time() + 90
+        drained = False
+        while time.time() < deadline:
+            svcs = serve.status(['upd'])
+            if svcs:
+                reps = svcs[0]['replicas']
+                v2_ready = [r for r in reps if r['version'] == 2
+                            and r['status'] == 'READY']
+                v1_left = [r for r in reps if r['version'] == 1]
+                if v2_ready and not v1_left:
+                    drained = True
+                    break
+            time.sleep(0.3)
+        assert drained, serve.status(['upd'])
+        # The LB drops the drained v1 URL at its next controller sync;
+        # poll past that propagation window (and transient 502s while
+        # the old replica dies).
+        deadline = time.time() + 20
+        msg = None
+        while time.time() < deadline:
+            try:
+                msg = _get(result['endpoint'] + '/x')['msg']
+            except Exception:
+                msg = None
+            if msg == 'v2':
+                break
+            time.sleep(0.3)
+        assert msg == 'v2'
     finally:
         _down_all()
 
