@@ -1,0 +1,100 @@
+"""SSH auth: per-user keypair generation + cloud public-key injection.
+
+Role of reference ``sky/authentication.py`` (``get_or_generate_keys``
+``:106``, GCP project-metadata injection ``:148``): every cluster is
+reachable with the user's skytpu keypair; the public key rides into the
+VM/TPU-VM via cloud metadata at provision time.
+
+Keys are ed25519, generated with the ``cryptography`` library (no
+ssh-keygen dependency) under ``~/.skytpu/keys/`` with a filelock so
+concurrent launches don't race.
+"""
+from __future__ import annotations
+
+import os
+from typing import Any, Dict, Tuple
+
+import filelock
+
+_KEY_NAME = 'skytpu'
+
+
+def keys_dir() -> str:
+    d = os.environ.get('SKYTPU_KEYS_DIR',
+                       os.path.expanduser('~/.skytpu/keys'))
+    os.makedirs(d, exist_ok=True)
+    return d
+
+
+def private_key_path() -> str:
+    return os.path.join(keys_dir(), f'{_KEY_NAME}.pem')
+
+
+def public_key_path() -> str:
+    return private_key_path() + '.pub'
+
+
+def get_or_generate_keys() -> Tuple[str, str]:
+    """Returns (private_key_path, public_key_path), generating once."""
+    from cryptography.hazmat.primitives import serialization
+    from cryptography.hazmat.primitives.asymmetric import ed25519
+
+    priv, pub = private_key_path(), public_key_path()
+    lock = filelock.FileLock(os.path.join(keys_dir(), '.keygen.lock'))
+    with lock:
+        if os.path.exists(priv) and os.path.exists(pub):
+            return priv, pub
+        if os.path.exists(priv):          # pub lost: rederive
+            with open(priv, 'rb') as f:
+                key = serialization.load_ssh_private_key(f.read(),
+                                                         password=None)
+        else:
+            key = ed25519.Ed25519PrivateKey.generate()
+            pem = key.private_bytes(
+                serialization.Encoding.PEM,
+                serialization.PrivateFormat.OpenSSH,
+                serialization.NoEncryption())
+            fd = os.open(priv, os.O_WRONLY | os.O_CREAT | os.O_TRUNC,
+                         0o600)
+            with os.fdopen(fd, 'wb') as f:
+                f.write(pem)
+        pub_line = key.public_key().public_bytes(
+            serialization.Encoding.OpenSSH,
+            serialization.PublicFormat.OpenSSH).decode() + ' skytpu\n'
+        with open(pub, 'w', encoding='utf-8') as f:
+            f.write(pub_line)
+        os.chmod(pub, 0o644)
+        return priv, pub
+
+
+def ssh_user() -> str:
+    return os.environ.get('SKYTPU_SSH_USER', 'skytpu')
+
+
+def gcp_metadata_entry() -> Dict[str, Any]:
+    """The metadata item GCP node/instance bodies carry so the VM boots
+    with our key authorized (reference injects into project metadata;
+    per-instance metadata avoids needing project-level IAM)."""
+    _, pub = get_or_generate_keys()
+    with open(pub, encoding='utf-8') as f:
+        pub_key = f.read().strip()
+    return {'key': 'ssh-keys', 'value': f'{ssh_user()}:{pub_key}'}
+
+
+def configure_node_body(body: Dict[str, Any],
+                        kind: str = 'tpu_vm') -> Dict[str, Any]:
+    """Attach the ssh public key to a TPU node / GCE instance create
+    body (both use the ``metadata`` field, with different shapes)."""
+    entry = gcp_metadata_entry()
+    if kind == 'tpu_vm':
+        md = dict(body.get('metadata') or {})
+        md[entry['key']] = entry['value']
+        body['metadata'] = md
+    else:
+        md = dict(body.get('metadata') or {'items': []})
+        items = [i for i in md.get('items', [])
+                 if i.get('key') != entry['key']]
+        items.append(entry)
+        md['items'] = items
+        body['metadata'] = md
+    return body

@@ -1,0 +1,80 @@
+"""Module-level callback API mirroring the reference's ``sky_callback``
+package (``init`` / ``step_begin`` / ``step_end`` / ``step`` context
+manager), plus a HuggingFace Trainer adapter. Apps that are NOT built on
+the in-tree Trainer instrument their loop with these so ``skytpu bench``
+can read step timing."""
+from __future__ import annotations
+
+import contextlib
+from typing import Any, Dict, Optional
+
+from skypilot_tpu.callbacks.base import TimerCallback
+
+_timer: Optional[TimerCallback] = None
+_step = 0
+
+
+def init(log_dir: Optional[str] = None, write_every: int = 10) -> None:
+    global _timer, _step
+    _timer = TimerCallback(log_dir=log_dir, write_every=write_every)
+    _step = 0
+
+
+def _ensure() -> TimerCallback:
+    global _timer
+    if _timer is None:
+        init()
+    return _timer
+
+
+def step_begin() -> None:
+    _ensure().on_step_begin(_step)
+
+
+def step_end(metrics: Optional[Dict[str, Any]] = None) -> None:
+    global _step
+    _ensure().on_step_end(_step, metrics)
+    _step += 1
+
+
+@contextlib.contextmanager
+def step(metrics: Optional[Dict[str, Any]] = None):
+    step_begin()
+    try:
+        yield
+    finally:
+        step_end(metrics)
+
+
+def write_summary() -> Optional[str]:
+    if _timer is None:
+        return None
+    return _timer.write_summary()
+
+
+def hf_trainer_callback(log_dir: Optional[str] = None):
+    """A ``transformers.TrainerCallback`` forwarding step events (the
+    reference ships an equivalent HF integration in sky-callback)."""
+    from transformers import TrainerCallback
+
+    timer = TimerCallback(log_dir=log_dir)
+
+    class SkyTpuHFCallback(TrainerCallback):
+        # transformers only delivers metrics via on_log (on_step_end
+        # carries none); keep the latest logs and attach them to steps.
+        _latest_logs: Dict[str, Any] = {}
+
+        def on_log(self, args, state, control, logs=None, **kwargs):
+            if logs:
+                self._latest_logs = dict(logs)
+
+        def on_step_begin(self, args, state, control, **kwargs):
+            timer.on_step_begin(state.global_step)
+
+        def on_step_end(self, args, state, control, **kwargs):
+            timer.on_step_end(state.global_step, self._latest_logs)
+
+        def on_train_end(self, args, state, control, **kwargs):
+            timer.on_train_end()
+
+    return SkyTpuHFCallback()

@@ -129,7 +129,8 @@ def _tpu_node_body(cluster_name: str, cfg: common.ProvisionConfig
         body['schedulingConfig'] = {'preemptible': True}
     if node_config.get('reserved'):
         body['schedulingConfig'] = {'reserved': True}
-    return body
+    from skypilot_tpu import authentication
+    return authentication.configure_node_body(body, kind='tpu_vm')
 
 
 # --------------------------------------------------------------------- ops
@@ -292,7 +293,8 @@ def _gce_body(cluster_name: str, name: str,
         }]
         body['scheduling'] = dict(body.get('scheduling', {}),
                                   onHostMaintenance='TERMINATE')
-    return body
+    from skypilot_tpu import authentication
+    return authentication.configure_node_body(body, kind='gce')
 
 
 def _run_gce(region: str, zone: str, cluster_name: str,
@@ -463,6 +465,7 @@ def get_cluster_info(region: str, cluster_name: str) -> common.ClusterInfo:
             rank += 1
     if not hosts:
         raise exceptions.ClusterDoesNotExist(cluster_name)
+    from skypilot_tpu import authentication
     return common.ClusterInfo(
         cluster_name=cluster_name,
         provider_name='gcp',
@@ -472,6 +475,7 @@ def get_cluster_info(region: str, cluster_name: str) -> common.ClusterInfo:
         head_instance_id=hosts[0].instance_id,
         chips_per_host=chips_per_host,
         accelerator=accelerator,
-        ssh_user='skytpu',
+        ssh_user=authentication.ssh_user(),
+        ssh_private_key=authentication.private_key_path(),
         provider_config={'project_id': project, 'zone': zone},
     )

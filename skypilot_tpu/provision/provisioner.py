@@ -65,16 +65,30 @@ def post_provision_runtime_setup(
     runners = common.get_command_runners(cluster_info)
     info_json = json.dumps(cluster_info.to_dict())
 
+    # Remote hosts get the client's package as a hash-addressed source
+    # zip on PYTHONPATH (version-skew restarts the agent); the local
+    # provider already sees the repo via LocalProcessRunner's PYTHONPATH.
+    ship_pkg = cluster_info.provider_name != 'local'
+    if ship_pkg:
+        from skypilot_tpu.utils import pkg_utils
+        zip_path, digest = pkg_utils.build_package()
+
     with tempfile.NamedTemporaryFile('w', suffix='.json',
                                      delete=False) as f:
         f.write(info_json)
         tmp_path = f.name
     try:
         def push(runner) -> None:
-            runner.run('mkdir -p ~/.skytpu_agent ~/sky_workdir',
+            runner.run('mkdir -p ~/.skytpu_agent ~/sky_workdir '
+                       '~/.skytpu_runtime',
                        log_path=os.devnull)
             runner.rsync(tmp_path, '~/.skytpu_agent/cluster_info.json',
                          up=True)
+            if ship_pkg:
+                runner.rsync(zip_path, pkg_utils.remote_zip_path(),
+                             up=True)
+                runner.run(pkg_utils.remote_setup_command(digest),
+                           log_path=os.devnull)
         subprocess_utils.run_in_parallel(push, runners)
     finally:
         os.unlink(tmp_path)

@@ -238,7 +238,12 @@ class SSHCommandRunner(CommandRunner):
 
     def run(self, cmd, *, env=None, log_path=os.devnull, stream_logs=False,
             require_outputs=False, cwd=None, timeout=None) -> RunResult:
-        remote_cmd = _env_prefix(env) + cmd
+        # Every remote command sees the shipped runtime zip on
+        # PYTHONPATH explicitly — shell init files can't be relied on
+        # from non-interactive login shells (see pkg_utils).
+        from skypilot_tpu.utils import pkg_utils
+        remote_cmd = (pkg_utils.RUNTIME_PYTHONPATH_PREFIX +
+                      _env_prefix(env) + cmd)
         if cwd:
             remote_cmd = f'cd {shlex.quote(cwd)} && {remote_cmd}'
         args = self.ssh_base_command() + [

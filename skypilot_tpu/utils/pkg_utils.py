@@ -1,0 +1,111 @@
+"""Runtime package shipping: hash-addressed source archive synced to
+cluster hosts so the remote agent runs the SAME code as the client.
+
+Role of reference ``sky/backends/wheel_utils.py`` (``build_sky_wheel``
+``:140``: build a wheel locally, hash-addressed, rsync to clusters so the
+remote skylet matches the client). TPU-first simplification: Python can
+import straight from a zip (zipimport), so the artifact is a source zip
+of ``skypilot_tpu`` put on every host's PYTHONPATH via ``~/.bashrc`` —
+no pip install on the host, and a content-hash version marker lets the
+bootstrap detect skew and restart the agent with the new code.
+
+The local provisioner skips all of this (LocalProcessRunner already
+injects the repo into PYTHONPATH).
+"""
+from __future__ import annotations
+
+import hashlib
+import os
+import zipfile
+from typing import Tuple
+
+import filelock
+
+_REMOTE_DIR = '~/.skytpu_runtime'
+_REMOTE_ZIP = f'{_REMOTE_DIR}/skypilot_tpu.zip'
+_SHIP_EXTENSIONS = ('.py', '.csv', '.json')
+
+
+def _package_root() -> str:
+    import skypilot_tpu
+    return os.path.dirname(os.path.abspath(skypilot_tpu.__file__))
+
+
+def _iter_package_files(root: str):
+    for dirpath, dirnames, filenames in os.walk(root):
+        # Sorted: the content hash must not depend on filesystem
+        # directory order, or identical code hashes differently across
+        # client machines and spuriously restarts remote agents.
+        dirnames[:] = sorted(d for d in dirnames if d != '__pycache__')
+        for fname in sorted(filenames):
+            if fname.endswith(_SHIP_EXTENSIONS):
+                full = os.path.join(dirpath, fname)
+                rel = os.path.join('skypilot_tpu',
+                                   os.path.relpath(full, root))
+                yield full, rel
+
+
+def package_hash() -> str:
+    """Content hash over every shipped file (path + bytes)."""
+    h = hashlib.sha256()
+    root = _package_root()
+    for full, rel in _iter_package_files(root):
+        h.update(rel.encode())
+        with open(full, 'rb') as f:
+            h.update(f.read())
+    return h.hexdigest()[:16]
+
+
+def build_package() -> Tuple[str, str]:
+    """Build (or reuse) the hash-addressed source zip.
+
+    Returns (zip_path, content_hash)."""
+    digest = package_hash()
+    out_dir = os.path.expanduser(
+        os.environ.get('SKYTPU_WHEEL_DIR', '~/.skytpu/wheels'))
+    os.makedirs(out_dir, exist_ok=True)
+    zip_path = os.path.join(out_dir, f'skypilot_tpu-{digest}.zip')
+    lock = filelock.FileLock(zip_path + '.lock')
+    with lock:
+        if os.path.exists(zip_path):
+            return zip_path, digest
+        tmp = zip_path + '.tmp'
+        root = _package_root()
+        with zipfile.ZipFile(tmp, 'w', zipfile.ZIP_DEFLATED) as zf:
+            for full, rel in _iter_package_files(root):
+                zf.write(full, rel)
+        os.replace(tmp, zip_path)
+    return zip_path, digest
+
+
+# Prefix the SSH runner applies to EVERY remote command: correctness
+# does not depend on shell init files (stock images' ~/.bashrc returns
+# early for non-interactive shells, so an appended export there would
+# never run). Harmless when the zip is absent.
+RUNTIME_PYTHONPATH_PREFIX = (
+    'export PYTHONPATH="$HOME/.skytpu_runtime/skypilot_tpu.zip'
+    ':${PYTHONPATH:-}"; ')
+
+
+def remote_setup_command(digest: str) -> str:
+    """Shell snippet run on each host AFTER the zip is rsynced to
+    ``{_REMOTE_ZIP}``: records the version (skew kills the running
+    agentd so the bootstrap restarts it on the new code) and adds the
+    PYTHONPATH export to ~/.profile for interactive debugging — the
+    load-bearing path is RUNTIME_PYTHONPATH_PREFIX in the SSH runner."""
+    return (
+        f'mkdir -p {_REMOTE_DIR}; '
+        'grep -q skytpu_runtime ~/.profile 2>/dev/null || '
+        f'echo \'export PYTHONPATH="$HOME/.skytpu_runtime/'
+        f'skypilot_tpu.zip:$PYTHONPATH"\' >> ~/.profile; '
+        f'if [ -f {_REMOTE_DIR}/version ] && '
+        f'[ "$(cat {_REMOTE_DIR}/version)" != "{digest}" ] && '
+        '[ -f ~/.skytpu_agent/agentd.pid ]; then '
+        'kill "$(cat ~/.skytpu_agent/agentd.pid)" 2>/dev/null || true; '
+        'fi; '
+        f'echo "{digest}" > {_REMOTE_DIR}/version'
+    )
+
+
+def remote_zip_path() -> str:
+    return _REMOTE_ZIP

@@ -27,8 +27,45 @@ jax.config.update('jax_platforms', 'cpu')
 import pytest  # noqa: E402
 
 
+@pytest.fixture(scope='session', autouse=True)
+def _sweep_stray_control_plane():
+    """Kill control-plane processes leaked by a previous CRASHED test
+    run (a SIGABRT'd pytest never runs its cleanup, and a leftover
+    agentd/replica server squatting on localhost ports poisons every
+    later serve/jobs test).
+
+    Scoped to TEST-spawned processes only: their state/agent dirs always
+    live under the system tempdir (tmp_state_dir / mktemp fixtures), so
+    a process whose env points elsewhere — a real local deployment — is
+    left alone."""
+    import tempfile
+
+    import psutil
+    me = os.getpid()
+    tmp = tempfile.gettempdir()
+    needles = ('skypilot_tpu.agent', 'skypilot_tpu.serve.service',
+               'skypilot_tpu.jobs.controller', 'replica_server.py')
+    for proc in psutil.process_iter(['pid', 'cmdline']):
+        try:
+            if proc.pid == me:
+                continue
+            cmd = ' '.join(proc.info['cmdline'] or ())
+            if not any(n in cmd for n in needles):
+                continue
+            env = proc.environ()
+            markers = (env.get('SKYTPU_STATE_DIR', ''),
+                       env.get('SKYTPU_AGENT_DIR', ''),
+                       env.get('HOME', ''))
+            if any(m.startswith(tmp) for m in markers if m):
+                proc.kill()
+        except (psutil.NoSuchProcess, psutil.AccessDenied):
+            continue
+    yield
+
+
 @pytest.fixture()
 def tmp_state_dir(tmp_path, monkeypatch):
-    """Isolate global sqlite state per test."""
+    """Isolate global sqlite state (and ssh keys) per test."""
     monkeypatch.setenv('SKYTPU_STATE_DIR', str(tmp_path / 'state'))
+    monkeypatch.setenv('SKYTPU_KEYS_DIR', str(tmp_path / 'keys'))
     yield tmp_path / 'state'

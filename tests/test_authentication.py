@@ -1,0 +1,44 @@
+"""Auth/keys: generation, idempotence, rederivation, GCP metadata
+injection (reference ``sky/authentication.py`` behaviors)."""
+import os
+
+import pytest
+
+from skypilot_tpu import authentication as auth
+
+pytestmark = pytest.mark.usefixtures('tmp_state_dir')
+
+
+def test_generate_and_idempotent():
+    priv, pub = auth.get_or_generate_keys()
+    assert os.path.exists(priv) and os.path.exists(pub)
+    assert oct(os.stat(priv).st_mode & 0o777) == '0o600'
+    pub_text = open(pub, encoding='utf-8').read()
+    assert pub_text.startswith('ssh-ed25519 ')
+    # Second call returns the same material.
+    priv2, pub2 = auth.get_or_generate_keys()
+    assert (priv2, pub2) == (priv, pub)
+    assert open(pub2, encoding='utf-8').read() == pub_text
+
+
+def test_public_key_rederived_when_lost():
+    priv, pub = auth.get_or_generate_keys()
+    original = open(pub, encoding='utf-8').read()
+    os.remove(pub)
+    _, pub2 = auth.get_or_generate_keys()
+    assert open(pub2, encoding='utf-8').read().split()[:2] == \
+        original.split()[:2]
+
+
+def test_tpu_node_body_injection():
+    body = auth.configure_node_body({'acceleratorType': 'v5e-8'},
+                                    kind='tpu_vm')
+    assert body['metadata']['ssh-keys'].startswith('skytpu:ssh-ed25519 ')
+
+
+def test_gce_body_injection_replaces_existing():
+    body = {'metadata': {'items': [{'key': 'ssh-keys', 'value': 'old'}]}}
+    body = auth.configure_node_body(body, kind='gce')
+    items = body['metadata']['items']
+    assert len(items) == 1
+    assert items[0]['value'].startswith('skytpu:ssh-ed25519 ')

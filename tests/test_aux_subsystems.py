@@ -99,6 +99,40 @@ class TestCallbacks:
         assert data['steps_per_second'] > 0
         assert abs(data['last_metrics']['loss'] - 1.7) < 1e-6
 
+    def test_module_level_step_api(self, tmp_path):
+        """The sky_callback-style API for apps not using the in-tree
+        Trainer."""
+        from skypilot_tpu.callbacks import api
+        api.init(log_dir=str(tmp_path), write_every=1)
+        for i in range(3):
+            with api.step({'loss': 1.0 - i * 0.1}):
+                time.sleep(0.002)
+        path = api.write_summary()
+        data = json.loads(open(path, encoding='utf-8').read())
+        assert data['num_steps'] == 3
+        assert abs(data['last_metrics']['loss'] - 0.8) < 1e-6
+
+    def test_hf_trainer_adapter_forwards_steps(self, tmp_path):
+        pytest.importorskip('transformers')
+        from skypilot_tpu.callbacks import api
+        cb = api.hf_trainer_callback(log_dir=str(tmp_path))
+
+        class _State:
+            global_step = 0
+        state = _State()
+        for i in range(2):
+            state.global_step = i
+            cb.on_step_begin(None, state, None)
+            time.sleep(0.002)
+            # transformers delivers metrics via on_log, NOT on_step_end.
+            cb.on_log(None, state, None, logs={'loss': 3.0 - i})
+            cb.on_step_end(None, state, None)
+        cb.on_train_end(None, state, None)
+        data = json.loads(
+            (tmp_path / 'benchmark_summary.json').read_text())
+        assert data['num_steps'] == 2
+        assert abs(data['last_metrics']['loss'] - 2.0) < 1e-6
+
     def test_trainer_fit_drives_callbacks(self):
         import jax
         import jax.numpy as jnp

@@ -1,0 +1,65 @@
+"""Runtime package shipping: hash-addressed zip build, importability
+from the archive, version-skew detection snippet."""
+import os
+import subprocess
+import sys
+
+import pytest
+
+from skypilot_tpu.utils import pkg_utils
+
+
+@pytest.fixture(autouse=True)
+def tmp_wheel_dir(tmp_path, monkeypatch):
+    monkeypatch.setenv('SKYTPU_WHEEL_DIR', str(tmp_path / 'wheels'))
+
+
+def test_build_is_hash_addressed_and_cached():
+    path1, digest1 = pkg_utils.build_package()
+    assert digest1 in path1 and os.path.exists(path1)
+    mtime = os.path.getmtime(path1)
+    path2, digest2 = pkg_utils.build_package()
+    assert (path2, digest2) == (path1, digest1)
+    assert os.path.getmtime(path2) == mtime          # reused, not rebuilt
+
+
+def test_zip_is_importable_via_pythonpath():
+    """The shipped artifact must work exactly as deployed: zipimport of
+    skypilot_tpu from a clean interpreter with only the zip on path."""
+    path, _ = pkg_utils.build_package()
+    out = subprocess.run(
+        [sys.executable, '-c',
+         'import skypilot_tpu, skypilot_tpu.task; '
+         'print(skypilot_tpu.__version__); '
+         't = skypilot_tpu.Task(name="z", run="true"); print(t.name)'],
+        capture_output=True, text=True,
+        env={**os.environ, 'PYTHONPATH': path},
+        cwd='/',                                     # not the repo
+    )
+    assert out.returncode == 0, out.stderr
+    assert out.stdout.split() == ['0.1.0', 'z']
+
+
+def test_setup_command_handles_version_skew():
+    cmd = pkg_utils.remote_setup_command('abc123')
+    assert 'PYTHONPATH' in cmd and '.profile' in cmd
+    assert 'abc123' in cmd
+    # Skew path kills the running agentd so it restarts on the new code.
+    assert 'agentd.pid' in cmd and 'kill' in cmd
+
+
+def test_ssh_runner_prefixes_runtime_pythonpath():
+    """Every SSH remote command must carry the runtime-zip PYTHONPATH
+    explicitly (shell init files can't be relied on non-interactively)."""
+    from skypilot_tpu.utils import command_runner
+
+    captured = {}
+
+    class Probe(command_runner.SSHCommandRunner):
+        def _popen(self, args, **kw):
+            captured['cmd'] = args[-1]
+            return 0
+
+    runner = Probe('1.2.3.4', ssh_user='u', ssh_private_key='/dev/null')
+    runner.run('echo hi')
+    assert '.skytpu_runtime/skypilot_tpu.zip' in captured['cmd']
