@@ -162,8 +162,10 @@ def _train_step_bench(on_tpu: bool, n_chips: int,
     from skypilot_tpu.train.trainer import TrainConfig, Trainer
 
     if on_tpu:
+        # head_dim 128 (8 heads): the training path then rides the
+        # Pallas flash-attention kernel (its tiling needs d % 128 == 0).
         cfg = ModelConfig(name='bench-320m', vocab_size=32000, dim=1024,
-                          n_layers=16, n_heads=16, n_kv_heads=8,
+                          n_layers=16, n_heads=8, n_kv_heads=8,
                           ffn_dim=4096, remat='block')
         batch, seq, steps = 8, 2048, 5
         peak_flops = chip_peak_tflops * 1e12

@@ -477,6 +477,21 @@ def serve_up(entrypoint, service_name, yes, env):
     click.echo(f"Service {result['name']!r} endpoint: {result['endpoint']}")
 
 
+@serve.command(name='update')
+@click.argument('entrypoint', type=click.Path(exists=True))
+@click.option('--service-name', '-n', required=True)
+@click.option('--yes', '-y', is_flag=True)
+@click.option('--env', multiple=True, metavar='KEY=VALUE')
+def serve_update(entrypoint, service_name, yes, env):
+    """Blue-green update: new replicas launch with the new task; old
+    ones drain once enough new replicas are ready."""
+    task = _load_task(entrypoint, env)
+    _confirm(f'Updating service {service_name!r}. Proceed?', yes)
+    result = sky.serve.update(task, service_name)
+    click.echo(f"Service {service_name!r} updating to "
+               f"v{result['version']}.")
+
+
 @serve.command(name='status')
 @click.argument('service_names', nargs=-1)
 def serve_status(service_names):

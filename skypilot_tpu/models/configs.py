@@ -34,6 +34,13 @@ class ModelConfig:
     moe_capacity_factor: float = 1.25
     # Remat policy for training: 'none' | 'block' (checkpoint each layer)
     remat: str = 'block'
+    # Gemma-family knobs: tied input/output embeddings, GeGLU instead of
+    # SwiGLU, and RMSNorm computing x * (1 + w) instead of x * w.
+    tie_embeddings: bool = False
+    activation: str = 'silu'            # 'silu' | 'gelu'
+    norm_plus_one: bool = False
+    # Gemma scales embeddings by sqrt(dim) at the input.
+    scale_embeddings: bool = False
 
     @property
     def head_dim(self) -> int:
@@ -54,7 +61,8 @@ class ModelConfig:
             ffn *= self.n_experts
             ffn += d * self.n_experts           # router
         per_layer = attn + ffn + 2 * d          # + 2 norms
-        return v * d * 2 + self.n_layers * per_layer + d
+        embeds = v * d if self.tie_embeddings else v * d * 2
+        return embeds + self.n_layers * per_layer + d
 
     def flops_per_token(self, training: bool = False) -> float:
         """~2*N matmul FLOPs per token fwd (6*N with backward)."""
@@ -99,8 +107,24 @@ TINY_MOE = _cfg(name='tiny-moe', vocab_size=256, dim=64, n_layers=2, n_heads=4,
                 n_kv_heads=2, ffn_dim=128, max_seq_len=128, n_experts=4,
                 n_experts_per_token=2, remat='none')
 
+GEMMA_2B = _cfg(name='gemma-2b', vocab_size=256128, dim=2048, n_layers=18,
+                n_heads=8, n_kv_heads=1, ffn_dim=16384,
+                rope_theta=10000.0, tie_embeddings=True, activation='gelu',
+                norm_plus_one=True, scale_embeddings=True)
+
+GEMMA_7B = _cfg(name='gemma-7b', vocab_size=256128, dim=3072, n_layers=28,
+                n_heads=16, n_kv_heads=16, ffn_dim=24576,
+                rope_theta=10000.0, tie_embeddings=True, activation='gelu',
+                norm_plus_one=True, scale_embeddings=True)
+
+TINY_GEMMA = _cfg(name='tiny-gemma', vocab_size=256, dim=64, n_layers=2,
+                  n_heads=4, n_kv_heads=1, ffn_dim=128, max_seq_len=128,
+                  remat='none', tie_embeddings=True, activation='gelu',
+                  norm_plus_one=True, scale_embeddings=True)
+
 PRESETS = {c.name: c for c in [
-    LLAMA3_8B, LLAMA3_70B, LLAMA2_7B, LLAMA3_1B, MIXTRAL_8X7B, TINY, TINY_MOE]}
+    LLAMA3_8B, LLAMA3_70B, LLAMA2_7B, LLAMA3_1B, MIXTRAL_8X7B,
+    GEMMA_2B, GEMMA_7B, TINY, TINY_MOE, TINY_GEMMA]}
 
 
 def get_config(name: str) -> ModelConfig:

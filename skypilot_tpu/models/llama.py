@@ -54,17 +54,24 @@ def init_params(rng: jax.Array, cfg: ModelConfig) -> Params:
 
     params: Params = {
         'embed': _dense_init(keys[0], (cfg.vocab_size, d), cfg.dtype, d),
-        'unembed': _dense_init(keys[1], (d, cfg.vocab_size), cfg.dtype, d),
-        'final_norm': jnp.ones((d,), jnp.float32),
+        'final_norm': (jnp.zeros((d,), jnp.float32) if cfg.norm_plus_one
+                       else jnp.ones((d,), jnp.float32)),
         'layers': {
-            'attn_norm': jnp.ones((L, d), jnp.float32),
-            'ffn_norm': jnp.ones((L, d), jnp.float32),
+            'attn_norm': (jnp.zeros((L, d), jnp.float32)
+                          if cfg.norm_plus_one
+                          else jnp.ones((L, d), jnp.float32)),
+            'ffn_norm': (jnp.zeros((L, d), jnp.float32)
+                         if cfg.norm_plus_one
+                         else jnp.ones((L, d), jnp.float32)),
             'wq': stack_init(keys[2], (d, n_h, hd), d),
             'wk': stack_init(keys[3], (d, n_kv, hd), d),
             'wv': stack_init(keys[4], (d, n_kv, hd), d),
             'wo': stack_init(keys[5], (n_h, hd, d), n_h * hd),
         },
     }
+    if not cfg.tie_embeddings:
+        params['unembed'] = _dense_init(keys[1], (d, cfg.vocab_size),
+                                        cfg.dtype, d)
     if cfg.is_moe:
         from skypilot_tpu.models import moe
         params['layers'].update(moe.init_moe_params(keys[6], cfg))
@@ -84,7 +91,6 @@ def param_logical_axes(cfg: ModelConfig) -> Params:
     The leading scan axis is 'layers' (never sharded)."""
     axes: Params = {
         'embed': ('vocab_in', 'embed'),
-        'unembed': ('embed', 'vocab'),
         'final_norm': ('norm',),
         'layers': {
             'attn_norm': ('layers', 'norm'),
@@ -95,6 +101,8 @@ def param_logical_axes(cfg: ModelConfig) -> Params:
             'wo': ('layers', 'heads', 'head_dim', 'embed'),
         },
     }
+    if not cfg.tie_embeddings:
+        axes['unembed'] = ('embed', 'vocab')
     if cfg.is_moe:
         from skypilot_tpu.models import moe
         axes['layers'].update(moe.moe_logical_axes(cfg))
@@ -135,10 +143,29 @@ def cache_logical_axes() -> KVCache:
 # --------------------------------------------------------------------------
 # Building blocks
 # --------------------------------------------------------------------------
-def rms_norm(x: jax.Array, w: jax.Array, eps: float) -> jax.Array:
+def rms_norm(x: jax.Array, w: jax.Array, eps: float,
+             plus_one: bool = False) -> jax.Array:
     xf = x.astype(jnp.float32)
     var = jnp.mean(jnp.square(xf), axis=-1, keepdims=True)
-    return (xf * lax.rsqrt(var + eps) * w).astype(x.dtype)
+    scale = (1.0 + w) if plus_one else w
+    return (xf * lax.rsqrt(var + eps) * scale).astype(x.dtype)
+
+
+def _embed_tokens(params: Params, tokens: jax.Array,
+                  cfg: ModelConfig) -> jax.Array:
+    x = params['embed'][tokens]
+    if cfg.scale_embeddings:                  # Gemma: sqrt(dim) input scale
+        x = (x.astype(jnp.float32) * cfg.dim ** 0.5).astype(x.dtype)
+    return x
+
+
+def _unembed_logits(params: Params, x: jax.Array,
+                    cfg: ModelConfig) -> jax.Array:
+    if cfg.tie_embeddings:                    # Gemma: unembed = embed^T
+        return jnp.einsum('bsd,vd->bsv', x, params['embed'],
+                          preferred_element_type=jnp.float32)
+    return jnp.einsum('bsd,dv->bsv', x, params['unembed'],
+                      preferred_element_type=jnp.float32)
 
 
 def rope(x: jax.Array, positions: jax.Array, theta: float) -> jax.Array:
@@ -218,7 +245,9 @@ def _shard(x: jax.Array, *logical_axes: Optional[str]) -> jax.Array:
 def _ffn(layer: Params, x: jax.Array, cfg: ModelConfig) -> jax.Array:
     gate = jnp.einsum('bsd,df->bsf', x, layer['w_gate'])
     up = jnp.einsum('bsd,df->bsf', x, layer['w_up'])
-    h = jax.nn.silu(gate.astype(jnp.float32)).astype(x.dtype) * up
+    act = jax.nn.silu if cfg.activation == 'silu' else \
+        functools.partial(jax.nn.gelu, approximate=True)
+    h = act(gate.astype(jnp.float32)).astype(x.dtype) * up
     h = _shard(h, 'batch', 'seq', 'mlp')
     return jnp.einsum('bsf,fd->bsd', h, layer['w_down'])
 
@@ -231,7 +260,8 @@ def _layer_core(layer: Params, x: jax.Array, cfg: ModelConfig,
     maps roped (q, k, v) to the attention output.
 
     Returns (x, (k, v) new kv rows, moe aux loss)."""
-    h = rms_norm(x, layer['attn_norm'], cfg.norm_eps)
+    h = rms_norm(x, layer['attn_norm'], cfg.norm_eps,
+                  cfg.norm_plus_one)
     q = jnp.einsum('bsd,dhk->bshk', h, layer['wq'])
     k = jnp.einsum('bsd,dhk->bshk', h, layer['wk'])
     v = jnp.einsum('bsd,dhk->bshk', h, layer['wv'])
@@ -241,7 +271,8 @@ def _layer_core(layer: Params, x: jax.Array, cfg: ModelConfig,
     out = attn_fn(q, k, v)
     out = _shard(out, 'batch', 'seq', 'heads', 'head_dim')
     x = x + jnp.einsum('bshk,hkd->bsd', out, layer['wo'])
-    h = rms_norm(x, layer['ffn_norm'], cfg.norm_eps)
+    h = rms_norm(x, layer['ffn_norm'], cfg.norm_eps,
+                 cfg.norm_plus_one)
     if cfg.is_moe:
         from skypilot_tpu.models import moe
         ffn_out, aux = moe.moe_ffn(layer, h, cfg)
@@ -297,7 +328,7 @@ def forward(
     Returns (logits [b, s, vocab], new_cache or None), plus the mean MoE
     load-balancing aux loss when ``return_aux`` (0 for dense models).
     """
-    x = params['embed'][tokens]  # [b, s, d] - gather
+    x = _embed_tokens(params, tokens, cfg)
     x = _shard(x, 'batch', 'seq', 'embed')
     b, s = tokens.shape
 
@@ -394,9 +425,9 @@ def forward(
             cache_v, v_rows.astype(cache_v.dtype), cache.length)
         new_cache = KVCache(k=new_k, v=new_v, length=cache.length + s)
 
-    x = rms_norm(x, params['final_norm'], cfg.norm_eps)
-    logits = jnp.einsum('bsd,dv->bsv', x, params['unembed'],
-                        preferred_element_type=jnp.float32)
+    x = rms_norm(x, params['final_norm'], cfg.norm_eps,
+                 cfg.norm_plus_one)
+    logits = _unembed_logits(params, x, cfg)
     logits = _shard(logits, 'batch', 'seq', 'vocab')
     if return_aux:
         return logits, new_cache, jnp.mean(aux_layers)
@@ -439,7 +470,7 @@ def decode_horizon(
     def one_step(carry, step_in):
         ring_k, ring_v, tok = carry
         i, rng = step_in
-        x = params['embed'][tok[:, None]]               # [b, 1, d]
+        x = _embed_tokens(params, tok[:, None], cfg)    # [b, 1, d]
         positions = (len0 + i)[:, None]
 
         def layer_body(xc, layer_and_idx):
@@ -463,9 +494,9 @@ def decode_horizon(
         ring_v = lax.dynamic_update_slice(
             ring_v, v_rows.astype(ring_v.dtype), (0, 0, i, 0, 0))
 
-        x = rms_norm(x, params['final_norm'], cfg.norm_eps)
-        logits = jnp.einsum('bsd,dv->bsv', x, params['unembed'],
-                            preferred_element_type=jnp.float32)[:, 0]
+        x = rms_norm(x, params['final_norm'], cfg.norm_eps,
+                 cfg.norm_plus_one)
+        logits = _unembed_logits(params, x, cfg)[:, 0]
         if sample_fn is None:
             nxt = jnp.argmax(logits, -1).astype(jnp.int32)
         else:

@@ -169,3 +169,55 @@ class TestGraftEntry:
     def test_dryrun_multichip_8(self):
         import __graft_entry__
         __graft_entry__.dryrun_multichip(8)
+
+
+class TestGemmaFamily:
+    """Gemma-style knobs: tied embeddings, GeGLU, +1 norms, MQA,
+    sqrt(dim) embedding scale."""
+
+    def test_forward_and_tied_logits(self):
+        cfg = configs.TINY_GEMMA
+        params = llama.init_params(jax.random.PRNGKey(0), cfg)
+        assert 'unembed' not in params          # tied: single table
+        toks = jnp.arange(12, dtype=jnp.int32)[None, :] % 250
+        logits, _ = llama.forward(params, toks, cfg)
+        assert logits.shape == (1, 12, cfg.vocab_size)
+        assert bool(jnp.all(jnp.isfinite(logits)))
+
+    def test_prefill_decode_matches_full(self):
+        cfg = configs.TINY_GEMMA
+        params = llama.init_params(jax.random.PRNGKey(0), cfg)
+        toks = jnp.array([[3, 1, 4, 1, 5]], jnp.int32)
+        cache = llama.KVCache.create(cfg, batch=1, max_seq=32)
+        logits_p, cache = llama.forward(params, toks, cfg, cache=cache)
+        nxt = jnp.argmax(logits_p[:, -1:], -1).astype(jnp.int32)
+        logits_d, _ = llama.forward(params, nxt, cfg, cache=cache)
+        full = jnp.concatenate([toks, nxt], axis=1)
+        logits_f, _ = llama.forward(params, full, cfg)
+        np.testing.assert_allclose(np.asarray(logits_d[:, -1]),
+                                   np.asarray(logits_f[:, -1]),
+                                   rtol=3e-2, atol=3e-2)
+
+    def test_gemma_trains(self):
+        trainer = Trainer(
+            configs.TINY_GEMMA,
+            mesh_spec=mesh_lib.MeshSpec(dp=2, fsdp=2, sp=1, tp=2),
+            train_config=TrainConfig(learning_rate=1e-2, warmup_steps=1,
+                                     total_steps=20, attn_impl='xla'))
+        state = trainer.init(jax.random.PRNGKey(0))
+        rng = np.random.RandomState(0)
+        data = rng.randint(0, 250, size=(8, 17))
+        batch = {'inputs': jnp.asarray(data[:, :-1], jnp.int32),
+                 'targets': jnp.asarray(data[:, 1:], jnp.int32)}
+        losses = []
+        for _ in range(4):
+            state, metrics = trainer.step(state, batch)
+            losses.append(float(metrics['loss']))
+        assert losses[-1] < losses[0], losses
+
+    def test_num_params_tied(self):
+        params = llama.init_params(jax.random.PRNGKey(0),
+                                   configs.TINY_GEMMA)
+        actual = sum(x.size for x in jax.tree.leaves(params))
+        est = configs.TINY_GEMMA.num_params
+        assert abs(actual - est) / actual < 0.05
