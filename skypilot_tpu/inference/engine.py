@@ -86,7 +86,7 @@ class InferenceEngine:
             params = llama.init_params(jax.random.PRNGKey(0), cfg)
         if mesh is not None:
             shardings = mesh_lib.tree_shardings(
-                llama.param_logical_axes(cfg), mesh)
+                llama.param_logical_axes(cfg), mesh, shapes=params)
             params = jax.device_put(params, shardings)
         self.params = params
 
@@ -94,10 +94,7 @@ class InferenceEngine:
                                           max_seq=max_seq)
         if mesh is not None:
             cache_sh = mesh_lib.tree_shardings(
-                jax.tree.map(lambda a: a,
-                             llama.cache_logical_axes(),
-                             is_leaf=lambda x: isinstance(x, tuple)),
-                mesh)
+                llama.cache_logical_axes(), mesh, shapes=self.cache)
             self.cache = jax.device_put(self.cache, cache_sh)
 
         # slot bookkeeping (host side)

@@ -41,9 +41,14 @@ class ModelConfig:
     norm_plus_one: bool = False
     # Gemma scales embeddings by sqrt(dim) at the input.
     scale_embeddings: bool = False
+    # Explicit per-head width (HF configs may set head_dim != dim//n_heads,
+    # e.g. Gemma-7B uses 256 with dim=3072, n_heads=16).
+    head_dim_override: Optional[int] = None
 
     @property
     def head_dim(self) -> int:
+        if self.head_dim_override is not None:
+            return self.head_dim_override
         return self.dim // self.n_heads
 
     @property
@@ -54,8 +59,9 @@ class ModelConfig:
     def num_params(self) -> int:
         """Approximate parameter count (embeddings + blocks + head)."""
         d, f, v = self.dim, self.ffn_dim, self.vocab_size
+        q_dim = self.n_heads * self.head_dim
         kv_dim = self.n_kv_heads * self.head_dim
-        attn = d * d + 2 * d * kv_dim + d * d   # wq, wk, wv, wo
+        attn = d * q_dim + 2 * d * kv_dim + q_dim * d   # wq, wk, wv, wo
         ffn = 3 * d * f
         if self.is_moe:
             ffn *= self.n_experts

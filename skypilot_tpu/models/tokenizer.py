@@ -1,0 +1,117 @@
+"""Tokenizer for the in-tree serving/training engines.
+
+The reference delegates tokenization to the engines it launches (vLLM /
+JetStream read the HF tokenizer next to the checkpoint, e.g.
+``llm/llama-3/llama3.yaml:109``); ours is in-tree. Two implementations:
+
+- ``HFTokenizer``: wraps a ``tokenizer.json`` via the ``tokenizers``
+  runtime (pure-local, no network) — covers Llama-3/Gemma/Mixtral
+  checkpoints, which all ship one.
+- ``ByteTokenizer``: ids are raw UTF-8 bytes (+BOS/EOS at 256/257).
+  Deterministic, vocab 258 — the test/demo fallback when no
+  ``tokenizer.json`` exists.
+"""
+from __future__ import annotations
+
+import json
+import os
+from typing import List, Optional, Sequence
+
+
+class BaseTokenizer:
+    bos_id: Optional[int] = None
+    eos_id: Optional[int] = None
+
+    def encode(self, text: str, *, bos: bool = True) -> List[int]:
+        raise NotImplementedError
+
+    def decode(self, ids: Sequence[int]) -> str:
+        raise NotImplementedError
+
+    @property
+    def vocab_size(self) -> int:
+        raise NotImplementedError
+
+
+class ByteTokenizer(BaseTokenizer):
+    """UTF-8 bytes as token ids; 256=BOS, 257=EOS."""
+    bos_id = 256
+    eos_id = 257
+
+    @property
+    def vocab_size(self) -> int:
+        return 258
+
+    def encode(self, text: str, *, bos: bool = True) -> List[int]:
+        ids = list(text.encode('utf-8'))
+        return ([self.bos_id] + ids) if bos else ids
+
+    def decode(self, ids: Sequence[int]) -> str:
+        return bytes(i for i in ids if i < 256).decode(
+            'utf-8', errors='replace')
+
+
+class HFTokenizer(BaseTokenizer):
+    """A HuggingFace ``tokenizer.json`` loaded with the ``tokenizers``
+    runtime. BOS/EOS ids come from ``tokenizer_config.json`` /
+    ``generation_config.json`` when present, else common special-token
+    names are probed."""
+
+    def __init__(self, path: str):
+        from tokenizers import Tokenizer
+        self._tk = Tokenizer.from_file(os.path.join(path, 'tokenizer.json'))
+        self.bos_id, self.eos_id = self._find_special_ids(path)
+
+    def _find_special_ids(self, path: str):
+        bos = eos = None
+        for fname in ('tokenizer_config.json', 'generation_config.json'):
+            fpath = os.path.join(path, fname)
+            if not os.path.exists(fpath):
+                continue
+            with open(fpath, encoding='utf-8') as f:
+                cfg = json.load(f)
+            for key, cur in (('bos_token', bos), ('eos_token', eos)):
+                tok = cfg.get(key)
+                if isinstance(tok, dict):
+                    tok = tok.get('content')
+                if tok is not None and cur is None:
+                    tid = self._tk.token_to_id(tok)
+                    if key == 'bos_token':
+                        bos = tid
+                    else:
+                        eos = tid
+            if bos is None and 'bos_token_id' in cfg:
+                bos = cfg['bos_token_id']
+            if eos is None and 'eos_token_id' in cfg:
+                eid = cfg['eos_token_id']
+                eos = eid[0] if isinstance(eid, list) else eid
+        if bos is None or eos is None:
+            for cand in ('<|begin_of_text|>', '<s>', '<bos>'):
+                if bos is None:
+                    bos = self._tk.token_to_id(cand)
+            for cand in ('<|end_of_text|>', '</s>', '<eos>',
+                         '<|eot_id|>'):
+                if eos is None:
+                    eos = self._tk.token_to_id(cand)
+        return bos, eos
+
+    @property
+    def vocab_size(self) -> int:
+        return self._tk.get_vocab_size()
+
+    def encode(self, text: str, *, bos: bool = True) -> List[int]:
+        ids = self._tk.encode(text, add_special_tokens=False).ids
+        if bos and self.bos_id is not None:
+            ids = [self.bos_id] + ids
+        return ids
+
+    def decode(self, ids: Sequence[int]) -> str:
+        return self._tk.decode(list(ids), skip_special_tokens=True)
+
+
+def load_tokenizer(path: Optional[str]) -> BaseTokenizer:
+    """Tokenizer for a checkpoint dir: ``tokenizer.json`` if present,
+    byte-level fallback otherwise."""
+    if path and os.path.exists(os.path.join(path, 'tokenizer.json')):
+        return HFTokenizer(path)
+    return ByteTokenizer()

@@ -1,0 +1,321 @@
+"""HF-format checkpoint import/export for the in-tree engines.
+
+The reference never loads weights itself — its recipes point external
+engines at HF checkpoints (vLLM `--model` in ``llm/llama-3/llama3.yaml:109``,
+JetStream converting Llama-2-7B in ``examples/tpu/v6e/README.md:119``).
+Since our engines are in-tree (SURVEY.md §2.3), the weight import is too:
+this module maps a HuggingFace checkpoint directory
+(``config.json`` + ``*.safetensors`` [+ index]) onto the stacked-layer
+param pytree used by ``models/llama.py``.
+
+Layout notes:
+- HF stores per-layer weights under ``model.layers.{i}.*`` as
+  ``[out, in]`` Linear matrices; we stack all layers on a leading
+  ``layers`` axis (for ``lax.scan``) and keep matrices input-major
+  (``[in, out]``), so every projection is transposed on import.
+- Our RoPE uses the split-half ("rotate_half") convention, identical to
+  HF Llama/Gemma/Mixtral — no head permutation is needed.
+- Norm weights stay float32; matmul weights cast to ``cfg.dtype``.
+"""
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Dict, Iterator, Optional, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from skypilot_tpu.models.configs import ModelConfig
+
+Params = Dict[str, Any]
+
+_ARCH_FAMILY = {
+    'LlamaForCausalLM': 'llama',
+    'MistralForCausalLM': 'llama',
+    'GemmaForCausalLM': 'gemma',
+    'MixtralForCausalLM': 'mixtral',
+}
+
+
+def config_from_hf(hf: Dict[str, Any],
+                   name: Optional[str] = None,
+                   dtype: Any = jnp.bfloat16) -> ModelConfig:
+    """Build a ModelConfig from an HF ``config.json`` dict."""
+    archs = hf.get('architectures') or []
+    family = next((_ARCH_FAMILY[a] for a in archs if a in _ARCH_FAMILY),
+                  None)
+    if family is None:
+        raise ValueError(
+            f'Unsupported architectures {archs!r}; supported: '
+            f'{sorted(_ARCH_FAMILY)}')
+    dim = hf['hidden_size']
+    n_heads = hf['num_attention_heads']
+    head_dim = hf.get('head_dim')
+    kw: Dict[str, Any] = dict(
+        name=name or hf.get('model_type', family),
+        vocab_size=hf['vocab_size'],
+        dim=dim,
+        n_layers=hf['num_hidden_layers'],
+        n_heads=n_heads,
+        n_kv_heads=hf.get('num_key_value_heads', n_heads),
+        ffn_dim=hf['intermediate_size'],
+        max_seq_len=hf.get('max_position_embeddings', 8192),
+        rope_theta=float(hf.get('rope_theta', 10000.0)),
+        norm_eps=float(hf.get('rms_norm_eps', 1e-5)),
+        dtype=dtype,
+        tie_embeddings=bool(hf.get('tie_word_embeddings', False)),
+    )
+    if head_dim is not None and head_dim != dim // n_heads:
+        kw['head_dim_override'] = head_dim
+    if family == 'gemma':
+        kw.update(tie_embeddings=True, activation='gelu',
+                  norm_plus_one=True, scale_embeddings=True)
+    if family == 'mixtral':
+        kw.update(n_experts=hf['num_local_experts'],
+                  n_experts_per_token=hf.get('num_experts_per_tok', 2))
+    return ModelConfig(**kw)
+
+
+def _read_hf_config(path: str) -> Dict[str, Any]:
+    with open(os.path.join(path, 'config.json'), encoding='utf-8') as f:
+        return json.load(f)
+
+
+def _safetensor_files(path: str) -> list:
+    index = os.path.join(path, 'model.safetensors.index.json')
+    if os.path.exists(index):
+        with open(index, encoding='utf-8') as f:
+            weight_map = json.load(f)['weight_map']
+        return sorted({os.path.join(path, v) for v in weight_map.values()})
+    single = os.path.join(path, 'model.safetensors')
+    if os.path.exists(single):
+        return [single]
+    files = sorted(f for f in os.listdir(path) if f.endswith('.safetensors'))
+    if not files:
+        raise FileNotFoundError(f'No .safetensors files under {path}')
+    return [os.path.join(path, f) for f in files]
+
+
+def _iter_tensors(path: str) -> Iterator[Tuple[str, np.ndarray]]:
+    from safetensors import safe_open
+    for fname in _safetensor_files(path):
+        with safe_open(fname, framework='np') as f:
+            for key in f.keys():
+                yield key, f.get_tensor(key)
+
+
+def _hf_key_map(cfg: ModelConfig) -> Dict[str, Tuple[str, ...]]:
+    """HF tensor suffix (within ``model.layers.{i}.``) -> our leaf path.
+    The transform per suffix is applied in ``load_hf_params``."""
+    m = {
+        'input_layernorm.weight': ('layers', 'attn_norm'),
+        'post_attention_layernorm.weight': ('layers', 'ffn_norm'),
+        'self_attn.q_proj.weight': ('layers', 'wq'),
+        'self_attn.k_proj.weight': ('layers', 'wk'),
+        'self_attn.v_proj.weight': ('layers', 'wv'),
+        'self_attn.o_proj.weight': ('layers', 'wo'),
+    }
+    if cfg.is_moe:
+        m['block_sparse_moe.gate.weight'] = ('layers', 'router')
+        for e in range(cfg.n_experts):
+            m[f'block_sparse_moe.experts.{e}.w1.weight'] = (
+                'layers', 'moe_gate', e)
+            m[f'block_sparse_moe.experts.{e}.w3.weight'] = (
+                'layers', 'moe_up', e)
+            m[f'block_sparse_moe.experts.{e}.w2.weight'] = (
+                'layers', 'moe_down', e)
+    else:
+        m['mlp.gate_proj.weight'] = ('layers', 'w_gate')
+        m['mlp.up_proj.weight'] = ('layers', 'w_up')
+        m['mlp.down_proj.weight'] = ('layers', 'w_down')
+    return m
+
+
+def _transform(leaf: Tuple[str, ...], w: np.ndarray,
+               cfg: ModelConfig) -> np.ndarray:
+    """HF [out, in] Linear -> our input-major layout (+ head reshapes)."""
+    name = leaf[1]
+    hd = cfg.head_dim
+    if name in ('attn_norm', 'ffn_norm'):
+        return w.astype(np.float32)
+    if name == 'wq':
+        return w.T.reshape(cfg.dim, cfg.n_heads, hd)
+    if name in ('wk', 'wv'):
+        return w.T.reshape(cfg.dim, cfg.n_kv_heads, hd)
+    if name == 'wo':
+        return w.T.reshape(cfg.n_heads, hd, cfg.dim)
+    if name == 'router':
+        return w.T                      # [E, d] -> [d, E]
+    # All FFN projections (dense + expert): [out, in] -> [in, out].
+    return w.T
+
+
+def load_hf_params(path: str, cfg: ModelConfig) -> Params:
+    """Load an HF checkpoint directory into the stacked-layer pytree.
+
+    Layer tensors are accumulated into preallocated numpy buffers
+    ([n_layers, ...]) so peak host memory stays ~1× checkpoint size, then
+    cast to ``cfg.dtype`` (norms stay fp32) as jax arrays.
+    """
+    key_map = _hf_key_map(cfg)
+    L = cfg.n_layers
+    stacked: Dict[str, np.ndarray] = {}     # our layer-leaf name -> buffer
+    expert_bufs: Dict[str, np.ndarray] = {}
+    top: Dict[str, np.ndarray] = {}
+    seen = set()
+
+    for key, w in _iter_tensors(path):
+        if key == 'model.embed_tokens.weight':
+            top['embed'] = w
+            seen.add(key)
+            continue
+        if key == 'model.norm.weight':
+            top['final_norm'] = w.astype(np.float32)
+            seen.add(key)
+            continue
+        if key == 'lm_head.weight':
+            if not cfg.tie_embeddings:
+                top['unembed'] = w.T
+                seen.add(key)
+            continue
+        if not key.startswith('model.layers.'):
+            continue
+        rest = key[len('model.layers.'):]
+        idx_str, suffix = rest.split('.', 1)
+        i = int(idx_str)
+        leaf = key_map.get(suffix)
+        if leaf is None:
+            continue
+        w = _transform(leaf, w, cfg)
+        name = leaf[1]
+        if len(leaf) == 3:                   # per-expert tensor
+            e = leaf[2]
+            buf = expert_bufs.setdefault(
+                name,
+                np.zeros((L, cfg.n_experts) + w.shape, w.dtype))
+            buf[i, e] = w
+        else:
+            buf = stacked.setdefault(name,
+                                     np.zeros((L,) + w.shape, w.dtype))
+            buf[i] = w
+        seen.add(key)
+
+    # Completeness: every expected tensor must have been seen, per layer —
+    # a missing layer tensor would otherwise silently load as zeros.
+    expected = {'model.embed_tokens.weight', 'model.norm.weight'}
+    if not cfg.tie_embeddings:
+        expected.add('lm_head.weight')
+    for i in range(L):
+        for suffix in key_map:
+            expected.add(f'model.layers.{i}.{suffix}')
+    missing = sorted(expected - seen)
+    if missing:
+        raise ValueError(
+            f'Checkpoint at {path} is missing {len(missing)} tensors, '
+            f'first: {missing[:6]}')
+
+    def cast(name: str, a: np.ndarray) -> jnp.ndarray:
+        if name in ('attn_norm', 'ffn_norm', 'final_norm'):
+            return jnp.asarray(a, jnp.float32)
+        return jnp.asarray(a).astype(cfg.dtype)
+
+    params: Params = {
+        'embed': cast('embed', top['embed']),
+        'final_norm': cast('final_norm', top['final_norm']),
+        'layers': {k: cast(k, v) for k, v in stacked.items()},
+    }
+    params['layers'].update(
+        {k: cast(k, v) for k, v in expert_bufs.items()})
+    if not cfg.tie_embeddings:
+        params['unembed'] = cast('unembed', top['unembed'])
+    return params
+
+
+def load_checkpoint(path: str,
+                    dtype: Any = jnp.bfloat16,
+                    name: Optional[str] = None
+                    ) -> Tuple[ModelConfig, Params]:
+    """One-call import: HF dir -> (ModelConfig, params)."""
+    cfg = config_from_hf(_read_hf_config(path), name=name, dtype=dtype)
+    return cfg, load_hf_params(path, cfg)
+
+
+# ---------------------------------------------------------------- export
+def save_hf_checkpoint(path: str, cfg: ModelConfig, params: Params) -> None:
+    """Inverse of ``load_hf_params``: write ``config.json`` +
+    ``model.safetensors`` in HF layout (used by tests and for handing
+    trained weights back to HF-ecosystem tools)."""
+    from safetensors.numpy import save_file
+    os.makedirs(path, exist_ok=True)
+    hd = cfg.head_dim
+    out: Dict[str, np.ndarray] = {}
+
+    def np_(a) -> np.ndarray:
+        # Must be C-contiguous: the host copy of a TPU-backed jax array
+        # can carry non-C strides (np.array keeps order='K'), and
+        # safetensors serializes the raw buffer while assuming C order —
+        # silently scrambling strided input.
+        return np.ascontiguousarray(
+            np.asarray(jnp.asarray(a, jnp.float32)), dtype=np.float32)
+
+    out['model.embed_tokens.weight'] = np_(params['embed'])
+    out['model.norm.weight'] = np_(params['final_norm'])
+    if not cfg.tie_embeddings:
+        out['lm_head.weight'] = np_(params['unembed']).T
+    lp = params['layers']
+    for i in range(cfg.n_layers):
+        p = f'model.layers.{i}.'
+        out[p + 'input_layernorm.weight'] = np_(lp['attn_norm'][i])
+        out[p + 'post_attention_layernorm.weight'] = np_(lp['ffn_norm'][i])
+        out[p + 'self_attn.q_proj.weight'] = (
+            np_(lp['wq'][i]).reshape(cfg.dim, cfg.n_heads * hd).T)
+        out[p + 'self_attn.k_proj.weight'] = (
+            np_(lp['wk'][i]).reshape(cfg.dim, cfg.n_kv_heads * hd).T)
+        out[p + 'self_attn.v_proj.weight'] = (
+            np_(lp['wv'][i]).reshape(cfg.dim, cfg.n_kv_heads * hd).T)
+        out[p + 'self_attn.o_proj.weight'] = (
+            np_(lp['wo'][i]).reshape(cfg.n_heads * hd, cfg.dim).T)
+        if cfg.is_moe:
+            out[p + 'block_sparse_moe.gate.weight'] = np_(lp['router'][i]).T
+            for e in range(cfg.n_experts):
+                ep = p + f'block_sparse_moe.experts.{e}.'
+                out[ep + 'w1.weight'] = np_(lp['moe_gate'][i, e]).T
+                out[ep + 'w3.weight'] = np_(lp['moe_up'][i, e]).T
+                out[ep + 'w2.weight'] = np_(lp['moe_down'][i, e]).T
+        else:
+            out[p + 'mlp.gate_proj.weight'] = np_(lp['w_gate'][i]).T
+            out[p + 'mlp.up_proj.weight'] = np_(lp['w_up'][i]).T
+            out[p + 'mlp.down_proj.weight'] = np_(lp['w_down'][i]).T
+    # Transposed views are not C-contiguous; safetensors assumes C order.
+    out = {k: np.ascontiguousarray(v) for k, v in out.items()}
+    save_file(out, os.path.join(path, 'model.safetensors'))
+
+    arch = {'llama': 'LlamaForCausalLM', 'gemma': 'GemmaForCausalLM',
+            'mixtral': 'MixtralForCausalLM'}
+    family = ('mixtral' if cfg.is_moe else
+              'gemma' if cfg.norm_plus_one else 'llama')
+    hf_cfg: Dict[str, Any] = {
+        'architectures': [arch[family]],
+        'model_type': family,
+        'hidden_size': cfg.dim,
+        'intermediate_size': cfg.ffn_dim,
+        'num_hidden_layers': cfg.n_layers,
+        'num_attention_heads': cfg.n_heads,
+        'num_key_value_heads': cfg.n_kv_heads,
+        'head_dim': cfg.head_dim,
+        'vocab_size': cfg.vocab_size,
+        'max_position_embeddings': cfg.max_seq_len,
+        'rope_theta': cfg.rope_theta,
+        'rms_norm_eps': cfg.norm_eps,
+        'tie_word_embeddings': cfg.tie_embeddings,
+        'torch_dtype': 'float32',
+    }
+    if cfg.is_moe:
+        hf_cfg.update(num_local_experts=cfg.n_experts,
+                      num_experts_per_tok=cfg.n_experts_per_token)
+    if family == 'gemma':
+        hf_cfg['hidden_act'] = 'gelu_pytorch_tanh'
+    with open(os.path.join(path, 'config.json'), 'w',
+              encoding='utf-8') as f:
+        json.dump(hf_cfg, f, indent=2)

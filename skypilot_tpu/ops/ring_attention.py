@@ -23,7 +23,13 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 from jax import lax
-from jax.experimental.shard_map import shard_map
+import warnings as _warnings
+with _warnings.catch_warnings():
+    # jax >= 0.8 renames this to jax.shard_map but changes the kwarg
+    # surface (check_rep -> check_vma); keep the stable experimental
+    # import until the minimum jax is bumped.
+    _warnings.simplefilter('ignore', DeprecationWarning)
+    from jax.experimental.shard_map import shard_map
 
 _NEG_INF = -1e30
 
@@ -97,8 +103,23 @@ def ring_attention(
     if sp == 1:
         from skypilot_tpu.ops.attention import reference_attention
         return reference_attention(q, k, v, causal=causal, scale=scale)
+    if q.shape[1] % sp:
+        raise ValueError(
+            f'ring attention needs seq ({q.shape[1]}) divisible by '
+            f'{axis_name}={sp}')
+    # The manual shard_map body pairs local q heads with local kv heads
+    # positionally, so kv heads must shard over tp exactly like q heads.
+    # For MQA/GQA where n_kv_heads doesn't divide tp, materialize the
+    # per-q-head kv (repeat) instead of replicating kv across tp — a
+    # replicated kv with sharded q would silently mis-pair GQA groups.
+    tp = mesh.shape.get('tp', 1)
+    if k.shape[2] % tp:
+        rep = q.shape[2] // k.shape[2]
+        k = jnp.repeat(k, rep, axis=2)
+        v = jnp.repeat(v, rep, axis=2)
     qspec = spec_for(('batch', 'seq', 'heads', 'head_dim'), rules)
-    kspec = spec_for(('batch', 'seq', 'kv_heads', 'head_dim'), rules)
+    kspec = (qspec if k.shape[2] == q.shape[2] else
+             spec_for(('batch', 'seq', 'kv_heads', 'head_dim'), rules))
     fn = shard_map(
         functools.partial(_ring_body, axis_name=axis_name, axis_size=sp,
                           causal=causal, scale=scale),

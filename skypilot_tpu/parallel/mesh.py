@@ -111,16 +111,26 @@ DEFAULT_RULES: LogicalRules = {
 
 
 def spec_for(logical_axes: Sequence[Optional[str]],
-             rules: Optional[LogicalRules] = None) -> PartitionSpec:
+             rules: Optional[LogicalRules] = None,
+             *,
+             shape: Optional[Sequence[int]] = None,
+             mesh: Optional[Mesh] = None) -> PartitionSpec:
     """Map a tuple of logical axis names to a PartitionSpec.
 
     Custom ``rules`` are OVERRIDES merged onto DEFAULT_RULES, so a user
     dict doesn't break when the model layer introduces a new logical
-    axis (e.g. 'vocab_in'); unknown axes still raise (typo guard)."""
+    axis (e.g. 'vocab_in'); unknown axes still raise (typo guard).
+
+    When ``shape`` and ``mesh`` are given, the mapping is
+    divisibility-aware: mesh axes that do not evenly divide the tensor
+    dimension are dropped (trailing-first), falling back to replication.
+    This is what lets MQA/GQA models with ``n_kv_heads < tp`` run under
+    tensor parallelism — KV heads are replicated over the tp axis instead
+    of pjit rejecting the layout (MaxText does the same)."""
     rules = {**DEFAULT_RULES, **rules} if rules else DEFAULT_RULES
     parts = []
     used = set()
-    for ax in logical_axes:
+    for i, ax in enumerate(logical_axes):
         if ax is None:
             parts.append(None)
             continue
@@ -130,30 +140,46 @@ def spec_for(logical_axes: Sequence[Optional[str]],
         # Drop mesh axes already used by an earlier dimension (a mesh axis
         # may shard at most one tensor dimension).
         if mesh_ax is None:
-            parts.append(None)
+            keep = ()
         elif isinstance(mesh_ax, (tuple, list)):
             keep = tuple(a for a in mesh_ax if a not in used)
-            used.update(keep)
-            parts.append(keep if keep else None)
         else:
-            if mesh_ax in used:
-                parts.append(None)
-            else:
-                used.add(mesh_ax)
-                parts.append(mesh_ax)
+            keep = (mesh_ax,) if mesh_ax not in used else ()
+        if keep and shape is not None and mesh is not None:
+            dim = shape[i]
+            while keep and dim % math.prod(
+                    mesh.shape[a] for a in keep):
+                keep = keep[:-1]
+        used.update(keep)
+        if not keep:
+            parts.append(None)
+        elif len(keep) == 1 and not isinstance(rules[ax], (tuple, list)):
+            parts.append(keep[0])
+        else:
+            parts.append(keep)
     while parts and parts[-1] is None:
         parts.pop()
     return PartitionSpec(*parts)
 
 
 def tree_shardings(logical_tree: Any, mesh: Mesh,
-                   rules: Optional[LogicalRules] = None) -> Any:
-    """Map a pytree of logical-axis tuples to a pytree of NamedShardings."""
+                   rules: Optional[LogicalRules] = None,
+                   shapes: Optional[Any] = None) -> Any:
+    """Map a pytree of logical-axis tuples to a pytree of NamedShardings.
+
+    ``shapes`` (optional) is a matching pytree of arrays or
+    ShapeDtypeStructs; when given, shardings are divisibility-aware (see
+    ``spec_for``)."""
+    is_leaf = lambda x: isinstance(x, tuple) and all(
+        a is None or isinstance(a, str) for a in x)
+    if shapes is None:
+        return jax.tree.map(
+            lambda axes: NamedSharding(mesh, spec_for(axes, rules)),
+            logical_tree, is_leaf=is_leaf)
     return jax.tree.map(
-        lambda axes: NamedSharding(mesh, spec_for(axes, rules)),
-        logical_tree,
-        is_leaf=lambda x: isinstance(x, tuple),
-    )
+        lambda axes, s: NamedSharding(
+            mesh, spec_for(axes, rules, shape=s.shape, mesh=mesh)),
+        logical_tree, shapes, is_leaf=is_leaf)
 
 
 def batch_sharding(mesh: Mesh,

@@ -172,6 +172,7 @@ def _run_tpu(region: str, zone: str, cluster_name: str,
 
     use_qr = bool(node_config.get('use_spot')
                   or node_config.get('best_effort'))
+    created_qrs: List[str] = []
     try:
         for i in range(config.count):
             name = _node_name(cluster_name, i)
@@ -181,6 +182,7 @@ def _run_tpu(region: str, zone: str, cluster_name: str,
             # leave a half-made node this attempt must clean up.
             created.append(name)
             if use_qr:
+                created_qrs.append(_qr_name(cluster_name, i))
                 _create_via_queued_resource(client, zone, cluster_name,
                                             i, config)
             else:
@@ -190,15 +192,17 @@ def _run_tpu(region: str, zone: str, cluster_name: str,
     except exceptions.SkyTpuError:
         # Gang semantics: a partially-created slice group is useless —
         # clean up what this attempt made, then let failover move on.
+        # Only QRs from THIS attempt are deleted: force-deleting an
+        # ACTIVE QR from a previous successful attempt (whose node was
+        # skipped as 'existing') would tear down healthy capacity.
         for name in created:
             try:
                 client.delete_node(zone, name)
             except exceptions.SkyTpuError:
                 pass
-        for i in range(config.count):
+        for qr_name in created_qrs:
             try:
-                client.delete_queued_resource(zone,
-                                              _qr_name(cluster_name, i))
+                client.delete_queued_resource(zone, qr_name)
             except exceptions.SkyTpuError:
                 pass
         raise
@@ -430,7 +434,14 @@ def get_cluster_info(region: str, cluster_name: str) -> common.ClusterInfo:
     chips_per_host = 0
     if nodes:
         rank = 0
-        for node_idx in sorted(nodes):
+        # Sort by the numeric index suffix ('name-<i>'), not
+        # lexicographically: 'c-10' must rank after 'c-2' or global
+        # ranks (slice-major) and the head instance come out wrong.
+        def _node_key(name: str):
+            suffix = name.rsplit('-', 1)[-1]
+            return (0, int(suffix)) if suffix.isdigit() else (1, name)
+
+        for node_idx in sorted(nodes, key=_node_key):
             node = nodes[node_idx]
             accelerator = node.get('acceleratorType', accelerator)
             endpoints = node.get('networkEndpoints') or []

@@ -104,6 +104,11 @@ class ModelServer:
                 prompt, max_new_tokens=max_new_tokens,
                 temperature=temperature, top_k=top_k, eos_id=eos_id)
             self._finished_events[rid] = done
+            # _fatal wakes events under this same lock; if the engine died
+            # between the check above and this registration, the event
+            # would never be set — re-check while still holding the lock.
+            if self._error is not None:
+                done.set()
         self._work.set()
         done.wait()
         if self._error is not None:   # woken by _fatal, not completion

@@ -103,8 +103,12 @@ class Trainer:
         self.rules = rules or mesh_lib.DEFAULT_RULES
         self.optimizer = make_optimizer(self.tc)
 
+        self._params_shape = jax.eval_shape(
+            functools.partial(llama.init_params, cfg=cfg),
+            jax.random.PRNGKey(0))
         self.param_shardings = mesh_lib.tree_shardings(
-            llama.param_logical_axes(cfg), mesh, self.rules)
+            llama.param_logical_axes(cfg), mesh, self.rules,
+            shapes=self._params_shape)
         self.state_shardings = self._state_shardings()
         self.batch_sharding = mesh_lib.batch_sharding(mesh, self.rules)
 
@@ -124,9 +128,7 @@ class Trainer:
         """Derive opt_state shardings: any subtree with the same structure as
         params gets the param shardings (adam mu/nu); everything else is
         replicated (scalars like count)."""
-        params_shape = jax.eval_shape(
-            functools.partial(llama.init_params, cfg=self.cfg),
-            jax.random.PRNGKey(0))
+        params_shape = self._params_shape
         opt_shape = jax.eval_shape(self.optimizer.init, params_shape)
         params_treedef = jax.tree.structure(params_shape)
         replicated = NamedSharding(self.mesh, PartitionSpec())

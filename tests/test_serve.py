@@ -92,6 +92,21 @@ def _get(url: str, timeout: float = 10.0) -> dict:
         return json.loads(r.read())
 
 
+def _get_retry(url: str, deadline_s: float = 20.0) -> dict:
+    """GET with retries: the LB learns a newly-READY replica only at its
+    next controller sync, so the first request(s) after _wait_ready may
+    legitimately 502 under load."""
+    deadline = time.time() + deadline_s
+    last: Exception = AssertionError('no attempt')
+    while time.time() < deadline:
+        try:
+            return _get(url)
+        except Exception as e:  # noqa: BLE001 — urllib HTTPError/URLError
+            last = e
+            time.sleep(0.3)
+    raise AssertionError(f'GET {url} never succeeded: {last!r}')
+
+
 def _down_all():
     try:
         for svc in serve.status():
@@ -122,7 +137,10 @@ def test_serve_up_two_replicas_lb_and_down(tmp_path):
         seen = set()
         deadline = time.time() + 20
         while time.time() < deadline and seen != {'1', '2'}:
-            seen.add(_get(result['endpoint'] + '/hello')['replica'])
+            try:
+                seen.add(_get(result['endpoint'] + '/hello')['replica'])
+            except Exception:
+                pass  # LB may 502 until its next sync picks up a replica
             time.sleep(0.2)
         assert seen == {'1', '2'}
 
@@ -189,7 +207,7 @@ def test_serve_update_blue_green(tmp_path):
     try:
         result = serve.up(task, service_name='upd')
         _wait_ready('upd', n_ready=1)
-        assert _get(result['endpoint'] + '/x')['msg'] == 'v1'
+        assert _get_retry(result['endpoint'] + '/x')['msg'] == 'v1'
 
         new_task = _service_task(tmp_path, n_replicas=1)
         new_task.update_envs({'MSG': 'v2'})

@@ -1,0 +1,154 @@
+"""HF checkpoint import: logits parity against ``transformers`` (torch
+CPU) for Llama/GQA, Gemma (MQA + tied embeddings + gelu + norm+1), and
+Mixtral (MoE), plus save/load round-trip and tokenizer behavior.
+
+The reference serves *real* HF checkpoints through external engines
+(``llm/llama-3/llama3.yaml:109``); this proves our in-tree engine computes
+the same function as the HF reference implementation for those layouts.
+"""
+import json
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from skypilot_tpu.models import configs, llama, weights
+from skypilot_tpu.models.tokenizer import (ByteTokenizer, load_tokenizer)
+
+jax.config.update('jax_platforms', 'cpu')
+
+
+def _save_hf_model(model, path):
+    model.save_pretrained(path, safe_serialization=True)
+
+
+def _our_logits(path, tokens):
+    cfg, params = weights.load_checkpoint(path, dtype=jnp.float32)
+    logits, _ = llama.forward(params, jnp.asarray(tokens), cfg)
+    return np.asarray(logits, np.float32), cfg
+
+
+def _hf_logits(model, tokens):
+    import torch
+    with torch.no_grad():
+        out = model(torch.tensor(tokens))
+    return out.logits.float().numpy()
+
+
+def _assert_close(ours, theirs, atol=2e-3):
+    err = np.abs(ours - theirs).max()
+    assert err < atol, f'max |logit diff| = {err}'
+
+
+@pytest.fixture(scope='module')
+def torch_seed():
+    import torch
+    torch.manual_seed(0)
+
+
+def test_llama_gqa_logits_parity(tmp_path, torch_seed):
+    from transformers import LlamaConfig, LlamaForCausalLM
+    hf_cfg = LlamaConfig(
+        vocab_size=97, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=3, num_attention_heads=8, num_key_value_heads=2,
+        max_position_embeddings=64, rope_theta=10000.0, rms_norm_eps=1e-5,
+        tie_word_embeddings=False, attention_bias=False, mlp_bias=False)
+    model = LlamaForCausalLM(hf_cfg).eval()
+    path = str(tmp_path / 'llama')
+    _save_hf_model(model, path)
+
+    tokens = np.random.RandomState(0).randint(0, 97, (2, 17))
+    ours, cfg = _our_logits(path, tokens)
+    assert cfg.n_kv_heads == 2 and not cfg.tie_embeddings
+    _assert_close(ours, _hf_logits(model, tokens))
+
+
+def test_gemma_mqa_logits_parity(tmp_path, torch_seed):
+    from transformers import GemmaConfig, GemmaForCausalLM
+    hf_cfg = GemmaConfig(
+        vocab_size=89, hidden_size=48, intermediate_size=96,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=1,
+        head_dim=16, max_position_embeddings=64, rope_theta=10000.0,
+        rms_norm_eps=1e-6, hidden_act='gelu_pytorch_tanh',
+        hidden_activation='gelu_pytorch_tanh')
+    model = GemmaForCausalLM(hf_cfg).eval()
+    path = str(tmp_path / 'gemma')
+    _save_hf_model(model, path)
+
+    tokens = np.random.RandomState(1).randint(0, 89, (2, 11))
+    ours, cfg = _our_logits(path, tokens)
+    assert cfg.tie_embeddings and cfg.norm_plus_one and cfg.scale_embeddings
+    assert cfg.head_dim == 16  # explicit head_dim != dim//n_heads
+    _assert_close(ours, _hf_logits(model, tokens))
+
+
+def test_mixtral_moe_logits_parity(tmp_path, torch_seed):
+    from transformers import MixtralConfig, MixtralForCausalLM
+    hf_cfg = MixtralConfig(
+        vocab_size=71, hidden_size=32, intermediate_size=64,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        num_local_experts=4, num_experts_per_tok=2,
+        max_position_embeddings=64, rope_theta=10000.0, rms_norm_eps=1e-5,
+        tie_word_embeddings=False)
+    model = MixtralForCausalLM(hf_cfg).eval()
+    path = str(tmp_path / 'mixtral')
+    _save_hf_model(model, path)
+
+    tokens = np.random.RandomState(2).randint(0, 71, (1, 13))
+    cfg, params = weights.load_checkpoint(path, dtype=jnp.float32)
+    assert cfg.is_moe and cfg.n_experts == 4
+    # Our MoE uses GShard capacity-limited dispatch: with a generous
+    # capacity factor no tokens are dropped and it matches HF's exact
+    # (ungated-capacity) routing.
+    import dataclasses
+    cfg = dataclasses.replace(cfg, moe_capacity_factor=8.0)
+    logits, _ = llama.forward(params, jnp.asarray(tokens), cfg)
+    _assert_close(np.asarray(logits, np.float32),
+                  _hf_logits(model, tokens), atol=5e-3)
+
+
+def test_save_load_roundtrip(tmp_path):
+    cfg = configs.TINY
+    params = llama.init_params(jax.random.PRNGKey(0), cfg)
+    path = str(tmp_path / 'rt')
+    weights.save_hf_checkpoint(path, cfg, params)
+    cfg2, params2 = weights.load_checkpoint(path, dtype=cfg.dtype)
+    assert cfg2.dim == cfg.dim and cfg2.n_kv_heads == cfg.n_kv_heads
+    tok = np.arange(24).reshape(1, 24) % cfg.vocab_size
+    l1, _ = llama.forward(params, jnp.asarray(tok), cfg)
+    l2, _ = llama.forward(params2, jnp.asarray(tok), cfg2)
+    np.testing.assert_allclose(np.asarray(l1, np.float32),
+                               np.asarray(l2, np.float32), atol=2e-2)
+
+
+def test_byte_tokenizer_roundtrip():
+    tk = ByteTokenizer()
+    ids = tk.encode('hello, TPU!')
+    assert ids[0] == tk.bos_id
+    assert tk.decode(ids) == 'hello, TPU!'
+    assert tk.vocab_size == 258
+
+
+def test_load_tokenizer_fallback(tmp_path):
+    assert isinstance(load_tokenizer(str(tmp_path)), ByteTokenizer)
+    assert isinstance(load_tokenizer(None), ByteTokenizer)
+
+
+def test_hf_tokenizer_from_file(tmp_path):
+    # Build a minimal valid tokenizer.json (WordLevel) via the tokenizers
+    # lib, then load through our wrapper.
+    from tokenizers import Tokenizer
+    from tokenizers.models import WordLevel
+    from tokenizers.pre_tokenizers import Whitespace
+    vocab = {'<s>': 0, '</s>': 1, 'hello': 2, 'tpu': 3}
+    tk = Tokenizer(WordLevel(vocab, unk_token='</s>'))
+    tk.pre_tokenizer = Whitespace()
+    tk.save(str(tmp_path / 'tokenizer.json'))
+    (tmp_path / 'tokenizer_config.json').write_text(json.dumps(
+        {'bos_token': '<s>', 'eos_token': '</s>'}))
+    our = load_tokenizer(str(tmp_path))
+    ids = our.encode('hello tpu')
+    assert ids == [0, 2, 3]
+    assert our.eos_id == 1
