@@ -110,6 +110,17 @@ class InferenceEngine:
         self._decode_fn = self._build_decode()
         self._prefill_fns: Dict[int, Any] = {}
 
+    @classmethod
+    def from_pretrained(cls, path: str, *, dtype: Any = None,
+                        **kwargs) -> 'InferenceEngine':
+        """Build an engine from an HF checkpoint directory
+        (``config.json`` + safetensors; see ``models/weights.py``)."""
+        import jax.numpy as jnp
+        from skypilot_tpu.models import weights
+        cfg, params = weights.load_checkpoint(
+            path, dtype=dtype if dtype is not None else jnp.bfloat16)
+        return cls(cfg, params, **kwargs)
+
     # ------------------------------------------------------------------
     # Compiled steps
     # ------------------------------------------------------------------
@@ -124,9 +135,10 @@ class InferenceEngine:
         cfg = self.cfg
 
         @functools.partial(jax.jit, donate_argnums=(1,),
-                           static_argnames=('horizon', 'sample'))
+                           static_argnames=('horizon', 'sample',
+                                            'kv_bucket'))
         def decode_steps(params, cache, tokens, rng, temps, topks, active,
-                         horizon, sample):
+                         horizon, sample, kv_bucket):
             if sample:
                 def sample_fn(logits, step_rng):
                     next_greedy = jnp.argmax(logits, -1).astype(jnp.int32)
@@ -141,7 +153,7 @@ class InferenceEngine:
                 sample_fn, rngs = None, None
             toks, cache = llama.decode_horizon(
                 params, cache, tokens, cfg, horizon=horizon,
-                sample_fn=sample_fn, rngs=rngs)
+                sample_fn=sample_fn, rngs=rngs, kv_bucket=kv_bucket)
             # inactive slots don't advance their cache length
             new_len = jnp.where(active, cache.length,
                                 cache.length - horizon)
@@ -235,6 +247,13 @@ class InferenceEngine:
                 break
         if not batch:
             return []
+        # More free slots than the largest prefill bucket: admit the
+        # first chunk now; the rest waits for the next step() call.
+        cap = self._PREFILL_N_BUCKETS[-1]
+        if len(batch) > cap:
+            for slot, req in batch[cap:]:
+                self._queue.put(req)      # requeued behind any new arrivals
+            batch = batch[:cap]
         # Pad request count to a compiled bucket (extra rows re-prefill the
         # first request into its own slot — harmless duplicate writes).
         n = 1
@@ -286,6 +305,15 @@ class InferenceEngine:
                   max(self._slot_len[s] for s in range(self.max_batch)
                       if self._slots[s] is not None))
         horizon = max(1, min(horizon, cap))
+        # Each fused step re-reads the whole [L, b, horizon] ring of rows
+        # produced this horizon; past ~15% of the weight-read traffic the
+        # ring dominates the HBM budget and longer horizons backfire
+        # (measured: 1B model, b=64 — horizon 128 halves throughput vs 64).
+        ring_row_bytes = (self.cfg.n_layers * self.max_batch *
+                          self.cfg.n_kv_heads * self.cfg.head_dim * 2 * 2)
+        ring_cap = max(8, int(0.15 * 2 * self.cfg.num_params
+                              / ring_row_bytes))
+        horizon = min(horizon, ring_cap)
         for b in reversed(self._HORIZON_BUCKETS):
             if b <= horizon:
                 horizon = b
@@ -296,11 +324,19 @@ class InferenceEngine:
         topks = np.array([r.top_k if r else 0 for r in self._slots],
                          np.int32)
         sample = bool((temps > 0).any())
+        # Length-aware KV reads: attention streams only the first
+        # kv_bucket cache rows (decode is HBM-bound on this read). The
+        # bucket must cover every live context through this horizon;
+        # power-of-two-ish rounding bounds compiled-program count.
+        max_live = int(max(self._slot_len[s]
+                           for s in range(self.max_batch)
+                           if self._slots[s] is not None))
+        kv_bucket = min(self.max_seq, _bucket_len(max_live + horizon))
         self._rng, rng = jax.random.split(self._rng)
         toks, self.cache = self._decode_fn(
             self.params, self.cache, jnp.asarray(self._cur_token), rng,
             jnp.asarray(temps), jnp.asarray(topks), jnp.asarray(active),
-            horizon, sample)
+            horizon, sample, kv_bucket)
         toks = np.asarray(toks)                       # [slots, horizon]
 
         events: List[Tuple[int, int, bool]] = []

@@ -260,15 +260,21 @@ def _layer_core(layer: Params, x: jax.Array, cfg: ModelConfig,
     maps roped (q, k, v) to the attention output.
 
     Returns (x, (k, v) new kv rows, moe aux loss)."""
+    from jax.ad_checkpoint import checkpoint_name
     h = rms_norm(x, layer['attn_norm'], cfg.norm_eps,
                   cfg.norm_plus_one)
     q = jnp.einsum('bsd,dhk->bshk', h, layer['wq'])
     k = jnp.einsum('bsd,dhk->bshk', h, layer['wk'])
     v = jnp.einsum('bsd,dhk->bshk', h, layer['wv'])
     q = _shard(q, 'batch', 'seq', 'heads', 'head_dim')
-    q = rope(q, positions, cfg.rope_theta)
-    k = rope(k, positions, cfg.rope_theta)
+    q = checkpoint_name(rope(q, positions, cfg.rope_theta), 'q_rope')
+    k = checkpoint_name(rope(k, positions, cfg.rope_theta), 'k_rope')
+    v = checkpoint_name(v, 'v_proj')
     out = attn_fn(q, k, v)
+    # Named for selective remat (cfg.remat='attn'): saving the attention
+    # output keeps the backward pass from re-running the whole attention
+    # forward, at [b,s,h,d] bytes per layer.
+    out = checkpoint_name(out, 'attn_out')
     out = _shard(out, 'batch', 'seq', 'heads', 'head_dim')
     x = x + jnp.einsum('bshk,hkd->bsd', out, layer['wo'])
     h = rms_norm(x, layer['ffn_norm'], cfg.norm_eps,
@@ -350,6 +356,22 @@ def forward(
     if cfg.remat == 'block':
         body = jax.checkpoint(body,
                               policy=jax.checkpoint_policies.nothing_saveable)
+    elif cfg.remat == 'attn':
+        # Selective remat: save roped q/k/v and the attention output
+        # ([b,s,h,d] each — small next to the ffn intermediates), so the
+        # backward pass never re-runs the attention forward; everything
+        # else (norms, ffn) is recomputed. The MFU middle ground between
+        # 'none' (OOM at ≥1B on one chip) and 'block' (full re-forward).
+        body = jax.checkpoint(
+            body,
+            policy=jax.checkpoint_policies.save_only_these_names(
+                'q_rope', 'k_rope', 'v_proj', 'attn_out'))
+    elif cfg.remat == 'dots':
+        # Keep all matmul outputs, recompute elementwise only. Highest
+        # memory — viable for small models / many-chip FSDP shards.
+        body = jax.checkpoint(
+            body,
+            policy=jax.checkpoint_policies.dots_with_no_batch_dims_saveable)
 
     if cache is None:
         pp_mesh = _pp_mesh()
@@ -443,6 +465,10 @@ def decode_horizon(
     horizon: int,
     sample_fn=None,                    # (logits [b, vocab], rng) -> [b] int32
     rngs: Optional[jax.Array] = None,  # [horizon] keys when sample_fn set
+    kv_bucket: Optional[int] = None,   # static: attention reads only the
+                                       # first kv_bucket cache rows; caller
+                                       # guarantees max(length)+horizon <=
+                                       # kv_bucket (length-aware decode)
 ):
     """``horizon`` fused autoregressive decode steps in one program.
 
@@ -460,7 +486,15 @@ def decode_horizon(
     b = tokens.shape[0]
     n_layers, n_kv, hd = cfg.n_layers, cfg.n_kv_heads, cfg.head_dim
     len0 = cache.length
-    cache_k, cache_v = cache.k, cache.v
+    full_k, full_v = cache.k, cache.v
+    if kv_bucket is not None and kv_bucket < full_k.shape[2]:
+        # Decode is HBM-bound on the cache read; a static prefix slice
+        # keeps per-step traffic proportional to the LIVE context, not
+        # max_seq. (Rows >= kv_bucket are masked out anyway.)
+        cache_k = full_k[:, :, :kv_bucket]
+        cache_v = full_v[:, :, :kv_bucket]
+    else:
+        cache_k, cache_v = full_k, full_v
     layer_params = params['layers']
     ring_k = jnp.zeros((n_layers, b, horizon, n_kv, hd), cache_k.dtype)
     ring_v = jnp.zeros_like(ring_k)
@@ -511,9 +545,9 @@ def decode_horizon(
         return lax.dynamic_update_slice(c, n, (0, start, 0, 0))
 
     new_k = jax.vmap(write, in_axes=(1, 1, 0), out_axes=1)(
-        cache_k, ring_k, len0)
+        full_k, ring_k, len0)
     new_v = jax.vmap(write, in_axes=(1, 1, 0), out_axes=1)(
-        cache_v, ring_v, len0)
+        full_v, ring_v, len0)
     return toks.T, KVCache(k=new_k, v=new_v, length=len0 + horizon)
 
 

@@ -34,9 +34,16 @@ class BaseTokenizer:
 
 
 class ByteTokenizer(BaseTokenizer):
-    """UTF-8 bytes as token ids; 256=BOS, 257=EOS."""
-    bos_id = 256
-    eos_id = 257
+    """UTF-8 bytes as token ids; 256=BOS, 257=EOS when the model's vocab
+    has room for them (``model_vocab_size >= 258``), omitted otherwise —
+    emitting id 256 at a 256-vocab model would silently clamp the
+    embedding gather."""
+
+    def __init__(self, model_vocab_size: int = 258):
+        if model_vocab_size >= 258:
+            self.bos_id, self.eos_id = 256, 257
+        else:
+            self.bos_id = self.eos_id = None
 
     @property
     def vocab_size(self) -> int:
@@ -44,7 +51,8 @@ class ByteTokenizer(BaseTokenizer):
 
     def encode(self, text: str, *, bos: bool = True) -> List[int]:
         ids = list(text.encode('utf-8'))
-        return ([self.bos_id] + ids) if bos else ids
+        return ([self.bos_id] + ids) if bos and self.bos_id is not None \
+            else ids
 
     def decode(self, ids: Sequence[int]) -> str:
         return bytes(i for i in ids if i < 256).decode(
@@ -109,9 +117,11 @@ class HFTokenizer(BaseTokenizer):
         return self._tk.decode(list(ids), skip_special_tokens=True)
 
 
-def load_tokenizer(path: Optional[str]) -> BaseTokenizer:
+def load_tokenizer(path: Optional[str],
+                   model_vocab_size: int = 258) -> BaseTokenizer:
     """Tokenizer for a checkpoint dir: ``tokenizer.json`` if present,
-    byte-level fallback otherwise."""
+    byte-level fallback otherwise. ``model_vocab_size`` lets the byte
+    fallback drop BOS/EOS ids the model's embedding can't represent."""
     if path and os.path.exists(os.path.join(path, 'tokenizer.json')):
         return HFTokenizer(path)
-    return ByteTokenizer()
+    return ByteTokenizer(model_vocab_size)

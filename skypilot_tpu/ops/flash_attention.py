@@ -360,7 +360,7 @@ _flash_bhsd.defvjp(_flash_fwd_rule, _flash_bwd_rule)
 
 def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
                     causal: bool = True, scale: Optional[float] = None,
-                    block_q: int = 128, block_k: int = 128,
+                    block_q: int = 512, block_k: int = 512,
                     interpret: bool = False) -> jax.Array:
     """Flash attention over model-layout tensors.
 
@@ -375,6 +375,15 @@ def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
         raise ValueError(
             f'causal flash kernel assumes sq == skv (got {sq} vs {skv}); '
             'use ops.attention with q_offset for cached prefill/decode')
+    # Shrink blocks (512 -> 256 -> 128) until they divide the sequence:
+    # 512 is the throughput sweet spot, but seq lengths like 640/768 are
+    # only 128-divisible and must still route through the kernel.
+    block_q = min(block_q, sq)
+    while block_q > 128 and sq % block_q:
+        block_q //= 2
+    block_k = min(block_k, skv)
+    while block_k > 128 and skv % block_k:
+        block_k //= 2
     if sq % block_q != 0 or skv % block_k != 0:
         raise ValueError(
             f'seq lengths must be divisible by block sizes: sq={sq} '

@@ -31,12 +31,15 @@ logger = tpu_logging.init_logger(__name__)
 class ModelServer:
 
     def __init__(self, cfg_name: str = 'tiny', *, max_batch: int = 8,
-                 max_seq: int = 1024, port: int = 8081):
+                 max_seq: int = 1024, port: int = 8081,
+                 model_path: Optional[str] = None):
         self.cfg_name = cfg_name
+        self.model_path = model_path  # HF checkpoint dir (real weights)
         self.max_batch = max_batch
         self.max_seq = max_seq
         self.port = port
         self.engine = None            # set once loaded
+        self.tokenizer = None         # set once loaded
         self._error: Optional[str] = None   # fatal engine failure
         self._ready = threading.Event()
         self._work = threading.Event()
@@ -49,9 +52,21 @@ class ModelServer:
     def _load_engine(self) -> None:
         from skypilot_tpu.inference.engine import InferenceEngine
         from skypilot_tpu.models import configs
-        cfg = configs.get_config(self.cfg_name)
-        engine = InferenceEngine(cfg, max_batch=self.max_batch,
-                                 max_seq=self.max_seq)
+        from skypilot_tpu.models.tokenizer import load_tokenizer
+        if self.model_path:
+            # Real weights: HF checkpoint dir (config.json + safetensors
+            # [+ tokenizer.json]) — the reference serves such checkpoints
+            # through vLLM/JetStream (llm/llama-3/llama3.yaml:109).
+            engine = InferenceEngine.from_pretrained(
+                self.model_path, max_batch=self.max_batch,
+                max_seq=self.max_seq)
+            self.cfg_name = engine.cfg.name
+        else:
+            cfg = configs.get_config(self.cfg_name)
+            engine = InferenceEngine(cfg, max_batch=self.max_batch,
+                                     max_seq=self.max_seq)
+        self.tokenizer = load_tokenizer(
+            self.model_path, model_vocab_size=engine.cfg.vocab_size)
         # Warmup: compile prefill+decode before declaring readiness.
         engine.add_request([1, 2, 3], max_new_tokens=2)
         engine.run_to_completion(horizon=4)
@@ -169,13 +184,22 @@ class ModelServer:
                 try:
                     payload = json.loads(self.rfile.read(length))
                     prompt = payload['prompt']
+                    tok = server.tokenizer
+                    is_text = isinstance(prompt, str)
+                    if is_text:
+                        prompt = tok.encode(prompt)
+                    eos_id = payload.get('eos_id')
+                    if eos_id is None and is_text:
+                        eos_id = tok.eos_id
                     result = server.submit(
                         prompt,
                         max_new_tokens=int(
                             payload.get('max_new_tokens', 128)),
                         temperature=float(payload.get('temperature', 0.0)),
                         top_k=int(payload.get('top_k', 0)),
-                        eos_id=payload.get('eos_id'))
+                        eos_id=eos_id)
+                    if is_text:
+                        result['text'] = tok.decode(result['tokens'])
                     self._json(200, result)
                 except (KeyError, ValueError, json.JSONDecodeError) as e:
                     self._json(400, {'error': f'{type(e).__name__}: {e}'})
@@ -203,7 +227,10 @@ class ModelServer:
 
 def main() -> None:
     parser = argparse.ArgumentParser()
-    parser.add_argument('--model', default='tiny')
+    parser.add_argument('--model', default='tiny',
+                        help='preset config name (random weights)')
+    parser.add_argument('--model-path', default=None,
+                        help='HF checkpoint dir (real weights + tokenizer)')
     parser.add_argument('--max-batch', type=int, default=8)
     parser.add_argument('--max-seq', type=int, default=1024)
     parser.add_argument('--port', type=int,
@@ -211,7 +238,8 @@ def main() -> None:
                                                    '8081')))
     args = parser.parse_args()
     server = ModelServer(args.model, max_batch=args.max_batch,
-                         max_seq=args.max_seq, port=args.port)
+                         max_seq=args.max_seq, port=args.port,
+                         model_path=args.model_path)
     server.start(block=True)
 
 

@@ -44,6 +44,10 @@ class TrainConfig:
     grad_clip_norm: float = 1.0
     attn_impl: str = 'auto'
     moe_aux_weight: float = 0.01
+    # Adam first-moment dtype: bfloat16 halves optimizer memory with
+    # negligible quality impact (the noisy moment tolerates it; the
+    # variance stays fp32) — lets ~1B-param models train on one 16GB chip.
+    mu_dtype: str = 'float32'
 
 
 def make_optimizer(tc: TrainConfig) -> optax.GradientTransformation:
@@ -55,7 +59,7 @@ def make_optimizer(tc: TrainConfig) -> optax.GradientTransformation:
         optax.clip_by_global_norm(tc.grad_clip_norm),
         optax.adamw(schedule, b1=tc.b1, b2=tc.b2,
                     weight_decay=tc.weight_decay,
-                    mu_dtype=jnp.float32),
+                    mu_dtype=jnp.dtype(tc.mu_dtype)),
     )
 
 
@@ -167,6 +171,22 @@ class Trainer:
     def init(self, rng: jax.Array) -> TrainState:
         with self.mesh:
             return self._init_jit(rng)
+
+    def init_from_pretrained(self, path: str) -> TrainState:
+        """Start training from an HF checkpoint (fine-tuning entry):
+        params come from the checkpoint (sharded per the param rules),
+        optimizer state is fresh."""
+        from skypilot_tpu.models import weights
+        params = weights.load_hf_params(path, self.cfg)
+        params = jax.device_put(params, self.param_shardings)
+
+        def init_opt(p):
+            return TrainState(step=jnp.zeros((), jnp.int32), params=p,
+                              opt_state=self.optimizer.init(p))
+
+        with self.mesh:
+            return jax.jit(init_opt,
+                           out_shardings=self.state_shardings)(params)
 
     def step(self, state: TrainState, batch: Dict[str, jax.Array]
              ) -> Tuple[TrainState, Dict[str, jax.Array]]:
