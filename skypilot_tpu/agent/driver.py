@@ -30,12 +30,18 @@ def _load_cluster_info() -> provision_common.ClusterInfo:
 
 
 def build_rank_env(cluster_info: provision_common.ClusterInfo,
-                   rank: int, job_id: int,
-                   num_slices: int = 1, slice_id: int = 0
-                   ) -> Dict[str, str]:
-    """The per-host env contract (gang/rank + jax.distributed bootstrap)."""
+                   rank: int, job_id: int) -> Dict[str, str]:
+    """The per-host env contract (gang/rank + jax.distributed bootstrap).
+
+    Multi-slice: SKYTPU_SLICE_ID/NUM_SLICES come from the cluster
+    topology (each provisioned TPU node/queued-resource is one slice);
+    the jax.distributed coordinator is global rank 0's host, so one
+    coordinator spans all slices and the DCN mesh axis works."""
     ips = cluster_info.worker_ips()
     head_ip = cluster_info.head_host().internal_ip
+    # Lookup by rank, not position: a gapped host list (partial failure)
+    # must fail loudly, not hand out another host's slice id.
+    slice_id = {h.rank: h for h in cluster_info.hosts}[rank].slice_id
     return {
         constants.ENV_NODE_RANK: str(rank),
         constants.ENV_NODE_IPS: '\n'.join(ips),
@@ -46,7 +52,7 @@ def build_rank_env(cluster_info: provision_common.ClusterInfo,
         constants.ENV_JOB_ID: str(job_id),
         constants.ENV_CLUSTER_NAME: cluster_info.cluster_name,
         constants.ENV_SLICE_ID: str(slice_id),
-        constants.ENV_NUM_SLICES: str(num_slices),
+        constants.ENV_NUM_SLICES: str(cluster_info.num_slices),
     }
 
 

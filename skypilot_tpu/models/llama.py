@@ -153,7 +153,19 @@ def rms_norm(x: jax.Array, w: jax.Array, eps: float,
 
 def _embed_tokens(params: Params, tokens: jax.Array,
                   cfg: ModelConfig) -> jax.Array:
-    x = params['embed'][tokens]
+    table = params['embed']
+    if tokens.shape[1] > 1 and _in_multidevice_mesh():
+        # Training/prefill under a mesh: a gather from the fsdp-sharded
+        # table forces an involuntary full rematerialization in the SPMD
+        # partitioner (gather output is embed-sharded, activations are
+        # batch-sharded). A one-hot matmul partitions cleanly and rides
+        # the MXU — the TPU-idiomatic embedding (MaxText's iota-embed).
+        # Decode (s == 1) keeps the gather: a per-step one-hot would
+        # stream the whole table instead of b rows.
+        oh = jax.nn.one_hot(tokens, cfg.vocab_size, dtype=table.dtype)
+        x = jnp.einsum('bsv,vd->bsd', oh, table)
+    else:
+        x = table[tokens]
     if cfg.scale_embeddings:                  # Gemma: sqrt(dim) input scale
         x = (x.astype(jnp.float32) * cfg.dim ** 0.5).astype(x.dtype)
     return x
@@ -181,19 +193,31 @@ def rope(x: jax.Array, positions: jax.Array, theta: float) -> jax.Array:
     return out.astype(x.dtype)
 
 
-def _in_mesh_context() -> bool:
-    """True when a `with mesh:` context is active. jax has no public
-    predicate for this; probe the known private locations and fail open
-    (no constraint) so a jax upgrade degrades perf, not correctness."""
-    try:
-        from jax._src import mesh as mesh_src
-        return not mesh_src.thread_resources.env.physical_mesh.empty
-    except Exception:
+def _ambient_mesh():
+    """The active `with mesh:` context's mesh, or None. jax has no public
+    accessor for this; probe the known private locations and fail open
+    (None → no constraint) so a jax upgrade degrades perf, not
+    correctness."""
+    for probe in ('jax._src.mesh', 'jax.interpreters.pxla'):
         try:
-            from jax.interpreters import pxla
-            return not pxla.thread_resources.env.physical_mesh.empty
-        except Exception:
-            return False
+            import importlib
+            mod = importlib.import_module(probe)
+            m = mod.thread_resources.env.physical_mesh
+            return None if m.empty else m
+        except Exception:  # pylint: disable=broad-except
+            continue
+    return None
+
+
+def _in_mesh_context() -> bool:
+    return _ambient_mesh() is not None
+
+
+def _in_multidevice_mesh() -> bool:
+    """True when the ambient mesh spans more than one device (the case
+    where gather-vs-one-hot embedding choice matters)."""
+    m = _ambient_mesh()
+    return m is not None and m.size > 1
 
 
 _pp_probe_warned = False

@@ -124,10 +124,16 @@ def moe_ffn(layer: Params, x: jax.Array, cfg: ModelConfig):
         kept[..., None].astype(x.dtype)
     dispatch = (assign.astype(x.dtype)[..., None] *
                 slot_oh[..., None, :]).reshape(G, group, k, E, C)
+    # Pin the dispatch/combine tensors to expert-dim sharding: without
+    # the constraint the partitioner propagates token-dim shardings into
+    # them and pays an involuntary full rematerialization flipping to
+    # the expert-sharded layout the expert matmuls need.
+    dispatch = _shard_moe(dispatch, None, None, None, 'expert', None)
     dispatch_mask = dispatch.sum(2)                         # [G,group,E,C]
     combine = jnp.einsum('gtk,gtkec->gtec',
                          topk_w.reshape(G, group, k).astype(x.dtype),
                          dispatch)
+    combine = _shard_moe(combine, None, None, 'expert', None)
 
     # Gather expert buffers, compute, scatter back — sharded over the
     # expert axis, batched over groups.

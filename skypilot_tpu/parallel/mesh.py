@@ -68,6 +68,46 @@ class MeshSpec:
                    num_slices=num_slices)
 
 
+def spec_from_env(*, tp: Optional[int] = None, sp: int = 1,
+                  num_devices: Optional[int] = None) -> MeshSpec:
+    """MeshSpec honoring the launch env contract: SKYTPU_NUM_SLICES (set
+    by the job driver from the provisioned topology) becomes the DCN
+    mesh axis. Falls back to a single slice outside a launched job."""
+    import os
+    num_slices = int(os.environ.get('SKYTPU_NUM_SLICES', '1') or 1)
+    if num_devices is None:
+        num_devices = jax.device_count()
+    return MeshSpec.auto(num_devices, num_slices=num_slices, tp=tp, sp=sp)
+
+
+_distributed_initialized = False
+
+
+def initialize_distributed_from_env() -> bool:
+    """Multi-host bootstrap from the SKYTPU_* env contract: calls
+    jax.distributed.initialize(coordinator, num_processes, process_id)
+    when launched on a multi-host cluster; no-op (returns False) when
+    the contract is absent or single-host. Idempotent — safe to call
+    from every Trainer/engine constructor."""
+    global _distributed_initialized
+    import os
+    coord = os.environ.get('SKYTPU_COORDINATOR_ADDRESS')
+    n = int(os.environ.get('SKYTPU_NUM_NODES', '1') or 1)
+    if not coord or n <= 1:
+        return False
+    if _distributed_initialized:
+        return True
+    rank = int(os.environ.get('SKYTPU_NODE_RANK', '0') or 0)
+    try:
+        jax.distributed.initialize(coordinator_address=coord,
+                                   num_processes=n, process_id=rank)
+    except RuntimeError:
+        # Already initialized by the user program — that's fine.
+        pass
+    _distributed_initialized = True
+    return True
+
+
 def make_mesh(spec: MeshSpec,
               devices: Optional[Sequence[jax.Device]] = None) -> Mesh:
     """Build the 5-D mesh. Axis order puts `tp` innermost so tensor-parallel

@@ -29,6 +29,9 @@ class HostInfo:
     ssh_port: int = 22
     # Local provisioner: the directory acting as this host's HOME.
     node_dir: Optional[str] = None
+    # Which pod slice this host belongs to (multi-slice DCN jobs; each
+    # provisioned TPU node/queued-resource is one slice).
+    slice_id: int = 0
     tags: Dict[str, str] = dataclasses.field(default_factory=dict)
 
     def to_dict(self) -> Dict[str, Any]:
@@ -61,6 +64,11 @@ class ClusterInfo:
     @property
     def num_hosts(self) -> int:
         return len(self.hosts)
+
+    @property
+    def num_slices(self) -> int:
+        """Slices in this cluster (1 + max host slice_id)."""
+        return 1 + max((h.slice_id for h in self.hosts), default=0)
 
     def head_host(self) -> HostInfo:
         for h in self.hosts:

@@ -441,7 +441,7 @@ def get_cluster_info(region: str, cluster_name: str) -> common.ClusterInfo:
             suffix = name.rsplit('-', 1)[-1]
             return (0, int(suffix)) if suffix.isdigit() else (1, name)
 
-        for node_idx in sorted(nodes, key=_node_key):
+        for slice_idx, node_idx in enumerate(sorted(nodes, key=_node_key)):
             node = nodes[node_idx]
             accelerator = node.get('acceleratorType', accelerator)
             endpoints = node.get('networkEndpoints') or []
@@ -452,6 +452,7 @@ def get_cluster_info(region: str, cluster_name: str) -> common.ClusterInfo:
                     internal_ip=ep.get('ipAddress', ''),
                     external_ip=(ep.get('accessConfig') or {}).get(
                         'externalIp'),
+                    slice_id=slice_idx,   # each TPU node/QR is one slice
                 ))
                 rank += 1
         chips = {'v2': 4, 'v3': 4, 'v4': 4, 'v5p': 4,

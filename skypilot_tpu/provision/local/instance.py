@@ -207,14 +207,16 @@ def get_cluster_info(region: str, cluster_name: str) -> common.ClusterInfo:
     meta = _load_meta(cluster_name)
     if meta is None:
         raise exceptions.ClusterDoesNotExist(cluster_name)
+    node_config = meta.get('node_config', {})
+    hosts_per_slice = int(node_config.get('hosts_per_node', 1)) or 1
     hosts = []
     for i in range(meta['num_hosts']):
         hosts.append(common.HostInfo(
             instance_id=f'{cluster_name}-node-{i}',
             rank=i,
             internal_ip='127.0.0.1',
+            slice_id=i // hosts_per_slice,
             node_dir=os.path.join(_cluster_dir(cluster_name), f'node-{i}')))
-    node_config = meta.get('node_config', {})
     return common.ClusterInfo(
         cluster_name=cluster_name,
         provider_name='local',

@@ -3,9 +3,10 @@
 Functional parity with reference ``sky/task.py`` (``Task`` at
 ``sky/task.py:171``, ``from_yaml_config`` at ``:347``). TPU-first differences:
 
-- ``num_nodes`` means *CPU VM count* for CPU clusters. For TPU tasks the host
-  count comes from the slice topology (``Resources.tpu.num_hosts``) — the
-  slice IS the gang, you don't pick node counts separately.
+- ``num_nodes`` means *CPU VM count* for CPU clusters and *slice count*
+  for TPU tasks (a multi-slice DCN job when > 1). Per-slice host count
+  always comes from the slice topology (``Resources.tpu.num_hosts``) —
+  the slice IS the gang.
 - Env interpolation supports ``$VAR``/``${VAR}`` from ``envs`` at YAML load.
 """
 from __future__ import annotations
@@ -104,19 +105,21 @@ class Task:
         self._best_resources = resources
 
     def _validate_topology(self) -> None:
-        for res in self._resources:
-            if res.is_tpu and self.num_nodes > 1:
-                raise exceptions.InvalidTaskError(
-                    'TPU tasks take their host count from the slice topology '
-                    f'({res.tpu}); do not set num_nodes (got '
-                    f'{self.num_nodes}). Use a larger slice instead.')
+        # For TPU tasks, per-slice host count comes from the slice
+        # topology; num_nodes > 1 requests a MULTI-SLICE job (num_nodes
+        # slices joined over DCN — the SKYTPU_SLICE_ID/NUM_SLICES env
+        # contract).
+        if self.num_nodes < 1:
+            raise exceptions.InvalidTaskError(
+                f'num_nodes must be >= 1, got {self.num_nodes}')
 
     def num_hosts(self, resources: Optional[resources_lib.Resources] = None
                   ) -> int:
-        """Hosts the run command executes on, for the chosen resources."""
+        """Hosts the run command executes on, for the chosen resources.
+        TPU: hosts-per-slice x num_nodes (slices)."""
         res = resources or self.best_resources
         if res.is_tpu:
-            return res.tpu.num_hosts
+            return res.tpu.num_hosts * self.num_nodes
         return self.num_nodes
 
     # ---------------- env ----------------

@@ -101,7 +101,13 @@ class Trainer:
         self.cfg = cfg
         self.tc = train_config or TrainConfig()
         if mesh is None:
-            spec = mesh_spec or mesh_lib.MeshSpec.auto(jax.device_count())
+            # Multi-host launched jobs: join the jax.distributed gang
+            # BEFORE reading device_count, else each host builds a
+            # disconnected local mesh. No-op outside a launched job.
+            mesh_lib.initialize_distributed_from_env()
+            # Default spec honors the launch env contract (multi-slice
+            # jobs set SKYTPU_NUM_SLICES; standalone use sees 1 slice).
+            spec = mesh_spec or mesh_lib.spec_from_env()
             mesh = mesh_lib.make_mesh(spec)
         self.mesh = mesh
         self.rules = rules or mesh_lib.DEFAULT_RULES

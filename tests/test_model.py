@@ -221,3 +221,28 @@ class TestGemmaFamily:
         actual = sum(x.size for x in jax.tree.leaves(params))
         est = configs.TINY_GEMMA.num_params
         assert abs(actual - est) / actual < 0.05
+
+
+class TestMeshFromEnv:
+    """The launch env contract (SKYTPU_NUM_SLICES) drives the trainer's
+    default mesh — the multi-slice wiring from driver to mesh."""
+
+    def test_spec_from_env_defaults_single_slice(self, monkeypatch):
+        monkeypatch.delenv('SKYTPU_NUM_SLICES', raising=False)
+        spec = mesh_lib.spec_from_env(num_devices=8)
+        assert spec.num_slices == 1 and spec.num_devices == 8
+
+    def test_spec_from_env_two_slices(self, monkeypatch):
+        monkeypatch.setenv('SKYTPU_NUM_SLICES', '2')
+        spec = mesh_lib.spec_from_env(num_devices=8)
+        assert spec.num_slices == 2
+        assert spec.shape[0] == 2 and spec.num_devices == 8
+
+    def test_initialize_distributed_noop_without_contract(self, monkeypatch):
+        monkeypatch.delenv('SKYTPU_COORDINATOR_ADDRESS', raising=False)
+        assert mesh_lib.initialize_distributed_from_env() is False
+
+    def test_initialize_distributed_noop_single_host(self, monkeypatch):
+        monkeypatch.setenv('SKYTPU_COORDINATOR_ADDRESS', '10.0.0.1:8476')
+        monkeypatch.setenv('SKYTPU_NUM_NODES', '1')
+        assert mesh_lib.initialize_distributed_from_env() is False

@@ -138,12 +138,16 @@ class TestTask:
         })
         assert task.storage_mounts['/ckpt']['source'] == 'gs://ckpts'
 
-    def test_tpu_task_rejects_num_nodes(self):
-        with pytest.raises(exceptions.InvalidTaskError):
-            Task.from_yaml_config({
-                'num_nodes': 2,
-                'resources': {'accelerators': 'tpu-v5e-8'},
-            })
+    def test_tpu_task_num_nodes_means_slices(self):
+        # num_nodes on a TPU task = slice count (multi-slice DCN job);
+        # total hosts = slices x hosts-per-slice.
+        task = Task.from_yaml_config({
+            'num_nodes': 2,
+            'resources': {'accelerators': 'tpu-v5e-16'},
+        })
+        task.set_best_resources(task.best_resources
+                                or task._resources[0])
+        assert task.num_hosts(task._resources[0]) == 4
 
     def test_cpu_task_num_nodes(self):
         task = Task.from_yaml_config({'num_nodes': 4, 'run': 'hostname'})
