@@ -282,6 +282,13 @@ class TpuVmBackend(backend_lib.Backend[TpuVmResourceHandle]):
                 f'{handle.launched_resources}, which does not satisfy the '
                 f'request {requested}. Use a new cluster name or down the '
                 'existing one.')
+        if task.num_nodes > handle.num_nodes:
+            # Resources alone don't carry node/slice count; a multi-slice
+            # request must not silently reuse a smaller cluster.
+            raise exceptions.ResourcesMismatchError(
+                f'Cluster {cluster_name!r} has {handle.num_nodes} '
+                f'node(s)/slice(s); the task requests {task.num_nodes}. '
+                'Use a new cluster name or down the existing one.')
         global_state.update_last_use(cluster_name)
         return handle
 
@@ -440,9 +447,10 @@ class TpuVmBackend(backend_lib.Backend[TpuVmResourceHandle]):
         if idle_minutes >= 0:
             stop_reason = None
             if not down:
-                stop_reason = clouds_lib.GCP.check_stop_supported(
-                    handle.launched_resources) if (
-                        handle.launched_resources.cloud == 'gcp') else None
+                stop_reason = clouds_lib.from_name(
+                    handle.launched_resources.cloud
+                    or 'gcp').check_stop_supported(
+                        handle.launched_resources)
             if stop_reason is not None:
                 raise exceptions.NotSupportedError(stop_reason)
         provisioner.agent_request(handle.head_runner(), {
@@ -456,9 +464,9 @@ class TpuVmBackend(backend_lib.Backend[TpuVmResourceHandle]):
                  terminate: bool) -> None:
         info = handle.cluster_info
         if not terminate:
-            reason = None
-            if handle.launched_resources.cloud == 'gcp':
-                reason = clouds_lib.GCP.check_stop_supported(
+            reason = clouds_lib.from_name(
+                handle.launched_resources.cloud
+                or 'gcp').check_stop_supported(
                     handle.launched_resources)
             if reason is not None:
                 raise exceptions.NotSupportedError(reason)

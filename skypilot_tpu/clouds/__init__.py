@@ -4,9 +4,10 @@ from skypilot_tpu.clouds.cloud import (CLOUD_REGISTRY, Cloud,
                                        CloudImplementationFeatures, Zone,
                                        from_name, register)
 from skypilot_tpu.clouds.gcp import GCP
+from skypilot_tpu.clouds.kubernetes import Kubernetes
 from skypilot_tpu.clouds.local import Local
 
 __all__ = [
     'CLOUD_REGISTRY', 'Cloud', 'CloudImplementationFeatures', 'GCP',
-    'Local', 'Zone', 'from_name', 'register',
+    'Kubernetes', 'Local', 'Zone', 'from_name', 'register',
 ]

@@ -47,6 +47,14 @@ class Cloud:
 
     # ------------------------------------------------ capabilities
     @classmethod
+    def check_stop_supported(cls, resources: 'Resources'
+                             ) -> Optional[str]:
+        """None if stop is supported for these resources, else the
+        human-readable reason it is not."""
+        del resources
+        return None
+
+    @classmethod
     def unsupported_features(cls) -> Dict[CloudImplementationFeatures, str]:
         """feature -> human reason, for features this cloud lacks."""
         return {}

@@ -1,0 +1,123 @@
+"""Kubernetes cloud: GKE TPU node pools (and plain CPU pods).
+
+Role of reference ``sky/clouds/kubernetes.py`` (713 LoC). The cluster is
+assumed to already exist (that's the k8s model — capacity lives in node
+pools); feasibility is "the kubeconfig context is reachable", pricing is
+zero (the nodes are already paid for), and the provisioner schedules
+pods against GKE TPU node selectors
+(``sky/provision/kubernetes/utils.py:340-390``).
+
+Zones == kubeconfig contexts: ``resources.region='kubernetes'`` with
+``zone=<context>`` pins a context; otherwise the current context is
+used.
+
+Image contract: the pod image (``resources.image_id``) must provide
+``python3``, ``tar``, and — for multi-host jobs, where the head pod's
+driver execs into worker pods — ``kubectl`` plus a service account
+bound to a role allowing ``pods/exec`` in the namespace. Single-host
+jobs need only python3 + tar.
+"""
+from __future__ import annotations
+
+import os
+from typing import Iterator, List, Optional, Tuple, TYPE_CHECKING
+
+from skypilot_tpu.clouds import cloud as cloud_lib
+from skypilot_tpu.provision import common as provision_common
+
+if TYPE_CHECKING:
+    from skypilot_tpu.resources import Resources
+
+REGION = 'kubernetes'
+
+
+@cloud_lib.register
+class Kubernetes(cloud_lib.Cloud):
+    NAME = 'kubernetes'
+    PROVISIONER = 'kubernetes'
+
+    @classmethod
+    def unsupported_features(cls):
+        return {
+            cloud_lib.CloudImplementationFeatures.STOP:
+                'pods cannot be stopped, only terminated',
+            cloud_lib.CloudImplementationFeatures.AUTOSTOP:
+                'pods cannot be stopped, only terminated',
+        }
+
+    @classmethod
+    def check_stop_supported(cls, resources: 'Resources'
+                             ) -> Optional[str]:
+        del resources
+        return 'kubernetes pods cannot be stopped; use down instead.'
+
+    # ------------------------------------------------ feasibility
+    def get_feasible_launchable_resources(
+            self, resources: 'Resources',
+            num_nodes: int = 1) -> Tuple[List['Resources'], List[str]]:
+        del num_nodes
+        # No catalog: the node pools are user-provisioned. Any TPU or
+        # CPU request is feasible iff the API is reachable (checked at
+        # `skytpu check` time); GPU passthrough is not supported yet.
+        if resources.accelerators and not resources.is_tpu:
+            return [], ['kubernetes cloud currently supports TPU node '
+                        'pools and CPU pods (no GPU passthrough)']
+        return [resources.copy(region=REGION)], []
+
+    def zones_provision_loop(self, resources: 'Resources'
+                             ) -> Iterator[cloud_lib.Zone]:
+        # Zone == kubeconfig context. Pinned zone, else current context.
+        if resources.zone is not None:
+            yield cloud_lib.Zone(resources.zone, REGION)
+            return
+        yield cloud_lib.Zone('default', REGION)
+
+    # ------------------------------------------------ pricing
+    def instance_type_to_hourly_cost(self, resources: 'Resources',
+                                     use_spot: bool) -> float:
+        del resources, use_spot
+        return 0.0          # node pools are already paid for
+
+    def get_egress_cost(self, num_gigabytes: float) -> float:
+        del num_gigabytes
+        return 0.0
+
+    # ------------------------------------------------ provisioning
+    def make_provision_config(self, resources: 'Resources', num_nodes: int,
+                              cluster_name: str
+                              ) -> provision_common.ProvisionConfig:
+        from skypilot_tpu import config as config_lib
+        node_config = {
+            'use_spot': resources.use_spot,
+            'hosts_per_node': 1,
+            'chips_per_host': 0,
+            'image': resources.image_id,
+        }
+        if resources.is_tpu:
+            tpu = resources.tpu
+            node_config.update({
+                'accelerator': tpu.name,
+                'generation': tpu.generation,
+                'num_chips': tpu.num_chips,
+                'hosts_per_node': tpu.num_hosts,
+                'chips_per_host': tpu.chips_per_host,
+            })
+        return provision_common.ProvisionConfig(
+            provider_config={
+                'namespace': config_lib.get_nested(
+                    ('kubernetes', 'namespace'), 'default'),
+            },
+            node_config=node_config,
+            count=num_nodes,
+            tags={'skytpu-cluster-name': cluster_name})
+
+    # ------------------------------------------------ credentials
+    @classmethod
+    def check_credentials(cls) -> Tuple[bool, Optional[str]]:
+        kubeconfig = os.environ.get(
+            'KUBECONFIG', os.path.expanduser('~/.kube/config'))
+        if not os.path.exists(kubeconfig):
+            return False, (f'no kubeconfig at {kubeconfig}; set '
+                           'KUBECONFIG or create a cluster')
+        from skypilot_tpu.provision.kubernetes import k8s_client
+        return k8s_client.K8sClient().check_reachable()

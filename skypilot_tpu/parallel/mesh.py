@@ -101,9 +101,12 @@ def initialize_distributed_from_env() -> bool:
     try:
         jax.distributed.initialize(coordinator_address=coord,
                                    num_processes=n, process_id=rank)
-    except RuntimeError:
-        # Already initialized by the user program — that's fine.
-        pass
+    except RuntimeError as e:
+        # Only the benign re-init case may pass; a coordinator-connect
+        # failure must fail LOUDLY — swallowing it would leave every
+        # host training a disconnected replica.
+        if 'already initialized' not in str(e).lower():
+            raise
     _distributed_initialized = True
     return True
 

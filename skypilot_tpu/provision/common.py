@@ -132,6 +132,19 @@ def get_command_runners(cluster_info: ClusterInfo) -> List[Any]:
             assert host.node_dir, f'local host {host.instance_id} missing dir'
             runners.append(cr.LocalProcessRunner(host.instance_id,
                                                  host.node_dir))
+        elif cluster_info.provider_name == 'kubernetes':
+            import os as _os
+            pc = cluster_info.provider_config or {}
+            # In-cluster (head-pod driver fan-out): the client-side
+            # kubeconfig context doesn't exist here — kubectl uses the
+            # pod's service account instead. Requires an image with
+            # kubectl + a role allowing pods/exec (see clouds/
+            # kubernetes.py image contract).
+            in_cluster = bool(_os.environ.get('KUBERNETES_SERVICE_HOST'))
+            runners.append(cr.KubernetesPodRunner(
+                host.instance_id,
+                namespace=pc.get('namespace', 'default'),
+                context=None if in_cluster else pc.get('context')))
         else:
             ip = host.external_ip or host.internal_ip
             runners.append(cr.SSHCommandRunner(

@@ -1,0 +1,127 @@
+"""Minimal Kubernetes API client over kubectl with an injectable runner.
+
+Role of the reference's kubernetes adaptor + `sky/provision/kubernetes/`
+API plumbing (it uses the `kubernetes` Python SDK; `sky/adaptors/
+kubernetes.py`). Here: the only hard dependency is the `kubectl` binary
+(standard on any machine that talks to a cluster), and the exec layer is
+an injectable callable so the provisioner is unit-testable without a
+cluster — the same design as the GCP REST transport
+(``provision/gcp/tpu_client.py``).
+
+Runner contract: ``runner(args: List[str], stdin: Optional[str]) ->
+(returncode, stdout, stderr)`` where ``args`` are kubectl arguments
+(without the leading 'kubectl').
+"""
+from __future__ import annotations
+
+import json
+import subprocess
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from skypilot_tpu import exceptions
+
+Runner = Callable[[List[str], Optional[str]], Tuple[int, str, str]]
+
+# Test hook: factory returning a Runner (see tests/test_k8s_provisioner).
+_runner_factory: Optional[Callable[[], Runner]] = None
+
+
+def set_runner_factory(fn: Optional[Callable[[], Runner]]) -> None:
+    global _runner_factory
+    _runner_factory = fn
+
+
+def _default_runner(args: List[str], stdin: Optional[str]
+                    ) -> Tuple[int, str, str]:
+    try:
+        proc = subprocess.run(['kubectl'] + args, input=stdin,
+                              capture_output=True, text=True, timeout=120)
+    except FileNotFoundError as e:
+        raise exceptions.NoCloudAccessError(
+            'kubectl not found; install it to use the kubernetes '
+            'cloud') from e
+    except subprocess.TimeoutExpired as e:
+        err = exceptions.ProvisionError(f'kubectl timed out: {e}')
+        err.blocklist_scope = 'zone'
+        raise err from e
+    return proc.returncode, proc.stdout, proc.stderr
+
+
+def get_runner() -> Runner:
+    if _runner_factory is not None:
+        return _runner_factory()
+    return _default_runner
+
+
+class K8sClient:
+    """Pods + services in one namespace, optionally one kubeconfig
+    context (the 'zone' of the kubernetes cloud)."""
+
+    def __init__(self, namespace: str = 'default',
+                 context: Optional[str] = None):
+        self.namespace = namespace
+        self.context = context
+        self._run = get_runner()
+
+    def _base(self) -> List[str]:
+        args = ['--namespace', self.namespace]
+        if self.context:
+            args += ['--context', self.context]
+        return args
+
+    def _json(self, args: List[str], stdin: Optional[str] = None,
+              allow_not_found: bool = False) -> Dict[str, Any]:
+        rc, out, err = self._run(self._base() + args, stdin)
+        if rc != 0:
+            low = err.lower()
+            if allow_not_found and 'not found' in low:
+                return {}
+            # Quota first: k8s phrases quota errors as 'forbidden:
+            # exceeded quota', which must blocklist-scope, not abort.
+            if 'exceeded quota' in low:
+                raise exceptions.QuotaExceededError(
+                    f'kubernetes quota exceeded: {err.strip()}')
+            if ('forbidden' in low or 'unauthorized' in low
+                    or 'unable to connect' in low
+                    or 'connection refused' in low):
+                raise exceptions.NoCloudAccessError(
+                    f'kubernetes API error: {err.strip()}')
+            e = exceptions.ProvisionError(
+                f'kubectl {" ".join(args[:3])} failed: {err.strip()}')
+            e.blocklist_scope = 'zone'
+            raise e
+        return json.loads(out) if out.strip() else {}
+
+    # ------------------------------------------------------------- pods
+    def apply(self, manifest: Dict[str, Any]) -> Dict[str, Any]:
+        return self._json(['apply', '-f', '-', '-o', 'json'],
+                          stdin=json.dumps(manifest))
+
+    def get_pod(self, name: str) -> Dict[str, Any]:
+        return self._json(['get', 'pod', name, '-o', 'json'],
+                          allow_not_found=True)
+
+    def list_pods(self, label_selector: str) -> List[Dict[str, Any]]:
+        out = self._json(['get', 'pods', '-l', label_selector,
+                          '-o', 'json'])
+        return out.get('items', [])
+
+    def delete_pod(self, name: str) -> None:
+        self._json(['delete', 'pod', name, '--ignore-not-found=true',
+                    '--wait=false', '-o', 'name'], allow_not_found=True)
+
+    def delete_collection(self, label_selector: str) -> None:
+        self._json(['delete', 'pods,services', '-l', label_selector,
+                    '--ignore-not-found=true', '--wait=false',
+                    '-o', 'name'], allow_not_found=True)
+
+    # ---------------------------------------------------------- cluster
+    def check_reachable(self) -> Tuple[bool, Optional[str]]:
+        try:
+            rc, _, err = self._run(self._base() + ['version', '-o', 'json'],
+                                   None)
+        except exceptions.SkyTpuError as e:
+            return False, str(e)
+        if rc != 0:
+            return False, err.strip() or 'kubectl version failed'
+        return True, None

@@ -209,13 +209,16 @@ def get_cluster_info(region: str, cluster_name: str) -> common.ClusterInfo:
         raise exceptions.ClusterDoesNotExist(cluster_name)
     node_config = meta.get('node_config', {})
     hosts_per_slice = int(node_config.get('hosts_per_node', 1)) or 1
+    # Only TPU clusters have slices; multi-node CPU clusters are plain
+    # separate nodes (slice_id 0 everywhere, matching the GCP provider).
+    is_tpu = bool(node_config.get('accelerator'))
     hosts = []
     for i in range(meta['num_hosts']):
         hosts.append(common.HostInfo(
             instance_id=f'{cluster_name}-node-{i}',
             rank=i,
             internal_ip='127.0.0.1',
-            slice_id=i // hosts_per_slice,
+            slice_id=(i // hosts_per_slice) if is_tpu else 0,
             node_dir=os.path.join(_cluster_dir(cluster_name), f'node-{i}')))
     return common.ClusterInfo(
         cluster_name=cluster_name,

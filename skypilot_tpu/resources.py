@@ -154,10 +154,12 @@ class Resources:
             self._accelerators = {self._tpu.name: 1}
             if self._cloud is None:
                 self._cloud = 'gcp'
-            elif self._cloud not in ('gcp', 'local'):
-                # 'local' simulates slice topology for hermetic tests.
+            elif self._cloud not in ('gcp', 'kubernetes', 'local'):
+                # 'kubernetes' = GKE TPU node pools; 'local' simulates
+                # slice topology for hermetic tests.
                 raise exceptions.InvalidResourcesError(
-                    f'TPUs are only available on GCP, got cloud={self._cloud!r}')
+                    f'TPUs are only available on GCP or Kubernetes, got '
+                    f'cloud={self._cloud!r}')
         else:
             self._accelerators = {name: int(count)}
 

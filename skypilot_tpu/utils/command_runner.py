@@ -271,3 +271,102 @@ class SSHCommandRunner(CommandRunner):
         if proc.returncode != 0:
             raise exceptions.CommandError(
                 proc.returncode, ' '.join(rsync_cmd), proc.stderr[-2000:])
+
+
+class KubernetesPodRunner(CommandRunner):
+    """Runs commands in a pod via ``kubectl exec`` (role of the
+    reference's ``KubernetesCommandRunner``, ``command_runner.py:685``);
+    file sync is a tar pipe through exec (kubectl cp needs tar in the
+    image anyway, and a pipe preserves the rsync-like semantics)."""
+
+    def __init__(self, pod_name: str, namespace: str = 'default',
+                 context: Optional[str] = None):
+        super().__init__(pod_name)
+        self.pod_name = pod_name
+        self.namespace = namespace
+        self.context = context
+
+    def _kubectl(self) -> List[str]:
+        args = ['kubectl', '--namespace', self.namespace]
+        if self.context:
+            args += ['--context', self.context]
+        return args
+
+    def run(self, cmd, *, env=None, log_path=os.devnull, stream_logs=False,
+            require_outputs=False, cwd=None, timeout=None) -> RunResult:
+        from skypilot_tpu.utils import pkg_utils
+        remote_cmd = (pkg_utils.RUNTIME_PYTHONPATH_PREFIX +
+                      _env_prefix(env) + cmd)
+        if cwd:
+            remote_cmd = f'cd {shlex.quote(cwd)} && {remote_cmd}'
+        args = self._kubectl() + [
+            'exec', self.pod_name, '--',
+            'sh', '-c', remote_cmd]
+        return self._popen(
+            args, shell=False, env=None, cwd=None, log_path=log_path,
+            stream_logs=stream_logs, require_outputs=require_outputs,
+            timeout=timeout)
+
+    @staticmethod
+    def _remote_path(p: str) -> str:
+        """Quote a remote path but keep a leading ~ expandable by the
+        pod's shell (plain shlex.quote would suppress it)."""
+        if p.startswith('~/'):
+            return '"$HOME"/' + shlex.quote(p[2:])
+        return shlex.quote(p)
+
+    def rsync(self, source: str, target: str, *, up: bool) -> None:
+        if up:
+            src = os.path.expanduser(source)
+            if os.path.isfile(src):
+                # Single file -> exact target path (runtime setup pushes
+                # cluster_info.json / the pkg zip this way).
+                with open(src, 'rb') as f:
+                    data = f.read()
+                qt = self._remote_path(target)
+                sink = self._kubectl() + [
+                    'exec', '-i', self.pod_name, '--', 'sh', '-c',
+                    f'mkdir -p $(dirname {qt}) && cat > {qt}']
+                proc = subprocess.run(sink, input=data,
+                                      capture_output=True)
+                if proc.returncode != 0:
+                    raise exceptions.CommandError(
+                        proc.returncode, f'pod rsync up {source}',
+                        proc.stderr.decode(errors="replace")[-2000:])
+                return
+            # rsync trailing-slash semantics: 'src/' ships contents into
+            # target; 'src' ships the directory itself under target.
+            if source.endswith('/'):
+                tar_dir, tar_what = src, '.'
+            else:
+                tar_dir = os.path.dirname(src.rstrip('/')) or '.'
+                tar_what = os.path.basename(src.rstrip('/'))
+            tar_make = subprocess.Popen(
+                ['tar', '-C', tar_dir, '--exclude', '.git', '-cf', '-',
+                 tar_what],
+                stdout=subprocess.PIPE)
+            qt = self._remote_path(target)
+            untar = self._kubectl() + [
+                'exec', '-i', self.pod_name, '--', 'sh', '-c',
+                f'mkdir -p {qt} && tar -C {qt} -xf -']
+            proc = subprocess.run(untar, stdin=tar_make.stdout,
+                                  capture_output=True, text=True)
+            tar_make.wait()
+            if proc.returncode != 0 or tar_make.returncode != 0:
+                raise exceptions.CommandError(
+                    proc.returncode or tar_make.returncode,
+                    f'pod rsync up {source}', proc.stderr[-2000:])
+        else:
+            os.makedirs(os.path.expanduser(target), exist_ok=True)
+            tar_out = self._kubectl() + [
+                'exec', self.pod_name, '--', 'sh', '-c',
+                f'tar -C {self._remote_path(source)} -cf - .']
+            make = subprocess.Popen(tar_out, stdout=subprocess.PIPE)
+            proc = subprocess.run(
+                ['tar', '-C', os.path.expanduser(target), '-xf', '-'],
+                stdin=make.stdout, capture_output=True, text=True)
+            make.wait()
+            if proc.returncode != 0 or make.returncode != 0:
+                raise exceptions.CommandError(
+                    proc.returncode or make.returncode,
+                    f'pod rsync down {source}', proc.stderr[-2000:])

@@ -1,0 +1,164 @@
+#!/usr/bin/env python3
+"""A kubectl stand-in for hermetic kubernetes-cloud tests.
+
+Pods are directories under $SKYTPU_K8S_FAKE_DIR; `exec` runs the command
+locally with HOME pointed at the pod's directory — the same VM-isolation
+trick the local provisioner uses, but reached through the REAL
+KubernetesPodRunner/k8s_client kubectl surface, so the whole launch
+spine (provision -> pkg ship -> agentd -> driver fan-out) is exercised
+against the kubernetes provider with no cluster.
+
+Supported argv subset (exactly what k8s_client + KubernetesPodRunner
+emit): apply -f -, get pod/pods, delete pod / pods,services -l, exec
+[-i] POD -- sh -c CMD, version.
+"""
+import fcntl
+import json
+import os
+import subprocess
+import sys
+
+
+def state_dir():
+    d = os.environ['SKYTPU_K8S_FAKE_DIR']
+    os.makedirs(d, exist_ok=True)
+    return d
+
+
+def state_path():
+    return os.path.join(state_dir(), 'state.json')
+
+
+class State:
+    def __enter__(self):
+        self._fh = open(os.path.join(state_dir(), '.lock'), 'w')
+        fcntl.flock(self._fh, fcntl.LOCK_EX)
+        try:
+            with open(state_path(), encoding='utf-8') as f:
+                self.data = json.load(f)
+        except FileNotFoundError:
+            self.data = {'pods': {}, 'services': {}}
+        return self
+
+    def __exit__(self, *exc):
+        with open(state_path(), 'w', encoding='utf-8') as f:
+            json.dump(self.data, f)
+        fcntl.flock(self._fh, fcntl.LOCK_UN)
+        self._fh.close()
+
+
+def parse(argv):
+    flags, rest, i = {}, [], 0
+    while i < len(argv):
+        a = argv[i]
+        if a in ('--namespace', '--context', '-l', '-o', '-f'):
+            flags[a] = argv[i + 1]
+            i += 2
+        elif a == '-i':
+            flags['-i'] = True
+            i += 1
+        elif a.startswith('--'):
+            i += 1
+        else:
+            rest.append(a)
+            i += 1
+    return flags, rest
+
+
+def matches(obj, selector):
+    if not selector:
+        return True
+    key, val = selector.split('=', 1)
+    return (obj.get('metadata', {}).get('labels', {}) or {}).get(key) == val
+
+
+def pod_dir(name):
+    d = os.path.join(state_dir(), 'pods', name)
+    os.makedirs(d, exist_ok=True)
+    return d
+
+
+def main():
+    flags, rest = parse(sys.argv[1:])
+    verb = rest[0] if rest else ''
+
+    if verb == 'version':
+        print('{"clientVersion": {}}')
+        return 0
+
+    if verb == 'apply':
+        manifest = json.load(sys.stdin)
+        name = manifest['metadata']['name']
+        with State() as s:
+            if manifest['kind'] == 'Service':
+                s.data['services'][name] = manifest
+            else:
+                idx = len(s.data['pods'])
+                manifest['status'] = {'phase': 'Running',
+                                      'podIP': f'10.0.0.{idx + 1}'}
+                s.data['pods'][name] = manifest
+                pod_dir(name)
+        print(json.dumps(manifest))
+        return 0
+
+    if verb == 'get':
+        with State() as s:
+            if rest[1] == 'pods':
+                items = [p for p in s.data['pods'].values()
+                         if matches(p, flags.get('-l'))]
+                print(json.dumps({'items': items}))
+                return 0
+            if rest[1] == 'pod':
+                p = s.data['pods'].get(rest[2])
+                if p is None:
+                    print(f'pods "{rest[2]}" not found', file=sys.stderr)
+                    return 1
+                print(json.dumps(p))
+                return 0
+        return 1
+
+    if verb == 'delete':
+        with State() as s:
+            sel = flags.get('-l')
+            if sel:
+                for name in [n for n, p in s.data['pods'].items()
+                             if matches(p, sel)]:
+                    del s.data['pods'][name]
+                for name in [n for n, v in s.data['services'].items()
+                             if matches(v, sel)]:
+                    del s.data['services'][name]
+            elif rest[1] == 'pod':
+                s.data['pods'].pop(rest[2], None)
+        return 0
+
+    if verb == 'exec':
+        pod = rest[1]
+        if '--' not in sys.argv:
+            print('exec needs --', file=sys.stderr)
+            return 1
+        cmd = sys.argv[sys.argv.index('--') + 1:]
+        with State() as s:
+            if pod not in s.data['pods']:
+                print(f'pods "{pod}" not found', file=sys.stderr)
+                return 1
+        env = dict(os.environ)
+        home = pod_dir(pod)
+        env['HOME'] = home
+        env['SKYTPU_AGENT_DIR'] = os.path.join(home, '.skytpu_agent')
+        # The pod must resolve `python3` to this interpreter (venv).
+        env.setdefault('PATH', '')
+        env['PATH'] = (os.path.dirname(sys.executable) + os.pathsep +
+                       env['PATH'])
+        proc = subprocess.run(cmd, env=env, cwd=home,
+                              stdin=(sys.stdin.buffer
+                                     if flags.get('-i') else
+                                     subprocess.DEVNULL))
+        return proc.returncode
+
+    print(f'kubectl shim: unsupported argv {sys.argv[1:]}',
+          file=sys.stderr)
+    return 1
+
+
+if __name__ == '__main__':
+    sys.exit(main())
