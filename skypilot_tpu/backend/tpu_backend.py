@@ -384,7 +384,12 @@ class TpuVmBackend(backend_lib.Backend[TpuVmResourceHandle]):
         spec = {
             'run': run_cmd,
             'env': {str(k): str(v) for k, v in task.envs.items()},
-            'workdir_target': WORKDIR_TARGET if task.workdir else None,
+            # A workdir synced directly OR delivered via a translated
+            # file_mount (controller_utils) both mean: run from there.
+            'workdir_target': WORKDIR_TARGET
+                              if (task.workdir
+                                  or WORKDIR_TARGET in task.file_mounts)
+                              else None,
         }
         resp = provisioner.agent_request(handle.head_runner(), {
             'op': 'queue_job',
@@ -478,5 +483,8 @@ class TpuVmBackend(backend_lib.Backend[TpuVmResourceHandle]):
 
 
 def _is_cloud_uri(path: str) -> bool:
+    # file:// is the LOCAL store's URI (a directory pretending to be a
+    # bucket) — it must take the download path, not client-side rsync,
+    # so translated controller file mounts resolve on the REMOTE host.
     return path.startswith(('gs://', 's3://', 'r2://', 'https://',
-                            'http://'))
+                            'http://', 'file://'))

@@ -537,6 +537,78 @@ def serve_logs(service_name, no_follow):
     sky.serve.tail_logs(service_name, follow=not no_follow)
 
 
+# --------------------------------------------------------------- storage
+@cli.group()
+def storage():
+    """Managed storage buckets (reference ``sky storage``,
+    ``sky/cli.py:3474``)."""
+
+
+@storage.command(name='ls')
+def storage_ls():
+    """List managed storage objects."""
+    from skypilot_tpu import global_state
+    records = global_state.get_storage()
+    if not records:
+        click.echo('No existing storage.')
+        return
+    rows = []
+    for r in records:
+        h = r.get('handle') or {}
+        rows.append([r['name'],
+                     ','.join(h.get('stores', [])) or '-',
+                     str(h.get('source') or '-'),
+                     _fmt_age(r.get('launched_at')),
+                     r['status'].value])
+    click.echo(_fmt_table(rows, ['NAME', 'STORE', 'SOURCE', 'CREATED',
+                                 'STATUS']))
+
+
+@storage.command(name='delete')
+@click.argument('names', nargs=-1)
+@click.option('--all', '-a', 'delete_all', is_flag=True)
+@click.option('--yes', '-y', is_flag=True)
+def storage_delete(names, delete_all, yes):
+    """Delete managed storage (bucket contents included)."""
+    from skypilot_tpu import global_state
+    from skypilot_tpu.data import storage as storage_lib
+    records = global_state.get_storage()
+    if delete_all:
+        targets = [r['name'] for r in records]
+    else:
+        targets = list(names)
+    if not targets:
+        click.echo('No storage to delete.')
+        return
+    if not yes:
+        click.confirm(f'Delete storage: {", ".join(targets)}?', abort=True)
+    by_name = {r['name']: r for r in records}
+    for name in targets:
+        rec = by_name.get(name)
+        if rec is None:
+            click.echo(f'Storage {name!r} not found.')
+            continue
+        h = rec.get('handle') or {}
+        stores = [storage_lib.StoreType.from_str(s)
+                  for s in h.get('stores', [])] or None
+        obj = storage_lib.Storage(name=name, source=h.get('source'),
+                                  stores=stores)
+        obj.delete()
+        click.echo(f'Storage {name!r} deleted.')
+
+
+@cli.command()
+@click.option('--port', default=8500, help='Port to serve the dashboard.')
+@click.option('--no-browser', is_flag=True, hidden=True)
+def dashboard(port, no_browser):
+    """Serve the live jobs/serve/cluster dashboard
+    (reference ``sky/jobs/dashboard/``)."""
+    del no_browser
+    from skypilot_tpu import dashboard as dash
+    click.echo(f'Dashboard: http://127.0.0.1:{port} (Ctrl-C to stop)')
+    dash.serve_forever(port)
+
+
 def main() -> None:
     import sys
 

@@ -1,0 +1,158 @@
+"""Live dashboard: one HTML page over the state DBs.
+
+Role of reference ``sky/jobs/dashboard/`` (a Flask app rendering the
+managed-jobs table). Here one stdlib HTTP server renders clusters,
+managed jobs, and services — everything the CLI tables show, auto-
+refreshing, no extra dependencies.
+"""
+from __future__ import annotations
+
+import html
+import http.server
+import json
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+from skypilot_tpu import global_state
+
+_PAGE = """<!doctype html>
+<html><head><title>skytpu dashboard</title>
+<meta http-equiv="refresh" content="5">
+<style>
+body {{ font-family: system-ui, sans-serif; margin: 2rem; }}
+h1 {{ font-size: 1.3rem; }} h2 {{ font-size: 1.05rem; margin-top: 2rem; }}
+table {{ border-collapse: collapse; min-width: 40rem; }}
+th, td {{ text-align: left; padding: .35rem .8rem;
+         border-bottom: 1px solid #ddd; font-size: .9rem; }}
+th {{ background: #f5f5f5; }}
+.ok {{ color: #0a7d36; }} .bad {{ color: #b00020; }}
+.muted {{ color: #777; }}
+</style></head><body>
+<h1>skytpu dashboard</h1>
+<div class="muted">refreshed {now}</div>
+{sections}
+</body></html>
+"""
+
+_GOOD = {'UP', 'RUNNING', 'SUCCEEDED', 'READY'}
+_BAD = {'FAILED', 'FAILED_SETUP', 'FAILED_CONTROLLER', 'FAILED_NO_RESOURCE',
+        'NOT_READY', 'INIT'}
+
+
+def _table(headers: List[str], rows: List[List[str]]) -> str:
+    if not rows:
+        return '<div class="muted">none</div>'
+    head = ''.join(f'<th>{html.escape(h)}</th>' for h in headers)
+    body = []
+    for row in rows:
+        tds = []
+        for cell in row:
+            cls = ''
+            if cell in _GOOD:
+                cls = ' class="ok"'
+            elif cell in _BAD:
+                cls = ' class="bad"'
+            tds.append(f'<td{cls}>{html.escape(str(cell))}</td>')
+        body.append('<tr>' + ''.join(tds) + '</tr>')
+    return (f'<table><tr>{head}</tr>' + ''.join(body) + '</table>')
+
+
+def _age(ts: Optional[float]) -> str:
+    if not ts:
+        return '-'
+    sec = max(0, int(time.time() - ts))
+    if sec < 60:
+        return f'{sec}s ago'
+    if sec < 3600:
+        return f'{sec // 60}m ago'
+    return f'{sec // 3600}h {sec % 3600 // 60}m ago'
+
+
+def _clusters() -> Tuple[str, List[Dict[str, Any]]]:
+    records = global_state.get_clusters()
+    rows = []
+    for r in records:
+        handle = r.get('handle')
+        res = (str(handle.launched_resources) if handle is not None and
+               getattr(handle, 'launched_resources', None) is not None
+               else '-')
+        rows.append([r['name'], res, r['status'].value,
+                     _age(r.get('launched_at'))])
+    return _table(['CLUSTER', 'RESOURCES', 'STATUS', 'LAUNCHED'],
+                  rows), records
+
+
+def _managed_jobs() -> str:
+    try:
+        from skypilot_tpu import jobs
+        table = jobs.queue()
+    except Exception:  # pylint: disable=broad-except — no controller up
+        return '<div class="muted">no jobs controller running</div>'
+    rows = [[str(j['job_id']), j.get('name', '-'), j.get('status', '-'),
+             str(j.get('recovery_count', 0)),
+             _age(j.get('submitted_at'))] for j in table]
+    return _table(['ID', 'NAME', 'STATUS', 'RECOVERIES', 'SUBMITTED'], rows)
+
+
+def _services() -> str:
+    try:
+        from skypilot_tpu import serve
+        svcs = serve.status()
+    except Exception:  # pylint: disable=broad-except — no serve controller
+        return '<div class="muted">no serve controller running</div>'
+    rows = []
+    for s in svcs:
+        replicas = s.get('replicas') or []
+        ready = sum(1 for r in replicas if r.get('status') == 'READY')
+        rows.append([s['name'], s.get('status', '-'),
+                     f'{ready}/{len(replicas)}',
+                     str(s.get('version', '-'))])
+    return _table(['SERVICE', 'STATUS', 'READY', 'VERSION'], rows)
+
+
+def render_page() -> str:
+    cluster_html, _ = _clusters()
+    sections = (
+        f'<h2>Clusters</h2>{cluster_html}'
+        f'<h2>Managed jobs</h2>{_managed_jobs()}'
+        f'<h2>Services</h2>{_services()}'
+    )
+    return _PAGE.format(now=time.strftime('%Y-%m-%d %H:%M:%S'),
+                        sections=sections)
+
+
+def _metrics_json() -> str:
+    _, clusters = _clusters()
+    return json.dumps({
+        'clusters': len(clusters),
+        'clusters_up': sum(1 for c in clusters
+                           if c['status'].value == 'UP'),
+        'time': time.time(),
+    })
+
+
+class _Handler(http.server.BaseHTTPRequestHandler):
+
+    def log_message(self, *args):
+        del args
+
+    def do_GET(self):  # noqa: N802
+        if self.path == '/metrics':
+            body = _metrics_json().encode()
+            ctype = 'application/json'
+        else:
+            body = render_page().encode()
+            ctype = 'text/html; charset=utf-8'
+        self.send_response(200)
+        self.send_header('Content-Type', ctype)
+        self.send_header('Content-Length', str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+
+def make_server(port: int) -> http.server.ThreadingHTTPServer:
+    return http.server.ThreadingHTTPServer(('127.0.0.1', port), _Handler)
+
+
+def serve_forever(port: int) -> None:
+    make_server(port).serve_forever()

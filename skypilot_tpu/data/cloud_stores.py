@@ -9,9 +9,17 @@ from __future__ import annotations
 import shlex
 
 
+def _q(path: str) -> str:
+    """Quote a remote path, keeping a leading ~ expandable by the remote
+    shell (plain shlex.quote would make it a literal '~' directory)."""
+    if path.startswith('~/'):
+        return '"$HOME"/' + shlex.quote(path[2:])
+    return shlex.quote(path)
+
+
 def make_download_command(src: str, dst: str) -> str:
     """Shell command to download src URI to dst path on a host."""
-    q_dst = shlex.quote(dst)
+    q_dst = _q(dst)
     q_src = shlex.quote(src)
     mkdir = f'mkdir -p $(dirname {q_dst})'
     if src.startswith('gs://'):
@@ -25,4 +33,9 @@ def make_download_command(src: str, dst: str) -> str:
                 f'{q_dst} --endpoint-url "$R2_ENDPOINT"')
     if src.startswith(('https://', 'http://')):
         return f'{mkdir} && curl -fsSL {q_src} -o {q_dst}'
+    if src.startswith('file://'):
+        # LOCAL-store bucket (shared-filesystem clusters / tests).
+        path = shlex.quote(src[len('file://'):])
+        return (f'{mkdir} && mkdir -p {q_dst} && '
+                f'cp -r {path}/. {q_dst}/')
     raise ValueError(f'Unsupported URI scheme: {src}')

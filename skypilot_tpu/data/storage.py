@@ -39,6 +39,16 @@ class StoreType(enum.Enum):
                 f'Unknown store type {s!r}; supported: '
                 f'{[t.value for t in cls]}') from None
 
+    @classmethod
+    def from_uri(cls, uri: str) -> 'StoreType':
+        scheme = uri.split('://', 1)[0].lower()
+        try:
+            return {'gs': cls.GCS, 's3': cls.S3, 'r2': cls.R2,
+                    'file': cls.LOCAL}[scheme]
+        except KeyError:
+            raise exceptions.StorageSpecError(
+                f'Unknown bucket URI scheme {uri!r}') from None
+
 
 class StorageMode(enum.Enum):
     MOUNT = 'MOUNT'
@@ -86,15 +96,18 @@ class GcsStore(AbstractStore):
         return f'gs://{self.name}'
 
     def ensure_bucket(self) -> None:
-        rc = subprocess.run(['gsutil', 'ls', '-b', self.uri()],
+        # ``name`` may carry a subpath ('bucket/sub'); only the bucket
+        # itself is created.
+        bucket = f'gs://{self.name.split("/", 1)[0]}'
+        rc = subprocess.run(['gsutil', 'ls', '-b', bucket],
                             capture_output=True, check=False).returncode
         if rc == 0:
             return
-        proc = subprocess.run(['gsutil', 'mb', self.uri()],
+        proc = subprocess.run(['gsutil', 'mb', bucket],
                               capture_output=True, text=True, check=False)
         if proc.returncode != 0:
             raise exceptions.StorageBucketCreateError(
-                f'gsutil mb {self.uri()} failed: {proc.stderr[-500:]}')
+                f'gsutil mb {bucket} failed: {proc.stderr[-500:]}')
 
     def upload(self) -> None:
         if not self.source:
@@ -113,24 +126,26 @@ class GcsStore(AbstractStore):
                        capture_output=True, check=False)
 
     def make_download_command(self, dst: str) -> str:
-        q = shlex.quote
-        return (f'mkdir -p {q(dst)} && '
-                f'(gsutil -m rsync -r {q(self.uri())} {q(dst)} || '
-                f'gcloud storage rsync --recursive {q(self.uri())} '
-                f'{q(dst)})')
+        from skypilot_tpu.data.cloud_stores import _q
+        q_dst = _q(dst)
+        q_uri = shlex.quote(self.uri())
+        return (f'mkdir -p {q_dst} && '
+                f'(gsutil -m rsync -r {q_uri} {q_dst} || '
+                f'gcloud storage rsync --recursive {q_uri} {q_dst})')
 
     def make_mount_command(self, mount_path: str) -> str:
         """gcsfuse with implicit dirs; install-on-demand like the
         reference's mounting_utils."""
-        q = shlex.quote
+        from skypilot_tpu.data.cloud_stores import _q
+        q_mp = _q(mount_path)
         install = (
             'which gcsfuse >/dev/null 2>&1 || '
             '(curl -fsSL https://github.com/GoogleCloudPlatform/gcsfuse'
             '/releases/download/v2.5.1/gcsfuse_2.5.1_amd64.deb '
             '-o /tmp/gcsfuse.deb && sudo dpkg -i /tmp/gcsfuse.deb)')
-        mount = (f'mkdir -p {q(mount_path)} && '
-                 f'mountpoint -q {q(mount_path)} || '
-                 f'gcsfuse --implicit-dirs {q(self.name)} {q(mount_path)}')
+        mount = (f'mkdir -p {q_mp} && '
+                 f'mountpoint -q {q_mp} || '
+                 f'gcsfuse --implicit-dirs {shlex.quote(self.name)} {q_mp}')
         return f'{install} && {mount}'
 
 
@@ -170,15 +185,17 @@ class S3Store(AbstractStore):
                        capture_output=True, check=False)
 
     def make_download_command(self, dst: str) -> str:
-        q = shlex.quote
-        return (f'mkdir -p {q(dst)} && aws s3 sync {q(self.uri())} '
-                f'{q(dst)}')
+        from skypilot_tpu.data.cloud_stores import _q
+        q_dst = _q(dst)
+        return (f'mkdir -p {q_dst} && aws s3 sync '
+                f'{shlex.quote(self.uri())} {q_dst}')
 
     def make_mount_command(self, mount_path: str) -> str:
-        q = shlex.quote
-        return (f'mkdir -p {q(mount_path)} && '
-                f'mountpoint -q {q(mount_path)} || '
-                f'goofys {q(self.name)} {q(mount_path)}')
+        from skypilot_tpu.data.cloud_stores import _q
+        q_mp = _q(mount_path)
+        return (f'mkdir -p {q_mp} && '
+                f'mountpoint -q {q_mp} || '
+                f'goofys {shlex.quote(self.name)} {q_mp}')
 
 
 class LocalStore(AbstractStore):
@@ -214,16 +231,18 @@ class LocalStore(AbstractStore):
         shutil.rmtree(self._bucket_dir(), ignore_errors=True)
 
     def make_download_command(self, dst: str) -> str:
-        q = shlex.quote
-        return (f'mkdir -p {q(dst)} && '
-                f'cp -r {q(self._bucket_dir())}/. {q(dst)}/')
+        # One implementation for file:// downloads (tilde-safe dst).
+        from skypilot_tpu.data import cloud_stores
+        return cloud_stores.make_download_command(self.uri(), dst)
 
     def make_mount_command(self, mount_path: str) -> str:
+        from skypilot_tpu.data.cloud_stores import _q
         q = shlex.quote
+        q_mp = _q(mount_path)
         bucket = self._bucket_dir()
-        return (f'mkdir -p $(dirname {q(mount_path)}) {q(bucket)} && '
-                f'([ -L {q(mount_path)} ] || [ -e {q(mount_path)} ] || '
-                f'ln -s {q(bucket)} {q(mount_path)})')
+        return (f'mkdir -p $(dirname {q_mp}) {q(bucket)} && '
+                f'([ -L {q_mp} ] || [ -e {q_mp} ] || '
+                f'ln -s {q(bucket)} {q_mp})')
 
 
 _STORE_CLASSES = {
@@ -231,6 +250,16 @@ _STORE_CLASSES = {
     StoreType.S3: S3Store,
     StoreType.LOCAL: LocalStore,
 }
+
+
+def make_store(store_type: StoreType, name: str,
+               source: Optional[str] = None) -> AbstractStore:
+    cls = _STORE_CLASSES.get(store_type)
+    if cls is None:
+        raise exceptions.StorageSpecError(
+            f'Store {store_type.value} is not supported yet; supported: '
+            f'{[t.value for t in _STORE_CLASSES]}')
+    return cls(name, source)
 
 
 class Storage:

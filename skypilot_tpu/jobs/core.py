@@ -98,8 +98,17 @@ def launch(task_or_dag: Union[Task, Dag],
         if t.run is not None and not isinstance(t.run, str):
             raise exceptions.InvalidTaskError(
                 'Managed-job tasks must have string run commands.')
+    job_name = name or dag.name or tasks[0].name or 'managed'
+    # The controller may live on another machine: client-local
+    # workdir/file_mounts must be uploaded to a bucket and the dag
+    # rewritten to pull from it (reference
+    # ``sky/utils/controller_utils.py:663``).
+    from skypilot_tpu.utils import controller_utils
+    run_timestamp = common_utils.make_run_timestamp()
+    controller_utils.translate_local_file_mounts(dag, job_name,
+                                                 run_timestamp)
     dag_config = {
-        'name': name or dag.name or tasks[0].name or 'managed',
+        'name': job_name,
         'tasks': [t.to_yaml_config() for t in tasks],
     }
     handle = _ensure_controller(dag)
@@ -107,7 +116,7 @@ def launch(task_or_dag: Union[Task, Dag],
         'op': 'queue',
         'name': dag_config['name'],
         'username': common_utils.get_cleaned_username(),
-        'run_timestamp': common_utils.make_run_timestamp(),
+        'run_timestamp': run_timestamp,
         'dag_config': dag_config,
     })
     job_id = int(resp['job_id'])

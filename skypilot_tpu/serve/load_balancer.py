@@ -36,10 +36,16 @@ def _sync_period() -> float:
 class SkyServeLoadBalancer:
 
     def __init__(self, controller_url: str, port: int,
-                 policy_name: str = 'round_robin'):
+                 policy_name: str = 'round_robin',
+                 tls_certfile: Optional[str] = None,
+                 tls_keyfile: Optional[str] = None,
+                 max_attempts: int = 3):
         self.controller_url = controller_url.rstrip('/')
         self.port = port
         self.policy = lb_policies.make_policy(policy_name)
+        self.tls_certfile = tls_certfile
+        self.tls_keyfile = tls_keyfile
+        self.max_attempts = max_attempts
         self._request_timestamps: List[float] = []
         self._ts_lock = threading.Lock()
         self._stop = threading.Event()
@@ -85,55 +91,116 @@ class SkyServeLoadBalancer:
             def log_message(self, *args):
                 del args
 
+            def _send_json(self, code: int, payload: dict) -> None:
+                body = json.dumps(payload).encode()
+                self.send_response(code)
+                self.send_header('Content-Type', 'application/json')
+                self.send_header('Content-Length', str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def _stream_response(self, resp) -> None:
+                """Pass a streaming (SSE/chunk) response through as it
+                arrives; the connection closes to mark the end (no
+                Content-Length is known up front)."""
+                self.send_response(resp.status)
+                for k, v in resp.headers.items():
+                    if k.lower() not in _HOP_HEADERS:
+                        self.send_header(k, v)
+                self.send_header('Connection', 'close')
+                self.end_headers()
+                while True:
+                    chunk = resp.read1(65536)
+                    if not chunk:
+                        break
+                    self.wfile.write(chunk)
+                    self.wfile.flush()
+                self.close_connection = True
+
             def _proxy(self, method: str) -> None:
                 with lb._ts_lock:
                     lb._request_timestamps.append(time.time())
-                url = lb.policy.select_replica()
-                if url is None:
-                    body = json.dumps({
-                        'error': 'No ready replicas. '
-                                 'Use "sky serve status" to check.'
-                    }).encode()
-                    self.send_response(503)
-                    self.send_header('Content-Type', 'application/json')
-                    self.send_header('Content-Length', str(len(body)))
-                    self.end_headers()
-                    self.wfile.write(body)
-                    return
                 length = int(self.headers.get('Content-Length', 0))
                 data = self.rfile.read(length) if length else None
                 headers = {k: v for k, v in self.headers.items()
                            if k.lower() not in _HOP_HEADERS}
-                req = urllib.request.Request(url + self.path, data=data,
-                                             headers=headers, method=method)
-                lb.policy.pre_execute(url)
-                try:
-                    with urllib.request.urlopen(req, timeout=120) as resp:
-                        body = resp.read()
-                        self.send_response(resp.status)
-                        for k, v in resp.headers.items():
+
+                # A replica dying mid-connect is retried transparently on
+                # another replica (reference LB behavior); an HTTP error
+                # response is NOT retried — the replica answered.
+                tried = set()
+                last_err: Optional[Exception] = None
+                responded = False       # bytes already sent to client?
+                for _ in range(lb.max_attempts):
+                    url = lb.policy.select_replica(exclude=tried)
+                    if url is None:
+                        break
+                    tried.add(url)
+                    req = urllib.request.Request(
+                        url + self.path, data=data, headers=headers,
+                        method=method)
+                    lb.policy.pre_execute(url)
+                    try:
+                        with urllib.request.urlopen(req,
+                                                    timeout=120) as resp:
+                            ctype = resp.headers.get('Content-Type', '')
+                            if ('text/event-stream' in ctype
+                                    or 'chunked' in (resp.headers.get(
+                                        'Transfer-Encoding') or '')):
+                                responded = True
+                                self._stream_response(resp)
+                                return
+                            # Read the FULL body before sending anything
+                            # client-ward: a mid-read failure here is
+                            # still safely retryable.
+                            body = resp.read()
+                            status, rheaders = resp.status, resp.headers
+                        responded = True
+                        self.send_response(status)
+                        for k, v in rheaders.items():
                             if k.lower() not in _HOP_HEADERS:
                                 self.send_header(k, v)
                         self.send_header('Content-Length', str(len(body)))
                         self.end_headers()
                         self.wfile.write(body)
-                except urllib.error.HTTPError as e:
-                    body = e.read()
-                    self.send_response(e.code)
-                    self.send_header('Content-Length', str(len(body)))
-                    self.end_headers()
-                    self.wfile.write(body)
-                except Exception as e:  # pylint: disable=broad-except
-                    body = json.dumps({
-                        'error': f'replica unreachable: '
-                                 f'{type(e).__name__}: {e}'}).encode()
-                    self.send_response(502)
-                    self.send_header('Content-Type', 'application/json')
-                    self.send_header('Content-Length', str(len(body)))
-                    self.end_headers()
-                    self.wfile.write(body)
-                finally:
-                    lb.policy.post_execute(url)
+                        return
+                    except urllib.error.HTTPError as e:
+                        # The replica ANSWERED; pass its error through —
+                        # replaying a side-effectful request is wrong.
+                        body = e.read()
+                        responded = True
+                        self.send_response(e.code)
+                        self.send_header('Content-Length', str(len(body)))
+                        self.end_headers()
+                        self.wfile.write(body)
+                        return
+                    except Exception as e:  # pylint: disable=broad-except
+                        if responded:
+                            # Mid-stream death (or client disconnect)
+                            # AFTER bytes went out: the response cannot
+                            # be restarted and the request must not be
+                            # replayed — drop the connection.
+                            logger.warning(
+                                f'stream to/from {url} broke mid-response'
+                                f' ({type(e).__name__}: {e}); closing')
+                            self.close_connection = True
+                            return
+                        last_err = e
+                        logger.warning(
+                            f'replica {url} failed mid-request '
+                            f'({type(e).__name__}: {e}); retrying on '
+                            f'another replica')
+                    finally:
+                        lb.policy.post_execute(url)
+                if last_err is not None:
+                    self._send_json(502, {
+                        'error': f'replicas unreachable after '
+                                 f'{len(tried)} attempt(s): '
+                                 f'{type(last_err).__name__}: {last_err}'})
+                else:
+                    self._send_json(503, {
+                        'error': 'No ready replicas. '
+                                 'Use "sky serve status" to check.'})
 
             def do_GET(self):  # noqa: N802
                 self._proxy('GET')
@@ -148,10 +215,19 @@ class SkyServeLoadBalancer:
         handler = self._make_handler()
         self._httpd = http.server.ThreadingHTTPServer(
             ('0.0.0.0', self.port), handler)
+        scheme = 'http'
+        if self.tls_certfile and self.tls_keyfile:
+            import ssl
+            ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+            ctx.load_cert_chain(certfile=self.tls_certfile,
+                                keyfile=self.tls_keyfile)
+            self._httpd.socket = ctx.wrap_socket(self._httpd.socket,
+                                                 server_side=True)
+            scheme = 'https'
         threading.Thread(target=self._httpd.serve_forever,
                          daemon=True).start()
         threading.Thread(target=self._sync_loop, daemon=True).start()
-        logger.info(f'Load balancer on port {self.port} → '
+        logger.info(f'Load balancer ({scheme}) on port {self.port} → '
                     f'{self.controller_url}')
 
     def stop(self) -> None:

@@ -5,7 +5,7 @@ URL list the LB syncs from the controller."""
 from __future__ import annotations
 
 import threading
-from typing import Dict, List, Optional
+from typing import Dict, List, Optional, Set
 
 
 class LoadBalancingPolicy:
@@ -23,7 +23,11 @@ class LoadBalancingPolicy:
     def _on_replicas_changed(self, urls: List[str]) -> None:
         del urls
 
-    def select_replica(self) -> Optional[str]:
+    def select_replica(self,
+                       exclude: Optional[Set[str]] = None
+                       ) -> Optional[str]:
+        """Pick a ready replica, skipping ``exclude`` (URLs that already
+        failed this request — the LB's transparent retry)."""
         raise NotImplementedError
 
     def pre_execute(self, url: str) -> None:
@@ -44,11 +48,15 @@ class RoundRobinPolicy(LoadBalancingPolicy):
     def _on_replicas_changed(self, urls: List[str]) -> None:
         self._index = 0
 
-    def select_replica(self) -> Optional[str]:
+    def select_replica(self,
+                       exclude: Optional[Set[str]] = None
+                       ) -> Optional[str]:
         with self._lock:
-            if not self.ready_replicas:
+            candidates = [u for u in self.ready_replicas
+                          if not exclude or u not in exclude]
+            if not candidates:
                 return None
-            url = self.ready_replicas[self._index % len(self.ready_replicas)]
+            url = candidates[self._index % len(candidates)]
             self._index += 1
             return url
 
@@ -60,11 +68,15 @@ class LeastLoadPolicy(LoadBalancingPolicy):
         super().__init__()
         self._inflight: Dict[str, int] = {}
 
-    def select_replica(self) -> Optional[str]:
+    def select_replica(self,
+                       exclude: Optional[Set[str]] = None
+                       ) -> Optional[str]:
         with self._lock:
-            if not self.ready_replicas:
+            candidates = [u for u in self.ready_replicas
+                          if not exclude or u not in exclude]
+            if not candidates:
                 return None
-            return min(self.ready_replicas,
+            return min(candidates,
                        key=lambda u: self._inflight.get(u, 0))
 
     def pre_execute(self, url: str) -> None:

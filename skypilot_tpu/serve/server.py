@@ -45,6 +45,9 @@ class ModelServer:
         self._work = threading.Event()
         self._lock = threading.Lock()  # engine mutation
         self._finished_events: Dict[int, threading.Event] = {}
+        # Streaming requests: per-request token queues fed by the engine
+        # loop; (token, finished) tuples, (None, True) on engine death.
+        self._stream_queues: Dict[int, 'queue.Queue'] = {}
         self._requests_served = 0
         self._httpd: Optional[http.server.ThreadingHTTPServer] = None
 
@@ -91,7 +94,10 @@ class ModelServer:
                     else:
                         self._work.clear()
                         events = []
-                for rid, _, finished in events:
+                for rid, token, finished in events:
+                    sq = self._stream_queues.get(rid)
+                    if sq is not None:
+                        sq.put((token, finished))
                     if finished and rid in self._finished_events:
                         self._finished_events[rid].set()
             except Exception as e:  # pylint: disable=broad-except
@@ -108,6 +114,8 @@ class ModelServer:
         with self._lock:
             for ev in self._finished_events.values():
                 ev.set()
+            for sq in self._stream_queues.values():
+                sq.put((None, True))
 
     def submit(self, prompt, max_new_tokens: int, temperature: float,
                top_k: int, eos_id: Optional[int]) -> Dict[str, Any]:
@@ -137,6 +145,31 @@ class ModelServer:
             'tokens': req.output,
             'ttft_ms': req.ttft_ms,
         }
+
+    def submit_stream(self, prompt, max_new_tokens: int, temperature: float,
+                      top_k: int, eos_id: Optional[int]):
+        """Register a streaming request; returns (request_id, token
+        queue). The engine loop feeds (token, finished) tuples; callers
+        must call finish_stream(rid) when done."""
+        import queue as queue_mod
+        if self._error is not None:
+            raise RuntimeError(f'engine failed: {self._error}')
+        sq: 'queue_mod.Queue' = queue_mod.Queue()
+        with self._lock:
+            rid = self.engine.add_request(
+                prompt, max_new_tokens=max_new_tokens,
+                temperature=temperature, top_k=top_k, eos_id=eos_id)
+            self._stream_queues[rid] = sq
+            if self._error is not None:
+                sq.put((None, True))
+        self._work.set()
+        return rid, sq
+
+    def finish_stream(self, rid: int) -> None:
+        with self._lock:
+            self._stream_queues.pop(rid, None)
+            self.engine.get_finished(rid)
+            self._requests_served += 1
 
     # --------------------------------------------------------------- HTTP
     def _make_handler(server):  # noqa: N805
@@ -173,6 +206,45 @@ class ModelServer:
                 else:
                     self._json(404, {'error': f'no route {self.path}'})
 
+            def _stream_generate(self, prompt, is_text, kwargs) -> None:
+                """Server-sent events: one ``data:`` line per token as
+                the engine emits it, a final ``done`` event with the
+                full sequence. Token streaming end to end — the LB
+                passes text/event-stream responses through unbuffered."""
+                tok = server.tokenizer
+                rid, sq = server.submit_stream(prompt, **kwargs)
+                self.send_response(200)
+                self.send_header('Content-Type', 'text/event-stream')
+                self.send_header('Cache-Control', 'no-cache')
+                self.send_header('Connection', 'close')
+                self.end_headers()
+                tokens = []
+                try:
+                    while True:
+                        token, finished = sq.get(timeout=300)
+                        if token is None:       # engine died
+                            self.wfile.write(
+                                b'data: {"error": "engine failed"}\n\n')
+                            break
+                        tokens.append(int(token))
+                        event = {'token': int(token)}
+                        if is_text:
+                            event['text'] = tok.decode([int(token)])
+                        self.wfile.write(
+                            f'data: {json.dumps(event)}\n\n'.encode())
+                        self.wfile.flush()
+                        if finished:
+                            done = {'done': True, 'request_id': rid,
+                                    'tokens': tokens}
+                            if is_text:
+                                done['text'] = tok.decode(tokens)
+                            self.wfile.write(
+                                f'data: {json.dumps(done)}\n\n'.encode())
+                            break
+                finally:
+                    server.finish_stream(rid)
+                    self.close_connection = True
+
             def do_POST(self):  # noqa: N802
                 if self.path != '/generate':
                     self._json(404, {'error': f'no route {self.path}'})
@@ -191,13 +263,16 @@ class ModelServer:
                     eos_id = payload.get('eos_id')
                     if eos_id is None and is_text:
                         eos_id = tok.eos_id
-                    result = server.submit(
-                        prompt,
+                    kwargs = dict(
                         max_new_tokens=int(
                             payload.get('max_new_tokens', 128)),
                         temperature=float(payload.get('temperature', 0.0)),
                         top_k=int(payload.get('top_k', 0)),
                         eos_id=eos_id)
+                    if payload.get('stream'):
+                        self._stream_generate(prompt, is_text, kwargs)
+                        return
+                    result = server.submit(prompt, **kwargs)
                     if is_text:
                         result['text'] = tok.decode(result['tokens'])
                     self._json(200, result)

@@ -40,7 +40,9 @@ def main() -> None:
         lb = lb_lib.SkyServeLoadBalancer(
             controller_url=f'http://127.0.0.1:{record["controller_port"]}',
             port=record['lb_port'],
-            policy_name=spec.load_balancing_policy)
+            policy_name=spec.load_balancing_policy,
+            tls_certfile=spec.tls_certfile,
+            tls_keyfile=spec.tls_keyfile)
         lb.start()
         controller = controller_lib.ServeController(
             args.service_name, spec, task_config,

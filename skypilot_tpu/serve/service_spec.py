@@ -31,6 +31,11 @@ class SkyServiceSpec:
     dynamic_ondemand_fallback: bool = False
     replica_port: int = 8081
     load_balancing_policy: str = 'round_robin'
+    # TLS for the public LB endpoint (reference carries tls on
+    # SkyServiceSpec, ``sky/serve/service_spec.py:18``). Paths are
+    # resolved on the controller cluster.
+    tls_certfile: Optional[str] = None
+    tls_keyfile: Optional[str] = None
 
     def __post_init__(self):
         if not self.readiness_path.startswith('/'):
@@ -73,6 +78,10 @@ class SkyServiceSpec:
             'load_balancing_policy': config.get('load_balancing_policy',
                                                 'round_robin'),
         }
+        tls = config.get('tls')
+        if tls:
+            fields.update(tls_certfile=tls.get('certfile'),
+                          tls_keyfile=tls.get('keyfile'))
         if policy is not None and 'replicas' in config:
             raise exceptions.InvalidServiceSpecError(
                 'Give either replicas (fixed) or replica_policy, not both.')
@@ -108,6 +117,9 @@ class SkyServiceSpec:
             'port': self.replica_port,
             'load_balancing_policy': self.load_balancing_policy,
         }
+        if self.tls_certfile and self.tls_keyfile:
+            cfg['tls'] = {'certfile': self.tls_certfile,
+                          'keyfile': self.tls_keyfile}
         if self.autoscaling_enabled or self.target_qps_per_replica:
             cfg['replica_policy'] = {
                 'min_replicas': self.min_replicas,

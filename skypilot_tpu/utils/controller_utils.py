@@ -1,0 +1,97 @@
+"""Controller-side task translation: local files -> store buckets.
+
+Role of reference ``sky/utils/controller_utils.py:663``
+(``maybe_translate_local_file_mounts_and_sync_up``): a managed job's
+controller may run on a DIFFERENT machine than the client, so a task
+whose ``workdir``/``file_mounts`` reference client-local paths cannot be
+launched there. Before submission, upload those paths to a store bucket
+and rewrite the task to download from the bucket URI instead.
+
+Store choice mirrors the task's cloud: GCS for gcp/kubernetes tasks,
+the LOCAL store (a directory pretending to be a bucket, shared-
+filesystem) for local tasks — overridable via config
+``jobs.bucket`` (e.g. ``gs://my-bucket``).
+"""
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+from skypilot_tpu import config as config_lib
+from skypilot_tpu import tpu_logging
+from skypilot_tpu.dag import Dag
+from skypilot_tpu.task import Task
+
+logger = tpu_logging.init_logger(__name__)
+
+# Where a translated workdir lands on the task cluster — must match the
+# backend's workdir target so `run` commands see the same cwd either way.
+WORKDIR_TARGET = '~/sky_workdir'
+
+
+def _store_for(task: Task, name: str):
+    from skypilot_tpu.data import storage as storage_lib
+    from skypilot_tpu.utils import common_utils
+    bucket_cfg: Optional[str] = config_lib.get_nested(('jobs', 'bucket'),
+                                                      None)
+    if bucket_cfg:
+        store_type = storage_lib.StoreType.from_uri(bucket_cfg)
+        bucket = bucket_cfg.split('://', 1)[1].rstrip('/')
+        return storage_lib.make_store(store_type, f'{bucket}/{name}')
+    cloud = None
+    for res in task.resources:
+        if res.cloud:
+            cloud = res.cloud
+            break
+    if cloud in (None, 'gcp', 'kubernetes'):
+        # GCS bucket names are GLOBAL: include the user hash so every
+        # user/project gets a creatable bucket (reference does the same,
+        # 'skypilot-filemounts-{user}-{hash}').
+        bucket = f'skytpu-filemounts-{common_utils.get_user_hash()}'
+        return storage_lib.make_store(storage_lib.StoreType.GCS,
+                                      f'{bucket}/{name}')
+    return storage_lib.make_store(storage_lib.StoreType.LOCAL, name)
+
+
+def translate_local_file_mounts(dag: Dag, job_name: str,
+                                run_id: str) -> bool:
+    """Rewrite every task in ``dag`` so it carries no client-local
+    paths: upload workdir/file_mounts to a bucket, point the task at the
+    bucket URIs. Returns True if anything was translated."""
+    from skypilot_tpu import global_state
+
+    def _upload(store, source: str) -> None:
+        store.source = os.path.expanduser(source)
+        store.ensure_bucket()
+        store.upload()
+        # Register so `skytpu storage ls/delete` sees and can clean up
+        # translation buckets (they are per-run; nothing auto-deletes
+        # them — the user's checkpoint-bucket lifecycle applies).
+        global_state.add_or_update_storage(
+            store.name,
+            {'name': store.name, 'source': source,
+             'stores': [store.store_type.value], 'mode': 'COPY',
+             'persistent': False},
+            global_state.StorageStatus.READY)
+
+    translated = False
+    for ti, task in enumerate(dag.topological_order()):
+        base = f'{job_name}-{run_id}-{ti}'
+        if task.workdir:
+            store = _store_for(task, f'{base}-workdir')
+            _upload(store, task.workdir)
+            task.workdir = None
+            task.file_mounts = dict(task.file_mounts or {})
+            task.file_mounts[WORKDIR_TARGET] = store.uri()
+            translated = True
+            logger.info(f'Translated workdir -> {store.uri()}')
+        local_mounts = {
+            dst: src for dst, src in (task.file_mounts or {}).items()
+            if '://' not in src}
+        for i, (dst, src) in enumerate(sorted(local_mounts.items())):
+            store = _store_for(task, f'{base}-mount{i}')
+            _upload(store, src)
+            task.file_mounts[dst] = store.uri()
+            translated = True
+            logger.info(f'Translated file_mount {src} -> {store.uri()}')
+    return translated

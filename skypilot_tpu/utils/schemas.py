@@ -110,6 +110,15 @@ SERVICE_SCHEMA: Dict[str, Any] = {
             'type': 'string',
             'enum': ['round_robin', 'least_load'],
         },
+        'tls': {
+            'type': 'object',
+            'additionalProperties': False,
+            'required': ['certfile', 'keyfile'],
+            'properties': {
+                'certfile': {'type': 'string'},
+                'keyfile': {'type': 'string'},
+            },
+        },
     },
 }
 

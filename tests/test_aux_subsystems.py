@@ -197,3 +197,56 @@ class TestBenchmark:
         assert benchmark.list_benchmarks() == []
         from skypilot_tpu import global_state
         assert global_state.get_cluster_from_name('bm1-0') is None
+
+
+class TestStorageCliAndDashboard:
+    """`skytpu storage ls/delete` (reference ``sky/cli.py:3474``) and the
+    dashboard page (reference ``sky/jobs/dashboard/``)."""
+
+    def test_storage_ls_and_delete(self, tmp_state_dir, tmp_path):
+        from click.testing import CliRunner
+        from skypilot_tpu import cli as cli_mod
+        from skypilot_tpu.data import storage as storage_lib
+
+        src = tmp_path / 'files'
+        src.mkdir()
+        (src / 'a.txt').write_text('data')
+        st = storage_lib.Storage(name='dash-bucket', source=str(src),
+                                 stores=[storage_lib.StoreType.LOCAL])
+        st.sync_to_stores()
+
+        runner = CliRunner()
+        out = runner.invoke(cli_mod.cli, ['storage', 'ls'])
+        assert out.exit_code == 0, out.output
+        assert 'dash-bucket' in out.output and 'READY' in out.output
+
+        out = runner.invoke(cli_mod.cli,
+                            ['storage', 'delete', 'dash-bucket', '-y'])
+        assert out.exit_code == 0, out.output
+        out = runner.invoke(cli_mod.cli, ['storage', 'ls'])
+        assert 'No existing storage' in out.output
+
+    def test_dashboard_renders_live_tables(self, tmp_state_dir):
+        import json as json_lib
+        import threading
+        import urllib.request
+
+        from skypilot_tpu import dashboard
+        from skypilot_tpu.utils import common_utils
+
+        port = common_utils.find_free_port(18600)
+        server = dashboard.make_server(port)
+        t = threading.Thread(target=server.serve_forever, daemon=True)
+        t.start()
+        try:
+            with urllib.request.urlopen(
+                    f'http://127.0.0.1:{port}/', timeout=10) as r:
+                page = r.read().decode()
+            assert 'skytpu dashboard' in page
+            assert 'Clusters' in page and 'Managed jobs' in page
+            with urllib.request.urlopen(
+                    f'http://127.0.0.1:{port}/metrics', timeout=10) as r:
+                metrics = json_lib.loads(r.read())
+            assert 'clusters' in metrics
+        finally:
+            server.shutdown()

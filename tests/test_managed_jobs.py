@@ -83,28 +83,37 @@ def test_managed_job_recovery_resumes_from_checkpoint(tmp_path):
     ckpt = tmp_path / 'bucket'
     ckpt.mkdir()
     progress = ckpt / 'progress'
+    release = ckpt / 'release'
     # Resumable "training": continues from the last checkpointed step.
+    # The first run BLOCKS after writing step 3 until the release file
+    # appears — so the preemption deterministically lands mid-run no
+    # matter how loaded the host is (a sleep-based window is a flake).
     run = (
         'i=1; '
         'if [ -f "$CKPT_DIR/progress" ]; then '
         '  i=$(( $(tail -1 "$CKPT_DIR/progress") + 1 )); fi; '
         'while [ $i -le 8 ]; do '
-        '  echo $i >> "$CKPT_DIR/progress"; i=$((i+1)); sleep 0.4; '
+        '  echo $i >> "$CKPT_DIR/progress"; '
+        '  if [ $i -eq 3 ]; then '
+        '    while [ ! -f "$CKPT_DIR/release" ]; do sleep 0.2; done; fi; '
+        '  i=$((i+1)); sleep 0.1; '
         'done')
     task = _local_task('train', run, envs={'CKPT_DIR': str(ckpt)})
     try:
         job_id = jobs.launch(task, name='train')
         cluster_name = f'train-{job_id}'
 
-        # Wait for some progress, then preempt out-of-band.
-        deadline = time.time() + 60
+        # Wait until the task is provably mid-run (blocked at step 3),
+        # then preempt out-of-band and release the gate.
+        deadline = time.time() + 90
         while time.time() < deadline:
             if progress.exists() and \
-                    len(progress.read_text().split()) >= 2:
+                    len(progress.read_text().split()) >= 3:
                 break
             time.sleep(0.1)
         assert progress.exists(), 'task never started writing steps'
         local_instance.terminate_instances('local', cluster_name)
+        release.write_text('go')
 
         assert _wait_managed(job_id, timeout=120) == 'SUCCEEDED'
         steps = [int(s) for s in progress.read_text().split()]
@@ -166,5 +175,37 @@ def test_managed_job_user_failure_is_not_recovered(tmp_path):
         assert _wait_managed(job_id) == 'FAILED'
         rec = [r for r in jobs.queue() if r['job_id'] == job_id][0]
         assert rec['recovery_count'] == 0
+    finally:
+        _down_controller()
+
+
+def test_managed_job_translates_local_workdir_and_mounts(tmp_path):
+    """A managed job with a local workdir and file_mount: the dag is
+    rewritten to bucket URIs before controller submission (reference
+    ``controller_utils.maybe_translate_local_file_mounts_and_sync_up``,
+    ``sky/utils/controller_utils.py:663``), so a controller on another
+    machine could launch it — and the task still sees its files."""
+    workdir = tmp_path / 'proj'
+    workdir.mkdir()
+    (workdir / 'hello.txt').write_text('from-workdir')
+    datadir = tmp_path / 'data'
+    datadir.mkdir()
+    (datadir / 'd.txt').write_text('from-mount')
+
+    out = tmp_path / 'out.txt'
+    task = Task(name='mjt',
+                run=(f'cat hello.txt > {out} && '
+                     f'cat ~/mounted/d.txt >> {out}'),
+                workdir=str(workdir),
+                file_mounts={'~/mounted': str(datadir)})
+    task.set_resources(sky.Resources(cloud='local', cpus='1+'))
+    try:
+        job_id = jobs.launch(task, name='mjt')
+        # The submitted task no longer references the client-local paths.
+        assert task.workdir is None
+        assert all('://' in src for src in task.file_mounts.values()), \
+            task.file_mounts
+        assert _wait_managed(job_id) == 'SUCCEEDED'
+        assert out.read_text() == 'from-workdirfrom-mount'
     finally:
         _down_controller()
