@@ -25,6 +25,11 @@ ENV_NUM_SLICES = 'SKYTPU_NUM_SLICES'
 
 JAX_COORDINATOR_PORT = 8476
 
+# Where a task's workdir lands on every cluster host — shared by the
+# backend's direct sync, the controller-side file-mount translation, and
+# the driver's cwd decision.
+WORKDIR_TARGET = '~/sky_workdir'
+
 # ---- control-plane vs data-plane environment ----
 # Accelerator-runtime env vars that control-plane processes (agentd, RPC
 # subprocesses, job drivers) must NOT see: site hooks key off them to

@@ -39,6 +39,21 @@ def _job_record_to_json(job: Dict[str, Any]) -> Dict[str, Any]:
 
 def handle(request: Dict[str, Any]) -> Dict[str, Any]:
     op = request.get('op')
+    if op == 'batch':
+        # N ops in ONE ssh/python round trip: against a real cluster
+        # every RPC costs a remote interpreter start (~100s of ms), so
+        # status paths batch their reads (reference ops pay the same
+        # per-codegen-exec cost; ``sky/benchmarks`` discussions).
+        results = []
+        for sub in request.get('requests', []):
+            try:
+                if sub.get('op') == 'batch':
+                    raise ValueError('nested batch ops are not allowed')
+                results.append(handle(sub))
+            except Exception as e:  # pylint: disable=broad-except
+                results.append({'ok': False,
+                                'error': f'{type(e).__name__}: {e}'})
+        return _ok(results=results)
     if op == 'queue_job':
         job_id = job_lib.add_job(
             name=request.get('name') or 'task',
@@ -80,7 +95,15 @@ def handle(request: Dict[str, Any]) -> Dict[str, Any]:
         except (FileNotFoundError, ValueError):
             pass
         alive = subprocess_utils.pid_is_alive(pid)
+        runtime_version = None
+        try:
+            vpath = os.path.expanduser('~/.skytpu_runtime/version')
+            with open(vpath, encoding='utf-8') as f:
+                runtime_version = f.read().strip()
+        except FileNotFoundError:
+            pass
         return _ok(agentd_alive=alive, agentd_pid=pid,
+                   runtime_version=runtime_version,
                    num_nonterminal_jobs=len(job_lib.get_jobs(
                        [job_lib.JobStatus.PENDING, job_lib.JobStatus.STARTING,
                         job_lib.JobStatus.RUNNING])))

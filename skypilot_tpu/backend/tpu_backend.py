@@ -28,6 +28,7 @@ from skypilot_tpu import global_state
 from skypilot_tpu import optimizer as optimizer_lib
 from skypilot_tpu import provision
 from skypilot_tpu import tpu_logging
+from skypilot_tpu.agent import constants as agent_constants
 from skypilot_tpu.backend import backend as backend_lib
 from skypilot_tpu.dag import Dag
 from skypilot_tpu.provision import common as provision_common
@@ -38,7 +39,7 @@ from skypilot_tpu.utils import common_utils, subprocess_utils
 
 logger = tpu_logging.init_logger(__name__)
 
-WORKDIR_TARGET = '~/sky_workdir'
+WORKDIR_TARGET = agent_constants.WORKDIR_TARGET
 
 
 class TpuVmResourceHandle(backend_lib.ResourceHandle):
@@ -289,8 +290,33 @@ class TpuVmBackend(backend_lib.Backend[TpuVmResourceHandle]):
                 f'Cluster {cluster_name!r} has {handle.num_nodes} '
                 f'node(s)/slice(s); the task requests {task.num_nodes}. '
                 'Use a new cluster name or down the existing one.')
+        self._ensure_runtime_current(handle)
         global_state.update_last_use(cluster_name)
         return handle
+
+    def _ensure_runtime_current(self, handle: TpuVmResourceHandle) -> None:
+        """Version-skew guard on cluster REUSE: a newer client must not
+        drive an agent running old code (the reference re-rsyncs its
+        wheel on every launch; ``sky/backends/wheel_utils.py:140`` +
+        ``tests/backward_compatibility_tests.sh``). One agent_health RPC
+        compares the remote runtime hash with the client's; on mismatch
+        the runtime re-ships and the agent restarts on the new code."""
+        info = handle.cluster_info
+        if info.provider_name == 'local':
+            return          # local nodes import the client's tree directly
+        from skypilot_tpu.utils import pkg_utils
+        try:
+            resp = provisioner.agent_request(handle.head_runner(),
+                                             {'op': 'agent_health'})
+        except Exception:  # pylint: disable=broad-except
+            return          # unreachable agents are the refresh's problem
+        remote = resp.get('runtime_version')
+        local = pkg_utils.package_hash()
+        if remote is not None and remote != local:
+            logger.info(f'Runtime version skew on {handle.cluster_name} '
+                        f'(agent {remote}, client {local}); re-shipping '
+                        'runtime and restarting the agent.')
+            provisioner.post_provision_runtime_setup(info)
 
     def _restart_config(self, handle: TpuVmResourceHandle):
         cloud = clouds_lib.from_name(

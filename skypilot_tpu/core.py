@@ -85,6 +85,27 @@ def autostop(cluster_name: str, idle_minutes: int,
 
 
 def queue(cluster_name: str) -> List[Dict[str, Any]]:
+    """Per-cluster job table. Health check + table read ride ONE
+    batched RPC round trip (each remote call costs an ssh exec + python
+    start against a real cluster)."""
+    from skypilot_tpu.provision import provisioner
+    record = global_state.get_cluster_from_name(cluster_name)
+    if record is None or record['handle'] is None:
+        raise exceptions.ClusterDoesNotExist(
+            f'Cluster {cluster_name!r} does not exist.')
+    handle = record['handle']
+    try:
+        resp = provisioner.agent_request(handle.head_runner(), {
+            'op': 'batch',
+            'requests': [{'op': 'agent_health'}, {'op': 'job_table'}]})
+        health, table = resp['results']
+        if health.get('ok') and health.get('agentd_alive') \
+                and table.get('ok'):
+            return table['jobs']
+    except Exception:  # pylint: disable=broad-except
+        pass
+    # Fallback: full status reconciliation (cloud truth), then the
+    # plain read — the slow path for unhealthy/stale clusters.
     handle = backend_utils.check_cluster_available(cluster_name)
     backend = tpu_backend.TpuVmBackend()
     return backend.get_job_queue(handle)
@@ -107,8 +128,20 @@ def tail_logs(cluster_name: str, job_id: int,
     backend.tail_logs(handle, job_id, follow=follow)
 
 
-def job_status(cluster_name: str, job_id: int) -> Optional[str]:
-    handle = backend_utils.check_cluster_available(cluster_name)
+def job_status(cluster_name: str, job_id: int,
+               fast: bool = False) -> Optional[str]:
+    """Agent job status. ``fast=True`` skips the cluster-health refresh
+    (one RPC instead of two) and trusts the cached handle — the right
+    mode for poll loops that already treat RPC failure as a possible
+    preemption signal (the jobs controller's monitor)."""
+    if fast:
+        record = global_state.get_cluster_from_name(cluster_name)
+        if record is None or record['handle'] is None:
+            raise exceptions.ClusterDoesNotExist(
+                f'Cluster {cluster_name!r} does not exist.')
+        handle = record['handle']
+    else:
+        handle = backend_utils.check_cluster_available(cluster_name)
     backend = tpu_backend.TpuVmBackend()
     return backend.get_job_status(handle, job_id)
 

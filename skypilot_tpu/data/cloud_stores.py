@@ -28,9 +28,14 @@ def make_download_command(src: str, dst: str) -> str:
     if src.startswith('s3://'):
         return f'{mkdir} && aws s3 cp --recursive {q_src} {q_dst}'
     if src.startswith('r2://'):
+        import os
         path = src[len('r2://'):]
+        # Resolve the endpoint client-side when available (cluster hosts
+        # don't inherit the client env); fall back to the remote env var.
+        endpoint = os.environ.get('R2_ENDPOINT')
+        ep = (shlex.quote(endpoint) if endpoint else '"$R2_ENDPOINT"')
         return (f'{mkdir} && aws s3 cp --recursive s3://{shlex.quote(path)} '
-                f'{q_dst} --endpoint-url "$R2_ENDPOINT"')
+                f'{q_dst} --endpoint-url {ep}')
     if src.startswith(('https://', 'http://')):
         return f'{mkdir} && curl -fsSL {q_src} -o {q_dst}'
     if src.startswith('file://'):

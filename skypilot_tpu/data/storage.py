@@ -198,6 +198,76 @@ class S3Store(AbstractStore):
                 f'goofys {shlex.quote(self.name)} {q_mp}')
 
 
+class R2Store(AbstractStore):
+    """Cloudflare R2 via the aws cli against the R2 endpoint (reference
+    ``R2Store`` ``sky/data/storage.py:3071``). The endpoint comes from
+    the ``R2_ENDPOINT`` env var (``https://<account>.r2.cloudflarestorage
+    .com``), credentials from the standard aws config chain."""
+
+    store_type = StoreType.R2
+
+    @staticmethod
+    def _endpoint_args() -> List[str]:
+        endpoint = os.environ.get('R2_ENDPOINT')
+        if not endpoint:
+            raise exceptions.StorageSpecError(
+                'R2 store needs the R2_ENDPOINT env var '
+                '(https://<account>.r2.cloudflarestorage.com)')
+        return ['--endpoint-url', endpoint]
+
+    def uri(self) -> str:
+        return f'r2://{self.name}'
+
+    def _s3_uri(self) -> str:
+        return f's3://{self.name}'
+
+    def ensure_bucket(self) -> None:
+        ep = self._endpoint_args()
+        rc = subprocess.run(
+            ['aws', 's3api', 'head-bucket', '--bucket',
+             self.name.split('/', 1)[0]] + ep,
+            capture_output=True, check=False).returncode
+        if rc == 0:
+            return
+        proc = subprocess.run(
+            ['aws', 's3', 'mb', f's3://{self.name.split("/", 1)[0]}'] + ep,
+            capture_output=True, text=True, check=False)
+        if proc.returncode != 0:
+            raise exceptions.StorageBucketCreateError(
+                f'aws s3 mb (r2) failed: {proc.stderr[-500:]}')
+
+    def upload(self) -> None:
+        if not self.source:
+            return
+        proc = subprocess.run(
+            ['aws', 's3', 'sync', os.path.expanduser(self.source),
+             self._s3_uri()] + self._endpoint_args(),
+            capture_output=True, text=True, check=False)
+        if proc.returncode != 0:
+            raise exceptions.StorageUploadError(
+                f'aws s3 sync (r2) failed: {proc.stderr[-500:]}')
+
+    def delete_bucket(self) -> None:
+        subprocess.run(['aws', 's3', 'rb', '--force', self._s3_uri()]
+                       + self._endpoint_args(),
+                       capture_output=True, check=False)
+
+    def make_download_command(self, dst: str) -> str:
+        from skypilot_tpu.data.cloud_stores import _q
+        q_dst = _q(dst)
+        # The endpoint is resolved CLIENT-side and inlined: cluster
+        # hosts don't inherit the client's R2_ENDPOINT env.
+        endpoint = self._endpoint_args()[1]
+        return (f'mkdir -p {q_dst} && aws s3 sync '
+                f'{shlex.quote(self._s3_uri())} {q_dst} '
+                f'--endpoint-url {shlex.quote(endpoint)}')
+
+    def make_mount_command(self, mount_path: str) -> str:
+        raise exceptions.StorageSpecError(
+            'R2 MOUNT mode is not supported; use COPY '
+            '(goofys has no R2 endpoint support in this build)')
+
+
 class LocalStore(AbstractStore):
     """A directory pretending to be a bucket: upload = copy in, mount =
     symlink. Survives cluster teardown (it lives in the client state
@@ -248,6 +318,7 @@ class LocalStore(AbstractStore):
 _STORE_CLASSES = {
     StoreType.GCS: GcsStore,
     StoreType.S3: S3Store,
+    StoreType.R2: R2Store,
     StoreType.LOCAL: LocalStore,
 }
 

@@ -86,7 +86,9 @@ class JobsController:
         unreachable / gone / not UP). Reference discrimination logic:
         ``sky/jobs/controller.py:209-330``."""
         try:
-            return core.job_status(cluster_name, agent_job_id)
+            # fast=True: one RPC per poll tick; an RPC failure routes
+            # into the full health/preemption discrimination below.
+            return core.job_status(cluster_name, agent_job_id, fast=True)
         except Exception as e:  # pylint: disable=broad-except
             logger.info(f'Status poll on {cluster_name} failed '
                         f'({type(e).__name__}: {e}); checking cluster '

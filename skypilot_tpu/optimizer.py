@@ -126,6 +126,10 @@ def optimize(dag: Dag,
 
     if dag.is_chain() and len(tasks) > 1:
         _optimize_chain_dp(tasks, per_task, minimize)
+    elif len(tasks) > 1 and dag.edges() and _have_scipy():
+        # General DAG: exact ILP over placements + egress edges
+        # (reference ``_optimize_by_ilp`` ``sky/optimizer.py:472``).
+        _optimize_by_ilp(dag, tasks, per_task, minimize)
     else:
         for task in tasks:
             if task.resources_ordered:
@@ -183,6 +187,118 @@ def _optimize_chain_dp(tasks: List[Task],
     for i in range(len(tasks) - 1, -1, -1):
         tasks[i].set_best_resources(per_task[tasks[i]][j][0])
         j = parent[i][j]
+
+
+def _have_scipy() -> bool:
+    """The ILP needs scipy (HiGHS), which the base orchestration install
+    does not require; general DAGs degrade to greedy without it."""
+    try:
+        import scipy.optimize  # noqa: F401 pylint: disable=unused-import
+        return True
+    except ImportError:
+        logger.warning('scipy not installed; general-DAG placement falls '
+                       'back to greedy per-task choice (no egress-aware '
+                       'ILP). pip install scipy to enable it.')
+        return False
+
+
+def _optimize_by_ilp(dag: Dag, tasks: List[Task],
+                     per_task: Dict[Task, List[Tuple[Resources, float]]],
+                     minimize: OptimizeTarget) -> None:
+    """Exact placement for general DAGs as a 0/1 ILP (reference
+    ``_optimize_by_ilp`` ``sky/optimizer.py:472``, which uses pulp; here
+    scipy's HiGHS MILP — already in the environment).
+
+    Variables: x[i,j] = task i uses candidate j; for every dag edge
+    (u, v) with egress, y[u,v,j,l] = (u on j) AND (v on l), linearized
+    with the standard flow constraints  sum_l y[..] = x[u,j]  and
+    sum_j y[..] = x[v,l].
+    """
+    import numpy as np
+    from scipy import optimize as sciopt
+    from scipy import sparse
+
+    idx: Dict[Task, int] = {t: i for i, t in enumerate(tasks)}
+    # Variable layout: all x's first, then y's per edge.
+    x_off: List[int] = []
+    n_vars = 0
+    for t in tasks:
+        x_off.append(n_vars)
+        n_vars += len(per_task[t])
+    costs: List[float] = []
+    for t in tasks:
+        costs.extend(_estimate_cost(t, c, minimize)
+                     for _, c in per_task[t])
+
+    edges = [(u, v) for (u, v) in dag.edges()
+             if u.estimated_outputs_gb > 0]
+    y_off: Dict[Tuple[int, int], int] = {}
+    for (u, v) in edges:
+        y_off[(idx[u], idx[v])] = n_vars
+        for pres, _ in per_task[u]:
+            for vres, _ in per_task[v]:
+                costs.append(_egress_cost(pres, vres,
+                                          u.estimated_outputs_gb))
+                n_vars += 1
+
+    rows, cols, vals = [], [], []
+    rhs_lo, rhs_hi = [], []
+    row = 0
+    # One candidate per task.
+    for i, t in enumerate(tasks):
+        for j in range(len(per_task[t])):
+            rows.append(row)
+            cols.append(x_off[i] + j)
+            vals.append(1.0)
+        rhs_lo.append(1.0)
+        rhs_hi.append(1.0)
+        row += 1
+    # Edge consistency.
+    for (u, v) in edges:
+        ui, vi = idx[u], idx[v]
+        nu, nv = len(per_task[u]), len(per_task[v])
+        base = y_off[(ui, vi)]
+        for j in range(nu):       # sum_l y[j,l] - x[u,j] = 0
+            for l in range(nv):
+                rows.append(row)
+                cols.append(base + j * nv + l)
+                vals.append(1.0)
+            rows.append(row)
+            cols.append(x_off[ui] + j)
+            vals.append(-1.0)
+            rhs_lo.append(0.0)
+            rhs_hi.append(0.0)
+            row += 1
+        for l in range(nv):       # sum_j y[j,l] - x[v,l] = 0
+            for j in range(nu):
+                rows.append(row)
+                cols.append(base + j * nv + l)
+                vals.append(1.0)
+            rows.append(row)
+            cols.append(x_off[vi] + l)
+            vals.append(-1.0)
+            rhs_lo.append(0.0)
+            rhs_hi.append(0.0)
+            row += 1
+
+    a_mat = sparse.csr_matrix(
+        (vals, (rows, cols)), shape=(row, n_vars))
+    res = sciopt.milp(
+        c=np.asarray(costs),
+        constraints=sciopt.LinearConstraint(a_mat, rhs_lo, rhs_hi),
+        integrality=np.ones(n_vars),
+        bounds=sciopt.Bounds(0, 1))
+    if not res.success:       # pragma: no cover — solver failure
+        logger.warning(f'ILP failed ({res.message}); falling back to '
+                       'greedy per-task placement')
+        for t in tasks:
+            best = min(per_task[t],
+                       key=lambda rc: _estimate_cost(t, rc[1], minimize))
+            t.set_best_resources(best[0])
+        return
+    for i, t in enumerate(tasks):
+        j = int(np.argmax(res.x[x_off[i]:x_off[i] + len(per_task[t])]))
+        t.set_best_resources(per_task[t][j][0])
 
 
 def format_plan(dag: Dag,

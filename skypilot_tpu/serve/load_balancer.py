@@ -29,6 +29,15 @@ _HOP_HEADERS = {'connection', 'keep-alive', 'transfer-encoding', 'host',
                 'content-length'}
 
 
+def _failed_before_send(e: Exception) -> bool:
+    """True when the error provably happened BEFORE the request reached
+    the replica (connect refused / DNS / connect timeout) — the only
+    failures safe to retry for non-idempotent methods."""
+    import socket
+    reason = getattr(e, 'reason', e)
+    return isinstance(reason, (ConnectionRefusedError, socket.gaierror))
+
+
 def _sync_period() -> float:
     return float(os.environ.get('SKYTPU_LB_SYNC', '3'))
 
@@ -185,9 +194,19 @@ class SkyServeLoadBalancer:
                                 f' ({type(e).__name__}: {e}); closing')
                             self.close_connection = True
                             return
+                        if method != 'GET' and not _failed_before_send(e):
+                            # The replica may have EXECUTED this request
+                            # (it died while we read the response);
+                            # replaying a non-idempotent method would
+                            # run it twice. Surface the failure instead.
+                            self._send_json(502, {
+                                'error': f'replica failed mid-request '
+                                         f'({type(e).__name__}: {e}); '
+                                         'not retried (non-idempotent)'})
+                            return
                         last_err = e
                         logger.warning(
-                            f'replica {url} failed mid-request '
+                            f'replica {url} failed before answering '
                             f'({type(e).__name__}: {e}); retrying on '
                             f'another replica')
                     finally:

@@ -24,9 +24,7 @@ from skypilot_tpu.task import Task
 
 logger = tpu_logging.init_logger(__name__)
 
-# Where a translated workdir lands on the task cluster — must match the
-# backend's workdir target so `run` commands see the same cwd either way.
-WORKDIR_TARGET = '~/sky_workdir'
+from skypilot_tpu.agent.constants import WORKDIR_TARGET  # noqa: E402
 
 
 def _store_for(task: Task, name: str):

@@ -101,7 +101,14 @@ def remote_setup_command(digest: str) -> str:
         f'if [ -f {_REMOTE_DIR}/version ] && '
         f'[ "$(cat {_REMOTE_DIR}/version)" != "{digest}" ] && '
         '[ -f ~/.skytpu_agent/agentd.pid ]; then '
-        'kill "$(cat ~/.skytpu_agent/agentd.pid)" 2>/dev/null || true; '
+        'p="$(cat ~/.skytpu_agent/agentd.pid)"; '
+        'kill "$p" 2>/dev/null || true; '
+        # Wait for the old agent to actually exit: the restart snippet
+        # checks liveness via the pid file, and a still-dying agent
+        # would read as "already running" — leaving NO agent after it
+        # exits.
+        'for _ in $(seq 50); do '
+        'kill -0 "$p" 2>/dev/null || break; sleep 0.2; done; '
         'fi; '
         f'echo "{digest}" > {_REMOTE_DIR}/version'
     )

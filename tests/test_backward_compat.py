@@ -1,0 +1,103 @@
+"""Backward-compat / version-skew harness (reference
+``tests/backward_compatibility_tests.sh``: old cluster, new client, old
+jobs must stay controllable). Hermetic version: the kubernetes kubectl
+shim gives real pkg-shipping semantics (pods are 'remote' hosts that
+import the shipped zip), and client 'versions' are simulated by forcing
+a new package hash.
+"""
+import os
+import stat
+import sys
+import time
+
+import pytest
+
+import skypilot_tpu as sky
+from skypilot_tpu import core, execution
+from skypilot_tpu.task import Task
+from skypilot_tpu.utils import pkg_utils
+
+pytestmark = pytest.mark.usefixtures('tmp_state_dir')
+
+
+@pytest.fixture()
+def kubectl_shim(tmp_path, monkeypatch):
+    shim_dir = tmp_path / 'bin'
+    shim_dir.mkdir()
+    shim = shim_dir / 'kubectl'
+    src = os.path.join(os.path.dirname(__file__), 'kubectl_shim.py')
+    shim.write_text(f'#!/bin/sh\nexec {sys.executable} {src} "$@"\n')
+    shim.chmod(shim.stat().st_mode | stat.S_IEXEC)
+    monkeypatch.setenv('PATH', f'{shim_dir}{os.pathsep}'
+                               f'{os.environ.get("PATH", "")}')
+    monkeypatch.setenv('SKYTPU_K8S_FAKE_DIR', str(tmp_path / 'k8s'))
+    monkeypatch.setenv('SKYTPU_AGENT_TICK', '0.1')
+    monkeypatch.setenv('SKYTPU_AGENT_READY_TIMEOUT', '30')
+    monkeypatch.setenv('SKYTPU_WHEEL_DIR', str(tmp_path / 'wheels'))
+    kubeconfig = tmp_path / 'kubeconfig'
+    kubeconfig.write_text('apiVersion: v1\nkind: Config\n')
+    monkeypatch.setenv('KUBECONFIG', str(kubeconfig))
+    from skypilot_tpu import check
+    assert 'kubernetes' in check.check(quiet=True)
+
+
+def _wait_job(cluster, job_id, timeout=60.0):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        jobs = {j['job_id']: j for j in core.queue(cluster)}
+        st = jobs.get(job_id, {}).get('status')
+        if st in ('SUCCEEDED', 'FAILED', 'FAILED_SETUP'):
+            return st
+        time.sleep(0.3)
+    raise AssertionError(f'job {job_id} never finished')
+
+
+def test_new_client_restarts_stale_agent_and_old_jobs_survive(
+        kubectl_shim, monkeypatch):
+    """Launch with client v1, then reuse the UP cluster from a 'newer'
+    client: the agent restarts on the new runtime, the old job's record
+    stays queryable, and a new job runs — the reference's
+    backward-compatibility contract."""
+    task = Task(name='v1', run='echo from-v1')
+    task.set_resources(sky.Resources(cloud='kubernetes', cpus='1+'))
+    job1, handle = execution.launch(task, cluster_name='bc',
+                                    detach_run=True)
+    try:
+        assert _wait_job('bc', job1) == 'SUCCEEDED'
+        from skypilot_tpu.provision import provisioner
+        health1 = provisioner.agent_request(handle.head_runner(),
+                                            {'op': 'agent_health'})
+        assert health1['agentd_alive']
+        v1 = health1['runtime_version']
+        assert v1 == pkg_utils.package_hash()
+
+        # 'Upgrade' the client: the package hash changes (as any code
+        # edit would change it).
+        real_hash = pkg_utils.package_hash()
+        monkeypatch.setattr(pkg_utils, 'package_hash',
+                            lambda: 'deadbeef' + real_hash[8:])
+
+        task2 = Task(name='v2', run='echo from-v2')
+        task2.set_resources(sky.Resources(cloud='kubernetes', cpus='1+'))
+        job2, handle2 = execution.launch(task2, cluster_name='bc',
+                                         detach_run=True)
+        assert handle2.cluster_name == handle.cluster_name
+        assert _wait_job('bc', job2) == 'SUCCEEDED'
+
+        # The agent restarted on the new runtime version...
+        deadline = time.time() + 30
+        health2 = {}
+        while time.time() < deadline:
+            health2 = provisioner.agent_request(handle.head_runner(),
+                                                {'op': 'agent_health'})
+            if health2.get('runtime_version') != v1:
+                break
+            time.sleep(0.3)
+        assert health2['runtime_version'] == 'deadbeef' + real_hash[8:]
+        assert health2['agentd_alive']
+        # ...and the OLD job's record is still there and terminal.
+        jobs = {j['job_id']: j for j in core.queue('bc')}
+        assert jobs[job1]['status'] == 'SUCCEEDED'
+        assert jobs[job2]['status'] == 'SUCCEEDED'
+    finally:
+        core.down('bc')
