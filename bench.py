@@ -103,6 +103,19 @@ def main() -> None:
 
     steady_decode_window()                  # compile every kv bucket hit
     decode_tok_s = steady_decode_window() / n_chips
+
+    # Weight-only int8 variant of the same steady window (halves the
+    # weight stream; KV/activations stay bf16).
+    int8_tok_s = None
+    if on_tpu:
+        del eng
+        eng = InferenceEngine(cfg, max_batch=batch, max_seq=max_seq,
+                              quantize='int8')
+        for _ in range(batch):
+            eng.add_request(prompt, max_new_tokens=gen_len)
+        eng.run_to_completion(horizon=horizon)
+        steady_decode_window()
+        int8_tok_s = steady_decode_window() / n_chips
     param_bytes = 2.0 * cfg.num_params
     live_kv = (batch * (prompt_len + gen_len / 2) * cfg.n_layers * 2 *
                cfg.n_kv_heads * cfg.head_dim * 2.0)
@@ -132,6 +145,8 @@ def main() -> None:
             'raw_tok_s_per_chip': round(tok_s_chip, 2),
             'decode_tok_s_per_chip': round(decode_tok_s, 2),
             'decode_roofline_frac': round(roofline_frac, 3),
+            'decode_tok_s_per_chip_int8': (round(int8_tok_s, 2)
+                                           if int8_tok_s else None),
             'batch': batch,
             'prompt_len': prompt_len,
             'gen_len': gen_len,

@@ -93,6 +93,12 @@ def run_job(job_id: int) -> int:
             else:
                 quoted = shlex.quote(workdir)
             cmd = f'cd {quoted} && {cmd}'
+        docker_image = spec.get('docker_image')
+        if docker_image:
+            # Containerized run (image_id: docker:<image>); privileged
+            # so the container sees the TPU devices.
+            from skypilot_tpu.utils import docker_utils
+            cmd = docker_utils.wrap_in_docker(cmd, docker_image, env)
         rc = runner.run(cmd, env=env, log_path=log_path)
         return rc if isinstance(rc, int) else rc[0]
 

@@ -378,11 +378,21 @@ class TpuVmBackend(backend_lib.Backend[TpuVmResourceHandle]):
         log_dir = os.path.join(common_utils.state_dir(), 'logs',
                                handle.cluster_name)
         env = dict(task.envs)
+        # docker-runtime tasks run setup INSIDE the container image too,
+        # or setup-installed deps would be invisible to the run command.
+        # (Not on kubernetes: the pod already IS the container.)
+        from skypilot_tpu.utils import docker_utils
+        image = (docker_utils.docker_image_of(
+                     handle.launched_resources.image_id)
+                 if handle.cluster_info.provider_name != 'kubernetes'
+                 else None)
+        setup_cmd = (docker_utils.wrap_in_docker(task.setup, image, env)
+                     if image else task.setup)
 
         def setup_one(rank_runner):
             rank, runner = rank_runner
             log_path = os.path.join(log_dir, f'setup-{rank}.log')
-            rc = runner.run(task.setup, env=env, log_path=log_path,
+            rc = runner.run(setup_cmd, env=env, log_path=log_path,
                             cwd=None)
             rc = rc if isinstance(rc, int) else rc[0]
             if rc != 0:
@@ -407,6 +417,7 @@ class TpuVmBackend(backend_lib.Backend[TpuVmResourceHandle]):
         if not isinstance(run_cmd, str):
             raise exceptions.InvalidTaskError(
                 'Command generators are resolved before execute().')
+        from skypilot_tpu.utils import docker_utils
         spec = {
             'run': run_cmd,
             'env': {str(k): str(v) for k, v in task.envs.items()},
@@ -416,6 +427,16 @@ class TpuVmBackend(backend_lib.Backend[TpuVmResourceHandle]):
                               if (task.workdir
                                   or WORKDIR_TARGET in task.file_mounts)
                               else None,
+            # 'docker:<image>' => the driver wraps the run command in a
+            # container on each host (reference docker runtime,
+            # ``sky/backends/local_docker_backend.py:47``). On
+            # kubernetes the POD already runs that image — no second
+            # docker layer.
+            'docker_image': (
+                docker_utils.docker_image_of(
+                    handle.launched_resources.image_id)
+                if handle.cluster_info.provider_name != 'kubernetes'
+                else None),
         }
         resp = provisioner.agent_request(handle.head_runner(), {
             'op': 'queue_job',

@@ -201,12 +201,18 @@ class GCP(cloud_lib.Cloud):
                 'best_effort': bool(accel_args.get('best_effort', False)),
             })
         else:
+            # 'docker:<image>' is the CONTAINER runtime (the driver
+            # wraps commands on the host); the VM boots the default
+            # image in that case.
+            vm_image = resources.image_id
+            if vm_image and vm_image.startswith('docker:'):
+                vm_image = None
             node_config.update({
                 'kind': 'gce',
                 'machine_type': resources.instance_type,
                 'hosts_per_node': 1,
                 'chips_per_host': 0,
-                'image_id': resources.image_id,
+                'image_id': vm_image,
             })
             if resources.accelerators:
                 (name, count), = resources.accelerators.items()

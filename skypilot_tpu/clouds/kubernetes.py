@@ -87,11 +87,16 @@ class Kubernetes(cloud_lib.Cloud):
                               cluster_name: str
                               ) -> provision_common.ProvisionConfig:
         from skypilot_tpu import config as config_lib
+        from skypilot_tpu.utils import docker_utils
+        # Pods ARE containers: 'docker:<image>' maps straight to the
+        # pod image (no second docker layer inside the pod).
+        image = (docker_utils.docker_image_of(resources.image_id)
+                 or resources.image_id)
         node_config = {
             'use_spot': resources.use_spot,
             'hosts_per_node': 1,
             'chips_per_host': 0,
-            'image': resources.image_id,
+            'image': image,
         }
         if resources.is_tpu:
             tpu = resources.tpu

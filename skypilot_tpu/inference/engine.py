@@ -74,7 +74,8 @@ class InferenceEngine:
     def __init__(self, cfg: ModelConfig, params: Optional[Any] = None,
                  *, max_batch: int = 8, max_seq: int = 1024,
                  mesh: Optional[Any] = None, rng_seed: int = 0,
-                 attn_impl: str = 'auto'):
+                 attn_impl: str = 'auto',
+                 quantize: Optional[str] = None):
         self.cfg = cfg
         self.max_batch = max_batch
         self.max_seq = max_seq
@@ -84,6 +85,19 @@ class InferenceEngine:
 
         if params is None:
             params = llama.init_params(jax.random.PRNGKey(0), cfg)
+        if quantize is not None:
+            # Weight-only int8: halves the decode weight stream (the
+            # HBM roofline bench.py reports). Single-host only for now
+            # (quantized leaves aren't in the sharding-rules tree).
+            if quantize != 'int8':
+                raise ValueError(f'unknown quantize mode {quantize!r}; '
+                                 "supported: 'int8'")
+            if mesh is not None:
+                raise NotImplementedError(
+                    'int8 quantization with a multi-device mesh is not '
+                    'supported yet')
+            from skypilot_tpu.models import quantization
+            params = quantization.quantize_params(params)
         if mesh is not None:
             shardings = mesh_lib.tree_shardings(
                 llama.param_logical_axes(cfg), mesh, shapes=params)
@@ -114,7 +128,8 @@ class InferenceEngine:
     def from_pretrained(cls, path: str, *, dtype: Any = None,
                         **kwargs) -> 'InferenceEngine':
         """Build an engine from an HF checkpoint directory
-        (``config.json`` + safetensors; see ``models/weights.py``)."""
+        (``config.json`` + safetensors; see ``models/weights.py``).
+        Pass ``quantize='int8'`` for weight-only int8 serving."""
         import jax.numpy as jnp
         from skypilot_tpu.models import weights
         cfg, params = weights.load_checkpoint(

@@ -176,7 +176,8 @@ def _unembed_logits(params: Params, x: jax.Array,
     if cfg.tie_embeddings:                    # Gemma: unembed = embed^T
         return jnp.einsum('bsd,vd->bsv', x, params['embed'],
                           preferred_element_type=jnp.float32)
-    return jnp.einsum('bsd,dv->bsv', x, params['unembed'],
+    from skypilot_tpu.models.quantization import deq
+    return jnp.einsum('bsd,dv->bsv', x, deq(params['unembed']),
                       preferred_element_type=jnp.float32)
 
 
@@ -267,13 +268,14 @@ def _shard(x: jax.Array, *logical_axes: Optional[str]) -> jax.Array:
 
 
 def _ffn(layer: Params, x: jax.Array, cfg: ModelConfig) -> jax.Array:
-    gate = jnp.einsum('bsd,df->bsf', x, layer['w_gate'])
-    up = jnp.einsum('bsd,df->bsf', x, layer['w_up'])
+    from skypilot_tpu.models.quantization import deq
+    gate = jnp.einsum('bsd,df->bsf', x, deq(layer['w_gate']))
+    up = jnp.einsum('bsd,df->bsf', x, deq(layer['w_up']))
     act = jax.nn.silu if cfg.activation == 'silu' else \
         functools.partial(jax.nn.gelu, approximate=True)
     h = act(gate.astype(jnp.float32)).astype(x.dtype) * up
     h = _shard(h, 'batch', 'seq', 'mlp')
-    return jnp.einsum('bsf,fd->bsd', h, layer['w_down'])
+    return jnp.einsum('bsf,fd->bsd', h, deq(layer['w_down']))
 
 
 def _layer_core(layer: Params, x: jax.Array, cfg: ModelConfig,
@@ -287,9 +289,10 @@ def _layer_core(layer: Params, x: jax.Array, cfg: ModelConfig,
     from jax.ad_checkpoint import checkpoint_name
     h = rms_norm(x, layer['attn_norm'], cfg.norm_eps,
                   cfg.norm_plus_one)
-    q = jnp.einsum('bsd,dhk->bshk', h, layer['wq'])
-    k = jnp.einsum('bsd,dhk->bshk', h, layer['wk'])
-    v = jnp.einsum('bsd,dhk->bshk', h, layer['wv'])
+    from skypilot_tpu.models.quantization import deq
+    q = jnp.einsum('bsd,dhk->bshk', h, deq(layer['wq']))
+    k = jnp.einsum('bsd,dhk->bshk', h, deq(layer['wk']))
+    v = jnp.einsum('bsd,dhk->bshk', h, deq(layer['wv']))
     q = _shard(q, 'batch', 'seq', 'heads', 'head_dim')
     q = checkpoint_name(rope(q, positions, cfg.rope_theta), 'q_rope')
     k = checkpoint_name(rope(k, positions, cfg.rope_theta), 'k_rope')
@@ -300,7 +303,7 @@ def _layer_core(layer: Params, x: jax.Array, cfg: ModelConfig,
     # forward, at [b,s,h,d] bytes per layer.
     out = checkpoint_name(out, 'attn_out')
     out = _shard(out, 'batch', 'seq', 'heads', 'head_dim')
-    x = x + jnp.einsum('bshk,hkd->bsd', out, layer['wo'])
+    x = x + jnp.einsum('bshk,hkd->bsd', out, deq(layer['wo']))
     h = rms_norm(x, layer['ffn_norm'], cfg.norm_eps,
                  cfg.norm_plus_one)
     if cfg.is_moe:

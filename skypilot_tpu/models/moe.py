@@ -140,11 +140,12 @@ def moe_ffn(layer: Params, x: jax.Array, cfg: ModelConfig):
     xg = xt.reshape(G, group, d)
     expert_in = jnp.einsum('gtec,gtd->gecd', dispatch_mask, xg)
     expert_in = _shard_moe(expert_in, None, 'expert', None, 'embed')
-    gate = jnp.einsum('gecd,edf->gecf', expert_in, layer['moe_gate'])
-    up = jnp.einsum('gecd,edf->gecf', expert_in, layer['moe_up'])
+    from skypilot_tpu.models.quantization import deq
+    gate = jnp.einsum('gecd,edf->gecf', expert_in, deq(layer['moe_gate']))
+    up = jnp.einsum('gecd,edf->gecf', expert_in, deq(layer['moe_up']))
     h = jax.nn.silu(gate.astype(jnp.float32)).astype(x.dtype) * up
     h = _shard_moe(h, None, 'expert', None, 'mlp')
-    expert_out = jnp.einsum('gecf,efd->gecd', h, layer['moe_down'])
+    expert_out = jnp.einsum('gecf,efd->gecd', h, deq(layer['moe_down']))
     out = jnp.einsum('gtec,gecd->gtd', combine, expert_out)
     out = out.reshape(Tp, d)[:T]
     return out.reshape(b, s, d), aux

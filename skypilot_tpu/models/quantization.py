@@ -1,0 +1,103 @@
+"""Weight-only int8 quantization for serving.
+
+Decode on TPU is HBM-bound on the weight stream (see bench.py's
+roofline); storing matmul weights as int8 + per-output-channel scales
+halves that traffic. Dequantization is expressed as convert+multiply
+immediately before each einsum, which XLA fuses into the matmul's
+operand read — the weight crosses HBM as int8. (The same weight-only
+scheme JetStream/MaxText serve with; the reference delegates serving to
+those engines, ``examples/tpu/v6e/README.md:119``.)
+
+Quantized leaves are ``QuantizedWeight(int8, scale)`` NamedTuples (a
+jax pytree); ``deq(w)`` is identity on plain arrays, so the model code
+calls it unconditionally.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+Params = Dict[str, Any]
+
+# Per-layer matmul weights worth quantizing: everything except norms
+# (tiny, fp32) and the embedding table (gather path, int8 gather is a
+# different trick).
+_QUANT_LEAVES = ('wq', 'wk', 'wv', 'wo', 'w_gate', 'w_up', 'w_down',
+                 'moe_gate', 'moe_up', 'moe_down', 'unembed')
+
+
+class QuantizedWeight(NamedTuple):
+    int8: jax.Array           # same shape as the original weight
+    scale: jax.Array          # original shape with contracted dims = 1
+
+    @property
+    def shape(self):
+        return self.int8.shape
+
+    @property
+    def dtype(self):          # the COMPUTE dtype consumers see after deq
+        return self.scale.dtype
+
+
+def deq(w) -> jax.Array:
+    """Dequantize if quantized; identity otherwise. The convert+mul
+    fuses into the consuming matmul's operand read."""
+    if isinstance(w, QuantizedWeight):
+        return w.int8.astype(w.scale.dtype) * w.scale
+    return w
+
+
+def _quantize_array(w: jax.Array, reduce_axes) -> QuantizedWeight:
+    """Symmetric per-channel int8: scale = absmax/127 over the
+    CONTRACTING axes, so each output channel keeps its dynamic range."""
+    wf = w.astype(jnp.float32)
+    absmax = jnp.max(jnp.abs(wf), axis=reduce_axes, keepdims=True)
+    scale = jnp.maximum(absmax, 1e-8) / 127.0
+    q = jnp.clip(jnp.round(wf / scale), -127, 127).astype(jnp.int8)
+    return QuantizedWeight(int8=q, scale=scale.astype(w.dtype))
+
+
+# Contracting axes per leaf (leading axis 0 is the scanned layer stack
+# for layer weights; it is never contracted). Shapes from
+# ``llama.init_params`` / ``moe.init_moe_params``.
+_REDUCE_AXES = {
+    'wq': (1,),          # [L, d, h, hd]   contract d
+    'wk': (1,),
+    'wv': (1,),
+    'wo': (1, 2),        # [L, h, hd, d]   contract h, hd
+    'w_gate': (1,),      # [L, d, f]       contract d
+    'w_up': (1,),
+    'w_down': (1,),      # [L, f, d]       contract f
+    'moe_gate': (2,),    # [L, E, d, f]    contract d
+    'moe_up': (2,),
+    'moe_down': (2,),    # [L, E, f, d]    contract f
+    'unembed': (0,),     # [d, V]          contract d
+}
+
+
+def quantize_params(params: Params) -> Params:
+    """Quantize the big matmul weights of a llama-family param pytree;
+    embeddings/norms/router stay as-is."""
+    out: Params = {}
+    for key, val in params.items():
+        if key == 'layers':
+            out[key] = {
+                k: (_quantize_array(v, _REDUCE_AXES[k])
+                    if k in _REDUCE_AXES else v)
+                for k, v in val.items()
+            }
+        elif key in _REDUCE_AXES:
+            out[key] = _quantize_array(val, _REDUCE_AXES[key])
+        else:
+            out[key] = val
+    return out
+
+
+def quantized_bytes(params: Params) -> int:
+    """Total parameter bytes as stored (int8 leaves count 1B/elem)."""
+    total = 0
+    for leaf in jax.tree.leaves(params):
+        total += leaf.size * leaf.dtype.itemsize
+    return total

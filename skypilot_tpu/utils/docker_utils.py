@@ -1,0 +1,40 @@
+"""Containerized-run helper for ``image_id: docker:<image>`` tasks.
+
+One wrap implementation shared by the driver (run command) and the
+backend (setup command) so both phases execute in the SAME image with
+the same mounts — the reference's docker runtime runs setup inside the
+container too (``sky/backends/local_docker_backend.py``).
+"""
+from __future__ import annotations
+
+import shlex
+from typing import Dict, Optional
+
+DOCKER_PREFIX = 'docker:'
+
+
+def docker_image_of(image_id: Optional[str]) -> Optional[str]:
+    """The container image when ``image_id`` selects the docker runtime,
+    else None (a VM image or unset)."""
+    if image_id and image_id.startswith(DOCKER_PREFIX):
+        return image_id[len(DOCKER_PREFIX):]
+    return None
+
+
+def wrap_in_docker(cmd: str, image: str, env: Dict[str, str],
+                   privileged: bool = True) -> str:
+    """Wrap ``cmd`` to run inside ``image`` on the host.
+
+    - ``--privileged``: Cloud TPU containers need the accelerator
+      devices (/dev/accel*, vfio); control-plane wraps may pass False.
+    - host network + $HOME bind-mounted and exported so synced files,
+      the workdir cd, and the rank/coordinator env contract behave the
+      same as a bare-host run.
+    """
+    env_flags = ' '.join(f'-e {shlex.quote(k)}' for k in env)
+    priv = '--privileged ' if privileged else ''
+    return (f'docker run --rm --net=host {priv}{env_flags} '
+            f'-e HOME="$HOME" '
+            f'-v "$HOME":"$HOME" -w "$HOME" '
+            f'{shlex.quote(image)} '
+            f'bash -c {shlex.quote(cmd)}')

@@ -108,3 +108,43 @@ class TestEngine:
         done = eng.run_to_completion()
         assert done[rid].ttft_ms is not None
         assert done[rid].finish_time >= done[rid].first_token_time
+
+
+class TestInt8Quantization:
+    """Weight-only int8 serving: halved weight stream, bounded logits
+    error, engine path end to end."""
+
+    def test_quantized_forward_close(self):
+        import numpy as np
+        from skypilot_tpu.models import configs, llama, quantization
+        cfg = configs.TINY
+        params = llama.init_params(jax.random.PRNGKey(0), cfg)
+        qparams = quantization.quantize_params(params)
+        toks = jnp.arange(32).reshape(1, 32) % cfg.vocab_size
+        ref, _ = llama.forward(params, toks, cfg)
+        got, _ = llama.forward(qparams, toks, cfg)
+        ref = np.asarray(ref, np.float32)
+        got = np.asarray(got, np.float32)
+        # int8 per-channel: logits track closely but not exactly.
+        assert np.abs(ref - got).max() < 0.35, np.abs(ref - got).max()
+        # argmax (greedy decode) largely agrees
+        agree = (ref.argmax(-1) == got.argmax(-1)).mean()
+        assert agree > 0.9, agree
+
+    def test_quantized_bytes_halved(self):
+        from skypilot_tpu.models import configs, llama, quantization
+        cfg = configs.TINY
+        params = llama.init_params(jax.random.PRNGKey(0), cfg)
+        full = quantization.quantized_bytes(params)
+        q = quantization.quantized_bytes(
+            quantization.quantize_params(params))
+        assert q < 0.7 * full, (q, full)
+
+    def test_engine_generates_int8(self):
+        from skypilot_tpu.inference.engine import InferenceEngine
+        from skypilot_tpu.models import configs
+        eng = InferenceEngine(configs.TINY, max_batch=2, max_seq=64,
+                              quantize='int8')
+        rid = eng.add_request([1, 2, 3], max_new_tokens=8)
+        done = eng.run_to_completion(horizon=8)
+        assert len(done[rid].output) == 8

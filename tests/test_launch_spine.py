@@ -255,3 +255,47 @@ def test_usage_intervals_and_cost_report():
     assert 'spine-cost' in names
     row = report[names.index('spine-cost')]
     assert row['duration_hours'] > 0
+
+
+def test_docker_image_runtime_wraps_run(tmp_path, monkeypatch):
+    """image_id: docker:<image> runs the job inside a container on each
+    host (reference docker runtime). Hermetic: a PATH `docker` shim
+    executes the inner command and records the invocation."""
+    import stat
+    shim_dir = tmp_path / 'bin'
+    shim_dir.mkdir()
+    record = tmp_path / 'docker_calls.txt'
+    shim = shim_dir / 'docker'
+    shim.write_text(f'''#!/usr/bin/env python3
+import subprocess, sys
+with open({str(record)!r}, 'a') as f:
+    f.write(' '.join(sys.argv[1:]) + chr(10))
+# find: ... <image> bash -c <cmd>
+args = sys.argv[1:]
+i = args.index('bash')
+sys.exit(subprocess.run(['bash', args[i+1], args[i+2]]).returncode)
+''')
+    shim.chmod(shim.stat().st_mode | stat.S_IEXEC)
+    monkeypatch.setenv('PATH', f'{shim_dir}{os.pathsep}'
+                               f'{os.environ.get("PATH", "")}')
+
+    out = tmp_path / 'out.txt'
+    setup_out = tmp_path / 'setup_out.txt'
+    task = Task(name='dkr', run=f'echo in-container-$MARK > {out}',
+                setup=f'echo setup-in-container > {setup_out}',
+                envs={'MARK': 'x7'})
+    task.set_resources(sky.Resources(cloud='local', cpus='1+',
+                                     image_id='docker:python:3.11-slim'))
+    job_id, handle = _launch(task, 'spine-docker')
+    try:
+        assert _wait_job('spine-docker', job_id) == 'SUCCEEDED'
+        assert out.read_text().strip() == 'in-container-x7'
+        calls = record.read_text()
+        assert 'run --rm --net=host --privileged' in calls
+        assert '-e HOME=' in calls
+        assert 'python:3.11-slim' in calls
+        # setup ran through docker too (two container invocations).
+        assert setup_out.read_text().strip() == 'setup-in-container'
+        assert calls.count('run --rm --net=host') >= 2
+    finally:
+        core.down('spine-docker')
