@@ -188,28 +188,35 @@ def attention(
 ) -> jax.Array:
     """Dispatching attention entry point used by the models.
 
-    impl: 'auto' | 'xla' | 'flash' | 'ring'. 'auto' picks flash on TPU
-    when the shape fits the kernel's tiling (training-style full-sequence
-    causal attention); decode (sq==1) always uses the XLA path, which
-    fuses into a single-pass softmax anyway. 'ring' shards the sequence
-    over the sp mesh axis.
+    impl: 'auto' | 'xla' | 'flash' | 'ring' | 'ulysses'. 'auto' picks
+    flash on TPU when the shape fits the kernel's tiling (training-style
+    full-sequence causal attention); decode (sq==1) always uses the XLA
+    path, which fuses into a single-pass softmax anyway. 'ring' shards
+    the sequence over the sp mesh axis with ppermute KV rotation;
+    'ulysses' shards it with all-to-all head scatter — two collectives
+    total; needs (n_heads/tp) divisible by sp.
 
     Deliberately NOT wrapped in jax.jit: the 'ring' dispatch reads the
     ambient mesh context at trace time, and a jit cache here is not keyed
     on that context — a cached no-mesh trace would silently serve the
     non-ring path inside a mesh. Callers jit the surrounding computation.
     """
-    if impl == 'ring':
+    if impl in ('ring', 'ulysses'):
         # Sequence-parallel exact attention over the sp mesh axis
         # (training/prefill; decode never shards its single query).
         assert q_offset is None and kv_len is None, (
-            'ring attention is a full-sequence path; decode masking '
-            'args are not supported')
+            'sequence-parallel attention is a full-sequence path; '
+            'decode masking args are not supported')
         from skypilot_tpu.ops import ring_attention as ring
         mesh = ring.current_mesh()
         if mesh is not None and mesh.shape.get('sp', 1) > 1:
+            if impl == 'ulysses':
+                from skypilot_tpu.ops.ulysses import ulysses_attention
+                return ulysses_attention(q, k, v, mesh, causal=causal)
             return ring.ring_attention(q, k, v, mesh, causal=causal)
         return reference_attention(q, k, v, causal=causal)
+    if impl not in ('auto', 'xla', 'flash'):
+        raise ValueError(f'unknown attention impl {impl!r}')
     use_flash = False
     if impl == 'flash':
         use_flash = True

@@ -101,6 +101,44 @@ def _ring_body(q: jax.Array, k: jax.Array, v: jax.Array, *,
     return out.reshape(b, s, h, d).astype(q.dtype)
 
 
+def seq_parallel_call(q, k, v, mesh, body, *, axis_name: str = 'sp',
+                      rules=None, kv_head_modulus: Optional[int] = None):
+    """Shared scaffolding for sequence-parallel attention variants
+    (ring, ulysses): seq-divisibility check, GQA kv expansion when local
+    kv heads wouldn't pair positionally with local q heads, spec_for +
+    shard_map plumbing. ``kv_head_modulus`` is what the GLOBAL kv head
+    count must divide by to stay in grouped form (tp for ring, tp*sp
+    for ulysses); ``body(q, k, v)`` runs in the manual region."""
+    from skypilot_tpu.parallel.mesh import spec_for
+    sp = mesh.shape[axis_name]
+    if q.shape[1] % sp:
+        raise ValueError(
+            f'sequence-parallel attention needs seq ({q.shape[1]}) '
+            f'divisible by {axis_name}={sp}')
+    # The manual bodies pair local q heads with local kv heads
+    # positionally, so kv heads must shard exactly like q heads. For
+    # MQA/GQA below the modulus, materialize the per-q-head kv (repeat)
+    # instead of replicating — replicated kv with sharded q would
+    # silently mis-pair GQA groups.
+    tp = mesh.shape.get('tp', 1)
+    modulus = kv_head_modulus if kv_head_modulus is not None else tp
+    if k.shape[2] % modulus:
+        rep = q.shape[2] // k.shape[2]
+        k = jnp.repeat(k, rep, axis=2)
+        v = jnp.repeat(v, rep, axis=2)
+    qspec = spec_for(('batch', 'seq', 'heads', 'head_dim'), rules)
+    kspec = (qspec if k.shape[2] == q.shape[2] else
+             spec_for(('batch', 'seq', 'kv_heads', 'head_dim'), rules))
+    fn = shard_map(
+        body,
+        mesh=mesh,
+        in_specs=(qspec, kspec, kspec),
+        out_specs=qspec,
+        check_rep=False,
+    )
+    return fn(q, k, v)
+
+
 def ring_attention(
     q: jax.Array,                      # [b, S, h, d] global (sharded) arrays
     k: jax.Array,                      # [b, S, hkv, d]
@@ -115,38 +153,15 @@ def ring_attention(
     """Exact attention with the sequence dimension sharded over
     ``axis_name``. Call inside (or outside) jit with a mesh whose
     ``axis_name`` size divides the sequence length."""
-    from skypilot_tpu.parallel.mesh import spec_for
     sp = mesh.shape[axis_name]
     scale = scale if scale is not None else q.shape[-1] ** -0.5
     if sp == 1:
         from skypilot_tpu.ops.attention import reference_attention
         return reference_attention(q, k, v, causal=causal, scale=scale)
-    if q.shape[1] % sp:
-        raise ValueError(
-            f'ring attention needs seq ({q.shape[1]}) divisible by '
-            f'{axis_name}={sp}')
-    # The manual shard_map body pairs local q heads with local kv heads
-    # positionally, so kv heads must shard over tp exactly like q heads.
-    # For MQA/GQA where n_kv_heads doesn't divide tp, materialize the
-    # per-q-head kv (repeat) instead of replicating kv across tp — a
-    # replicated kv with sharded q would silently mis-pair GQA groups.
-    tp = mesh.shape.get('tp', 1)
-    if k.shape[2] % tp:
-        rep = q.shape[2] // k.shape[2]
-        k = jnp.repeat(k, rep, axis=2)
-        v = jnp.repeat(v, rep, axis=2)
-    qspec = spec_for(('batch', 'seq', 'heads', 'head_dim'), rules)
-    kspec = (qspec if k.shape[2] == q.shape[2] else
-             spec_for(('batch', 'seq', 'kv_heads', 'head_dim'), rules))
-    fn = shard_map(
-        functools.partial(_ring_body, axis_name=axis_name, axis_size=sp,
-                          causal=causal, scale=scale),
-        mesh=mesh,
-        in_specs=(qspec, kspec, kspec),
-        out_specs=qspec,
-        check_rep=False,
-    )
-    return fn(q, k, v)
+    body = functools.partial(_ring_body, axis_name=axis_name,
+                             axis_size=sp, causal=causal, scale=scale)
+    return seq_parallel_call(q, k, v, mesh, body, axis_name=axis_name,
+                             rules=rules)
 
 
 def current_mesh() -> Optional[jax.sharding.Mesh]:

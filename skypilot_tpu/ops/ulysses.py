@@ -1,0 +1,85 @@
+"""Ulysses-style sequence parallelism: all-to-all head scatter.
+
+SURVEY §5 names this the alternative to ring attention for short rings:
+instead of rotating KV blocks around the `sp` axis (sp_size ppermute
+hops, work growing with ring length), ONE all-to-all converts the
+sequence sharding into a head sharding, every rank runs ordinary
+full-sequence attention over its head slice, and a second all-to-all
+converts back. Two collectives total — cheaper than a ring whenever the
+head count divides nicely over sp and the full sequence fits per-rank
+memory for the attention inner op (flash keeps that O(s)).
+
+Layout contract (matches ring_attention): q/k/v arrive sharded
+[b, S/sp, h, d] over the `sp` mesh axis; output leaves the same way.
+Inside the manual region each rank holds [b, S, h/sp, d].
+
+GQA: kv heads must also divide sp; when they don't, kv is expanded to
+per-q-head form first (same policy as ring_attention — positional
+pairing must stay aligned).
+"""
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def _attn_body(q, k, v, *, axis_name: str, causal: bool, scale: float):
+    """Per-rank: seq-sharded -> head-sharded via all_to_all, full-seq
+    attention on the local heads, then back."""
+    # [b, s_local, h, d] -> [b, S, h_local, d]: split the HEAD axis
+    # across ranks, concatenate the SEQ axis.
+    qh = lax.all_to_all(q, axis_name, split_axis=2, concat_axis=1,
+                        tiled=True)
+    kh = lax.all_to_all(k, axis_name, split_axis=2, concat_axis=1,
+                        tiled=True)
+    vh = lax.all_to_all(v, axis_name, split_axis=2, concat_axis=1,
+                        tiled=True)
+    from skypilot_tpu.ops.attention import attention
+    # attention() applies its own 1/sqrt(d); fold the CALLER's scale in
+    # by pre-scaling q (keeps the auto flash-kernel dispatch, which has
+    # no scale parameter at this layer).
+    d = qh.shape[-1]
+    qh = (qh * jnp.asarray(scale * d ** 0.5, qh.dtype))
+    out = attention(qh, kh, vh, causal=causal, impl='auto')
+    # [b, S, h_local, d] -> [b, s_local, h, d]
+    return lax.all_to_all(out, axis_name, split_axis=1, concat_axis=2,
+                          tiled=True)
+
+
+def ulysses_attention(
+    q: jax.Array,                      # [b, S, h, d] global (sharded)
+    k: jax.Array,                      # [b, S, hkv, d]
+    v: jax.Array,
+    mesh: jax.sharding.Mesh,
+    *,
+    causal: bool = True,
+    scale: Optional[float] = None,
+    axis_name: str = 'sp',
+    rules=None,
+) -> jax.Array:
+    """Exact attention with the sequence sharded over ``axis_name`` via
+    head-scatter all-to-alls. Requires ``(n_heads / tp) % sp == 0``."""
+    from skypilot_tpu.ops.ring_attention import seq_parallel_call
+    sp = mesh.shape[axis_name]
+    scale = scale if scale is not None else q.shape[-1] ** -0.5
+    if sp == 1:
+        from skypilot_tpu.ops.attention import reference_attention
+        return reference_attention(q, k, v, causal=causal, scale=scale)
+    # Heads are ALSO tp-sharded entering the manual region, so the
+    # all-to-all splits LOCAL head counts.
+    tp = mesh.shape.get('tp', 1)
+    if q.shape[2] % tp or (q.shape[2] // tp) % sp:
+        raise ValueError(
+            f'ulysses needs n_heads per tp shard ({q.shape[2]}/{tp}) '
+            f'divisible by {axis_name}={sp}; use ring attention for '
+            'head counts below tp*sp')
+    body = functools.partial(_attn_body, axis_name=axis_name,
+                             causal=causal, scale=scale)
+    # GQA grouping survives the head scatter iff each kv head's whole
+    # q-group lands on one (tp, sp) shard — hence the tp*sp modulus.
+    return seq_parallel_call(q, k, v, mesh, body, axis_name=axis_name,
+                             rules=rules, kv_head_modulus=tp * sp)
