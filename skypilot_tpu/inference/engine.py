@@ -86,8 +86,8 @@ class InferenceEngine:
         if params is None:
             params = llama.init_params(jax.random.PRNGKey(0), cfg)
         if quantize is not None:
-            # Weight-only int8: halves the decode weight stream (the
-            # HBM roofline bench.py reports). Single-host only for now
+            # int8 weights AND int8 KV cache: the two biggest decode
+            # HBM streams each halve. Single-host only for now
             # (quantized leaves aren't in the sharding-rules tree).
             if quantize != 'int8':
                 raise ValueError(f'unknown quantize mode {quantize!r}; '
@@ -105,7 +105,8 @@ class InferenceEngine:
         self.params = params
 
         self.cache = llama.KVCache.create(cfg, batch=max_batch,
-                                          max_seq=max_seq)
+                                          max_seq=max_seq,
+                                          quantized=quantize == 'int8')
         if mesh is not None:
             cache_sh = mesh_lib.tree_shardings(
                 llama.cache_logical_axes(), mesh, shapes=self.cache)
@@ -129,7 +130,8 @@ class InferenceEngine:
                         **kwargs) -> 'InferenceEngine':
         """Build an engine from an HF checkpoint directory
         (``config.json`` + safetensors; see ``models/weights.py``).
-        Pass ``quantize='int8'`` for weight-only int8 serving."""
+        Pass ``quantize='int8'`` for int8 serving (weights AND KV
+        cache)."""
         import jax.numpy as jnp
         from skypilot_tpu.models import weights
         cfg, params = weights.load_checkpoint(
@@ -190,6 +192,8 @@ class InferenceEngine:
         @functools.partial(jax.jit, donate_argnums=(1,))
         def prefill(params, big_cache, tokens, true_lens, slots):
             """tokens [n, bucket]; true_lens [n]; slots [n] target rows."""
+            # The per-prefill scratch cache stays bf16 (exact prefill
+            # math); rows quantize once on the way into the slot cache.
             cache = llama.KVCache.create(cfg, batch=n, max_seq=bucket)
             logits, cache2 = llama.forward(params, tokens, cfg, cache=cache,
                                            attn_impl=attn_impl)
@@ -197,11 +201,20 @@ class InferenceEngine:
                 logits, (true_lens - 1)[:, None, None], axis=1)[:, 0]
             next_tokens = jnp.argmax(last, -1).astype(jnp.int32)
             # Scatter KV rows + lengths into the slot cache.
+            length = big_cache.length.at[slots].set(true_lens)
+            if big_cache.quantized:
+                kq, ks = llama.quantize_kv_rows(cache2.k)
+                vq, vs = llama.quantize_kv_rows(cache2.v)
+                return next_tokens, llama.KVCache(
+                    k=big_cache.k.at[:, slots, :bucket].set(kq),
+                    v=big_cache.v.at[:, slots, :bucket].set(vq),
+                    length=length,
+                    k_scale=big_cache.k_scale.at[:, slots, :bucket].set(ks),
+                    v_scale=big_cache.v_scale.at[:, slots, :bucket].set(vs))
             ck = big_cache.k.at[:, slots, :bucket].set(
                 cache2.k.astype(big_cache.k.dtype))
             cv = big_cache.v.at[:, slots, :bucket].set(
                 cache2.v.astype(big_cache.v.dtype))
-            length = big_cache.length.at[slots].set(true_lens)
             return next_tokens, llama.KVCache(k=ck, v=cv, length=length)
 
         self._prefill_fns[key] = prefill

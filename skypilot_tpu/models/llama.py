@@ -121,23 +121,88 @@ def param_logical_axes(cfg: ModelConfig) -> Params:
 class KVCache(NamedTuple):
     """Decode cache. k/v: [layers, batch, max_seq, kv_heads, head_dim];
     length: [batch] valid entries per sequence (supports continuous
-    batching where sequences are at different positions)."""
+    batching where sequences are at different positions).
+
+    int8 mode (``create(..., quantized=True)``): k/v are int8 with
+    per-(layer, slot, position, head) fp32 absmax/127 scales — halves
+    the decode cache read (the second-largest HBM stream after the
+    weights). The dequantizing convert+mul fuses into the attention
+    matmul's operand read, like the weight-only int8 path."""
     k: jax.Array
     v: jax.Array
     length: jax.Array
+    k_scale: Optional[jax.Array] = None    # [L, b, S, hkv, 1] fp32
+    v_scale: Optional[jax.Array] = None
+
+    @property
+    def quantized(self) -> bool:
+        return self.k_scale is not None
 
     @classmethod
-    def create(cls, cfg: ModelConfig, batch: int, max_seq: int) -> 'KVCache':
+    def create(cls, cfg: ModelConfig, batch: int, max_seq: int,
+               quantized: bool = False) -> 'KVCache':
         shape = (cfg.n_layers, batch, max_seq, cfg.n_kv_heads, cfg.head_dim)
+        length = jnp.zeros((batch,), jnp.int32)
+        if quantized:
+            sshape = shape[:-1] + (1,)
+            return cls(k=jnp.zeros(shape, jnp.int8),
+                       v=jnp.zeros(shape, jnp.int8),
+                       length=length,
+                       k_scale=jnp.zeros(sshape, jnp.float32),
+                       v_scale=jnp.zeros(sshape, jnp.float32))
         return cls(k=jnp.zeros(shape, cfg.dtype),
                    v=jnp.zeros(shape, cfg.dtype),
-                   length=jnp.zeros((batch,), jnp.int32))
+                   length=length)
 
 
 def cache_logical_axes() -> KVCache:
     return KVCache(k=('layers', 'batch', None, 'kv_heads', 'head_dim'),
                    v=('layers', 'batch', None, 'kv_heads', 'head_dim'),
                    length=('batch',))
+
+
+def quantize_kv_rows(rows: jax.Array) -> Tuple[jax.Array, jax.Array]:
+    """[..., d] bf16 rows -> (int8 rows, [..., 1] fp32 scales)."""
+    rf = rows.astype(jnp.float32)
+    absmax = jnp.max(jnp.abs(rf), axis=-1, keepdims=True)
+    scale = jnp.maximum(absmax, 1e-8) / 127.0
+    q = jnp.clip(jnp.round(rf / scale), -127, 127).astype(jnp.int8)
+    return q, scale
+
+
+def _deq_kv(rows: jax.Array, scale: Optional[jax.Array],
+            out_dtype) -> jax.Array:
+    """Identity for bf16 caches; int8 * scale (fuses into the consuming
+    matmul) for quantized ones."""
+    if scale is None:
+        return rows
+    return (rows.astype(jnp.float32) * scale).astype(out_dtype)
+
+
+def merge_rows_into_cache(cache: KVCache, k_rows: jax.Array,
+                          v_rows: jax.Array, starts: jax.Array,
+                          new_length: jax.Array) -> KVCache:
+    """Scatter new [L, b, n, hkv, d] KV rows into the cache at each
+    batch row's ``starts`` offset, quantizing on the way in when the
+    cache is int8. Shared by the prefill forward and the fused decode
+    horizon."""
+
+    def write(c, n, start):            # c [L,S,h,d] <- n [L,n,h,d] @ start
+        return lax.dynamic_update_slice(c, n, (0, start, 0, 0))
+
+    def scatter(c, rows):
+        return jax.vmap(write, in_axes=(1, 1, 0), out_axes=1)(
+            c, rows.astype(c.dtype), starts)
+
+    if cache.quantized:
+        kq, ks = quantize_kv_rows(k_rows)
+        vq, vs = quantize_kv_rows(v_rows)
+        return KVCache(k=scatter(cache.k, kq), v=scatter(cache.v, vq),
+                       length=new_length,
+                       k_scale=scatter(cache.k_scale, ks),
+                       v_scale=scatter(cache.v_scale, vs))
+    return KVCache(k=scatter(cache.k, k_rows),
+                   v=scatter(cache.v, v_rows), length=new_length)
 
 
 # --------------------------------------------------------------------------
@@ -449,6 +514,7 @@ def forward(
         # restack the entire [L, b, S, h, d] cache every call — for
         # decode that turns a ~MB token write into a ~GB cache rewrite.
         cache_k, cache_v = cache.k, cache.v
+        k_scale, v_scale = cache.k_scale, cache.v_scale
 
         def scan_body(carry, layer_and_idx):
             layer, li = layer_and_idx
@@ -456,6 +522,11 @@ def forward(
                                           keepdims=False)
             cv = lax.dynamic_index_in_dim(cache_v, li, axis=0,
                                           keepdims=False)
+            if cache.quantized:
+                ck = _deq_kv(ck, lax.dynamic_index_in_dim(
+                    k_scale, li, axis=0, keepdims=False), carry.dtype)
+                cv = _deq_kv(cv, lax.dynamic_index_in_dim(
+                    v_scale, li, axis=0, keepdims=False), carry.dtype)
             out, new_kv, aux = body(carry, (layer, (ck, cv)))
             return out, (new_kv, aux)
 
@@ -465,14 +536,8 @@ def forward(
         # k_rows: [L, b, s, kv_heads, d]; per batch row, write the
         # [L, s, kv_heads, d] block at that sequence's offset.
 
-        def write(c, n, start):
-            return lax.dynamic_update_slice(c, n, (0, start, 0, 0))
-
-        new_k = jax.vmap(write, in_axes=(1, 1, 0), out_axes=1)(
-            cache_k, k_rows.astype(cache_k.dtype), cache.length)
-        new_v = jax.vmap(write, in_axes=(1, 1, 0), out_axes=1)(
-            cache_v, v_rows.astype(cache_v.dtype), cache.length)
-        new_cache = KVCache(k=new_k, v=new_v, length=cache.length + s)
+        new_cache = merge_rows_into_cache(cache, k_rows, v_rows,
+                                          cache.length, cache.length + s)
 
     x = rms_norm(x, params['final_norm'], cfg.norm_eps,
                  cfg.norm_plus_one)
@@ -514,16 +579,22 @@ def decode_horizon(
     n_layers, n_kv, hd = cfg.n_layers, cfg.n_kv_heads, cfg.head_dim
     len0 = cache.length
     full_k, full_v = cache.k, cache.v
+    ks_full, vs_full = cache.k_scale, cache.v_scale
     if kv_bucket is not None and kv_bucket < full_k.shape[2]:
         # Decode is HBM-bound on the cache read; a static prefix slice
         # keeps per-step traffic proportional to the LIVE context, not
         # max_seq. (Rows >= kv_bucket are masked out anyway.)
         cache_k = full_k[:, :, :kv_bucket]
         cache_v = full_v[:, :, :kv_bucket]
+        k_scale = ks_full[:, :, :kv_bucket] if cache.quantized else None
+        v_scale = vs_full[:, :, :kv_bucket] if cache.quantized else None
     else:
         cache_k, cache_v = full_k, full_v
+        k_scale, v_scale = ks_full, vs_full
     layer_params = params['layers']
-    ring_k = jnp.zeros((n_layers, b, horizon, n_kv, hd), cache_k.dtype)
+    # The ring (this horizon's rows) stays in model dtype — it is tiny
+    # next to the main cache; only the main cache rides int8.
+    ring_k = jnp.zeros((n_layers, b, horizon, n_kv, hd), cfg.dtype)
     ring_v = jnp.zeros_like(ring_k)
     if rngs is None:
         rngs = jnp.zeros((horizon, 2), jnp.uint32)      # unused filler
@@ -538,6 +609,11 @@ def decode_horizon(
             layer, li = layer_and_idx
             ck = lax.dynamic_index_in_dim(cache_k, li, 0, keepdims=False)
             cv = lax.dynamic_index_in_dim(cache_v, li, 0, keepdims=False)
+            if cache.quantized:
+                ck = _deq_kv(ck, lax.dynamic_index_in_dim(
+                    k_scale, li, 0, keepdims=False), xc.dtype)
+                cv = _deq_kv(cv, lax.dynamic_index_in_dim(
+                    v_scale, li, 0, keepdims=False), xc.dtype)
             rk = lax.dynamic_index_in_dim(ring_k, li, 0, keepdims=False)
             rv = lax.dynamic_index_in_dim(ring_v, li, 0, keepdims=False)
 
@@ -568,14 +644,9 @@ def decode_horizon(
         one_step, (ring_k, ring_v, tokens),
         (jnp.arange(horizon), rngs))
 
-    def write(c, n, start):            # c [L,S,h,d] <- n [L,H,h,d] @ start
-        return lax.dynamic_update_slice(c, n, (0, start, 0, 0))
-
-    new_k = jax.vmap(write, in_axes=(1, 1, 0), out_axes=1)(
-        full_k, ring_k, len0)
-    new_v = jax.vmap(write, in_axes=(1, 1, 0), out_axes=1)(
-        full_v, ring_v, len0)
-    return toks.T, KVCache(k=new_k, v=new_v, length=len0 + horizon)
+    new_cache = merge_rows_into_cache(cache, ring_k, ring_v, len0,
+                                      len0 + horizon)
+    return toks.T, new_cache
 
 
 @functools.partial(jax.jit, static_argnames=('cfg',))

@@ -148,3 +148,20 @@ class TestInt8Quantization:
         rid = eng.add_request([1, 2, 3], max_new_tokens=8)
         done = eng.run_to_completion(horizon=8)
         assert len(done[rid].output) == 8
+
+    def test_int8_kv_cache_outputs_close_to_bf16(self):
+        """Same prompts, bf16 vs int8(weights+KV): outputs stay close
+        (greedy tokens mostly agree on a random tiny model)."""
+        from skypilot_tpu.inference.engine import InferenceEngine
+        from skypilot_tpu.models import configs
+        outs = {}
+        for mode in (None, 'int8'):
+            eng = InferenceEngine(configs.TINY, max_batch=2, max_seq=64,
+                                  quantize=mode)
+            assert eng.cache.quantized == (mode == 'int8')
+            rid = eng.add_request(list(range(1, 12)), max_new_tokens=6)
+            done = eng.run_to_completion(horizon=4)
+            outs[mode] = done[rid].output
+        assert len(outs['int8']) == 6
+        agree = sum(a == b for a, b in zip(outs[None], outs['int8']))
+        assert agree >= 3, outs
