@@ -44,6 +44,8 @@ class ModelConfig:
     # Explicit per-head width (HF configs may set head_dim != dim//n_heads,
     # e.g. Gemma-7B uses 256 with dim=3072, n_heads=16).
     head_dim_override: Optional[int] = None
+    # Qwen2-family: biases on the q/k/v projections (attention only).
+    qkv_bias: bool = False
 
     @property
     def head_dim(self) -> int:
@@ -128,9 +130,18 @@ TINY_GEMMA = _cfg(name='tiny-gemma', vocab_size=256, dim=64, n_layers=2,
                   remat='none', tie_embeddings=True, activation='gelu',
                   norm_plus_one=True, scale_embeddings=True)
 
+QWEN2_7B = _cfg(name='qwen2-7b', vocab_size=152064, dim=3584, n_layers=28,
+                n_heads=28, n_kv_heads=4, ffn_dim=18944,
+                rope_theta=1000000.0, qkv_bias=True, max_seq_len=32768)
+
+TINY_QWEN = _cfg(name='tiny-qwen', vocab_size=256, dim=64, n_layers=2,
+                 n_heads=4, n_kv_heads=2, ffn_dim=128, max_seq_len=128,
+                 remat='none', qkv_bias=True)
+
 PRESETS = {c.name: c for c in [
     LLAMA3_8B, LLAMA3_70B, LLAMA2_7B, LLAMA3_1B, MIXTRAL_8X7B,
-    GEMMA_2B, GEMMA_7B, TINY, TINY_MOE, TINY_GEMMA]}
+    GEMMA_2B, GEMMA_7B, QWEN2_7B, TINY, TINY_MOE, TINY_GEMMA,
+    TINY_QWEN]}
 
 
 def get_config(name: str) -> ModelConfig:

@@ -69,6 +69,12 @@ def init_params(rng: jax.Array, cfg: ModelConfig) -> Params:
             'wo': stack_init(keys[5], (n_h, hd, d), n_h * hd),
         },
     }
+    if cfg.qkv_bias:                    # Qwen2-family attention biases
+        params['layers'].update({
+            'bq': jnp.zeros((L, n_h, hd), jnp.float32),
+            'bk': jnp.zeros((L, n_kv, hd), jnp.float32),
+            'bv': jnp.zeros((L, n_kv, hd), jnp.float32),
+        })
     if not cfg.tie_embeddings:
         params['unembed'] = _dense_init(keys[1], (d, cfg.vocab_size),
                                         cfg.dtype, d)
@@ -101,6 +107,12 @@ def param_logical_axes(cfg: ModelConfig) -> Params:
             'wo': ('layers', 'heads', 'head_dim', 'embed'),
         },
     }
+    if cfg.qkv_bias:
+        axes['layers'].update({
+            'bq': ('layers', 'heads', 'head_dim'),
+            'bk': ('layers', 'kv_heads', 'head_dim'),
+            'bv': ('layers', 'kv_heads', 'head_dim'),
+        })
     if not cfg.tie_embeddings:
         axes['unembed'] = ('embed', 'vocab')
     if cfg.is_moe:
@@ -358,6 +370,10 @@ def _layer_core(layer: Params, x: jax.Array, cfg: ModelConfig,
     q = jnp.einsum('bsd,dhk->bshk', h, deq(layer['wq']))
     k = jnp.einsum('bsd,dhk->bshk', h, deq(layer['wk']))
     v = jnp.einsum('bsd,dhk->bshk', h, deq(layer['wv']))
+    if cfg.qkv_bias:
+        q = q + layer['bq'].astype(q.dtype)
+        k = k + layer['bk'].astype(k.dtype)
+        v = v + layer['bv'].astype(v.dtype)
     q = _shard(q, 'batch', 'seq', 'heads', 'head_dim')
     q = checkpoint_name(rope(q, positions, cfg.rope_theta), 'q_rope')
     k = checkpoint_name(rope(k, positions, cfg.rope_theta), 'k_rope')

@@ -35,6 +35,7 @@ _ARCH_FAMILY = {
     'MistralForCausalLM': 'llama',
     'GemmaForCausalLM': 'gemma',
     'MixtralForCausalLM': 'mixtral',
+    'Qwen2ForCausalLM': 'qwen2',
 }
 
 
@@ -71,6 +72,8 @@ def config_from_hf(hf: Dict[str, Any],
     if family == 'gemma':
         kw.update(tie_embeddings=True, activation='gelu',
                   norm_plus_one=True, scale_embeddings=True)
+    if family == 'qwen2':
+        kw.update(qkv_bias=True)
     if family == 'mixtral':
         kw.update(n_experts=hf['num_local_experts'],
                   n_experts_per_token=hf.get('num_experts_per_tok', 2))
@@ -116,6 +119,12 @@ def _hf_key_map(cfg: ModelConfig) -> Dict[str, Tuple[str, ...]]:
         'self_attn.v_proj.weight': ('layers', 'wv'),
         'self_attn.o_proj.weight': ('layers', 'wo'),
     }
+    if cfg.qkv_bias:
+        m.update({
+            'self_attn.q_proj.bias': ('layers', 'bq'),
+            'self_attn.k_proj.bias': ('layers', 'bk'),
+            'self_attn.v_proj.bias': ('layers', 'bv'),
+        })
     if cfg.is_moe:
         m['block_sparse_moe.gate.weight'] = ('layers', 'router')
         for e in range(cfg.n_experts):
@@ -139,6 +148,10 @@ def _transform(leaf: Tuple[str, ...], w: np.ndarray,
     hd = cfg.head_dim
     if name in ('attn_norm', 'ffn_norm'):
         return w.astype(np.float32)
+    if name == 'bq':
+        return w.reshape(cfg.n_heads, hd).astype(np.float32)
+    if name in ('bk', 'bv'):
+        return w.reshape(cfg.n_kv_heads, hd).astype(np.float32)
     if name == 'wq':
         return w.T.reshape(cfg.dim, cfg.n_heads, hd)
     if name in ('wk', 'wv'):
@@ -216,7 +229,8 @@ def load_hf_params(path: str, cfg: ModelConfig) -> Params:
             f'first: {missing[:6]}')
 
     def cast(name: str, a: np.ndarray) -> jnp.ndarray:
-        if name in ('attn_norm', 'ffn_norm', 'final_norm'):
+        if name in ('attn_norm', 'ffn_norm', 'final_norm',
+                    'bq', 'bk', 'bv'):
             return jnp.asarray(a, jnp.float32)
         return jnp.asarray(a).astype(cfg.dtype)
 
@@ -276,6 +290,13 @@ def save_hf_checkpoint(path: str, cfg: ModelConfig, params: Params) -> None:
             np_(lp['wv'][i]).reshape(cfg.dim, cfg.n_kv_heads * hd).T)
         out[p + 'self_attn.o_proj.weight'] = (
             np_(lp['wo'][i]).reshape(cfg.n_heads * hd, cfg.dim).T)
+        if cfg.qkv_bias:
+            out[p + 'self_attn.q_proj.bias'] = (
+                np_(lp['bq'][i]).reshape(cfg.n_heads * hd))
+            out[p + 'self_attn.k_proj.bias'] = (
+                np_(lp['bk'][i]).reshape(cfg.n_kv_heads * hd))
+            out[p + 'self_attn.v_proj.bias'] = (
+                np_(lp['bv'][i]).reshape(cfg.n_kv_heads * hd))
         if cfg.is_moe:
             out[p + 'block_sparse_moe.gate.weight'] = np_(lp['router'][i]).T
             for e in range(cfg.n_experts):
@@ -292,9 +313,11 @@ def save_hf_checkpoint(path: str, cfg: ModelConfig, params: Params) -> None:
     save_file(out, os.path.join(path, 'model.safetensors'))
 
     arch = {'llama': 'LlamaForCausalLM', 'gemma': 'GemmaForCausalLM',
-            'mixtral': 'MixtralForCausalLM'}
+            'mixtral': 'MixtralForCausalLM',
+            'qwen2': 'Qwen2ForCausalLM'}
     family = ('mixtral' if cfg.is_moe else
-              'gemma' if cfg.norm_plus_one else 'llama')
+              'gemma' if cfg.norm_plus_one else
+              'qwen2' if cfg.qkv_bias else 'llama')
     hf_cfg: Dict[str, Any] = {
         'architectures': [arch[family]],
         'model_type': family,

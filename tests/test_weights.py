@@ -219,3 +219,36 @@ def test_trainer_init_from_pretrained(tmp_path):
     }
     state2, metrics = tr.step(state, batch)
     assert np.isfinite(metrics['loss'])
+
+
+def test_qwen2_qkv_bias_logits_parity(tmp_path, torch_seed):
+    from transformers import Qwen2Config, Qwen2ForCausalLM
+    hf_cfg = Qwen2Config(
+        vocab_size=83, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=8, num_key_value_heads=2,
+        max_position_embeddings=64, rope_theta=10000.0, rms_norm_eps=1e-5,
+        tie_word_embeddings=False)
+    model = Qwen2ForCausalLM(hf_cfg).eval()
+    path = str(tmp_path / 'qwen2')
+    _save_hf_model(model, path)
+
+    tokens = np.random.RandomState(5).randint(0, 83, (2, 13))
+    ours, cfg = _our_logits(path, tokens)
+    assert cfg.qkv_bias
+    _assert_close(ours, _hf_logits(model, tokens))
+
+
+def test_qwen2_save_load_roundtrip(tmp_path):
+    cfg = configs.TINY_QWEN
+    params = llama.init_params(jax.random.PRNGKey(2), cfg)
+    # nonzero biases so the roundtrip actually tests them
+    params['layers']['bq'] = params['layers']['bq'] + 0.1
+    path = str(tmp_path / 'rtq')
+    weights.save_hf_checkpoint(path, cfg, params)
+    cfg2, params2 = weights.load_checkpoint(path, dtype=cfg.dtype)
+    assert cfg2.qkv_bias
+    tok = np.arange(24).reshape(1, 24) % cfg.vocab_size
+    l1, _ = llama.forward(params, jnp.asarray(tok), cfg)
+    l2, _ = llama.forward(params2, jnp.asarray(tok), cfg2)
+    np.testing.assert_allclose(np.asarray(l1, np.float32),
+                               np.asarray(l2, np.float32), atol=2e-2)
