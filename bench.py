@@ -182,9 +182,17 @@ def _flash_kernel_check(on_tpu: bool) -> dict:
     ref = np.asarray(reference_attention(q, k, v, causal=True))
     max_err = float(np.abs(out.astype(np.float32) -
                            ref.astype(np.float32)).max())
+    # Time N chained calls with one device sync at the end (a single
+    # call + host transfer measures dispatch/transfer, not the kernel).
+    # The sync is a scalar host read, NOT block_until_ready: the axon
+    # remote backend returns from block_until_ready without waiting.
+    n = 20
+    acc = q
     t0 = _t.perf_counter()
-    np.asarray(fn(q, k, v))
-    ms = (_t.perf_counter() - t0) * 1e3
+    for _ in range(n):
+        acc = fn(acc, k, v)
+    float(jnp.sum(acc))
+    ms = (_t.perf_counter() - t0) * 1e3 / n
     return {'ok': bool(max_err < 0.05), 'max_err': round(max_err, 4),
             'shape': [b, s, h, d], 'ms': round(ms, 2)}
 

@@ -13,7 +13,7 @@ import skypilot_tpu as sky
 from skypilot_tpu import core, execution, exceptions, global_state
 from skypilot_tpu.task import Task
 
-pytestmark = pytest.mark.usefixtures('tmp_state_dir', 'fast_agent')
+pytestmark = [pytest.mark.usefixtures('tmp_state_dir', 'fast_agent'), pytest.mark.slow]
 
 TERMINAL = ('SUCCEEDED', 'FAILED', 'FAILED_DRIVER', 'CANCELLED')
 

@@ -17,7 +17,7 @@ from skypilot_tpu import jobs
 from skypilot_tpu.provision.local import instance as local_instance
 from skypilot_tpu.task import Task
 
-pytestmark = pytest.mark.usefixtures('tmp_state_dir', 'fast_jobs')
+pytestmark = [pytest.mark.usefixtures('tmp_state_dir', 'fast_jobs'), pytest.mark.slow]
 
 TERMINAL = ('SUCCEEDED', 'FAILED', 'FAILED_SETUP', 'FAILED_NO_RESOURCE',
             'FAILED_CONTROLLER', 'CANCELLED')

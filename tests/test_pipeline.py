@@ -13,6 +13,8 @@ from skypilot_tpu.parallel import mesh as mesh_lib
 from skypilot_tpu.parallel.pipeline import pipeline_layers
 from skypilot_tpu.train.trainer import TrainConfig, Trainer
 
+pytestmark = pytest.mark.slow
+
 
 def _mesh(pp: int, fsdp: int = 1, tp: int = 1) -> jax.sharding.Mesh:
     spec = mesh_lib.MeshSpec(pp=pp, fsdp=fsdp, tp=tp,

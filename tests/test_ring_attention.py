@@ -14,6 +14,8 @@ from skypilot_tpu.ops.ring_attention import ring_attention
 from skypilot_tpu.parallel import mesh as mesh_lib
 from skypilot_tpu.train.trainer import TrainConfig, Trainer
 
+pytestmark = pytest.mark.slow
+
 
 def _mesh(sp: int, dp: int = 1) -> jax.sharding.Mesh:
     spec = mesh_lib.MeshSpec(dp=dp, fsdp=8 // (sp * dp), sp=sp, tp=1)

@@ -19,7 +19,7 @@ from skypilot_tpu import global_state
 from skypilot_tpu import serve
 from skypilot_tpu.task import Task
 
-pytestmark = pytest.mark.usefixtures('tmp_state_dir', 'fast_serve')
+pytestmark = [pytest.mark.usefixtures('tmp_state_dir', 'fast_serve'), pytest.mark.slow]
 
 
 @pytest.fixture()

@@ -10,6 +10,8 @@ from skypilot_tpu.ops.attention import reference_attention
 from skypilot_tpu.ops.ulysses import ulysses_attention
 from skypilot_tpu.parallel import mesh as mesh_lib
 
+pytestmark = pytest.mark.slow
+
 jax.config.update('jax_platforms', 'cpu')
 
 
