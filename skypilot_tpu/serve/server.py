@@ -32,9 +32,11 @@ class ModelServer:
 
     def __init__(self, cfg_name: str = 'tiny', *, max_batch: int = 8,
                  max_seq: int = 1024, port: int = 8081,
-                 model_path: Optional[str] = None):
+                 model_path: Optional[str] = None,
+                 quantize: Optional[str] = None):
         self.cfg_name = cfg_name
         self.model_path = model_path  # HF checkpoint dir (real weights)
+        self.quantize = quantize      # 'int8' => int8 weights + KV cache
         self.max_batch = max_batch
         self.max_seq = max_seq
         self.port = port
@@ -62,12 +64,13 @@ class ModelServer:
             # through vLLM/JetStream (llm/llama-3/llama3.yaml:109).
             engine = InferenceEngine.from_pretrained(
                 self.model_path, max_batch=self.max_batch,
-                max_seq=self.max_seq)
+                max_seq=self.max_seq, quantize=self.quantize)
             self.cfg_name = engine.cfg.name
         else:
             cfg = configs.get_config(self.cfg_name)
             engine = InferenceEngine(cfg, max_batch=self.max_batch,
-                                     max_seq=self.max_seq)
+                                     max_seq=self.max_seq,
+                                     quantize=self.quantize)
         self.tokenizer = load_tokenizer(
             self.model_path, model_vocab_size=engine.cfg.vocab_size)
         # Warmup: compile prefill+decode before declaring readiness.
@@ -306,6 +309,8 @@ def main() -> None:
                         help='preset config name (random weights)')
     parser.add_argument('--model-path', default=None,
                         help='HF checkpoint dir (real weights + tokenizer)')
+    parser.add_argument('--quantize', default=None, choices=['int8'],
+                        help='int8 weights + KV cache (2x decode)')
     parser.add_argument('--max-batch', type=int, default=8)
     parser.add_argument('--max-seq', type=int, default=1024)
     parser.add_argument('--port', type=int,
@@ -314,7 +319,8 @@ def main() -> None:
     args = parser.parse_args()
     server = ModelServer(args.model, max_batch=args.max_batch,
                          max_seq=args.max_seq, port=args.port,
-                         model_path=args.model_path)
+                         model_path=args.model_path,
+                         quantize=args.quantize)
     server.start(block=True)
 
 

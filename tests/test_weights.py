@@ -154,10 +154,10 @@ def test_hf_tokenizer_from_file(tmp_path):
     assert our.eos_id == 1
 
 
-def test_server_serves_real_checkpoint_text(tmp_path):
-    """E2e: ModelServer --model-path serves a saved checkpoint and
-    answers a TEXT prompt with decoded text (the reference's real-model
-    serving recipes, in-tree)."""
+def _serve_checkpoint(tmp_path, port_base, **server_kwargs):
+    """Save a TINY checkpoint, boot a ModelServer on it, wait for
+    readiness. Returns (server, port); caller must server.stop()."""
+    import time as time_mod
     import urllib.request
     from skypilot_tpu.serve.server import ModelServer
     from skypilot_tpu.utils import common_utils
@@ -166,25 +166,34 @@ def test_server_serves_real_checkpoint_text(tmp_path):
     params = llama.init_params(jax.random.PRNGKey(0), cfg)
     path = str(tmp_path / 'ckpt')
     weights.save_hf_checkpoint(path, cfg, params)
-
-    port = common_utils.find_free_port(18200)
+    port = common_utils.find_free_port(port_base)
     server = ModelServer(max_batch=2, max_seq=64, port=port,
-                         model_path=path)
+                         model_path=path, **server_kwargs)
     server.start(block=False)
-    try:
-        deadline = __import__('time').time() + 60
-        ready = False
-        while __import__('time').time() < deadline:
-            try:
-                with urllib.request.urlopen(
-                        f'http://127.0.0.1:{port}/readiness',
-                        timeout=5) as r:
-                    ready = r.status == 200
-                    break
-            except Exception:
-                __import__('time').sleep(0.3)
-        assert ready, 'server never became ready'
+    deadline = time_mod.time() + 60
+    ready = False
+    while time_mod.time() < deadline:
+        try:
+            with urllib.request.urlopen(
+                    f'http://127.0.0.1:{port}/readiness', timeout=5) as r:
+                ready = r.status == 200
+                break
+        except Exception:
+            time_mod.sleep(0.3)
+    if not ready:
+        server.stop()
+        raise AssertionError('server never became ready')
+    return server, port
 
+
+@pytest.mark.slow
+def test_server_serves_real_checkpoint_text(tmp_path):
+    """E2e: ModelServer --model-path serves a saved checkpoint and
+    answers a TEXT prompt with decoded text (the reference's real-model
+    serving recipes, in-tree)."""
+    import urllib.request
+    server, port = _serve_checkpoint(tmp_path, 18200)
+    try:
         req = urllib.request.Request(
             f'http://127.0.0.1:{port}/generate',
             data=json.dumps({'prompt': 'hello tpu',
@@ -252,3 +261,23 @@ def test_qwen2_save_load_roundtrip(tmp_path):
     l2, _ = llama.forward(params2, jnp.asarray(tok), cfg2)
     np.testing.assert_allclose(np.asarray(l1, np.float32),
                                np.asarray(l2, np.float32), atol=2e-2)
+
+
+@pytest.mark.slow
+def test_server_int8_quantized_serving(tmp_path):
+    """ModelServer --quantize int8 serves a checkpoint with int8
+    weights + KV cache."""
+    import urllib.request
+    server, port = _serve_checkpoint(tmp_path, 18300, quantize='int8')
+    try:
+        assert server.engine.cache.quantized
+        req = urllib.request.Request(
+            f'http://127.0.0.1:{port}/generate',
+            data=json.dumps({'prompt': [1, 2, 3],
+                             'max_new_tokens': 4}).encode(),
+            headers={'Content-Type': 'application/json'})
+        with urllib.request.urlopen(req, timeout=30) as r:
+            out = json.loads(r.read())
+        assert len(out['tokens']) == 4
+    finally:
+        server.stop()
