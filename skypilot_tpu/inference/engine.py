@@ -75,7 +75,8 @@ class InferenceEngine:
                  *, max_batch: int = 8, max_seq: int = 1024,
                  mesh: Optional[Any] = None, rng_seed: int = 0,
                  attn_impl: str = 'auto',
-                 quantize: Optional[str] = None):
+                 quantize: Optional[str] = None,
+                 donate_params: bool = False):
         self.cfg = cfg
         self.max_batch = max_batch
         self.max_seq = max_seq
@@ -83,33 +84,47 @@ class InferenceEngine:
         self.attn_impl = attn_impl
         self._rng = jax.random.PRNGKey(rng_seed)
 
+        from skypilot_tpu.models import quantization
         if params is None:
             params = llama.init_params(jax.random.PRNGKey(0), cfg)
-        if quantize is not None:
-            # int8 weights AND int8 KV cache: the two biggest decode
-            # HBM streams each halve. Single-host only for now
-            # (quantized leaves aren't in the sharding-rules tree).
-            if quantize != 'int8':
-                raise ValueError(f'unknown quantize mode {quantize!r}; '
-                                 "supported: 'int8'")
-            if mesh is not None:
-                raise NotImplementedError(
-                    'int8 quantization with a multi-device mesh is not '
-                    'supported yet')
-            from skypilot_tpu.models import quantization
-            params = quantization.quantize_params(params)
+        if quantize is not None and quantize != 'int8':
+            raise ValueError(f'unknown quantize mode {quantize!r}; '
+                             "supported: 'int8'")
         if mesh is not None:
-            shardings = mesh_lib.tree_shardings(
+            # Shard the bf16 tree FIRST so a 7B-class checkpoint never
+            # has to fit (bf16 + int8) on one chip; quantization then
+            # runs shard-parallel (the absmax over a sharded contracting
+            # axis compiles to an on-mesh reduction).
+            bf16_sh = mesh_lib.tree_shardings(
                 llama.param_logical_axes(cfg), mesh, shapes=params)
-            params = jax.device_put(params, shardings)
+            params = jax.device_put(params, bf16_sh)
+        if quantize == 'int8':
+            # int8 weights AND int8 KV cache: the two biggest decode
+            # HBM streams each halve. ``donate_params`` frees each bf16
+            # buffer as its int8 replacement lands (see quantize_params).
+            params = quantization.quantize_params(params,
+                                                  donate=donate_params)
+            if mesh is not None:
+                # Canonicalize: int8 codes shard like their bf16
+                # parents; per-channel scales follow the output axes and
+                # replicate over the contracted (unit) dims.
+                qaxes = quantization.quantize_logical_axes(
+                    llama.param_logical_axes(cfg))
+                params = jax.device_put(params, mesh_lib.tree_shardings(
+                    qaxes, mesh, shapes=params))
         self.params = params
+        # Actual stored parameter bytes (int8 leaves count 1B/elem) —
+        # sizes the decode-horizon ring cap against the true weight
+        # stream, not a bf16 assumption.
+        self._param_bytes = quantization.quantized_bytes(params)
 
         self.cache = llama.KVCache.create(cfg, batch=max_batch,
                                           max_seq=max_seq,
                                           quantized=quantize == 'int8')
         if mesh is not None:
             cache_sh = mesh_lib.tree_shardings(
-                llama.cache_logical_axes(), mesh, shapes=self.cache)
+                llama.cache_logical_axes(quantized=self.cache.quantized),
+                mesh, shapes=self.cache)
             self.cache = jax.device_put(self.cache, cache_sh)
 
         # slot bookkeeping (host side)
@@ -136,6 +151,9 @@ class InferenceEngine:
         from skypilot_tpu.models import weights
         cfg, params = weights.load_checkpoint(
             path, dtype=dtype if dtype is not None else jnp.bfloat16)
+        # The freshly loaded tree has no other owner: let quantization
+        # free bf16 buffers in place (7B bf16 + int8 won't coexist).
+        kwargs.setdefault('donate_params', True)
         return cls(cfg, params, **kwargs)
 
     # ------------------------------------------------------------------
@@ -337,10 +355,14 @@ class InferenceEngine:
         # produced this horizon; past ~15% of the weight-read traffic the
         # ring dominates the HBM budget and longer horizons backfire
         # (measured: 1B model, b=64 — horizon 128 halves throughput vs 64).
+        # Both sides use ACTUAL stored bytes: int8 halves the weight
+        # stream (so the cap tightens) and quarters the KV rows.
+        kv_itemsize = jnp.dtype(self.cache.k.dtype).itemsize
         ring_row_bytes = (self.cfg.n_layers * self.max_batch *
-                          self.cfg.n_kv_heads * self.cfg.head_dim * 2 * 2)
-        ring_cap = max(8, int(0.15 * 2 * self.cfg.num_params
-                              / ring_row_bytes))
+                          self.cfg.n_kv_heads *
+                          (self.cfg.head_dim * kv_itemsize +
+                           (4 if self.cache.quantized else 0)) * 2)
+        ring_cap = max(8, int(0.15 * self._param_bytes / ring_row_bytes))
         horizon = min(horizon, ring_cap)
         for b in reversed(self._HORIZON_BUCKETS):
             if b <= horizon:
@@ -394,8 +416,45 @@ class InferenceEngine:
             self._slot_len[slot] = 0
         return done
 
+    def cancel(self, request_id: int) -> bool:
+        """Abort a live request: drop it from the wait queue or free its
+        decode slot so a disconnected client stops consuming capacity.
+        Returns True if the request was still live (it is NOT recorded in
+        the finished table). Safe no-op for finished/unknown ids."""
+        # Still queued? Rebuild the queue without it.
+        drained: List[Request] = []
+        found = False
+        while True:
+            try:
+                r = self._queue.get_nowait()
+            except queue.Empty:
+                break
+            if r.request_id == request_id:
+                found = True
+            else:
+                drained.append(r)
+        for r in drained:
+            self._queue.put(r)
+        if found:
+            return True
+        # Occupying a slot? Free it — the next admit overwrites the
+        # slot's KV rows and device-side length.
+        for slot, req in enumerate(self._slots):
+            if req is not None and req.request_id == request_id:
+                req.finish_time = time.time()
+                self._slots[slot] = None
+                self._slot_len[slot] = 0
+                return True
+        return False
+
     def get_finished(self, request_id: int) -> Optional[Request]:
         return self._finished.get(request_id)
+
+    def pop_finished(self, request_id: int) -> Optional[Request]:
+        """Consume a finished request, evicting it from the finished
+        table. Long-lived servers MUST use this (or evict otherwise):
+        the table grows without bound under steady traffic."""
+        return self._finished.pop(request_id, None)
 
     def run_to_completion(self, horizon: int = 32) -> Dict[int, Request]:
         """Drive until queue + slots drain. Returns finished requests."""

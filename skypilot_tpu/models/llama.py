@@ -167,10 +167,14 @@ class KVCache(NamedTuple):
                    length=length)
 
 
-def cache_logical_axes() -> KVCache:
-    return KVCache(k=('layers', 'batch', None, 'kv_heads', 'head_dim'),
-                   v=('layers', 'batch', None, 'kv_heads', 'head_dim'),
-                   length=('batch',))
+def cache_logical_axes(quantized: bool = False) -> KVCache:
+    kv = ('layers', 'batch', None, 'kv_heads', 'head_dim')
+    if quantized:
+        # fp32 scales ride the same layout; their unit head_dim is
+        # replicated by the divisibility-aware spec mapping.
+        return KVCache(k=kv, v=kv, length=('batch',),
+                       k_scale=kv, v_scale=kv)
+    return KVCache(k=kv, v=kv, length=('batch',))
 
 
 def quantize_kv_rows(rows: jax.Array) -> Tuple[jax.Array, jax.Array]:

@@ -384,10 +384,15 @@ def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
     block_k = min(block_k, skv)
     while block_k > 128 and skv % block_k:
         block_k //= 2
-    if sq % block_q != 0 or skv % block_k != 0:
+    # Mosaic needs sublane-aligned tiles: a sequence like 300 or 129
+    # would otherwise sail through with block==sq and die in the kernel
+    # compile with an opaque error. Short power-of-two sequences
+    # (block == sq, multiple of 8) remain valid, as before.
+    if (sq % block_q != 0 or skv % block_k != 0
+            or block_q % 8 != 0 or block_k % 8 != 0):
         raise ValueError(
-            f'seq lengths must be divisible by block sizes: sq={sq} '
-            f'(block_q={block_q}), skv={skv} (block_k={block_k})')
+            f'seq lengths must be divisible by 8-aligned block sizes: '
+            f'sq={sq} (block_q={block_q}), skv={skv} (block_k={block_k})')
     if scale is None:
         scale = q.shape[-1] ** -0.5
     qt = q.transpose(0, 2, 1, 3)

@@ -51,6 +51,7 @@ class ModelServer:
         # loop; (token, finished) tuples, (None, True) on engine death.
         self._stream_queues: Dict[int, 'queue.Queue'] = {}
         self._requests_served = 0
+        self._requests_aborted = 0
         self._httpd: Optional[http.server.ThreadingHTTPServer] = None
 
     # ------------------------------------------------------------- engine
@@ -140,7 +141,7 @@ class ModelServer:
         if self._error is not None:   # woken by _fatal, not completion
             raise RuntimeError(f'engine failed: {self._error}')
         with self._lock:
-            req = self.engine.get_finished(rid)
+            req = self.engine.pop_finished(rid)
             del self._finished_events[rid]
             self._requests_served += 1
         return {
@@ -169,10 +170,16 @@ class ModelServer:
         return rid, sq
 
     def finish_stream(self, rid: int) -> None:
+        """Deregister a streaming request. If the client disconnected
+        mid-stream (the request is not finished), cancel it engine-side
+        so the slot stops generating tokens nobody will read — and count
+        it as aborted, not served."""
         with self._lock:
             self._stream_queues.pop(rid, None)
-            self.engine.get_finished(rid)
-            self._requests_served += 1
+            if self.engine.pop_finished(rid) is not None:
+                self._requests_served += 1
+            elif self.engine.cancel(rid):
+                self._requests_aborted += 1
 
     # --------------------------------------------------------------- HTTP
     def _make_handler(server):  # noqa: N805
@@ -203,6 +210,7 @@ class ModelServer:
                     eng = server.engine
                     self._json(200, {
                         'requests_served': server._requests_served,
+                        'requests_aborted': server._requests_aborted,
                         'active_slots': eng.num_active if eng else 0,
                         'max_batch': server.max_batch,
                     })
@@ -216,37 +224,46 @@ class ModelServer:
                 passes text/event-stream responses through unbuffered."""
                 tok = server.tokenizer
                 rid, sq = server.submit_stream(prompt, **kwargs)
-                self.send_response(200)
-                self.send_header('Content-Type', 'text/event-stream')
-                self.send_header('Cache-Control', 'no-cache')
-                self.send_header('Connection', 'close')
-                self.end_headers()
                 tokens = []
+                # Everything after registration lives under the finally:
+                # even a client that drops before the headers flush must
+                # reach finish_stream, or the slot decodes to
+                # max_new_tokens for nobody.
                 try:
-                    while True:
-                        token, finished = sq.get(timeout=300)
-                        if token is None:       # engine died
-                            self.wfile.write(
-                                b'data: {"error": "engine failed"}\n\n')
-                            break
-                        tokens.append(int(token))
-                        event = {'token': int(token)}
-                        if is_text:
-                            event['text'] = tok.decode([int(token)])
-                        self.wfile.write(
-                            f'data: {json.dumps(event)}\n\n'.encode())
-                        self.wfile.flush()
-                        if finished:
-                            done = {'done': True, 'request_id': rid,
-                                    'tokens': tokens}
-                            if is_text:
-                                done['text'] = tok.decode(tokens)
-                            self.wfile.write(
-                                f'data: {json.dumps(done)}\n\n'.encode())
-                            break
+                    self.send_response(200)
+                    self.send_header('Content-Type', 'text/event-stream')
+                    self.send_header('Cache-Control', 'no-cache')
+                    self.send_header('Connection', 'close')
+                    self.end_headers()
+                    self._stream_loop(rid, sq, tokens, is_text, tok)
+                except (BrokenPipeError, ConnectionResetError):
+                    pass    # client vanished; finish_stream cancels
                 finally:
                     server.finish_stream(rid)
                     self.close_connection = True
+
+            def _stream_loop(self, rid, sq, tokens, is_text, tok) -> None:
+                while True:
+                    token, finished = sq.get(timeout=300)
+                    if token is None:       # engine died
+                        self.wfile.write(
+                            b'data: {"error": "engine failed"}\n\n')
+                        break
+                    tokens.append(int(token))
+                    event = {'token': int(token)}
+                    if is_text:
+                        event['text'] = tok.decode([int(token)])
+                    self.wfile.write(
+                        f'data: {json.dumps(event)}\n\n'.encode())
+                    self.wfile.flush()
+                    if finished:
+                        done = {'done': True, 'request_id': rid,
+                                'tokens': tokens}
+                        if is_text:
+                            done['text'] = tok.decode(tokens)
+                        self.wfile.write(
+                            f'data: {json.dumps(done)}\n\n'.encode())
+                        break
 
             def do_POST(self):  # noqa: N802
                 if self.path != '/generate':

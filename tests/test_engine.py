@@ -165,3 +165,102 @@ class TestInt8Quantization:
         assert len(outs['int8']) == 6
         agree = sum(a == b for a, b in zip(outs[None], outs['int8']))
         assert agree >= 3, outs
+
+
+class TestShardedInt8:
+    """int8 quantization combined with a device mesh — the production
+    serving shape (7B-class, tp-sharded, quantized; VERDICT r3 task 2;
+    ref anchor: vLLM --tensor-parallel-size recipes,
+    llm/llama-3/llama3.yaml:109)."""
+
+    def _mesh(self, tp):
+        from skypilot_tpu.parallel import mesh as mesh_lib
+        spec = mesh_lib.MeshSpec(dp=1, fsdp=1, sp=1, tp=tp)
+        return mesh_lib.make_mesh(
+            spec, devices=jax.devices()[:spec.num_devices])
+
+    def test_int8_tp2_matches_single_device_int8(self, engine_setup):
+        cfg, params = engine_setup
+        prompt = [3, 1, 4, 1, 5, 9, 2, 6]
+        outs = {}
+        for mesh in (None, self._mesh(2)):
+            eng = InferenceEngine(cfg, params, max_batch=2, max_seq=128,
+                                  mesh=mesh, quantize='int8',
+                                  attn_impl='xla')
+            rid = eng.add_request(prompt, max_new_tokens=8)
+            done = eng.run_to_completion(horizon=4)
+            outs['single' if mesh is None else 'tp2'] = done[rid].output
+        assert outs['single'] == outs['tp2'], outs
+
+    def test_int8_scales_shard_with_parents(self, engine_setup):
+        """Quantized leaves + scales get mesh shardings; scale unit dims
+        replicate while output-channel dims follow the parent."""
+        cfg, params = engine_setup
+        eng = InferenceEngine(cfg, params, max_batch=2, max_seq=64,
+                              mesh=self._mesh(2), quantize='int8',
+                              attn_impl='xla')
+        wq = eng.params['layers']['wq']
+        # int8 codes: heads dim (axis 2) sharded over tp=2
+        spec = wq.int8.sharding.spec
+        assert 'tp' in str(spec), spec
+        # scale has the contracted dim as size 1 and still lands on the
+        # mesh without error
+        assert wq.scale.shape[1] == 1
+        # int8 KV cache sharded too: kv_heads dim rides tp
+        assert eng.cache.quantized
+        assert 'tp' in str(eng.cache.k.sharding.spec), \
+            eng.cache.k.sharding.spec
+
+    def test_quantize_logical_axes_structure(self):
+        """Axes tree after quantization matches the quantized params
+        tree structure exactly (tree_map compatibility)."""
+        from skypilot_tpu.models import configs, llama, quantization
+        cfg = configs.TINY
+        params = llama.init_params(jax.random.PRNGKey(0), cfg)
+        qparams = quantization.quantize_params(params)
+        qaxes = quantization.quantize_logical_axes(
+            llama.param_logical_axes(cfg))
+        is_leaf = lambda x: isinstance(x, tuple) and all(
+            a is None or isinstance(a, str) for a in x)
+        # Must not raise: structures line up leaf-for-leaf.
+        jax.tree.map(lambda a, p: None, qaxes, qparams, is_leaf=is_leaf)
+
+
+class TestCancel:
+    """Engine-side request cancellation (dropped streaming clients must
+    release their decode slot — ADVICE r3 serve/server.py finding)."""
+
+    def test_cancel_queued(self, engine_setup):
+        cfg, params = engine_setup
+        eng = InferenceEngine(cfg, params, max_batch=1, max_seq=128,
+                              attn_impl='xla')
+        r1 = eng.add_request([1, 2, 3], max_new_tokens=4)
+        r2 = eng.add_request([4, 5, 6], max_new_tokens=4)
+        assert eng.cancel(r2)
+        done = eng.run_to_completion()
+        assert r1 in done and r2 not in done
+
+    def test_cancel_active_frees_slot(self, engine_setup):
+        cfg, params = engine_setup
+        eng = InferenceEngine(cfg, params, max_batch=1, max_seq=128,
+                              attn_impl='xla')
+        rid = eng.add_request([1, 2, 3], max_new_tokens=64)
+        eng.step(horizon=2)          # admit + some decode
+        assert eng.num_active == 1
+        assert eng.cancel(rid)
+        assert eng.num_active == 0
+        assert not eng.has_work()
+        assert eng.get_finished(rid) is None   # aborted, not served
+        # engine still serves new work afterwards
+        r2 = eng.add_request([7, 8], max_new_tokens=3)
+        done = eng.run_to_completion()
+        assert len(done[r2].output) == 3
+
+    def test_cancel_finished_noop(self, engine_setup):
+        cfg, params = engine_setup
+        eng = InferenceEngine(cfg, params, max_batch=1, max_seq=128,
+                              attn_impl='xla')
+        rid = eng.add_request([1, 2], max_new_tokens=2)
+        eng.run_to_completion()
+        assert not eng.cancel(rid)
+        assert eng.get_finished(rid) is not None

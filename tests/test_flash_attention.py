@@ -82,3 +82,30 @@ def test_bf16_forward_close():
     np.testing.assert_allclose(np.asarray(out, dtype=np.float32),
                                np.asarray(ref, dtype=np.float32),
                                rtol=3e-2, atol=3e-2)
+
+
+def test_unaligned_seq_rejected_loudly():
+    """sq not 128-divisible must raise a clear ValueError instead of
+    reaching Mosaic with unaligned blocks (ADVICE r3)."""
+    import pytest
+    q = jnp.zeros((1, 300, 4, 64), jnp.float32)
+    with pytest.raises(ValueError, match='8-aligned'):
+        flash_attention(q, q, q, causal=True, interpret=True)
+
+
+def test_quant_scale_consistency():
+    """Weight codes are computed against the SAME (dtype-rounded) scale
+    dequantization multiplies by: per-element error <= scale (ADVICE r3
+    quantization.py finding)."""
+    import numpy as np
+    from skypilot_tpu.models.quantization import _quantize_array, deq
+    w = jax.random.normal(jax.random.PRNGKey(0), (64, 32),
+                          jnp.bfloat16) * 3.0
+    qw = _quantize_array(w, (0,))
+    assert qw.scale.dtype == jnp.bfloat16
+    err = np.abs(np.asarray(deq(qw), np.float32) -
+                 np.asarray(w, np.float32))
+    # 0.5*scale from int8 rounding + up to ~0.5*scale from bf16 rounding
+    # of the dequantized product (127*scale * 2^-8).
+    bound = np.asarray(qw.scale, np.float32) * 1.05 + 1e-6
+    assert (err <= np.broadcast_to(bound, err.shape)).all(), err.max()
