@@ -3,24 +3,25 @@
 Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
 
 Anchor (BASELINE.md): JetStream Llama-2-7B on TPU v6e-8 produces 2147.98
-output tok/s = 268.5 tok/s/chip. This machine exposes one chip (v5e under
-the driver), which cannot hold a 7B model in bf16, so we bench the in-tree
-engine on the llama3-1b flagship and convert to a Llama-2-7B-equivalent
-rate with a bandwidth model — batched decode is HBM-bandwidth-bound, so
-per-step traffic ratio is the conversion:
+output tok/s = 268.5 tok/s/chip. The headline is now a RAW measurement of
+the SAME model configuration: a Llama-2-7B-config checkpoint (32 layers,
+dim 4096, real HF config; synthetic weights — this env has zero egress,
+and decode perf depends on the config, not the values) is materialized on
+disk, loaded through the HF import path with host-side int8 quantization,
+and served by the in-tree engine on the local chip. ``vs_baseline`` is
+the direct per-chip ratio against the anchor (no modeling); the
+bandwidth-normalized v6e projection (v5e 819 GB/s vs v6e 1640 GB/s) is
+reported in ``detail`` only.
 
-    traffic(model) = param_bytes + batch * avg_ctx * kv_bytes_per_token
-    equiv_7b_tok_s = measured_tok_s * traffic(ours) / traffic(llama2_7b)
-
-vs_baseline additionally normalizes the chip generations by HBM bandwidth
-(v5e 819 GB/s vs v6e 1640 GB/s) so the number approximates "how this stack
-would compare on the anchor's hardware":
-
-    vs_baseline = (equiv_7b_tok_s * BW_v6e / BW_chip) / 268.5
+If the 7B path fails (e.g. no TPU, HBM regression), the bench falls back
+to the previous rounds' 1B-measured + traffic-modeled estimate, clearly
+labeled via ``detail.mode``.
 """
 from __future__ import annotations
 
 import json
+import os
+import sys
 import time
 
 BASELINE_TOK_S_PER_CHIP = 2147.98 / 8          # JetStream Llama-2-7B, v6e-8
@@ -38,19 +39,9 @@ def main() -> None:
     import jax
 
     from skypilot_tpu.accelerators import TPU_GENERATIONS
-    from skypilot_tpu.inference.engine import InferenceEngine
-    from skypilot_tpu.models import configs
 
     backend = jax.default_backend()
     on_tpu = backend == 'tpu'
-    if on_tpu:
-        cfg = configs.LLAMA3_1B
-        batch, prompt_len, gen_len, max_seq = 32, 128, 128, 512
-        n_requests = 2 * batch
-    else:  # CPU fallback so the bench always emits a line
-        cfg = configs.TINY
-        batch, prompt_len, gen_len, max_seq = 4, 16, 16, 64
-        n_requests = 8
 
     # Identify the chip generation for bandwidth/FLOPs normalization.
     dev_kind = jax.devices()[0].device_kind.lower()
@@ -62,6 +53,142 @@ def main() -> None:
             chip_bw = gen.hbm_bw_gbps
             chip_peak_tflops = gen.peak_bf16_tflops
     n_chips = max(1, len(jax.devices()))
+
+    result = None
+    if on_tpu:
+        try:
+            result = _bench_7b_serving(chip_bw, n_chips)
+        except Exception as e:  # pylint: disable=broad-except
+            print(f'7B bench failed ({type(e).__name__}: {e}); '
+                  'falling back to 1B-modeled path', file=sys.stderr)
+    if result is None:
+        result = _bench_1b_modeled(on_tpu, chip_bw, n_chips)
+
+    result['detail'].update({
+        'backend': backend,
+        'device_kind': jax.devices()[0].device_kind,
+        'flash_kernel': _flash_kernel_check(on_tpu),
+        'train': _train_step_bench(on_tpu, n_chips, chip_peak_tflops),
+    })
+    print(json.dumps(result))
+
+
+def _bench_7b_serving(chip_bw: float, n_chips: int) -> dict:
+    """RAW Llama-2-7B-config serving measurement on the local chip:
+    materialize the checkpoint (cached), load via the HF import path with
+    host-side int8 quantization, run e2e + steady-state decode. Request
+    shape mirrors the anchor workload (avg ~220 in / ~190 out,
+    ``examples/tpu/v6e/README.md:119-125``)."""
+    from skypilot_tpu.inference.engine import InferenceEngine
+    from skypilot_tpu.models import configs, synth
+
+    ckpt = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        '.bench_cache', 'llama2-7b-synth')
+    t0 = time.time()
+    synth.write_synthetic_hf_checkpoint(ckpt, configs.LLAMA2_7B)
+    t_synth = time.time() - t0
+    t0 = time.time()
+    eng = InferenceEngine.from_pretrained(ckpt, quantize='int8',
+                                          max_batch=32, max_seq=512)
+    t_load = time.time() - t0
+    cfg = eng.cfg
+    batch, prompt_len, gen_len = 32, 220, 190
+    prompt = list(range(1, prompt_len + 1))
+    horizon = 64
+
+    # Warmup at measurement shapes (compile prefill bucket + decode).
+    for _ in range(batch):
+        eng.add_request(prompt, max_new_tokens=gen_len)
+    eng.run_to_completion(horizon=horizon)
+
+    # (1) End-to-end: prefill + decode + scheduling, 2 waves.
+    ids = {eng.add_request(prompt, max_new_tokens=gen_len)
+           for _ in range(2 * batch)}
+    t0 = time.time()
+    done = eng.run_to_completion(horizon=horizon)
+    dt = time.time() - t0
+    finished = [r for rid, r in done.items() if rid in ids]
+    out_tokens = sum(len(r.output) for r in finished)
+    tok_s_chip = out_tokens / dt / n_chips
+    ttfts = sorted(r.ttft_ms for r in finished if r.ttft_ms is not None)
+    ttft_median = ttfts[len(ttfts) // 2] if ttfts else None
+
+    # (2) Steady-state decode window (all slots active, fused horizons).
+    def steady():
+        for _ in range(batch):
+            eng.add_request(prompt, max_new_tokens=gen_len)
+        eng.step(horizon=1)
+        tokens = 0
+        t0 = time.time()
+        for _ in range(3):
+            tokens += len(eng.step(horizon=horizon))
+        window = time.time() - t0
+        eng.run_to_completion(horizon=horizon)
+        return tokens / window
+
+    steady()                                 # hit every kv bucket once
+    decode_tok_s = steady() / n_chips
+
+    # Isolated TTFT: one request on an idle engine (the e2e median above
+    # includes queue wait under the 2x-batch burst, which is an arrival-
+    # rate artifact, not engine latency). First call compiles the n=1
+    # prefill program; the second measures.
+    for _ in range(2):
+        t0 = time.time()
+        rid = eng.add_request(prompt, max_new_tokens=2)
+        eng.step(horizon=1)
+        ttft_isolated = (time.time() - t0) * 1e3
+        eng.run_to_completion(horizon=4)
+
+    # int8 roofline: weight + scale stream + live KV (int8 + scales).
+    param_bytes = eng._param_bytes
+    avg_ctx = prompt_len + gen_len / 2
+    live_kv = (batch * avg_ctx * cfg.n_layers * 2 * cfg.n_kv_heads *
+               (cfg.head_dim * 1.0 + 4.0))
+    roofline_tok_s = chip_bw * 1e9 / (param_bytes + live_kv) * batch
+    vs_baseline = tok_s_chip / BASELINE_TOK_S_PER_CHIP
+    return {
+        'metric': 'llama2_7b_int8_out_tok_s_per_chip',
+        'value': round(tok_s_chip, 2),
+        'unit': 'tokens/s/chip',
+        'vs_baseline': round(vs_baseline, 3),
+        'detail': {
+            'mode': 'raw-7b-config',
+            'model': cfg.name,
+            'quantize': 'int8',
+            'num_params': cfg.num_params,
+            'decode_tok_s_per_chip': round(decode_tok_s, 2),
+            'decode_roofline_frac': round(decode_tok_s / roofline_tok_s,
+                                          3),
+            'ttft_ms_median_burst': (round(ttft_median, 1)
+                                     if ttft_median else None),
+            'ttft_ms_isolated': round(ttft_isolated, 1),
+            'batch': batch,
+            'prompt_len': prompt_len,
+            'gen_len': gen_len,
+            'wall_s': round(dt, 2),
+            'ckpt_synth_s': round(t_synth, 1),
+            'ckpt_load_s': round(t_load, 1),
+            # projection of this rate onto the anchor's v6e bandwidth
+            'vs_baseline_v6e_bw_normalized': round(
+                (tok_s_chip * V6E_HBM_BW / chip_bw)
+                / BASELINE_TOK_S_PER_CHIP, 3),
+        },
+    }
+
+
+def _bench_1b_modeled(on_tpu: bool, chip_bw: float, n_chips: int) -> dict:
+    from skypilot_tpu.inference.engine import InferenceEngine
+    from skypilot_tpu.models import configs
+
+    if on_tpu:
+        cfg = configs.LLAMA3_1B
+        batch, prompt_len, gen_len, max_seq = 32, 128, 128, 512
+        n_requests = 2 * batch
+    else:  # CPU fallback so the bench always emits a line
+        cfg = configs.TINY
+        batch, prompt_len, gen_len, max_seq = 4, 16, 16, 64
+        n_requests = 8
 
     eng = InferenceEngine(cfg, max_batch=batch, max_seq=max_seq)
     prompt = list(range(1, prompt_len + 1))
@@ -104,18 +231,6 @@ def main() -> None:
     steady_decode_window()                  # compile every kv bucket hit
     decode_tok_s = steady_decode_window() / n_chips
 
-    # Weight-only int8 variant of the same steady window (halves the
-    # weight stream; KV/activations stay bf16).
-    int8_tok_s = None
-    if on_tpu:
-        del eng
-        eng = InferenceEngine(cfg, max_batch=batch, max_seq=max_seq,
-                              quantize='int8')
-        for _ in range(batch):
-            eng.add_request(prompt, max_new_tokens=gen_len)
-        eng.run_to_completion(horizon=horizon)
-        steady_decode_window()
-        int8_tok_s = steady_decode_window() / n_chips
     param_bytes = 2.0 * cfg.num_params
     live_kv = (batch * (prompt_len + gen_len / 2) * cfg.n_layers * 2 *
                cfg.n_kv_heads * cfg.head_dim * 2.0)
@@ -130,31 +245,23 @@ def main() -> None:
     vs_baseline = (equiv_7b * V6E_HBM_BW / chip_bw) / BASELINE_TOK_S_PER_CHIP
 
     del eng
-    flash_detail = _flash_kernel_check(on_tpu)
-    train_detail = _train_step_bench(on_tpu, n_chips, chip_peak_tflops)
-
-    print(json.dumps({
+    return {
         'metric': 'decode_tok_s_per_chip_llama2_7b_equiv',
         'value': round(equiv_7b, 2),
         'unit': 'tokens/s/chip',
         'vs_baseline': round(vs_baseline, 3),
         'detail': {
-            'backend': backend,
-            'device_kind': jax.devices()[0].device_kind,
+            'mode': 'modeled-1b-fallback',
             'model': cfg.name,
             'raw_tok_s_per_chip': round(tok_s_chip, 2),
             'decode_tok_s_per_chip': round(decode_tok_s, 2),
             'decode_roofline_frac': round(roofline_frac, 3),
-            'decode_tok_s_per_chip_int8': (round(int8_tok_s, 2)
-                                           if int8_tok_s else None),
             'batch': batch,
             'prompt_len': prompt_len,
             'gen_len': gen_len,
             'wall_s': round(dt, 2),
-            'flash_kernel': flash_detail,
-            'train': train_detail,
         },
-    }))
+    }
 
 
 def _flash_kernel_check(on_tpu: bool) -> dict:

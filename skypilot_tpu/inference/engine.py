@@ -90,7 +90,12 @@ class InferenceEngine:
         if quantize is not None and quantize != 'int8':
             raise ValueError(f'unknown quantize mode {quantize!r}; '
                              "supported: 'int8'")
-        if mesh is not None:
+        prequantized = quantization.is_quantized(params)
+        if prequantized:
+            # e.g. host-side quantization during checkpoint load
+            # (weights.load_checkpoint(quantize='int8')).
+            quantize = 'int8'
+        if mesh is not None and not prequantized:
             # Shard the bf16 tree FIRST so a 7B-class checkpoint never
             # has to fit (bf16 + int8) on one chip; quantization then
             # runs shard-parallel (the absmax over a sharded contracting
@@ -98,20 +103,20 @@ class InferenceEngine:
             bf16_sh = mesh_lib.tree_shardings(
                 llama.param_logical_axes(cfg), mesh, shapes=params)
             params = jax.device_put(params, bf16_sh)
-        if quantize == 'int8':
+        if quantize == 'int8' and not prequantized:
             # int8 weights AND int8 KV cache: the two biggest decode
             # HBM streams each halve. ``donate_params`` frees each bf16
             # buffer as its int8 replacement lands (see quantize_params).
             params = quantization.quantize_params(params,
                                                   donate=donate_params)
-            if mesh is not None:
-                # Canonicalize: int8 codes shard like their bf16
-                # parents; per-channel scales follow the output axes and
-                # replicate over the contracted (unit) dims.
-                qaxes = quantization.quantize_logical_axes(
-                    llama.param_logical_axes(cfg))
-                params = jax.device_put(params, mesh_lib.tree_shardings(
-                    qaxes, mesh, shapes=params))
+        if mesh is not None and quantize == 'int8':
+            # Canonicalize: int8 codes shard like their bf16 parents;
+            # per-channel scales follow the output axes and replicate
+            # over the contracted (unit) dims.
+            qaxes = quantization.quantize_logical_axes(
+                llama.param_logical_axes(cfg))
+            params = jax.device_put(params, mesh_lib.tree_shardings(
+                qaxes, mesh, shapes=params))
         self.params = params
         # Actual stored parameter bytes (int8 leaves count 1B/elem) —
         # sizes the decode-horizon ring cap against the true weight
@@ -149,10 +154,14 @@ class InferenceEngine:
         cache)."""
         import jax.numpy as jnp
         from skypilot_tpu.models import weights
+        # Quantize host-side during load: only int8 codes + scales ever
+        # reach the device (a 7B bf16 tree would not leave room on a
+        # 16 GB chip for the quantization pass).
         cfg, params = weights.load_checkpoint(
-            path, dtype=dtype if dtype is not None else jnp.bfloat16)
+            path, dtype=dtype if dtype is not None else jnp.bfloat16,
+            quantize=kwargs.get('quantize'))
         # The freshly loaded tree has no other owner: let quantization
-        # free bf16 buffers in place (7B bf16 + int8 won't coexist).
+        # free bf16 buffers in place if it ever runs on-device.
         kwargs.setdefault('donate_params', True)
         return cls(cfg, params, **kwargs)
 

@@ -79,6 +79,17 @@ _REDUCE_AXES = {
     'moe_down': (2,),    # [L, E, f, d]    contract f
     'unembed': (0,),     # [d, V]          contract d
 }
+# Public alias: the host-side loader (weights._host_quantize) quantizes
+# against the same per-leaf contracting axes.
+REDUCE_AXES = _REDUCE_AXES
+
+
+def is_quantized(params: Params) -> bool:
+    """True if the pytree already carries QuantizedWeight leaves (e.g.
+    loaded via ``weights.load_checkpoint(..., quantize='int8')``)."""
+    leaves = jax.tree.leaves(
+        params, is_leaf=lambda x: isinstance(x, QuantizedWeight))
+    return any(isinstance(l, QuantizedWeight) for l in leaves)
 
 
 def _map_quant_leaves(tree: Params, leaf_fn) -> Params:

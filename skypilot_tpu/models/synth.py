@@ -1,0 +1,103 @@
+"""Synthetic HF checkpoints at real-model scale.
+
+The reference's headline serving numbers come from a *real* Llama-2-7B
+checkpoint (``examples/tpu/v6e/README.md:119-125``). This environment has
+zero egress, so real weights cannot be downloaded — but the perf
+measurement only depends on the *config* (layer count, dims, dtype):
+decode is HBM-bound on the weight/KV streams and the MXU doesn't care
+what the bytes are. This module materializes an HF-format checkpoint
+directory (``config.json`` + ``model.safetensors``) for any preset config
+with fan-in-scaled random weights, so the full import path
+(``weights.load_checkpoint`` → engine) and the benchmark run exactly as
+they would on the real model.
+
+To keep generation fast at 7B scale (~13 GB), one random block of
+per-layer tensors is generated and reused for every layer — identical
+layers are indistinguishable to the memory system and the MXU, which is
+what the benchmark measures. ``unique_layers=True`` generates fresh
+randomness per layer for numerical studies.
+"""
+from __future__ import annotations
+
+import json
+import os
+from typing import Dict, Optional
+
+import numpy as np
+
+from skypilot_tpu.models.configs import ModelConfig
+
+
+def write_synthetic_hf_checkpoint(path: str, cfg: ModelConfig, *,
+                                  seed: int = 0,
+                                  unique_layers: bool = False,
+                                  dtype=np.float16) -> str:
+    """Write an HF checkpoint dir for ``cfg`` with synthetic weights.
+
+    Idempotent: returns immediately if ``path`` already holds a complete
+    checkpoint for the same config. Weights are fan-in-scaled normals
+    (std = 1/sqrt(fan_in)) so forwards stay numerically sane through
+    deep stacks.
+    """
+    from safetensors.numpy import save_file
+    marker = os.path.join(path, '.synth_complete.json')
+    request = {'name': cfg.name, 'seed': seed,
+               'unique_layers': unique_layers}
+    if os.path.exists(marker):
+        with open(marker, encoding='utf-8') as f:
+            if json.load(f) == request:
+                return path
+    if cfg.is_moe:
+        raise NotImplementedError('synthetic MoE checkpoints not needed '
+                                  'yet; dense families only')
+    os.makedirs(path, exist_ok=True)
+    rng = np.random.default_rng(seed)
+    d, f, hd = cfg.dim, cfg.ffn_dim, cfg.head_dim
+    nh, nkv = cfg.n_heads, cfg.n_kv_heads
+
+    def w(out_dim: int, in_dim: int) -> np.ndarray:
+        a = rng.standard_normal((out_dim, in_dim), dtype=np.float32)
+        return (a * (in_dim ** -0.5)).astype(dtype)
+
+    def layer_block() -> Dict[str, np.ndarray]:
+        blk = {
+            'self_attn.q_proj.weight': w(nh * hd, d),
+            'self_attn.k_proj.weight': w(nkv * hd, d),
+            'self_attn.v_proj.weight': w(nkv * hd, d),
+            'self_attn.o_proj.weight': w(d, nh * hd),
+            'mlp.gate_proj.weight': w(f, d),
+            'mlp.up_proj.weight': w(f, d),
+            'mlp.down_proj.weight': w(d, f),
+            'input_layernorm.weight': np.ones(d, np.float32),
+            'post_attention_layernorm.weight': np.ones(d, np.float32),
+        }
+        if cfg.qkv_bias:
+            blk.update({
+                'self_attn.q_proj.bias': np.zeros(nh * hd, np.float32),
+                'self_attn.k_proj.bias': np.zeros(nkv * hd, np.float32),
+                'self_attn.v_proj.bias': np.zeros(nkv * hd, np.float32),
+            })
+        return blk
+
+    tensors: Dict[str, np.ndarray] = {
+        'model.embed_tokens.weight': w(cfg.vocab_size, d),
+        'model.norm.weight': np.ones(d, np.float32),
+    }
+    if not cfg.tie_embeddings:
+        tensors['lm_head.weight'] = w(cfg.vocab_size, d)
+    shared: Optional[Dict[str, np.ndarray]] = None
+    for i in range(cfg.n_layers):
+        if unique_layers or shared is None:
+            shared = layer_block()
+        for suffix, arr in shared.items():
+            tensors[f'model.layers.{i}.{suffix}'] = arr
+    save_file(tensors, os.path.join(path, 'model.safetensors'))
+
+    from skypilot_tpu.models.weights import hf_config_dict
+    with open(os.path.join(path, 'config.json'), 'w',
+              encoding='utf-8') as fp:
+        json.dump(hf_config_dict(cfg, torch_dtype='float16'), fp,
+                  indent=2)
+    with open(marker, 'w', encoding='utf-8') as fp:
+        json.dump(request, fp)
+    return path

@@ -1,0 +1,113 @@
+"""Text -> tokenize -> pack pipeline feeding ``Trainer.fit``.
+
+The reference trains through recipes that lean on external data stacks
+(HF datasets in ``llm/llama-3_1-finetuning/lora.yaml``); our trainer is
+in-tree, so the corpus pipeline is too. Design constraints are TPU-shaped:
+
+- **Static shapes**: every batch is exactly ``[batch, seq]`` int32 —
+  documents are concatenated (EOS-separated) into one token stream and
+  sliced, never padded, so XLA compiles one train step.
+- **Determinism == resumability**: batch contents are a pure function of
+  ``(step, dp_rank)``. Resuming from a checkpoint at step N just means
+  restarting the iterator at ``start_step=N`` — no iterator state to
+  snapshot, no skew between data position and optimizer step.
+- **dp sharding**: each rank reads only its stride of the stream
+  (``dp_rank``/``dp_size``), so multi-host training feeds disjoint data
+  with no coordination.
+
+Corpus sources: local text files, directories (``*.txt`` sorted), or
+``gs://`` URIs (downloaded via ``data.cloud_stores``).
+"""
+from __future__ import annotations
+
+import glob
+import os
+from typing import Dict, Iterator, List, Optional, Sequence
+
+import numpy as np
+
+from skypilot_tpu.models.tokenizer import BaseTokenizer, load_tokenizer
+
+
+def _resolve_sources(source: str) -> List[str]:
+    if source.startswith(('gs://', 's3://', 'r2://')):
+        import subprocess
+        import tempfile
+
+        from skypilot_tpu.data import cloud_stores
+        dest = tempfile.mkdtemp(prefix='skytpu-corpus-')
+        local = os.path.join(dest,
+                             os.path.basename(source.rstrip('/'))
+                             or 'corpus.txt')
+        subprocess.run(cloud_stores.make_download_command(source, local),
+                       shell=True, check=True)
+        return [local]
+    if os.path.isdir(source):
+        files = sorted(glob.glob(os.path.join(source, '*.txt')))
+        if not files:
+            raise FileNotFoundError(f'no *.txt files under {source}')
+        return files
+    matched = sorted(glob.glob(source))
+    if not matched:
+        raise FileNotFoundError(f'corpus source {source!r} matched nothing')
+    return matched
+
+
+class TokenStream:
+    """A corpus tokenized once into a single int32 stream (EOS-joined
+    documents), held in host memory. For corpora past host RAM, shard
+    files across dp ranks instead (``_resolve_sources`` per rank)."""
+
+    def __init__(self, source: str,
+                 tokenizer: Optional[BaseTokenizer] = None,
+                 *, vocab_size: int = 258):
+        self.tokenizer = tokenizer or load_tokenizer(
+            None, model_vocab_size=vocab_size)
+        pieces = []
+        eos = self.tokenizer.eos_id
+        for path in _resolve_sources(source):
+            with open(path, encoding='utf-8', errors='replace') as f:
+                ids = self.tokenizer.encode(f.read())
+            if eos is not None:
+                ids = ids + [eos]
+            pieces.append(np.asarray(ids, np.int32))
+        self.tokens = np.concatenate(pieces)
+        if len(self.tokens) < 2:
+            raise ValueError(f'corpus {source!r} tokenized to '
+                             f'{len(self.tokens)} tokens; need >= 2')
+
+    def __len__(self) -> int:
+        return len(self.tokens)
+
+
+def packed_batches(stream: TokenStream, *, batch: int, seq: int,
+                   dp_rank: int = 0, dp_size: int = 1,
+                   start_step: int = 0,
+                   global_batch: Optional[int] = None
+                   ) -> Iterator[Dict[str, np.ndarray]]:
+    """Infinite iterator of ``{'inputs','targets'}`` [batch, seq] int32.
+
+    ``batch`` is the PER-RANK batch; ``global_batch`` (default
+    batch*dp_size) positions each rank's slice inside the global step so
+    ranks read disjoint stream windows. Row ``i`` of rank ``r`` at step
+    ``t`` starts at token ``((t*G + r*batch + i) * seq) % (N - seq - 1)``
+    — a pure function of (t, r), which is what makes mid-epoch resume
+    exact: restart with ``start_step`` = the restored optimizer step.
+    """
+    if dp_rank >= dp_size:
+        raise ValueError(f'dp_rank {dp_rank} >= dp_size {dp_size}')
+    G = global_batch if global_batch is not None else batch * dp_size
+    toks = stream.tokens
+    n = len(toks)
+    if n < seq + 2:
+        raise ValueError(f'corpus has {n} tokens; need >= seq+2 '
+                         f'({seq + 2}) for one window')
+    span = n - seq - 1
+    step = start_step
+    while True:
+        rows = np.empty((batch, seq + 1), np.int32)
+        for i in range(batch):
+            off = ((step * G + dp_rank * batch + i) * seq) % span
+            rows[i] = toks[off:off + seq + 1]
+        yield {'inputs': rows[:, :-1], 'targets': rows[:, 1:]}
+        step += 1

@@ -51,9 +51,12 @@ class TrainConfig:
 
 
 def make_optimizer(tc: TrainConfig) -> optax.GradientTransformation:
+    # Clamp warmup below the step budget: optax requires positive decay
+    # span (a short --steps run with the default warmup would crash).
+    warmup = min(tc.warmup_steps, max(tc.total_steps - 1, 0))
     schedule = optax.warmup_cosine_decay_schedule(
         init_value=0.0, peak_value=tc.learning_rate,
-        warmup_steps=tc.warmup_steps, decay_steps=tc.total_steps,
+        warmup_steps=warmup, decay_steps=tc.total_steps,
         end_value=tc.learning_rate * 0.1)
     return optax.chain(
         optax.clip_by_global_norm(tc.grad_clip_norm),
