@@ -1,0 +1,33 @@
+# Runtime image for skypilot-tpu workloads (reference ships Dockerfile /
+# Dockerfile_k8s; this is the TPU-flavored equivalent).
+#
+#   docker build -t skypilot-tpu:latest .
+#
+# Used by:
+# - the `docker:` runtime on provisioned TPU VMs (tasks run inside it)
+# - as a base for Dockerfile_k8s (pods on GKE TPU node pools)
+#
+# jax[tpu] pulls libtpu from the Google releases index; on GKE TPU node
+# pools libtpu is injected by the device plugin and the wheel's copy is
+# ignored.
+FROM python:3.11-slim
+
+RUN apt-get update && apt-get install -y --no-install-recommends \
+        openssh-client rsync git curl ca-certificates \
+    && rm -rf /var/lib/apt/lists/*
+
+RUN pip install --no-cache-dir \
+        "jax[tpu]" -f https://storage.googleapis.com/jax-releases/libtpu_releases.html \
+        flax optax orbax-checkpoint einops safetensors
+
+WORKDIR /skypilot-tpu
+COPY pyproject.toml ./
+COPY skypilot_tpu ./skypilot_tpu
+RUN pip install --no-cache-dir -e .
+
+# Agent state/log locations (the provisioner's instance_setup writes
+# here; keeping them in the image makes `docker run` usable standalone).
+RUN mkdir -p /root/.skytpu /root/sky_logs
+
+ENTRYPOINT []
+CMD ["/bin/bash"]

@@ -28,6 +28,9 @@ class StoreType(enum.Enum):
     GCS = 'GCS'
     S3 = 'S3'
     R2 = 'R2'
+    AZURE = 'AZURE'
+    IBM = 'IBM'
+    OCI = 'OCI'
     LOCAL = 'LOCAL'
 
     @classmethod
@@ -42,9 +45,12 @@ class StoreType(enum.Enum):
     @classmethod
     def from_uri(cls, uri: str) -> 'StoreType':
         scheme = uri.split('://', 1)[0].lower()
+        if scheme == 'https' and '.blob.core.windows.net' in uri:
+            return cls.AZURE
         try:
             return {'gs': cls.GCS, 's3': cls.S3, 'r2': cls.R2,
-                    'file': cls.LOCAL}[scheme]
+                    'azure': cls.AZURE, 'cos': cls.IBM,
+                    'oci': cls.OCI, 'file': cls.LOCAL}[scheme]
         except KeyError:
             raise exceptions.StorageSpecError(
                 f'Unknown bucket URI scheme {uri!r}') from None
@@ -315,10 +321,245 @@ class LocalStore(AbstractStore):
                 f'ln -s {q(bucket)} {q_mp})')
 
 
+class _CliGatedStore(AbstractStore):
+    """Base for stores whose backing CLI/SDK may be absent in this
+    environment (reference impls: ``sky/data/storage.py:2232`` Azure,
+    ``:3517`` IBM COS, ``:3971`` OCI). All command GENERATION works
+    without the CLI (remote clusters run the commands); operations the
+    CLIENT must run locally (bucket create/upload/delete) check for the
+    CLI and fail with an actionable install message."""
+
+    cli: str = ''
+    install_hint: str = ''
+
+    def _require_cli(self, op: str) -> None:
+        if shutil.which(self.cli) is None:
+            raise exceptions.StorageError(
+                f'{type(self).__name__}.{op} needs the {self.cli!r} CLI '
+                f'which is not installed. {self.install_hint}')
+
+
+class AzureBlobStore(_CliGatedStore):
+    """Azure Blob via az CLI + blobfuse2 (reference ``AzureBlobStore``
+    ``sky/data/storage.py:2232``). Name: 'account/container[/path]'."""
+
+    store_type = StoreType.AZURE
+    cli = 'az'
+    install_hint = 'pip install azure-cli'
+
+    def __init__(self, name: str, source: Optional[str] = None):
+        name = self._normalize(name)
+        super().__init__(name, source)
+        if '/' not in name:
+            raise exceptions.StorageSpecError(
+                'Azure store name must be "account/container[/path]", '
+                f'got {name!r}')
+        self.account, rest = name.split('/', 1)
+        parts = rest.split('/', 1)
+        self.container = parts[0]
+        self.path = parts[1] if len(parts) > 1 else ''
+
+    @staticmethod
+    def _normalize(name: str) -> str:
+        """Accept the https URL and azure:// forms ``from_uri`` routes
+        here and reduce them to 'account/container[/path]'."""
+        if name.startswith('azure://'):
+            name = name[len('azure://'):]
+        if '.blob.core.windows.net' in name:
+            name = name.split('://', 1)[-1]
+            host, _, rest = name.partition('/')
+            account = host.split('.blob.core.windows.net')[0]
+            name = f'{account}/{rest}' if rest else account
+        return name
+
+    def uri(self) -> str:
+        rest = self.name.split('/', 1)[1]
+        return (f'https://{self.account}.blob.core.windows.net/{rest}')
+
+    def ensure_bucket(self) -> None:
+        self._require_cli('ensure_bucket')
+        proc = subprocess.run(
+            ['az', 'storage', 'container', 'create', '--name',
+             self.container, '--account-name', self.account],
+            capture_output=True, text=True, check=False)
+        if proc.returncode != 0:
+            raise exceptions.StorageBucketCreateError(
+                f'az container create failed: {proc.stderr[-500:]}')
+
+    def upload(self) -> None:
+        if not self.source:
+            return
+        self._require_cli('upload')
+        cmd = ['az', 'storage', 'blob', 'upload-batch', '--destination',
+               self.container, '--account-name', self.account,
+               '--source', os.path.expanduser(self.source)]
+        if self.path:
+            # sub-path prefix keeps multiple stores in one container
+            # disjoint (job workdirs collide at the container root).
+            cmd += ['--destination-path', self.path]
+        proc = subprocess.run(cmd, capture_output=True, text=True,
+                              check=False)
+        if proc.returncode != 0:
+            raise exceptions.StorageUploadError(
+                f'az blob upload-batch failed: {proc.stderr[-500:]}')
+
+    def delete_bucket(self) -> None:
+        self._require_cli('delete_bucket')
+        subprocess.run(['az', 'storage', 'container', 'delete', '--name',
+                        self.container, '--account-name', self.account],
+                       capture_output=True, check=False)
+
+    def make_download_command(self, dst: str) -> str:
+        from skypilot_tpu.data.cloud_stores import _q
+        q_dst = _q(dst)
+        cmd = (f'mkdir -p {q_dst} && az storage blob download-batch '
+               f'--destination {q_dst} --source '
+               f'{shlex.quote(self.container)} --account-name '
+               f'{shlex.quote(self.account)}')
+        if self.path:
+            cmd += f' --pattern {shlex.quote(self.path + "/*")}'
+        return cmd
+
+    def make_mount_command(self, mount_path: str) -> str:
+        from skypilot_tpu.data.cloud_stores import _q
+        q_mp = _q(mount_path)
+        install = ('which blobfuse2 >/dev/null 2>&1 || '
+                   'sudo apt-get install -y blobfuse2')
+        mount = (f'mkdir -p {q_mp} && mountpoint -q {q_mp} || '
+                 f'AZURE_STORAGE_ACCOUNT={shlex.quote(self.account)} '
+                 f'blobfuse2 mount {q_mp} --container-name '
+                 f'{shlex.quote(self.container)}')
+        return f'{install} && {mount}'
+
+
+class IbmCosStore(_CliGatedStore):
+    """IBM Cloud Object Storage via rclone (reference ``IBMCosStore``
+    ``sky/data/storage.py:3517``, which also mounts via rclone).
+    Requires an ``[ibmcos]`` rclone remote configured on the host."""
+
+    store_type = StoreType.IBM
+    cli = 'rclone'
+    install_hint = 'curl https://rclone.org/install.sh | sudo bash'
+
+    def uri(self) -> str:
+        return f'cos://{self.name}'
+
+    def _remote(self) -> str:
+        return f'ibmcos:{self.name}'
+
+    def ensure_bucket(self) -> None:
+        self._require_cli('ensure_bucket')
+        proc = subprocess.run(['rclone', 'mkdir', self._remote()],
+                              capture_output=True, text=True, check=False)
+        if proc.returncode != 0:
+            raise exceptions.StorageBucketCreateError(
+                f'rclone mkdir {self._remote()} failed: '
+                f'{proc.stderr[-500:]}')
+
+    def upload(self) -> None:
+        if not self.source:
+            return
+        self._require_cli('upload')
+        proc = subprocess.run(
+            ['rclone', 'sync', os.path.expanduser(self.source),
+             self._remote()],
+            capture_output=True, text=True, check=False)
+        if proc.returncode != 0:
+            raise exceptions.StorageUploadError(
+                f'rclone sync to {self._remote()} failed: '
+                f'{proc.stderr[-500:]}')
+
+    def delete_bucket(self) -> None:
+        self._require_cli('delete_bucket')
+        subprocess.run(['rclone', 'purge', self._remote()],
+                       capture_output=True, check=False)
+
+    def make_download_command(self, dst: str) -> str:
+        from skypilot_tpu.data.cloud_stores import _q
+        q_dst = _q(dst)
+        return (f'mkdir -p {q_dst} && rclone sync '
+                f'{shlex.quote(self._remote())} {q_dst}')
+
+    def make_mount_command(self, mount_path: str) -> str:
+        from skypilot_tpu.data.cloud_stores import _q
+        q_mp = _q(mount_path)
+        return (f'mkdir -p {q_mp} && mountpoint -q {q_mp} || '
+                f'rclone mount {shlex.quote(self._remote())} {q_mp} '
+                f'--daemon --vfs-cache-mode writes')
+
+
+class OciStore(_CliGatedStore):
+    """OCI Object Storage via the oci CLI (reference ``OciStore``
+    ``sky/data/storage.py:3971``); mounts via rclone's oci backend."""
+
+    store_type = StoreType.OCI
+    cli = 'oci'
+    install_hint = 'pip install oci-cli'
+
+    def __init__(self, name: str, source: Optional[str] = None):
+        super().__init__(name, source)
+        parts = name.split('/', 1)
+        self.bucket = parts[0]
+        self.path = parts[1] if len(parts) > 1 else ''
+
+    def uri(self) -> str:
+        return f'oci://{self.name}'
+
+    def ensure_bucket(self) -> None:
+        self._require_cli('ensure_bucket')
+        proc = subprocess.run(
+            ['oci', 'os', 'bucket', 'create', '--name', self.bucket],
+            capture_output=True, text=True, check=False)
+        if proc.returncode != 0:
+            raise exceptions.StorageBucketCreateError(
+                f'oci bucket create failed: {proc.stderr[-500:]}')
+
+    def upload(self) -> None:
+        if not self.source:
+            return
+        self._require_cli('upload')
+        cmd = ['oci', 'os', 'object', 'bulk-upload', '--bucket-name',
+               self.bucket, '--src-dir',
+               os.path.expanduser(self.source), '--overwrite']
+        if self.path:
+            cmd += ['--object-prefix', self.path + '/']
+        proc = subprocess.run(cmd, capture_output=True, text=True,
+                              check=False)
+        if proc.returncode != 0:
+            raise exceptions.StorageUploadError(
+                f'oci bulk-upload failed: {proc.stderr[-500:]}')
+
+    def delete_bucket(self) -> None:
+        self._require_cli('delete_bucket')
+        subprocess.run(['oci', 'os', 'bucket', 'delete', '--name',
+                        self.bucket, '--force'],
+                       capture_output=True, check=False)
+
+    def make_download_command(self, dst: str) -> str:
+        from skypilot_tpu.data.cloud_stores import _q
+        q_dst = _q(dst)
+        cmd = (f'mkdir -p {q_dst} && oci os object bulk-download '
+               f'--bucket-name {shlex.quote(self.bucket)} '
+               f'--download-dir {q_dst}')
+        if self.path:
+            cmd += f' --prefix {shlex.quote(self.path + "/")}'
+        return cmd
+
+    def make_mount_command(self, mount_path: str) -> str:
+        from skypilot_tpu.data.cloud_stores import _q
+        q_mp = _q(mount_path)
+        return (f'mkdir -p {q_mp} && mountpoint -q {q_mp} || '
+                f'rclone mount oci:{shlex.quote(self.name)} {q_mp} '
+                f'--daemon --vfs-cache-mode writes')
+
+
 _STORE_CLASSES = {
     StoreType.GCS: GcsStore,
     StoreType.S3: S3Store,
     StoreType.R2: R2Store,
+    StoreType.AZURE: AzureBlobStore,
+    StoreType.IBM: IbmCosStore,
+    StoreType.OCI: OciStore,
     StoreType.LOCAL: LocalStore,
 }
 

@@ -24,9 +24,9 @@ decode stops at capacity.
 """
 from __future__ import annotations
 
+import collections
 import dataclasses
 import functools
-import queue
 import time
 from typing import Any, Dict, List, Optional, Tuple
 
@@ -67,7 +67,164 @@ def _bucket_len(n: int, minimum: int = 64) -> int:
     return b
 
 
-class InferenceEngine:
+def prepare_params(cfg: ModelConfig, params, *, quantize=None, mesh=None,
+                   donate_params: bool = False):
+    """Shared param preparation for the slot and paged engines:
+    init-if-absent, optional int8 quantization, mesh sharding.
+    Returns (params, effective_quantize).
+
+    Ordering matters for HBM: on a mesh the bf16 tree is sharded FIRST
+    so a 7B-class checkpoint never has to fit (bf16 + int8) on one chip;
+    single-device quantization frees each bf16 leaf as its int8
+    replacement lands when ``donate_params``."""
+    from skypilot_tpu.models import quantization
+    if params is None:
+        params = llama.init_params(jax.random.PRNGKey(0), cfg)
+    if quantize is not None and quantize != 'int8':
+        raise ValueError(f'unknown quantize mode {quantize!r}; '
+                         "supported: 'int8'")
+    prequantized = quantization.is_quantized(params)
+    if prequantized:
+        # e.g. host-side quantization during checkpoint load
+        # (weights.load_checkpoint(quantize='int8')).
+        quantize = 'int8'
+    if mesh is not None and not prequantized:
+        bf16_sh = mesh_lib.tree_shardings(
+            llama.param_logical_axes(cfg), mesh, shapes=params)
+        params = jax.device_put(params, bf16_sh)
+    if quantize == 'int8' and not prequantized:
+        # int8 weights AND int8 KV cache: the two biggest decode HBM
+        # streams each halve.
+        params = quantization.quantize_params(params, donate=donate_params)
+    if mesh is not None and quantize == 'int8':
+        # Canonicalize: int8 codes shard like their bf16 parents;
+        # per-channel scales follow the output axes and replicate over
+        # the contracted (unit) dims.
+        qaxes = quantization.quantize_logical_axes(
+            llama.param_logical_axes(cfg))
+        params = jax.device_put(params, mesh_lib.tree_shardings(
+            qaxes, mesh, shapes=params))
+    return params, quantize
+
+
+class _EngineBase:
+    """Host-side request lifecycle shared by the slot engine (below) and
+    the paged engine (``inference/paged.py``): queue, slot table,
+    finish/cancel bookkeeping, the step loop. Subclasses implement
+    ``_admit()`` and ``_decode(horizon)`` (the compiled paths) and may
+    override ``_free_slot``/``_validate_request``."""
+
+    def _init_slots(self, max_batch: int) -> None:
+        self._slots: List[Optional[Request]] = [None] * max_batch
+        # A deque, not queue.Queue: admission must be able to REQUEUE AT
+        # THE HEAD (capacity backoff) without starving the request
+        # behind later arrivals. Thread safety is the caller's job (the
+        # serve layer serializes all engine calls under one lock).
+        self._queue: 'collections.deque[Request]' = collections.deque()
+        self._next_id = 0
+        self._finished: Dict[int, Request] = {}
+        self._slot_len = np.zeros(max_batch, np.int64)
+        self._cur_token = np.zeros(max_batch, np.int32)
+
+    def _queue_pop(self) -> Optional[Request]:
+        try:
+            return self._queue.popleft()
+        except IndexError:
+            return None
+
+    def _requeue_front(self, reqs: List[Request]) -> None:
+        """Put not-yet-admitted requests back at the FRONT, preserving
+        their original order (FIFO fairness under backpressure)."""
+        self._queue.extendleft(reversed(reqs))
+
+    # ------------------------------------------------------------- API
+    def add_request(self, prompt: List[int], max_new_tokens: int = 128,
+                    temperature: float = 0.0, top_k: int = 0,
+                    eos_id: Optional[int] = None) -> int:
+        if not prompt:
+            raise ValueError('empty prompt')
+        self._validate_request(prompt, max_new_tokens)
+        req = Request(request_id=self._next_id, prompt=list(prompt),
+                      max_new_tokens=max_new_tokens, temperature=temperature,
+                      top_k=top_k, eos_id=eos_id, submit_time=time.time())
+        self._next_id += 1
+        self._queue.append(req)
+        return req.request_id
+
+    def _validate_request(self, prompt: List[int],
+                          max_new_tokens: int) -> None:
+        if len(prompt) + max_new_tokens > self.max_seq:
+            raise ValueError(
+                f'prompt ({len(prompt)}) + max_new_tokens '
+                f'({max_new_tokens}) exceeds engine max_seq '
+                f'({self.max_seq})')
+
+    def has_work(self) -> bool:
+        return (len(self._queue) > 0
+                or any(r is not None for r in self._slots))
+
+    @property
+    def num_active(self) -> int:
+        return sum(r is not None for r in self._slots)
+
+    def step(self, horizon: int = 1) -> List[Tuple[int, int, bool]]:
+        """Admit waiting requests into free slots (prefill), then run up
+        to ``horizon`` fused decode steps (one host sync). Returns
+        [(request_id, token, finished), ...] in emission order."""
+        events = self._admit()
+        events.extend(self._decode(horizon))
+        return events
+
+    def run_to_completion(self, horizon: int = 32) -> Dict[int, Request]:
+        """Drive until queue + slots drain. Returns finished requests."""
+        while self.has_work():
+            self.step(horizon)
+        return dict(self._finished)
+
+    def cancel(self, request_id: int) -> bool:
+        """Abort a live request: drop it from the wait queue or free its
+        decode slot so a disconnected client stops consuming capacity.
+        Returns True if the request was still live (it is NOT recorded in
+        the finished table). Safe no-op for finished/unknown ids."""
+        n_before = len(self._queue)
+        self._queue = collections.deque(
+            r for r in self._queue if r.request_id != request_id)
+        if len(self._queue) != n_before:
+            return True
+        for slot, req in enumerate(self._slots):
+            if req is not None and req.request_id == request_id:
+                req.finish_time = time.time()
+                self._free_slot(slot)
+                return True
+        return False
+
+    def get_finished(self, request_id: int) -> Optional[Request]:
+        return self._finished.get(request_id)
+
+    def pop_finished(self, request_id: int) -> Optional[Request]:
+        """Consume a finished request, evicting it from the finished
+        table. Long-lived servers MUST use this (or evict otherwise):
+        the table grows without bound under steady traffic."""
+        return self._finished.pop(request_id, None)
+
+    # -------------------------------------------------------- internals
+    def _free_slot(self, slot: int) -> None:
+        self._slots[slot] = None
+        self._slot_len[slot] = 0
+
+    def _maybe_finish(self, slot: int, token: int) -> bool:
+        req = self._slots[slot]
+        done = (len(req.output) >= req.max_new_tokens
+                or (req.eos_id is not None and token == req.eos_id)
+                or len(req.prompt) + len(req.output) >= self.max_seq)
+        if done:
+            req.finish_time = time.time()
+            self._finished[req.request_id] = req
+            self._free_slot(slot)
+        return done
+
+
+class InferenceEngine(_EngineBase):
     """Synchronous engine core: callers drive ``step()``; the serve layer
     wraps it in an HTTP loop."""
 
@@ -84,44 +241,14 @@ class InferenceEngine:
         self.attn_impl = attn_impl
         self._rng = jax.random.PRNGKey(rng_seed)
 
-        from skypilot_tpu.models import quantization
-        if params is None:
-            params = llama.init_params(jax.random.PRNGKey(0), cfg)
-        if quantize is not None and quantize != 'int8':
-            raise ValueError(f'unknown quantize mode {quantize!r}; '
-                             "supported: 'int8'")
-        prequantized = quantization.is_quantized(params)
-        if prequantized:
-            # e.g. host-side quantization during checkpoint load
-            # (weights.load_checkpoint(quantize='int8')).
-            quantize = 'int8'
-        if mesh is not None and not prequantized:
-            # Shard the bf16 tree FIRST so a 7B-class checkpoint never
-            # has to fit (bf16 + int8) on one chip; quantization then
-            # runs shard-parallel (the absmax over a sharded contracting
-            # axis compiles to an on-mesh reduction).
-            bf16_sh = mesh_lib.tree_shardings(
-                llama.param_logical_axes(cfg), mesh, shapes=params)
-            params = jax.device_put(params, bf16_sh)
-        if quantize == 'int8' and not prequantized:
-            # int8 weights AND int8 KV cache: the two biggest decode
-            # HBM streams each halve. ``donate_params`` frees each bf16
-            # buffer as its int8 replacement lands (see quantize_params).
-            params = quantization.quantize_params(params,
-                                                  donate=donate_params)
-        if mesh is not None and quantize == 'int8':
-            # Canonicalize: int8 codes shard like their bf16 parents;
-            # per-channel scales follow the output axes and replicate
-            # over the contracted (unit) dims.
-            qaxes = quantization.quantize_logical_axes(
-                llama.param_logical_axes(cfg))
-            params = jax.device_put(params, mesh_lib.tree_shardings(
-                qaxes, mesh, shapes=params))
-        self.params = params
+        self.params, quantize = prepare_params(
+            cfg, params, quantize=quantize, mesh=mesh,
+            donate_params=donate_params)
         # Actual stored parameter bytes (int8 leaves count 1B/elem) —
         # sizes the decode-horizon ring cap against the true weight
         # stream, not a bf16 assumption.
-        self._param_bytes = quantization.quantized_bytes(params)
+        from skypilot_tpu.models import quantization
+        self._param_bytes = quantization.quantized_bytes(self.params)
 
         self.cache = llama.KVCache.create(cfg, batch=max_batch,
                                           max_seq=max_seq,
@@ -132,16 +259,9 @@ class InferenceEngine:
                 mesh, shapes=self.cache)
             self.cache = jax.device_put(self.cache, cache_sh)
 
-        # slot bookkeeping (host side)
-        self._slots: List[Optional[Request]] = [None] * max_batch
-        self._queue: 'queue.Queue[Request]' = queue.Queue()
-        self._next_id = 0
-        self._finished: Dict[int, Request] = {}
-        # Host mirror of per-slot state; device cache.length is authoritative
-        # for attention masking.
-        self._slot_len = np.zeros(max_batch, np.int64)
-        self._cur_token = np.zeros(max_batch, np.int32)
-
+        # slot bookkeeping (host side); device cache.length is
+        # authoritative for attention masking.
+        self._init_slots(max_batch)
         self._decode_fn = self._build_decode()
         self._prefill_fns: Dict[int, Any] = {}
 
@@ -248,45 +368,6 @@ class InferenceEngine:
         return prefill
 
     # ------------------------------------------------------------------
-    # Public API
-    # ------------------------------------------------------------------
-    def add_request(self, prompt: List[int], max_new_tokens: int = 128,
-                    temperature: float = 0.0, top_k: int = 0,
-                    eos_id: Optional[int] = None) -> int:
-        if len(prompt) + max_new_tokens > self.max_seq:
-            raise ValueError(
-                f'prompt ({len(prompt)}) + max_new_tokens ({max_new_tokens}) '
-                f'exceeds engine max_seq ({self.max_seq})')
-        if not prompt:
-            raise ValueError('empty prompt')
-        req = Request(request_id=self._next_id, prompt=list(prompt),
-                      max_new_tokens=max_new_tokens, temperature=temperature,
-                      top_k=top_k, eos_id=eos_id, submit_time=time.time())
-        self._next_id += 1
-        self._queue.put(req)
-        return req.request_id
-
-    def has_work(self) -> bool:
-        return (not self._queue.empty()
-                or any(r is not None for r in self._slots))
-
-    @property
-    def num_active(self) -> int:
-        return sum(r is not None for r in self._slots)
-
-    def step(self, horizon: int = 1) -> List[Tuple[int, int, bool]]:
-        """Admit waiting requests into free slots (prefill), then run up to
-        ``horizon`` fused decode steps (one host sync). Returns
-        [(request_id, token, finished), ...] in emission order — including
-        the prefill (first) token of each newly admitted request, so
-        streaming consumers see requests that finish during admission.
-        Tokens a slot produces after its EOS/max_new_tokens within the
-        horizon are discarded host-side (not emitted, not in ``output``)."""
-        events = self._admit()
-        events.extend(self._decode(horizon))
-        return events
-
-    # ------------------------------------------------------------------
     _PREFILL_N_BUCKETS = (1, 2, 4, 8, 16, 32)
 
     def _admit(self) -> List[Tuple[int, int, bool]]:
@@ -296,18 +377,18 @@ class InferenceEngine:
         free = [s for s in range(self.max_batch) if self._slots[s] is None]
         batch: List[Tuple[int, Request]] = []
         for slot in free:
-            try:
-                batch.append((slot, self._queue.get_nowait()))
-            except queue.Empty:
+            req = self._queue_pop()
+            if req is None:
                 break
+            batch.append((slot, req))
         if not batch:
             return []
         # More free slots than the largest prefill bucket: admit the
-        # first chunk now; the rest waits for the next step() call.
+        # first chunk now; the rest goes back to the FRONT (keeps FIFO
+        # order) and waits for the next step() call.
         cap = self._PREFILL_N_BUCKETS[-1]
         if len(batch) > cap:
-            for slot, req in batch[cap:]:
-                self._queue.put(req)      # requeued behind any new arrivals
+            self._requeue_front([req for _, req in batch[cap:]])
             batch = batch[:cap]
         # Pad request count to a compiled bucket (extra rows re-prefill the
         # first request into its own slot — harmless duplicate writes).
@@ -412,64 +493,6 @@ class InferenceEngine:
                 if finished:
                     break
         return events
-
-    def _maybe_finish(self, slot: int, token: int) -> bool:
-        req = self._slots[slot]
-        done = (len(req.output) >= req.max_new_tokens
-                or (req.eos_id is not None and token == req.eos_id)
-                or len(req.prompt) + len(req.output) >= self.max_seq)
-        if done:
-            req.finish_time = time.time()
-            self._finished[req.request_id] = req
-            self._slots[slot] = None
-            self._slot_len[slot] = 0
-        return done
-
-    def cancel(self, request_id: int) -> bool:
-        """Abort a live request: drop it from the wait queue or free its
-        decode slot so a disconnected client stops consuming capacity.
-        Returns True if the request was still live (it is NOT recorded in
-        the finished table). Safe no-op for finished/unknown ids."""
-        # Still queued? Rebuild the queue without it.
-        drained: List[Request] = []
-        found = False
-        while True:
-            try:
-                r = self._queue.get_nowait()
-            except queue.Empty:
-                break
-            if r.request_id == request_id:
-                found = True
-            else:
-                drained.append(r)
-        for r in drained:
-            self._queue.put(r)
-        if found:
-            return True
-        # Occupying a slot? Free it — the next admit overwrites the
-        # slot's KV rows and device-side length.
-        for slot, req in enumerate(self._slots):
-            if req is not None and req.request_id == request_id:
-                req.finish_time = time.time()
-                self._slots[slot] = None
-                self._slot_len[slot] = 0
-                return True
-        return False
-
-    def get_finished(self, request_id: int) -> Optional[Request]:
-        return self._finished.get(request_id)
-
-    def pop_finished(self, request_id: int) -> Optional[Request]:
-        """Consume a finished request, evicting it from the finished
-        table. Long-lived servers MUST use this (or evict otherwise):
-        the table grows without bound under steady traffic."""
-        return self._finished.pop(request_id, None)
-
-    def run_to_completion(self, horizon: int = 32) -> Dict[int, Request]:
-        """Drive until queue + slots drain. Returns finished requests."""
-        while self.has_work():
-            self.step(horizon)
-        return dict(self._finished)
 
 
 def _topk_threshold(logits: jax.Array, topks: jax.Array) -> jax.Array:

@@ -459,42 +459,50 @@ def forward(
 
     layer_params = params['layers']
 
-    def body(carry, layer_and_cache):
-        x = carry
-        layer, layer_cache = layer_and_cache
-        return _layer_fn(layer, x, cfg, positions, layer_cache, cache_len,
-                         attn_impl)
+    def make_body(positions, cache_len):
+        """Per-layer body closing over a SPECIFIC positions/cache_len —
+        a factory so the pp-decode path can rebuild it inside the
+        shard_map region (closed-over tracers don't cross that
+        boundary)."""
 
-    if cfg.remat == 'block':
-        body = jax.checkpoint(body,
-                              policy=jax.checkpoint_policies.nothing_saveable)
-    elif cfg.remat == 'attn':
-        # Selective remat: save roped q/k/v and the attention output
-        # ([b,s,h,d] each — small next to the ffn intermediates), so the
-        # backward pass never re-runs the attention forward; everything
-        # else (norms, ffn) is recomputed. The MFU middle ground between
-        # 'none' (OOM at ≥1B on one chip) and 'block' (full re-forward).
-        body = jax.checkpoint(
-            body,
-            policy=jax.checkpoint_policies.save_only_these_names(
-                'q_rope', 'k_rope', 'v_proj', 'attn_out'))
-    elif cfg.remat == 'dots':
-        # Keep all matmul outputs, recompute elementwise only. Highest
-        # memory — viable for small models / many-chip FSDP shards.
-        body = jax.checkpoint(
-            body,
-            policy=jax.checkpoint_policies.dots_with_no_batch_dims_saveable)
+        def body(carry, layer_and_cache):
+            x = carry
+            layer, layer_cache = layer_and_cache
+            return _layer_fn(layer, x, cfg, positions, layer_cache,
+                             cache_len, attn_impl)
+
+        if cfg.remat == 'block':
+            body = jax.checkpoint(
+                body, policy=jax.checkpoint_policies.nothing_saveable)
+        elif cfg.remat == 'attn':
+            # Selective remat: save roped q/k/v and the attention output
+            # ([b,s,h,d] each — small next to the ffn intermediates), so
+            # the backward pass never re-runs the attention forward;
+            # everything else (norms, ffn) is recomputed. The MFU middle
+            # ground between 'none' (OOM at ≥1B on one chip) and 'block'
+            # (full re-forward).
+            body = jax.checkpoint(
+                body,
+                policy=jax.checkpoint_policies.save_only_these_names(
+                    'q_rope', 'k_rope', 'v_proj', 'attn_out'))
+        elif cfg.remat == 'dots':
+            # Keep all matmul outputs, recompute elementwise only.
+            # Highest memory — viable for small models / many-chip FSDP
+            # shards.
+            body = jax.checkpoint(
+                body,
+                policy=jax.checkpoint_policies
+                .dots_with_no_batch_dims_saveable)
+        return body
+
+    body = make_body(positions, cache_len)
 
     if cache is None:
         pp_mesh = _pp_mesh()
         if pp_mesh is not None:
-            # Pipeline-parallel layer stack (GPipe over the pp axis);
-            # each stage scans its local layers. MoE aux loss is not
-            # plumbed through the pipeline yet.
-            if cfg.is_moe:
-                raise NotImplementedError(
-                    'pipeline parallelism with MoE layers is not '
-                    'supported yet (aux loss not plumbed)')
+            # Pipeline-parallel layer stack (bubble-skipping GPipe over
+            # the pp axis); each stage scans its local layers. MoE aux
+            # flows through the schedule (``with_aux``).
             from skypilot_tpu.parallel.pipeline import pipeline_layers
 
             def stage_fn(stage_params, x_mb):
@@ -507,20 +515,21 @@ def forward(
                 def layer_body(carry, layer):
                     _manual_region.active = True
                     try:
-                        out, _, _ = _layer_fn(layer, carry, cfg, mb_pos,
-                                              None, None, attn_impl)
+                        out, _, aux = _layer_fn(layer, carry, cfg, mb_pos,
+                                                None, None, attn_impl)
                     finally:
                         _manual_region.active = False
-                    return out, None
+                    return out, aux
                 if cfg.remat == 'block':
                     layer_body = jax.checkpoint(
                         layer_body,
                         policy=jax.checkpoint_policies.nothing_saveable)
-                out, _ = lax.scan(layer_body, x_mb, stage_params)
-                return out
+                out, auxs = lax.scan(layer_body, x_mb, stage_params)
+                return out, jnp.mean(auxs)
 
-            x = pipeline_layers(layer_params, x, stage_fn, pp_mesh)
-            aux_layers = jnp.zeros((1,), jnp.float32)
+            x, aux_mean = pipeline_layers(layer_params, x, stage_fn,
+                                          pp_mesh, with_aux=True)
+            aux_layers = aux_mean[None]
         else:
             def scan_body(carry, layer):
                 out, _, aux = body(carry, (layer, None))
@@ -536,22 +545,66 @@ def forward(
         cache_k, cache_v = cache.k, cache.v
         k_scale, v_scale = cache.k_scale, cache.v_scale
 
-        def scan_body(carry, layer_and_idx):
-            layer, li = layer_and_idx
-            ck = lax.dynamic_index_in_dim(cache_k, li, axis=0,
-                                          keepdims=False)
-            cv = lax.dynamic_index_in_dim(cache_v, li, axis=0,
-                                          keepdims=False)
-            if cache.quantized:
-                ck = _deq_kv(ck, lax.dynamic_index_in_dim(
-                    k_scale, li, axis=0, keepdims=False), carry.dtype)
-                cv = _deq_kv(cv, lax.dynamic_index_in_dim(
-                    v_scale, li, axis=0, keepdims=False), carry.dtype)
-            out, new_kv, aux = body(carry, (layer, (ck, cv)))
-            return out, (new_kv, aux)
+        def local_scan(stack_params, ck_stack, cv_stack, ks_stack,
+                       vs_stack, x0, scan_body_fn):
+            """Scan a (possibly stage-local) layer stack against its
+            cache stack; returns (x, (k_rows, v_rows), aux)."""
+            n_local = jax.tree.leaves(stack_params)[0].shape[0]
 
-        x, ((k_rows, v_rows), aux_layers) = lax.scan(
-            scan_body, x, (layer_params, jnp.arange(cfg.n_layers)))
+            def scan_body(carry, layer_and_idx):
+                layer, li = layer_and_idx
+                ck = lax.dynamic_index_in_dim(ck_stack, li, axis=0,
+                                              keepdims=False)
+                cv = lax.dynamic_index_in_dim(cv_stack, li, axis=0,
+                                              keepdims=False)
+                if cache.quantized:
+                    ck = _deq_kv(ck, lax.dynamic_index_in_dim(
+                        ks_stack, li, axis=0, keepdims=False),
+                        carry.dtype)
+                    cv = _deq_kv(cv, lax.dynamic_index_in_dim(
+                        vs_stack, li, axis=0, keepdims=False),
+                        carry.dtype)
+                out, new_kv, aux = scan_body_fn(carry, (layer, (ck, cv)))
+                return out, (new_kv, aux)
+
+            x1, (kv_rows, auxs) = lax.scan(
+                scan_body, x0, (stack_params, jnp.arange(n_local)))
+            return x1, kv_rows, auxs
+
+        pp_mesh = _pp_mesh()
+        if pp_mesh is not None:
+            # pp-sharded decode/prefill: each stage reads only its
+            # local layer + cache shards; the token activation chains
+            # through the stages (parallel/pipeline.py, round-3 gap
+            # "decode ignores pp").
+            from skypilot_tpu.parallel.pipeline import \
+                pipeline_decode_layers
+            caches = ((cache.k, cache.v, k_scale, v_scale)
+                      if cache.quantized else (cache.k, cache.v))
+
+            def stage_fn(stage_params, stage_caches, x_mb, extras):
+                pos_x, clen_x = extras
+                if cache.quantized:
+                    ck_s, cv_s, ks_s, vs_s = stage_caches
+                else:
+                    (ck_s, cv_s), ks_s, vs_s = stage_caches, None, None
+                _manual_region.active = True
+                try:
+                    x1, kv_rows, _ = local_scan(
+                        stage_params, ck_s, cv_s, ks_s, vs_s, x_mb,
+                        make_body(pos_x, clen_x))
+                finally:
+                    _manual_region.active = False
+                return x1, kv_rows
+
+            x, (k_rows, v_rows) = pipeline_decode_layers(
+                layer_params, caches, x, stage_fn, pp_mesh,
+                extras=(positions, cache_len))
+            aux_layers = jnp.zeros((1,), jnp.float32)
+        else:
+            x, (k_rows, v_rows), aux_layers = local_scan(
+                layer_params, cache_k, cache_v, k_scale, v_scale, x,
+                body)
         # One scatter of the new token rows across all layers.
         # k_rows: [L, b, s, kv_heads, d]; per batch row, write the
         # [L, s, kv_heads, d] block at that sequence's offset.

@@ -1,5 +1,4 @@
-"""Pipeline parallelism: GPipe microbatch schedule over the ``pp`` mesh
-axis.
+"""Pipeline parallelism: microbatch schedule over the ``pp`` mesh axis.
 
 SURVEY §2.3 row "Pipeline (PP)": the reference delegates PP to launched
 frameworks (DeepSpeed recipes); here it is a first-class op. The layer
@@ -9,14 +8,28 @@ stage-to-stage via ``lax.ppermute`` (nearest-neighbor ICI hops) in a
 ``jax.shard_map`` that is manual over ONLY the pp axis — fsdp/tp/sp
 sharding inside each stage remains compiler-managed (``axis_names``).
 
-Schedule: plain GPipe — M microbatches drain through P stages in
-M + P - 1 ticks; the (P-1)/M bubble shrinks as M grows. Activations for
-the backward pass are kept by scan autodiff (remat of the stage body
-applies as usual via the model's remat policy).
+Schedule: GPipe ticks (M microbatches drain through P stages in
+M + P - 1 ticks) with **bubble compute skipped**: a stage whose tick
+carries no live microbatch takes the identity branch of a ``lax.cond``
+instead of running the stage body, so bubble ticks cost a branch, not a
+forward pass (the round-2/3 implementation computed every tick on every
+rank). The (P-1)/M bubble *latency* remains — that is the schedule;
+1F1B-style interleaving changes peak activation memory, not the bubble
+— but the wasted FLOPs are gone.
+
+MoE: the stage body returns (activations, aux_scalar); aux accumulates
+over live ticks and psums across stages, so MoE load-balancing loss
+flows through the pipeline (round-3 gap).
+
+Boundary dtype: activations cross stages in the model dtype on TPU. On
+the CPU backend the boundary rides fp32 — a bf16 psum inside a
+partially-manual shard_map trips an XLA-CPU internal check ("Invalid
+binary instruction opcode copy"); that workaround is now gated to CPU
+instead of taxing TPU with 2x boundary traffic.
 """
 from __future__ import annotations
 
-from typing import Any, Callable, Optional
+from typing import Any, Callable, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -24,19 +37,29 @@ from jax import lax
 from jax.sharding import PartitionSpec as P
 
 
+def _boundary_dtype(x_dtype):
+    if jax.default_backend() == 'cpu':
+        return jnp.float32
+    return x_dtype
+
+
 def pipeline_layers(
     layer_params: Any,                # pytree; leaves [L, ...] over pp
     x: jax.Array,                     # [batch, seq, d] activations
-    stage_fn: Callable[[Any, jax.Array], jax.Array],
+    stage_fn: Callable[[Any, jax.Array], Any],
     mesh: jax.sharding.Mesh,
     *,
     num_microbatches: Optional[int] = None,
     axis_name: str = 'pp',
-) -> jax.Array:
+    with_aux: bool = False,
+) -> Any:
     """Apply the full layer stack to ``x`` through the pipeline.
 
     ``stage_fn(stage_params, x_mb)`` applies ONE stage's local layers to
-    one microbatch (it sees leaves with leading axis L/P)."""
+    one microbatch (it sees leaves with leading axis L/P). With
+    ``with_aux`` it returns ``(y_mb, aux_scalar)`` and
+    ``pipeline_layers`` returns ``(y, aux_mean_over_stages_and_mbs)``.
+    """
     pp = mesh.shape[axis_name]
     if pp == 1:
         return stage_fn(layer_params, x)
@@ -47,28 +70,55 @@ def pipeline_layers(
                          f'{n_micro} microbatches')
 
     param_specs = jax.tree.map(lambda _: P(axis_name), layer_params)
-    # The shard_map boundary rides fp32: replicated (P()) inputs get a
-    # psum over pp in the TRANSPOSE (cotangent accumulation), and a bf16
-    # all-reduce inside a partially-manual shard_map trips an XLA-CPU
-    # internal check. Stage compute still runs in the model dtype.
     x_dtype = x.dtype
+    bdt = _boundary_dtype(x_dtype)
+    # Bubble skip is a lax.cond whose predicate differs across pp ranks.
+    # If the SPMD partitioner inserts collectives INSIDE the stage body
+    # (fsdp param all-gathers, tp psums), ranks in different branches
+    # execute different collective streams and the runtime deadlocks
+    # (observed on XLA:CPU: half the devices at permute N, half at N+1).
+    # Skip bubbles only when the intra-stage axes are trivial; otherwise
+    # compute bubbles unconditionally (correct, GPipe-classic).
+    skip_bubbles = all(mesh.shape.get(a, 1) == 1
+                       for a in ('fsdp', 'tp', 'sp'))
 
     def body(params_local, x_full):
         x_full = x_full.astype(x_dtype)
         rank = lax.axis_index(axis_name)
         mbs = x_full.reshape(n_micro, batch // n_micro, *x_full.shape[1:])
-        outputs = jnp.zeros_like(mbs)
-        recv = jnp.zeros_like(mbs[0])
+        outputs = jnp.zeros(mbs.shape, bdt)
+        recv = jnp.zeros(mbs.shape[1:], bdt)
+        aux_acc = jnp.zeros((), jnp.float32)
         fwd = [(i, (i + 1) % pp) for i in range(pp)]
 
+        def run_stage(x_in):
+            out = stage_fn(params_local, x_in)
+            if with_aux:
+                y, aux = out
+            else:
+                y, aux = out, jnp.zeros((), jnp.float32)
+            return y.astype(bdt), aux.astype(jnp.float32)
+
+        def skip_stage(x_in):
+            # Bubble tick: no live microbatch here — identity, no
+            # compute. (cond executes ONE branch at runtime.)
+            return x_in.astype(bdt), jnp.zeros((), jnp.float32)
+
         def tick(carry, t):
-            recv, outputs = carry
+            recv, outputs, aux_acc = carry
             # Stage `rank` processes microbatch (t - rank) at tick t.
             mb_idx = jnp.clip(t - rank, 0, n_micro - 1)
             active = (t - rank >= 0) & (t - rank < n_micro)
             x_in = jnp.where(rank == 0,
-                             mbs[jnp.clip(t, 0, n_micro - 1)], recv)
-            y = stage_fn(params_local, x_in)
+                             mbs[jnp.clip(t, 0, n_micro - 1)].astype(bdt),
+                             recv)
+            if skip_bubbles:
+                y, aux = lax.cond(active, run_stage, skip_stage,
+                                  x_in.astype(x_dtype))
+            else:
+                y, aux = run_stage(x_in.astype(x_dtype))
+                y = jnp.where(active, y, x_in)
+            aux_acc = aux_acc + jnp.where(active, aux, 0.0)
             # Last stage banks its finished microbatch.
             prev = lax.dynamic_index_in_dim(outputs, mb_idx, 0,
                                             keepdims=False)
@@ -76,24 +126,128 @@ def pipeline_layers(
             outputs = lax.dynamic_update_index_in_dim(outputs, banked,
                                                       mb_idx, 0)
             recv = lax.ppermute(y, axis_name, fwd)
-            return (recv, outputs), None
+            return (recv, outputs, aux_acc), None
 
-        (recv, outputs), _ = lax.scan(
-            tick, (recv, outputs), jnp.arange(n_micro + pp - 1))
+        (recv, outputs, aux_acc), _ = lax.scan(
+            tick, (recv, outputs, aux_acc),
+            jnp.arange(n_micro + pp - 1))
         del recv
         # Only the last stage holds real outputs; broadcast to the ring
         # so downstream (final norm / unembed / loss) is replicated over
-        # pp. The psum rides fp32: a bf16 all-reduce inside a
-        # partially-manual shard_map trips an XLA-CPU internal check
-        # ("Invalid binary instruction opcode copy").
+        # pp.
         outputs = jnp.where(rank == pp - 1, outputs,
                             jnp.zeros_like(outputs))
-        outputs = lax.psum(outputs.astype(jnp.float32), axis_name)
-        return outputs.reshape(x_full.shape)
+        outputs = lax.psum(outputs, axis_name)
+        # aux: each live (stage, microbatch) contributed one scalar;
+        # mean over all of them = psum / (pp * n_micro).
+        aux_mean = lax.psum(aux_acc, axis_name) / (pp * n_micro)
+        out = outputs.reshape(x_full.shape)
+        if with_aux:
+            return out, aux_mean
+        return out
 
+    out_specs = (P(), P()) if with_aux else P()
     fn = jax.shard_map(body, mesh=mesh,
                        in_specs=(param_specs, P()),
-                       out_specs=P(),
+                       out_specs=out_specs,
                        axis_names={axis_name},
                        check_vma=False)
-    return fn(layer_params, x.astype(jnp.float32)).astype(x_dtype)
+    result = fn(layer_params, x.astype(bdt))
+    if with_aux:
+        y, aux = result
+        return y.astype(x_dtype), aux
+    return result.astype(x_dtype)
+
+
+def pipeline_decode_layers(
+    layer_params: Any,                # pytree; leaves [L, ...] over pp
+    caches: Tuple[Any, ...],          # cache pytrees; leaves [L, ...] over pp
+    x: jax.Array,                     # [b, s, d] current-token activations
+    stage_fn: Callable[..., Any],
+    mesh: jax.sharding.Mesh,
+    *,
+    extras: Any = (),                 # replicated pytree handed to stage_fn
+    axis_name: str = 'pp',
+):
+    """Single-wave pipelined DECODE: the activation chains through the
+    P stages (P-1 ppermute hops), each stage scanning its LOCAL layers
+    against its LOCAL cache shard — pp-sharded params and caches are
+    honored at decode instead of being all-gathered (round-3 gap:
+    "decode ignores pp").
+
+    ``stage_fn(stage_params, stage_caches, x, extras) -> (y,
+    stage_new_kv)`` where ``stage_new_kv`` leaves have leading axis L/P.
+    Returns ``(y_replicated, new_kv)`` with new_kv leaves [L, ...]
+    sharded over pp — ready to merge into the pp-sharded cache.
+
+    No microbatching: a decode token is latency-bound through the
+    stage chain anyway; the win is that each rank only reads 1/P of the
+    weights and cache (HBM), which is what pp buys at decode.
+    """
+    pp = mesh.shape[axis_name]
+    if pp == 1:
+        return stage_fn(layer_params, caches, x, extras)
+    param_specs = jax.tree.map(lambda _: P(axis_name), layer_params)
+    cache_specs = jax.tree.map(lambda _: P(axis_name), caches)
+    x_dtype = x.dtype
+    bdt = _boundary_dtype(x_dtype)
+    fwd = [(i, (i + 1) % pp) for i in range(pp)]
+
+    def body(params_local, caches_local, x_in, extras_in):
+        rank = lax.axis_index(axis_name)
+        act = x_in.astype(bdt)
+
+        def _astype_tree(out):
+            y, kv = out
+            return y.astype(bdt), kv
+
+        def _zeros_kv(a):
+            shapes = jax.eval_shape(
+                lambda p, c, xx, e: stage_fn(p, c, xx, e)[1],
+                params_local, caches_local, a.astype(x_dtype), extras_in)
+            return jax.tree.map(
+                lambda s: jnp.zeros(s.shape, s.dtype), shapes)
+
+        def hop(carry, stage):
+            # lax.scan (strict hop ordering) and NO lax.cond around the
+            # stage body: a cond whose predicate differs across pp ranks
+            # plus a collective in the stream deadlocks the XLA:CPU
+            # rendezvous (half the devices at permute N, half at N+1).
+            # Every rank runs its LOCAL layers each hop and a `where`
+            # keeps only the live stage's result — decode is HBM-bound
+            # and each rank re-reads only its 1/P weight shard, so the
+            # redundant hops cost idle FLOPs, not bandwidth.
+            act, kv_acc = carry
+            live = rank == stage
+            y, new_kv = _astype_tree(
+                stage_fn(params_local, caches_local,
+                         act.astype(x_dtype), extras_in))
+            y = jnp.where(live, y, act)
+            # Each rank keeps real rows only from its own stage's hop.
+            kv_acc = jax.tree.map(
+                lambda acc, kv: acc + jnp.where(live, kv,
+                                                jnp.zeros_like(kv)),
+                kv_acc, new_kv)
+            return (lax.ppermute(y, axis_name, fwd), kv_acc), None
+
+        kv0 = _zeros_kv(act)
+        (act, new_kvs), _ = lax.scan(hop, (act, kv0), jnp.arange(pp))
+        # After pp hops the activation is back at rank 0 holding the
+        # final stage's output; broadcast it.
+        act = jnp.where(rank == 0, act, jnp.zeros_like(act))
+        act = lax.psum(act, axis_name)
+        return act.astype(x_dtype), new_kvs
+
+    kv_shapes = jax.eval_shape(
+        lambda p, c, xx, e: stage_fn(
+            jax.tree.map(lambda a: a[:a.shape[0] // pp], p),
+            jax.tree.map(lambda a: a[:a.shape[0] // pp], c),
+            xx, e)[1],
+        layer_params, caches, x, extras)
+    fn = jax.shard_map(body, mesh=mesh,
+                       in_specs=(param_specs, cache_specs, P(), P()),
+                       out_specs=(P(), jax.tree.map(
+                           lambda _: P(axis_name), kv_shapes)),
+                       axis_names={axis_name},
+                       check_vma=False)
+    return fn(layer_params, caches, x, extras)

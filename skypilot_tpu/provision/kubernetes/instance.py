@@ -97,30 +97,47 @@ def _client_for(cluster_name: str) -> kc.K8sClient:
 
 
 # ------------------------------------------------------------ manifests
+# Pinned per-generation chips -> topology selector values. GKE node
+# pools expose SPECIFIC topology strings (cloud.google.com/tpu docs;
+# reference pins the same values, sky/provision/kubernetes/utils.py:
+# 340-390) — a computed "near-equal factorization" can produce a string
+# no node pool carries (e.g. 4x2x1 for v4-16 instead of 2x2x2), which
+# never schedules and surfaces as a phantom stockout.
+# v5e/v6e are 2-D (chip-count naming); v4/v5p are 3-D torus slices
+# (TensorCore naming halved to chips), dims ascending powers of two.
+_GKE_TOPOLOGY_2D = {
+    1: '1x1', 4: '2x2', 8: '2x4', 16: '4x4', 32: '4x8', 64: '8x8',
+    128: '8x16', 256: '16x16',
+}
+_GKE_TOPOLOGY_3D = {
+    4: '2x2x1', 8: '2x2x2', 16: '2x2x4', 32: '2x4x4', 64: '4x4x4',
+    128: '4x4x8', 256: '4x8x8', 512: '8x8x8', 1024: '8x8x16',
+    2048: '8x16x16', 4096: '16x16x16',
+}
+GKE_TPU_TOPOLOGIES = {
+    'v4': _GKE_TOPOLOGY_3D,
+    'v5p': _GKE_TOPOLOGY_3D,
+    'v5e': _GKE_TOPOLOGY_2D,
+    'v6e': _GKE_TOPOLOGY_2D,
+}
+
+
 def gke_topology(generation: str, num_chips: int,
                  chips_per_host: int) -> str:
-    """GKE topology selector value: 2-D for v5e/v6e ('2x4'), 3-D for
-    v4/v5p ('2x2x1')."""
-    if generation in ('v4', 'v5p'):
-        # Factor chips into three near-equal powers-of-two-ish factors.
-        a = 1
-        for a_try in range(int(num_chips ** (1 / 3)) + 1, 0, -1):
-            if num_chips % a_try == 0:
-                a = a_try
-                break
-        rest = num_chips // a
-        b = 1
-        for b_try in range(int(rest ** 0.5) + 1, 0, -1):
-            if rest % b_try == 0:
-                b = b_try
-                break
-        return f'{a}x{b}x{rest // b}'
-    rows = 1
-    for r in range(int(num_chips ** 0.5) + 1, 0, -1):
-        if num_chips % r == 0:
-            rows = r
-            break
-    return f'{rows}x{num_chips // rows}'
+    """GKE topology selector value for a slice size, from the pinned
+    table; unknown sizes fail loudly with the valid options."""
+    del chips_per_host
+    table = GKE_TPU_TOPOLOGIES.get(generation)
+    if table is None:
+        raise exceptions.InvalidResourcesError(
+            f'No GKE topology table for TPU generation {generation!r}; '
+            f'known: {sorted(GKE_TPU_TOPOLOGIES)}')
+    topo = table.get(num_chips)
+    if topo is None:
+        raise exceptions.InvalidResourcesError(
+            f'{generation} has no GKE node-pool topology for '
+            f'{num_chips} chips; valid sizes: {sorted(table)}')
+    return topo
 
 
 def _pod_name(cluster_name: str, slice_idx: int, host_idx: int) -> str:

@@ -33,10 +33,12 @@ class ModelServer:
     def __init__(self, cfg_name: str = 'tiny', *, max_batch: int = 8,
                  max_seq: int = 1024, port: int = 8081,
                  model_path: Optional[str] = None,
-                 quantize: Optional[str] = None):
+                 quantize: Optional[str] = None,
+                 kv_cache: str = 'slot'):
         self.cfg_name = cfg_name
         self.model_path = model_path  # HF checkpoint dir (real weights)
         self.quantize = quantize      # 'int8' => int8 weights + KV cache
+        self.kv_cache = kv_cache      # 'slot' | 'paged' (prefix caching)
         self.max_batch = max_batch
         self.max_seq = max_seq
         self.port = port
@@ -57,21 +59,24 @@ class ModelServer:
     # ------------------------------------------------------------- engine
     def _load_engine(self) -> None:
         from skypilot_tpu.inference.engine import InferenceEngine
+        from skypilot_tpu.inference.paged import PagedInferenceEngine
         from skypilot_tpu.models import configs
         from skypilot_tpu.models.tokenizer import load_tokenizer
+        engine_cls = (PagedInferenceEngine if self.kv_cache == 'paged'
+                      else InferenceEngine)
         if self.model_path:
             # Real weights: HF checkpoint dir (config.json + safetensors
             # [+ tokenizer.json]) — the reference serves such checkpoints
             # through vLLM/JetStream (llm/llama-3/llama3.yaml:109).
-            engine = InferenceEngine.from_pretrained(
+            engine = engine_cls.from_pretrained(
                 self.model_path, max_batch=self.max_batch,
                 max_seq=self.max_seq, quantize=self.quantize)
             self.cfg_name = engine.cfg.name
         else:
             cfg = configs.get_config(self.cfg_name)
-            engine = InferenceEngine(cfg, max_batch=self.max_batch,
-                                     max_seq=self.max_seq,
-                                     quantize=self.quantize)
+            engine = engine_cls(cfg, max_batch=self.max_batch,
+                                max_seq=self.max_seq,
+                                quantize=self.quantize)
         self.tokenizer = load_tokenizer(
             self.model_path, model_vocab_size=engine.cfg.vocab_size)
         # Warmup: compile prefill+decode before declaring readiness.
@@ -328,6 +333,10 @@ def main() -> None:
                         help='HF checkpoint dir (real weights + tokenizer)')
     parser.add_argument('--quantize', default=None, choices=['int8'],
                         help='int8 weights + KV cache (2x decode)')
+    parser.add_argument('--kv-cache', default='slot',
+                        choices=['slot', 'paged'],
+                        help='paged = shared page pool with prefix '
+                             'caching + chunked prefill')
     parser.add_argument('--max-batch', type=int, default=8)
     parser.add_argument('--max-seq', type=int, default=1024)
     parser.add_argument('--port', type=int,
@@ -337,7 +346,8 @@ def main() -> None:
     server = ModelServer(args.model, max_batch=args.max_batch,
                          max_seq=args.max_seq, port=args.port,
                          model_path=args.model_path,
-                         quantize=args.quantize)
+                         quantize=args.quantize,
+                         kv_cache=args.kv_cache)
     server.start(block=True)
 
 

@@ -1,0 +1,194 @@
+"""Paged KV cache engine: equivalence vs the slot engine, prefix
+caching, chunked prefill, pool accounting (VERDICT r4 task 3; reference
+capability anchor: vLLM paged attention, llm/vllm/README.md:10)."""
+import jax
+import numpy as np
+import pytest
+
+from skypilot_tpu.inference.engine import InferenceEngine
+from skypilot_tpu.inference.paged import (PageAllocator,
+                                          PagedInferenceEngine)
+from skypilot_tpu.models import configs, llama
+
+
+@pytest.fixture(scope='module')
+def setup():
+    cfg = configs.TINY
+    params = llama.init_params(jax.random.PRNGKey(0), cfg)
+    return cfg, params
+
+
+def _greedy_slot_engine(cfg, params, prompts, n_new, **kw):
+    eng = InferenceEngine(cfg, params, max_batch=4, max_seq=256,
+                          attn_impl='xla', **kw)
+    rids = [eng.add_request(p, max_new_tokens=n_new) for p in prompts]
+    done = eng.run_to_completion(horizon=4)
+    return [done[r].output for r in rids]
+
+
+class TestPagedEquivalence:
+
+    def test_greedy_matches_slot_engine(self, setup):
+        cfg, params = setup
+        prompts = [[3, 1, 4, 1, 5], [2, 7, 1, 8, 2, 8, 1, 8], [9]]
+        want = _greedy_slot_engine(cfg, params, prompts, 8)
+        eng = PagedInferenceEngine(cfg, params, max_batch=4, max_seq=256,
+                                   page_size=8, attn_impl='xla')
+        rids = [eng.add_request(p, max_new_tokens=8) for p in prompts]
+        done = eng.run_to_completion(horizon=4)
+        got = [done[r].output for r in rids]
+        assert got == want, (got, want)
+
+    def test_long_prompt_chunked_prefill(self, setup):
+        """Prompt far longer than the chunk size prefills in pieces and
+        still matches the slot engine."""
+        cfg, params = setup
+        prompt = [(i * 7 + 3) % cfg.vocab_size for i in range(150)]
+        want = _greedy_slot_engine(cfg, params, [prompt], 6)[0]
+        eng = PagedInferenceEngine(cfg, params, max_batch=2, max_seq=256,
+                                   page_size=8, chunk=32,
+                                   attn_impl='xla')
+        rid = eng.add_request(prompt, max_new_tokens=6)
+        done = eng.run_to_completion(horizon=4)
+        assert eng.chunks_prefilled >= 5       # 150/32 -> 5 chunks
+        assert done[rid].output == want
+
+    def test_int8_paged_generates(self, setup):
+        cfg, params = setup
+        eng = PagedInferenceEngine(cfg, params, max_batch=2, max_seq=128,
+                                   page_size=8, quantize='int8',
+                                   attn_impl='xla')
+        assert eng.cache.quantized
+        rid = eng.add_request(list(range(1, 12)), max_new_tokens=6)
+        done = eng.run_to_completion(horizon=4)
+        assert len(done[rid].output) == 6
+
+    def test_sampling_runs(self, setup):
+        cfg, params = setup
+        eng = PagedInferenceEngine(cfg, params, max_batch=2, max_seq=128,
+                                   page_size=8, attn_impl='xla')
+        rid = eng.add_request([1, 2, 3], max_new_tokens=16,
+                              temperature=1.5, top_k=40)
+        done = eng.run_to_completion(horizon=4)
+        assert len(set(done[rid].output)) > 1
+
+
+class TestPrefixCache:
+
+    def test_shared_prefix_reuses_pages(self, setup):
+        """Second request with the same long prefix prefills fewer
+        chunks (the shared pages are not recomputed) and still decodes
+        identically."""
+        cfg, params = setup
+        shared = [(i * 5 + 2) % cfg.vocab_size for i in range(64)]
+        p1 = shared + [11, 12]
+        p2 = shared + [13, 14, 15]
+        want = _greedy_slot_engine(cfg, params, [p2], 6)[0]
+
+        eng = PagedInferenceEngine(cfg, params, max_batch=1, max_seq=256,
+                                   page_size=8, chunk=16,
+                                   attn_impl='xla')
+        r1 = eng.add_request(p1, max_new_tokens=4)
+        eng.run_to_completion(horizon=4)
+        chunks_before = eng.chunks_prefilled
+        assert eng.alloc.prefix_misses == 1
+        r2 = eng.add_request(p2, max_new_tokens=6)
+        done = eng.run_to_completion(horizon=4)
+        delta = eng.chunks_prefilled - chunks_before
+        # 64 shared tokens = 8 full pages reused; only the 3-token tail
+        # prefills -> exactly 1 chunk vs 5 without reuse.
+        assert eng.alloc.prefix_hits == 1
+        assert delta == 1, delta
+        assert done[r2].output == want
+
+    def test_prefix_pages_survive_slot_free_until_pressure(self, setup):
+        cfg, params = setup
+        eng = PagedInferenceEngine(cfg, params, max_batch=1, max_seq=128,
+                                   page_size=8, attn_impl='xla')
+        prompt = list(range(1, 26))            # 3 full pages
+        eng.add_request(prompt, max_new_tokens=2)
+        eng.run_to_completion(horizon=2)
+        stats = eng.memory_stats()
+        assert stats['pages_retained_prefix'] >= 3
+        # a re-submit hits the retained pages
+        eng.add_request(prompt + [30], max_new_tokens=2)
+        eng.run_to_completion(horizon=2)
+        assert eng.alloc.prefix_hits == 1
+
+    def test_memory_stats_accounting(self, setup):
+        cfg, params = setup
+        eng = PagedInferenceEngine(cfg, params, max_batch=2, max_seq=128,
+                                   page_size=8, attn_impl='xla')
+        s0 = eng.memory_stats()
+        assert s0['pages_in_use'] == 0
+        assert s0['pool_bytes'] > 0
+        eng.add_request(list(range(1, 20)), max_new_tokens=64)
+        eng.step(horizon=2)
+        s1 = eng.memory_stats()
+        assert s1['pages_in_use'] >= 3         # 19 tokens / 8 per page
+        eng.run_to_completion(horizon=8)
+        s2 = eng.memory_stats()
+        assert s2['pages_in_use'] == 0         # all freed or retained
+        assert (s2['pages_free'] + s2['pages_retained_prefix']
+                == s2['n_pages'] - 1)
+
+
+class TestAllocator:
+
+    def test_exhaustion_and_lru_eviction(self):
+        a = PageAllocator(n_pages=5, page_size=4)     # 4 usable
+        pages = [a.alloc() for _ in range(4)]
+        with pytest.raises(MemoryError):
+            a.alloc()
+        # register 2 pages as prefix pages, then free them -> retained
+        a.page_hash[pages[0]] = b'h0'
+        a.by_hash[b'h0'] = pages[0]
+        a.page_hash[pages[1]] = b'h1'
+        a.by_hash[b'h1'] = pages[1]
+        a.release(pages[0])
+        a.release(pages[1])
+        assert a.available == 2
+        # allocation evicts the LRU retained page (pages[0] first)
+        p = a.alloc()
+        assert p == pages[0]
+        assert b'h0' not in a.by_hash          # hash forgotten
+        assert a.by_hash[b'h1'] == pages[1]    # newer one survives
+
+    def test_refcount_sharing(self):
+        a = PageAllocator(n_pages=4, page_size=4)
+        p = a.alloc()
+        a.retain(p)
+        a.release(p)
+        assert a.refcount[p] == 1              # still held by one user
+        a.release(p)
+        assert p in a.free                     # unregistered -> free list
+
+
+class TestPallasDecodeKernel:
+    """Paged-attention Pallas kernel (interpret mode on CPU): the
+    engine's pallas decode path matches the gather path exactly."""
+
+    def test_pallas_decode_matches_gather(self, setup):
+        cfg, params = setup
+        prompts = [[3, 1, 4, 1, 5, 9, 2], [2, 7]]
+        outs = {}
+        for impl in ('gather', 'pallas'):
+            eng = PagedInferenceEngine(cfg, params, max_batch=2,
+                                       max_seq=64, page_size=8,
+                                       attn_impl='xla',
+                                       decode_impl=impl)
+            rids = [eng.add_request(p, max_new_tokens=5)
+                    for p in prompts]
+            done = eng.run_to_completion(horizon=2)
+            outs[impl] = [done[r].output for r in rids]
+        assert outs['pallas'] == outs['gather'], outs
+
+    def test_pallas_decode_int8(self, setup):
+        cfg, params = setup
+        eng = PagedInferenceEngine(cfg, params, max_batch=2, max_seq=64,
+                                   page_size=8, quantize='int8',
+                                   attn_impl='xla',
+                                   decode_impl='pallas')
+        rid = eng.add_request(list(range(1, 12)), max_new_tokens=4)
+        done = eng.run_to_completion(horizon=2)
+        assert len(done[rid].output) == 4

@@ -102,3 +102,98 @@ class TestTrainerIntegration:
         loss_ring = self._loss_after_step('ring', sp=2)
         loss_xla = self._loss_after_step('xla', sp=2)
         assert abs(loss_ring - loss_xla) < 2e-2, (loss_ring, loss_xla)
+
+
+class TestZigzag:
+    """Balanced causal ring (VERDICT r4 task 6)."""
+
+    @pytest.mark.parametrize('sp', [2, 4, 8])
+    def test_zigzag_matches_reference(self, sp):
+        mesh = _mesh(sp)
+        q, k, v = _rand_qkv(s=32 * (sp // 2) if sp > 2 else 32)
+        ref = reference_attention(q, k, v, causal=True)
+        with mesh:
+            out = jax.jit(lambda q, k, v: ring_attention(
+                q, k, v, mesh, causal=True, layout='zigzag',
+                block_impl='einsum'))(q, k, v)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=2e-5, atol=2e-5)
+
+    def test_zigzag_gradients_match(self):
+        mesh = _mesh(2)
+        q, k, v = _rand_qkv()
+
+        def loss_ring(q, k, v):
+            return jnp.sum(ring_attention(q, k, v, mesh, causal=True,
+                                          layout='zigzag',
+                                          block_impl='einsum') ** 2)
+
+        def loss_ref(q, k, v):
+            return jnp.sum(reference_attention(q, k, v,
+                                               causal=True) ** 2)
+
+        with mesh:
+            g_ring = jax.jit(jax.grad(loss_ring, (0, 1, 2)))(q, k, v)
+        g_ref = jax.grad(loss_ref, (0, 1, 2))(q, k, v)
+        for a, b, name in zip(g_ring, g_ref, 'qkv'):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=2e-4, atol=2e-4,
+                                       err_msg=f'd{name}')
+
+    def test_schedule_balanced_within_one_block(self):
+        """The asserted balance property: zigzag per-rank cost is
+        rank-independent; contiguous spreads 0.5 .. sp-0.5."""
+        from skypilot_tpu.ops.ring_attention import ring_schedule_cost
+        for sp in (2, 4, 8, 16):
+            zig = [ring_schedule_cost(sp, r, 'zigzag')
+                   for r in range(sp)]
+            con = [ring_schedule_cost(sp, r, 'contiguous')
+                   for r in range(sp)]
+            assert max(zig) - min(zig) <= 1.0, (sp, zig)
+            assert max(zig) - min(zig) == 0.0          # exactly even
+            assert max(con) - min(con) == sp - 1
+            # total work conserved (same attention, same FLOPs)
+            np.testing.assert_allclose(sum(zig), sum(con))
+
+
+class TestFlashBlockBody:
+    """Pallas flash kernel as the per-block ring body (interpret mode
+    on the CPU mesh; VERDICT r4 task 6)."""
+
+    @pytest.mark.parametrize('layout', ['contiguous', 'zigzag'])
+    def test_flash_body_matches_einsum_body(self, layout):
+        mesh = _mesh(2)
+        # 128-aligned halves + d=128 so the kernel tiles.
+        q, k, v = _rand_qkv(b=4, s=512, h=2, hkv=2, d=128)
+        with mesh:
+            ref = jax.jit(lambda q, k, v: ring_attention(
+                q, k, v, mesh, causal=True, layout=layout,
+                block_impl='einsum'))(q, k, v)
+            out = jax.jit(lambda q, k, v: ring_attention(
+                q, k, v, mesh, causal=True, layout=layout,
+                block_impl='flash'))(q, k, v)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=2e-3, atol=2e-3)
+
+    def test_flash_body_gradients(self):
+        """Backward re-derives via the einsum reference (custom_vjp):
+        grads match the dense reference."""
+        mesh = _mesh(2)
+        q, k, v = _rand_qkv(b=4, s=512, h=2, hkv=2, d=128)
+
+        def loss_ring(q, k, v):
+            return jnp.sum(ring_attention(q, k, v, mesh, causal=True,
+                                          layout='zigzag',
+                                          block_impl='flash') ** 2)
+
+        def loss_ref(q, k, v):
+            return jnp.sum(reference_attention(q, k, v,
+                                               causal=True) ** 2)
+
+        with mesh:
+            g_ring = jax.jit(jax.grad(loss_ring, (0, 1, 2)))(q, k, v)
+        g_ref = jax.grad(loss_ref, (0, 1, 2))(q, k, v)
+        for a, b, name in zip(g_ring, g_ref, 'qkv'):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=5e-3, atol=5e-3,
+                                       err_msg=f'd{name}')
