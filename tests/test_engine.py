@@ -7,6 +7,10 @@ import pytest
 from skypilot_tpu.inference.engine import InferenceEngine, _bucket_len
 from skypilot_tpu.models import configs, llama
 
+# Compile-heavy (jit of full models): slow tier — the fast sweep is
+# the orchestration layer (SURVEY §4 offline tier analog).
+pytestmark = pytest.mark.slow
+
 
 @pytest.fixture(scope='module')
 def engine_setup():

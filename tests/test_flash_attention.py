@@ -13,6 +13,10 @@ import pytest
 from skypilot_tpu.ops.attention import reference_attention
 from skypilot_tpu.ops.flash_attention import flash_attention
 
+# Compile-heavy (jit of full models): slow tier — the fast sweep is
+# the orchestration layer (SURVEY §4 offline tier analog).
+pytestmark = pytest.mark.slow
+
 _INTERPRET = jax.default_backend() != 'tpu'
 
 

@@ -10,6 +10,10 @@ from skypilot_tpu.models import configs, llama
 from skypilot_tpu.parallel import mesh as mesh_lib
 from skypilot_tpu.train.trainer import TrainConfig, Trainer
 
+# Compile-heavy (jit of full models): slow tier — the fast sweep is
+# the orchestration layer (SURVEY §4 offline tier analog).
+pytestmark = pytest.mark.slow
+
 
 @pytest.fixture(scope='module')
 def tiny_params():

@@ -1,6 +1,7 @@
 """MoE capacity-dispatch tests: exactness vs a dense masked reference
 when capacity is ample, drop semantics when it is not, capacity math,
 and balanced-routing aux loss."""
+import pytest
 import dataclasses
 
 import jax
@@ -8,6 +9,10 @@ import jax.numpy as jnp
 import numpy as np
 
 from skypilot_tpu.models import configs, llama, moe
+
+# Compile-heavy (jit of full models): slow tier — the fast sweep is
+# the orchestration layer (SURVEY §4 offline tier analog).
+pytestmark = pytest.mark.slow
 
 
 def _dense_reference(layer, x, cfg):

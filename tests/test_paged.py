@@ -10,6 +10,10 @@ from skypilot_tpu.inference.paged import (PageAllocator,
                                           PagedInferenceEngine)
 from skypilot_tpu.models import configs, llama
 
+# Compile-heavy (jit of full models): slow tier — the fast sweep is
+# the orchestration layer (SURVEY §4 offline tier analog).
+pytestmark = pytest.mark.slow
+
 
 @pytest.fixture(scope='module')
 def setup():

@@ -17,6 +17,10 @@ import pytest
 from skypilot_tpu.models import configs, llama, weights
 from skypilot_tpu.models.tokenizer import (ByteTokenizer, load_tokenizer)
 
+# Compile-heavy (jit of full models): slow tier — the fast sweep is
+# the orchestration layer (SURVEY §4 offline tier analog).
+pytestmark = pytest.mark.slow
+
 jax.config.update('jax_platforms', 'cpu')
 
 
