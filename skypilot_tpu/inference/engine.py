@@ -70,14 +70,23 @@ def _bucket_len(n: int, minimum: int = 64) -> int:
 def prepare_params(cfg: ModelConfig, params, *, quantize=None, mesh=None,
                    donate_params: bool = False):
     """Shared param preparation for the slot and paged engines:
-    init-if-absent, optional int8 quantization, mesh sharding.
-    Returns (params, effective_quantize).
+    LoRA merge, init-if-absent, optional int8 quantization, mesh
+    sharding. Returns (cfg, params, effective_quantize) — cfg changes
+    when a LoRA checkpoint is folded (lora_rank drops to 0).
 
-    Ordering matters for HBM: on a mesh the bf16 tree is sharded FIRST
-    so a 7B-class checkpoint never has to fit (bf16 + int8) on one chip;
-    single-device quantization frees each bf16 leaf as its int8
-    replacement lands when ``donate_params``."""
+    Ordering matters twice: LoRA adapters fold BEFORE quantization
+    (folding into an int8 base is refused), and on a mesh the bf16 tree
+    is sharded FIRST so a 7B-class checkpoint never has to fit
+    (bf16 + int8) on one chip; single-device quantization frees each
+    bf16 leaf as its int8 replacement lands when ``donate_params``."""
+    from skypilot_tpu.models import lora as lora_lib
     from skypilot_tpu.models import quantization
+    # A LoRA checkpoint serves as its merged model: fold the adapters
+    # into the base once; decode then runs the plain weight path.
+    # ``donate_params`` lets the fold reuse the base buffers (peak HBM
+    # = |W| + one layer's delta instead of 2|W|).
+    cfg, params = lora_lib.maybe_merge(cfg, params,
+                                       donate=donate_params)
     if params is None:
         params = llama.init_params(jax.random.PRNGKey(0), cfg)
     if quantize is not None and quantize != 'int8':
@@ -104,7 +113,7 @@ def prepare_params(cfg: ModelConfig, params, *, quantize=None, mesh=None,
             llama.param_logical_axes(cfg))
         params = jax.device_put(params, mesh_lib.tree_shardings(
             qaxes, mesh, shapes=params))
-    return params, quantize
+    return cfg, params, quantize
 
 
 class _EngineBase:
@@ -234,16 +243,16 @@ class InferenceEngine(_EngineBase):
                  attn_impl: str = 'auto',
                  quantize: Optional[str] = None,
                  donate_params: bool = False):
-        self.cfg = cfg
         self.max_batch = max_batch
         self.max_seq = max_seq
         self.mesh = mesh
         self.attn_impl = attn_impl
         self._rng = jax.random.PRNGKey(rng_seed)
 
-        self.params, quantize = prepare_params(
+        cfg, self.params, quantize = prepare_params(
             cfg, params, quantize=quantize, mesh=mesh,
             donate_params=donate_params)
+        self.cfg = cfg
         # Actual stored parameter bytes (int8 leaves count 1B/elem) —
         # sizes the decode-horizon ring cap against the true weight
         # stream, not a bf16 assumption.

@@ -469,7 +469,6 @@ class PagedInferenceEngine(_EngineBase):
                  decode_impl: str = 'auto'):
         from skypilot_tpu.inference.engine import prepare_params
         from skypilot_tpu.parallel import mesh as mesh_lib
-        self.cfg = cfg
         self.max_batch = max_batch
         self.max_seq = max_seq
         self.page = page_size
@@ -477,9 +476,10 @@ class PagedInferenceEngine(_EngineBase):
         self.mesh = mesh
         self.attn_impl = attn_impl
         self._rng = jax.random.PRNGKey(rng_seed)
-        self.params, quantize = prepare_params(
+        cfg, self.params, quantize = prepare_params(
             cfg, params, quantize=quantize, mesh=mesh,
             donate_params=donate_params)
+        self.cfg = cfg
         from skypilot_tpu.models import quantization
         self._param_bytes = quantization.quantized_bytes(self.params)
 

@@ -46,6 +46,23 @@ class ModelConfig:
     head_dim_override: Optional[int] = None
     # Qwen2-family: biases on the q/k/v projections (attention only).
     qkv_bias: bool = False
+    # LoRA fine-tuning (reference recipe parity: torchtune LoRA at
+    # ``llm/llama-3_1-finetuning/lora.yaml``). rank > 0 adds low-rank
+    # adapter leaves under ``params['layers']['lora']``; the trainer
+    # freezes the base and trains only the adapters. ``lora_targets``
+    # names the projections to adapt ('wq','wk','wv','wo' always legal;
+    # 'w_gate','w_up','w_down' for dense-FFN models).
+    lora_rank: int = 0
+    lora_alpha: float = 16.0
+    lora_targets: tuple = ('wq', 'wk', 'wv', 'wo')
+
+    @property
+    def lora_enabled(self) -> bool:
+        return self.lora_rank > 0
+
+    @property
+    def lora_scale(self) -> float:
+        return self.lora_alpha / max(self.lora_rank, 1)
 
     @property
     def head_dim(self) -> int:

@@ -88,6 +88,9 @@ def init_params(rng: jax.Array, cfg: ModelConfig) -> Params:
             'w_up': stack_init(k2, (d, f), d),
             'w_down': stack_init(k3, (f, d), f),
         })
+    if cfg.lora_enabled:
+        from skypilot_tpu.models import lora
+        params['layers']['lora'] = lora.init_lora_layers(keys[7], cfg)
     return params
 
 
@@ -124,6 +127,9 @@ def param_logical_axes(cfg: ModelConfig) -> Params:
             'w_up': ('layers', 'embed', 'mlp'),
             'w_down': ('layers', 'mlp', 'embed'),
         })
+    if cfg.lora_enabled:
+        from skypilot_tpu.models import lora
+        axes['layers']['lora'] = lora.lora_logical_axes(cfg)
     return axes
 
 
@@ -350,13 +356,22 @@ def _shard(x: jax.Array, *logical_axes: Optional[str]) -> jax.Array:
 
 def _ffn(layer: Params, x: jax.Array, cfg: ModelConfig) -> jax.Array:
     from skypilot_tpu.models.quantization import deq
+    lo = layer.get('lora') if isinstance(layer, dict) else None
     gate = jnp.einsum('bsd,df->bsf', x, deq(layer['w_gate']))
     up = jnp.einsum('bsd,df->bsf', x, deq(layer['w_up']))
+    if lo is not None:
+        from skypilot_tpu.models import lora as lora_lib
+        gate = gate + lora_lib.apply(lo, 'w_gate', x, cfg)
+        up = up + lora_lib.apply(lo, 'w_up', x, cfg)
     act = jax.nn.silu if cfg.activation == 'silu' else \
         functools.partial(jax.nn.gelu, approximate=True)
     h = act(gate.astype(jnp.float32)).astype(x.dtype) * up
     h = _shard(h, 'batch', 'seq', 'mlp')
-    return jnp.einsum('bsf,fd->bsd', h, deq(layer['w_down']))
+    down = jnp.einsum('bsf,fd->bsd', h, deq(layer['w_down']))
+    if lo is not None:
+        from skypilot_tpu.models import lora as lora_lib
+        down = down + lora_lib.apply(lo, 'w_down', h, cfg)
+    return down
 
 
 def _layer_core(layer: Params, x: jax.Array, cfg: ModelConfig,
@@ -371,9 +386,15 @@ def _layer_core(layer: Params, x: jax.Array, cfg: ModelConfig,
     h = rms_norm(x, layer['attn_norm'], cfg.norm_eps,
                   cfg.norm_plus_one)
     from skypilot_tpu.models.quantization import deq
+    lo = layer.get('lora') if isinstance(layer, dict) else None
     q = jnp.einsum('bsd,dhk->bshk', h, deq(layer['wq']))
     k = jnp.einsum('bsd,dhk->bshk', h, deq(layer['wk']))
     v = jnp.einsum('bsd,dhk->bshk', h, deq(layer['wv']))
+    if lo is not None:
+        from skypilot_tpu.models import lora as lora_lib
+        q = q + lora_lib.apply(lo, 'wq', h, cfg)
+        k = k + lora_lib.apply(lo, 'wk', h, cfg)
+        v = v + lora_lib.apply(lo, 'wv', h, cfg)
     if cfg.qkv_bias:
         q = q + layer['bq'].astype(q.dtype)
         k = k + layer['bk'].astype(k.dtype)
@@ -388,7 +409,10 @@ def _layer_core(layer: Params, x: jax.Array, cfg: ModelConfig,
     # forward, at [b,s,h,d] bytes per layer.
     out = checkpoint_name(out, 'attn_out')
     out = _shard(out, 'batch', 'seq', 'heads', 'head_dim')
-    x = x + jnp.einsum('bshk,hkd->bsd', out, deq(layer['wo']))
+    proj = jnp.einsum('bshk,hkd->bsd', out, deq(layer['wo']))
+    if lo is not None:
+        proj = proj + lora_lib.apply(lo, 'wo', out, cfg)
+    x = x + proj
     h = rms_norm(x, layer['ffn_norm'], cfg.norm_eps,
                  cfg.norm_plus_one)
     if cfg.is_moe:

@@ -1,0 +1,218 @@
+"""LoRA adapters for the in-tree models (TPU-native fine-tuning).
+
+Reference parity: the reference fine-tunes via an external framework
+(torchtune recipe ``llm/llama-3_1-finetuning/lora.yaml``); here LoRA is
+in-tree and mesh-native:
+
+- Adapter leaves live under ``params['layers']['lora'][target]['a'|'b']``
+  with the layer dimension stacked on the leading axis — they ride the
+  existing layer ``lax.scan``, the pipeline stage split, and the
+  logical-axis sharding machinery with zero special cases.
+- ``a`` contracts the projection's input axes down to ``rank`` (Gaussian
+  init), ``b`` expands ``rank`` to the output axes (zero init), so the
+  delta starts at exactly 0 and the adapted model's first forward equals
+  the base model bit-for-bit.
+- Sharding: ``b``'s output axes use the SAME logical names as the parent
+  weight (heads/head_dim, mlp, embed), ``a``'s input axes likewise, and
+  the rank axis replicates — under tp the low-rank matmuls compose with
+  the parent's sharding without extra collectives.
+- ``merge(cfg, params)`` folds ``W + (alpha/rank) * A @ B`` for serving;
+  the engines call ``maybe_merge`` so a LoRA checkpoint can be served
+  directly.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from skypilot_tpu.models.configs import ModelConfig
+
+Params = Dict[str, Any]
+
+_ATTN_TARGETS = ('wq', 'wk', 'wv', 'wo')
+_MLP_TARGETS = ('w_gate', 'w_up', 'w_down')
+
+
+def resolve_targets(cfg: ModelConfig) -> Tuple[str, ...]:
+    """Validated adapter targets for this config."""
+    targets = tuple(cfg.lora_targets)
+    for t in targets:
+        if t not in _ATTN_TARGETS + _MLP_TARGETS:
+            raise ValueError(
+                f'unknown LoRA target {t!r}; legal: '
+                f'{_ATTN_TARGETS + _MLP_TARGETS}')
+        if t in _MLP_TARGETS and cfg.is_moe:
+            raise ValueError(
+                f'LoRA target {t!r} needs a dense FFN; {cfg.name} is MoE '
+                f'(adapt the attention projections instead)')
+    return targets
+
+
+def _target_shapes(cfg: ModelConfig, target: str):
+    """(a_shape, b_shape) WITHOUT the leading layer axis. ``a`` ends in
+    rank; ``b`` starts with rank."""
+    d, hd, r = cfg.dim, cfg.head_dim, cfg.lora_rank
+    n_h, n_kv, f = cfg.n_heads, cfg.n_kv_heads, cfg.ffn_dim
+    return {
+        'wq': ((d, r), (r, n_h, hd)),
+        'wk': ((d, r), (r, n_kv, hd)),
+        'wv': ((d, r), (r, n_kv, hd)),
+        'wo': ((n_h, hd, r), (r, d)),
+        'w_gate': ((d, r), (r, f)),
+        'w_up': ((d, r), (r, f)),
+        'w_down': ((f, r), (r, d)),
+    }[target]
+
+
+def _target_axes(target: str):
+    """Logical axes for (a, b), leading 'layers' axis included. The rank
+    axis is None (replicated); input/output axes mirror the parent's."""
+    axes = {
+        'wq': (('embed', None), (None, 'heads', 'head_dim')),
+        'wk': (('embed', None), (None, 'kv_heads', 'head_dim')),
+        'wv': (('embed', None), (None, 'kv_heads', 'head_dim')),
+        'wo': (('heads', 'head_dim', None), (None, 'embed')),
+        'w_gate': (('embed', None), (None, 'mlp')),
+        'w_up': (('embed', None), (None, 'mlp')),
+        'w_down': (('mlp', None), (None, 'embed')),
+    }[target]
+    return tuple(('layers',) + a for a in axes)
+
+
+def init_lora_layers(rng: jax.Array, cfg: ModelConfig) -> Params:
+    """The ``params['layers']['lora']`` subtree: per-target a/b stacks.
+
+    ``a`` ~ N(0, 1/fan_in), ``b`` = 0 (standard LoRA init: the delta is
+    exactly zero until training moves ``b``). Adapters train in fp32 —
+    they are tiny next to the base, and the low-rank product is cast to
+    the activation dtype at apply time."""
+    targets = resolve_targets(cfg)
+    L = cfg.n_layers
+    out: Params = {}
+    keys = jax.random.split(rng, len(targets))
+    for key, t in zip(keys, targets):
+        a_shape, b_shape = _target_shapes(cfg, t)
+        fan_in = 1
+        for s in a_shape[:-1]:
+            fan_in *= s
+        out[t] = {
+            'a': (jax.random.normal(key, (L,) + a_shape, jnp.float32)
+                  * fan_in ** -0.5),
+            'b': jnp.zeros((L,) + b_shape, jnp.float32),
+        }
+    return out
+
+
+def lora_logical_axes(cfg: ModelConfig) -> Params:
+    return {t: {'a': _target_axes(t)[0], 'b': _target_axes(t)[1]}
+            for t in resolve_targets(cfg)}
+
+
+def _ab_matmul(x: jax.Array, a: jax.Array, b: jax.Array,
+               target: str) -> jax.Array:
+    """x -> (x @ a) @ b for one UNSTACKED layer's adapter (inside the
+    layer scan the leading layer axis is already consumed)."""
+    dt = x.dtype
+    if target == 'wo':                       # x: [b,s,h,k]
+        z = jnp.einsum('bshk,hkr->bsr', x, a.astype(dt))
+        return jnp.einsum('bsr,rd->bsd', z, b.astype(dt))
+    z = jnp.einsum('bsd,dr->bsr', x, a.astype(dt))
+    if target in ('wq', 'wk', 'wv'):
+        return jnp.einsum('bsr,rhk->bshk', z, b.astype(dt))
+    return jnp.einsum('bsr,rf->bsf', z, b.astype(dt))
+
+
+def apply(lora_layer: Params, target: str, x: jax.Array,
+          cfg: ModelConfig) -> jax.Array:
+    """The scaled low-rank delta for ``target``, or 0 if not adapted."""
+    if lora_layer is None or target not in lora_layer:
+        return jnp.zeros((), x.dtype)
+    ab = lora_layer[target]
+    return cfg.lora_scale * _ab_matmul(x, ab['a'], ab['b'], target)
+
+
+def merge(cfg: ModelConfig, params: Params, *,
+          donate: bool = False) -> Tuple[ModelConfig, Params]:
+    """Fold the adapters into the base weights for serving:
+    ``W <- W + (alpha/rank) * A @ B`` per target, per layer (stacked
+    einsum). Returns (cfg with lora off, params without 'lora').
+
+    Only a bf16/fp32 base can be merged — quantize AFTER merging."""
+    from skypilot_tpu.models.quantization import is_quantized
+    layers = params['layers']
+    if 'lora' not in layers:
+        return dataclasses.replace(cfg, lora_rank=0), params
+    if is_quantized(params):
+        raise ValueError('cannot merge LoRA into an int8 base; load the '
+                         'bf16 checkpoint, merge, then quantize')
+    # The fold scale comes from the CONFIG (alpha/rank): refuse to guess
+    # when the config says no-LoRA but the tree carries adapters (e.g. a
+    # trainer checkpoint served with the stock base config) — a silent
+    # alpha/1 fold would corrupt every adapted weight.
+    first_ab = next(iter(layers['lora'].values()))
+    tree_rank = int(first_ab['a'].shape[-1])
+    if not cfg.lora_enabled:
+        raise ValueError(
+            f'params carry LoRA adapters (rank {tree_rank}) but '
+            f'cfg.lora_rank == 0; pass the training config, e.g. '
+            f'dataclasses.replace(cfg, lora_rank={tree_rank}, '
+            f'lora_alpha=<alpha used in training>)')
+    if tree_rank != cfg.lora_rank:
+        raise ValueError(
+            f'adapter rank in params ({tree_rank}) != cfg.lora_rank '
+            f'({cfg.lora_rank})')
+    scale = cfg.lora_scale
+    specs = {
+        'wq': 'dr,rhk->dhk', 'wk': 'dr,rhk->dhk',
+        'wv': 'dr,rhk->dhk', 'wo': 'hkr,rd->hkd',
+        'w_gate': 'dr,rf->df', 'w_up': 'dr,rf->df',
+        'w_down': 'fr,rd->fd',
+    }
+
+    def fold(w, a, b, spec):
+        # Per-layer map in the BASE dtype: the fp32 stacked delta of a
+        # 7B MLP target would be ~6 GB — a transient the serving load
+        # path must never materialize (merge runs before mesh
+        # sharding). With ``donate`` the base stack's buffer is reused,
+        # keeping the peak at |W| + one layer's delta; without it the
+        # caller keeps its tree (tests, REPL) at a |W| copy's cost.
+        def per_layer(args):
+            w_l, a_l, b_l = args
+            d = jnp.einsum(spec, a_l.astype(w_l.dtype),
+                           b_l.astype(w_l.dtype))
+            return w_l + (scale * d).astype(w_l.dtype)
+        return jax.lax.map(per_layer, (w, a, b))
+
+    new_layers = dict(layers)
+    lora_tree = new_layers.pop('lora')
+    fold_jit = jax.jit(fold, static_argnums=3,
+                       donate_argnums=(0,) if donate else ())
+    for t, ab in lora_tree.items():
+        new_layers[t] = fold_jit(new_layers[t], ab['a'], ab['b'],
+                                 specs[t])
+    merged = dict(params, layers=new_layers)
+    return dataclasses.replace(cfg, lora_rank=0), merged
+
+
+def maybe_merge(cfg: ModelConfig, params, *,
+                donate: bool = False) -> Tuple[ModelConfig, Any]:
+    """Engine entry: serve a LoRA checkpoint by folding its adapters.
+    No-op when params is None or carries no adapters."""
+    if params is None or 'lora' not in params.get('layers', {}):
+        if cfg.lora_enabled:
+            cfg = dataclasses.replace(cfg, lora_rank=0)
+        return cfg, params
+    return merge(cfg, params, donate=donate)
+
+
+def split_lora(params: Params) -> Params:
+    """The trainable adapter subtree (shared structure with params)."""
+    return params['layers']['lora']
+
+
+def with_lora(params: Params, lora_tree: Params) -> Params:
+    """params with its adapter subtree replaced (pure; no mutation)."""
+    return dict(params, layers=dict(params['layers'], lora=lora_tree))

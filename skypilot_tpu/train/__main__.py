@@ -45,6 +45,16 @@ def main(argv=None) -> None:
     parser.add_argument('--save-every', type=int, default=500)
     parser.add_argument('--from-pretrained', default=None,
                         help='HF checkpoint dir to fine-tune from')
+    parser.add_argument('--lora-rank', type=int, default=0,
+                        help='> 0 enables LoRA fine-tuning: the base is '
+                             'frozen, only low-rank adapters train '
+                             '(models/lora.py)')
+    parser.add_argument('--lora-alpha', type=float, default=16.0)
+    parser.add_argument('--lora-targets', default='wq,wk,wv,wo',
+                        help='comma-separated projections to adapt')
+    parser.add_argument('--adapter-out', default=None,
+                        help='where to save the final adapter-only '
+                             'checkpoint (LoRA runs)')
     parser.add_argument('--tp', type=int, default=None)
     parser.add_argument('--sp', type=int, default=1)
     parser.add_argument('--attn-impl', default='auto')
@@ -60,6 +70,12 @@ def main(argv=None) -> None:
     from skypilot_tpu.train.trainer import TrainConfig, Trainer
 
     cfg = configs.get_config(args.model)
+    if args.lora_rank > 0:
+        import dataclasses
+        cfg = dataclasses.replace(
+            cfg, lora_rank=args.lora_rank, lora_alpha=args.lora_alpha,
+            lora_targets=tuple(
+                t.strip() for t in args.lora_targets.split(',') if t))
     trainer = Trainer(
         cfg,
         mesh_spec=(mesh_lib.spec_from_env(tp=args.tp, sp=args.sp)
@@ -126,6 +142,9 @@ def main(argv=None) -> None:
             _save(trainer, state, args.ckpt_dir)
     if args.ckpt_dir:
         _save(trainer, state, args.ckpt_dir)
+    if args.lora_rank > 0 and args.adapter_out:
+        trainer.save_adapter(os.path.abspath(args.adapter_out), state)
+        print(f'[train] adapter saved: {args.adapter_out}', flush=True)
     print(f'[train] done at step {int(state.step)}', flush=True)
 
 

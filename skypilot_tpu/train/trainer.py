@@ -48,9 +48,15 @@ class TrainConfig:
     # negligible quality impact (the noisy moment tolerates it; the
     # variance stays fp32) — lets ~1B-param models train on one 16GB chip.
     mu_dtype: str = 'float32'
+    # LoRA weight decay (applied to adapter leaves when the model config
+    # has lora_rank > 0; the frozen base takes no updates at all, so
+    # tc.weight_decay never touches it). 0.0 is the standard choice.
+    lora_weight_decay: float = 0.0
 
 
-def make_optimizer(tc: TrainConfig) -> optax.GradientTransformation:
+def make_optimizer(tc: TrainConfig,
+                   weight_decay: Optional[float] = None
+                   ) -> optax.GradientTransformation:
     # Clamp warmup below the step budget: optax requires positive decay
     # span (a short --steps run with the default warmup would crash).
     warmup = min(tc.warmup_steps, max(tc.total_steps - 1, 0))
@@ -61,7 +67,8 @@ def make_optimizer(tc: TrainConfig) -> optax.GradientTransformation:
     return optax.chain(
         optax.clip_by_global_norm(tc.grad_clip_norm),
         optax.adamw(schedule, b1=tc.b1, b2=tc.b2,
-                    weight_decay=tc.weight_decay,
+                    weight_decay=(tc.weight_decay if weight_decay is None
+                                  else weight_decay),
                     mu_dtype=jnp.dtype(tc.mu_dtype)),
     )
 
@@ -114,7 +121,14 @@ class Trainer:
             mesh = mesh_lib.make_mesh(spec)
         self.mesh = mesh
         self.rules = rules or mesh_lib.DEFAULT_RULES
-        self.optimizer = make_optimizer(self.tc)
+        # LoRA configs train ONLY the adapter subtree: grads, updates,
+        # and optimizer moments are adapter-sized (the memory win that
+        # makes fine-tuning a 7B on one chip possible); the base is
+        # frozen bit-for-bit.
+        self._lora = cfg.lora_enabled
+        self.optimizer = make_optimizer(
+            self.tc, weight_decay=(self.tc.lora_weight_decay
+                                   if self._lora else None))
 
         self._params_shape = jax.eval_shape(
             functools.partial(llama.init_params, cfg=cfg),
@@ -122,6 +136,13 @@ class Trainer:
         self.param_shardings = mesh_lib.tree_shardings(
             llama.param_logical_axes(cfg), mesh, self.rules,
             shapes=self._params_shape)
+        if self._lora:
+            self._trainable_shape = self._params_shape['layers']['lora']
+            self._trainable_shardings = \
+                self.param_shardings['layers']['lora']
+        else:
+            self._trainable_shape = self._params_shape
+            self._trainable_shardings = self.param_shardings
         self.state_shardings = self._state_shardings()
         self.batch_sharding = mesh_lib.batch_sharding(mesh, self.rules)
 
@@ -138,22 +159,23 @@ class Trainer:
 
     # ---------------- sharding derivation ----------------
     def _state_shardings(self) -> TrainState:
-        """Derive opt_state shardings: any subtree with the same structure as
-        params gets the param shardings (adam mu/nu); everything else is
+        """Derive opt_state shardings: any subtree with the same structure
+        as the TRAINABLE tree (full params, or the LoRA adapter subtree)
+        gets that tree's shardings (adam mu/nu); everything else is
         replicated (scalars like count)."""
-        params_shape = self._params_shape
-        opt_shape = jax.eval_shape(self.optimizer.init, params_shape)
-        params_treedef = jax.tree.structure(params_shape)
+        trainable_shape = self._trainable_shape
+        opt_shape = jax.eval_shape(self.optimizer.init, trainable_shape)
+        trainable_treedef = jax.tree.structure(trainable_shape)
         replicated = NamedSharding(self.mesh, PartitionSpec())
 
         def map_opt(node):
-            if jax.tree.structure(node) == params_treedef:
-                return self.param_shardings
+            if jax.tree.structure(node) == trainable_treedef:
+                return self._trainable_shardings
             return jax.tree.map(lambda _: replicated, node)
 
         opt_shardings = jax.tree.map(
             map_opt, opt_shape,
-            is_leaf=lambda n: (jax.tree.structure(n) == params_treedef
+            is_leaf=lambda n: (jax.tree.structure(n) == trainable_treedef
                                if not isinstance(n, jax.ShapeDtypeStruct)
                                else True))
         return TrainState(step=replicated, params=self.param_shardings,
@@ -162,17 +184,38 @@ class Trainer:
     # ---------------- init / step ----------------
     def _init_fn(self, rng: jax.Array) -> TrainState:
         params = llama.init_params(rng, self.cfg)
-        opt_state = self.optimizer.init(params)
+        opt_state = self.optimizer.init(self._trainable(params))
         return TrainState(step=jnp.zeros((), jnp.int32), params=params,
                           opt_state=opt_state)
 
+    def _trainable(self, params):
+        from skypilot_tpu.models import lora as lora_lib
+        return lora_lib.split_lora(params) if self._lora else params
+
     def _step_fn(self, state: TrainState, batch) -> Tuple[TrainState, Dict]:
-        (_, metrics), grads = jax.value_and_grad(
-            loss_fn, has_aux=True)(state.params, batch, self.cfg,
-                                   self.tc.attn_impl, self.tc.moe_aux_weight)
-        updates, new_opt = self.optimizer.update(grads, state.opt_state,
-                                                 state.params)
-        new_params = optax.apply_updates(state.params, updates)
+        if self._lora:
+            from skypilot_tpu.models import lora as lora_lib
+
+            def lora_loss(lora_tree, batch):
+                return loss_fn(lora_lib.with_lora(state.params, lora_tree),
+                               batch, self.cfg, self.tc.attn_impl,
+                               self.tc.moe_aux_weight)
+
+            trainable = lora_lib.split_lora(state.params)
+            (_, metrics), grads = jax.value_and_grad(
+                lora_loss, has_aux=True)(trainable, batch)
+            updates, new_opt = self.optimizer.update(grads, state.opt_state,
+                                                     trainable)
+            new_params = lora_lib.with_lora(
+                state.params, optax.apply_updates(trainable, updates))
+        else:
+            (_, metrics), grads = jax.value_and_grad(
+                loss_fn, has_aux=True)(state.params, batch, self.cfg,
+                                       self.tc.attn_impl,
+                                       self.tc.moe_aux_weight)
+            updates, new_opt = self.optimizer.update(grads, state.opt_state,
+                                                     state.params)
+            new_params = optax.apply_updates(state.params, updates)
         metrics['grad_norm'] = optax.global_norm(grads)
         return TrainState(step=state.step + 1, params=new_params,
                           opt_state=new_opt), metrics
@@ -184,14 +227,21 @@ class Trainer:
     def init_from_pretrained(self, path: str) -> TrainState:
         """Start training from an HF checkpoint (fine-tuning entry):
         params come from the checkpoint (sharded per the param rules),
-        optimizer state is fresh."""
+        optimizer state is fresh. Under LoRA the checkpoint carries no
+        adapters — fresh ones are initialized (delta starts at 0)."""
         from skypilot_tpu.models import weights
         params = weights.load_hf_params(path, self.cfg)
+        if self._lora and 'lora' not in params['layers']:
+            from skypilot_tpu.models import lora as lora_lib
+            params = lora_lib.with_lora(
+                params,
+                lora_lib.init_lora_layers(jax.random.PRNGKey(0), self.cfg))
         params = jax.device_put(params, self.param_shardings)
 
         def init_opt(p):
             return TrainState(step=jnp.zeros((), jnp.int32), params=p,
-                              opt_state=self.optimizer.init(p))
+                              opt_state=self.optimizer.init(
+                                  self._trainable(p)))
 
         with self.mesh:
             return jax.jit(init_opt,
@@ -248,3 +298,62 @@ class Trainer:
                                                    sharding=sh),
                 like, self.state_shardings)
         return ckpt.restore(path, like)
+
+    # ---------------- LoRA adapter checkpoints ----------------
+    def save_adapter(self, path: str, state: TrainState) -> None:
+        """Adapter-only checkpoint: the LoRA subtree, megabytes instead
+        of the base's gigabytes (the artifact a fine-tuning job ships)."""
+        from skypilot_tpu.models import lora as lora_lib
+        if not self._lora:
+            raise ValueError('save_adapter requires a LoRA config '
+                             '(cfg.lora_rank > 0)')
+        import orbax.checkpoint as ocp
+        ckpt = ocp.StandardCheckpointer()
+        ckpt.save(path, lora_lib.split_lora(state.params), force=True)
+        ckpt.wait_until_finished()
+        # Sidecar metadata: rank is recoverable from the tree, but a
+        # wrong lora_alpha at serve time would silently mis-scale the
+        # fold — record the full adapter config so load can validate.
+        import json
+        with open(self._adapter_meta_path(path), 'w',
+                  encoding='utf-8') as f:
+            json.dump({'lora_rank': self.cfg.lora_rank,
+                       'lora_alpha': self.cfg.lora_alpha,
+                       'lora_targets': list(self.cfg.lora_targets)}, f)
+
+    @staticmethod
+    def _adapter_meta_path(path: str) -> str:
+        return path.rstrip('/') + '.lora.json'
+
+    def load_adapter(self, path: str, state: TrainState) -> TrainState:
+        """Swap a saved adapter into an existing state (base unchanged);
+        optimizer moments are NOT restored — use restore_checkpoint to
+        resume training exactly."""
+        from skypilot_tpu.models import lora as lora_lib
+        if not self._lora:
+            raise ValueError('load_adapter requires a LoRA config '
+                             '(cfg.lora_rank > 0)')
+        import json
+        import os
+        meta_path = self._adapter_meta_path(path)
+        if os.path.exists(meta_path):
+            with open(meta_path, encoding='utf-8') as f:
+                meta = json.load(f)
+            mine = {'lora_rank': self.cfg.lora_rank,
+                    'lora_alpha': self.cfg.lora_alpha,
+                    'lora_targets': list(self.cfg.lora_targets)}
+            if meta != mine:
+                raise ValueError(
+                    f'adapter at {path} was trained with {meta}, but '
+                    f'this trainer is configured with {mine}; a '
+                    f'mismatched alpha/rank would silently mis-scale '
+                    f'the fold')
+        import orbax.checkpoint as ocp
+        ckpt = ocp.StandardCheckpointer()
+        like = jax.tree.map(
+            lambda s, sh: jax.ShapeDtypeStruct(s.shape, s.dtype,
+                                               sharding=sh),
+            self._trainable_shape, self._trainable_shardings)
+        adapter = ckpt.restore(path, like)
+        return state._replace(
+            params=lora_lib.with_lora(state.params, adapter))

@@ -29,7 +29,8 @@ class TestParseAll:
         """The documented example set ships."""
         expected = {'minimal.yaml', 'tpu_hello.yaml', 'tpuvm_mnist.yaml',
                     'train_llama_job.yaml', 'serve_llama.yaml',
-                    'k8s_hello.yaml', 'multislice_train.yaml'}
+                    'k8s_hello.yaml', 'multislice_train.yaml',
+                    'finetune_lora.yaml'}
         assert expected.issubset(set(ALL_YAMLS)), ALL_YAMLS
 
     @pytest.mark.parametrize('yaml_name', ALL_YAMLS)
