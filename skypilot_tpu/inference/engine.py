@@ -13,7 +13,8 @@ recipes become. Design:
 - **Prefill/decode split**: prefill runs per-request at bucketed lengths
   (powers of two — bounded compile count), writes its KV rows into the
   slot; decode advances all active slots one token per step.
-- **Sampling**: greedy / temperature / top-k, jitted with the decode step.
+- **Sampling**: greedy / temperature / top-k / top-p (nucleus), jitted
+  with the decode step; per-request stop sequences checked host-side.
 - **Sharding**: with a mesh, params shard by their logical axes (tp for
   serving) and the KV cache by ``cache_logical_axes`` — batch over data
   axes, kv heads over tp.
@@ -46,7 +47,14 @@ class Request:
     max_new_tokens: int
     temperature: float = 0.0
     top_k: int = 0
+    top_p: float = 1.0
     eos_id: Optional[int] = None
+    # Stop sequences (token-id lists): decode finishes when the output
+    # ends with any of them; the matched suffix is trimmed from
+    # ``output``. NOTE a multi-token stop may partially stream before it
+    # matches — non-streaming callers always see the trimmed output.
+    stop: Optional[List[List[int]]] = None
+    stop_hit: bool = False
     # filled by the engine
     output: List[int] = dataclasses.field(default_factory=list)
     submit_time: float = 0.0
@@ -149,13 +157,19 @@ class _EngineBase:
     # ------------------------------------------------------------- API
     def add_request(self, prompt: List[int], max_new_tokens: int = 128,
                     temperature: float = 0.0, top_k: int = 0,
-                    eos_id: Optional[int] = None) -> int:
+                    top_p: float = 1.0, eos_id: Optional[int] = None,
+                    stop: Optional[List[List[int]]] = None) -> int:
         if not prompt:
             raise ValueError('empty prompt')
+        if not 0.0 < top_p <= 1.0:
+            raise ValueError(f'top_p must be in (0, 1], got {top_p}')
+        if stop:
+            stop = [list(s) for s in stop if s]
         self._validate_request(prompt, max_new_tokens)
         req = Request(request_id=self._next_id, prompt=list(prompt),
                       max_new_tokens=max_new_tokens, temperature=temperature,
-                      top_k=top_k, eos_id=eos_id, submit_time=time.time())
+                      top_k=top_k, top_p=top_p, eos_id=eos_id,
+                      stop=stop or None, submit_time=time.time())
         self._next_id += 1
         self._queue.append(req)
         return req.request_id
@@ -223,7 +237,18 @@ class _EngineBase:
 
     def _maybe_finish(self, slot: int, token: int) -> bool:
         req = self._slots[slot]
-        done = (len(req.output) >= req.max_new_tokens
+        # Stop sequences first: a stop completing exactly on the
+        # max_new_tokens/max_seq boundary must still be trimmed.
+        done = False
+        if req.stop:
+            for seq in req.stop:
+                if (len(req.output) >= len(seq)
+                        and req.output[-len(seq):] == seq):
+                    del req.output[-len(seq):]
+                    req.stop_hit = True
+                    done = True
+                    break
+        done = (done or len(req.output) >= req.max_new_tokens
                 or (req.eos_id is not None and token == req.eos_id)
                 or len(req.prompt) + len(req.output) >= self.max_seq)
         if done:
@@ -310,17 +335,12 @@ class InferenceEngine(_EngineBase):
         @functools.partial(jax.jit, donate_argnums=(1,),
                            static_argnames=('horizon', 'sample',
                                             'kv_bucket'))
-        def decode_steps(params, cache, tokens, rng, temps, topks, active,
-                         horizon, sample, kv_bucket):
+        def decode_steps(params, cache, tokens, rng, temps, topks, topps,
+                         active, horizon, sample, kv_bucket):
             if sample:
                 def sample_fn(logits, step_rng):
-                    next_greedy = jnp.argmax(logits, -1).astype(jnp.int32)
-                    scaled = logits / jnp.maximum(temps, 1e-6)[:, None]
-                    thr = _topk_threshold(scaled, topks)
-                    masked = jnp.where(scaled >= thr, scaled, -jnp.inf)
-                    sampled = jax.random.categorical(
-                        step_rng, masked).astype(jnp.int32)
-                    return jnp.where(temps > 0, sampled, next_greedy)
+                    return sample_tokens(logits, step_rng, temps, topks,
+                                         topps)
                 rngs = jax.random.split(rng, horizon)
             else:
                 sample_fn, rngs = None, None
@@ -472,6 +492,8 @@ class InferenceEngine(_EngineBase):
                          np.float32)
         topks = np.array([r.top_k if r else 0 for r in self._slots],
                          np.int32)
+        topps = np.array([r.top_p if r else 1.0 for r in self._slots],
+                         np.float32)
         sample = bool((temps > 0).any())
         # Length-aware KV reads: attention streams only the first
         # kv_bucket cache rows (decode is HBM-bound on this read). The
@@ -484,8 +506,8 @@ class InferenceEngine(_EngineBase):
         self._rng, rng = jax.random.split(self._rng)
         toks, self.cache = self._decode_fn(
             self.params, self.cache, jnp.asarray(self._cur_token), rng,
-            jnp.asarray(temps), jnp.asarray(topks), jnp.asarray(active),
-            horizon, sample, kv_bucket)
+            jnp.asarray(temps), jnp.asarray(topks), jnp.asarray(topps),
+            jnp.asarray(active), horizon, sample, kv_bucket)
         toks = np.asarray(toks)                       # [slots, horizon]
 
         events: List[Tuple[int, int, bool]] = []
@@ -504,10 +526,33 @@ class InferenceEngine(_EngineBase):
         return events
 
 
-def _topk_threshold(logits: jax.Array, topks: jax.Array) -> jax.Array:
-    """Per-row value of the k-th largest logit ([slots,1]); rows with k<=0
-    get -inf (no top-k filtering)."""
-    sorted_desc = -jnp.sort(-logits, axis=-1)
+def sample_tokens(logits: jax.Array, step_rng: jax.Array,
+                  temps: jax.Array, topks: jax.Array,
+                  topps: jax.Array) -> jax.Array:
+    """Per-slot next-token sampling, shared by the slot and paged
+    engines' fused decode: temperature scaling, then top-k and nucleus
+    (top-p) filtering on ONE descending sort of the scaled logits, then
+    categorical draw. Rows with temp <= 0 take the greedy argmax; top-k
+    <= 0 and top-p >= 1 disable their filters."""
+    next_greedy = jnp.argmax(logits, -1).astype(jnp.int32)
+    scaled = logits / jnp.maximum(temps, 1e-6)[:, None]
+    sorted_desc = -jnp.sort(-scaled, axis=-1)
     idx = jnp.clip(topks - 1, 0, logits.shape[-1] - 1)
-    thr = jnp.take_along_axis(sorted_desc, idx[:, None], axis=-1)
-    return jnp.where(topks[:, None] > 0, thr, -jnp.inf)
+    kth = jnp.take_along_axis(sorted_desc, idx[:, None], axis=-1)
+    thr_k = jnp.where(topks[:, None] > 0, kth, -jnp.inf)
+    # Nucleus: keep the smallest prefix of the (top-k-filtered) sorted
+    # distribution whose mass reaches top_p. A token is kept iff the
+    # mass BEFORE it is < p, so the top-1 token always survives.
+    masked_sorted = jnp.where(sorted_desc >= thr_k, sorted_desc,
+                              -jnp.inf)
+    probs = jax.nn.softmax(masked_sorted.astype(jnp.float32), axis=-1)
+    cum = jnp.cumsum(probs, axis=-1)
+    keep = (cum - probs) < topps[:, None]
+    thr_p = jnp.min(jnp.where(keep, masked_sorted, jnp.inf), axis=-1,
+                    keepdims=True)
+    thr = jnp.maximum(thr_k, jnp.where(topps[:, None] < 1.0,
+                                       thr_p.astype(scaled.dtype),
+                                       -jnp.inf))
+    masked = jnp.where(scaled >= thr, scaled, -jnp.inf)
+    sampled = jax.random.categorical(step_rng, masked).astype(jnp.int32)
+    return jnp.where(temps > 0, sampled, next_greedy)

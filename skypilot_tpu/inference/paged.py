@@ -534,18 +534,12 @@ class PagedInferenceEngine(_EngineBase):
         @functools.partial(jax.jit, donate_argnums=(1,),
                            static_argnames=('horizon', 'sample'))
         def decode_steps(params, cache, table_p, tokens, lengths, rng,
-                         temps, topks, active, horizon, sample):
+                         temps, topks, topps, active, horizon, sample):
             if sample:
                 def sample_fn(logits, step_rng):
-                    from skypilot_tpu.inference.engine import \
-                        _topk_threshold
-                    next_greedy = jnp.argmax(logits, -1).astype(jnp.int32)
-                    scaled = logits / jnp.maximum(temps, 1e-6)[:, None]
-                    thr = _topk_threshold(scaled, topks)
-                    masked = jnp.where(scaled >= thr, scaled, -jnp.inf)
-                    sampled = jax.random.categorical(
-                        step_rng, masked).astype(jnp.int32)
-                    return jnp.where(temps > 0, sampled, next_greedy)
+                    from skypilot_tpu.inference.engine import sample_tokens
+                    return sample_tokens(logits, step_rng, temps, topks,
+                                         topps)
                 rngs = jax.random.split(rng, horizon)
             else:
                 sample_fn, rngs = None, None
@@ -758,6 +752,8 @@ class PagedInferenceEngine(_EngineBase):
         active = np.array([r is not None for r in self._slots])
         temps = np.array([r.temperature if r else 0.0
                           for r in self._slots], np.float32)
+        topps = np.array([r.top_p if r else 1.0 for r in self._slots],
+                         np.float32)
         topks = np.array([r.top_k if r else 0 for r in self._slots],
                          np.int32)
         sample = bool((temps > 0).any())
@@ -775,8 +771,8 @@ class PagedInferenceEngine(_EngineBase):
             self.params, self.cache, jnp.asarray(table_p),
             jnp.asarray(self._cur_token),
             jnp.asarray(self._slot_len.astype(np.int32)), rng,
-            jnp.asarray(temps), jnp.asarray(topks), jnp.asarray(active),
-            horizon, sample)
+            jnp.asarray(temps), jnp.asarray(topks), jnp.asarray(topps),
+            jnp.asarray(active), horizon, sample)
         toks = np.asarray(toks)
 
         events: List[Tuple[int, int, bool]] = []

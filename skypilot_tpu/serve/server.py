@@ -6,7 +6,10 @@ Endpoints:
 - ``GET /readiness`` — 200 once the engine has compiled its first step
   (the serve readiness-probe target).
 - ``POST /generate`` — ``{"prompt": [ids...], "max_new_tokens": N,
-  "temperature": t, "top_k": k}`` → ``{"tokens": [...], "ttft_ms": ...}``.
+  "temperature": t, "top_k": k, "top_p": p, "stop": [...]}`` →
+  ``{"tokens": [...], "ttft_ms": ...}``. ``stop`` entries are strings
+  (tokenized with the model tokenizer) or token-id lists; generation
+  ends when the output ends with any entry, which is trimmed.
 - ``GET /metrics`` — queue depth / active slots / counters.
 
 One background thread drives ``engine.step()`` continuously (the engine
@@ -127,14 +130,16 @@ class ModelServer:
                 sq.put((None, True))
 
     def submit(self, prompt, max_new_tokens: int, temperature: float,
-               top_k: int, eos_id: Optional[int]) -> Dict[str, Any]:
+               top_k: int, eos_id: Optional[int], top_p: float = 1.0,
+               stop=None) -> Dict[str, Any]:
         if self._error is not None:
             raise RuntimeError(f'engine failed: {self._error}')
         done = threading.Event()
         with self._lock:
             rid = self.engine.add_request(
                 prompt, max_new_tokens=max_new_tokens,
-                temperature=temperature, top_k=top_k, eos_id=eos_id)
+                temperature=temperature, top_k=top_k, top_p=top_p,
+                eos_id=eos_id, stop=stop)
             self._finished_events[rid] = done
             # _fatal wakes events under this same lock; if the engine died
             # between the check above and this registration, the event
@@ -156,7 +161,8 @@ class ModelServer:
         }
 
     def submit_stream(self, prompt, max_new_tokens: int, temperature: float,
-                      top_k: int, eos_id: Optional[int]):
+                      top_k: int, eos_id: Optional[int],
+                      top_p: float = 1.0, stop=None):
         """Register a streaming request; returns (request_id, token
         queue). The engine loop feeds (token, finished) tuples; callers
         must call finish_stream(rid) when done."""
@@ -167,7 +173,8 @@ class ModelServer:
         with self._lock:
             rid = self.engine.add_request(
                 prompt, max_new_tokens=max_new_tokens,
-                temperature=temperature, top_k=top_k, eos_id=eos_id)
+                temperature=temperature, top_k=top_k, top_p=top_p,
+                eos_id=eos_id, stop=stop)
             self._stream_queues[rid] = sq
             if self._error is not None:
                 sq.put((None, True))
@@ -288,11 +295,22 @@ class ModelServer:
                     eos_id = payload.get('eos_id')
                     if eos_id is None and is_text:
                         eos_id = tok.eos_id
+                    stop = payload.get('stop')
+                    if stop is not None:
+                        if isinstance(stop, (str, bytes)):
+                            stop = [stop]
+                        # bos=False: generated output never contains
+                        # BOS, so a BOS-prefixed stop would never match.
+                        stop = [tok.encode(s, bos=False)
+                                if isinstance(s, str)
+                                else [int(t) for t in s] for s in stop]
                     kwargs = dict(
                         max_new_tokens=int(
                             payload.get('max_new_tokens', 128)),
                         temperature=float(payload.get('temperature', 0.0)),
                         top_k=int(payload.get('top_k', 0)),
+                        top_p=float(payload.get('top_p', 1.0)),
+                        stop=stop,
                         eos_id=eos_id)
                     if payload.get('stream'):
                         self._stream_generate(prompt, is_text, kwargs)
@@ -301,7 +319,8 @@ class ModelServer:
                     if is_text:
                         result['text'] = tok.decode(result['tokens'])
                     self._json(200, result)
-                except (KeyError, ValueError, json.JSONDecodeError) as e:
+                except (KeyError, ValueError, TypeError,
+                        json.JSONDecodeError) as e:
                     self._json(400, {'error': f'{type(e).__name__}: {e}'})
                 except RuntimeError as e:
                     self._json(500, {'error': str(e)})

@@ -104,6 +104,45 @@ class TestEngine:
         # hot sampling at high temperature should not be constant
         assert len(set(toks)) > 1
 
+    def test_top_p_tiny_equals_greedy(self, engine_setup):
+        """top_p -> 0 collapses the nucleus to the single top token, so
+        even hot sampling reproduces the greedy output."""
+        cfg, params = engine_setup
+        outs = []
+        for top_p in (1e-6, None):      # None = greedy run
+            eng = InferenceEngine(cfg, params, max_batch=1, max_seq=128,
+                                  rng_seed=11, attn_impl='xla')
+            if top_p is None:
+                rid = eng.add_request([3, 1, 4], max_new_tokens=12)
+            else:
+                rid = eng.add_request([3, 1, 4], max_new_tokens=12,
+                                      temperature=2.0, top_p=top_p)
+            outs.append(eng.run_to_completion()[rid].output)
+        assert outs[0] == outs[1], outs
+
+    def test_top_p_validated(self, engine_setup):
+        cfg, params = engine_setup
+        eng = InferenceEngine(cfg, params, max_batch=1, max_seq=128,
+                              attn_impl='xla')
+        with pytest.raises(ValueError, match='top_p'):
+            eng.add_request([1, 2], max_new_tokens=2, top_p=0.0)
+        with pytest.raises(ValueError, match='top_p'):
+            eng.add_request([1, 2], max_new_tokens=2, top_p=1.5)
+
+    def test_stop_sequence_trims_and_finishes(self, engine_setup):
+        cfg, params = engine_setup
+        eng = InferenceEngine(cfg, params, max_batch=1, max_seq=128,
+                              attn_impl='xla')
+        rid = eng.add_request([3, 1, 4], max_new_tokens=12)
+        full = eng.run_to_completion()[rid].output
+        stop = full[2:4]                 # 2-token stop inside the output
+        eng2 = InferenceEngine(cfg, params, max_batch=1, max_seq=128,
+                               attn_impl='xla')
+        rid = eng2.add_request([3, 1, 4], max_new_tokens=12, stop=[stop])
+        req = eng2.run_to_completion()[rid]
+        assert req.stop_hit
+        assert req.output == full[:2], (req.output, full)
+
     def test_ttft_recorded(self, engine_setup):
         cfg, params = engine_setup
         eng = InferenceEngine(cfg, params, max_batch=1, max_seq=128,
@@ -112,6 +151,52 @@ class TestEngine:
         done = eng.run_to_completion()
         assert done[rid].ttft_ms is not None
         assert done[rid].finish_time >= done[rid].first_token_time
+
+
+class TestSampleTokens:
+    """Unit tests of the shared sampling op (no model)."""
+
+    def test_nucleus_restricts_support(self):
+        from skypilot_tpu.inference.engine import sample_tokens
+        # Row distribution: probs ~ [0.5, 0.25, 0.125, ...]; top_p=0.6
+        # keeps {0, 1} (mass before token 1 is 0.5 < 0.6; before token
+        # 2 it is 0.75 >= 0.6).
+        logits = jnp.log(jnp.array([[0.5, 0.25, 0.125, 0.0625, 0.0625]],
+                                   jnp.float32))
+        temps = jnp.ones((1,), jnp.float32)
+        topks = jnp.zeros((1,), jnp.int32)
+        topps = jnp.full((1,), 0.6, jnp.float32)
+        seen = set()
+        for i in range(50):
+            tok = sample_tokens(logits, jax.random.PRNGKey(i), temps,
+                                topks, topps)
+            seen.add(int(tok[0]))
+        assert seen == {0, 1}, seen
+
+    def test_top_p_one_keeps_full_support(self):
+        from skypilot_tpu.inference.engine import sample_tokens
+        logits = jnp.zeros((1, 4), jnp.float32)      # uniform
+        temps = jnp.ones((1,), jnp.float32)
+        topks = jnp.zeros((1,), jnp.int32)
+        topps = jnp.ones((1,), jnp.float32)
+        seen = {int(sample_tokens(logits, jax.random.PRNGKey(i), temps,
+                                  topks, topps)[0]) for i in range(80)}
+        assert seen == {0, 1, 2, 3}, seen
+
+    def test_composes_with_top_k(self):
+        from skypilot_tpu.inference.engine import sample_tokens
+        # top_k=3 cuts tokens 3-4; top_p=0.75 over the renormalized
+        # top-3 ([0.4, 0.33, 0.27]) keeps all three (mass before token
+        # 2 is 0.73 < 0.75). Distinct logits: ties at the k-th value
+        # would all pass the threshold.
+        logits = jnp.log(jnp.array(
+            [[0.3, 0.25, 0.2, 0.15, 0.1]], jnp.float32))
+        temps = jnp.ones((1,), jnp.float32)
+        topks = jnp.full((1,), 3, jnp.int32)
+        topps = jnp.full((1,), 0.75, jnp.float32)
+        seen = {int(sample_tokens(logits, jax.random.PRNGKey(i), temps,
+                                  topks, topps)[0]) for i in range(60)}
+        assert seen <= {0, 1, 2}, seen
 
 
 class TestInt8Quantization:

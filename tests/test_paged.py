@@ -76,6 +76,26 @@ class TestPagedEquivalence:
         done = eng.run_to_completion(horizon=4)
         assert len(set(done[rid].output)) > 1
 
+    def test_top_p_and_stop(self, setup):
+        """top_p -> 0 equals greedy under hot sampling; stop sequences
+        finish early with the matched suffix trimmed (paged engine)."""
+        cfg, params = setup
+        eng = PagedInferenceEngine(cfg, params, max_batch=2, max_seq=128,
+                                   page_size=8, attn_impl='xla')
+        g = eng.add_request([3, 1, 4], max_new_tokens=12)
+        n = eng.add_request([3, 1, 4], max_new_tokens=12,
+                            temperature=2.0, top_p=1e-6)
+        done = eng.run_to_completion(horizon=4)
+        assert done[g].output == done[n].output
+        full = done[g].output
+        eng2 = PagedInferenceEngine(cfg, params, max_batch=2,
+                                    max_seq=128, page_size=8,
+                                    attn_impl='xla')
+        rid = eng2.add_request([3, 1, 4], max_new_tokens=12,
+                               stop=[full[2:4]])
+        req = eng2.run_to_completion(horizon=4)[rid]
+        assert req.stop_hit and req.output == full[:2]
+
 
 class TestPrefixCache:
 

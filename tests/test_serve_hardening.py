@@ -162,6 +162,63 @@ def test_service_spec_tls_roundtrip():
                           'keyfile': '/etc/key.pem'}
 
 
+def test_generate_top_p_and_stop_over_http():
+    """The /generate API accepts top_p and stop (token-id lists) and
+    returns the trimmed output."""
+    from skypilot_tpu.serve.server import ModelServer
+    sport = common_utils.find_free_port(18910)
+    server = ModelServer('tiny', max_batch=2, max_seq=64, port=sport)
+    server.start(block=False)
+    deadline = time.time() + 120
+    while time.time() < deadline:
+        try:
+            with urllib.request.urlopen(
+                    f'http://127.0.0.1:{sport}/readiness', timeout=5) as r:
+                if r.status == 200:
+                    break
+        except Exception:
+            time.sleep(0.3)
+
+    def gen(payload):
+        req = urllib.request.Request(
+            f'http://127.0.0.1:{sport}/generate',
+            data=json.dumps(payload).encode(),
+            headers={'Content-Type': 'application/json'})
+        with urllib.request.urlopen(req, timeout=60) as r:
+            return json.loads(r.read())
+    try:
+        full = gen({'prompt': [3, 1, 4], 'max_new_tokens': 8})['tokens']
+        # nucleus collapse: hot sampling with top_p~0 equals greedy
+        nuc = gen({'prompt': [3, 1, 4], 'max_new_tokens': 8,
+                   'temperature': 2.0, 'top_p': 1e-6})['tokens']
+        assert nuc == full, (nuc, full)
+        stopped = gen({'prompt': [3, 1, 4], 'max_new_tokens': 8,
+                       'stop': [full[2:4]]})['tokens']
+        assert stopped == full[:2], (stopped, full)
+        # a stop completing exactly at max_new_tokens still trims
+        boundary = gen({'prompt': [3, 1, 4], 'max_new_tokens': 4,
+                        'stop': [full[2:4]]})['tokens']
+        assert boundary == full[:2], (boundary, full)
+        # STRING stops ride the tokenizer (byte tokenizer for 'tiny',
+        # 1 char <-> 1 token); encoding must not prepend BOS or they
+        # could never match generated output.
+        text_full = gen({'prompt': 'ab', 'max_new_tokens': 8})
+        if len(text_full['text']) == len(text_full['tokens']):
+            frag = text_full['text'][2:4]
+            text_stop = gen({'prompt': 'ab', 'max_new_tokens': 8,
+                             'stop': frag})
+            assert text_stop['tokens'] == text_full['tokens'][:2], \
+                (text_stop, text_full)
+        # malformed stop payloads return 400, not a dropped connection
+        try:
+            gen({'prompt': [3, 1, 4], 'max_new_tokens': 4, 'stop': 13})
+            raise AssertionError('expected HTTP 400')
+        except urllib.error.HTTPError as e:
+            assert e.code == 400
+    finally:
+        server.stop()
+
+
 def test_sse_streaming_through_server_and_lb(monkeypatch):
     """E2e: the model server streams tokens as SSE; the LB passes the
     stream through unbuffered; the client sees per-token events then the
