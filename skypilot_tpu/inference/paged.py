@@ -193,14 +193,25 @@ def paged_decode_horizon(
     DMA'd straight from HBM, length-exact per slot — the gather path
     measured 0.37x the slot cache on a v5e because the gather
     materializes a full KV copy per layer). table_p must cover
-    lengths+horizon for active slots. Returns
-    (tokens [slots, horizon], new cache)."""
+    lengths+horizon for active slots.
+
+    READ-ONLY on the cache: returns (tokens [slots, horizon],
+    ring_k, ring_v [L, slots, horizon, hkv, d]); the caller scatters
+    the ring into the pool via ``merge_ring_into_pool`` in a separate
+    donated program (see its docstring for why)."""
     b = tokens.shape[0]
     n_layers, n_kv, hd = cfg.n_layers, cfg.n_kv_heads, cfg.head_dim
     page = cache.page_size
     len0 = lengths
     pool_k, pool_v = cache.pool_k, cache.pool_v
     ks_pool, vs_pool = cache.k_scale, cache.v_scale
+    # Squeeze the scale pools' unit dim ONCE per program for the pallas
+    # path (see the kernel's layout note); the gather path keeps the
+    # broadcast-friendly storage shape.
+    if decode_impl == 'pallas' and cache.quantized:
+        ks_sq, vs_sq = ks_pool[..., 0], vs_pool[..., 0]
+    else:
+        ks_sq = vs_sq = None
     layer_params = params['layers']
     ring_k = jnp.zeros((n_layers, b, horizon, n_kv, hd), cfg.dtype)
     ring_v = jnp.zeros_like(ring_k)
@@ -215,27 +226,38 @@ def paged_decode_horizon(
 
         def layer_body(xc, layer_and_idx):
             layer, li = layer_and_idx
-            pk = lax.dynamic_index_in_dim(pool_k, li, 0, keepdims=False)
-            pv = lax.dynamic_index_in_dim(pool_v, li, 0, keepdims=False)
-            sk = (lax.dynamic_index_in_dim(ks_pool, li, 0, keepdims=False)
-                  if cache.quantized else None)
-            sv = (lax.dynamic_index_in_dim(vs_pool, li, 0, keepdims=False)
-                  if cache.quantized else None)
             rk = lax.dynamic_index_in_dim(ring_k, li, 0, keepdims=False)
             rv = lax.dynamic_index_in_dim(ring_v, li, 0, keepdims=False)
 
             if decode_impl == 'pallas':
+                # The kernel takes the FULL stacked pool with the layer
+                # as a scalar-prefetch block index: slicing the pool
+                # here (dynamic_index_in_dim) would force XLA to
+                # materialize a copy of the layer's pool as the
+                # pallas_call operand — one extra read+write of the
+                # whole KV stream per decode step (measured 0.4x the
+                # slot cache on a 7B before this change).
                 from skypilot_tpu.ops.paged_attention import (
                     merge_partial_with_ring_self, paged_decode_attention)
                 interp = jax.default_backend() != 'tpu'
 
                 def attn_fn(q, k, v):
                     partial = paged_decode_attention(
-                        q[:, 0], pk, pv, table_p, len0, sk, sv,
-                        interpret=interp)
+                        q[:, 0], pool_k, pool_v, table_p, len0,
+                        ks_sq, vs_sq, layer=li, interpret=interp)
                     return merge_partial_with_ring_self(
                         partial, q, k, v, rk, rv, i)
             else:
+                pk = lax.dynamic_index_in_dim(pool_k, li, 0,
+                                              keepdims=False)
+                pv = lax.dynamic_index_in_dim(pool_v, li, 0,
+                                              keepdims=False)
+                sk = (lax.dynamic_index_in_dim(ks_pool, li, 0,
+                                               keepdims=False)
+                      if cache.quantized else None)
+                sv = (lax.dynamic_index_in_dim(vs_pool, li, 0,
+                                               keepdims=False)
+                      if cache.quantized else None)
                 ck = _gather_layer(pk, sk, table_p, xc.dtype)
                 cv = _gather_layer(pv, sv, table_p, xc.dtype)
 
@@ -264,13 +286,24 @@ def paged_decode_horizon(
 
     (ring_k, ring_v, _), toks = lax.scan(
         one_step, (ring_k, ring_v, tokens), (jnp.arange(horizon), rngs))
+    return toks.T, ring_k, ring_v
 
+
+def merge_ring_into_pool(cache: PagedKVCache, ring_k, ring_v,
+                         table_p: jax.Array, lengths: jax.Array,
+                         active: Optional[jax.Array]) -> PagedKVCache:
+    """Scatter a decode horizon's ring rows into the pool — a SEPARATE
+    jitted program from the token computation (engine donates the cache
+    here). Keeping the pool update out of the program whose layer scan
+    feeds the pool to pallas_call is what lets XLA alias the donated
+    pool buffers in place; fused, the pool double-buffers (+4.4 GB on
+    the 7B bench — an OOM)."""
+    horizon = ring_k.shape[2]
     act = (active.astype(jnp.int32) if active is not None
-           else jnp.ones_like(len0))
+           else jnp.ones_like(lengths))
     rk, rv = _maybe_quantize_rows((ring_k, ring_v), cache.quantized)
-    new_cache = merge_rows_into_pool(cache, rk, rv, table_p,
-                                     len0, valid_len=act * horizon)
-    return toks.T, new_cache
+    return merge_rows_into_pool(cache, rk, rv, table_p, lengths,
+                                valid_len=act * horizon)
 
 
 def paged_prefill_chunk(
@@ -528,10 +561,14 @@ class PagedInferenceEngine(_EngineBase):
 
     # ---------------------------------------------------------- compiled
     def _build_decode(self):
+        """Two programs per horizon, enqueued back to back with ONE host
+        sync: token computation reads the pool (pallas blocks DMA from
+        it directly), then the ring scatter runs with the cache donated
+        so the pool updates in place — see merge_ring_into_pool."""
         cfg = self.cfg
         decode_impl = self.decode_impl
 
-        @functools.partial(jax.jit, donate_argnums=(1,),
+        @functools.partial(jax.jit,
                            static_argnames=('horizon', 'sample'))
         def decode_steps(params, cache, table_p, tokens, lengths, rng,
                          temps, topks, topps, active, horizon, sample):
@@ -548,7 +585,19 @@ class PagedInferenceEngine(_EngineBase):
                 horizon=horizon, sample_fn=sample_fn, rngs=rngs,
                 active=active, decode_impl=decode_impl)
 
-        return decode_steps
+        merge = jax.jit(merge_ring_into_pool, donate_argnums=(0,))
+
+        def decode_and_merge(params, cache, table_p, tokens, lengths,
+                             rng, temps, topks, topps, active, horizon,
+                             sample):
+            toks, ring_k, ring_v = decode_steps(
+                params, cache, table_p, tokens, lengths, rng, temps,
+                topks, topps, active, horizon, sample)
+            new_cache = merge(cache, ring_k, ring_v, table_p, lengths,
+                              active)
+            return toks, new_cache
+
+        return decode_and_merge
 
     def _get_prefill(self, n: int, P: int):
         key = (n, P)

@@ -31,14 +31,20 @@ from jax.experimental.pallas import tpu as pltpu
 _NEG_INF = -1e30
 
 
-def _kernel(table_ref, lens_ref,                 # scalar prefetch
+def _kernel(li_ref, table_ref, lens_ref,         # scalar prefetch
             q_ref, k_ref, v_ref,                 # inputs (VMEM blocks)
             *refs,                               # [ks, vs,] outs, scratch
             page: int, pages_per_slot: int, scale: float,
             quantized: bool):
+    # li_ref carries the layer index: the pool stays [L, ...] and the
+    # block specs index straight into it, so the per-layer slice is a
+    # DMA address, never a materialized copy (feeding
+    # dynamic_index_in_dim output into pallas_call would copy the whole
+    # layer's pool per step — measured 0.4x the slot cache on a 7B).
     # Quantized pools carry two extra scale operands; the bf16 variant
     # omits them entirely (a dummy scale pool would cost a real HBM DMA
     # per page on the decode hot path).
+    del li_ref                                   # consumed by index maps
     if quantized:
         ks_ref, vs_ref = refs[0], refs[1]
         refs = refs[2:]
@@ -67,11 +73,15 @@ def _kernel(table_ref, lens_ref,                 # scalar prefetch
         # implicit dimension"); m/l ride [hq, LANES] broadcast columns,
         # the same trick the flash kernel's lse uses.
         q = q_ref[0].astype(jnp.float32) * scale          # [hq, d]
-        k = k_ref[0].astype(jnp.float32)                  # [page, hkv, d]
-        v = v_ref[0].astype(jnp.float32)
+        k = k_ref[0, 0].astype(jnp.float32)               # [page, hkv, d]
+        v = v_ref[0, 0].astype(jnp.float32)
         if quantized:
-            k = k * ks_ref[0].astype(jnp.float32)
-            v = v * vs_ref[0].astype(jnp.float32)
+            # scales ride [page, hkv] blocks (the storage layout's
+            # trailing unit dim is squeezed by the caller: a unit minor
+            # dim in a pallas operand pads to the 128-lane tile — an
+            # 8 GB copy of a 64 MB pool on the 7B bench).
+            k = k * ks_ref[0, 0].astype(jnp.float32)[..., None]
+            v = v * vs_ref[0, 0].astype(jnp.float32)[..., None]
         hq, d = q.shape
         hkv = k.shape[1]
         g = hq // hkv
@@ -112,17 +122,23 @@ def _kernel(table_ref, lens_ref,                 # scalar prefetch
 
 def paged_decode_attention(
     q: jax.Array,                      # [slots, hq, d] current-token queries
-    pool_k: jax.Array,                 # [n_pages, page, hkv, d]
+    pool_k: jax.Array,                 # [L, n_pages, page, hkv, d]
     pool_v: jax.Array,
     table_p: jax.Array,                # [slots, P] page ids
     lengths: jax.Array,                # [slots] valid cache rows
-    k_scale: Optional[jax.Array] = None,   # [n_pages, page, hkv, 1]
-    v_scale: Optional[jax.Array] = None,
+    k_scale: Optional[jax.Array] = None,  # [L, n_pages, page, hkv]
+    v_scale: Optional[jax.Array] = None,  # (unit dim pre-squeezed)
     *,
+    layer: jax.Array | int = 0,        # which pool layer to attend over
     scale: Optional[float] = None,
     interpret: bool = False,
 ) -> Tuple[jax.Array, jax.Array, jax.Array]:
-    """Partial softmax of each slot's query against its OWN pages.
+    """Partial softmax of each slot's query against its OWN pages of
+    pool layer ``layer``. The full stacked pool is taken (with the
+    layer as a scalar-prefetch index into the block specs) so the
+    caller's per-layer scan never materializes a pool slice — a sliced
+    operand would cost a whole extra read+write of the KV stream per
+    decode step.
 
     Returns (acc [slots, hq, d] f32 — UNnormalized, rebased at m;
     m [slots, hq] f32; l [slots, hq] f32). Rows past ``lengths`` are
@@ -130,7 +146,7 @@ def paged_decode_attention(
     no-op for them.
     """
     slots, hq, d = q.shape
-    n_pages, page, hkv, _ = pool_k.shape
+    _, n_pages, page, hkv, _ = pool_k.shape
     P = table_p.shape[1]
     g = hq // hkv
     if scale is None:
@@ -147,33 +163,36 @@ def paged_decode_attention(
         jax.ShapeDtypeStruct((slots, hq, LANES), jnp.float32),
     ]
     in_specs = [
-        pl.BlockSpec((1, hq, d), lambda i, j, tab, lens: (i, 0, 0)),
-        pl.BlockSpec((1, page, hkv, d), lambda i, j, tab, lens:
-                     (tab[i, j], 0, 0, 0)),
-        pl.BlockSpec((1, page, hkv, d), lambda i, j, tab, lens:
-                     (tab[i, j], 0, 0, 0)),
+        pl.BlockSpec((1, hq, d), lambda i, j, li, tab, lens: (i, 0, 0)),
+        pl.BlockSpec((1, 1, page, hkv, d), lambda i, j, li, tab, lens:
+                     (li[0], tab[i, j], 0, 0, 0)),
+        pl.BlockSpec((1, 1, page, hkv, d), lambda i, j, li, tab, lens:
+                     (li[0], tab[i, j], 0, 0, 0)),
     ]
-    args = [table_p, lengths, q, pool_k, pool_v]
+    li = jnp.asarray(layer, jnp.int32).reshape(1)
+    args = [li, table_p, lengths, q, pool_k, pool_v]
     if quantized:
         in_specs += [
-            pl.BlockSpec((1, page, hkv, 1), lambda i, j, tab, lens:
-                         (tab[i, j], 0, 0, 0)),
-            pl.BlockSpec((1, page, hkv, 1), lambda i, j, tab, lens:
-                         (tab[i, j], 0, 0, 0)),
+            pl.BlockSpec((1, 1, page, hkv),
+                         lambda i, j, li, tab, lens:
+                         (li[0], tab[i, j], 0, 0)),
+            pl.BlockSpec((1, 1, page, hkv),
+                         lambda i, j, li, tab, lens:
+                         (li[0], tab[i, j], 0, 0)),
         ]
         args += [k_scale, v_scale]
     acc, m, l = pl.pallas_call(
         kernel,
         grid_spec=pltpu.PrefetchScalarGridSpec(
-            num_scalar_prefetch=2,               # table, lengths
+            num_scalar_prefetch=3,               # layer, table, lengths
             grid=grid,
             in_specs=in_specs,
             out_specs=[
-                pl.BlockSpec((1, hq, d), lambda i, j, tab, lens:
+                pl.BlockSpec((1, hq, d), lambda i, j, li, tab, lens:
                              (i, 0, 0)),
-                pl.BlockSpec((1, hq, LANES), lambda i, j, tab, lens:
+                pl.BlockSpec((1, hq, LANES), lambda i, j, li, tab, lens:
                              (i, 0, 0)),
-                pl.BlockSpec((1, hq, LANES), lambda i, j, tab, lens:
+                pl.BlockSpec((1, hq, LANES), lambda i, j, li, tab, lens:
                              (i, 0, 0)),
             ],
             scratch_shapes=[
