@@ -110,7 +110,38 @@ def handle(request: Dict[str, Any]) -> Dict[str, Any]:
     raise ValueError(f'Unknown RPC op: {op!r}')
 
 
+READY_LINE = 'SKYTPU_RPC_READY'
+
+
+def serve(handle_fn=None) -> None:
+    """Persistent stdio server: one JSON request per stdin line, one
+    PAYLOAD line per response. A single remote interpreter then serves
+    every status/logs/cancel call of a client session — the per-op
+    interpreter start (~100s of ms over SSH, the reference's
+    per-codegen-exec cost) is paid once. EOF on stdin ends the loop
+    (the channel dies with the client). Streaming ops (``tail``) are
+    refused — they own stdout and ride the one-shot path."""
+    handle_fn = handle_fn or handle
+    print(READY_LINE, flush=True)
+    for line in sys.stdin:
+        line = line.strip()
+        if not line:
+            continue
+        try:
+            request = json.loads(line)
+            if request.get('op') == 'tail':
+                raise ValueError('streaming op "tail" cannot ride the '
+                                 'persistent channel')
+            response = handle_fn(request)
+        except Exception as e:  # pylint: disable=broad-except
+            response = {'ok': False, 'error': f'{type(e).__name__}: {e}'}
+        print(PAYLOAD_PREFIX + json.dumps(response), flush=True)
+
+
 def main() -> None:
+    if len(sys.argv) > 1 and sys.argv[1] == '--serve':
+        serve()
+        return
     raw = sys.argv[1] if len(sys.argv) > 1 else sys.stdin.read()
     request = json.loads(raw)
     if request.get('op') == 'tail':

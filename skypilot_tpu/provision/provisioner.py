@@ -128,10 +128,40 @@ def _wait_agent_ready(head_runner) -> None:
 def agent_request(head_runner, request: Dict,
                   module: str = 'skypilot_tpu.agent.rpc',
                   error_cls: type = exceptions.ProvisionError) -> Dict:
-    """Send one JSON RPC to a head-side module via the command runner;
-    return the parsed payload. The same wire protocol serves the agent RPC
-    and the jobs/serve controller RPCs — pass ``module``/``error_cls``.
-    Raises CommandError / ``error_cls`` on failure."""
+    """Send one JSON RPC to a head-side module; return the parsed
+    payload. The same wire protocol serves the agent RPC and the
+    jobs/serve controller RPCs — pass ``module``/``error_cls``.
+
+    Transport: a persistent ``--serve`` channel (one remote interpreter
+    per client session, ``agent/channel.py``) when the runner supports
+    it, falling back to a one-shot exec — so logs/cancel/status paths
+    stop paying an interpreter start per op, and a broken channel never
+    becomes a new failure mode. Raises CommandError / ``error_cls`` on
+    failure."""
+    from skypilot_tpu.agent import channel as channel_lib
+    ch = channel_lib.channel_for(head_runner, module)
+    if ch is not None:
+        try:
+            payload = ch.request(request)
+            if not payload.get('ok'):
+                raise error_cls(
+                    f'RPC {module}:{request.get("op")} failed: '
+                    f'{payload.get("error")}')
+            return payload
+        except channel_lib.ChannelError as e:
+            if e.sent:
+                # The op MAY have executed remotely: re-running it via
+                # the fallback could double-submit writes (queue_job,
+                # cancel). Surface the transport failure instead.
+                raise error_cls(
+                    f'RPC {module}:{request.get("op")}: channel failed '
+                    f'after the request was sent ({e}); not retrying a '
+                    f'possibly-executed op') from e
+            # Startup failure (e.g. head running an older runtime):
+            # negative-cache so later calls skip straight to one-shot.
+            channel_lib.disable(head_runner, module)
+            logger.debug(f'RPC channel unavailable '
+                         f'({e}); falling back to one-shot exec')
     cmd = (f'{agent_constants.control_plane_env_prefix()}'
            f'{shlex.quote(head_runner.remote_python)} '
            f'-m {module} '

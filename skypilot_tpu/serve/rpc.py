@@ -176,6 +176,12 @@ def handle(request: Dict[str, Any]) -> Dict[str, Any]:
 
 
 def main() -> None:
+    if len(sys.argv) > 1 and sys.argv[1] == '--serve':
+        # Persistent stdio channel (agent/channel.py): same wire
+        # protocol as the agent RPC's --serve loop.
+        from skypilot_tpu.agent import rpc as agent_rpc
+        agent_rpc.serve(handle)
+        return
     raw = sys.argv[1] if len(sys.argv) > 1 else sys.stdin.read()
     request = json.loads(raw)
     try:

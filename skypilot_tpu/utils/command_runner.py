@@ -62,6 +62,17 @@ class CommandRunner:
         """Sync source->target. ``up=True``: local source to node target."""
         raise NotImplementedError
 
+    def popen_interactive(self, cmd: str) -> 'subprocess.Popen':
+        """Start ``cmd`` on the node with stdin/stdout attached as text
+        pipes (stderr discarded) — the transport for persistent RPC
+        channels (``agent/channel.py``)."""
+        raise NotImplementedError
+
+    @property
+    def channel_key(self) -> tuple:
+        """Identity for caching persistent channels per node."""
+        return (type(self).__name__, self.node_id)
+
     def check_run(self, cmd: str, **kwargs) -> str:
         """Run; raise CommandError on failure; return stdout."""
         rc, stdout, stderr = self.run(cmd, require_outputs=True, **kwargs)
@@ -167,6 +178,16 @@ class LocalProcessRunner(CommandRunner):
             stream_logs=stream_logs, require_outputs=require_outputs,
             timeout=timeout)
 
+    def popen_interactive(self, cmd: str) -> 'subprocess.Popen':
+        return subprocess.Popen(
+            ['bash', '-c', cmd], env=self._node_env(None),
+            cwd=self.node_dir, text=True, stdin=subprocess.PIPE,
+            stdout=subprocess.PIPE, stderr=subprocess.DEVNULL)
+
+    @property
+    def channel_key(self) -> tuple:
+        return (type(self).__name__, self.node_id, self.node_dir)
+
     def rsync(self, source: str, target: str, *, up: bool) -> None:
         if up:
             src = os.path.expanduser(source)
@@ -253,6 +274,19 @@ class SSHCommandRunner(CommandRunner):
             stream_logs=stream_logs, require_outputs=require_outputs,
             timeout=timeout)
 
+    def popen_interactive(self, cmd: str) -> 'subprocess.Popen':
+        from skypilot_tpu.utils import pkg_utils
+        remote_cmd = pkg_utils.RUNTIME_PYTHONPATH_PREFIX + cmd
+        args = self.ssh_base_command() + [
+            f'bash --login -c {shlex.quote(remote_cmd)}']
+        return subprocess.Popen(
+            args, text=True, stdin=subprocess.PIPE,
+            stdout=subprocess.PIPE, stderr=subprocess.DEVNULL)
+
+    @property
+    def channel_key(self) -> tuple:
+        return (type(self).__name__, self.ip, self.port, self.ssh_user)
+
     def rsync(self, source: str, target: str, *, up: bool) -> None:
         ssh_cmd = ' '.join(['ssh'] + [shlex.quote(o)
                                       for o in self._ssh_options()])
@@ -306,6 +340,20 @@ class KubernetesPodRunner(CommandRunner):
             args, shell=False, env=None, cwd=None, log_path=log_path,
             stream_logs=stream_logs, require_outputs=require_outputs,
             timeout=timeout)
+
+    def popen_interactive(self, cmd: str) -> 'subprocess.Popen':
+        from skypilot_tpu.utils import pkg_utils
+        remote_cmd = pkg_utils.RUNTIME_PYTHONPATH_PREFIX + cmd
+        args = self._kubectl() + [
+            'exec', '-i', self.pod_name, '--', 'sh', '-c', remote_cmd]
+        return subprocess.Popen(
+            args, text=True, stdin=subprocess.PIPE,
+            stdout=subprocess.PIPE, stderr=subprocess.DEVNULL)
+
+    @property
+    def channel_key(self) -> tuple:
+        return (type(self).__name__, self.pod_name, self.namespace,
+                self.context)
 
     @staticmethod
     def _remote_path(p: str) -> str:

@@ -64,3 +64,136 @@ def test_remote_python_contract(tmp_path):
     ssh = command_runner.SSHCommandRunner('1.2.3.4', 'user',
                                           os.devnull)
     assert ssh.remote_python == 'python3'
+
+
+class TestRpcChannel:
+    """Persistent JSON-RPC channel: one interpreter serves many ops."""
+
+    def _runner(self, tmp_path):
+        return command_runner.LocalProcessRunner('n0', str(tmp_path / 'n'))
+
+    def test_many_requests_one_process(self, tmp_path, monkeypatch):
+        monkeypatch.setenv('SKYTPU_AGENT_DIR', str(tmp_path / 'agent'))
+        from skypilot_tpu.agent import channel as channel_lib
+        runner = self._runner(tmp_path)
+        spawns = []
+        orig = command_runner.LocalProcessRunner.popen_interactive
+
+        def counting(self, cmd):
+            proc = orig(self, cmd)
+            spawns.append(proc.pid)
+            return proc
+
+        monkeypatch.setattr(command_runner.LocalProcessRunner,
+                            'popen_interactive', counting)
+        ch = channel_lib.RpcChannel(runner, 'skypilot_tpu.agent.rpc')
+        try:
+            for _ in range(3):
+                resp = ch.request({'op': 'agent_health'})
+                assert resp['ok']
+            assert len(spawns) == 1, spawns
+        finally:
+            ch.close()
+
+    def test_channel_restarts_after_death(self, tmp_path, monkeypatch):
+        monkeypatch.setenv('SKYTPU_AGENT_DIR', str(tmp_path / 'agent'))
+        from skypilot_tpu.agent import channel as channel_lib
+        ch = channel_lib.RpcChannel(self._runner(tmp_path),
+                                    'skypilot_tpu.agent.rpc')
+        try:
+            assert ch.request({'op': 'agent_health'})['ok']
+            ch._proc.kill()
+            ch._proc.wait()
+            assert ch.request({'op': 'agent_health'})['ok']
+        finally:
+            ch.close()
+
+    def test_streaming_tail_refused_on_channel(self, tmp_path,
+                                               monkeypatch):
+        monkeypatch.setenv('SKYTPU_AGENT_DIR', str(tmp_path / 'agent'))
+        from skypilot_tpu.agent import channel as channel_lib
+        ch = channel_lib.RpcChannel(self._runner(tmp_path),
+                                    'skypilot_tpu.agent.rpc')
+        try:
+            resp = ch.request({'op': 'tail', 'job_id': 1})
+            assert not resp['ok'] and 'tail' in resp['error']
+        finally:
+            ch.close()
+
+    def test_agent_request_uses_channel_then_fallback(self, tmp_path,
+                                                      monkeypatch):
+        """agent_request rides the channel; a transport that cannot
+        serve falls back to the one-shot exec path transparently."""
+        monkeypatch.setenv('SKYTPU_AGENT_DIR', str(tmp_path / 'agent'))
+        from skypilot_tpu.agent import channel as channel_lib
+        from skypilot_tpu.provision import provisioner
+        channel_lib.close_all()
+        runner = self._runner(tmp_path)
+        resp = provisioner.agent_request(runner, {'op': 'agent_health'})
+        assert 'agentd_alive' in resp
+        # Break the interactive transport entirely: fallback still works.
+        monkeypatch.setattr(
+            command_runner.LocalProcessRunner, 'popen_interactive',
+            lambda self, cmd: (_ for _ in ()).throw(NotImplementedError))
+        channel_lib.close_all()
+        resp = provisioner.agent_request(runner, {'op': 'agent_health'})
+        assert 'agentd_alive' in resp
+        channel_lib.close_all()
+
+    def test_no_retry_after_send(self, tmp_path, monkeypatch):
+        """A failure AFTER the request was written must surface, not
+        re-send (double-submit hazard for queue_job/cancel)."""
+        monkeypatch.setenv('SKYTPU_AGENT_DIR', str(tmp_path / 'agent'))
+        from skypilot_tpu.agent import channel as channel_lib
+        ch = channel_lib.RpcChannel(self._runner(tmp_path),
+                                    'skypilot_tpu.agent.rpc')
+        starts = []
+        orig_start = channel_lib.RpcChannel._start
+
+        def counting_start(self):
+            starts.append(1)
+            return orig_start(self)
+
+        monkeypatch.setattr(channel_lib.RpcChannel, '_start',
+                            counting_start)
+        monkeypatch.setattr(
+            channel_lib.RpcChannel, '_roundtrip',
+            lambda self, req: (_ for _ in ()).throw(
+                channel_lib.ChannelError('EOF mid-request', sent=True)))
+        try:
+            import pytest as _pytest
+            with _pytest.raises(channel_lib.ChannelError) as ei:
+                ch.request({'op': 'queue_job'})
+            assert ei.value.sent
+            assert len(starts) == 1, 'must not re-establish and re-send'
+        finally:
+            ch.close()
+
+    def test_startup_failure_negative_cached(self, tmp_path,
+                                             monkeypatch):
+        """A head without --serve support costs failed spawns ONCE;
+        later agent_requests skip straight to the one-shot exec."""
+        monkeypatch.setenv('SKYTPU_AGENT_DIR', str(tmp_path / 'agent'))
+        from skypilot_tpu.agent import channel as channel_lib
+        from skypilot_tpu.provision import provisioner
+        channel_lib.close_all()
+        runner = self._runner(tmp_path)
+        spawns = []
+        orig = command_runner.LocalProcessRunner.popen_interactive
+
+        def failing(self, cmd):
+            spawns.append(1)
+            # Simulate an old runtime: the process exits immediately
+            # without the ready banner.
+            return orig(self, 'true')
+
+        monkeypatch.setattr(command_runner.LocalProcessRunner,
+                            'popen_interactive', failing)
+        for _ in range(3):
+            resp = provisioner.agent_request(runner,
+                                             {'op': 'agent_health'})
+            assert 'agentd_alive' in resp
+        # 2 spawn attempts for the first call (retry), then the key is
+        # disabled — calls 2 and 3 never touch the channel.
+        assert len(spawns) == 2, spawns
+        channel_lib.close_all()

@@ -109,12 +109,18 @@ def test_multislice_env_contract_two_slices():
 
 
 def test_exec_reuses_cluster_and_fifo_order():
+    # A TPU cluster is EXCLUSIVE (chips owned by one program): strict
+    # FIFO. CPU clusters multiplex jobs (reference resource-slot
+    # semantics), so without an accelerator this ordering would be a
+    # race — one the old per-op RPC latency used to mask.
     task = Task(name='first', run='sleep 0.3; echo first-done')
-    task.set_resources(sky.Resources(cloud='local'))
+    task.set_resources(sky.Resources(cloud='local',
+                                     accelerators='tpu-v5e-8'))
     job1, handle = _launch(task, 'spine-exec')
     try:
         task2 = Task(name='second', run='echo second-done')
-        task2.set_resources(sky.Resources(cloud='local'))
+        task2.set_resources(sky.Resources(cloud='local',
+                                          accelerators='tpu-v5e-8'))
         job2, handle2 = execution.exec_cmd(task2, 'spine-exec')
         assert handle2.cluster_name == handle.cluster_name
         assert job2 == job1 + 1
