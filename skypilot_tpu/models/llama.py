@@ -281,19 +281,49 @@ def rope(x: jax.Array, positions: jax.Array, theta: float) -> jax.Array:
     return out.astype(x.dtype)
 
 
+_probe_broken_warned = False
+
+
 def _ambient_mesh():
-    """The active `with mesh:` context's mesh, or None. jax has no public
-    accessor for this; probe the known private locations and fail open
-    (None → no constraint) so a jax upgrade degrades perf, not
-    correctness."""
+    """The active mesh context's mesh, or None.
+
+    Tries the PUBLIC accessor first (``jax.sharding.get_mesh`` sees
+    ``jax.sharding.use_mesh``/``set_mesh`` contexts), then probes the
+    private locations that back the legacy ``with mesh:`` context (no
+    public accessor exists for it) and fails open (None → no
+    constraint) so a jax upgrade degrades perf, not correctness — but
+    warns ONCE when every probe RAISED (probe broken ≠ no mesh), since
+    silently disabled pipelining/sharding constraints would otherwise
+    degrade with no signal. ``tests/test_aux_subsystems.py::
+    test_ambient_mesh_probe`` additionally turns probe breakage into a
+    visible CI failure on the pinned jax."""
+    global _probe_broken_warned
+    try:
+        from jax.sharding import get_mesh
+        m = get_mesh()
+        if isinstance(m, jax.sharding.Mesh) and not m.empty:
+            return m
+    except Exception:  # pylint: disable=broad-except
+        pass
+    probe_healthy = False
     for probe in ('jax._src.mesh', 'jax.interpreters.pxla'):
         try:
             import importlib
             mod = importlib.import_module(probe)
             m = mod.thread_resources.env.physical_mesh
-            return None if m.empty else m
+            probe_healthy = True
+            if not m.empty:
+                return m
         except Exception:  # pylint: disable=broad-except
             continue
+    if not probe_healthy and not _probe_broken_warned:
+        _probe_broken_warned = True
+        import warnings
+        warnings.warn(
+            'skypilot_tpu: ambient-mesh probe failed (jax internals '
+            'changed?); mesh-context detection is DISABLED — pipeline '
+            'parallelism and activation sharding constraints will '
+            'silently not apply inside `with mesh:` contexts.')
     return None
 
 
@@ -314,15 +344,14 @@ _pp_probe_warned = False
 def _pp_mesh():
     """The ambient mesh iff its pp axis is > 1 (else None).
 
-    Probes jax's private thread_resources (no public ambient-mesh API);
-    warns ONCE if the probe breaks on a jax upgrade — silently disabled
-    pipelining with pp-sharded layer params would otherwise degrade to
-    a full layer-stack all-gather per step with no visible signal."""
+    Rides ``_ambient_mesh`` (public accessor first, then the private
+    legacy-context probe — which itself warns once when broken); the
+    probe-works-at-all guarantee is pinned by
+    ``tests/test_aux_subsystems.py::test_ambient_mesh_probe``."""
     global _pp_probe_warned
     try:
-        from jax._src import mesh as mesh_src
-        env_mesh = mesh_src.thread_resources.env.physical_mesh
-        if env_mesh.empty:
+        env_mesh = _ambient_mesh()
+        if env_mesh is None:
             return None
         return env_mesh if env_mesh.shape.get('pp', 1) > 1 else None
     except Exception:  # pylint: disable=broad-except
