@@ -30,7 +30,8 @@ class TestParseAll:
         expected = {'minimal.yaml', 'tpu_hello.yaml', 'tpuvm_mnist.yaml',
                     'train_llama_job.yaml', 'serve_llama.yaml',
                     'k8s_hello.yaml', 'multislice_train.yaml',
-                    'finetune_lora.yaml'}
+                    'finetune_lora.yaml', 'serve_mixtral.yaml',
+                    'serve_qwen2.yaml', 'train_gemma.yaml'}
         assert expected.issubset(set(ALL_YAMLS)), ALL_YAMLS
 
     @pytest.mark.parametrize('yaml_name', ALL_YAMLS)
@@ -46,9 +47,12 @@ class TestParseAll:
             res = list(task.resources)[0]
             assert res.accelerators, name
 
-    def test_serve_example_has_service(self):
+    @pytest.mark.parametrize('yaml_name', ['serve_llama.yaml',
+                                           'serve_mixtral.yaml',
+                                           'serve_qwen2.yaml'])
+    def test_serve_example_has_service(self, yaml_name):
         from skypilot_tpu.serve.service_spec import SkyServiceSpec
-        task = Task.from_yaml(_example('serve_llama.yaml'))
+        task = Task.from_yaml(_example(yaml_name))
         assert task.service is not None
         spec = SkyServiceSpec.from_yaml_config(task.service)
         assert spec.readiness_path == '/readiness'
