@@ -290,7 +290,8 @@ def cost_report():
              f"{r.get('duration_hours', 0):.2f}h",
              f"${r.get('cost_per_hour', 0):.2f}",
              f"${r.get('total_cost', 0):.2f}"] for r in report]
-    click.echo(_fmt_table(rows, ['NAME', 'DURATION', '$/HR', 'TOTAL COST']))
+    click.echo(_fmt_table(rows, ['NAME', 'DURATION', '$/HR (est.)',
+                                 'TOTAL COST (est.)']))
     click.echo('Note: dollar amounts are ESTIMATES from the checked-in '
                'catalog\n(approximate list prices); billing truth lives '
                'with your cloud provider.')

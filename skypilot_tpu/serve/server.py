@@ -37,11 +37,12 @@ class ModelServer:
                  max_seq: int = 1024, port: int = 8081,
                  model_path: Optional[str] = None,
                  quantize: Optional[str] = None,
-                 kv_cache: str = 'slot'):
+                 kv_cache: str = 'slot', page_size: int = 64):
         self.cfg_name = cfg_name
         self.model_path = model_path  # HF checkpoint dir (real weights)
         self.quantize = quantize      # 'int8' => int8 weights + KV cache
         self.kv_cache = kv_cache      # 'slot' | 'paged' (prefix caching)
+        self.page_size = page_size    # paged-cache page granularity
         self.max_batch = max_batch
         self.max_seq = max_seq
         self.port = port
@@ -67,19 +68,21 @@ class ModelServer:
         from skypilot_tpu.models.tokenizer import load_tokenizer
         engine_cls = (PagedInferenceEngine if self.kv_cache == 'paged'
                       else InferenceEngine)
+        extra = ({'page_size': self.page_size}
+                 if self.kv_cache == 'paged' else {})
         if self.model_path:
             # Real weights: HF checkpoint dir (config.json + safetensors
             # [+ tokenizer.json]) — the reference serves such checkpoints
             # through vLLM/JetStream (llm/llama-3/llama3.yaml:109).
             engine = engine_cls.from_pretrained(
                 self.model_path, max_batch=self.max_batch,
-                max_seq=self.max_seq, quantize=self.quantize)
+                max_seq=self.max_seq, quantize=self.quantize, **extra)
             self.cfg_name = engine.cfg.name
         else:
             cfg = configs.get_config(self.cfg_name)
             engine = engine_cls(cfg, max_batch=self.max_batch,
                                 max_seq=self.max_seq,
-                                quantize=self.quantize)
+                                quantize=self.quantize, **extra)
         self.tokenizer = load_tokenizer(
             self.model_path, model_vocab_size=engine.cfg.vocab_size)
         # Warmup: compile prefill+decode before declaring readiness.
@@ -356,6 +359,10 @@ def main() -> None:
                         choices=['slot', 'paged'],
                         help='paged = shared page pool with prefix '
                              'caching + chunked prefill')
+    parser.add_argument('--page-size', type=int, default=64,
+                        help='paged-cache page granularity (tokens); '
+                             'larger pages DMA more efficiently, '
+                             'smaller pages cache prefixes finer')
     parser.add_argument('--max-batch', type=int, default=8)
     parser.add_argument('--max-seq', type=int, default=1024)
     parser.add_argument('--port', type=int,
@@ -366,7 +373,8 @@ def main() -> None:
                          max_seq=args.max_seq, port=args.port,
                          model_path=args.model_path,
                          quantize=args.quantize,
-                         kv_cache=args.kv_cache)
+                         kv_cache=args.kv_cache,
+                         page_size=args.page_size)
     server.start(block=True)
 
 
