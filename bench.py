@@ -38,6 +38,16 @@ def _model_traffic_bytes(n_params: float, n_layers: int, n_kv: int,
 def main() -> None:
     import jax
 
+    # Persistent compilation cache: the 7B paged/slot programs cost
+    # tens of minutes of XLA+Mosaic compile on a cold process; cached
+    # executables cut a re-run to the measurement itself.
+    cache_dir = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                             '.bench_cache', 'jax_cache')
+    try:
+        jax.config.update('jax_compilation_cache_dir', cache_dir)
+    except Exception:  # pylint: disable=broad-except
+        pass
+
     from skypilot_tpu.accelerators import TPU_GENERATIONS
 
     backend = jax.default_backend()

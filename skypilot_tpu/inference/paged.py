@@ -68,7 +68,7 @@ class PagedKVCache(NamedTuple):
 
     @classmethod
     def create(cls, cfg: ModelConfig, *, n_pages: int,
-               page_size: int = 64, quantized: bool = False
+               page_size: int = 128, quantized: bool = False
                ) -> 'PagedKVCache':
         shape = (cfg.n_layers, n_pages, page_size, cfg.n_kv_heads,
                  cfg.head_dim)
@@ -205,11 +205,14 @@ def paged_decode_horizon(
     len0 = lengths
     pool_k, pool_v = cache.pool_k, cache.pool_v
     ks_pool, vs_pool = cache.k_scale, cache.v_scale
-    # Squeeze the scale pools' unit dim ONCE per program for the pallas
-    # path (see the kernel's layout note); the gather path keeps the
-    # broadcast-friendly storage shape.
+    # Re-lay the scale pools ONCE per program for the pallas path:
+    # squeeze the unit dim and go head-major [L, n_pages, hkv, page]
+    # (minor dim page is DMA-tileable where hkv is not; the kernels
+    # fold these into logits/p — see the kernel layout note). The
+    # gather path keeps the broadcast-friendly storage shape.
     if decode_impl == 'pallas' and cache.quantized:
-        ks_sq, vs_sq = ks_pool[..., 0], vs_pool[..., 0]
+        ks_sq = jnp.swapaxes(ks_pool[..., 0], -1, -2)
+        vs_sq = jnp.swapaxes(vs_pool[..., 0], -1, -2)
     else:
         ks_sq = vs_sq = None
     layer_params = params['layers']
@@ -494,7 +497,7 @@ class PagedInferenceEngine(_EngineBase):
 
     def __init__(self, cfg: ModelConfig, params=None, *,
                  max_batch: int = 8, max_seq: int = 1024,
-                 page_size: int = 64, n_pages: Optional[int] = None,
+                 page_size: int = 128, n_pages: Optional[int] = None,
                  chunk: int = 256,
                  mesh=None, rng_seed: int = 0, attn_impl: str = 'auto',
                  quantize: Optional[str] = None,

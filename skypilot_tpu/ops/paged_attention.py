@@ -61,9 +61,12 @@ def _kernel(li_ref, table_ref, lens_ref,         # scalar prefetch
         acc_s[:] = jnp.zeros_like(acc_s)
 
     length = lens_ref[i]
-    # Number of pages this slot actually needs; pages past that are
-    # skipped entirely (their DMA still happens — block specs are
-    # prefetched — but the FLOPs and softmax pollution are masked).
+    # Number of pages this slot actually needs. Pages past that are
+    # compute-masked here AND their DMA collapses: the index maps clamp
+    # j to the last needed page, and Pallas skips the copy when a grid
+    # step's block index repeats the previous step's — so a
+    # short-context slot in a long-bucket table pays no extra HBM
+    # traffic.
     needed = (length + page - 1) // page
 
     @pl.when(j < needed)
@@ -75,23 +78,23 @@ def _kernel(li_ref, table_ref, lens_ref,         # scalar prefetch
         q = q_ref[0].astype(jnp.float32) * scale          # [hq, d]
         k = k_ref[0, 0].astype(jnp.float32)               # [page, hkv, d]
         v = v_ref[0, 0].astype(jnp.float32)
-        if quantized:
-            # scales ride [page, hkv] blocks (the storage layout's
-            # trailing unit dim is squeezed by the caller: a unit minor
-            # dim in a pallas operand pads to the 128-lane tile — an
-            # 8 GB copy of a 64 MB pool on the 7B bench).
-            k = k * ks_ref[0, 0].astype(jnp.float32)[..., None]
-            v = v * vs_ref[0, 0].astype(jnp.float32)[..., None]
         hq, d = q.shape
         hkv = k.shape[1]
         g = hq // hkv
         qg = q.reshape(hkv, g, d)
         # logits[h, g, p] = sum_d q[h,g,d] * k[p,h,d]: batched (over
-        # hkv) [g,d] x [d,page] matmuls.
+        # hkv) [g,d] x [d,page] matmuls. int8 pools: the per-row scales
+        # ride HEAD-MAJOR [hkv, page] blocks and fold into the LOGITS
+        # (and into p for the v side) — no in-kernel reshape/transpose,
+        # and the layout's minor dim (page) satisfies Mosaic's
+        # slice-tiling where [.., page, hkv] could not.
         kt = k.transpose(1, 2, 0)                         # [hkv, d, page]
         logits = jax.lax.dot_general(
             qg, kt, (((2,), (1,)), ((0,), (0,))),
             preferred_element_type=jnp.float32)           # [hkv, g, page]
+        if quantized:
+            logits = logits * ks_ref[0, 0].astype(
+                jnp.float32)[:, None, :]
         logits = logits.reshape(hq, page)
         pos = j * page + jax.lax.broadcasted_iota(
             jnp.int32, (hq, page), 1)
@@ -107,6 +110,8 @@ def _kernel(li_ref, table_ref, lens_ref,         # scalar prefetch
         m_s[:] = jnp.broadcast_to(m_new, m_s.shape)
         # pv[h,g,d] = sum_p p[h,g,p] * v[p,h,d]: batched over hkv.
         pg = p.reshape(hkv, g, page)
+        if quantized:
+            pg = pg * vs_ref[0, 0].astype(jnp.float32)[:, None, :]
         vt = v.transpose(1, 0, 2)                         # [hkv, page, d]
         pv = jax.lax.dot_general(
             pg, vt, (((2,), (1,)), ((0,), (0,))),
@@ -120,14 +125,117 @@ def _kernel(li_ref, table_ref, lens_ref,         # scalar prefetch
         l_ref[0] = l_s[:]
 
 
+def _kernel_manual(li_ref, table_ref, lens_ref,   # scalar prefetch
+                   q_ref, k_hbm, v_hbm,           # q VMEM; pools in HBM
+                   *refs,
+                   page: int, scale: float, quantized: bool):
+    """Manual-DMA variant: grid is (slots,) and the kernel loops over
+    the slot's pages itself with double-buffered async copies — page
+    j+1 streams from HBM while page j computes. This beats the
+    grid-per-page formulation (which pays per-grid-step pipeline
+    overhead on hundreds of tiny steps per layer: measured 0.71x the
+    slot cache's decode on a 7B) and reads length-exact pages."""
+    if quantized:
+        ks_hbm, vs_hbm = refs[0], refs[1]
+        refs = refs[2:]
+    else:
+        ks_hbm = vs_hbm = None
+    acc_ref, m_ref, l_ref = refs[:3]
+    scratch = refs[3:]
+    if quantized:
+        kb, vb, ksb, vsb, sem = scratch
+    else:
+        kb, vb, sem = scratch
+        ksb = vsb = None
+    i = pl.program_id(0)
+    li = li_ref[0]
+    length = lens_ref[i]
+    needed = (length + page - 1) // page
+    hq, d = q_ref.shape[1], q_ref.shape[2]
+    hkv = kb.shape[2]
+    g = hq // hkv
+
+    def dmas(slot, j):
+        pid = table_ref[i, j]
+        out = [pltpu.make_async_copy(k_hbm.at[li, pid], kb.at[slot],
+                                     sem.at[slot, 0]),
+               pltpu.make_async_copy(v_hbm.at[li, pid], vb.at[slot],
+                                     sem.at[slot, 1])]
+        if quantized:
+            out += [pltpu.make_async_copy(ks_hbm.at[li, pid],
+                                          ksb.at[slot],
+                                          sem.at[slot, 2]),
+                    pltpu.make_async_copy(vs_hbm.at[li, pid],
+                                          vsb.at[slot],
+                                          sem.at[slot, 3])]
+        return out
+
+    @pl.when(needed > 0)
+    def _prefetch_first():
+        for dma in dmas(0, 0):
+            dma.start()
+
+    q = q_ref[0].astype(jnp.float32) * scale              # [hq, d]
+    qg = q.reshape(hkv, g, d)
+
+    def page_step(j, carry):
+        acc, m_prev, l_prev = carry
+        slot = j % 2
+
+        @pl.when(j + 1 < needed)
+        def _prefetch_next():
+            for dma in dmas((j + 1) % 2, j + 1):
+                dma.start()
+
+        for dma in dmas(slot, j):
+            dma.wait()
+        k = kb[slot].astype(jnp.float32)                  # [page, hkv, d]
+        v = vb[slot].astype(jnp.float32)
+        kt = k.transpose(1, 2, 0)                         # [hkv, d, page]
+        logits = jax.lax.dot_general(
+            qg, kt, (((2,), (1,)), ((0,), (0,))),
+            preferred_element_type=jnp.float32)           # [hkv, g, page]
+        if quantized:
+            # head-major [hkv, page] scale blocks fold into the logits
+            # (k side) and p (v side): no reshapes, DMA-aligned minor.
+            logits = logits * ksb[slot].astype(jnp.float32)[:, None, :]
+        logits = logits.reshape(hq, page)
+        pos = j * page + jax.lax.broadcasted_iota(
+            jnp.int32, (hq, page), 1)
+        logits = jnp.where(pos < length, logits, _NEG_INF)
+        m_page = jnp.max(logits, axis=1, keepdims=True)
+        m_new = jnp.maximum(m_prev, m_page)
+        p = jnp.exp(logits - m_new)
+        p = jnp.where(pos < length, p, 0.0)
+        corr = jnp.exp(m_prev - m_new)
+        l_new = l_prev * corr + jnp.sum(p, axis=1, keepdims=True)
+        pg = p.reshape(hkv, g, page)
+        if quantized:
+            pg = pg * vsb[slot].astype(jnp.float32)[:, None, :]
+        vt = v.transpose(1, 0, 2)                         # [hkv, page, d]
+        pv = jax.lax.dot_general(
+            pg, vt, (((2,), (1,)), ((0,), (0,))),
+            preferred_element_type=jnp.float32)           # [hkv, g, d]
+        acc = acc * corr + pv.reshape(hq, d)
+        return acc, m_new, l_new
+
+    acc0 = jnp.zeros((hq, d), jnp.float32)
+    m0 = jnp.full((hq, 1), _NEG_INF, jnp.float32)
+    l0 = jnp.zeros((hq, 1), jnp.float32)
+    acc, m, l = jax.lax.fori_loop(0, needed, page_step, (acc0, m0, l0))
+    acc_ref[0] = acc
+    m_ref[0] = jnp.broadcast_to(m, m_ref.shape[1:])
+    l_ref[0] = jnp.broadcast_to(l, l_ref.shape[1:])
+
+
 def paged_decode_attention(
     q: jax.Array,                      # [slots, hq, d] current-token queries
     pool_k: jax.Array,                 # [L, n_pages, page, hkv, d]
     pool_v: jax.Array,
     table_p: jax.Array,                # [slots, P] page ids
     lengths: jax.Array,                # [slots] valid cache rows
-    k_scale: Optional[jax.Array] = None,  # [L, n_pages, page, hkv]
-    v_scale: Optional[jax.Array] = None,  # (unit dim pre-squeezed)
+    k_scale: Optional[jax.Array] = None,  # [L, n_pages, hkv, page]
+    v_scale: Optional[jax.Array] = None,  # (HEAD-MAJOR; see caller)
     *,
     layer: jax.Array | int = 0,        # which pool layer to attend over
     scale: Optional[float] = None,
@@ -154,31 +262,90 @@ def paged_decode_attention(
     quantized = k_scale is not None
 
     LANES = 128
-    grid = (slots, P)
-    kernel = functools.partial(_kernel, page=page, pages_per_slot=P,
-                               scale=scale, quantized=quantized)
-    out_shape = [
+    li = jnp.asarray(layer, jnp.int32).reshape(1)
+    out_shape_m = [
         jax.ShapeDtypeStruct((slots, hq, d), jnp.float32),
         jax.ShapeDtypeStruct((slots, hq, LANES), jnp.float32),
         jax.ShapeDtypeStruct((slots, hq, LANES), jnp.float32),
     ]
+    # Manual path constraint: the per-page scale DMA slices a
+    # [hkv, page] block whose minor dim (page) must be 128-aligned for
+    # Mosaic — int8 pools need page % 128 == 0 (the engine's default
+    # page is 128 for exactly this reason); bf16 pools have no scale
+    # operand and run at any page size.
+    if not interpret and (k_scale is None or page % 128 == 0):
+        # Compiled path: manual double-buffered page DMA, one grid step
+        # per slot (the per-page grid pays pipeline overhead on
+        # hundreds of tiny steps; interpret mode has no DMA emulation
+        # guarantee, so CPU tests ride the grid variant below).
+        kernel = functools.partial(_kernel_manual, page=page,
+                                   scale=scale, quantized=quantized)
+        any_spec = pl.BlockSpec(memory_space=pl.ANY)
+        in_specs = [
+            pl.BlockSpec((1, hq, d),
+                         lambda i, li, tab, lens: (i, 0, 0)),
+            any_spec, any_spec,
+        ]
+        args = [li, table_p, lengths, q, pool_k, pool_v]
+        n_sems = 2
+        scratch = [
+            pltpu.VMEM((2, page, hkv, d), pool_k.dtype),
+            pltpu.VMEM((2, page, hkv, d), pool_v.dtype),
+        ]
+        if quantized:
+            in_specs += [any_spec, any_spec]
+            args += [k_scale, v_scale]
+            scratch += [pltpu.VMEM((2, hkv, page), jnp.float32),
+                        pltpu.VMEM((2, hkv, page), jnp.float32)]
+            n_sems = 4
+        scratch.append(pltpu.SemaphoreType.DMA((2, n_sems)))
+        acc, m, l = pl.pallas_call(
+            kernel,
+            grid_spec=pltpu.PrefetchScalarGridSpec(
+                num_scalar_prefetch=3,           # layer, table, lengths
+                grid=(slots,),
+                in_specs=in_specs,
+                out_specs=[
+                    pl.BlockSpec((1, hq, d),
+                                 lambda i, li, tab, lens: (i, 0, 0)),
+                    pl.BlockSpec((1, hq, LANES),
+                                 lambda i, li, tab, lens: (i, 0, 0)),
+                    pl.BlockSpec((1, hq, LANES),
+                                 lambda i, li, tab, lens: (i, 0, 0)),
+                ],
+                scratch_shapes=scratch,
+            ),
+            out_shape=out_shape_m,
+        )(*args)
+        return acc, m[..., 0], l[..., 0]
+
+    grid = (slots, P)
+    kernel = functools.partial(_kernel, page=page, pages_per_slot=P,
+                               scale=scale, quantized=quantized)
+    out_shape = out_shape_m
+
+    def page_idx(i, j, lens):
+        # Clamp past-needed steps to the last needed page: a repeated
+        # block index skips the DMA (see kernel note).
+        needed = (lens[i] + page - 1) // page
+        return jnp.minimum(j, jnp.maximum(needed - 1, 0))
+
     in_specs = [
         pl.BlockSpec((1, hq, d), lambda i, j, li, tab, lens: (i, 0, 0)),
         pl.BlockSpec((1, 1, page, hkv, d), lambda i, j, li, tab, lens:
-                     (li[0], tab[i, j], 0, 0, 0)),
+                     (li[0], tab[i, page_idx(i, j, lens)], 0, 0, 0)),
         pl.BlockSpec((1, 1, page, hkv, d), lambda i, j, li, tab, lens:
-                     (li[0], tab[i, j], 0, 0, 0)),
+                     (li[0], tab[i, page_idx(i, j, lens)], 0, 0, 0)),
     ]
-    li = jnp.asarray(layer, jnp.int32).reshape(1)
     args = [li, table_p, lengths, q, pool_k, pool_v]
     if quantized:
         in_specs += [
-            pl.BlockSpec((1, 1, page, hkv),
+            pl.BlockSpec((1, 1, hkv, page),
                          lambda i, j, li, tab, lens:
-                         (li[0], tab[i, j], 0, 0)),
-            pl.BlockSpec((1, 1, page, hkv),
+                         (li[0], tab[i, page_idx(i, j, lens)], 0, 0)),
+            pl.BlockSpec((1, 1, hkv, page),
                          lambda i, j, li, tab, lens:
-                         (li[0], tab[i, j], 0, 0)),
+                         (li[0], tab[i, page_idx(i, j, lens)], 0, 0)),
         ]
         args += [k_scale, v_scale]
     acc, m, l = pl.pallas_call(

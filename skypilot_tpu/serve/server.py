@@ -37,7 +37,7 @@ class ModelServer:
                  max_seq: int = 1024, port: int = 8081,
                  model_path: Optional[str] = None,
                  quantize: Optional[str] = None,
-                 kv_cache: str = 'slot', page_size: int = 64):
+                 kv_cache: str = 'slot', page_size: int = 128):
         self.cfg_name = cfg_name
         self.model_path = model_path  # HF checkpoint dir (real weights)
         self.quantize = quantize      # 'int8' => int8 weights + KV cache
@@ -359,7 +359,7 @@ def main() -> None:
                         choices=['slot', 'paged'],
                         help='paged = shared page pool with prefix '
                              'caching + chunked prefill')
-    parser.add_argument('--page-size', type=int, default=64,
+    parser.add_argument('--page-size', type=int, default=128,
                         help='paged-cache page granularity (tokens); '
                              'larger pages DMA more efficiently, '
                              'smaller pages cache prefixes finer')
@@ -369,6 +369,8 @@ def main() -> None:
                         default=int(os.environ.get('SKYTPU_REPLICA_PORT',
                                                    '8081')))
     args = parser.parse_args()
+    if args.kv_cache != 'paged' and args.page_size != 128:
+        parser.error('--page-size only applies with --kv-cache paged')
     server = ModelServer(args.model, max_batch=args.max_batch,
                          max_seq=args.max_seq, port=args.port,
                          model_path=args.model_path,
