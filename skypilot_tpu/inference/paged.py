@@ -209,7 +209,12 @@ def paged_decode_horizon(
     # squeeze the unit dim and go head-major [L, n_pages, hkv, page]
     # (minor dim page is DMA-tileable where hkv is not; the kernels
     # fold these into logits/p — see the kernel layout note). The
-    # gather path keeps the broadcast-friendly storage shape.
+    # gather path keeps the broadcast-friendly storage shape. Cost:
+    # one full scale-pool relayout (~0.5 GB on a 7B) per HORIZON
+    # program — ~1% of a 64-step horizon's HBM traffic, but it does
+    # scale with pool capacity, not live tokens; storing the scales
+    # head-major would remove it at the price of a 2-D scatter in
+    # merge_rows_into_pool.
     if decode_impl == 'pallas' and cache.quantized:
         ks_sq = jnp.swapaxes(ks_pool[..., 0], -1, -2)
         vs_sq = jnp.swapaxes(vs_pool[..., 0], -1, -2)

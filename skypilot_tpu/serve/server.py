@@ -157,10 +157,15 @@ class ModelServer:
             req = self.engine.pop_finished(rid)
             del self._finished_events[rid]
             self._requests_served += 1
+        hit_eos = (req.eos_id is not None and req.output
+                   and req.output[-1] == req.eos_id)
         return {
             'request_id': rid,
             'tokens': req.output,
             'ttft_ms': req.ttft_ms,
+            'finish_reason': ('stop' if (req.stop_hit or hit_eos)
+                              else 'length'),
+            'prompt_tokens': len(req.prompt),
         }
 
     def submit_stream(self, prompt, max_new_tokens: int, temperature: float,
@@ -229,6 +234,13 @@ class ModelServer:
                         'active_slots': eng.num_active if eng else 0,
                         'max_batch': server.max_batch,
                     })
+                elif self.path == '/v1/models':
+                    self._json(200, {
+                        'object': 'list',
+                        'data': [{'id': server.cfg_name,
+                                  'object': 'model',
+                                  'owned_by': 'skypilot-tpu'}],
+                    })
                 else:
                     self._json(404, {'error': f'no route {self.path}'})
 
@@ -280,12 +292,183 @@ class ModelServer:
                             f'data: {json.dumps(done)}\n\n'.encode())
                         break
 
+            # ---------------- OpenAI-compatible surface ----------------
+            # The reference's serving recipes expose vLLM's OpenAI API
+            # (llm/llama-3/llama3.yaml, llm/vllm/README.md) — clients
+            # built against it work against these routes unchanged.
+            def _parse_sampling(self, payload, tok):
+                stop = payload.get('stop')
+                if stop is not None:
+                    if isinstance(stop, (str, bytes)):
+                        stop = [stop]
+                    stop = [tok.encode(s, bos=False)
+                            if isinstance(s, str)
+                            else [int(t) for t in s] for s in stop]
+                return dict(
+                    max_new_tokens=int(payload.get(
+                        'max_tokens', payload.get('max_new_tokens', 128))),
+                    temperature=float(payload.get('temperature', 0.0)),
+                    top_k=int(payload.get('top_k', 0)),
+                    top_p=float(payload.get('top_p', 1.0)),
+                    stop=stop,
+                    eos_id=payload.get('eos_id', tok.eos_id))
+
+            def _openai_completions(self, payload, chat: bool) -> None:
+                import time as time_mod
+                tok = server.tokenizer
+                if chat:
+                    msgs = payload['messages']
+                    # Minimal role-tagged template (no in-repo chat
+                    # templates; HF tokenizers with one still consume
+                    # plain text fine for completion-style serving).
+                    text = ''.join(
+                        f"{m['role']}: {m['content']}\n" for m in msgs)
+                    text += 'assistant:'
+                else:
+                    text = payload['prompt']
+                    # OpenAI accepts str | [str] | [int] | [[int]];
+                    # single-element wrappers unwrap (n>1 prompts need
+                    # one request per prompt — the engine queue batches
+                    # them anyway).
+                    if (isinstance(text, list) and text
+                            and isinstance(text[0], (list, str))):
+                        if len(text) != 1:
+                            raise ValueError(
+                                'multiple prompts per request are not '
+                                'supported; send one request per '
+                                'prompt')
+                        text = text[0]
+                prompt_ids = (tok.encode(text) if isinstance(text, str)
+                              else [int(t) for t in text])
+                kwargs = self._parse_sampling(payload, tok)
+                if payload.get('stream'):
+                    self._openai_stream(prompt_ids, payload, chat,
+                                        kwargs)
+                    return
+                result = server.submit(prompt_ids, **kwargs)
+                out_text = tok.decode(result['tokens'])
+                created = int(time_mod.time())
+                if chat:
+                    choice = {'index': 0,
+                              'message': {'role': 'assistant',
+                                          'content': out_text},
+                              'finish_reason': result['finish_reason']}
+                    obj = 'chat.completion'
+                else:
+                    choice = {'index': 0, 'text': out_text,
+                              'logprobs': None,
+                              'finish_reason': result['finish_reason']}
+                    obj = 'text_completion'
+                self._json(200, {
+                    'id': f'cmpl-{result["request_id"]}',
+                    'object': obj,
+                    'created': created,
+                    'model': server.cfg_name,
+                    'choices': [choice],
+                    'usage': {
+                        'prompt_tokens': result['prompt_tokens'],
+                        'completion_tokens': len(result['tokens']),
+                        'total_tokens': (result['prompt_tokens'] +
+                                         len(result['tokens'])),
+                    },
+                })
+
+            def _openai_stream(self, prompt_ids, payload, chat,
+                               kwargs) -> None:
+                import time as time_mod
+                tok = server.tokenizer
+                rid, sq = server.submit_stream(prompt_ids, **kwargs)
+                created = int(time_mod.time())
+                obj = ('chat.completion.chunk' if chat
+                       else 'text_completion')
+                def chunk_of(choice):
+                    return {'id': f'cmpl-{rid}', 'object': obj,
+                            'created': created,
+                            'model': server.cfg_name,
+                            'choices': [choice]}
+
+                def emit(data) -> None:
+                    self.wfile.write(f'data: {data}\n\n'.encode())
+                    self.wfile.flush()
+                try:
+                    self.send_response(200)
+                    self.send_header('Content-Type', 'text/event-stream')
+                    self.send_header('Cache-Control', 'no-cache')
+                    self.send_header('Connection', 'close')
+                    self.end_headers()
+                    if chat:
+                        # OpenAI chat streams open with a role delta.
+                        emit(json.dumps(chunk_of(
+                            {'index': 0,
+                             'delta': {'role': 'assistant'},
+                             'finish_reason': None})))
+                    while True:
+                        token, finished = sq.get(timeout=300)
+                        if token is None:
+                            # Engine died mid-stream: an explicit error
+                            # event (and NO [DONE]) so clients can tell
+                            # truncation from completion.
+                            emit(json.dumps({'error': {
+                                'message': 'engine failed'}}))
+                            break
+                        piece = tok.decode([int(token)])
+                        if chat:
+                            choice = {'index': 0,
+                                      'delta': {'content': piece},
+                                      'finish_reason': None}
+                        else:
+                            choice = {'index': 0, 'text': piece,
+                                      'finish_reason': None}
+                        emit(json.dumps(chunk_of(choice)))
+                        if finished:
+                            # Terminal chunk: empty delta/text with the
+                            # real finish_reason, then [DONE] — the
+                            # OpenAI truncation-detection contract.
+                            with server._lock:
+                                req = server.engine.get_finished(rid)
+                            hit_eos = (req is not None
+                                       and req.eos_id is not None
+                                       and req.output
+                                       and req.output[-1] == req.eos_id)
+                            reason = ('stop' if req is not None
+                                      and (req.stop_hit or hit_eos)
+                                      else 'length')
+                            final = ({'index': 0, 'delta': {},
+                                      'finish_reason': reason} if chat
+                                     else {'index': 0, 'text': '',
+                                           'finish_reason': reason})
+                            emit(json.dumps(chunk_of(final)))
+                            emit('[DONE]')
+                            break
+                except (BrokenPipeError, ConnectionResetError):
+                    pass
+                finally:
+                    server.finish_stream(rid)
+                    self.close_connection = True
+
             def do_POST(self):  # noqa: N802
-                if self.path != '/generate':
+                routes = ('/generate', '/v1/completions',
+                          '/v1/chat/completions')
+                if self.path not in routes:
                     self._json(404, {'error': f'no route {self.path}'})
                     return
                 if not server._ready.is_set():
                     self._json(503, {'status': 'loading'})
+                    return
+                if self.path != '/generate':
+                    length = int(self.headers.get('Content-Length', 0))
+                    try:
+                        payload = json.loads(self.rfile.read(length))
+                        self._openai_completions(
+                            payload, chat=self.path.endswith(
+                                'chat/completions'))
+                    except (KeyError, ValueError, TypeError,
+                            json.JSONDecodeError) as e:
+                        self._json(400, {'error': {
+                            'message': f'{type(e).__name__}: {e}',
+                            'type': 'invalid_request_error'}})
+                    except RuntimeError as e:
+                        self._json(500, {'error': {'message': str(e)}})
                     return
                 length = int(self.headers.get('Content-Length', 0))
                 try:
@@ -295,26 +478,11 @@ class ModelServer:
                     is_text = isinstance(prompt, str)
                     if is_text:
                         prompt = tok.encode(prompt)
-                    eos_id = payload.get('eos_id')
-                    if eos_id is None and is_text:
-                        eos_id = tok.eos_id
-                    stop = payload.get('stop')
-                    if stop is not None:
-                        if isinstance(stop, (str, bytes)):
-                            stop = [stop]
-                        # bos=False: generated output never contains
-                        # BOS, so a BOS-prefixed stop would never match.
-                        stop = [tok.encode(s, bos=False)
-                                if isinstance(s, str)
-                                else [int(t) for t in s] for s in stop]
-                    kwargs = dict(
-                        max_new_tokens=int(
-                            payload.get('max_new_tokens', 128)),
-                        temperature=float(payload.get('temperature', 0.0)),
-                        top_k=int(payload.get('top_k', 0)),
-                        top_p=float(payload.get('top_p', 1.0)),
-                        stop=stop,
-                        eos_id=eos_id)
+                    kwargs = self._parse_sampling(payload, tok)
+                    # /generate's legacy defaults: eos only applies to
+                    # text prompts unless explicitly requested.
+                    if 'eos_id' not in payload and not is_text:
+                        kwargs['eos_id'] = None
                     if payload.get('stream'):
                         self._stream_generate(prompt, is_text, kwargs)
                         return

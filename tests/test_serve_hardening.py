@@ -219,6 +219,111 @@ def test_generate_top_p_and_stop_over_http():
         server.stop()
 
 
+def test_openai_compatible_api():
+    """/v1/completions, /v1/chat/completions, /v1/models speak the
+    OpenAI wire format (the reference's serving recipes expose vLLM's
+    OpenAI server; clients built against it must work here)."""
+    from skypilot_tpu.serve.server import ModelServer
+    sport = common_utils.find_free_port(18920)
+    server = ModelServer('tiny', max_batch=2, max_seq=64, port=sport)
+    server.start(block=False)
+    deadline = time.time() + 120
+    while time.time() < deadline:
+        try:
+            with urllib.request.urlopen(
+                    f'http://127.0.0.1:{sport}/readiness', timeout=5) as r:
+                if r.status == 200:
+                    break
+        except Exception:
+            time.sleep(0.3)
+
+    def post(path, payload):
+        req = urllib.request.Request(
+            f'http://127.0.0.1:{sport}{path}',
+            data=json.dumps(payload).encode(),
+            headers={'Content-Type': 'application/json'})
+        with urllib.request.urlopen(req, timeout=60) as r:
+            return json.loads(r.read())
+    try:
+        with urllib.request.urlopen(
+                f'http://127.0.0.1:{sport}/v1/models', timeout=10) as r:
+            models = json.loads(r.read())
+        assert models['data'][0]['id'] == 'tiny'
+
+        comp = post('/v1/completions',
+                    {'model': 'tiny', 'prompt': 'ab', 'max_tokens': 6})
+        assert comp['object'] == 'text_completion'
+        assert comp['choices'][0]['finish_reason'] == 'length'
+        assert comp['usage']['completion_tokens'] == 6
+        assert isinstance(comp['choices'][0]['text'], str)
+
+        chat = post('/v1/chat/completions',
+                    {'model': 'tiny', 'max_tokens': 4,
+                     'messages': [{'role': 'user', 'content': 'hi'}]})
+        assert chat['object'] == 'chat.completion'
+        assert chat['choices'][0]['message']['role'] == 'assistant'
+
+        # streaming: OpenAI chunk objects then [DONE]
+        req = urllib.request.Request(
+            f'http://127.0.0.1:{sport}/v1/completions',
+            data=json.dumps({'prompt': 'ab', 'max_tokens': 4,
+                             'stream': True}).encode(),
+            headers={'Content-Type': 'application/json'})
+        events = []
+        with urllib.request.urlopen(req, timeout=60) as r:
+            assert 'text/event-stream' in r.headers.get('Content-Type', '')
+            for raw in r:
+                line = raw.decode().strip()
+                if line.startswith('data: '):
+                    events.append(line[len('data: '):])
+        assert events[-1] == '[DONE]'
+        chunks = [json.loads(e) for e in events[:-1]]
+        # 4 content chunks + the terminal finish_reason chunk (the
+        # OpenAI truncation-detection contract).
+        assert len(chunks) == 5
+        assert all(c['object'] == 'text_completion' for c in chunks)
+        assert all(c['choices'][0]['finish_reason'] is None
+                   for c in chunks[:-1])
+        assert chunks[-1]['choices'][0]['finish_reason'] == 'length'
+        assert chunks[-1]['choices'][0]['text'] == ''
+
+        # chat stream: role delta first, then content, then reason
+        req = urllib.request.Request(
+            f'http://127.0.0.1:{sport}/v1/chat/completions',
+            data=json.dumps({'max_tokens': 3, 'stream': True,
+                             'messages': [{'role': 'user',
+                                           'content': 'x'}]}).encode(),
+            headers={'Content-Type': 'application/json'})
+        events = []
+        with urllib.request.urlopen(req, timeout=60) as r:
+            for raw in r:
+                line = raw.decode().strip()
+                if line.startswith('data: '):
+                    events.append(line[len('data: '):])
+        assert events[-1] == '[DONE]'
+        cchunks = [json.loads(e) for e in events[:-1]]
+        assert cchunks[0]['choices'][0]['delta'] == {'role': 'assistant'}
+        assert cchunks[-1]['choices'][0]['finish_reason'] == 'length'
+        # OpenAI-style prompt variants: [str] and [[int]] unwrap
+        one = post('/v1/completions', {'prompt': ['ab'],
+                                       'max_tokens': 2})
+        assert len(one['choices'][0]['text']) >= 0
+        two = post('/v1/completions', {'prompt': [[3, 1, 4]],
+                                       'max_tokens': 2})
+        assert two['usage']['prompt_tokens'] == 3
+
+        # bad request -> OpenAI error envelope
+        try:
+            post('/v1/completions', {'max_tokens': 4})
+            raise AssertionError('expected 400')
+        except urllib.error.HTTPError as e:
+            assert e.code == 400
+            assert json.loads(e.read())['error']['type'] == \
+                'invalid_request_error'
+    finally:
+        server.stop()
+
+
 def test_sse_streaming_through_server_and_lb(monkeypatch):
     """E2e: the model server streams tokens as SSE; the LB passes the
     stream through unbuffered; the client sees per-token events then the
