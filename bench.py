@@ -327,19 +327,36 @@ def _flash_kernel_check(on_tpu: bool) -> dict:
     ref = np.asarray(reference_attention(q, k, v, causal=True))
     max_err = float(np.abs(out.astype(np.float32) -
                            ref.astype(np.float32)).max())
-    # Time N chained calls with one device sync at the end (a single
-    # call + host transfer measures dispatch/transfer, not the kernel).
-    # The sync is a scalar host read, NOT block_until_ready: the axon
-    # remote backend returns from block_until_ready without waiting.
-    n = 20
-    acc = q
+
+    # Device-side timing: N kernel invocations CHAINED INSIDE one
+    # program (acc feeds the next call's q, so nothing folds away) —
+    # one host round trip total. Round 4 timed N *separate* chained
+    # calls, which under the axon remote backend measures per-call
+    # dispatch (~10 ms each), not the kernel: it reported 550 ms for a
+    # ~4 GFLOP attention. The dispatch-inclusive number is kept
+    # alongside for visibility.
+    n = 32
+
+    @jax.jit
+    def chain(q, k, v):
+        def body(acc, _):
+            return flash_attention(acc, k, v, causal=True), None
+        acc, _ = jax.lax.scan(body, q, None, length=n)
+        return acc
+
+    float(jnp.sum(chain(q, k, v)))                # compile
     t0 = _t.perf_counter()
-    for _ in range(n):
-        acc = fn(acc, k, v)
-    float(jnp.sum(acc))
+    float(jnp.sum(chain(q, k, v)))                # scalar read = sync
     ms = (_t.perf_counter() - t0) * 1e3 / n
-    return {'ok': bool(max_err < 0.05), 'max_err': round(max_err, 4),
-            'shape': [b, s, h, d], 'ms': round(ms, 2)}
+    t0 = _t.perf_counter()
+    float(jnp.sum(fn(q, k, v)))
+    dispatch_ms = (_t.perf_counter() - t0) * 1e3
+    # Sanity: [4,512,16,128] causal is ~4.3 GFLOP + ~25 MB of HBM
+    # traffic — anything past 5 ms means the bench is measuring the
+    # harness again, and the number must not be trusted silently.
+    return {'ok': bool(max_err < 0.05 and ms < 5.0),
+            'max_err': round(max_err, 4), 'shape': [b, s, h, d],
+            'ms': round(ms, 3), 'dispatch_ms': round(dispatch_ms, 1)}
 
 
 def _train_step_bench(on_tpu: bool, n_chips: int,

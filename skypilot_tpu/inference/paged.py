@@ -158,18 +158,20 @@ def _maybe_quantize_rows(new_kv, quantized: bool):
             llama.quantize_kv_rows(v_rows))
 
 
-def _gather_layer(pool_layer: jax.Array, scale_layer, table_p: jax.Array,
-                  out_dtype) -> jax.Array:
-    """pool_layer [n_pages, page, hkv, d*] -> [slots, P*page, hkv, d]
-    contiguous view of each slot's first P pages (dequantized)."""
+def _gather_layer(pool_layer: jax.Array, scale_layer, table_p: jax.Array):
+    """pool_layer [n_pages, page, hkv, d*] -> ([slots, P*page, hkv, d],
+    scales or None): contiguous view of each slot's first P pages. int8
+    pools return CODES + gathered scales — the gathered copy stays int8
+    (half the write+read traffic of a dequantized gather) and the
+    attention op folds the scales into logits/probs."""
     g = pool_layer[table_p]                     # [slots, P, page, hkv, d*]
     slots, P, page = g.shape[:3]
     g = g.reshape((slots, P * page) + g.shape[3:])
     if scale_layer is not None:
         s = scale_layer[table_p]                # [slots, P, page, hkv, 1]
         s = s.reshape((slots, P * page) + s.shape[3:])
-        g = (g.astype(jnp.float32) * s).astype(out_dtype)
-    return g
+        return g, s
+    return g, None
 
 
 def paged_decode_horizon(
@@ -266,12 +268,13 @@ def paged_decode_horizon(
                 sv = (lax.dynamic_index_in_dim(vs_pool, li, 0,
                                                keepdims=False)
                       if cache.quantized else None)
-                ck = _gather_layer(pk, sk, table_p, xc.dtype)
-                cv = _gather_layer(pv, sv, table_p, xc.dtype)
+                ck, sck = _gather_layer(pk, sk, table_p)
+                cv, scv = _gather_layer(pv, sv, table_p)
 
                 def attn_fn(q, k, v):
                     return ring_decode_attention(q, k, v, ck, cv, len0,
-                                                 rk, rv, i)
+                                                 rk, rv, i, k_scale=sck,
+                                                 v_scale=scv)
 
             xc, new_kv, _ = llama._layer_core(layer, xc, cfg, positions,
                                               attn_fn)
@@ -347,11 +350,12 @@ def paged_prefill_chunk(
               if cache.quantized else None)
         sv = (lax.dynamic_index_in_dim(vs_pool, li, 0, keepdims=False)
               if cache.quantized else None)
-        ck = _gather_layer(pk, sk, table_p, xc.dtype)
-        cv = _gather_layer(pv, sv, table_p, xc.dtype)
+        ck, sck = _gather_layer(pk, sk, table_p)
+        cv, scv = _gather_layer(pv, sv, table_p)
 
         def attn_fn(q, k, v):
-            return cached_attention(q, k, v, ck, cv, len0)
+            return cached_attention(q, k, v, ck, cv, len0,
+                                    k_scale=sck, v_scale=scv)
 
         xc, new_kv, _ = llama._layer_core(layer, xc, cfg, positions,
                                           attn_fn)
@@ -510,6 +514,16 @@ class PagedInferenceEngine(_EngineBase):
                  decode_impl: str = 'auto'):
         from skypilot_tpu.inference.engine import prepare_params
         from skypilot_tpu.parallel import mesh as mesh_lib
+        if page_size % 128 != 0 and quantize == 'int8':
+            # The manual-DMA kernel's per-page scale blocks need a
+            # 128-aligned minor dim; off the fast path decode drops to
+            # the per-page-grid kernel (~0.71x measured). Loud, not
+            # silent — the model server exposes --page-size directly.
+            import warnings
+            warnings.warn(
+                f'page_size={page_size} is not a multiple of 128: int8 '
+                'paged decode falls off the manual-DMA fast path '
+                '(~0.7x throughput). Use a multiple of 128.')
         self.max_batch = max_batch
         self.max_seq = max_seq
         self.page = page_size
@@ -525,9 +539,8 @@ class PagedInferenceEngine(_EngineBase):
         self._param_bytes = quantization.quantized_bytes(self.params)
 
         if n_pages is None:
-            # Default capacity parity with the slot cache (shared pool,
-            # so prefix sharing turns the slack into extra headroom).
-            n_pages = max_batch * -(-max_seq // page_size) + 1
+            n_pages = self._auto_n_pages(cfg, max_batch, max_seq,
+                                         page_size)
         self.alloc = PageAllocator(n_pages, page_size)
         self.cache = PagedKVCache.create(cfg, n_pages=n_pages,
                                          page_size=page_size,
@@ -550,9 +563,50 @@ class PagedInferenceEngine(_EngineBase):
         # host slot state (queue/slots/finish from _EngineBase)
         self._init_slots(max_batch)
         self._pages: List[List[int]] = [[] for _ in range(max_batch)]
+        # slot -> tokens of its prompt TAIL prefilled so far; a slot in
+        # this dict is assigned but not yet decodable (continuous
+        # admission interleaves its remaining chunks with decode).
+        self._prefill_off: Dict[int, int] = {}
         self._decode_fn = self._build_decode()
         self._prefill_fns: Dict[Tuple[int, int], Any] = {}
         self.chunks_prefilled = 0          # diagnostics (prefix-hit wins)
+        self.preemptions = 0               # pool-pressure recomputes
+
+    @staticmethod
+    def _page_bytes(cfg: ModelConfig, page_size: int,
+                    quantized: bool) -> int:
+        return (cfg.n_layers * page_size * cfg.n_kv_heads *
+                (cfg.head_dim * (1 if quantized else
+                                 jnp.dtype(cfg.dtype).itemsize) +
+                 (4 if quantized else 0)) * 2)
+
+    def _auto_n_pages(self, cfg: ModelConfig, max_batch: int,
+                      max_seq: int, page_size: int) -> int:
+        """Size the pool from FREE HBM after the weights landed, not
+        from slot-cache parity: the pool is the paged engine's whole
+        advantage (HBM proportional to live tokens -> more concurrent
+        long contexts on the same chip), so idle HBM is wasted
+        capacity. A reserve covers decode transients (the horizon ring,
+        unembed logits, prefill activations) and XLA workspace. Falls
+        back to slot parity when the backend has no memory stats (CPU
+        tests, interpret mode)."""
+        parity = max_batch * -(-max_seq // page_size) + 1
+        from skypilot_tpu.models import quantization
+        quantized = quantization.is_quantized(self.params)
+        try:
+            stats = jax.devices()[0].memory_stats()
+            limit = stats['bytes_limit']
+            used = stats['bytes_in_use']
+        except Exception:  # pylint: disable=broad-except
+            return parity
+        reserve = max(int(1.5e9), int(0.10 * limit))
+        page_bytes = self._page_bytes(cfg, page_size, quantized)
+        fit = max(0, (limit - used - reserve)) // page_bytes
+        # Take what fits, capped at 4x slot parity (prefix-cache
+        # headroom without letting a tiny model grab the whole chip);
+        # under pool pressure admission backs off, so a sub-parity fit
+        # still serves. Never below 2 (page 0 is reserved).
+        return int(max(min(fit, 4 * parity), 2))
 
     @classmethod
     def from_pretrained(cls, path: str, *, dtype=None,
@@ -635,11 +689,8 @@ class PagedInferenceEngine(_EngineBase):
                 f'{self.alloc.n_pages - 1}; raise n_pages')
 
     def memory_stats(self) -> Dict[str, Any]:
-        page_bytes = (self.cfg.n_layers * self.page *
-                      self.cfg.n_kv_heads *
-                      (self.cfg.head_dim *
-                       jnp.dtype(self.cache.pool_k.dtype).itemsize +
-                       (4 if self.cache.quantized else 0)) * 2)
+        page_bytes = self._page_bytes(self.cfg, self.page,
+                                      self.cache.quantized)
         used = self.alloc.n_pages - 1 - len(self.alloc.free) \
             - len(self.alloc.retained)
         return {
@@ -679,21 +730,47 @@ class PagedInferenceEngine(_EngineBase):
         for p in self._pages[slot]:
             self.alloc.release(p)
         self._pages[slot] = []
+        self._prefill_off.pop(slot, None)        # cancel mid-prefill
         super()._free_slot(slot)
 
+    def _preempt_slot(self, slot: int) -> None:
+        """Pool pressure: push a live request back to the FRONT of the
+        queue, releasing its pages. It re-enters through _assign_slots
+        with prompt+output as context (recompute) — generated tokens
+        are kept, TTFT is not reset."""
+        req = self._slots[slot]
+        self.preemptions += 1
+        self._free_slot(slot)
+        self._requeue_front([req])
+
     def _admit(self) -> List[Tuple[int, int, bool]]:
-        free = [s for s in range(self.max_batch) if self._slots[s] is None]
-        # Cap one admission wave at the largest compiled n-bucket; the
-        # remainder waits for the next step() (mirrors the slot engine).
-        free = free[:self._PREFILL_N_BUCKETS[-1]]
-        batch: List[Tuple[int, Any]] = []
-        for slot in free:
+        """Continuous admission: assign free slots immediately, then run
+        at most ONE prefill chunk-batch before decode resumes. The
+        round-4 wave-synchronous admission ran *every* chunk of a wave
+        before any decode step — running requests stalled for the whole
+        wave (the measured 7.8 s burst TTFT was this architecture).
+        Interleaving one chunk per step bounds active-request TPOT at
+        one chunk time while prompts stream in (the JetStream/vLLM
+        continuous-batching admission contract, the capability the
+        reference serves through those engines)."""
+        self._assign_slots()
+        return self._prefill_chunk_batch()
+
+    def _assign_slots(self) -> None:
+        for slot in range(self.max_batch):
+            if self._slots[slot] is not None:
+                continue
             req = self._queue_pop()
             if req is None:
-                break
-            matched = self.alloc.match_prefix(req.prompt)
+                return
+            # A preempted request re-enters with its generated tokens as
+            # part of the context (preemption-by-recompute): prefilling
+            # prompt+output resumes generation exactly where it stopped,
+            # and the completed-prefill logits ARE its next token.
+            ctx = req.prompt + req.output
+            matched = self.alloc.match_prefix(ctx)
             self._pages[slot] = list(matched)
-            if not self._ensure_pages(slot, len(req.prompt)):
+            if not self._ensure_pages(slot, len(ctx)):
                 # Pool pressure: back to the FRONT of the queue (tail
                 # requeue would let later small requests starve it) and
                 # stop admitting.
@@ -701,81 +778,103 @@ class PagedInferenceEngine(_EngineBase):
                     self.alloc.release(p)
                 self._pages[slot] = []
                 self._requeue_front([req])
-                break
+                return
             self._slots[slot] = req
             self._slot_len[slot] = len(matched) * self.page
-            req._n_matched = len(matched)        # host-only annotation
-            batch.append((slot, req))
-        if not batch:
+            req._n_matched = len(matched)        # host-only annotations
+            req._ctx = ctx
+            self._prefill_off[slot] = 0          # tail tokens done so far
+
+    def _prefill_chunk_batch(self) -> List[Tuple[int, int, bool]]:
+        """One fixed-size chunk across up to a compiled n-bucket of
+        mid-prefill slots. Slots whose prompt completes this chunk emit
+        their first token and become decodable."""
+        pending = sorted(self._prefill_off)
+        if not pending:
             return []
-
-        # chunked prefill of the uncached tails (batched across slots)
-        n = next(b for b in self._PREFILL_N_BUCKETS
-                 if b >= len(batch)) if len(batch) <= \
-            self._PREFILL_N_BUCKETS[-1] else self._PREFILL_N_BUCKETS[-1]
-        tails = {s: r.prompt[int(self._slot_len[s]):] for s, r in batch}
-        max_tail = max(len(t) for t in tails.values())
-        n_chunks = -(-max_tail // self.chunk)
-        first_tokens: Dict[int, int] = {}
-        for c in range(n_chunks):
-            tokens = np.zeros((n, self.chunk), np.int32)
-            lengths = np.zeros(n, np.int32)
-            valid = np.zeros(n, np.int32)
-            want = np.full(n, -1, np.int32)
-            rows: List[Optional[int]] = [None] * n
-            P_needed = 1
-            for i, (slot, req) in enumerate(batch):
-                tail = tails[slot]
-                off = c * self.chunk
-                piece = tail[off:off + self.chunk]
-                rows[i] = slot
-                lengths[i] = self._slot_len[slot]
-                if piece:
-                    tokens[i, :len(piece)] = piece
-                    valid[i] = len(piece)
-                    if off + len(piece) == len(tail):
-                        want[i] = len(piece) - 1
-                P_needed = max(P_needed, self._pages_needed(
-                    int(lengths[i]) + int(valid[i])))
-            for i in range(len(batch), n):       # padding rows
-                rows[i] = batch[0][0]
-                lengths[i] = self._slot_len[batch[0][0]]
-            from skypilot_tpu.inference.engine import _bucket_len
-            P = _bucket_len(P_needed, minimum=1)
-            table_p = np.zeros((n, P), np.int32)
-            for i, (slot, _) in enumerate(batch):
-                ps = self._pages[slot][:P]
-                table_p[i, :len(ps)] = ps
-            prefill = self._get_prefill(n, P)
-            logits, self.cache = prefill(
-                self.params, self.cache, jnp.asarray(table_p),
-                jnp.asarray(tokens), jnp.asarray(lengths),
-                jnp.asarray(valid), jnp.asarray(want))
-            self.chunks_prefilled += 1
-            logits_np = np.asarray(logits)
-            for i, (slot, req) in enumerate(batch):
-                self._slot_len[slot] += int(valid[i])
-                if want[i] >= 0:
-                    first_tokens[slot] = int(
-                        np.argmax(logits_np[i]))
-
+        batch = pending[:self._PREFILL_N_BUCKETS[-1]]
+        n = next(b for b in self._PREFILL_N_BUCKETS if b >= len(batch))
+        tokens = np.zeros((n, self.chunk), np.int32)
+        lengths = np.zeros(n, np.int32)
+        valid = np.zeros(n, np.int32)
+        want = np.full(n, -1, np.int32)
+        P_needed = 1
+        pieces: List[List[int]] = []
+        for i, slot in enumerate(batch):
+            req = self._slots[slot]
+            tail = req._ctx[req._n_matched * self.page:]
+            off = self._prefill_off[slot]
+            piece = tail[off:off + self.chunk]
+            pieces.append(piece)
+            lengths[i] = self._slot_len[slot]
+            tokens[i, :len(piece)] = piece
+            valid[i] = len(piece)
+            if off + len(piece) == len(tail):
+                want[i] = len(piece) - 1
+            P_needed = max(P_needed, self._pages_needed(
+                int(lengths[i]) + int(valid[i])))
+        for i in range(len(batch), n):           # padding rows: valid=0
+            lengths[i] = self._slot_len[batch[0]]   # rows write to trash
+        from skypilot_tpu.inference.engine import _bucket_len
+        P = _bucket_len(P_needed, minimum=1)
+        table_p = np.zeros((n, P), np.int32)
+        for i, slot in enumerate(batch):
+            ps = self._pages[slot][:P]
+            table_p[i, :len(ps)] = ps
+        prefill = self._get_prefill(n, P)
+        logits, self.cache = prefill(
+            self.params, self.cache, jnp.asarray(table_p),
+            jnp.asarray(tokens), jnp.asarray(lengths),
+            jnp.asarray(valid), jnp.asarray(want))
+        self.chunks_prefilled += 1
+        logits_np = None
         now = time.time()
         events: List[Tuple[int, int, bool]] = []
-        for slot, req in batch:
-            self.alloc.register_prefix(req.prompt, self._pages[slot],
+        for i, slot in enumerate(batch):
+            req = self._slots[slot]
+            self._slot_len[slot] += int(valid[i])
+            self._prefill_off[slot] += int(valid[i])
+            if want[i] < 0:
+                continue                         # more chunks to go
+            del self._prefill_off[slot]          # decodable from now on
+            self.alloc.register_prefix(req._ctx, self._pages[slot],
                                        req._n_matched)
-            token = first_tokens[slot]
-            req.first_token_time = now
+            if logits_np is None:
+                logits_np = np.asarray(logits)
+            token = int(np.argmax(logits_np[i]))
+            if req.first_token_time is None:     # not on re-admission
+                req.first_token_time = now
             req.output.append(token)
             self._cur_token[slot] = token
             finished = self._maybe_finish(slot, token)
             events.append((req.request_id, token, finished))
         return events
 
+    def step(self, horizon: int = 1) -> List[Tuple[int, int, bool]]:
+        """Admit (one chunk max), then decode. While prompts are still
+        streaming in, the decode horizon is capped at
+        ``interleave_horizon`` so the next chunk runs within a bounded
+        number of decode steps (admission latency), and capped at a
+        medium bucket while the queue is non-empty so freed slots are
+        noticed promptly (a full 64-step horizon is ~2 s of wall clock
+        on a 7B — queue wait at that granularity is the burst-TTFT
+        bill). Steady state (no queue, no prefill) runs the caller's
+        full horizon."""
+        events = self._admit()
+        if self._prefill_off:
+            horizon = min(horizon, self.interleave_horizon)
+        elif self._queue:
+            horizon = min(horizon, 32)
+        events.extend(self._decode(horizon))
+        return events
+
+    interleave_horizon = 8
+
     # ---------------------------------------------------------- decode
     def _decode(self, horizon: int = 1) -> List[Tuple[int, int, bool]]:
         active_slots = [s for s in range(self.max_batch)
-                        if self._slots[s] is not None]
+                        if self._slots[s] is not None
+                        and s not in self._prefill_off]
         if not active_slots:
             return []
         cap = int(self.max_seq - 1 -
@@ -793,25 +892,48 @@ class PagedInferenceEngine(_EngineBase):
                 horizon = b
                 break
         # page capacity: every active slot must hold pages for
-        # len+horizon; shrink the horizon under pool pressure.
-        while horizon > 1:
-            if all(self._ensure_pages(s, int(self._slot_len[s]) + horizon)
-                   for s in active_slots):
-                break
-            horizon //= 2
-        else:
-            if not all(self._ensure_pages(s, int(self._slot_len[s]) + 1)
+        # len+horizon; shrink the horizon under pool pressure, and when
+        # even horizon=1 cannot fit, PREEMPT the newest request back to
+        # the queue (vLLM-style recompute: it re-enters with
+        # prompt+output as its context) instead of crashing — the
+        # auto-sized pool may legitimately be smaller than
+        # slots x max_seq.
+        while True:
+            while horizon > 1:
+                if all(self._ensure_pages(s,
+                                          int(self._slot_len[s]) + horizon)
                        for s in active_slots):
+                    break
+                horizon //= 2
+            if horizon > 1 or all(
+                    self._ensure_pages(s, int(self._slot_len[s]) + 1)
+                    for s in active_slots):
+                break
+            # Victim pool: every occupied slot (mid-prefill ones hold
+            # pages too) EXCEPT the oldest decodable request — keeping
+            # that one guarantees progress, and _validate_request
+            # guarantees it fits the pool alone.
+            oldest = min(active_slots,
+                         key=lambda s: self._slots[s].request_id)
+            cands = [s for s in range(self.max_batch)
+                     if self._slots[s] is not None and s != oldest]
+            if not cands:
                 raise MemoryError(
-                    'KV page pool exhausted even at horizon=1; '
-                    'raise n_pages or lower max_batch')
+                    'KV page pool exhausted even at horizon=1 with one '
+                    'active request; raise n_pages')
+            victim = max(cands, key=lambda s: self._slots[s].request_id)
+            self._preempt_slot(victim)
+            if victim in active_slots:
+                active_slots.remove(victim)
 
-        active = np.array([r is not None for r in self._slots])
+        ready = [r if s not in self._prefill_off else None
+                 for s, r in enumerate(self._slots)]
+        active = np.array([r is not None for r in ready])
         temps = np.array([r.temperature if r else 0.0
-                          for r in self._slots], np.float32)
-        topps = np.array([r.top_p if r else 1.0 for r in self._slots],
+                          for r in ready], np.float32)
+        topps = np.array([r.top_p if r else 1.0 for r in ready],
                          np.float32)
-        topks = np.array([r.top_k if r else 0 for r in self._slots],
+        topks = np.array([r.top_k if r else 0 for r in ready],
                          np.int32)
         sample = bool((temps > 0).any())
         from skypilot_tpu.inference.engine import _bucket_len
@@ -833,7 +955,7 @@ class PagedInferenceEngine(_EngineBase):
         toks = np.asarray(toks)
 
         events: List[Tuple[int, int, bool]] = []
-        for slot, req in enumerate(self._slots):
+        for slot, req in enumerate(ready):
             if req is None:
                 continue
             for i in range(horizon):

@@ -192,15 +192,6 @@ def quantize_kv_rows(rows: jax.Array) -> Tuple[jax.Array, jax.Array]:
     return q, scale
 
 
-def _deq_kv(rows: jax.Array, scale: Optional[jax.Array],
-            out_dtype) -> jax.Array:
-    """Identity for bf16 caches; int8 * scale (fuses into the consuming
-    matmul) for quantized ones."""
-    if scale is None:
-        return rows
-    return (rows.astype(jnp.float32) * scale).astype(out_dtype)
-
-
 def merge_rows_into_cache(cache: KVCache, k_rows: jax.Array,
                           v_rows: jax.Array, starts: jax.Array,
                           new_length: jax.Array) -> KVCache:
@@ -263,9 +254,9 @@ def _unembed_logits(params: Params, x: jax.Array,
     if cfg.tie_embeddings:                    # Gemma: unembed = embed^T
         return jnp.einsum('bsd,vd->bsv', x, params['embed'],
                           preferred_element_type=jnp.float32)
-    from skypilot_tpu.models.quantization import deq
-    return jnp.einsum('bsd,dv->bsv', x, deq(params['unembed']),
-                      preferred_element_type=jnp.float32)
+    from skypilot_tpu.models.quantization import qeinsum
+    return qeinsum('bsd,dv->bsv', x, params['unembed'],
+                   out_dtype=jnp.float32)
 
 
 def rope(x: jax.Array, positions: jax.Array, theta: float) -> jax.Array:
@@ -384,10 +375,10 @@ def _shard(x: jax.Array, *logical_axes: Optional[str]) -> jax.Array:
 
 
 def _ffn(layer: Params, x: jax.Array, cfg: ModelConfig) -> jax.Array:
-    from skypilot_tpu.models.quantization import deq
+    from skypilot_tpu.models.quantization import qeinsum
     lo = layer.get('lora') if isinstance(layer, dict) else None
-    gate = jnp.einsum('bsd,df->bsf', x, deq(layer['w_gate']))
-    up = jnp.einsum('bsd,df->bsf', x, deq(layer['w_up']))
+    gate = qeinsum('bsd,df->bsf', x, layer['w_gate'])
+    up = qeinsum('bsd,df->bsf', x, layer['w_up'])
     if lo is not None:
         from skypilot_tpu.models import lora as lora_lib
         gate = gate + lora_lib.apply(lo, 'w_gate', x, cfg)
@@ -396,7 +387,7 @@ def _ffn(layer: Params, x: jax.Array, cfg: ModelConfig) -> jax.Array:
         functools.partial(jax.nn.gelu, approximate=True)
     h = act(gate.astype(jnp.float32)).astype(x.dtype) * up
     h = _shard(h, 'batch', 'seq', 'mlp')
-    down = jnp.einsum('bsf,fd->bsd', h, deq(layer['w_down']))
+    down = qeinsum('bsf,fd->bsd', h, layer['w_down'])
     if lo is not None:
         from skypilot_tpu.models import lora as lora_lib
         down = down + lora_lib.apply(lo, 'w_down', h, cfg)
@@ -414,11 +405,11 @@ def _layer_core(layer: Params, x: jax.Array, cfg: ModelConfig,
     from jax.ad_checkpoint import checkpoint_name
     h = rms_norm(x, layer['attn_norm'], cfg.norm_eps,
                   cfg.norm_plus_one)
-    from skypilot_tpu.models.quantization import deq
+    from skypilot_tpu.models.quantization import qeinsum
     lo = layer.get('lora') if isinstance(layer, dict) else None
-    q = jnp.einsum('bsd,dhk->bshk', h, deq(layer['wq']))
-    k = jnp.einsum('bsd,dhk->bshk', h, deq(layer['wk']))
-    v = jnp.einsum('bsd,dhk->bshk', h, deq(layer['wv']))
+    q = qeinsum('bsd,dhk->bshk', h, layer['wq'])
+    k = qeinsum('bsd,dhk->bshk', h, layer['wk'])
+    v = qeinsum('bsd,dhk->bshk', h, layer['wv'])
     if lo is not None:
         from skypilot_tpu.models import lora as lora_lib
         q = q + lora_lib.apply(lo, 'wq', h, cfg)
@@ -438,7 +429,7 @@ def _layer_core(layer: Params, x: jax.Array, cfg: ModelConfig,
     # forward, at [b,s,h,d] bytes per layer.
     out = checkpoint_name(out, 'attn_out')
     out = _shard(out, 'batch', 'seq', 'heads', 'head_dim')
-    proj = jnp.einsum('bshk,hkd->bsd', out, deq(layer['wo']))
+    proj = qeinsum('bshk,hkd->bsd', out, layer['wo'])
     if lo is not None:
         proj = proj + lora_lib.apply(lo, 'wo', out, cfg)
     x = x + proj
@@ -465,11 +456,17 @@ def _layer_fn(layer: Params, x: jax.Array, cfg: ModelConfig,
         # Two-block attention: the cache is read-only here (forward
         # scatters the new rows once, after the layer scan) — a decode
         # step's cache traffic is one streaming read + an s-token write,
-        # not a full rewrite through scan carries.
-        ck, cv = cache_kv
+        # not a full rewrite through scan carries. int8 caches arrive as
+        # a 4-tuple of (codes, codes, k_scale, v_scale) and are
+        # contracted in int8 (see cached_attention).
+        if len(cache_kv) == 4:
+            ck, cv, sk, sv = cache_kv
+        else:
+            (ck, cv), sk, sv = cache_kv, None, None
 
         def attn_fn(q, k, v):
-            return cached_attention(q, k, v, ck, cv, cache_len)
+            return cached_attention(q, k, v, ck, cv, cache_len,
+                                    k_scale=sk, v_scale=sv)
 
     x, new_kv, aux = _layer_core(layer, x, cfg, positions, attn_fn)
     return x, (None if cache_kv is None else new_kv), aux
@@ -611,13 +608,15 @@ def forward(
                 cv = lax.dynamic_index_in_dim(cv_stack, li, axis=0,
                                               keepdims=False)
                 if cache.quantized:
-                    ck = _deq_kv(ck, lax.dynamic_index_in_dim(
-                        ks_stack, li, axis=0, keepdims=False),
-                        carry.dtype)
-                    cv = _deq_kv(cv, lax.dynamic_index_in_dim(
-                        vs_stack, li, axis=0, keepdims=False),
-                        carry.dtype)
-                out, new_kv, aux = scan_body_fn(carry, (layer, (ck, cv)))
+                    layer_cache = (
+                        ck, cv,
+                        lax.dynamic_index_in_dim(ks_stack, li, axis=0,
+                                                 keepdims=False),
+                        lax.dynamic_index_in_dim(vs_stack, li, axis=0,
+                                                 keepdims=False))
+                else:
+                    layer_cache = (ck, cv)
+                out, new_kv, aux = scan_body_fn(carry, (layer, layer_cache))
                 return out, (new_kv, aux)
 
             x1, (kv_rows, auxs) = lax.scan(
@@ -736,16 +735,21 @@ def decode_horizon(
             ck = lax.dynamic_index_in_dim(cache_k, li, 0, keepdims=False)
             cv = lax.dynamic_index_in_dim(cache_v, li, 0, keepdims=False)
             if cache.quantized:
-                ck = _deq_kv(ck, lax.dynamic_index_in_dim(
-                    k_scale, li, 0, keepdims=False), xc.dtype)
-                cv = _deq_kv(cv, lax.dynamic_index_in_dim(
-                    v_scale, li, 0, keepdims=False), xc.dtype)
+                # int8 codes stay int8 across HBM; the per-row scales
+                # fold into logits/probs inside the attention op.
+                sk = lax.dynamic_index_in_dim(k_scale, li, 0,
+                                              keepdims=False)
+                sv = lax.dynamic_index_in_dim(v_scale, li, 0,
+                                              keepdims=False)
+            else:
+                sk = sv = None
             rk = lax.dynamic_index_in_dim(ring_k, li, 0, keepdims=False)
             rv = lax.dynamic_index_in_dim(ring_v, li, 0, keepdims=False)
 
             def attn_fn(q, k, v):
                 return ring_decode_attention(q, k, v, ck, cv, len0,
-                                             rk, rv, i)
+                                             rk, rv, i, k_scale=sk,
+                                             v_scale=sv)
 
             xc, new_kv, _ = _layer_core(layer, xc, cfg, positions, attn_fn)
             return xc, new_kv

@@ -49,6 +49,46 @@ def deq(w) -> jax.Array:
     return w
 
 
+def qeinsum(eq: str, x: jax.Array, w, *, out_dtype=None) -> jax.Array:
+    """``jnp.einsum(eq, x, w)`` that keeps int8 weights int8 across HBM.
+
+    The pre-dequantize form (``einsum(x, deq(w))``) streams the weight at
+    ~290 GB/s on a v5e — the scale-multiply keeps XLA from using its fast
+    int8 operand path. Contracting the int8 CODES directly in the dot and
+    applying the per-output-channel scale to the (tiny) output runs the
+    same stream at ~430 GB/s measured, and is *more* accurate (the scale
+    multiply happens once per output in fp32 instead of once per weight
+    element in bf16). Supported ``eq`` shapes are the model's weight
+    patterns: w's contracted axes lead and match x's trailing axes
+    ('bsd,dhk->bshk', 'bshk,hkd->bsd', 'bsd,df->bsf', ...).
+
+    Falls back to plain einsum for unquantized weights."""
+    if not isinstance(w, QuantizedWeight):
+        if out_dtype is not None:
+            return jnp.einsum(eq, x, w, preferred_element_type=out_dtype)
+        return jnp.einsum(eq, x, w)
+    ins, _ = eq.split('->')
+    xs, ws = ins.split(',')
+    nc = sum(c in xs for c in ws)
+    assert all(c in xs for c in ws[:nc]) and \
+        xs[-nc:] == ws[:nc], f'unsupported qeinsum pattern {eq!r}'
+    k = 1
+    for d in w.shape[:nc]:
+        k *= d
+    n = 1
+    for d in w.shape[nc:]:
+        n *= d
+    batch_shape = x.shape[:x.ndim - nc]
+    x2 = x.reshape(batch_shape + (k,))
+    w2 = w.int8.reshape(k, n)
+    y = jax.lax.dot_general(
+        x2, w2, (((x2.ndim - 1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)
+    y = y * w.scale.reshape(n).astype(jnp.float32)
+    out_dtype = out_dtype if out_dtype is not None else x.dtype
+    return y.astype(out_dtype).reshape(batch_shape + w.shape[nc:])
+
+
 def _quantize_array(w: jax.Array, reduce_axes) -> QuantizedWeight:
     """Symmetric per-channel int8: scale = absmax/127 over the
     CONTRACTING axes, so each output channel keeps its dynamic range."""

@@ -267,15 +267,35 @@ def load_hf_params(path: str, cfg: ModelConfig,
 def _host_quantize(a: np.ndarray, reduce_axes, scale_dtype):
     """Numpy twin of ``quantization._quantize_array`` (same rounded-scale
     contract): quantizes on the host so only int8 + scales hit the
-    device."""
+    device. Stacked layer leaves quantize one layer-slice at a time —
+    the fp32 transient stays ~1/L of the leaf (a 7B MLP leaf upcast
+    whole is ~5.8 GB), with reduce axes always excluding axis 0."""
     from skypilot_tpu.models.quantization import QuantizedWeight
+
+    if a.ndim >= 3 and 0 not in reduce_axes:
+        q = np.empty(a.shape, np.int8)
+        scales = []
+        sub_axes = tuple(ax - 1 for ax in reduce_axes)
+        for i in range(a.shape[0]):
+            qi, si = _host_quantize_slice(a[i], sub_axes, scale_dtype)
+            q[i] = qi
+            scales.append(si)
+        scale = np.stack(scales)
+        return QuantizedWeight(int8=jnp.asarray(q),
+                               scale=jnp.asarray(scale))
+    q, scale = _host_quantize_slice(a, reduce_axes, scale_dtype)
+    return QuantizedWeight(int8=jnp.asarray(q), scale=jnp.asarray(scale))
+
+
+def _host_quantize_slice(a: np.ndarray, reduce_axes, scale_dtype):
+    """Round-scale-first int8 quantize of one array (fp32 transient =
+    this slice only)."""
     af = np.asarray(a, np.float32)
     absmax = np.max(np.abs(af), axis=reduce_axes, keepdims=True)
-    # Round the scale to the storage dtype first (see _quantize_array).
     scale = (np.maximum(absmax, 1e-8) / 127.0).astype(scale_dtype)
     q = np.clip(np.rint(af / scale.astype(np.float32)), -127,
                 127).astype(np.int8)
-    return QuantizedWeight(int8=jnp.asarray(q), scale=jnp.asarray(scale))
+    return q, scale
 
 
 def load_checkpoint(path: str,
@@ -287,12 +307,12 @@ def load_checkpoint(path: str,
     """One-call import: HF dir -> (ModelConfig, params).
 
     With ``quantize='int8'`` the quantized tree is cached next to the
-    checkpoint (``.int8_cache.npz``): the first load pays the full
-    fp16-read + host-quantize pass (~minutes at 7B on one core); reruns
-    read the ~2x-smaller int8 tree directly. Best-effort — a read-only
-    checkpoint dir just skips the cache."""
+    checkpoint (``.int8_cache.bin`` + ``.meta.json`` manifest): the
+    first load pays the full fp16-read + host-quantize pass; reruns
+    mmap the ~2x-smaller int8 tree and device_put leaves in parallel.
+    Best-effort — a read-only checkpoint dir just skips the cache."""
     cfg = config_from_hf(_read_hf_config(path), name=name, dtype=dtype)
-    cache_file = os.path.join(path, '.int8_cache.npz')
+    cache_file = os.path.join(path, '.int8_cache.bin')
     fingerprint = _cache_fingerprint(path, dtype)
     if quantize == 'int8' and use_cache and os.path.exists(cache_file):
         try:
@@ -323,13 +343,21 @@ def _cache_fingerprint(path: str, dtype: Any) -> Dict[str, Any]:
 
 
 def _read_cache_meta(cache_file: str) -> Optional[Dict[str, Any]]:
+    """The saved fingerprint (for staleness checks)."""
+    meta = _read_cache_manifest(cache_file)
+    if meta is None:
+        return None
+    fp = meta['fingerprint']
+    fp['files'] = [tuple(e) for e in fp.get('files', [])]
+    return fp
+
+
+def _read_cache_manifest(cache_file: str) -> Optional[Dict[str, Any]]:
     meta_file = cache_file + '.meta.json'
     if not os.path.exists(meta_file):
         return None
     with open(meta_file, encoding='utf-8') as f:
-        meta = json.load(f)
-    meta['files'] = [tuple(e) for e in meta.get('files', [])]
-    return meta
+        return json.load(f)
 
 
 def _flatten_leaves(params: Params, prefix: str = ''):
@@ -346,36 +374,70 @@ def _flatten_leaves(params: Params, prefix: str = ''):
 
 def _save_int8_cache(cache_file: str, params: Params,
                      fingerprint: Dict[str, Any]) -> None:
-    """npz of the quantized tree. bf16 arrays ride as uint16 views with
-    a ``#bf16`` name tag (npz has no bf16 dtype). The meta file is
-    written LAST so a crashed save never yields a valid-looking cache."""
-    out = {}
+    """Flat binary + JSON manifest: each leaf's raw little-endian
+    buffer at a 128-byte-aligned offset. The loader np.memmaps the file
+    and hands zero-copy views straight to ``jax.device_put`` — the
+    round-4 npz (zip-container) cache decompressed through a single
+    thread at ~0.25 GB/s (27.9 s for the 7B int8 tree, which is
+    replica scale-up latency). bf16 arrays ride as uint16 with a
+    ``view`` tag (numpy has no native bf16). The meta file is written
+    LAST so a crashed save never yields a valid-looking cache."""
+    align = 128
+    manifest = []
+    entries = []
+    off = 0
     for name, leaf in _flatten_leaves(params):
-        a = np.asarray(leaf)
+        a = np.ascontiguousarray(np.asarray(leaf))
+        view = None
         if a.dtype == jnp.bfloat16:
-            out[name + '#bf16'] = a.view(np.uint16)
-        else:
-            out[name] = a
+            a = a.view(np.uint16)
+            view = 'bfloat16'
+        off = (off + align - 1) // align * align
+        manifest.append({'name': name, 'dtype': str(a.dtype),
+                         'view': view, 'shape': list(a.shape),
+                         'offset': off, 'nbytes': int(a.nbytes)})
+        entries.append((off, a))
+        off += a.nbytes
     tmp = cache_file + '.tmp'
     with open(tmp, 'wb') as f:
-        np.savez(f, **out)
+        for o, a in entries:
+            f.seek(o)
+            a.tofile(f)
     os.replace(tmp, cache_file)
     meta_tmp = cache_file + '.meta.json.tmp'
     with open(meta_tmp, 'w', encoding='utf-8') as f:
-        json.dump(fingerprint, f)
+        json.dump({'version': 2, 'fingerprint': fingerprint,
+                   'manifest': manifest}, f)
     os.replace(meta_tmp, cache_file + '.meta.json')
+    # Drop the round-4 zip-container cache (superseded; multi-GB).
+    legacy = cache_file[:-len('.bin')] + '.npz'
+    for f in (legacy, legacy + '.meta.json'):
+        try:
+            os.remove(f)
+        except OSError:
+            pass
 
 
 def _load_int8_cache(cache_file: str, cfg: ModelConfig) -> Params:
+    from concurrent.futures import ThreadPoolExecutor
+
     from skypilot_tpu.models.quantization import QuantizedWeight
-    z = np.load(cache_file)
-    flat: Dict[str, Any] = {}
-    for name in z.files:
-        a = z[name]
-        if name.endswith('#bf16'):
-            name = name[:-5]
+    meta = _read_cache_manifest(cache_file)
+    mm = np.memmap(cache_file, dtype=np.uint8, mode='r')
+
+    def fetch(entry):
+        raw = mm[entry['offset']:entry['offset'] + entry['nbytes']]
+        a = raw.view(np.dtype(entry['dtype'])).reshape(entry['shape'])
+        if entry['view'] == 'bfloat16':
             a = a.view(jnp.bfloat16)
-        flat[name] = jnp.asarray(a)
+        return entry['name'], jnp.asarray(a)
+
+    # Parallel device puts: each leaf streams disk -> page cache ->
+    # device independently; 8 threads overlap the host read with the
+    # transfer (the serialized per-leaf put was the other half of the
+    # 27.9 s).
+    with ThreadPoolExecutor(max_workers=8) as ex:
+        flat = dict(ex.map(fetch, meta['manifest']))
     params: Params = {}
     pending: Dict[str, Dict[str, Any]] = {}
     for name, arr in flat.items():

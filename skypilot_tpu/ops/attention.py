@@ -70,6 +70,14 @@ def reference_attention(
     return out.reshape(b, sq, h, d)
 
 
+def _scale_bhk(s: Optional[jax.Array]) -> Optional[jax.Array]:
+    """[b, S, hkv, 1] fp32 per-row KV scales -> [b, hkv, 1, 1, S] for
+    folding into 'bhgqk' logits/probs."""
+    if s is None:
+        return None
+    return jnp.transpose(s[..., 0], (0, 2, 1))[:, :, None, None, :]
+
+
 def cached_attention(
     q: jax.Array,                      # [b, s, h, d] new-token queries
     k_new: jax.Array,                  # [b, s, hkv, d] new-token keys
@@ -79,6 +87,8 @@ def cached_attention(
     cache_len: jax.Array,              # [b] valid cache entries
     *,
     scale: Optional[float] = None,
+    k_scale: Optional[jax.Array] = None,   # [b, S, hkv, 1] fp32: cache_k/v
+    v_scale: Optional[jax.Array] = None,   # are int8 CODES when given
 ) -> jax.Array:
     """Decode/prefill attention against a KV cache without materializing
     the concatenated [cache; new] sequence.
@@ -89,7 +99,12 @@ def cached_attention(
     within the s new positions). The cache is only READ here — the caller
     scatters the new rows in afterwards — so a decode step's cache traffic
     is one streaming read plus an s-token write, not a full rewrite.
-    fp32 logits/softmax; GQA stays in grouped form (no kv broadcast)."""
+    fp32 logits/softmax; GQA stays in grouped form (no kv broadcast).
+
+    int8 caches pass CODES + per-row scales: the codes are contracted
+    directly (int8 stays int8 across HBM — a pre-dequantized operand
+    streams ~30% slower, see quantization.qeinsum) and the row scales
+    fold into the fp32 logits (K) / probabilities (V) exactly."""
     b, s, h, d = q.shape
     hkv = k_new.shape[2]
     group = h // hkv
@@ -98,6 +113,9 @@ def cached_attention(
 
     lc = jnp.einsum('bqhgd,bkhd->bhgqk', qg, cache_k,
                     preferred_element_type=jnp.float32) * scale
+    ks = _scale_bhk(k_scale)
+    if ks is not None:
+        lc = lc * ks
     ls = jnp.einsum('bqhgd,bkhd->bhgqk', qg, k_new,
                     preferred_element_type=jnp.float32) * scale
 
@@ -114,8 +132,16 @@ def cached_attention(
     ec = jnp.exp(lc - m)
     es = jnp.exp(ls - m)
     denom = jnp.sum(ec, -1, keepdims=True) + jnp.sum(es, -1, keepdims=True)
-    out = jnp.einsum('bhgqk,bkhd->bqhgd', (ec / denom).astype(cache_v.dtype),
-                     cache_v)
+    pc = ec / denom
+    vs = _scale_bhk(v_scale)
+    if vs is not None:
+        pc = pc * vs
+        out = jnp.einsum('bhgqk,bkhd->bqhgd', pc.astype(jnp.bfloat16),
+                         cache_v, preferred_element_type=jnp.float32
+                         ).astype(q.dtype)
+    else:
+        out = jnp.einsum('bhgqk,bkhd->bqhgd', pc.astype(cache_v.dtype),
+                         cache_v)
     out = out + jnp.einsum('bhgqk,bkhd->bqhgd',
                            (es / denom).astype(v_new.dtype), v_new)
     return out.reshape(b, s, h, d)
@@ -134,13 +160,16 @@ def ring_decode_attention(
     ring_len: jax.Array,               # scalar: rows < ring_len are valid
     *,
     scale: Optional[float] = None,
+    k_scale: Optional[jax.Array] = None,   # [b, S, hkv, 1] fp32: cache_k/v
+    v_scale: Optional[jax.Array] = None,   # are int8 CODES when given
 ) -> jax.Array:
     """Single-token decode attention over three blocks sharing one
     softmax: the main cache (read-only inside a fused multi-step decode —
     its mask depends only on the horizon-start lengths), the ring of rows
     produced by the previous steps of this horizon, and the current
     token. Keeping the main cache out of the loop carry is the point:
-    XLA then streams it instead of re-materializing it every step."""
+    XLA then streams it instead of re-materializing it every step.
+    int8 caches pass codes + scales (see cached_attention)."""
     b, _, h, d = q.shape
     hkv = k_self.shape[2]
     group = h // hkv
@@ -149,6 +178,9 @@ def ring_decode_attention(
 
     lc = jnp.einsum('bqhgd,bkhd->bhgqk', qg, cache_k,
                     preferred_element_type=jnp.float32) * scale
+    ks = _scale_bhk(k_scale)
+    if ks is not None:
+        lc = lc * ks
     lr = jnp.einsum('bqhgd,bkhd->bhgqk', qg, ring_k,
                     preferred_element_type=jnp.float32) * scale
     lself = jnp.einsum('bqhgd,bqhd->bhgq', qg, k_self,
@@ -166,8 +198,16 @@ def ring_decode_attention(
     ec, er, es = jnp.exp(lc - m), jnp.exp(lr - m), jnp.exp(lself - m)
     denom = (jnp.sum(ec, -1, keepdims=True) +
              jnp.sum(er, -1, keepdims=True) + es)
-    out = jnp.einsum('bhgqk,bkhd->bqhgd',
-                     (ec / denom).astype(cache_v.dtype), cache_v)
+    pc = ec / denom
+    vs = _scale_bhk(v_scale)
+    if vs is not None:
+        out = jnp.einsum('bhgqk,bkhd->bqhgd',
+                         (pc * vs).astype(jnp.bfloat16), cache_v,
+                         preferred_element_type=jnp.float32
+                         ).astype(q.dtype)
+    else:
+        out = jnp.einsum('bhgqk,bkhd->bqhgd', pc.astype(cache_v.dtype),
+                         cache_v)
     out = out + jnp.einsum('bhgqk,bkhd->bqhgd',
                            (er / denom).astype(ring_v.dtype), ring_v)
     w_self = (es / denom)[..., 0].transpose(0, 3, 1, 2)   # [b, 1, hkv, g]

@@ -52,6 +52,7 @@ def pipeline_layers(
     num_microbatches: Optional[int] = None,
     axis_name: str = 'pp',
     with_aux: bool = False,
+    skip_bubbles: Optional[bool] = None,   # None = auto from mesh axes
 ) -> Any:
     """Apply the full layer stack to ``x`` through the pipeline.
 
@@ -77,10 +78,20 @@ def pipeline_layers(
     # (fsdp param all-gathers, tp psums), ranks in different branches
     # execute different collective streams and the runtime deadlocks
     # (observed on XLA:CPU: half the devices at permute N, half at N+1).
-    # Skip bubbles only when the intra-stage axes are trivial; otherwise
-    # compute bubbles unconditionally (correct, GPipe-classic).
-    skip_bubbles = all(mesh.shape.get(a, 1) == 1
-                       for a in ('fsdp', 'tp', 'sp'))
+    #
+    # fsdp is handled by making the collective schedule UNIFORM: the
+    # stage's param all-gather is hoisted OUT of the cond (an explicit
+    # replication constraint per tick, executed by every rank on every
+    # tick — bubbles included), so the cond branches contain no
+    # collectives at all. The gather itself is the same traffic the
+    # non-skip path paid (the partitioner gathered per stage body);
+    # only the bubble FLOPs are skipped. tp/sp still disable the skip:
+    # their psums ride inside the layer math where no such hoist
+    # exists.
+    if skip_bubbles is None:
+        skip_bubbles = all(mesh.shape.get(a, 1) == 1
+                           for a in ('tp', 'sp'))
+    hoist_gather = (skip_bubbles and mesh.shape.get('fsdp', 1) > 1)
 
     def body(params_local, x_full):
         x_full = x_full.astype(x_dtype)
@@ -91,13 +102,16 @@ def pipeline_layers(
         aux_acc = jnp.zeros((), jnp.float32)
         fwd = [(i, (i + 1) % pp) for i in range(pp)]
 
-        def run_stage(x_in):
-            out = stage_fn(params_local, x_in)
+        def run_stage_with(params, x_in):
+            out = stage_fn(params, x_in)
             if with_aux:
                 y, aux = out
             else:
                 y, aux = out, jnp.zeros((), jnp.float32)
             return y.astype(bdt), aux.astype(jnp.float32)
+
+        def run_stage(x_in):
+            return run_stage_with(params_local, x_in)
 
         def skip_stage(x_in):
             # Bubble tick: no live microbatch here — identity, no
@@ -112,7 +126,20 @@ def pipeline_layers(
             x_in = jnp.where(rank == 0,
                              mbs[jnp.clip(t, 0, n_micro - 1)].astype(bdt),
                              recv)
-            if skip_bubbles:
+            if hoist_gather:
+                # Uniform per-tick param gather (see skip_bubbles note):
+                # every rank executes this all-gather every tick, so the
+                # cond below is collective-free on both branches. Peak
+                # memory holds one stage's params unsharded over fsdp —
+                # the same transient the stage body's own gather created.
+                gathered = jax.tree.map(
+                    lambda p: lax.with_sharding_constraint(p, P()),
+                    params_local)
+                y, aux = lax.cond(
+                    active,
+                    lambda xi: run_stage_with(gathered, xi),
+                    skip_stage, x_in.astype(x_dtype))
+            elif skip_bubbles:
                 y, aux = lax.cond(active, run_stage, skip_stage,
                                   x_in.astype(x_dtype))
             else:

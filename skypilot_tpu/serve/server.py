@@ -37,7 +37,7 @@ class ModelServer:
                  max_seq: int = 1024, port: int = 8081,
                  model_path: Optional[str] = None,
                  quantize: Optional[str] = None,
-                 kv_cache: str = 'slot', page_size: int = 128):
+                 kv_cache: str = 'paged', page_size: int = 128):
         self.cfg_name = cfg_name
         self.model_path = model_path  # HF checkpoint dir (real weights)
         self.quantize = quantize      # 'int8' => int8 weights + KV cache
@@ -523,14 +523,16 @@ def main() -> None:
                         help='HF checkpoint dir (real weights + tokenizer)')
     parser.add_argument('--quantize', default=None, choices=['int8'],
                         help='int8 weights + KV cache (2x decode)')
-    parser.add_argument('--kv-cache', default='slot',
+    parser.add_argument('--kv-cache', default='paged',
                         choices=['slot', 'paged'],
-                        help='paged = shared page pool with prefix '
-                             'caching + chunked prefill')
+                        help='paged (default) = shared page pool with '
+                             'prefix caching, chunked prefill and '
+                             'continuous admission; slot = fixed '
+                             'per-slot reservations')
     parser.add_argument('--page-size', type=int, default=128,
                         help='paged-cache page granularity (tokens); '
-                             'larger pages DMA more efficiently, '
-                             'smaller pages cache prefixes finer')
+                             'int8 decode needs a multiple of 128 to '
+                             'stay on the manual-DMA fast path')
     parser.add_argument('--max-batch', type=int, default=8)
     parser.add_argument('--max-seq', type=int, default=1024)
     parser.add_argument('--port', type=int,
