@@ -75,6 +75,28 @@ def _bucket_len(n: int, minimum: int = 64) -> int:
     return b
 
 
+def _ring_row_bytes(cfg, batch: int) -> int:
+    """Bytes of ONE horizon-step's ring rows (k+v across all layers) —
+    the ring stays in model dtype regardless of cache quantization."""
+    return (cfg.n_layers * batch * cfg.n_kv_heads * cfg.head_dim *
+            jnp.dtype(cfg.dtype).itemsize * 2)
+
+
+_RING_BYTES_CAP = int(512e6)
+
+
+def _ring_horizon_cap(cfg, batch: int, param_bytes: int) -> int:
+    """Longest sensible fused-decode horizon: the ring re-read must stay
+    under ~15% of the weight stream AND the ring buffers under ~512 MB
+    (at batch 48 on a 7B the 15% rule alone allowed a 1.6 GB ring that
+    blew the HBM budget at runtime; a bigger ring is also SLOWER — the
+    full buffer re-reads every step, and sqrt(dispatch*bw/row) puts the
+    optimum near 16 steps there)."""
+    row = _ring_row_bytes(cfg, batch)
+    return max(8, min(int(0.15 * param_bytes / row),
+                      _RING_BYTES_CAP // row))
+
+
 def prepare_params(cfg: ModelConfig, params, *, quantize=None, mesh=None,
                    donate_params: bool = False):
     """Shared param preparation for the slot and paged engines:
@@ -474,14 +496,12 @@ class InferenceEngine(_EngineBase):
         # produced this horizon; past ~15% of the weight-read traffic the
         # ring dominates the HBM budget and longer horizons backfire
         # (measured: 1B model, b=64 — horizon 128 halves throughput vs 64).
-        # Both sides use ACTUAL stored bytes: int8 halves the weight
-        # stream (so the cap tightens) and quarters the KV rows.
-        kv_itemsize = jnp.dtype(self.cache.k.dtype).itemsize
-        ring_row_bytes = (self.cfg.n_layers * self.max_batch *
-                          self.cfg.n_kv_heads *
-                          (self.cfg.head_dim * kv_itemsize +
-                           (4 if self.cache.quantized else 0)) * 2)
-        ring_cap = max(8, int(0.15 * self._param_bytes / ring_row_bytes))
+        # The ring rides MODEL dtype (it only quantizes on the merge into
+        # an int8 cache), so its rows are costed at cfg.dtype — costing
+        # them at the pool's int8 width (round-4 bug) both understated
+        # the re-read traffic and allowed rings that blew the HBM budget.
+        ring_cap = _ring_horizon_cap(self.cfg, self.max_batch,
+                                     self._param_bytes)
         horizon = min(horizon, ring_cap)
         for b in reversed(self._HORIZON_BUCKETS):
             if b <= horizon:

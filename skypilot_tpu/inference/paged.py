@@ -503,6 +503,7 @@ class PagedInferenceEngine(_EngineBase):
 
     _PREFILL_N_BUCKETS = (1, 2, 4, 8, 16, 32)
     _HORIZON_BUCKETS = (1, 2, 4, 8, 16, 32, 64, 128)
+    _PREFILL_STACK_BUDGET = int(0.75e9)    # stacked-chunk KV transient
 
     def __init__(self, cfg: ModelConfig, params=None, *,
                  max_batch: int = 8, max_seq: int = 1024,
@@ -514,8 +515,22 @@ class PagedInferenceEngine(_EngineBase):
                  decode_impl: str = 'auto'):
         from skypilot_tpu.inference.engine import prepare_params
         from skypilot_tpu.parallel import mesh as mesh_lib
+        self.max_batch = max_batch
+        self.max_seq = max_seq
+        self.page = page_size
+        self.chunk = chunk
+        self.mesh = mesh
+        self.attn_impl = attn_impl
+        self._rng = jax.random.PRNGKey(rng_seed)
+        self._host_rng = np.random.default_rng(rng_seed)
+        cfg, self.params, quantize = prepare_params(
+            cfg, params, quantize=quantize, mesh=mesh,
+            donate_params=donate_params)
+        self.cfg = cfg
         if page_size % 128 != 0 and quantize == 'int8':
-            # The manual-DMA kernel's per-page scale blocks need a
+            # Checked AFTER prepare_params so pre-quantized param trees
+            # (load_checkpoint(quantize='int8')) are caught too. The
+            # manual-DMA kernel's per-page scale blocks need a
             # 128-aligned minor dim; off the fast path decode drops to
             # the per-page-grid kernel (~0.71x measured). Loud, not
             # silent — the model server exposes --page-size directly.
@@ -524,17 +539,6 @@ class PagedInferenceEngine(_EngineBase):
                 f'page_size={page_size} is not a multiple of 128: int8 '
                 'paged decode falls off the manual-DMA fast path '
                 '(~0.7x throughput). Use a multiple of 128.')
-        self.max_batch = max_batch
-        self.max_seq = max_seq
-        self.page = page_size
-        self.chunk = chunk
-        self.mesh = mesh
-        self.attn_impl = attn_impl
-        self._rng = jax.random.PRNGKey(rng_seed)
-        cfg, self.params, quantize = prepare_params(
-            cfg, params, quantize=quantize, mesh=mesh,
-            donate_params=donate_params)
-        self.cfg = cfg
         from skypilot_tpu.models import quantization
         self._param_bytes = quantization.quantized_bytes(self.params)
 
@@ -569,6 +573,17 @@ class PagedInferenceEngine(_EngineBase):
         self._prefill_off: Dict[int, int] = {}
         self._decode_fn = self._build_decode()
         self._prefill_fns: Dict[Tuple[int, int], Any] = {}
+        # A prefill chunk-batch stacks [L, n, chunk] KV rows as a scan
+        # transient; cap n so that stack stays ~<=0.75 GB (at n=32 x
+        # chunk=256 on a 7B the two stacks alone are 2 GB — the compile
+        # OOM'd the chip). _auto_n_pages reserves the same budget.
+        tok_bytes = self._page_bytes(self.cfg, 1, self.cache.quantized)
+        n_fit = int(self._PREFILL_STACK_BUDGET // max(1, chunk *
+                                                      tok_bytes))
+        self._prefill_n_max = 1
+        for b in self._PREFILL_N_BUCKETS:
+            if b <= n_fit:
+                self._prefill_n_max = b
         self.chunks_prefilled = 0          # diagnostics (prefix-hit wins)
         self.preemptions = 0               # pool-pressure recomputes
 
@@ -591,6 +606,7 @@ class PagedInferenceEngine(_EngineBase):
         back to slot parity when the backend has no memory stats (CPU
         tests, interpret mode)."""
         parity = max_batch * -(-max_seq // page_size) + 1
+        from skypilot_tpu.inference.engine import _ring_row_bytes
         from skypilot_tpu.models import quantization
         quantized = quantization.is_quantized(self.params)
         try:
@@ -599,7 +615,19 @@ class PagedInferenceEngine(_EngineBase):
             used = stats['bytes_in_use']
         except Exception:  # pylint: disable=broad-except
             return parity
-        reserve = max(int(1.5e9), int(0.10 * limit))
+        # The reserve must cover the decode transients, dominated by
+        # the fused-horizon ring (model-dtype rows re-read every step)
+        # at the LONGEST horizon the ring budget allows — sizing the
+        # pool without it compiled programs 1.5 GB past HBM at
+        # batch=48 on a 7B.
+        from skypilot_tpu.inference.engine import (_ring_horizon_cap,
+                                                   _ring_row_bytes)
+        row = _ring_row_bytes(cfg, max_batch)
+        h_max = min(self._HORIZON_BUCKETS[-1],
+                    _ring_horizon_cap(cfg, max_batch,
+                                      self._param_bytes))
+        reserve = (int(1.6e9) + row * h_max +
+                   self._PREFILL_STACK_BUDGET)
         page_bytes = self._page_bytes(cfg, page_size, quantized)
         fit = max(0, (limit - used - reserve)) // page_bytes
         # Take what fits, capped at 4x slot parity (prefix-cache
@@ -733,6 +761,30 @@ class PagedInferenceEngine(_EngineBase):
         self._prefill_off.pop(slot, None)        # cancel mid-prefill
         super()._free_slot(slot)
 
+    def _sample_host(self, logits: np.ndarray, req) -> int:
+        """Sample the prefill-completion token with the REQUEST's
+        sampling params (greedy when temperature<=0). Matters twice:
+        the first token of a sampled request, and — after a
+        pool-pressure preemption — the RESUMED token of a sampled
+        request mid-stream (an argmax there would silently collapse
+        that token's distribution to greedy)."""
+        if req.temperature <= 0:
+            return int(np.argmax(logits))
+        scaled = logits.astype(np.float64) / max(req.temperature, 1e-6)
+        if req.top_k and req.top_k > 0:
+            kth = np.partition(scaled, -req.top_k)[-req.top_k]
+            scaled = np.where(scaled >= kth, scaled, -np.inf)
+        if req.top_p < 1.0:
+            order = np.argsort(-scaled)
+            probs = np.exp(scaled[order] - np.max(scaled))
+            probs /= probs.sum()
+            keep_mass = np.cumsum(probs) - probs < req.top_p
+            drop = order[~keep_mass]
+            scaled[drop] = -np.inf
+        probs = np.exp(scaled - np.max(scaled))
+        probs /= probs.sum()
+        return int(self._host_rng.choice(len(probs), p=probs))
+
     def _preempt_slot(self, slot: int) -> None:
         """Pool pressure: push a live request back to the FRONT of the
         queue, releasing its pages. It re-enters through _assign_slots
@@ -792,7 +844,7 @@ class PagedInferenceEngine(_EngineBase):
         pending = sorted(self._prefill_off)
         if not pending:
             return []
-        batch = pending[:self._PREFILL_N_BUCKETS[-1]]
+        batch = pending[:self._prefill_n_max]
         n = next(b for b in self._PREFILL_N_BUCKETS if b >= len(batch))
         tokens = np.zeros((n, self.chunk), np.int32)
         lengths = np.zeros(n, np.int32)
@@ -841,7 +893,7 @@ class PagedInferenceEngine(_EngineBase):
                                        req._n_matched)
             if logits_np is None:
                 logits_np = np.asarray(logits)
-            token = int(np.argmax(logits_np[i]))
+            token = self._sample_host(logits_np[i], req)
             if req.first_token_time is None:     # not on re-admission
                 req.first_token_time = now
             req.output.append(token)
@@ -880,12 +932,9 @@ class PagedInferenceEngine(_EngineBase):
         cap = int(self.max_seq - 1 -
                   max(self._slot_len[s] for s in active_slots))
         horizon = max(1, min(horizon, cap))
-        kv_itemsize = jnp.dtype(self.cache.pool_k.dtype).itemsize
-        ring_row_bytes = (self.cfg.n_layers * self.max_batch *
-                          self.cfg.n_kv_heads *
-                          (self.cfg.head_dim * kv_itemsize +
-                           (4 if self.cache.quantized else 0)) * 2)
-        ring_cap = max(8, int(0.15 * self._param_bytes / ring_row_bytes))
+        from skypilot_tpu.inference.engine import _ring_horizon_cap
+        ring_cap = _ring_horizon_cap(self.cfg, self.max_batch,
+                                     self._param_bytes)
         horizon = min(horizon, ring_cap)
         for b in reversed(self._HORIZON_BUCKETS):
             if b <= horizon:

@@ -88,9 +88,14 @@ def pipeline_layers(
     # only the bubble FLOPs are skipped. tp/sp still disable the skip:
     # their psums ride inside the layer math where no such hoist
     # exists.
+    safe_to_skip = all(mesh.shape.get(a, 1) == 1 for a in ('tp', 'sp'))
     if skip_bubbles is None:
-        skip_bubbles = all(mesh.shape.get(a, 1) == 1
-                           for a in ('tp', 'sp'))
+        skip_bubbles = safe_to_skip
+    elif skip_bubbles and not safe_to_skip:
+        raise ValueError(
+            'skip_bubbles=True is unsafe with tp/sp > 1: the stage '
+            'body contains tp/sp collectives that would diverge across '
+            "the cond's branches and deadlock the rendezvous")
     hoist_gather = (skip_bubbles and mesh.shape.get('fsdp', 1) > 1)
 
     def body(params_local, x_full):
