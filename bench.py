@@ -76,9 +76,16 @@ def main() -> None:
                   'falling back to 1B-modeled path', file=sys.stderr)
             # The traceback pins the failed section's frames — and with
             # them the 7B params + KV pool on the chip; the fallback
-            # OOMs unless they drop first.
+            # OOMs unless they drop first. Belt and braces: drop every
+            # live device array (everything below re-creates its own).
             e = None
             gc.collect()
+            try:
+                for arr in jax.live_arrays():
+                    arr.delete()
+                jax.clear_caches()
+            except Exception:  # pylint: disable=broad-except
+                pass
     if result is None:
         result = _bench_1b_modeled(on_tpu, chip_bw, n_chips)
     elif on_tpu:
@@ -96,12 +103,24 @@ def main() -> None:
             result['detail']['serving_http'] = {
                 'error': f'{type(e).__name__}: {e}'}
 
+    import gc
+    gc.collect()          # HTTP server engine HBM must be gone first
     result['detail'].update({
         'backend': backend,
         'device_kind': jax.devices()[0].device_kind,
-        'flash_kernel': _flash_kernel_check(on_tpu),
-        'train': _train_step_bench(on_tpu, n_chips, chip_peak_tflops),
     })
+    # Aux sections are best-effort: a failure here must not discard the
+    # serving measurements above (the one JSON line still prints).
+    for key, fn in (
+            ('flash_kernel',
+             lambda: _flash_kernel_check(on_tpu)),
+            ('train',
+             lambda: _train_step_bench(on_tpu, n_chips,
+                                       chip_peak_tflops))):
+        try:
+            result['detail'][key] = fn()
+        except Exception as e:  # pylint: disable=broad-except
+            result['detail'][key] = {'error': f'{type(e).__name__}: {e}'}
     print(json.dumps(result))
 
 
@@ -148,7 +167,7 @@ def _bench_7b_serving(chip_bw: float, n_chips: int) -> dict:
     t_load = time.time() - t0
 
     batch = int(os.environ.get('BENCH_PAGED_BATCH', '48'))
-    slot_batch, max_seq, horizon = 24, 576, 64
+    slot_batch, max_seq, horizon = 32, 576, 64
     eng = PagedInferenceEngine(cfg, params, max_batch=batch,
                                max_seq=max_seq)
 
@@ -183,7 +202,7 @@ def _bench_7b_serving(chip_bw: float, n_chips: int) -> dict:
         Takes the engine as a PARAMETER: a closure would pin the paged
         pool in HBM past the `del eng` below (the round-5 bench OOM)."""
         submit(engine, _anchor_workload(engine.max_batch, seed=2,
-                                        gen_fixed=317))
+                                        gen_fixed=160))
         while engine._queue or getattr(engine, '_prefill_off', None):
             engine.step(horizon=1)           # drain admission
         tokens = 0
@@ -212,10 +231,14 @@ def _bench_7b_serving(chip_bw: float, n_chips: int) -> dict:
 
     # Isolated TTFT: one request on an idle engine. First call compiles
     # the n=1 prefill; second measures.
-    for _ in range(2):
+    for it in range(2):
+        # A FRESH prompt each iteration (seeds 3, then 4): re-using one
+        # prompt would register its pages on iteration 1 and measure a
+        # prefix-cache HIT on iteration 2 — flattering and mislabeled.
+        p_iso = [17 + (j * 13 + it * 997) % 18313
+                 for j in range(220)]
         t0 = time.time()
-        eng.add_request(_anchor_workload(1, seed=3)[0][0],
-                        max_new_tokens=2)
+        eng.add_request(p_iso, max_new_tokens=2)
         while eng._queue or eng._prefill_off:
             eng.step(horizon=1)
         ttft_isolated = (time.time() - t0) * 1e3
@@ -258,41 +281,67 @@ def _bench_7b_serving(chip_bw: float, n_chips: int) -> dict:
         pass
     del eng
     slot_detail = None
+    slot_e2e = None
     try:
         from skypilot_tpu.inference.engine import InferenceEngine
         seng = InferenceEngine(cfg, params, max_batch=slot_batch,
                                max_seq=max_seq)
-        wl = _anchor_workload(slot_batch, seed=2, gen_fixed=317)
-        for p, g in wl:
-            seng.add_request(p, max_new_tokens=g)
-        seng.step(horizon=1)
-        for _ in range(2):
-            tokens = 0
-            t0 = time.time()
-            for _ in range(3):
-                tokens += len(seng.step(horizon=horizon))
-            window = time.time() - t0
-        slot_tok_s = tokens / window / n_chips
-        seng.run_to_completion(horizon=horizon)
+        # Warmup + steady decode window.
+        _, _, _ = steady(seng)
+        slot_tok_s, _, _ = steady(seng)
+        slot_tok_s /= n_chips
+        # Slot e2e at ITS 2x burst (same workload generator): the two
+        # engines trade off — slot streams the contiguous cache faster
+        # per token at its feasible batch, paged holds 2x the
+        # concurrent contexts + prefix cache + continuous admission.
+        sids = submit(seng, _anchor_workload(2 * slot_batch, seed=1))
+        t0 = time.time()
+        sdone = seng.run_to_completion(horizon=horizon)
+        sdt = time.time() - t0
+        sfin = [r for rid, r in sdone.items() if rid in sids]
+        s_out = sum(len(r.output) for r in sfin)
+        slot_e2e = s_out / sdt / n_chips
+        sttfts = sorted(r.ttft_ms for r in sfin
+                        if r.ttft_ms is not None)
         del seng
         slot_detail = {
             'batch': slot_batch,
             'decode_tok_s_per_chip': round(slot_tok_s, 2),
+            'e2e_out_tok_s_per_chip': round(slot_e2e, 2),
+            'ttft_ms_median_burst': (round(sttfts[len(sttfts) // 2], 1)
+                                     if sttfts else None),
         }
         paged_detail['vs_slot_cache'] = round(decode_tok_s / slot_tok_s,
                                               3)
     except Exception as e:  # pylint: disable=broad-except
         slot_detail = {'error': f'{type(e).__name__}: {e}'}
 
-    # int8 roofline at the paged batch: weight + scale stream + live KV.
-    avg_ctx = 220 + 317 / 2                  # steady-window shapes
-    live_kv = (batch * avg_ctx * cfg.n_layers * 2 * cfg.n_kv_heads *
-               (cfg.head_dim * 1.0 + 4.0))
-    roofline_tok_s = chip_bw * 1e9 / (param_bytes + live_kv) * batch
-    vs_baseline = tok_s_chip / BASELINE_TOK_S_PER_CHIP
+    # Headline = the better e2e of the two engines (the slot engine's
+    # contiguous cache streams faster per token at its feasible batch;
+    # the paged engine holds 2x the concurrent contexts). Both full
+    # results ride in detail — the trade-off IS the result.
+    paged_detail['e2e_out_tok_s_per_chip'] = round(tok_s_chip, 2)
+    paged_detail['ttft_ms_median_burst'] = (round(ttft_median, 1)
+                                            if ttft_median else None)
+    if slot_e2e is not None and slot_e2e > tok_s_chip:
+        headline, headline_engine = slot_e2e, 'slot'
+        headline_decode = slot_detail['decode_tok_s_per_chip']
+        roof_batch = slot_batch
+    else:
+        headline, headline_engine = tok_s_chip, 'paged'
+        headline_decode = decode_tok_s
+        roof_batch = batch
+
+    # int8 roofline at the headline batch: weight + scale stream +
+    # live KV.
+    avg_ctx = 220 + 160 / 2                  # steady-window shapes
+    live_kv = (roof_batch * avg_ctx * cfg.n_layers * 2 *
+               cfg.n_kv_heads * (cfg.head_dim * 1.0 + 4.0))
+    roofline_tok_s = chip_bw * 1e9 / (param_bytes + live_kv) * roof_batch
+    vs_baseline = headline / BASELINE_TOK_S_PER_CHIP
     return {
         'metric': 'llama2_7b_int8_out_tok_s_per_chip',
-        'value': round(tok_s_chip, 2),
+        'value': round(headline, 2),
         'unit': 'tokens/s/chip',
         'vs_baseline': round(vs_baseline, 3),
         'detail': {
@@ -300,10 +349,10 @@ def _bench_7b_serving(chip_bw: float, n_chips: int) -> dict:
             'model': cfg.name,
             'quantize': 'int8',
             'num_params': cfg.num_params,
-            'engine': 'paged',
-            'decode_tok_s_per_chip': round(decode_tok_s, 2),
-            'decode_roofline_frac': round(decode_tok_s / roofline_tok_s,
-                                          3),
+            'engine': headline_engine,
+            'decode_tok_s_per_chip': round(headline_decode, 2),
+            'decode_roofline_frac': round(headline_decode /
+                                          roofline_tok_s, 3),
             'phase_ms_per_step': {
                 'total': round(per_step * 1e3, 3),
                 'weights_stream': round(weights_ms, 3),
@@ -326,7 +375,7 @@ def _bench_7b_serving(chip_bw: float, n_chips: int) -> dict:
             'capacity': capacity,
             # projection of this rate onto the anchor's v6e bandwidth
             'vs_baseline_v6e_bw_normalized': round(
-                (tok_s_chip * V6E_HBM_BW / chip_bw)
+                (headline * V6E_HBM_BW / chip_bw)
                 / BASELINE_TOK_S_PER_CHIP, 3),
         },
     }
@@ -353,23 +402,25 @@ def _serving_http_bench(ckpt: str, n_chips: int) -> dict:
                       port=18282)
     srv.start(block=False)
     try:
-        return _serving_http_measure(srv, n_chips, batch)
+        return _serving_http_measure(srv, n_chips, batch, srv.port)
     finally:
         # Always stop: a leaked server pins the 7B engine's HBM under
         # the flash/train sections that run next.
         srv.stop()
 
 
-def _serving_http_measure(srv, n_chips: int, batch: int) -> dict:
+def _serving_http_measure(srv, n_chips: int, batch: int,
+                          port: int) -> dict:
     import json as _json
     import random
     import threading
     import urllib.request
     if not srv._ready.wait(1800):
         raise RuntimeError('model server did not become ready')
-    base = 'http://127.0.0.1:18282'
+    base = f'http://127.0.0.1:{port}'
     lock = threading.Lock()
     results = []
+    errors = []
 
     def median(xs, nd=1):
         xs = sorted(xs)
@@ -381,23 +432,32 @@ def _serving_http_measure(srv, n_chips: int, batch: int) -> dict:
         req = urllib.request.Request(
             base + '/generate', body,
             {'Content-Type': 'application/json'})
-        t0, first, n = time.time(), None, 0
-        with urllib.request.urlopen(req, timeout=1200) as resp:
-            for line in resp:
-                if not line.startswith(b'data:'):
-                    continue
-                try:
-                    ev = _json.loads(line[5:].strip())
-                except ValueError:
-                    continue
-                if 'token' in ev:
-                    if first is None:
-                        first = time.time()
-                    n += 1
-                if ev.get('done') or 'error' in ev:
-                    break
+        t0, first, n, err = time.time(), None, 0, None
+        try:
+            with urllib.request.urlopen(req, timeout=1200) as resp:
+                for line in resp:
+                    if not line.startswith(b'data:'):
+                        continue
+                    try:
+                        ev = _json.loads(line[5:].strip())
+                    except ValueError:
+                        continue
+                    if 'token' in ev:
+                        if first is None:
+                            first = time.time()
+                        n += 1
+                    if 'error' in ev:
+                        err = str(ev['error'])
+                        break
+                    if ev.get('done'):
+                        break
+        except Exception as e:  # pylint: disable=broad-except
+            err = f'{type(e).__name__}: {e}'
         with lock:
-            results.append((t0, first, time.time(), n))
+            if err is not None or n == 0:
+                errors.append(err or 'no tokens streamed')
+            else:
+                results.append((t0, first, time.time(), n))
 
     # Warm the HTTP path + compiled shapes.
     wl = _anchor_workload(4, seed=11)
@@ -428,6 +488,8 @@ def _serving_http_measure(srv, n_chips: int, batch: int) -> dict:
     http_detail = {
         'n_requests': n_req,
         'n_completed': len(results),
+        'n_errors': len(errors),
+        'first_error': errors[0] if errors else None,
         'req_s_per_chip': round(len(results) / wall / n_chips, 3),
         'out_tok_s_per_chip': round(out_tokens / wall / n_chips, 1),
         'ttft_ms_median': median(ttfts),

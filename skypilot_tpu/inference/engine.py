@@ -82,16 +82,18 @@ def _ring_row_bytes(cfg, batch: int) -> int:
             jnp.dtype(cfg.dtype).itemsize * 2)
 
 
-_RING_BYTES_CAP = int(512e6)
+_RING_BYTES_CAP = int(1e9)
 
 
 def _ring_horizon_cap(cfg, batch: int, param_bytes: int) -> int:
     """Longest sensible fused-decode horizon: the ring re-read must stay
-    under ~15% of the weight stream AND the ring buffers under ~512 MB
+    under ~15% of the weight stream AND the ring buffers under ~1 GB
     (at batch 48 on a 7B the 15% rule alone allowed a 1.6 GB ring that
-    blew the HBM budget at runtime; a bigger ring is also SLOWER — the
-    full buffer re-reads every step, and sqrt(dispatch*bw/row) puts the
-    optimum near 16 steps there)."""
+    blew the HBM budget at runtime). The floor matters as much as the
+    cap: per-call dispatch through the axon tunnel measured ~100 ms, so
+    horizons below ~32 pay more in dispatch than a bigger ring costs in
+    re-reads (a 512 MB cap that forced h=16 at batch 48 added ~5 ms to
+    every step)."""
     row = _ring_row_bytes(cfg, batch)
     return max(8, min(int(0.15 * param_bytes / row),
                       _RING_BYTES_CAP // row))
@@ -434,13 +436,28 @@ class InferenceEngine(_EngineBase):
             batch.append((slot, req))
         if not batch:
             return []
-        # More free slots than the largest prefill bucket: admit the
-        # first chunk now; the rest goes back to the FRONT (keeps FIFO
-        # order) and waits for the next step() call.
-        cap = self._PREFILL_N_BUCKETS[-1]
+        # Cap the wave: by the largest compiled bucket, AND by the bf16
+        # prefill-scratch transient — the batched prefill materializes a
+        # fresh [L, n, bucket] bf16 KV scratch (exact prefill math), and
+        # at n=32 x bucket=256 on a 7B that is 2 GB x2, which pushed the
+        # compile past HBM with the slot cache + weights resident. The
+        # overflow requeues at the FRONT (keeps FIFO) for the next step.
+        bucket = min(_bucket_len(max(len(r.prompt) for _, r in batch)),
+                     self.max_seq)
+        scratch_tok = (self.cfg.n_layers * self.cfg.n_kv_heads *
+                       self.cfg.head_dim *
+                       jnp.dtype(self.cfg.dtype).itemsize * 2)
+        fit = int(0.75e9) // max(1, bucket * scratch_tok)
+        cap = 1
+        for b in self._PREFILL_N_BUCKETS:     # largest PADDED n that fits
+            if b <= fit:
+                cap = b
         if len(batch) > cap:
             self._requeue_front([req for _, req in batch[cap:]])
             batch = batch[:cap]
+            bucket = min(_bucket_len(max(len(r.prompt)
+                                         for _, r in batch)),
+                         self.max_seq)
         # Pad request count to a compiled bucket (extra rows re-prefill the
         # first request into its own slot — harmless duplicate writes).
         n = 1
@@ -450,8 +467,6 @@ class InferenceEngine(_EngineBase):
                 break
         else:
             n = self._PREFILL_N_BUCKETS[-1]
-        bucket = min(_bucket_len(max(len(r.prompt) for _, r in batch)),
-                     self.max_seq)
         prefill = self._get_prefill(bucket, n)
 
         tokens = np.zeros((n, bucket), np.int32)

@@ -504,6 +504,7 @@ class PagedInferenceEngine(_EngineBase):
     _PREFILL_N_BUCKETS = (1, 2, 4, 8, 16, 32)
     _HORIZON_BUCKETS = (1, 2, 4, 8, 16, 32, 64, 128)
     _PREFILL_STACK_BUDGET = int(0.75e9)    # stacked-chunk KV transient
+    _RING_BYTES_CAP_PAGED = int(512e6)     # see _decode's ring note
 
     def __init__(self, cfg: ModelConfig, params=None, *,
                  max_batch: int = 8, max_seq: int = 1024,
@@ -606,7 +607,6 @@ class PagedInferenceEngine(_EngineBase):
         back to slot parity when the backend has no memory stats (CPU
         tests, interpret mode)."""
         parity = max_batch * -(-max_seq // page_size) + 1
-        from skypilot_tpu.inference.engine import _ring_row_bytes
         from skypilot_tpu.models import quantization
         quantized = quantization.is_quantized(self.params)
         try:
@@ -615,6 +615,10 @@ class PagedInferenceEngine(_EngineBase):
             used = stats['bytes_in_use']
         except Exception:  # pylint: disable=broad-except
             return parity
+        # bytes_in_use can lag async transfers (observed right after the
+        # parallel checkpoint puts: the pool then oversized by ~3 GB and
+        # decode OOM'd at runtime); the weights are a known floor.
+        used = max(used, self._param_bytes + int(0.3e9))
         # The reserve must cover the decode transients, dominated by
         # the fused-horizon ring (model-dtype rows re-read every step)
         # at the LONGEST horizon the ring budget allows — sizing the
@@ -625,8 +629,9 @@ class PagedInferenceEngine(_EngineBase):
         row = _ring_row_bytes(cfg, max_batch)
         h_max = min(self._HORIZON_BUCKETS[-1],
                     _ring_horizon_cap(cfg, max_batch,
-                                      self._param_bytes))
-        reserve = (int(1.6e9) + row * h_max +
+                                      self._param_bytes),
+                    max(8, self._RING_BYTES_CAP_PAGED // row))
+        reserve = (int(1.6e9) + 2 * row * h_max +
                    self._PREFILL_STACK_BUDGET)
         page_bytes = self._page_bytes(cfg, page_size, quantized)
         fit = max(0, (limit - used - reserve)) // page_bytes
@@ -932,9 +937,16 @@ class PagedInferenceEngine(_EngineBase):
         cap = int(self.max_seq - 1 -
                   max(self._slot_len[s] for s in active_slots))
         horizon = max(1, min(horizon, cap))
-        from skypilot_tpu.inference.engine import _ring_horizon_cap
-        ring_cap = _ring_horizon_cap(self.cfg, self.max_batch,
-                                     self._param_bytes)
+        from skypilot_tpu.inference.engine import (_ring_horizon_cap,
+                                                   _ring_row_bytes)
+        # Tighter ring budget than the slot engine: the pool + params
+        # already fill most of HBM at capacity-stretch batches, and the
+        # decode scan can double-buffer the ring carry (h=32 at batch
+        # 48 on a 7B OOM'd at runtime where h=16 ran).
+        row = _ring_row_bytes(self.cfg, self.max_batch)
+        ring_cap = min(_ring_horizon_cap(self.cfg, self.max_batch,
+                                         self._param_bytes),
+                       max(8, self._RING_BYTES_CAP_PAGED // row))
         horizon = min(horizon, ring_cap)
         for b in reversed(self._HORIZON_BUCKETS):
             if b <= horizon:

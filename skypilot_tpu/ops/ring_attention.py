@@ -438,11 +438,11 @@ def ring_attention(
 
 
 def current_mesh() -> Optional[jax.sharding.Mesh]:
-    """The active `with mesh:` context, if any (no public jax API; same
-    probe as llama._in_mesh_context — fails open to None)."""
-    try:
-        from jax._src import mesh as mesh_src
-        env_mesh = mesh_src.thread_resources.env.physical_mesh
-        return None if env_mesh.empty else env_mesh
-    except Exception:  # pylint: disable=broad-except
-        return None
+    """The active mesh context, if any. Delegates to llama's probe:
+    public ``jax.sharding.get_mesh`` first, then the private
+    legacy-context locations, warning ONCE if every probe RAISES (a jax
+    bump silently disabling sequence parallelism would otherwise have
+    no signal; ``tests/test_aux_subsystems.py::test_ambient_mesh_probe``
+    pins probe health on the in-repo jax)."""
+    from skypilot_tpu.models.llama import _ambient_mesh
+    return _ambient_mesh()

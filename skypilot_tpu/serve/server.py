@@ -59,6 +59,8 @@ class ModelServer:
         self._requests_served = 0
         self._requests_aborted = 0
         self._httpd: Optional[http.server.ThreadingHTTPServer] = None
+        self._stopping = False
+        self._engine_thread: Optional[threading.Thread] = None
 
     # ------------------------------------------------------------- engine
     def _load_engine(self) -> None:
@@ -99,9 +101,17 @@ class ModelServer:
         except Exception as e:  # pylint: disable=broad-except
             self._fatal(e)
             return
-        while True:
+        if self._stopping:
+            # stop() raced the load: drop the just-loaded engine instead
+            # of resurrecting the reference stop() exists to release.
+            self.engine = None
+            self._ready.clear()
+            return
+        while not self._stopping:
             try:
                 self._work.wait()
+                if self._stopping:
+                    break
                 with self._lock:
                     has_work = self.engine.has_work()
                     if has_work:
@@ -499,7 +509,9 @@ class ModelServer:
         return Handler
 
     def start(self, block: bool = True) -> None:
-        threading.Thread(target=self._engine_loop, daemon=True).start()
+        self._engine_thread = threading.Thread(target=self._engine_loop,
+                                               daemon=True)
+        self._engine_thread.start()
         handler = self._make_handler()
         self._httpd = http.server.ThreadingHTTPServer(('0.0.0.0', self.port),
                                                       handler)
@@ -511,8 +523,17 @@ class ModelServer:
                              daemon=True).start()
 
     def stop(self) -> None:
+        """Shut down the HTTP front end AND the engine loop, dropping
+        the engine reference — the daemon loop thread would otherwise
+        keep the model weights + KV pool alive (on TPU, several GB of
+        HBM) for the life of the process."""
+        self._stopping = True
+        self._work.set()                      # wake the loop to exit
         if self._httpd is not None:
             self._httpd.shutdown()
+        if self._engine_thread is not None:
+            self._engine_thread.join(timeout=60)
+        self.engine = None
 
 
 def main() -> None:

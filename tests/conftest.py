@@ -24,6 +24,20 @@ import jax  # noqa: E402
 
 jax.config.update('jax_platforms', 'cpu')
 
+# Persistent compilation cache shared by every test AND every
+# subprocess they spawn (model-server replicas, job drivers — each is a
+# fresh python paying full XLA compiles otherwise). The env var reaches
+# subprocesses; the config.update covers this process, whose jax is
+# already imported. Round-4's 21-minute slow tier was dominated by
+# recompiling the same tiny-model programs per test/process.
+_cache_dir = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), '.bench_cache', 'jax_test_cache')
+os.environ.setdefault('JAX_COMPILATION_CACHE_DIR', _cache_dir)
+os.environ.setdefault('JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS', '1')
+jax.config.update('jax_compilation_cache_dir',
+                  os.environ['JAX_COMPILATION_CACHE_DIR'])
+jax.config.update('jax_persistent_cache_min_compile_time_secs', 1.0)
+
 import pytest  # noqa: E402
 
 
