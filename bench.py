@@ -193,6 +193,39 @@ def _bench_7b_serving(chip_bw: float, n_chips: int) -> dict:
     ttft_median = ttfts[len(ttfts) // 2] if ttfts else None
     ttft_p90 = ttfts[int(len(ttfts) * 0.9)] if ttfts else None
 
+    # (1b) SUSTAINED saturated serving — the anchor's methodology
+    # (JetStream's benchmark drives a continuous request stream and
+    # reports output tok/s over the serving window,
+    # ``examples/tpu/v6e/README.md:121``): keep the queue topped up so
+    # occupancy never decays, measure output tokens over a fixed
+    # window. The 2x-burst drain above underestimates steady serving —
+    # its tail runs at falling occupancy with no new arrivals.
+    def sustained(engine, window_s=40.0):
+        seed_box = [40]
+
+        def top_up():
+            if len(engine._queue) < engine.max_batch:
+                seed_box[0] += 1
+                submit(engine, _anchor_workload(engine.max_batch // 2,
+                                                seed=seed_box[0]))
+
+        top_up()
+        for _ in range(4):                   # warm to full occupancy
+            engine.step(horizon=8)
+            top_up()
+        tokens = 0
+        t0 = time.time()
+        while time.time() - t0 < window_s:
+            tokens += len(engine.step(horizon=horizon))
+            top_up()
+        rate = tokens / (time.time() - t0)
+        # Drain without counting (bounded: no new arrivals).
+        engine._queue.clear()
+        engine.run_to_completion(horizon=horizon)
+        return rate
+
+    sustained_tok_s = sustained(eng) / n_chips
+
     # (2) Steady-state decode: all slots active (uniform long gens so
     # nothing finishes inside the window), pure fused-horizon steps.
     def steady(engine, measure_horizon=horizon):
@@ -286,10 +319,11 @@ def _bench_7b_serving(chip_bw: float, n_chips: int) -> dict:
         from skypilot_tpu.inference.engine import InferenceEngine
         seng = InferenceEngine(cfg, params, max_batch=slot_batch,
                                max_seq=max_seq)
-        # Warmup + steady decode window.
+        # Warmup + steady decode window + sustained serving rate.
         _, _, _ = steady(seng)
         slot_tok_s, _, _ = steady(seng)
         slot_tok_s /= n_chips
+        slot_sustained = sustained(seng) / n_chips
         # Slot e2e at ITS 2x burst (same workload generator): the two
         # engines trade off — slot streams the contiguous cache faster
         # per token at its feasible batch, paged holds 2x the
@@ -307,7 +341,8 @@ def _bench_7b_serving(chip_bw: float, n_chips: int) -> dict:
         slot_detail = {
             'batch': slot_batch,
             'decode_tok_s_per_chip': round(slot_tok_s, 2),
-            'e2e_out_tok_s_per_chip': round(slot_e2e, 2),
+            'sustained_out_tok_s_per_chip': round(slot_sustained, 2),
+            'e2e_burst_out_tok_s_per_chip': round(slot_e2e, 2),
             'ttft_ms_median_burst': (round(sttfts[len(sttfts) // 2], 1)
                                      if sttfts else None),
         }
@@ -320,15 +355,18 @@ def _bench_7b_serving(chip_bw: float, n_chips: int) -> dict:
     # contiguous cache streams faster per token at its feasible batch;
     # the paged engine holds 2x the concurrent contexts). Both full
     # results ride in detail — the trade-off IS the result.
-    paged_detail['e2e_out_tok_s_per_chip'] = round(tok_s_chip, 2)
+    paged_detail['sustained_out_tok_s_per_chip'] = round(
+        sustained_tok_s, 2)
+    paged_detail['e2e_burst_out_tok_s_per_chip'] = round(tok_s_chip, 2)
     paged_detail['ttft_ms_median_burst'] = (round(ttft_median, 1)
                                             if ttft_median else None)
-    if slot_e2e is not None and slot_e2e > tok_s_chip:
-        headline, headline_engine = slot_e2e, 'slot'
+    slot_sust = (slot_detail or {}).get('sustained_out_tok_s_per_chip')
+    if slot_sust is not None and slot_sust > sustained_tok_s:
+        headline, headline_engine = slot_sust, 'slot'
         headline_decode = slot_detail['decode_tok_s_per_chip']
         roof_batch = slot_batch
     else:
-        headline, headline_engine = tok_s_chip, 'paged'
+        headline, headline_engine = sustained_tok_s, 'paged'
         headline_decode = decode_tok_s
         roof_batch = batch
 
@@ -340,7 +378,7 @@ def _bench_7b_serving(chip_bw: float, n_chips: int) -> dict:
     roofline_tok_s = chip_bw * 1e9 / (param_bytes + live_kv) * roof_batch
     vs_baseline = headline / BASELINE_TOK_S_PER_CHIP
     return {
-        'metric': 'llama2_7b_int8_out_tok_s_per_chip',
+        'metric': 'llama2_7b_int8_sustained_out_tok_s_per_chip',
         'value': round(headline, 2),
         'unit': 'tokens/s/chip',
         'vs_baseline': round(vs_baseline, 3),
@@ -402,22 +440,21 @@ def _serving_http_bench(ckpt: str, n_chips: int) -> dict:
                       port=18282)
     srv.start(block=False)
     try:
-        return _serving_http_measure(srv, n_chips, batch, srv.port)
+        return _serving_http_measure(srv, n_chips, batch)
     finally:
         # Always stop: a leaked server pins the 7B engine's HBM under
         # the flash/train sections that run next.
         srv.stop()
 
 
-def _serving_http_measure(srv, n_chips: int, batch: int,
-                          port: int) -> dict:
+def _serving_http_measure(srv, n_chips: int, batch: int) -> dict:
     import json as _json
     import random
     import threading
     import urllib.request
     if not srv._ready.wait(1800):
         raise RuntimeError('model server did not become ready')
-    base = f'http://127.0.0.1:{port}'
+    base = f'http://127.0.0.1:{srv.port}'
     lock = threading.Lock()
     results = []
     errors = []
@@ -464,6 +501,7 @@ def _serving_http_measure(srv, n_chips: int, batch: int,
     for p, g in wl:
         one(p, min(g, 32))
     results.clear()
+    errors.clear()                           # warmup failures don't count
 
     # Open-loop Poisson arrivals past saturation: throughput-limited
     # req/s with realistic queueing in the TTFT.

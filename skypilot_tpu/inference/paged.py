@@ -617,8 +617,10 @@ class PagedInferenceEngine(_EngineBase):
             return parity
         # bytes_in_use can lag async transfers (observed right after the
         # parallel checkpoint puts: the pool then oversized by ~3 GB and
-        # decode OOM'd at runtime); the weights are a known floor.
-        used = max(used, self._param_bytes + int(0.3e9))
+        # decode OOM'd at runtime); the weights are a known floor —
+        # PER DEVICE (a tp-sharded tree spreads over mesh.size chips).
+        n_dev = self.mesh.size if self.mesh is not None else 1
+        used = max(used, self._param_bytes // n_dev + int(0.3e9))
         # The reserve must cover the decode transients, dominated by
         # the fused-horizon ring (model-dtype rows re-read every step)
         # at the LONGEST horizon the ring budget allows — sizing the

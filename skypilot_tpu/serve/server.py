@@ -107,6 +107,7 @@ class ModelServer:
             self.engine = None
             self._ready.clear()
             return
+
         while not self._stopping:
             try:
                 self._work.wait()
@@ -128,6 +129,14 @@ class ModelServer:
             except Exception as e:  # pylint: disable=broad-except
                 self._fatal(e)
                 return
+        # Clean stop: wake every waiter the way _fatal does — an
+        # in-flight handler blocked on its finished event (or a stream
+        # queue) would otherwise hang its client forever.
+        with self._lock:
+            for ev in self._finished_events.values():
+                ev.set()
+            for sq in self._stream_queues.values():
+                sq.put((None, True))
 
     def _fatal(self, e: Exception) -> None:
         """Engine died: drop readiness (the serve probe then pulls this

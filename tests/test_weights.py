@@ -301,7 +301,7 @@ class TestSynthAndInt8Cache:
             str(tmp_path / 'ck'), configs.TINY)
         cfg, q1 = weights.load_checkpoint(p, quantize='int8')
         assert cfg.dim == configs.TINY.dim
-        assert os.path.exists(os.path.join(p, '.int8_cache.npz'))
+        assert os.path.exists(os.path.join(p, '.int8_cache.bin'))
         _, q2 = weights.load_checkpoint(p, quantize='int8')  # via cache
         flat1 = dict(weights._flatten_leaves(q1))
         flat2 = dict(weights._flatten_leaves(q2))
