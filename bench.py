@@ -167,7 +167,17 @@ def _bench_7b_serving(chip_bw: float, n_chips: int) -> dict:
     t_load = time.time() - t0
 
     batch = int(os.environ.get('BENCH_PAGED_BATCH', '48'))
-    slot_batch, max_seq, horizon = 32, 576, 64
+    # Horizon 32 (was 64): the async dispatch pipeline (engine._pending)
+    # hides the per-call round trip, so the horizon no longer needs to
+    # amortize ~100 ms of dispatch — and the fused-horizon ring it sizes
+    # re-reads avg horizon/2 rows per step (h=32 halves that traffic vs
+    # 64; measured best on the L=8 slice sweep: 2522 tok/s at h=32 vs
+    # 2266 at h=64). Slot batch 36 (was 32): bigger batches amortize the
+    # ~8.5 ms weight stream; 40 measured 1348 tok/s steady on the 7B but
+    # OOM'd (16.13G/15.75G) when the sustained mix compiled its last
+    # prefill variant — 36 keeps ~0.6 GB of program headroom.
+    slot_batch = int(os.environ.get('BENCH_SLOT_BATCH', '36'))
+    max_seq, horizon = 576, 32
     eng = PagedInferenceEngine(cfg, params, max_batch=batch,
                                max_seq=max_seq)
 
@@ -210,8 +220,8 @@ def _bench_7b_serving(chip_bw: float, n_chips: int) -> dict:
                                                 seed=seed_box[0]))
 
         top_up()
-        for _ in range(4):                   # warm to full occupancy
-            engine.step(horizon=8)
+        for _ in range(6):                   # warm occupancy + prime the
+            engine.step(horizon=8)           # async dispatch pipeline
             top_up()
         tokens = 0
         t0 = time.time()
@@ -233,19 +243,32 @@ def _bench_7b_serving(chip_bw: float, n_chips: int) -> dict:
         the engine may cap the requested horizon (ring budget, pool
         pressure), so the dispatch solver below uses what really ran.
         Takes the engine as a PARAMETER: a closure would pin the paged
-        pool in HBM past the `del eng` below (the round-5 bench OOM)."""
+        pool in HBM past the `del eng` below (the round-5 bench OOM).
+        The async pipeline (results lag enqueues by its depth) is
+        primed before the window, so each timed step syncs one full
+        call's tokens — the lag is constant across the window and the
+        rate is exact."""
+        # gen_fixed must outlast the whole window: ~drain + 2 priming +
+        # 6 timed steps at h=32 consumes ~270 tokens/slot (160 ran dry
+        # mid-window and understated the rate). 320 keeps every slot
+        # live through the window and fits max_seq for the LONGEST
+        # anchor prompt (252 + 320 <= 576 — _validate_request checks
+        # the max, not the 220 average).
         submit(engine, _anchor_workload(engine.max_batch, seed=2,
-                                        gen_fixed=160))
-        while engine._queue or getattr(engine, '_prefill_off', None):
+                                        gen_fixed=320))
+        while engine._queue or getattr(engine, '_prefill_off', None) \
+                or getattr(engine, '_await_first', None):
             engine.step(horizon=1)           # drain admission
+        for _ in range(2):                   # prime the pipeline
+            engine.step(horizon=measure_horizon)
         tokens = 0
         t0 = time.time()
-        for _ in range(3):
+        for _ in range(6):
             tokens += len(engine.step(horizon=measure_horizon))
         window = time.time() - t0
         steps = tokens / max(1, engine.max_batch)
         engine.run_to_completion(horizon=horizon)
-        return tokens / window, window / max(steps, 1e-9), steps / 3
+        return tokens / window, window / max(steps, 1e-9), steps / 6
 
     steady(eng)                              # hit every kv bucket once
     decode_tok_s, step_s, h_big = steady(eng)
@@ -271,8 +294,9 @@ def _bench_7b_serving(chip_bw: float, n_chips: int) -> dict:
         p_iso = [17 + (j * 13 + it * 997) % 18313
                  for j in range(220)]
         t0 = time.time()
-        eng.add_request(p_iso, max_new_tokens=2)
-        while eng._queue or eng._prefill_off:
+        rid_iso = eng.add_request(p_iso, max_new_tokens=2)
+        while (eng._queue or eng._prefill_off or eng._await_first) \
+                and eng.get_finished(rid_iso) is None:
             eng.step(horizon=1)
         ttft_isolated = (time.time() - t0) * 1e3
         eng.run_to_completion(horizon=4)

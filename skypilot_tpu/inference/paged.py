@@ -572,6 +572,18 @@ class PagedInferenceEngine(_EngineBase):
         # this dict is assigned but not yet decodable (continuous
         # admission interleaves its remaining chunks with decode).
         self._prefill_off: Dict[int, int] = {}
+        # Extra async-pipeline state beyond _EngineBase's (_tok_dev /
+        # _pending live there): slots whose prefill-completion logits
+        # are still in flight sit in _await_first (their first token is
+        # sampled HOST-side with the request's params at _process_one,
+        # then scattered into the device token vector).
+        self._await_first: set = set()
+        self._slot_inflight = np.zeros(max_batch, np.int64)
+        # Bumped when a slot is freed: an in-flight call enqueued for a
+        # previous occupant must not decrement the NEW occupant's
+        # inflight count at processing time.
+        self._slot_epoch = np.zeros(max_batch, np.int64)
+        self._deferred_events: List[Tuple[int, int, bool]] = []
         self._decode_fn = self._build_decode()
         self._prefill_fns: Dict[Tuple[int, int], Any] = {}
         # A prefill chunk-batch stacks [L, n, chunk] KV rows as a scan
@@ -766,6 +778,9 @@ class PagedInferenceEngine(_EngineBase):
             self.alloc.release(p)
         self._pages[slot] = []
         self._prefill_off.pop(slot, None)        # cancel mid-prefill
+        self._await_first.discard(slot)
+        self._slot_inflight[slot] = 0
+        self._slot_epoch[slot] += 1
         super()._free_slot(slot)
 
     def _sample_host(self, logits: np.ndarray, req) -> int:
@@ -886,58 +901,69 @@ class PagedInferenceEngine(_EngineBase):
             jnp.asarray(tokens), jnp.asarray(lengths),
             jnp.asarray(valid), jnp.asarray(want))
         self.chunks_prefilled += 1
-        logits_np = None
-        now = time.time()
-        events: List[Tuple[int, int, bool]] = []
+        # Async: host bookkeeping advances NOW (the device writes are
+        # program-ordered); the logits readback + first-token sampling
+        # ride the pipeline (_process_one). Slots that completed their
+        # prompt this chunk wait in _await_first until their sampled
+        # token lands in the device token vector.
+        done_rows: List[Tuple[int, int]] = []    # (row i, slot)
         for i, slot in enumerate(batch):
             req = self._slots[slot]
             self._slot_len[slot] += int(valid[i])
             self._prefill_off[slot] += int(valid[i])
             if want[i] < 0:
                 continue                         # more chunks to go
-            del self._prefill_off[slot]          # decodable from now on
+            del self._prefill_off[slot]
+            self._await_first.add(slot)
             self.alloc.register_prefix(req._ctx, self._pages[slot],
                                        req._n_matched)
-            if logits_np is None:
-                logits_np = np.asarray(logits)
-            token = self._sample_host(logits_np[i], req)
-            if req.first_token_time is None:     # not on re-admission
-                req.first_token_time = now
-            req.output.append(token)
-            self._cur_token[slot] = token
-            finished = self._maybe_finish(slot, token)
-            events.append((req.request_id, token, finished))
-        return events
+            done_rows.append((i, slot))
+        if done_rows:
+            self._pending.append({
+                'kind': 'prefill', 'toks': logits,
+                'batch': [(slot, self._slots[slot], i)
+                          for i, slot in done_rows]})
+        return []
 
     def step(self, horizon: int = 1) -> List[Tuple[int, int, bool]]:
-        """Admit (one chunk max), then decode. While prompts are still
-        streaming in, the decode horizon is capped at
-        ``interleave_horizon`` so the next chunk runs within a bounded
-        number of decode steps (admission latency), and capped at a
-        medium bucket while the queue is non-empty so freed slots are
-        noticed promptly (a full 64-step horizon is ~2 s of wall clock
-        on a 7B — queue wait at that granularity is the burst-TTFT
-        bill). Steady state (no queue, no prefill) runs the caller's
-        full horizon."""
-        events = self._admit()
+        """Admit (one chunk max), then enqueue decode through the async
+        pipeline (_EngineBase semantics: results lag enqueues by up to
+        _PIPELINE_DEPTH calls). While prompts are still streaming in,
+        the decode horizon is capped at ``interleave_horizon`` so the
+        next chunk runs within a bounded number of decode steps
+        (admission latency), and capped at a medium bucket while the
+        queue is non-empty so freed slots are noticed promptly. Steady
+        state (no queue, no prefill) runs the caller's full horizon."""
+        events: List[Tuple[int, int, bool]] = []
+        while len(self._pending) >= self._PIPELINE_DEPTH:
+            events.extend(self._process_one())
+        events.extend(self._admit())
         if self._prefill_off:
             horizon = min(horizon, self.interleave_horizon)
         elif self._queue:
             horizon = min(horizon, 32)
-        events.extend(self._decode(horizon))
+        if not self._enqueue_decode(horizon) and self._pending:
+            events.extend(self._process_one())
+        if self._deferred_events:        # pool-pressure pipeline drain
+            events.extend(self._deferred_events)
+            self._deferred_events = []
         return events
 
     interleave_horizon = 8
 
     # ---------------------------------------------------------- decode
-    def _decode(self, horizon: int = 1) -> List[Tuple[int, int, bool]]:
+    def _enqueue_decode(self, horizon: int = 1) -> bool:
         active_slots = [s for s in range(self.max_batch)
                         if self._slots[s] is not None
-                        and s not in self._prefill_off]
+                        and s not in self._prefill_off
+                        and s not in self._await_first]
         if not active_slots:
-            return []
+            return False
         cap = int(self.max_seq - 1 -
-                  max(self._slot_len[s] for s in active_slots))
+                  max(self._slot_len[s] + self._slot_inflight[s]
+                      for s in active_slots))
+        if cap < 1:
+            return False
         horizon = max(1, min(horizon, cap))
         from skypilot_tpu.inference.engine import (_ring_horizon_cap,
                                                    _ring_row_bytes)
@@ -955,23 +981,36 @@ class PagedInferenceEngine(_EngineBase):
                 horizon = b
                 break
         # page capacity: every active slot must hold pages for
-        # len+horizon; shrink the horizon under pool pressure, and when
-        # even horizon=1 cannot fit, PREEMPT the newest request back to
-        # the queue (vLLM-style recompute: it re-enters with
+        # len+inflight+horizon; shrink the horizon under pool pressure,
+        # and when even horizon=1 cannot fit, PREEMPT the newest request
+        # back to the queue (vLLM-style recompute: it re-enters with
         # prompt+output as its context) instead of crashing — the
         # auto-sized pool may legitimately be smaller than
-        # slots x max_seq.
+        # slots x max_seq. Preemption must see COMPLETE outputs (the
+        # requeued context is prompt+output), so with calls in flight
+        # the pipeline drains first and the step retries.
+        def covered(s, extra):
+            return self._ensure_pages(
+                s, int(self._slot_len[s] + self._slot_inflight[s]) +
+                extra)
+
         while True:
             while horizon > 1:
-                if all(self._ensure_pages(s,
-                                          int(self._slot_len[s]) + horizon)
-                       for s in active_slots):
+                if all(covered(s, horizon) for s in active_slots):
                     break
                 horizon //= 2
-            if horizon > 1 or all(
-                    self._ensure_pages(s, int(self._slot_len[s]) + 1)
-                    for s in active_slots):
+            if horizon > 1 or all(covered(s, 1) for s in active_slots):
                 break
+            if self._pending:
+                # In-flight tokens would be lost by preempting now:
+                # drain into the deferred stash (step() flushes it into
+                # its returned events) and retry next step.
+                drained = list(self._deferred_events)
+                self._deferred_events = []
+                while self._pending:
+                    drained.extend(self._process_one())
+                self._deferred_events = drained
+                return False
             # Victim pool: every occupied slot (mid-prefill ones hold
             # pages too) EXCEPT the oldest decodable request — keeping
             # that one guarantees progress, and _validate_request
@@ -989,42 +1028,80 @@ class PagedInferenceEngine(_EngineBase):
             if victim in active_slots:
                 active_slots.remove(victim)
 
-        ready = [r if s not in self._prefill_off else None
+        ready = [r if (s not in self._prefill_off
+                       and s not in self._await_first) else None
                  for s, r in enumerate(self._slots)]
-        active = np.array([r is not None for r in ready])
-        temps = np.array([r.temperature if r else 0.0
-                          for r in ready], np.float32)
-        topps = np.array([r.top_p if r else 1.0 for r in ready],
-                         np.float32)
-        topks = np.array([r.top_k if r else 0 for r in ready],
-                         np.int32)
-        sample = bool((temps > 0).any())
+        temps_d, topks_d, topps_d, active_d, sample = \
+            self._slot_meta(ready)
         from skypilot_tpu.inference.engine import _bucket_len
         max_pages_live = max(
-            self._pages_needed(int(self._slot_len[s]) + horizon)
+            self._pages_needed(int(self._slot_len[s] +
+                                   self._slot_inflight[s]) + horizon)
             for s in active_slots)
         P = _bucket_len(max_pages_live, minimum=1)
         table_p = np.zeros((self.max_batch, P), np.int32)
         for s in range(self.max_batch):
             ps = self._pages[s][:P]
             table_p[s, :len(ps)] = ps
+        # Device-truth lengths at this call = processed + in-flight.
+        lengths = (self._slot_len + self._slot_inflight).astype(np.int32)
         self._rng, rng = jax.random.split(self._rng)
         toks, self.cache = self._decode_fn(
             self.params, self.cache, jnp.asarray(table_p),
-            jnp.asarray(self._cur_token),
-            jnp.asarray(self._slot_len.astype(np.int32)), rng,
-            jnp.asarray(temps), jnp.asarray(topks), jnp.asarray(topps),
-            jnp.asarray(active), horizon, sample)
-        toks = np.asarray(toks)
+            self._tok_dev, jnp.asarray(lengths), rng,
+            temps_d, topks_d, topps_d, active_d, horizon, sample)
+        self._tok_dev = toks[:, -1]
+        for s in range(self.max_batch):
+            if ready[s] is not None:
+                self._slot_inflight[s] += horizon
+        self._pending.append({'kind': 'decode', 'toks': toks,
+                              'horizon': horizon,
+                              'snapshot': list(ready),
+                              'epochs': self._slot_epoch.copy()})
+        return True
 
+    def _process_one(self) -> List[Tuple[int, int, bool]]:
+        """Sync the oldest in-flight call into events. Prefill entries
+        carry completion LOGITS: the first token is sampled host-side
+        with the request's params (see _sample_host) and scattered into
+        the device token vector, unblocking the slot for decode."""
         events: List[Tuple[int, int, bool]] = []
-        for slot, req in enumerate(ready):
+        entry = self._pending.popleft()
+        vals = np.asarray(entry['toks'])
+        now = time.time()
+        if entry['kind'] == 'prefill':
+            toks_new, slots_new = [], []
+            for slot, req, row in entry['batch']:
+                if req.finish_time is not None \
+                        or self._slots[slot] is not req:
+                    continue                     # cancelled/preempted
+                token = self._sample_host(vals[row], req)
+                self._await_first.discard(slot)
+                self._meta_dirty = True      # slot becomes decodable
+                if req.first_token_time is None:  # not on re-admission
+                    req.first_token_time = now
+                req.output.append(token)
+                finished = self._maybe_finish(slot, token)
+                events.append((req.request_id, token, finished))
+                if not finished:
+                    toks_new.append(token)
+                    slots_new.append(slot)
+            if slots_new:
+                self._tok_dev = self._merge_tokens(
+                    self._tok_dev, jnp.asarray(slots_new, jnp.int32),
+                    jnp.asarray(toks_new, jnp.int32))
+            return events
+        for slot, req in enumerate(entry['snapshot']):
             if req is None:
                 continue
-            for i in range(horizon):
-                token = int(toks[slot, i])
+            if entry['epochs'][slot] == self._slot_epoch[slot]:
+                self._slot_inflight[slot] = max(
+                    0, self._slot_inflight[slot] - entry['horizon'])
+            if req.finish_time is not None or self._slots[slot] is not req:
+                continue
+            for i in range(entry['horizon']):
+                token = int(vals[slot, i])
                 req.output.append(token)
-                self._cur_token[slot] = token
                 self._slot_len[slot] += 1
                 finished = self._maybe_finish(slot, token)
                 events.append((req.request_id, token, finished))

@@ -131,7 +131,12 @@ class ModelServer:
                 return
         # Clean stop: wake every waiter the way _fatal does — an
         # in-flight handler blocked on its finished event (or a stream
-        # queue) would otherwise hang its client forever.
+        # queue) would otherwise hang its client forever. The error
+        # sentinel must be set BEFORE waking (exactly like _fatal):
+        # a woken submit() that passes the error check would call
+        # pop_finished on a never-finished request and crash on None.
+        if self._error is None:
+            self._error = 'server stopped'
         with self._lock:
             for ev in self._finished_events.values():
                 ev.set()

@@ -353,3 +353,64 @@ class TestCancel:
         eng.run_to_completion()
         assert not eng.cancel(rid)
         assert eng.get_finished(rid) is not None
+
+
+class TestAsyncPipeline:
+    """The async dispatch pipeline (engine._pending): decode calls are
+    enqueued with device-resident tokens/cache and their results read
+    back up to _PIPELINE_DEPTH calls later. These tests pin the
+    invariants the lag must preserve."""
+
+    def test_results_lag_but_complete(self, engine_setup):
+        cfg, params = engine_setup
+        eng = InferenceEngine(cfg, params, max_batch=2, max_seq=64)
+        rid = eng.add_request([1, 2, 3], max_new_tokens=6)
+        all_events = []
+        for _ in range(30):
+            all_events.extend(eng.step(horizon=2))
+            if eng.get_finished(rid):
+                break
+        assert eng.get_finished(rid) is not None
+        toks = [t for r, t, _ in all_events if r == rid]
+        assert toks == eng.get_finished(rid).output
+
+    def test_lagged_equals_reference(self, engine_setup):
+        """Tokens produced through the pipeline match the no-cache
+        greedy reference — the device token chaining (call N+1 fed
+        call N's last column without a host trip) must not skew the
+        sequence."""
+        cfg, params = engine_setup
+        eng = InferenceEngine(cfg, params, max_batch=2, max_seq=64)
+        prompt = [5, 9, 2, 14]
+        rid = eng.add_request(prompt, max_new_tokens=8)
+        done = eng.run_to_completion(horizon=4)
+        assert done[rid].output == _greedy_reference(params, cfg,
+                                                     prompt, 8)
+
+    def test_inflight_bookkeeping_drains(self, engine_setup):
+        cfg, params = engine_setup
+        eng = InferenceEngine(cfg, params, max_batch=2, max_seq=64)
+        for _ in range(4):
+            eng.add_request([1, 2, 3], max_new_tokens=5)
+        eng.run_to_completion(horizon=4)
+        assert eng._inflight_steps == 0
+        assert not eng._pending
+        assert eng.num_active == 0
+
+    def test_cancel_mid_flight_discards_tokens(self, engine_setup):
+        """Cancel between enqueue and processing: the in-flight call's
+        tokens for that request must be dropped, and the slot reusable."""
+        cfg, params = engine_setup
+        eng = InferenceEngine(cfg, params, max_batch=1, max_seq=64)
+        rid = eng.add_request([1, 2, 3], max_new_tokens=30)
+        eng.step(horizon=2)          # admit (prefill enqueued)
+        eng.step(horizon=2)          # decode enqueued
+        assert eng.cancel(rid)
+        n_before = len(eng.get_finished(rid).output) \
+            if eng.get_finished(rid) else 0
+        assert n_before == 0         # cancelled, not finished
+        rid2 = eng.add_request([4, 5], max_new_tokens=3)
+        done = eng.run_to_completion(horizon=4)
+        assert rid2 in done
+        assert len(done[rid2].output) == 3
+        assert rid not in done

@@ -260,3 +260,27 @@ class TestContinuousAdmission:
         assert eng.preemptions >= 1
         assert done[r1].output == ref
         assert done[r2].output == ref
+
+    def test_preemption_event_stream_complete(self, setup):
+        """Every generated token must surface as a step() event even
+        when pool pressure forces a pipeline drain + preemption (the
+        serve layer streams from events; a dropped event is a lost
+        streamed token or a hung client). Regression: the drain path
+        once collected events into an aliased list and lost them."""
+        cfg, params = setup
+        eng = PagedInferenceEngine(cfg, params, max_batch=2, max_seq=256,
+                                   page_size=8, n_pages=12,
+                                   decode_impl='gather')
+        r1 = eng.add_request(list(range(1, 30)), max_new_tokens=24)
+        r2 = eng.add_request(list(range(1, 30)), max_new_tokens=24)
+        events = []
+        while eng.has_work() or eng._pending:
+            events.extend(eng.step(horizon=4))
+        assert eng.preemptions >= 1
+        for rid in (r1, r2):
+            streamed = [t for r, t, _ in events if r == rid]
+            out = eng.get_finished(rid).output
+            # A preempted request's regenerated tokens stream twice
+            # (recompute); the final output must be a SUFFIX of the
+            # stream and every output token must have been streamed.
+            assert streamed[-len(out):] == out
