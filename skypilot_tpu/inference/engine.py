@@ -353,11 +353,16 @@ class InferenceEngine(_EngineBase):
                  mesh: Optional[Any] = None, rng_seed: int = 0,
                  attn_impl: str = 'auto',
                  quantize: Optional[str] = None,
-                 donate_params: bool = False):
+                 donate_params: bool = False,
+                 prefill_w8a8: bool = False):
         self.max_batch = max_batch
         self.max_seq = max_seq
         self.mesh = mesh
         self.attn_impl = attn_impl
+        # Opt-in: quantize prefill activations to int8 (2x MXU rate on
+        # the compute-bound prefill; decode unaffected). Off by default
+        # — W8A8 adds activation quantization noise to the KV rows.
+        self.prefill_w8a8 = prefill_w8a8
         self._rng = jax.random.PRNGKey(rng_seed)
 
         cfg, self.params, quantize = prepare_params(
@@ -445,38 +450,42 @@ class InferenceEngine(_EngineBase):
         """Batched prefill: n prompts (padded to one bucket) in one device
         call that computes KV, scatters it into the requested slots of the
         big cache, and returns the first sampled token per prompt. One host
-        round trip per admit cycle instead of three per request."""
+        round trip per admit cycle instead of three per request.
+
+        Rides ``llama.prefill_rows``: plain causal attention over the
+        bucket (flash kernel on TPU — the old forward-with-scratch-cache
+        path read a bucket of zero cache rows per layer and never hit
+        flash), rows quantized inside the layer scan for int8 caches
+        (halves the stacked-rows transient -> doubles the admission
+        wave), and last-position-only unembed."""
         key = (bucket, n)
         if key in self._prefill_fns:
             return self._prefill_fns[key]
         cfg, attn_impl = self.cfg, self.attn_impl
+        w8a8 = self.prefill_w8a8
 
         @functools.partial(jax.jit, donate_argnums=(1,))
         def prefill(params, big_cache, tokens, true_lens, slots):
             """tokens [n, bucket]; true_lens [n]; slots [n] target rows."""
-            # The per-prefill scratch cache stays bf16 (exact prefill
-            # math); rows quantize once on the way into the slot cache.
-            cache = llama.KVCache.create(cfg, batch=n, max_seq=bucket)
-            logits, cache2 = llama.forward(params, tokens, cfg, cache=cache,
-                                           attn_impl=attn_impl)
-            last = jnp.take_along_axis(
-                logits, (true_lens - 1)[:, None, None], axis=1)[:, 0]
+            last, rows = llama.prefill_rows(
+                params, tokens, true_lens, cfg, attn_impl=attn_impl,
+                quantize_rows=big_cache.quantized, w8a8=w8a8)
             next_tokens = jnp.argmax(last, -1).astype(jnp.int32)
             # Scatter KV rows + lengths into the slot cache.
             length = big_cache.length.at[slots].set(true_lens)
             if big_cache.quantized:
-                kq, ks = llama.quantize_kv_rows(cache2.k)
-                vq, vs = llama.quantize_kv_rows(cache2.v)
+                kq, vq, ks, vs = rows
                 return next_tokens, llama.KVCache(
                     k=big_cache.k.at[:, slots, :bucket].set(kq),
                     v=big_cache.v.at[:, slots, :bucket].set(vq),
                     length=length,
                     k_scale=big_cache.k_scale.at[:, slots, :bucket].set(ks),
                     v_scale=big_cache.v_scale.at[:, slots, :bucket].set(vs))
+            k_rows, v_rows = rows
             ck = big_cache.k.at[:, slots, :bucket].set(
-                cache2.k.astype(big_cache.k.dtype))
+                k_rows.astype(big_cache.k.dtype))
             cv = big_cache.v.at[:, slots, :bucket].set(
-                cache2.v.astype(big_cache.v.dtype))
+                v_rows.astype(big_cache.v.dtype))
             return next_tokens, llama.KVCache(k=ck, v=cv, length=length)
 
         self._prefill_fns[key] = prefill
@@ -485,11 +494,29 @@ class InferenceEngine(_EngineBase):
     # ------------------------------------------------------------------
     _PREFILL_N_BUCKETS = (1, 2, 4, 8, 16, 32)
 
+    # Under saturation, admissions batch into waves of at least this
+    # many slots: a prefill call's cost is dominated by its fixed part
+    # at small n (measured 7B: n=2 ~120 ms vs n=8 ~260 ms — 60 vs 32 ms
+    # per request), so admitting every freed slot immediately spends
+    # ~2x the device time on prefill for the same arrivals.
+    _ADMIT_WAVE_MIN = 4
+
     def _admit(self) -> List[Tuple[int, int, bool]]:
-        """Admit as many queued requests as free slots allow, prefilling
-        them in one batched device call. Returns the prefill-token events
-        [(request_id, token, finished), ...] for the admitted requests."""
+        """Reserve free slots for queued requests and enqueue one
+        batched prefill call. ALWAYS returns [] — the prefill result
+        rides the async pipeline and its first-token events surface in
+        ``_process_one`` up to ``_PIPELINE_DEPTH`` calls later."""
         free = [s for s in range(self.max_batch) if self._slots[s] is None]
+        wave_min = min(self._ADMIT_WAVE_MIN, self.max_batch)
+        if (0 < len(free) < wave_min and len(free) < self.max_batch
+                and len(self._queue) > len(free) + wave_min):
+            # Saturated (queue outruns capacity) with slots still
+            # decoding: hold admission until a fuller wave accumulates.
+            # Freed slots arrive within ~a call horizon, so the TTFT
+            # cost is bounded; when the queue is short (latency regime)
+            # or every slot is free (nothing to wait for) admission is
+            # immediate.
+            return []
         batch: List[Tuple[int, Request]] = []
         for slot in free:
             req = self._queue_pop()
@@ -498,17 +525,21 @@ class InferenceEngine(_EngineBase):
             batch.append((slot, req))
         if not batch:
             return []
-        # Cap the wave: by the largest compiled bucket, AND by the bf16
-        # prefill-scratch transient — the batched prefill materializes a
-        # fresh [L, n, bucket] bf16 KV scratch (exact prefill math), and
-        # at n=32 x bucket=256 on a 7B that is 2 GB x2, which pushed the
-        # compile past HBM with the slot cache + weights resident. The
+        # Cap the wave: by the largest compiled bucket, AND by the
+        # prefill stacked-rows transient — the batched prefill stacks
+        # [L, n, bucket] KV rows across the layer scan, and at n=32 x
+        # bucket=256 on a 7B the bf16 stack is 2 GB x2, which pushed the
+        # compile past HBM with the slot cache + weights resident. int8
+        # caches quantize the rows INSIDE the scan (prefill_rows), so
+        # their stack is half the width and the wave twice as deep. The
         # overflow requeues at the FRONT (keeps FIFO) for the next step.
         bucket = min(_bucket_len(max(len(r.prompt) for _, r in batch)),
                      self.max_seq)
-        scratch_tok = (self.cfg.n_layers * self.cfg.n_kv_heads *
-                       self.cfg.head_dim *
-                       jnp.dtype(self.cfg.dtype).itemsize * 2)
+        row_width = ((self.cfg.head_dim + 4) if self.cache.quantized
+                     else self.cfg.head_dim *
+                     jnp.dtype(self.cfg.dtype).itemsize)
+        scratch_tok = self.cfg.n_layers * self.cfg.n_kv_heads * \
+            row_width * 2
         fit = int(0.75e9) // max(1, bucket * scratch_tok)
         cap = 1
         for b in self._PREFILL_N_BUCKETS:     # largest PADDED n that fits

@@ -327,6 +327,7 @@ def paged_prefill_chunk(
     want_idx: jax.Array,               # [n] in-chunk index of the row whose
                                        #     logits the caller needs (-1: none)
     cfg: ModelConfig,
+    w8a8: bool = False,
 ):
     """One fixed-size prefill chunk for ``n`` slots: attends against the
     pages written so far (each slot's ``lengths``) plus causal
@@ -334,7 +335,9 @@ def paged_prefill_chunk(
     pool, and returns per-slot logits at ``want_idx`` (the sampled
     first token when the chunk contains the prompt's end).
 
-    Returns (logits [n, vocab], new cache)."""
+    Returns (logits [n, vocab], new cache). ``w8a8`` quantizes the
+    layer-matmul activations per token (prefill is compute-bound; see
+    ``quantization.w8a8_region``) — the unembed stays W8A16."""
     n, chunk = tokens.shape
     len0 = lengths
     pool_k, pool_v = cache.pool_k, cache.pool_v
@@ -363,8 +366,11 @@ def paged_prefill_chunk(
         # int8 (the bf16 stack is the 7B prefill's biggest transient).
         return xc, _maybe_quantize_rows(new_kv, cache.quantized)
 
-    x, (k_rows, v_rows) = lax.scan(
-        layer_body, x, (params['layers'], jnp.arange(cfg.n_layers)))
+    import contextlib
+    from skypilot_tpu.models.quantization import w8a8_region
+    with (w8a8_region() if w8a8 else contextlib.nullcontext()):
+        x, (k_rows, v_rows) = lax.scan(
+            layer_body, x, (params['layers'], jnp.arange(cfg.n_layers)))
     x = llama.rms_norm(x, params['final_norm'], cfg.norm_eps,
                        cfg.norm_plus_one)
     idx = jnp.clip(want_idx, 0, chunk - 1)
@@ -513,7 +519,8 @@ class PagedInferenceEngine(_EngineBase):
                  mesh=None, rng_seed: int = 0, attn_impl: str = 'auto',
                  quantize: Optional[str] = None,
                  donate_params: bool = False,
-                 decode_impl: str = 'auto'):
+                 decode_impl: str = 'auto',
+                 prefill_w8a8: bool = False):
         from skypilot_tpu.inference.engine import prepare_params
         from skypilot_tpu.parallel import mesh as mesh_lib
         self.max_batch = max_batch
@@ -522,6 +529,9 @@ class PagedInferenceEngine(_EngineBase):
         self.chunk = chunk
         self.mesh = mesh
         self.attn_impl = attn_impl
+        # Opt-in W8A8 prefill (int8 activations on the compute-bound
+        # chunk prefill; decode unaffected) — see quantization.w8a8_region.
+        self.prefill_w8a8 = prefill_w8a8
         self._rng = jax.random.PRNGKey(rng_seed)
         self._host_rng = np.random.default_rng(rng_seed)
         cfg, self.params, quantize = prepare_params(
@@ -712,13 +722,14 @@ class PagedInferenceEngine(_EngineBase):
         key = (n, P)
         if key not in self._prefill_fns:
             cfg = self.cfg
+            w8a8 = self.prefill_w8a8
 
             @functools.partial(jax.jit, donate_argnums=(1,))
             def prefill(params, cache, table_p, tokens, lengths, valid,
                         want_idx):
                 return paged_prefill_chunk(params, cache, table_p,
                                            tokens, lengths, valid,
-                                           want_idx, cfg)
+                                           want_idx, cfg, w8a8=w8a8)
 
             self._prefill_fns[key] = prefill
         return self._prefill_fns[key]
@@ -861,8 +872,10 @@ class PagedInferenceEngine(_EngineBase):
 
     def _prefill_chunk_batch(self) -> List[Tuple[int, int, bool]]:
         """One fixed-size chunk across up to a compiled n-bucket of
-        mid-prefill slots. Slots whose prompt completes this chunk emit
-        their first token and become decodable."""
+        mid-prefill slots. ALWAYS returns [] — slots whose prompt
+        completes this chunk wait in ``_await_first``; their first
+        token is sampled host-side when the logits surface in
+        ``_process_one``, up to ``_PIPELINE_DEPTH`` calls later."""
         pending = sorted(self._prefill_off)
         if not pending:
             return []

@@ -673,6 +673,67 @@ def forward(
     return logits, new_cache
 
 
+def prefill_rows(
+    params: Params,
+    tokens: jax.Array,                 # [n, bucket] padded prompts
+    true_lens: jax.Array,              # [n] real prompt lengths
+    cfg: ModelConfig,
+    *,
+    attn_impl: str = 'auto',
+    quantize_rows: bool = False,
+    w8a8: bool = False,
+):
+    """Full-prompt prefill for the slot engine: plain causal attention
+    over the padded bucket — flash-eligible on TPU (the forward-with-
+    scratch-cache path it replaces ran ``cached_attention`` against a
+    bucket of zero rows: an extra masked cache read per layer and no
+    flash). Returns only what admission needs:
+
+    - ``last_logits`` [n, vocab] fp32 at each prompt's final position
+      (the full [n, bucket, vocab] logits tensor is a ~0.5 GB transient
+      at n=8 x bucket=512 — only the last row is ever used);
+    - the per-layer KV rows, quantized INSIDE the layer scan when
+      ``quantize_rows`` (the stacked bf16 [L, n, bucket] rows are the
+      7B prefill's biggest transient — int8 halves it, doubling the
+      admission wave the scratch budget admits):
+      (k_rows, v_rows) bf16, or (kq, vq, ks, vs) int8 + scales.
+
+    ``w8a8`` additionally quantizes activations per token inside the
+    LAYER matmuls (prefill is compute-bound; the MXU's int8 path is 2x
+    bf16 — see ``quantization.w8a8_region``). The unembed stays W8A16:
+    logits feed sampling directly and are not worth the noise.
+    """
+    from skypilot_tpu.models import quantization
+    x = _embed_tokens(params, tokens, cfg)
+    x = _shard(x, 'batch', 'seq', 'embed')
+    n, s = tokens.shape
+    positions = jnp.broadcast_to(jnp.arange(s)[None, :], (n, s))
+
+    def body(carry, layer):
+        def attn_fn(q, k, v):
+            return attention(q, k, v, causal=True, impl=attn_impl)
+
+        xc, (k, v), _ = _layer_core(layer, carry, cfg, positions,
+                                    attn_fn)
+        if quantize_rows:
+            kq, ks = quantize_kv_rows(k)
+            vq, vs = quantize_kv_rows(v)
+            return xc, (kq, vq, ks, vs)
+        return xc, (k, v)
+
+    import contextlib
+    ctx = (quantization.w8a8_region() if w8a8
+           else contextlib.nullcontext())
+    with ctx:
+        x, rows = lax.scan(body, x, params['layers'])
+    x = rms_norm(x, params['final_norm'], cfg.norm_eps,
+                 cfg.norm_plus_one)
+    last_x = jnp.take_along_axis(x, (true_lens - 1)[:, None, None],
+                                 axis=1)
+    last_logits = _unembed_logits(params, last_x, cfg)[:, 0]
+    return last_logits, rows
+
+
 def decode_horizon(
     params: Params,
     cache: KVCache,

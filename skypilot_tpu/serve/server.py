@@ -37,12 +37,14 @@ class ModelServer:
                  max_seq: int = 1024, port: int = 8081,
                  model_path: Optional[str] = None,
                  quantize: Optional[str] = None,
-                 kv_cache: str = 'paged', page_size: int = 128):
+                 kv_cache: str = 'paged', page_size: int = 128,
+                 prefill_w8a8: bool = False):
         self.cfg_name = cfg_name
         self.model_path = model_path  # HF checkpoint dir (real weights)
         self.quantize = quantize      # 'int8' => int8 weights + KV cache
         self.kv_cache = kv_cache      # 'slot' | 'paged' (prefix caching)
         self.page_size = page_size    # paged-cache page granularity
+        self.prefill_w8a8 = prefill_w8a8  # int8 activations on prefill
         self.max_batch = max_batch
         self.max_seq = max_seq
         self.port = port
@@ -72,6 +74,7 @@ class ModelServer:
                       else InferenceEngine)
         extra = ({'page_size': self.page_size}
                  if self.kv_cache == 'paged' else {})
+        extra['prefill_w8a8'] = self.prefill_w8a8
         if self.model_path:
             # Real weights: HF checkpoint dir (config.json + safetensors
             # [+ tokenizer.json]) — the reference serves such checkpoints
@@ -116,7 +119,14 @@ class ModelServer:
                 with self._lock:
                     has_work = self.engine.has_work()
                     if has_work:
-                        events = self.engine.step(horizon=8)
+                        # Adaptive fused horizon: long fused calls
+                        # maximize throughput at saturation (dispatch
+                        # is pipelined away, but per-call host work
+                        # isn't), short ones keep streaming latency
+                        # low when the batch is nearly idle.
+                        sat = max(2, self.engine.max_batch // 2)
+                        h = 32 if self.engine.num_active >= sat else 8
+                        events = self.engine.step(horizon=h)
                     else:
                         self._work.clear()
                         events = []
@@ -568,6 +578,11 @@ def main() -> None:
                         help='paged-cache page granularity (tokens); '
                              'int8 decode needs a multiple of 128 to '
                              'stay on the manual-DMA fast path')
+    parser.add_argument('--prefill-w8a8', action='store_true',
+                        help='quantize prefill activations to int8 '
+                             '(2x MXU rate on the compute-bound '
+                             'prefill; adds quantization noise to '
+                             'prefilled KV rows — decode unaffected)')
     parser.add_argument('--max-batch', type=int, default=8)
     parser.add_argument('--max-seq', type=int, default=1024)
     parser.add_argument('--port', type=int,
@@ -581,7 +596,8 @@ def main() -> None:
                          model_path=args.model_path,
                          quantize=args.quantize,
                          kv_cache=args.kv_cache,
-                         page_size=args.page_size)
+                         page_size=args.page_size,
+                         prefill_w8a8=args.prefill_w8a8)
     server.start(block=True)
 
 

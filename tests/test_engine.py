@@ -414,3 +414,47 @@ class TestAsyncPipeline:
         assert rid2 in done
         assert len(done[rid2].output) == 3
         assert rid not in done
+
+
+class TestW8A8Prefill:
+    """Opt-in int8-activation prefill (quantization.w8a8_region):
+    int8 x int8 MXU dots on the compute-bound prefill, decode W8A16."""
+
+    def test_qeinsum_w8a8_close_to_exact(self):
+        from skypilot_tpu.models import quantization as q
+        key = jax.random.PRNGKey(0)
+        x = jax.random.normal(key, (4, 8, 64), jnp.bfloat16)
+        w = q._quantize_array(
+            jax.random.normal(jax.random.PRNGKey(1), (64, 96),
+                              jnp.bfloat16), (0,))
+        exact = q.qeinsum('bsd,df->bsf', x, w, out_dtype=jnp.float32)
+        with q.w8a8_region():
+            approx = q.qeinsum('bsd,df->bsf', x, w,
+                               out_dtype=jnp.float32)
+        # per-row int8 activations: ~0.5-1% relative error on a
+        # 64-deep dot of unit-scale gaussians
+        err = jnp.abs(approx - exact)
+        rel = float(jnp.max(err) / (jnp.max(jnp.abs(exact)) + 1e-6))
+        assert rel < 0.05, rel
+
+    def test_engine_generates_with_w8a8_prefill(self, engine_setup):
+        cfg, params = engine_setup
+        from skypilot_tpu.models import quantization
+        qparams = quantization.quantize_params(params)
+        eng = InferenceEngine(cfg, qparams, max_batch=2, max_seq=64,
+                              prefill_w8a8=True)
+        rid = eng.add_request([3, 1, 4, 1, 5], max_new_tokens=6)
+        done = eng.run_to_completion(horizon=4)
+        assert len(done[rid].output) == 6
+        # Decode is untouched: a second engine without w8a8 but the
+        # same prefilled first token should continue identically given
+        # the same cache content modulo prefill activation noise — we
+        # only assert generation is well-formed (ids in vocab).
+        assert all(0 <= t < cfg.vocab_size for t in done[rid].output)
+
+    def test_region_is_trace_time_scoped(self):
+        from skypilot_tpu.models import quantization as q
+        assert not getattr(q._a8_region, 'active', False)
+        with q.w8a8_region():
+            assert q._a8_region.active
+        assert not q._a8_region.active
