@@ -5,10 +5,12 @@ re-prefills shared prefixes. This module is the vLLM-class capability
 (the reference's serving recipes lean on vLLM's paged attention,
 ``llm/vllm/README.md:10``) designed for XLA's static-shape world:
 
-- **Page pool**: one ``[L, n_pages, page, hkv, d]`` tensor shared by all
+- **Page pool**: one ``[L, n_pages, hkv, page, d]`` tensor shared by all
   slots; a slot holds a host-side list of page ids. HBM is proportional
   to LIVE tokens (rounded to pages), not slots × max_seq — longer
-  contexts / more slots fit the same chip.
+  contexts / more slots fit the same chip. Pages are HEAD-MAJOR so the
+  Pallas decode kernel contracts straight off each DMA'd page with no
+  in-kernel relayout (see ``ops/paged_attention.py``'s layout note).
 - **Static shapes everywhere**: decode gathers each slot's first ``P``
   pages where ``P`` is a power-of-two bucket of the live maximum — the
   same compiled-program-count bound as the slot cache's ``kv_bucket``.
@@ -23,7 +25,9 @@ re-prefills shared prefixes. This module is the vLLM-class capability
   length, bounded scratch memory (long-prompt serving).
 
 int8: the pool quantizes per-row like the slot cache (``k_scale``
-[L, n_pages, page, hkv, 1] fp32).
+[L, n_pages, hkv, page] fp32, head-major like the pool — the kernel
+DMAs scale pages contiguously and the old per-horizon-call relayout
+of the whole scale pool is gone).
 """
 from __future__ import annotations
 
@@ -49,9 +53,9 @@ class PagedKVCache(NamedTuple):
     writes); the allocator never hands it out. Per-slot lengths are
     HOST state (the engine controls every admit/advance), passed as a
     small per-call argument — no device length bookkeeping."""
-    pool_k: jax.Array                      # [L, n_pages, page, hkv, d]
+    pool_k: jax.Array                      # [L, n_pages, hkv, page, d]
     pool_v: jax.Array
-    k_scale: Optional[jax.Array] = None    # [L, n_pages, page, hkv, 1]
+    k_scale: Optional[jax.Array] = None    # [L, n_pages, hkv, page]
     v_scale: Optional[jax.Array] = None
 
     @property
@@ -60,7 +64,7 @@ class PagedKVCache(NamedTuple):
 
     @property
     def page_size(self) -> int:
-        return self.pool_k.shape[2]
+        return self.pool_k.shape[3]
 
     @property
     def n_pages(self) -> int:
@@ -70,10 +74,10 @@ class PagedKVCache(NamedTuple):
     def create(cls, cfg: ModelConfig, *, n_pages: int,
                page_size: int = 128, quantized: bool = False
                ) -> 'PagedKVCache':
-        shape = (cfg.n_layers, n_pages, page_size, cfg.n_kv_heads,
+        shape = (cfg.n_layers, n_pages, cfg.n_kv_heads, page_size,
                  cfg.head_dim)
         if quantized:
-            sshape = shape[:-1] + (1,)
+            sshape = shape[:-1]
             return cls(pool_k=jnp.zeros(shape, jnp.int8),
                        pool_v=jnp.zeros(shape, jnp.int8),
                        k_scale=jnp.zeros(sshape, jnp.float32),
@@ -83,10 +87,11 @@ class PagedKVCache(NamedTuple):
 
 
 def paged_cache_logical_axes(quantized: bool = False) -> PagedKVCache:
-    pool = ('layers', None, None, 'kv_heads', 'head_dim')
+    pool = ('layers', None, 'kv_heads', None, 'head_dim')
     if quantized:
+        scale = ('layers', None, 'kv_heads', None)
         return PagedKVCache(pool_k=pool, pool_v=pool,
-                            k_scale=pool, v_scale=pool)
+                            k_scale=scale, v_scale=scale)
     return PagedKVCache(pool_k=pool, pool_v=pool)
 
 
@@ -110,13 +115,36 @@ def _flat_write_indices(table: jax.Array, starts: jax.Array, n: int,
 
 def _scatter_rows(pool: jax.Array, rows: jax.Array,
                   flat_idx: jax.Array) -> jax.Array:
-    """pool [L, n_pages, page, hkv, d*]; rows [L, slots, n, hkv, d*];
-    flat_idx [slots, n] into the flattened page axis."""
-    L, n_pages, page = pool.shape[:3]
-    tail = pool.shape[3:]
-    flat_pool = pool.reshape((L, n_pages * page) + tail)
-    flat_rows = rows.reshape((L, -1) + tail)
-    flat_pool = flat_pool.at[:, flat_idx.reshape(-1)].set(
+    """pool [L, n_pages, hkv, page] (+ optional trailing [d]); rows
+    [L, slots, n, hkv] (+ the same tail); flat_idx [slots, n] logical
+    token indices (page_id * page + pos). The pool's head-major layout
+    interleaves heads between a page's token rows, so each (LAYER,
+    token, head) triple scatters to its own flattened row:
+    layer * n_pages*hkv*page + (tok // page) * hkv*page + head * page
+    + tok % page.
+
+    Why fully flat (the layer axis folded into the scatter indices
+    rather than ridden as a batch dim): every batched formulation that
+    leaves L as a window dim makes XLA's layout assignment relay the
+    whole pool around the scatter (a measured 3.77 GB HLO-temp copy of
+    the 7B pool — an instant OOM with the pool + weights resident),
+    because the scatter windows [L, ..] span the operand's major dim.
+    Fully flat windows are [page-row] = the operand's own minor layout,
+    the scatter runs IN PLACE (0-byte temps, donation holds), at the
+    price of a slower per-row scatter (~3-4x the token-major merge,
+    bounded at ~3% of a decode-horizon program)."""
+    L, n_pages, hkv, page = pool.shape[:4]
+    tail = pool.shape[4:]
+    rows_per_layer = n_pages * hkv * page
+    flat_pool = pool.reshape((L * rows_per_layer,) + tail)
+    f = flat_idx.reshape(-1)                            # [slots*n]
+    tok = ((f[:, None] // page) * (hkv * page)
+           + jnp.arange(hkv)[None, :] * page
+           + f[:, None] % page)                         # [slots*n, hkv]
+    idx = (jnp.arange(L)[:, None, None] * rows_per_layer
+           + tok[None]).reshape(-1)                     # [L*slots*n*hkv]
+    flat_rows = rows.reshape((idx.size,) + tail)
+    flat_pool = flat_pool.at[idx].set(
         flat_rows.astype(flat_pool.dtype), mode='drop')
     return flat_pool.reshape(pool.shape)
 
@@ -159,17 +187,21 @@ def _maybe_quantize_rows(new_kv, quantized: bool):
 
 
 def _gather_layer(pool_layer: jax.Array, scale_layer, table_p: jax.Array):
-    """pool_layer [n_pages, page, hkv, d*] -> ([slots, P*page, hkv, d],
-    scales or None): contiguous view of each slot's first P pages. int8
-    pools return CODES + gathered scales — the gathered copy stays int8
-    (half the write+read traffic of a dequantized gather) and the
-    attention op folds the scales into logits/probs."""
-    g = pool_layer[table_p]                     # [slots, P, page, hkv, d*]
-    slots, P, page = g.shape[:3]
-    g = g.reshape((slots, P * page) + g.shape[3:])
+    """pool_layer [n_pages, hkv, page, d] -> ([slots, P*page, hkv, d],
+    scales or None): contiguous token-major view of each slot's first P
+    pages (the XLA attention ops are token-major; the permute fuses
+    into the gather's copy — this is the fallback path, the Pallas
+    kernel reads the head-major pool directly). int8 pools return
+    CODES + gathered scales — the gathered copy stays int8 (half the
+    write+read traffic of a dequantized gather) and the attention op
+    folds the scales into logits/probs."""
+    g = pool_layer[table_p]                     # [slots, P, hkv, page, d]
+    slots, P, hkv, page = g.shape[:4]
+    g = g.transpose(0, 1, 3, 2, 4).reshape(
+        (slots, P * page, hkv) + g.shape[4:])
     if scale_layer is not None:
-        s = scale_layer[table_p]                # [slots, P, page, hkv, 1]
-        s = s.reshape((slots, P * page) + s.shape[3:])
+        s = scale_layer[table_p]                # [slots, P, hkv, page]
+        s = s.transpose(0, 1, 3, 2).reshape(slots, P * page, hkv, 1)
         return g, s
     return g, None
 
@@ -203,25 +235,13 @@ def paged_decode_horizon(
     donated program (see its docstring for why)."""
     b = tokens.shape[0]
     n_layers, n_kv, hd = cfg.n_layers, cfg.n_kv_heads, cfg.head_dim
-    page = cache.page_size
     len0 = lengths
     pool_k, pool_v = cache.pool_k, cache.pool_v
     ks_pool, vs_pool = cache.k_scale, cache.v_scale
-    # Re-lay the scale pools ONCE per program for the pallas path:
-    # squeeze the unit dim and go head-major [L, n_pages, hkv, page]
-    # (minor dim page is DMA-tileable where hkv is not; the kernels
-    # fold these into logits/p — see the kernel layout note). The
-    # gather path keeps the broadcast-friendly storage shape. Cost:
-    # one full scale-pool relayout (~0.5 GB on a 7B) per HORIZON
-    # program — ~1% of a 64-step horizon's HBM traffic, but it does
-    # scale with pool capacity, not live tokens; storing the scales
-    # head-major would remove it at the price of a 2-D scatter in
-    # merge_rows_into_pool.
-    if decode_impl == 'pallas' and cache.quantized:
-        ks_sq = jnp.swapaxes(ks_pool[..., 0], -1, -2)
-        vs_sq = jnp.swapaxes(vs_pool[..., 0], -1, -2)
-    else:
-        ks_sq = vs_sq = None
+    # Scales are STORED head-major [L, n_pages, hkv, page] (like the
+    # pool): the kernel DMAs them per page with no relayout — the old
+    # token-major storage cost one full scale-pool relayout (~0.5 GB
+    # on a 7B) per horizon program, scaling with pool capacity.
     layer_params = params['layers']
     ring_k = jnp.zeros((n_layers, b, horizon, n_kv, hd), cfg.dtype)
     ring_v = jnp.zeros_like(ring_k)
@@ -254,7 +274,7 @@ def paged_decode_horizon(
                 def attn_fn(q, k, v):
                     partial = paged_decode_attention(
                         q[:, 0], pool_k, pool_v, table_p, len0,
-                        ks_sq, vs_sq, layer=li, interpret=interp)
+                        ks_pool, vs_pool, layer=li, interpret=interp)
                     return merge_partial_with_ring_self(
                         partial, q, k, v, rk, rv, i)
             else:

@@ -305,6 +305,9 @@ def _ambient_mesh():
             probe_healthy = True
             if not m.empty:
                 return m
+            break   # both probes back the SAME context; one healthy
+                    # read of an empty mesh settles it (and skipping
+                    # the pxla probe avoids its DeprecationWarning)
         except Exception:  # pylint: disable=broad-except
             continue
     if not probe_healthy and not _probe_broken_warned:

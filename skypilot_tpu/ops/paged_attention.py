@@ -12,6 +12,18 @@ slot. Reads are LENGTH-EXACT per slot: a slot visits only
 ceil(len/page) pages (the XLA gather path had to read the bucketed max
 over all slots).
 
+The pool stores pages HEAD-MAJOR: ``[L, n_pages, hkv, page, d]`` (and
+scales ``[L, n_pages, hkv, page]``). Both attention contractions then
+run straight off the DMA'd block — logits contract d (the minor dim of
+q AND k, the MXU's native A.B^T form) and the p.v dot contracts page —
+so the kernel performs NO in-kernel relayout. The previous token-major
+``[page, hkv, d]`` layout needed k.transpose(1, 2, 0) / v.transpose(1,
+0, 2) per page visit: a VPU lane-shuffle of every streamed byte that
+capped the kernel at ~175 GB/s effective vs the slot cache's ~430
+(perf.md "slot vs paged"). Head-major costs the WRITE side a strided
+row append ([hkv, 1, d] slices, 32 runs x 128 B) — decode writes one
+row per slot per step vs reading hundreds, so the read side wins.
+
 The kernel computes the CACHE part of decode attention and returns the
 partial-softmax triple (acc, m, l); the caller merges the current
 token + fused-horizon ring rows (tiny tensors) in XLA — one softmax
@@ -76,21 +88,19 @@ def _kernel(li_ref, table_ref, lens_ref,         # scalar prefetch
         # implicit dimension"); m/l ride [hq, LANES] broadcast columns,
         # the same trick the flash kernel's lse uses.
         q = q_ref[0].astype(jnp.float32) * scale          # [hq, d]
-        k = k_ref[0, 0].astype(jnp.float32)               # [page, hkv, d]
+        k = k_ref[0, 0].astype(jnp.float32)               # [hkv, page, d]
         v = v_ref[0, 0].astype(jnp.float32)
         hq, d = q.shape
-        hkv = k.shape[1]
+        hkv = k.shape[0]
         g = hq // hkv
         qg = q.reshape(hkv, g, d)
-        # logits[h, g, p] = sum_d q[h,g,d] * k[p,h,d]: batched (over
-        # hkv) [g,d] x [d,page] matmuls. int8 pools: the per-row scales
-        # ride HEAD-MAJOR [hkv, page] blocks and fold into the LOGITS
-        # (and into p for the v side) — no in-kernel reshape/transpose,
-        # and the layout's minor dim (page) satisfies Mosaic's
-        # slice-tiling where [.., page, hkv] could not.
-        kt = k.transpose(1, 2, 0)                         # [hkv, d, page]
+        # logits[h, g, p] = sum_d q[h,g,d] * k[h,p,d]: batched (over
+        # hkv) A.B^T dots, both operands contracting their MINOR dim —
+        # the head-major page layout feeds the MXU with no relayout.
+        # int8 pools: the per-row scales ride HEAD-MAJOR [hkv, page]
+        # blocks and fold into the LOGITS (and into p for the v side).
         logits = jax.lax.dot_general(
-            qg, kt, (((2,), (1,)), ((0,), (0,))),
+            qg, k, (((2,), (2,)), ((0,), (0,))),
             preferred_element_type=jnp.float32)           # [hkv, g, page]
         if quantized:
             logits = logits * ks_ref[0, 0].astype(
@@ -108,13 +118,12 @@ def _kernel(li_ref, table_ref, lens_ref,         # scalar prefetch
         l_s[:] = l_s[:] * corr + jnp.broadcast_to(
             jnp.sum(p, axis=1, keepdims=True), l_s.shape)
         m_s[:] = jnp.broadcast_to(m_new, m_s.shape)
-        # pv[h,g,d] = sum_p p[h,g,p] * v[p,h,d]: batched over hkv.
+        # pv[h,g,d] = sum_p p[h,g,p] * v[h,p,d]: batched over hkv.
         pg = p.reshape(hkv, g, page)
         if quantized:
             pg = pg * vs_ref[0, 0].astype(jnp.float32)[:, None, :]
-        vt = v.transpose(1, 0, 2)                         # [hkv, page, d]
         pv = jax.lax.dot_general(
-            pg, vt, (((2,), (1,)), ((0,), (0,))),
+            pg, v, (((2,), (1,)), ((0,), (0,))),
             preferred_element_type=jnp.float32)           # [hkv, g, d]
         acc_s[:] = acc_s[:] * corr + pv.reshape(hq, d)
 
@@ -138,13 +147,16 @@ def _kernel_manual(li_ref, table_ref, lens_ref,   # scalar prefetch
     slot cache's decode on a 7B) and reads length-exact blocks.
 
     ``pages_per_block`` (K) pages are fetched per loop iteration into
-    one contiguous VMEM block (K async copies issued back-to-back, ONE
-    wait each): per-iteration DMA-latency/loop overhead amortizes over
-    K*page tokens and the flash blocks get K x larger — a single page
-    per iteration measured ~165 GB/s effective on a 7B MHA decode where
-    the slot cache's contiguous XLA read ran ~430 GB/s (the vLLM TPU
-    kernel's num_kv_pages_per_block knob exists for the same reason).
-    Reads round up to K pages per slot."""
+    per-page VMEM buffers (K async copies issued back-to-back, ONE wait
+    each): per-iteration DMA-latency/loop overhead amortizes over
+    K*page tokens — a single page per iteration measured ~165 GB/s
+    effective on a 7B MHA decode (the vLLM TPU kernel's
+    num_kv_pages_per_block knob exists for the same reason). Reads
+    round up to K pages per slot. With the head-major pool every DMA
+    (data AND scales) lands contiguously in its [kk] buffer, and the
+    flash update runs per page (K unrolled online-softmax updates per
+    loop iteration — exp over [hq, page] is VPU noise next to the
+    stream)."""
     if quantized:
         ks_hbm, vs_hbm = refs[0], refs[1]
         refs = refs[2:]
@@ -178,20 +190,20 @@ def _kernel_manual(li_ref, table_ref, lens_ref,   # scalar prefetch
             s0, s1 = 2 * kk, 2 * kk + 1
             out += [pltpu.make_async_copy(
                         k_hbm.at[li, pid],
-                        kb.at[buf, pl.ds(kk * page, page)],
+                        kb.at[buf, kk],
                         sem.at[buf, s0]),
                     pltpu.make_async_copy(
                         v_hbm.at[li, pid],
-                        vb.at[buf, pl.ds(kk * page, page)],
+                        vb.at[buf, kk],
                         sem.at[buf, s1])]
             if quantized:
                 out += [pltpu.make_async_copy(
                             ks_hbm.at[li, pid],
-                            ksb.at[buf, :, pl.ds(kk * page, page)],
+                            ksb.at[buf, kk],
                             sem.at[buf, 2 * K + s0]),
                         pltpu.make_async_copy(
                             vs_hbm.at[li, pid],
-                            vsb.at[buf, :, pl.ds(kk * page, page)],
+                            vsb.at[buf, kk],
                             sem.at[buf, 2 * K + s1])]
         return out
 
@@ -204,7 +216,7 @@ def _kernel_manual(li_ref, table_ref, lens_ref,   # scalar prefetch
     qg = q.reshape(hkv, g, d)
 
     def page_step(j, carry):
-        acc, m_prev, l_prev = carry
+        carry_in = carry
         buf = j % 2
 
         @pl.when(j + 1 < needed)
@@ -214,35 +226,39 @@ def _kernel_manual(li_ref, table_ref, lens_ref,   # scalar prefetch
 
         for dma in dmas(buf, j):
             dma.wait()
-        k = kb[buf].astype(jnp.float32)                   # [blk, hkv, d]
-        v = vb[buf].astype(jnp.float32)
-        kt = k.transpose(1, 2, 0)                         # [hkv, d, blk]
-        logits = jax.lax.dot_general(
-            qg, kt, (((2,), (1,)), ((0,), (0,))),
-            preferred_element_type=jnp.float32)           # [hkv, g, blk]
-        if quantized:
-            # head-major [hkv, blk] scale blocks fold into the logits
-            # (k side) and p (v side): no reshapes, DMA-aligned minor.
-            logits = logits * ksb[buf].astype(jnp.float32)[:, None, :]
-        logits = logits.reshape(hq, blk)
-        pos = j * blk + jax.lax.broadcasted_iota(
-            jnp.int32, (hq, blk), 1)
-        logits = jnp.where(pos < length, logits, _NEG_INF)
-        m_page = jnp.max(logits, axis=1, keepdims=True)
-        m_new = jnp.maximum(m_prev, m_page)
-        p = jnp.exp(logits - m_new)
-        p = jnp.where(pos < length, p, 0.0)
-        corr = jnp.exp(m_prev - m_new)
-        l_new = l_prev * corr + jnp.sum(p, axis=1, keepdims=True)
-        pg = p.reshape(hkv, g, blk)
-        if quantized:
-            pg = pg * vsb[buf].astype(jnp.float32)[:, None, :]
-        vt = v.transpose(1, 0, 2)                         # [hkv, blk, d]
-        pv = jax.lax.dot_general(
-            pg, vt, (((2,), (1,)), ((0,), (0,))),
-            preferred_element_type=jnp.float32)           # [hkv, g, d]
-        acc = acc * corr + pv.reshape(hq, d)
-        return acc, m_new, l_new
+        acc, m_prev, l_prev = carry_in
+        for kk in range(K):                       # unrolled: static K
+            k = kb[buf, kk].astype(jnp.float32)           # [hkv, page, d]
+            v = vb[buf, kk].astype(jnp.float32)
+            # Batched A.B^T: both operands contract their minor dim
+            # straight off the DMA'd head-major block — no relayout.
+            logits = jax.lax.dot_general(
+                qg, k, (((2,), (2,)), ((0,), (0,))),
+                preferred_element_type=jnp.float32)       # [hkv, g, page]
+            if quantized:
+                # head-major [hkv, page] scale blocks fold into the
+                # logits (k side) and p (v side).
+                logits = logits * ksb[buf, kk].astype(
+                    jnp.float32)[:, None, :]
+            logits = logits.reshape(hq, page)
+            pos = (j * K + kk) * page + jax.lax.broadcasted_iota(
+                jnp.int32, (hq, page), 1)
+            logits = jnp.where(pos < length, logits, _NEG_INF)
+            m_page = jnp.max(logits, axis=1, keepdims=True)
+            m_new = jnp.maximum(m_prev, m_page)
+            p = jnp.exp(logits - m_new)
+            p = jnp.where(pos < length, p, 0.0)
+            corr = jnp.exp(m_prev - m_new)
+            l_prev = l_prev * corr + jnp.sum(p, axis=1, keepdims=True)
+            m_prev = m_new
+            pg = p.reshape(hkv, g, page)
+            if quantized:
+                pg = pg * vsb[buf, kk].astype(jnp.float32)[:, None, :]
+            pv = jax.lax.dot_general(
+                pg, v, (((2,), (1,)), ((0,), (0,))),
+                preferred_element_type=jnp.float32)       # [hkv, g, d]
+            acc = acc * corr + pv.reshape(hq, d)
+        return acc, m_prev, l_prev
 
     acc0 = jnp.zeros((hq, d), jnp.float32)
     m0 = jnp.full((hq, 1), _NEG_INF, jnp.float32)
@@ -255,7 +271,7 @@ def _kernel_manual(li_ref, table_ref, lens_ref,   # scalar prefetch
 
 def paged_decode_attention(
     q: jax.Array,                      # [slots, hq, d] current-token queries
-    pool_k: jax.Array,                 # [L, n_pages, page, hkv, d]
+    pool_k: jax.Array,                 # [L, n_pages, hkv, page, d]
     pool_v: jax.Array,
     table_p: jax.Array,                # [slots, P] page ids
     lengths: jax.Array,                # [slots] valid cache rows
@@ -280,7 +296,7 @@ def paged_decode_attention(
     no-op for them.
     """
     slots, hq, d = q.shape
-    _, n_pages, page, hkv, _ = pool_k.shape
+    _, n_pages, hkv, page, _ = pool_k.shape
     P = table_p.shape[1]
     g = hq // hkv
     if scale is None:
@@ -323,14 +339,14 @@ def paged_decode_attention(
         args = [li, table_p, lengths, q, pool_k, pool_v]
         n_sems = 2 * K
         scratch = [
-            pltpu.VMEM((2, K * page, hkv, d), pool_k.dtype),
-            pltpu.VMEM((2, K * page, hkv, d), pool_v.dtype),
+            pltpu.VMEM((2, K, hkv, page, d), pool_k.dtype),
+            pltpu.VMEM((2, K, hkv, page, d), pool_v.dtype),
         ]
         if quantized:
             in_specs += [any_spec, any_spec]
             args += [k_scale, v_scale]
-            scratch += [pltpu.VMEM((2, hkv, K * page), jnp.float32),
-                        pltpu.VMEM((2, hkv, K * page), jnp.float32)]
+            scratch += [pltpu.VMEM((2, K, hkv, page), jnp.float32),
+                        pltpu.VMEM((2, K, hkv, page), jnp.float32)]
             n_sems = 4 * K
         scratch.append(pltpu.SemaphoreType.DMA((2, n_sems)))
         acc, m, l = pl.pallas_call(
@@ -371,9 +387,9 @@ def paged_decode_attention(
 
     in_specs = [
         pl.BlockSpec((1, hq, d), lambda i, j, li, tab, lens: (i, 0, 0)),
-        pl.BlockSpec((1, 1, page, hkv, d), lambda i, j, li, tab, lens:
+        pl.BlockSpec((1, 1, hkv, page, d), lambda i, j, li, tab, lens:
                      (li[0], tab[i, page_idx(i, j, lens)], 0, 0, 0)),
-        pl.BlockSpec((1, 1, page, hkv, d), lambda i, j, li, tab, lens:
+        pl.BlockSpec((1, 1, hkv, page, d), lambda i, j, li, tab, lens:
                      (li[0], tab[i, page_idx(i, j, lens)], 0, 0, 0)),
     ]
     args = [li, table_p, lengths, q, pool_k, pool_v]

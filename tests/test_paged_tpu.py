@@ -40,10 +40,14 @@ def test_manual_kernel_bf16_matches_reference():
     from skypilot_tpu.ops.paged_attention import paged_decode_attention
     L, n_pages, page, hkv, d, hq, slots = 2, 9, 64, 2, 128, 4, 3
     ks = jax.random.split(jax.random.PRNGKey(0), 3)
-    pool_k = jax.random.normal(ks[0], (L, n_pages, page, hkv, d),
-                               jnp.float32).astype(jnp.bfloat16)
-    pool_v = jax.random.normal(ks[1], (L, n_pages, page, hkv, d),
-                               jnp.float32).astype(jnp.bfloat16)
+    # Reference data is token-major [.., page, hkv, d]; the pool stores
+    # pages head-major [.., hkv, page, d].
+    kt = jax.random.normal(ks[0], (L, n_pages, page, hkv, d),
+                           jnp.float32).astype(jnp.bfloat16)
+    vt = jax.random.normal(ks[1], (L, n_pages, page, hkv, d),
+                           jnp.float32).astype(jnp.bfloat16)
+    pool_k = jnp.swapaxes(kt, 2, 3)
+    pool_v = jnp.swapaxes(vt, 2, 3)
     q = jax.random.normal(ks[2], (slots, hq, d), jnp.float32)
     table = jnp.array([[1, 2, 3, 4], [5, 6, 0, 0], [7, 8, 0, 0]],
                       jnp.int32)
@@ -52,8 +56,8 @@ def test_manual_kernel_bf16_matches_reference():
         lambda q, pk, pv: paged_decode_attention(
             q, pk, pv, table, lengths, layer=1))(q, pool_k, pool_v)
     acc, m = np.asarray(acc), np.asarray(m)
-    kd = np.asarray(pool_k[1], np.float32)
-    vd = np.asarray(pool_v[1], np.float32)
+    kd = np.asarray(kt[1], np.float32)
+    vd = np.asarray(vt[1], np.float32)
     for s in range(2):
         m_ref, out_ref = _reference(q, kd, vd, table, lengths, page, s)
         got = acc[s] * np.exp(m[s] - m_ref)[:, None]
@@ -76,16 +80,19 @@ def test_manual_kernel_int8_matches_reference():
         return (jnp.clip(jnp.round(x / s), -127, 127).astype(jnp.int8),
                 s[..., 0])
 
-    pk, sk = q8(kf)
+    pk, sk = q8(kf)                    # token-major codes + scales
     pv, sv = q8(vf)
     q = jax.random.normal(ks[2], (slots, hq, d), jnp.float32)
     table = jnp.array([[1, 2, 3, 4], [5, 6, 0, 0], [7, 8, 0, 0]],
                       jnp.int32)
     lengths = jnp.array([400, 140, 0], jnp.int32)
+    # Pool layout is head-major: codes [.., hkv, page, d], scales
+    # [.., hkv, page].
     acc, m, l = jax.jit(
         lambda q, pk, pv, skt, svt: paged_decode_attention(
             q, pk, pv, table, lengths, skt, svt, layer=1))(
-        q, pk, pv, jnp.swapaxes(sk, -1, -2), jnp.swapaxes(sv, -1, -2))
+        q, jnp.swapaxes(pk, 2, 3), jnp.swapaxes(pv, 2, 3),
+        jnp.swapaxes(sk, -1, -2), jnp.swapaxes(sv, -1, -2))
     acc, m = np.asarray(acc), np.asarray(m)
     kd = np.asarray(pk[1], np.float32) * np.asarray(sk[1],
                                                     np.float32)[..., None]
