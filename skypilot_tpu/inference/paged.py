@@ -219,6 +219,7 @@ def paged_decode_horizon(
     rngs: Optional[jax.Array] = None,
     active: Optional[jax.Array] = None,
     decode_impl: str = 'gather',       # 'gather' | 'pallas'
+    pages_per_block: int = 1,          # pallas path: K pages per DMA loop
 ):
     """``horizon`` fused decode steps over the paged pool — the twin of
     ``llama.decode_horizon`` with the contiguous cache read replaced by
@@ -274,7 +275,8 @@ def paged_decode_horizon(
                 def attn_fn(q, k, v):
                     partial = paged_decode_attention(
                         q[:, 0], pool_k, pool_v, table_p, len0,
-                        ks_pool, vs_pool, layer=li, interpret=interp)
+                        ks_pool, vs_pool, layer=li, interpret=interp,
+                        pages_per_block=pages_per_block)
                     return merge_partial_with_ring_self(
                         partial, q, k, v, rk, rv, i)
             else:
@@ -540,7 +542,8 @@ class PagedInferenceEngine(_EngineBase):
                  quantize: Optional[str] = None,
                  donate_params: bool = False,
                  decode_impl: str = 'auto',
-                 prefill_w8a8: bool = False):
+                 prefill_w8a8: bool = False,
+                 pages_per_block: int = 1):
         from skypilot_tpu.inference.engine import prepare_params
         from skypilot_tpu.parallel import mesh as mesh_lib
         self.max_batch = max_batch
@@ -552,6 +555,14 @@ class PagedInferenceEngine(_EngineBase):
         # Opt-in W8A8 prefill (int8 activations on the compute-bound
         # chunk prefill; decode unaffected) — see quantization.w8a8_region.
         self.prefill_w8a8 = prefill_w8a8
+        # Pallas decode: K pages DMA'd/computed per loop iteration.
+        # With the kernel's conditional tail-page DMAs reads are
+        # length-exact at ANY K, so K only trades fori_loop/DMA-issue
+        # overhead against double-buffer granularity. Measured on the
+        # 7B int8 at batch 48 (anchor workload, steady): K=1 1790,
+        # K=2 1724, K=4 1625, K=8 1620 tok/s/chip — single-page blocks
+        # win now that no transpose hides in the loop body.
+        self.pages_per_block = pages_per_block
         self._rng = jax.random.PRNGKey(rng_seed)
         self._host_rng = np.random.default_rng(rng_seed)
         cfg, self.params, quantize = prepare_params(
@@ -722,7 +733,8 @@ class PagedInferenceEngine(_EngineBase):
             return paged_decode_horizon(
                 params, cache, table_p, tokens, lengths, cfg,
                 horizon=horizon, sample_fn=sample_fn, rngs=rngs,
-                active=active, decode_impl=decode_impl)
+                active=active, decode_impl=decode_impl,
+                pages_per_block=self.pages_per_block)
 
         merge = jax.jit(merge_ring_into_pool, donate_argnums=(0,))
 

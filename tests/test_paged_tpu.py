@@ -88,19 +88,27 @@ def test_manual_kernel_int8_matches_reference():
     lengths = jnp.array([400, 140, 0], jnp.int32)
     # Pool layout is head-major: codes [.., hkv, page, d], scales
     # [.., hkv, page].
-    acc, m, l = jax.jit(
-        lambda q, pk, pv, skt, svt: paged_decode_attention(
-            q, pk, pv, table, lengths, skt, svt, layer=1))(
-        q, jnp.swapaxes(pk, 2, 3), jnp.swapaxes(pv, 2, 3),
-        jnp.swapaxes(sk, -1, -2), jnp.swapaxes(sv, -1, -2))
-    acc, m = np.asarray(acc), np.asarray(m)
     kd = np.asarray(pk[1], np.float32) * np.asarray(sk[1],
                                                     np.float32)[..., None]
     vd = np.asarray(pv[1], np.float32) * np.asarray(sv[1],
                                                     np.float32)[..., None]
-    for s in range(2):
-        m_ref, out_ref = _reference(q, kd, vd, table, lengths, page, s)
-        got = acc[s] * np.exp(m[s] - m_ref)[:, None]
-        # int8 rounding differs slightly between scale-on-logits
-        # (kernel) and scale-on-k (reference): ~1% of output scale.
-        np.testing.assert_allclose(got, out_ref, rtol=6e-2, atol=6e-2)
+    # K=1 (default, unpredicated DMAs) AND K=4 (multi-page blocks:
+    # lengths 400/140 need 4/2 pages, so the K=4 block has skipped
+    # tail-page DMAs reading zero-initialized scratch — the predicate
+    # + stale-buffer-masking path gets real coverage).
+    for kpb in (1, 4):
+        acc, m, l = jax.jit(
+            lambda q, pk, pv, skt, svt: paged_decode_attention(
+                q, pk, pv, table, lengths, skt, svt, layer=1,
+                pages_per_block=kpb))(
+            q, jnp.swapaxes(pk, 2, 3), jnp.swapaxes(pv, 2, 3),
+            jnp.swapaxes(sk, -1, -2), jnp.swapaxes(sv, -1, -2))
+        acc, m = np.asarray(acc), np.asarray(m)
+        for s in range(2):
+            m_ref, out_ref = _reference(q, kd, vd, table, lengths,
+                                        page, s)
+            got = acc[s] * np.exp(m[s] - m_ref)[:, None]
+            # int8 rounding differs slightly between scale-on-logits
+            # (kernel) and scale-on-k (reference): ~1% of output scale.
+            np.testing.assert_allclose(got, out_ref, rtol=6e-2,
+                                       atol=6e-2, err_msg=f'K={kpb}')
