@@ -347,18 +347,30 @@ def paged_prefill_chunk(
     lengths: jax.Array,                # [n] context already in the pool
     valid: jax.Array,                  # [n] tokens of this chunk in use
     want_idx: jax.Array,               # [n] in-chunk index of the row whose
-                                       #     logits the caller needs (-1: none)
+                                       #     next token the caller needs
+                                       #     (-1: none)
     cfg: ModelConfig,
+    temps: jax.Array = None,           # [n] per-row sampling params
+    topks: jax.Array = None,
+    topps: jax.Array = None,
+    rng: jax.Array = None,
     w8a8: bool = False,
 ):
     """One fixed-size prefill chunk for ``n`` slots: attends against the
     pages written so far (each slot's ``lengths``) plus causal
     self-attention within the chunk, scatters the new rows into the
-    pool, and returns per-slot logits at ``want_idx`` (the sampled
-    first token when the chunk contains the prompt's end).
+    pool, and SAMPLES each completing row's next token ON DEVICE with
+    that row's params (temperature/top-k/top-p; ``engine.
+    sample_tokens`` — greedy rows take temp<=0's argmax path).
 
-    Returns (logits [n, vocab], new cache). ``w8a8`` quantizes the
-    layer-matmul activations per token (prefill is compute-bound; see
+    Returns (first_tokens [n] int32, new cache). Device-side sampling
+    is what lets the engine feed a completing slot's token straight
+    into the device token vector at ENQUEUE time: the slot starts
+    decoding on the very next horizon instead of idling 1-2 pipelined
+    calls for a host logits readback + host sampling (measured: that
+    idle was a double-digit share of sustained-serving slot time once
+    decode itself got fast). ``w8a8`` quantizes the layer-matmul
+    activations per token (prefill is compute-bound; see
     ``quantization.w8a8_region``) — the unembed stays W8A16."""
     n, chunk = tokens.shape
     len0 = lengths
@@ -398,10 +410,19 @@ def paged_prefill_chunk(
     idx = jnp.clip(want_idx, 0, chunk - 1)
     last_x = jnp.take_along_axis(x, idx[:, None, None], axis=1)
     logits = llama._unembed_logits(params, last_x, cfg)[:, 0]
+    # All-greedy batches (the common case) take the argmax path
+    # STATICALLY: sample_tokens sorts the [n, vocab] logits, and a TPU
+    # sort over vocab=32k costs hundreds of ms — compiled into every
+    # admission step, it halved sustained serving before this gate.
+    if temps is None:                      # static: all rows greedy
+        first = jnp.argmax(logits, -1).astype(jnp.int32)
+    else:
+        from skypilot_tpu.inference.engine import sample_tokens
+        first = sample_tokens(logits, rng, temps, topks, topps)
 
     new_cache = merge_rows_into_pool(cache, k_rows, v_rows, table_p,
                                      len0, valid_len=valid)
-    return logits, new_cache
+    return first, new_cache
 
 
 # ---------------------------------------------------------------------------
@@ -531,7 +552,14 @@ class PagedInferenceEngine(_EngineBase):
 
     _PREFILL_N_BUCKETS = (1, 2, 4, 8, 16, 32)
     _HORIZON_BUCKETS = (1, 2, 4, 8, 16, 32, 64, 128)
-    _PREFILL_STACK_BUDGET = int(0.75e9)    # stacked-chunk KV transient
+    # Stacked-chunk KV transient budget. Sized so n_max reaches 16 on a
+    # 7B (chunk 256, ~270 KB/token): with decode at ~1800 tok/s/chip a
+    # 32-step horizon completes ~9.5 req/s, and the old 8-wide chunk
+    # batches (~9.4 admits/s) were the sustained-serving bottleneck —
+    # slots idled waiting on admission while decode ran 2x faster than
+    # round 4. The pool auto-size reserves this same constant, so the
+    # pool shrinks ~0.75 GB (~22 pages) to pay for it.
+    _PREFILL_STACK_BUDGET = int(1.5e9)
     _RING_BYTES_CAP_PAGED = int(512e6)     # see _decode's ring note
 
     def __init__(self, cfg: ModelConfig, params=None, *,
@@ -614,12 +642,20 @@ class PagedInferenceEngine(_EngineBase):
         # admission interleaves its remaining chunks with decode).
         self._prefill_off: Dict[int, int] = {}
         # Extra async-pipeline state beyond _EngineBase's (_tok_dev /
-        # _pending live there): slots whose prefill-completion logits
-        # are still in flight sit in _await_first (their first token is
-        # sampled HOST-side with the request's params at _process_one,
-        # then scattered into the device token vector).
+        # _pending live there): slots whose DEVICE-sampled first token
+        # hasn't surfaced to the host yet sit in _await_first. They
+        # DECODE meanwhile (the token merged into the device token
+        # vector at prefill enqueue); membership only gates the
+        # first-token event + finish bookkeeping, and the preemption
+        # path drains the pipeline before acting so requeued contexts
+        # stay complete.
         self._await_first: set = set()
         self._slot_inflight = np.zeros(max_batch, np.int64)
+        # Fixed-shape first-token merge: padding entries scatter to the
+        # out-of-range sentinel max_batch and are dropped.
+        self._merge_tokens_drop = jax.jit(
+            lambda tok, slots, vals: tok.at[slots].set(vals,
+                                                       mode='drop'))
         # Bumped when a slot is freed: an in-flight call enqueued for a
         # previous occupant must not decrement the NEW occupant's
         # inflight count at processing time.
@@ -628,9 +664,10 @@ class PagedInferenceEngine(_EngineBase):
         self._decode_fn = self._build_decode()
         self._prefill_fns: Dict[Tuple[int, int], Any] = {}
         # A prefill chunk-batch stacks [L, n, chunk] KV rows as a scan
-        # transient; cap n so that stack stays ~<=0.75 GB (at n=32 x
-        # chunk=256 on a 7B the two stacks alone are 2 GB — the compile
-        # OOM'd the chip). _auto_n_pages reserves the same budget.
+        # transient; cap n so the stack stays within
+        # _PREFILL_STACK_BUDGET (at n=32 x chunk=256 on a 7B the two
+        # stacks alone are 2 GB — the compile OOM'd the chip).
+        # _auto_n_pages reserves the same budget.
         tok_bytes = self._page_bytes(self.cfg, 1, self.cache.quantized)
         n_fit = int(self._PREFILL_STACK_BUDGET // max(1, chunk *
                                                       tok_bytes))
@@ -750,18 +787,19 @@ class PagedInferenceEngine(_EngineBase):
 
         return decode_and_merge
 
-    def _get_prefill(self, n: int, P: int):
-        key = (n, P)
+    def _get_prefill(self, n: int, P: int, sample: bool):
+        key = (n, P, sample)
         if key not in self._prefill_fns:
             cfg = self.cfg
             w8a8 = self.prefill_w8a8
 
             @functools.partial(jax.jit, donate_argnums=(1,))
             def prefill(params, cache, table_p, tokens, lengths, valid,
-                        want_idx):
-                return paged_prefill_chunk(params, cache, table_p,
-                                           tokens, lengths, valid,
-                                           want_idx, cfg, w8a8=w8a8)
+                        want_idx, temps, topks, topps, rng):
+                return paged_prefill_chunk(
+                    params, cache, table_p, tokens, lengths, valid,
+                    want_idx, cfg, temps=temps if sample else None,
+                    topks=topks, topps=topps, rng=rng, w8a8=w8a8)
 
             self._prefill_fns[key] = prefill
         return self._prefill_fns[key]
@@ -826,30 +864,6 @@ class PagedInferenceEngine(_EngineBase):
         self._slot_epoch[slot] += 1
         super()._free_slot(slot)
 
-    def _sample_host(self, logits: np.ndarray, req) -> int:
-        """Sample the prefill-completion token with the REQUEST's
-        sampling params (greedy when temperature<=0). Matters twice:
-        the first token of a sampled request, and — after a
-        pool-pressure preemption — the RESUMED token of a sampled
-        request mid-stream (an argmax there would silently collapse
-        that token's distribution to greedy)."""
-        if req.temperature <= 0:
-            return int(np.argmax(logits))
-        scaled = logits.astype(np.float64) / max(req.temperature, 1e-6)
-        if req.top_k and req.top_k > 0:
-            kth = np.partition(scaled, -req.top_k)[-req.top_k]
-            scaled = np.where(scaled >= kth, scaled, -np.inf)
-        if req.top_p < 1.0:
-            order = np.argsort(-scaled)
-            probs = np.exp(scaled[order] - np.max(scaled))
-            probs /= probs.sum()
-            keep_mass = np.cumsum(probs) - probs < req.top_p
-            drop = order[~keep_mass]
-            scaled[drop] = -np.inf
-        probs = np.exp(scaled - np.max(scaled))
-        probs /= probs.sum()
-        return int(self._host_rng.choice(len(probs), p=probs))
-
     def _preempt_slot(self, slot: int) -> None:
         """Pool pressure: push a live request back to the FRONT of the
         queue, releasing its pages. It re-enters through _assign_slots
@@ -904,10 +918,11 @@ class PagedInferenceEngine(_EngineBase):
 
     def _prefill_chunk_batch(self) -> List[Tuple[int, int, bool]]:
         """One fixed-size chunk across up to a compiled n-bucket of
-        mid-prefill slots. ALWAYS returns [] — slots whose prompt
-        completes this chunk wait in ``_await_first``; their first
-        token is sampled host-side when the logits surface in
-        ``_process_one``, up to ``_PIPELINE_DEPTH`` calls later."""
+        mid-prefill slots. ALWAYS returns [] — completing slots'
+        first tokens are sampled ON DEVICE (per-request params) and
+        merged into the device token vector before this returns, so
+        they decode next horizon; the first-token EVENT surfaces via
+        ``_process_one`` up to ``_PIPELINE_DEPTH`` calls later."""
         pending = sorted(self._prefill_off)
         if not pending:
             return []
@@ -940,17 +955,44 @@ class PagedInferenceEngine(_EngineBase):
         for i, slot in enumerate(batch):
             ps = self._pages[slot][:P]
             table_p[i, :len(ps)] = ps
-        prefill = self._get_prefill(n, P)
-        logits, self.cache = prefill(
-            self.params, self.cache, jnp.asarray(table_p),
-            jnp.asarray(tokens), jnp.asarray(lengths),
-            jnp.asarray(valid), jnp.asarray(want))
+        # Per-row sampling params: completing rows sample their first
+        # token ON DEVICE inside the prefill program (padding and
+        # mid-prompt rows run greedy on garbage logits — discarded).
+        temps = np.zeros(n, np.float32)
+        topks = np.zeros(n, np.int32)
+        topps = np.ones(n, np.float32)
+        for i, slot in enumerate(batch):
+            req = self._slots[slot]
+            temps[i] = req.temperature
+            topks[i] = req.top_k or 0
+            topps[i] = req.top_p
+        self._rng, prng = jax.random.split(self._rng)   # device op
+        # ONE batched host->device transfer for every host-built
+        # operand: each separate jnp.asarray is its own dispatch round
+        # trip (~100-600 ms through the remote tunnel) — nine of them
+        # measured as multi-second admission spikes that halved
+        # sustained throughput.
+        (table_d, tokens_d, lengths_d, valid_d, want_d, temps_d,
+         topks_d, topps_d) = jax.device_put(
+            (table_p, tokens, lengths, valid, want, temps, topks,
+             topps))
+        # Sampling variant only when a row COMPLETING this chunk needs
+        # it: sample_tokens sorts the [n, vocab] logits (hundreds of ms
+        # on TPU at vocab 32k) — mid-prompt chunks and greedy
+        # completions must not pay it.
+        sample = any(self._slots[s].temperature > 0
+                     for i, s in enumerate(batch) if want[i] >= 0)
+        prefill = self._get_prefill(n, P, sample)
+        first, self.cache = prefill(
+            self.params, self.cache, table_d, tokens_d, lengths_d,
+            valid_d, want_d, temps_d, topks_d, topps_d, prng)
         self.chunks_prefilled += 1
         # Async: host bookkeeping advances NOW (the device writes are
-        # program-ordered); the logits readback + first-token sampling
-        # ride the pipeline (_process_one). Slots that completed their
-        # prompt this chunk wait in _await_first until their sampled
-        # token lands in the device token vector.
+        # program-ordered). Completing slots' sampled tokens merge into
+        # the device token vector IMMEDIATELY (device-to-device, no
+        # sync) so they decode on the very next horizon; _await_first
+        # now gates only the first-token EVENT (host readback of the
+        # token value rides the pipeline).
         done_rows: List[Tuple[int, int]] = []    # (row i, slot)
         for i, slot in enumerate(batch):
             req = self._slots[slot]
@@ -964,8 +1006,23 @@ class PagedInferenceEngine(_EngineBase):
                                        req._n_matched)
             done_rows.append((i, slot))
         if done_rows:
+            # FIXED [n] shapes for the token gather + merge: a
+            # len(done_rows)-shaped array would compile a fresh tiny
+            # gather/scatter program per distinct count (measured:
+            # ~0.9 s per remote compile, dozens across a serving run —
+            # the dominant admission cost). Padding rows point at row
+            # 0 and scatter to the out-of-range sentinel max_batch,
+            # which mode='drop' discards.
+            rows_p = np.zeros(n, np.int32)
+            slots_p = np.full(n, self.max_batch, np.int32)
+            for j, (i, slot) in enumerate(done_rows):
+                rows_p[j], slots_p[j] = i, slot
+            rows_d, slots_d = jax.device_put((rows_p, slots_p))
+            self._tok_dev = self._merge_tokens_drop(
+                self._tok_dev, slots_d, jnp.take(first, rows_d))
+            self._meta_dirty = True          # slots become decodable
             self._pending.append({
-                'kind': 'prefill', 'toks': logits,
+                'kind': 'prefill', 'toks': first,
                 'batch': [(slot, self._slots[slot], i)
                           for i, slot in done_rows]})
         return []
@@ -998,10 +1055,12 @@ class PagedInferenceEngine(_EngineBase):
 
     # ---------------------------------------------------------- decode
     def _enqueue_decode(self, horizon: int = 1) -> bool:
+        # _await_first slots DO decode: their device-sampled first
+        # token was merged into the token vector at prefill enqueue;
+        # only the first-token EVENT is still in flight.
         active_slots = [s for s in range(self.max_batch)
                         if self._slots[s] is not None
-                        and s not in self._prefill_off
-                        and s not in self._await_first]
+                        and s not in self._prefill_off]
         if not active_slots:
             return False
         cap = int(self.max_seq - 1 -
@@ -1073,8 +1132,7 @@ class PagedInferenceEngine(_EngineBase):
             if victim in active_slots:
                 active_slots.remove(victim)
 
-        ready = [r if (s not in self._prefill_off
-                       and s not in self._await_first) else None
+        ready = [r if s not in self._prefill_off else None
                  for s, r in enumerate(self._slots)]
         temps_d, topks_d, topps_d, active_d, sample = \
             self._slot_meta(ready)
@@ -1091,9 +1149,10 @@ class PagedInferenceEngine(_EngineBase):
         # Device-truth lengths at this call = processed + in-flight.
         lengths = (self._slot_len + self._slot_inflight).astype(np.int32)
         self._rng, rng = jax.random.split(self._rng)
+        table_dd, lengths_dd = jax.device_put((table_p, lengths))
         toks, self.cache = self._decode_fn(
-            self.params, self.cache, jnp.asarray(table_p),
-            self._tok_dev, jnp.asarray(lengths), rng,
+            self.params, self.cache, table_dd,
+            self._tok_dev, lengths_dd, rng,
             temps_d, topks_d, topps_d, active_d, horizon, sample)
         self._tok_dev = toks[:, -1]
         for s in range(self.max_batch):
@@ -1107,34 +1166,27 @@ class PagedInferenceEngine(_EngineBase):
 
     def _process_one(self) -> List[Tuple[int, int, bool]]:
         """Sync the oldest in-flight call into events. Prefill entries
-        carry completion LOGITS: the first token is sampled host-side
-        with the request's params (see _sample_host) and scattered into
-        the device token vector, unblocking the slot for decode."""
+        carry the DEVICE-sampled first tokens (already merged into the
+        device token vector at enqueue — the slot has been decoding
+        since the next horizon); this readback only surfaces the token
+        VALUE for the first-token event, host bookkeeping, and finish
+        checks."""
         events: List[Tuple[int, int, bool]] = []
         entry = self._pending.popleft()
         vals = np.asarray(entry['toks'])
         now = time.time()
         if entry['kind'] == 'prefill':
-            toks_new, slots_new = [], []
             for slot, req, row in entry['batch']:
                 if req.finish_time is not None \
                         or self._slots[slot] is not req:
                     continue                     # cancelled/preempted
-                token = self._sample_host(vals[row], req)
+                token = int(vals[row])
                 self._await_first.discard(slot)
-                self._meta_dirty = True      # slot becomes decodable
                 if req.first_token_time is None:  # not on re-admission
                     req.first_token_time = now
                 req.output.append(token)
                 finished = self._maybe_finish(slot, token)
                 events.append((req.request_id, token, finished))
-                if not finished:
-                    toks_new.append(token)
-                    slots_new.append(slot)
-            if slots_new:
-                self._tok_dev = self._merge_tokens(
-                    self._tok_dev, jnp.asarray(slots_new, jnp.int32),
-                    jnp.asarray(toks_new, jnp.int32))
             return events
         for slot, req in enumerate(entry['snapshot']):
             if req is None:
