@@ -60,6 +60,11 @@ class Request:
     submit_time: float = 0.0
     first_token_time: Optional[float] = None
     finish_time: Optional[float] = None
+    # Paged-engine early slot recycle: output tokens covered by
+    # ENQUEUED device calls, and whether the slot was freed before the
+    # request's tail tokens surfaced through the async pipeline.
+    _enq_out: int = 0
+    _early_freed: bool = False
 
     @property
     def ttft_ms(self) -> Optional[float]:
@@ -318,7 +323,15 @@ class _EngineBase:
         self._meta_dirty = True      # async engines re-upload slot meta
 
     def _maybe_finish(self, slot: int, token: int) -> bool:
-        req = self._slots[slot]
+        return self._finish_req(slot, self._slots[slot], token)
+
+    def _finish_req(self, slot: int, req, token: int) -> bool:
+        """Request-scoped finish check. Distinct from _maybe_finish so
+        the paged engine's EARLY-RECYCLED tenancies (slot already freed
+        or re-assigned, tail tokens still surfacing through the async
+        pipeline) can finish their request without touching whoever
+        holds the slot now — it is only freed when ``req`` still owns
+        it."""
         # Stop sequences first: a stop completing exactly on the
         # max_new_tokens/max_seq boundary must still be trimmed.
         done = False
@@ -336,7 +349,8 @@ class _EngineBase:
         if done:
             req.finish_time = time.time()
             self._finished[req.request_id] = req
-            self._free_slot(slot)
+            if self._slots[slot] is req:
+                self._free_slot(slot)
         return done
 
 

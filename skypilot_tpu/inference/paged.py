@@ -560,7 +560,14 @@ class PagedInferenceEngine(_EngineBase):
     # round 4. The pool auto-size reserves this same constant, so the
     # pool shrinks ~0.75 GB (~22 pages) to pay for it.
     _PREFILL_STACK_BUDGET = int(1.5e9)
-    _RING_BYTES_CAP_PAGED = int(512e6)     # see _decode's ring note
+    # Ring-buffer byte cap. At batch 48 on a 7B this admits horizon 32
+    # (ring 1.6 GB, k+v): _auto_n_pages reserves 2*row*h_max so the
+    # pool shrinks to pay for it — a LONGER horizon halves the
+    # admission interleaves and fixed per-call costs per token, which
+    # measured as ~40% of sustained-serving device time at h=16. (The
+    # old 512 MB cap predates the reserve accounting: h=32 at batch 48
+    # OOM'd when the pool was sized ignoring the ring.)
+    _RING_BYTES_CAP_PAGED = int(1.7e9)
 
     def __init__(self, cfg: ModelConfig, params=None, *,
                  max_batch: int = 8, max_seq: int = 1024,
@@ -656,6 +663,12 @@ class PagedInferenceEngine(_EngineBase):
         self._merge_tokens_drop = jax.jit(
             lambda tok, slots, vals: tok.at[slots].set(vals,
                                                        mode='drop'))
+        # Early-recycled requests whose tail tokens are still in the
+        # pipeline: in neither _queue nor _slots, so has_work() and
+        # cancel() must consult this registry (a serve loop that slept
+        # on queue+slots alone stranded the final tokens forever, and
+        # a disconnecting client's request leaked uncancellable).
+        self._lagging: Dict[int, Any] = {}
         # Bumped when a slot is freed: an in-flight call enqueued for a
         # previous occupant must not decrement the NEW occupant's
         # inflight count at processing time.
@@ -704,18 +717,41 @@ class PagedInferenceEngine(_EngineBase):
             limit = stats['bytes_limit']
             used = stats['bytes_in_use']
         except Exception:  # pylint: disable=broad-except
-            return parity
+            # memory_stats is unavailable through some PJRT transports
+            # (observed: the remote-tunnel TPU backend returns none —
+            # and the silent parity fallback left a 7B serving config
+            # at 241 pages with an UNRESERVED ring: horizon 32 OOM'd).
+            # Fall back to the static per-generation HBM table; the
+            # usable fraction matches the observed bytes_limit/total
+            # on a v5e (15.75/16 GB).
+            limit = used = None
+            if jax.default_backend() == 'tpu':
+                from skypilot_tpu.accelerators import TPU_GENERATIONS
+                kind = jax.devices()[0].device_kind.lower()
+                for gen in TPU_GENERATIONS.values():
+                    gen_key = (gen.name.replace('e', ' lite')
+                               if gen.name.endswith('e') else gen.name)
+                    if gen.name in kind or gen_key in kind:
+                        limit = int(gen.hbm_gb_per_chip * 0.984e9)
+                        used = 0          # floor applied below
+            if limit is None:
+                return parity
         # bytes_in_use can lag async transfers (observed right after the
         # parallel checkpoint puts: the pool then oversized by ~3 GB and
         # decode OOM'd at runtime); the weights are a known floor —
         # PER DEVICE (a tp-sharded tree spreads over mesh.size chips).
         n_dev = self.mesh.size if self.mesh is not None else 1
         used = max(used, self._param_bytes // n_dev + int(0.3e9))
-        # The reserve must cover the decode transients, dominated by
-        # the fused-horizon ring (model-dtype rows re-read every step)
-        # at the LONGEST horizon the ring budget allows — sizing the
-        # pool without it compiled programs 1.5 GB past HBM at
-        # batch=48 on a 7B.
+        # The reserve must cover the decode transients at the LONGEST
+        # horizon the ring budget allows — sizing the pool without
+        # them compiled programs past HBM at batch=48 on a 7B. The
+        # ring (decode program) and the stacked prefill-chunk KV
+        # (prefill program) are transients of DIFFERENT programs and
+        # never peak together, so the reserve takes their MAX on top
+        # of a fixed workspace: summing them shrank a 7B pool to 65
+        # pages (2.2 GB) where 170 pages ran h=32 clean — the
+        # empirically-safe reserve on that config is ~3.1 GB. h_max
+        # rounds DOWN to the horizon bucket decode will actually pick.
         from skypilot_tpu.inference.engine import (_ring_horizon_cap,
                                                    _ring_row_bytes)
         row = _ring_row_bytes(cfg, max_batch)
@@ -723,8 +759,10 @@ class PagedInferenceEngine(_EngineBase):
                     _ring_horizon_cap(cfg, max_batch,
                                       self._param_bytes),
                     max(8, self._RING_BYTES_CAP_PAGED // row))
-        reserve = (int(1.6e9) + 2 * row * h_max +
-                   self._PREFILL_STACK_BUDGET)
+        h_max = next((b for b in reversed(self._HORIZON_BUCKETS)
+                      if b <= h_max), 8)
+        reserve = (int(1.6e9) + max(2 * row * h_max,
+                                    self._PREFILL_STACK_BUDGET))
         page_bytes = self._page_bytes(cfg, page_size, quantized)
         fit = max(0, (limit - used - reserve)) // page_bytes
         # Take what fits, capped at 4x slot parity (prefix-cache
@@ -787,8 +825,9 @@ class PagedInferenceEngine(_EngineBase):
 
         return decode_and_merge
 
-    def _get_prefill(self, n: int, P: int, sample: bool):
-        key = (n, P, sample)
+    def _get_prefill(self, n: int, P: int, sample: bool,
+                     chunk_w: Optional[int] = None):
+        key = (n, P, sample, chunk_w or self.chunk)
         if key not in self._prefill_fns:
             cfg = self.cfg
             w8a8 = self.prefill_w8a8
@@ -864,6 +903,51 @@ class PagedInferenceEngine(_EngineBase):
         self._slot_epoch[slot] += 1
         super()._free_slot(slot)
 
+    def has_work(self) -> bool:
+        self._purge_lagging()
+        return super().has_work() or bool(self._lagging)
+
+    def cancel(self, request_id: int) -> bool:
+        if super().cancel(request_id):
+            return True
+        req = self._lagging.pop(request_id, None)
+        if req is not None and req.finish_time is None:
+            # Early-recycled: the slot/pages are already released; the
+            # pipeline's remaining tail tokens are dropped at readback
+            # by the finish_time check. NOT recorded as finished —
+            # same contract as a slot cancel.
+            req.finish_time = time.time()
+            return True
+        return False
+
+    def _purge_lagging(self) -> None:
+        if self._lagging:
+            for rid in [rid for rid, r in self._lagging.items()
+                        if r.finish_time is not None]:
+                del self._lagging[rid]
+
+    def _maybe_early_free(self, slot: int, req) -> None:
+        """Recycle the slot the moment the request's whole output is
+        covered by ENQUEUED device calls. Only budget-bound requests
+        qualify — stop sequences / eos make completion data-dependent,
+        so those free at readback like before. The tail tokens surface
+        later through the pipeline (entries hold the request object;
+        ``_finish_req`` never touches a recycled slot), and the pages
+        released here are only ever re-written by programs enqueued
+        AFTER the in-flight reads/merges — the single device stream
+        orders them. Without this, a finished slot decoded garbage for
+        ~PIPELINE_DEPTH more horizons and then idled until readback:
+        measured at 1790 tok/s steady, that waste held the sustained
+        token YIELD (counted / issued slot-steps) at 0.44."""
+        if req.stop or req.eos_id is not None or req._early_freed:
+            return
+        budget = min(req.max_new_tokens,
+                     max(1, self.max_seq - len(req.prompt)))
+        if req._enq_out >= budget:
+            req._early_freed = True
+            self._lagging[req.request_id] = req
+            self._free_slot(slot)
+
     def _preempt_slot(self, slot: int) -> None:
         """Pool pressure: push a live request back to the FRONT of the
         queue, releasing its pages. It re-enters through _assign_slots
@@ -928,7 +1012,21 @@ class PagedInferenceEngine(_EngineBase):
             return []
         batch = pending[:self._prefill_n_max]
         n = next(b for b in self._PREFILL_N_BUCKETS if b >= len(batch))
-        tokens = np.zeros((n, self.chunk), np.int32)
+        # Chunk-width variant: when every pending piece fits 128
+        # tokens (the common case with a prefix-cache hit — e.g. a
+        # 220-token prompt whose first page is cached leaves a <=92
+        # token tail), the half-width program does half the prefill
+        # FLOPs. Mixed batches fall back to the full chunk. Pure
+        # arithmetic — no tail slicing here (a list copy per slot per
+        # chunk made long-prompt prefill O(len^2/chunk) host work).
+        rest_max = max(
+            len(self._slots[s]._ctx)
+            - self._slots[s]._n_matched * self.page
+            - self._prefill_off[s]
+            for s in batch)
+        chunk_w = (128 if self.chunk > 128 and rest_max <= 128
+                   else self.chunk)
+        tokens = np.zeros((n, chunk_w), np.int32)
         lengths = np.zeros(n, np.int32)
         valid = np.zeros(n, np.int32)
         want = np.full(n, -1, np.int32)
@@ -938,7 +1036,7 @@ class PagedInferenceEngine(_EngineBase):
             req = self._slots[slot]
             tail = req._ctx[req._n_matched * self.page:]
             off = self._prefill_off[slot]
-            piece = tail[off:off + self.chunk]
+            piece = tail[off:off + chunk_w]
             pieces.append(piece)
             lengths[i] = self._slot_len[slot]
             tokens[i, :len(piece)] = piece
@@ -982,7 +1080,7 @@ class PagedInferenceEngine(_EngineBase):
         # completions must not pay it.
         sample = any(self._slots[s].temperature > 0
                      for i, s in enumerate(batch) if want[i] >= 0)
-        prefill = self._get_prefill(n, P, sample)
+        prefill = self._get_prefill(n, P, sample, chunk_w)
         first, self.cache = prefill(
             self.params, self.cache, table_d, tokens_d, lengths_d,
             valid_d, want_d, temps_d, topks_d, topps_d, prng)
@@ -1025,6 +1123,11 @@ class PagedInferenceEngine(_EngineBase):
                 'kind': 'prefill', 'toks': first,
                 'batch': [(slot, self._slots[slot], i)
                           for i, slot in done_rows]})
+            for i, slot in done_rows:
+                req = self._slots[slot]
+                # re-admission resumes with output already present
+                req._enq_out = len(req.output) + 1
+                self._maybe_early_free(slot, req)
         return []
 
     def step(self, horizon: int = 1) -> List[Tuple[int, int, bool]]:
@@ -1158,6 +1261,8 @@ class PagedInferenceEngine(_EngineBase):
         for s in range(self.max_batch):
             if ready[s] is not None:
                 self._slot_inflight[s] += horizon
+                ready[s]._enq_out += horizon
+                self._maybe_early_free(s, ready[s])
         self._pending.append({'kind': 'decode', 'toks': toks,
                               'horizon': horizon,
                               'snapshot': list(ready),
@@ -1177,15 +1282,18 @@ class PagedInferenceEngine(_EngineBase):
         now = time.time()
         if entry['kind'] == 'prefill':
             for slot, req, row in entry['batch']:
-                if req.finish_time is not None \
-                        or self._slots[slot] is not req:
+                if req.finish_time is not None:
+                    continue
+                tenant = self._slots[slot] is req
+                if not tenant and not req._early_freed:
                     continue                     # cancelled/preempted
                 token = int(vals[row])
-                self._await_first.discard(slot)
+                if tenant:
+                    self._await_first.discard(slot)
                 if req.first_token_time is None:  # not on re-admission
                     req.first_token_time = now
                 req.output.append(token)
-                finished = self._maybe_finish(slot, token)
+                finished = self._finish_req(slot, req, token)
                 events.append((req.request_id, token, finished))
             return events
         for slot, req in enumerate(entry['snapshot']):
@@ -1194,13 +1302,17 @@ class PagedInferenceEngine(_EngineBase):
             if entry['epochs'][slot] == self._slot_epoch[slot]:
                 self._slot_inflight[slot] = max(
                     0, self._slot_inflight[slot] - entry['horizon'])
-            if req.finish_time is not None or self._slots[slot] is not req:
+            if req.finish_time is not None:
                 continue
+            tenant = self._slots[slot] is req
+            if not tenant and not req._early_freed:
+                continue                         # cancelled/preempted
             for i in range(entry['horizon']):
                 token = int(vals[slot, i])
                 req.output.append(token)
-                self._slot_len[slot] += 1
-                finished = self._maybe_finish(slot, token)
+                if tenant:
+                    self._slot_len[slot] += 1
+                finished = self._finish_req(slot, req, token)
                 events.append((req.request_id, token, finished))
                 if finished:
                     break
