@@ -619,6 +619,12 @@ class PagedInferenceEngine(_EngineBase):
         from skypilot_tpu.models import quantization
         self._param_bytes = quantization.quantized_bytes(self.params)
 
+        # Auto-sized pools reserve HBM for the long-horizon ring (see
+        # _auto_n_pages); an EXPLICIT n_pages made no such bargain, so
+        # its ring budget stays at the conservative cap — a user pool
+        # sized to fill HBM under the old 512 MB assumption must not
+        # suddenly meet a 3x ring at runtime.
+        self._pool_auto_sized = n_pages is None
         if n_pages is None:
             n_pages = self._auto_n_pages(cfg, max_batch, max_seq,
                                          page_size)
@@ -1174,14 +1180,18 @@ class PagedInferenceEngine(_EngineBase):
         horizon = max(1, min(horizon, cap))
         from skypilot_tpu.inference.engine import (_ring_horizon_cap,
                                                    _ring_row_bytes)
-        # Tighter ring budget than the slot engine: the pool + params
-        # already fill most of HBM at capacity-stretch batches, and the
-        # decode scan can double-buffer the ring carry (h=32 at batch
-        # 48 on a 7B OOM'd at runtime where h=16 ran).
+        # Ring budget: auto-sized pools reserved HBM for the full
+        # _RING_BYTES_CAP_PAGED ring (see _auto_n_pages — horizon 32
+        # on the 7B config), so they take it; explicit pools keep the
+        # historical conservative 512 MB cap, since nothing shrank
+        # them to pay for a bigger ring (h=32 at batch 48 on a 7B
+        # OOM'd at runtime against a full-HBM pool where h=16 ran).
         row = _ring_row_bytes(self.cfg, self.max_batch)
+        ring_bytes = (self._RING_BYTES_CAP_PAGED
+                      if self._pool_auto_sized else int(512e6))
         ring_cap = min(_ring_horizon_cap(self.cfg, self.max_batch,
                                          self._param_bytes),
-                       max(8, self._RING_BYTES_CAP_PAGED // row))
+                       max(8, ring_bytes // row))
         horizon = min(horizon, ring_cap)
         for b in reversed(self._HORIZON_BUCKETS):
             if b <= horizon:
@@ -1258,6 +1268,13 @@ class PagedInferenceEngine(_EngineBase):
             self._tok_dev, lengths_dd, rng,
             temps_d, topks_d, topps_d, active_d, horizon, sample)
         self._tok_dev = toks[:, -1]
+        # Snapshot the epochs BEFORE any early free below bumps them:
+        # the entry must record the epochs its tokens were produced
+        # under, or a recycled slot's stale entry would pass the epoch
+        # check at readback and decrement the NEW tenant's in-flight
+        # count (understated lengths -> decode overwrites in-flight KV
+        # positions).
+        epochs = self._slot_epoch.copy()
         for s in range(self.max_batch):
             if ready[s] is not None:
                 self._slot_inflight[s] += horizon
@@ -1266,7 +1283,7 @@ class PagedInferenceEngine(_EngineBase):
         self._pending.append({'kind': 'decode', 'toks': toks,
                               'horizon': horizon,
                               'snapshot': list(ready),
-                              'epochs': self._slot_epoch.copy()})
+                              'epochs': epochs})
         return True
 
     def _process_one(self) -> List[Tuple[int, int, bool]]:

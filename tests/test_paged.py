@@ -284,3 +284,53 @@ class TestContinuousAdmission:
             # (recompute); the final output must be a SUFFIX of the
             # stream and every output token must have been streamed.
             assert streamed[-len(out):] == out
+
+
+class TestEarlyRecycle:
+    """Host-known completion frees slots at ENQUEUE: a budget-bound
+    request's slot recycles while its tail tokens are still riding the
+    async pipeline. These pin the lifecycle contracts around that
+    window (the serve loop and disconnecting clients both hit it)."""
+
+    def _engine(self, cfg, params):
+        return PagedInferenceEngine(cfg, params, max_batch=2,
+                                    max_seq=256, page_size=8,
+                                    n_pages=32, decode_impl='gather')
+
+    def test_lagging_tail_tokens_surface(self, setup):
+        cfg, params = setup
+        eng = self._engine(cfg, params)
+        rid = eng.add_request([1, 2, 3, 4] * 3, max_new_tokens=4)
+        eng.step(horizon=8)            # prefill + covering decode call
+        # Budget covered at enqueue: slot freed, tail still in flight.
+        assert all(r is None for r in eng._slots)
+        assert eng._pending
+        assert eng.has_work()          # lagging request keeps it awake
+        done = eng.run_to_completion(horizon=8)
+        assert len(done[rid].output) == 4
+        assert not eng.has_work() and not eng._lagging
+
+    def test_cancel_in_recycle_window(self, setup):
+        cfg, params = setup
+        eng = self._engine(cfg, params)
+        rid = eng.add_request([5, 6, 7, 8] * 3, max_new_tokens=4)
+        eng.step(horizon=8)
+        assert all(r is None for r in eng._slots)
+        # Early-freed but unfinished: cancel must still find it (a
+        # disconnecting client in this window once leaked the request
+        # into _finished forever).
+        assert eng.cancel(rid) is True
+        eng.run_to_completion(horizon=8)
+        assert eng.get_finished(rid) is None
+        assert not eng.has_work() and not eng._lagging
+
+    def test_stop_sequences_disable_early_free(self, setup):
+        cfg, params = setup
+        eng = self._engine(cfg, params)
+        rid = eng.add_request([1, 2] * 4, max_new_tokens=4,
+                              stop=[[99999]])
+        eng.step(horizon=8)
+        # Completion is data-dependent: the slot must NOT recycle early.
+        assert any(r is not None for r in eng._slots)
+        done = eng.run_to_completion(horizon=8)
+        assert len(done[rid].output) == 4
