@@ -675,6 +675,7 @@ class PagedInferenceEngine(_EngineBase):
         # on queue+slots alone stranded the final tokens forever, and
         # a disconnecting client's request leaked uncancellable).
         self._lagging: Dict[int, Any] = {}
+        self._eager_drain = True       # see step()'s opportunistic drain
         # Bumped when a slot is freed: an in-flight call enqueued for a
         # previous occupant must not decrement the NEW occupant's
         # inflight count at processing time.
@@ -741,13 +742,18 @@ class PagedInferenceEngine(_EngineBase):
                         limit = int(gen.hbm_gb_per_chip * 0.984e9)
                         used = 0          # floor applied below
             if limit is None:
+                # Parity fallback reserves NOTHING for the long ring:
+                # decode must keep the conservative ring budget, or a
+                # large-batch config meets a 1.7 GB ring the pool
+                # never paid for.
+                self._pool_auto_sized = False
                 return parity
         # bytes_in_use can lag async transfers (observed right after the
         # parallel checkpoint puts: the pool then oversized by ~3 GB and
         # decode OOM'd at runtime); the weights are a known floor —
         # PER DEVICE (a tp-sharded tree spreads over mesh.size chips).
         n_dev = self.mesh.size if self.mesh is not None else 1
-        used = max(used, self._param_bytes // n_dev + int(0.3e9))
+        used = max(used, self._param_bytes // n_dev + int(0.15e9))
         # The reserve must cover the decode transients at the LONGEST
         # horizon the ring budget allows — sizing the pool without
         # them compiled programs past HBM at batch=48 on a 7B. The
@@ -758,15 +764,9 @@ class PagedInferenceEngine(_EngineBase):
         # pages (2.2 GB) where 170 pages ran h=32 clean — the
         # empirically-safe reserve on that config is ~3.1 GB. h_max
         # rounds DOWN to the horizon bucket decode will actually pick.
-        from skypilot_tpu.inference.engine import (_ring_horizon_cap,
-                                                   _ring_row_bytes)
+        from skypilot_tpu.inference.engine import _ring_row_bytes
         row = _ring_row_bytes(cfg, max_batch)
-        h_max = min(self._HORIZON_BUCKETS[-1],
-                    _ring_horizon_cap(cfg, max_batch,
-                                      self._param_bytes),
-                    max(8, self._RING_BYTES_CAP_PAGED // row))
-        h_max = next((b for b in reversed(self._HORIZON_BUCKETS)
-                      if b <= h_max), 8)
+        h_max = self._ring_horizon_bucket(self._RING_BYTES_CAP_PAGED)
         reserve = (int(1.6e9) + max(2 * row * h_max,
                                     self._PREFILL_STACK_BUDGET))
         page_bytes = self._page_bytes(cfg, page_size, quantized)
@@ -932,6 +932,22 @@ class PagedInferenceEngine(_EngineBase):
                         if r.finish_time is not None]:
                 del self._lagging[rid]
 
+    def _ring_horizon_bucket(self, ring_bytes: int) -> int:
+        """The horizon BUCKET the ring budget admits — the one place
+        this is computed: _auto_n_pages sizes the pool reserve with it
+        and _enqueue_decode caps live horizons with it, and the two
+        drifting apart re-creates the under/over-reserve OOMs (see the
+        reserve note in _auto_n_pages)."""
+        from skypilot_tpu.inference.engine import (_ring_horizon_cap,
+                                                   _ring_row_bytes)
+        row = _ring_row_bytes(self.cfg, self.max_batch)
+        cap = min(self._HORIZON_BUCKETS[-1],
+                  _ring_horizon_cap(self.cfg, self.max_batch,
+                                    self._param_bytes),
+                  max(8, ring_bytes // row))
+        return next((b for b in reversed(self._HORIZON_BUCKETS)
+                     if b <= cap), 8)
+
     def _maybe_early_free(self, slot: int, req) -> None:
         """Recycle the slot the moment the request's whole output is
         covered by ENQUEUED device calls. Only budget-bound requests
@@ -973,9 +989,24 @@ class PagedInferenceEngine(_EngineBase):
         Interleaving one chunk per step bounds active-request TPOT at
         one chunk time while prompts stream in (the JetStream/vLLM
         continuous-batching admission contract, the capability the
-        reference serves through those engines)."""
+        reference serves through those engines).
+
+        BURST exception: while the batch is mostly EMPTY (cold start /
+        arrival burst), the one-chunk-per-step TPOT bound protects
+        almost nobody — so admission keeps running chunk batches until
+        the DECODING population reaches a QUARTER of the batch. A
+        2x-batch burst's median TTFT was ~7 s with strictly one
+        chunk-batch per ~0.8 s horizon; filling the first slots
+        back-to-back cuts the queue wait for everyone, while the low
+        threshold keeps the loop from stalling a half-full batch of
+        live streams behind a run of long prompts."""
         self._assign_slots()
-        return self._prefill_chunk_batch()
+        events = self._prefill_chunk_batch()
+        while (self._prefill_off
+               and sum(r is not None for r in self._slots)
+               - len(self._prefill_off) < self.max_batch // 4):
+            events += self._prefill_chunk_batch()
+        return events
 
     def _assign_slots(self) -> None:
         for slot in range(self.max_batch):
@@ -1155,6 +1186,23 @@ class PagedInferenceEngine(_EngineBase):
             horizon = min(horizon, 32)
         if not self._enqueue_decode(horizon) and self._pending:
             events.extend(self._process_one())
+        # Opportunistic drain: surface any entry whose device results
+        # are ALREADY ready (non-blocking probe) instead of letting it
+        # age up to _PIPELINE_DEPTH calls — at a 32-step horizon that
+        # lag added ~1.5 s to every first-token/finish event. (Tests
+        # pinning recycle-window behavior turn it off: on CPU every
+        # result is instantly ready and the window collapses.)
+        if self._eager_drain:
+            while self._pending:
+                probe = getattr(self._pending[0]['toks'], 'is_ready',
+                                None)
+                # Probe OUTSIDE any except: an exception from result
+                # processing itself must propagate (the entry is
+                # already popped — swallowing it would drop tokens
+                # and strand inflight counts).
+                if probe is None or not probe():
+                    break
+                events.extend(self._process_one())
         if self._deferred_events:        # pool-pressure pipeline drain
             events.extend(self._deferred_events)
             self._deferred_events = []
@@ -1186,13 +1234,9 @@ class PagedInferenceEngine(_EngineBase):
         # historical conservative 512 MB cap, since nothing shrank
         # them to pay for a bigger ring (h=32 at batch 48 on a 7B
         # OOM'd at runtime against a full-HBM pool where h=16 ran).
-        row = _ring_row_bytes(self.cfg, self.max_batch)
         ring_bytes = (self._RING_BYTES_CAP_PAGED
                       if self._pool_auto_sized else int(512e6))
-        ring_cap = min(_ring_horizon_cap(self.cfg, self.max_batch,
-                                         self._param_bytes),
-                       max(8, ring_bytes // row))
-        horizon = min(horizon, ring_cap)
+        horizon = min(horizon, self._ring_horizon_bucket(ring_bytes))
         for b in reversed(self._HORIZON_BUCKETS):
             if b <= horizon:
                 horizon = b

@@ -293,9 +293,14 @@ class TestEarlyRecycle:
     window (the serve loop and disconnecting clients both hit it)."""
 
     def _engine(self, cfg, params):
-        return PagedInferenceEngine(cfg, params, max_batch=2,
-                                    max_seq=256, page_size=8,
-                                    n_pages=32, decode_impl='gather')
+        eng = PagedInferenceEngine(cfg, params, max_batch=2,
+                                   max_seq=256, page_size=8,
+                                   n_pages=32, decode_impl='gather')
+        # Pin the recycle WINDOW: on CPU every result is instantly
+        # ready, so the opportunistic drain would collapse the lag
+        # these tests exist to exercise.
+        eng._eager_drain = False
+        return eng
 
     def test_lagging_tail_tokens_surface(self, setup):
         cfg, params = setup
