@@ -570,39 +570,52 @@ def _serving_http_measure(srv, n_chips: int, batch: int) -> dict:
     results.clear()
     errors.clear()                           # warmup failures don't count
 
-    # Open-loop Poisson arrivals past saturation: throughput-limited
-    # req/s with realistic queueing in the TTFT.
-    n_req = 2 * batch
-    wl = _anchor_workload(n_req, seed=12)
-    rng = random.Random(12)
-    threads = []
-    t_start = time.time()
-    for p, g in wl:
-        th = threading.Thread(target=one, args=(p, g))
-        th.start()
-        threads.append(th)
-        time.sleep(rng.expovariate(8.0))     # ~8 req/s arrival
-    for th in threads:
-        th.join()
-    wall = time.time() - t_start
-    ttfts = sorted((f - t0) * 1e3 for t0, f, _, _ in results
-                   if f is not None)
-    tpots = sorted((end - f) / max(n - 1, 1) * 1e3
-                   for _, f, end, n in results if f is not None and n > 1)
-    out_tokens = sum(n for _, _, _, n in results)
-    http_detail = {
-        'n_requests': n_req,
-        'n_completed': len(results),
-        'n_errors': len(errors),
-        'first_error': errors[0] if errors else None,
-        'req_s_per_chip': round(len(results) / wall / n_chips, 3),
-        'out_tok_s_per_chip': round(out_tokens / wall / n_chips, 1),
-        'ttft_ms_median': median(ttfts),
-        'ttft_ms_p90': (round(ttfts[int(len(ttfts) * 0.9)], 1)
-                        if ttfts else None),
-        'tpot_ms_median': median(tpots, nd=2),
-        'anchor_req_s_per_chip': round(11.42 / 8, 3),
-    }
+    def poisson_pass(n_req, seed, rate):
+        """Open-loop Poisson arrivals at ``rate`` req/s; returns the
+        stats dict (completion counts included — a partially failed
+        pass must be visible, not just faster)."""
+        results.clear()
+        errors.clear()
+        wl = _anchor_workload(n_req, seed=seed)
+        rng = random.Random(seed)
+        threads = []
+        t_start = time.time()
+        for p, g in wl:
+            th = threading.Thread(target=one, args=(p, g))
+            th.start()
+            threads.append(th)
+            time.sleep(rng.expovariate(rate))
+        for th in threads:
+            th.join()
+        wall = time.time() - t_start
+        ttfts = sorted((f - t0) * 1e3 for t0, f, _, _ in results
+                       if f is not None)
+        tpots = sorted((end - f) / max(n - 1, 1) * 1e3
+                       for _, f, end, n in results
+                       if f is not None and n > 1)
+        out_tokens = sum(n for _, _, _, n in results)
+        return {
+            'n_requests': n_req,
+            'n_completed': len(results),
+            'n_errors': len(errors),
+            'first_error': errors[0] if errors else None,
+            'req_s_per_chip': round(len(results) / wall / n_chips, 3),
+            'out_tok_s_per_chip': round(out_tokens / wall / n_chips, 1),
+            'ttft_ms_median': median(ttfts),
+            'ttft_ms_p90': (round(ttfts[int(len(ttfts) * 0.9)], 1)
+                            if ttfts else None),
+            'tpot_ms_median': median(tpots, nd=2),
+        }
+
+    # Pass 1 — past saturation: throughput-limited req/s (its TTFT is
+    # mostly queue depth). Pass 2 — ~70% of the measured capacity: the
+    # anchor's TTFT (1829 ms) is from a rate its server SUSTAINS, so
+    # this is the apples-to-apples latency regime.
+    http_detail = poisson_pass(2 * batch, seed=12, rate=8.0)
+    http_detail['anchor_req_s_per_chip'] = round(11.42 / 8, 3)
+    mu = http_detail['req_s_per_chip'] * n_chips   # measured capacity
+    http_detail['at_0p7_capacity'] = poisson_pass(
+        batch, seed=13, rate=max(0.5, 0.7 * mu))
 
     # Shared-prefix TTFT win: register a 384-token prefix once, then
     # compare single-request TTFTs with and without a cached prefix.
