@@ -338,6 +338,9 @@ def _bench_7b_serving(chip_bw: float, n_chips: int) -> dict:
     paged_detail = {
         'batch': batch,
         'page_size': eng.page,
+        # Scheduler config (trajectory comparison across bench rounds).
+        'chunk': eng.chunk,
+        'decode_priority_ratio': eng.decode_priority_ratio,
         'n_pages': stats['n_pages'],
         'pool_bytes': stats['pool_bytes'],
         'pool_token_capacity': stats['n_pages'] * eng.page,
@@ -377,38 +380,84 @@ def _bench_7b_serving(chip_bw: float, n_chips: int) -> dict:
     slot_e2e = None
     try:
         from skypilot_tpu.inference.engine import InferenceEngine
-        seng = InferenceEngine(cfg, params, max_batch=slot_batch,
-                               max_seq=max_seq, prefill_w8a8=True)
-        # Warmup + steady decode window + sustained serving rate.
-        _, _, _ = steady(seng)
-        slot_tok_s, _, _ = steady(seng)
-        slot_tok_s /= n_chips
-        slot_sustained, slot_windows = sustained(seng)
-        # Slot e2e at ITS 2x burst (same workload generator): the two
-        # engines trade off — slot streams the contiguous cache faster
-        # per token at its feasible batch, paged holds 2x the
-        # concurrent contexts + prefix cache + continuous admission.
-        sids = submit(seng, _anchor_workload(2 * slot_batch, seed=1))
-        t0 = time.time()
-        sdone = seng.run_to_completion(horizon=horizon)
-        sdt = time.time() - t0
-        sfin = [r for rid, r in sdone.items() if rid in sids]
-        s_out = sum(len(r.output) for r in sfin)
-        slot_e2e = s_out / sdt / n_chips
-        sttfts = sorted(r.ttft_ms for r in sfin
-                        if r.ttft_ms is not None)
-        del seng
-        slot_detail = {
-            'batch': slot_batch,
-            'decode_tok_s_per_chip': round(slot_tok_s, 2),
-            'sustained_out_tok_s_per_chip': round(slot_sustained, 2),
-            'sustained_windows_tok_s': slot_windows,
-            'e2e_burst_out_tok_s_per_chip': round(slot_e2e, 2),
-            'ttft_ms_median_burst': (round(sttfts[len(sttfts) // 2], 1)
-                                     if sttfts else None),
-        }
-        paged_detail['vs_slot_cache'] = round(decode_tok_s / slot_tok_s,
-                                              3)
+
+        def run_slot(chunked: bool) -> dict:
+            """One slot-engine measurement pass: steady decode window,
+            sustained serving rate, 2x-burst e2e + TTFT. ``chunked``
+            False runs the monolithic-admit baseline
+            (prefill_chunk_tokens=0) so the chunked scheduler's TTFT
+            win and throughput cost are both numbers in the JSON."""
+            kw = {} if chunked else {'prefill_chunk_tokens': 0}
+            seng = InferenceEngine(cfg, params, max_batch=slot_batch,
+                                   max_seq=max_seq, prefill_w8a8=True,
+                                   **kw)
+            # Warmup + steady decode window + sustained serving rate.
+            _, _, _ = steady(seng)
+            tok_s, _, _ = steady(seng)
+            tok_s /= n_chips
+            sus, windows = sustained(seng)
+            # Slot e2e at ITS 2x burst (same workload generator): the
+            # engines trade off — slot streams the contiguous cache
+            # faster per token at its feasible batch, paged holds 2x
+            # the concurrent contexts + prefix cache.
+            sids = submit(seng, _anchor_workload(2 * slot_batch,
+                                                 seed=1))
+            t0 = time.time()
+            sdone = seng.run_to_completion(horizon=horizon)
+            sdt = time.time() - t0
+            sfin = [r for rid, r in sdone.items() if rid in sids]
+            s_out = sum(len(r.output) for r in sfin)
+            sttfts = sorted(r.ttft_ms for r in sfin
+                            if r.ttft_ms is not None)
+            detail = {
+                'batch': slot_batch,
+                'prefill_chunk_tokens': seng.chunk,
+                'decode_priority_ratio': seng.decode_priority_ratio,
+                'decode_tok_s_per_chip': round(tok_s, 2),
+                'sustained_out_tok_s_per_chip': round(sus, 2),
+                'sustained_windows_tok_s': windows,
+                'e2e_burst_out_tok_s_per_chip': round(s_out / sdt /
+                                                      n_chips, 2),
+                'ttft_ms_median_burst': (round(
+                    sttfts[len(sttfts) // 2], 1) if sttfts else None),
+                'ttft_ms_p90_burst': (round(
+                    sttfts[int(len(sttfts) * 0.9)], 1)
+                    if sttfts else None),
+            }
+            del seng
+            gc.collect()       # free the slot cache before the next run
+            return detail
+
+        slot_detail = run_slot(chunked=True)
+        slot_e2e = slot_detail['e2e_burst_out_tok_s_per_chip']
+        paged_detail['vs_slot_cache'] = round(
+            decode_tok_s / slot_detail['decode_tok_s_per_chip'], 3)
+        # Monolithic-admit baseline: the chunked-vs-monolithic TTFT /
+        # sustained comparison IS the chunked scheduler's acceptance
+        # number. Best-effort — its failure must not discard the
+        # chunked measurements.
+        try:
+            mono = run_slot(chunked=False)
+            slot_detail['monolithic'] = mono
+
+            def ratio(a, b):
+                return (round(a / b, 3)
+                        if a is not None and b else None)
+
+            slot_detail['chunked_vs_monolithic'] = {
+                'ttft_p90_burst_speedup': ratio(
+                    mono.get('ttft_ms_p90_burst'),
+                    slot_detail.get('ttft_ms_p90_burst')),
+                'ttft_median_burst_speedup': ratio(
+                    mono.get('ttft_ms_median_burst'),
+                    slot_detail.get('ttft_ms_median_burst')),
+                'sustained_frac': ratio(
+                    slot_detail.get('sustained_out_tok_s_per_chip'),
+                    mono.get('sustained_out_tok_s_per_chip')),
+            }
+        except Exception as e:  # pylint: disable=broad-except
+            slot_detail['monolithic'] = {
+                'error': f'{type(e).__name__}: {e}'}
     except Exception as e:  # pylint: disable=broad-except
         slot_detail = {'error': f'{type(e).__name__}: {e}'}
 
@@ -751,6 +800,7 @@ def _bench_1b_modeled(on_tpu: bool, chip_bw: float, n_chips: int) -> dict:
     equiv_7b = tok_s_chip * ours / ref7b
     vs_baseline = (equiv_7b * V6E_HBM_BW / chip_bw) / BASELINE_TOK_S_PER_CHIP
 
+    chunk_cfg = (eng.chunk, eng.decode_priority_ratio)
     del eng
     return {
         'metric': 'decode_tok_s_per_chip_llama2_7b_equiv',
@@ -760,6 +810,8 @@ def _bench_1b_modeled(on_tpu: bool, chip_bw: float, n_chips: int) -> dict:
         'detail': {
             'mode': 'modeled-1b-fallback',
             'model': cfg.name,
+            'prefill_chunk_tokens': chunk_cfg[0],
+            'decode_priority_ratio': chunk_cfg[1],
             'raw_tok_s_per_chip': round(tok_s_chip, 2),
             'decode_tok_s_per_chip': round(decode_tok_s, 2),
             'decode_roofline_frac': round(roofline_frac, 3),

@@ -253,6 +253,36 @@ class _EngineBase:
     def num_active(self) -> int:
         return sum(r is not None for r in self._slots)
 
+    @property
+    def queue_depth(self) -> int:
+        """Requests waiting for a slot (the serve metrics surface)."""
+        return len(self._queue)
+
+    # Fraction of the interleaved scheduler's token budget spent on
+    # decode while prompts are mid-prefill (None = engine default).
+    _DEFAULT_DECODE_PRIORITY = 0.5
+
+    def _interleave_horizon(self) -> int:
+        """Decode horizon to run between prefill chunk batches, from the
+        ``decode_priority_ratio`` token budget (Sarathi-style
+        piggybacking): one scheduler iteration spends ``n x chunk``
+        prompt tokens on the chunk batch and ``active x h`` tokens on
+        decode, so ``h = r/(1-r) * chunk * n / active`` splits the
+        budget r:(1-r). r -> 0 drains prefill monolithically (decode
+        starves); r -> 1 starves prefill instead. The caller still caps
+        by its own horizon and the ring/capacity limits."""
+        r = self.decode_priority_ratio
+        if r is None:
+            r = self._DEFAULT_DECODE_PRIORITY
+        if r >= 1.0:
+            return self._HORIZON_BUCKETS[-1]
+        active = self.num_active - len(self._prefill_off)
+        if active <= 0:
+            return 1
+        n = max(1, min(len(self._prefill_off), self._prefill_n_max))
+        want = r / max(1.0 - r, 1e-3) * self.chunk * n / active
+        return max(1, int(want))
+
     # Depth of the async dispatch pipeline: device calls kept in flight
     # before the host reads results back. Depth 2 overlaps the per-call
     # dispatch round trip (measured ~100-600 ms through a remote PJRT
@@ -368,7 +398,9 @@ class InferenceEngine(_EngineBase):
                  attn_impl: str = 'auto',
                  quantize: Optional[str] = None,
                  donate_params: bool = False,
-                 prefill_w8a8: bool = False):
+                 prefill_w8a8: bool = False,
+                 prefill_chunk_tokens: Optional[int] = 256,
+                 decode_priority_ratio: Optional[float] = None):
         self.max_batch = max_batch
         self.max_seq = max_seq
         self.mesh = mesh
@@ -377,6 +409,18 @@ class InferenceEngine(_EngineBase):
         # the compute-bound prefill; decode unaffected). Off by default
         # — W8A8 adds activation quantization noise to the KV rows.
         self.prefill_w8a8 = prefill_w8a8
+        # Chunked prefill (on by default): prompts prefill in
+        # ``prefill_chunk_tokens``-sized chunks interleaved with decode
+        # horizons, bounding how long running requests stall behind a
+        # long prompt (the monolithic admit measured 5.5 s median burst
+        # TTFT — head-of-line blocking, BENCH_r05). 0/None falls back
+        # to monolithic whole-prompt admission waves (bench baseline).
+        # ``decode_priority_ratio`` splits the interleaved token budget
+        # (see _EngineBase._interleave_horizon); None = 0.5.
+        chunk = prefill_chunk_tokens or 0
+        self.chunk = _bucket_len(chunk, minimum=8) if chunk else 0
+        self.chunked = self.chunk > 0
+        self.decode_priority_ratio = decode_priority_ratio
         self._rng = jax.random.PRNGKey(rng_seed)
 
         cfg, self.params, quantize = prepare_params(
@@ -403,6 +447,22 @@ class InferenceEngine(_EngineBase):
         self._init_slots(max_batch)
         self._decode_fn = self._build_decode()
         self._prefill_fns: Dict[int, Any] = {}
+        # Chunked-prefill scheduler state: slot -> prompt tokens
+        # prefilled so far. A slot in this dict is assigned but not yet
+        # decodable; the scheduling loop interleaves its remaining
+        # chunks with decode horizons.
+        self._prefill_off: Dict[int, int] = {}
+        self._chunk_prefill_fns: Dict[Tuple, Any] = {}
+        # Max mid-prefill slots per chunk batch (padded to a compiled
+        # n bucket); the per-call stacked-rows budget shrinks it
+        # further when the gathered-cache bucket is wide.
+        self._prefill_n_max = self._PREFILL_N_BUCKETS[-1]
+        # Fixed-shape first-token merge (completing chunk rows):
+        # padding entries scatter to the out-of-range sentinel
+        # max_batch and are dropped.
+        self._merge_tokens_drop = jax.jit(
+            lambda tok, slots, vals: tok.at[slots].set(vals,
+                                                       mode='drop'))
 
     @classmethod
     def from_pretrained(cls, path: str, *, dtype: Any = None,
@@ -516,10 +576,256 @@ class InferenceEngine(_EngineBase):
     _ADMIT_WAVE_MIN = 4
 
     def _admit(self) -> List[Tuple[int, int, bool]]:
-        """Reserve free slots for queued requests and enqueue one
-        batched prefill call. ALWAYS returns [] — the prefill result
-        rides the async pipeline and its first-token events surface in
+        """Admission dispatch. Chunked (default): assign free slots
+        immediately and run at most ONE prefill chunk batch before
+        decode resumes — the scheduling loop (``step``) interleaves the
+        remaining chunks with decode horizons. Monolithic
+        (``prefill_chunk_tokens=0``): the historical whole-prompt
+        admission wave. Both ALWAYS return [] — prefill results ride
+        the async pipeline and their first-token events surface in
         ``_process_one`` up to ``_PIPELINE_DEPTH`` calls later."""
+        if not self.chunked:
+            return self._admit_monolithic()
+        self._assign_slots()
+        events = self._prefill_chunk_batch()
+        # Burst exception (mirrors the paged engine): while the
+        # DECODING population is under a quarter of the batch (cold
+        # start / arrival burst), the one-chunk-per-step TPOT bound
+        # protects almost nobody — run chunk batches back to back so
+        # the first slots start decoding sooner.
+        while (self._prefill_off
+               and self.num_active - len(self._prefill_off)
+               < self.max_batch // 4):
+            events += self._prefill_chunk_batch()
+        return events
+
+    def _assign_slots(self) -> None:
+        """Reserve free slots for queued requests with a zero prefill
+        cursor; chunks stream in via _prefill_chunk_batch."""
+        for slot in range(self.max_batch):
+            if self._slots[slot] is not None:
+                continue
+            req = self._queue_pop()
+            if req is None:
+                return
+            self._slots[slot] = req
+            self._slot_len[slot] = 0
+            self._prefill_off[slot] = 0
+
+    def _free_slot(self, slot: int) -> None:
+        self._prefill_off.pop(slot, None)      # cancel mid-prefill
+        super()._free_slot(slot)
+
+    def _prefill_chunk_batch(self) -> List[Tuple[int, int, bool]]:
+        """One fixed-size prefill chunk across up to a compiled
+        n-bucket of mid-prefill slots, attending the slots' EXISTING
+        cache rows (nonzero cache offset) and scattering the new rows
+        at each slot's cursor. Completing rows sample their first token
+        ON DEVICE (per-request params) and merge it into the device
+        token vector before this returns, so they decode on the very
+        next horizon; the first-token EVENT surfaces via _process_one.
+        ALWAYS returns []."""
+        pending = sorted(self._prefill_off)
+        if not pending:
+            return []
+        quantized = self.cache.quantized
+        row_w = ((self.cfg.head_dim + 4) if quantized
+                 else self.cfg.head_dim *
+                 jnp.dtype(self.cfg.dtype).itemsize)
+        scratch_tok = self.cfg.n_layers * self.cfg.n_kv_heads * row_w * 2
+
+        def shapes(batch):
+            # Chunk width: the full chunk, or a smaller bucket when
+            # every pending piece is short (prompt tails) — bounded
+            # compiled-program count, half/quarter the FLOPs.
+            rest_max = max(len(self._slots[s].prompt)
+                           - self._prefill_off[s] for s in batch)
+            chunk_w = min(self.chunk,
+                          _bucket_len(rest_max,
+                                      minimum=min(64, self.chunk)))
+            # Cache-read bucket: covers every batch row's cursor (rows
+            # past each cursor are masked); 0 when no row has context
+            # yet — that variant runs plain causal attention
+            # (flash-eligible), exactly the monolithic first-chunk
+            # math.
+            start_max = int(max(self._slot_len[s] for s in batch))
+            kv_bucket = (0 if start_max == 0
+                         else min(_bucket_len(start_max), self.max_seq))
+            return chunk_w, kv_bucket
+
+        batch = pending[:self._prefill_n_max]
+        chunk_w, kv_bucket = shapes(batch)
+        # The chunk program's transient is the stacked [L, n, chunk_w]
+        # new rows PLUS the gathered [L, n, kv_bucket] cache copy —
+        # cap n to the same scratch budget as the monolithic wave.
+        fit = int(0.75e9) // max(1, (chunk_w + kv_bucket) * scratch_tok)
+        cap = 1
+        for b in self._PREFILL_N_BUCKETS:
+            if b <= fit:
+                cap = b
+        if len(batch) > cap:
+            batch = batch[:cap]
+            chunk_w, kv_bucket = shapes(batch)
+        n = next(b for b in self._PREFILL_N_BUCKETS if b >= len(batch))
+
+        tokens = np.zeros((n, chunk_w), np.int32)
+        starts = np.zeros(n, np.int32)
+        valid = np.zeros(n, np.int32)
+        want = np.full(n, -1, np.int32)
+        # Padding rows carry the out-of-range slot sentinel: their
+        # writes (rows, lengths, token merge) all drop.
+        slots_arr = np.full(n, self.max_batch, np.int32)
+        temps = np.zeros(n, np.float32)
+        topks = np.zeros(n, np.int32)
+        topps = np.ones(n, np.float32)
+        for i, slot in enumerate(batch):
+            req = self._slots[slot]
+            off = self._prefill_off[slot]
+            piece = req.prompt[off:off + chunk_w]
+            tokens[i, :len(piece)] = piece
+            starts[i] = self._slot_len[slot]
+            valid[i] = len(piece)
+            if off + len(piece) == len(req.prompt):
+                want[i] = len(piece) - 1
+            slots_arr[i] = slot
+            temps[i] = req.temperature
+            topks[i] = req.top_k or 0
+            topps[i] = req.top_p
+        # Sampling variant only when a COMPLETING row needs it (the
+        # full-vocab sort costs hundreds of ms on TPU; mid-prompt
+        # chunks and greedy completions must not pay it).
+        sample = any(self._slots[s].temperature > 0
+                     for i, s in enumerate(batch) if want[i] >= 0)
+        self._rng, prng = jax.random.split(self._rng)
+        # ONE batched host->device transfer for every host-built
+        # operand (each separate jnp.asarray is its own dispatch round
+        # trip through a remote tunnel).
+        (tokens_d, starts_d, valid_d, want_d, slots_d, temps_d,
+         topks_d, topps_d) = jax.device_put(
+            (tokens, starts, valid, want, slots_arr, temps, topks,
+             topps))
+        prefill = self._get_chunk_prefill(n, chunk_w, kv_bucket, sample)
+        first, self.cache = prefill(
+            self.params, self.cache, tokens_d, starts_d, valid_d,
+            want_d, slots_d, temps_d, topks_d, topps_d, prng)
+        # Async: host bookkeeping advances NOW (device writes are
+        # program-ordered); completing slots' sampled tokens merge into
+        # the device token vector immediately so they decode on the
+        # next horizon.
+        done_rows: List[Tuple[int, int]] = []    # (row i, slot)
+        for i, slot in enumerate(batch):
+            self._slot_len[slot] += int(valid[i])
+            self._prefill_off[slot] += int(valid[i])
+            if want[i] < 0:
+                continue                         # more chunks to go
+            del self._prefill_off[slot]
+            done_rows.append((i, slot))
+        if done_rows:
+            rows_p = np.zeros(n, np.int32)
+            slots_p = np.full(n, self.max_batch, np.int32)
+            for j, (i, slot) in enumerate(done_rows):
+                rows_p[j], slots_p[j] = i, slot
+            rows_d, sl_d = jax.device_put((rows_p, slots_p))
+            self._tok_dev = self._merge_tokens_drop(
+                self._tok_dev, sl_d, jnp.take(first, rows_d))
+            self._meta_dirty = True              # slots become decodable
+            self._pending.append({'kind': 'prefill', 'toks': first,
+                                  'batch': [(slot, self._slots[slot], i)
+                                            for i, slot in done_rows]})
+        return []
+
+    def _get_chunk_prefill(self, n: int, chunk_w: int, kv_bucket: int,
+                           sample: bool):
+        """Compiled chunk-prefill program: gather the batch slots' first
+        ``kv_bucket`` cache rows (0 = no cache read — plain causal,
+        flash-eligible), run the chunk through prefill_rows at each
+        row's offset, scatter the new rows back at the cursors
+        (mode='drop': positions past ``valid`` or ``max_seq`` and the
+        padding sentinel slot all discard instead of clamp-corrupting
+        the cache tail), and sample each completing row's next token."""
+        key = (n, chunk_w, kv_bucket, sample)
+        if key in self._chunk_prefill_fns:
+            return self._chunk_prefill_fns[key]
+        cfg, attn_impl = self.cfg, self.attn_impl
+        w8a8 = self.prefill_w8a8
+        max_seq = self.max_seq
+
+        @functools.partial(jax.jit, donate_argnums=(1,))
+        def prefill(params, big_cache, tokens, starts, valid, want_idx,
+                    slots, temps, topks, topps, rng):
+            if kv_bucket:
+                ck = big_cache.k[:, slots, :kv_bucket]
+                cv = big_cache.v[:, slots, :kv_bucket]
+                if big_cache.quantized:
+                    cache_kv = (ck, cv,
+                                big_cache.k_scale[:, slots, :kv_bucket],
+                                big_cache.v_scale[:, slots, :kv_bucket])
+                else:
+                    cache_kv = (ck, cv)
+            else:
+                cache_kv = None
+            last_idx = jnp.clip(want_idx, 0, chunk_w - 1)
+            last, rows = llama.prefill_rows(
+                params, tokens, last_idx + 1, cfg, attn_impl=attn_impl,
+                quantize_rows=big_cache.quantized, w8a8=w8a8,
+                cache_kv=cache_kv,
+                cache_len=starts if kv_bucket else None)
+            if sample:
+                first = sample_tokens(last, rng, temps, topks, topps)
+            else:
+                first = jnp.argmax(last, -1).astype(jnp.int32)
+            pos = starts[:, None] + jnp.arange(chunk_w)[None, :]
+            pos = jnp.where(jnp.arange(chunk_w)[None, :] < valid[:, None],
+                            pos, max_seq)        # invalid rows drop
+            length = big_cache.length.at[slots].set(starts + valid,
+                                                    mode='drop')
+
+            def scatter(c, r):
+                return c.at[:, slots[:, None], pos].set(
+                    r.astype(c.dtype), mode='drop')
+
+            if big_cache.quantized:
+                kq, vq, ks, vs = rows
+                new_cache = llama.KVCache(
+                    k=scatter(big_cache.k, kq),
+                    v=scatter(big_cache.v, vq), length=length,
+                    k_scale=scatter(big_cache.k_scale, ks),
+                    v_scale=scatter(big_cache.v_scale, vs))
+            else:
+                k_rows, v_rows = rows
+                new_cache = llama.KVCache(k=scatter(big_cache.k, k_rows),
+                                          v=scatter(big_cache.v, v_rows),
+                                          length=length)
+            return first, new_cache
+
+        self._chunk_prefill_fns[key] = prefill
+        return prefill
+
+    def step(self, horizon: int = 1) -> List[Tuple[int, int, bool]]:
+        """Chunked scheduling loop: admit (one chunk batch max), then
+        enqueue decode through the async pipeline. While prompts are
+        mid-prefill the decode horizon is capped by the
+        ``decode_priority_ratio`` token budget so the next chunk runs
+        within a bounded number of decode steps; while the queue is
+        non-empty a medium cap keeps freed slots noticed promptly.
+        Monolithic mode keeps _EngineBase.step semantics unchanged."""
+        if not self.chunked:
+            return super().step(horizon)
+        events: List[Tuple[int, int, bool]] = []
+        while len(self._pending) >= self._PIPELINE_DEPTH:
+            events.extend(self._process_one())
+        events.extend(self._admit())
+        if self._prefill_off:
+            horizon = min(horizon, self._interleave_horizon())
+        elif self._queue:
+            horizon = min(horizon, 32)
+        if not self._enqueue_decode(horizon) and self._pending:
+            events.extend(self._process_one())
+        return events
+
+    def _admit_monolithic(self) -> List[Tuple[int, int, bool]]:
+        """Whole-prompt admission waves (``prefill_chunk_tokens=0`` —
+        the pre-chunking baseline, kept for bench comparison)."""
         free = [s for s in range(self.max_batch) if self._slots[s] is None]
         wave_min = min(self._ADMIT_WAVE_MIN, self.max_batch)
         if (0 < len(free) < wave_min and len(free) < self.max_batch
@@ -601,7 +907,8 @@ class InferenceEngine(_EngineBase):
             self._slot_len[slot] = len(req.prompt)
         self._meta_dirty = True
         self._pending.append({'kind': 'prefill', 'toks': next_tokens,
-                              'batch': list(batch)})
+                              'batch': [(slot, req, i) for i, (slot, req)
+                                        in enumerate(batch)]})
         return []
 
     _HORIZON_BUCKETS = (1, 2, 4, 8, 16, 32, 64, 128)
@@ -611,14 +918,23 @@ class InferenceEngine(_EngineBase):
         device-resident state (tokens from the previous call's last
         column, the chained cache). Returns False when nothing could be
         enqueued. The host reads the result back in _process_one, up to
-        _PIPELINE_DEPTH calls later."""
-        active = np.array([r is not None for r in self._slots])
+        _PIPELINE_DEPTH calls later. Mid-prefill slots (chunked
+        admission cursors still advancing) are masked inactive: their
+        cache lengths are mid-prompt and their token-vector entries
+        stale until the completing chunk merges the first token."""
+        ready = [r if s not in self._prefill_off else None
+                 for s, r in enumerate(self._slots)]
+        active = np.array([r is not None for r in ready])
         if not active.any():
             return False
-        # Cap the horizon by remaining KV capacity of active slots (+1
-        # for the token written during the step) — counting the steps
-        # already IN FLIGHT, whose device-side lengths have advanced
-        # past the host view.
+        # Cap the horizon by remaining KV capacity (+1 for the token
+        # written during the step) — counting the steps already IN
+        # FLIGHT, whose device-side lengths have advanced past the host
+        # view. The max runs over EVERY occupied slot, mid-prefill ones
+        # included: the horizon's ring merge writes (masked-off garbage)
+        # rows at each slot's device length, and dynamic_update_slice
+        # CLAMPS — a merge pushed past max_seq on a nearly-full
+        # mid-prefill slot would slide back over its real prompt rows.
         max_live = int(max(self._slot_len[s]
                            for s in range(self.max_batch)
                            if self._slots[s] is not None))
@@ -643,7 +959,7 @@ class InferenceEngine(_EngineBase):
                 break
 
         temps_d, topks_d, topps_d, active_d, sample = \
-            self._slot_meta(self._slots)
+            self._slot_meta(ready)
         # Length-aware KV reads: attention streams only the first
         # kv_bucket cache rows (decode is HBM-bound on this read). The
         # bucket must cover every live context through this horizon
@@ -661,7 +977,7 @@ class InferenceEngine(_EngineBase):
         self._inflight_steps += horizon
         self._pending.append({'kind': 'decode', 'toks': toks,
                               'horizon': horizon,
-                              'snapshot': list(self._slots)})
+                              'snapshot': ready})
         return True
 
     def _process_one(self) -> List[Tuple[int, int, bool]]:
@@ -675,13 +991,13 @@ class InferenceEngine(_EngineBase):
         events: List[Tuple[int, int, bool]] = []
         now = time.time()
         if entry['kind'] == 'prefill':
-            for i, (slot, req) in enumerate(entry['batch']):
+            for slot, req, row in entry['batch']:
                 if req.finish_time is not None:       # cancelled in flight
                     continue
-                token = int(toks[i])
+                token = int(toks[row])
                 req.first_token_time = now
                 req.output.append(token)
-                finished = self._maybe_finish(slot, token)
+                finished = self._finish_req(slot, req, token)
                 events.append((req.request_id, token, finished))
             return events
         self._inflight_steps -= entry['horizon']

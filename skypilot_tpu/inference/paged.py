@@ -571,8 +571,11 @@ class PagedInferenceEngine(_EngineBase):
 
     def __init__(self, cfg: ModelConfig, params=None, *,
                  max_batch: int = 8, max_seq: int = 1024,
-                 page_size: int = 128, n_pages: Optional[int] = None,
+                 page_size: Optional[int] = None,
+                 n_pages: Optional[int] = None,
                  chunk: int = 256,
+                 prefill_chunk_tokens: Optional[int] = None,
+                 decode_priority_ratio: Optional[float] = None,
                  mesh=None, rng_seed: int = 0, attn_impl: str = 'auto',
                  quantize: Optional[str] = None,
                  donate_params: bool = False,
@@ -583,8 +586,20 @@ class PagedInferenceEngine(_EngineBase):
         from skypilot_tpu.parallel import mesh as mesh_lib
         self.max_batch = max_batch
         self.max_seq = max_seq
-        self.page = page_size
+        # page_size=None auto-selects a FAST-PATH size after the
+        # quantize mode is known (see below); explicit values are the
+        # user's to keep (with the misalignment warning).
+        self._page_user = page_size is not None
+        # ``prefill_chunk_tokens`` is the cross-engine spelling of the
+        # chunk knob (the slot engine and serve layer use it); it wins
+        # over ``chunk`` when given.
+        if prefill_chunk_tokens is not None:
+            chunk = prefill_chunk_tokens
         self.chunk = chunk
+        # Decode share of the interleaved token budget while prompts
+        # are mid-prefill (see _EngineBase._interleave_horizon). None
+        # keeps this engine's measured-best fixed interleave horizon.
+        self.decode_priority_ratio = decode_priority_ratio
         self.mesh = mesh
         self.attn_impl = attn_impl
         # Opt-in W8A8 prefill (int8 activations on the compute-bound
@@ -604,13 +619,20 @@ class PagedInferenceEngine(_EngineBase):
             cfg, params, quantize=quantize, mesh=mesh,
             donate_params=donate_params)
         self.cfg = cfg
-        if page_size % 128 != 0 and quantize == 'int8':
+        if page_size is None:
+            page_size = self._auto_page_size(cfg, max_seq, quantize,
+                                             mesh)
+        self.page = page_size
+        if self._page_user and page_size % 128 != 0 \
+                and quantize == 'int8':
             # Checked AFTER prepare_params so pre-quantized param trees
             # (load_checkpoint(quantize='int8')) are caught too. The
             # manual-DMA kernel's per-page scale blocks need a
             # 128-aligned minor dim; off the fast path decode drops to
             # the per-page-grid kernel (~0.71x measured). Loud, not
             # silent — the model server exposes --page-size directly.
+            # Only EXPLICIT sizes warn: auto-selection never picks a
+            # misaligned size where the fast path is reachable.
             import warnings
             warnings.warn(
                 f'page_size={page_size} is not a multiple of 128: int8 '
@@ -697,6 +719,23 @@ class PagedInferenceEngine(_EngineBase):
                 self._prefill_n_max = b
         self.chunks_prefilled = 0          # diagnostics (prefix-hit wins)
         self.preemptions = 0               # pool-pressure recomputes
+
+    @staticmethod
+    def _auto_page_size(cfg: ModelConfig, max_seq: int,
+                        quantize: Optional[str], mesh) -> int:
+        """Default page size: stay on the decode fast path. Wherever
+        the Pallas manual-DMA int8 kernel is reachable (the same
+        condition ``decode_impl='auto'`` uses to pick it), pages must
+        be 128-aligned — the multichip dryrun's explicit page_size=8
+        int8 pool tripped the ~0.7x per-page-grid fallback this guard
+        exists to catch. Elsewhere (bf16 pools, CPU tests, gather
+        path) alignment is free, so short-context configs get smaller
+        pages instead of one page per slot."""
+        if (quantize == 'int8' and cfg.head_dim % 128 == 0
+                and jax.default_backend() == 'tpu' and mesh is None):
+            return 128
+        from skypilot_tpu.inference.engine import _bucket_len
+        return min(128, _bucket_len(max(8, max_seq // 8), minimum=8))
 
     @staticmethod
     def _page_bytes(cfg: ModelConfig, page_size: int,
@@ -1181,7 +1220,14 @@ class PagedInferenceEngine(_EngineBase):
             events.extend(self._process_one())
         events.extend(self._admit())
         if self._prefill_off:
-            horizon = min(horizon, self.interleave_horizon)
+            # decode_priority_ratio switches the fixed interleave
+            # horizon to the Sarathi-style token-budget split (shared
+            # with the slot engine); None keeps this engine's
+            # measured-best fixed cap.
+            horizon = min(horizon,
+                          self.interleave_horizon
+                          if self.decode_priority_ratio is None
+                          else self._interleave_horizon())
         elif self._queue:
             horizon = min(horizon, 32)
         if not self._enqueue_decode(horizon) and self._pending:

@@ -685,16 +685,28 @@ def prefill_rows(
     attn_impl: str = 'auto',
     quantize_rows: bool = False,
     w8a8: bool = False,
+    cache_kv=None,                     # per-row cache stacks (chunked
+                                       # prefill): ([L, n, S, hkv, d] k,
+                                       # v) bf16 or (kq, vq, ks, vs)
+                                       # int8 codes + scales
+    cache_len: Optional[jax.Array] = None,   # [n] valid cache rows =
+                                       # each row's chunk start offset
 ):
-    """Full-prompt prefill for the slot engine: plain causal attention
-    over the padded bucket — flash-eligible on TPU (the forward-with-
-    scratch-cache path it replaces ran ``cached_attention`` against a
-    bucket of zero rows: an extra masked cache read per layer and no
-    flash). Returns only what admission needs:
+    """Prompt/chunk prefill for the slot engine. Without ``cache_kv``:
+    plain causal attention over the padded bucket — flash-eligible on
+    TPU (the forward-with-scratch-cache path it replaces ran
+    ``cached_attention`` against a bucket of zero rows: an extra masked
+    cache read per layer and no flash). With ``cache_kv``/``cache_len``
+    the bucket is a prompt CHUNK attending over a NONZERO cache offset:
+    positions start at ``cache_len`` per row, and each layer attends the
+    gathered cache rows (masked to ``cache_len``) plus the causal chunk
+    (``ops.chunk_attention`` — flash chunk kernel on TPU, two-block XLA
+    softmax elsewhere). Returns only what admission needs:
 
-    - ``last_logits`` [n, vocab] fp32 at each prompt's final position
-      (the full [n, bucket, vocab] logits tensor is a ~0.5 GB transient
-      at n=8 x bucket=512 — only the last row is ever used);
+    - ``last_logits`` [n, vocab] fp32 at each row's position
+      ``true_lens - 1`` (the full [n, bucket, vocab] logits tensor is a
+      ~0.5 GB transient at n=8 x bucket=512 — only one row is ever
+      used; chunked callers pass the completing index + 1);
     - the per-layer KV rows, quantized INSIDE the layer scan when
       ``quantize_rows`` (the stacked bf16 [L, n, bucket] rows are the
       7B prefill's biggest transient — int8 halves it, doubling the
@@ -707,28 +719,63 @@ def prefill_rows(
     logits feed sampling directly and are not worth the noise.
     """
     from skypilot_tpu.models import quantization
+    from skypilot_tpu.ops.attention import chunk_attention
     x = _embed_tokens(params, tokens, cfg)
     x = _shard(x, 'batch', 'seq', 'embed')
     n, s = tokens.shape
-    positions = jnp.broadcast_to(jnp.arange(s)[None, :], (n, s))
+    if cache_len is None:
+        positions = jnp.broadcast_to(jnp.arange(s)[None, :], (n, s))
+    else:
+        positions = cache_len[:, None] + jnp.arange(s)[None, :]
 
-    def body(carry, layer):
-        def attn_fn(q, k, v):
-            return attention(q, k, v, causal=True, impl=attn_impl)
-
-        xc, (k, v), _ = _layer_core(layer, carry, cfg, positions,
-                                    attn_fn)
+    def emit_rows(k, v):
         if quantize_rows:
             kq, ks = quantize_kv_rows(k)
             vq, vs = quantize_kv_rows(v)
-            return xc, (kq, vq, ks, vs)
-        return xc, (k, v)
+            return (kq, vq, ks, vs)
+        return (k, v)
+
+    if cache_kv is None:
+        def body(carry, layer):
+            def attn_fn(q, k, v):
+                return attention(q, k, v, causal=True, impl=attn_impl)
+
+            xc, (k, v), _ = _layer_core(layer, carry, cfg, positions,
+                                        attn_fn)
+            return xc, emit_rows(k, v)
+
+        xs = params['layers']
+    else:
+        if len(cache_kv) == 4:
+            ck_all, cv_all, ks_all, vs_all = cache_kv
+        else:
+            (ck_all, cv_all), ks_all, vs_all = cache_kv, None, None
+
+        def body(carry, layer_and_idx):
+            layer, li = layer_and_idx
+            ck = lax.dynamic_index_in_dim(ck_all, li, 0, keepdims=False)
+            cv = lax.dynamic_index_in_dim(cv_all, li, 0, keepdims=False)
+            sk = (lax.dynamic_index_in_dim(ks_all, li, 0, keepdims=False)
+                  if ks_all is not None else None)
+            sv = (lax.dynamic_index_in_dim(vs_all, li, 0, keepdims=False)
+                  if vs_all is not None else None)
+
+            def attn_fn(q, k, v):
+                return chunk_attention(q, k, v, ck, cv, cache_len,
+                                       impl=attn_impl, k_scale=sk,
+                                       v_scale=sv)
+
+            xc, (k, v), _ = _layer_core(layer, carry, cfg, positions,
+                                        attn_fn)
+            return xc, emit_rows(k, v)
+
+        xs = (params['layers'], jnp.arange(cfg.n_layers))
 
     import contextlib
     ctx = (quantization.w8a8_region() if w8a8
            else contextlib.nullcontext())
     with ctx:
-        x, rows = lax.scan(body, x, params['layers'])
+        x, rows = lax.scan(body, x, xs)
     x = rms_norm(x, params['final_norm'], cfg.norm_eps,
                  cfg.norm_plus_one)
     last_x = jnp.take_along_axis(x, (true_lens - 1)[:, None, None],

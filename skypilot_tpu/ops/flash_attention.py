@@ -32,6 +32,11 @@ from jax.experimental.pallas import tpu as pltpu
 DEFAULT_MASK_VALUE = -0.7 * float(jnp.finfo(jnp.float32).max)
 _LANES = 128
 
+# jax renamed TPUCompilerParams -> CompilerParams across 0.4.x/0.5.x;
+# resolve whichever the pinned jax ships.
+_CompilerParams = getattr(pltpu, 'CompilerParams',
+                          getattr(pltpu, 'TPUCompilerParams', None))
+
 
 # --------------------------------------------------------------------------
 # Forward kernel
@@ -140,12 +145,137 @@ def _fwd(q, k, v, *, scale, causal, block_q, block_k, interpret):
             pltpu.VMEM((block_q, _LANES), jnp.float32),
             pltpu.VMEM((block_q, d), jnp.float32),
         ],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=('parallel', 'parallel', 'parallel',
                                  'arbitrary')),
         interpret=interpret,
     )(q, k, v)
     return out, lse[..., 0]
+
+
+# --------------------------------------------------------------------------
+# Chunked-prefill forward (nonzero cache offset)
+# --------------------------------------------------------------------------
+def _fwd_chunk_kernel(cl_ref, q_ref, k_ref, v_ref, o_ref,
+                      m_scratch, l_scratch, acc_scratch,
+                      *, scale: float, split: int, block_q: int,
+                      block_k: int, num_k_blocks: int):
+    """Forward-only flash for a prefill CHUNK against a cache prefix.
+
+    kv rows [0, split) are the per-row cache prefix (valid iff their
+    index < cl_ref[b], the row's live cache length); rows [split, skv)
+    are the chunk itself, causal against the chunk-local q positions.
+    The chunk's absolute positions are cl_b + [0..sq) so every valid
+    cache row strictly precedes every q row — only the length mask
+    applies to the cache region."""
+    ib = pl.program_id(0)
+    iq = pl.program_id(2)
+    ik = pl.program_id(3)
+    cl = cl_ref[ib]              # this row's live cache length (SMEM)
+
+    @pl.when(ik == 0)
+    def _init():
+        m_scratch[:] = jnp.full_like(m_scratch, -jnp.inf)
+        l_scratch[:] = jnp.zeros_like(l_scratch)
+        acc_scratch[:] = jnp.zeros_like(acc_scratch)
+
+    q_start = iq * block_q
+    k_start = ik * block_k
+    # A block is needed when it holds live cache rows (any index < cl)
+    # or overlaps the chunk's causal triangle; blocks straddling
+    # ``split`` evaluate both.
+    needed = ((jnp.logical_and(k_start < split, k_start < cl))
+              | jnp.logical_and(k_start + block_k > split,
+                                k_start - split <= q_start + block_q - 1))
+    # The diagonal chunk block is always needed and always has the
+    # largest needed ik (chunk rows come after cache rows), so the
+    # finalize index depends only on the q block.
+    last_needed_ik = jnp.minimum((split + q_start + block_q - 1)
+                                 // block_k, num_k_blocks - 1)
+
+    @pl.when(needed)
+    def _compute():
+        q = q_ref[0, 0].astype(jnp.float32)            # [bq, d]
+        k = k_ref[0, 0].astype(jnp.float32)            # [bk, d]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale  # [bq, bk]
+
+        q_pos = q_start + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 0)
+        k_idx = k_start + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 1)
+        valid = jnp.where(k_idx < split, k_idx < cl,
+                          k_idx - split <= q_pos)
+        s = jnp.where(valid, s, DEFAULT_MASK_VALUE)
+
+        m_prev = m_scratch[:, :1]
+        m_cur = jnp.max(s, axis=-1, keepdims=True)
+        m_new = jnp.maximum(m_prev, m_cur)
+        p = jnp.exp(s - m_new)
+        alpha = jnp.exp(m_prev - m_new)
+        l_new = alpha * l_scratch[:, :1] + jnp.sum(p, -1, keepdims=True)
+
+        v = v_ref[0, 0].astype(jnp.float32)
+        pv = jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        acc_scratch[:] = acc_scratch[:] * alpha + pv
+        m_scratch[:] = jnp.broadcast_to(m_new, m_scratch.shape)
+        l_scratch[:] = jnp.broadcast_to(l_new, l_scratch.shape)
+
+    @pl.when(ik == last_needed_ik)
+    def _finalize():
+        l = l_scratch[:, :1]
+        l_safe = jnp.where(l == 0.0, 1.0, l)
+        o_ref[0, 0] = (acc_scratch[:] / l_safe).astype(o_ref.dtype)
+
+
+def _fwd_chunk(q, k, v, cache_len, *, scale, split, block_q, block_k,
+               interpret):
+    """q: [b, hq, sq, d]; k/v: [b, hkv, skv, d] laid out as
+    [cache(:split); chunk]; cache_len: [b] int32 live cache rows."""
+    b, hq, sq, d = q.shape
+    hkv, skv = k.shape[1], k.shape[2]
+    group = hq // hkv
+    nq = pl.cdiv(sq, block_q)
+    nk = pl.cdiv(skv, block_k)
+
+    kernel = functools.partial(
+        _fwd_chunk_kernel, scale=scale, split=split, block_q=block_q,
+        block_k=block_k, num_k_blocks=nk)
+
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,               # cache lengths [b]
+            grid=(b, hq, nq, nk),
+            in_specs=[
+                pl.BlockSpec((1, 1, block_q, d),
+                             lambda ib, ih, iq, ik, cl: (ib, ih, iq, 0)),
+                pl.BlockSpec((1, 1, block_k, d),
+                             lambda ib, ih, iq, ik, cl:
+                             (ib, ih // group, ik, 0)),
+                pl.BlockSpec((1, 1, block_k, d),
+                             lambda ib, ih, iq, ik, cl:
+                             (ib, ih // group, ik, 0)),
+            ],
+            out_specs=pl.BlockSpec((1, 1, block_q, d),
+                                   lambda ib, ih, iq, ik, cl:
+                                   (ib, ih, iq, 0)),
+            scratch_shapes=[
+                pltpu.VMEM((block_q, _LANES), jnp.float32),
+                pltpu.VMEM((block_q, _LANES), jnp.float32),
+                pltpu.VMEM((block_q, d), jnp.float32),
+            ],
+        ),
+        out_shape=jax.ShapeDtypeStruct((b, hq, sq, d), q.dtype),
+        compiler_params=_CompilerParams(
+            dimension_semantics=('parallel', 'parallel', 'parallel',
+                                 'arbitrary')),
+        interpret=interpret,
+    )(cache_len.astype(jnp.int32), q, k, v)
+    return out
 
 
 # --------------------------------------------------------------------------
@@ -302,7 +432,7 @@ def _bwd(scale, causal, block_q, block_k, interpret, res, g):
             pltpu.VMEM((bk, d), jnp.float32),
             pltpu.VMEM((bk, d), jnp.float32),
         ],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=('parallel', 'parallel', 'parallel',
                                  'arbitrary')),
         interpret=interpret,
@@ -323,7 +453,7 @@ def _bwd(scale, causal, block_q, block_k, interpret, res, g):
                                lambda ib, ih, a, b_: (ib, ih, a, 0)),
         out_shape=jax.ShapeDtypeStruct((b, hq, sq, d), q.dtype),
         scratch_shapes=[pltpu.VMEM((bq, d), jnp.float32)],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=('parallel', 'parallel', 'parallel',
                                  'arbitrary')),
         interpret=interpret,
@@ -361,17 +491,36 @@ _flash_bhsd.defvjp(_flash_fwd_rule, _flash_bwd_rule)
 def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
                     causal: bool = True, scale: Optional[float] = None,
                     block_q: int = 512, block_k: int = 512,
+                    cache_len: Optional[jax.Array] = None,
+                    kv_split: Optional[int] = None,
                     interpret: bool = False) -> jax.Array:
     """Flash attention over model-layout tensors.
 
     q: [b, sq, hq, d]; k/v: [b, skv, hkv, d] (GQA: hkv divides hq).
     Returns [b, sq, hq, d].
+
+    Chunked prefill against a cache (nonzero cache offset): pass
+    ``kv_split`` and ``cache_len`` with k/v laid out as
+    ``[cache(:kv_split); chunk]``. Row b's cache prefix is valid up to
+    ``cache_len[b]`` rows; the chunk (rows ``kv_split:``) is causal
+    against q, whose absolute positions are ``cache_len[b] + [0..sq)``.
+    This path is FORWARD-ONLY (inference prefill — no VJP).
     """
     b, sq, hq, d = q.shape
     _, skv, hkv, _ = k.shape
     if hq % hkv != 0:
         raise ValueError(f'GQA requires hkv ({hkv}) to divide hq ({hq})')
-    if causal and sq != skv:
+    if cache_len is not None or kv_split is not None:
+        if cache_len is None or kv_split is None:
+            raise ValueError('cache_len and kv_split must be passed '
+                             'together')
+        if not causal:
+            raise ValueError('chunked-prefill flash is causal only')
+        if skv != kv_split + sq:
+            raise ValueError(
+                f'kv must be [cache({kv_split}); chunk({sq})] rows, got '
+                f'skv={skv}')
+    elif causal and sq != skv:
         raise ValueError(
             f'causal flash kernel assumes sq == skv (got {sq} vs {skv}); '
             'use ops.attention with q_offset for cached prefill/decode')
@@ -398,5 +547,11 @@ def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
     qt = q.transpose(0, 2, 1, 3)
     kt = k.transpose(0, 2, 1, 3)
     vt = v.transpose(0, 2, 1, 3)
-    out = _flash_bhsd(qt, kt, vt, scale, causal, block_q, block_k, interpret)
+    if cache_len is not None:
+        out = _fwd_chunk(qt, kt, vt, cache_len, scale=scale,
+                         split=kv_split, block_q=block_q,
+                         block_k=block_k, interpret=interpret)
+    else:
+        out = _flash_bhsd(qt, kt, vt, scale, causal, block_q, block_k,
+                          interpret)
     return out.transpose(0, 2, 1, 3)

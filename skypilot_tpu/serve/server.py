@@ -37,14 +37,22 @@ class ModelServer:
                  max_seq: int = 1024, port: int = 8081,
                  model_path: Optional[str] = None,
                  quantize: Optional[str] = None,
-                 kv_cache: str = 'paged', page_size: int = 128,
-                 prefill_w8a8: bool = False):
+                 kv_cache: str = 'paged',
+                 page_size: Optional[int] = None,
+                 prefill_w8a8: bool = False,
+                 prefill_chunk_tokens: Optional[int] = None,
+                 decode_priority_ratio: Optional[float] = None):
         self.cfg_name = cfg_name
         self.model_path = model_path  # HF checkpoint dir (real weights)
         self.quantize = quantize      # 'int8' => int8 weights + KV cache
         self.kv_cache = kv_cache      # 'slot' | 'paged' (prefix caching)
-        self.page_size = page_size    # paged-cache page granularity
+        self.page_size = page_size    # paged granularity (None = auto)
         self.prefill_w8a8 = prefill_w8a8  # int8 activations on prefill
+        # Chunked-prefill scheduler knobs (None = engine defaults):
+        # chunk width and the decode share of the interleaved token
+        # budget while prompts are mid-prefill.
+        self.prefill_chunk_tokens = prefill_chunk_tokens
+        self.decode_priority_ratio = decode_priority_ratio
         self.max_batch = max_batch
         self.max_seq = max_seq
         self.port = port
@@ -60,6 +68,12 @@ class ModelServer:
         self._stream_queues: Dict[int, 'queue.Queue'] = {}
         self._requests_served = 0
         self._requests_aborted = 0
+        # Rolling TTFT window for /metrics (median/p90): the serve
+        # autoscaler and operators watch these to see the chunked
+        # scheduler holding its latency SLO. Bounded so a long-lived
+        # replica's metrics reflect CURRENT traffic, not its lifetime.
+        import collections
+        self._ttfts: 'collections.deque' = collections.deque(maxlen=512)
         self._httpd: Optional[http.server.ThreadingHTTPServer] = None
         self._stopping = False
         self._engine_thread: Optional[threading.Thread] = None
@@ -72,8 +86,13 @@ class ModelServer:
         from skypilot_tpu.models.tokenizer import load_tokenizer
         engine_cls = (PagedInferenceEngine if self.kv_cache == 'paged'
                       else InferenceEngine)
-        extra = ({'page_size': self.page_size}
-                 if self.kv_cache == 'paged' else {})
+        extra = {}
+        if self.kv_cache == 'paged' and self.page_size is not None:
+            extra['page_size'] = self.page_size
+        if self.prefill_chunk_tokens is not None:
+            extra['prefill_chunk_tokens'] = self.prefill_chunk_tokens
+        if self.decode_priority_ratio is not None:
+            extra['decode_priority_ratio'] = self.decode_priority_ratio
         extra['prefill_w8a8'] = self.prefill_w8a8
         if self.model_path:
             # Real weights: HF checkpoint dir (config.json + safetensors
@@ -191,6 +210,8 @@ class ModelServer:
             req = self.engine.pop_finished(rid)
             del self._finished_events[rid]
             self._requests_served += 1
+            if req.ttft_ms is not None:
+                self._ttfts.append(req.ttft_ms)
         hit_eos = (req.eos_id is not None and req.output
                    and req.output[-1] == req.eos_id)
         return {
@@ -230,8 +251,11 @@ class ModelServer:
         it as aborted, not served."""
         with self._lock:
             self._stream_queues.pop(rid, None)
-            if self.engine.pop_finished(rid) is not None:
+            req = self.engine.pop_finished(rid)
+            if req is not None:
                 self._requests_served += 1
+                if req.ttft_ms is not None:
+                    self._ttfts.append(req.ttft_ms)
             elif self.engine.cancel(rid):
                 self._requests_aborted += 1
 
@@ -262,12 +286,32 @@ class ModelServer:
                         self._json(503, {'status': 'loading'})
                 elif self.path == '/metrics':
                     eng = server.engine
-                    self._json(200, {
+                    ttfts = sorted(server._ttfts)
+                    payload = {
                         'requests_served': server._requests_served,
                         'requests_aborted': server._requests_aborted,
                         'active_slots': eng.num_active if eng else 0,
+                        'queue_depth': eng.queue_depth if eng else 0,
+                        # Slots still streaming prompt chunks in —
+                        # decodable occupancy = active - this.
+                        'prefill_inflight': (len(getattr(
+                            eng, '_prefill_off', ())) if eng else 0),
                         'max_batch': server.max_batch,
-                    })
+                        'ttft_ms_median': (round(
+                            ttfts[len(ttfts) // 2], 1)
+                            if ttfts else None),
+                        'ttft_ms_p90': (round(
+                            ttfts[int(len(ttfts) * 0.9)], 1)
+                            if ttfts else None),
+                        'ttft_window': len(ttfts),
+                        'scheduler': {
+                            'prefill_chunk_tokens': getattr(
+                                eng, 'chunk', None),
+                            'decode_priority_ratio': getattr(
+                                eng, 'decode_priority_ratio', None),
+                        },
+                    }
+                    self._json(200, payload)
                 elif self.path == '/v1/models':
                     self._json(200, {
                         'object': 'list',
@@ -574,10 +618,24 @@ def main() -> None:
                              'prefix caching, chunked prefill and '
                              'continuous admission; slot = fixed '
                              'per-slot reservations')
-    parser.add_argument('--page-size', type=int, default=128,
+    parser.add_argument('--page-size', type=int, default=None,
                         help='paged-cache page granularity (tokens); '
-                             'int8 decode needs a multiple of 128 to '
-                             'stay on the manual-DMA fast path')
+                             'default auto-selects a fast-path size '
+                             '(int8 decode needs a multiple of 128 to '
+                             'stay on the manual-DMA fast path)')
+    parser.add_argument('--prefill-chunk-tokens', type=int, default=None,
+                        help='chunked-prefill chunk width (tokens); '
+                             'prompts prefill in chunks interleaved '
+                             'with decode so running requests keep '
+                             'streaming behind long prompts. Engine '
+                             'default 256; 0 = monolithic prefill '
+                             '(slot engine only)')
+    parser.add_argument('--decode-priority-ratio', type=float,
+                        default=None,
+                        help='decode share of the interleaved token '
+                             'budget while prompts are mid-prefill '
+                             '(0..1); higher favors streaming TPOT, '
+                             'lower favors TTFT. Default: engine-tuned')
     parser.add_argument('--prefill-w8a8', action='store_true',
                         help='quantize prefill activations to int8 '
                              '(2x MXU rate on the compute-bound '
@@ -589,7 +647,7 @@ def main() -> None:
                         default=int(os.environ.get('SKYTPU_REPLICA_PORT',
                                                    '8081')))
     args = parser.parse_args()
-    if args.kv_cache != 'paged' and args.page_size != 128:
+    if args.kv_cache != 'paged' and args.page_size is not None:
         parser.error('--page-size only applies with --kv-cache paged')
     server = ModelServer(args.model, max_batch=args.max_batch,
                          max_seq=args.max_seq, port=args.port,
@@ -597,7 +655,9 @@ def main() -> None:
                          quantize=args.quantize,
                          kv_cache=args.kv_cache,
                          page_size=args.page_size,
-                         prefill_w8a8=args.prefill_w8a8)
+                         prefill_w8a8=args.prefill_w8a8,
+                         prefill_chunk_tokens=args.prefill_chunk_tokens,
+                         decode_priority_ratio=args.decode_priority_ratio)
     server.start(block=True)
 
 

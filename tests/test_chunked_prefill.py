@@ -1,0 +1,281 @@
+"""Chunked prefill in the slot engine + prefill/decode interleaving.
+
+The fast (not-slow) tests are the tier-1 scheduler smoke: CPU, tiny
+config, one compile apiece — they pin that the chunked path is ON by
+default, that decode makes progress while a long prompt is mid-prefill,
+and the host-side scheduler arithmetic (interleave budget, page-size
+auto-select) with no device work at all. The compile-heavy equivalence
+matrix (chunked == monolithic across slot/paged/int8/prefix-hit) rides
+the slow tier with the other engine suites.
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from skypilot_tpu.inference.engine import InferenceEngine
+from skypilot_tpu.inference.paged import PagedInferenceEngine
+from skypilot_tpu.models import configs, llama
+
+
+@pytest.fixture(scope='module')
+def setup():
+    cfg = configs.TINY
+    params = llama.init_params(jax.random.PRNGKey(0), cfg)
+    return cfg, params
+
+
+def _greedy_reference(params, cfg, prompt, n):
+    """Greedy decode via repeated full forwards (no cache)."""
+    toks = list(prompt)
+    out = []
+    for _ in range(n):
+        logits, _ = llama.forward(params, jnp.asarray([toks], jnp.int32),
+                                  cfg)
+        nxt = int(jnp.argmax(logits[0, -1]))
+        out.append(nxt)
+        toks.append(nxt)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Fast tier: scheduler smoke (tier-1 exercises the chunked path)
+# ---------------------------------------------------------------------------
+class TestSchedulerSmoke:
+
+    def test_chunked_on_by_default(self, setup):
+        cfg, params = setup
+        eng = InferenceEngine(cfg, params, max_batch=2, max_seq=128,
+                              attn_impl='xla')
+        assert eng.chunked and eng.chunk == 256
+        assert PagedInferenceEngine(cfg, params, max_batch=2,
+                                    max_seq=128, page_size=8,
+                                    attn_impl='xla').chunk == 256
+
+    def test_decode_progresses_while_long_prompt_prefills(self, setup):
+        """The scheduler unit contract: with request A decoding, a long
+        prompt B prefills in chunks and A gains tokens BETWEEN chunks
+        (bounded TPOT during admission) — plus the chunked output
+        matches the no-cache greedy reference."""
+        cfg, params = setup
+        eng = InferenceEngine(cfg, params, max_batch=2, max_seq=256,
+                              attn_impl='xla', prefill_chunk_tokens=16)
+        a = eng.add_request([3, 1, 4, 1, 5], max_new_tokens=64)
+        while eng._prefill_off or eng._queue:
+            eng.step(horizon=1)
+        # B needs ~8 chunks; each step runs at most one chunk batch and
+        # then decodes.
+        prompt_b = [(i * 7 + 3) % cfg.vocab_size for i in range(120)]
+        b = eng.add_request(prompt_b, max_new_tokens=4)
+        saw_interleave = False
+        for _ in range(10):
+            events = eng.step(horizon=2)
+            if eng._prefill_off and any(rid == a for rid, _, _ in events):
+                saw_interleave = True
+        assert saw_interleave
+        done = eng.run_to_completion(horizon=4)
+        assert done[b].output == _greedy_reference(params, cfg,
+                                                   prompt_b, 4)
+
+    def test_interleave_horizon_token_budget(self, setup):
+        """Host-only arithmetic: the decode_priority_ratio budget
+        h = r/(1-r) * chunk * n / active."""
+        cfg, params = setup
+        eng = InferenceEngine(cfg, params, max_batch=8, max_seq=128,
+                              prefill_chunk_tokens=64,
+                              decode_priority_ratio=0.5)
+        # 2 decodable slots + 1 mid-prefill -> h = 1 * 64 * 1 / 2 = 32
+        for s in range(3):
+            eng._slots[s] = object()
+        eng._prefill_off[2] = 0
+        assert eng._interleave_horizon() == 32
+        eng.decode_priority_ratio = 0.2        # 0.25 * 64 / 2 = 8
+        assert eng._interleave_horizon() == 8
+        eng.decode_priority_ratio = 1.0        # decode never capped
+        assert eng._interleave_horizon() == eng._HORIZON_BUCKETS[-1]
+        # no decodable slots: prefill must not wait on decode
+        eng._prefill_off = {0: 0, 1: 0, 2: 0}
+        eng.decode_priority_ratio = 0.5
+        assert eng._interleave_horizon() == 1
+        eng._slots = [None] * 8                # don't step this engine
+        eng._prefill_off = {}
+
+    def test_paged_page_size_auto_select(self, setup):
+        """Auto page size stays on the fast path and never warns; an
+        explicit misaligned int8 size keeps the loud warning."""
+        import warnings
+        cfg, params = setup
+        with warnings.catch_warnings(record=True) as w_auto:
+            warnings.simplefilter('always')
+            eng = PagedInferenceEngine(cfg, params, max_batch=2,
+                                       max_seq=96, quantize='int8',
+                                       attn_impl='xla')
+        assert not any('multiple of 128' in str(x.message)
+                       for x in w_auto)
+        # CPU/gather path: no 128-alignment constraint; short-context
+        # configs get small pages instead of one page per slot.
+        assert eng.page == 16
+        with warnings.catch_warnings(record=True) as w:
+            warnings.simplefilter('always')
+            PagedInferenceEngine(cfg, params, max_batch=2, max_seq=96,
+                                 quantize='int8', attn_impl='xla',
+                                 page_size=8)
+        assert any('multiple of 128' in str(x.message) for x in w)
+
+
+# ---------------------------------------------------------------------------
+# Slow tier: equivalence matrix (chunked == monolithic)
+# ---------------------------------------------------------------------------
+@pytest.mark.slow
+class TestChunkedEquivalence:
+
+    def _mono(self, cfg, params, prompts, n_new, **kw):
+        eng = InferenceEngine(cfg, params, max_batch=4, max_seq=256,
+                              attn_impl='xla', prefill_chunk_tokens=0,
+                              **kw)
+        rids = [eng.add_request(p, max_new_tokens=n_new)
+                for p in prompts]
+        done = eng.run_to_completion(horizon=4)
+        return [done[r].output for r in rids]
+
+    def test_slot_chunked_matches_monolithic(self, setup):
+        cfg, params = setup
+        prompts = [[3, 1, 4, 1, 5],
+                   [(i * 5 + 2) % cfg.vocab_size for i in range(150)],
+                   [9],
+                   [(i * 11 + 7) % cfg.vocab_size for i in range(40)]]
+        want = self._mono(cfg, params, prompts, 8)
+        eng = InferenceEngine(cfg, params, max_batch=4, max_seq=256,
+                              attn_impl='xla', prefill_chunk_tokens=32)
+        rids = [eng.add_request(p, max_new_tokens=8) for p in prompts]
+        done = eng.run_to_completion(horizon=4)
+        got = [done[r].output for r in rids]
+        assert got == want, (got, want)
+
+    def test_slot_chunked_int8_generates(self, setup):
+        cfg, params = setup
+        eng = InferenceEngine(cfg, params, max_batch=2, max_seq=256,
+                              quantize='int8', prefill_chunk_tokens=32)
+        rid = eng.add_request(list(range(1, 100)), max_new_tokens=6)
+        done = eng.run_to_completion(horizon=4)
+        assert len(done[rid].output) == 6
+
+    def test_paged_chunked_matches_monolithic_slot(self, setup):
+        """Paged chunked prefill — WITHOUT and then WITH a prefix-cache
+        hit (tail-only prefill) — matches monolithic slot outputs."""
+        cfg, params = setup
+        shared = [(i * 5 + 2) % cfg.vocab_size for i in range(64)]
+        p1 = shared + [11, 12]
+        p2 = shared + [13, 14, 15]
+        want = self._mono(cfg, params, [p1, p2], 6)
+        eng = PagedInferenceEngine(cfg, params, max_batch=2,
+                                   max_seq=256, page_size=8, chunk=16,
+                                   attn_impl='xla')
+        r1 = eng.add_request(p1, max_new_tokens=6)
+        done = eng.run_to_completion(horizon=4)
+        assert done[r1].output == want[0]      # cold (no prefix hit)
+        r2 = eng.add_request(p2, max_new_tokens=6)
+        done = eng.run_to_completion(horizon=4)
+        assert eng.alloc.prefix_hits >= 1      # tail-only prefill
+        assert done[r2].output == want[1]
+
+    def test_prefill_rows_chunked_logits_match(self, setup):
+        """Model-layer equivalence: a prompt prefilled as two chunks
+        against gathered cache rows produces the same last logits and
+        KV rows as one monolithic prefill_rows call."""
+        cfg, params = setup
+        n, plen, half = 2, 64, 32
+        toks = np.array([[(i * 7 + r * 13 + 3) % cfg.vocab_size
+                          for i in range(plen)] for r in range(n)],
+                        np.int32)
+        lens = jnp.full((n,), plen, jnp.int32)
+        last_mono, (k_mono, v_mono) = llama.prefill_rows(
+            params, jnp.asarray(toks), lens, cfg, attn_impl='xla')
+        # chunk 1: plain causal (offset 0)
+        _, (k1, v1) = llama.prefill_rows(
+            params, jnp.asarray(toks[:, :half]),
+            jnp.full((n,), half, jnp.int32), cfg, attn_impl='xla')
+        # chunk 2: attends chunk 1's rows at a nonzero cache offset
+        starts = jnp.full((n,), half, jnp.int32)
+        last_chunk, (k2, v2) = llama.prefill_rows(
+            params, jnp.asarray(toks[:, half:]),
+            jnp.full((n,), half, jnp.int32), cfg, attn_impl='xla',
+            cache_kv=(k1, v1), cache_len=starts)
+        np.testing.assert_allclose(np.asarray(last_chunk),
+                                   np.asarray(last_mono),
+                                   rtol=2e-2, atol=2e-2)
+        np.testing.assert_allclose(
+            np.asarray(jnp.concatenate([k1, k2], axis=2)
+                       .astype(jnp.float32)),
+            np.asarray(k_mono.astype(jnp.float32)),
+            rtol=2e-2, atol=2e-2)
+
+    def test_sampling_through_chunked_completion(self, setup):
+        """A completing chunk samples its first token on device with
+        the request's params; hot sampling still yields varied, valid
+        tokens, and top_p->0 collapses to the greedy output."""
+        cfg, params = setup
+        eng = InferenceEngine(cfg, params, max_batch=2, max_seq=256,
+                              attn_impl='xla', prefill_chunk_tokens=16,
+                              rng_seed=7)
+        prompt = [(i * 3 + 1) % cfg.vocab_size for i in range(40)]
+        g = eng.add_request(prompt, max_new_tokens=10)
+        h = eng.add_request(prompt, max_new_tokens=10,
+                            temperature=2.0, top_p=1e-6)
+        done = eng.run_to_completion(horizon=4)
+        assert done[g].output == done[h].output
+        eng2 = InferenceEngine(cfg, params, max_batch=1, max_seq=256,
+                               attn_impl='xla',
+                               prefill_chunk_tokens=16, rng_seed=7)
+        rid = eng2.add_request(prompt, max_new_tokens=12,
+                               temperature=2.0, top_k=50)
+        out = eng2.run_to_completion(horizon=4)[rid].output
+        assert len(out) == 12
+        assert all(0 <= t < cfg.vocab_size for t in out)
+
+    def test_cancel_mid_prefill_frees_slot(self, setup):
+        cfg, params = setup
+        eng = InferenceEngine(cfg, params, max_batch=1, max_seq=256,
+                              attn_impl='xla', prefill_chunk_tokens=16)
+        rid = eng.add_request(list(range(1, 150)), max_new_tokens=8)
+        eng.step(horizon=1)                    # first chunk in flight
+        assert eng._prefill_off
+        assert eng.cancel(rid)
+        assert not eng._prefill_off and eng.num_active == 0
+        r2 = eng.add_request([7, 8], max_new_tokens=3)
+        done = eng.run_to_completion(horizon=4)
+        assert len(done[r2].output) == 3 and rid not in done
+
+
+@pytest.mark.slow
+class TestFlashChunkKernel:
+    """The flash forward's nonzero-cache-offset path (interpret mode on
+    CPU) matches the XLA two-block softmax exactly."""
+
+    def test_chunk_path_matches_cached_attention(self):
+        from skypilot_tpu.ops.attention import cached_attention
+        from skypilot_tpu.ops.flash_attention import flash_attention
+        b, s, S, h, hkv, d = 2, 128, 256, 4, 2, 128
+        ks = jax.random.split(jax.random.PRNGKey(0), 5)
+        q = jax.random.normal(ks[0], (b, s, h, d), jnp.float32)
+        kn = jax.random.normal(ks[1], (b, s, hkv, d), jnp.float32)
+        vn = jax.random.normal(ks[2], (b, s, hkv, d), jnp.float32)
+        ck = jax.random.normal(ks[3], (b, S, hkv, d), jnp.float32)
+        cv = jax.random.normal(ks[4], (b, S, hkv, d), jnp.float32)
+        # one row mid-prompt, one at offset 0 (no live cache rows)
+        cl = jnp.array([100, 0], jnp.int32)
+        ref = cached_attention(q, kn, vn, ck, cv, cl)
+        out = flash_attention(q, jnp.concatenate([ck, kn], 1),
+                              jnp.concatenate([cv, vn], 1), causal=True,
+                              cache_len=cl, kv_split=S, interpret=True)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=2e-3, atol=2e-3)
+
+    def test_chunk_path_validates_layout(self):
+        from skypilot_tpu.ops.flash_attention import flash_attention
+        q = jnp.zeros((1, 128, 2, 128))
+        kv = jnp.zeros((1, 200, 2, 128))
+        with pytest.raises(ValueError, match='cache'):
+            flash_attention(q, kv, kv, causal=True,
+                            cache_len=jnp.zeros(1, jnp.int32),
+                            kv_split=128, interpret=True)
