@@ -170,11 +170,14 @@ class RpcChannel:
                 proc.stdin.close()
             proc.terminate()
             proc.wait(timeout=2)
-        except Exception:  # pylint: disable=broad-except
+        except Exception as e:  # pylint: disable=broad-except
+            logger.debug(f'channel close: terminate failed '
+                         f'({type(e).__name__}: {e}); killing')
             try:
                 proc.kill()
-            except Exception:  # pylint: disable=broad-except
-                pass
+            except Exception as e2:  # pylint: disable=broad-except
+                logger.debug(f'channel close: kill failed too '
+                             f'({type(e2).__name__}: {e2})')
 
 
 _channels: Dict[Tuple, RpcChannel] = {}

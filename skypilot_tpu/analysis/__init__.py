@@ -1,0 +1,19 @@
+"""graftcheck: repo-specific static analysis + runtime jaxpr audit.
+
+Part A (``rules``/``lint``) is an AST lint over the package enforcing
+the concurrency and TPU hot-path discipline the serving tier depends
+on; part B (``jaxpr_audit``) traces the engines' decode/chunked-prefill
+steps at runtime and proves them host-transfer-free and
+recompile-stable. Both gate the tier-1 test suite via
+``tests/test_analysis.py`` and run standalone as the ``graftcheck``
+CLI. The lint half is stdlib-only; jax is required only for the audit.
+"""
+from skypilot_tpu.analysis.lint import (default_baseline_path,
+                                        lint_paths, load_baseline,
+                                        write_baseline)
+from skypilot_tpu.analysis.rules import RULES, Violation, check_source
+
+__all__ = [
+    'RULES', 'Violation', 'check_source', 'lint_paths', 'load_baseline',
+    'write_baseline', 'default_baseline_path',
+]

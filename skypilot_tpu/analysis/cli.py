@@ -1,0 +1,103 @@
+"""The ``graftcheck`` console entry point.
+
+Subcommands:
+
+- ``graftcheck lint [paths...]`` (default when omitted) — AST lint;
+  exit 1 on violations not covered by the baseline or inline
+  suppressions. ``--update-baseline`` rewrites the baseline from the
+  current violations (review before committing).
+- ``graftcheck audit [--preset slot|slot-monolithic|paged|llama]`` —
+  runtime jaxpr audit of the engines' hot loops (requires jax); exit 1
+  on unsanctioned host transfers, steady-state recompiles, callback
+  primitives, or float64 promotions.
+- ``graftcheck rules`` — list the rule set.
+"""
+from __future__ import annotations
+
+import argparse
+import sys
+from typing import List, Optional
+
+
+def _cmd_lint(args: argparse.Namespace) -> int:
+    from skypilot_tpu.analysis import lint
+    baseline = lint.load_baseline(args.baseline)
+    new, old = lint.lint_paths(args.paths or None, baseline=baseline)
+    if args.update_baseline:
+        path = lint.write_baseline(new + old, args.baseline)
+        print(f'graftcheck: baseline with {len(new) + len(old)} '
+              f'fingerprint(s) written to {path}')
+        return 0
+    for v in sorted(new, key=lambda v: (v.path, v.line)):
+        print(v.format())
+    stale = baseline - {v.fingerprint for v in old}
+    if stale and args.verbose:
+        print(f'note: {len(stale)} baseline entr(ies) no longer match '
+              'any violation — prune with --update-baseline')
+    print(f'graftcheck lint: {len(new)} violation(s), '
+          f'{len(old)} baselined')
+    return 1 if new else 0
+
+
+def _cmd_audit(args: argparse.Namespace) -> int:
+    from skypilot_tpu.analysis import jaxpr_audit
+    try:
+        import jax  # noqa: F401
+    except ImportError:
+        print('graftcheck audit requires jax (the compute extra)')
+        return 2
+    reports = jaxpr_audit.run_presets(args.preset or None)
+    rc = 0
+    for rep in reports:
+        print(rep.format())
+        if not rep.ok():
+            rc = 1
+    return rc
+
+
+def _cmd_rules(_args: argparse.Namespace) -> int:
+    from skypilot_tpu.analysis import rules as rules_lib
+    for rule, desc in sorted(rules_lib.RULES.items()):
+        print(f'{rule}  {desc}')
+    return 0
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog='graftcheck',
+        description='skypilot-tpu static analysis + jaxpr audit')
+    sub = parser.add_subparsers(dest='cmd')
+
+    p_lint = sub.add_parser('lint', help='AST lint (GC1xx/GC2xx rules)')
+    p_lint.add_argument('paths', nargs='*',
+                        help='files/dirs (default: the whole package)')
+    p_lint.add_argument('--baseline', default=None,
+                        help='baseline file (default: '
+                             'graftcheck.baseline at the repo root)')
+    p_lint.add_argument('--update-baseline', action='store_true',
+                        help='rewrite the baseline from current '
+                             'violations')
+    p_lint.add_argument('-v', '--verbose', action='store_true')
+
+    p_audit = sub.add_parser('audit',
+                             help='runtime jaxpr audit of engine hot '
+                                  'loops (requires jax)')
+    p_audit.add_argument('--preset', action='append',
+                         choices=['slot', 'slot-monolithic', 'paged',
+                                  'llama'],
+                         help='repeatable; default: slot, paged, llama')
+
+    sub.add_parser('rules', help='list the rule set')
+
+    args = parser.parse_args(argv)
+    if args.cmd == 'audit':
+        return _cmd_audit(args)
+    if args.cmd == 'rules':
+        return _cmd_rules(args)
+    if args.cmd is None:
+        args = parser.parse_args(['lint'] + (argv or []))
+    return _cmd_lint(args)
+
+
+if __name__ == '__main__':
+    sys.exit(main())

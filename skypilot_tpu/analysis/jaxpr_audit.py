@@ -1,0 +1,393 @@
+"""graftcheck part B: runtime jaxpr + host-transfer auditor.
+
+Proves, at runtime, the invariants the slot/paged engines' performance
+depends on (SageServe/ThunderServe-class serving wins hinge on a
+sync-free, recompile-stable steady-state loop — PAPERS.md):
+
+1. **Host-transfer freedom** — while an engine steps in steady state,
+   no device value is read back to host except through the sanctioned
+   :func:`skypilot_tpu.utils.host.host_sync` helper (the async
+   pipeline's lagged readback). jax's native ``transfer_guard`` is a
+   no-op on the zero-copy CPU backend CI runs on, so the interceptor
+   patches the actual Python sync entry points instead
+   (``ArrayImpl.__float__/__int__/__bool__/.item()/.tolist()``,
+   ``jax.device_get``, ``np.asarray``/``np.array``) — backend
+   independent by construction.
+2. **Recompile stability** — the decode (and chunked-prefill) jit
+   caches do not grow across repeated same-shaped calls; the observed
+   static keys (horizon, sample, kv_bucket) that form the recompile
+   key are reported.
+3. **Jaxpr hygiene** — the traced decode/prefill/forward jaxprs
+   contain no host-callback primitives and no unexpected wide-dtype
+   promotions (anything promoting to float64 on a TPU program is a
+   bug); donation misses surface as captured compile warnings.
+
+Pre-existing violations live in the same baseline mechanism as the AST
+lint (the pytest gate hard-fails on new ones).
+"""
+from __future__ import annotations
+
+import contextlib
+import dataclasses
+import traceback
+import warnings
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+_CALLBACK_PRIMS = {'pure_callback', 'io_callback', 'debug_callback',
+                   'callback', 'outside_call', 'host_callback_call'}
+
+
+@dataclasses.dataclass
+class TransferEvent:
+    kind: str          # '__float__' | 'item' | 'np.asarray' | ...
+    sanctioned: bool   # made inside host_sync()/host_block()
+    where: str         # innermost skypilot_tpu frame 'file:line (fn)'
+
+    def __str__(self):
+        tag = 'sanctioned' if self.sanctioned else 'UNSANCTIONED'
+        return f'[{tag}] {self.kind} at {self.where}'
+
+
+@dataclasses.dataclass
+class AuditReport:
+    name: str
+    transfers: List[TransferEvent] = dataclasses.field(
+        default_factory=list)
+    compile_counts: Dict[str, Tuple[int, int]] = dataclasses.field(
+        default_factory=dict)           # label -> (before, after)
+    static_keys: List[Dict[str, Any]] = dataclasses.field(
+        default_factory=list)           # observed decode static args
+    callback_prims: List[str] = dataclasses.field(default_factory=list)
+    promotions: List[str] = dataclasses.field(default_factory=list)
+    f64_promotions: List[str] = dataclasses.field(default_factory=list)
+    donation_warnings: List[str] = dataclasses.field(
+        default_factory=list)
+
+    @property
+    def unsanctioned_transfers(self) -> List[TransferEvent]:
+        return [t for t in self.transfers if not t.sanctioned]
+
+    @property
+    def recompiles(self) -> Dict[str, int]:
+        return {k: after - before
+                for k, (before, after) in self.compile_counts.items()}
+
+    def ok(self) -> bool:
+        return (not self.unsanctioned_transfers
+                and not any(self.recompiles.values())
+                and not self.callback_prims
+                and not self.f64_promotions)
+
+    def format(self) -> str:
+        lines = [f'jaxpr audit: {self.name} — '
+                 f'{"OK" if self.ok() else "VIOLATIONS"}']
+        lines.append(f'  host transfers: {len(self.transfers)} total, '
+                     f'{len(self.unsanctioned_transfers)} unsanctioned')
+        for t in self.unsanctioned_transfers:
+            lines.append(f'    {t}')
+        for label, (before, after) in self.compile_counts.items():
+            lines.append(f'  compile cache [{label}]: {before} -> '
+                         f'{after} ({after - before} recompiles in '
+                         'steady state)')
+        if self.static_keys:
+            keys = sorted({tuple(sorted(k.items()))
+                           for k in self.static_keys})
+            lines.append(f'  recompile key (observed static args): '
+                         f'{[dict(k) for k in keys]}')
+        if self.callback_prims:
+            lines.append(f'  host-callback primitives: '
+                         f'{self.callback_prims}')
+        if self.promotions:
+            lines.append(f'  dtype promotions: {self.promotions[:8]}'
+                         + (' ...' if len(self.promotions) > 8 else ''))
+        if self.f64_promotions:
+            lines.append(f'  float64 promotions (BUG on TPU): '
+                         f'{self.f64_promotions}')
+        if self.donation_warnings:
+            lines.append(f'  donation misses: {self.donation_warnings}')
+        return '\n'.join(lines)
+
+
+# ------------------------------------------------------------------ intercept
+def _caller_frame() -> str:
+    """Innermost stack frame inside skypilot_tpu but outside this
+    module / the host helper — where the sync was requested."""
+    for frame in reversed(traceback.extract_stack(limit=40)):
+        fn = frame.filename.replace('\\', '/')
+        if ('skypilot_tpu' in fn and 'analysis/jaxpr_audit' not in fn
+                and 'utils/host' not in fn):
+            short = fn.split('skypilot_tpu/', 1)[-1]
+            return f'{short}:{frame.lineno} ({frame.name})'
+    return '<outside skypilot_tpu>'
+
+
+@contextlib.contextmanager
+def intercept_host_transfers(events: List[TransferEvent]):
+    """Record every device->host materialization made while active.
+
+    Patches the Python-level sync entry points on jax's ArrayImpl plus
+    the module-level ``jax.device_get`` / ``np.asarray`` / ``np.array``
+    names. Re-entrant internal calls (device_get materializes via
+    ``_value``) are collapsed to one event via a depth guard. Events
+    made inside host_sync()/host_block() are marked sanctioned."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from skypilot_tpu.utils import host as host_lib
+
+    array_t = type(jnp.zeros((), jnp.int32))
+    depth = [0]
+
+    def record(kind: str) -> None:
+        if depth[0] == 0:
+            events.append(TransferEvent(
+                kind=kind, sanctioned=host_lib.in_sanctioned_sync(),
+                where=_caller_frame()))
+
+    def wrap_method(name: str):
+        orig = getattr(array_t, name)
+
+        def patched(self, *args, **kwargs):
+            record(name)
+            depth[0] += 1
+            try:
+                return orig(self, *args, **kwargs)
+            finally:
+                depth[0] -= 1
+        return orig, patched
+
+    def wrap_module(mod, name: str, kind: str, check_first_arg: bool):
+        orig = getattr(mod, name)
+
+        def patched(*args, **kwargs):
+            is_dev = bool(args) and isinstance(args[0], array_t)
+            if not check_first_arg or is_dev:
+                record(kind)
+            depth[0] += 1
+            try:
+                return orig(*args, **kwargs)
+            finally:
+                depth[0] -= 1
+        return orig, patched
+
+    method_names = ['__array__', '__float__', '__int__', '__bool__',
+                    '__index__', 'item', 'tolist']
+    saved_methods = {}
+    for name in method_names:
+        try:
+            orig, patched = wrap_method(name)
+            setattr(array_t, name, patched)
+            saved_methods[name] = orig
+        except (AttributeError, TypeError):
+            continue
+    saved_mods = []
+    for mod, name, kind, chk in [
+            (jax, 'device_get', 'jax.device_get', False),
+            (np, 'asarray', 'np.asarray', True),
+            (np, 'array', 'np.array', True)]:
+        try:
+            orig, patched = wrap_module(mod, name, kind, chk)
+            setattr(mod, name, patched)
+            saved_mods.append((mod, name, orig))
+        except (AttributeError, TypeError):
+            continue
+    try:
+        yield events
+    finally:
+        for name, orig in saved_methods.items():
+            setattr(array_t, name, orig)
+        for mod, name, orig in saved_mods:
+            setattr(mod, name, orig)
+
+
+# ------------------------------------------------------------------- jaxpr
+def walk_jaxpr(jaxpr) -> Tuple[List[str], List[str]]:
+    """Recursively walk a (closed) jaxpr: returns (callback primitive
+    names, dtype-promotion descriptions from convert_element_type eqns
+    that WIDEN the element type)."""
+    import numpy as np
+    callbacks: List[str] = []
+    promotions: List[str] = []
+
+    def visit(jx) -> None:
+        jx = getattr(jx, 'jaxpr', jx)
+        for eqn in jx.eqns:
+            name = eqn.primitive.name
+            if name in _CALLBACK_PRIMS:
+                callbacks.append(name)
+            if name == 'convert_element_type' and eqn.invars:
+                src = getattr(eqn.invars[0].aval, 'dtype', None)
+                dst = eqn.params.get('new_dtype')
+                if (src is not None and dst is not None
+                        and np.dtype(dst).itemsize
+                        > np.dtype(src).itemsize):
+                    promotions.append(f'{src} -> {np.dtype(dst).name}')
+            for param in eqn.params.values():
+                for sub in (param if isinstance(param, (list, tuple))
+                            else [param]):
+                    if hasattr(sub, 'eqns') or hasattr(sub, 'jaxpr'):
+                        visit(sub)
+    visit(jaxpr)
+    return callbacks, promotions
+
+
+def check_donation(jit_fn, *args, **kwargs) -> List[str]:
+    """Compile ``jit_fn`` for the given arguments, capturing
+    donation-miss warnings ('Some donated buffers were not usable',
+    'buffer donations ... ignored')."""
+    with warnings.catch_warnings(record=True) as caught:
+        warnings.simplefilter('always')
+        jit_fn.lower(*args, **kwargs).compile()
+    return [str(w.message) for w in caught
+            if 'donat' in str(w.message).lower()]
+
+
+def _cache_size(fn) -> int:
+    getter = getattr(fn, '_cache_size', None)
+    if getter is None:
+        return -1
+    try:
+        return int(getter())
+    except (TypeError, ValueError):    # jax-internal API drift
+        return -1
+
+
+def _jit_fns(fn) -> List[Any]:
+    """The jitted function(s) behind ``fn``: itself if jitted, else any
+    jitted functions captured in its closure (the paged engine's decode
+    is a plain wrapper enqueueing two jitted programs)."""
+    if hasattr(fn, '_cache_size'):
+        return [fn]
+    out = []
+    for cell in getattr(fn, '__closure__', None) or ():
+        obj = cell.cell_contents
+        if hasattr(obj, '_cache_size'):
+            out.append(obj)
+    return out
+
+
+# ------------------------------------------------------------------ presets
+def _tiny_engine(kind: str, chunked: bool):
+    from skypilot_tpu.models import configs
+    cfg = configs.get_config('tiny')
+    chunk = 16 if chunked else 0
+    if kind == 'paged':
+        from skypilot_tpu.inference.paged import PagedInferenceEngine
+        return PagedInferenceEngine(cfg, max_batch=4, max_seq=128,
+                                    prefill_chunk_tokens=chunk or None)
+    from skypilot_tpu.inference.engine import InferenceEngine
+    return InferenceEngine(cfg, max_batch=4, max_seq=128,
+                           prefill_chunk_tokens=chunk)
+
+
+def _drive(engine, prompts: List[List[int]], max_new: int = 8) -> None:
+    for p in prompts:
+        engine.add_request(list(p), max_new_tokens=max_new)
+    engine.run_to_completion(horizon=8)
+
+
+def _record_static_keys(engine, report: AuditReport):
+    """Shim the engine's decode fn to log the static args of each call
+    — the (horizon, sample[, kv_bucket]) tuple IS the recompile key the
+    scheduler must keep stable. The slot engine's decode takes
+    (..., horizon, sample, kv_bucket); the paged engine's
+    (..., horizon, sample) — both pass them as trailing positionals."""
+    inner = engine._decode_fn
+    names = (('horizon', 'sample')
+             if type(engine).__name__.startswith('Paged')
+             else ('horizon', 'sample', 'kv_bucket'))
+
+    def shim(*args, **kwargs):
+        key = {k: kwargs[k] for k in names if k in kwargs}
+        missing = [k for k in names if k not in key]
+        if missing:
+            tail = args[len(args) - len(missing):]
+            key.update(dict(zip(missing, tail)))
+        report.static_keys.append(key)
+        return inner(*args, **kwargs)
+
+    engine._decode_fn = shim
+    return inner
+
+
+def audit_engine(kind: str = 'slot', chunked: bool = True,
+                 rounds: int = 2) -> AuditReport:
+    """Build a tiny engine, run one warmup wave (compiles allowed),
+    then audit ``rounds`` identical same-shaped waves: every compile
+    and every unsanctioned host transfer in those waves is a violation.
+
+    ``kind``: 'slot' | 'paged'. ``chunked``: prompts longer than one
+    chunk so the chunked-prefill path (cursor chunks + completing
+    chunk) is exercised, not just monolithic admission."""
+    report = AuditReport(
+        name=f'{kind} engine '
+             f'({"chunked prefill + " if chunked else ""}decode)')
+    engine = _tiny_engine(kind, chunked)
+    prompts = [[1, 2, 3] * 9, [4, 5] * 10, [7] * 21]   # spans >1 chunk
+    _drive(engine, prompts)                             # warmup: compiles
+    inner = _record_static_keys(engine, report)
+    decode_jits = _jit_fns(inner)
+    labels = {'decode': lambda: (sum(_cache_size(f)
+                                     for f in decode_jits)
+                                 if decode_jits else -1)}
+    chunk_fns = getattr(engine, '_chunk_prefill_fns', None)
+    if chunk_fns is not None:
+        labels['chunk_prefill'] = lambda: len(chunk_fns)
+    prefill_fns = getattr(engine, '_prefill_fns', None)
+    if prefill_fns is not None:
+        labels['prefill'] = lambda: len(prefill_fns)
+    before = {k: get() for k, get in labels.items()}
+    with intercept_host_transfers(report.transfers):
+        for _ in range(rounds):
+            _drive(engine, prompts)        # identical shapes: no compiles
+    engine._decode_fn = inner
+    report.compile_counts = {
+        k: (before[k], get()) for k, get in labels.items()}
+    # Jaxpr of the fused decode step itself (the hot program).
+    try:
+        import jax
+        from skypilot_tpu.models import llama
+        cfg = engine.cfg
+        if kind == 'slot':
+            jx = jax.make_jaxpr(
+                lambda p, c, t: llama.decode_horizon(
+                    p, c, t, cfg, horizon=4, kv_bucket=64))(
+                        engine.params, engine.cache, engine._tok_dev)
+            report.callback_prims, report.promotions = walk_jaxpr(jx)
+            report.f64_promotions = [
+                p for p in report.promotions if 'float64' in p]
+    except Exception as e:  # pragma: no cover - trace-shape drift
+        report.promotions.append(f'<jaxpr trace failed: {e}>')
+    return report
+
+
+def audit_llama_forward() -> AuditReport:
+    """Static jaxpr audit of the llama training/prefill forward."""
+    import jax
+    import jax.numpy as jnp
+
+    from skypilot_tpu.models import configs, llama
+    report = AuditReport(name='llama forward (jaxpr)')
+    cfg = configs.get_config('tiny')
+    params = llama.init_params(jax.random.PRNGKey(0), cfg)
+    tokens = jnp.zeros((2, 16), jnp.int32)
+    jx = jax.make_jaxpr(
+        lambda p, t: llama.forward(p, t, cfg))(params, tokens)
+    report.callback_prims, report.promotions = walk_jaxpr(jx)
+    report.f64_promotions = [p for p in report.promotions
+                             if 'float64' in p]
+    return report
+
+
+PRESETS: Dict[str, Callable[[], AuditReport]] = {
+    'slot': lambda: audit_engine('slot', chunked=True),
+    'slot-monolithic': lambda: audit_engine('slot', chunked=False),
+    'paged': lambda: audit_engine('paged', chunked=True),
+    'llama': audit_llama_forward,
+}
+
+
+def run_presets(names: Optional[List[str]] = None) -> List[AuditReport]:
+    names = names or ['slot', 'paged', 'llama']
+    return [PRESETS[n]() for n in names]

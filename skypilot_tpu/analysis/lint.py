@@ -1,0 +1,89 @@
+"""graftcheck lint driver: file discovery, baseline handling, reporting.
+
+The baseline file (``graftcheck.baseline`` at the repo root) holds one
+violation fingerprint per line for pre-existing violations that are
+understood and deliberately retained; the pytest gate
+(``tests/test_analysis.py``) fails on any violation NOT in the
+baseline, so new violations cannot land while old ones cannot silently
+multiply. Regenerate with ``graftcheck lint --update-baseline`` only
+after reviewing each retained entry.
+"""
+from __future__ import annotations
+
+import os
+from typing import Iterable, List, Optional, Set, Tuple
+
+from skypilot_tpu.analysis import rules as rules_lib
+
+BASELINE_NAME = 'graftcheck.baseline'
+
+
+def repo_root() -> str:
+    """The directory containing the ``skypilot_tpu`` package."""
+    return os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+
+
+def default_baseline_path() -> str:
+    return os.path.join(repo_root(), BASELINE_NAME)
+
+
+def iter_py_files(paths: Iterable[str]) -> List[str]:
+    out: List[str] = []
+    for path in paths:
+        if os.path.isfile(path):
+            out.append(os.path.abspath(path))
+            continue
+        for dirpath, dirnames, filenames in os.walk(path):
+            dirnames[:] = [d for d in dirnames
+                           if d not in ('__pycache__', '.git')]
+            out.extend(os.path.join(dirpath, f)
+                       for f in sorted(filenames) if f.endswith('.py'))
+    return out
+
+
+def load_baseline(path: Optional[str] = None) -> Set[str]:
+    path = path or default_baseline_path()
+    if not os.path.exists(path):
+        return set()
+    with open(path, encoding='utf-8') as f:
+        return {line.rstrip('\n') for line in f
+                if line.strip() and not line.startswith('#')}
+
+
+def write_baseline(violations: List[rules_lib.Violation],
+                   path: Optional[str] = None) -> str:
+    path = path or default_baseline_path()
+    with open(path, 'w', encoding='utf-8') as f:
+        f.write('# graftcheck baseline: reviewed pre-existing '
+                'violations (one fingerprint per line).\n'
+                '# Regenerate with `graftcheck lint --update-baseline` '
+                'after reviewing each entry.\n')
+        for fp in sorted({v.fingerprint for v in violations}):
+            f.write(fp + '\n')
+    return path
+
+
+def lint_paths(paths: Optional[Iterable[str]] = None,
+               baseline: Optional[Set[str]] = None,
+               ) -> Tuple[List[rules_lib.Violation],
+                          List[rules_lib.Violation]]:
+    """Lint ``paths`` (default: the whole ``skypilot_tpu`` package).
+    Returns (new_violations, baselined_violations)."""
+    root = repo_root()
+    if paths is None:
+        paths = [os.path.join(root, 'skypilot_tpu')]
+    if baseline is None:
+        baseline = load_baseline()
+    new: List[rules_lib.Violation] = []
+    old: List[rules_lib.Violation] = []
+    for fpath in iter_py_files(paths):
+        rel = os.path.relpath(fpath, root).replace(os.sep, '/')
+        try:
+            with open(fpath, encoding='utf-8') as f:
+                source = f.read()
+        except (OSError, UnicodeDecodeError):
+            continue
+        for v in rules_lib.check_source(rel, source):
+            (old if v.fingerprint in baseline else new).append(v)
+    return new, old

@@ -1,0 +1,556 @@
+"""graftcheck part A: repo-specific AST lint rules.
+
+The reference SkyPilot is ~94k LoC of lock-and-thread Python whose
+concurrency discipline lives in reviewers' heads; this module turns the
+discipline this repo actually relies on into machine-checked rules.
+Two families:
+
+Concurrency / control-plane hygiene (GC1xx):
+
+- **GC101 unlocked-state-write** — an attribute that is written under a
+  class's threading lock somewhere is part of that lock's protected
+  state; writing it without the lock elsewhere is a race.
+- **GC102 blocking-under-lock** — ``time.sleep``, socket/HTTP I/O,
+  subprocess waits, unbounded ``.wait()/.get()/.join()``, and (under a
+  *threading* lock) sqlite-backed state-module or cluster-RPC calls
+  stall every thread contending for the lock. Locks whose name marks
+  them as DB-serialization locks (``db_lock``, ``_state_lock``,
+  ``_scheduler_lock``, ``FileLock``) are exempt from the state-module
+  check only — serializing DB access is their entire job.
+- **GC103 rpc-no-timeout** — ``urlopen``/``create_connection`` without
+  a timeout turns a wedged peer into a wedged controller.
+- **GC104 bare-except** — ``except:`` catches ``KeyboardInterrupt`` and
+  ``SystemExit``; never acceptable.
+- **GC105 swallowed-except** — ``except Exception`` whose body neither
+  logs, raises, nor does any real work erases the only evidence of a
+  failure. (Narrow exception types may be silently dropped; broad ones
+  may not.)
+- **GC107 handler-no-timeout** — an ``http.server`` request handler
+  without a ``timeout`` class attribute lets one slow-loris client pin
+  a server thread forever.
+
+TPU hot-path hygiene (GC2xx), applied to the compute layer
+(``inference/``, ``models/``, ``ops/``, ``train/``):
+
+- **GC201 impure-jit** — impure or host-synchronizing calls inside a
+  ``@jax.jit`` body (``time.time``, ``print``, ``np.*``, ``.item()``,
+  ``float()`` on a traced value) either fail at trace time or bake a
+  constant into the compiled program.
+- **GC202 host-sync** — device->host readbacks outside the sanctioned
+  :func:`skypilot_tpu.utils.host.host_sync` helper (bare
+  ``np.asarray(x)``, ``.item()``, ``jax.device_get``,
+  ``block_until_ready``, ``float(x)``). One accidental sync in the
+  decode loop costs a dispatch round trip (~100 ms through a remote
+  PJRT tunnel) *per step*. ``np.asarray(x, dtype)`` — the explicit
+  host-side conversion idiom — is allowed; the bare one-argument form
+  is the classic accidental-sync spelling.
+
+Suppression: ``# graftcheck: disable=GC102`` (comma-list or ``all``)
+on the offending line, or a checked-in baseline (``graftcheck.baseline``)
+of fingerprints for pre-existing violations — new ones hard-fail.
+"""
+from __future__ import annotations
+
+import ast
+import dataclasses
+import io
+import re
+import tokenize
+from typing import Dict, List, Optional, Set, Tuple
+
+RULES: Dict[str, str] = {
+    'GC101': 'unlocked-state-write: attribute guarded by a lock '
+             'elsewhere is written without holding it',
+    'GC102': 'blocking-under-lock: blocking call (sleep / socket / '
+             'subprocess / sqlite state / cluster RPC / unbounded wait) '
+             'while holding a lock',
+    'GC103': 'rpc-no-timeout: network call with no timeout',
+    'GC104': 'bare-except: except: catches KeyboardInterrupt/SystemExit',
+    'GC105': 'swallowed-except: broad except whose body neither logs, '
+             'raises, nor acts',
+    'GC107': 'handler-no-timeout: http.server handler class without a '
+             'timeout attribute (slow-loris pins a thread)',
+    'GC201': 'impure-jit: impure or host-synchronizing call inside a '
+             '@jax.jit body',
+    'GC202': 'host-sync: device->host readback outside the '
+             'host_sync()/host_block() helpers (compute layer only)',
+}
+
+# Directories (relative to the package root) where the GC2xx hot-path
+# rules apply.
+COMPUTE_DIRS = ('inference', 'models', 'ops', 'train')
+
+# The sanctioned-sync helper module: GC202 does not apply to its own
+# implementation.
+HOST_HELPER_SUFFIX = 'utils/host.py'
+
+_SUPPRESS_RE = re.compile(r'graftcheck:\s*disable=([A-Za-z0-9,\s]+)')
+
+# --------------------------------------------------------------------- GC102
+# Calls that block regardless of what lock is held.
+_ALWAYS_BLOCKING = {
+    'time.sleep', 'sleep',
+    'urllib.request.urlopen', 'urlopen',
+    'subprocess.run', 'subprocess.call', 'subprocess.check_call',
+    'subprocess.check_output',
+    'socket.create_connection',
+}
+# Methods that block regardless of arguments.
+_BLOCKING_METHODS = {'recv', 'accept', 'communicate', 'serve_forever'}
+# Methods that block *unboundedly* when called with no args and no
+# timeout= (Event.wait, Queue.get, Thread.join, Popen.wait).
+_UNBOUNDED_WAIT_METHODS = {'wait', 'get', 'join'}
+# sqlite-backed state modules and cluster-RPC-grade modules: calling
+# them under a *threading* lock stalls every contending thread behind
+# disk/SSH latency. (Under a DB-named lock the sqlite calls are the
+# point.)
+_STATE_MODULES = {'state', 'serve_state', 'global_state', 'job_lib',
+                  'agent_job_lib'}
+_RPC_MODULES = {'core', 'execution', 'backend_utils', 'provisioner'}
+
+# --------------------------------------------------------------------- GC201
+_IMPURE_IN_JIT = {
+    'time.time', 'time.sleep', 'time.monotonic', 'time.perf_counter',
+    'print', 'open', 'input',
+    'np.asarray', 'np.array', 'numpy.asarray', 'numpy.array',
+    'jax.device_get', 'jax.block_until_ready',
+}
+_IMPURE_PREFIXES_IN_JIT = ('np.random.', 'numpy.random.', 'random.')
+
+_LOCK_FACTORIES = {'threading.Lock', 'threading.RLock',
+                   'threading.Condition', 'Lock', 'RLock', 'Condition'}
+_DB_LOCK_MARKERS = ('db_lock', 'state_lock', 'scheduler_lock', 'filelock')
+
+
+@dataclasses.dataclass
+class Violation:
+    rule: str
+    path: str               # repo-relative path
+    line: int
+    col: int
+    func: str               # enclosing scope qualname ('' = module)
+    message: str
+    source: str             # stripped source line
+
+    @property
+    def fingerprint(self) -> str:
+        """Stable identity for the baseline: deliberately excludes the
+        line number so unrelated edits above a known violation don't
+        invalidate the suppression."""
+        return f'{self.path}::{self.rule}::{self.func}::{self.source}'
+
+    def format(self) -> str:
+        return (f'{self.path}:{self.line}:{self.col}: {self.rule} '
+                f'{self.message}\n    {self.source}')
+
+
+def _dotted(node: ast.AST) -> Optional[str]:
+    """'a.b.c' for Name/Attribute chains, else None."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return '.'.join(reversed(parts))
+    return None
+
+
+def _self_attr(node: ast.AST) -> Optional[str]:
+    """'x' for ``self.x`` (through one Subscript level: ``self.x[k]``)."""
+    if isinstance(node, ast.Subscript):
+        node = node.value
+    if (isinstance(node, ast.Attribute)
+            and isinstance(node.value, ast.Name)
+            and node.value.id == 'self'):
+        return node.attr
+    return None
+
+
+def _has_timeout(call: ast.Call) -> bool:
+    return any(kw.arg == 'timeout' for kw in call.keywords)
+
+
+def _lock_category(item: ast.AST, lock_attrs: Set[str],
+                   db_locals: Optional[Set[str]] = None) -> Optional[str]:
+    """Classify a with-item expression: None (not a lock), 'thread'
+    (in-process mutual exclusion), or 'db' (a lock whose purpose is
+    serializing DB/file access — sqlite calls under it are exempt).
+    ``db_locals`` are local names known to hold file locks
+    (``x = filelock.FileLock(...)``)."""
+    expr = item
+    if isinstance(expr, ast.Call):
+        expr = expr.func
+    name = _dotted(expr)
+    if name is None:
+        return None
+    low = name.lower()
+    attr = _self_attr(expr)
+    if any(m in low for m in _DB_LOCK_MARKERS):
+        return 'db'
+    if db_locals and isinstance(expr, ast.Name) and expr.id in db_locals:
+        return 'db'
+    if attr is not None and attr in lock_attrs:
+        return 'thread'
+    if 'lock' in low.rsplit('.', 1)[-1]:
+        return 'thread'
+    return None
+
+
+class _ClassPrepass(ast.NodeVisitor):
+    """First pass over a ClassDef: find lock attributes and the set of
+    self-attributes ever written while holding one (the lock's
+    protected state)."""
+
+    def __init__(self):
+        self.lock_attrs: Set[str] = set()
+        self.guarded_attrs: Set[str] = set()
+        self._lock_depth = 0
+        self._in_init = False
+
+    def visit_FunctionDef(self, node):
+        outer = self._in_init
+        if node.name in ('__init__', '__new__'):
+            self._in_init = True
+        self.generic_visit(node)
+        self._in_init = outer
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def visit_Assign(self, node):
+        value = node.value
+        factory = None
+        if isinstance(value, ast.Call):
+            factory = _dotted(value.func)
+        for tgt in node.targets:
+            attr = _self_attr(tgt)
+            if attr is None:
+                continue
+            if factory in _LOCK_FACTORIES:
+                self.lock_attrs.add(attr)
+            elif self._lock_depth and not self._in_init:
+                self.guarded_attrs.add(attr)
+        self.generic_visit(node)
+
+    def visit_AugAssign(self, node):
+        attr = _self_attr(node.target)
+        if attr and self._lock_depth and not self._in_init:
+            self.guarded_attrs.add(attr)
+        self.generic_visit(node)
+
+    def visit_With(self, node):
+        held = any(_lock_category(i.context_expr, self.lock_attrs)
+                   == 'thread' for i in node.items)
+        self._lock_depth += 1 if held else 0
+        self.generic_visit(node)
+        self._lock_depth -= 1 if held else 0
+
+
+class _Checker(ast.NodeVisitor):
+
+    def __init__(self, rel: str, lines: List[str], is_compute: bool):
+        self.rel = rel
+        self.lines = lines
+        self.is_compute = is_compute
+        self.violations: List[Violation] = []
+        self._scope: List[str] = []
+        self._class: List[Tuple[Set[str], Set[str]]] = []  # (locks, guarded)
+        self._locks: List[str] = []     # categories of locks held
+        self._db_locals: Set[str] = set()   # names bound to FileLocks
+        self._jit_depth = 0
+        self._in_init = 0
+
+    # ------------------------------------------------------------ helpers
+    def _add(self, rule: str, node: ast.AST, message: str) -> None:
+        line = getattr(node, 'lineno', 1)
+        src = (self.lines[line - 1].strip()
+               if 0 < line <= len(self.lines) else '')
+        self.violations.append(Violation(
+            rule=rule, path=self.rel, line=line,
+            col=getattr(node, 'col_offset', 0) + 1,
+            func='.'.join(self._scope), message=message, source=src))
+
+    @property
+    def _lock_attrs(self) -> Set[str]:
+        return self._class[-1][0] if self._class else set()
+
+    @property
+    def _guarded(self) -> Set[str]:
+        return self._class[-1][1] if self._class else set()
+
+    def _thread_lock_held(self) -> bool:
+        return 'thread' in self._locks
+
+    def _any_lock_held(self) -> bool:
+        return bool(self._locks)
+
+    # ------------------------------------------------------------- scopes
+    def visit_ClassDef(self, node):
+        pre = _ClassPrepass()
+        pre.visit(node)
+        self._class.append((pre.lock_attrs, pre.guarded_attrs))
+        self._scope.append(node.name)
+        self._check_handler_timeout(node)
+        self.generic_visit(node)
+        self._scope.pop()
+        self._class.pop()
+
+    def _check_handler_timeout(self, node: ast.ClassDef) -> None:
+        bases = {(_dotted(b) or '').rsplit('.', 1)[-1]
+                 for b in node.bases}
+        if not bases & {'BaseHTTPRequestHandler', 'StreamRequestHandler',
+                        'SimpleHTTPRequestHandler'}:
+            return
+        for stmt in node.body:
+            if isinstance(stmt, ast.Assign) and any(
+                    isinstance(t, ast.Name) and t.id == 'timeout'
+                    for t in stmt.targets):
+                return
+            if (isinstance(stmt, ast.AnnAssign)
+                    and isinstance(stmt.target, ast.Name)
+                    and stmt.target.id == 'timeout'):
+                return
+        self._add('GC107', node,
+                  f'{node.name} extends an http.server handler but sets '
+                  'no `timeout` class attribute — a slow-loris client '
+                  'pins one server thread forever')
+
+    def _is_jit_decorated(self, node) -> bool:
+        for dec in node.decorator_list:
+            d = dec
+            if isinstance(d, ast.Call):
+                fname = _dotted(d.func)
+                if fname in ('jax.jit', 'jit'):
+                    return True
+                if fname in ('functools.partial', 'partial') and d.args:
+                    if _dotted(d.args[0]) in ('jax.jit', 'jit'):
+                        return True
+                continue
+            if _dotted(d) in ('jax.jit', 'jit'):
+                return True
+        return False
+
+    def visit_FunctionDef(self, node):
+        jit = self._is_jit_decorated(node)
+        self._jit_depth += 1 if jit else 0
+        self._in_init += 1 if node.name in ('__init__', '__new__') else 0
+        self._scope.append(node.name)
+        self.generic_visit(node)
+        self._scope.pop()
+        self._in_init -= 1 if node.name in ('__init__', '__new__') else 0
+        self._jit_depth -= 1 if jit else 0
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def visit_With(self, node):
+        cats = [c for c in (_lock_category(i.context_expr,
+                                           self._lock_attrs,
+                                           self._db_locals)
+                            for i in node.items) if c]
+        self._locks.extend(cats)
+        self.generic_visit(node)
+        del self._locks[len(self._locks) - len(cats):]
+
+    visit_AsyncWith = visit_With
+
+    # ------------------------------------------------------------- GC101
+    def _check_state_write(self, target: ast.AST, node: ast.AST) -> None:
+        attr = _self_attr(target)
+        if (attr and attr in self._guarded and attr not in self._lock_attrs
+                and not self._in_init and not self._thread_lock_held()):
+            self._add('GC101', node,
+                      f'self.{attr} is written under a lock elsewhere in '
+                      'this class but written here without it')
+
+    def visit_Assign(self, node):
+        if isinstance(node.value, ast.Call):
+            factory = _dotted(node.value.func) or ''
+            if factory.rsplit('.', 1)[-1] == 'FileLock':
+                self._db_locals.update(
+                    t.id for t in node.targets
+                    if isinstance(t, ast.Name))
+        for tgt in node.targets:
+            self._check_state_write(tgt, node)
+        self.generic_visit(node)
+
+    def visit_AugAssign(self, node):
+        self._check_state_write(node.target, node)
+        self.generic_visit(node)
+
+    # ----------------------------------------------------------- excepts
+    def visit_ExceptHandler(self, node):
+        if node.type is None:
+            if not self._reraises(node):
+                self._add('GC104', node,
+                          'bare `except:` (catches KeyboardInterrupt / '
+                          'SystemExit); catch Exception or narrower')
+        elif self._is_broad(node.type) and self._is_swallowed(node):
+            self._add('GC105', node,
+                      'broad except swallows the failure silently — log '
+                      'it, re-raise, or narrow the exception type')
+        self.generic_visit(node)
+
+    @staticmethod
+    def _is_broad(type_node: ast.AST) -> bool:
+        names = ([_dotted(e) for e in type_node.elts]
+                 if isinstance(type_node, ast.Tuple)
+                 else [_dotted(type_node)])
+        return any(n in ('Exception', 'BaseException') for n in names)
+
+    @staticmethod
+    def _reraises(node: ast.ExceptHandler) -> bool:
+        return any(isinstance(n, ast.Raise)
+                   for n in ast.walk(node))  # type: ignore[arg-type]
+
+    @staticmethod
+    def _is_swallowed(node: ast.ExceptHandler) -> bool:
+        """True when the handler body does nothing observable: no call
+        (logging or otherwise), no raise, no assignment — just
+        pass/continue/constant-return."""
+        for stmt in node.body:
+            for sub in ast.walk(stmt):
+                if isinstance(sub, (ast.Call, ast.Raise, ast.Assign,
+                                    ast.AugAssign, ast.Yield,
+                                    ast.YieldFrom)):
+                    return False
+        return True
+
+    # -------------------------------------------------------------- calls
+    def visit_Call(self, node):
+        name = _dotted(node.func) or ''
+        method = (node.func.attr
+                  if isinstance(node.func, ast.Attribute) else '')
+        self._check_timeouts(node, name)
+        if self._any_lock_held():
+            self._check_blocking_under_lock(node, name, method)
+        if self._jit_depth:
+            self._check_jit_purity(node, name, method)
+        elif self.is_compute:
+            self._check_host_sync(node, name, method)
+        self.generic_visit(node)
+
+    def _check_timeouts(self, node: ast.Call, name: str) -> None:
+        if name.rsplit('.', 1)[-1] == 'urlopen' and not _has_timeout(node):
+            self._add('GC103', node,
+                      'urlopen without timeout= — a wedged peer wedges '
+                      'this thread (and any lock it holds) forever')
+        elif (name.endswith('create_connection')
+              and not _has_timeout(node) and len(node.args) < 2):
+            self._add('GC103', node,
+                      'socket.create_connection without a timeout')
+
+    def _check_blocking_under_lock(self, node: ast.Call, name: str,
+                                   method: str) -> None:
+        if name in _ALWAYS_BLOCKING:
+            self._add('GC102', node,
+                      f'{name}() while holding a lock stalls every '
+                      'contending thread')
+            return
+        if method in _BLOCKING_METHODS:
+            self._add('GC102', node,
+                      f'.{method}() (blocking I/O) while holding a lock')
+            return
+        if (method in _UNBOUNDED_WAIT_METHODS and not node.args
+                and not _has_timeout(node)):
+            self._add('GC102', node,
+                      f'unbounded .{method}() while holding a lock — '
+                      'pass timeout= or move it outside the lock')
+            return
+        if self._thread_lock_held():
+            root = name.split('.', 1)[0]
+            if root in _STATE_MODULES and '.' in name:
+                self._add('GC102', node,
+                          f'sqlite-backed {name}() under a threading '
+                          'lock — hoist the DB write out of the hot '
+                          'lock (dedicated *_db_lock locks are exempt)')
+            elif root in _RPC_MODULES and '.' in name:
+                self._add('GC102', node,
+                          f'cluster RPC {name}() under a threading lock')
+
+    def _check_jit_purity(self, node: ast.Call, name: str,
+                          method: str) -> None:
+        if (name in _IMPURE_IN_JIT
+                or any(name.startswith(p)
+                       for p in _IMPURE_PREFIXES_IN_JIT)):
+            self._add('GC201', node,
+                      f'{name}() inside a @jax.jit body is impure or '
+                      'host-synchronizing — it runs at trace time, not '
+                      'per step')
+        elif method in ('item', 'block_until_ready') and not node.args:
+            self._add('GC201', node,
+                      f'.{method}() on a traced value inside @jax.jit')
+        elif (name in ('float', 'int', 'bool')
+              and len(node.args) == 1
+              and isinstance(node.args[0], (ast.Name, ast.Subscript))):
+            self._add('GC201', node,
+                      f'{name}() on a traced value inside @jax.jit '
+                      'forces a concretization error or a baked-in '
+                      'constant')
+
+    def _check_host_sync(self, node: ast.Call, name: str,
+                         method: str) -> None:
+        if name in ('jax.device_get', 'jax.block_until_ready'):
+            self._add('GC202', node,
+                      f'{name}() outside host_sync()/host_block() — '
+                      'route the readback through '
+                      'skypilot_tpu.utils.host')
+        elif method in ('item', 'block_until_ready') and not node.args:
+            self._add('GC202', node,
+                      f'.{method}() is an implicit device sync — use '
+                      'host_sync()/host_block()')
+        elif (name in ('np.asarray', 'numpy.asarray')
+              and len(node.args) == 1 and not node.keywords):
+            self._add('GC202', node,
+                      'bare np.asarray(x) on a (possibly device) array '
+                      'is the classic accidental sync — use host_sync() '
+                      'for readbacks, or np.asarray(x, dtype) for '
+                      'explicit host-side conversion')
+        elif (name == 'float' and len(node.args) == 1
+              and isinstance(node.args[0], (ast.Name, ast.Subscript))):
+            self._add('GC202', node,
+                      'float(x) implicitly syncs a device value — use '
+                      'host_sync()')
+
+
+def _line_suppressions(source: str) -> Dict[int, Set[str]]:
+    """line -> set of rule ids disabled on that line ('all' disables
+    everything)."""
+    out: Dict[int, Set[str]] = {}
+    try:
+        tokens = tokenize.generate_tokens(io.StringIO(source).readline)
+        for tok in tokens:
+            if tok.type != tokenize.COMMENT:
+                continue
+            m = _SUPPRESS_RE.search(tok.string)
+            if m:
+                rules = {r.strip().upper() if r.strip().lower() != 'all'
+                         else 'all' for r in m.group(1).split(',')}
+                out.setdefault(tok.start[0], set()).update(
+                    r for r in rules if r)
+    except tokenize.TokenizeError:
+        pass
+    return out
+
+
+def check_source(rel: str, source: str) -> List[Violation]:
+    """Run every rule over one file's source; returns violations with
+    line-level suppressions already applied."""
+    try:
+        tree = ast.parse(source)
+    except SyntaxError as e:
+        return [Violation(rule='GC000', path=rel, line=e.lineno or 1,
+                          col=e.offset or 1, func='',
+                          message=f'syntax error: {e.msg}', source='')]
+    norm = rel.replace('\\', '/')
+    is_compute = (any(f'/{d}/' in f'/{norm}' for d in COMPUTE_DIRS)
+                  and not norm.endswith(HOST_HELPER_SUFFIX))
+    checker = _Checker(norm, source.splitlines(), is_compute)
+    checker.visit(tree)
+    suppressed = _line_suppressions(source)
+    out = []
+    for v in checker.violations:
+        rules_off = suppressed.get(v.line, set())
+        if 'all' in rules_off or v.rule in rules_off:
+            continue
+        out.append(v)
+    return out

@@ -70,7 +70,9 @@ def _agent_healthy(handle) -> bool:
         runner = handle.head_runner()
         resp = provisioner.agent_request(runner, {'op': 'agent_health'})
         return bool(resp.get('agentd_alive'))
-    except Exception:  # pylint: disable=broad-except
+    except Exception as e:  # pylint: disable=broad-except
+        logger.debug(f'agent_health probe failed: '
+                     f'{type(e).__name__}: {e}')
         return False
 
 

@@ -308,8 +308,11 @@ class TpuVmBackend(backend_lib.Backend[TpuVmResourceHandle]):
         try:
             resp = provisioner.agent_request(handle.head_runner(),
                                              {'op': 'agent_health'})
-        except Exception:  # pylint: disable=broad-except
-            return          # unreachable agents are the refresh's problem
+        except Exception as e:  # pylint: disable=broad-except
+            logger.debug(f'agent_health on {handle.cluster_name} failed '
+                         f'({type(e).__name__}: {e}); unreachable '
+                         'agents are the refresh\'s problem')
+            return
         remote = resp.get('runtime_version')
         local = pkg_utils.package_hash()
         if remote is not None and remote != local:

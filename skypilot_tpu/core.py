@@ -102,8 +102,10 @@ def queue(cluster_name: str) -> List[Dict[str, Any]]:
         if health.get('ok') and health.get('agentd_alive') \
                 and table.get('ok'):
             return table['jobs']
-    except Exception:  # pylint: disable=broad-except
-        pass
+    except Exception as e:  # pylint: disable=broad-except
+        logger.debug(f'Fast job-queue path on {cluster_name} failed '
+                     f'({type(e).__name__}: {e}); falling back to full '
+                     'status reconciliation.')
     # Fallback: full status reconciliation (cloud truth), then the
     # plain read — the slow path for unhealthy/stale clusters.
     handle = backend_utils.check_cluster_available(cluster_name)

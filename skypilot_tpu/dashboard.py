@@ -14,6 +14,9 @@ import time
 from typing import Any, Dict, List, Optional, Tuple
 
 from skypilot_tpu import global_state
+from skypilot_tpu import tpu_logging
+
+logger = tpu_logging.init_logger(__name__)
 
 _PAGE = """<!doctype html>
 <html><head><title>skytpu dashboard</title>
@@ -86,7 +89,8 @@ def _managed_jobs() -> str:
     try:
         from skypilot_tpu import jobs
         table = jobs.queue()
-    except Exception:  # pylint: disable=broad-except — no controller up
+    except Exception as e:  # pylint: disable=broad-except
+        logger.debug(f'jobs queue unavailable: {type(e).__name__}: {e}')
         return '<div class="muted">no jobs controller running</div>'
     rows = [[str(j['job_id']), j.get('name', '-'), j.get('status', '-'),
              str(j.get('recovery_count', 0)),
@@ -98,7 +102,9 @@ def _services() -> str:
     try:
         from skypilot_tpu import serve
         svcs = serve.status()
-    except Exception:  # pylint: disable=broad-except — no serve controller
+    except Exception as e:  # pylint: disable=broad-except
+        logger.debug(f'serve status unavailable: '
+                     f'{type(e).__name__}: {e}')
         return '<div class="muted">no serve controller running</div>'
     rows = []
     for s in svcs:
@@ -132,6 +138,9 @@ def _metrics_json() -> str:
 
 
 class _Handler(http.server.BaseHTTPRequestHandler):
+    # Socket-op timeout (graftcheck GC107): a stalled client must not
+    # pin a dashboard thread forever.
+    timeout = 60
 
     def log_message(self, *args):
         del args

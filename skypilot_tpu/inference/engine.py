@@ -38,6 +38,7 @@ import numpy as np
 from skypilot_tpu.models import llama
 from skypilot_tpu.models.configs import ModelConfig
 from skypilot_tpu.parallel import mesh as mesh_lib
+from skypilot_tpu.utils.host import host_sync
 
 
 @dataclasses.dataclass
@@ -987,7 +988,10 @@ class InferenceEngine(_EngineBase):
         its cache rows sit past the corrected length and the slot's
         next prefill overwrites them."""
         entry = self._pending.popleft()
-        toks = np.asarray(entry['toks'])
+        # THE sanctioned device->host readback of the async pipeline:
+        # everything else in the step loop must stay device-side (the
+        # jaxpr audit gates on it).
+        toks = host_sync(entry['toks'])
         events: List[Tuple[int, int, bool]] = []
         now = time.time()
         if entry['kind'] == 'prefill':

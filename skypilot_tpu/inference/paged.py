@@ -44,6 +44,7 @@ from jax import lax
 from skypilot_tpu.models import llama
 from skypilot_tpu.models.configs import ModelConfig
 from skypilot_tpu.ops.attention import cached_attention, ring_decode_attention
+from skypilot_tpu.utils.host import host_sync
 
 Params = Dict[str, Any]
 
@@ -1385,7 +1386,9 @@ class PagedInferenceEngine(_EngineBase):
         checks."""
         events: List[Tuple[int, int, bool]] = []
         entry = self._pending.popleft()
-        vals = np.asarray(entry['toks'])
+        # THE sanctioned device->host readback of the async pipeline
+        # (jaxpr-audit-gated; see engine.py._process_one).
+        vals = host_sync(entry['toks'])
         now = time.time()
         if entry['kind'] == 'prefill':
             for slot, req, row in entry['batch']:

@@ -98,7 +98,10 @@ class JobsController:
         from skypilot_tpu.backend import backend_utils
         try:
             record, _ = backend_utils.refresh_cluster_status(cluster_name)
-        except Exception:  # pylint: disable=broad-except
+        except Exception as e:  # pylint: disable=broad-except
+            logger.info(f'Status refresh of {cluster_name} failed '
+                        f'({type(e).__name__}: {e}); treating as '
+                        'preemption.')
             return None
         if record is None or record['status'] != \
                 global_state.ClusterStatus.UP:
@@ -106,7 +109,10 @@ class JobsController:
         # Cluster looks UP; retry the poll once before giving up on it.
         try:
             return core.job_status(cluster_name, agent_job_id)
-        except Exception:  # pylint: disable=broad-except
+        except Exception as e:  # pylint: disable=broad-except
+            logger.info(f'Retried status poll on {cluster_name} failed '
+                        f'({type(e).__name__}: {e}); treating as '
+                        'preemption.')
             return None
 
     def _run_one_task(self, task_idx: int, task: Task) -> bool:
@@ -171,7 +177,9 @@ class JobsController:
                 return ''
             backend = tpu_backend.TpuVmBackend()
             return backend.get_job_logs(handle, agent_job_id, tail=20)
-        except Exception:  # pylint: disable=broad-except
+        except Exception as e:  # pylint: disable=broad-except
+            logger.debug(f'Could not fetch failure-log tail from '
+                         f'{cluster_name}: {type(e).__name__}: {e}')
             return ''
 
     # ------------------------------------------------------------ run

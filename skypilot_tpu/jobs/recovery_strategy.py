@@ -128,7 +128,10 @@ class StrategyExecutor:
         try:
             record, handle = backend_utils.refresh_cluster_status(
                 self.cluster_name)
-        except Exception:  # pylint: disable=broad-except
+        except Exception as e:  # pylint: disable=broad-except
+            logger.debug(f'Status refresh of {self.cluster_name} failed '
+                         f'({type(e).__name__}: {e}); relaunching '
+                         'instead of reusing.')
             return None
         if record is None or handle is None:
             return None

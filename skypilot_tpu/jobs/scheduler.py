@@ -10,6 +10,7 @@ from __future__ import annotations
 
 import contextlib
 import os
+import random
 import time
 
 from skypilot_tpu.jobs import state
@@ -24,14 +25,19 @@ def launch_slot(job_id: int, poll_seconds: float = 0.5):
     """Block until a launch slot is free, hold it for the with-body.
 
     Slot accounting lives in the state DB (schedule_state LAUNCHING),
-    guarded by the DB file lock so concurrent controllers serialize."""
+    guarded by the DB file lock so concurrent controllers serialize.
+    The slot check runs entirely under ``db_lock`` (count + set must be
+    atomic — two controllers passing the count check together would
+    both take the last slot); the sleep happens OUTSIDE it
+    (graftcheck GC102), jittered so a burst of waiting controllers
+    doesn't re-contend the file lock in lockstep every tick."""
     while True:
         with state.db_lock():
             if state.count_in_launch_phase() < max_parallel_launches():
                 state.set_schedule_state(job_id,
                                          state.ScheduleState.LAUNCHING)
                 break
-        time.sleep(poll_seconds)
+        time.sleep(poll_seconds * (0.5 + random.random()))
     try:
         yield
     finally:

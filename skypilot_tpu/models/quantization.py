@@ -19,6 +19,8 @@ from typing import Any, Dict, NamedTuple
 import jax
 import jax.numpy as jnp
 
+from skypilot_tpu.utils.host import host_block
+
 Params = Dict[str, Any]
 
 # Per-layer matmul weights worth quantizing: everything except norms
@@ -204,8 +206,8 @@ def quantize_params(params: Params, *, donate: bool = False) -> Params:
     def leaf(k, v):
         q = _quantize_array(v, _REDUCE_AXES[k])
         if donate and isinstance(v, jax.Array):
-            jax.block_until_ready(q)
-            v.delete()
+            host_block(q)       # barrier only — q must exist before
+            v.delete()          # its source buffer is freed
         return q
 
     return _map_quant_leaves(params, leaf)

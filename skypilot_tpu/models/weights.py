@@ -27,6 +27,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from skypilot_tpu.models.configs import ModelConfig
+from skypilot_tpu.utils.host import host_sync
 
 Params = Dict[str, Any]
 
@@ -250,7 +251,7 @@ def load_hf_params(path: str, cfg: ModelConfig,
                                   cfg.dtype)
         # Cast on host (numpy handles ml_dtypes) so only ONE device
         # buffer per leaf is ever live, not fp16+bf16 copies.
-        return jnp.asarray(np.asarray(a).astype(cfg.dtype))
+        return jnp.asarray(np.asarray(a, cfg.dtype))
 
     params: Params = {
         'embed': cast('embed', top['embed']),
@@ -387,7 +388,7 @@ def _save_int8_cache(cache_file: str, params: Params,
     entries = []
     off = 0
     for name, leaf in _flatten_leaves(params):
-        a = np.ascontiguousarray(np.asarray(leaf))
+        a = np.ascontiguousarray(host_sync(leaf))
         view = None
         if a.dtype == jnp.bfloat16:
             a = a.view(np.uint16)
@@ -510,7 +511,7 @@ def save_hf_checkpoint(path: str, cfg: ModelConfig, params: Params) -> None:
         # safetensors serializes the raw buffer while assuming C order —
         # silently scrambling strided input.
         return np.ascontiguousarray(
-            np.asarray(jnp.asarray(a, jnp.float32)), dtype=np.float32)
+            host_sync(jnp.asarray(a, jnp.float32)), dtype=np.float32)
 
     out['model.embed_tokens.weight'] = np_(params['embed'])
     out['model.norm.weight'] = np_(params['final_norm'])

@@ -30,6 +30,12 @@ _AGENT_READY_TIMEOUT = float(os.environ.get('SKYTPU_AGENT_READY_TIMEOUT',
                                             '60'))
 
 
+def _oneshot_rpc_timeout() -> float:
+    """Bound on a one-shot RPC exec (interpreter start + handler), kept
+    in line with the persistent channel's 120s request timeout."""
+    return float(os.environ.get('SKYTPU_RPC_TIMEOUT', '120'))
+
+
 def bulk_provision(provider_name: str,
                    region: str,
                    zone: Optional[str],
@@ -166,7 +172,10 @@ def agent_request(head_runner, request: Dict,
            f'{shlex.quote(head_runner.remote_python)} '
            f'-m {module} '
            f'{shlex.quote(json.dumps(request))}')
-    out = head_runner.check_run(cmd)
+    # Bounded like the channel path (graftcheck GC103 discipline): a
+    # wedged remote interpreter must not hang the caller's poll loop —
+    # and any lock it holds — forever.
+    out = head_runner.check_run(cmd, timeout=_oneshot_rpc_timeout())
     for line in out.splitlines():
         if line.startswith(agent_rpc.PAYLOAD_PREFIX):
             payload = json.loads(line[len(agent_rpc.PAYLOAD_PREFIX):])

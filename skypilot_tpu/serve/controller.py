@@ -141,6 +141,9 @@ class ServeController:
     # ------------------------------------------------------------- HTTP
     def _make_handler(controller):  # noqa: N805
         class Handler(http.server.BaseHTTPRequestHandler):
+            # Socket-op timeout (graftcheck GC107): a stalled LB/CLI
+            # peer must not pin a controller thread forever.
+            timeout = 60
 
             def log_message(self, *args):  # quiet
                 del args

@@ -197,8 +197,10 @@ def _purge_replica_clusters(service_name: str) -> None:
         if record['name'].startswith(prefix):
             try:
                 sky_core.down(record['name'])
-            except Exception:  # pylint: disable=broad-except
-                pass
+            except Exception as e:  # pylint: disable=broad-except
+                logger.warning(f'Purge: teardown of {record["name"]} '
+                               f'failed (it may leak): '
+                               f'{type(e).__name__}: {e}')
 
 
 def tail_logs(service_name: str, follow: bool = True) -> None:

@@ -96,6 +96,12 @@ class SkyServeLoadBalancer:
     def _make_handler(lb):  # noqa: N805
         class Handler(http.server.BaseHTTPRequestHandler):
             protocol_version = 'HTTP/1.1'
+            # Socket-op timeout (graftcheck GC107): a slow-loris client
+            # (or one that stops reading mid-proxy) must not pin an LB
+            # thread forever. Set above the 120s upstream urlopen
+            # timeout so healthy long requests are never cut by the LB
+            # first.
+            timeout = 150
 
             def log_message(self, *args):
                 del args

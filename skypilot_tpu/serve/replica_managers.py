@@ -80,6 +80,15 @@ class ReplicaManager:
         # RLock: _persist checks membership under the lock and is called
         # both with and without it held.
         self._lock = threading.RLock()
+        # DB-serialization lock (graftcheck GC102): sqlite row writes/
+        # deletes happen under THIS lock only, so probe sweeps and
+        # scale decisions contending on the hot ``_lock`` never stall
+        # behind disk I/O. Ordering: ``_db_lock`` is taken FIRST, then
+        # ``_lock`` briefly for the membership check — the row write
+        # then runs with only ``_db_lock`` held. A racing removal needs
+        # ``_db_lock`` too, so check+write stay atomic with respect to
+        # pop+delete and no phantom row can survive a removal.
+        self._db_lock = threading.Lock()
         self._shutdown = False
         self._launch_failures = 0
         self._backoff_until = 0.0
@@ -182,11 +191,12 @@ class ReplicaManager:
                         'launch; tearing its cluster down.')
             try:
                 core.down(info.cluster_name)
-            except Exception:  # pylint: disable=broad-except
-                pass
-            with self._lock:
-                self._replicas.pop(info.replica_id, None)
-            serve_state.remove_replica(self.service_name, info.replica_id)
+            except Exception as e:  # pylint: disable=broad-except
+                logger.warning(
+                    f'Teardown of abandoned replica cluster '
+                    f'{info.cluster_name} failed (it may leak): '
+                    f'{type(e).__name__}: {e}')
+            self._untrack(info.replica_id)
             return
         handle = global_state.get_handle_from_cluster_name(info.cluster_name)
         if handle is None:
@@ -238,10 +248,8 @@ class ReplicaManager:
                 rid for rid, r in self._replicas.items()
                 if r.status == serve_state.ReplicaStatus.FAILED)
             prune = failed_ids[:-_MAX_RETAINED_FAILED]
-            for rid in prune:
-                self._replicas.pop(rid, None)
-        for rid in prune:
-            serve_state.remove_replica(self.service_name, rid)
+        for rid in prune:      # outside _lock: _untrack takes _db_lock
+            self._untrack(rid)
 
     # ------------------------------------------------------------ teardown
     def scale_down(self, replica_id: int, status: Optional[
@@ -262,9 +270,7 @@ class ReplicaManager:
             except Exception as e:  # pylint: disable=broad-except
                 logger.warning(f'Teardown of {info.cluster_name} failed: '
                                f'{type(e).__name__}: {e}')
-            with self._lock:     # atomic with _persist's membership check
-                self._replicas.pop(replica_id, None)
-                serve_state.remove_replica(self.service_name, replica_id)
+            self._untrack(replica_id)  # atomic vs _persist (see _db_lock)
 
         threading.Thread(target=_down, daemon=True).start()
 
@@ -285,11 +291,11 @@ class ReplicaManager:
     def _sync_down(self, info: ReplicaInfo) -> None:
         try:
             core.down(info.cluster_name)
-        except Exception:  # pylint: disable=broad-except
-            pass
-        with self._lock:
-            self._replicas.pop(info.replica_id, None)
-            serve_state.remove_replica(self.service_name, info.replica_id)
+        except Exception as e:  # pylint: disable=broad-except
+            logger.warning(f'Teardown of {info.cluster_name} during '
+                           f'terminate_all failed (it may leak): '
+                           f'{type(e).__name__}: {e}')
+        self._untrack(info.replica_id)
 
     # ------------------------------------------------------------- probing
     def _probe_one(self, info: ReplicaInfo) -> bool:
@@ -306,7 +312,11 @@ class ReplicaManager:
             with urllib.request.urlopen(
                     req, timeout=self.spec.readiness_timeout_seconds) as r:
                 return 200 <= r.status < 300
-        except Exception:  # pylint: disable=broad-except
+        except Exception as e:  # pylint: disable=broad-except
+            # Routine while a replica boots; the consecutive-failure
+            # counters escalate, but the reason must stay observable.
+            logger.debug(f'Probe of replica {info.replica_id} ({url}) '
+                         f'failed: {type(e).__name__}: {e}')
             return False
 
     def _check_preempted(self, info: ReplicaInfo) -> bool:
@@ -318,8 +328,11 @@ class ReplicaManager:
         from skypilot_tpu.backend import backend_utils
         try:
             rec, _ = backend_utils.refresh_cluster_status(info.cluster_name)
-        except Exception:  # pylint: disable=broad-except
-            return False          # transient; keep probing
+        except Exception as e:  # pylint: disable=broad-except
+            logger.debug(f'Status refresh of {info.cluster_name} failed '
+                         f'(transient; keep probing): '
+                         f'{type(e).__name__}: {e}')
+            return False
         return rec is None or rec['status'] != global_state.ClusterStatus.UP
 
     def probe_all(self) -> None:
@@ -399,13 +412,24 @@ class ReplicaManager:
 
     def _persist(self, info: ReplicaInfo) -> None:
         """Write the replica row — only while the replica is still
-        tracked. Held under the manager lock so a concurrent
-        scale_down's pop+row-delete can't interleave with this write and
-        leave a phantom row for an untracked replica."""
-        with self._lock:
-            if self._replicas.get(info.replica_id) is not info:
-                return
+        tracked. ``_db_lock`` serializes this check+write against
+        ``_untrack``'s pop+delete, so a concurrent scale_down can't
+        leave a phantom row for an untracked replica; the hot ``_lock``
+        is held only for the in-memory membership check, never across
+        the sqlite write."""
+        with self._db_lock:
+            with self._lock:
+                if self._replicas.get(info.replica_id) is not info:
+                    return
             serve_state.add_or_update_replica(
                 self.service_name, info.replica_id, info.cluster_name,
                 info.status, info.url, info.version, info.is_spot,
                 port=info.port)
+
+    def _untrack(self, replica_id: int) -> None:
+        """Atomically drop a replica from the in-memory table AND its
+        DB row (the removal half of the ``_persist`` protocol)."""
+        with self._db_lock:
+            with self._lock:
+                self._replicas.pop(replica_id, None)
+            serve_state.remove_replica(self.service_name, replica_id)

@@ -14,9 +14,12 @@ import time
 from typing import Any, Dict
 import urllib.request
 
+from skypilot_tpu import tpu_logging
 from skypilot_tpu.agent import job_lib as agent_job_lib
 from skypilot_tpu.serve import serve_state
 from skypilot_tpu.utils import common_utils
+
+logger = tpu_logging.init_logger(__name__)
 
 PAYLOAD_PREFIX = 'SKYTPU_RPC_PAYLOAD:'
 
@@ -58,13 +61,16 @@ def _force_down(svc: Dict[str, Any]) -> None:
     if svc.get('agent_job_id'):
         try:
             agent_job_lib.cancel_job(svc['agent_job_id'])
-        except Exception:  # pylint: disable=broad-except
-            pass
+        except Exception as e:  # pylint: disable=broad-except
+            logger.warning(f'force-down {name}: cancel of controller '
+                           f'job failed: {type(e).__name__}: {e}')
     for rep in serve_state.get_replicas(name):
         try:
             sky_core.down(rep['cluster_name'])
-        except Exception:  # pylint: disable=broad-except
-            pass
+        except Exception as e:  # pylint: disable=broad-except
+            logger.warning(f'force-down {name}: teardown of '
+                           f'{rep["cluster_name"]} failed (it may '
+                           f'leak): {type(e).__name__}: {e}')
     serve_state.remove_service(name)
 
 
@@ -140,8 +146,10 @@ def handle(request: Dict[str, Any]) -> Dict[str, Any]:
                 headers={'Content-Type': 'application/json'})
             with urllib.request.urlopen(req, timeout=10):
                 pass
-        except Exception:  # pylint: disable=broad-except
-            pass
+        except Exception as e:  # pylint: disable=broad-except
+            logger.debug(f'update nudge to controller failed '
+                         f'(reconciled next tick): '
+                         f'{type(e).__name__}: {e}')
         return _ok(version=version)
     if op == 'down':
         name = request['service_name']

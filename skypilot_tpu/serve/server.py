@@ -262,6 +262,12 @@ class ModelServer:
     # --------------------------------------------------------------- HTTP
     def _make_handler(server):  # noqa: N805
         class Handler(http.server.BaseHTTPRequestHandler):
+            # Socket-op timeout (graftcheck GC107): a client that stops
+            # reading its stream must not pin a handler thread (and its
+            # engine slot) forever. Above the 300s stream-queue wait so
+            # a healthy-but-slow engine never trips it first; the
+            # finally: finish_stream path cancels the slot on timeout.
+            timeout = 330
 
             def log_message(self, *args):
                 del args

@@ -21,6 +21,8 @@ import json
 import os
 import time
 
+from skypilot_tpu.utils.host import host_scalars
+
 
 def main(argv=None) -> None:
     parser = argparse.ArgumentParser(prog='python -m skypilot_tpu.train')
@@ -127,10 +129,11 @@ def main(argv=None) -> None:
         if (step + 1) % args.log_every == 0 or step + 1 == args.steps:
             dt = time.time() - t0
             window = step + 1 - last_logged     # actual steps elapsed
+            m = host_scalars(metrics)   # explicit readback (GC202)
             print(json.dumps({
                 'step': step + 1,
-                'loss': round(float(metrics['loss']), 4),
-                'accuracy': round(float(metrics['accuracy']), 4),
+                'loss': round(m['loss'], 4),
+                'accuracy': round(m['accuracy'], 4),
                 'tok_s': round(args.batch * args.seq * window
                                / max(dt, 1e-9), 1),
             }), flush=True)

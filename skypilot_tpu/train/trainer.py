@@ -25,6 +25,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec
 from skypilot_tpu.models import llama
 from skypilot_tpu.models.configs import ModelConfig
 from skypilot_tpu.parallel import mesh as mesh_lib
+from skypilot_tpu.utils.host import host_scalars
 
 
 class TrainState(NamedTuple):
@@ -273,7 +274,7 @@ class Trainer:
             cbs.on_step_begin(step_no)
             state, metrics = self.step(state, batch)
             # Block so the timer measures compute, not dispatch.
-            metrics = {k: float(v) for k, v in metrics.items()}
+            metrics = host_scalars(metrics)
             cbs.on_step_end(step_no, metrics)
         cbs.on_train_end()
         return state

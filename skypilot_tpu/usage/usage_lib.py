@@ -17,7 +17,10 @@ import uuid
 from typing import Any, Dict, List, Optional
 
 from skypilot_tpu import config as config_lib
+from skypilot_tpu import tpu_logging
 from skypilot_tpu.utils import common_utils
+
+logger = tpu_logging.init_logger(__name__)
 
 _run_id: Optional[str] = None
 
@@ -70,8 +73,10 @@ def _maybe_push(entry: Dict[str, Any]) -> None:
                 endpoint, data=json.dumps(entry).encode(),
                 headers={'Content-Type': 'application/json'})
             urllib.request.urlopen(req, timeout=2)
-        except Exception:  # pylint: disable=broad-except
-            pass               # telemetry must never break a command
+        except Exception as e:  # pylint: disable=broad-except
+            # Telemetry must never break a command — but the failure
+            # should still be observable under SKYTPU_DEBUG.
+            logger.debug(f'usage push failed: {type(e).__name__}: {e}')
 
     # Fire-and-forget: a slow/unreachable collector must not stall the
     # command path.

@@ -49,7 +49,7 @@ def get_user_hash() -> str:
 def get_cleaned_username() -> str:
     try:
         return re.sub(r'[^a-z0-9-]', '-', getpass.getuser().lower())
-    except Exception:  # pylint: disable=broad-except
+    except (OSError, KeyError):   # no passwd entry / env in containers
         return 'unknown'
 
 

@@ -1,0 +1,316 @@
+"""graftcheck part A: rule unit tests on fixture snippets, plus the
+whole-repo regression gate (zero violations outside the checked-in
+baseline). The gate is what makes the concurrency/hot-path discipline
+machine-checked: a PR reintroducing a blocking call under a lock or a
+host sync in the decode loop fails HERE, not in a bench regression
+three rounds later."""
+import textwrap
+
+from skypilot_tpu.analysis import lint as lint_lib
+from skypilot_tpu.analysis import rules as rules_lib
+from skypilot_tpu.analysis.cli import main as graftcheck_main
+
+
+def check(src, path='skypilot_tpu/serve/x.py'):
+    return rules_lib.check_source(path, textwrap.dedent(src))
+
+
+def rule_ids(src, path='skypilot_tpu/serve/x.py'):
+    return [v.rule for v in check(src, path)]
+
+
+# ------------------------------------------------------------------ GC101
+def test_gc101_unlocked_write_flagged():
+    src = '''
+    import threading
+    class M:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._n = 0          # init writes are setup, not races
+        def locked(self):
+            with self._lock:
+                self._n += 1
+        def racy(self):
+            self._n = 5
+    '''
+    vs = check(src)
+    assert [v.rule for v in vs] == ['GC101']
+    assert vs[0].func == 'M.racy'
+
+
+def test_gc101_consistently_unlocked_attr_not_flagged():
+    # An attr never written under the lock isn't claimed by it.
+    src = '''
+    import threading
+    class M:
+        def __init__(self):
+            self._lock = threading.Lock()
+        def a(self):
+            self._free = 1
+        def b(self):
+            self._free = 2
+    '''
+    assert rule_ids(src) == []
+
+
+# ------------------------------------------------------------------ GC102
+def test_gc102_sleep_and_urlopen_under_lock():
+    src = '''
+    import threading, time, urllib.request
+    class M:
+        def __init__(self):
+            self._lock = threading.Lock()
+        def bad(self):
+            with self._lock:
+                time.sleep(1)
+                urllib.request.urlopen('http://x', timeout=5)
+    '''
+    ids = rule_ids(src)
+    assert ids.count('GC102') == 2
+
+
+def test_gc102_sqlite_state_under_thread_lock_flagged():
+    src = '''
+    import threading
+    from skypilot_tpu.serve import serve_state
+    class M:
+        def __init__(self):
+            self._lock = threading.Lock()
+        def bad(self):
+            with self._lock:
+                serve_state.remove_replica('s', 1)
+    '''
+    assert 'GC102' in rule_ids(src)
+
+
+def test_gc102_db_named_locks_exempt_for_state_calls():
+    # A lock whose job is serializing DB access may hold it across the
+    # DB call — that's the replica-manager _db_lock protocol and the
+    # jobs scheduler's state.db_lock().
+    src = '''
+    import threading
+    from skypilot_tpu.jobs import state
+    class M:
+        def __init__(self):
+            self._db_lock = threading.Lock()
+        def ok(self):
+            with self._db_lock:
+                state.set_schedule_state(1, 2)
+        def also_ok(self):
+            with state.db_lock():
+                state.set_schedule_state(1, 2)
+    '''
+    assert rule_ids(src) == []
+
+
+def test_gc102_filelock_local_exempt():
+    src = '''
+    import filelock
+    from skypilot_tpu import global_state
+    def f():
+        lock = filelock.FileLock('/tmp/x')
+        with lock:
+            global_state.add_or_update_cluster('c', None)
+    '''
+    assert rule_ids(src) == []
+
+
+def test_gc102_unbounded_wait_under_lock():
+    src = '''
+    import threading
+    class M:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self.q = None
+        def bad(self):
+            with self._lock:
+                self.q.get()
+        def ok(self):
+            with self._lock:
+                self.q.get(timeout=5)
+    '''
+    assert rule_ids(src) == ['GC102']
+
+
+# ------------------------------------------------------------------ GC103
+def test_gc103_urlopen_without_timeout():
+    src = '''
+    import urllib.request
+    def f(req):
+        with urllib.request.urlopen(req) as r:
+            return r.read()
+    def g(req):
+        with urllib.request.urlopen(req, timeout=10) as r:
+            return r.read()
+    '''
+    assert rule_ids(src) == ['GC103']
+
+
+# ------------------------------------------------------- GC104 / GC105
+def test_gc104_bare_except():
+    assert rule_ids('''
+    def f():
+        try:
+            return 1
+        except:
+            return None
+    ''') == ['GC104']
+
+
+def test_gc104_bare_except_reraise_ok():
+    assert rule_ids('''
+    def f():
+        try:
+            return 1
+        except:
+            raise
+    ''') == []
+
+
+def test_gc105_swallowed_broad_except():
+    assert rule_ids('''
+    def f():
+        try:
+            return 1
+        except Exception:
+            pass
+    ''') == ['GC105']
+
+
+def test_gc105_logged_or_narrow_excepts_ok():
+    assert rule_ids('''
+    import logging
+    def f():
+        try:
+            return 1
+        except Exception as e:
+            logging.warning('boom %s', e)
+        try:
+            return 2
+        except KeyError:
+            pass
+    ''') == []
+
+
+# ------------------------------------------------------------------ GC107
+def test_gc107_handler_without_timeout():
+    src = '''
+    import http.server
+    class H(http.server.BaseHTTPRequestHandler):
+        pass
+    class H2(http.server.BaseHTTPRequestHandler):
+        timeout = 60
+    '''
+    vs = check(src)
+    assert [v.rule for v in vs] == ['GC107']
+    assert 'H ' in vs[0].message
+
+
+# ------------------------------------------------------------------ GC201
+def test_gc201_impure_calls_inside_jit():
+    src = '''
+    import functools, time, jax
+    import numpy as np
+    @functools.partial(jax.jit, static_argnames=('n',))
+    def step(x, n):
+        t = time.time()
+        y = np.asarray(x)
+        return float(x)
+    '''
+    ids = rule_ids(src, 'skypilot_tpu/inference/x.py')
+    assert ids == ['GC201', 'GC201', 'GC201']
+
+
+def test_gc201_plain_jax_ops_fine():
+    src = '''
+    import jax
+    import jax.numpy as jnp
+    @jax.jit
+    def step(x):
+        return jnp.argmax(x, -1)
+    '''
+    assert rule_ids(src, 'skypilot_tpu/inference/x.py') == []
+
+
+# ------------------------------------------------------------------ GC202
+def test_gc202_bare_asarray_item_device_get_in_compute_dirs():
+    src = '''
+    import numpy as np
+    import jax
+    def f(x):
+        a = np.asarray(x)          # bare: classic accidental sync
+        b = x.item()
+        c = jax.device_get(x)
+        d = float(x)
+        ok = np.asarray(x, np.int32)   # explicit host conversion
+        return a, b, c, d, ok
+    '''
+    ids = rule_ids(src, 'skypilot_tpu/inference/x.py')
+    assert ids == ['GC202'] * 4
+
+
+def test_gc202_only_applies_to_compute_dirs():
+    src = '''
+    import numpy as np
+    def f(x):
+        return np.asarray(x)
+    '''
+    assert rule_ids(src, 'skypilot_tpu/serve/x.py') == []
+    assert rule_ids(src, 'skypilot_tpu/models/x.py') == ['GC202']
+    # The helper module itself is exempt.
+    assert rule_ids(src, 'skypilot_tpu/utils/host.py') == []
+
+
+# ------------------------------------------------- suppression / baseline
+def test_inline_suppression():
+    src = '''
+    def f():
+        try:
+            return 1
+        except Exception:   # graftcheck: disable=GC105
+            pass
+    '''
+    assert rule_ids(src) == []
+
+
+def test_fingerprint_is_line_number_stable():
+    src1 = 'def f():\n    try:\n        pass\n    except:\n        pass\n'
+    src2 = '\n\n' + src1     # shifted two lines down
+    fp1 = rules_lib.check_source('p.py', src1)[0].fingerprint
+    fp2 = rules_lib.check_source('p.py', src2)[0].fingerprint
+    assert fp1 == fp2
+
+
+def test_baseline_round_trip(tmp_path):
+    v = rules_lib.check_source(
+        'p.py', 'try:\n    pass\nexcept:\n    pass\n')[0]
+    path = str(tmp_path / 'base')
+    lint_lib.write_baseline([v], path)
+    assert v.fingerprint in lint_lib.load_baseline(path)
+
+
+# ------------------------------------------------------------ repo gate
+def test_repo_is_clean_modulo_baseline():
+    """THE gate: zero violations outside graftcheck.baseline. If this
+    fails, fix the violation (preferred) or — for a reviewed,
+    deliberate pattern — add its fingerprint to the baseline with a
+    justification comment."""
+    new, _old = lint_lib.lint_paths()
+    assert not new, ('graftcheck found new violations:\n\n'
+                     + '\n'.join(v.format() for v in new))
+
+
+def test_baseline_has_no_stale_entries():
+    """Baseline entries whose violation was fixed must be pruned, or
+    the suppression could silently re-cover a future regression."""
+    baseline = lint_lib.load_baseline()
+    _new, old = lint_lib.lint_paths()
+    stale = baseline - {v.fingerprint for v in old}
+    assert not stale, f'stale graftcheck.baseline entries: {stale}'
+
+
+def test_cli_smoke(capsys):
+    assert graftcheck_main(['rules']) == 0
+    out = capsys.readouterr().out
+    assert 'GC202' in out
+    assert graftcheck_main(['lint']) == 0
