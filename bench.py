@@ -158,6 +158,76 @@ def _anchor_workload(n: int, seed: int = 0, gen_fixed=None):
     return reqs
 
 
+def _repetitive_workload(n: int, seed: int = 0, gen: int = 160,
+                         prompt_len: int = 160, vocab: int = 32000):
+    """Repetitive-text requests (cycled phrase + tiny per-request salt):
+    the prompt-lookup proposer's favorable case — the n-gram of the
+    generated continuation keeps matching earlier history. The anchor
+    mix's prompt/gen scale, deterministic."""
+    phrase = [(17 + (j % 23)) % vocab for j in range(16)]
+    reqs = []
+    for i in range(n):
+        salt = [(300 + ((seed * 131 + i * 7) % 900)) % vocab]
+        prompt = (salt + phrase * (prompt_len // len(phrase) + 1)
+                  )[:prompt_len + (i % 5)]
+        reqs.append((prompt, gen))
+    return reqs
+
+
+def _spec_bench(engine_cls, cfg, params, *, batch: int, max_seq: int,
+                n_chips: int, speculate_k: int, horizon: int,
+                roofline_tok_s: float, gen: int = 160,
+                engine_kwargs=None) -> dict:
+    """Spec-on vs spec-off sustained serving on the repetitive-text
+    workload: the speculative win (accept rate, tokens/verify, tok/s
+    ratio) as bench-trajectory numbers."""
+    import gc
+    prompt_len = min(160, max(16, max_seq // 3))
+    gen = min(gen, max(8, max_seq - prompt_len - 8))
+
+    def workload(n, seed):
+        return _repetitive_workload(n, seed=seed, gen=gen,
+                                    prompt_len=prompt_len,
+                                    vocab=cfg.vocab_size)
+
+    def run(k: int):
+        eng = engine_cls(cfg, params, max_batch=batch, max_seq=max_seq,
+                         speculate_k=k, **(engine_kwargs or {}))
+        for p, g in workload(batch, 0):
+            eng.add_request(p, max_new_tokens=g)
+        eng.run_to_completion(horizon=horizon)       # warmup/compile
+        ids = {eng.add_request(p, max_new_tokens=g)
+               for p, g in workload(2 * batch, 1)}
+        t0 = time.time()
+        done = eng.run_to_completion(horizon=horizon)
+        dt = time.time() - t0
+        out = sum(len(r.output) for rid, r in done.items()
+                  if rid in ids)
+        metrics = eng.spec_metrics()
+        del eng
+        gc.collect()
+        return out / dt / n_chips, metrics
+
+    off_tok_s, _ = run(0)
+    on_tok_s, m = run(speculate_k)
+    return {
+        'speculate_k': speculate_k,
+        'workload': 'repetitive-text',
+        'spec_accept_rate': round(m['spec_accept_rate'], 4),
+        'spec_tokens_per_verify': round(m['spec_tokens_per_step'], 3),
+        'spec_off_out_tok_s_per_chip': round(off_tok_s, 2),
+        'spec_on_out_tok_s_per_chip': round(on_tok_s, 2),
+        'spec_speedup': round(on_tok_s / off_tok_s, 3) if off_tok_s
+        else None,
+        'decode_roofline_frac_spec_on': (
+            round(on_tok_s / roofline_tok_s, 3) if roofline_tok_s
+            else None),
+        'decode_roofline_frac_spec_off': (
+            round(off_tok_s / roofline_tok_s, 3) if roofline_tok_s
+            else None),
+    }
+
+
 def _bench_7b_serving(chip_bw: float, n_chips: int) -> dict:
     """RAW Llama-2-7B-config serving measurement on the local chip:
     materialize the checkpoint (cached), load via the HF import path
@@ -487,6 +557,20 @@ def _bench_7b_serving(chip_bw: float, n_chips: int) -> dict:
     live_kv = (roof_batch * avg_ctx * cfg.n_layers * 2 *
                cfg.n_kv_heads * (cfg.head_dim * 1.0 + 4.0))
     roofline_tok_s = chip_bw * 1e9 / (param_bytes + live_kv) * roof_batch
+    # Speculative-decoding comparison (paged engine, repetitive-text
+    # workload — the prompt-lookup proposer's favorable case). Runs
+    # LAST in this section so the pool/caches above are freed first;
+    # best-effort, its failure must not discard the measurements.
+    try:
+        spec_detail = _spec_bench(
+            PagedInferenceEngine, cfg, params, batch=batch,
+            max_seq=max_seq, n_chips=n_chips,
+            speculate_k=int(os.environ.get('BENCH_SPECULATE_K', '4')),
+            horizon=horizon,
+            roofline_tok_s=chip_bw * 1e9 / (param_bytes + live_kv)
+            * batch, engine_kwargs={'prefill_w8a8': True})
+    except Exception as e:  # pylint: disable=broad-except
+        spec_detail = {'error': f'{type(e).__name__}: {e}'}
     vs_baseline = headline / BASELINE_TOK_S_PER_CHIP
     return {
         'metric': 'llama2_7b_int8_sustained_out_tok_s_per_chip',
@@ -524,6 +608,11 @@ def _bench_7b_serving(chip_bw: float, n_chips: int) -> dict:
             'wall_s': round(dt, 2),
             'ckpt_synth_s': round(t_synth, 1),
             'ckpt_load_s': round(t_load, 1),
+            # Thread-pool parallelism of the safetensors load + device
+            # puts (SKYTPU_LOAD_WORKERS) — keeps ckpt_load_s
+            # attributable across rounds.
+            'ckpt_load_workers': weights.load_workers(),
+            'spec': spec_detail,
             'paged': paged_detail,
             'slot': slot_detail,
             'capacity': capacity,
@@ -733,6 +822,14 @@ def _weights_only_step_ms(params, cfg, batch: int, horizon: int) -> float:
     return (time.time() - t0) * 1e3 / horizon
 
 
+def _load_workers_safe() -> int:
+    try:
+        from skypilot_tpu.models import weights
+        return weights.load_workers()
+    except Exception:  # pylint: disable=broad-except
+        return 1
+
+
 def _bench_1b_modeled(on_tpu: bool, chip_bw: float, n_chips: int) -> dict:
     from skypilot_tpu.inference.engine import InferenceEngine
     from skypilot_tpu.models import configs
@@ -802,6 +899,18 @@ def _bench_1b_modeled(on_tpu: bool, chip_bw: float, n_chips: int) -> dict:
 
     chunk_cfg = (eng.chunk, eng.decode_priority_ratio)
     del eng
+    # Speculative comparison at this scale too (slot engine; tiny on
+    # the CPU fallback so the spec block always rides the trajectory).
+    try:
+        roofline_spec = chip_bw * 1e9 / (param_bytes + live_kv) * batch
+        spec_detail = _spec_bench(
+            InferenceEngine, cfg, None, batch=batch, max_seq=max_seq,
+            n_chips=n_chips,
+            speculate_k=int(os.environ.get('BENCH_SPECULATE_K', '4')),
+            horizon=horizon, roofline_tok_s=roofline_spec,
+            gen=min(gen_len, max_seq // 4))
+    except Exception as e:  # pylint: disable=broad-except
+        spec_detail = {'error': f'{type(e).__name__}: {e}'}
     return {
         'metric': 'decode_tok_s_per_chip_llama2_7b_equiv',
         'value': round(equiv_7b, 2),
@@ -812,6 +921,8 @@ def _bench_1b_modeled(on_tpu: bool, chip_bw: float, n_chips: int) -> dict:
             'model': cfg.name,
             'prefill_chunk_tokens': chunk_cfg[0],
             'decode_priority_ratio': chunk_cfg[1],
+            'ckpt_load_workers': _load_workers_safe(),
+            'spec': spec_detail,
             'raw_tok_s_per_chip': round(tok_s_chip, 2),
             'decode_tok_s_per_chip': round(decode_tok_s, 2),
             'decode_roofline_frac': round(roofline_frac, 3),

@@ -6,10 +6,11 @@ Subcommands:
   exit 1 on violations not covered by the baseline or inline
   suppressions. ``--update-baseline`` rewrites the baseline from the
   current violations (review before committing).
-- ``graftcheck audit [--preset slot|slot-monolithic|paged|llama]`` —
-  runtime jaxpr audit of the engines' hot loops (requires jax); exit 1
-  on unsanctioned host transfers, steady-state recompiles, callback
-  primitives, or float64 promotions.
+- ``graftcheck audit [--preset slot|slot-monolithic|paged|slot-spec|
+  paged-spec|llama]`` — runtime jaxpr audit of the engines' hot loops,
+  including the speculative propose→verify→commit steady state
+  (requires jax); exit 1 on unsanctioned host transfers, steady-state
+  recompiles, callback primitives, or float64 promotions.
 - ``graftcheck rules`` — list the rule set.
 """
 from __future__ import annotations
@@ -84,8 +85,9 @@ def main(argv: Optional[List[str]] = None) -> int:
                                   'loops (requires jax)')
     p_audit.add_argument('--preset', action='append',
                          choices=['slot', 'slot-monolithic', 'paged',
-                                  'llama'],
-                         help='repeatable; default: slot, paged, llama')
+                                  'slot-spec', 'paged-spec', 'llama'],
+                         help='repeatable; default: slot, paged, '
+                              'slot-spec, paged-spec, llama')
 
     sub.add_parser('rules', help='list the rule set')
 

@@ -268,17 +268,19 @@ def _jit_fns(fn) -> List[Any]:
 
 
 # ------------------------------------------------------------------ presets
-def _tiny_engine(kind: str, chunked: bool):
+def _tiny_engine(kind: str, chunked: bool, speculate_k: int = 0):
     from skypilot_tpu.models import configs
     cfg = configs.get_config('tiny')
     chunk = 16 if chunked else 0
     if kind == 'paged':
         from skypilot_tpu.inference.paged import PagedInferenceEngine
         return PagedInferenceEngine(cfg, max_batch=4, max_seq=128,
-                                    prefill_chunk_tokens=chunk or None)
+                                    prefill_chunk_tokens=chunk or None,
+                                    speculate_k=speculate_k)
     from skypilot_tpu.inference.engine import InferenceEngine
     return InferenceEngine(cfg, max_batch=4, max_seq=128,
-                           prefill_chunk_tokens=chunk)
+                           prefill_chunk_tokens=chunk,
+                           speculate_k=speculate_k)
 
 
 def _drive(engine, prompts: List[List[int]], max_new: int = 8) -> None:
@@ -312,19 +314,32 @@ def _record_static_keys(engine, report: AuditReport):
 
 
 def audit_engine(kind: str = 'slot', chunked: bool = True,
-                 rounds: int = 2) -> AuditReport:
+                 rounds: int = 2, speculate_k: int = 0) -> AuditReport:
     """Build a tiny engine, run one warmup wave (compiles allowed),
     then audit ``rounds`` identical same-shaped waves: every compile
     and every unsanctioned host transfer in those waves is a violation.
 
     ``kind``: 'slot' | 'paged'. ``chunked``: prompts longer than one
     chunk so the chunked-prefill path (cursor chunks + completing
-    chunk) is exercised, not just monolithic admission."""
+    chunk) is exercised, not just monolithic admission.
+    ``speculate_k > 0`` drives the speculative propose→verify→commit
+    steady state on REPETITIVE prompts (so proposals actually fire and
+    acceptance varies per slot): the verify jit cache must stay bounded
+    by the observed (k, sample, kv_bucket) key set, and the only host
+    readback per round is the sanctioned commit sync."""
+    spec_tag = f' + speculate_k={speculate_k}' if speculate_k else ''
     report = AuditReport(
         name=f'{kind} engine '
-             f'({"chunked prefill + " if chunked else ""}decode)')
-    engine = _tiny_engine(kind, chunked)
-    prompts = [[1, 2, 3] * 9, [4, 5] * 10, [7] * 21]   # spans >1 chunk
+             f'({"chunked prefill + " if chunked else ""}decode'
+             f'{spec_tag})')
+    engine = _tiny_engine(kind, chunked, speculate_k)
+    if speculate_k:
+        # Repetitive prompts: the n-gram proposer matches, acceptance
+        # is nonzero AND per-slot variable — the masked-commit shapes
+        # are what must stay recompile-free.
+        prompts = [[1, 2, 3, 4] * 7, [5, 6] * 11, [7, 8, 9] * 7]
+    else:
+        prompts = [[1, 2, 3] * 9, [4, 5] * 10, [7] * 21]  # >1 chunk
     _drive(engine, prompts)                             # warmup: compiles
     inner = _record_static_keys(engine, report)
     decode_jits = _jit_fns(inner)
@@ -337,11 +352,22 @@ def audit_engine(kind: str = 'slot', chunked: bool = True,
     prefill_fns = getattr(engine, '_prefill_fns', None)
     if prefill_fns is not None:
         labels['prefill'] = lambda: len(prefill_fns)
+    spec_fns = getattr(engine, '_spec_verify_fns', None)
+    if spec_fns is not None and speculate_k:
+        # The verify program cache is keyed (k, sample, kv_bucket) —
+        # steady state must never grow it (per-slot acceptance rides
+        # masked commits, not fresh shapes).
+        labels['spec_verify'] = lambda: len(spec_fns)
     before = {k: get() for k, get in labels.items()}
     with intercept_host_transfers(report.transfers):
         for _ in range(rounds):
             _drive(engine, prompts)        # identical shapes: no compiles
     engine._decode_fn = inner
+    if spec_fns is not None and speculate_k:
+        names = ('k', 'sample',
+                 'P' if kind == 'paged' else 'kv_bucket')
+        report.static_keys.extend(
+            dict(zip(names, key)) for key in sorted(spec_fns))
     report.compile_counts = {
         k: (before[k], get()) for k, get in labels.items()}
     # Jaxpr of the fused decode step itself (the hot program).
@@ -384,10 +410,15 @@ PRESETS: Dict[str, Callable[[], AuditReport]] = {
     'slot': lambda: audit_engine('slot', chunked=True),
     'slot-monolithic': lambda: audit_engine('slot', chunked=False),
     'paged': lambda: audit_engine('paged', chunked=True),
+    'slot-spec': lambda: audit_engine('slot', chunked=True,
+                                      speculate_k=4),
+    'paged-spec': lambda: audit_engine('paged', chunked=True,
+                                       speculate_k=4),
     'llama': audit_llama_forward,
 }
 
 
 def run_presets(names: Optional[List[str]] = None) -> List[AuditReport]:
-    names = names or ['slot', 'paged', 'llama']
+    names = names or ['slot', 'paged', 'slot-spec', 'paged-spec',
+                      'llama']
     return [PRESETS[n]() for n in names]

@@ -28,6 +28,10 @@ Concurrency / control-plane hygiene (GC1xx):
 - **GC107 handler-no-timeout** — an ``http.server`` request handler
   without a ``timeout`` class attribute lets one slow-loris client pin
   a server thread forever.
+- **GC108 proposer-under-lock** — speculative-decoding proposer host
+  work (``prepare_proposals``/``ngram_propose`` — per-slot numpy n-gram
+  matching) invoked while holding a lock serializes every HTTP handler
+  behind proposer CPU time; the serve loop runs it before locking.
 
 TPU hot-path hygiene (GC2xx), applied to the compute layer
 (``inference/``, ``models/``, ``ops/``, ``train/``):
@@ -70,6 +74,9 @@ RULES: Dict[str, str] = {
              'raises, nor acts',
     'GC107': 'handler-no-timeout: http.server handler class without a '
              'timeout attribute (slow-loris pins a thread)',
+    'GC108': 'proposer-under-lock: speculative-proposer host work '
+             '(n-gram matching) invoked while holding a lock — call '
+             'prepare_proposals() BEFORE taking the engine lock',
     'GC201': 'impure-jit: impure or host-synchronizing call inside a '
              '@jax.jit body',
     'GC202': 'host-sync: device->host readback outside the '
@@ -107,6 +114,13 @@ _UNBOUNDED_WAIT_METHODS = {'wait', 'get', 'join'}
 _STATE_MODULES = {'state', 'serve_state', 'global_state', 'job_lib',
                   'agent_job_lib'}
 _RPC_MODULES = {'core', 'execution', 'backend_utils', 'provisioner'}
+# --------------------------------------------------------------------- GC108
+# Speculative-proposer host entry points: O(history x max_ngram) numpy
+# matching per slot. Under the serve layer's engine lock this work
+# serializes every HTTP handler behind proposer CPU time — the serve
+# loop must call prepare_proposals() BEFORE locking (the engine
+# revalidates and recomputes stale entries inside step()).
+_PROPOSER_HOST_FNS = {'prepare_proposals', 'ngram_propose'}
 
 # --------------------------------------------------------------------- GC201
 _IMPURE_IN_JIT = {
@@ -441,6 +455,13 @@ class _Checker(ast.NodeVisitor):
 
     def _check_blocking_under_lock(self, node: ast.Call, name: str,
                                    method: str) -> None:
+        if name.rsplit('.', 1)[-1] in _PROPOSER_HOST_FNS:
+            self._add('GC108', node,
+                      f'{name}() (speculative-proposer host work) while '
+                      'holding a lock — run it before taking the '
+                      'engine lock; the engine revalidates stale '
+                      'proposals itself')
+            return
         if name in _ALWAYS_BLOCKING:
             self._add('GC102', node,
                       f'{name}() while holding a lock stalls every '

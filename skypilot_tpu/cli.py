@@ -541,6 +541,56 @@ def serve_logs(service_name, no_follow):
     sky.serve.tail_logs(service_name, follow=not no_follow)
 
 
+@cli.command(name='model-server')
+@click.option('--model', default='tiny',
+              help='Preset config name (random weights).')
+@click.option('--model-path', default=None,
+              help='HF checkpoint dir (real weights + tokenizer).')
+@click.option('--quantize', default=None, type=click.Choice(['int8']),
+              help='int8 weights + KV cache (2x decode).')
+@click.option('--kv-cache', default='paged',
+              type=click.Choice(['slot', 'paged']),
+              help='paged (default) = shared page pool with prefix '
+                   'caching; slot = fixed per-slot reservations.')
+@click.option('--page-size', type=int, default=None,
+              help='Paged-cache page granularity (tokens; auto).')
+@click.option('--prefill-chunk-tokens', type=int, default=None,
+              help='Chunked-prefill chunk width (0 = monolithic).')
+@click.option('--decode-priority-ratio', type=float, default=None,
+              help='Decode share of the interleaved token budget.')
+@click.option('--prefill-w8a8', is_flag=True,
+              help='int8 activations on the compute-bound prefill.')
+@click.option('--speculate-k', type=int, default=0,
+              help='Speculative decoding: propose up to K tokens per '
+                   'verify step via prompt-lookup (n-gram) matching '
+                   '(0 = off). Greedy outputs are identical to vanilla '
+                   'decode; sampling keeps the output distribution.')
+@click.option('--max-batch', type=int, default=8)
+@click.option('--max-seq', type=int, default=1024)
+@click.option('--port', type=int, default=8081)
+def model_server(model, model_path, quantize, kv_cache, page_size,
+                 prefill_chunk_tokens, decode_priority_ratio,
+                 prefill_w8a8, speculate_k, max_batch, max_seq, port):
+    """Run the in-tree replica model server on this host (the process
+    a service task's ``run`` command starts on each replica; same
+    knobs as ``python -m skypilot_tpu.serve.server``)."""
+    if kv_cache != 'paged' and page_size is not None:
+        raise click.UsageError(
+            '--page-size only applies with --kv-cache paged')
+    from skypilot_tpu.serve.server import ModelServer
+    server = ModelServer(model, max_batch=max_batch, max_seq=max_seq,
+                         port=port, model_path=model_path,
+                         quantize=quantize, kv_cache=kv_cache,
+                         page_size=page_size,
+                         prefill_w8a8=prefill_w8a8,
+                         prefill_chunk_tokens=prefill_chunk_tokens,
+                         decode_priority_ratio=decode_priority_ratio,
+                         speculate_k=speculate_k)
+    click.echo(f'Model server on :{port} '
+               f'(kv_cache={kv_cache}, speculate_k={speculate_k})')
+    server.start(block=True)
+
+
 # --------------------------------------------------------------- storage
 @cli.group()
 def storage():

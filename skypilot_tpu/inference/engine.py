@@ -35,6 +35,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from skypilot_tpu.inference.speculative import SpeculativeMixin
 from skypilot_tpu.models import llama
 from skypilot_tpu.models.configs import ModelConfig
 from skypilot_tpu.parallel import mesh as mesh_lib
@@ -385,13 +386,15 @@ class _EngineBase:
         return done
 
 
-class InferenceEngine(_EngineBase):
+class InferenceEngine(SpeculativeMixin, _EngineBase):
     """Slot-cache engine core: callers drive ``step()``; the serve layer
     wraps it in an HTTP loop. Decode/prefill calls dispatch through the
     async pipeline (``_EngineBase.step``): results are read back one
     call behind the enqueue, so per-call dispatch latency overlaps
     device compute and short fused horizons stop paying a round trip
-    each."""
+    each. ``speculate_k > 0`` switches decode to the speculative
+    propose→verify→commit loop (``inference/speculative.py``): up to
+    k+1 tokens per slot per weight-stream pass."""
 
     def __init__(self, cfg: ModelConfig, params: Optional[Any] = None,
                  *, max_batch: int = 8, max_seq: int = 1024,
@@ -401,7 +404,8 @@ class InferenceEngine(_EngineBase):
                  donate_params: bool = False,
                  prefill_w8a8: bool = False,
                  prefill_chunk_tokens: Optional[int] = 256,
-                 decode_priority_ratio: Optional[float] = None):
+                 decode_priority_ratio: Optional[float] = None,
+                 speculate_k: int = 0):
         self.max_batch = max_batch
         self.max_seq = max_seq
         self.mesh = mesh
@@ -464,6 +468,9 @@ class InferenceEngine(_EngineBase):
         self._merge_tokens_drop = jax.jit(
             lambda tok, slots, vals: tok.at[slots].set(vals,
                                                        mode='drop'))
+        # Speculative decoding (0 = off): n-gram propose + batched
+        # verify instead of the fused decode horizon.
+        self._init_spec(speculate_k)
 
     @classmethod
     def from_pretrained(cls, path: str, *, dtype: Any = None,
@@ -802,6 +809,99 @@ class InferenceEngine(_EngineBase):
         self._chunk_prefill_fns[key] = prefill
         return prefill
 
+    # ------------------------------------------------------- speculative
+    def _get_spec_verify(self, sample: bool, kv_bucket: int):
+        """Compiled speculative verify: one forward over the k+1
+        positions [t0, d1..dk] per slot against the slots' existing
+        cache rows (the nonzero-cache-offset prefill path), acceptance
+        on device, and a MASKED scatter of the accepted rows — per-slot
+        variable acceptance never changes a shape, so the jit key is
+        exactly (k, sample, kv_bucket)."""
+        key = (self.speculate_k, sample, kv_bucket)
+        if key in self._spec_verify_fns:
+            return self._spec_verify_fns[key]
+        from skypilot_tpu.inference import speculative
+        cfg, attn_impl = self.cfg, self.attn_impl
+        k = self.speculate_k
+        max_seq = self.max_seq
+
+        @functools.partial(jax.jit, donate_argnums=(1,))
+        def verify(params, big_cache, tokens, proposals, n_prop, temps,
+                   topks, topps, active, rng):
+            b = tokens.shape[0]
+            len0 = big_cache.length
+            # Length-aware cache read, same policy as decode_horizon:
+            # slice only when it at least halves the stream (the sliced
+            # prefix materializes as a program temp).
+            ck = big_cache.k[:, :, :kv_bucket]
+            cv = big_cache.v[:, :, :kv_bucket]
+            if big_cache.quantized:
+                cache_kv = (ck, cv, big_cache.k_scale[:, :, :kv_bucket],
+                            big_cache.v_scale[:, :, :kv_bucket])
+            else:
+                cache_kv = (ck, cv)
+            seq = jnp.concatenate([tokens[:, None], proposals], axis=1)
+            logits, rows = llama.prefill_rows(
+                params, seq, jnp.full((b,), k + 1, jnp.int32), cfg,
+                attn_impl=attn_impl, quantize_rows=big_cache.quantized,
+                cache_kv=cache_kv, cache_len=len0, all_logits=True)
+            commit, n_commit = speculative.verify_tokens(
+                logits, proposals, n_prop, rng, temps, topks, topps,
+                sample=sample)
+            n_commit = jnp.where(active, n_commit, 0)
+            # Masked commit: rows past each slot's accepted count (and
+            # every row of inactive slots) scatter to the max_seq
+            # sentinel and drop.
+            pos = len0[:, None] + jnp.arange(k + 1)[None, :]
+            pos = jnp.where(jnp.arange(k + 1)[None, :]
+                            < n_commit[:, None], pos, max_seq)
+            slots = jnp.arange(b)
+            length = len0 + n_commit
+
+            def scatter(c, r):
+                return c.at[:, slots[:, None], pos].set(
+                    r.astype(c.dtype), mode='drop')
+
+            if big_cache.quantized:
+                kq, vq, ks, vs = rows
+                new_cache = llama.KVCache(
+                    k=scatter(big_cache.k, kq),
+                    v=scatter(big_cache.v, vq), length=length,
+                    k_scale=scatter(big_cache.k_scale, ks),
+                    v_scale=scatter(big_cache.v_scale, vs))
+            else:
+                k_rows, v_rows = rows
+                new_cache = llama.KVCache(
+                    k=scatter(big_cache.k, k_rows),
+                    v=scatter(big_cache.v, v_rows), length=length)
+            # Next round's t0 = the last committed token per slot.
+            nxt = jnp.take_along_axis(
+                commit, jnp.maximum(n_commit - 1, 0)[:, None],
+                axis=1)[:, 0]
+            new_tok = jnp.where(active, nxt, tokens)
+            return commit, n_commit, new_tok, new_cache
+
+        self._spec_verify_fns[key] = verify
+        return verify
+
+    def _spec_verify_call(self, ready, proposals, n_prop):
+        temps_d, topks_d, topps_d, active_d, sample = \
+            self._slot_meta(ready)
+        k = self.speculate_k
+        max_live = int(max(self._slot_len[s]
+                           for s in range(self.max_batch)
+                           if self._slots[s] is not None))
+        kv_bucket = min(self.max_seq, _bucket_len(max_live + k + 1))
+        if kv_bucket > self.max_seq // 2:
+            kv_bucket = self.max_seq
+        self._rng, rng = jax.random.split(self._rng)
+        prop_d, n_prop_d = jax.device_put((proposals, n_prop))
+        verify = self._get_spec_verify(sample, kv_bucket)
+        commit, n_commit, self._tok_dev, self.cache = verify(
+            self.params, self.cache, self._tok_dev, prop_d, n_prop_d,
+            temps_d, topks_d, topps_d, active_d, rng)
+        return commit, n_commit
+
     def step(self, horizon: int = 1) -> List[Tuple[int, int, bool]]:
         """Chunked scheduling loop: admit (one chunk batch max), then
         enqueue decode through the async pipeline. While prompts are
@@ -809,13 +909,19 @@ class InferenceEngine(_EngineBase):
         ``decode_priority_ratio`` token budget so the next chunk runs
         within a bounded number of decode steps; while the queue is
         non-empty a medium cap keeps freed slots noticed promptly.
-        Monolithic mode keeps _EngineBase.step semantics unchanged."""
-        if not self.chunked:
+        Monolithic mode keeps _EngineBase.step semantics unchanged.
+        ``speculate_k > 0`` replaces the fused decode horizon with one
+        synchronous propose→verify→commit round per step (admission —
+        chunked or monolithic — is unchanged)."""
+        if not self.chunked and not self.speculate_k:
             return super().step(horizon)
         events: List[Tuple[int, int, bool]] = []
         while len(self._pending) >= self._PIPELINE_DEPTH:
             events.extend(self._process_one())
         events.extend(self._admit())
+        if self.speculate_k:
+            events.extend(self._spec_step())
+            return events
         if self._prefill_off:
             horizon = min(horizon, self._interleave_horizon())
         elif self._queue:
@@ -1028,28 +1134,12 @@ def sample_tokens(logits: jax.Array, step_rng: jax.Array,
                   topps: jax.Array) -> jax.Array:
     """Per-slot next-token sampling, shared by the slot and paged
     engines' fused decode: temperature scaling, then top-k and nucleus
-    (top-p) filtering on ONE descending sort of the scaled logits, then
-    categorical draw. Rows with temp <= 0 take the greedy argmax; top-k
-    <= 0 and top-p >= 1 disable their filters."""
+    (top-p) filtering (``llama.filtered_logits`` — one descending sort
+    of the scaled logits, also the distribution speculative verify
+    rejection-samples against), then categorical draw. Rows with
+    temp <= 0 take the greedy argmax; top-k <= 0 and top-p >= 1 disable
+    their filters."""
     next_greedy = jnp.argmax(logits, -1).astype(jnp.int32)
-    scaled = logits / jnp.maximum(temps, 1e-6)[:, None]
-    sorted_desc = -jnp.sort(-scaled, axis=-1)
-    idx = jnp.clip(topks - 1, 0, logits.shape[-1] - 1)
-    kth = jnp.take_along_axis(sorted_desc, idx[:, None], axis=-1)
-    thr_k = jnp.where(topks[:, None] > 0, kth, -jnp.inf)
-    # Nucleus: keep the smallest prefix of the (top-k-filtered) sorted
-    # distribution whose mass reaches top_p. A token is kept iff the
-    # mass BEFORE it is < p, so the top-1 token always survives.
-    masked_sorted = jnp.where(sorted_desc >= thr_k, sorted_desc,
-                              -jnp.inf)
-    probs = jax.nn.softmax(masked_sorted.astype(jnp.float32), axis=-1)
-    cum = jnp.cumsum(probs, axis=-1)
-    keep = (cum - probs) < topps[:, None]
-    thr_p = jnp.min(jnp.where(keep, masked_sorted, jnp.inf), axis=-1,
-                    keepdims=True)
-    thr = jnp.maximum(thr_k, jnp.where(topps[:, None] < 1.0,
-                                       thr_p.astype(scaled.dtype),
-                                       -jnp.inf))
-    masked = jnp.where(scaled >= thr, scaled, -jnp.inf)
+    masked = llama.filtered_logits(logits, temps, topks, topps)
     sampled = jax.random.categorical(step_rng, masked).astype(jnp.int32)
     return jnp.where(temps > 0, sampled, next_greedy)

@@ -426,6 +426,84 @@ def paged_prefill_chunk(
     return first, new_cache
 
 
+def paged_spec_verify(
+    params: Params,
+    cache: PagedKVCache,
+    table_p: jax.Array,                # [n, P] pages covering len+k+1
+    tokens: jax.Array,                 # [n] current token per slot (t0)
+    proposals: jax.Array,              # [n, k] drafted continuations
+    n_prop: jax.Array,                 # [n] valid drafts per slot
+    lengths: jax.Array,                # [n] context already in the pool
+    active: jax.Array,                 # [n] bool decodable mask
+    cfg: ModelConfig,
+    *,
+    sample: bool,
+    temps: jax.Array = None,
+    topks: jax.Array = None,
+    topps: jax.Array = None,
+    rng: jax.Array = None,
+    w8a8: bool = False,
+):
+    """Speculative verify over the paged pool: one forward over the
+    ``k+1`` positions ``[t0, d1..dk]`` per slot against the pages
+    written so far (``paged_prefill_chunk``'s attention math with
+    every position's logits kept), device-side acceptance
+    (``speculative.verify_tokens``), and a MASKED merge of the accepted
+    rows — ``merge_rows_into_pool``'s ``valid_len`` mask redirects rows
+    past each slot's commit count to the trash page, so per-slot
+    variable acceptance never changes a program shape.
+
+    Returns ``(commit [n, k+1], n_commit [n], new_tok [n], new_cache)``
+    where ``new_tok`` is each slot's next-round current token (the last
+    committed one; unchanged for inactive slots)."""
+    from skypilot_tpu.inference import speculative
+    n, k = proposals.shape
+    seq = jnp.concatenate([tokens[:, None], proposals], axis=1)
+    len0 = lengths
+    pool_k, pool_v = cache.pool_k, cache.pool_v
+    ks_pool, vs_pool = cache.k_scale, cache.v_scale
+    x = llama._embed_tokens(params, seq, cfg)
+    positions = len0[:, None] + jnp.arange(k + 1)[None, :]
+
+    def layer_body(xc, layer_and_idx):
+        layer, li = layer_and_idx
+        pk = lax.dynamic_index_in_dim(pool_k, li, 0, keepdims=False)
+        pv = lax.dynamic_index_in_dim(pool_v, li, 0, keepdims=False)
+        sk = (lax.dynamic_index_in_dim(ks_pool, li, 0, keepdims=False)
+              if cache.quantized else None)
+        sv = (lax.dynamic_index_in_dim(vs_pool, li, 0, keepdims=False)
+              if cache.quantized else None)
+        ck, sck = _gather_layer(pk, sk, table_p)
+        cv, scv = _gather_layer(pv, sv, table_p)
+
+        def attn_fn(q, kk, vv):
+            return cached_attention(q, kk, vv, ck, cv, len0,
+                                    k_scale=sck, v_scale=scv)
+
+        xc, new_kv, _ = llama._layer_core(layer, xc, cfg, positions,
+                                          attn_fn)
+        return xc, _maybe_quantize_rows(new_kv, cache.quantized)
+
+    import contextlib
+    from skypilot_tpu.models.quantization import w8a8_region
+    with (w8a8_region() if w8a8 else contextlib.nullcontext()):
+        x, (k_rows, v_rows) = lax.scan(
+            layer_body, x, (params['layers'], jnp.arange(cfg.n_layers)))
+    x = llama.rms_norm(x, params['final_norm'], cfg.norm_eps,
+                       cfg.norm_plus_one)
+    logits = llama._unembed_logits(params, x, cfg)      # [n, k+1, v]
+    commit, n_commit = speculative.verify_tokens(
+        logits, proposals, n_prop, rng, temps, topks, topps,
+        sample=sample)
+    n_commit = jnp.where(active, n_commit, 0)
+    new_cache = merge_rows_into_pool(cache, k_rows, v_rows, table_p,
+                                     len0, valid_len=n_commit)
+    nxt = jnp.take_along_axis(
+        commit, jnp.maximum(n_commit - 1, 0)[:, None], axis=1)[:, 0]
+    new_tok = jnp.where(active, nxt, tokens)
+    return commit, n_commit, new_tok, new_cache
+
+
 # ---------------------------------------------------------------------------
 # Host-side allocator + prefix index
 # ---------------------------------------------------------------------------
@@ -537,9 +615,10 @@ class PageAllocator:
 # Engine
 # ---------------------------------------------------------------------------
 from skypilot_tpu.inference.engine import _EngineBase
+from skypilot_tpu.inference.speculative import SpeculativeMixin
 
 
-class PagedInferenceEngine(_EngineBase):
+class PagedInferenceEngine(SpeculativeMixin, _EngineBase):
     """Continuous-batching engine over the paged pool. Same public API
     as ``engine.InferenceEngine`` (the serve layer treats them
     interchangeably — both extend ``_EngineBase``); differs inside:
@@ -548,7 +627,10 @@ class PagedInferenceEngine(_EngineBase):
       the uncached tail (one compiled program per (n, P) bucket pair,
       any prompt length);
     - decode gathers pages instead of slicing a per-slot reservation;
-    - HBM = page pool sized by TOTAL live tokens, not slots x max_seq.
+    - HBM = page pool sized by TOTAL live tokens, not slots x max_seq;
+    - ``speculate_k > 0``: decode runs the speculative
+      propose→verify→commit loop (``inference/speculative.py``) with
+      masked page-pool commits.
     """
 
     _PREFILL_N_BUCKETS = (1, 2, 4, 8, 16, 32)
@@ -582,7 +664,8 @@ class PagedInferenceEngine(_EngineBase):
                  donate_params: bool = False,
                  decode_impl: str = 'auto',
                  prefill_w8a8: bool = False,
-                 pages_per_block: int = 1):
+                 pages_per_block: int = 1,
+                 speculate_k: int = 0):
         from skypilot_tpu.inference.engine import prepare_params
         from skypilot_tpu.parallel import mesh as mesh_lib
         self.max_batch = max_batch
@@ -720,6 +803,9 @@ class PagedInferenceEngine(_EngineBase):
                 self._prefill_n_max = b
         self.chunks_prefilled = 0          # diagnostics (prefix-hit wins)
         self.preemptions = 0               # pool-pressure recomputes
+        # Speculative decoding (0 = off): n-gram propose + batched
+        # verify with masked page-pool commits.
+        self._init_spec(speculate_k)
 
     @staticmethod
     def _auto_page_size(cfg: ModelConfig, max_seq: int,
@@ -1207,6 +1293,73 @@ class PagedInferenceEngine(_EngineBase):
                 self._maybe_early_free(slot, req)
         return []
 
+    # ------------------------------------------------------- speculative
+    def _spec_room(self, slot: int) -> int:
+        """Proposal cap from page availability: reserve pages for
+        len + k + 1 rows; under pool pressure shrink the cover (masked
+        commits write at most that many rows) down to 1; -1 when even
+        one more token has no page (the mixin then routes the slot
+        through ``_spec_starved``)."""
+        base = int(self._slot_len[slot])
+        for cover in range(self.speculate_k + 1, 0, -1):
+            if self._ensure_pages(slot, base + cover):
+                return cover - 1
+        return -1
+
+    def _spec_starved(self, slots: List[int]) -> None:
+        """Pool exhausted for these slots even at one token: preempt
+        them back to the queue (vLLM-style recompute — same contract as
+        the decode path's pool-pressure preemption). The oldest live
+        request is never in this set in practice: ``_spec_room`` is
+        called in slot order after earlier slots reserved their pages,
+        and ``_validate_request`` guarantees any single request fits
+        the pool alone once the others release."""
+        for slot in slots:
+            if self._slots[slot] is not None:
+                self._preempt_slot(slot)
+
+    def _get_spec_verify(self, n: int, P: int, sample: bool):
+        key = (self.speculate_k, sample, P)
+        if key not in self._spec_verify_fns:
+            cfg = self.cfg
+            w8a8 = self.prefill_w8a8
+
+            @functools.partial(jax.jit, donate_argnums=(1,))
+            def verify(params, cache, table_p, tokens, proposals,
+                       n_prop, lengths, active, temps, topks, topps,
+                       rng):
+                return paged_spec_verify(
+                    params, cache, table_p, tokens, proposals, n_prop,
+                    lengths, active, cfg, sample=sample,
+                    temps=temps, topks=topks, topps=topps, rng=rng,
+                    w8a8=w8a8)
+
+            self._spec_verify_fns[key] = verify
+        return self._spec_verify_fns[key]
+
+    def _spec_verify_call(self, ready, proposals, n_prop):
+        from skypilot_tpu.inference.engine import _bucket_len
+        temps_d, topks_d, topps_d, active_d, sample = \
+            self._slot_meta(ready)
+        P_needed = max(max((len(self._pages[s])
+                            for s, r in enumerate(ready)
+                            if r is not None), default=1), 1)
+        P = _bucket_len(P_needed, minimum=1)
+        table_p = np.zeros((self.max_batch, P), np.int32)
+        for s in range(self.max_batch):
+            ps = self._pages[s][:P]
+            table_p[s, :len(ps)] = ps
+        lengths = self._slot_len.astype(np.int32)
+        self._rng, rng = jax.random.split(self._rng)
+        table_d, prop_d, n_prop_d, lengths_d = jax.device_put(
+            (table_p, proposals, n_prop, lengths))
+        verify = self._get_spec_verify(self.max_batch, P, sample)
+        commit, n_commit, self._tok_dev, self.cache = verify(
+            self.params, self.cache, table_d, self._tok_dev, prop_d,
+            n_prop_d, lengths_d, active_d, temps_d, topks_d, topps_d,
+            rng)
+        return commit, n_commit
+
     def step(self, horizon: int = 1) -> List[Tuple[int, int, bool]]:
         """Admit (one chunk max), then enqueue decode through the async
         pipeline (_EngineBase semantics: results lag enqueues by up to
@@ -1215,11 +1368,19 @@ class PagedInferenceEngine(_EngineBase):
         next chunk runs within a bounded number of decode steps
         (admission latency), and capped at a medium bucket while the
         queue is non-empty so freed slots are noticed promptly. Steady
-        state (no queue, no prefill) runs the caller's full horizon."""
+        state (no queue, no prefill) runs the caller's full horizon.
+        ``speculate_k > 0`` replaces the fused decode horizon with one
+        synchronous propose→verify→commit round per step."""
         events: List[Tuple[int, int, bool]] = []
         while len(self._pending) >= self._PIPELINE_DEPTH:
             events.extend(self._process_one())
         events.extend(self._admit())
+        if self.speculate_k:
+            events.extend(self._spec_step())
+            if self._deferred_events:
+                events.extend(self._deferred_events)
+                self._deferred_events = []
+            return events
         if self._prefill_off:
             # decode_priority_ratio switches the fixed interleave
             # horizon to the Sarathi-style token-budget split (shared

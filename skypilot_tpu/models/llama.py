@@ -259,6 +259,45 @@ def _unembed_logits(params: Params, x: jax.Array,
                    out_dtype=jnp.float32)
 
 
+def filtered_logits(logits: jax.Array, temps: jax.Array,
+                    topks: jax.Array, topps: jax.Array) -> jax.Array:
+    """Temperature-scaled, top-k/top-p-masked logits over the LAST axis:
+    kept tokens carry their scaled value, filtered ones -inf, so
+    ``jax.random.categorical`` over the result draws from exactly the
+    engines' sampling distribution. ``temps``/``topks``/``topps``
+    broadcast over ``logits.shape[:-1]`` — the single-position decode
+    sampler ([b, vocab]) and the speculative multi-position verify
+    ([b, k+1, vocab]) share this one implementation, which is what
+    makes rejection-sampling acceptance distribution-preserving.
+
+    Filter semantics (identical to the historical ``sample_tokens``):
+    top-k <= 0 and top-p >= 1 disable their filters; nucleus keeps the
+    smallest prefix of the sorted distribution whose mass reaches
+    top_p (the top-1 token always survives). Rows with temp <= 0 are
+    scaled by 1/1e-6 — callers take the greedy argmax for those rows
+    instead of sampling."""
+    shape = logits.shape[:-1]
+    temps = jnp.broadcast_to(temps, shape)[..., None]
+    topks = jnp.broadcast_to(topks, shape)[..., None]
+    topps = jnp.broadcast_to(topps, shape)[..., None]
+    scaled = logits / jnp.maximum(temps, 1e-6)
+    sorted_desc = -jnp.sort(-scaled, axis=-1)
+    idx = jnp.clip(topks - 1, 0, logits.shape[-1] - 1)
+    kth = jnp.take_along_axis(sorted_desc, idx, axis=-1)
+    thr_k = jnp.where(topks > 0, kth, -jnp.inf)
+    masked_sorted = jnp.where(sorted_desc >= thr_k, sorted_desc,
+                              -jnp.inf)
+    probs = jax.nn.softmax(masked_sorted.astype(jnp.float32), axis=-1)
+    cum = jnp.cumsum(probs, axis=-1)
+    keep = (cum - probs) < topps
+    thr_p = jnp.min(jnp.where(keep, masked_sorted, jnp.inf), axis=-1,
+                    keepdims=True)
+    thr = jnp.maximum(thr_k, jnp.where(topps < 1.0,
+                                       thr_p.astype(scaled.dtype),
+                                       -jnp.inf))
+    return jnp.where(scaled >= thr, scaled, -jnp.inf)
+
+
 def rope(x: jax.Array, positions: jax.Array, theta: float) -> jax.Array:
     """Rotary embedding. x: [b, s, h, d], positions: [b, s]."""
     d = x.shape[-1]
@@ -691,6 +730,11 @@ def prefill_rows(
                                        # int8 codes + scales
     cache_len: Optional[jax.Array] = None,   # [n] valid cache rows =
                                        # each row's chunk start offset
+    all_logits: bool = False,          # return [n, bucket, vocab] logits
+                                       # at EVERY position (speculative
+                                       # verify; keep bucket ~k+1 tiny —
+                                       # the full tensor is ~0.5 GB at
+                                       # n=8 x bucket=512)
 ):
     """Prompt/chunk prefill for the slot engine. Without ``cache_kv``:
     plain causal attention over the padded bucket — flash-eligible on
@@ -778,6 +822,11 @@ def prefill_rows(
         x, rows = lax.scan(body, x, xs)
     x = rms_norm(x, params['final_norm'], cfg.norm_eps,
                  cfg.norm_plus_one)
+    if all_logits:
+        # Multi-position logits for speculative verify: every position
+        # of the (tiny) bucket is a next-token distribution the
+        # acceptance test reads.
+        return _unembed_logits(params, x, cfg), rows
     last_x = jnp.take_along_axis(x, (true_lens - 1)[:, None, None],
                                  axis=1)
     last_logits = _unembed_logits(params, last_x, cfg)[:, 0]

@@ -101,12 +101,66 @@ def _safetensor_files(path: str) -> list:
     return [os.path.join(path, f) for f in files]
 
 
+def load_workers() -> int:
+    """Checkpoint-load parallelism (threads reading safetensors
+    shards). ``SKYTPU_LOAD_WORKERS`` overrides; default
+    min(8, cpu count). 1 disables threading entirely. The bench
+    records this so load-time trajectories stay attributable."""
+    env = os.environ.get('SKYTPU_LOAD_WORKERS')
+    if env:
+        return max(1, int(env))
+    return min(8, os.cpu_count() or 1)
+
+
 def _iter_tensors(path: str) -> Iterator[Tuple[str, np.ndarray]]:
     from safetensors import safe_open
     for fname in _safetensor_files(path):
         with safe_open(fname, framework='np') as f:
             for key in f.keys():
                 yield key, f.get_tensor(key)
+
+
+def _for_each_tensor(path: str, process) -> None:
+    """Apply ``process(key, tensor)`` to every tensor in the checkpoint,
+    reading shards with a thread pool of :func:`load_workers` threads.
+    Each worker holds its own ``safe_open`` handles and AT MOST ONE
+    decoded tensor at a time, so peak extra host memory is bounded by
+    ``workers x largest tensor`` — not the checkpoint size. safetensors
+    reads release the GIL for the file I/O + memcpy, so ``ckpt_load_s``
+    scales with workers until the disk saturates (BENCH_r05 measured
+    10.6 s serial for the 7B). ``process`` must be thread-safe for
+    DISTINCT keys (each key is processed exactly once)."""
+    from concurrent.futures import ThreadPoolExecutor
+
+    from safetensors import safe_open
+    workers = load_workers()
+    files = _safetensor_files(path)
+    per_file: list = []
+    for fname in files:
+        with safe_open(fname, framework='np') as f:
+            per_file.append((fname, list(f.keys())))
+    pairs = [(fname, key) for fname, keys in per_file for key in keys]
+    if workers <= 1 or len(pairs) <= 1:
+        for key, w in _iter_tensors(path):
+            process(key, w)
+        return
+
+    def run_shard(shard) -> None:
+        import contextlib
+        with contextlib.ExitStack() as stack:
+            handles = {}
+            for fname, key in shard:
+                f = handles.get(fname)
+                if f is None:
+                    f = stack.enter_context(
+                        safe_open(fname, framework='np'))
+                    handles[fname] = f
+                process(key, f.get_tensor(key))
+
+    shards = [pairs[i::workers] for i in range(workers)]
+    with ThreadPoolExecutor(max_workers=workers) as ex:
+        # list() re-raises the first worker exception.
+        list(ex.map(run_shard, [s for s in shards if s]))
 
 
 def _hf_key_map(cfg: ModelConfig) -> Dict[str, Tuple[str, ...]]:
@@ -189,42 +243,57 @@ def load_hf_params(path: str, cfg: ModelConfig,
     expert_bufs: Dict[str, np.ndarray] = {}
     top: Dict[str, np.ndarray] = {}
     seen = set()
+    # Tensors stream in from a thread pool (_for_each_tensor; bounded
+    # memory — each worker decodes one tensor at a time). Buffer ROW
+    # writes are disjoint per key; only the shared-dict mutations
+    # (buffer allocation, the seen set) need the lock.
+    import threading
+    alloc_lock = threading.Lock()
 
-    for key, w in _iter_tensors(path):
+    def process(key: str, w: np.ndarray) -> None:
         if key == 'model.embed_tokens.weight':
-            top['embed'] = w
-            seen.add(key)
-            continue
+            with alloc_lock:
+                top['embed'] = w
+                seen.add(key)
+            return
         if key == 'model.norm.weight':
-            top['final_norm'] = w.astype(np.float32)
-            seen.add(key)
-            continue
+            w = w.astype(np.float32)
+            with alloc_lock:
+                top['final_norm'] = w
+                seen.add(key)
+            return
         if key == 'lm_head.weight':
             if not cfg.tie_embeddings:
-                top['unembed'] = w.T
-                seen.add(key)
-            continue
+                with alloc_lock:
+                    top['unembed'] = w.T
+                    seen.add(key)
+            return
         if not key.startswith('model.layers.'):
-            continue
+            return
         rest = key[len('model.layers.'):]
         idx_str, suffix = rest.split('.', 1)
         i = int(idx_str)
         leaf = key_map.get(suffix)
         if leaf is None:
-            continue
+            return
         w = _transform(leaf, w, cfg)
         name = leaf[1]
         if len(leaf) == 3:                   # per-expert tensor
             e = leaf[2]
-            buf = expert_bufs.setdefault(
-                name,
-                np.zeros((L, cfg.n_experts) + w.shape, w.dtype))
+            with alloc_lock:
+                buf = expert_bufs.setdefault(
+                    name,
+                    np.zeros((L, cfg.n_experts) + w.shape, w.dtype))
+                seen.add(key)
             buf[i, e] = w
         else:
-            buf = stacked.setdefault(name,
-                                     np.zeros((L,) + w.shape, w.dtype))
+            with alloc_lock:
+                buf = stacked.setdefault(
+                    name, np.zeros((L,) + w.shape, w.dtype))
+                seen.add(key)
             buf[i] = w
-        seen.add(key)
+
+    _for_each_tensor(path, process)
 
     # Completeness: every expected tensor must have been seen, per layer —
     # a missing layer tensor would otherwise silently load as zeros.
@@ -434,10 +503,10 @@ def _load_int8_cache(cache_file: str, cfg: ModelConfig) -> Params:
         return entry['name'], jnp.asarray(a)
 
     # Parallel device puts: each leaf streams disk -> page cache ->
-    # device independently; 8 threads overlap the host read with the
-    # transfer (the serialized per-leaf put was the other half of the
-    # 27.9 s).
-    with ThreadPoolExecutor(max_workers=8) as ex:
+    # device independently; the load_workers() pool overlaps the host
+    # read with the transfer (the serialized per-leaf put was the
+    # other half of the 27.9 s).
+    with ThreadPoolExecutor(max_workers=load_workers()) as ex:
         flat = dict(ex.map(fetch, meta['manifest']))
     params: Params = {}
     pending: Dict[str, Dict[str, Any]] = {}

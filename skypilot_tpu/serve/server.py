@@ -41,7 +41,8 @@ class ModelServer:
                  page_size: Optional[int] = None,
                  prefill_w8a8: bool = False,
                  prefill_chunk_tokens: Optional[int] = None,
-                 decode_priority_ratio: Optional[float] = None):
+                 decode_priority_ratio: Optional[float] = None,
+                 speculate_k: int = 0):
         self.cfg_name = cfg_name
         self.model_path = model_path  # HF checkpoint dir (real weights)
         self.quantize = quantize      # 'int8' => int8 weights + KV cache
@@ -53,6 +54,10 @@ class ModelServer:
         # budget while prompts are mid-prefill.
         self.prefill_chunk_tokens = prefill_chunk_tokens
         self.decode_priority_ratio = decode_priority_ratio
+        # Speculative decoding: n-gram/prompt-lookup proposer + batched
+        # on-device verify (0 = off). Greedy outputs are identical to
+        # vanilla decode; sampling keeps the output distribution.
+        self.speculate_k = speculate_k or 0
         self.max_batch = max_batch
         self.max_seq = max_seq
         self.port = port
@@ -94,6 +99,7 @@ class ModelServer:
         if self.decode_priority_ratio is not None:
             extra['decode_priority_ratio'] = self.decode_priority_ratio
         extra['prefill_w8a8'] = self.prefill_w8a8
+        extra['speculate_k'] = self.speculate_k
         if self.model_path:
             # Real weights: HF checkpoint dir (config.json + safetensors
             # [+ tokenizer.json]) — the reference serves such checkpoints
@@ -135,6 +141,14 @@ class ModelServer:
                 self._work.wait()
                 if self._stopping:
                     break
+                if self.speculate_k and self.engine is not None:
+                    # Host-only n-gram matching for the next verify
+                    # round, BEFORE taking the engine lock — handler
+                    # threads must never queue behind proposer CPU
+                    # work (graftcheck GC108 pins this discipline).
+                    # Stale results (a slot turned over meanwhile) are
+                    # revalidated and recomputed inside step().
+                    self.engine.prepare_proposals()
                 with self._lock:
                     has_work = self.engine.has_work()
                     if has_work:
@@ -293,6 +307,13 @@ class ModelServer:
                 elif self.path == '/metrics':
                     eng = server.engine
                     ttfts = sorted(server._ttfts)
+                    # Gauge block contract: every key is ALWAYS present
+                    # and numeric (0 when idle / a feature is off) —
+                    # scrapers see one stable schema, never a key that
+                    # appears only once traffic or speculation starts.
+                    spec = (eng.spec_metrics()
+                            if eng is not None
+                            and hasattr(eng, 'spec_metrics') else {})
                     payload = {
                         'requests_served': server._requests_served,
                         'requests_aborted': server._requests_aborted,
@@ -305,16 +326,26 @@ class ModelServer:
                         'max_batch': server.max_batch,
                         'ttft_ms_median': (round(
                             ttfts[len(ttfts) // 2], 1)
-                            if ttfts else None),
+                            if ttfts else 0.0),
                         'ttft_ms_p90': (round(
                             ttfts[int(len(ttfts) * 0.9)], 1)
-                            if ttfts else None),
+                            if ttfts else 0.0),
                         'ttft_window': len(ttfts),
+                        # Speculative decoding gauges (zeros when off).
+                        'speculate_k': spec.get('speculate_k', 0),
+                        'spec_accept_rate': round(
+                            spec.get('spec_accept_rate', 0.0), 4),
+                        'spec_tokens_per_step': round(
+                            spec.get('spec_tokens_per_step', 0.0), 3),
+                        'spec_proposed': spec.get('spec_proposed', 0),
+                        'spec_accepted': spec.get('spec_accepted', 0),
+                        'spec_rounds': spec.get('spec_rounds', 0),
                         'scheduler': {
                             'prefill_chunk_tokens': getattr(
-                                eng, 'chunk', None),
+                                eng, 'chunk', 0) or 0,
                             'decode_priority_ratio': getattr(
-                                eng, 'decode_priority_ratio', None),
+                                eng, 'decode_priority_ratio', 0) or 0,
+                            'speculate_k': spec.get('speculate_k', 0),
                         },
                     }
                     self._json(200, payload)
@@ -642,6 +673,14 @@ def main() -> None:
                              'budget while prompts are mid-prefill '
                              '(0..1); higher favors streaming TPOT, '
                              'lower favors TTFT. Default: engine-tuned')
+    parser.add_argument('--speculate-k', type=int, default=0,
+                        help='speculative decoding: propose up to K '
+                             'tokens per verify step via prompt-lookup '
+                             '(n-gram) matching against each request\'s '
+                             'own history (0 = off). Greedy outputs are '
+                             'identical to vanilla decode; sampling '
+                             'keeps the output distribution. Biggest '
+                             'win on repetitive/extractive text')
     parser.add_argument('--prefill-w8a8', action='store_true',
                         help='quantize prefill activations to int8 '
                              '(2x MXU rate on the compute-bound '
@@ -663,7 +702,8 @@ def main() -> None:
                          page_size=args.page_size,
                          prefill_w8a8=args.prefill_w8a8,
                          prefill_chunk_tokens=args.prefill_chunk_tokens,
-                         decode_priority_ratio=args.decode_priority_ratio)
+                         decode_priority_ratio=args.decode_priority_ratio,
+                         speculate_k=args.speculate_k)
     server.start(block=True)
 
 

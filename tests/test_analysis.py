@@ -206,6 +206,48 @@ def test_gc107_handler_without_timeout():
     assert 'H ' in vs[0].message
 
 
+# ------------------------------------------------------------------ GC108
+def test_gc108_proposer_under_lock_flagged():
+    src = '''
+    import threading
+    class S:
+        def __init__(self):
+            self._lock = threading.Lock()
+        def loop(self):
+            with self._lock:
+                self.engine.prepare_proposals()
+    '''
+    vs = check(src)
+    assert [v.rule for v in vs] == ['GC108']
+    assert 'prepare_proposals' in vs[0].message
+
+
+def test_gc108_proposer_outside_lock_ok():
+    src = '''
+    import threading
+    class S:
+        def __init__(self):
+            self._lock = threading.Lock()
+        def loop(self):
+            self.engine.prepare_proposals()
+            with self._lock:
+                self.engine.step()
+    '''
+    assert rule_ids(src) == []
+
+
+def test_gc108_ngram_propose_under_lock_flagged():
+    src = '''
+    import threading
+    lock = threading.Lock()
+    def f(eng, hist):
+        from skypilot_tpu.inference.speculative import ngram_propose
+        with lock:
+            return ngram_propose(hist, 4)
+    '''
+    assert rule_ids(src) == ['GC108']
+
+
 # ------------------------------------------------------------------ GC201
 def test_gc201_impure_calls_inside_jit():
     src = '''

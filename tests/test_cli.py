@@ -50,6 +50,18 @@ class TestBasics:
     def test_version(self, runner):
         assert '0.1.0' in _ok(runner.invoke(cli.cli, ['--version']))
 
+    def test_model_server_help_and_validation(self, runner):
+        out = _ok(runner.invoke(cli.cli, ['model-server', '--help']))
+        for opt in ('--speculate-k', '--kv-cache', '--quantize',
+                    '--prefill-chunk-tokens', '--page-size'):
+            assert opt in out
+        # --page-size only applies to the paged cache (mirrors the
+        # serve/server.py argparse contract).
+        bad = runner.invoke(cli.cli, ['model-server', '--kv-cache',
+                                      'slot', '--page-size', '128'])
+        assert bad.exit_code != 0
+        assert 'page-size' in bad.output
+
     def test_status_empty(self, runner):
         assert 'No existing clusters' in _ok(
             runner.invoke(cli.cli, ['status']))

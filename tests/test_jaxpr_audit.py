@@ -108,6 +108,32 @@ def test_paged_engine_audit():
     assert report.transfers, 'expected sanctioned pipeline readbacks'
 
 
+def test_slot_engine_speculative_audit():
+    """The speculative propose→verify→commit steady state: zero d2h
+    transfers outside the sanctioned per-round commit sync, and the
+    verify jit cache bounded by the (k, sample, kv_bucket) key set —
+    per-slot variable acceptance rides masked commits, never fresh
+    shapes."""
+    report = jaxpr_audit.audit_engine('slot', chunked=True,
+                                      speculate_k=4)
+    _assert_hot_loop_clean(report)
+    assert report.transfers, 'expected sanctioned commit readbacks'
+    assert 'spec_verify' in report.compile_counts
+    before, after = report.compile_counts['spec_verify']
+    assert before >= 1 and after == before
+    assert any('kv_bucket' in k and k.get('k') == 4
+               for k in report.static_keys)
+
+
+def test_paged_engine_speculative_audit():
+    report = jaxpr_audit.audit_engine('paged', chunked=True,
+                                      speculate_k=4)
+    _assert_hot_loop_clean(report)
+    assert 'spec_verify' in report.compile_counts
+    before, after = report.compile_counts['spec_verify']
+    assert before >= 1 and after == before
+
+
 def test_llama_forward_jaxpr_audit():
     report = jaxpr_audit.audit_llama_forward()
     assert not report.callback_prims
