@@ -418,6 +418,11 @@ def _bench_7b_serving(chip_bw: float, n_chips: int) -> dict:
         'prefix_misses': stats['prefix_misses'],
         'preemptions': eng.preemptions,
         'decode_impl': eng.decode_impl,
+        # Step-phase latency decomposition (telemetry profiler): where
+        # the host-side scheduling time went across the whole run —
+        # admit / prefill_chunk / decode_enqueue / readback / spec —
+        # plus the first-call-per-jit-key (compile) events.
+        'step_phases': eng.phase_stats(),
     }
 
     # (4) Slot-cache comparison at ITS feasible batch. The paged pool
@@ -493,6 +498,7 @@ def _bench_7b_serving(chip_bw: float, n_chips: int) -> dict:
                 'ttft_ms_p90_burst': (round(
                     sttfts[int(len(sttfts) * 0.9)], 1)
                     if sttfts else None),
+                'step_phases': seng.phase_stats(),
             }
             del seng
             gc.collect()       # free the slot cache before the next run
@@ -898,6 +904,9 @@ def _bench_1b_modeled(on_tpu: bool, chip_bw: float, n_chips: int) -> dict:
     vs_baseline = (equiv_7b * V6E_HBM_BW / chip_bw) / BASELINE_TOK_S_PER_CHIP
 
     chunk_cfg = (eng.chunk, eng.decode_priority_ratio)
+    # Step-phase latency decomposition (telemetry profiler) — where
+    # the host-side scheduling time went, plus first-compile events.
+    step_phases = eng.phase_stats()
     del eng
     # Speculative comparison at this scale too (slot engine; tiny on
     # the CPU fallback so the spec block always rides the trajectory).
@@ -921,6 +930,7 @@ def _bench_1b_modeled(on_tpu: bool, chip_bw: float, n_chips: int) -> dict:
             'model': cfg.name,
             'prefill_chunk_tokens': chunk_cfg[0],
             'decode_priority_ratio': chunk_cfg[1],
+            'step_phases': step_phases,
             'ckpt_load_workers': _load_workers_safe(),
             'spec': spec_detail,
             'raw_tok_s_per_chip': round(tok_s_chip, 2),

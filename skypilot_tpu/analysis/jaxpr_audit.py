@@ -268,7 +268,8 @@ def _jit_fns(fn) -> List[Any]:
 
 
 # ------------------------------------------------------------------ presets
-def _tiny_engine(kind: str, chunked: bool, speculate_k: int = 0):
+def _tiny_engine(kind: str, chunked: bool, speculate_k: int = 0,
+                 telemetry: bool = True):
     from skypilot_tpu.models import configs
     cfg = configs.get_config('tiny')
     chunk = 16 if chunked else 0
@@ -276,11 +277,13 @@ def _tiny_engine(kind: str, chunked: bool, speculate_k: int = 0):
         from skypilot_tpu.inference.paged import PagedInferenceEngine
         return PagedInferenceEngine(cfg, max_batch=4, max_seq=128,
                                     prefill_chunk_tokens=chunk or None,
-                                    speculate_k=speculate_k)
+                                    speculate_k=speculate_k,
+                                    telemetry=telemetry)
     from skypilot_tpu.inference.engine import InferenceEngine
     return InferenceEngine(cfg, max_batch=4, max_seq=128,
                            prefill_chunk_tokens=chunk,
-                           speculate_k=speculate_k)
+                           speculate_k=speculate_k,
+                           telemetry=telemetry)
 
 
 def _drive(engine, prompts: List[List[int]], max_new: int = 8) -> None:
@@ -406,6 +409,51 @@ def audit_llama_forward() -> AuditReport:
     return report
 
 
+def audit_telemetry_parity(kind: str = 'slot') -> AuditReport:
+    """Prove telemetry is free at the device boundary: a
+    telemetry-ENABLED engine run performs zero unsanctioned d2h
+    transfers and compiles exactly the same set of programs as a
+    telemetry-OFF run (all measurement is host-side around
+    dispatches). Per-mode steady-state recompiles and the on-vs-off
+    jit-cache-size comparison both land in ``compile_counts``, so a
+    parity break fails ``ok()`` like any other recompile."""
+    report = AuditReport(name=f'telemetry parity ({kind} engine)')
+    prompts = [[1, 2, 3] * 9, [4, 5] * 10, [7] * 21]
+
+    def cache_total(engine) -> int:
+        total = 0
+        for attr in ('_prefill_fns', '_chunk_prefill_fns',
+                     '_spec_verify_fns'):
+            fns = getattr(engine, attr, None)
+            if fns is not None:
+                total += len(fns)
+        decode_jits = _jit_fns(engine._decode_fn)
+        total += sum(max(0, _cache_size(f)) for f in decode_jits)
+        return total
+
+    totals: Dict[bool, int] = {}
+    for mode in (False, True):
+        engine = _tiny_engine(kind, chunked=True, telemetry=mode)
+        _drive(engine, prompts)                   # warmup: compiles
+        before = cache_total(engine)
+        label = 'telemetry-on' if mode else 'telemetry-off'
+        if mode:
+            # Transfers recorded only for the telemetry-ON run: the
+            # claim under test is that telemetry adds none.
+            with intercept_host_transfers(report.transfers):
+                for _ in range(2):
+                    _drive(engine, prompts)
+        else:
+            for _ in range(2):
+                _drive(engine, prompts)
+        report.compile_counts[f'steady-state [{label}]'] = (
+            before, cache_total(engine))
+        totals[mode] = cache_total(engine)
+    report.compile_counts['jit cache size (off vs on)'] = (
+        totals[False], totals[True])
+    return report
+
+
 PRESETS: Dict[str, Callable[[], AuditReport]] = {
     'slot': lambda: audit_engine('slot', chunked=True),
     'slot-monolithic': lambda: audit_engine('slot', chunked=False),
@@ -414,11 +462,13 @@ PRESETS: Dict[str, Callable[[], AuditReport]] = {
                                       speculate_k=4),
     'paged-spec': lambda: audit_engine('paged', chunked=True,
                                        speculate_k=4),
+    'telemetry': audit_telemetry_parity,
+    'telemetry-paged': lambda: audit_telemetry_parity('paged'),
     'llama': audit_llama_forward,
 }
 
 
 def run_presets(names: Optional[List[str]] = None) -> List[AuditReport]:
     names = names or ['slot', 'paged', 'slot-spec', 'paged-spec',
-                      'llama']
+                      'telemetry', 'llama']
     return [PRESETS[n]() for n in names]

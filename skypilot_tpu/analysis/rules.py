@@ -32,6 +32,14 @@ Concurrency / control-plane hygiene (GC1xx):
   work (``prepare_proposals``/``ngram_propose`` — per-slot numpy n-gram
   matching) invoked while holding a lock serializes every HTTP handler
   behind proposer CPU time; the serve loop runs it before locking.
+- **GC109 adhoc-timing** — ``time.time()`` / ``perf_counter()`` /
+  ``monotonic()`` calls in the ``inference/`` hot paths outside the
+  telemetry helpers. Timing on the engine hot path must route through
+  ``skypilot_tpu.telemetry`` (``clock`` / the step-phase profiler) so
+  overhead is accounted, phases land in the registry, and a stray
+  timing pair around a jitted dispatch can't masquerade as device
+  time (inside jit bodies GC201 already fires; this rule covers the
+  host side).
 
 TPU hot-path hygiene (GC2xx), applied to the compute layer
 (``inference/``, ``models/``, ``ops/``, ``train/``):
@@ -77,6 +85,9 @@ RULES: Dict[str, str] = {
     'GC108': 'proposer-under-lock: speculative-proposer host work '
              '(n-gram matching) invoked while holding a lock — call '
              'prepare_proposals() BEFORE taking the engine lock',
+    'GC109': 'adhoc-timing: wall-clock/perf-counter call in an '
+             'inference hot path — use skypilot_tpu.telemetry '
+             '(clock / step-phase profiler) instead',
     'GC201': 'impure-jit: impure or host-synchronizing call inside a '
              '@jax.jit body',
     'GC202': 'host-sync: device->host readback outside the '
@@ -121,6 +132,18 @@ _RPC_MODULES = {'core', 'execution', 'backend_utils', 'provisioner'}
 # loop must call prepare_proposals() BEFORE locking (the engine
 # revalidates and recomputes stale entries inside step()).
 _PROPOSER_HOST_FNS = {'prepare_proposals', 'ngram_propose'}
+
+# --------------------------------------------------------------------- GC109
+# Ad-hoc timing calls banned from inference/ hot paths: telemetry's
+# clock/profiler are the sanctioned spellings there (GC201 covers the
+# inside-jit case; this covers the host side of the engine loop).
+_ADHOC_TIMING = {
+    'time.time', 'time.monotonic', 'time.perf_counter',
+    'time.perf_counter_ns', 'time.process_time', 'time.thread_time',
+}
+# from-import spellings (``from time import perf_counter``).
+_ADHOC_TIMING_BARE = {'perf_counter', 'perf_counter_ns', 'monotonic',
+                      'process_time', 'thread_time'}
 
 # --------------------------------------------------------------------- GC201
 _IMPURE_IN_JIT = {
@@ -262,10 +285,12 @@ class _ClassPrepass(ast.NodeVisitor):
 
 class _Checker(ast.NodeVisitor):
 
-    def __init__(self, rel: str, lines: List[str], is_compute: bool):
+    def __init__(self, rel: str, lines: List[str], is_compute: bool,
+                 is_inference: bool = False):
         self.rel = rel
         self.lines = lines
         self.is_compute = is_compute
+        self.is_inference = is_inference
         self.violations: List[Violation] = []
         self._scope: List[str] = []
         self._class: List[Tuple[Set[str], Set[str]]] = []  # (locks, guarded)
@@ -441,7 +466,19 @@ class _Checker(ast.NodeVisitor):
             self._check_jit_purity(node, name, method)
         elif self.is_compute:
             self._check_host_sync(node, name, method)
+            if self.is_inference:
+                self._check_adhoc_timing(node, name)
         self.generic_visit(node)
+
+    def _check_adhoc_timing(self, node: ast.Call, name: str) -> None:
+        if (name in _ADHOC_TIMING
+                or ('.' not in name and name in _ADHOC_TIMING_BARE)):
+            self._add('GC109', node,
+                      f'{name}() in an inference hot path — route '
+                      'timing through skypilot_tpu.telemetry '
+                      '(clock.now()/clock.monotonic() or the '
+                      'step-phase profiler) so overhead is accounted '
+                      'and the phase lands in the registry')
 
     def _check_timeouts(self, node: ast.Call, name: str) -> None:
         if name.rsplit('.', 1)[-1] == 'urlopen' and not _has_timeout(node):
@@ -565,7 +602,9 @@ def check_source(rel: str, source: str) -> List[Violation]:
     norm = rel.replace('\\', '/')
     is_compute = (any(f'/{d}/' in f'/{norm}' for d in COMPUTE_DIRS)
                   and not norm.endswith(HOST_HELPER_SUFFIX))
-    checker = _Checker(norm, source.splitlines(), is_compute)
+    is_inference = is_compute and '/inference/' in f'/{norm}'
+    checker = _Checker(norm, source.splitlines(), is_compute,
+                       is_inference)
     checker.visit(tree)
     suppressed = _line_suppressions(source)
     out = []

@@ -651,6 +651,56 @@ def storage_delete(names, delete_all, yes):
         click.echo(f'Storage {name!r} deleted.')
 
 
+# ------------------------------------------------------------ telemetry
+@cli.group()
+def telemetry():
+    """Unified telemetry: metrics registry, request traces, profiler."""
+
+
+@telemetry.command(name='dump')
+@click.option('--url', default=None, metavar='http://HOST:PORT',
+              help='Fetch a running server\'s /metrics instead of this '
+                   'process\'s registry (model server, dashboard — any '
+                   'endpoint speaking the telemetry exposition).')
+@click.option('--format', 'fmt', default='prom',
+              type=click.Choice(['prom', 'json']),
+              help='Prometheus text exposition (default) or JSON.')
+@click.option('--debug-requests', is_flag=True,
+              help='With --url: dump /debug/requests (completed '
+                   'request span timelines) instead of /metrics.')
+@click.option('--chrome-trace', default=None, metavar='PATH',
+              help='Also export this process\'s completed request '
+                   'traces as a chrome://tracing file.')
+def telemetry_dump(url, fmt, debug_requests, chrome_trace):
+    """Dump telemetry: the local process registry, or a remote
+    server's /metrics or /debug/requests."""
+    import urllib.request
+
+    from skypilot_tpu import telemetry as telemetry_lib
+    if debug_requests and not url:
+        raise click.UsageError('--debug-requests requires --url')
+    if url:
+        base = url.rstrip('/')
+        if debug_requests:
+            path = '/debug/requests'
+        elif fmt == 'json':
+            path = '/metrics?format=json'
+        else:
+            path = '/metrics'
+        with urllib.request.urlopen(base + path, timeout=10) as r:
+            click.echo(r.read().decode())
+        return
+    reg = telemetry_lib.get_registry()
+    if fmt == 'json':
+        import json as json_lib
+        click.echo(json_lib.dumps(reg.render_json(), indent=2))
+    else:
+        click.echo(reg.render_prometheus(), nl=False)
+    if chrome_trace:
+        out = telemetry_lib.export_chrome_trace(chrome_trace)
+        click.echo(f'chrome trace: {out or "no completed traces"}')
+
+
 @cli.command()
 @click.option('--port', default=8500, help='Port to serve the dashboard.')
 @click.option('--no-browser', is_flag=True, hidden=True)

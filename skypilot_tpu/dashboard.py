@@ -11,9 +11,11 @@ import html
 import http.server
 import json
 import time
+import urllib.parse
 from typing import Any, Dict, List, Optional, Tuple
 
 from skypilot_tpu import global_state
+from skypilot_tpu import telemetry
 from skypilot_tpu import tpu_logging
 
 logger = tpu_logging.init_logger(__name__)
@@ -127,14 +129,33 @@ def render_page() -> str:
                         sections=sections)
 
 
-def _metrics_json() -> str:
+def _update_cluster_gauges() -> None:
+    """Fold control-plane state into the process telemetry registry —
+    the dashboard no longer keeps a private metrics dict; it renders
+    the same registry the serve layer writes to."""
     _, clusters = _clusters()
+    reg = telemetry.get_registry()
+    reg.gauge('skytpu_clusters', 'Known clusters').set(len(clusters))
+    reg.gauge('skytpu_clusters_up', 'Clusters in UP status').set(
+        sum(1 for c in clusters if c['status'].value == 'UP'))
+
+
+def _metrics_json() -> str:
+    """Stable legacy keys (clusters / clusters_up / time) plus the full
+    registry dump under ``telemetry`` — one source of truth."""
+    _update_cluster_gauges()
+    reg = telemetry.get_registry()
     return json.dumps({
-        'clusters': len(clusters),
-        'clusters_up': sum(1 for c in clusters
-                           if c['status'].value == 'UP'),
+        'clusters': int(reg.get('skytpu_clusters').value),
+        'clusters_up': int(reg.get('skytpu_clusters_up').value),
         'time': time.time(),
+        'telemetry': reg.render_json(),
     })
+
+
+def _metrics_prometheus() -> str:
+    _update_cluster_gauges()
+    return telemetry.get_registry().render_prometheus()
 
 
 class _Handler(http.server.BaseHTTPRequestHandler):
@@ -146,9 +167,15 @@ class _Handler(http.server.BaseHTTPRequestHandler):
         del args
 
     def do_GET(self):  # noqa: N802
-        if self.path == '/metrics':
-            body = _metrics_json().encode()
-            ctype = 'application/json'
+        parsed = urllib.parse.urlparse(self.path)
+        if parsed.path == '/metrics':
+            query = urllib.parse.parse_qs(parsed.query)
+            if query.get('format', [''])[0] == 'json':
+                body = _metrics_json().encode()
+                ctype = 'application/json'
+            else:
+                body = _metrics_prometheus().encode()
+                ctype = 'text/plain; version=0.0.4; charset=utf-8'
         else:
             body = render_page().encode()
             ctype = 'text/html; charset=utf-8'

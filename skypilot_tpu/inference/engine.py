@@ -28,17 +28,19 @@ from __future__ import annotations
 import collections
 import dataclasses
 import functools
-import time
 from typing import Any, Dict, List, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
+from skypilot_tpu import telemetry
 from skypilot_tpu.inference.speculative import SpeculativeMixin
 from skypilot_tpu.models import llama
 from skypilot_tpu.models.configs import ModelConfig
 from skypilot_tpu.parallel import mesh as mesh_lib
+from skypilot_tpu.telemetry import clock
+from skypilot_tpu.telemetry import tracing
 from skypilot_tpu.utils.host import host_sync
 
 
@@ -67,6 +69,9 @@ class Request:
     # request's tail tokens surfaced through the async pipeline.
     _enq_out: int = 0
     _early_freed: bool = False
+    # Per-request lifecycle trace (telemetry.tracing.RequestTrace;
+    # None when engine telemetry is off).
+    trace: Optional[Any] = None
 
     @property
     def ttft_ms(self) -> Optional[float]:
@@ -163,7 +168,44 @@ class _EngineBase:
     (the compiled paths + their lagged readback) and may override
     ``_free_slot``/``_validate_request``."""
 
+    def _init_telemetry(self, enabled: bool = True) -> None:
+        """Engine telemetry: the step-phase profiler + per-request
+        traces. ``enabled`` ANDs with the process-wide kill switch
+        (``SKYTPU_TELEMETRY=0``). All measurement is host-side around
+        dispatches — the jaxpr audit's ``telemetry`` preset proves
+        telemetry-on adds zero d2h transfers and zero compiles."""
+        from skypilot_tpu.telemetry import profiler as profiler_lib
+        self.telemetry_enabled = bool(enabled) and telemetry.enabled()
+        self._prof = (profiler_lib.StepProfiler(
+            engine=type(self).__name__) if self.telemetry_enabled
+            else profiler_lib.NullProfiler())
+
+    def phase_stats(self) -> Dict[str, Any]:
+        """Step-phase latency decomposition + first-compile events for
+        THIS engine (the bench and ``/debug`` surface)."""
+        return self._prof.phase_stats()
+
+    def _trace_finish(self, req: 'Request', **meta: Any) -> None:
+        """Complete a request's trace and publish it to the process
+        ring buffer (the ``/debug/requests`` surface)."""
+        if req.trace is None:
+            return
+        req.trace.end('decode')
+        req.trace.finish(output_tokens=len(req.output), **meta)
+        tracing.get_trace_buffer().add(req.trace)
+        req.trace = None            # publish exactly once
+
+    def _trace_sched(self, req: 'Request') -> None:
+        """Queue -> slot transition: close the queue-wait span, open
+        the prefill span (re-admissions re-open both — the spans
+        repeat, preserving the real timeline)."""
+        if req.trace is not None:
+            req.trace.end('queue')
+            req.trace.begin('prefill')
+
     def _init_slots(self, max_batch: int) -> None:
+        if not hasattr(self, '_prof'):       # engines call _init_telemetry
+            self._init_telemetry(True)       # first; belt and braces
         self._slots: List[Optional[Request]] = [None] * max_batch
         # A deque, not queue.Queue: admission must be able to REQUEUE AT
         # THE HEAD (capacity backoff) without starving the request
@@ -234,7 +276,11 @@ class _EngineBase:
         req = Request(request_id=self._next_id, prompt=list(prompt),
                       max_new_tokens=max_new_tokens, temperature=temperature,
                       top_k=top_k, top_p=top_p, eos_id=eos_id,
-                      stop=stop or None, submit_time=time.time())
+                      stop=stop or None, submit_time=clock.now())
+        if self.telemetry_enabled:
+            req.trace = tracing.RequestTrace(req.request_id)
+            req.trace.begin('queue', prompt_tokens=len(prompt),
+                            max_new_tokens=max_new_tokens)
         self._next_id += 1
         self._queue.append(req)
         return req.request_id
@@ -306,13 +352,18 @@ class _EngineBase:
         # Make room in the pipeline (sync the oldest call) BEFORE
         # admitting: processing frees finished slots, so admission sees
         # the freshest slot table.
-        while len(self._pending) >= self._PIPELINE_DEPTH:
-            events.extend(self._process_one())
-        events.extend(self._admit())
-        if not self._enqueue_decode(horizon) and self._pending:
+        with self._prof.phase('readback'):
+            while len(self._pending) >= self._PIPELINE_DEPTH:
+                events.extend(self._process_one())
+        with self._prof.phase('admit'):
+            events.extend(self._admit())
+        with self._prof.phase('decode_enqueue'):
+            enqueued = self._enqueue_decode(horizon)
+        if not enqueued and self._pending:
             # Nothing to enqueue (no active slots, or capacity pinned
             # until in-flight calls land): drain one instead.
-            events.extend(self._process_one())
+            with self._prof.phase('readback'):
+                events.extend(self._process_one())
         return events
 
     def run_to_completion(self, horizon: int = 32) -> Dict[int, Request]:
@@ -327,14 +378,16 @@ class _EngineBase:
         decode slot so a disconnected client stops consuming capacity.
         Returns True if the request was still live (it is NOT recorded in
         the finished table). Safe no-op for finished/unknown ids."""
-        n_before = len(self._queue)
+        dropped = [r for r in self._queue if r.request_id == request_id]
         self._queue = collections.deque(
             r for r in self._queue if r.request_id != request_id)
-        if len(self._queue) != n_before:
+        if dropped:
+            self._trace_finish(dropped[0], cancelled=True)
             return True
         for slot, req in enumerate(self._slots):
             if req is not None and req.request_id == request_id:
-                req.finish_time = time.time()
+                req.finish_time = clock.now()
+                self._trace_finish(req, cancelled=True)
                 self._free_slot(slot)
                 return True
         return False
@@ -379,8 +432,9 @@ class _EngineBase:
                 or (req.eos_id is not None and token == req.eos_id)
                 or len(req.prompt) + len(req.output) >= self.max_seq)
         if done:
-            req.finish_time = time.time()
+            req.finish_time = clock.now()
             self._finished[req.request_id] = req
+            self._trace_finish(req, stop_hit=req.stop_hit)
             if self._slots[slot] is req:
                 self._free_slot(slot)
         return done
@@ -405,7 +459,9 @@ class InferenceEngine(SpeculativeMixin, _EngineBase):
                  prefill_w8a8: bool = False,
                  prefill_chunk_tokens: Optional[int] = 256,
                  decode_priority_ratio: Optional[float] = None,
-                 speculate_k: int = 0):
+                 speculate_k: int = 0,
+                 telemetry: bool = True):
+        self._init_telemetry(telemetry)
         self.max_batch = max_batch
         self.max_seq = max_seq
         self.mesh = mesh
@@ -619,6 +675,7 @@ class InferenceEngine(SpeculativeMixin, _EngineBase):
             self._slots[slot] = req
             self._slot_len[slot] = 0
             self._prefill_off[slot] = 0
+            self._trace_sched(req)
 
     def _free_slot(self, slot: int) -> None:
         self._prefill_off.pop(slot, None)      # cancel mid-prefill
@@ -713,9 +770,20 @@ class InferenceEngine(SpeculativeMixin, _EngineBase):
             (tokens, starts, valid, want, slots_arr, temps, topks,
              topps))
         prefill = self._get_chunk_prefill(n, chunk_w, kv_bucket, sample)
-        first, self.cache = prefill(
-            self.params, self.cache, tokens_d, starts_d, valid_d,
-            want_d, slots_d, temps_d, topks_d, topps_d, prng)
+        chunk_t0 = clock.monotonic()
+        with self._prof.phase('prefill_chunk'), \
+                self._prof.jit_key('chunk_prefill',
+                                   (n, chunk_w, kv_bucket, sample)):
+            first, self.cache = prefill(
+                self.params, self.cache, tokens_d, starts_d, valid_d,
+                want_d, slots_d, temps_d, topks_d, topps_d, prng)
+        chunk_t1 = clock.monotonic()
+        for i, slot in enumerate(batch):
+            r = self._slots[slot]
+            if r.trace is not None:
+                r.trace.add('prefill_chunk', chunk_t0, chunk_t1,
+                            offset=self._prefill_off[slot],
+                            tokens=int(valid[i]))
         # Async: host bookkeeping advances NOW (device writes are
         # program-ordered); completing slots' sampled tokens merge into
         # the device token vector immediately so they decode on the
@@ -897,9 +965,11 @@ class InferenceEngine(SpeculativeMixin, _EngineBase):
         self._rng, rng = jax.random.split(self._rng)
         prop_d, n_prop_d = jax.device_put((proposals, n_prop))
         verify = self._get_spec_verify(sample, kv_bucket)
-        commit, n_commit, self._tok_dev, self.cache = verify(
-            self.params, self.cache, self._tok_dev, prop_d, n_prop_d,
-            temps_d, topks_d, topps_d, active_d, rng)
+        with self._prof.jit_key('spec_verify',
+                                (self.speculate_k, sample, kv_bucket)):
+            commit, n_commit, self._tok_dev, self.cache = verify(
+                self.params, self.cache, self._tok_dev, prop_d, n_prop_d,
+                temps_d, topks_d, topps_d, active_d, rng)
         return commit, n_commit
 
     def step(self, horizon: int = 1) -> List[Tuple[int, int, bool]]:
@@ -916,9 +986,11 @@ class InferenceEngine(SpeculativeMixin, _EngineBase):
         if not self.chunked and not self.speculate_k:
             return super().step(horizon)
         events: List[Tuple[int, int, bool]] = []
-        while len(self._pending) >= self._PIPELINE_DEPTH:
-            events.extend(self._process_one())
-        events.extend(self._admit())
+        with self._prof.phase('readback'):
+            while len(self._pending) >= self._PIPELINE_DEPTH:
+                events.extend(self._process_one())
+        with self._prof.phase('admit'):
+            events.extend(self._admit())
         if self.speculate_k:
             events.extend(self._spec_step())
             return events
@@ -926,8 +998,11 @@ class InferenceEngine(SpeculativeMixin, _EngineBase):
             horizon = min(horizon, self._interleave_horizon())
         elif self._queue:
             horizon = min(horizon, 32)
-        if not self._enqueue_decode(horizon) and self._pending:
-            events.extend(self._process_one())
+        with self._prof.phase('decode_enqueue'):
+            enqueued = self._enqueue_decode(horizon)
+        if not enqueued and self._pending:
+            with self._prof.phase('readback'):
+                events.extend(self._process_one())
         return events
 
     def _admit_monolithic(self) -> List[Tuple[int, int, bool]]:
@@ -997,9 +1072,11 @@ class InferenceEngine(SpeculativeMixin, _EngineBase):
             tokens[i, :len(req.prompt)] = req.prompt
             true_lens[i] = len(req.prompt)
             slots[i] = slot
-        next_tokens, self.cache = prefill(
-            self.params, self.cache, jnp.asarray(tokens),
-            jnp.asarray(true_lens), jnp.asarray(slots))
+        with self._prof.phase('prefill_chunk'), \
+                self._prof.jit_key('prefill', (bucket, n)):
+            next_tokens, self.cache = prefill(
+                self.params, self.cache, jnp.asarray(tokens),
+                jnp.asarray(true_lens), jnp.asarray(slots))
         # Async: reserve the slots NOW (so the next admission wave and
         # _enqueue_decode see them taken) but defer the token readback —
         # the prefill result rides the pipeline and its events surface
@@ -1012,6 +1089,7 @@ class InferenceEngine(SpeculativeMixin, _EngineBase):
         for slot, req in batch:
             self._slots[slot] = req
             self._slot_len[slot] = len(req.prompt)
+            self._trace_sched(req)
         self._meta_dirty = True
         self._pending.append({'kind': 'prefill', 'toks': next_tokens,
                               'batch': [(slot, req, i) for i, (slot, req)
@@ -1076,10 +1154,11 @@ class InferenceEngine(SpeculativeMixin, _EngineBase):
                         _bucket_len(max_live + self._inflight_steps +
                                     horizon))
         self._rng, rng = jax.random.split(self._rng)
-        toks, self.cache = self._decode_fn(
-            self.params, self.cache, self._tok_dev, rng,
-            temps_d, topks_d, topps_d, active_d, horizon, sample,
-            kv_bucket)
+        with self._prof.jit_key('decode', (horizon, sample, kv_bucket)):
+            toks, self.cache = self._decode_fn(
+                self.params, self.cache, self._tok_dev, rng,
+                temps_d, topks_d, topps_d, active_d, horizon, sample,
+                kv_bucket)
         self._tok_dev = toks[:, -1]
         self._inflight_steps += horizon
         self._pending.append({'kind': 'decode', 'toks': toks,
@@ -1099,13 +1178,16 @@ class InferenceEngine(SpeculativeMixin, _EngineBase):
         # jaxpr audit gates on it).
         toks = host_sync(entry['toks'])
         events: List[Tuple[int, int, bool]] = []
-        now = time.time()
+        now = clock.now()
         if entry['kind'] == 'prefill':
             for slot, req, row in entry['batch']:
                 if req.finish_time is not None:       # cancelled in flight
                     continue
                 token = int(toks[row])
                 req.first_token_time = now
+                if req.trace is not None:
+                    req.trace.end('prefill')
+                    req.trace.begin('decode')
                 req.output.append(token)
                 finished = self._finish_req(slot, req, token)
                 events.append((req.request_id, token, finished))

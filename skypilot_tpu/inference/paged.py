@@ -33,7 +33,6 @@ from __future__ import annotations
 
 import functools
 import hashlib
-import time
 from typing import Any, Dict, List, NamedTuple, Optional, Tuple
 
 import jax
@@ -44,6 +43,7 @@ from jax import lax
 from skypilot_tpu.models import llama
 from skypilot_tpu.models.configs import ModelConfig
 from skypilot_tpu.ops.attention import cached_attention, ring_decode_attention
+from skypilot_tpu.telemetry import clock
 from skypilot_tpu.utils.host import host_sync
 
 Params = Dict[str, Any]
@@ -665,9 +665,11 @@ class PagedInferenceEngine(SpeculativeMixin, _EngineBase):
                  decode_impl: str = 'auto',
                  prefill_w8a8: bool = False,
                  pages_per_block: int = 1,
-                 speculate_k: int = 0):
+                 speculate_k: int = 0,
+                 telemetry: bool = True):
         from skypilot_tpu.inference.engine import prepare_params
         from skypilot_tpu.parallel import mesh as mesh_lib
+        self._init_telemetry(telemetry)
         self.max_batch = max_batch
         self.max_seq = max_seq
         # page_size=None auto-selects a FAST-PATH size after the
@@ -1048,7 +1050,8 @@ class PagedInferenceEngine(SpeculativeMixin, _EngineBase):
             # pipeline's remaining tail tokens are dropped at readback
             # by the finish_time check. NOT recorded as finished —
             # same contract as a slot cancel.
-            req.finish_time = time.time()
+            req.finish_time = clock.now()
+            self._trace_finish(req, cancelled=True)
             return True
         return False
 
@@ -1103,6 +1106,12 @@ class PagedInferenceEngine(SpeculativeMixin, _EngineBase):
         are kept, TTFT is not reset."""
         req = self._slots[slot]
         self.preemptions += 1
+        if req.trace is not None:
+            # Close the in-slot spans; the re-admission re-opens
+            # queue → prefill → decode, preserving the real timeline.
+            req.trace.end('decode')
+            req.trace.end('prefill')
+            req.trace.begin('queue', preempted=True)
         self._free_slot(slot)
         self._requeue_front([req])
 
@@ -1162,6 +1171,10 @@ class PagedInferenceEngine(SpeculativeMixin, _EngineBase):
             req._n_matched = len(matched)        # host-only annotations
             req._ctx = ctx
             self._prefill_off[slot] = 0          # tail tokens done so far
+            self._trace_sched(req)
+            if req.trace is not None and matched:
+                req.trace.instant('prefix_cache_hit',
+                                  pages=len(matched))
 
     def _prefill_chunk_batch(self) -> List[Tuple[int, int, bool]]:
         """One fixed-size chunk across up to a compiled n-bucket of
@@ -1244,10 +1257,20 @@ class PagedInferenceEngine(SpeculativeMixin, _EngineBase):
         sample = any(self._slots[s].temperature > 0
                      for i, s in enumerate(batch) if want[i] >= 0)
         prefill = self._get_prefill(n, P, sample, chunk_w)
-        first, self.cache = prefill(
-            self.params, self.cache, table_d, tokens_d, lengths_d,
-            valid_d, want_d, temps_d, topks_d, topps_d, prng)
+        chunk_t0 = clock.monotonic()
+        with self._prof.phase('prefill_chunk'), \
+                self._prof.jit_key('prefill', (n, P, sample, chunk_w)):
+            first, self.cache = prefill(
+                self.params, self.cache, table_d, tokens_d, lengths_d,
+                valid_d, want_d, temps_d, topks_d, topps_d, prng)
+        chunk_t1 = clock.monotonic()
         self.chunks_prefilled += 1
+        for i, slot in enumerate(batch):
+            r = self._slots[slot]
+            if r.trace is not None:
+                r.trace.add('prefill_chunk', chunk_t0, chunk_t1,
+                            offset=self._prefill_off[slot],
+                            tokens=int(valid[i]))
         # Async: host bookkeeping advances NOW (the device writes are
         # program-ordered). Completing slots' sampled tokens merge into
         # the device token vector IMMEDIATELY (device-to-device, no
@@ -1354,10 +1377,12 @@ class PagedInferenceEngine(SpeculativeMixin, _EngineBase):
         table_d, prop_d, n_prop_d, lengths_d = jax.device_put(
             (table_p, proposals, n_prop, lengths))
         verify = self._get_spec_verify(self.max_batch, P, sample)
-        commit, n_commit, self._tok_dev, self.cache = verify(
-            self.params, self.cache, table_d, self._tok_dev, prop_d,
-            n_prop_d, lengths_d, active_d, temps_d, topks_d, topps_d,
-            rng)
+        with self._prof.jit_key('spec_verify',
+                                (self.speculate_k, sample, P)):
+            commit, n_commit, self._tok_dev, self.cache = verify(
+                self.params, self.cache, table_d, self._tok_dev, prop_d,
+                n_prop_d, lengths_d, active_d, temps_d, topks_d, topps_d,
+                rng)
         return commit, n_commit
 
     def step(self, horizon: int = 1) -> List[Tuple[int, int, bool]]:
@@ -1372,9 +1397,11 @@ class PagedInferenceEngine(SpeculativeMixin, _EngineBase):
         ``speculate_k > 0`` replaces the fused decode horizon with one
         synchronous propose→verify→commit round per step."""
         events: List[Tuple[int, int, bool]] = []
-        while len(self._pending) >= self._PIPELINE_DEPTH:
-            events.extend(self._process_one())
-        events.extend(self._admit())
+        with self._prof.phase('readback'):
+            while len(self._pending) >= self._PIPELINE_DEPTH:
+                events.extend(self._process_one())
+        with self._prof.phase('admit'):
+            events.extend(self._admit())
         if self.speculate_k:
             events.extend(self._spec_step())
             if self._deferred_events:
@@ -1392,8 +1419,11 @@ class PagedInferenceEngine(SpeculativeMixin, _EngineBase):
                           else self._interleave_horizon())
         elif self._queue:
             horizon = min(horizon, 32)
-        if not self._enqueue_decode(horizon) and self._pending:
-            events.extend(self._process_one())
+        with self._prof.phase('decode_enqueue'):
+            enqueued = self._enqueue_decode(horizon)
+        if not enqueued and self._pending:
+            with self._prof.phase('readback'):
+                events.extend(self._process_one())
         # Opportunistic drain: surface any entry whose device results
         # are ALREADY ready (non-blocking probe) instead of letting it
         # age up to _PIPELINE_DEPTH calls — at a 32-step horizon that
@@ -1401,16 +1431,17 @@ class PagedInferenceEngine(SpeculativeMixin, _EngineBase):
         # pinning recycle-window behavior turn it off: on CPU every
         # result is instantly ready and the window collapses.)
         if self._eager_drain:
-            while self._pending:
-                probe = getattr(self._pending[0]['toks'], 'is_ready',
-                                None)
-                # Probe OUTSIDE any except: an exception from result
-                # processing itself must propagate (the entry is
-                # already popped — swallowing it would drop tokens
-                # and strand inflight counts).
-                if probe is None or not probe():
-                    break
-                events.extend(self._process_one())
+            with self._prof.phase('readback'):
+                while self._pending:
+                    probe = getattr(self._pending[0]['toks'],
+                                    'is_ready', None)
+                    # Probe OUTSIDE any except: an exception from
+                    # result processing itself must propagate (the
+                    # entry is already popped — swallowing it would
+                    # drop tokens and strand inflight counts).
+                    if probe is None or not probe():
+                        break
+                    events.extend(self._process_one())
         if self._deferred_events:        # pool-pressure pipeline drain
             events.extend(self._deferred_events)
             self._deferred_events = []
@@ -1515,10 +1546,11 @@ class PagedInferenceEngine(SpeculativeMixin, _EngineBase):
         lengths = (self._slot_len + self._slot_inflight).astype(np.int32)
         self._rng, rng = jax.random.split(self._rng)
         table_dd, lengths_dd = jax.device_put((table_p, lengths))
-        toks, self.cache = self._decode_fn(
-            self.params, self.cache, table_dd,
-            self._tok_dev, lengths_dd, rng,
-            temps_d, topks_d, topps_d, active_d, horizon, sample)
+        with self._prof.jit_key('decode', (horizon, sample, P)):
+            toks, self.cache = self._decode_fn(
+                self.params, self.cache, table_dd,
+                self._tok_dev, lengths_dd, rng,
+                temps_d, topks_d, topps_d, active_d, horizon, sample)
         self._tok_dev = toks[:, -1]
         # Snapshot the epochs BEFORE any early free below bumps them:
         # the entry must record the epochs its tokens were produced
@@ -1550,7 +1582,7 @@ class PagedInferenceEngine(SpeculativeMixin, _EngineBase):
         # THE sanctioned device->host readback of the async pipeline
         # (jaxpr-audit-gated; see engine.py._process_one).
         vals = host_sync(entry['toks'])
-        now = time.time()
+        now = clock.now()
         if entry['kind'] == 'prefill':
             for slot, req, row in entry['batch']:
                 if req.finish_time is not None:
@@ -1563,6 +1595,9 @@ class PagedInferenceEngine(SpeculativeMixin, _EngineBase):
                     self._await_first.discard(slot)
                 if req.first_token_time is None:  # not on re-admission
                     req.first_token_time = now
+                if req.trace is not None:
+                    req.trace.end('prefill')
+                    req.trace.begin('decode')
                 req.output.append(token)
                 finished = self._finish_req(slot, req, token)
                 events.append((req.request_id, token, finished))

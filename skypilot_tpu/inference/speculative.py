@@ -274,27 +274,33 @@ class SpeculativeMixin:
         bookkeeping need host-complete outputs), then runs ONE verify
         program and commits its masked results. Emits 1..k+1 tokens per
         active slot."""
+        from skypilot_tpu.telemetry import clock
         from skypilot_tpu.utils.host import host_sync
         events: List[Tuple[int, int, bool]] = []
-        while self._pending:
-            events.extend(self._process_one())
+        with self._prof.phase('readback'):
+            while self._pending:
+                events.extend(self._process_one())
         ready = [r if s not in self._prefill_off else None
                  for s, r in enumerate(self._slots)]
         if not any(r is not None for r in ready):
             return events
-        proposals, n_prop, starved = self._spec_build_proposals(ready)
-        if starved:
-            self._spec_starved(starved)
-            ready = [r if s not in self._prefill_off else None
-                     for s, r in enumerate(self._slots)]
-            if not any(r is not None for r in ready):
-                return events
-        commit, n_commit = self._spec_verify_call(ready, proposals,
-                                                  n_prop)
-        # THE sanctioned readback of the speculative loop (the round is
-        # synchronous by design — see class docstring).
-        commit_h = host_sync(commit)
-        n_commit_h = host_sync(n_commit)
+        round_t0 = clock.monotonic()
+        with self._prof.phase('spec_verify'):
+            proposals, n_prop, starved = \
+                self._spec_build_proposals(ready)
+            if starved:
+                self._spec_starved(starved)
+                ready = [r if s not in self._prefill_off else None
+                         for s, r in enumerate(self._slots)]
+                if not any(r is not None for r in ready):
+                    return events
+            commit, n_commit = self._spec_verify_call(ready, proposals,
+                                                      n_prop)
+            # THE sanctioned readback of the speculative loop (the
+            # round is synchronous by design — see class docstring).
+            commit_h = host_sync(commit)
+            n_commit_h = host_sync(n_commit)
+        round_t1 = clock.monotonic()
         self._spec_rounds += 1
         self._spec_proposed += int(n_prop.sum())
         for slot, req in enumerate(ready):
@@ -306,6 +312,9 @@ class SpeculativeMixin:
             self._spec_slot_steps += 1
             self._spec_accepted += m - 1
             self._spec_committed += m
+            if req.trace is not None:
+                req.trace.add('spec_round', round_t0, round_t1,
+                              proposed=int(n_prop[slot]), committed=m)
             for j in range(m):
                 token = int(commit_h[slot, j])
                 req.output.append(token)

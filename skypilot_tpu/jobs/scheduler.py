@@ -31,6 +31,8 @@ def launch_slot(job_id: int, poll_seconds: float = 0.5):
     both take the last slot); the sleep happens OUTSIDE it
     (graftcheck GC102), jittered so a burst of waiting controllers
     doesn't re-contend the file lock in lockstep every tick."""
+    from skypilot_tpu import telemetry
+    t0 = time.monotonic()
     while True:
         with state.db_lock():
             if state.count_in_launch_phase() < max_parallel_launches():
@@ -38,6 +40,13 @@ def launch_slot(job_id: int, poll_seconds: float = 0.5):
                                          state.ScheduleState.LAUNCHING)
                 break
         time.sleep(poll_seconds * (0.5 + random.random()))
+    # Slot-wait pressure: how long controllers queue behind the
+    # parallel-launch cap (the autoscaling/capacity-planning signal).
+    telemetry.get_registry().histogram(
+        'skytpu_jobs_launch_slot_wait_seconds',
+        'Wait for a controller launch slot',
+        buckets=(.01, .1, .5, 1, 5, 15, 60, 300, 900)).observe(
+            time.monotonic() - t0)
     try:
         yield
     finally:

@@ -165,6 +165,14 @@ def _update(job_id: int, **cols: Any) -> None:
 
 def set_status(job_id: int, status: ManagedJobStatus,
                failure_reason: Optional[str] = None) -> None:
+    # One transition counter per target status in the shared process
+    # registry — the jobs controller's state machine becomes visible
+    # on the telemetry surface (dashboard /metrics) without parsing
+    # logs.
+    from skypilot_tpu import telemetry
+    telemetry.get_registry().counter(
+        'skytpu_jobs_transitions_total',
+        'Managed-job status transitions', to=status.value).inc()
     cols: Dict[str, Any] = {'status': status.value}
     if status == ManagedJobStatus.RUNNING:
         record = get_job(job_id)

@@ -20,6 +20,7 @@ from typing import List, Optional
 import urllib.error
 import urllib.request
 
+from skypilot_tpu import telemetry
 from skypilot_tpu import tpu_logging
 from skypilot_tpu.serve import load_balancing_policies as lb_policies
 
@@ -59,6 +60,21 @@ class SkyServeLoadBalancer:
         self._ts_lock = threading.Lock()
         self._stop = threading.Event()
         self._httpd: Optional[http.server.ThreadingHTTPServer] = None
+        # Telemetry (the shared process registry): proxy traffic,
+        # transparent retries, and controller-sync health.
+        reg = telemetry.get_registry()
+        self._m_requests = reg.counter(
+            'skytpu_lb_requests_total', 'Requests proxied by the LB')
+        self._m_retries = reg.counter(
+            'skytpu_lb_retries_total',
+            'Transparent retries after a replica failed before '
+            'answering')
+        self._m_sync_failures = reg.counter(
+            'skytpu_lb_sync_failures_total',
+            'Failed controller sync rounds')
+        self._h_proxy = reg.histogram(
+            'skytpu_lb_request_ms',
+            'LB-observed request latency, non-streaming (ms)')
 
     # ------------------------------------------------------------- sync
     def _sync_once(self) -> None:
@@ -84,6 +100,7 @@ class SkyServeLoadBalancer:
                 self._request_timestamps = (
                     [t for t in timestamps if t >= cutoff]
                     + self._request_timestamps)
+            self._m_sync_failures.inc()
             logger.warning(f'LB sync with controller failed: '
                            f'{type(e).__name__}: {e}')
 
@@ -133,6 +150,8 @@ class SkyServeLoadBalancer:
                 self.close_connection = True
 
             def _proxy(self, method: str) -> None:
+                t_start = time.monotonic()
+                lb._m_requests.inc()
                 with lb._ts_lock:
                     lb._request_timestamps.append(time.time())
                 length = int(self.headers.get('Content-Length', 0))
@@ -178,6 +197,8 @@ class SkyServeLoadBalancer:
                         self.send_header('Content-Length', str(len(body)))
                         self.end_headers()
                         self.wfile.write(body)
+                        lb._h_proxy.observe(
+                            (time.monotonic() - t_start) * 1e3)
                         return
                     except urllib.error.HTTPError as e:
                         # The replica ANSWERED; pass its error through —
@@ -211,6 +232,7 @@ class SkyServeLoadBalancer:
                                          'not retried (non-idempotent)'})
                             return
                         last_err = e
+                        lb._m_retries.inc()
                         logger.warning(
                             f'replica {url} failed before answering '
                             f'({type(e).__name__}: {e}); retrying on '

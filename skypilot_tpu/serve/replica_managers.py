@@ -29,6 +29,7 @@ from skypilot_tpu import core
 from skypilot_tpu import exceptions
 from skypilot_tpu import execution
 from skypilot_tpu import global_state
+from skypilot_tpu import telemetry
 from skypilot_tpu import tpu_logging
 from skypilot_tpu.serve import serve_state
 from skypilot_tpu.task import Task
@@ -47,6 +48,21 @@ _LAUNCH_BACKOFF_CAP = 300.0
 
 def _launch_backoff_base() -> float:
     return float(os.environ.get('SKYTPU_SERVE_LAUNCH_BACKOFF', '5'))
+
+
+def _probe_counter(outcome: str) -> 'telemetry.Counter':
+    """Probe-outcome counters in the shared process registry (the
+    controller's /metrics surface via the dashboard)."""
+    return telemetry.get_registry().counter(
+        'skytpu_replica_probe_total',
+        'Replica readiness-probe outcomes', outcome=outcome)
+
+
+def _transition_counter(to_status: str) -> 'telemetry.Counter':
+    return telemetry.get_registry().counter(
+        'skytpu_replica_transitions_total',
+        'Replica status transitions observed by the probe loop',
+        to=to_status)
 
 
 class ReplicaInfo:
@@ -350,15 +366,18 @@ class ReplicaManager:
             if self._check_preempted(info):
                 logger.info(f'Replica {info.replica_id} preempted.')
                 info.status = serve_state.ReplicaStatus.PREEMPTED
+                _transition_counter('PREEMPTED').inc()
                 self._persist(info)
                 self.scale_down(info.replica_id,
                                 serve_state.ReplicaStatus.PREEMPTED)
                 continue
             if self._probe_one(info):
+                _probe_counter('success').inc()
                 info.consecutive_failures = 0
                 if info.status != serve_state.ReplicaStatus.READY:
                     logger.info(f'Replica {info.replica_id} is READY at '
                                 f'{info.url}.')
+                    _transition_counter('READY').inc()
                     with self._lock:     # a replica serves: reset backoff
                         self._launch_failures = 0
                         self._backoff_until = 0.0
@@ -366,6 +385,7 @@ class ReplicaManager:
                 self._persist(info)
                 continue
             # Probe failed on a live cluster.
+            _probe_counter('failure').inc()
             if info.status == serve_state.ReplicaStatus.STARTING:
                 elapsed = time.time() - (info.first_probe_time or 0)
                 if elapsed > self.spec.initial_delay_seconds:
@@ -373,6 +393,7 @@ class ReplicaManager:
                         f'Replica {info.replica_id} failed to become ready '
                         f'within {self.spec.initial_delay_seconds}s.')
                     info.status = serve_state.ReplicaStatus.FAILED_PROBE
+                    _transition_counter('FAILED_PROBE').inc()
                     self._persist(info)
                     self.scale_down(info.replica_id,
                                     serve_state.ReplicaStatus.FAILED_PROBE)
@@ -391,11 +412,14 @@ class ReplicaManager:
                     f'{info.consecutive_failures} consecutive probes; '
                     'terminating it for replacement.')
                 info.status = serve_state.ReplicaStatus.FAILED_PROBE
+                _transition_counter('FAILED_PROBE').inc()
                 self._persist(info)
                 self.scale_down(info.replica_id,
                                 serve_state.ReplicaStatus.FAILED_PROBE)
                 self._bump_backoff()
             elif info.consecutive_failures >= _PROBE_FAILURE_GRACE:
+                if info.status != serve_state.ReplicaStatus.NOT_READY:
+                    _transition_counter('NOT_READY').inc()
                 info.status = serve_state.ReplicaStatus.NOT_READY
                 self._persist(info)
 

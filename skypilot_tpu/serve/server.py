@@ -10,7 +10,20 @@ Endpoints:
   ``{"tokens": [...], "ttft_ms": ...}``. ``stop`` entries are strings
   (tokenized with the model tokenizer) or token-id lists; generation
   ends when the output ends with any entry, which is trimmed.
-- ``GET /metrics`` — queue depth / active slots / counters.
+- ``GET /metrics`` — the process telemetry registry in Prometheus text
+  exposition format (TTFT/TPOT/queue-wait histograms, engine
+  step-phase timings, speculation gauges).
+  ``GET /metrics?format=json`` keeps the PR-3 stable-schema JSON gauge
+  block for existing scrapers (every key always present, zeros never
+  omitted).
+- ``GET /debug/requests`` — the bounded ring of completed request
+  timelines (queue → prefill chunks → decode → spec rounds), newest
+  first; ``?limit=N`` caps the count.
+
+Every number comes from the single telemetry registry
+(``skypilot_tpu.telemetry``) — the server keeps no private metrics
+dicts; the rolling TTFT/TPOT/queue-wait median/p90 ride the registry
+histograms' bounded windows (ONE windowed-quantile implementation).
 
 One background thread drives ``engine.step()`` continuously (the engine
 core is synchronous); HTTP handler threads enqueue requests and wait on
@@ -24,9 +37,12 @@ import http.server
 import json
 import os
 import threading
+import urllib.parse
 from typing import Any, Dict, Optional
 
+from skypilot_tpu import telemetry
 from skypilot_tpu import tpu_logging
+from skypilot_tpu.telemetry import tracing
 
 logger = tpu_logging.init_logger(__name__)
 
@@ -71,14 +87,31 @@ class ModelServer:
         # Streaming requests: per-request token queues fed by the engine
         # loop; (token, finished) tuples, (None, True) on engine death.
         self._stream_queues: Dict[int, 'queue.Queue'] = {}
-        self._requests_served = 0
-        self._requests_aborted = 0
-        # Rolling TTFT window for /metrics (median/p90): the serve
-        # autoscaler and operators watch these to see the chunked
-        # scheduler holding its latency SLO. Bounded so a long-lived
-        # replica's metrics reflect CURRENT traffic, not its lifetime.
-        import collections
-        self._ttfts: 'collections.deque' = collections.deque(maxlen=512)
+        # Telemetry: every counter/gauge/histogram lives in the process
+        # registry (rendered at /metrics in Prometheus format and as
+        # the stable-schema JSON at /metrics?format=json). The request
+        # latency histograms keep a bounded window for exact rolling
+        # median/p90 — the one windowed-quantile implementation shared
+        # by TTFT, TPOT, and queue-wait (the serve autoscaler and
+        # operators watch these to see the scheduler holding its
+        # latency SLO; bounded so a long-lived replica's quantiles
+        # reflect CURRENT traffic, not its lifetime).
+        reg = telemetry.get_registry()
+        self._reg = reg
+        self._m_served = reg.counter(
+            'skytpu_requests_served_total',
+            'Requests completed and returned to a client')
+        self._m_aborted = reg.counter(
+            'skytpu_requests_aborted_total',
+            'Requests cancelled mid-stream (client disconnect)')
+        self._h_ttft = reg.histogram(
+            'skytpu_request_ttft_ms', 'Time to first token (ms)')
+        self._h_tpot = reg.histogram(
+            'skytpu_request_tpot_ms',
+            'Mean time per output token after the first (ms)')
+        self._h_queue_wait = reg.histogram(
+            'skytpu_request_queue_wait_ms',
+            'Time from submit to slot assignment (ms)')
         self._httpd: Optional[http.server.ThreadingHTTPServer] = None
         self._stopping = False
         self._engine_thread: Optional[threading.Thread] = None
@@ -223,9 +256,7 @@ class ModelServer:
         with self._lock:
             req = self.engine.pop_finished(rid)
             del self._finished_events[rid]
-            self._requests_served += 1
-            if req.ttft_ms is not None:
-                self._ttfts.append(req.ttft_ms)
+        self._record_finished(req)
         hit_eos = (req.eos_id is not None and req.output
                    and req.output[-1] == req.eos_id)
         return {
@@ -266,12 +297,111 @@ class ModelServer:
         with self._lock:
             self._stream_queues.pop(rid, None)
             req = self.engine.pop_finished(rid)
-            if req is not None:
-                self._requests_served += 1
-                if req.ttft_ms is not None:
-                    self._ttfts.append(req.ttft_ms)
-            elif self.engine.cancel(rid):
-                self._requests_aborted += 1
+            cancelled = req is None and self.engine.cancel(rid)
+        if req is not None:
+            self._record_finished(req)
+        elif cancelled:
+            self._m_aborted.inc()
+
+    def _record_finished(self, req) -> None:
+        """Fold one finished request into the registry: served counter
+        plus the TTFT / TPOT / queue-wait latency decomposition (the
+        queue-wait span comes off the request's telemetry trace)."""
+        self._m_served.inc()
+        if req.ttft_ms is not None:
+            self._h_ttft.observe(req.ttft_ms)
+        if (req.first_token_time is not None
+                and req.finish_time is not None
+                and len(req.output) > 1):
+            self._h_tpot.observe(
+                (req.finish_time - req.first_token_time) * 1e3
+                / (len(req.output) - 1))
+        trace = tracing.get_trace_buffer().find(req.request_id)
+        if trace is not None:
+            queue_ms = trace.span_ms('queue')
+            if queue_ms is not None:
+                self._h_queue_wait.observe(queue_ms)
+
+    # ----------------------------------------------------------- metrics
+    def _update_gauges(self) -> None:
+        """Refresh the scrape-time registry gauges from engine state.
+        Gauges are registered here get-or-create, so the Prometheus
+        schema is stable from the first scrape (zeros before the
+        engine loads or a feature turns on)."""
+        eng = self.engine
+        spec = (eng.spec_metrics() if eng is not None
+                and hasattr(eng, 'spec_metrics') else {})
+        g = self._reg.gauge
+        g('skytpu_active_slots',
+          'Occupied decode slots').set(eng.num_active if eng else 0)
+        g('skytpu_queue_depth',
+          'Requests waiting for a slot').set(
+              eng.queue_depth if eng else 0)
+        g('skytpu_prefill_inflight',
+          'Slots still streaming prompt chunks in').set(
+              len(getattr(eng, '_prefill_off', ())) if eng else 0)
+        g('skytpu_max_batch', 'Configured decode batch').set(
+            self.max_batch)
+        g('skytpu_speculate_k',
+          'Speculative proposal depth (0 = off)').set(
+              spec.get('speculate_k', 0))
+        g('skytpu_spec_accept_rate',
+          'Accepted / proposed draft tokens').set(
+              spec.get('spec_accept_rate', 0.0))
+        g('skytpu_spec_tokens_per_step',
+          'Mean tokens committed per slot per verify call').set(
+              spec.get('spec_tokens_per_step', 0.0))
+        g('skytpu_spec_proposed_total',
+          'Draft tokens proposed').set(spec.get('spec_proposed', 0))
+        g('skytpu_spec_accepted_total',
+          'Draft tokens accepted').set(spec.get('spec_accepted', 0))
+        g('skytpu_spec_rounds_total',
+          'Speculative verify rounds').set(spec.get('spec_rounds', 0))
+
+    def _metrics_json_payload(self) -> Dict[str, Any]:
+        """The PR-3 stable-schema JSON gauge block, now sourced from
+        the telemetry registry (every key ALWAYS present and numeric;
+        0 when idle / a feature is off — scrapers see one stable
+        schema, never a key that appears only once traffic or
+        speculation starts)."""
+        eng = self.engine
+        spec = (eng.spec_metrics() if eng is not None
+                and hasattr(eng, 'spec_metrics') else {})
+        return {
+            'requests_served': int(self._m_served.value),
+            'requests_aborted': int(self._m_aborted.value),
+            'active_slots': eng.num_active if eng else 0,
+            'queue_depth': eng.queue_depth if eng else 0,
+            # Slots still streaming prompt chunks in — decodable
+            # occupancy = active - this.
+            'prefill_inflight': (len(getattr(
+                eng, '_prefill_off', ())) if eng else 0),
+            'max_batch': self.max_batch,
+            'ttft_ms_median': round(self._h_ttft.quantile(0.5), 1),
+            'ttft_ms_p90': round(self._h_ttft.quantile(0.9), 1),
+            'ttft_window': self._h_ttft.window_len,
+            'tpot_ms_median': round(self._h_tpot.quantile(0.5), 2),
+            'tpot_ms_p90': round(self._h_tpot.quantile(0.9), 2),
+            'queue_wait_ms_median': round(
+                self._h_queue_wait.quantile(0.5), 1),
+            'queue_wait_ms_p90': round(
+                self._h_queue_wait.quantile(0.9), 1),
+            # Speculative decoding gauges (zeros when off).
+            'speculate_k': spec.get('speculate_k', 0),
+            'spec_accept_rate': round(
+                spec.get('spec_accept_rate', 0.0), 4),
+            'spec_tokens_per_step': round(
+                spec.get('spec_tokens_per_step', 0.0), 3),
+            'spec_proposed': spec.get('spec_proposed', 0),
+            'spec_accepted': spec.get('spec_accepted', 0),
+            'spec_rounds': spec.get('spec_rounds', 0),
+            'scheduler': {
+                'prefill_chunk_tokens': getattr(eng, 'chunk', 0) or 0,
+                'decode_priority_ratio': getattr(
+                    eng, 'decode_priority_ratio', 0) or 0,
+                'speculate_k': spec.get('speculate_k', 0),
+            },
+        }
 
     # --------------------------------------------------------------- HTTP
     def _make_handler(server):  # noqa: N805
@@ -295,7 +425,9 @@ class ModelServer:
                 self.wfile.write(body)
 
             def do_GET(self):  # noqa: N802
-                if self.path == '/readiness':
+                parsed = urllib.parse.urlparse(self.path)
+                query = urllib.parse.parse_qs(parsed.query)
+                if parsed.path == '/readiness':
                     if server._error is not None:
                         self._json(503, {'status': 'failed',
                                          'error': server._error})
@@ -304,52 +436,27 @@ class ModelServer:
                                          'model': server.cfg_name})
                     else:
                         self._json(503, {'status': 'loading'})
-                elif self.path == '/metrics':
-                    eng = server.engine
-                    ttfts = sorted(server._ttfts)
-                    # Gauge block contract: every key is ALWAYS present
-                    # and numeric (0 when idle / a feature is off) —
-                    # scrapers see one stable schema, never a key that
-                    # appears only once traffic or speculation starts.
-                    spec = (eng.spec_metrics()
-                            if eng is not None
-                            and hasattr(eng, 'spec_metrics') else {})
-                    payload = {
-                        'requests_served': server._requests_served,
-                        'requests_aborted': server._requests_aborted,
-                        'active_slots': eng.num_active if eng else 0,
-                        'queue_depth': eng.queue_depth if eng else 0,
-                        # Slots still streaming prompt chunks in —
-                        # decodable occupancy = active - this.
-                        'prefill_inflight': (len(getattr(
-                            eng, '_prefill_off', ())) if eng else 0),
-                        'max_batch': server.max_batch,
-                        'ttft_ms_median': (round(
-                            ttfts[len(ttfts) // 2], 1)
-                            if ttfts else 0.0),
-                        'ttft_ms_p90': (round(
-                            ttfts[int(len(ttfts) * 0.9)], 1)
-                            if ttfts else 0.0),
-                        'ttft_window': len(ttfts),
-                        # Speculative decoding gauges (zeros when off).
-                        'speculate_k': spec.get('speculate_k', 0),
-                        'spec_accept_rate': round(
-                            spec.get('spec_accept_rate', 0.0), 4),
-                        'spec_tokens_per_step': round(
-                            spec.get('spec_tokens_per_step', 0.0), 3),
-                        'spec_proposed': spec.get('spec_proposed', 0),
-                        'spec_accepted': spec.get('spec_accepted', 0),
-                        'spec_rounds': spec.get('spec_rounds', 0),
-                        'scheduler': {
-                            'prefill_chunk_tokens': getattr(
-                                eng, 'chunk', 0) or 0,
-                            'decode_priority_ratio': getattr(
-                                eng, 'decode_priority_ratio', 0) or 0,
-                            'speculate_k': spec.get('speculate_k', 0),
-                        },
-                    }
-                    self._json(200, payload)
-                elif self.path == '/v1/models':
+                elif parsed.path == '/metrics':
+                    server._update_gauges()
+                    if query.get('format', [''])[0] == 'json':
+                        self._json(200, server._metrics_json_payload())
+                        return
+                    body = server._reg.render_prometheus().encode()
+                    self.send_response(200)
+                    self.send_header(
+                        'Content-Type',
+                        'text/plain; version=0.0.4; charset=utf-8')
+                    self.send_header('Content-Length', str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+                elif parsed.path == '/debug/requests':
+                    try:
+                        limit = int(query.get('limit', ['64'])[0])
+                    except ValueError:
+                        limit = 64
+                    self._json(200, {'requests': tracing.
+                                     get_trace_buffer().to_json(limit)})
+                elif parsed.path == '/v1/models':
                     self._json(200, {
                         'object': 'list',
                         'data': [{'id': server.cfg_name,

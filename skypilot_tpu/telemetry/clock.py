@@ -1,0 +1,27 @@
+"""Sanctioned time sources for the inference hot paths.
+
+graftcheck rule GC109 bans ad-hoc ``time.time()`` / ``perf_counter()``
+calls inside ``inference/`` — every wall-clock stamp and every duration
+measurement there routes through these two functions instead. Why a
+module and not a convention: the lint can then PROVE no stray timing
+call sits on the hot path (a mis-placed ``perf_counter()`` pair around
+a jitted dispatch is how accidental host syncs and misleading
+"device time" numbers historically crept in), and a future
+trace-overhead kill switch has exactly one seam to hook.
+
+``now()`` is wall time (request timestamps, cross-process alignment);
+``monotonic()`` is for durations (immune to NTP steps).
+"""
+from __future__ import annotations
+
+import time as _time
+
+
+def now() -> float:
+    """Wall-clock seconds since the epoch (request timestamps)."""
+    return _time.time()
+
+
+def monotonic() -> float:
+    """Monotonic seconds (span/phase durations)."""
+    return _time.monotonic()

@@ -1,0 +1,143 @@
+"""Engine step-phase profiler.
+
+Answers "which step phase regressed": per-phase wall time of the
+engine scheduling loop (admit, prefill-chunk, decode-enqueue,
+spec-verify, sanctioned readback) and first-call-per-jit-key events
+(the call that pays XLA compilation).
+
+Measurement discipline — the reason this is safe on the hot path and
+the jaxpr audit's ``telemetry`` preset stays green:
+
+- Monotonic clocks only (``telemetry.clock``), taken strictly on the
+  HOST side AROUND jitted dispatches — never inside a jit body (that
+  would trace a constant) and never forcing a device sync (a phase
+  ends when the dispatch returns, not when the device finishes; device
+  completion is visible in the ``readback`` phase, which wraps the
+  engines' one sanctioned ``host_sync``).
+- First-compile events ride the engines' existing jit-key bookkeeping:
+  a key never seen before has its first dispatch timed (jit compiles
+  synchronously at first call, so the wall time ≈ trace+compile);
+  seen keys pay one set lookup.
+
+Per-phase times accumulate BOTH locally (``phase_stats()`` — bench's
+per-engine latency decomposition) and into the process registry
+(``skypilot_tpu_engine_step_phase_seconds{phase=...}`` — the
+``/metrics`` surface). :class:`NullProfiler` is the telemetry-off
+no-op twin with the same API.
+"""
+from __future__ import annotations
+
+import contextlib
+import threading
+from typing import Any, Dict, List, Optional, Tuple
+
+from skypilot_tpu.telemetry import clock
+from skypilot_tpu.telemetry import registry as registry_lib
+
+PHASE_METRIC = 'skytpu_engine_step_phase_seconds'
+COMPILE_METRIC = 'skytpu_jit_first_call_seconds'
+
+
+class NullProfiler:
+    """Telemetry-off profiler: same API, zero work."""
+
+    compile_events: List[Dict[str, Any]] = []
+
+    @contextlib.contextmanager
+    def phase(self, name: str):
+        del name
+        yield
+
+    @contextlib.contextmanager
+    def jit_key(self, fn: str, key: Tuple):
+        del fn, key
+        yield
+
+    def phase_stats(self) -> Dict[str, Any]:
+        return {}
+
+
+class StepProfiler:
+    """Per-engine step-phase + first-compile recorder. The phase/jit
+    context managers are called from the single engine thread;
+    ``phase_stats()`` may be read from other threads (bench, handlers)
+    — the small accumulator dict is guarded."""
+
+    def __init__(self, engine: str = '',
+                 registry: Optional[registry_lib.MetricsRegistry] = None):
+        self.engine = engine
+        self._reg = registry or registry_lib.get_registry()
+        self._lock = threading.Lock()
+        # phase -> [count, total_s, max_s]
+        self._acc: Dict[str, List[float]] = {}
+        self._hists: Dict[str, registry_lib.Histogram] = {}
+        self._seen_keys: Dict[str, set] = {}
+        self.compile_events: List[Dict[str, Any]] = []
+
+    def _phase_hist(self, name: str) -> registry_lib.Histogram:
+        hist = self._hists.get(name)
+        if hist is None:
+            hist = self._reg.histogram(
+                PHASE_METRIC,
+                'Engine scheduling-loop phase wall time (host-side, '
+                'around async dispatches)',
+                buckets=registry_lib.DEFAULT_SECONDS_BUCKETS,
+                phase=name)
+            self._hists[name] = hist
+        return hist
+
+    @contextlib.contextmanager
+    def phase(self, name: str):
+        t0 = clock.monotonic()
+        try:
+            yield
+        finally:
+            dt = clock.monotonic() - t0
+            self._phase_hist(name).observe(dt)
+            with self._lock:
+                acc = self._acc.setdefault(name, [0, 0.0, 0.0])
+                acc[0] += 1
+                acc[1] += dt
+                acc[2] = max(acc[2], dt)
+
+    @contextlib.contextmanager
+    def jit_key(self, fn: str, key: Tuple):
+        """Time the FIRST dispatch of each (fn, static key) — the call
+        that pays compilation. Subsequent calls: one set lookup."""
+        seen = self._seen_keys.setdefault(fn, set())
+        if key in seen:
+            yield
+            return
+        t0 = clock.monotonic()
+        try:
+            yield
+        finally:
+            dt = clock.monotonic() - t0
+            seen.add(key)
+            self._reg.histogram(
+                COMPILE_METRIC,
+                'Wall time of the first dispatch per jit static key '
+                '(trace + XLA compile)',
+                buckets=registry_lib.DEFAULT_SECONDS_BUCKETS,
+                fn=fn).observe(dt)
+            with self._lock:
+                self.compile_events.append(
+                    {'fn': fn, 'key': repr(key),
+                     'seconds': round(dt, 6)})
+
+    def phase_stats(self) -> Dict[str, Any]:
+        """Per-phase summary for THIS engine (bench's latency
+        decomposition): phase -> count/total_s/mean_ms/max_ms, plus
+        the first-compile event list."""
+        with self._lock:
+            acc = {k: list(v) for k, v in self._acc.items()}
+            compiles = list(self.compile_events)
+        out: Dict[str, Any] = {'phases': {}, 'compiles': compiles}
+        for name, (count, total, mx) in sorted(acc.items()):
+            out['phases'][name] = {
+                'count': int(count),
+                'total_s': round(total, 6),
+                'mean_ms': round(total / count * 1e3, 3) if count else 0.0,
+                'max_ms': round(mx * 1e3, 3),
+            }
+        return out

@@ -1,0 +1,324 @@
+"""Process-wide, thread-safe metrics registry.
+
+One registry per process (``get_registry()``); every subsystem —
+model server, engines, dashboard, load balancer, replica manager,
+jobs layer — registers its series here and the scrape endpoints render
+the whole registry, so no component assembles a private metrics dict
+(the pre-telemetry ``/metrics`` duplication between ``serve/server.py``
+and ``dashboard.py``).
+
+Three metric types:
+
+- :class:`Counter` — monotonically increasing (requests served,
+  probe failures).
+- :class:`Gauge` — set-to-current-value (queue depth, active slots).
+- :class:`Histogram` — fixed cumulative buckets (Prometheus
+  exposition) PLUS a bounded window of raw observations for exact
+  rolling quantiles. The window is THE windowed-quantile
+  implementation: TTFT, TPOT and queue-wait median/p90 all read from
+  it (one implementation, not three ad-hoc deques), and it is bounded
+  so a long-lived replica's quantiles reflect current traffic.
+
+Series identity is ``(name, sorted(labels))``; re-registering an
+existing series returns the same object (handles are cheap to look up
+in hot-ish paths). Rendering:
+
+- :meth:`MetricsRegistry.render_prometheus` — text exposition format
+  0.0.4 (``# HELP`` / ``# TYPE`` once per family, ``_bucket``/``_sum``/
+  ``_count`` for histograms, cumulative ``le`` buckets ending in
+  ``+Inf``). Every registered series renders, zeros included — the
+  stable-schema guarantee scrapers rely on.
+- :meth:`MetricsRegistry.render_json` — the same data as nested JSON
+  (the dashboard and ``/metrics?format=json`` compat surface).
+"""
+from __future__ import annotations
+
+import collections
+import math
+import threading
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+# Default buckets: millisecond-scale latencies (TTFT/TPOT/queue-wait).
+DEFAULT_MS_BUCKETS: Tuple[float, ...] = (
+    1, 2.5, 5, 10, 25, 50, 100, 250, 500, 1000, 2500, 5000, 10000,
+    30000, 60000)
+# Second-scale durations (engine step phases, jit first calls).
+DEFAULT_SECONDS_BUCKETS: Tuple[float, ...] = (
+    .0005, .001, .0025, .005, .01, .025, .05, .1, .25, .5, 1, 2.5, 5,
+    10, 30, 60)
+DEFAULT_WINDOW = 512
+
+
+def _fmt(v: float) -> str:
+    """Prometheus sample value: integral floats print as ints."""
+    if v == math.inf:
+        return '+Inf'
+    if isinstance(v, float) and v.is_integer():
+        return str(int(v))
+    return repr(float(v)) if isinstance(v, float) else str(v)
+
+
+def _label_str(labels: Dict[str, str]) -> str:
+    if not labels:
+        return ''
+    inner = ','.join(f'{k}="{v}"' for k, v in sorted(labels.items()))
+    return '{' + inner + '}'
+
+
+class _Metric:
+    kind = 'untyped'
+
+    def __init__(self, name: str, help_text: str,
+                 labels: Dict[str, str]):
+        self.name = name
+        self.help = help_text
+        self.labels = dict(labels)
+        self._lock = threading.Lock()
+
+
+class Counter(_Metric):
+    kind = 'counter'
+
+    def __init__(self, name, help_text, labels):
+        super().__init__(name, help_text, labels)
+        self._value = 0.0
+
+    def inc(self, n: float = 1.0) -> None:
+        if n < 0:
+            raise ValueError(f'counter {self.name} cannot decrease')
+        with self._lock:
+            self._value += n
+
+    @property
+    def value(self) -> float:
+        with self._lock:
+            return self._value
+
+
+class Gauge(_Metric):
+    kind = 'gauge'
+
+    def __init__(self, name, help_text, labels):
+        super().__init__(name, help_text, labels)
+        self._value = 0.0
+
+    def set(self, v: float) -> None:
+        with self._lock:
+            self._value = float(v)
+
+    def inc(self, n: float = 1.0) -> None:
+        with self._lock:
+            self._value += n
+
+    @property
+    def value(self) -> float:
+        with self._lock:
+            return self._value
+
+
+class Histogram(_Metric):
+    """Fixed cumulative buckets + bounded raw-observation window.
+
+    The buckets serve Prometheus (aggregatable across replicas); the
+    window serves exact in-process rolling quantiles
+    (:meth:`quantile`) — the one windowed-quantile implementation the
+    serve layer uses for TTFT, TPOT, and queue-wait."""
+    kind = 'histogram'
+
+    def __init__(self, name, help_text, labels,
+                 buckets: Sequence[float] = DEFAULT_MS_BUCKETS,
+                 window: int = DEFAULT_WINDOW):
+        super().__init__(name, help_text, labels)
+        uppers = sorted(float(b) for b in buckets)
+        if not uppers:
+            raise ValueError('histogram needs at least one bucket')
+        self.buckets: Tuple[float, ...] = tuple(uppers)
+        self._counts = [0] * (len(uppers) + 1)   # +1 = +Inf
+        self._sum = 0.0
+        self._count = 0
+        self._window: 'collections.deque[float]' = collections.deque(
+            maxlen=max(1, int(window)))
+
+    def observe(self, v: float) -> None:
+        v = float(v)
+        with self._lock:
+            self._sum += v
+            self._count += 1
+            self._window.append(v)
+            for i, upper in enumerate(self.buckets):
+                if v <= upper:
+                    self._counts[i] += 1
+                    return
+            self._counts[-1] += 1
+
+    def snapshot(self) -> Dict[str, Any]:
+        """(cumulative bucket counts, sum, count, window copy) under one
+        lock acquisition — rendering must not tear mid-observe."""
+        with self._lock:
+            cum, acc = [], 0
+            for c in self._counts:
+                acc += c
+                cum.append(acc)
+            return {'cumulative': cum, 'sum': self._sum,
+                    'count': self._count,
+                    'window': list(self._window)}
+
+    def quantile(self, q: float) -> float:
+        """Exact quantile over the bounded rolling window (0 when
+        empty) — zeros-not-omitted, like every other gauge."""
+        with self._lock:
+            window = sorted(self._window)
+        if not window:
+            return 0.0
+        idx = min(len(window) - 1, int(q * len(window)))
+        return window[idx]
+
+    @property
+    def window_len(self) -> int:
+        with self._lock:
+            return len(self._window)
+
+    @property
+    def count(self) -> int:
+        with self._lock:
+            return self._count
+
+    @property
+    def sum(self) -> float:
+        with self._lock:
+            return self._sum
+
+
+class MetricsRegistry:
+    """Thread-safe collection of metric series, keyed by
+    ``(name, labels)``. ``counter``/``gauge``/``histogram`` are
+    get-or-create: safe to call from multiple subsystems for the same
+    series (they share the object)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._series: Dict[Tuple[str, Tuple[Tuple[str, str], ...]],
+                           _Metric] = {}
+        self._families: Dict[str, Tuple[str, str]] = {}  # name->(kind,help)
+
+    # ------------------------------------------------------------ create
+    def _get_or_create(self, cls, name: str, help_text: str,
+                       labels: Dict[str, str], **kwargs) -> _Metric:
+        key = (name, tuple(sorted(labels.items())))
+        with self._lock:
+            metric = self._series.get(key)
+            if metric is not None:
+                if not isinstance(metric, cls):
+                    raise TypeError(
+                        f'{name} already registered as {metric.kind}')
+                return metric
+            fam = self._families.get(name)
+            if fam is not None and fam[0] != cls.kind:
+                raise TypeError(
+                    f'{name} already registered as a {fam[0]} family')
+            metric = cls(name, help_text, labels, **kwargs)
+            self._series[key] = metric
+            if fam is None or (not fam[1] and help_text):
+                self._families[name] = (cls.kind, help_text)
+            return metric
+
+    def counter(self, name: str, help_text: str = '',
+                **labels: str) -> Counter:
+        return self._get_or_create(Counter, name, help_text, labels)
+
+    def gauge(self, name: str, help_text: str = '',
+              **labels: str) -> Gauge:
+        return self._get_or_create(Gauge, name, help_text, labels)
+
+    def histogram(self, name: str, help_text: str = '',
+                  buckets: Sequence[float] = DEFAULT_MS_BUCKETS,
+                  window: int = DEFAULT_WINDOW,
+                  **labels: str) -> Histogram:
+        return self._get_or_create(Histogram, name, help_text, labels,
+                                   buckets=buckets, window=window)
+
+    # ------------------------------------------------------------ access
+    def families(self) -> Dict[str, List[_Metric]]:
+        """name -> series, names sorted, series sorted by labels."""
+        with self._lock:
+            series = list(self._series.items())
+        out: Dict[str, List[_Metric]] = {}
+        for (name, _), metric in sorted(series, key=lambda kv: kv[0]):
+            out.setdefault(name, []).append(metric)
+        return out
+
+    def get(self, name: str, **labels: str) -> Optional[_Metric]:
+        key = (name, tuple(sorted(labels.items())))
+        with self._lock:
+            return self._series.get(key)
+
+    # ------------------------------------------------------------ render
+    def render_prometheus(self) -> str:
+        """Text exposition format 0.0.4. Every registered series is
+        emitted, zeros included — the stable-schema contract."""
+        lines: List[str] = []
+        for name, series in self.families().items():
+            kind, help_text = self._families.get(name, ('untyped', ''))
+            if help_text:
+                lines.append(f'# HELP {name} {help_text}')
+            lines.append(f'# TYPE {name} {kind}')
+            for m in series:
+                if isinstance(m, Histogram):
+                    snap = m.snapshot()
+                    for upper, cum in zip(
+                            list(m.buckets) + [math.inf],
+                            snap['cumulative']):
+                        labels = dict(m.labels)
+                        labels['le'] = _fmt(upper)
+                        lines.append(f'{name}_bucket'
+                                     f'{_label_str(labels)} {cum}')
+                    ls = _label_str(m.labels)
+                    lines.append(f'{name}_sum{ls} '
+                                 f'{_fmt(snap["sum"])}')
+                    lines.append(f'{name}_count{ls} {snap["count"]}')
+                else:
+                    lines.append(f'{name}{_label_str(m.labels)} '
+                                 f'{_fmt(m.value)}')
+        return '\n'.join(lines) + '\n'
+
+    def render_json(self) -> Dict[str, Any]:
+        out: Dict[str, Any] = {}
+        for name, series in self.families().items():
+            kind, help_text = self._families.get(name, ('untyped', ''))
+            entries = []
+            for m in series:
+                entry: Dict[str, Any] = {'labels': dict(m.labels)}
+                if isinstance(m, Histogram):
+                    snap = m.snapshot()
+                    entry.update(
+                        count=snap['count'], sum=snap['sum'],
+                        p50=m.quantile(0.5), p90=m.quantile(0.9),
+                        p99=m.quantile(0.99),
+                        window=len(snap['window']))
+                else:
+                    entry['value'] = m.value
+                entries.append(entry)
+            out[name] = {'type': kind, 'help': help_text,
+                         'series': entries}
+        return out
+
+
+_global_lock = threading.Lock()
+_global_registry: Optional[MetricsRegistry] = None
+
+
+def get_registry() -> MetricsRegistry:
+    """THE process-wide registry (created on first use)."""
+    global _global_registry
+    with _global_lock:
+        if _global_registry is None:
+            _global_registry = MetricsRegistry()
+        return _global_registry
+
+
+def reset_registry() -> MetricsRegistry:
+    """Swap in a fresh process registry (tests)."""
+    global _global_registry
+    with _global_lock:
+        _global_registry = MetricsRegistry()
+        return _global_registry

@@ -1,0 +1,212 @@
+"""Per-request lifecycle tracing.
+
+A :class:`RequestTrace` is minted when a request enters an engine
+(``add_request``) and carried on the ``Request`` object through its
+whole life: queue-wait → prefill (one span per chunk in chunked mode) →
+decode → speculative propose/verify rounds → finish or cancel. Spans
+are HOST-DISPATCH-ALIGNED: a span covers the host-side time of the
+stage (the device executes asynchronously behind the dispatch
+pipeline), which is exactly the latency a client observes and what the
+"where did this request's latency go" question needs.
+
+Completed traces land in a bounded ring buffer (:class:`TraceBuffer`,
+default 256 — a long-lived replica keeps CURRENT traffic, memory
+bounded) served by the model server at ``/debug/requests`` and
+exportable as a chrome trace through the existing
+``utils/timeline.py`` writer (:func:`export_chrome_trace`).
+
+Engines only ever touch traces from their single engine thread, so
+span mutation is unlocked; the buffer (crossed by HTTP handler
+threads) is locked.
+"""
+from __future__ import annotations
+
+import collections
+import itertools
+import os
+import threading
+from typing import Any, Dict, List, Optional
+
+from skypilot_tpu.telemetry import clock
+
+DEFAULT_BUFFER = int(os.environ.get('SKYTPU_TRACE_BUFFER', '256'))
+
+_trace_seq = itertools.count(1)
+
+
+class Span:
+    __slots__ = ('name', 't0', 't1', 'wall0', 'meta')
+
+    def __init__(self, name: str, t0: float, wall0: float,
+                 meta: Optional[Dict[str, Any]] = None):
+        self.name = name
+        self.t0 = t0                # monotonic
+        self.t1: Optional[float] = None
+        self.wall0 = wall0          # wall clock (chrome-trace ts)
+        self.meta = meta or {}
+
+    @property
+    def dur_ms(self) -> Optional[float]:
+        if self.t1 is None:
+            return None
+        return (self.t1 - self.t0) * 1e3
+
+
+class RequestTrace:
+    """One request's span timeline. Engine-thread-only mutation."""
+
+    def __init__(self, request_id: int):
+        self.request_id = request_id
+        self.trace_id = f'{os.getpid():x}-{next(_trace_seq):x}'
+        self.t0 = clock.monotonic()
+        self.wall0 = clock.now()
+        self.spans: List[Span] = []
+        self.done = False
+        self.meta: Dict[str, Any] = {}
+
+    # ------------------------------------------------------------- spans
+    def begin(self, name: str, **meta: Any) -> Span:
+        span = Span(name, clock.monotonic(), clock.now(), meta or None)
+        self.spans.append(span)
+        return span
+
+    def end(self, name: str) -> None:
+        """Close the most recent still-open span named ``name``
+        (no-op when none is open — re-admission paths may re-begin)."""
+        for span in reversed(self.spans):
+            if span.name == name and span.t1 is None:
+                span.t1 = clock.monotonic()
+                return
+
+    def add(self, name: str, t0: float, t1: float, **meta: Any) -> Span:
+        """Record a pre-timed span (monotonic endpoints)."""
+        span = Span(name, t0, clock.now() - (clock.monotonic() - t0),
+                    meta or None)
+        span.t1 = t1
+        self.spans.append(span)
+        return span
+
+    def instant(self, name: str, **meta: Any) -> None:
+        t = clock.monotonic()
+        span = Span(name, t, clock.now(), meta or None)
+        span.t1 = t
+        self.spans.append(span)
+
+    def finish(self, **meta: Any) -> None:
+        """Close every open span and mark the trace complete."""
+        t1 = clock.monotonic()
+        for span in self.spans:
+            if span.t1 is None:
+                span.t1 = t1
+        self.meta.update(meta)
+        self.done = True
+
+    # ----------------------------------------------------------- queries
+    def span_ms(self, name: str) -> Optional[float]:
+        """Duration of the FIRST completed span named ``name``."""
+        for span in self.spans:
+            if span.name == name and span.t1 is not None:
+                return span.dur_ms
+        return None
+
+    def to_dict(self) -> Dict[str, Any]:
+        spans = []
+        for span in self.spans:
+            d: Dict[str, Any] = {
+                'name': span.name,
+                'start_ms': round((span.t0 - self.t0) * 1e3, 3),
+            }
+            if span.t1 is not None:
+                d['dur_ms'] = round((span.t1 - span.t0) * 1e3, 3)
+            if span.meta:
+                d['meta'] = dict(span.meta)
+            spans.append(d)
+        return {'trace_id': self.trace_id,
+                'request_id': self.request_id,
+                'submitted_at': self.wall0,
+                'done': self.done,
+                'meta': dict(self.meta),
+                'spans': spans}
+
+
+class TraceBuffer:
+    """Bounded ring of COMPLETED traces (oldest evicted first)."""
+
+    def __init__(self, maxlen: int = DEFAULT_BUFFER):
+        self._lock = threading.Lock()
+        self._traces: 'collections.deque[RequestTrace]' = \
+            collections.deque(maxlen=max(1, maxlen))
+
+    def add(self, trace: RequestTrace) -> None:
+        with self._lock:
+            self._traces.append(trace)
+
+    def snapshot(self) -> List[RequestTrace]:
+        with self._lock:
+            return list(self._traces)
+
+    def to_json(self, limit: Optional[int] = None) -> List[Dict[str, Any]]:
+        """Newest-first trace dicts (the ``/debug/requests`` body)."""
+        traces = self.snapshot()[::-1]
+        if limit is not None:
+            traces = traces[:max(0, int(limit))]
+        return [t.to_dict() for t in traces]
+
+    def find(self, request_id: int) -> Optional[RequestTrace]:
+        for t in reversed(self.snapshot()):
+            if t.request_id == request_id:
+                return t
+        return None
+
+    def clear(self) -> None:
+        with self._lock:
+            self._traces.clear()
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._traces)
+
+
+_buffer_lock = threading.Lock()
+_buffer: Optional[TraceBuffer] = None
+
+
+def get_trace_buffer() -> TraceBuffer:
+    """THE process-wide completed-request trace buffer."""
+    global _buffer
+    with _buffer_lock:
+        if _buffer is None:
+            _buffer = TraceBuffer()
+        return _buffer
+
+
+def export_chrome_trace(path: str,
+                        traces: Optional[List[RequestTrace]] = None
+                        ) -> Optional[str]:
+    """Write traces as a ``chrome://tracing`` file via the existing
+    ``utils/timeline.py`` writer. One chrome thread (tid) per request;
+    span args carry the meta. Returns the path (None when empty)."""
+    from skypilot_tpu.utils import timeline
+    if traces is None:
+        traces = get_trace_buffer().snapshot()
+    events: List[Dict[str, Any]] = []
+    for trace in traces:
+        base_wall_us = trace.wall0 * 1e6
+        for span in trace.spans:
+            if span.t1 is None:
+                continue
+            ev: Dict[str, Any] = {
+                'name': span.name,
+                'ph': 'X',
+                'ts': base_wall_us + (span.t0 - trace.t0) * 1e6,
+                'dur': (span.t1 - span.t0) * 1e6,
+                'pid': os.getpid(),
+                'tid': trace.request_id,
+            }
+            args = {k: str(v) for k, v in span.meta.items()}
+            args['trace_id'] = trace.trace_id
+            ev['args'] = args
+            events.append(ev)
+    if not events:
+        return None
+    return timeline.write_trace(path, events)

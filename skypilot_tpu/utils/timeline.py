@@ -77,6 +77,18 @@ def event(name_or_fn=None):
     return lambda fn: deco(fn, name_or_fn)
 
 
+def write_trace(path: str, events: List[Dict[str, Any]]) -> str:
+    """THE chrome-trace writer: dump ``events`` (chrome trace-event
+    dicts) as a ``chrome://tracing``-loadable JSON file. Shared by
+    :func:`save` (control-plane events) and
+    ``skypilot_tpu.telemetry.tracing.export_chrome_trace``
+    (per-request engine timelines)."""
+    os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+    with open(path, 'w', encoding='utf-8') as f:
+        json.dump({'traceEvents': events, 'displayTimeUnit': 'ms'}, f)
+    return path
+
+
 def save(path: Optional[str] = None) -> Optional[str]:
     """Write buffered events as a Chrome trace; returns the path."""
     path = path or os.environ.get('SKYTPU_TIMELINE_FILE')
@@ -86,10 +98,7 @@ def save(path: Optional[str] = None) -> Optional[str]:
         events = list(_events)
     if not events:
         return None
-    os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
-    with open(path, 'w', encoding='utf-8') as f:
-        json.dump({'traceEvents': events, 'displayTimeUnit': 'ms'}, f)
-    return path
+    return write_trace(path, events)
 
 
 def clear() -> None:

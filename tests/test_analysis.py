@@ -248,6 +248,55 @@ def test_gc108_ngram_propose_under_lock_flagged():
     assert rule_ids(src) == ['GC108']
 
 
+# ------------------------------------------------------------------ GC109
+def test_gc109_adhoc_timing_in_inference_flagged():
+    src = '''
+    import time
+    from time import perf_counter
+    def step(self):
+        t0 = time.time()
+        t1 = perf_counter()
+        t2 = time.monotonic()
+        return t0, t1, t2
+    '''
+    assert rule_ids(src, 'skypilot_tpu/inference/x.py') == \
+        ['GC109', 'GC109', 'GC109']
+
+
+def test_gc109_only_applies_to_inference():
+    src = '''
+    import time
+    def f():
+        return time.time()
+    '''
+    # Fine in the serve layer / other compute dirs — only the
+    # inference hot paths must route through telemetry.
+    assert rule_ids(src, 'skypilot_tpu/serve/x.py') == []
+    assert rule_ids(src, 'skypilot_tpu/models/x.py') == []
+
+
+def test_gc109_telemetry_clock_spelling_ok():
+    src = '''
+    from skypilot_tpu.telemetry import clock
+    def step(self):
+        with self._prof.phase('admit'):
+            return clock.now(), clock.monotonic()
+    '''
+    assert rule_ids(src, 'skypilot_tpu/inference/x.py') == []
+
+
+def test_gc109_inside_jit_stays_gc201():
+    """Inside a jit body GC201 already fires; GC109 must not
+    double-flag the same call."""
+    src = '''
+    import functools, time, jax
+    @functools.partial(jax.jit)
+    def step(x):
+        return time.time()
+    '''
+    assert rule_ids(src, 'skypilot_tpu/inference/x.py') == ['GC201']
+
+
 # ------------------------------------------------------------------ GC201
 def test_gc201_impure_calls_inside_jit():
     src = '''

@@ -245,9 +245,16 @@ class TestStorageCliAndDashboard:
             assert 'skytpu dashboard' in page
             assert 'Clusters' in page and 'Managed jobs' in page
             with urllib.request.urlopen(
-                    f'http://127.0.0.1:{port}/metrics', timeout=10) as r:
+                    f'http://127.0.0.1:{port}/metrics?format=json',
+                    timeout=10) as r:
                 metrics = json_lib.loads(r.read())
             assert 'clusters' in metrics
+            assert 'telemetry' in metrics     # the registry dump
+            with urllib.request.urlopen(
+                    f'http://127.0.0.1:{port}/metrics',
+                    timeout=10) as r:
+                prom = r.read().decode()
+            assert '# TYPE skytpu_clusters gauge' in prom
         finally:
             server.shutdown()
 

@@ -138,3 +138,24 @@ def test_llama_forward_jaxpr_audit():
     report = jaxpr_audit.audit_llama_forward()
     assert not report.callback_prims
     assert not report.f64_promotions
+
+
+def test_telemetry_parity_audit():
+    """Telemetry must be free at the device boundary: a
+    telemetry-enabled engine run performs zero unsanctioned d2h
+    transfers, zero steady-state recompiles, and its jit cache is
+    byte-for-byte the same SIZE as a telemetry-off run's (profiling is
+    host-side around dispatches, never inside programs)."""
+    report = jaxpr_audit.audit_telemetry_parity('slot')
+    assert report.ok(), report.format()
+    off, on = report.compile_counts['jit cache size (off vs on)']
+    assert off == on and on > 0
+    # The telemetry-on run still performs its sanctioned readbacks.
+    assert report.transfers
+    assert not report.unsanctioned_transfers
+
+
+@pytest.mark.slow
+def test_telemetry_parity_audit_paged():
+    report = jaxpr_audit.audit_telemetry_parity('paged')
+    assert report.ok(), report.format()

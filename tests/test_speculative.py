@@ -379,8 +379,11 @@ def test_metrics_schema_stable_spec_on_and_off():
             return json.loads(r.read())
 
     def metrics(port):
+        # The stable-schema JSON gauge block moved behind ?format=json
+        # when /metrics switched to Prometheus exposition by default.
         with urllib.request.urlopen(
-                f'http://127.0.0.1:{port}/metrics', timeout=10) as r:
+                f'http://127.0.0.1:{port}/metrics?format=json',
+                timeout=10) as r:
             return json.loads(r.read())
 
     port_off = common_utils.find_free_port(18940)

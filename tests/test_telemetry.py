@@ -1,0 +1,341 @@
+"""Unified telemetry: registry exposition + thread safety, per-request
+trace timelines (queue → prefill → decode span order), chrome-trace
+export through the timeline writer, and the model server's Prometheus
+``/metrics`` + ``/debug/requests`` surfaces."""
+import json
+import math
+import threading
+import urllib.request
+
+import jax
+import pytest
+
+from skypilot_tpu.telemetry import registry as registry_lib
+from skypilot_tpu.telemetry import tracing
+
+jax.config.update('jax_platforms', 'cpu')
+
+
+# ---------------------------------------------------------------------------
+# Registry: Prometheus exposition golden test
+# ---------------------------------------------------------------------------
+def _golden_registry() -> registry_lib.MetricsRegistry:
+    reg = registry_lib.MetricsRegistry()
+    reg.counter('t_requests_total', 'Requests served').inc(3)
+    reg.gauge('t_queue_depth', 'Queue depth')          # stays 0
+    h = reg.histogram('t_latency_ms', 'Latency', buckets=(10, 100))
+    h.observe(5)
+    h.observe(50)
+    h.observe(5000)
+    reg.counter('t_probe_total', 'Probes', outcome='success').inc(2)
+    reg.counter('t_probe_total', 'Probes', outcome='failure')
+    return reg
+
+
+def test_prometheus_exposition_golden():
+    """Parse the exposition line by line: HELP/TYPE present once per
+    family, every registered series emitted (zeros NOT omitted),
+    histogram buckets cumulative and terminated by +Inf with matching
+    _sum/_count."""
+    text = _golden_registry().render_prometheus()
+    lines = [ln for ln in text.splitlines() if ln]
+    # Every family has exactly one HELP and one TYPE line.
+    for fam, kind in [('t_requests_total', 'counter'),
+                      ('t_queue_depth', 'gauge'),
+                      ('t_latency_ms', 'histogram'),
+                      ('t_probe_total', 'counter')]:
+        assert lines.count(f'# TYPE {fam} {kind}') == 1, fam
+        assert sum(1 for ln in lines
+                   if ln.startswith(f'# HELP {fam} ')) == 1, fam
+    # Samples are machine-parseable: "name{labels} value".
+    samples = {}
+    for ln in lines:
+        if ln.startswith('#'):
+            continue
+        name, value = ln.rsplit(' ', 1)
+        samples[name] = float(value)
+    assert samples['t_requests_total'] == 3
+    # Zero-valued gauge present, not omitted (stable schema).
+    assert samples['t_queue_depth'] == 0
+    # Histogram: cumulative buckets, +Inf terminator, sum/count.
+    assert samples['t_latency_ms_bucket{le="10"}'] == 1
+    assert samples['t_latency_ms_bucket{le="100"}'] == 2
+    assert samples['t_latency_ms_bucket{le="+Inf"}'] == 3
+    assert samples['t_latency_ms_count'] == 3
+    assert samples['t_latency_ms_sum'] == 5055
+    # Labeled series: both outcomes present, the zero one included.
+    assert samples['t_probe_total{outcome="success"}'] == 2
+    assert samples['t_probe_total{outcome="failure"}'] == 0
+    # TYPE precedes its family's samples.
+    type_idx = lines.index('# TYPE t_latency_ms histogram')
+    first_sample = next(i for i, ln in enumerate(lines)
+                        if ln.startswith('t_latency_ms_bucket'))
+    assert type_idx < first_sample
+
+
+def test_registry_json_rendering():
+    data = _golden_registry().render_json()
+    assert data['t_requests_total']['type'] == 'counter'
+    assert data['t_requests_total']['series'][0]['value'] == 3
+    hist = data['t_latency_ms']['series'][0]
+    assert hist['count'] == 3 and hist['window'] == 3
+
+
+def test_registry_get_or_create_and_type_conflict():
+    reg = registry_lib.MetricsRegistry()
+    c1 = reg.counter('x_total', 'X')
+    c2 = reg.counter('x_total')
+    assert c1 is c2
+    with pytest.raises(TypeError):
+        reg.gauge('x_total')
+    with pytest.raises(ValueError):
+        c1.inc(-1)
+
+
+def test_registry_thread_safety():
+    """Concurrent writers on one counter + one histogram: no lost
+    increments or observations."""
+    reg = registry_lib.MetricsRegistry()
+    c = reg.counter('race_total')
+    h = reg.histogram('race_ms', window=100000)
+    n_threads, n_iter = 8, 2000
+
+    def work():
+        for i in range(n_iter):
+            c.inc()
+            h.observe(i % 50)
+
+    threads = [threading.Thread(target=work) for _ in range(n_threads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert c.value == n_threads * n_iter
+    assert h.count == n_threads * n_iter
+    snap = h.snapshot()
+    assert snap['cumulative'][-1] == n_threads * n_iter
+
+
+def test_windowed_quantiles():
+    """ONE windowed-quantile implementation: exact rolling median/p90
+    over a bounded window (old values age out)."""
+    reg = registry_lib.MetricsRegistry()
+    h = reg.histogram('q_ms', window=100)
+    assert h.quantile(0.5) == 0.0          # empty -> 0, not missing
+    for v in range(1, 101):
+        h.observe(float(v))
+    assert h.quantile(0.5) == 51
+    assert h.quantile(0.9) == 91
+    for _ in range(100):                   # roll the window over
+        h.observe(1000.0)
+    assert h.quantile(0.5) == 1000.0
+    assert h.window_len == 100
+
+
+# ---------------------------------------------------------------------------
+# Per-request tracing: e2e span order through the engines
+# ---------------------------------------------------------------------------
+def _span_names(trace):
+    return [s['name'] for s in trace.to_dict()['spans']]
+
+
+@pytest.mark.parametrize('kind', ['slot', 'paged'])
+def test_request_trace_span_order_e2e(kind):
+    """A finished request's trace holds queue → prefill (with per-chunk
+    spans) → decode in order, all durations non-negative, published
+    exactly once to the ring buffer."""
+    from skypilot_tpu.models import configs
+    cfg = configs.get_config('tiny')
+    if kind == 'paged':
+        from skypilot_tpu.inference.paged import PagedInferenceEngine
+        eng = PagedInferenceEngine(cfg, max_batch=2, max_seq=64,
+                                   prefill_chunk_tokens=8)
+    else:
+        from skypilot_tpu.inference.engine import InferenceEngine
+        eng = InferenceEngine(cfg, max_batch=2, max_seq=64,
+                              prefill_chunk_tokens=8)
+    rid = eng.add_request([1, 2, 3] * 7, max_new_tokens=5)
+    done = eng.run_to_completion(horizon=8)
+    assert rid in done
+    trace = tracing.get_trace_buffer().find(rid)
+    assert trace is not None and trace.done
+    d = trace.to_dict()
+    names = [s['name'] for s in d['spans']]
+    # Lifecycle order (by position in the span list).
+    for earlier, later in [('queue', 'prefill'), ('prefill', 'decode')]:
+        assert names.index(earlier) < names.index(later), names
+    # 21 prompt tokens / chunk 8 -> at least 3 chunk spans.
+    assert names.count('prefill_chunk') >= 3
+    for span in d['spans']:
+        assert span.get('dur_ms', 0.0) >= 0.0, span
+        assert span['start_ms'] >= -1e-6, span
+    assert d['meta']['output_tokens'] == 5
+    # Queue-wait span is completed and measurable (the serve layer's
+    # queue-wait histogram reads exactly this).
+    assert trace.span_ms('queue') is not None
+
+
+def test_trace_cancel_publishes_trace():
+    from skypilot_tpu.inference.engine import InferenceEngine
+    from skypilot_tpu.models import configs
+    eng = InferenceEngine(configs.get_config('tiny'), max_batch=2,
+                          max_seq=64)
+    rid = eng.add_request([1, 2, 3, 4], max_new_tokens=30)
+    eng.step(horizon=1)
+    assert eng.cancel(rid)
+    trace = tracing.get_trace_buffer().find(rid)
+    assert trace is not None and trace.done
+    assert trace.meta.get('cancelled') is True
+
+
+def test_telemetry_off_no_traces_no_phases():
+    from skypilot_tpu.inference.engine import InferenceEngine
+    from skypilot_tpu.models import configs
+    before = len(tracing.get_trace_buffer())
+    eng = InferenceEngine(configs.get_config('tiny'), max_batch=2,
+                          max_seq=64, telemetry=False)
+    rid = eng.add_request([1, 2, 3], max_new_tokens=3)
+    done = eng.run_to_completion(horizon=4)
+    assert rid in done and done[rid].trace is None
+    assert len(tracing.get_trace_buffer()) == before
+    assert eng.phase_stats() == {}
+
+
+def test_chrome_trace_export(tmp_path):
+    """Completed traces export as a chrome://tracing file via the
+    utils/timeline.py writer."""
+    from skypilot_tpu.inference.engine import InferenceEngine
+    from skypilot_tpu.models import configs
+    eng = InferenceEngine(configs.get_config('tiny'), max_batch=2,
+                          max_seq=64, prefill_chunk_tokens=8)
+    rid = eng.add_request([5, 6, 7] * 5, max_new_tokens=4)
+    eng.run_to_completion(horizon=8)
+    out = tmp_path / 'req_trace.json'
+    path = tracing.export_chrome_trace(
+        str(out), traces=[tracing.get_trace_buffer().find(rid)])
+    assert path == str(out)
+    payload = json.loads(out.read_text())
+    events = payload['traceEvents']
+    assert events and all(
+        ev['ph'] == 'X' and ev['dur'] >= 0 and 'ts' in ev
+        for ev in events)
+    assert any(ev['name'] == 'decode' for ev in events)
+
+
+def test_step_phase_profiler_and_compile_events():
+    """The engine records per-phase wall time and one first-call event
+    per distinct jit key (steady state adds none)."""
+    from skypilot_tpu.inference.engine import InferenceEngine
+    from skypilot_tpu.models import configs
+    eng = InferenceEngine(configs.get_config('tiny'), max_batch=2,
+                          max_seq=64, prefill_chunk_tokens=8)
+    for _ in range(2):
+        eng.add_request([1, 2, 3] * 7, max_new_tokens=4)
+        eng.run_to_completion(horizon=8)
+    stats = eng.phase_stats()
+    for phase in ('admit', 'decode_enqueue', 'readback',
+                  'prefill_chunk'):
+        assert phase in stats['phases'], stats
+        assert stats['phases'][phase]['total_s'] >= 0
+    n_compiles = len(stats['compiles'])
+    assert n_compiles >= 2                  # >=1 prefill + >=1 decode key
+    # Same shapes again: no new first-call events.
+    eng.add_request([1, 2, 3] * 7, max_new_tokens=4)
+    eng.run_to_completion(horizon=8)
+    assert len(eng.phase_stats()['compiles']) == n_compiles
+
+
+# ---------------------------------------------------------------------------
+# Model server: Prometheus /metrics + /debug/requests over HTTP
+# ---------------------------------------------------------------------------
+def _wait_ready(port, timeout=120.0):
+    import time
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        try:
+            with urllib.request.urlopen(
+                    f'http://127.0.0.1:{port}/readiness', timeout=5) as r:
+                if r.status == 200:
+                    return
+        except Exception:  # pylint: disable=broad-except
+            time.sleep(0.3)
+    raise RuntimeError('server did not become ready')
+
+
+def test_server_prometheus_metrics_and_debug_requests():
+    """e2e: serve one request, then (a) /metrics parses as Prometheus
+    text with the TTFT/TPOT/queue-wait histograms, step-phase timings
+    and spec gauges, (b) /metrics?format=json keeps the stable gauge
+    schema, (c) /debug/requests returns the request's complete span
+    timeline in lifecycle order."""
+    from skypilot_tpu.serve.server import ModelServer
+    from skypilot_tpu.utils import common_utils
+    port = common_utils.find_free_port(18980)
+    server = ModelServer('tiny', max_batch=2, max_seq=64, port=port)
+    server.start(block=False)
+    try:
+        _wait_ready(port)
+        body = json.dumps({'prompt': [3, 1, 4, 1, 5] * 4,
+                           'max_new_tokens': 6}).encode()
+        req = urllib.request.Request(
+            f'http://127.0.0.1:{port}/generate', data=body,
+            headers={'Content-Type': 'application/json'})
+        with urllib.request.urlopen(req, timeout=60) as r:
+            result = json.loads(r.read())
+        assert len(result['tokens']) == 6
+
+        # (a) Prometheus exposition.
+        with urllib.request.urlopen(
+                f'http://127.0.0.1:{port}/metrics', timeout=10) as r:
+            assert 'text/plain' in r.headers.get('Content-Type', '')
+            prom = r.read().decode()
+        for needle in ('# TYPE skytpu_request_ttft_ms histogram',
+                       '# TYPE skytpu_request_tpot_ms histogram',
+                       '# TYPE skytpu_request_queue_wait_ms histogram',
+                       '# TYPE skytpu_engine_step_phase_seconds '
+                       'histogram',
+                       '# TYPE skytpu_requests_served_total counter',
+                       '# TYPE skytpu_spec_accept_rate gauge',
+                       '# TYPE skytpu_queue_depth gauge'):
+            assert needle in prom, needle
+        assert 'skytpu_request_ttft_ms_bucket{le="+Inf"}' in prom
+        assert 'phase="decode_enqueue"' in prom
+        # Every sample line parses.
+        for ln in prom.splitlines():
+            if not ln or ln.startswith('#'):
+                continue
+            value = float(ln.rsplit(' ', 1)[1])
+            assert not math.isnan(value)
+
+        # (b) Stable-schema JSON retained behind ?format=json.
+        with urllib.request.urlopen(
+                f'http://127.0.0.1:{port}/metrics?format=json',
+                timeout=10) as r:
+            m = json.loads(r.read())
+        for key in ('requests_served', 'active_slots', 'queue_depth',
+                    'prefill_inflight', 'max_batch', 'ttft_ms_median',
+                    'ttft_ms_p90', 'ttft_window', 'tpot_ms_median',
+                    'queue_wait_ms_median', 'speculate_k',
+                    'spec_accept_rate', 'spec_tokens_per_step',
+                    'spec_proposed', 'spec_accepted', 'spec_rounds'):
+            assert key in m, key
+            assert isinstance(m[key], (int, float)), key
+        assert m['scheduler']['speculate_k'] == 0
+        assert m['requests_served'] >= 1
+        assert m['ttft_window'] >= 1
+
+        # (c) /debug/requests: the finished request's span timeline.
+        with urllib.request.urlopen(
+                f'http://127.0.0.1:{port}/debug/requests?limit=8',
+                timeout=10) as r:
+            traces = json.loads(r.read())['requests']
+        assert traces
+        ours = next(t for t in traces
+                    if t['request_id'] == result['request_id'])
+        names = [s['name'] for s in ours['spans']]
+        assert names.index('queue') < names.index('prefill') \
+            < names.index('decode')
+        assert all(s.get('dur_ms', 0) >= 0 for s in ours['spans'])
+        assert ours['done']
+    finally:
+        server.stop()
