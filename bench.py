@@ -411,9 +411,12 @@ def _bench_7b_serving(chip_bw: float, n_chips: int) -> dict:
         # Scheduler config (trajectory comparison across bench rounds).
         'chunk': eng.chunk,
         'decode_priority_ratio': eng.decode_priority_ratio,
+        'kv_cache_dtype': eng.kv_cache_dtype,
         'n_pages': stats['n_pages'],
         'pool_bytes': stats['pool_bytes'],
-        'pool_token_capacity': stats['n_pages'] * eng.page,
+        # Allocatable tokens at the QUANTIZED per-token byte cost
+        # (page 0 reserved) — int8 KV ~doubles this on the same HBM.
+        'pool_token_capacity': stats['pool_token_capacity'],
         'prefix_hits': stats['prefix_hits'],
         'prefix_misses': stats['prefix_misses'],
         'preemptions': eng.preemptions,
@@ -537,6 +540,56 @@ def _bench_7b_serving(chip_bw: float, n_chips: int) -> dict:
     except Exception as e:  # pylint: disable=broad-except
         slot_detail = {'error': f'{type(e).__name__}: {e}'}
 
+    # int8-vs-bf16 KV ablation: same int8 weights, same anchor
+    # workload, only the KV storage dtype flips (kv_cache_dtype='bf16'
+    # overrides the auto coupling). Runs after the slot section so its
+    # HBM is free; best-effort — a failure must not discard the
+    # measurements above. Both sides report RAW step time minus the
+    # weights-only stream (the per-call dispatch share rides both
+    # equally), so attn_kv_and_rest is directly comparable.
+    kv_detail = None
+    try:
+        keng = PagedInferenceEngine(cfg, params, max_batch=batch,
+                                    max_seq=max_seq, prefill_w8a8=True,
+                                    kv_cache_dtype='bf16')
+        submit(keng, _anchor_workload(batch, seed=23))
+        keng.run_to_completion(horizon=horizon)      # warmup/compile
+        steady(keng)                                 # hit every bucket
+        bf16_tok_s, bf16_step_s, _ = steady(keng)
+        bf16_tok_s /= n_chips
+        bf16_sus, _ = sustained(keng)
+        kstats = keng.memory_stats()
+        bf16_preempt = keng.preemptions
+        del keng
+        gc.collect()
+        int8_cap = paged_detail['pool_token_capacity']
+        kv_detail = {
+            'int8': {
+                'pool_token_capacity': int8_cap,
+                'preemptions': paged_detail['preemptions'],
+                'sustained_out_tok_s_per_chip': round(sustained_tok_s,
+                                                      2),
+                'decode_tok_s_per_chip': round(decode_tok_s, 2),
+                'attn_kv_and_rest_ms_per_step': round(
+                    step_s * 1e3 - weights_ms, 3),
+            },
+            'bf16': {
+                'pool_token_capacity': kstats['pool_token_capacity'],
+                'preemptions': bf16_preempt,
+                'sustained_out_tok_s_per_chip': round(bf16_sus, 2),
+                'decode_tok_s_per_chip': round(bf16_tok_s, 2),
+                'attn_kv_and_rest_ms_per_step': round(
+                    bf16_step_s * 1e3 - weights_ms, 3),
+            },
+            'capacity_ratio_int8_vs_bf16': (round(
+                int8_cap / kstats['pool_token_capacity'], 2)
+                if kstats['pool_token_capacity'] else None),
+            'sustained_speedup_int8_vs_bf16': (round(
+                sustained_tok_s / bf16_sus, 3) if bf16_sus else None),
+        }
+    except Exception as e:  # pylint: disable=broad-except
+        kv_detail = {'error': f'{type(e).__name__}: {e}'}
+
     # Headline = the better e2e of the two engines (the slot engine's
     # contiguous cache streams faster per token at its feasible batch;
     # the paged engine holds 2x the concurrent contexts). Both full
@@ -587,6 +640,7 @@ def _bench_7b_serving(chip_bw: float, n_chips: int) -> dict:
             'mode': 'raw-7b-config',
             'model': cfg.name,
             'quantize': 'int8',
+            'kv_cache_dtype': paged_detail['kv_cache_dtype'],
             # int8 activations on the compute-bound prefill (opt-in
             # engine mode, measured +10% sustained; decode + unembed
             # stay W8A16) — labeled here because the anchor's JetStream
@@ -619,6 +673,7 @@ def _bench_7b_serving(chip_bw: float, n_chips: int) -> dict:
             # attributable across rounds.
             'ckpt_load_workers': weights.load_workers(),
             'spec': spec_detail,
+            'kv_cache': kv_detail,
             'paged': paged_detail,
             'slot': slot_detail,
             'capacity': capacity,

@@ -7,8 +7,10 @@ Subcommands:
   suppressions. ``--update-baseline`` rewrites the baseline from the
   current violations (review before committing).
 - ``graftcheck audit [--preset slot|slot-monolithic|paged|slot-spec|
-  paged-spec|llama]`` — runtime jaxpr audit of the engines' hot loops,
-  including the speculative propose→verify→commit steady state
+  paged-spec|telemetry|telemetry-paged|kv-int8|kv-int8-slot|llama]`` —
+  runtime jaxpr audit of the engines' hot loops, including the
+  speculative propose→verify→commit steady state and the int8-KV
+  (``kv_cache_dtype='int8'`` over bf16 weights) quantize-on-write path
   (requires jax); exit 1 on unsanctioned host transfers, steady-state
   recompiles, callback primitives, or float64 promotions.
 - ``graftcheck rules`` — list the rule set.
@@ -83,11 +85,14 @@ def main(argv: Optional[List[str]] = None) -> int:
     p_audit = sub.add_parser('audit',
                              help='runtime jaxpr audit of engine hot '
                                   'loops (requires jax)')
+    # Choices come from the preset registry (importable without jax)
+    # so new presets are runnable from the CLI the day they land.
+    from skypilot_tpu.analysis import jaxpr_audit
     p_audit.add_argument('--preset', action='append',
-                         choices=['slot', 'slot-monolithic', 'paged',
-                                  'slot-spec', 'paged-spec', 'llama'],
+                         choices=sorted(jaxpr_audit.PRESETS),
                          help='repeatable; default: slot, paged, '
-                              'slot-spec, paged-spec, llama')
+                              'slot-spec, paged-spec, telemetry, '
+                              'kv-int8, kv-int8-slot, llama')
 
     sub.add_parser('rules', help='list the rule set')
 

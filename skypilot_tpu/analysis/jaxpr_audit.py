@@ -269,7 +269,8 @@ def _jit_fns(fn) -> List[Any]:
 
 # ------------------------------------------------------------------ presets
 def _tiny_engine(kind: str, chunked: bool, speculate_k: int = 0,
-                 telemetry: bool = True):
+                 telemetry: bool = True,
+                 kv_cache_dtype: Optional[str] = None):
     from skypilot_tpu.models import configs
     cfg = configs.get_config('tiny')
     chunk = 16 if chunked else 0
@@ -278,11 +279,13 @@ def _tiny_engine(kind: str, chunked: bool, speculate_k: int = 0,
         return PagedInferenceEngine(cfg, max_batch=4, max_seq=128,
                                     prefill_chunk_tokens=chunk or None,
                                     speculate_k=speculate_k,
+                                    kv_cache_dtype=kv_cache_dtype,
                                     telemetry=telemetry)
     from skypilot_tpu.inference.engine import InferenceEngine
     return InferenceEngine(cfg, max_batch=4, max_seq=128,
                            prefill_chunk_tokens=chunk,
                            speculate_k=speculate_k,
+                           kv_cache_dtype=kv_cache_dtype,
                            telemetry=telemetry)
 
 
@@ -317,7 +320,8 @@ def _record_static_keys(engine, report: AuditReport):
 
 
 def audit_engine(kind: str = 'slot', chunked: bool = True,
-                 rounds: int = 2, speculate_k: int = 0) -> AuditReport:
+                 rounds: int = 2, speculate_k: int = 0,
+                 kv_cache_dtype: Optional[str] = None) -> AuditReport:
     """Build a tiny engine, run one warmup wave (compiles allowed),
     then audit ``rounds`` identical same-shaped waves: every compile
     and every unsanctioned host transfer in those waves is a violation.
@@ -331,11 +335,14 @@ def audit_engine(kind: str = 'slot', chunked: bool = True,
     by the observed (k, sample, kv_bucket) key set, and the only host
     readback per round is the sanctioned commit sync."""
     spec_tag = f' + speculate_k={speculate_k}' if speculate_k else ''
+    kv_tag = (f' + kv_cache_dtype={kv_cache_dtype}'
+              if kv_cache_dtype else '')
     report = AuditReport(
         name=f'{kind} engine '
              f'({"chunked prefill + " if chunked else ""}decode'
-             f'{spec_tag})')
-    engine = _tiny_engine(kind, chunked, speculate_k)
+             f'{spec_tag}{kv_tag})')
+    engine = _tiny_engine(kind, chunked, speculate_k,
+                          kv_cache_dtype=kv_cache_dtype)
     if speculate_k:
         # Repetitive prompts: the n-gram proposer matches, acceptance
         # is nonzero AND per-slot variable — the masked-commit shapes
@@ -464,11 +471,19 @@ PRESETS: Dict[str, Callable[[], AuditReport]] = {
                                        speculate_k=4),
     'telemetry': audit_telemetry_parity,
     'telemetry-paged': lambda: audit_telemetry_parity('paged'),
+    # int8 KV over bf16 weights — the DECOUPLED kv_cache_dtype path no
+    # other preset drives (the coupled int8+int8 case rides the bench):
+    # quantize-on-write in every scan + fused-dequant reads must add
+    # zero d2h transfers and zero steady-state jit-cache growth.
+    'kv-int8': lambda: audit_engine('paged', chunked=True,
+                                    kv_cache_dtype='int8'),
+    'kv-int8-slot': lambda: audit_engine('slot', chunked=True,
+                                         kv_cache_dtype='int8'),
     'llama': audit_llama_forward,
 }
 
 
 def run_presets(names: Optional[List[str]] = None) -> List[AuditReport]:
     names = names or ['slot', 'paged', 'slot-spec', 'paged-spec',
-                      'telemetry', 'llama']
+                      'telemetry', 'kv-int8', 'kv-int8-slot', 'llama']
     return [PRESETS[n]() for n in names]

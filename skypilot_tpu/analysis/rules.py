@@ -48,6 +48,14 @@ TPU hot-path hygiene (GC2xx), applied to the compute layer
   ``@jax.jit`` body (``time.time``, ``print``, ``np.*``, ``.item()``,
   ``float()`` on a traced value) either fail at trace time or bake a
   constant into the compiled program.
+- **GC110 unscaled-int8-kv-write** — ``.astype(jnp.int8)`` in the
+  compute layer outside the quantization helpers
+  (``models/quantization.py``, ``quantize_*`` functions). Symmetric
+  int8 KV is (codes, absmax/127 scales) pairs written through
+  ``llama.quantize_kv_rows``; a bare astype silently truncates to
+  ±1-integer range and drops the scale — garbage KV that still
+  type-checks. (Classed with the 1xx rules because it polices a
+  repo-wide write discipline, not a jaxpr property.)
 - **GC202 host-sync** — device->host readbacks outside the sanctioned
   :func:`skypilot_tpu.utils.host.host_sync` helper (bare
   ``np.asarray(x)``, ``.item()``, ``jax.device_get``,
@@ -88,6 +96,10 @@ RULES: Dict[str, str] = {
     'GC109': 'adhoc-timing: wall-clock/perf-counter call in an '
              'inference hot path — use skypilot_tpu.telemetry '
              '(clock / step-phase profiler) instead',
+    'GC110': 'unscaled-int8-kv-write: .astype(jnp.int8) outside the '
+             'quantization helpers — int8 KV/weight writes must go '
+             'through quantize_kv_rows/models.quantization (codes + '
+             'scales); a bare astype drops the scale',
     'GC201': 'impure-jit: impure or host-synchronizing call inside a '
              '@jax.jit body',
     'GC202': 'host-sync: device->host readback outside the '
@@ -101,6 +113,13 @@ COMPUTE_DIRS = ('inference', 'models', 'ops', 'train')
 # The sanctioned-sync helper module: GC202 does not apply to its own
 # implementation.
 HOST_HELPER_SUFFIX = 'utils/host.py'
+
+# The sanctioned quantization module: GC110 does not apply to its own
+# implementation (nor to any function whose name carries 'quantize' —
+# llama.quantize_kv_rows is the KV write helper the rule points at).
+QUANT_HELPER_SUFFIX = 'models/quantization.py'
+# Spellings of the int8 dtype as an astype argument.
+_INT8_DTYPES = {'jnp.int8', 'jax.numpy.int8', 'np.int8', 'numpy.int8'}
 
 _SUPPRESS_RE = re.compile(r'graftcheck:\s*disable=([A-Za-z0-9,\s]+)')
 
@@ -286,11 +305,13 @@ class _ClassPrepass(ast.NodeVisitor):
 class _Checker(ast.NodeVisitor):
 
     def __init__(self, rel: str, lines: List[str], is_compute: bool,
-                 is_inference: bool = False):
+                 is_inference: bool = False,
+                 is_quant_helper: bool = False):
         self.rel = rel
         self.lines = lines
         self.is_compute = is_compute
         self.is_inference = is_inference
+        self.is_quant_helper = is_quant_helper
         self.violations: List[Violation] = []
         self._scope: List[str] = []
         self._class: List[Tuple[Set[str], Set[str]]] = []  # (locks, guarded)
@@ -460,6 +481,10 @@ class _Checker(ast.NodeVisitor):
         method = (node.func.attr
                   if isinstance(node.func, ast.Attribute) else '')
         self._check_timeouts(node, name)
+        if self.is_compute:
+            # Applies inside jit bodies too — int8 KV writes live in
+            # the jitted prefill/decode scans.
+            self._check_int8_write(node, method)
         if self._any_lock_held():
             self._check_blocking_under_lock(node, name, method)
         if self._jit_depth:
@@ -469,6 +494,30 @@ class _Checker(ast.NodeVisitor):
             if self.is_inference:
                 self._check_adhoc_timing(node, name)
         self.generic_visit(node)
+
+    def _check_int8_write(self, node: ast.Call, method: str) -> None:
+        """GC110: ``x.astype(jnp.int8)`` / ``x.astype('int8')`` outside
+        the quantization helpers. Exempt: the quantization module
+        itself, and any enclosing function whose name carries
+        'quantize' (``quantize_kv_rows``, ``_quantize_array``, ...) —
+        those ARE the sanctioned spellings this rule routes writers
+        to."""
+        if (self.is_quant_helper or method != 'astype'
+                or not node.args):
+            return
+        if any('quantize' in s for s in self._scope):
+            return
+        arg = node.args[0]
+        dtype = _dotted(arg)
+        is_int8 = (dtype in _INT8_DTYPES
+                   or (isinstance(arg, ast.Constant)
+                       and arg.value == 'int8'))
+        if is_int8:
+            self._add('GC110', node,
+                      '.astype(int8) outside the quantization helpers '
+                      'silently drops the scale — write int8 KV/weights '
+                      'through llama.quantize_kv_rows / '
+                      'models.quantization (codes + absmax scales)')
 
     def _check_adhoc_timing(self, node: ast.Call, name: str) -> None:
         if (name in _ADHOC_TIMING
@@ -604,7 +653,9 @@ def check_source(rel: str, source: str) -> List[Violation]:
                   and not norm.endswith(HOST_HELPER_SUFFIX))
     is_inference = is_compute and '/inference/' in f'/{norm}'
     checker = _Checker(norm, source.splitlines(), is_compute,
-                       is_inference)
+                       is_inference,
+                       is_quant_helper=norm.endswith(
+                           QUANT_HELPER_SUFFIX))
     checker.visit(tree)
     suppressed = _line_suppressions(source)
     out = []

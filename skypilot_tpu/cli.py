@@ -547,11 +547,17 @@ def serve_logs(service_name, no_follow):
 @click.option('--model-path', default=None,
               help='HF checkpoint dir (real weights + tokenizer).')
 @click.option('--quantize', default=None, type=click.Choice(['int8']),
-              help='int8 weights + KV cache (2x decode).')
+              help='int8 weights (KV cache follows via '
+                   '--kv-cache-dtype auto; 2x decode).')
 @click.option('--kv-cache', default='paged',
               type=click.Choice(['slot', 'paged']),
               help='paged (default) = shared page pool with prefix '
                    'caching; slot = fixed per-slot reservations.')
+@click.option('--kv-cache-dtype', default=None,
+              type=click.Choice(['bf16', 'int8']),
+              help='KV cache storage dtype; default follows --quantize. '
+                   'int8 halves decode KV HBM traffic and ~doubles '
+                   'paged pool token capacity.')
 @click.option('--page-size', type=int, default=None,
               help='Paged-cache page granularity (tokens; auto).')
 @click.option('--prefill-chunk-tokens', type=int, default=None,
@@ -568,8 +574,8 @@ def serve_logs(service_name, no_follow):
 @click.option('--max-batch', type=int, default=8)
 @click.option('--max-seq', type=int, default=1024)
 @click.option('--port', type=int, default=8081)
-def model_server(model, model_path, quantize, kv_cache, page_size,
-                 prefill_chunk_tokens, decode_priority_ratio,
+def model_server(model, model_path, quantize, kv_cache, kv_cache_dtype,
+                 page_size, prefill_chunk_tokens, decode_priority_ratio,
                  prefill_w8a8, speculate_k, max_batch, max_seq, port):
     """Run the in-tree replica model server on this host (the process
     a service task's ``run`` command starts on each replica; same
@@ -581,6 +587,7 @@ def model_server(model, model_path, quantize, kv_cache, page_size,
     server = ModelServer(model, max_batch=max_batch, max_seq=max_seq,
                          port=port, model_path=model_path,
                          quantize=quantize, kv_cache=kv_cache,
+                         kv_cache_dtype=kv_cache_dtype,
                          page_size=page_size,
                          prefill_w8a8=prefill_w8a8,
                          prefill_chunk_tokens=prefill_chunk_tokens,

@@ -97,6 +97,37 @@ def _ring_row_bytes(cfg, batch: int) -> int:
 _RING_BYTES_CAP = int(1e9)
 
 
+def resolve_kv_cache_dtype(kv_cache_dtype: Optional[str],
+                           quantize: Optional[str]) -> str:
+    """Effective KV storage dtype ('bf16' | 'int8') from the engine
+    flag. ``None``/``'auto'`` follows the WEIGHT quantization mode (the
+    historical coupling: int8 weights => int8 KV); an explicit value
+    decouples them in either direction — int8 KV over bf16 weights
+    halves the dominant decode HBM stream (and ~doubles pool token
+    capacity) on its own, and bf16 KV over int8 weights is the
+    ablation/debug spelling."""
+    if kv_cache_dtype in (None, 'auto'):
+        return 'int8' if quantize == 'int8' else 'bf16'
+    if kv_cache_dtype not in ('bf16', 'int8'):
+        raise ValueError(
+            f'unknown kv_cache_dtype {kv_cache_dtype!r}; supported: '
+            "'bf16', 'int8' (None/'auto' follows the weight quantize "
+            'mode)')
+    return kv_cache_dtype
+
+
+def kv_token_bytes(cfg, quantized: bool) -> int:
+    """Stored bytes of ONE cached token: k+v rows across all layers,
+    per-row fp32 scales included for int8 caches. THE per-token cost
+    every capacity decision rides — paged pool sizing, the prefill
+    stacked-rows caps, preemption accounting, and the telemetry
+    capacity gauges — so int8 KV's halved cost shows up everywhere at
+    once instead of drifting per call site."""
+    row_w = (cfg.head_dim + 4 if quantized
+             else cfg.head_dim * jnp.dtype(cfg.dtype).itemsize)
+    return cfg.n_layers * cfg.n_kv_heads * row_w * 2
+
+
 def _ring_horizon_cap(cfg, batch: int, param_bytes: int) -> int:
     """Longest sensible fused-decode horizon: the ring re-read must stay
     under ~15% of the weight stream AND the ring buffers under ~1 GB
@@ -297,6 +328,12 @@ class _EngineBase:
         return (len(self._queue) > 0
                 or any(r is not None for r in self._slots))
 
+    # Pool-pressure recompute requeues. The slot engine reserves
+    # max_seq rows per slot up front so it never preempts; the paged
+    # engine overrides this with a live counter. One spelling so the
+    # telemetry/bench surfaces read the same attribute off either.
+    preemptions = 0
+
     @property
     def num_active(self) -> int:
         return sum(r is not None for r in self._slots)
@@ -455,6 +492,7 @@ class InferenceEngine(SpeculativeMixin, _EngineBase):
                  mesh: Optional[Any] = None, rng_seed: int = 0,
                  attn_impl: str = 'auto',
                  quantize: Optional[str] = None,
+                 kv_cache_dtype: Optional[str] = None,
                  donate_params: bool = False,
                  prefill_w8a8: bool = False,
                  prefill_chunk_tokens: Optional[int] = 256,
@@ -494,9 +532,16 @@ class InferenceEngine(SpeculativeMixin, _EngineBase):
         from skypilot_tpu.models import quantization
         self._param_bytes = quantization.quantized_bytes(self.params)
 
-        self.cache = llama.KVCache.create(cfg, batch=max_batch,
-                                          max_seq=max_seq,
-                                          quantized=quantize == 'int8')
+        # KV storage dtype is its OWN knob (decoupled from the weight
+        # quantize mode; None follows it for backward compatibility):
+        # the cache's quantized flag drives every downstream write site
+        # (prefill scatter, chunk prefill, spec verify, decode merge)
+        # and the fused-dequant attention reads.
+        self.kv_cache_dtype = resolve_kv_cache_dtype(kv_cache_dtype,
+                                                     quantize)
+        self.cache = llama.KVCache.create(
+            cfg, batch=max_batch, max_seq=max_seq,
+            quantized=self.kv_cache_dtype == 'int8')
         if mesh is not None:
             cache_sh = mesh_lib.tree_shardings(
                 llama.cache_logical_axes(quantized=self.cache.quantized),
@@ -547,6 +592,24 @@ class InferenceEngine(SpeculativeMixin, _EngineBase):
         # free bf16 buffers in place if it ever runs on-device.
         kwargs.setdefault('donate_params', True)
         return cls(cfg, params, **kwargs)
+
+    def kv_pool_stats(self) -> Dict[str, Any]:
+        """KV capacity/pressure in TOKENS — the schema the telemetry
+        gauges and bench share with the paged engine. The slot cache's
+        capacity is the static ``max_batch x max_seq`` reservation;
+        "used" counts live context rows, and preemptions are always 0
+        (every admitted request owns its full reservation)."""
+        cap = self.max_batch * self.max_seq
+        used = int(self._slot_len.sum())
+        return {
+            'kv_cache_dtype': self.kv_cache_dtype,
+            'pool_token_capacity': cap,
+            'tokens_used': used,
+            'tokens_free': cap - used,
+            'preemptions': int(self.preemptions),
+            'kv_token_bytes': kv_token_bytes(self.cfg,
+                                             self.cache.quantized),
+        }
 
     # ------------------------------------------------------------------
     # Compiled steps
@@ -693,11 +756,7 @@ class InferenceEngine(SpeculativeMixin, _EngineBase):
         pending = sorted(self._prefill_off)
         if not pending:
             return []
-        quantized = self.cache.quantized
-        row_w = ((self.cfg.head_dim + 4) if quantized
-                 else self.cfg.head_dim *
-                 jnp.dtype(self.cfg.dtype).itemsize)
-        scratch_tok = self.cfg.n_layers * self.cfg.n_kv_heads * row_w * 2
+        scratch_tok = kv_token_bytes(self.cfg, self.cache.quantized)
 
         def shapes(batch):
             # Chunk width: the full chunk, or a smaller bucket when
@@ -1037,11 +1096,7 @@ class InferenceEngine(SpeculativeMixin, _EngineBase):
         # overflow requeues at the FRONT (keeps FIFO) for the next step.
         bucket = min(_bucket_len(max(len(r.prompt) for _, r in batch)),
                      self.max_seq)
-        row_width = ((self.cfg.head_dim + 4) if self.cache.quantized
-                     else self.cfg.head_dim *
-                     jnp.dtype(self.cfg.dtype).itemsize)
-        scratch_tok = self.cfg.n_layers * self.cfg.n_kv_heads * \
-            row_width * 2
+        scratch_tok = kv_token_bytes(self.cfg, self.cache.quantized)
         fit = int(0.75e9) // max(1, bucket * scratch_tok)
         cap = 1
         for b in self._PREFILL_N_BUCKETS:     # largest PADDED n that fits

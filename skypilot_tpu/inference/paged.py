@@ -24,10 +24,15 @@ re-prefills shared prefixes. This module is the vLLM-class capability
   the pages written so far — one compiled program regardless of prompt
   length, bounded scratch memory (long-prompt serving).
 
-int8: the pool quantizes per-row like the slot cache (``k_scale``
+int8 (``kv_cache_dtype='int8'``, its own knob — decoupled from the
+weight quantize mode, which it follows only when left on auto): the
+pool quantizes per-row like the slot cache (``k_scale``
 [L, n_pages, hkv, page] fp32, head-major like the pool — the kernel
 DMAs scale pages contiguously and the old per-horizon-call relayout
-of the whole scale pool is gone).
+of the whole scale pool is gone). Every capacity decision — auto pool
+sizing, preemption pressure, prefill stack caps, telemetry — costs
+tokens at the QUANTIZED per-token byte width, so int8 KV ~doubles pool
+token capacity as well as halving the decode KV stream.
 """
 from __future__ import annotations
 
@@ -661,6 +666,7 @@ class PagedInferenceEngine(SpeculativeMixin, _EngineBase):
                  decode_priority_ratio: Optional[float] = None,
                  mesh=None, rng_seed: int = 0, attn_impl: str = 'auto',
                  quantize: Optional[str] = None,
+                 kv_cache_dtype: Optional[str] = None,
                  donate_params: bool = False,
                  decode_impl: str = 'auto',
                  prefill_w8a8: bool = False,
@@ -705,25 +711,39 @@ class PagedInferenceEngine(SpeculativeMixin, _EngineBase):
             cfg, params, quantize=quantize, mesh=mesh,
             donate_params=donate_params)
         self.cfg = cfg
+        # KV storage dtype is its OWN knob, decoupled from the weight
+        # quantize mode (None/'auto' follows it — the historical
+        # coupling). Resolved AFTER prepare_params so pre-quantized
+        # param trees (load_checkpoint(quantize='int8')) resolve 'auto'
+        # correctly too. The resulting flag drives the pool dtype,
+        # page-size selection, pool sizing, and every capacity surface.
+        from skypilot_tpu.inference.engine import resolve_kv_cache_dtype
+        self.kv_cache_dtype = resolve_kv_cache_dtype(kv_cache_dtype,
+                                                     quantize)
+        kv_int8 = self.kv_cache_dtype == 'int8'
         if page_size is None:
-            page_size = self._auto_page_size(cfg, max_seq, quantize,
+            page_size = self._auto_page_size(cfg, max_seq, kv_int8,
                                              mesh)
-        self.page = page_size
-        if self._page_user and page_size % 128 != 0 \
-                and quantize == 'int8':
-            # Checked AFTER prepare_params so pre-quantized param trees
-            # (load_checkpoint(quantize='int8')) are caught too. The
-            # manual-DMA kernel's per-page scale blocks need a
+        if self._page_user and page_size % 128 != 0 and kv_int8 \
+                and self._int8_fast_path_reachable(cfg, mesh):
+            # The manual-DMA int8 kernel's per-page scale blocks need a
             # 128-aligned minor dim; off the fast path decode drops to
-            # the per-page-grid kernel (~0.71x measured). Loud, not
-            # silent — the model server exposes --page-size directly.
-            # Only EXPLICIT sizes warn: auto-selection never picks a
-            # misaligned size where the fast path is reachable.
+            # the per-page-grid kernel (~0.71x measured). Where that
+            # kernel is actually reachable, an explicit misaligned size
+            # is a pure footgun (the multichip dryrun's page_size=8 int8
+            # pool shipped the 0.7x path for weeks) — so it is ROUNDED
+            # UP to the next fast-path size, loudly. Elsewhere (CPU
+            # tests, gather path, meshes) alignment is free and the
+            # explicit size is the user's to keep.
+            adjusted = self._fast_path_page_size(page_size)
             import warnings
             warnings.warn(
                 f'page_size={page_size} is not a multiple of 128: int8 '
-                'paged decode falls off the manual-DMA fast path '
-                '(~0.7x throughput). Use a multiple of 128.')
+                'paged decode would fall off the manual-DMA fast path '
+                f'(~0.7x throughput). Auto-adjusted to {adjusted}; '
+                'pass a multiple of 128 to silence this.')
+            page_size = adjusted
+        self.page = page_size
         from skypilot_tpu.models import quantization
         self._param_bytes = quantization.quantized_bytes(self.params)
 
@@ -739,7 +759,7 @@ class PagedInferenceEngine(SpeculativeMixin, _EngineBase):
         self.alloc = PageAllocator(n_pages, page_size)
         self.cache = PagedKVCache.create(cfg, n_pages=n_pages,
                                          page_size=page_size,
-                                         quantized=quantize == 'int8')
+                                         quantized=kv_int8)
         if mesh is not None:
             sh = mesh_lib.tree_shardings(
                 paged_cache_logical_axes(self.cache.quantized), mesh,
@@ -810,18 +830,34 @@ class PagedInferenceEngine(SpeculativeMixin, _EngineBase):
         self._init_spec(speculate_k)
 
     @staticmethod
-    def _auto_page_size(cfg: ModelConfig, max_seq: int,
-                        quantize: Optional[str], mesh) -> int:
+    def _int8_fast_path_reachable(cfg: ModelConfig, mesh) -> bool:
+        """True when ``decode_impl='auto'`` would pick the Pallas
+        manual-DMA int8 kernel — the one condition under which page
+        alignment matters (its per-page scale blocks need a 128-aligned
+        minor dim)."""
+        return (cfg.head_dim % 128 == 0
+                and jax.default_backend() == 'tpu' and mesh is None)
+
+    @staticmethod
+    def _fast_path_page_size(page_size: int) -> int:
+        """Smallest fast-path-compatible (128-multiple) page size that
+        holds at least ``page_size`` tokens."""
+        return max(128, -(-page_size // 128) * 128)
+
+    @classmethod
+    def _auto_page_size(cls, cfg: ModelConfig, max_seq: int,
+                        kv_int8: bool, mesh) -> int:
         """Default page size: stay on the decode fast path. Wherever
         the Pallas manual-DMA int8 kernel is reachable (the same
         condition ``decode_impl='auto'`` uses to pick it), pages must
         be 128-aligned — the multichip dryrun's explicit page_size=8
         int8 pool tripped the ~0.7x per-page-grid fallback this guard
-        exists to catch. Elsewhere (bf16 pools, CPU tests, gather
-        path) alignment is free, so short-context configs get smaller
-        pages instead of one page per slot."""
-        if (quantize == 'int8' and cfg.head_dim % 128 == 0
-                and jax.default_backend() == 'tpu' and mesh is None):
+        exists to catch (explicit misaligned sizes are now auto-rounded
+        up in ``__init__`` under the same condition). Elsewhere (bf16
+        pools, CPU tests, gather path) alignment is free, so
+        short-context configs get smaller pages instead of one page per
+        slot."""
+        if kv_int8 and cls._int8_fast_path_reachable(cfg, mesh):
             return 128
         from skypilot_tpu.inference.engine import _bucket_len
         return min(128, _bucket_len(max(8, max_seq // 8), minimum=8))
@@ -829,10 +865,8 @@ class PagedInferenceEngine(SpeculativeMixin, _EngineBase):
     @staticmethod
     def _page_bytes(cfg: ModelConfig, page_size: int,
                     quantized: bool) -> int:
-        return (cfg.n_layers * page_size * cfg.n_kv_heads *
-                (cfg.head_dim * (1 if quantized else
-                                 jnp.dtype(cfg.dtype).itemsize) +
-                 (4 if quantized else 0)) * 2)
+        from skypilot_tpu.inference.engine import kv_token_bytes
+        return kv_token_bytes(cfg, quantized) * page_size
 
     def _auto_n_pages(self, cfg: ModelConfig, max_batch: int,
                       max_seq: int, page_size: int) -> int:
@@ -845,8 +879,11 @@ class PagedInferenceEngine(SpeculativeMixin, _EngineBase):
         back to slot parity when the backend has no memory stats (CPU
         tests, interpret mode)."""
         parity = max_batch * -(-max_seq // page_size) + 1
-        from skypilot_tpu.models import quantization
-        quantized = quantization.is_quantized(self.params)
+        # Per-page byte cost follows the KV CACHE dtype, not the weight
+        # dtype — with the flags decoupled (int8 weights + bf16 KV or
+        # vice versa) sizing the pool off the params would mis-state
+        # capacity by 2x in either direction.
+        quantized = self.kv_cache_dtype == 'int8'
         try:
             stats = jax.devices()[0].memory_stats()
             limit = stats['bytes_limit']
@@ -1001,8 +1038,30 @@ class PagedInferenceEngine(SpeculativeMixin, _EngineBase):
             'pages_free': len(self.alloc.free),
             'page_bytes': page_bytes,
             'pool_bytes': page_bytes * self.alloc.n_pages,
+            'kv_cache_dtype': self.kv_cache_dtype,
+            # Allocatable tokens (page 0 is the reserved trash page).
+            'pool_token_capacity': (self.alloc.n_pages - 1) * self.page,
             'prefix_hits': self.alloc.prefix_hits,
             'prefix_misses': self.alloc.prefix_misses,
+        }
+
+    def kv_pool_stats(self) -> Dict[str, Any]:
+        """KV capacity/pressure in TOKENS (page-granular: a partially
+        filled page counts as used) — the schema shared with the slot
+        engine for the telemetry gauges and bench. Prefix-retained
+        pages count as FREE: allocation evicts them on demand."""
+        from skypilot_tpu.inference.engine import kv_token_bytes
+        stats = self.memory_stats()
+        cap = stats['pool_token_capacity']
+        used = stats['pages_in_use'] * self.page
+        return {
+            'kv_cache_dtype': self.kv_cache_dtype,
+            'pool_token_capacity': cap,
+            'tokens_used': used,
+            'tokens_free': cap - used,
+            'preemptions': int(self.preemptions),
+            'kv_token_bytes': kv_token_bytes(self.cfg,
+                                             self.cache.quantized),
         }
 
     # ---------------------------------------------------------- admission

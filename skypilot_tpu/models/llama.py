@@ -141,11 +141,15 @@ class KVCache(NamedTuple):
     length: [batch] valid entries per sequence (supports continuous
     batching where sequences are at different positions).
 
-    int8 mode (``create(..., quantized=True)``): k/v are int8 with
-    per-(layer, slot, position, head) fp32 absmax/127 scales — halves
-    the decode cache read (the second-largest HBM stream after the
-    weights). The dequantizing convert+mul fuses into the attention
-    matmul's operand read, like the weight-only int8 path."""
+    int8 mode (``create(..., quantized=True)`` — the engines' own
+    ``kv_cache_dtype`` knob, independent of weight quantization): k/v
+    are int8 with per-(layer, slot, position, head) fp32 absmax/127
+    scales — halves the decode cache read (the second-largest HBM
+    stream after the weights). The dequantizing convert+mul fuses into
+    the attention matmul's operand read, like the weight-only int8
+    path; no materialized bf16 KV copy ever hits HBM. Every write site
+    (prefill scatter, chunked-prefill chunks, decode merges, spec
+    verify commits) quantizes through :func:`quantize_kv_rows`."""
     k: jax.Array
     v: jax.Array
     length: jax.Array

@@ -12,7 +12,9 @@ Endpoints:
   ends when the output ends with any entry, which is trimmed.
 - ``GET /metrics`` — the process telemetry registry in Prometheus text
   exposition format (TTFT/TPOT/queue-wait histograms, engine
-  step-phase timings, speculation gauges).
+  step-phase timings, speculation gauges, KV pool capacity/pressure —
+  ``skytpu_kv_pool_tokens{state=used|free,kv_cache_dtype=...}`` and
+  ``skytpu_kv_pool_preemptions_total``).
   ``GET /metrics?format=json`` keeps the PR-3 stable-schema JSON gauge
   block for existing scrapers (every key always present, zeros never
   omitted).
@@ -54,6 +56,7 @@ class ModelServer:
                  model_path: Optional[str] = None,
                  quantize: Optional[str] = None,
                  kv_cache: str = 'paged',
+                 kv_cache_dtype: Optional[str] = None,
                  page_size: Optional[int] = None,
                  prefill_w8a8: bool = False,
                  prefill_chunk_tokens: Optional[int] = None,
@@ -61,8 +64,12 @@ class ModelServer:
                  speculate_k: int = 0):
         self.cfg_name = cfg_name
         self.model_path = model_path  # HF checkpoint dir (real weights)
-        self.quantize = quantize      # 'int8' => int8 weights + KV cache
+        self.quantize = quantize      # 'int8' => int8 weights
         self.kv_cache = kv_cache      # 'slot' | 'paged' (prefix caching)
+        # KV storage dtype ('bf16' | 'int8'); None follows --quantize.
+        # Decoupled: int8 KV over bf16 weights halves the dominant
+        # decode HBM stream (and ~doubles pool capacity) on its own.
+        self.kv_cache_dtype = kv_cache_dtype
         self.page_size = page_size    # paged granularity (None = auto)
         self.prefill_w8a8 = prefill_w8a8  # int8 activations on prefill
         # Chunked-prefill scheduler knobs (None = engine defaults):
@@ -131,6 +138,8 @@ class ModelServer:
             extra['prefill_chunk_tokens'] = self.prefill_chunk_tokens
         if self.decode_priority_ratio is not None:
             extra['decode_priority_ratio'] = self.decode_priority_ratio
+        if self.kv_cache_dtype is not None:
+            extra['kv_cache_dtype'] = self.kv_cache_dtype
         extra['prefill_w8a8'] = self.prefill_w8a8
         extra['speculate_k'] = self.speculate_k
         if self.model_path:
@@ -357,6 +366,38 @@ class ModelServer:
           'Draft tokens accepted').set(spec.get('spec_accepted', 0))
         g('skytpu_spec_rounds_total',
           'Speculative verify rounds').set(spec.get('spec_rounds', 0))
+        # KV pool capacity/pressure (shared engine schema; zeros until
+        # the engine loads). The kv_cache_dtype label is constant for
+        # the process, so the series set is stable from first scrape.
+        pool = self._kv_pool_stats()
+        dtype = pool['kv_cache_dtype']
+        g('skytpu_kv_pool_tokens',
+          'KV cache pool tokens by state (paged: page-granular)',
+          state='used', kv_cache_dtype=dtype).set(pool['tokens_used'])
+        g('skytpu_kv_pool_tokens',
+          'KV cache pool tokens by state (paged: page-granular)',
+          state='free', kv_cache_dtype=dtype).set(pool['tokens_free'])
+        g('skytpu_kv_pool_token_capacity',
+          'Total KV pool token capacity',
+          kv_cache_dtype=dtype).set(pool['pool_token_capacity'])
+        g('skytpu_kv_pool_preemptions_total',
+          'Pool-pressure preemptions (recompute requeues)').set(
+              pool['preemptions'])
+
+    def _kv_pool_stats(self) -> Dict[str, Any]:
+        """Engine KV pool stats with a stable all-zeros fallback before
+        the engine loads (the dtype resolves from the configured flags
+        so the gauge label never flips once serving starts)."""
+        eng = self.engine
+        if eng is not None and hasattr(eng, 'kv_pool_stats'):
+            return eng.kv_pool_stats()
+        from skypilot_tpu.inference.engine import resolve_kv_cache_dtype
+        return {
+            'kv_cache_dtype': resolve_kv_cache_dtype(
+                self.kv_cache_dtype, self.quantize),
+            'pool_token_capacity': 0, 'tokens_used': 0,
+            'tokens_free': 0, 'preemptions': 0, 'kv_token_bytes': 0,
+        }
 
     def _metrics_json_payload(self) -> Dict[str, Any]:
         """The PR-3 stable-schema JSON gauge block, now sourced from
@@ -367,6 +408,7 @@ class ModelServer:
         eng = self.engine
         spec = (eng.spec_metrics() if eng is not None
                 and hasattr(eng, 'spec_metrics') else {})
+        pool = self._kv_pool_stats()
         return {
             'requests_served': int(self._m_served.value),
             'requests_aborted': int(self._m_aborted.value),
@@ -395,6 +437,13 @@ class ModelServer:
             'spec_proposed': spec.get('spec_proposed', 0),
             'spec_accepted': spec.get('spec_accepted', 0),
             'spec_rounds': spec.get('spec_rounds', 0),
+            # KV pool capacity/pressure (zeros before the engine loads;
+            # kv_cache_dtype is the configured resolution either way).
+            'kv_cache_dtype': pool['kv_cache_dtype'],
+            'kv_pool_token_capacity': pool['pool_token_capacity'],
+            'kv_pool_tokens_used': pool['tokens_used'],
+            'kv_pool_tokens_free': pool['tokens_free'],
+            'kv_pool_preemptions': pool['preemptions'],
             'scheduler': {
                 'prefill_chunk_tokens': getattr(eng, 'chunk', 0) or 0,
                 'decode_priority_ratio': getattr(
@@ -755,7 +804,15 @@ def main() -> None:
     parser.add_argument('--model-path', default=None,
                         help='HF checkpoint dir (real weights + tokenizer)')
     parser.add_argument('--quantize', default=None, choices=['int8'],
-                        help='int8 weights + KV cache (2x decode)')
+                        help='int8 weights (the KV cache follows via '
+                             '--kv-cache-dtype auto; 2x decode)')
+    parser.add_argument('--kv-cache-dtype', default=None,
+                        choices=['bf16', 'int8'],
+                        help='KV cache storage dtype; default follows '
+                             '--quantize (int8 weights => int8 KV). '
+                             'int8 halves KV HBM traffic in decode and '
+                             '~doubles paged pool token capacity, with '
+                             'dequant fused into the attention kernels')
     parser.add_argument('--kv-cache', default='paged',
                         choices=['slot', 'paged'],
                         help='paged (default) = shared page pool with '
@@ -806,6 +863,7 @@ def main() -> None:
                          model_path=args.model_path,
                          quantize=args.quantize,
                          kv_cache=args.kv_cache,
+                         kv_cache_dtype=args.kv_cache_dtype,
                          page_size=args.page_size,
                          prefill_w8a8=args.prefill_w8a8,
                          prefill_chunk_tokens=args.prefill_chunk_tokens,

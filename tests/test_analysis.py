@@ -297,6 +297,71 @@ def test_gc109_inside_jit_stays_gc201():
     assert rule_ids(src, 'skypilot_tpu/inference/x.py') == ['GC201']
 
 
+# ------------------------------------------------------------------ GC110
+def test_gc110_bare_int8_astype_in_compute_flagged():
+    src = '''
+    import jax.numpy as jnp
+    def write_kv(cache, rows):
+        return cache.at[0].set(rows.astype(jnp.int8))
+    '''
+    assert rule_ids(src, 'skypilot_tpu/inference/x.py') == ['GC110']
+    assert rule_ids(src, 'skypilot_tpu/ops/x.py') == ['GC110']
+
+
+def test_gc110_string_and_np_spellings_flagged():
+    src = '''
+    import numpy as np
+    def write_kv(rows, other):
+        a = rows.astype('int8')
+        b = other.astype(np.int8)
+        return a, b
+    '''
+    assert rule_ids(src, 'skypilot_tpu/models/x.py') == \
+        ['GC110', 'GC110']
+
+
+def test_gc110_quantize_scope_exempt():
+    # Functions named *quantize* ARE the sanctioned write helpers the
+    # rule routes everyone else to — including nested helpers.
+    src = '''
+    import jax.numpy as jnp
+    def quantize_kv_rows(rows):
+        scale = 1.0
+        return (rows / scale).astype(jnp.int8), scale
+    def _quantize_array(x):
+        def inner(y):
+            return y.astype(jnp.int8)
+        return inner(x)
+    '''
+    assert rule_ids(src, 'skypilot_tpu/models/x.py') == []
+
+
+def test_gc110_quantization_module_and_other_dtypes_exempt():
+    src = '''
+    import jax.numpy as jnp
+    def pack(x):
+        return x.astype(jnp.int8)
+    '''
+    # The quantization module is the sanctioned implementation.
+    assert rule_ids(src, 'skypilot_tpu/models/quantization.py') == []
+    # Only the int8 dtype is policed; other casts are fine anywhere.
+    src_ok = '''
+    import jax.numpy as jnp
+    def widen(x):
+        return x.astype(jnp.int32), x.astype(jnp.bfloat16)
+    '''
+    assert rule_ids(src_ok, 'skypilot_tpu/inference/x.py') == []
+
+
+def test_gc110_only_applies_to_compute_dirs():
+    src = '''
+    import numpy as np
+    def shrink(x):
+        return x.astype(np.int8)
+    '''
+    assert rule_ids(src, 'skypilot_tpu/serve/x.py') == []
+
+
 # ------------------------------------------------------------------ GC201
 def test_gc201_impure_calls_inside_jit():
     src = '''

@@ -159,3 +159,29 @@ def test_telemetry_parity_audit():
 def test_telemetry_parity_audit_paged():
     report = jaxpr_audit.audit_telemetry_parity('paged')
     assert report.ok(), report.format()
+
+
+def test_kv_int8_paged_audit():
+    """int8 KV over bf16 weights (the decoupled kv_cache_dtype path):
+    quantize-on-write in the chunked-prefill and decode scans plus the
+    fused-dequant reads add zero unsanctioned d2h transfers and zero
+    steady-state recompiles — the jit key set stays what the bf16
+    engine observes."""
+    report = jaxpr_audit.audit_engine('paged', chunked=True,
+                                      kv_cache_dtype='int8')
+    _assert_hot_loop_clean(report)
+    assert report.transfers, 'expected sanctioned pipeline readbacks'
+
+
+@pytest.mark.slow
+def test_kv_int8_slot_audit():
+    report = jaxpr_audit.audit_engine('slot', chunked=True,
+                                      kv_cache_dtype='int8')
+    _assert_hot_loop_clean(report)
+    assert any('kv_bucket' in k for k in report.static_keys)
+
+
+def test_kv_int8_presets_registered():
+    """The kv-int8 presets gate CI through the default preset list."""
+    assert 'kv-int8' in jaxpr_audit.PRESETS
+    assert 'kv-int8-slot' in jaxpr_audit.PRESETS

@@ -1,0 +1,206 @@
+"""int8 KV cache (``kv_cache_dtype``): the decoupled KV storage knob
+and its equivalence contract — greedy decode with int8 KV must match
+bf16 KV byte-for-byte on the tiny model across BOTH engines and every
+KV write path (monolithic + chunked prefill, decode appends,
+speculative masked commits, prefix-cache reuse, preemption recompute).
+Fast tier: the per-token byte-cost math every capacity surface rides,
+the knob resolution, the pool-stats schema, and one slot smoke; the
+engine matrix rides the slow tier with the other engine suites."""
+import jax
+import pytest
+
+from skypilot_tpu.inference.engine import (InferenceEngine,
+                                           kv_token_bytes,
+                                           resolve_kv_cache_dtype)
+from skypilot_tpu.inference.paged import PagedInferenceEngine
+from skypilot_tpu.models import configs, llama
+
+
+@pytest.fixture(scope='module')
+def setup():
+    cfg = configs.TINY
+    params = llama.init_params(jax.random.PRNGKey(0), cfg)
+    return cfg, params
+
+
+def _greedy(engcls, cfg, params, prompts, n_new, **kw):
+    eng = engcls(cfg, params, max_batch=4, max_seq=256,
+                 attn_impl='xla', **kw)
+    rids = [eng.add_request(list(p), max_new_tokens=n_new)
+            for p in prompts]
+    done = eng.run_to_completion(horizon=4)
+    return [done[r].output for r in rids], eng
+
+
+# ---------------------------------------------------------------------------
+# Fast tier
+# ---------------------------------------------------------------------------
+def test_resolve_kv_cache_dtype():
+    """None/'auto' follows the weight quantize mode (the historical
+    coupling); explicit values decouple in either direction."""
+    assert resolve_kv_cache_dtype(None, None) == 'bf16'
+    assert resolve_kv_cache_dtype(None, 'int8') == 'int8'
+    assert resolve_kv_cache_dtype('auto', 'int8') == 'int8'
+    assert resolve_kv_cache_dtype('auto', None) == 'bf16'
+    assert resolve_kv_cache_dtype('bf16', 'int8') == 'bf16'
+    assert resolve_kv_cache_dtype('int8', None) == 'int8'
+    with pytest.raises(ValueError):
+        resolve_kv_cache_dtype('fp8', None)
+
+
+def test_kv_token_bytes_math():
+    """The ONE per-token byte cost behind pool sizing, prefill caps,
+    preemption pressure and the telemetry gauges: int8 rows are codes
+    plus a 4-byte fp32 absmax scale. At serving head_dims (128) the
+    bf16/int8 ratio clears the 1.8x pool-capacity acceptance bar."""
+    cfg = configs.LLAMA3_8B
+    bf16 = kv_token_bytes(cfg, quantized=False)
+    i8 = kv_token_bytes(cfg, quantized=True)
+    assert bf16 == cfg.n_layers * cfg.n_kv_heads * cfg.head_dim * 2 * 2
+    assert i8 == cfg.n_layers * cfg.n_kv_heads * (cfg.head_dim + 4) * 2
+    assert bf16 / i8 >= 1.8
+    # Paged pages cost exactly page_size tokens at this rate — the pool
+    # auto-size and the capacity gauges can never drift from it.
+    assert PagedInferenceEngine._page_bytes(cfg, 128, True) == i8 * 128
+    assert PagedInferenceEngine._page_bytes(cfg, 128, False) == bf16 * 128
+
+
+def test_kv_pool_stats_schema(setup):
+    """Both engines expose the same token-denominated pool schema the
+    telemetry gauges and bench read; the paged side is page-granular
+    and counts only allocatable pages (page 0 reserved)."""
+    cfg, params = setup
+    keys = {'kv_cache_dtype', 'pool_token_capacity', 'tokens_used',
+            'tokens_free', 'preemptions', 'kv_token_bytes'}
+    slot = InferenceEngine(cfg, params, max_batch=2, max_seq=64,
+                           attn_impl='xla', kv_cache_dtype='int8')
+    s = slot.kv_pool_stats()
+    assert set(s) == keys
+    assert s['kv_cache_dtype'] == 'int8' and slot.cache.quantized
+    assert s['pool_token_capacity'] == 2 * 64
+    assert s['tokens_used'] + s['tokens_free'] == s['pool_token_capacity']
+    assert s['kv_token_bytes'] == kv_token_bytes(cfg, True)
+
+    paged = PagedInferenceEngine(cfg, params, max_batch=2, max_seq=64,
+                                 page_size=8, attn_impl='xla',
+                                 kv_cache_dtype='bf16', quantize='int8')
+    p = paged.kv_pool_stats()
+    assert set(p) == keys
+    # Decoupled: int8 weights, bf16 KV.
+    assert p['kv_cache_dtype'] == 'bf16' and not paged.cache.quantized
+    assert p['pool_token_capacity'] == (paged.alloc.n_pages - 1) * 8
+    assert p['kv_token_bytes'] == kv_token_bytes(cfg, False)
+
+
+def test_slot_int8_kv_greedy_smoke(setup):
+    """Tier-1 smoke: int8 KV greedy decode is byte-identical to bf16
+    KV on the slot engine (prefill scatter + decode appends)."""
+    cfg, params = setup
+    prompts = [[3, 1, 4, 1, 5]]
+    bf, _ = _greedy(InferenceEngine, cfg, params, prompts, 8,
+                    kv_cache_dtype='bf16')
+    i8, eng = _greedy(InferenceEngine, cfg, params, prompts, 8,
+                      kv_cache_dtype='int8')
+    assert i8 == bf
+    assert eng.cache.quantized and eng.kv_cache_dtype == 'int8'
+
+
+# ---------------------------------------------------------------------------
+# Slow tier: the int8-vs-bf16 equivalence matrix
+# ---------------------------------------------------------------------------
+PROMPTS = [[3, 1, 4, 1, 5], [2, 7, 1, 8, 2, 8, 1, 8],
+           [(i * 7 + 3) % 256 for i in range(60)]]
+REPETITIVE = [3, 1, 4, 1, 5, 9, 2, 6] * 4
+
+
+@pytest.mark.slow
+class TestKVInt8Equivalence:
+
+    def test_slot_chunked_prefill(self, setup):
+        """Chunked prefill quantizes per chunk inside the layer scan;
+        per-row absmax makes chunking invisible — byte-identical to
+        bf16 KV AND to int8 monolithic prefill."""
+        cfg, params = setup
+        bf, _ = _greedy(InferenceEngine, cfg, params, PROMPTS, 12,
+                        kv_cache_dtype='bf16', prefill_chunk_tokens=16)
+        i8, _ = _greedy(InferenceEngine, cfg, params, PROMPTS, 12,
+                        kv_cache_dtype='int8', prefill_chunk_tokens=16)
+        mono, _ = _greedy(InferenceEngine, cfg, params, PROMPTS, 12,
+                          kv_cache_dtype='int8', prefill_chunk_tokens=0)
+        assert i8 == bf
+        assert i8 == mono
+
+    def test_paged_chunked_prefill(self, setup):
+        cfg, params = setup
+        bf, _ = _greedy(PagedInferenceEngine, cfg, params, PROMPTS, 12,
+                        kv_cache_dtype='bf16', page_size=8, chunk=16)
+        i8, eng = _greedy(PagedInferenceEngine, cfg, params, PROMPTS,
+                          12, kv_cache_dtype='int8', page_size=8,
+                          chunk=16)
+        assert i8 == bf
+        assert eng.chunks_prefilled >= 4      # 60-token prompt, chunk 16
+
+    def test_speculative_commits(self, setup):
+        """speculate_k>0 with int8 KV: the masked KV commit writes
+        quantized rows and decode continues off them. Unlike bf16 KV
+        (where spec greedy is byte-identical by construction), int8 KV
+        rounds at different points in the verify forward (in-window
+        rows ride full precision) than in vanilla decode — on the tiny
+        random model's near-flat logits an occasional near-tie argmax
+        flips. The contract here is bounded divergence: a long exact
+        prefix, near-total agreement, nonzero acceptance."""
+        cfg, params = setup
+        for engcls, kw in ((InferenceEngine, {}),
+                           (PagedInferenceEngine, {'page_size': 8})):
+            want, _ = _greedy(engcls, cfg, params,
+                              [REPETITIVE, PROMPTS[2]], 16,
+                              kv_cache_dtype='int8', **kw)
+            got, eng = _greedy(engcls, cfg, params,
+                               [REPETITIVE, PROMPTS[2]], 16,
+                               kv_cache_dtype='int8', speculate_k=4,
+                               **kw)
+            for a, b in zip(want, got):
+                assert a[:10] == b[:10], engcls.__name__
+                agree = sum(x == y for x, y in zip(a, b))
+                assert agree >= int(0.85 * len(a)), (engcls.__name__,
+                                                     a, b)
+            assert eng.spec_metrics()['spec_accepted'] > 0
+
+    def test_prefix_cache_reuse(self, setup):
+        """A prefix-cache HIT reuses already-quantized pages — the
+        second request's decode reads them through the fused-dequant
+        kernel and still matches the slot engine's int8 output."""
+        cfg, params = setup
+        shared = [(i * 5 + 2) % 256 for i in range(64)]
+        p1, p2 = shared + [11, 12], shared + [13, 14, 15]
+        want, _ = _greedy(InferenceEngine, cfg, params, [p2], 8,
+                          kv_cache_dtype='int8')
+        eng = PagedInferenceEngine(cfg, params, max_batch=1,
+                                   max_seq=256, page_size=8, chunk=16,
+                                   attn_impl='xla',
+                                   kv_cache_dtype='int8')
+        r1 = eng.add_request(p1, max_new_tokens=4)
+        eng.run_to_completion(horizon=4)
+        assert eng.alloc.prefix_misses == 1
+        r2 = eng.add_request(p2, max_new_tokens=8)
+        done = eng.run_to_completion(horizon=4)
+        assert eng.alloc.prefix_hits >= 1
+        assert done[r2].output == want[0]
+
+    def test_preemption_recompute(self, setup):
+        """Pool pressure preempts + recomputes with quantized pages and
+        the preemption count surfaces through kv_pool_stats (the
+        telemetry/bench counter)."""
+        cfg, params = setup
+        want, _ = _greedy(PagedInferenceEngine, cfg, params, PROMPTS,
+                          12, kv_cache_dtype='int8', page_size=8)
+        eng = PagedInferenceEngine(cfg, params, max_batch=4,
+                                   max_seq=256, page_size=8, n_pages=12,
+                                   attn_impl='xla',
+                                   kv_cache_dtype='int8')
+        rids = [eng.add_request(list(p), max_new_tokens=12)
+                for p in PROMPTS]
+        done = eng.run_to_completion(horizon=4)
+        assert eng.preemptions >= 1
+        assert eng.kv_pool_stats()['preemptions'] == eng.preemptions
+        assert [done[r].output for r in rids] == want

@@ -296,10 +296,26 @@ def test_server_prometheus_metrics_and_debug_requests():
                        'histogram',
                        '# TYPE skytpu_requests_served_total counter',
                        '# TYPE skytpu_spec_accept_rate gauge',
-                       '# TYPE skytpu_queue_depth gauge'):
+                       '# TYPE skytpu_queue_depth gauge',
+                       '# TYPE skytpu_kv_pool_tokens gauge',
+                       '# TYPE skytpu_kv_pool_preemptions_total gauge'):
             assert needle in prom, needle
         assert 'skytpu_request_ttft_ms_bucket{le="+Inf"}' in prom
         assert 'phase="decode_enqueue"' in prom
+        # KV pool capacity/pressure gauges: both states present with
+        # the kv_cache_dtype label, capacity nonzero once the engine
+        # is up, used + free == capacity.
+        pool = {}
+        for ln in prom.splitlines():
+            if ln.startswith('skytpu_kv_pool_tokens{'):
+                assert 'kv_cache_dtype="bf16"' in ln, ln
+                pool[ln.split('state="')[1].split('"')[0]] = \
+                    float(ln.rsplit(' ', 1)[1])
+        assert set(pool) == {'used', 'free'}
+        cap_lines = [ln for ln in prom.splitlines()
+                     if ln.startswith('skytpu_kv_pool_token_capacity')]
+        cap = float(cap_lines[0].rsplit(' ', 1)[1])
+        assert cap > 0 and pool['used'] + pool['free'] == cap
         # Every sample line parses.
         for ln in prom.splitlines():
             if not ln or ln.startswith('#'):
@@ -317,9 +333,13 @@ def test_server_prometheus_metrics_and_debug_requests():
                     'ttft_ms_p90', 'ttft_window', 'tpot_ms_median',
                     'queue_wait_ms_median', 'speculate_k',
                     'spec_accept_rate', 'spec_tokens_per_step',
-                    'spec_proposed', 'spec_accepted', 'spec_rounds'):
+                    'spec_proposed', 'spec_accepted', 'spec_rounds',
+                    'kv_pool_token_capacity', 'kv_pool_tokens_used',
+                    'kv_pool_tokens_free', 'kv_pool_preemptions'):
             assert key in m, key
             assert isinstance(m[key], (int, float)), key
+        assert m['kv_cache_dtype'] == 'bf16'
+        assert m['kv_pool_token_capacity'] > 0
         assert m['scheduler']['speculate_k'] == 0
         assert m['requests_served'] >= 1
         assert m['ttft_window'] >= 1
