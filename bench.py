@@ -113,7 +113,9 @@ def main() -> None:
                             '.bench_cache', 'llama2-7b-synth')
         try:
             result['detail']['serving_http'] = _serving_http_bench(
-                ckpt, n_chips)
+                ckpt, n_chips,
+                raw_engine_tok_s=(result['detail'].get('paged') or {})
+                .get('sustained_out_tok_s_per_chip'))
         except Exception as e:  # pylint: disable=broad-except
             result['detail']['serving_http'] = {
                 'error': f'{type(e).__name__}: {e}'}
@@ -685,7 +687,8 @@ def _bench_7b_serving(chip_bw: float, n_chips: int) -> dict:
     }
 
 
-def _serving_http_bench(ckpt: str, n_chips: int) -> dict:
+def _serving_http_bench(ckpt: str, n_chips: int,
+                        raw_engine_tok_s=None) -> dict:
     """Measure the SERVING STACK over real HTTP (the anchor's numbers
     are request-level through a serving front end, not engine-level):
     stand up serve/server.py (paged engine) on the chip, drive it with
@@ -706,17 +709,20 @@ def _serving_http_bench(ckpt: str, n_chips: int) -> dict:
                       port=18282, prefill_w8a8=True)
     srv.start(block=False)
     try:
-        return _serving_http_measure(srv, n_chips, batch)
+        return _serving_http_measure(srv, n_chips, batch,
+                                     raw_engine_tok_s=raw_engine_tok_s)
     finally:
         # Always stop: a leaked server pins the 7B engine's HBM under
         # the flash/train sections that run next.
         srv.stop()
 
 
-def _serving_http_measure(srv, n_chips: int, batch: int) -> dict:
+def _serving_http_measure(srv, n_chips: int, batch: int,
+                          raw_engine_tok_s=None) -> dict:
     import json as _json
     import random
     import threading
+    import urllib.error
     import urllib.request
     if not srv._ready.wait(1800):
         raise RuntimeError('model server did not become ready')
@@ -815,6 +821,122 @@ def _serving_http_measure(srv, n_chips: int, batch: int) -> dict:
     mu = http_detail['req_s_per_chip'] * n_chips   # measured capacity
     http_detail['at_0p7_capacity'] = poisson_pass(
         batch, seed=13, rate=max(0.5, 0.7 * mu))
+
+    # Two-tier SLO workload (r06): ~30% latency-tier interactive
+    # requests (short prompt, short generation) mixed into anchor-
+    # shaped throughput work, driven PAST capacity so admission
+    # control engages. The acceptance numbers for the SLO scheduler
+    # live here: per-tier TTFT quantiles, the shed rate (overload
+    # answered with 429+Retry-After instead of silent queue growth),
+    # and the HTTP-vs-raw-engine out-tok/s/chip ratio.
+    tier_results = {'latency': [], 'throughput': []}
+    tier_shed = {'latency': 0, 'throughput': 0}
+    tier_err = {'latency': 0, 'throughput': 0}
+
+    def one_tiered(prompt, gen, tier):
+        body = _json.dumps({'prompt': prompt, 'max_new_tokens': gen,
+                            'stream': True,
+                            'slo_tier': tier}).encode()
+        req = urllib.request.Request(
+            base + '/generate', body,
+            {'Content-Type': 'application/json'})
+        t0, first, n = time.time(), None, 0
+        try:
+            with urllib.request.urlopen(req, timeout=1200) as resp:
+                for line in resp:
+                    if not line.startswith(b'data:'):
+                        continue
+                    try:
+                        ev = _json.loads(line[5:].strip())
+                    except ValueError:
+                        continue
+                    if 'token' in ev:
+                        if first is None:
+                            first = time.time()
+                        n += 1
+                    if 'error' in ev or ev.get('done'):
+                        break
+        except urllib.error.HTTPError as e:
+            with lock:
+                if e.code == 429:
+                    tier_shed[tier] += 1
+                else:
+                    tier_err[tier] += 1
+            return
+        except Exception:  # pylint: disable=broad-except
+            with lock:
+                tier_err[tier] += 1
+            return
+        with lock:
+            if n:
+                tier_results[tier].append((t0, first, time.time(), n))
+            else:
+                tier_err[tier] += 1
+
+    def two_tier_pass(n_req, seed, rate, latency_frac=0.3):
+        rng = random.Random(seed)
+        thr_wl = iter(_anchor_workload(n_req, seed=seed))
+        threads = []
+        t_start = time.time()
+        for i in range(n_req):
+            if rng.random() < latency_frac:
+                # Interactive shape: one chat turn, short answer.
+                p = [13 + (j * 11 + i) % 97 for j in
+                     range(rng.randint(24, 64))]
+                g, tier = rng.randint(16, 48), 'latency'
+            else:
+                p, g = next(thr_wl)
+                tier = 'throughput'
+            th = threading.Thread(target=one_tiered, args=(p, g, tier))
+            th.start()
+            threads.append(th)
+            time.sleep(rng.expovariate(rate))
+        for th in threads:
+            th.join()
+        wall = time.time() - t_start
+        out: dict = {'n_requests': n_req, 'rate_req_s': round(rate, 2),
+                     'wall_s': round(wall, 1)}
+        total_tokens = 0
+        for tier in ('latency', 'throughput'):
+            rs = tier_results[tier]
+            ttfts = sorted((f - t0) * 1e3 for t0, f, _, _ in rs
+                           if f is not None)
+            total_tokens += sum(n for _, _, _, n in rs)
+            n_sent = len(rs) + tier_shed[tier] + tier_err[tier]
+            out[tier] = {
+                'n_completed': len(rs),
+                'n_shed': tier_shed[tier],
+                'n_errors': tier_err[tier],
+                'shed_rate': round(tier_shed[tier] / n_sent, 3)
+                if n_sent else 0.0,
+                'ttft_ms_median': median(ttfts),
+                'ttft_ms_p90': (round(ttfts[int(len(ttfts) * 0.9)], 1)
+                                if ttfts else None),
+            }
+        out['out_tok_s_per_chip'] = round(
+            total_tokens / wall / n_chips, 1)
+        return out
+
+    # 1.5x measured capacity: overload by construction. Sheds are the
+    # designed response (bounded queues), so completed-request TTFT
+    # stays meaningful even past saturation.
+    http_detail['two_tier'] = two_tier_pass(
+        3 * batch, seed=14, rate=max(1.0, 1.5 * mu))
+    if raw_engine_tok_s:
+        http_detail['raw_engine_out_tok_s_per_chip'] = raw_engine_tok_s
+        http_detail['http_vs_engine_ratio'] = round(
+            http_detail['two_tier']['out_tok_s_per_chip']
+            / raw_engine_tok_s, 3)
+    # Scheduler's own view of the pass (shed counters, queue-wait and
+    # per-tier TTFT quantiles from the registry histograms).
+    try:
+        with urllib.request.urlopen(
+                f'{base}/metrics?format=json', timeout=10) as r:
+            http_detail['two_tier']['sched'] = _json.loads(
+                r.read())['sched']
+    except Exception as e:  # pylint: disable=broad-except
+        http_detail['two_tier']['sched'] = {
+            'error': f'{type(e).__name__}: {e}'}
 
     # Shared-prefix TTFT win: register a 384-token prefix once, then
     # compare single-request TTFTs with and without a cached prefix.

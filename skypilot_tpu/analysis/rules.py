@@ -40,6 +40,14 @@ Concurrency / control-plane hygiene (GC1xx):
   timing pair around a jitted dispatch can't masquerade as device
   time (inside jit bodies GC201 already fires; this rule covers the
   host side).
+- **GC111 sync-engine-call-in-coroutine** — synchronous engine-path
+  calls (``step``/``submit``/``add_request``/``run_to_completion``)
+  or unbounded blocking waits (argless ``.get()``/``.wait()``/
+  ``.join()`` with no timeout) inside an ``async def`` in ``serve/``.
+  One such call freezes the whole event loop — every concurrent
+  stream stalls behind one engine step. Coroutines must consume
+  through the async adapters (``Outbox.aget``) or hand blocking work
+  to a thread (``await loop.run_in_executor(...)``).
 
 TPU hot-path hygiene (GC2xx), applied to the compute layer
 (``inference/``, ``models/``, ``ops/``, ``train/``):
@@ -100,6 +108,11 @@ RULES: Dict[str, str] = {
              'quantization helpers — int8 KV/weight writes must go '
              'through quantize_kv_rows/models.quantization (codes + '
              'scales); a bare astype drops the scale',
+    'GC111': 'sync-engine-call-in-coroutine: synchronous engine call '
+             '(step/submit/add_request/...) or unbounded blocking '
+             'wait inside an async def in serve/ freezes the event '
+             'loop — use the async adapters (Outbox.aget) or '
+             'await loop.run_in_executor(...)',
     'GC201': 'impure-jit: impure or host-synchronizing call inside a '
              '@jax.jit body',
     'GC202': 'host-sync: device->host readback outside the '
@@ -151,6 +164,23 @@ _RPC_MODULES = {'core', 'execution', 'backend_utils', 'provisioner'}
 # loop must call prepare_proposals() BEFORE locking (the engine
 # revalidates and recomputes stale entries inside step()).
 _PROPOSER_HOST_FNS = {'prepare_proposals', 'ngram_propose'}
+
+# --------------------------------------------------------------------- GC111
+# Synchronous engine-path entry points banned inside serve/ coroutines:
+# each one either drives the engine (step / run_to_completion), takes
+# the scheduler/engine locks (submit / add_request / fill_engine /
+# cancel-side pops), or runs proposer CPU work — all of it blocks the
+# event loop for every concurrent stream. The directory the rule
+# applies to:
+SERVE_DIR = 'serve'
+_ENGINE_SYNC_CALLS = {'step', 'submit', 'submit_stream', 'add_request',
+                      'run_to_completion', 'fill_engine', 'pop_finished',
+                      'prepare_proposals'}
+# Argless no-timeout waits that park the event loop (Outbox.get /
+# Event.wait / Queue.get / Thread.join). With a timeout they are still
+# wrong in a coroutine, but bounded — the unbounded form is the
+# deadlock-shaped one this rule hard-fails.
+_ASYNC_BLOCKING_WAITS = {'get', 'wait', 'join'}
 
 # --------------------------------------------------------------------- GC109
 # Ad-hoc timing calls banned from inference/ hot paths: telemetry's
@@ -306,12 +336,14 @@ class _Checker(ast.NodeVisitor):
 
     def __init__(self, rel: str, lines: List[str], is_compute: bool,
                  is_inference: bool = False,
-                 is_quant_helper: bool = False):
+                 is_quant_helper: bool = False,
+                 is_serve: bool = False):
         self.rel = rel
         self.lines = lines
         self.is_compute = is_compute
         self.is_inference = is_inference
         self.is_quant_helper = is_quant_helper
+        self.is_serve = is_serve
         self.violations: List[Violation] = []
         self._scope: List[str] = []
         self._class: List[Tuple[Set[str], Set[str]]] = []  # (locks, guarded)
@@ -319,6 +351,10 @@ class _Checker(ast.NodeVisitor):
         self._db_locals: Set[str] = set()   # names bound to FileLocks
         self._jit_depth = 0
         self._in_init = 0
+        # Innermost-function asyncness (a sync def nested inside an
+        # async def runs off-loop when handed to an executor, so only
+        # the IMMEDIATE enclosing function decides GC111).
+        self._async_stack: List[bool] = []
 
     # ------------------------------------------------------------ helpers
     def _add(self, rule: str, node: ast.AST, message: str) -> None:
@@ -390,17 +426,27 @@ class _Checker(ast.NodeVisitor):
                 return True
         return False
 
-    def visit_FunctionDef(self, node):
+    def _visit_func(self, node, is_async: bool):
         jit = self._is_jit_decorated(node)
         self._jit_depth += 1 if jit else 0
         self._in_init += 1 if node.name in ('__init__', '__new__') else 0
         self._scope.append(node.name)
+        self._async_stack.append(is_async)
         self.generic_visit(node)
+        self._async_stack.pop()
         self._scope.pop()
         self._in_init -= 1 if node.name in ('__init__', '__new__') else 0
         self._jit_depth -= 1 if jit else 0
 
-    visit_AsyncFunctionDef = visit_FunctionDef
+    def visit_FunctionDef(self, node):
+        self._visit_func(node, is_async=False)
+
+    def visit_AsyncFunctionDef(self, node):
+        self._visit_func(node, is_async=True)
+
+    @property
+    def _in_async(self) -> bool:
+        return bool(self._async_stack) and self._async_stack[-1]
 
     def visit_With(self, node):
         cats = [c for c in (_lock_category(i.context_expr,
@@ -485,6 +531,8 @@ class _Checker(ast.NodeVisitor):
             # Applies inside jit bodies too — int8 KV writes live in
             # the jitted prefill/decode scans.
             self._check_int8_write(node, method)
+        if self.is_serve and self._in_async:
+            self._check_async_engine_call(node, name, method)
         if self._any_lock_held():
             self._check_blocking_under_lock(node, name, method)
         if self._jit_depth:
@@ -518,6 +566,27 @@ class _Checker(ast.NodeVisitor):
                       'silently drops the scale — write int8 KV/weights '
                       'through llama.quantize_kv_rows / '
                       'models.quantization (codes + absmax scales)')
+
+    def _check_async_engine_call(self, node: ast.Call, name: str,
+                                 method: str) -> None:
+        """GC111: a synchronous engine call or an unbounded blocking
+        wait inside an ``async def`` in ``serve/`` parks the event
+        loop — every concurrent stream stalls behind it."""
+        target = method or name.rsplit('.', 1)[-1]
+        if target in _ENGINE_SYNC_CALLS:
+            self._add('GC111', node,
+                      f'synchronous engine call {target}() inside an '
+                      'async coroutine blocks the event loop for every '
+                      'concurrent stream — await the async adapter '
+                      '(Outbox.aget) or hand it to a thread via '
+                      'await loop.run_in_executor(...)')
+        elif (target in _ASYNC_BLOCKING_WAITS and not node.args
+              and not _has_timeout(node)
+              and not name.startswith('asyncio.')):
+            self._add('GC111', node,
+                      f'unbounded .{target}() inside an async '
+                      'coroutine parks the event loop — await an '
+                      'async primitive or run the wait in an executor')
 
     def _check_adhoc_timing(self, node: ast.Call, name: str) -> None:
         if (name in _ADHOC_TIMING
@@ -655,7 +724,8 @@ def check_source(rel: str, source: str) -> List[Violation]:
     checker = _Checker(norm, source.splitlines(), is_compute,
                        is_inference,
                        is_quant_helper=norm.endswith(
-                           QUANT_HELPER_SUFFIX))
+                           QUANT_HELPER_SUFFIX),
+                       is_serve=f'/{SERVE_DIR}/' in f'/{norm}')
     checker.visit(tree)
     suppressed = _line_suppressions(source)
     out = []

@@ -571,12 +571,27 @@ def serve_logs(service_name, no_follow):
                    'verify step via prompt-lookup (n-gram) matching '
                    '(0 = off). Greedy outputs are identical to vanilla '
                    'decode; sampling keeps the output distribution.')
+@click.option('--slo-tier-default', default='latency',
+              type=click.Choice(['latency', 'throughput']),
+              help='SLO tier for requests that declare none '
+                   '(per-request: "slo_tier" body field or X-SLO-Tier '
+                   'header). latency = interactive TTFT contract; '
+                   'throughput = batch tokens/s contract.')
+@click.option('--max-queue-tokens', type=int, default=None,
+              help='Per-tier admission bound in work tokens; overflow '
+                   'is shed with HTTP 429 + Retry-After instead of '
+                   'queueing. Default: 2x KV pool token capacity.')
+@click.option('--latency-admit-frac', type=float, default=0.7,
+              help='Share of admitted work tokens reserved for the '
+                   'latency tier while both tiers are backlogged.')
 @click.option('--max-batch', type=int, default=8)
 @click.option('--max-seq', type=int, default=1024)
 @click.option('--port', type=int, default=8081)
 def model_server(model, model_path, quantize, kv_cache, kv_cache_dtype,
                  page_size, prefill_chunk_tokens, decode_priority_ratio,
-                 prefill_w8a8, speculate_k, max_batch, max_seq, port):
+                 prefill_w8a8, speculate_k, slo_tier_default,
+                 max_queue_tokens, latency_admit_frac, max_batch,
+                 max_seq, port):
     """Run the in-tree replica model server on this host (the process
     a service task's ``run`` command starts on each replica; same
     knobs as ``python -m skypilot_tpu.serve.server``)."""
@@ -592,7 +607,10 @@ def model_server(model, model_path, quantize, kv_cache, kv_cache_dtype,
                          prefill_w8a8=prefill_w8a8,
                          prefill_chunk_tokens=prefill_chunk_tokens,
                          decode_priority_ratio=decode_priority_ratio,
-                         speculate_k=speculate_k)
+                         speculate_k=speculate_k,
+                         slo_tier_default=slo_tier_default,
+                         max_queue_tokens=max_queue_tokens,
+                         latency_admit_frac=latency_admit_frac)
     click.echo(f'Model server on :{port} '
                f'(kv_cache={kv_cache}, speculate_k={speculate_k})')
     server.start(block=True)

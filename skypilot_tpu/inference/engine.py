@@ -59,6 +59,12 @@ class Request:
     # matches — non-streaming callers always see the trimmed output.
     stop: Optional[List[List[int]]] = None
     stop_hit: bool = False
+    # Admission priority hint (lower = more urgent; the serve
+    # scheduler maps SLO tiers to these). Orders queue pops — FIFO
+    # within a priority class — so an engine-internal requeue
+    # (paged preemption backoff) cannot park a latency-tier request
+    # behind newly queued throughput work.
+    priority: int = 0
     # filled by the engine
     output: List[int] = dataclasses.field(default_factory=list)
     submit_time: float = 0.0
@@ -282,10 +288,26 @@ class _EngineBase:
         return self._meta_dev
 
     def _queue_pop(self) -> Optional[Request]:
-        try:
-            return self._queue.popleft()
-        except IndexError:
+        """Next request to admit: the FIRST queue entry of the most
+        urgent (lowest) priority present — FIFO within a priority
+        class, and requeue-at-front keeps its meaning for same-priority
+        capacity backoff. O(n) scan; the serve scheduler keeps this
+        queue at most a few entries deep (it holds its own backlog)."""
+        if not self._queue:
             return None
+        best_i = 0
+        best_p = self._queue[0].priority
+        if best_p > 0:              # a lower-priority head: scan for better
+            for i, r in enumerate(self._queue):
+                if r.priority < best_p:
+                    best_i, best_p = i, r.priority
+                    if best_p <= 0:
+                        break
+        if best_i == 0:
+            return self._queue.popleft()
+        req = self._queue[best_i]
+        del self._queue[best_i]
+        return req
 
     def _requeue_front(self, reqs: List[Request]) -> None:
         """Put not-yet-admitted requests back at the FRONT, preserving
@@ -296,7 +318,8 @@ class _EngineBase:
     def add_request(self, prompt: List[int], max_new_tokens: int = 128,
                     temperature: float = 0.0, top_k: int = 0,
                     top_p: float = 1.0, eos_id: Optional[int] = None,
-                    stop: Optional[List[List[int]]] = None) -> int:
+                    stop: Optional[List[List[int]]] = None,
+                    priority: int = 0) -> int:
         if not prompt:
             raise ValueError('empty prompt')
         if not 0.0 < top_p <= 1.0:
@@ -307,7 +330,8 @@ class _EngineBase:
         req = Request(request_id=self._next_id, prompt=list(prompt),
                       max_new_tokens=max_new_tokens, temperature=temperature,
                       top_k=top_k, top_p=top_p, eos_id=eos_id,
-                      stop=stop or None, submit_time=clock.now())
+                      stop=stop or None, priority=int(priority),
+                      submit_time=clock.now())
         if self.telemetry_enabled:
             req.trace = tracing.RequestTrace(req.request_id)
             req.trace.begin('queue', prompt_tokens=len(prompt),
@@ -342,6 +366,40 @@ class _EngineBase:
     def queue_depth(self) -> int:
         """Requests waiting for a slot (the serve metrics surface)."""
         return len(self._queue)
+
+    def _slot_remaining_prefill(self, slot: int) -> int:
+        """Prompt tokens this slot still has to prefill (0 once
+        decodable). Chunked engines override with their cursor."""
+        del slot
+        return 0
+
+    def _remaining_decode(self, req: 'Request') -> int:
+        """Decode tokens this request may still emit (budget- and
+        capacity-clamped)."""
+        ctx = len(req.prompt) + len(req.output)
+        return max(0, min(req.max_new_tokens - len(req.output),
+                          self.max_seq - ctx))
+
+    def remaining_work_tokens(self) -> int:
+        """Estimated TOKENS of work ahead of a new arrival: every
+        queued request's full prefill+decode budget plus every live
+        slot's unprefilled prompt tail and remaining decode budget.
+        An upper bound (eos/stop finish early) — the serve scheduler's
+        Retry-After and the queue-depth LB policy both read it, where
+        overestimating by the early-stop margin only makes backoff
+        slightly conservative."""
+        total = 0
+        for r in self._queue:
+            # Recompute context (prompt+output) + decode remainder
+            # telescopes to prompt + max_new_tokens.
+            total += len(r.prompt) + min(r.max_new_tokens,
+                                         self.max_seq - len(r.prompt))
+        for slot, r in enumerate(self._slots):
+            if r is None:
+                continue
+            total += self._slot_remaining_prefill(slot)
+            total += self._remaining_decode(r)
+        return total
 
     # Fraction of the interleaved scheduler's token budget spent on
     # decode while prompts are mid-prefill (None = engine default).
@@ -743,6 +801,12 @@ class InferenceEngine(SpeculativeMixin, _EngineBase):
     def _free_slot(self, slot: int) -> None:
         self._prefill_off.pop(slot, None)      # cancel mid-prefill
         super()._free_slot(slot)
+
+    def _slot_remaining_prefill(self, slot: int) -> int:
+        off = self._prefill_off.get(slot)
+        if off is None:
+            return 0
+        return max(0, len(self._slots[slot].prompt) - off)
 
     def _prefill_chunk_batch(self) -> List[Tuple[int, int, bool]]:
         """One fixed-size prefill chunk across up to a compiled

@@ -1114,6 +1114,16 @@ class PagedInferenceEngine(SpeculativeMixin, _EngineBase):
             return True
         return False
 
+    def _slot_remaining_prefill(self, slot: int) -> int:
+        """Uncached prompt-tail tokens still to prefill: the context
+        minus prefix-matched pages minus the chunk cursor."""
+        off = self._prefill_off.get(slot)
+        if off is None:
+            return 0
+        req = self._slots[slot]
+        tail = len(req._ctx) - req._n_matched * self.page
+        return max(0, tail - off)
+
     def _purge_lagging(self) -> None:
         if self._lagging:
             for rid in [rid for rid, r in self._lagging.items()
@@ -1165,6 +1175,22 @@ class PagedInferenceEngine(SpeculativeMixin, _EngineBase):
         are kept, TTFT is not reset."""
         req = self._slots[slot]
         self.preemptions += 1
+        # Content-address the full pages ALREADY WRITTEN for this
+        # context before releasing them: re-admission then re-matches
+        # them (refcount-0 registered pages retire into the LRU, which
+        # allocation evicts only on demand) instead of recomputing the
+        # whole context. Besides the work saved, the resumed KV is the
+        # ORIGINAL bytes — a full recompute re-derives the generated
+        # tokens' rows through the chunk-prefill program, whose bf16
+        # rounding differs from the decode ring's by a few ULPs, enough
+        # to flip near-tie argmaxes on resume. Rows are written for
+        # ctx[:_slot_len] only (the current token's row rides the next
+        # decode call), so registration is capped there — a mid-prefill
+        # victim must not register pages it never filled.
+        written = (req.prompt + req.output)[:int(self._slot_len[slot]) + 1]
+        if self._pages[slot]:
+            self.alloc.register_prefix(written, self._pages[slot],
+                                       getattr(req, '_n_matched', 0))
         if req.trace is not None:
             # Close the in-slot spans; the re-admission re-opens
             # queue → prefill → decode, preserving the real timeline.

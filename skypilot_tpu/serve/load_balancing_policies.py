@@ -1,11 +1,20 @@
 """Load-balancing policies (reference
 ``sky/serve/load_balancing_policies.py``: ``RoundRobinPolicy`` ``:89``,
 ``LeastLoadPolicy`` ``:115``). Pure selection logic over the ready-replica
-URL list the LB syncs from the controller."""
+URL list the LB syncs from the controller — plus
+:class:`QueueDepthPolicy`, which load-ranks replicas by the work-token
+estimate their SLO scheduler publishes at ``/metrics?format=json``."""
 from __future__ import annotations
 
+import json
 import threading
-from typing import Dict, List, Optional, Set
+import urllib.request
+from typing import Dict, List, Optional, Set, Tuple
+
+from skypilot_tpu import tpu_logging
+from skypilot_tpu.telemetry import clock
+
+logger = tpu_logging.init_logger(__name__)
 
 
 class LoadBalancingPolicy:
@@ -90,9 +99,88 @@ class LeastLoadPolicy(LoadBalancingPolicy):
             self._inflight[url] = max(0, self._inflight.get(url, 0) - 1)
 
 
+class QueueDepthPolicy(LoadBalancingPolicy):
+    """Route to the replica with the least estimated work AHEAD of a
+    new request, read from the replica model server's
+    ``/metrics?format=json`` ``queue_tokens_total`` gauge (the SLO
+    scheduler's queued work tokens + the engine's in-flight prefill
+    tails and decode budgets). Token-denominated load ranking sees a
+    replica digesting three 4k-token prompts as busier than one
+    serving thirty 20-token chats — the distinction request-count
+    policies miss.
+
+    Probes run OUTSIDE the policy lock with a short timeout and are
+    cached for :attr:`PROBE_TTL_S`; between probes the score advances
+    by :attr:`EST_TOKENS_PER_REQUEST` per in-flight dispatch so a
+    burst landing within one TTL window still spreads. A replica whose
+    probe fails scores by dispatch count alone (graceful least-load
+    degradation; the LB's transparent retry covers replicas that are
+    actually dead)."""
+
+    PROBE_TTL_S = 1.0
+    PROBE_TIMEOUT_S = 0.5
+    # Work-token haircut per in-flight dispatch between probes (about
+    # one anchor-shaped request: ~220 prompt + ~190 decode tokens).
+    EST_TOKENS_PER_REQUEST = 400
+
+    def __init__(self) -> None:
+        super().__init__()
+        self._inflight: Dict[str, int] = {}
+        # url -> (monotonic expiry, queue_tokens_total or None=failed)
+        self._cache: Dict[str, Tuple[float, Optional[int]]] = {}
+
+    def _probe(self, url: str) -> Optional[int]:
+        try:
+            with urllib.request.urlopen(
+                    f'{url}/metrics?format=json',
+                    timeout=self.PROBE_TIMEOUT_S) as resp:
+                payload = json.loads(resp.read())
+            return int(payload['queue_tokens_total'])
+        except Exception as e:  # pylint: disable=broad-except
+            logger.debug(f'queue-depth probe failed for {url}: '
+                         f'{type(e).__name__}: {e}')
+            return None
+
+    def select_replica(self,
+                       exclude: Optional[Set[str]] = None
+                       ) -> Optional[str]:
+        with self._lock:
+            candidates = [u for u in self.ready_replicas
+                          if not exclude or u not in exclude]
+            if not candidates:
+                return None
+            now = clock.monotonic()
+            stale = [u for u in candidates
+                     if self._cache.get(u, (0.0, None))[0] <= now]
+        # Probes happen with the lock RELEASED: a slow replica must
+        # not serialize every concurrent select behind its timeout.
+        fresh = {u: self._probe(u) for u in stale}
+        with self._lock:
+            expiry = clock.monotonic() + self.PROBE_TTL_S
+            for u, tokens in fresh.items():
+                self._cache[u] = (expiry, tokens)
+
+            def score(u: str) -> int:
+                tokens = self._cache.get(u, (0.0, None))[1]
+                return ((tokens if tokens is not None else 0)
+                        + self.EST_TOKENS_PER_REQUEST
+                        * self._inflight.get(u, 0))
+
+            return min(candidates, key=score)
+
+    def pre_execute(self, url: str) -> None:
+        with self._lock:
+            self._inflight[url] = self._inflight.get(url, 0) + 1
+
+    def post_execute(self, url: str) -> None:
+        with self._lock:
+            self._inflight[url] = max(0, self._inflight.get(url, 0) - 1)
+
+
 POLICIES = {
     'round_robin': RoundRobinPolicy,
     'least_load': LeastLoadPolicy,
+    'queue_depth': QueueDepthPolicy,
 }
 
 

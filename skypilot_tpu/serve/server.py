@@ -6,18 +6,22 @@ Endpoints:
 - ``GET /readiness`` — 200 once the engine has compiled its first step
   (the serve readiness-probe target).
 - ``POST /generate`` — ``{"prompt": [ids...], "max_new_tokens": N,
-  "temperature": t, "top_k": k, "top_p": p, "stop": [...]}`` →
+  "temperature": t, "top_k": k, "top_p": p, "stop": [...],
+  "slo_tier": "latency"|"throughput"}`` →
   ``{"tokens": [...], "ttft_ms": ...}``. ``stop`` entries are strings
   (tokenized with the model tokenizer) or token-id lists; generation
   ends when the output ends with any entry, which is trimmed.
 - ``GET /metrics`` — the process telemetry registry in Prometheus text
-  exposition format (TTFT/TPOT/queue-wait histograms, engine
-  step-phase timings, speculation gauges, KV pool capacity/pressure —
-  ``skytpu_kv_pool_tokens{state=used|free,kv_cache_dtype=...}`` and
-  ``skytpu_kv_pool_preemptions_total``).
+  exposition format (TTFT/TPOT/queue-wait histograms — aggregate AND
+  per SLO tier, engine step-phase timings, speculation gauges,
+  scheduler queue/shed series —
+  ``skytpu_sched_queue_tokens{tier=...}``,
+  ``skytpu_sched_shed_total{tier,reason}`` — and KV pool
+  capacity/pressure).
   ``GET /metrics?format=json`` keeps the PR-3 stable-schema JSON gauge
   block for existing scrapers (every key always present, zeros never
-  omitted).
+  omitted; the scheduler adds a ``sched.tiers`` block with the same
+  guarantee).
 - ``GET /debug/requests`` — the bounded ring of completed request
   timelines (queue → prefill chunks → decode → spec rounds), newest
   first; ``?limit=N`` caps the count.
@@ -27,10 +31,18 @@ Every number comes from the single telemetry registry
 dicts; the rolling TTFT/TPOT/queue-wait median/p90 ride the registry
 histograms' bounded windows (ONE windowed-quantile implementation).
 
-One background thread drives ``engine.step()`` continuously (the engine
-core is synchronous); HTTP handler threads enqueue requests and wait on
-per-request events. Run on every replica slice via the service task's
-``run`` command:  ``python -m skypilot_tpu.serve.server --model llama3-1b``.
+Request flow (round 6): handler threads submit into the
+:class:`skypilot_tpu.serve.scheduler.RequestScheduler` — the SLO-aware
+admission core that owns per-tier bounded queues, the priority +
+shortest-remaining-work admission order, load shedding (HTTP 429 with
+a telemetry-derived ``Retry-After`` instead of silent queue growth)
+and the per-request outboxes handlers stream from. One background
+thread drives ``engine.step()`` continuously (the engine core is
+synchronous); each iteration it tops the engine up from the scheduler
+and routes the step's token events to the outboxes — the step never
+blocks on a slow client. Run on every replica slice via the service
+task's ``run`` command:
+``python -m skypilot_tpu.serve.server --model llama3-1b``.
 """
 from __future__ import annotations
 
@@ -44,6 +56,7 @@ from typing import Any, Dict, Optional
 
 from skypilot_tpu import telemetry
 from skypilot_tpu import tpu_logging
+from skypilot_tpu.serve import scheduler as scheduler_lib
 from skypilot_tpu.telemetry import tracing
 
 logger = tpu_logging.init_logger(__name__)
@@ -61,7 +74,10 @@ class ModelServer:
                  prefill_w8a8: bool = False,
                  prefill_chunk_tokens: Optional[int] = None,
                  decode_priority_ratio: Optional[float] = None,
-                 speculate_k: int = 0):
+                 speculate_k: int = 0,
+                 slo_tier_default: str = 'latency',
+                 max_queue_tokens: Optional[int] = None,
+                 latency_admit_frac: float = 0.7):
         self.cfg_name = cfg_name
         self.model_path = model_path  # HF checkpoint dir (real weights)
         self.quantize = quantize      # 'int8' => int8 weights
@@ -90,10 +106,16 @@ class ModelServer:
         self._ready = threading.Event()
         self._work = threading.Event()
         self._lock = threading.Lock()  # engine mutation
-        self._finished_events: Dict[int, threading.Event] = {}
-        # Streaming requests: per-request token queues fed by the engine
-        # loop; (token, finished) tuples, (None, True) on engine death.
-        self._stream_queues: Dict[int, 'queue.Queue'] = {}
+        # The SLO-aware admission/scheduling core: per-tier bounded
+        # queues, priority + shortest-remaining-work admission, load
+        # shedding (429 + Retry-After), per-request outbox streaming.
+        # Constructed UP FRONT so its /metrics schema is stable from
+        # the first scrape; the engine binds once loaded.
+        self.sched = scheduler_lib.RequestScheduler(
+            self._lock, default_tier=slo_tier_default,
+            max_queue_tokens=max_queue_tokens,
+            latency_admit_frac=latency_admit_frac,
+            wake=self._work.set)
         # Telemetry: every counter/gauge/histogram lives in the process
         # registry (rendered at /metrics in Prometheus format and as
         # the stable-schema JSON at /metrics?format=json). The request
@@ -161,6 +183,7 @@ class ModelServer:
         engine.add_request([1, 2, 3], max_new_tokens=2)
         engine.run_to_completion(horizon=4)
         self.engine = engine
+        self.sched.bind_engine(engine)
         self._ready.set()
         logger.info(f'Engine ready: model={self.cfg_name} '
                     f'max_batch={self.max_batch} max_seq={self.max_seq}')
@@ -192,8 +215,14 @@ class ModelServer:
                     # revalidated and recomputed inside step().
                     self.engine.prepare_proposals()
                 with self._lock:
-                    has_work = self.engine.has_work()
-                    if has_work:
+                    # Top the engine up from the scheduler's tier
+                    # queues (priority + SRW order, tier budget
+                    # split), then step. The scheduler holds the
+                    # backlog; the engine queue stays empty, so
+                    # admission ORDER is decided here every step, not
+                    # at submit time.
+                    self.sched.fill_engine(self.engine)
+                    if self.engine.has_work():
                         # Adaptive fused horizon: long fused calls
                         # maximize throughput at saturation (dispatch
                         # is pipelined away, but per-call host work
@@ -203,73 +232,66 @@ class ModelServer:
                         h = 32 if self.engine.num_active >= sat else 8
                         events = self.engine.step(horizon=h)
                     else:
-                        self._work.clear()
                         events = []
-                for rid, token, finished in events:
-                    sq = self._stream_queues.get(rid)
-                    if sq is not None:
-                        sq.put((token, finished))
-                    if finished and rid in self._finished_events:
-                        self._finished_events[rid].set()
+                        if not self.sched.backlog:
+                            self._work.clear()
+                            if self.sched.backlog:
+                                # A submit raced the clear (its wake
+                                # landed between the check and clear):
+                                # re-arm or the request strands until
+                                # the next arrival.
+                                self._work.set()
+                # Outbox routing runs OUTSIDE the lock: puts are
+                # lock-free and a slow SSE consumer can never hold the
+                # engine step hostage.
+                self.sched.on_events(self.engine, events)
             except Exception as e:  # pylint: disable=broad-except
                 self._fatal(e)
                 return
         # Clean stop: wake every waiter the way _fatal does — an
-        # in-flight handler blocked on its finished event (or a stream
-        # queue) would otherwise hang its client forever. The error
-        # sentinel must be set BEFORE waking (exactly like _fatal):
-        # a woken submit() that passes the error check would call
-        # pop_finished on a never-finished request and crash on None.
+        # in-flight handler blocked on its outbox would otherwise hang
+        # its client forever. The error sentinel is set BEFORE waking
+        # (exactly like _fatal) so woken handlers report the stop.
         if self._error is None:
             self._error = 'server stopped'
-        with self._lock:
-            for ev in self._finished_events.values():
-                ev.set()
-            for sq in self._stream_queues.values():
-                sq.put((None, True))
+        self.sched.fail_all(self._error)
 
     def _fatal(self, e: Exception) -> None:
         """Engine died: drop readiness (the serve probe then pulls this
-        replica out of rotation) and wake every waiting request so handler
-        threads return errors instead of blocking forever."""
+        replica out of rotation) and fail every queued and in-flight
+        request so handler threads return errors instead of blocking
+        forever."""
         logger.exception(f'Engine loop died: {type(e).__name__}: {e}')
         self._error = f'{type(e).__name__}: {e}'
         self._ready.clear()
-        with self._lock:
-            for ev in self._finished_events.values():
-                ev.set()
-            for sq in self._stream_queues.values():
-                sq.put((None, True))
+        self.sched.fail_all(self._error)
 
     def submit(self, prompt, max_new_tokens: int, temperature: float,
                top_k: int, eos_id: Optional[int], top_p: float = 1.0,
-               stop=None) -> Dict[str, Any]:
+               stop=None, tier: Optional[str] = None) -> Dict[str, Any]:
+        """Blocking submit (non-streaming handlers): admission-control
+        through the scheduler, then drain the outbox to completion.
+        Raises ``scheduler.ShedError`` (→ HTTP 429) when the tier's
+        queue bound would be exceeded."""
         if self._error is not None:
             raise RuntimeError(f'engine failed: {self._error}')
-        done = threading.Event()
-        with self._lock:
-            rid = self.engine.add_request(
-                prompt, max_new_tokens=max_new_tokens,
-                temperature=temperature, top_k=top_k, top_p=top_p,
-                eos_id=eos_id, stop=stop)
-            self._finished_events[rid] = done
-            # _fatal wakes events under this same lock; if the engine died
-            # between the check above and this registration, the event
-            # would never be set — re-check while still holding the lock.
-            if self._error is not None:
-                done.set()
-        self._work.set()
-        done.wait()
-        if self._error is not None:   # woken by _fatal, not completion
-            raise RuntimeError(f'engine failed: {self._error}')
-        with self._lock:
-            req = self.engine.pop_finished(rid)
-            del self._finished_events[rid]
+        sr = self.sched.submit(
+            prompt, max_new_tokens=max_new_tokens, tier=tier,
+            temperature=temperature, top_k=top_k, top_p=top_p,
+            eos_id=eos_id, stop=stop)
+        while True:
+            token, finished = sr.outbox.get()
+            if token is None or finished:
+                break
+        if sr.outbox.error is not None or sr.result is None:
+            raise RuntimeError(
+                f'engine failed: {sr.outbox.error or self._error}')
+        req = sr.result
         self._record_finished(req)
         hit_eos = (req.eos_id is not None and req.output
                    and req.output[-1] == req.eos_id)
         return {
-            'request_id': rid,
+            'request_id': sr.request_id,
             'tokens': req.output,
             'ttft_ms': req.ttft_ms,
             'finish_reason': ('stop' if (req.stop_hit or hit_eos)
@@ -279,38 +301,33 @@ class ModelServer:
 
     def submit_stream(self, prompt, max_new_tokens: int, temperature: float,
                       top_k: int, eos_id: Optional[int],
-                      top_p: float = 1.0, stop=None):
-        """Register a streaming request; returns (request_id, token
-        queue). The engine loop feeds (token, finished) tuples; callers
-        must call finish_stream(rid) when done."""
-        import queue as queue_mod
+                      top_p: float = 1.0, stop=None,
+                      tier: Optional[str] = None):
+        """Register a streaming request; returns its ScheduledRequest
+        (``sr.outbox`` streams ``(token, finished)`` tuples). Callers
+        must call ``finish_stream(sr)`` when done. Raises
+        ``scheduler.ShedError`` (→ HTTP 429) on admission refusal."""
         if self._error is not None:
             raise RuntimeError(f'engine failed: {self._error}')
-        sq: 'queue_mod.Queue' = queue_mod.Queue()
-        with self._lock:
-            rid = self.engine.add_request(
-                prompt, max_new_tokens=max_new_tokens,
-                temperature=temperature, top_k=top_k, top_p=top_p,
-                eos_id=eos_id, stop=stop)
-            self._stream_queues[rid] = sq
-            if self._error is not None:
-                sq.put((None, True))
-        self._work.set()
-        return rid, sq
+        return self.sched.submit(
+            prompt, max_new_tokens=max_new_tokens, tier=tier,
+            temperature=temperature, top_k=top_k, top_p=top_p,
+            eos_id=eos_id, stop=stop)
 
-    def finish_stream(self, rid: int) -> None:
+    def finish_stream(self, sr) -> None:
         """Deregister a streaming request. If the client disconnected
-        mid-stream (the request is not finished), cancel it engine-side
-        so the slot stops generating tokens nobody will read — and count
-        it as aborted, not served."""
-        with self._lock:
-            self._stream_queues.pop(rid, None)
-            req = self.engine.pop_finished(rid)
-            cancelled = req is None and self.engine.cancel(rid)
-        if req is not None:
-            self._record_finished(req)
-        elif cancelled:
+        mid-stream (the request is not finished), cancel it so the
+        slot stops generating tokens nobody will read — and count it
+        as aborted, not served."""
+        if sr.result is not None:
+            self._record_finished(sr.result)
+            return
+        if self.sched.cancel(sr):
             self._m_aborted.inc()
+        elif sr.result is not None:
+            # Finished during the cancel race: cancel() popped the
+            # finished request into sr.result instead of aborting.
+            self._record_finished(sr.result)
 
     def _record_finished(self, req) -> None:
         """Fold one finished request into the registry: served counter
@@ -343,9 +360,16 @@ class ModelServer:
         g = self._reg.gauge
         g('skytpu_active_slots',
           'Occupied decode slots').set(eng.num_active if eng else 0)
+        # Queue depth = engine queue (kept ~empty by the scheduler) +
+        # the scheduler's own tier backlog: the number operators (and
+        # the queue-depth LB policy) actually care about.
         g('skytpu_queue_depth',
           'Requests waiting for a slot').set(
-              eng.queue_depth if eng else 0)
+              (eng.queue_depth if eng else 0) + self.sched.backlog)
+        g('skytpu_sched_engine_work_tokens',
+          'Estimated work tokens ahead in the engine '
+          '(prefill tails + decode budgets)').set(
+              eng.remaining_work_tokens() if eng else 0)
         g('skytpu_prefill_inflight',
           'Slots still streaming prompt chunks in').set(
               len(getattr(eng, '_prefill_off', ())) if eng else 0)
@@ -409,11 +433,20 @@ class ModelServer:
         spec = (eng.spec_metrics() if eng is not None
                 and hasattr(eng, 'spec_metrics') else {})
         pool = self._kv_pool_stats()
+        sched_stats = self.sched.json_stats()
         return {
             'requests_served': int(self._m_served.value),
             'requests_aborted': int(self._m_aborted.value),
             'active_slots': eng.num_active if eng else 0,
-            'queue_depth': eng.queue_depth if eng else 0,
+            'queue_depth': ((eng.queue_depth if eng else 0)
+                            + self.sched.backlog),
+            # Estimated work tokens ahead (engine prefill tails +
+            # decode budgets + scheduler backlog) — what the
+            # queue-depth LB policy load-ranks replicas by.
+            'queue_tokens_total': (
+                (eng.remaining_work_tokens() if eng else 0)
+                + sum(t['queue_tokens']
+                      for t in sched_stats['tiers'].values())),
             # Slots still streaming prompt chunks in — decodable
             # occupancy = active - this.
             'prefill_inflight': (len(getattr(
@@ -450,6 +483,9 @@ class ModelServer:
                     eng, 'decode_priority_ratio', 0) or 0,
                 'speculate_k': spec.get('speculate_k', 0),
             },
+            # SLO scheduler block (stable schema: every tier and every
+            # key present from the first scrape, zeros when idle).
+            'sched': sched_stats,
         }
 
     # --------------------------------------------------------------- HTTP
@@ -465,13 +501,39 @@ class ModelServer:
             def log_message(self, *args):
                 del args
 
-            def _json(self, code: int, payload: Dict[str, Any]) -> None:
+            def _json(self, code: int, payload: Dict[str, Any],
+                      extra_headers: Optional[Dict[str, str]] = None
+                      ) -> None:
                 body = json.dumps(payload).encode()
                 self.send_response(code)
                 self.send_header('Content-Type', 'application/json')
                 self.send_header('Content-Length', str(len(body)))
+                for k, v in (extra_headers or {}).items():
+                    self.send_header(k, v)
                 self.end_headers()
                 self.wfile.write(body)
+
+            def _shed(self, e: 'scheduler_lib.ShedError') -> None:
+                """HTTP 429 for an admission refusal: Retry-After from
+                live queue telemetry (the 429 contract — clients back
+                off for a meaningful interval instead of hammering a
+                saturated replica)."""
+                self._json(429, {'error': {
+                    'message': str(e),
+                    'type': 'overloaded',
+                    'tier': e.tier,
+                    'reason': e.reason,
+                    'retry_after_s': e.retry_after_s,
+                }}, extra_headers={'Retry-After': str(e.retry_after_s)})
+
+            def _slo_tier(self, payload) -> Optional[str]:
+                """Per-request SLO tier: JSON field (``slo_tier``) wins
+                over the ``X-SLO-Tier`` header; None -> server
+                default. Unknown values 400 via resolve_tier."""
+                tier = payload.get('slo_tier')
+                if tier is None:
+                    tier = self.headers.get('X-SLO-Tier')
+                return server.sched.resolve_tier(tier)
 
             def do_GET(self):  # noqa: N802
                 parsed = urllib.parse.urlparse(self.path)
@@ -519,9 +581,12 @@ class ModelServer:
                 """Server-sent events: one ``data:`` line per token as
                 the engine emits it, a final ``done`` event with the
                 full sequence. Token streaming end to end — the LB
-                passes text/event-stream responses through unbuffered."""
+                passes text/event-stream responses through unbuffered.
+                Tokens arrive through the request's scheduler outbox,
+                fed fire-and-forget off the engine loop: a slow reader
+                here never stalls the step."""
                 tok = server.tokenizer
-                rid, sq = server.submit_stream(prompt, **kwargs)
+                sr = server.submit_stream(prompt, **kwargs)
                 tokens = []
                 # Everything after registration lives under the finally:
                 # even a client that drops before the headers flush must
@@ -533,17 +598,17 @@ class ModelServer:
                     self.send_header('Cache-Control', 'no-cache')
                     self.send_header('Connection', 'close')
                     self.end_headers()
-                    self._stream_loop(rid, sq, tokens, is_text, tok)
+                    self._stream_loop(sr, tokens, is_text, tok)
                 except (BrokenPipeError, ConnectionResetError):
                     pass    # client vanished; finish_stream cancels
                 finally:
-                    server.finish_stream(rid)
+                    server.finish_stream(sr)
                     self.close_connection = True
 
-            def _stream_loop(self, rid, sq, tokens, is_text, tok) -> None:
+            def _stream_loop(self, sr, tokens, is_text, tok) -> None:
                 while True:
-                    token, finished = sq.get(timeout=300)
-                    if token is None:       # engine died
+                    token, finished = sr.outbox.get(timeout=300)
+                    if token is None:       # engine died / shed
                         self.wfile.write(
                             b'data: {"error": "engine failed"}\n\n')
                         break
@@ -555,7 +620,8 @@ class ModelServer:
                         f'data: {json.dumps(event)}\n\n'.encode())
                     self.wfile.flush()
                     if finished:
-                        done = {'done': True, 'request_id': rid,
+                        done = {'done': True,
+                                'request_id': sr.request_id,
                                 'tokens': tokens}
                         if is_text:
                             done['text'] = tok.decode(tokens)
@@ -612,6 +678,7 @@ class ModelServer:
                 prompt_ids = (tok.encode(text) if isinstance(text, str)
                               else [int(t) for t in text])
                 kwargs = self._parse_sampling(payload, tok)
+                kwargs['tier'] = self._slo_tier(payload)
                 if payload.get('stream'):
                     self._openai_stream(prompt_ids, payload, chat,
                                         kwargs)
@@ -648,12 +715,13 @@ class ModelServer:
                                kwargs) -> None:
                 import time as time_mod
                 tok = server.tokenizer
-                rid, sq = server.submit_stream(prompt_ids, **kwargs)
+                sr = server.submit_stream(prompt_ids, **kwargs)
                 created = int(time_mod.time())
                 obj = ('chat.completion.chunk' if chat
                        else 'text_completion')
                 def chunk_of(choice):
-                    return {'id': f'cmpl-{rid}', 'object': obj,
+                    return {'id': f'cmpl-{sr.request_id}',
+                            'object': obj,
                             'created': created,
                             'model': server.cfg_name,
                             'choices': [choice]}
@@ -674,7 +742,7 @@ class ModelServer:
                              'delta': {'role': 'assistant'},
                              'finish_reason': None})))
                     while True:
-                        token, finished = sq.get(timeout=300)
+                        token, finished = sr.outbox.get(timeout=300)
                         if token is None:
                             # Engine died mid-stream: an explicit error
                             # event (and NO [DONE]) so clients can tell
@@ -695,8 +763,9 @@ class ModelServer:
                             # Terminal chunk: empty delta/text with the
                             # real finish_reason, then [DONE] — the
                             # OpenAI truncation-detection contract.
-                            with server._lock:
-                                req = server.engine.get_finished(rid)
+                            # sr.result is populated BEFORE the
+                            # finished token lands in the outbox.
+                            req = sr.result
                             hit_eos = (req is not None
                                        and req.eos_id is not None
                                        and req.output
@@ -714,7 +783,7 @@ class ModelServer:
                 except (BrokenPipeError, ConnectionResetError):
                     pass
                 finally:
-                    server.finish_stream(rid)
+                    server.finish_stream(sr)
                     self.close_connection = True
 
             def do_POST(self):  # noqa: N802
@@ -738,6 +807,10 @@ class ModelServer:
                         self._json(400, {'error': {
                             'message': f'{type(e).__name__}: {e}',
                             'type': 'invalid_request_error'}})
+                    except scheduler_lib.ShedError as e:
+                        # Before RuntimeError: ShedError subclasses it,
+                        # and a shed is a 429 contract, not a 500.
+                        self._shed(e)
                     except RuntimeError as e:
                         self._json(500, {'error': {'message': str(e)}})
                     return
@@ -750,6 +823,7 @@ class ModelServer:
                     if is_text:
                         prompt = tok.encode(prompt)
                     kwargs = self._parse_sampling(payload, tok)
+                    kwargs['tier'] = self._slo_tier(payload)
                     # /generate's legacy defaults: eos only applies to
                     # text prompts unless explicitly requested.
                     if 'eos_id' not in payload and not is_text:
@@ -764,6 +838,8 @@ class ModelServer:
                 except (KeyError, ValueError, TypeError,
                         json.JSONDecodeError) as e:
                     self._json(400, {'error': f'{type(e).__name__}: {e}'})
+                except scheduler_lib.ShedError as e:
+                    self._shed(e)
                 except RuntimeError as e:
                     self._json(500, {'error': str(e)})
 
@@ -850,6 +926,24 @@ def main() -> None:
                              '(2x MXU rate on the compute-bound '
                              'prefill; adds quantization noise to '
                              'prefilled KV rows — decode unaffected)')
+    parser.add_argument('--slo-tier-default', default='latency',
+                        choices=list(scheduler_lib.TIERS),
+                        help='SLO tier for requests that declare none '
+                             '(per-request override: "slo_tier" in the '
+                             'JSON body or the X-SLO-Tier header). '
+                             'latency = interactive TTFT contract, '
+                             'throughput = batch tokens/s contract')
+    parser.add_argument('--max-queue-tokens', type=int, default=None,
+                        help='per-tier admission bound in work tokens '
+                             '(prompt + decode budget); a request that '
+                             'would overflow its tier is shed with '
+                             'HTTP 429 + Retry-After instead of '
+                             'queueing. Default: 2x the KV pool token '
+                             'capacity')
+    parser.add_argument('--latency-admit-frac', type=float, default=0.7,
+                        help='share of admitted work tokens reserved '
+                             'for the latency tier while both tiers '
+                             'are backlogged (0..1, exclusive)')
     parser.add_argument('--max-batch', type=int, default=8)
     parser.add_argument('--max-seq', type=int, default=1024)
     parser.add_argument('--port', type=int,
@@ -868,7 +962,10 @@ def main() -> None:
                          prefill_w8a8=args.prefill_w8a8,
                          prefill_chunk_tokens=args.prefill_chunk_tokens,
                          decode_priority_ratio=args.decode_priority_ratio,
-                         speculate_k=args.speculate_k)
+                         speculate_k=args.speculate_k,
+                         slo_tier_default=args.slo_tier_default,
+                         max_queue_tokens=args.max_queue_tokens,
+                         latency_admit_frac=args.latency_admit_frac)
     server.start(block=True)
 
 

@@ -108,7 +108,7 @@ SERVICE_SCHEMA: Dict[str, Any] = {
         'port': {'type': 'integer', 'minimum': 1, 'maximum': 65535},
         'load_balancing_policy': {
             'type': 'string',
-            'enum': ['round_robin', 'least_load'],
+            'enum': ['round_robin', 'least_load', 'queue_depth'],
         },
         'tls': {
             'type': 'object',

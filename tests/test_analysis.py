@@ -353,6 +353,75 @@ def test_gc110_quantization_module_and_other_dtypes_exempt():
     assert rule_ids(src_ok, 'skypilot_tpu/inference/x.py') == []
 
 
+# ------------------------------------------------------------------ GC111
+def test_gc111_sync_engine_calls_in_coroutine_flagged():
+    src = '''
+    async def handler(engine, sched, prompt):
+        sr = sched.submit(prompt, max_new_tokens=4)
+        events = engine.step(horizon=8)
+        engine.add_request(prompt)
+        return sr, events
+    '''
+    assert rule_ids(src) == ['GC111', 'GC111', 'GC111']
+
+
+def test_gc111_unbounded_wait_in_coroutine_flagged():
+    src = '''
+    async def consume(outbox, done):
+        token, finished = outbox.get()
+        done.wait()
+        return token, finished
+    '''
+    vs = check(src)
+    assert [v.rule for v in vs] == ['GC111', 'GC111']
+    assert 'event loop' in vs[0].message
+
+
+def test_gc111_async_adapters_and_executor_clean():
+    # The sanctioned spellings: the async adapter, a wait handed to an
+    # executor (the callable is passed, not called), bounded waits,
+    # and asyncio's own primitives.
+    src = '''
+    import asyncio
+    async def consume(outbox, loop, done):
+        token, finished = await outbox.aget()
+        more = await loop.run_in_executor(None, outbox.get)
+        done.wait(timeout=5)
+        await asyncio.wait([])
+        return token, finished, more
+    '''
+    assert rule_ids(src) == []
+
+
+def test_gc111_sync_functions_and_other_dirs_exempt():
+    # The same calls are the NORMAL engine-loop idiom in sync code;
+    # only serve/ coroutines are policed.
+    src = '''
+    def engine_loop(engine, outbox):
+        events = engine.step(horizon=8)
+        return outbox.get()
+    '''
+    assert rule_ids(src) == []
+    src_async_elsewhere = '''
+    async def run(engine):
+        return engine.step(horizon=8)
+    '''
+    assert rule_ids(src_async_elsewhere,
+                    'skypilot_tpu/inference/x.py') == []
+
+
+def test_gc111_nested_sync_def_inside_coroutine_exempt():
+    # A sync def nested in a coroutine is executor fodder — only the
+    # IMMEDIATE enclosing function's asyncness decides.
+    src = '''
+    async def handler(engine, loop):
+        def blocking():
+            return engine.step(horizon=8)
+        return await loop.run_in_executor(None, blocking)
+    '''
+    assert rule_ids(src) == []
+
+
 def test_gc110_only_applies_to_compute_dirs():
     src = '''
     import numpy as np

@@ -344,6 +344,41 @@ def test_server_prometheus_metrics_and_debug_requests():
         assert m['requests_served'] >= 1
         assert m['ttft_window'] >= 1
 
+        # (b2) SLO-scheduler stable schema: every per-tier series is
+        # registered at construction, so both tiers (and every shed
+        # reason) render from the FIRST scrape — zeros, never omitted.
+        from skypilot_tpu.serve import scheduler as sched_lib
+        for tier in sched_lib.TIERS:
+            assert f'skytpu_sched_queue_tokens{{tier="{tier}"}}' \
+                in prom, tier
+            assert f'skytpu_sched_queue_depth{{tier="{tier}"}}' \
+                in prom, tier
+            for reason in sched_lib.SHED_REASONS:
+                assert ('skytpu_sched_shed_total{reason="%s",tier="%s"}'
+                        % (reason, tier)) in prom, (tier, reason)
+            assert (f'# TYPE skytpu_request_ttft_ms histogram' in prom
+                    and f'tier="{tier}"' in prom)
+        assert '# TYPE skytpu_sched_shed_total counter' in prom
+        assert '# TYPE skytpu_sched_queue_tokens gauge' in prom
+        # JSON: per-tier latency quantile keys always present and
+        # numeric — zeros for the tier no request used.
+        assert set(m['sched']['tiers']) == set(sched_lib.TIERS)
+        for tier, block in m['sched']['tiers'].items():
+            for key in ('queue_depth', 'queue_tokens', 'admitted',
+                        'admitted_tokens', 'admit_share', 'shed_total',
+                        'ttft_ms_median', 'ttft_ms_p90',
+                        'tpot_ms_median', 'queue_wait_ms_median',
+                        'queue_wait_ms_p90'):
+                assert key in block, (tier, key)
+                assert isinstance(block[key], (int, float)), (tier, key)
+        # The default tier served the request above; the other saw
+        # nothing and still renders a full (zeroed) block.
+        assert m['sched']['tiers']['latency']['admitted'] >= 1
+        assert m['sched']['tiers']['throughput']['admitted'] == 0
+        assert m['sched']['tiers']['throughput']['ttft_ms_median'] == 0
+        assert m['queue_tokens_total'] >= 0
+        assert m['sched']['max_queue_tokens'] > 0
+
         # (c) /debug/requests: the finished request's span timeline.
         with urllib.request.urlopen(
                 f'http://127.0.0.1:{port}/debug/requests?limit=8',
