@@ -131,6 +131,8 @@ def main() -> None:
     for key, fn in (
             ('flash_kernel',
              lambda: _flash_kernel_check(on_tpu)),
+            ('chaos',
+             lambda: _chaos_bench(n_chips)),
             ('train',
              lambda: _train_step_bench(on_tpu, n_chips,
                                        chip_peak_tflops))):
@@ -965,6 +967,214 @@ def _serving_http_measure(srv, n_chips: int, batch: int,
         http_detail['prefix_cache'] = {'error': f'{type(e).__name__}: '
                                                 f'{e}'}
     return http_detail
+
+
+def _chaos_bench(n_chips: int) -> dict:
+    """Chaos block (round 7): replay a two-tier workload through the
+    real LB against two replicas, with a deterministic mid-run replica
+    crash injected (serve/faults.py), and compare against a fault-free
+    pass. The numbers that matter: ``lost_requests`` (MUST be 0 — every
+    accepted request completes or gets a retryable error), migration
+    recovery p50/p90, and the SLO-attainment delta the fault costs.
+    Runs on the tiny config regardless of backend: it measures the
+    robustness layer (LB migration, drain, retry plumbing), not the
+    model."""
+    import json as _json
+    import random
+    import threading
+    import urllib.request
+
+    import http.server as hs
+
+    from skypilot_tpu import telemetry
+    from skypilot_tpu.serve.load_balancer import SkyServeLoadBalancer
+    from skypilot_tpu.serve.server import ModelServer
+    from skypilot_tpu.utils import common_utils
+
+    n_req, gen, rate = 16, 24, 12.0
+    ttft_slo_ms = {'latency': 2000.0, 'throughput': 10000.0}
+
+    def make_controller(urls):
+        class H(hs.BaseHTTPRequestHandler):
+            timeout = 30
+
+            def log_message(self, *a):
+                del a
+
+            def do_POST(self):  # noqa: N802
+                body = _json.dumps({'ready_replica_urls': urls,
+                                    'retry_after_s': 5}).encode()
+                self.send_response(200)
+                self.send_header('Content-Length', str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+        port = common_utils.find_free_port(18400)
+        httpd = hs.ThreadingHTTPServer(('127.0.0.1', port), H)
+        threading.Thread(target=httpd.serve_forever, daemon=True).start()
+        return httpd, f'http://127.0.0.1:{port}'
+
+    def run_pass(fault_spec):
+        pa = common_utils.find_free_port(18440)
+        pb = common_utils.find_free_port(pa + 1)
+        sa = ModelServer('tiny', max_batch=4, max_seq=128, port=pa,
+                         fault_spec=fault_spec)
+        sb = ModelServer('tiny', max_batch=4, max_seq=128, port=pb)
+        sa.start(block=False)
+        sb.start(block=False)
+        ctrl = httpd = lb = None
+        try:
+            if not (sa._ready.wait(600) and sb._ready.wait(600)):
+                raise RuntimeError('chaos replicas never became ready')
+            httpd, ctrl_url = make_controller(
+                [f'http://127.0.0.1:{pa}', f'http://127.0.0.1:{pb}'])
+            ctrl = httpd
+            lb_port = common_utils.find_free_port(18480)
+            os.environ['SKYTPU_LB_SYNC'] = '3600'
+            lb = SkyServeLoadBalancer(controller_url=ctrl_url,
+                                      port=lb_port, max_attempts=4)
+            lb.start()
+            lb._sync_once()
+            reg = telemetry.get_registry()
+            h_rec = reg.histogram('skytpu_replica_recovery_seconds')
+            rec0 = h_rec.count
+            mig0 = {o: reg.get('skytpu_requests_migrated_total',
+                               outcome=o).value
+                    for o in ('completed', 'failed')}
+            lock = threading.Lock()
+            done, retryable, lost = [], [], []
+
+            def one(prompt, g, tier):
+                body = _json.dumps({'prompt': prompt,
+                                    'max_new_tokens': g,
+                                    'stream': True,
+                                    'slo_tier': tier}).encode()
+                req = urllib.request.Request(
+                    f'http://127.0.0.1:{lb_port}/generate', body,
+                    {'Content-Type': 'application/json'})
+                t0, first, n, err = time.time(), None, 0, None
+                retry_ok = False
+                try:
+                    with urllib.request.urlopen(req,
+                                                timeout=300) as resp:
+                        for line in resp:
+                            if not line.startswith(b'data:'):
+                                continue
+                            try:
+                                ev = _json.loads(line[5:].strip())
+                            except ValueError:
+                                continue
+                            if 'token' in ev:
+                                if first is None:
+                                    first = time.time()
+                                n += 1
+                            if 'error' in ev:
+                                err = str(ev['error'])
+                                retry_ok = bool(ev.get('retryable'))
+                                break
+                            if ev.get('done'):
+                                break
+                except urllib.error.HTTPError as e:
+                    err = f'HTTP {e.code}'
+                    retry_ok = (e.code in (429, 503)
+                                and 'Retry-After' in e.headers)
+                except Exception as e:  # pylint: disable=broad-except
+                    err = f'{type(e).__name__}: {e}'
+                with lock:
+                    if err is None and n == g:
+                        done.append((tier, t0, first))
+                    elif err is not None and retry_ok:
+                        retryable.append((tier, err))
+                    else:
+                        lost.append((tier, err or
+                                     f'short stream ({n}/{g})'))
+
+            rng = random.Random(7)
+            threads = []
+            for i in range(n_req):
+                tier = 'latency' if rng.random() < 0.3 else 'throughput'
+                prompt = [11 + (i * 13 + j) % 89
+                          for j in range(8 if tier == 'latency' else 24)]
+                th = threading.Thread(target=one,
+                                      args=(prompt, gen, tier))
+                th.start()
+                threads.append(th)
+                time.sleep(rng.expovariate(rate))
+            for th in threads:
+                th.join(timeout=300)
+            rec_window = h_rec.snapshot()['window']
+            new_rec = sorted(rec_window[len(rec_window)
+                                        - (h_rec.count - rec0):]) \
+                if h_rec.count > rec0 else []
+            attain = {}
+            for tier in ('latency', 'throughput'):
+                ttfts = [(f - t0) * 1e3 for t, t0, f in done
+                         if t == tier and f is not None]
+                sent = [1 for t, *_ in done if t == tier] + \
+                    [1 for t, _ in retryable + lost if t == tier]
+                ok = sum(1 for ms in ttfts
+                         if ms <= ttft_slo_ms[tier])
+                attain[tier] = {
+                    'n_sent': len(sent),
+                    'n_completed': len(ttfts),
+                    'ttft_ms_median': (round(sorted(ttfts)[
+                        len(ttfts) // 2], 1) if ttfts else None),
+                    'slo_attainment': (round(ok / len(sent), 3)
+                                       if sent else None),
+                }
+            return {
+                'n_requests': n_req,
+                'n_completed': len(done),
+                'n_retryable_errors': len(retryable),
+                'lost_requests': len(lost),
+                'lost_detail': lost[:4],
+                'migrated_completed': int(
+                    reg.get('skytpu_requests_migrated_total',
+                            outcome='completed').value
+                    - mig0['completed']),
+                'migrated_failed': int(
+                    reg.get('skytpu_requests_migrated_total',
+                            outcome='failed').value - mig0['failed']),
+                'recovery_s_p50': (round(new_rec[len(new_rec) // 2], 3)
+                                   if new_rec else None),
+                'recovery_s_p90': (round(new_rec[int(len(new_rec)
+                                                     * 0.9)], 3)
+                                   if new_rec else None),
+                'tiers': attain,
+                'replica_a_died': sa._error is not None,
+            }
+        finally:
+            if lb is not None:
+                lb.stop()
+            if ctrl is not None:
+                ctrl.shutdown()
+            sa.stop()
+            sb.stop()
+
+    # Fault-free reference pass, then the same workload with replica A
+    # crash-injected mid-run. Engine-loop iterations are COARSE (each
+    # runs a fused 32-step decode horizon over the whole batch), so a
+    # small `at` lands mid-workload with streams in flight.
+    clean = run_pass(None)
+    faulted = run_pass({'seed': 0, 'rules': [
+        {'kind': 'replica_crash', 'site': 'engine_step', 'at': 3}]})
+    delta = {}
+    for tier in ('latency', 'throughput'):
+        a = (clean['tiers'][tier]['slo_attainment'] or 0)
+        b = (faulted['tiers'][tier]['slo_attainment'] or 0)
+        delta[tier] = round(b - a, 3)
+    return {
+        'workload': {'n_requests': n_req, 'gen_tokens': gen,
+                     'rate_req_s': rate,
+                     'ttft_slo_ms': ttft_slo_ms,
+                     'model': 'tiny', 'n_chips': n_chips},
+        'fault_free': clean,
+        'injected_preemption': faulted,
+        'slo_attainment_delta': delta,
+        'zero_lost_contract_held':
+            faulted['lost_requests'] == 0
+            and clean['lost_requests'] == 0,
+    }
 
 
 def _weights_only_step_ms(params, cfg, batch: int, horizon: int) -> float:

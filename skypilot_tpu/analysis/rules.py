@@ -48,6 +48,16 @@ Concurrency / control-plane hygiene (GC1xx):
   stream stalls behind one engine step. Coroutines must consume
   through the async adapters (``Outbox.aget``) or hand blocking work
   to a thread (``await loop.run_in_executor(...)``).
+- **GC112 fixed-sleep-retry** — ``time.sleep`` with a loop-invariant
+  delay inside a ``while``/``for`` loop in ``serve/`` or ``jobs/``.
+  A fleet of controllers/retriers sleeping the same fixed interval
+  produces synchronized retry storms (every replica relaunches
+  against the same exhausted quota at the same instant) and
+  lockstep DB/RPC polling. Retry/poll loops must back off (reassign
+  the delay inside the loop), jitter (draw from ``random``), or wait
+  on an ``Event`` with a timeout. The delay counts as dynamic when
+  its expression contains a ``random``-module/RNG call or any name
+  reassigned within the loop.
 
 TPU hot-path hygiene (GC2xx), applied to the compute layer
 (``inference/``, ``models/``, ``ops/``, ``train/``):
@@ -113,6 +123,11 @@ RULES: Dict[str, str] = {
              'wait inside an async def in serve/ freezes the event '
              'loop — use the async adapters (Outbox.aget) or '
              'await loop.run_in_executor(...)',
+    'GC112': 'fixed-sleep-retry: time.sleep with a loop-invariant '
+             'delay inside a retry/poll loop in serve/ or jobs/ — '
+             'add exponential backoff and/or jitter, or wait on an '
+             'Event with a timeout (fixed sleeps synchronize retry '
+             'storms across the fleet)',
     'GC201': 'impure-jit: impure or host-synchronizing call inside a '
              '@jax.jit body',
     'GC202': 'host-sync: device->host readback outside the '
@@ -181,6 +196,18 @@ _ENGINE_SYNC_CALLS = {'step', 'submit', 'submit_stream', 'add_request',
 # wrong in a coroutine, but bounded — the unbounded form is the
 # deadlock-shaped one this rule hard-fails.
 _ASYNC_BLOCKING_WAITS = {'get', 'wait', 'join'}
+
+# --------------------------------------------------------------------- GC112
+# Directories whose retry/poll loops must back off or jitter: the
+# serve control plane (replica relaunch, drain/DB polls) and the jobs
+# layer (status polls, recovery relaunches) both run MANY concurrent
+# loops against shared, failure-correlated resources.
+RETRYLOOP_DIRS = ('serve', 'jobs')
+# RNG method spellings whose presence in a sleep delay expression
+# marks it as jittered (module `random`, a Random instance, numpy).
+_JITTER_METHODS = {'random', 'uniform', 'expovariate', 'gauss',
+                   'betavariate', 'triangular', 'randint', 'randrange',
+                   'choice', 'rand', 'random_sample'}
 
 # --------------------------------------------------------------------- GC109
 # Ad-hoc timing calls banned from inference/ hot paths: telemetry's
@@ -337,13 +364,16 @@ class _Checker(ast.NodeVisitor):
     def __init__(self, rel: str, lines: List[str], is_compute: bool,
                  is_inference: bool = False,
                  is_quant_helper: bool = False,
-                 is_serve: bool = False):
+                 is_serve: bool = False,
+                 is_retryloop_dir: bool = False):
         self.rel = rel
         self.lines = lines
         self.is_compute = is_compute
         self.is_inference = is_inference
         self.is_quant_helper = is_quant_helper
         self.is_serve = is_serve
+        self.is_retryloop_dir = is_retryloop_dir
+        self._flagged_sleeps: Set[int] = set()   # node ids (GC112 dedupe)
         self.violations: List[Violation] = []
         self._scope: List[str] = []
         self._class: List[Tuple[Set[str], Set[str]]] = []  # (locks, guarded)
@@ -458,6 +488,75 @@ class _Checker(ast.NodeVisitor):
         del self._locks[len(self._locks) - len(cats):]
 
     visit_AsyncWith = visit_With
+
+    # ------------------------------------------------------------- GC112
+    def visit_While(self, node):
+        if self.is_retryloop_dir:
+            self._check_fixed_sleep_loop(node)
+        self.generic_visit(node)
+
+    def visit_For(self, node):
+        if self.is_retryloop_dir:
+            self._check_fixed_sleep_loop(node)
+        self.generic_visit(node)
+
+    def _check_fixed_sleep_loop(self, loop) -> None:
+        """GC112: a ``time.sleep`` whose delay never changes across
+        iterations, inside a loop in serve//jobs/. The delay counts as
+        dynamic when its expression draws from an RNG (jitter) or
+        references a name reassigned inside the loop (backoff)."""
+        assigned: Set[str] = set()
+        for sub in ast.walk(loop):
+            if isinstance(sub, ast.Assign):
+                for tgt in sub.targets:
+                    for n in ast.walk(tgt):
+                        if isinstance(n, ast.Name):
+                            assigned.add(n.id)
+            elif isinstance(sub, ast.AugAssign):
+                for n in ast.walk(sub.target):
+                    if isinstance(n, ast.Name):
+                        assigned.add(n.id)
+            elif isinstance(sub, ast.For):
+                for n in ast.walk(sub.target):
+                    if isinstance(n, ast.Name):
+                        assigned.add(n.id)
+        for sub in ast.walk(loop):
+            if not isinstance(sub, ast.Call) or id(sub) in \
+                    self._flagged_sleeps:
+                continue
+            name = _dotted(sub.func)
+            if name not in ('time.sleep', 'sleep') or not sub.args:
+                continue
+            if self._sleep_delay_is_fixed(sub.args[0], assigned):
+                self._flagged_sleeps.add(id(sub))
+                self._add('GC112', sub,
+                          'fixed-delay sleep inside a retry/poll loop '
+                          'synchronizes retry storms across the fleet '
+                          '— add backoff (reassign the delay in the '
+                          'loop) and/or jitter (multiply by a random '
+                          'draw), or wait on an Event with a timeout')
+
+    @staticmethod
+    def _sleep_delay_is_fixed(arg: ast.AST, assigned: Set[str]) -> bool:
+        """Loop-invariant delay heuristic: fixed unless the expression
+        contains an RNG call, a name reassigned inside the loop, or an
+        attribute/subscript/call read (unknown value — conservatively
+        treated as dynamic to keep the rule low-noise)."""
+        for sub in ast.walk(arg):
+            if isinstance(sub, ast.Call):
+                cname = _dotted(sub.func) or ''
+                leaf = cname.rsplit('.', 1)[-1]
+                if (cname.split('.', 1)[0] == 'random'
+                        or leaf in _JITTER_METHODS):
+                    return False
+                # Any other call: value unknown per-iteration — assume
+                # dynamic (poll_interval()-style accessors).
+                return False
+            if isinstance(sub, ast.Name) and sub.id in assigned:
+                return False
+            if isinstance(sub, (ast.Attribute, ast.Subscript)):
+                return False
+        return True
 
     # ------------------------------------------------------------- GC101
     def _check_state_write(self, target: ast.AST, node: ast.AST) -> None:
@@ -725,7 +824,10 @@ def check_source(rel: str, source: str) -> List[Violation]:
                        is_inference,
                        is_quant_helper=norm.endswith(
                            QUANT_HELPER_SUFFIX),
-                       is_serve=f'/{SERVE_DIR}/' in f'/{norm}')
+                       is_serve=f'/{SERVE_DIR}/' in f'/{norm}',
+                       is_retryloop_dir=any(
+                           f'/{d}/' in f'/{norm}'
+                           for d in RETRYLOOP_DIRS))
     checker.visit(tree)
     suppressed = _line_suppressions(source)
     out = []

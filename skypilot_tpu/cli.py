@@ -584,14 +584,21 @@ def serve_logs(service_name, no_follow):
 @click.option('--latency-admit-frac', type=float, default=0.7,
               help='Share of admitted work tokens reserved for the '
                    'latency tier while both tiers are backlogged.')
+@click.option('--drain-deadline-s', type=float, default=30.0,
+              help='Graceful-drain deadline: POST /drain stops '
+                   'admission (retryable 503 + Retry-After) and lets '
+                   'in-flight requests finish before teardown.')
+@click.option('--fault-spec', default=None,
+              help='Deterministic fault-injection spec (JSON or '
+                   '@/path; default SKYTPU_FAULT_SPEC env var).')
 @click.option('--max-batch', type=int, default=8)
 @click.option('--max-seq', type=int, default=1024)
 @click.option('--port', type=int, default=8081)
 def model_server(model, model_path, quantize, kv_cache, kv_cache_dtype,
                  page_size, prefill_chunk_tokens, decode_priority_ratio,
                  prefill_w8a8, speculate_k, slo_tier_default,
-                 max_queue_tokens, latency_admit_frac, max_batch,
-                 max_seq, port):
+                 max_queue_tokens, latency_admit_frac, drain_deadline_s,
+                 fault_spec, max_batch, max_seq, port):
     """Run the in-tree replica model server on this host (the process
     a service task's ``run`` command starts on each replica; same
     knobs as ``python -m skypilot_tpu.serve.server``)."""
@@ -610,7 +617,9 @@ def model_server(model, model_path, quantize, kv_cache, kv_cache_dtype,
                          speculate_k=speculate_k,
                          slo_tier_default=slo_tier_default,
                          max_queue_tokens=max_queue_tokens,
-                         latency_admit_frac=latency_admit_frac)
+                         latency_admit_frac=latency_admit_frac,
+                         drain_deadline_s=drain_deadline_s,
+                         fault_spec=fault_spec)
     click.echo(f'Model server on :{port} '
                f'(kv_cache={kv_cache}, speculate_k={speculate_k})')
     server.start(block=True)

@@ -487,6 +487,38 @@ class _EngineBase:
                 return True
         return False
 
+    def export_inflight(self) -> List[Dict[str, Any]]:
+        """Resubmittable snapshot of every live request (queued AND
+        decoding), for the fault-tolerance layer: original prompt plus
+        the tokens generated so far, so a surviving replica can
+        continue from ``prompt + output`` (the prefix cache makes the
+        recompute cheap) with the remaining decode budget. Greedy
+        continuations are byte-identical to the uninterrupted run.
+        Callers serialize engine access (the serve layer's engine
+        lock), like every other host-side engine call."""
+        out: List[Dict[str, Any]] = []
+        live = list(self._queue) + [r for r in self._slots
+                                    if r is not None]
+        for req in live:
+            if req.finish_time is not None:
+                continue
+            out.append({
+                'request_id': req.request_id,
+                'prompt': list(req.prompt),
+                'output': list(req.output),
+                'max_new_tokens': req.max_new_tokens,
+                'remaining_new_tokens': max(
+                    0, req.max_new_tokens - len(req.output)),
+                'temperature': req.temperature,
+                'top_k': req.top_k,
+                'top_p': req.top_p,
+                'eos_id': req.eos_id,
+                'stop': ([list(s) for s in req.stop]
+                         if req.stop else None),
+                'priority': req.priority,
+            })
+        return out
+
     def get_finished(self, request_id: int) -> Optional[Request]:
         return self._finished.get(request_id)
 

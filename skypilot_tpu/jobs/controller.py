@@ -14,6 +14,7 @@ from __future__ import annotations
 
 import argparse
 import os
+import random
 import sys
 import time
 import traceback
@@ -166,8 +167,11 @@ class JobsController:
                                        cluster_name, agent_job_id)
                 state.set_recovered(self.job_id)
                 continue
-            # PENDING/STARTING/RUNNING: keep polling.
-            time.sleep(JOB_STATUS_CHECK_GAP_SECONDS)
+            # PENDING/STARTING/RUNNING: keep polling — jittered
+            # (graftcheck GC112) so many concurrent job controllers
+            # don't hit the agent RPC in lockstep.
+            time.sleep(JOB_STATUS_CHECK_GAP_SECONDS
+                       * (0.75 + random.random() * 0.5))
 
     def _failure_tail(self, cluster_name: str, agent_job_id: int) -> str:
         try:

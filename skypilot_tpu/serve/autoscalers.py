@@ -45,6 +45,7 @@ class ReplicaView:
     is_ready: bool
     is_spot: bool
     is_terminal: bool = False     # preempted/failed: replaced, not counted
+    is_draining: bool = False     # graceful scale-down in progress
     version: int = 1              # service version this replica runs
 
 
@@ -96,8 +97,12 @@ class Autoscaler:
     @staticmethod
     def _downscale_candidates(alive: List[ReplicaView],
                               count: int) -> List[ReplicaView]:
-        """Prefer killing not-ready replicas, then highest ids (newest)."""
-        return sorted(alive, key=lambda r: (r.is_ready, -r.replica_id))[:count]
+        """Prefer replicas already draining (the decision is in flight
+        — re-issuing it is an idempotent no-op, never a second
+        victim), then not-ready ones, then highest ids (newest)."""
+        return sorted(alive, key=lambda r: (not r.is_draining,
+                                            r.is_ready,
+                                            -r.replica_id))[:count]
 
     @classmethod
     def from_spec(cls, spec: 'SkyServiceSpec') -> 'Autoscaler':

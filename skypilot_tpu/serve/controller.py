@@ -14,7 +14,6 @@ import http.server
 import json
 import os
 import threading
-import time
 from typing import Any, Dict, List, Optional
 
 from skypilot_tpu import tpu_logging
@@ -56,6 +55,8 @@ class ServeController:
                 is_ready=(info.status == serve_state.ReplicaStatus.READY),
                 is_spot=info.is_spot,
                 is_terminal=info.status.is_terminal(),
+                is_draining=(info.status ==
+                             serve_state.ReplicaStatus.DRAINING),
                 version=info.version))
         return views
 
@@ -68,7 +69,13 @@ class ServeController:
                 self.replica_manager.scale_up(
                     use_spot=bool(d.target.get('use_spot')))
             else:
-                self.replica_manager.scale_down(d.target['replica_id'])
+                # Scale-down routes through graceful drain: the replica
+                # leaves LB rotation, finishes its in-flight requests
+                # under the drain deadline, THEN tears down — no work
+                # is killed mid-decode. drain() is idempotent across
+                # controller ticks and falls back to a direct teardown
+                # for replicas that never served.
+                self.replica_manager.drain(d.target['replica_id'])
         self._drain_old_versions()
 
     def _drain_old_versions(self) -> None:
@@ -84,11 +91,12 @@ class ServeController:
             return
         for info in infos:
             if info.version < latest and not info.status.is_terminal() \
-                    and info.status != serve_state.ReplicaStatus.\
-                    SHUTTING_DOWN:
+                    and info.status not in (
+                        serve_state.ReplicaStatus.SHUTTING_DOWN,
+                        serve_state.ReplicaStatus.DRAINING):
                 logger.info(f'Draining replica {info.replica_id} '
                             f'(v{info.version} < v{latest}).')
-                self.replica_manager.scale_down(info.replica_id)
+                self.replica_manager.drain(info.replica_id)
 
     def apply_update(self) -> None:
         """Reload spec/task from serve state after an `update` RPC bumped
@@ -176,7 +184,13 @@ class ServeController:
                     controller.autoscaler.collect_request_information(ts)
                     self._json(200, {
                         'ready_replica_urls':
-                            controller.replica_manager.ready_urls()})
+                            controller.replica_manager.ready_urls(),
+                        # Retry-After hint for the LB's own 503 while
+                        # no replica is READY, from live probe/launch
+                        # backoff state.
+                        'retry_after_s':
+                            controller.replica_manager.retry_after_hint(),
+                    })
                 elif self.path == '/controller/update':
                     try:
                         controller.apply_update()
@@ -240,5 +254,6 @@ class ServeController:
         self._done.set()
 
     def wait(self) -> None:
-        while not self._done.is_set():
-            time.sleep(0.2)
+        # Event wait, not a sleep-poll loop (graftcheck GC112): blocks
+        # until terminate() finishes the teardown.
+        self._done.wait()

@@ -1,0 +1,247 @@
+"""Deterministic, seedable fault injection for the serve stack.
+
+Replica crashes, probe timeouts, slow/partial HTTP responses,
+engine-step stalls and spot-preemption signals are real failure modes
+the serve layer must survive — and none of them used to be exercisable
+in a test. This module turns each one into a *rule* that fires at an
+exact, reproducible point (the Nth invocation of a named injection
+site, or a seeded probability per invocation), so the chaos suite and
+the bench's chaos block can replay the same failure on every run.
+
+Configuration: the ``SKYTPU_FAULT_SPEC`` environment variable holds a
+JSON spec (or ``@/path/to/spec.json``), e.g.::
+
+    {"seed": 42,
+     "rules": [
+       {"kind": "replica_crash",  "site": "engine_step", "at": 120},
+       {"kind": "probe_timeout",  "site": "probe", "every": 7},
+       {"kind": "slow_response",  "site": "proxy", "prob": 0.05,
+        "delay_s": 0.25},
+       {"kind": "partial_response", "site": "proxy_stream",
+        "at": 1, "after_events": 5},
+       {"kind": "preempt_signal", "site": "preempt", "at": 3}]}
+
+Each rule names a *kind* (what happens) and a *site* (where the hook
+lives). Sites are the points where the serve stack already touches the
+network or the hardware:
+
+- ``engine_step`` — the model server's engine loop
+  (``serve/server.py``), once per loop iteration with work. Kinds:
+  ``engine_stall`` (sleep ``delay_s`` inside the loop), ``replica_crash``
+  (raise :class:`InjectedFault` — the loop's ``_fatal`` path runs,
+  readiness drops, every in-flight request fails over).
+- ``probe`` — ``replica_managers._probe_one``. Kind ``probe_timeout``
+  makes the readiness probe report failure (after ``delay_s``).
+- ``preempt`` — ``replica_managers._check_preempted``. Kind
+  ``preempt_signal`` reports the replica's cluster as preempted.
+- ``preempt_warning`` — the probe sweep, once per swept replica. Kind
+  ``preempt_signal`` here is the *advance warning* flavor: the replica
+  is drained instead of hard-killed.
+- ``proxy`` — ``load_balancer._proxy`` before dispatch. Kinds:
+  ``slow_response`` (sleep ``delay_s``), ``partial_response`` (the
+  upstream connection "breaks" before the request is sent — exercises
+  the retry path).
+- ``proxy_stream`` — the LB's recoverable-stream forwarder, once per
+  stream. Kind ``partial_response`` breaks the upstream stream after
+  ``after_events`` token events — exercises mid-stream migration with
+  a nonzero generated prefix, deterministically.
+
+Rule matching fields (all optional, combined with OR): ``at`` (fire on
+exactly the Nth invocation of the site, 1-based), ``every`` (fire on
+every Nth invocation), ``prob`` (fire with this probability per
+invocation, drawn from the spec-seeded RNG — deterministic for a fixed
+seed and invocation order). ``count`` caps total fires per rule
+(default: unlimited; ``at`` naturally fires once).
+
+Zero overhead when disabled: components resolve their injector ONCE at
+construction (``get_injector()`` returns ``None`` when no spec is
+configured) and every hook is behind an ``if self._faults is not
+None`` — no parsing, no counters, no RNG on the hot path, and nothing
+in the compute layer (``inference/``) references this module at all,
+so the jaxpr-audit presets see byte-identical programs either way
+(``tests/test_chaos.py::test_inference_layer_never_imports_faults``
+pins that).
+
+Telemetry: every fire increments
+``skytpu_faults_injected_total{kind}``; :func:`register_metrics`
+registers the full kind set up front so the series render as zeros
+from the first scrape (the stable-schema contract).
+"""
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import random
+import threading
+from typing import Any, Dict, List, Optional
+
+from skypilot_tpu import telemetry
+from skypilot_tpu import tpu_logging
+
+logger = tpu_logging.init_logger(__name__)
+
+FAULT_SPEC_ENV = 'SKYTPU_FAULT_SPEC'
+
+# The stable label set of skytpu_faults_injected_total{kind}.
+FAULT_KINDS = ('replica_crash', 'probe_timeout', 'slow_response',
+               'partial_response', 'engine_stall', 'preempt_signal')
+
+# Injection sites (for spec validation; the hook call sites are the
+# module docstring's list).
+FAULT_SITES = ('engine_step', 'probe', 'preempt', 'preempt_warning',
+               'proxy', 'proxy_stream', 'http_response')
+
+# Outcomes of skytpu_requests_migrated_total{outcome}: a migrated
+# request either completed on a surviving replica or exhausted every
+# replica and got the retryable error.
+MIGRATION_OUTCOMES = ('completed', 'failed')
+
+
+class InjectedFault(RuntimeError):
+    """Raised by a ``replica_crash`` rule: the component's normal
+    fatal-error path runs, exactly as a real crash would drive it."""
+
+
+@dataclasses.dataclass
+class FaultRule:
+    kind: str
+    site: str
+    at: Optional[int] = None          # fire on the Nth invocation
+    every: Optional[int] = None       # fire on every Nth invocation
+    prob: float = 0.0                 # fire with seeded probability
+    count: Optional[int] = None       # max total fires (None = no cap)
+    delay_s: float = 0.25             # stall/slow-response duration
+    after_events: int = 0             # proxy_stream: break after N events
+    fired: int = 0                    # bookkeeping (not a spec field)
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> 'FaultRule':
+        kind = d.get('kind')
+        site = d.get('site')
+        if kind not in FAULT_KINDS:
+            raise ValueError(f'unknown fault kind {kind!r}; supported: '
+                             f'{FAULT_KINDS}')
+        if site not in FAULT_SITES:
+            raise ValueError(f'unknown fault site {site!r}; supported: '
+                             f'{FAULT_SITES}')
+        return cls(kind=kind, site=site,
+                   at=(int(d['at']) if d.get('at') else None),
+                   every=(int(d['every']) if d.get('every') else None),
+                   prob=float(d.get('prob', 0.0)),
+                   count=(int(d['count']) if d.get('count') else None),
+                   delay_s=float(d.get('delay_s', 0.25)),
+                   after_events=int(d.get('after_events', 0)))
+
+
+class FaultInjector:
+    """Evaluates the fault spec at each instrumented site. Thread-safe:
+    the LB's handler threads, the probe loop and the engine loop all
+    fire through one injector. Deterministic for a fixed spec: site
+    invocation counters drive ``at``/``every`` and a spec-seeded RNG
+    drives ``prob``."""
+
+    def __init__(self, spec: Dict[str, Any]):
+        self.seed = int(spec.get('seed', 0))
+        self._rng = random.Random(self.seed)
+        self._rules: List[FaultRule] = [
+            FaultRule.from_dict(r) for r in spec.get('rules', [])]
+        self._site_counts: Dict[str, int] = {}
+        self._lock = threading.Lock()
+        reg = telemetry.get_registry()
+        self._counters = {
+            kind: reg.counter(
+                'skytpu_faults_injected_total',
+                'Faults injected by the deterministic fault-injection '
+                'subsystem', kind=kind) for kind in FAULT_KINDS}
+
+    def fire(self, site: str) -> Optional[FaultRule]:
+        """Count one invocation of ``site``; return the first rule that
+        fires there (and record it in telemetry), else None."""
+        with self._lock:
+            n = self._site_counts.get(site, 0) + 1
+            self._site_counts[site] = n
+            for rule in self._rules:
+                if rule.site != site:
+                    continue
+                if rule.count is not None and rule.fired >= rule.count:
+                    continue
+                hit = ((rule.at is not None and n == rule.at)
+                       or (rule.every is not None
+                           and n % rule.every == 0)
+                       or (rule.prob > 0.0
+                           and self._rng.random() < rule.prob))
+                if not hit:
+                    continue
+                rule.fired += 1
+                self._counters[rule.kind].inc()
+                logger.warning(
+                    f'fault injected: kind={rule.kind} site={site} '
+                    f'invocation={n} (fire #{rule.fired})')
+                return rule
+        return None
+
+    def site_count(self, site: str) -> int:
+        with self._lock:
+            return self._site_counts.get(site, 0)
+
+
+def parse_spec(raw: str) -> Dict[str, Any]:
+    """Parse a fault spec: a JSON object, or ``@/path`` to a JSON
+    file."""
+    if raw.startswith('@'):
+        with open(raw[1:], encoding='utf-8') as f:
+            raw = f.read()
+    spec = json.loads(raw)
+    if not isinstance(spec, dict):
+        raise ValueError('fault spec must be a JSON object')
+    return spec
+
+
+def make_injector(spec: Optional[Any] = None) -> Optional[FaultInjector]:
+    """Build an injector from an explicit spec (dict or JSON string),
+    falling back to ``SKYTPU_FAULT_SPEC``; None when neither is set —
+    the hooks then cost one attribute check."""
+    if spec is None:
+        raw = os.environ.get(FAULT_SPEC_ENV)
+        if not raw:
+            return None
+        spec = parse_spec(raw)
+    elif isinstance(spec, str):
+        spec = parse_spec(spec)
+    return FaultInjector(spec)
+
+
+def get_injector() -> Optional[FaultInjector]:
+    """Alias of :func:`make_injector` with no explicit spec — the
+    spelling env-configured components resolve at construction."""
+    return make_injector(None)
+
+
+def register_metrics() -> None:
+    """Register the robustness series up front — zeros from the first
+    scrape whether or not any fault, drain or migration ever happens
+    (the stable-schema contract ``tests/test_telemetry.py`` pins):
+
+    - ``skytpu_faults_injected_total{kind}`` for every kind,
+    - ``skytpu_requests_migrated_total{outcome}`` for every outcome,
+    - ``skytpu_replica_drain_seconds`` (drain start -> idle),
+    - ``skytpu_replica_recovery_seconds`` (failure detected -> stream
+      resumed on a surviving replica).
+    """
+    reg = telemetry.get_registry()
+    for kind in FAULT_KINDS:
+        reg.counter('skytpu_faults_injected_total',
+                    'Faults injected by the deterministic '
+                    'fault-injection subsystem', kind=kind)
+    for outcome in MIGRATION_OUTCOMES:
+        reg.counter('skytpu_requests_migrated_total',
+                    'In-flight requests migrated off a failed replica',
+                    outcome=outcome)
+    reg.histogram('skytpu_replica_drain_seconds',
+                  'Graceful-drain duration: drain start to idle (s)',
+                  buckets=telemetry.registry.DEFAULT_SECONDS_BUCKETS)
+    reg.histogram('skytpu_replica_recovery_seconds',
+                  'Mid-stream migration: replica failure detected to '
+                  'stream resumed on a surviving replica (s)',
+                  buckets=telemetry.registry.DEFAULT_SECONDS_BUCKETS)

@@ -8,6 +8,35 @@ autoscaler's QPS signal) and receives the current ready-replica URLs;
 requests are proxied per the load-balancing policy. Reference stack is
 FastAPI+httpx; stdlib http.server + urllib here (the LB does one stream
 per request — threads suffice).
+
+Fault tolerance (round 7, ``docs/robustness.md``): the LB owns the
+**zero-lost-requests** contract — under a replica crash, preemption, or
+injected fault, every accepted request either completes or gets a clean
+retryable error with ``Retry-After``:
+
+- **In-flight recovery.** A streaming ``/generate`` request with a
+  token-id prompt is *recoverable*: the LB parses the SSE events it
+  forwards, and when the upstream replica dies mid-stream (transport
+  break or a retryable error event) it resubmits the request to a
+  surviving replica as ``original prompt + tokens generated so far``
+  (the prefix cache makes the recompute cheap, and greedy decode
+  continues byte-identically), then keeps feeding the SAME client
+  stream. The client sees one uninterrupted stream and one ``done``
+  event carrying the full merged token list.
+- **Idempotent request keys.** The LB mints an ``X-Request-ID`` for
+  recoverable requests (client-supplied keys pass through). Replicas
+  dedupe completed keys, so a replayed request returns the same answer
+  instead of executing twice — which makes mid-request failures safe
+  to retry (the *hedged retry* extension of ``_failed_before_send``:
+  un-keyed non-idempotent requests still refuse the replay).
+- **Retryable replica refusals.** A replica answering 503 (loading /
+  draining / failed) did not execute the request: the LB transparently
+  retries it on another replica, and only passes the 503 through (with
+  its ``Retry-After``) when every replica refused. Scheduler 429s pass
+  through unmodified — including their ``Retry-After``.
+- **No-replica 503.** The LB's own 503 carries a JSON error body and a
+  ``Retry-After`` derived from the controller's probe/launch backoff
+  state (shipped on every sync).
 """
 from __future__ import annotations
 
@@ -16,12 +45,14 @@ import json
 import os
 import threading
 import time
-from typing import List, Optional
+from typing import Any, Dict, List, Optional, Set
 import urllib.error
 import urllib.request
+import uuid
 
 from skypilot_tpu import telemetry
 from skypilot_tpu import tpu_logging
+from skypilot_tpu.serve import faults as faults_lib
 from skypilot_tpu.serve import load_balancing_policies as lb_policies
 
 logger = tpu_logging.init_logger(__name__)
@@ -30,13 +61,30 @@ _HOP_HEADERS = {'connection', 'keep-alive', 'transfer-encoding', 'host',
                 'content-length'}
 
 
+class _ClientGone(Exception):
+    """The DOWNSTREAM client broke mid-stream: abort forwarding (and
+    any migration) — there is nobody left to answer."""
+
+
 def _failed_before_send(e: Exception) -> bool:
     """True when the error provably happened BEFORE the request reached
-    the replica (connect refused / DNS / connect timeout) — the only
-    failures safe to retry for non-idempotent methods."""
+    the replica (connect refused / reset during connect / DNS) — the
+    only failures safe to retry for non-idempotent, un-keyed methods.
+    Requests carrying an idempotency key retry on ANY pre-response
+    failure instead (the replica-side key dedupe makes the replay
+    return one answer) — see ``_retry_safe``."""
     import socket
     reason = getattr(e, 'reason', e)
-    return isinstance(reason, (ConnectionRefusedError, socket.gaierror))
+    return isinstance(reason, (ConnectionRefusedError,
+                               ConnectionAbortedError, socket.gaierror))
+
+
+def _retry_safe(method: str, e: Exception, has_key: bool) -> bool:
+    """May this failed attempt be replayed on another replica? GETs are
+    idempotent by definition; keyed requests by construction (replica
+    dedupe); everything else only when the failure provably preceded
+    the send."""
+    return method == 'GET' or has_key or _failed_before_send(e)
 
 
 def _sync_period() -> float:
@@ -75,6 +123,26 @@ class SkyServeLoadBalancer:
         self._h_proxy = reg.histogram(
             'skytpu_lb_request_ms',
             'LB-observed request latency, non-streaming (ms)')
+        # Robustness series (stable schema: all registered up front).
+        faults_lib.register_metrics()
+        self._m_migrated = {
+            outcome: reg.counter(
+                'skytpu_requests_migrated_total',
+                'In-flight requests migrated off a failed replica',
+                outcome=outcome)
+            for outcome in faults_lib.MIGRATION_OUTCOMES}
+        self._h_recovery = reg.histogram(
+            'skytpu_replica_recovery_seconds',
+            'Mid-stream migration: replica failure detected to stream '
+            'resumed on a surviving replica (s)',
+            buckets=telemetry.registry.DEFAULT_SECONDS_BUCKETS)
+        # Fault injection (serve/faults.py): resolved once; None keeps
+        # the hooks at a single attribute check.
+        self._faults = faults_lib.get_injector()
+        # Retry-After hint for the LB's own 503 (no ready replicas),
+        # refreshed from the controller's probe/launch backoff state on
+        # every sync. Plain int write — single-writer sync loop.
+        self._retry_after_hint = 5
 
     # ------------------------------------------------------------- sync
     def _sync_once(self) -> None:
@@ -90,6 +158,9 @@ class SkyServeLoadBalancer:
                 payload = json.loads(resp.read())
             self.policy.set_ready_replicas(
                 payload.get('ready_replica_urls', []))
+            hint = payload.get('retry_after_s')
+            if hint:
+                self._retry_after_hint = max(1, int(hint))
         except Exception as e:  # pylint: disable=broad-except
             # Keep serving the last known replica set; re-queue the
             # timestamps so the QPS signal survives controller restarts —
@@ -109,6 +180,38 @@ class SkyServeLoadBalancer:
             self._sync_once()
             self._stop.wait(_sync_period())
 
+    # --------------------------------------------------------- recovery
+    @staticmethod
+    def _recoverable(method: str, path: str,
+                     data: Optional[bytes]) -> Optional[Dict[str, Any]]:
+        """The parsed payload when this request supports in-flight
+        recovery — a ``/generate`` POST with a token-id prompt (the
+        continuation must splice generated token ids onto the prompt,
+        which a text prompt cannot express). Streaming payloads also
+        migrate mid-stream; non-streaming ones get the keyed hedged
+        retry."""
+        if method != 'POST' or path != '/generate' or not data:
+            return None
+        try:
+            payload = json.loads(data)
+        except (ValueError, UnicodeDecodeError):
+            return None
+        if not isinstance(payload, dict):
+            return None
+        prompt = payload.get('prompt')
+        if (not isinstance(prompt, list) or not prompt
+                or not all(isinstance(t, int) for t in prompt)):
+            return None
+        return payload
+
+    @staticmethod
+    def _remaining_budget(payload: Dict[str, Any],
+                          tokens: List[int]) -> int:
+        """Decode tokens still owed after ``tokens`` already streamed."""
+        budget = int(payload.get('max_new_tokens',
+                                 payload.get('max_tokens', 128)))
+        return budget - len(tokens)
+
     # ------------------------------------------------------------- proxy
     def _make_handler(lb):  # noqa: N805
         class Handler(http.server.BaseHTTPRequestHandler):
@@ -123,10 +226,34 @@ class SkyServeLoadBalancer:
             def log_message(self, *args):
                 del args
 
-            def _send_json(self, code: int, payload: dict) -> None:
+            def _send_json(self, code: int, payload: dict,
+                           extra_headers: Optional[dict] = None) -> None:
                 body = json.dumps(payload).encode()
                 self.send_response(code)
                 self.send_header('Content-Type', 'application/json')
+                self.send_header('Content-Length', str(len(body)))
+                for k, v in (extra_headers or {}).items():
+                    self.send_header(k, v)
+                self.end_headers()
+                self.wfile.write(body)
+
+            def _forward_http_error(self, code: int, body: bytes,
+                                    rheaders) -> None:
+                """Pass a replica's HTTP error through — headers
+                included, so scheduler 429/503 Retry-After values reach
+                the client unmodified (a retryable code without one
+                gets the LB's backoff-derived hint)."""
+                self.send_response(code)
+                seen_retry_after = False
+                for k, v in rheaders.items():
+                    if k.lower() in _HOP_HEADERS:
+                        continue
+                    if k.lower() == 'retry-after':
+                        seen_retry_after = True
+                    self.send_header(k, v)
+                if not seen_retry_after and code in (429, 503):
+                    self.send_header('Retry-After',
+                                     str(lb._retry_after_hint))
                 self.send_header('Content-Length', str(len(body)))
                 self.end_headers()
                 self.wfile.write(body)
@@ -149,6 +276,179 @@ class SkyServeLoadBalancer:
                     self.wfile.flush()
                 self.close_connection = True
 
+            # ---------------------------------------- in-flight recovery
+            def _emit_event(self, ev: dict) -> None:
+                try:
+                    self.wfile.write(
+                        f'data: {json.dumps(ev)}\n\n'.encode())
+                    self.wfile.flush()
+                except (BrokenPipeError, ConnectionResetError) as e:
+                    raise _ClientGone(str(e)) from e
+
+            def _forward_sse(self, resp, tokens: list,
+                             break_after: Optional[int]) -> bool:
+                """Forward one upstream SSE leg, accumulating token ids
+                into ``tokens``. Returns True when the stream finished
+                cleanly (its ``done`` event was forwarded with the full
+                MERGED token list); False when the upstream broke or
+                reported a retryable error — the caller migrates.
+                Raises :class:`_ClientGone` when the downstream client
+                went away."""
+                events = 0
+                try:
+                    for raw in resp:
+                        if not raw.startswith(b'data:'):
+                            continue
+                        try:
+                            ev = json.loads(raw[5:].strip())
+                        except ValueError:
+                            continue
+                        if 'error' in ev:
+                            # Replica-side failure event (engine died /
+                            # drain deadline): migrate, don't forward.
+                            logger.warning(
+                                f'upstream stream error: {ev["error"]}')
+                            return False
+                        if ev.get('done'):
+                            done = dict(ev)
+                            done['tokens'] = list(tokens)
+                            self._emit_event(done)
+                            return True
+                        if 'token' in ev:
+                            tokens.append(int(ev['token']))
+                            self._emit_event(ev)
+                            events += 1
+                            if (break_after is not None
+                                    and events >= break_after):
+                                # Injected partial_response: the
+                                # upstream "dies" mid-stream, with a
+                                # nonzero generated prefix.
+                                resp.close()
+                                return False
+                        else:
+                            self._emit_event(ev)
+                except _ClientGone:
+                    raise
+                except Exception as e:  # pylint: disable=broad-except
+                    logger.warning(f'upstream stream broke: '
+                                   f'{type(e).__name__}: {e}')
+                    return False
+                return False       # EOF without a done event: broken
+
+            def _stream_recover(self, resp, url: str, payload: dict,
+                                headers: dict, tried: Set[str]) -> None:
+                """Forward a *recoverable* stream, migrating it to a
+                surviving replica when the upstream dies mid-stream:
+                the resubmission carries ``original prompt + tokens so
+                far`` and the remaining decode budget, under the same
+                idempotency key. The client sees ONE stream and one
+                ``done`` event with the merged tokens; if every replica
+                is exhausted it sees a clean retryable error event."""
+                self.send_response(resp.status)
+                for k, v in resp.headers.items():
+                    if k.lower() not in _HOP_HEADERS:
+                        self.send_header(k, v)
+                self.send_header('Connection', 'close')
+                self.end_headers()
+                tokens: list = []
+                break_after = None
+                if lb._faults is not None:
+                    rule = lb._faults.fire('proxy_stream')
+                    if rule is not None and \
+                            rule.kind == 'partial_response':
+                        break_after = rule.after_events or 1
+                migrated = False
+                leg = resp              # caller's with closes the first
+                own_leg = None          # legs we opened get closed here
+                try:
+                    while True:
+                        finished = self._forward_sse(leg, tokens,
+                                                     break_after)
+                        break_after = None    # injected break fires once
+                        if finished:
+                            if migrated:
+                                lb._m_migrated['completed'].inc()
+                            return
+                        t_fail = time.monotonic()
+                        if own_leg is not None:
+                            try:
+                                own_leg.close()
+                            except OSError:
+                                pass    # already dead — that's the point
+                            own_leg = None
+                        own_leg = self._open_continuation(
+                            payload, tokens, headers, tried)
+                        if own_leg is None:
+                            # Budget already exhausted -> the request IS
+                            # complete; otherwise: every replica failed.
+                            remaining = lb._remaining_budget(payload,
+                                                             tokens)
+                            if remaining <= 0 and tokens:
+                                self._emit_event({'done': True,
+                                                  'tokens': tokens,
+                                                  'migrated': True})
+                                lb._m_migrated['completed'].inc()
+                                return
+                            lb._m_migrated['failed'].inc()
+                            self._emit_event({
+                                'error': 'replica failed mid-stream and '
+                                         'no surviving replica could '
+                                         'resume',
+                                'retryable': True,
+                                'retry_after_s': lb._retry_after_hint,
+                                'tokens_so_far': tokens,
+                            })
+                            return
+                        migrated = True
+                        lb._h_recovery.observe(
+                            time.monotonic() - t_fail)
+                        leg = own_leg
+                except _ClientGone:
+                    logger.info('client disconnected mid-stream; '
+                                'abandoning recovery')
+                finally:
+                    if own_leg is not None:
+                        try:
+                            own_leg.close()
+                        except OSError:
+                            pass    # best-effort close of a dead leg
+                    self.close_connection = True
+
+            def _open_continuation(self, payload: dict, tokens: list,
+                                   headers: dict, tried: Set[str]):
+                """Open the continuation stream on a surviving replica
+                (prompt extended with the generated prefix, budget
+                reduced). Returns the live response, or None when no
+                replica could take it (or nothing remains to decode)."""
+                remaining = lb._remaining_budget(payload, tokens)
+                if remaining <= 0:
+                    return None
+                cont = dict(payload)
+                cont['prompt'] = list(payload['prompt']) + list(tokens)
+                cont['max_new_tokens'] = remaining
+                cont.pop('max_tokens', None)
+                body = json.dumps(cont).encode()
+                while True:
+                    nxt = lb.policy.select_replica(exclude=tried)
+                    if nxt is None or len(tried) >= lb.max_attempts + 2:
+                        return None
+                    tried.add(nxt)
+                    req = urllib.request.Request(
+                        nxt + '/generate', data=body, headers=headers,
+                        method='POST')
+                    try:
+                        leg = urllib.request.urlopen(req, timeout=120)
+                    except Exception as e:  # pylint: disable=broad-except
+                        logger.warning(
+                            f'continuation on {nxt} failed '
+                            f'({type(e).__name__}: {e}); trying next')
+                        continue
+                    logger.info(
+                        f'migrated stream to {nxt} with '
+                        f'{len(tokens)} generated token(s) '
+                        f'({remaining} remaining)')
+                    return leg
+
             def _proxy(self, method: str) -> None:
                 t_start = time.monotonic()
                 lb._m_requests.inc()
@@ -158,12 +458,30 @@ class SkyServeLoadBalancer:
                 data = self.rfile.read(length) if length else None
                 headers = {k: v for k, v in self.headers.items()
                            if k.lower() not in _HOP_HEADERS}
+                forced_break = False
+                if lb._faults is not None:
+                    rule = lb._faults.fire('proxy')
+                    if rule is not None:
+                        if rule.kind == 'slow_response':
+                            time.sleep(rule.delay_s)
+                        elif rule.kind == 'partial_response':
+                            forced_break = True
+                # Recoverable request? (streaming /generate, token-id
+                # prompt). The LB mints an idempotency key for it, so a
+                # replay on another replica returns one answer.
+                recover = lb._recoverable(method, self.path, data)
+                req_key = self.headers.get('X-Request-ID')
+                if recover is not None and req_key is None:
+                    req_key = uuid.uuid4().hex
+                    headers['X-Request-ID'] = req_key
 
-                # A replica dying mid-connect is retried transparently on
-                # another replica (reference LB behavior); an HTTP error
-                # response is NOT retried — the replica answered.
-                tried = set()
+                # A replica dying mid-connect is retried transparently
+                # on another replica; an HTTP-503 refusal (loading /
+                # draining) never executed and retries too; any other
+                # HTTP error passes through — the replica answered.
+                tried: Set[str] = set()
                 last_err: Optional[Exception] = None
+                last_http = None        # (code, body, headers)
                 responded = False       # bytes already sent to client?
                 for _ in range(lb.max_attempts):
                     url = lb.policy.select_replica(exclude=tried)
@@ -175,6 +493,14 @@ class SkyServeLoadBalancer:
                         method=method)
                     lb.policy.pre_execute(url)
                     try:
+                        if forced_break:
+                            # Injected partial_response: the connection
+                            # "breaks" before the request lands —
+                            # drives the exact retry path a flaky
+                            # network does.
+                            forced_break = False
+                            raise ConnectionResetError(
+                                'injected partial_response')
                         with urllib.request.urlopen(req,
                                                     timeout=120) as resp:
                             ctype = resp.headers.get('Content-Type', '')
@@ -182,7 +508,13 @@ class SkyServeLoadBalancer:
                                     or 'chunked' in (resp.headers.get(
                                         'Transfer-Encoding') or '')):
                                 responded = True
-                                self._stream_response(resp)
+                                if (recover is not None
+                                        and recover.get('stream')):
+                                    self._stream_recover(
+                                        resp, url, recover, headers,
+                                        tried)
+                                else:
+                                    self._stream_response(resp)
                                 return
                             # Read the FULL body before sending anything
                             # client-ward: a mid-read failure here is
@@ -201,35 +533,46 @@ class SkyServeLoadBalancer:
                             (time.monotonic() - t_start) * 1e3)
                         return
                     except urllib.error.HTTPError as e:
-                        # The replica ANSWERED; pass its error through —
-                        # replaying a side-effectful request is wrong.
                         body = e.read()
+                        if e.code == 503:
+                            # Pre-admission refusal (loading/draining/
+                            # failed): nothing executed — try another
+                            # replica; the last refusal passes through
+                            # (with Retry-After) if all of them refuse.
+                            last_http = (e.code, body, e.headers)
+                            lb._m_retries.inc()
+                            logger.warning(
+                                f'replica {url} refused ({e.code}); '
+                                'retrying on another replica')
+                            continue
+                        # The replica ANSWERED; pass its error through
+                        # headers included (scheduler 429 Retry-After
+                        # reaches the client unmodified).
                         responded = True
-                        self.send_response(e.code)
-                        self.send_header('Content-Length', str(len(body)))
-                        self.end_headers()
-                        self.wfile.write(body)
+                        self._forward_http_error(e.code, body, e.headers)
                         return
                     except Exception as e:  # pylint: disable=broad-except
                         if responded:
                             # Mid-stream death (or client disconnect)
-                            # AFTER bytes went out: the response cannot
-                            # be restarted and the request must not be
-                            # replayed — drop the connection.
+                            # AFTER bytes went out on a non-recoverable
+                            # stream: the response cannot be restarted —
+                            # drop the connection.
                             logger.warning(
                                 f'stream to/from {url} broke mid-response'
                                 f' ({type(e).__name__}: {e}); closing')
                             self.close_connection = True
                             return
-                        if method != 'GET' and not _failed_before_send(e):
-                            # The replica may have EXECUTED this request
-                            # (it died while we read the response);
-                            # replaying a non-idempotent method would
-                            # run it twice. Surface the failure instead.
+                        if not _retry_safe(method, e, req_key is not None):
+                            # The replica may have EXECUTED this
+                            # un-keyed request (it died while we read
+                            # the response); replaying could run it
+                            # twice. Surface the failure instead.
                             self._send_json(502, {
                                 'error': f'replica failed mid-request '
                                          f'({type(e).__name__}: {e}); '
-                                         'not retried (non-idempotent)'})
+                                         'not retried (non-idempotent; '
+                                         'pass X-Request-ID to make it '
+                                         'replayable)'})
                             return
                         last_err = e
                         lb._m_retries.inc()
@@ -239,15 +582,28 @@ class SkyServeLoadBalancer:
                             f'another replica')
                     finally:
                         lb.policy.post_execute(url)
-                if last_err is not None:
+                if last_http is not None:
+                    self._forward_http_error(*last_http)
+                elif last_err is not None:
                     self._send_json(502, {
                         'error': f'replicas unreachable after '
                                  f'{len(tried)} attempt(s): '
-                                 f'{type(last_err).__name__}: {last_err}'})
+                                 f'{type(last_err).__name__}: {last_err}',
+                        'retryable': True,
+                        'retry_after_s': lb._retry_after_hint,
+                    }, extra_headers={
+                        'Retry-After': str(lb._retry_after_hint)})
                 else:
+                    # No ready replicas: a clean retryable error with a
+                    # Retry-After derived from the controller's probe/
+                    # launch backoff state (shipped on every sync).
                     self._send_json(503, {
                         'error': 'No ready replicas. '
-                                 'Use "sky serve status" to check.'})
+                                 'Use "sky serve status" to check.',
+                        'retryable': True,
+                        'retry_after_s': lb._retry_after_hint,
+                    }, extra_headers={
+                        'Retry-After': str(lb._retry_after_hint)})
 
             def do_GET(self):  # noqa: N802
                 self._proxy('GET')

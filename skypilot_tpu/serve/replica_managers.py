@@ -18,6 +18,7 @@ from __future__ import annotations
 
 import json
 import os
+import random
 import threading
 import time
 import typing
@@ -31,6 +32,7 @@ from skypilot_tpu import execution
 from skypilot_tpu import global_state
 from skypilot_tpu import telemetry
 from skypilot_tpu import tpu_logging
+from skypilot_tpu.serve import faults as faults_lib
 from skypilot_tpu.serve import serve_state
 from skypilot_tpu.task import Task
 from skypilot_tpu.utils import common_utils
@@ -44,10 +46,21 @@ _PROBE_FAILURE_GRACE = 3          # consecutive probe failures → NOT_READY
 _PROBE_FAILURE_TERMINATE = 9      # consecutive failures → replace replica
 _MAX_RETAINED_FAILED = 3          # FAILED rows kept for debugging
 _LAUNCH_BACKOFF_CAP = 300.0
+# Launch-backoff jitter band: the delay is drawn uniformly from
+# [JITTER_FRAC, 1.0] x the exponential target, so replicas that failed
+# together don't relaunch together (a synchronized retry storm against
+# the same exhausted zone/quota).
+_BACKOFF_JITTER_FRAC = 0.5
 
 
 def _launch_backoff_base() -> float:
     return float(os.environ.get('SKYTPU_SERVE_LAUNCH_BACKOFF', '5'))
+
+
+def _drain_deadline_default() -> float:
+    """Graceful-drain deadline before a draining replica is torn down
+    regardless (in-flight requests past it fail over via the LB)."""
+    return float(os.environ.get('SKYTPU_SERVE_DRAIN_S', '30'))
 
 
 def _probe_counter(outcome: str) -> 'telemetry.Counter':
@@ -108,6 +121,14 @@ class ReplicaManager:
         self._shutdown = False
         self._launch_failures = 0
         self._backoff_until = 0.0
+        # Backoff jitter source (tests seed it for determinism).
+        self._rng = random.Random()
+        # Deterministic fault injection (serve/faults.py): resolved
+        # once from SKYTPU_FAULT_SPEC; None = hooks are one attribute
+        # check. Sites here: 'probe' (probe_timeout), 'preempt'
+        # (preempt_signal — hard kill), 'preempt_warning'
+        # (preempt_signal with advance notice — routes through drain).
+        self._faults = faults_lib.get_injector()
 
     # ------------------------------------------------------------- update
     def update_version(self, spec: 'SkyServiceSpec', task_config: dict,
@@ -165,6 +186,34 @@ class ReplicaManager:
         doesn't spin up a doomed launch every controller tick)."""
         with self._lock:
             return time.time() < self._backoff_until
+
+    def backoff_remaining(self) -> float:
+        """Seconds until launches resume (0 when not backing off) —
+        the controller ships this to the LB as the Retry-After hint on
+        the no-ready-replicas 503."""
+        with self._lock:
+            return max(0.0, self._backoff_until - time.time())
+
+    def retry_after_hint(self) -> int:
+        """Whole-second Retry-After for clients hitting the service
+        while no replica is READY, from live replica state: the launch
+        backoff remainder when backing off, a short probe-propagation
+        interval while a replica is already starting/draining, and a
+        provisioning-scale guess otherwise."""
+        backoff = self.backoff_remaining()
+        if backoff > 0:
+            return max(1, int(backoff))
+        with self._lock:
+            statuses = {r.status for r in self._replicas.values()}
+        if (serve_state.ReplicaStatus.STARTING in statuses
+                or serve_state.ReplicaStatus.READY in statuses
+                or serve_state.ReplicaStatus.DRAINING in statuses):
+            # A replica exists and is (nearly) servable: the LB learns
+            # about it at its next controller sync.
+            return 5
+        if serve_state.ReplicaStatus.PROVISIONING in statuses:
+            return max(5, int(self.spec.initial_delay_seconds / 4))
+        return 15
 
     def _pick_port(self, replica_id: int) -> int:
         """Fixed spec port on real clouds (distinct head IPs); a free local
@@ -251,12 +300,19 @@ class ReplicaManager:
 
     def _bump_backoff(self) -> None:
         """One more replica died before ever serving: extend the
-        exponential launch backoff and prune old FAILED rows."""
+        exponential launch backoff (jittered — concurrent failures
+        must not produce synchronized retry storms against the same
+        exhausted zone/quota) and prune old FAILED rows."""
         with self._lock:
             self._launch_failures += 1
             delay = min(
                 _launch_backoff_base() * (2 ** (self._launch_failures - 1)),
                 _LAUNCH_BACKOFF_CAP)
+            # Uniform over [_BACKOFF_JITTER_FRAC, 1.0] x delay: decorrelates
+            # concurrent managers while keeping the exponential shape
+            # (and the cap as a hard ceiling).
+            delay *= (_BACKOFF_JITTER_FRAC
+                      + (1.0 - _BACKOFF_JITTER_FRAC) * self._rng.random())
             self._backoff_until = time.time() + delay
             # Keep only the newest few FAILED rows (status/debugging);
             # older ones would otherwise accumulate one per retry forever.
@@ -266,6 +322,109 @@ class ReplicaManager:
             prune = failed_ids[:-_MAX_RETAINED_FAILED]
         for rid in prune:      # outside _lock: _untrack takes _db_lock
             self._untrack(rid)
+
+    # -------------------------------------------------------------- drain
+    def drain(self, replica_id: int,
+              deadline_s: Optional[float] = None) -> bool:
+        """Graceful scale-down: mark the replica DRAINING (it drops out
+        of ``ready_urls`` — the LB removes it from rotation at its next
+        sync), ask its model server to stop admitting and finish its
+        in-flight requests, then tear the cluster down once drained or
+        at the deadline. Idempotent; returns True when a drain was
+        started (False: unknown replica or already leaving)."""
+        with self._lock:
+            info = self._replicas.get(replica_id)
+            if info is None or info.status in (
+                    serve_state.ReplicaStatus.DRAINING,
+                    serve_state.ReplicaStatus.SHUTTING_DOWN) or \
+                    info.status.is_terminal():
+                return False
+            # A replica that never served (no URL yet) has nothing to
+            # drain — plain scale_down below.
+            drainable = (info.url is not None and info.status in (
+                serve_state.ReplicaStatus.READY,
+                serve_state.ReplicaStatus.NOT_READY))
+            if drainable:
+                info.status = serve_state.ReplicaStatus.DRAINING
+        if not drainable:
+            self.scale_down(replica_id)
+            return False
+        _transition_counter('DRAINING').inc()
+        self._persist(info)
+        deadline_s = (float(deadline_s) if deadline_s is not None
+                      else _drain_deadline_default())
+        logger.info(f'Draining replica {replica_id} '
+                    f'(deadline {deadline_s:.0f}s).')
+        threading.Thread(target=self._drain_then_down,
+                         args=(info, deadline_s), daemon=True).start()
+        return True
+
+    def _drain_then_down(self, info: ReplicaInfo,
+                         deadline_s: float) -> None:
+        try:
+            self._await_replica_drain(info, deadline_s)
+        except Exception as e:  # pylint: disable=broad-except
+            logger.warning(f'Drain of replica {info.replica_id} failed '
+                           f'({type(e).__name__}: {e}); tearing down '
+                           'anyway')
+        self.scale_down(info.replica_id)
+
+    def _await_replica_drain(self, info: ReplicaInfo,
+                             deadline_s: float) -> None:
+        """POST /drain to the replica's model server, then poll its
+        drain status until drained or the deadline. A replica whose
+        server doesn't implement the drain contract (no ``draining``
+        key in the response) tears down immediately — there is nothing
+        to wait for."""
+        assert info.url is not None
+        deadline = time.monotonic() + deadline_s
+        try:
+            req = urllib.request.Request(
+                info.url + '/drain',
+                data=json.dumps({'deadline_s': deadline_s}).encode(),
+                headers={'Content-Type': 'application/json'})
+            with urllib.request.urlopen(req, timeout=10) as resp:
+                payload = json.loads(resp.read())
+        except Exception as e:  # pylint: disable=broad-except
+            logger.warning(f'Drain request to replica '
+                           f'{info.replica_id} failed '
+                           f'({type(e).__name__}: {e}); skipping wait')
+            return
+        if not isinstance(payload, dict) or 'draining' not in payload:
+            logger.info(f'Replica {info.replica_id} has no drain '
+                        'support; tearing down immediately.')
+            return
+        while time.monotonic() < deadline:
+            try:
+                with urllib.request.urlopen(info.url + '/drain',
+                                            timeout=10) as resp:
+                    status = json.loads(resp.read())
+                if status.get('drained'):
+                    logger.info(
+                        f'Replica {info.replica_id} drained cleanly.')
+                    return
+            except Exception as e:  # pylint: disable=broad-except
+                logger.warning(f'Drain poll of replica '
+                               f'{info.replica_id} failed '
+                               f'({type(e).__name__}: {e}); assuming '
+                               'gone')
+                return
+            # Jittered poll (graftcheck GC112: no fixed-sleep loops).
+            time.sleep(0.25 * (0.5 + self._rng.random()))
+        logger.warning(f'Replica {info.replica_id} drain deadline '
+                       f'({deadline_s:.0f}s) exceeded; tearing down '
+                       '(stragglers fail over through the LB).')
+
+    def handle_preemption_warning(
+            self, replica_id: int,
+            deadline_s: Optional[float] = None) -> bool:
+        """Advance preemption notice (cloud spot warning / injected
+        ``preempt_signal`` at the ``preempt_warning`` site): route
+        through graceful drain so in-flight work finishes (or migrates)
+        before the capacity disappears."""
+        logger.info(f'Preemption warning for replica {replica_id}; '
+                    'draining ahead of it.')
+        return self.drain(replica_id, deadline_s)
 
     # ------------------------------------------------------------ teardown
     def scale_down(self, replica_id: int, status: Optional[
@@ -316,6 +475,17 @@ class ReplicaManager:
     # ------------------------------------------------------------- probing
     def _probe_one(self, info: ReplicaInfo) -> bool:
         assert info.url is not None
+        if self._faults is not None:
+            rule = self._faults.fire('probe')
+            if rule is not None and rule.kind == 'probe_timeout':
+                # Injected probe timeout: burn (a bounded slice of) the
+                # timeout, then report failure — the consecutive-
+                # failure escalation runs exactly as for a real one.
+                time.sleep(min(rule.delay_s,
+                               self.spec.readiness_timeout_seconds))
+                logger.warning(f'Probe of replica {info.replica_id} '
+                               'failed (injected probe_timeout)')
+                return False
         url = info.url + self.spec.readiness_path
         try:
             if self.spec.post_data is not None:
@@ -338,6 +508,12 @@ class ReplicaManager:
     def _check_preempted(self, info: ReplicaInfo) -> bool:
         """Cluster-gone (or not UP) while we thought it was running =
         preemption (reference ``_handle_preemption`` ``:782``)."""
+        if self._faults is not None:
+            rule = self._faults.fire('preempt')
+            if rule is not None and rule.kind == 'preempt_signal':
+                logger.warning(f'Replica {info.replica_id} preempted '
+                               '(injected preempt_signal)')
+                return True
         record = global_state.get_cluster_from_name(info.cluster_name)
         if record is None:
             return True
@@ -360,6 +536,14 @@ class ReplicaManager:
                                    serve_state.ReplicaStatus.READY,
                                    serve_state.ReplicaStatus.NOT_READY):
                 continue
+            # Advance preemption warning (injected; cloud spot notices
+            # would land here too): drain instead of hard-killing.
+            if (self._faults is not None
+                    and info.status == serve_state.ReplicaStatus.READY):
+                rule = self._faults.fire('preempt_warning')
+                if rule is not None and rule.kind == 'preempt_signal':
+                    self.handle_preemption_warning(info.replica_id)
+                    continue
             # Cluster existence is ground truth, checked BEFORE the HTTP
             # probe: a terminated replica's address can keep answering (IP
             # reuse on clouds; surviving process on the local provider).

@@ -9,6 +9,7 @@ status, down, update.
 from __future__ import annotations
 
 import json
+import random
 import sys
 import time
 from typing import Any, Dict
@@ -166,12 +167,17 @@ def handle(request: Dict[str, Any]) -> Dict[str, Any]:
                 headers={'Content-Type': 'application/json'})
             with urllib.request.urlopen(req, timeout=10):
                 pass
-            # Wait briefly for the row to disappear (terminate is async).
+            # Wait briefly for the row to disappear (terminate is
+            # async). Jittered with mild backoff (graftcheck GC112):
+            # many concurrent `serve down`s must not poll the DB in
+            # lockstep.
             deadline = time.time() + float(request.get('timeout', 60))
+            gap = 0.2
             while time.time() < deadline:
                 if serve_state.get_service(name) is None:
                     break
-                time.sleep(0.2)
+                time.sleep(gap * (0.5 + random.random()))
+                gap = min(gap * 1.5, 2.0)
             else:
                 # Controller accepted the terminate but wedged mid-
                 # teardown: escalate rather than reporting success with

@@ -74,15 +74,19 @@ TIERS: Tuple[str, ...] = ('latency', 'throughput')
 
 # Shed reasons (the stable label set of skytpu_sched_shed_total —
 # every (tier, reason) series is registered at scheduler construction
-# so the /metrics schema never grows mid-flight).
-SHED_REASONS: Tuple[str, ...] = ('queue_full', 'engine_error')
+# so the /metrics schema never grows mid-flight). 'draining' is the
+# graceful-drain refusal: the replica is leaving rotation, so the
+# caller gets a retryable 503 instead of the overload 429.
+SHED_REASONS: Tuple[str, ...] = ('queue_full', 'engine_error',
+                                 'draining')
 
 _RETRY_AFTER_MIN_S = 1
 _RETRY_AFTER_MAX_S = 120
 
 
 class ShedError(RuntimeError):
-    """Admission refused: the caller should answer HTTP 429 with the
+    """Admission refused: the caller should answer ``http_status``
+    (429 for overload, 503 while draining — both retryable) with the
     ``retry_after_s`` hint (derived from live queue telemetry — the
     work ahead of this request over the measured token throughput)."""
 
@@ -92,6 +96,13 @@ class ShedError(RuntimeError):
         self.tier = tier
         self.reason = reason
         self.retry_after_s = retry_after_s
+
+    @property
+    def http_status(self) -> int:
+        # Draining is not overload: the replica is healthy but
+        # leaving — 503 + Retry-After tells the client (and the LB's
+        # transparent retry) to go elsewhere, now.
+        return 503 if self.reason == 'draining' else 429
 
 
 class Outbox:
@@ -252,6 +263,7 @@ class RequestScheduler:
         self._admitted_tokens: Dict[str, int] = {t: 0 for t in TIERS}
         self._rate = _TokenRateMeter()
         self._failed: Optional[str] = None
+        self._draining = False
         self._init_metrics()
 
     # ------------------------------------------------------------ metrics
@@ -337,6 +349,17 @@ class RequestScheduler:
             raise RuntimeError(f'engine failed: {self._failed}')
         work = len(prompt) + max_new_tokens
         with self._q_lock:
+            if self._draining:
+                # Graceful drain: already-accepted work runs to
+                # completion, but nothing new is admitted — the client
+                # retries (through the LB: on another replica).
+                retry = max(_RETRY_AFTER_MIN_S,
+                            min(5, _RETRY_AFTER_MAX_S))
+                self._c_shed[(tier, 'draining')].inc()
+                raise ShedError(
+                    tier, 'draining', retry,
+                    'replica is draining (graceful scale-down); '
+                    f'retry on another replica in ~{retry}s')
             bound = self._max_queue_tokens
             if bound and self._queued_tokens[tier] + work > bound:
                 retry = self._retry_after_locked(tier, work)
@@ -562,6 +585,33 @@ class RequestScheduler:
         for sr in inflight:
             sr.outbox.fail(error)
         self._refresh_gauges()
+
+    # -------------------------------------------------------------- drain
+    def begin_drain(self) -> None:
+        """Enter graceful drain: new submits shed with a retryable 503
+        (reason ``draining``); queued and in-flight requests keep
+        running to completion (``fill_engine`` still admits the
+        backlog). Idempotent."""
+        with self._q_lock:
+            self._draining = True
+
+    @property
+    def draining(self) -> bool:
+        with self._q_lock:
+            return self._draining
+
+    @property
+    def inflight(self) -> int:
+        """Requests the scheduler still owns: queued + engine-side."""
+        with self._q_lock:
+            return (sum(len(q) for q in self._queues.values())
+                    + len(self._by_rid))
+
+    @property
+    def drained(self) -> bool:
+        """True once every accepted request has finished (queues empty
+        AND nothing in flight engine-side)."""
+        return self.inflight == 0
 
     # ------------------------------------------------------------- surface
     def json_stats(self) -> Dict[str, Any]:

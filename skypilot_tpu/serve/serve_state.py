@@ -40,6 +40,9 @@ class ReplicaStatus(enum.Enum):
     STARTING = 'STARTING'             # cluster up, probe not yet passing
     READY = 'READY'
     NOT_READY = 'NOT_READY'           # probe failing; grace period
+    # Graceful scale-down: out of LB rotation, in-flight requests run
+    # to completion under a deadline, THEN the cluster tears down.
+    DRAINING = 'DRAINING'
     SHUTTING_DOWN = 'SHUTTING_DOWN'
     PREEMPTED = 'PREEMPTED'
     FAILED = 'FAILED'

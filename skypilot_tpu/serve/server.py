@@ -47,15 +47,18 @@ task's ``run`` command:
 from __future__ import annotations
 
 import argparse
+import collections
 import http.server
 import json
 import os
 import threading
+import time
 import urllib.parse
 from typing import Any, Dict, Optional
 
 from skypilot_tpu import telemetry
 from skypilot_tpu import tpu_logging
+from skypilot_tpu.serve import faults as faults_lib
 from skypilot_tpu.serve import scheduler as scheduler_lib
 from skypilot_tpu.telemetry import tracing
 
@@ -77,7 +80,9 @@ class ModelServer:
                  speculate_k: int = 0,
                  slo_tier_default: str = 'latency',
                  max_queue_tokens: Optional[int] = None,
-                 latency_admit_frac: float = 0.7):
+                 latency_admit_frac: float = 0.7,
+                 drain_deadline_s: float = 30.0,
+                 fault_spec: Optional[Any] = None):
         self.cfg_name = cfg_name
         self.model_path = model_path  # HF checkpoint dir (real weights)
         self.quantize = quantize      # 'int8' => int8 weights
@@ -144,6 +149,34 @@ class ModelServer:
         self._httpd: Optional[http.server.ThreadingHTTPServer] = None
         self._stopping = False
         self._engine_thread: Optional[threading.Thread] = None
+        # Fault injection (serve/faults.py): resolved ONCE here from
+        # the explicit spec or SKYTPU_FAULT_SPEC; None (the default)
+        # keeps the hooks at a single attribute check — zero overhead
+        # on the engine loop, nothing in the compute layer.
+        self._faults = faults_lib.make_injector(fault_spec)
+        # Robustness series (faults/migrations/drain/recovery) register
+        # up front so they render as zeros from the first scrape.
+        faults_lib.register_metrics()
+        self._h_drain = reg.histogram(
+            'skytpu_replica_drain_seconds',
+            'Graceful-drain duration: drain start to idle (s)',
+            buckets=telemetry.registry.DEFAULT_SECONDS_BUCKETS)
+        # Graceful drain: all drain attributes are written under
+        # _drain_lock (begin_drain is idempotent and may race the
+        # monitor thread and /drain handlers).
+        self.drain_deadline_s = float(drain_deadline_s)
+        self._drain_lock = threading.Lock()
+        self._drain_started: Optional[float] = None
+        self._drain_deadline: Optional[float] = None
+        self._drained = threading.Event()
+        # Idempotent request keys: a bounded map of completed
+        # request_key -> result, so a retried request (the LB's hedged
+        # retry / a client replay after a mid-stream migration) gets
+        # the SAME answer instead of a second execution.
+        self._keys_lock = threading.Lock()
+        self._completed_keys: 'collections.OrderedDict[str, Dict]' = \
+            collections.OrderedDict()
+        self._max_completed_keys = 512
 
     # ------------------------------------------------------------- engine
     def _load_engine(self) -> None:
@@ -206,6 +239,20 @@ class ModelServer:
                 self._work.wait()
                 if self._stopping:
                     break
+                if self._faults is not None:
+                    # Deterministic fault injection at the point the
+                    # loop touches the hardware: a stall sleeps inside
+                    # the loop (slow replica), a crash raises into the
+                    # _fatal path (dead replica) — exactly the paths a
+                    # real failure exercises.
+                    rule = self._faults.fire('engine_step')
+                    if rule is not None:
+                        if rule.kind == 'engine_stall':
+                            time.sleep(rule.delay_s)
+                        elif rule.kind == 'replica_crash':
+                            raise faults_lib.InjectedFault(
+                                'injected replica_crash '
+                                f'(engine_step #{self._faults.site_count("engine_step")})')
                 if self.speculate_k and self.engine is not None:
                     # Host-only n-gram matching for the next verify
                     # round, BEFORE taking the engine lock — handler
@@ -328,6 +375,90 @@ class ModelServer:
             # Finished during the cancel race: cancel() popped the
             # finished request into sr.result instead of aborting.
             self._record_finished(sr.result)
+
+    # -------------------------------------------------------------- drain
+    def begin_drain(self, deadline_s: Optional[float] = None
+                    ) -> Dict[str, Any]:
+        """Enter graceful drain: the scheduler stops admitting (new
+        submits get a retryable 503 + Retry-After), in-flight requests
+        run to completion, and a monitor thread records the drain
+        duration — failing whatever is still running once the deadline
+        passes (the LB migrates those). Idempotent; returns the status
+        payload."""
+        with self._drain_lock:
+            if self._drain_started is None:
+                self._drain_started = time.monotonic()
+                self._drain_deadline = self._drain_started + (
+                    float(deadline_s) if deadline_s else
+                    self.drain_deadline_s)
+                self.sched.begin_drain()
+                self._work.set()      # wake the loop to run the tail
+                threading.Thread(target=self._drain_monitor,
+                                 daemon=True).start()
+                logger.info(
+                    'drain started: deadline '
+                    f'{self._drain_deadline - self._drain_started:.1f}s,'
+                    f' {self.sched.inflight} request(s) in flight')
+        return self.drain_status()
+
+    def _drain_monitor(self) -> None:
+        import random
+        with self._drain_lock:
+            started, deadline = self._drain_started, self._drain_deadline
+        while time.monotonic() < deadline:
+            if self.sched.drained:
+                break
+            # Jittered poll (graftcheck GC112: no fixed-sleep loops).
+            time.sleep(0.05 * (0.5 + random.random()))
+        dur = time.monotonic() - started
+        clean = self.sched.drained
+        self._h_drain.observe(dur)
+        self._drained.set()
+        if clean:
+            logger.info(f'drain complete in {dur:.2f}s')
+        else:
+            # Deadline exceeded: fail the stragglers with a retryable
+            # error — the LB resubmits them to a surviving replica, so
+            # the teardown that follows still loses nothing.
+            logger.warning(
+                f'drain deadline exceeded after {dur:.1f}s with '
+                f'{self.sched.inflight} request(s) still running; '
+                'failing them over')
+            self.sched.fail_all('drain deadline exceeded; retry on '
+                                'another replica')
+
+    def drain_status(self) -> Dict[str, Any]:
+        with self._drain_lock:
+            started, deadline = self._drain_started, self._drain_deadline
+        now = time.monotonic()
+        return {
+            'draining': started is not None,
+            'drained': self._drained.is_set() and self.sched.drained,
+            'inflight': self.sched.inflight,
+            'deadline_remaining_s': (round(max(0.0, deadline - now), 2)
+                                     if deadline is not None else None),
+        }
+
+    # -------------------------------------------------------- idempotency
+    def lookup_request_key(self, key: Optional[str]
+                           ) -> Optional[Dict[str, Any]]:
+        if not key:
+            return None
+        with self._keys_lock:
+            return self._completed_keys.get(key)
+
+    def record_request_key(self, key: Optional[str],
+                           result: Dict[str, Any]) -> None:
+        """Remember a completed keyed request (bounded LRU): a replay
+        of the same key returns this result instead of executing the
+        request a second time."""
+        if not key:
+            return
+        with self._keys_lock:
+            self._completed_keys[key] = result
+            self._completed_keys.move_to_end(key)
+            while len(self._completed_keys) > self._max_completed_keys:
+                self._completed_keys.popitem(last=False)
 
     def _record_finished(self, req) -> None:
         """Fold one finished request into the registry: served counter
@@ -514,17 +645,27 @@ class ModelServer:
                 self.wfile.write(body)
 
             def _shed(self, e: 'scheduler_lib.ShedError') -> None:
-                """HTTP 429 for an admission refusal: Retry-After from
-                live queue telemetry (the 429 contract — clients back
-                off for a meaningful interval instead of hammering a
-                saturated replica)."""
-                self._json(429, {'error': {
+                """Admission refusal: HTTP 429 (overload) or 503
+                (draining), always with Retry-After from live queue
+                telemetry — clients back off for a meaningful interval
+                instead of hammering a saturated or leaving replica."""
+                self._json(e.http_status, {'error': {
                     'message': str(e),
-                    'type': 'overloaded',
+                    'type': ('draining' if e.reason == 'draining'
+                             else 'overloaded'),
                     'tier': e.tier,
                     'reason': e.reason,
                     'retry_after_s': e.retry_after_s,
                 }}, extra_headers={'Retry-After': str(e.retry_after_s)})
+
+            def _request_key(self, payload) -> Optional[str]:
+                """Client-supplied idempotency key: JSON field wins
+                over the X-Request-ID header (the LB mints one for
+                recoverable requests)."""
+                key = payload.get('request_key')
+                if key is None:
+                    key = self.headers.get('X-Request-ID')
+                return str(key) if key else None
 
             def _slo_tier(self, payload) -> Optional[str]:
                 """Per-request SLO tier: JSON field (``slo_tier``) wins
@@ -542,11 +683,19 @@ class ModelServer:
                     if server._error is not None:
                         self._json(503, {'status': 'failed',
                                          'error': server._error})
+                    elif server.sched.draining:
+                        # Out of rotation: probes see 503 so the LB /
+                        # controller stop routing here while the tail
+                        # of in-flight work finishes.
+                        self._json(503, dict(
+                            server.drain_status(), status='draining'))
                     elif server._ready.is_set():
                         self._json(200, {'status': 'ready',
                                          'model': server.cfg_name})
                     else:
                         self._json(503, {'status': 'loading'})
+                elif parsed.path == '/drain':
+                    self._json(200, server.drain_status())
                 elif parsed.path == '/metrics':
                     server._update_gauges()
                     if query.get('format', [''])[0] == 'json':
@@ -577,7 +726,8 @@ class ModelServer:
                 else:
                     self._json(404, {'error': f'no route {self.path}'})
 
-            def _stream_generate(self, prompt, is_text, kwargs) -> None:
+            def _stream_generate(self, prompt, is_text, kwargs,
+                                 key=None) -> None:
                 """Server-sent events: one ``data:`` line per token as
                 the engine emits it, a final ``done`` event with the
                 full sequence. Token streaming end to end — the LB
@@ -598,19 +748,27 @@ class ModelServer:
                     self.send_header('Cache-Control', 'no-cache')
                     self.send_header('Connection', 'close')
                     self.end_headers()
-                    self._stream_loop(sr, tokens, is_text, tok)
+                    self._stream_loop(sr, tokens, is_text, tok, key)
                 except (BrokenPipeError, ConnectionResetError):
                     pass    # client vanished; finish_stream cancels
                 finally:
                     server.finish_stream(sr)
                     self.close_connection = True
 
-            def _stream_loop(self, sr, tokens, is_text, tok) -> None:
+            def _stream_loop(self, sr, tokens, is_text, tok,
+                             key=None) -> None:
                 while True:
                     token, finished = sr.outbox.get(timeout=300)
                     if token is None:       # engine died / shed
+                        # Retryable stream failure: the error event
+                        # carries enough for the LB (or a client) to
+                        # resubmit elsewhere instead of giving up.
                         self.wfile.write(
-                            b'data: {"error": "engine failed"}\n\n')
+                            ('data: ' + json.dumps({
+                                'error': sr.outbox.error
+                                or 'engine failed',
+                                'retryable': True,
+                                'retry_after_s': 1}) + '\n\n').encode())
                         break
                     tokens.append(int(token))
                     event = {'token': int(token)}
@@ -625,9 +783,36 @@ class ModelServer:
                                 'tokens': tokens}
                         if is_text:
                             done['text'] = tok.decode(tokens)
+                        server.record_request_key(key, dict(
+                            done, request_id=sr.request_id))
                         self.wfile.write(
                             f'data: {json.dumps(done)}\n\n'.encode())
                         break
+
+            def _replay_stream(self, cached, is_text, tok) -> None:
+                """Replay a completed keyed request as one SSE burst —
+                the duplicate of an already-answered request streams
+                the SAME tokens, never a second execution."""
+                try:
+                    self.send_response(200)
+                    self.send_header('Content-Type', 'text/event-stream')
+                    self.send_header('Cache-Control', 'no-cache')
+                    self.send_header('Connection', 'close')
+                    self.end_headers()
+                    for t in cached.get('tokens', []):
+                        event = {'token': int(t)}
+                        if is_text:
+                            event['text'] = tok.decode([int(t)])
+                        self.wfile.write(
+                            f'data: {json.dumps(event)}\n\n'.encode())
+                    done = dict(cached, done=True, deduped=True)
+                    self.wfile.write(
+                        f'data: {json.dumps(done)}\n\n'.encode())
+                    self.wfile.flush()
+                except (BrokenPipeError, ConnectionResetError):
+                    pass    # replay consumer vanished; nothing to free
+                finally:
+                    self.close_connection = True
 
             # ---------------- OpenAI-compatible surface ----------------
             # The reference's serving recipes expose vLLM's OpenAI API
@@ -788,12 +973,24 @@ class ModelServer:
 
             def do_POST(self):  # noqa: N802
                 routes = ('/generate', '/v1/completions',
-                          '/v1/chat/completions')
+                          '/v1/chat/completions', '/drain')
                 if self.path not in routes:
                     self._json(404, {'error': f'no route {self.path}'})
                     return
+                if self.path == '/drain':
+                    length = int(self.headers.get('Content-Length', 0))
+                    try:
+                        payload = (json.loads(self.rfile.read(length))
+                                   if length else {})
+                    except json.JSONDecodeError:
+                        self._json(400, {'error': 'bad json'})
+                        return
+                    self._json(200, server.begin_drain(
+                        payload.get('deadline_s')))
+                    return
                 if not server._ready.is_set():
-                    self._json(503, {'status': 'loading'})
+                    self._json(503, {'status': 'loading'},
+                               extra_headers={'Retry-After': '5'})
                     return
                 if self.path != '/generate':
                     length = int(self.headers.get('Content-Length', 0))
@@ -822,6 +1019,18 @@ class ModelServer:
                     is_text = isinstance(prompt, str)
                     if is_text:
                         prompt = tok.encode(prompt)
+                    key = self._request_key(payload)
+                    cached = server.lookup_request_key(key)
+                    if cached is not None:
+                        # Idempotent replay: the key already completed
+                        # here — return the SAME answer instead of
+                        # executing a second time (the one-answer
+                        # guarantee behind the LB's hedged retry).
+                        if payload.get('stream'):
+                            self._replay_stream(cached, is_text, tok)
+                        else:
+                            self._json(200, dict(cached, deduped=True))
+                        return
                     kwargs = self._parse_sampling(payload, tok)
                     kwargs['tier'] = self._slo_tier(payload)
                     # /generate's legacy defaults: eos only applies to
@@ -829,11 +1038,13 @@ class ModelServer:
                     if 'eos_id' not in payload and not is_text:
                         kwargs['eos_id'] = None
                     if payload.get('stream'):
-                        self._stream_generate(prompt, is_text, kwargs)
+                        self._stream_generate(prompt, is_text, kwargs,
+                                              key)
                         return
                     result = server.submit(prompt, **kwargs)
                     if is_text:
                         result['text'] = tok.decode(result['tokens'])
+                    server.record_request_key(key, result)
                     self._json(200, result)
                 except (KeyError, ValueError, TypeError,
                         json.JSONDecodeError) as e:
@@ -944,6 +1155,17 @@ def main() -> None:
                         help='share of admitted work tokens reserved '
                              'for the latency tier while both tiers '
                              'are backlogged (0..1, exclusive)')
+    parser.add_argument('--drain-deadline-s', type=float, default=30.0,
+                        help='graceful-drain deadline (seconds): on '
+                             'POST /drain new requests get a retryable '
+                             '503 + Retry-After while in-flight ones '
+                             'run to completion; stragglers past the '
+                             'deadline are failed over (retryable)')
+    parser.add_argument('--fault-spec', default=None,
+                        help='deterministic fault-injection spec (JSON '
+                             'or @/path/to/spec.json; default: the '
+                             'SKYTPU_FAULT_SPEC env var). Unset = '
+                             'injection compiled out of the hot path')
     parser.add_argument('--max-batch', type=int, default=8)
     parser.add_argument('--max-seq', type=int, default=1024)
     parser.add_argument('--port', type=int,
@@ -965,7 +1187,9 @@ def main() -> None:
                          speculate_k=args.speculate_k,
                          slo_tier_default=args.slo_tier_default,
                          max_queue_tokens=args.max_queue_tokens,
-                         latency_admit_frac=args.latency_admit_frac)
+                         latency_admit_frac=args.latency_admit_frac,
+                         drain_deadline_s=args.drain_deadline_s,
+                         fault_spec=args.fault_spec)
     server.start(block=True)
 
 

@@ -431,6 +431,83 @@ def test_gc110_only_applies_to_compute_dirs():
     assert rule_ids(src, 'skypilot_tpu/serve/x.py') == []
 
 
+# ------------------------------------------------------------------ GC112
+def test_gc112_fixed_sleep_in_retry_loop_flagged():
+    src = '''
+    import time
+    GAP = 5.0
+    def poll():
+        while True:
+            time.sleep(0.2)
+    def poll_const(deadline):
+        while time.time() < deadline:
+            time.sleep(GAP)
+    '''
+    vs = check(src)
+    assert [v.rule for v in vs] == ['GC112', 'GC112']
+    assert 'retry storms' in vs[0].message
+    # jobs/ is policed too.
+    assert rule_ids(src, 'skypilot_tpu/jobs/x.py') == \
+        ['GC112', 'GC112']
+
+
+def test_gc112_jitter_and_backoff_clean():
+    src = '''
+    import random, time
+    def jittered(poll_seconds):
+        while True:
+            time.sleep(poll_seconds * (0.5 + random.random()))
+    def rng_method(self, interval):
+        while True:
+            time.sleep(interval * (0.5 + self._rng.random()))
+    def backoff():
+        gap = 1.0
+        while True:
+            time.sleep(gap)
+            gap = min(gap * 2, 300)
+    def event_wait(stop, tick):
+        while not stop.is_set():
+            stop.wait(tick)
+    def dynamic_accessor(tc):
+        while True:
+            time.sleep(tc.poll_interval())
+    '''
+    assert rule_ids(src) == []
+
+
+def test_gc112_other_dirs_and_non_loop_sleeps_exempt():
+    src = '''
+    import time
+    def poll():
+        while True:
+            time.sleep(0.2)
+    '''
+    assert rule_ids(src, 'skypilot_tpu/provision/x.py') == []
+    src_no_loop = '''
+    import time
+    def settle():
+        time.sleep(0.5)
+    '''
+    assert rule_ids(src_no_loop) == []
+
+
+def test_gc112_suppression_and_for_loops():
+    src = '''
+    import time
+    def retry(urls):
+        for u in urls:
+            time.sleep(1.0)
+    '''
+    assert rule_ids(src) == ['GC112']
+    suppressed = '''
+    import time
+    def retry(urls):
+        for u in urls:
+            time.sleep(1.0)  # graftcheck: disable=GC112
+    '''
+    assert rule_ids(suppressed) == []
+
+
 # ------------------------------------------------------------------ GC201
 def test_gc201_impure_calls_inside_jit():
     src = '''

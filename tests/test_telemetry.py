@@ -360,6 +360,27 @@ def test_server_prometheus_metrics_and_debug_requests():
                     and f'tier="{tier}"' in prom)
         assert '# TYPE skytpu_sched_shed_total counter' in prom
         assert '# TYPE skytpu_sched_queue_tokens gauge' in prom
+
+        # (b3) Robustness series (round 7): faults / migrations /
+        # drain / recovery all register at construction — every series
+        # renders as zeros from the first scrape even though no fault,
+        # migration or drain ever happened on this server.
+        from skypilot_tpu.serve import faults as faults_lib
+        assert '# TYPE skytpu_faults_injected_total counter' in prom
+        for kind in faults_lib.FAULT_KINDS:
+            assert (f'skytpu_faults_injected_total{{kind="{kind}"}} 0'
+                    in prom), kind
+        assert '# TYPE skytpu_requests_migrated_total counter' in prom
+        for outcome in faults_lib.MIGRATION_OUTCOMES:
+            assert ('skytpu_requests_migrated_total'
+                    f'{{outcome="{outcome}"}} 0' in prom), outcome
+        assert '# TYPE skytpu_replica_drain_seconds histogram' in prom
+        assert 'skytpu_replica_drain_seconds_bucket{le="+Inf"} 0' \
+            in prom
+        assert '# TYPE skytpu_replica_recovery_seconds histogram' \
+            in prom
+        assert 'skytpu_replica_recovery_seconds_bucket{le="+Inf"} 0' \
+            in prom
         # JSON: per-tier latency quantile keys always present and
         # numeric — zeros for the tier no request used.
         assert set(m['sched']['tiers']) == set(sched_lib.TIERS)
