@@ -49,12 +49,36 @@ def _cmd_audit(args: argparse.Namespace) -> int:
     except ImportError:
         print('graftcheck audit requires jax (the compute extra)')
         return 2
-    reports = jaxpr_audit.run_presets(args.preset or None)
+    names = args.preset or list(jaxpr_audit.DEFAULT_PRESETS)
+    # Multi-device presets (paged-tp*) need >= N devices; on a
+    # single-device environment re-exec JUST those in a subprocess
+    # with a forced virtual CPU device count (the env must be set
+    # before jax initializes — this process's backend is already
+    # pinned). Same bootstrap as __graft_entry__.dryrun_multichip.
+    local = [n for n in names
+             if jax.device_count()
+             >= jaxpr_audit.MULTI_DEVICE_PRESETS.get(n, 1)]
+    remote = [n for n in names if n not in local]
     rc = 0
-    for rep in reports:
+    for rep in jaxpr_audit.run_presets(local) if local else []:
         print(rep.format())
         if not rep.ok():
             rc = 1
+    if remote:
+        import os
+        import subprocess
+        n_dev = max(jaxpr_audit.MULTI_DEVICE_PRESETS[n] for n in remote)
+        env = dict(os.environ)
+        env['XLA_FLAGS'] = (env.get('XLA_FLAGS', '') +
+                            f' --xla_force_host_platform_device_count='
+                            f'{n_dev}').strip()
+        env['JAX_PLATFORMS'] = 'cpu'
+        cmd = [sys.executable, '-m', 'skypilot_tpu.analysis.cli',
+               'audit'] + [x for n in remote for x in ('--preset', n)]
+        print(f'graftcheck audit: re-exec for {remote} on a '
+              f'{n_dev}-device virtual CPU mesh')
+        proc = subprocess.run(cmd, env=env)
+        rc = rc or proc.returncode
     return rc
 
 

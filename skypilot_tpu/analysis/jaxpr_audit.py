@@ -62,6 +62,16 @@ class AuditReport:
     f64_promotions: List[str] = dataclasses.field(default_factory=list)
     donation_warnings: List[str] = dataclasses.field(
         default_factory=list)
+    # Collective-instruction census of the steady-state decode chain's
+    # compiled HLO (mesh presets only): program label -> {op: count}.
+    # The zero-resharding contract: no all-to-all / collective-permute
+    # anywhere, and all-gathers bounded by the KNOWN decode set (the
+    # tp-sharded argmax's tiny top-candidate gathers) — a pool- or
+    # activation-shaped gather appearing here means a step's output
+    # sharding stopped matching the next step's input sharding.
+    collectives: Dict[str, Dict[str, int]] = dataclasses.field(
+        default_factory=dict)
+    allowed_all_gathers: int = 2
 
     @property
     def unsanctioned_transfers(self) -> List[TransferEvent]:
@@ -72,11 +82,24 @@ class AuditReport:
         return {k: after - before
                 for k, (before, after) in self.compile_counts.items()}
 
+    def collective_violations(self) -> List[str]:
+        out = []
+        for label, counts in self.collectives.items():
+            for op in ('all-to-all', 'collective-permute'):
+                if counts.get(op, 0):
+                    out.append(f'{label}: {counts[op]} {op}')
+            gathers = counts.get('all-gather', 0)
+            if gathers > self.allowed_all_gathers:
+                out.append(f'{label}: {gathers} all-gather(s) > '
+                           f'{self.allowed_all_gathers} known')
+        return out
+
     def ok(self) -> bool:
         return (not self.unsanctioned_transfers
                 and not any(self.recompiles.values())
                 and not self.callback_prims
-                and not self.f64_promotions)
+                and not self.f64_promotions
+                and not self.collective_violations())
 
     def format(self) -> str:
         lines = [f'jaxpr audit: {self.name} — '
@@ -105,6 +128,11 @@ class AuditReport:
                          f'{self.f64_promotions}')
         if self.donation_warnings:
             lines.append(f'  donation misses: {self.donation_warnings}')
+        for label, counts in self.collectives.items():
+            lines.append(f'  collectives [{label}]: '
+                         f'{dict(sorted(counts.items())) or "none"}')
+        for v in self.collective_violations():
+            lines.append(f'  RESHARDING COLLECTIVE: {v}')
         return '\n'.join(lines)
 
 
@@ -270,23 +298,40 @@ def _jit_fns(fn) -> List[Any]:
 # ------------------------------------------------------------------ presets
 def _tiny_engine(kind: str, chunked: bool, speculate_k: int = 0,
                  telemetry: bool = True,
-                 kv_cache_dtype: Optional[str] = None):
+                 kv_cache_dtype: Optional[str] = None,
+                 mesh_tp: int = 0):
     from skypilot_tpu.models import configs
     cfg = configs.get_config('tiny')
     chunk = 16 if chunked else 0
+    extra: Dict[str, Any] = {}
+    if mesh_tp and mesh_tp > 1:
+        import jax
+
+        from skypilot_tpu.parallel import mesh as mesh_lib
+        if jax.device_count() < mesh_tp:
+            # LOUD: a single-device environment must fail the preset
+            # with the fix in the message, not silently audit tp=1.
+            raise RuntimeError(
+                f'mesh preset needs {mesh_tp} devices but only '
+                f'{jax.device_count()} visible; run under '
+                f'XLA_FLAGS=--xla_force_host_platform_device_count='
+                f'{mesh_tp} JAX_PLATFORMS=cpu (the graftcheck CLI '
+                'does this re-exec automatically)')
+        extra['mesh'] = mesh_lib.serving_mesh(tp=mesh_tp)
+        extra['attn_impl'] = 'xla'
     if kind == 'paged':
         from skypilot_tpu.inference.paged import PagedInferenceEngine
         return PagedInferenceEngine(cfg, max_batch=4, max_seq=128,
                                     prefill_chunk_tokens=chunk or None,
                                     speculate_k=speculate_k,
                                     kv_cache_dtype=kv_cache_dtype,
-                                    telemetry=telemetry)
+                                    telemetry=telemetry, **extra)
     from skypilot_tpu.inference.engine import InferenceEngine
     return InferenceEngine(cfg, max_batch=4, max_seq=128,
                            prefill_chunk_tokens=chunk,
                            speculate_k=speculate_k,
                            kv_cache_dtype=kv_cache_dtype,
-                           telemetry=telemetry)
+                           telemetry=telemetry, **extra)
 
 
 def _drive(engine, prompts: List[List[int]], max_new: int = 8) -> None:
@@ -295,12 +340,16 @@ def _drive(engine, prompts: List[List[int]], max_new: int = 8) -> None:
     engine.run_to_completion(horizon=8)
 
 
-def _record_static_keys(engine, report: AuditReport):
+def _record_static_keys(engine, report: AuditReport,
+                        capture: Optional[Dict[str, Any]] = None):
     """Shim the engine's decode fn to log the static args of each call
     — the (horizon, sample[, kv_bucket]) tuple IS the recompile key the
     scheduler must keep stable. The slot engine's decode takes
     (..., horizon, sample, kv_bucket); the paged engine's
-    (..., horizon, sample) — both pass them as trailing positionals."""
+    (..., horizon, sample) — both pass them as trailing positionals.
+    ``capture`` (optional dict) additionally records each call's full
+    argument avals+shardings — what the mesh presets re-lower the
+    steady-state decode chain from for the collective census."""
     inner = engine._decode_fn
     names = (('horizon', 'sample')
              if type(engine).__name__.startswith('Paged')
@@ -313,15 +362,89 @@ def _record_static_keys(engine, report: AuditReport):
             tail = args[len(args) - len(missing):]
             key.update(dict(zip(missing, tail)))
         report.static_keys.append(key)
+        if capture is not None:
+            capture['args'] = _arg_structs(args)
         return inner(*args, **kwargs)
 
     engine._decode_fn = shim
     return inner
 
 
+def _arg_structs(args):
+    """args -> ShapeDtypeStructs carrying mesh shardings. Committed
+    NamedSharding args (params, cache, the pinned ring) keep their
+    sharding; per-call host uploads (single-device placed) become
+    unspecified, exactly how the real call presents them to jit.
+    Structs, not arrays: donated buffers in ``args`` are dead by the
+    time the census lowers from them."""
+    import jax
+    from jax.sharding import NamedSharding
+
+    def struct(a):
+        if isinstance(a, jax.Array):
+            sh = (a.sharding if isinstance(a.sharding, NamedSharding)
+                  else None)
+            return jax.ShapeDtypeStruct(a.shape, a.dtype, sharding=sh)
+        return a
+
+    return jax.tree.map(struct, args)
+
+
+_COLLECTIVE_RE = None
+
+
+def _count_collectives(hlo_text: str) -> Dict[str, int]:
+    """Instruction-level census of communication ops in compiled HLO
+    (matches the op at its defining instruction only, so async
+    start/done pairs and textual mentions don't double-count)."""
+    global _COLLECTIVE_RE
+    import collections
+    import re
+    if _COLLECTIVE_RE is None:
+        _COLLECTIVE_RE = re.compile(
+            r'= \S+ (all-reduce|all-gather|all-to-all'
+            r'|collective-permute|reduce-scatter)(?:-start)?\(')
+    return dict(collections.Counter(
+        m.group(1) for m in _COLLECTIVE_RE.finditer(hlo_text)))
+
+
+def _decode_chain_collectives(engine, inner, captured
+                              ) -> Dict[str, Dict[str, int]]:
+    """Compile-and-census the steady-state decode chain from the last
+    captured call's arg structs: the slot engine's fused decode is one
+    jitted program; the paged engine's chain is (decode_steps, merge)
+    behind a plain wrapper — the merge's ring operands are
+    reconstructed at the pinned ``_ring_sh`` sharding (decode's output
+    sharding IS merge's input sharding — the contract under test)."""
+    import jax
+    args = captured.get('args')
+    if args is None:
+        return {}
+    out: Dict[str, Dict[str, int]] = {}
+    for fn in _jit_fns(inner):
+        try:
+            txt = fn.lower(*args).compile().as_text()
+            out['decode'] = _count_collectives(txt)
+            continue
+        except TypeError:
+            pass        # the paged merge: different signature
+        cache, table, lengths, active = args[1], args[2], args[4], args[9]
+        horizon = args[10]
+        cfg = engine.cfg
+        ring = jax.ShapeDtypeStruct(
+            (cfg.n_layers, engine.max_batch, horizon, cfg.n_kv_heads,
+             cfg.head_dim), cfg.dtype,
+            sharding=getattr(engine, '_ring_sh', None))
+        txt = fn.lower(cache, ring, ring, table, lengths,
+                       active).compile().as_text()
+        out['merge'] = _count_collectives(txt)
+    return out
+
+
 def audit_engine(kind: str = 'slot', chunked: bool = True,
                  rounds: int = 2, speculate_k: int = 0,
-                 kv_cache_dtype: Optional[str] = None) -> AuditReport:
+                 kv_cache_dtype: Optional[str] = None,
+                 mesh_tp: int = 0) -> AuditReport:
     """Build a tiny engine, run one warmup wave (compiles allowed),
     then audit ``rounds`` identical same-shaped waves: every compile
     and every unsanctioned host transfer in those waves is a violation.
@@ -333,16 +456,27 @@ def audit_engine(kind: str = 'slot', chunked: bool = True,
     steady state on REPETITIVE prompts (so proposals actually fire and
     acceptance varies per slot): the verify jit cache must stay bounded
     by the observed (k, sample, kv_bucket) key set, and the only host
-    readback per round is the sanctioned commit sync."""
+    readback per round is the sanctioned commit sync.
+
+    ``mesh_tp >= 2`` audits the SHARDED serving path on a (tp,) CPU
+    mesh (forced host platform device count): the same transfer/
+    recompile gates, plus a collective census of the compiled decode
+    chain — no all-to-all / collective-permute, and no all-gathers
+    beyond the known decode set (the tp-sharded argmax's tiny top-
+    candidate gathers). This is the zero-resharding contract: every
+    step's pinned output shardings ARE the next step's input
+    shardings, so a fat gather here means the chain broke."""
     spec_tag = f' + speculate_k={speculate_k}' if speculate_k else ''
     kv_tag = (f' + kv_cache_dtype={kv_cache_dtype}'
               if kv_cache_dtype else '')
+    tp_tag = f' + tp={mesh_tp}' if mesh_tp else ''
     report = AuditReport(
         name=f'{kind} engine '
              f'({"chunked prefill + " if chunked else ""}decode'
-             f'{spec_tag}{kv_tag})')
+             f'{spec_tag}{kv_tag}{tp_tag})')
     engine = _tiny_engine(kind, chunked, speculate_k,
-                          kv_cache_dtype=kv_cache_dtype)
+                          kv_cache_dtype=kv_cache_dtype,
+                          mesh_tp=mesh_tp)
     if speculate_k:
         # Repetitive prompts: the n-gram proposer matches, acceptance
         # is nonzero AND per-slot variable — the masked-commit shapes
@@ -351,7 +485,9 @@ def audit_engine(kind: str = 'slot', chunked: bool = True,
     else:
         prompts = [[1, 2, 3] * 9, [4, 5] * 10, [7] * 21]  # >1 chunk
     _drive(engine, prompts)                             # warmup: compiles
-    inner = _record_static_keys(engine, report)
+    capture: Dict[str, Any] = {}
+    inner = _record_static_keys(engine, report,
+                                capture if mesh_tp else None)
     decode_jits = _jit_fns(inner)
     labels = {'decode': lambda: (sum(_cache_size(f)
                                      for f in decode_jits)
@@ -380,6 +516,9 @@ def audit_engine(kind: str = 'slot', chunked: bool = True,
             dict(zip(names, key)) for key in sorted(spec_fns))
     report.compile_counts = {
         k: (before[k], get()) for k, get in labels.items()}
+    if mesh_tp:
+        report.collectives = _decode_chain_collectives(
+            engine, inner, capture)
     # Jaxpr of the fused decode step itself (the hot program).
     try:
         import jax
@@ -479,11 +618,32 @@ PRESETS: Dict[str, Callable[[], AuditReport]] = {
                                     kv_cache_dtype='int8'),
     'kv-int8-slot': lambda: audit_engine('slot', chunked=True,
                                          kv_cache_dtype='int8'),
+    # Sharded serving path (tp=2 CPU mesh): chunked prefill + decode +
+    # ring merge over the head-sharded pool — zero steady-state
+    # recompiles, zero unsanctioned d2h, and no resharding collectives
+    # (no all-to-all; all-gathers bounded by the known sharded-argmax
+    # pair). Needs >= 2 devices — the graftcheck CLI re-execs under a
+    # forced host platform device count when short.
+    'paged-tp': lambda: audit_engine('paged', chunked=True, mesh_tp=2),
+    'paged-tp-int8': lambda: audit_engine('paged', chunked=True,
+                                          mesh_tp=2,
+                                          kv_cache_dtype='int8'),
     'llama': audit_llama_forward,
 }
 
+# Presets that need a multi-device backend: preset -> device count.
+# The CLI (and any other single-device driver) re-execs these under
+# XLA_FLAGS=--xla_force_host_platform_device_count=<n>.
+MULTI_DEVICE_PRESETS: Dict[str, int] = {
+    'paged-tp': 2,
+    'paged-tp-int8': 2,
+}
+
+DEFAULT_PRESETS: List[str] = [
+    'slot', 'paged', 'slot-spec', 'paged-spec', 'telemetry',
+    'kv-int8', 'kv-int8-slot', 'paged-tp', 'paged-tp-int8', 'llama']
+
 
 def run_presets(names: Optional[List[str]] = None) -> List[AuditReport]:
-    names = names or ['slot', 'paged', 'slot-spec', 'paged-spec',
-                      'telemetry', 'kv-int8', 'kv-int8-slot', 'llama']
+    names = names or list(DEFAULT_PRESETS)
     return [PRESETS[n]() for n in names]

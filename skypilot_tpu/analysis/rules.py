@@ -128,6 +128,14 @@ RULES: Dict[str, str] = {
              'add exponential backoff and/or jitter, or wait on an '
              'Event with a timeout (fixed sleeps synchronize retry '
              'storms across the fleet)',
+    'GC113': 'device-put-in-step-path: jax.device_put inside an '
+             'inference/ step function — an implicit cross-mesh '
+             'reshard of committed device state silently inserts '
+             'collectives (or a full device round trip) into the hot '
+             'loop. Host->device uploads of freshly built numpy '
+             'operands go through utils.host.device_upload; placement '
+             '(construction-time sharding) belongs in prepare_params '
+             'or engine __init__',
     'GC201': 'impure-jit: impure or host-synchronizing call inside a '
              '@jax.jit body',
     'GC202': 'host-sync: device->host readback outside the '
@@ -630,6 +638,8 @@ class _Checker(ast.NodeVisitor):
             # Applies inside jit bodies too — int8 KV writes live in
             # the jitted prefill/decode scans.
             self._check_int8_write(node, method)
+        if self.is_inference:
+            self._check_device_put(node, name)
         if self.is_serve and self._in_async:
             self._check_async_engine_call(node, name, method)
         if self._any_lock_held():
@@ -641,6 +651,30 @@ class _Checker(ast.NodeVisitor):
             if self.is_inference:
                 self._check_adhoc_timing(node, name)
         self.generic_visit(node)
+
+    # Functions where jax.device_put IS the sanctioned spelling:
+    # construction-time placement of params and caches (runs once, off
+    # the step path). Everything else in inference/ uses
+    # utils.host.device_upload (h2d-only by contract) — or is a bug.
+    _PLACEMENT_FUNCS = ('prepare_params', '__init__', 'from_pretrained')
+
+    def _check_device_put(self, node: ast.Call, name: str) -> None:
+        """GC113: bare ``jax.device_put`` in an inference/ step path.
+        On a committed (mesh-sharded) array device_put is an implicit
+        RESHARD — a collective (or full host round trip) the zero-
+        resharding steady-state contract bans; on host operands it is
+        an upload that must use the auditable ``device_upload``
+        spelling instead."""
+        if name != 'jax.device_put':
+            return
+        if any(s in self._PLACEMENT_FUNCS for s in self._scope):
+            return
+        self._add('GC113', node,
+                  'jax.device_put outside the sanctioned placement '
+                  'helpers (prepare_params / __init__ / '
+                  'from_pretrained) — use utils.host.device_upload '
+                  'for per-step host uploads; resharding committed '
+                  'state in the step path is banned')
 
     def _check_int8_write(self, node: ast.Call, method: str) -> None:
         """GC110: ``x.astype(jnp.int8)`` / ``x.astype('int8')`` outside

@@ -549,6 +549,14 @@ def serve_logs(service_name, no_follow):
 @click.option('--quantize', default=None, type=click.Choice(['int8']),
               help='int8 weights (KV cache follows via '
                    '--kv-cache-dtype auto; 2x decode).')
+@click.option('--tp', type=int, default=None,
+              help='Tensor-parallel degree (shard weights + KV heads '
+                   'over tp chips; ~linear decode TPOT win). Default: '
+                   'SKYTPU_TP env, else 1.')
+@click.option('--dp', type=int, default=None,
+              help='Data-parallel degree (decode batch over chip '
+                   'groups; aggregate tok/s). Default: SKYTPU_DP env, '
+                   'else 1.')
 @click.option('--kv-cache', default='paged',
               type=click.Choice(['slot', 'paged']),
               help='paged (default) = shared page pool with prefix '
@@ -594,11 +602,12 @@ def serve_logs(service_name, no_follow):
 @click.option('--max-batch', type=int, default=8)
 @click.option('--max-seq', type=int, default=1024)
 @click.option('--port', type=int, default=8081)
-def model_server(model, model_path, quantize, kv_cache, kv_cache_dtype,
-                 page_size, prefill_chunk_tokens, decode_priority_ratio,
-                 prefill_w8a8, speculate_k, slo_tier_default,
-                 max_queue_tokens, latency_admit_frac, drain_deadline_s,
-                 fault_spec, max_batch, max_seq, port):
+def model_server(model, model_path, quantize, tp, dp, kv_cache,
+                 kv_cache_dtype, page_size, prefill_chunk_tokens,
+                 decode_priority_ratio, prefill_w8a8, speculate_k,
+                 slo_tier_default, max_queue_tokens, latency_admit_frac,
+                 drain_deadline_s, fault_spec, max_batch, max_seq,
+                 port):
     """Run the in-tree replica model server on this host (the process
     a service task's ``run`` command starts on each replica; same
     knobs as ``python -m skypilot_tpu.serve.server``)."""
@@ -608,7 +617,8 @@ def model_server(model, model_path, quantize, kv_cache, kv_cache_dtype,
     from skypilot_tpu.serve.server import ModelServer
     server = ModelServer(model, max_batch=max_batch, max_seq=max_seq,
                          port=port, model_path=model_path,
-                         quantize=quantize, kv_cache=kv_cache,
+                         quantize=quantize, tp=tp, dp=dp,
+                         kv_cache=kv_cache,
                          kv_cache_dtype=kv_cache_dtype,
                          page_size=page_size,
                          prefill_w8a8=prefill_w8a8,
@@ -621,7 +631,8 @@ def model_server(model, model_path, quantize, kv_cache, kv_cache_dtype,
                          drain_deadline_s=drain_deadline_s,
                          fault_spec=fault_spec)
     click.echo(f'Model server on :{port} '
-               f'(kv_cache={kv_cache}, speculate_k={speculate_k})')
+               f'(kv_cache={kv_cache}, speculate_k={speculate_k}, '
+               f'tp={server.tp}, dp={server.dp})')
     server.start(block=True)
 
 

@@ -41,7 +41,7 @@ from skypilot_tpu.models.configs import ModelConfig
 from skypilot_tpu.parallel import mesh as mesh_lib
 from skypilot_tpu.telemetry import clock
 from skypilot_tpu.telemetry import tracing
-from skypilot_tpu.utils.host import host_sync
+from skypilot_tpu.utils.host import device_upload, host_sync
 
 
 @dataclasses.dataclass
@@ -93,11 +93,16 @@ def _bucket_len(n: int, minimum: int = 64) -> int:
     return b
 
 
-def _ring_row_bytes(cfg, batch: int) -> int:
+def _ring_row_bytes(cfg, batch: int, mesh=None) -> int:
     """Bytes of ONE horizon-step's ring rows (k+v across all layers) —
-    the ring stays in model dtype regardless of cache quantization."""
+    the ring stays in model dtype regardless of cache quantization.
+    With a mesh, PER-DEVICE bytes: the ring's kv-head dim shards over
+    tp like the cache it merges into (batch sharding is NOT credited —
+    the paged ring rides a replicated batch, so dividing by dp would
+    under-reserve)."""
     return (cfg.n_layers * batch * cfg.n_kv_heads * cfg.head_dim *
-            jnp.dtype(cfg.dtype).itemsize * 2)
+            jnp.dtype(cfg.dtype).itemsize * 2
+            ) // kv_shard_degree(cfg, mesh)
 
 
 _RING_BYTES_CAP = int(1e9)
@@ -122,19 +127,40 @@ def resolve_kv_cache_dtype(kv_cache_dtype: Optional[str],
     return kv_cache_dtype
 
 
-def kv_token_bytes(cfg, quantized: bool) -> int:
+def kv_shard_degree(cfg, mesh=None) -> int:
+    """How many ways the stored KV-head dimension actually splits over
+    the mesh: the tp axis size when it divides ``n_kv_heads``, else 1 —
+    mirroring ``mesh_lib.spec_for``'s divisibility fallback, which
+    replicates KV heads for MQA/GQA models with ``n_kv_heads < tp``.
+    THE divisor per-shard KV byte accounting rides; using the raw tp
+    size would claim HBM savings the sharding rules never delivered."""
+    from skypilot_tpu.parallel import mesh as mesh_lib
+    return mesh_lib.axis_shard_degree(
+        mesh, mesh_lib.DEFAULT_RULES['kv_heads'], cfg.n_kv_heads)
+
+
+def kv_token_bytes(cfg, quantized: bool, mesh=None) -> int:
     """Stored bytes of ONE cached token: k+v rows across all layers,
     per-row fp32 scales included for int8 caches. THE per-token cost
     every capacity decision rides — paged pool sizing, the prefill
     stacked-rows caps, preemption accounting, and the telemetry
     capacity gauges — so int8 KV's halved cost shows up everywhere at
-    once instead of drifting per call site."""
+    once instead of drifting per call site.
+
+    ``mesh`` (optional) makes the cost PER-SHARD: the kv-head dim
+    shards over tp, so one device stores ``1/tp`` of every token's
+    rows. HBM-budget decisions (pool auto-sizing, prefill stack caps)
+    must pass the mesh; token-capacity surfaces (pool stats, scheduler
+    bounds) stay global — a token is a token regardless of how many
+    chips hold its rows."""
     row_w = (cfg.head_dim + 4 if quantized
              else cfg.head_dim * jnp.dtype(cfg.dtype).itemsize)
-    return cfg.n_layers * cfg.n_kv_heads * row_w * 2
+    return (cfg.n_layers * cfg.n_kv_heads * row_w * 2
+            ) // kv_shard_degree(cfg, mesh)
 
 
-def _ring_horizon_cap(cfg, batch: int, param_bytes: int) -> int:
+def _ring_horizon_cap(cfg, batch: int, param_bytes: int,
+                      mesh=None) -> int:
     """Longest sensible fused-decode horizon: the ring re-read must stay
     under ~15% of the weight stream AND the ring buffers under ~1 GB
     (at batch 48 on a 7B the 15% rule alone allowed a 1.6 GB ring that
@@ -143,7 +169,7 @@ def _ring_horizon_cap(cfg, batch: int, param_bytes: int) -> int:
     horizons below ~32 pay more in dispatch than a bigger ring costs in
     re-reads (a 512 MB cap that forced h=16 at batch 48 added ~5 ms to
     every step)."""
-    row = _ring_row_bytes(cfg, batch)
+    row = _ring_row_bytes(cfg, batch, mesh)
     return max(8, min(int(0.15 * param_bytes / row),
                       _RING_BYTES_CAP // row))
 
@@ -267,6 +293,21 @@ class _EngineBase:
         self._merge_tokens = jax.jit(
             lambda tok, slots, vals: tok.at[slots].set(vals))
 
+    def _step_out_shardings(self, n_lead: int) -> Dict[str, Any]:
+        """jit kwargs pinning a step program's CACHE output to the
+        cache's own sharding tree (``_cache_sh``), preceded by
+        ``n_lead`` unpinned outputs (tokens/commit counts — GSPMD
+        infers those). This is the zero-resharding contract: every
+        program that returns the cache emits it in exactly the layout
+        the next program consumes it in, so chained steps never insert
+        a resharding collective. Empty (no kwargs) for meshless
+        engines — the single-chip path stays untouched."""
+        sh = getattr(self, '_cache_sh', None)
+        if sh is None:
+            return {}
+        out = sh if n_lead == 0 else (None,) * n_lead + (sh,)
+        return {'out_shardings': out}
+
     def _slot_meta(self, ready: List[Optional[Request]]):
         """Device copies of the per-slot sampling params + active mask,
         rebuilt only when the slot table changed (``_meta_dirty``) —
@@ -357,6 +398,13 @@ class _EngineBase:
     # engine overrides this with a live counter. One spelling so the
     # telemetry/bench surfaces read the same attribute off either.
     preemptions = 0
+
+    def mesh_axes(self) -> Dict[str, int]:
+        """{axis: size} of this engine's mesh (all 1s when meshless) —
+        the stable-schema payload behind ``skytpu_mesh_shape{axis=}``,
+        the JSON ``mesh`` block, and the LB's replica view."""
+        from skypilot_tpu.parallel import mesh as mesh_lib
+        return mesh_lib.mesh_axis_sizes(getattr(self, 'mesh', None))
 
     @property
     def num_active(self) -> int:
@@ -616,11 +664,14 @@ class InferenceEngine(SpeculativeMixin, _EngineBase):
             cfg, params, quantize=quantize, mesh=mesh,
             donate_params=donate_params)
         self.cfg = cfg
-        # Actual stored parameter bytes (int8 leaves count 1B/elem) —
-        # sizes the decode-horizon ring cap against the true weight
-        # stream, not a bf16 assumption.
+        # Actual PER-DEVICE stored parameter bytes (int8 leaves count
+        # 1B/elem; sharded leaves count their local shard) — sizes the
+        # decode-horizon ring cap against the true per-chip weight
+        # stream: under tp both the weight stream and the ring rows
+        # split, so the cap stays put instead of drifting with mesh
+        # shape.
         from skypilot_tpu.models import quantization
-        self._param_bytes = quantization.quantized_bytes(self.params)
+        self._param_bytes = quantization.per_device_bytes(self.params)
 
         # KV storage dtype is its OWN knob (decoupled from the weight
         # quantize mode; None follows it for backward compatibility):
@@ -632,11 +683,20 @@ class InferenceEngine(SpeculativeMixin, _EngineBase):
         self.cache = llama.KVCache.create(
             cfg, batch=max_batch, max_seq=max_seq,
             quantized=self.kv_cache_dtype == 'int8')
+        # Pre-partitioned cache + pinned output shardings: the cache is
+        # device_put ONCE with its logical-axis shardings, and every
+        # jitted step that returns it pins the SAME tree as its
+        # out_shardings — each program's output layout IS the next
+        # program's input layout (the pjit in/out_axis_resources-
+        # matching discipline), so steady state never inserts a
+        # resharding collective between steps. None (meshless) skips
+        # the machinery entirely.
+        self._cache_sh = None
         if mesh is not None:
-            cache_sh = mesh_lib.tree_shardings(
+            self._cache_sh = mesh_lib.tree_shardings(
                 llama.cache_logical_axes(quantized=self.cache.quantized),
                 mesh, shapes=self.cache)
-            self.cache = jax.device_put(self.cache, cache_sh)
+            self.cache = jax.device_put(self.cache, self._cache_sh)
 
         # slot bookkeeping (host side); device cache.length is
         # authoritative for attention masking.
@@ -699,6 +759,12 @@ class InferenceEngine(SpeculativeMixin, _EngineBase):
             'preemptions': int(self.preemptions),
             'kv_token_bytes': kv_token_bytes(self.cfg,
                                              self.cache.quantized),
+            # Bytes ONE device stores per token (kv heads shard over
+            # tp) — the per-shard HBM view; token counts above stay
+            # GLOBAL (a token is a token however many chips hold it).
+            'kv_token_bytes_per_shard': kv_token_bytes(
+                self.cfg, self.cache.quantized, mesh=self.mesh),
+            'kv_shards': kv_shard_degree(self.cfg, self.mesh),
         }
 
     # ------------------------------------------------------------------
@@ -716,7 +782,8 @@ class InferenceEngine(SpeculativeMixin, _EngineBase):
 
         @functools.partial(jax.jit, donate_argnums=(1,),
                            static_argnames=('horizon', 'sample',
-                                            'kv_bucket'))
+                                            'kv_bucket'),
+                           **self._step_out_shardings(1))
         def decode_steps(params, cache, tokens, rng, temps, topks, topps,
                          active, horizon, sample, kv_bucket):
             if sample:
@@ -755,7 +822,8 @@ class InferenceEngine(SpeculativeMixin, _EngineBase):
         cfg, attn_impl = self.cfg, self.attn_impl
         w8a8 = self.prefill_w8a8
 
-        @functools.partial(jax.jit, donate_argnums=(1,))
+        @functools.partial(jax.jit, donate_argnums=(1,),
+                           **self._step_out_shardings(1))
         def prefill(params, big_cache, tokens, true_lens, slots):
             """tokens [n, bucket]; true_lens [n]; slots [n] target rows."""
             last, rows = llama.prefill_rows(
@@ -852,7 +920,11 @@ class InferenceEngine(SpeculativeMixin, _EngineBase):
         pending = sorted(self._prefill_off)
         if not pending:
             return []
-        scratch_tok = kv_token_bytes(self.cfg, self.cache.quantized)
+        # Per-DEVICE token cost: the stacked chunk transient shards
+        # its kv-head dim over tp, so a tp=2 engine admits twice the
+        # wave within the same per-chip scratch budget.
+        scratch_tok = kv_token_bytes(self.cfg, self.cache.quantized,
+                                     mesh=self.mesh)
 
         def shapes(batch):
             # Chunk width: the full chunk, or a smaller bucket when
@@ -921,7 +993,7 @@ class InferenceEngine(SpeculativeMixin, _EngineBase):
         # operand (each separate jnp.asarray is its own dispatch round
         # trip through a remote tunnel).
         (tokens_d, starts_d, valid_d, want_d, slots_d, temps_d,
-         topks_d, topps_d) = jax.device_put(
+         topks_d, topps_d) = device_upload(
             (tokens, starts, valid, want, slots_arr, temps, topks,
              topps))
         prefill = self._get_chunk_prefill(n, chunk_w, kv_bucket, sample)
@@ -956,7 +1028,7 @@ class InferenceEngine(SpeculativeMixin, _EngineBase):
             slots_p = np.full(n, self.max_batch, np.int32)
             for j, (i, slot) in enumerate(done_rows):
                 rows_p[j], slots_p[j] = i, slot
-            rows_d, sl_d = jax.device_put((rows_p, slots_p))
+            rows_d, sl_d = device_upload((rows_p, slots_p))
             self._tok_dev = self._merge_tokens_drop(
                 self._tok_dev, sl_d, jnp.take(first, rows_d))
             self._meta_dirty = True              # slots become decodable
@@ -981,7 +1053,8 @@ class InferenceEngine(SpeculativeMixin, _EngineBase):
         w8a8 = self.prefill_w8a8
         max_seq = self.max_seq
 
-        @functools.partial(jax.jit, donate_argnums=(1,))
+        @functools.partial(jax.jit, donate_argnums=(1,),
+                           **self._step_out_shardings(1))
         def prefill(params, big_cache, tokens, starts, valid, want_idx,
                     slots, temps, topks, topps, rng):
             if kv_bucket:
@@ -1048,7 +1121,8 @@ class InferenceEngine(SpeculativeMixin, _EngineBase):
         k = self.speculate_k
         max_seq = self.max_seq
 
-        @functools.partial(jax.jit, donate_argnums=(1,))
+        @functools.partial(jax.jit, donate_argnums=(1,),
+                           **self._step_out_shardings(3))
         def verify(params, big_cache, tokens, proposals, n_prop, temps,
                    topks, topps, active, rng):
             b = tokens.shape[0]
@@ -1118,7 +1192,7 @@ class InferenceEngine(SpeculativeMixin, _EngineBase):
         if kv_bucket > self.max_seq // 2:
             kv_bucket = self.max_seq
         self._rng, rng = jax.random.split(self._rng)
-        prop_d, n_prop_d = jax.device_put((proposals, n_prop))
+        prop_d, n_prop_d = device_upload((proposals, n_prop))
         verify = self._get_spec_verify(sample, kv_bucket)
         with self._prof.jit_key('spec_verify',
                                 (self.speculate_k, sample, kv_bucket)):
@@ -1192,7 +1266,8 @@ class InferenceEngine(SpeculativeMixin, _EngineBase):
         # overflow requeues at the FRONT (keeps FIFO) for the next step.
         bucket = min(_bucket_len(max(len(r.prompt) for _, r in batch)),
                      self.max_seq)
-        scratch_tok = kv_token_bytes(self.cfg, self.cache.quantized)
+        scratch_tok = kv_token_bytes(self.cfg, self.cache.quantized,
+                                     mesh=self.mesh)
         fit = int(0.75e9) // max(1, bucket * scratch_tok)
         cap = 1
         for b in self._PREFILL_N_BUCKETS:     # largest PADDED n that fits
@@ -1287,7 +1362,7 @@ class InferenceEngine(SpeculativeMixin, _EngineBase):
         # them at the pool's int8 width (round-4 bug) both understated
         # the re-read traffic and allowed rings that blew the HBM budget.
         ring_cap = _ring_horizon_cap(self.cfg, self.max_batch,
-                                     self._param_bytes)
+                                     self._param_bytes, self.mesh)
         horizon = min(horizon, ring_cap)
         for b in reversed(self._HORIZON_BUCKETS):
             if b <= horizon:

@@ -49,7 +49,7 @@ from skypilot_tpu.models import llama
 from skypilot_tpu.models.configs import ModelConfig
 from skypilot_tpu.ops.attention import cached_attention, ring_decode_attention
 from skypilot_tpu.telemetry import clock
-from skypilot_tpu.utils.host import host_sync
+from skypilot_tpu.utils.host import device_upload, host_sync
 
 Params = Dict[str, Any]
 
@@ -157,12 +157,30 @@ def _scatter_rows(pool: jax.Array, rows: jax.Array,
 
 def merge_rows_into_pool(cache: PagedKVCache, k_rows, v_rows,
                          table: jax.Array, starts: jax.Array,
-                         valid_len: jax.Array) -> PagedKVCache:
+                         valid_len: jax.Array,
+                         mesh=None) -> PagedKVCache:
     """Scatter [L, slots, n, hkv, d] new rows into the pool through the
     page table. For int8 pools the rows arrive PRE-quantized as
     ``(codes, scales)`` tuples — quantizing per layer inside the caller's
     scan keeps the stacked transient int8 (a 7B prefill chunk's bf16
-    [L, n, chunk] rows alone are ~4 GB; int8 is ~1 GB)."""
+    [L, n, chunk] rows alone are ~4 GB; int8 is ~1 GB).
+
+    ``mesh``: REQUIRED whenever the pool is tp-sharded. The fully-flat
+    scatter below folds the head dim into its indices, which GSPMD
+    cannot keep sharded — left to propagation it ALL-GATHERS the whole
+    pool every merge (measured on the CPU tp=2 audit: a pool-shaped
+    all-gather per decode step — the exact resharding collective the
+    paged-tp audit preset exists to ban). With a mesh the merge runs
+    under ``shard_map`` instead: each tp shard scatters its local head
+    slice of the rows into its local pool shard (indices are
+    head-uniform, so the flat in-place scatter is unchanged per
+    shard), and a dp-sharded row batch is first all-gathered over dp
+    INSIDE the body — ring-rows-sized, the one known dp collective —
+    so every dp shard's pool replica stays identical."""
+    axes = _pool_shard_axes(cache, table, mesh)
+    if axes is not None:
+        return _merge_rows_sharded(cache, k_rows, v_rows, table, starts,
+                                   valid_len, mesh, *axes)
     if cache.quantized:
         kq, ks = k_rows
         vq, vs = v_rows
@@ -180,6 +198,114 @@ def merge_rows_into_pool(cache: PagedKVCache, k_rows, v_rows,
     return cache._replace(
         pool_k=_scatter_rows(cache.pool_k, k_rows, flat_idx),
         pool_v=_scatter_rows(cache.pool_v, v_rows, flat_idx))
+
+
+def _pool_shard_axes(cache: PagedKVCache, table: jax.Array, mesh):
+    """(tp_axis, dp_axes) the sharded merge should map over, or None
+    for the plain local path (no mesh, or nothing actually shards).
+    Mirrors the divisibility rules the cache shardings were built
+    with: tp only when it divides the head dim, dp only when the data
+    axes divide the row batch (``table``'s slot dim)."""
+    if mesh is None:
+        return None
+    import math as _math
+    hkv = cache.pool_k.shape[2]
+    tp = ('tp' if mesh.shape['tp'] > 1 and hkv % mesh.shape['tp'] == 0
+          else None)
+    data = tuple(a for a in ('slice', 'dp', 'fsdp') if mesh.shape[a] > 1)
+    dp = (data if data and table.shape[0] % _math.prod(
+        mesh.shape[a] for a in data) == 0 else None)
+    if tp is None and dp is None:
+        return None
+    return tp, dp
+
+
+def _compat_shard_map(body, mesh, in_specs, out_specs):
+    """shard_map across jax generations: ``jax.shard_map`` (new api,
+    ``check_vma``) when present, else the 0.4.x
+    ``jax.experimental.shard_map`` (``check_rep``). Replication
+    checking is off either way: with a dp-sharded row batch the pool
+    outputs ARE replicated over dp — every shard gathers the full row
+    set before scattering — but the checker cannot see through the
+    explicit all_gather."""
+    if hasattr(jax, 'shard_map'):
+        try:
+            return jax.shard_map(body, mesh=mesh, in_specs=in_specs,
+                                 out_specs=out_specs, check_vma=False)
+        except TypeError:           # older spelling of the new api
+            return jax.shard_map(body, mesh=mesh, in_specs=in_specs,
+                                 out_specs=out_specs)
+    from jax.experimental.shard_map import shard_map
+    return shard_map(body, mesh=mesh, in_specs=in_specs,
+                     out_specs=out_specs, check_rep=False)
+
+
+def _merge_rows_sharded(cache: PagedKVCache, k_rows, v_rows,
+                        table: jax.Array, starts: jax.Array,
+                        valid_len: jax.Array, mesh, tp, dp
+                        ) -> PagedKVCache:
+    """``merge_rows_into_pool`` under ``shard_map``: per-shard flat
+    scatters (in place, zero cross-shard traffic for tp) plus one
+    ring-rows-sized all-gather over dp when the row batch is
+    dp-sharded. See the caller's docstring for why GSPMD alone cannot
+    do this without all-gathering the pool."""
+    from jax.sharding import PartitionSpec as P
+    quantized = cache.quantized
+    pool_s = P(None, None, tp, None, None)
+    spool_s = P(None, None, tp, None)
+    rows_s = P(None, dp, None, tp, None)      # codes AND rank-5 scales
+    args: List[Any] = [cache.pool_k, cache.pool_v]
+    specs: List[Any] = [pool_s, pool_s]
+    if quantized:
+        kq, ks = k_rows
+        vq, vs = v_rows
+        args += [cache.k_scale, cache.v_scale, kq, ks, vq, vs]
+        specs += [spool_s, spool_s, rows_s, rows_s, rows_s, rows_s]
+    else:
+        args += [k_rows, v_rows]
+        specs += [rows_s, rows_s]
+    args += [table, starts, valid_len]
+    specs += [P(dp, None), P(dp), P(dp)]
+    out_s = ((pool_s, pool_s, spool_s, spool_s) if quantized
+             else (pool_s, pool_s))
+
+    def body(*flat):
+        if quantized:
+            pk, pv, ksc, vsc, akq, aks, avq, avs, tbl, st, vl = flat
+            rows = [akq, aks, avq, avs]
+        else:
+            pk, pv, akr, avr, tbl, st, vl = flat
+            ksc = vsc = None
+            rows = [akr, avr]
+        if dp is not None:
+            # Regroup the dp-sharded row batch so EVERY dp shard
+            # applies every slot's updates — the pool replicates over
+            # dp and must not diverge. Ring-rows-sized: the one known
+            # dp collective of the decode chain.
+            rows = [lax.all_gather(r, dp, axis=1, tiled=True)
+                    for r in rows]
+            tbl = lax.all_gather(tbl, dp, axis=0, tiled=True)
+            st = lax.all_gather(st, dp, axis=0, tiled=True)
+            vl = lax.all_gather(vl, dp, axis=0, tiled=True)
+        local = PagedKVCache(pool_k=pk, pool_v=pv, k_scale=ksc,
+                             v_scale=vsc)
+        n = rows[0].shape[2]
+        flat_idx = _flat_write_indices(tbl, st, n, vl, local.page_size)
+        if quantized:
+            akq, aks, avq, avs = rows
+            return (_scatter_rows(pk, akq, flat_idx),
+                    _scatter_rows(pv, avq, flat_idx),
+                    _scatter_rows(ksc, aks, flat_idx),
+                    _scatter_rows(vsc, avs, flat_idx))
+        akr, avr = rows
+        return (_scatter_rows(pk, akr, flat_idx),
+                _scatter_rows(pv, avr, flat_idx))
+
+    out = _compat_shard_map(body, mesh, tuple(specs), out_s)(*args)
+    if quantized:
+        return cache._replace(pool_k=out[0], pool_v=out[1],
+                              k_scale=out[2], v_scale=out[3])
+    return cache._replace(pool_k=out[0], pool_v=out[1])
 
 
 def _maybe_quantize_rows(new_kv, quantized: bool):
@@ -330,7 +456,8 @@ def paged_decode_horizon(
 
 def merge_ring_into_pool(cache: PagedKVCache, ring_k, ring_v,
                          table_p: jax.Array, lengths: jax.Array,
-                         active: Optional[jax.Array]) -> PagedKVCache:
+                         active: Optional[jax.Array],
+                         mesh=None) -> PagedKVCache:
     """Scatter a decode horizon's ring rows into the pool — a SEPARATE
     jitted program from the token computation (engine donates the cache
     here). Keeping the pool update out of the program whose layer scan
@@ -342,7 +469,7 @@ def merge_ring_into_pool(cache: PagedKVCache, ring_k, ring_v,
            else jnp.ones_like(lengths))
     rk, rv = _maybe_quantize_rows((ring_k, ring_v), cache.quantized)
     return merge_rows_into_pool(cache, rk, rv, table_p, lengths,
-                                valid_len=act * horizon)
+                                valid_len=act * horizon, mesh=mesh)
 
 
 def paged_prefill_chunk(
@@ -361,6 +488,7 @@ def paged_prefill_chunk(
     topps: jax.Array = None,
     rng: jax.Array = None,
     w8a8: bool = False,
+    mesh=None,
 ):
     """One fixed-size prefill chunk for ``n`` slots: attends against the
     pages written so far (each slot's ``lengths``) plus causal
@@ -427,7 +555,7 @@ def paged_prefill_chunk(
         first = sample_tokens(logits, rng, temps, topks, topps)
 
     new_cache = merge_rows_into_pool(cache, k_rows, v_rows, table_p,
-                                     len0, valid_len=valid)
+                                     len0, valid_len=valid, mesh=mesh)
     return first, new_cache
 
 
@@ -448,6 +576,7 @@ def paged_spec_verify(
     topps: jax.Array = None,
     rng: jax.Array = None,
     w8a8: bool = False,
+    mesh=None,
 ):
     """Speculative verify over the paged pool: one forward over the
     ``k+1`` positions ``[t0, d1..dk]`` per slot against the pages
@@ -502,7 +631,7 @@ def paged_spec_verify(
         sample=sample)
     n_commit = jnp.where(active, n_commit, 0)
     new_cache = merge_rows_into_pool(cache, k_rows, v_rows, table_p,
-                                     len0, valid_len=n_commit)
+                                     len0, valid_len=n_commit, mesh=mesh)
     nxt = jnp.take_along_axis(
         commit, jnp.maximum(n_commit - 1, 0)[:, None], axis=1)[:, 0]
     new_tok = jnp.where(active, nxt, tokens)
@@ -745,7 +874,14 @@ class PagedInferenceEngine(SpeculativeMixin, _EngineBase):
             page_size = adjusted
         self.page = page_size
         from skypilot_tpu.models import quantization
-        self._param_bytes = quantization.quantized_bytes(self.params)
+        # PER-DEVICE stored parameter bytes (sharded leaves count their
+        # local shard; dp-replicated leaves count in full) — the floor
+        # pool auto-sizing subtracts and the weight stream the ring cap
+        # is sized against. Dividing global bytes by mesh.size was
+        # wrong in both directions once dp>1 exists: dp REPLICATES the
+        # weights, so a (tp=1, dp=2) mesh would have claimed half the
+        # resident bytes and oversized the pool into an OOM.
+        self._param_bytes = quantization.per_device_bytes(self.params)
 
         # Auto-sized pools reserve HBM for the long-horizon ring (see
         # _auto_n_pages); an EXPLICIT n_pages made no such bargain, so
@@ -760,11 +896,28 @@ class PagedInferenceEngine(SpeculativeMixin, _EngineBase):
         self.cache = PagedKVCache.create(cfg, n_pages=n_pages,
                                          page_size=page_size,
                                          quantized=kv_int8)
+        # Pre-partitioned pool + pinned output shardings: the pool is
+        # device_put ONCE (kv heads over tp; pages replicated — the
+        # page table indexes them dynamically, so a page-sharded pool
+        # would turn every gather into a collective), and every jitted
+        # step that returns it pins this same tree as out_shardings.
+        # The decode ring rows are pinned too (``_ring_sh``): the
+        # decode program's ring OUTPUT sharding is exactly the merge
+        # program's ring INPUT sharding, so the decode→merge chain has
+        # no resharding between programs.
+        self._cache_sh = None
+        self._ring_sh = None
         if mesh is not None:
-            sh = mesh_lib.tree_shardings(
+            self._cache_sh = mesh_lib.tree_shardings(
                 paged_cache_logical_axes(self.cache.quantized), mesh,
                 shapes=self.cache)
-            self.cache = jax.device_put(self.cache, sh)
+            self.cache = jax.device_put(self.cache, self._cache_sh)
+            from jax.sharding import NamedSharding
+            self._ring_sh = NamedSharding(mesh, mesh_lib.spec_for(
+                ('layers', 'batch', None, 'kv_heads', 'head_dim'),
+                shape=(cfg.n_layers, max_batch, 1, cfg.n_kv_heads,
+                       cfg.head_dim),
+                mesh=mesh))
 
         if decode_impl == 'auto':
             # The Pallas kernel needs 128-lane head_dim; on CPU its
@@ -816,7 +969,8 @@ class PagedInferenceEngine(SpeculativeMixin, _EngineBase):
         # _PREFILL_STACK_BUDGET (at n=32 x chunk=256 on a 7B the two
         # stacks alone are 2 GB — the compile OOM'd the chip).
         # _auto_n_pages reserves the same budget.
-        tok_bytes = self._page_bytes(self.cfg, 1, self.cache.quantized)
+        tok_bytes = self._page_bytes(self.cfg, 1, self.cache.quantized,
+                                     mesh=self.mesh)
         n_fit = int(self._PREFILL_STACK_BUDGET // max(1, chunk *
                                                       tok_bytes))
         self._prefill_n_max = 1
@@ -864,9 +1018,13 @@ class PagedInferenceEngine(SpeculativeMixin, _EngineBase):
 
     @staticmethod
     def _page_bytes(cfg: ModelConfig, page_size: int,
-                    quantized: bool) -> int:
+                    quantized: bool, mesh=None) -> int:
+        """Stored bytes of one page; with ``mesh``, PER-DEVICE bytes
+        (kv heads shard over tp — the pool's pages replicate over dp,
+        so dp never divides). HBM sizing passes the mesh; reporting
+        surfaces keep the global cost."""
         from skypilot_tpu.inference.engine import kv_token_bytes
-        return kv_token_bytes(cfg, quantized) * page_size
+        return kv_token_bytes(cfg, quantized, mesh=mesh) * page_size
 
     def _auto_n_pages(self, cfg: ModelConfig, max_batch: int,
                       max_seq: int, page_size: int) -> int:
@@ -916,9 +1074,11 @@ class PagedInferenceEngine(SpeculativeMixin, _EngineBase):
         # bytes_in_use can lag async transfers (observed right after the
         # parallel checkpoint puts: the pool then oversized by ~3 GB and
         # decode OOM'd at runtime); the weights are a known floor —
-        # PER DEVICE (a tp-sharded tree spreads over mesh.size chips).
-        n_dev = self.mesh.size if self.mesh is not None else 1
-        used = max(used, self._param_bytes // n_dev + int(0.15e9))
+        # _param_bytes is already the exact PER-DEVICE resident bytes
+        # (sharded leaves count their local shard, dp-replicated leaves
+        # in full — dividing by mesh.size here was the dp>1 oversizing
+        # bug).
+        used = max(used, self._param_bytes + int(0.15e9))
         # The reserve must cover the decode transients at the LONGEST
         # horizon the ring budget allows — sizing the pool without
         # them compiled programs past HBM at batch=48 on a 7B. The
@@ -930,11 +1090,16 @@ class PagedInferenceEngine(SpeculativeMixin, _EngineBase):
         # empirically-safe reserve on that config is ~3.1 GB. h_max
         # rounds DOWN to the horizon bucket decode will actually pick.
         from skypilot_tpu.inference.engine import _ring_row_bytes
-        row = _ring_row_bytes(cfg, max_batch)
+        row = _ring_row_bytes(cfg, max_batch, self.mesh)
         h_max = self._ring_horizon_bucket(self._RING_BYTES_CAP_PAGED)
         reserve = (int(1.6e9) + max(2 * row * h_max,
                                     self._PREFILL_STACK_BUDGET))
-        page_bytes = self._page_bytes(cfg, page_size, quantized)
+        # Per-DEVICE page cost: a tp-sharded pool stores 1/tp of each
+        # page's rows per chip, so the same free HBM fits tp x the
+        # pages (the whole point of sharding the pool) — while a dp>1
+        # mesh replicates the pool and gets NO page-count credit.
+        page_bytes = self._page_bytes(cfg, page_size, quantized,
+                                      mesh=self.mesh)
         fit = max(0, (limit - used - reserve)) // page_bytes
         # Take what fits, capped at 4x slot parity (prefix-cache
         # headroom without letting a tiny model grab the whole chip);
@@ -963,9 +1128,20 @@ class PagedInferenceEngine(SpeculativeMixin, _EngineBase):
         so the pool updates in place — see merge_ring_into_pool."""
         cfg = self.cfg
         decode_impl = self.decode_impl
+        # Pinned ring output shardings: the decode program emits the
+        # ring rows in exactly the layout the merge program consumes
+        # them in (out_axis_resources == next in_axis_resources), and
+        # the merge returns the pool in its own resident sharding —
+        # the decode→merge chain reshards nothing in steady state.
+        ring_kwargs = ({'out_shardings': (None, self._ring_sh,
+                                          self._ring_sh)}
+                       if self._ring_sh is not None else {})
+        merge_kwargs = ({'out_shardings': self._cache_sh}
+                        if self._cache_sh is not None else {})
 
         @functools.partial(jax.jit,
-                           static_argnames=('horizon', 'sample'))
+                           static_argnames=('horizon', 'sample'),
+                           **ring_kwargs)
         def decode_steps(params, cache, table_p, tokens, lengths, rng,
                          temps, topks, topps, active, horizon, sample):
             if sample:
@@ -982,7 +1158,9 @@ class PagedInferenceEngine(SpeculativeMixin, _EngineBase):
                 active=active, decode_impl=decode_impl,
                 pages_per_block=self.pages_per_block)
 
-        merge = jax.jit(merge_ring_into_pool, donate_argnums=(0,))
+        merge = jax.jit(functools.partial(merge_ring_into_pool,
+                                          mesh=self.mesh),
+                        donate_argnums=(0,), **merge_kwargs)
 
         def decode_and_merge(params, cache, table_p, tokens, lengths,
                              rng, temps, topks, topps, active, horizon,
@@ -1003,13 +1181,17 @@ class PagedInferenceEngine(SpeculativeMixin, _EngineBase):
             cfg = self.cfg
             w8a8 = self.prefill_w8a8
 
-            @functools.partial(jax.jit, donate_argnums=(1,))
+            mesh = self.mesh
+
+            @functools.partial(jax.jit, donate_argnums=(1,),
+                               **self._step_out_shardings(1))
             def prefill(params, cache, table_p, tokens, lengths, valid,
                         want_idx, temps, topks, topps, rng):
                 return paged_prefill_chunk(
                     params, cache, table_p, tokens, lengths, valid,
                     want_idx, cfg, temps=temps if sample else None,
-                    topks=topks, topps=topps, rng=rng, w8a8=w8a8)
+                    topks=topks, topps=topps, rng=rng, w8a8=w8a8,
+                    mesh=mesh)
 
             self._prefill_fns[key] = prefill
         return self._prefill_fns[key]
@@ -1050,7 +1232,8 @@ class PagedInferenceEngine(SpeculativeMixin, _EngineBase):
         filled page counts as used) — the schema shared with the slot
         engine for the telemetry gauges and bench. Prefix-retained
         pages count as FREE: allocation evicts them on demand."""
-        from skypilot_tpu.inference.engine import kv_token_bytes
+        from skypilot_tpu.inference.engine import (kv_shard_degree,
+                                                   kv_token_bytes)
         stats = self.memory_stats()
         cap = stats['pool_token_capacity']
         used = stats['pages_in_use'] * self.page
@@ -1062,6 +1245,13 @@ class PagedInferenceEngine(SpeculativeMixin, _EngineBase):
             'preemptions': int(self.preemptions),
             'kv_token_bytes': kv_token_bytes(self.cfg,
                                              self.cache.quantized),
+            # Per-DEVICE byte view (kv heads shard over tp; pages
+            # replicate over dp): token counts above stay GLOBAL so
+            # scheduler bounds and preemption pressure mean the same
+            # thing at any mesh shape.
+            'kv_token_bytes_per_shard': kv_token_bytes(
+                self.cfg, self.cache.quantized, mesh=self.mesh),
+            'kv_shards': kv_shard_degree(self.cfg, self.mesh),
         }
 
     # ---------------------------------------------------------- admission
@@ -1138,10 +1328,10 @@ class PagedInferenceEngine(SpeculativeMixin, _EngineBase):
         reserve note in _auto_n_pages)."""
         from skypilot_tpu.inference.engine import (_ring_horizon_cap,
                                                    _ring_row_bytes)
-        row = _ring_row_bytes(self.cfg, self.max_batch)
+        row = _ring_row_bytes(self.cfg, self.max_batch, self.mesh)
         cap = min(self._HORIZON_BUCKETS[-1],
                   _ring_horizon_cap(self.cfg, self.max_batch,
-                                    self._param_bytes),
+                                    self._param_bytes, self.mesh),
                   max(8, ring_bytes // row))
         return next((b for b in reversed(self._HORIZON_BUCKETS)
                      if b <= cap), 8)
@@ -1332,7 +1522,7 @@ class PagedInferenceEngine(SpeculativeMixin, _EngineBase):
         # measured as multi-second admission spikes that halved
         # sustained throughput.
         (table_d, tokens_d, lengths_d, valid_d, want_d, temps_d,
-         topks_d, topps_d) = jax.device_put(
+         topks_d, topps_d) = device_upload(
             (table_p, tokens, lengths, valid, want, temps, topks,
              topps))
         # Sampling variant only when a row COMPLETING this chunk needs
@@ -1386,7 +1576,7 @@ class PagedInferenceEngine(SpeculativeMixin, _EngineBase):
             slots_p = np.full(n, self.max_batch, np.int32)
             for j, (i, slot) in enumerate(done_rows):
                 rows_p[j], slots_p[j] = i, slot
-            rows_d, slots_d = jax.device_put((rows_p, slots_p))
+            rows_d, slots_d = device_upload((rows_p, slots_p))
             self._tok_dev = self._merge_tokens_drop(
                 self._tok_dev, slots_d, jnp.take(first, rows_d))
             self._meta_dirty = True          # slots become decodable
@@ -1432,7 +1622,10 @@ class PagedInferenceEngine(SpeculativeMixin, _EngineBase):
             cfg = self.cfg
             w8a8 = self.prefill_w8a8
 
-            @functools.partial(jax.jit, donate_argnums=(1,))
+            mesh = self.mesh
+
+            @functools.partial(jax.jit, donate_argnums=(1,),
+                               **self._step_out_shardings(3))
             def verify(params, cache, table_p, tokens, proposals,
                        n_prop, lengths, active, temps, topks, topps,
                        rng):
@@ -1440,7 +1633,7 @@ class PagedInferenceEngine(SpeculativeMixin, _EngineBase):
                     params, cache, table_p, tokens, proposals, n_prop,
                     lengths, active, cfg, sample=sample,
                     temps=temps, topks=topks, topps=topps, rng=rng,
-                    w8a8=w8a8)
+                    w8a8=w8a8, mesh=mesh)
 
             self._spec_verify_fns[key] = verify
         return self._spec_verify_fns[key]
@@ -1459,7 +1652,7 @@ class PagedInferenceEngine(SpeculativeMixin, _EngineBase):
             table_p[s, :len(ps)] = ps
         lengths = self._slot_len.astype(np.int32)
         self._rng, rng = jax.random.split(self._rng)
-        table_d, prop_d, n_prop_d, lengths_d = jax.device_put(
+        table_d, prop_d, n_prop_d, lengths_d = device_upload(
             (table_p, proposals, n_prop, lengths))
         verify = self._get_spec_verify(self.max_batch, P, sample)
         with self._prof.jit_key('spec_verify',
@@ -1630,7 +1823,7 @@ class PagedInferenceEngine(SpeculativeMixin, _EngineBase):
         # Device-truth lengths at this call = processed + in-flight.
         lengths = (self._slot_len + self._slot_inflight).astype(np.int32)
         self._rng, rng = jax.random.split(self._rng)
-        table_dd, lengths_dd = jax.device_put((table_p, lengths))
+        table_dd, lengths_dd = device_upload((table_p, lengths))
         with self._prof.jit_key('decode', (horizon, sample, P)):
             toks, self.cache = self._decode_fn(
                 self.params, self.cache, table_dd,

@@ -231,3 +231,26 @@ def quantized_bytes(params: Params) -> int:
     for leaf in jax.tree.leaves(params):
         total += leaf.size * leaf.dtype.itemsize
     return total
+
+
+def per_device_bytes(params: Params) -> int:
+    """Bytes ONE device holds of the tree: each sharded leaf counts its
+    local shard shape (exact — divisibility fallbacks and replicated
+    axes included via ``sharding.shard_shape``), unsharded leaves their
+    full size. The HBM-budget divisor pool sizing must use: dividing
+    global bytes by ``mesh.size`` is wrong whenever an axis REPLICATES
+    (dp, or a dimension tp does not divide) — under dp=2 it halves the
+    accounted weights that are in fact fully resident per chip."""
+    import math
+    total = 0
+    for leaf in jax.tree.leaves(params):
+        sharding = getattr(leaf, 'sharding', None)
+        if sharding is not None and hasattr(sharding, 'shard_shape'):
+            try:
+                local = math.prod(sharding.shard_shape(leaf.shape))
+            except Exception:  # pylint: disable=broad-except
+                local = leaf.size       # exotic sharding: conservative
+        else:
+            local = leaf.size
+        total += local * leaf.dtype.itemsize
+    return total

@@ -67,6 +67,18 @@ class MeshSpec:
         return cls(dp=1, fsdp=per_slice // (tp * sp), sp=sp, tp=tp,
                    num_slices=num_slices)
 
+    @classmethod
+    def for_serving(cls, tp: int = 1, dp: int = 1) -> 'MeshSpec':
+        """The serving layout: params/KV heads sharded over ``tp``
+        (innermost — collectives on nearest-neighbor ICI), the decode
+        batch replicated-or-sharded over ``dp``. No fsdp/sp/pp —
+        inference keeps whole layers resident and decode reads are
+        latency-bound, so the only profitable axes are tensor split
+        (TPOT) and batch split (tok/s)."""
+        if tp < 1 or dp < 1:
+            raise ValueError(f'tp/dp must be >= 1, got tp={tp} dp={dp}')
+        return cls(dp=dp, tp=tp)
+
 
 def spec_from_env(*, tp: Optional[int] = None, sp: int = 1,
                   num_devices: Optional[int] = None) -> MeshSpec:
@@ -78,6 +90,66 @@ def spec_from_env(*, tp: Optional[int] = None, sp: int = 1,
     if num_devices is None:
         num_devices = jax.device_count()
     return MeshSpec.auto(num_devices, num_slices=num_slices, tp=tp, sp=sp)
+
+
+def serving_spec_from_env(*, tp: Optional[int] = None,
+                          dp: Optional[int] = None) -> MeshSpec:
+    """Serving MeshSpec from the launch env contract: the controller's
+    adaptive-TP placement exports ``SKYTPU_TP``/``SKYTPU_DP`` on the
+    replica, and explicit args (``--tp/--dp``) override. Absent both,
+    tp=dp=1 — the single-chip path stays the default."""
+    import os
+    if tp is None:
+        tp = int(os.environ.get('SKYTPU_TP', '1') or 1)
+    if dp is None:
+        dp = int(os.environ.get('SKYTPU_DP', '1') or 1)
+    return MeshSpec.for_serving(tp=tp, dp=dp)
+
+
+def serving_mesh(tp: int = 1, dp: int = 1,
+                 devices: Optional[Sequence[jax.Device]] = None
+                 ) -> Optional[Mesh]:
+    """Build the (tp, dp) serving mesh over the first ``tp*dp`` visible
+    devices. Returns None for tp=dp=1: the engines' meshless path skips
+    sharding entirely (and keeps the Pallas decode kernel eligible), so
+    single-chip serving must not pay for an over-general 1-device mesh."""
+    spec = MeshSpec.for_serving(tp=tp, dp=dp)
+    if spec.num_devices == 1:
+        return None
+    if devices is None:
+        devices = jax.devices()
+    if len(devices) < spec.num_devices:
+        raise ValueError(
+            f'serving mesh tp={tp} x dp={dp} needs {spec.num_devices} '
+            f'devices, but only {len(devices)} are visible')
+    return make_mesh(spec, devices[:spec.num_devices])
+
+
+def mesh_axis_sizes(mesh: Optional[Mesh]) -> Dict[str, int]:
+    """{axis: size} for every logical mesh axis — the stable-schema
+    payload behind the ``skytpu_mesh_shape{axis=...}`` gauges and the
+    LB's replica view. All 1s for a meshless (single-chip) engine, so
+    the series exist with sane values from the first scrape."""
+    if mesh is None:
+        return {a: 1 for a in MESH_AXES}
+    return {a: int(mesh.shape[a]) for a in MESH_AXES}
+
+
+def axis_shard_degree(mesh: Optional[Mesh], axes, dim: int) -> int:
+    """Effective shard count of a tensor dimension of size ``dim``
+    mapped to mesh ``axes`` (a name or tuple), mirroring ``spec_for``'s
+    divisibility fallback: trailing axes that do not divide ``dim``
+    drop to replication. THE divisor per-shard byte accounting must use
+    — sizing with the raw axis product would overstate sharding exactly
+    where spec_for silently replicated (e.g. MQA's n_kv_heads < tp)."""
+    if mesh is None:
+        return 1
+    if isinstance(axes, str):
+        axes = (axes,)
+    keep = tuple(axes)
+    while keep and dim % math.prod(mesh.shape[a] for a in keep):
+        keep = keep[:-1]
+    return math.prod(mesh.shape[a] for a in keep) if keep else 1
 
 
 _distributed_initialized = False

@@ -190,6 +190,12 @@ class ServeController:
                         # backoff state.
                         'retry_after_s':
                             controller.replica_manager.retry_after_hint(),
+                        # The (tp, dp) plan replicas of the current
+                        # spec version run with — the LB's replica
+                        # view carries it alongside the live
+                        # per-replica mesh probes.
+                        'replica_parallelism':
+                            controller.parallelism_payload(),
                     })
                 elif self.path == '/controller/update':
                     try:
@@ -208,10 +214,19 @@ class ServeController:
 
         return Handler
 
+    def parallelism_payload(self) -> Dict[str, Any]:
+        """The adaptive-TP plan as a wire dict (stable keys)."""
+        plan = self.replica_manager.parallelism_plan()
+        return {'tp': plan.tp, 'dp': plan.dp, 'chips': plan.chips,
+                'reason': plan.reason,
+                'policy': self.spec.parallelism_policy}
+
     def status_payload(self) -> Dict[str, Any]:
+        par = self.parallelism_payload()
         return {
             'service_name': self.service_name,
             'target_num_replicas': self.autoscaler.target_num_replicas,
+            'replica_parallelism': par,
             'replicas': [{
                 'replica_id': i.replica_id,
                 'cluster_name': i.cluster_name,
@@ -219,6 +234,7 @@ class ServeController:
                 'url': i.url,
                 'version': i.version,
                 'is_spot': i.is_spot,
+                'mesh': {'tp': par['tp'], 'dp': par['dp']},
             } for i in self.replica_manager.replicas()],
         }
 

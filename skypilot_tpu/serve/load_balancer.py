@@ -143,6 +143,10 @@ class SkyServeLoadBalancer:
         # refreshed from the controller's probe/launch backoff state on
         # every sync. Plain int write — single-writer sync loop.
         self._retry_after_hint = 5
+        # The controller's (tp, dp) replica plan, refreshed on every
+        # sync (single-writer) — part of the /lb/replicas view next to
+        # the live per-replica mesh probes.
+        self._replica_parallelism: Dict[str, Any] = {}
 
     # ------------------------------------------------------------- sync
     def _sync_once(self) -> None:
@@ -161,6 +165,9 @@ class SkyServeLoadBalancer:
             hint = payload.get('retry_after_s')
             if hint:
                 self._retry_after_hint = max(1, int(hint))
+            par = payload.get('replica_parallelism')
+            if par is not None:
+                self._replica_parallelism = par
         except Exception as e:  # pylint: disable=broad-except
             # Keep serving the last known replica set; re-queue the
             # timestamps so the QPS signal survives controller restarts —
@@ -606,12 +613,34 @@ class SkyServeLoadBalancer:
                         'Retry-After': str(lb._retry_after_hint)})
 
             def do_GET(self):  # noqa: N802
+                if self.path == '/lb/replicas':
+                    # LB-local replica view (not proxied): ready URLs,
+                    # the controller's (tp, dp) plan, and live-probed
+                    # per-replica mesh shapes where the policy caches
+                    # them (queue_depth probes /metrics JSON anyway).
+                    self._send_json(200, lb.replica_view())
+                    return
                 self._proxy('GET')
 
             def do_POST(self):  # noqa: N802
                 self._proxy('POST')
 
         return Handler
+
+    def replica_view(self) -> Dict[str, Any]:
+        """The LB's replica view: ready URLs + mesh shape per replica.
+        ``mesh`` is the live shape from the policy's /metrics probes
+        when available (queue_depth policy), else null — the
+        controller-planned ``replica_parallelism`` block is always
+        present as the configured truth."""
+        meshes = self.policy.replica_meshes()
+        urls = list(self.policy.ready_replicas)
+        return {
+            'ready_replica_urls': urls,
+            'replica_parallelism': self._replica_parallelism,
+            'replicas': [{'url': u, 'mesh': meshes.get(u)}
+                         for u in urls],
+        }
 
     # ---------------------------------------------------------- lifecycle
     def start(self) -> None:

@@ -47,6 +47,12 @@ class LoadBalancingPolicy:
         """Called when the request to ``url`` completes."""
         del url
 
+    def replica_meshes(self) -> Dict[str, Dict]:
+        """url -> live-probed mesh shape, for policies that probe the
+        replicas' /metrics JSON (queue_depth). Empty for the rest —
+        the LB's replica view then falls back to the controller plan."""
+        return {}
+
 
 class RoundRobinPolicy(LoadBalancingPolicy):
 
@@ -128,18 +134,22 @@ class QueueDepthPolicy(LoadBalancingPolicy):
         self._inflight: Dict[str, int] = {}
         # url -> (monotonic expiry, queue_tokens_total or None=failed)
         self._cache: Dict[str, Tuple[float, Optional[int]]] = {}
+        # url -> last-probed mesh shape block (the same /metrics JSON
+        # carries it — the LB's replica view reads this for free).
+        self._mesh: Dict[str, Dict] = {}
 
-    def _probe(self, url: str) -> Optional[int]:
+    def _probe(self, url: str) -> Tuple[Optional[int], Optional[Dict]]:
         try:
             with urllib.request.urlopen(
                     f'{url}/metrics?format=json',
                     timeout=self.PROBE_TIMEOUT_S) as resp:
                 payload = json.loads(resp.read())
-            return int(payload['queue_tokens_total'])
+            return int(payload['queue_tokens_total']), \
+                payload.get('mesh')
         except Exception as e:  # pylint: disable=broad-except
             logger.debug(f'queue-depth probe failed for {url}: '
                          f'{type(e).__name__}: {e}')
-            return None
+            return None, None
 
     def select_replica(self,
                        exclude: Optional[Set[str]] = None
@@ -157,8 +167,10 @@ class QueueDepthPolicy(LoadBalancingPolicy):
         fresh = {u: self._probe(u) for u in stale}
         with self._lock:
             expiry = clock.monotonic() + self.PROBE_TTL_S
-            for u, tokens in fresh.items():
+            for u, (tokens, mesh) in fresh.items():
                 self._cache[u] = (expiry, tokens)
+                if mesh is not None:
+                    self._mesh[u] = mesh
 
             def score(u: str) -> int:
                 tokens = self._cache.get(u, (0.0, None))[1]
@@ -175,6 +187,10 @@ class QueueDepthPolicy(LoadBalancingPolicy):
     def post_execute(self, url: str) -> None:
         with self._lock:
             self._inflight[url] = max(0, self._inflight.get(url, 0) - 1)
+
+    def replica_meshes(self) -> Dict[str, Dict]:
+        with self._lock:
+            return dict(self._mesh)
 
 
 POLICIES = {

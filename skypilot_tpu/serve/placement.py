@@ -1,0 +1,156 @@
+"""Adaptive tensor-parallel placement for serving replicas.
+
+The policy layer of multi-chip serving ("Nitsum: Serving Tiered LLM
+Requests with Adaptive Tensor Parallelism", PAPERS.md): given a model
+size, a replica's chip budget, and the SLO tier the replica is meant
+for, pick the (tp, dp) mesh shape the replica's engine should serve
+with.
+
+The tradeoff it encodes:
+
+- **Tensor parallelism (tp)** splits every weight matrix and the KV
+  pool's head dim across chips. Decode is HBM-bandwidth-bound, so the
+  per-token weight+KV stream — and therefore TPOT — improves ~linearly
+  with tp (minus the per-layer all-reduce). tp is also the only way to
+  SERVE a model at all once its weights outgrow one chip's HBM.
+- **Data parallelism (dp)** replicates the engine over chip groups.
+  Aggregate tok/s scales linearly with dp at unchanged TPOT, and there
+  are no cross-chip collectives on the critical path at all.
+
+So: **latency-tier replicas take the highest tp the chip budget
+allows** (interactive TPOT is the contract), **throughput-tier
+replicas take the smallest tp that fits the model and spend the rest
+on dp** (batch tok/s is the contract; dp's collective-free scaling
+wins). The controller exports the plan to the replica as
+``SKYTPU_TP``/``SKYTPU_DP`` (the env contract
+``mesh_lib.serving_spec_from_env`` reads); ``serve/server.py --tp/--dp``
+override per process.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, Optional
+
+# Fraction of a chip's HBM the weights may take before the model is
+# considered not-to-fit at that tp: the rest is KV pool, decode
+# transients (horizon ring, prefill stacks) and XLA workspace — the
+# same reserve structure PagedInferenceEngine._auto_n_pages budgets.
+_WEIGHT_HBM_FRAC = 0.6
+
+
+@dataclasses.dataclass(frozen=True)
+class ParallelismPlan:
+    """A replica's mesh shape plus why it was chosen."""
+    tp: int
+    dp: int
+    reason: str
+
+    @property
+    def chips(self) -> int:
+        return self.tp * self.dp
+
+    def as_env(self) -> Dict[str, str]:
+        """The replica launch env contract
+        (``serving_spec_from_env`` on the model-server side)."""
+        return {'SKYTPU_TP': str(self.tp), 'SKYTPU_DP': str(self.dp)}
+
+
+def model_weight_bytes(cfg_name: str,
+                       quantize: Optional[str] = None) -> int:
+    """Stored weight bytes of a preset config: 1 B/param for int8
+    (per-channel fp32 scales are noise at this granularity), else the
+    config dtype's width."""
+    import jax.numpy as jnp
+
+    from skypilot_tpu.models import configs
+    cfg = configs.get_config(cfg_name)
+    itemsize = 1 if quantize == 'int8' else jnp.dtype(cfg.dtype).itemsize
+    return cfg.num_params * itemsize
+
+
+def min_tp_to_fit(model_bytes: int, *, hbm_per_chip_gb: float = 16.0,
+                  max_tp: int = 256) -> int:
+    """Smallest power-of-two tp at which the weight shard leaves room
+    for a useful KV pool on each chip (weights under
+    ``_WEIGHT_HBM_FRAC`` of HBM)."""
+    budget = _WEIGHT_HBM_FRAC * hbm_per_chip_gb * 1e9
+    tp = 1
+    while model_bytes / tp > budget and tp < max_tp:
+        tp *= 2
+    return tp
+
+
+def choose_parallelism(model_bytes: int, chips: int, *,
+                       slo_tier: str = 'latency',
+                       hbm_per_chip_gb: float = 16.0
+                       ) -> ParallelismPlan:
+    """Pick (tp, dp) for one replica of ``chips`` chips.
+
+    Raises ``ValueError`` when the model cannot fit even at
+    ``tp == chips`` — the caller (controller/autoscaler) must grow the
+    replica's chip budget, not silently serve a model that OOMs on
+    load.
+    """
+    if chips < 1:
+        raise ValueError(f'chips must be >= 1, got {chips}')
+    # Largest power of two <= chips: mesh axes need exact factoring and
+    # power-of-two splits keep every sharded dim divisible.
+    tp_max = 1
+    while tp_max * 2 <= chips:
+        tp_max *= 2
+    tp_min = min_tp_to_fit(model_bytes, hbm_per_chip_gb=hbm_per_chip_gb)
+    if tp_min > tp_max:
+        raise ValueError(
+            f'model ({model_bytes / 1e9:.1f} GB) does not fit '
+            f'{chips} chip(s) at {hbm_per_chip_gb:.0f} GB HBM each '
+            f'(needs tp>={tp_min}); raise the replica chip budget')
+    if slo_tier == 'latency':
+        # Interactive TPOT contract: all chips into the tensor split.
+        tp = tp_max
+        reason = (f'latency tier: max tp={tp} of {chips} chip(s) for '
+                  f'TPOT (model needs tp>={tp_min})')
+    else:
+        # Batch tok/s contract: smallest tp that fits, rest as
+        # collective-free dp replicas.
+        tp = tp_min
+        reason = (f'throughput tier: min fitting tp={tp}, remaining '
+                  'chips as dp for aggregate tok/s')
+    dp = max(1, chips // tp)
+    return ParallelismPlan(tp=tp, dp=dp, reason=reason)
+
+
+def plan_for_model(cfg_name: str, chips: int, *,
+                   slo_tier: str = 'latency',
+                   quantize: Optional[str] = None,
+                   hbm_per_chip_gb: float = 16.0) -> ParallelismPlan:
+    """``choose_parallelism`` from a preset config name (the spelling
+    the controller uses — service specs carry model names, not byte
+    counts)."""
+    return choose_parallelism(
+        model_weight_bytes(cfg_name, quantize=quantize), chips,
+        slo_tier=slo_tier, hbm_per_chip_gb=hbm_per_chip_gb)
+
+
+def plan_for_spec(spec) -> ParallelismPlan:
+    """The controller's entry point: the replica (tp, dp) plan for a
+    service spec's ``parallelism`` block. 'fixed' pins the explicit
+    shape; 'adaptive' with a model name runs the Nitsum-style policy;
+    no block (or a 1-chip replica with no model) stays single-chip."""
+    if spec.parallelism_policy == 'fixed' or (
+            spec.tp is not None or spec.dp is not None):
+        return ParallelismPlan(tp=int(spec.tp or 1), dp=int(spec.dp or 1),
+                               reason='fixed by service spec')
+    if spec.parallelism_model is None:
+        if spec.chips_per_replica > 1:
+            # Chips with no model-size signal: a pure-dp split is the
+            # safe default (no fit constraint to satisfy, no
+            # collectives added).
+            return ParallelismPlan(tp=1, dp=spec.chips_per_replica,
+                                   reason='no model size declared: '
+                                          'chips as dp replicas')
+        return ParallelismPlan(tp=1, dp=1, reason='single-chip replica')
+    return plan_for_model(spec.parallelism_model,
+                          spec.chips_per_replica,
+                          slo_tier=spec.slo_tier,
+                          quantize=spec.parallelism_quantize,
+                          hbm_per_chip_gb=spec.hbm_per_chip_gb)

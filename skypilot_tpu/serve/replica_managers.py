@@ -150,11 +150,22 @@ class ReplicaManager:
         envs['SKYTPU_REPLICA_PORT'] = str(info.port)
         envs['SKYTPU_SERVE_REPLICA_ID'] = str(info.replica_id)
         envs['SKYTPU_SERVE_SERVICE'] = self.service_name
+        # Adaptive-TP placement (serve/placement.py): the replica's
+        # (tp, dp) mesh shape rides the launch env — the model server
+        # reads SKYTPU_TP/SKYTPU_DP via serving_spec_from_env unless
+        # overridden with explicit --tp/--dp.
+        envs.update(self.parallelism_plan().as_env())
         task.update_envs(envs)
         if info.is_spot:
             task.set_resources([r.copy(use_spot=True)
                                 for r in task.resources])
         return task
+
+    def parallelism_plan(self):
+        """The (tp, dp) plan every replica of the current spec version
+        launches with (serve/placement.py)."""
+        from skypilot_tpu.serve import placement
+        return placement.plan_for_spec(self.spec)
 
     def scale_up(self, use_spot: bool = False) -> Optional[int]:
         """Start one replica launch in the background; returns its id

@@ -252,6 +252,15 @@ class RequestScheduler:
         self._max_queue_tokens = max_queue_tokens or None
         self._wake = wake or (lambda: None)
         self._engine: Optional[Any] = None
+        # Mesh throughput factor (tp x dp of the bound engine's mesh):
+        # scales the WORK-TOKEN RATE estimates — the cold-meter
+        # Retry-After fallback and nothing else. Work tokens themselves
+        # stay mesh-independent (a token is a token); only how fast the
+        # engine chews through them changes with mesh shape. Once the
+        # live _TokenRateMeter warms up it dominates, so the factor
+        # only matters for the first seconds after boot — exactly when
+        # a tp=4 replica must not tell clients to back off 4x too long.
+        self._mesh_speedup = 1
         self._q_lock = threading.Lock()
         self._queues: Dict[str, List[ScheduledRequest]] = {
             t: [] for t in TIERS}
@@ -311,6 +320,10 @@ class RequestScheduler:
         its KV pool capacity."""
         with self._q_lock:
             self._engine = engine
+            if hasattr(engine, 'mesh_axes'):
+                axes = engine.mesh_axes()
+                self._mesh_speedup = max(
+                    1, int(axes.get('tp', 1)) * int(axes.get('dp', 1)))
             if self._max_queue_tokens is None:
                 cap = 0
                 if hasattr(engine, 'kv_pool_stats'):
@@ -321,7 +334,13 @@ class RequestScheduler:
         logger.info(
             f'scheduler bound: max_queue_tokens={self._max_queue_tokens} '
             f'default_tier={self.default_tier} '
-            f'latency_admit_frac={self.latency_admit_frac}')
+            f'latency_admit_frac={self.latency_admit_frac} '
+            f'mesh_speedup={self._mesh_speedup}')
+
+    @property
+    def mesh_speedup(self) -> int:
+        """tp x dp of the bound engine's mesh (1 until bound)."""
+        return self._mesh_speedup
 
     @property
     def max_queue_tokens(self) -> int:
@@ -393,9 +412,13 @@ class RequestScheduler:
         rate = self._rate.rate()
         if rate <= 0.0:
             # Cold meter: assume the engine streams ~8 tok/s/slot (a
-            # deliberately conservative interactive-decode floor).
+            # deliberately conservative interactive-decode floor),
+            # scaled by the mesh's tp x dp — a sharded replica chews
+            # the same work tokens proportionally faster, and quoting
+            # a single-chip Retry-After off a tp=4 mesh overstates the
+            # backoff 4x right when the replica is freshest.
             eng_batch = eng.max_batch if eng is not None else 8
-            rate = 8.0 * max(1, eng_batch)
+            rate = 8.0 * max(1, eng_batch) * self._mesh_speedup
         return int(min(_RETRY_AFTER_MAX_S,
                        max(_RETRY_AFTER_MIN_S,
                            math.ceil((ahead + work) / rate))))
@@ -650,5 +673,6 @@ class RequestScheduler:
             'default_tier': self.default_tier,
             'max_queue_tokens': self.max_queue_tokens,
             'latency_admit_frac': self.latency_admit_frac,
+            'mesh_speedup': self._mesh_speedup,
             'tiers': tiers,
         }

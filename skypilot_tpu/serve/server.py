@@ -71,6 +71,8 @@ class ModelServer:
                  max_seq: int = 1024, port: int = 8081,
                  model_path: Optional[str] = None,
                  quantize: Optional[str] = None,
+                 tp: Optional[int] = None,
+                 dp: Optional[int] = None,
                  kv_cache: str = 'paged',
                  kv_cache_dtype: Optional[str] = None,
                  page_size: Optional[int] = None,
@@ -86,6 +88,16 @@ class ModelServer:
         self.cfg_name = cfg_name
         self.model_path = model_path  # HF checkpoint dir (real weights)
         self.quantize = quantize      # 'int8' => int8 weights
+        # Serving mesh shape: explicit args win, else the controller's
+        # adaptive-TP placement env (SKYTPU_TP/SKYTPU_DP), else 1x1.
+        # Resolved HERE (not at engine load) so the mesh gauges and the
+        # JSON mesh block report the configured shape from the very
+        # first scrape — the LB's replica view must not see a replica
+        # flap from 1x1 to tp=2 mid-boot.
+        from skypilot_tpu.parallel import mesh as mesh_lib
+        self._mesh_spec = mesh_lib.serving_spec_from_env(tp=tp, dp=dp)
+        self.tp = self._mesh_spec.tp
+        self.dp = self._mesh_spec.dp
         self.kv_cache = kv_cache      # 'slot' | 'paged' (prefix caching)
         # KV storage dtype ('bf16' | 'int8'); None follows --quantize.
         # Decoupled: int8 KV over bf16 weights halves the dominant
@@ -187,6 +199,14 @@ class ModelServer:
         engine_cls = (PagedInferenceEngine if self.kv_cache == 'paged'
                       else InferenceEngine)
         extra = {}
+        if self.tp * self.dp > 1:
+            # Multi-chip serving: build the (tp, dp) mesh over the
+            # first tp*dp visible devices and hand it to the engine
+            # (params + KV pool pre-partitioned by logical axes; jitted
+            # steps pin matching output shardings — the zero-resharding
+            # contract the paged-tp jaxpr-audit preset gates).
+            from skypilot_tpu.parallel import mesh as mesh_lib
+            extra['mesh'] = mesh_lib.serving_mesh(self.tp, self.dp)
         if self.kv_cache == 'paged' and self.page_size is not None:
             extra['page_size'] = self.page_size
         if self.prefill_chunk_tokens is not None:
@@ -506,6 +526,13 @@ class ModelServer:
               len(getattr(eng, '_prefill_off', ())) if eng else 0)
         g('skytpu_max_batch', 'Configured decode batch').set(
             self.max_batch)
+        # Serving mesh shape, one series per logical axis — all 1s on
+        # a single-chip replica, configured values before the engine
+        # loads (stable schema: the series never appear/disappear).
+        for axis, size in self._mesh_axes().items():
+            g('skytpu_mesh_shape',
+              'Serving mesh axis size (1 = axis unused)',
+              axis=axis).set(size)
         g('skytpu_speculate_k',
           'Speculative proposal depth (0 = off)').set(
               spec.get('speculate_k', 0))
@@ -538,6 +565,17 @@ class ModelServer:
         g('skytpu_kv_pool_preemptions_total',
           'Pool-pressure preemptions (recompute requeues)').set(
               pool['preemptions'])
+
+    def _mesh_axes(self) -> Dict[str, int]:
+        """The replica's mesh shape: the live engine's view once
+        loaded, the configured (tp, dp) spec before — same keys either
+        way (every logical axis, 1 when unused)."""
+        eng = self.engine
+        if eng is not None and hasattr(eng, 'mesh_axes'):
+            return eng.mesh_axes()
+        from skypilot_tpu.parallel import mesh as mesh_lib
+        return {a: int(s) for a, s in zip(mesh_lib.MESH_AXES,
+                                          self._mesh_spec.shape)}
 
     def _kv_pool_stats(self) -> Dict[str, Any]:
         """Engine KV pool stats with a stable all-zeros fallback before
@@ -608,6 +646,11 @@ class ModelServer:
             'kv_pool_tokens_used': pool['tokens_used'],
             'kv_pool_tokens_free': pool['tokens_free'],
             'kv_pool_preemptions': pool['preemptions'],
+            # Serving mesh shape (stable: configured values before the
+            # engine loads, 1s on a single-chip replica). The LB's
+            # replica view and the adaptive-TP policy read this.
+            'mesh': dict(self._mesh_axes(),
+                         devices=self.tp * self.dp),
             'scheduler': {
                 'prefill_chunk_tokens': getattr(eng, 'chunk', 0) or 0,
                 'decode_priority_ratio': getattr(
@@ -1093,6 +1136,19 @@ def main() -> None:
     parser.add_argument('--quantize', default=None, choices=['int8'],
                         help='int8 weights (the KV cache follows via '
                              '--kv-cache-dtype auto; 2x decode)')
+    parser.add_argument('--tp', type=int, default=None,
+                        help='tensor-parallel degree: shard weights + '
+                             'KV heads over this many chips (decode '
+                             'TPOT improves ~linearly; required once '
+                             'the model outgrows one chip). Default: '
+                             'SKYTPU_TP env (the controller\'s '
+                             'adaptive-TP placement), else 1')
+    parser.add_argument('--dp', type=int, default=None,
+                        help='data-parallel degree: shard the decode '
+                             'batch over chip groups (aggregate tok/s '
+                             'scales; TPOT unchanged). Default: '
+                             'SKYTPU_DP env, else 1. The mesh uses '
+                             'tp*dp visible devices')
     parser.add_argument('--kv-cache-dtype', default=None,
                         choices=['bf16', 'int8'],
                         help='KV cache storage dtype; default follows '
@@ -1178,6 +1234,7 @@ def main() -> None:
                          max_seq=args.max_seq, port=args.port,
                          model_path=args.model_path,
                          quantize=args.quantize,
+                         tp=args.tp, dp=args.dp,
                          kv_cache=args.kv_cache,
                          kv_cache_dtype=args.kv_cache_dtype,
                          page_size=args.page_size,

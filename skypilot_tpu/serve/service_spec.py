@@ -36,6 +36,20 @@ class SkyServiceSpec:
     # resolved on the controller cluster.
     tls_certfile: Optional[str] = None
     tls_keyfile: Optional[str] = None
+    # Multi-chip replica parallelism (``parallelism:`` block).
+    # 'adaptive' picks (tp, dp) per model size and SLO tier
+    # (serve/placement.py — Nitsum-style: latency tier maxes tp for
+    # TPOT, throughput tier takes the smallest fitting tp and spends
+    # the rest on dp); 'fixed' pins the explicit tp/dp below. The plan
+    # reaches replicas as SKYTPU_TP/SKYTPU_DP launch env.
+    parallelism_policy: str = 'adaptive'
+    chips_per_replica: int = 1
+    slo_tier: str = 'latency'
+    parallelism_model: Optional[str] = None
+    parallelism_quantize: Optional[str] = None
+    hbm_per_chip_gb: float = 16.0
+    tp: Optional[int] = None
+    dp: Optional[int] = None
 
     def __post_init__(self):
         if not self.readiness_path.startswith('/'):
@@ -82,6 +96,16 @@ class SkyServiceSpec:
         if tls:
             fields.update(tls_certfile=tls.get('certfile'),
                           tls_keyfile=tls.get('keyfile'))
+        par = config.get('parallelism')
+        if par:
+            fields.update(
+                parallelism_policy=par.get('policy', 'adaptive'),
+                chips_per_replica=int(par.get('chips_per_replica', 1)),
+                slo_tier=par.get('slo_tier', 'latency'),
+                parallelism_model=par.get('model'),
+                parallelism_quantize=par.get('quantize'),
+                hbm_per_chip_gb=float(par.get('hbm_per_chip_gb', 16.0)),
+                tp=par.get('tp'), dp=par.get('dp'))
         if policy is not None and 'replicas' in config:
             raise exceptions.InvalidServiceSpecError(
                 'Give either replicas (fixed) or replica_policy, not both.')

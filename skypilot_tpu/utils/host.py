@@ -97,3 +97,24 @@ def host_block(tree: Any) -> Any:
     with _sanctioned():
         import jax
         return jax.block_until_ready(tree)
+
+
+def device_upload(tree: Any, sharding: Any = None) -> Any:
+    """The sanctioned host->device upload for compute-layer step paths.
+
+    Thin wrapper over ``jax.device_put`` whose NAME carries the
+    contract: the operands are freshly built HOST arrays (numpy) —
+    never committed device arrays — so the call is a pure h2d copy and
+    can NEVER trigger an implicit cross-mesh reshard of device state.
+    graftcheck GC113 bans bare ``jax.device_put`` inside ``inference/``
+    step functions; placement (construction-time sharding of params and
+    caches) stays on ``jax.device_put`` in the sanctioned helpers
+    (``prepare_params``, engine ``__init__``).
+
+    ``sharding`` (optional) pre-partitions the upload — matching the
+    consuming program's ``in_shardings`` so steady state never inserts
+    a resharding collective between upload and use."""
+    import jax
+    if sharding is None:
+        return jax.device_put(tree)
+    return jax.device_put(tree, sharding)

@@ -119,6 +119,25 @@ SERVICE_SCHEMA: Dict[str, Any] = {
                 'keyfile': {'type': 'string'},
             },
         },
+        # Multi-chip replica parallelism: adaptive picks (tp, dp) per
+        # model size and SLO tier (serve/placement.py); fixed pins an
+        # explicit shape. Exported to replicas as SKYTPU_TP/SKYTPU_DP.
+        'parallelism': {
+            'type': 'object',
+            'additionalProperties': False,
+            'properties': {
+                'policy': {'type': 'string',
+                           'enum': ['adaptive', 'fixed']},
+                'chips_per_replica': {'type': 'integer', 'minimum': 1},
+                'slo_tier': {'type': 'string',
+                             'enum': ['latency', 'throughput']},
+                'model': {'type': 'string'},
+                'quantize': {'type': 'string', 'enum': ['int8']},
+                'hbm_per_chip_gb': {'type': 'number'},
+                'tp': {'type': 'integer', 'minimum': 1},
+                'dp': {'type': 'integer', 'minimum': 1},
+            },
+        },
     },
 }
 

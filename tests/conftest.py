@@ -83,3 +83,21 @@ def tmp_state_dir(tmp_path, monkeypatch):
     monkeypatch.setenv('SKYTPU_STATE_DIR', str(tmp_path / 'state'))
     monkeypatch.setenv('SKYTPU_KEYS_DIR', str(tmp_path / 'keys'))
     yield tmp_path / 'state'
+
+
+@pytest.fixture()
+def tp_devices():
+    """Devices for tensor-parallel (multi-chip serving) tests. This
+    conftest forces an 8-device virtual CPU mesh before jax
+    initializes, so the skip below should never fire in CI — when it
+    does (XLA_FLAGS overridden, or a real single-chip backend won the
+    platform race), it says so LOUDLY instead of letting the TP suite
+    vanish silently."""
+    if jax.device_count() < 2:
+        pytest.skip(
+            'tensor-parallel tests need >= 2 devices but only '
+            f'{jax.device_count()} visible. tests/conftest.py forces '
+            'XLA_FLAGS=--xla_force_host_platform_device_count=8; this '
+            'environment overrode it — run with that flag (and '
+            'JAX_PLATFORMS=cpu) to exercise the TP serving path.')
+    return jax.devices()

@@ -508,6 +508,61 @@ def test_gc112_suppression_and_for_loops():
     assert rule_ids(suppressed) == []
 
 
+# ------------------------------------------------------------------ GC113
+def test_gc113_device_put_in_step_path_flagged():
+    src = '''
+    import jax
+    def _enqueue_decode(self, table, lengths):
+        table_d, lengths_d = jax.device_put((table, lengths))
+        return table_d, lengths_d
+    '''
+    assert rule_ids(src, 'skypilot_tpu/inference/x.py') == ['GC113']
+    # Only inference/ is policed — serve/models code places freely.
+    assert rule_ids(src, 'skypilot_tpu/serve/x.py') == []
+    assert rule_ids(src, 'skypilot_tpu/models/x.py') == []
+
+
+def test_gc113_placement_helpers_exempt():
+    src = '''
+    import jax
+    def prepare_params(cfg, params, mesh):
+        return jax.device_put(params, mesh)
+    class Engine:
+        def __init__(self, cache, sh):
+            self.cache = jax.device_put(cache, sh)
+        @classmethod
+        def from_pretrained(cls, params):
+            return jax.device_put(params)
+    '''
+    assert rule_ids(src, 'skypilot_tpu/inference/x.py') == []
+
+
+def test_gc113_device_upload_spelling_fine():
+    src = '''
+    from skypilot_tpu.utils.host import device_upload
+    def _prefill_chunk_batch(self, tokens, starts):
+        return device_upload((tokens, starts))
+    '''
+    assert rule_ids(src, 'skypilot_tpu/inference/x.py') == []
+
+
+def test_gc113_inline_suppression():
+    src = '''
+    import jax
+    def _spec_verify_call(self, rows):
+        return jax.device_put(rows)  # graftcheck: disable=GC113
+    '''
+    assert rule_ids(src, 'skypilot_tpu/inference/x.py') == []
+
+
+def test_gc113_whole_repo_clean():
+    # The engines' own step paths ride device_upload; any new bare
+    # device_put in inference/ fails here before it ships.
+    from skypilot_tpu.analysis import lint
+    new, _ = lint.lint_paths(None, baseline=lint.load_baseline(None))
+    assert [v for v in new if v.rule == 'GC113'] == []
+
+
 # ------------------------------------------------------------------ GC201
 def test_gc201_impure_calls_inside_jit():
     src = '''

@@ -185,3 +185,53 @@ def test_kv_int8_presets_registered():
     """The kv-int8 presets gate CI through the default preset list."""
     assert 'kv-int8' in jaxpr_audit.PRESETS
     assert 'kv-int8-slot' in jaxpr_audit.PRESETS
+
+
+# ------------------------------------------------------------ sharded (tp)
+def _need_devices(n: int) -> None:
+    import jax
+    if jax.device_count() < n:
+        pytest.skip(
+            f'tp audit needs {n} devices, have {jax.device_count()}: '
+            'run under XLA_FLAGS=--xla_force_host_platform_device_'
+            f'count={n} (tests/conftest.py forces 8 — a single-device '
+            'run means the forced count was overridden)')
+
+
+def test_paged_tp_audit():
+    """The sharded serving path (tp=2 CPU mesh): zero steady-state
+    recompiles, zero unsanctioned d2h, and the collective census shows
+    ONLY the known decode set — per-layer all-reduces plus the
+    tp-sharded argmax's tiny top-candidate all-gathers; the pool merge
+    (shard_map per-shard scatters) must be collective-FREE. A pool- or
+    ring-shaped gather appearing here means an output sharding stopped
+    matching the next step's input sharding."""
+    _need_devices(2)
+    report = jaxpr_audit.audit_engine('paged', chunked=True, mesh_tp=2)
+    _assert_hot_loop_clean(report)
+    assert report.collectives, 'tp preset must census collectives'
+    assert report.collective_violations() == [], report.format()
+    assert report.collectives.get('merge') == {}, \
+        'the shard_map pool merge must be collective-free'
+    assert report.collectives['decode'].get('all-to-all', 0) == 0
+
+
+@pytest.mark.slow
+def test_paged_tp_int8_audit():
+    _need_devices(2)
+    report = jaxpr_audit.audit_engine('paged', chunked=True, mesh_tp=2,
+                                      kv_cache_dtype='int8')
+    _assert_hot_loop_clean(report)
+    assert report.collective_violations() == [], report.format()
+    assert report.collectives.get('merge') == {}
+
+
+def test_paged_tp_presets_registered():
+    """The tp presets ride the default list AND declare their device
+    need so single-device drivers (graftcheck CLI) re-exec instead of
+    silently skipping."""
+    assert 'paged-tp' in jaxpr_audit.PRESETS
+    assert 'paged-tp-int8' in jaxpr_audit.PRESETS
+    assert 'paged-tp' in jaxpr_audit.DEFAULT_PRESETS
+    assert 'paged-tp-int8' in jaxpr_audit.DEFAULT_PRESETS
+    assert jaxpr_audit.MULTI_DEVICE_PRESETS['paged-tp'] == 2

@@ -71,7 +71,8 @@ def test_kv_pool_stats_schema(setup):
     and counts only allocatable pages (page 0 reserved)."""
     cfg, params = setup
     keys = {'kv_cache_dtype', 'pool_token_capacity', 'tokens_used',
-            'tokens_free', 'preemptions', 'kv_token_bytes'}
+            'tokens_free', 'preemptions', 'kv_token_bytes',
+            'kv_token_bytes_per_shard', 'kv_shards'}
     slot = InferenceEngine(cfg, params, max_batch=2, max_seq=64,
                            attn_impl='xla', kv_cache_dtype='int8')
     s = slot.kv_pool_stats()

@@ -344,6 +344,18 @@ def test_server_prometheus_metrics_and_debug_requests():
         assert m['requests_served'] >= 1
         assert m['ttft_window'] >= 1
 
+        # (b3) Serving-mesh shape: one gauge series per logical axis
+        # with 1s on a single-chip replica (stable — the series never
+        # appear/disappear with mesh shape), and the JSON mesh block
+        # the LB's replica view reads, present from the first scrape.
+        from skypilot_tpu.parallel import mesh as mesh_lib
+        assert '# TYPE skytpu_mesh_shape gauge' in prom
+        for axis in mesh_lib.MESH_AXES:
+            assert f'skytpu_mesh_shape{{axis="{axis}"}} 1' in prom, axis
+        assert set(m['mesh']) == set(mesh_lib.MESH_AXES) | {'devices'}
+        assert m['mesh']['tp'] == 1 and m['mesh']['devices'] == 1
+        assert m['sched']['mesh_speedup'] == 1
+
         # (b2) SLO-scheduler stable schema: every per-tier series is
         # registered at construction, so both tiers (and every shed
         # reason) render from the FIRST scrape — zeros, never omitted.
