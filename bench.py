@@ -135,6 +135,8 @@ def main() -> None:
              lambda: _serving_tp_bench(n_chips)),
             ('chaos',
              lambda: _chaos_bench(n_chips)),
+            ('disagg',
+             lambda: _disagg_bench(n_chips)),
             ('train',
              lambda: _train_step_bench(on_tpu, n_chips,
                                        chip_peak_tflops))):
@@ -1316,6 +1318,248 @@ def _chaos_bench(n_chips: int) -> dict:
         'zero_lost_contract_held':
             faulted['lost_requests'] == 0
             and clean['lost_requests'] == 0,
+    }
+
+
+def _disagg_bench(n_chips: int) -> dict:
+    """Disaggregation block (round 9): colocated vs disaggregated at
+    EQUAL chips (two tiny engines each), through the real LB. The
+    workload is the disaggregation thesis in miniature: a steady
+    latency-tier stream of short interactive prompts plus a burst of
+    long throughput-tier prompts. On the colocated fleet every replica
+    interleaves the burst's chunked prefill with decode — latency-tier
+    TTFT tails out behind prefill chunks; the disaggregated fleet's
+    decode worker never runs a prefill program, so the latency tier's
+    continuations ride undisturbed (the TTFT itself still includes one
+    prefill + handoff hop). Records per-tier TTFT p50/p90, sustained
+    out-tok/s/chip, handoff bytes + p90 transfer latency, SLO
+    attainment, and the headline ``ttft_isolation`` ratio
+    (disagg latency-tier p90 / colocated p90 under the same burst).
+    Tiny config on any backend: it measures the SERVING layer, not the
+    model. Warning-free by construction (asserted into the block)."""
+    import json as _json
+    import random
+    import threading
+    import urllib.request
+    import warnings as warnings_mod
+
+    import http.server as hs
+
+    from skypilot_tpu import telemetry
+    from skypilot_tpu.serve.load_balancer import SkyServeLoadBalancer
+    from skypilot_tpu.serve.server import ModelServer
+    from skypilot_tpu.utils import common_utils
+
+    # A burst of LONG-DECODE throughput requests saturates the decode
+    # phase first (their prefill completes during the settle window);
+    # the latency stream then arrives into a fleet whose chips are
+    # busy decoding. Colocated: every latency prefill chunk interleaves
+    # with burst decode horizons on both replicas. Disaggregated: the
+    # burst decodes on the decode worker, the prefill worker's chips
+    # are free — the latency tier's TTFT tail is isolated from the
+    # burst (it pays one prefill + one handoff hop instead).
+    n_lat, n_burst = 8, 4
+    lat_gen, burst_gen = 16, 96
+    burst_settle_s = 4.0              # burst prefill -> decode phase
+    lat_rate = 2.0                    # steady latency arrivals (req/s)
+    ttft_slo_ms = {'latency': 2000.0, 'throughput': 60000.0}
+
+    def make_controller(urls, roles):
+        class H(hs.BaseHTTPRequestHandler):
+            timeout = 30
+
+            def log_message(self, *a):
+                del a
+
+            def do_POST(self):  # noqa: N802
+                body = _json.dumps({'ready_replica_urls': urls,
+                                    'retry_after_s': 5,
+                                    'replica_roles': roles}).encode()
+                self.send_response(200)
+                self.send_header('Content-Length', str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+        port = common_utils.find_free_port(18600)
+        httpd = hs.ThreadingHTTPServer(('127.0.0.1', port), H)
+        threading.Thread(target=httpd.serve_forever, daemon=True).start()
+        return httpd, f'http://127.0.0.1:{port}'
+
+    def run_pass(mode):
+        pa = common_utils.find_free_port(18640)
+        pb = common_utils.find_free_port(pa + 1)
+        # 16-token prefill chunks so every latency prompt's admission
+        # interleaves with (colocated: burst decode horizons;
+        # disagg: an idle prefill worker). Decode batch covers the
+        # whole burst — no capacity refusals muddying the comparison.
+        kw = dict(max_batch=6, max_seq=160, prefill_chunk_tokens=16,
+                  kv_cache_dtype='int8')
+        roles = (('prefill', 'decode') if mode == 'disagg'
+                 else ('colocated', 'colocated'))
+        sa = ModelServer('tiny', port=pa, role=roles[0], **kw)
+        sb = ModelServer('tiny', port=pb, role=roles[1], **kw)
+        sa.start(block=False)
+        sb.start(block=False)
+        httpd = lb = None
+        reg = telemetry.get_registry()
+        try:
+            if not (sa._ready.wait(600) and sb._ready.wait(600)):
+                raise RuntimeError('disagg replicas never became ready')
+            urls = [f'http://127.0.0.1:{pa}', f'http://127.0.0.1:{pb}']
+            httpd, ctrl_url = make_controller(
+                urls, dict(zip(urls, roles)))
+            lb_port = common_utils.find_free_port(18680)
+            os.environ['SKYTPU_LB_SYNC'] = '3600'
+            lb = SkyServeLoadBalancer(
+                controller_url=ctrl_url, port=lb_port,
+                policy_name=('phase_aware' if mode == 'disagg'
+                             else 'queue_depth'),
+                max_attempts=4)
+            lb.start()
+            lb._sync_once()
+            bytes0 = reg.get('skytpu_kv_transfer_bytes_total',
+                             direction='export').value
+            h_transfer = reg.histogram('skytpu_kv_transfer_seconds')
+            t_count0 = h_transfer.count
+            lock = threading.Lock()
+            results = []              # (tier, ttft_s or None, n_tokens)
+
+            def one(prompt, gen, tier):
+                body = _json.dumps({'prompt': prompt,
+                                    'max_new_tokens': gen,
+                                    'stream': True,
+                                    'slo_tier': tier}).encode()
+                req = urllib.request.Request(
+                    f'http://127.0.0.1:{lb_port}/generate', body,
+                    {'Content-Type': 'application/json'})
+                t0, first, n = time.time(), None, 0
+                try:
+                    with urllib.request.urlopen(req,
+                                                timeout=600) as resp:
+                        for line in resp:
+                            if not line.startswith(b'data:'):
+                                continue
+                            try:
+                                ev = _json.loads(line[5:].strip())
+                            except ValueError:
+                                continue
+                            if 'token' in ev:
+                                if first is None:
+                                    first = time.time()
+                                n += 1
+                            if 'error' in ev or ev.get('done'):
+                                break
+                except Exception:  # pylint: disable=broad-except
+                    pass           # counted as incomplete below
+                with lock:
+                    results.append(
+                        (tier, (first - t0) if first else None, n))
+
+            rng = random.Random(11)
+            t_start = time.time()
+            threads = []
+            # The burst lands first and settles into its decode phase;
+            # the steady latency stream then arrives into a fleet busy
+            # DECODING the burst.
+            for i in range(n_burst):
+                prompt = [23 + (i * 17 + j) % 151 for j in range(32)]
+                th = threading.Thread(target=one,
+                                      args=(prompt, burst_gen,
+                                            'throughput'))
+                th.start()
+                threads.append(th)
+            time.sleep(burst_settle_s)
+            for i in range(n_lat):
+                prompt = [7 + (i * 13 + j) % 89 for j in range(8)]
+                th = threading.Thread(target=one,
+                                      args=(prompt, lat_gen, 'latency'))
+                th.start()
+                threads.append(th)
+                time.sleep(rng.expovariate(lat_rate))
+            for th in threads:
+                th.join(timeout=600)
+            wall = max(1e-6, time.time() - t_start)
+            out: dict = {'mode': mode, 'replicas': 2}
+            total_tokens = sum(n for _, _, n in results)
+            out['sustained_out_tok_s'] = round(total_tokens / wall, 1)
+            out['sustained_out_tok_s_per_chip'] = round(
+                total_tokens / wall / max(1, min(2, n_chips)), 1)
+            for tier in ('latency', 'throughput'):
+                want = {'latency': (n_lat, lat_gen),
+                        'throughput': (n_burst, burst_gen)}[tier]
+                ttfts = sorted((t * 1e3 for tr, t, _ in results
+                                if tr == tier and t is not None))
+                n_done = sum(1 for tr, t, n in results
+                             if tr == tier and n == want[1])
+                ok = sum(1 for ms in ttfts
+                         if ms <= ttft_slo_ms[tier])
+                out[tier] = {
+                    'n_sent': want[0],
+                    'n_completed': n_done,
+                    'ttft_ms_p50': (round(ttfts[len(ttfts) // 2], 1)
+                                    if ttfts else None),
+                    'ttft_ms_p90': (round(
+                        ttfts[min(len(ttfts) - 1,
+                                  int(len(ttfts) * 0.9))], 1)
+                        if ttfts else None),
+                    'slo_attainment': (round(ok / want[0], 3)
+                                       if want[0] else None),
+                }
+            handoff_bytes = int(reg.get(
+                'skytpu_kv_transfer_bytes_total',
+                direction='export').value - bytes0)
+            transfers = h_transfer.snapshot()['window']
+            new_t = sorted(transfers[len(transfers)
+                                     - (h_transfer.count - t_count0):]) \
+                if h_transfer.count > t_count0 else []
+            out['handoff'] = {
+                'count': int(h_transfer.count - t_count0),
+                'bytes_total': handoff_bytes,
+                'transfer_s_p50': (round(new_t[len(new_t) // 2], 4)
+                                   if new_t else None),
+                'transfer_s_p90': (round(
+                    new_t[min(len(new_t) - 1, int(len(new_t) * 0.9))],
+                    4) if new_t else None),
+            }
+            return out
+        finally:
+            if lb is not None:
+                lb.stop()
+            if httpd is not None:
+                httpd.shutdown()
+            sa.stop()
+            sb.stop()
+
+    with warnings_mod.catch_warnings(record=True) as caught:
+        warnings_mod.simplefilter('always')
+        colocated = run_pass('colocated')
+        disagg = run_pass('disagg')
+    # The pinned warning-free discipline covers the serving layer's
+    # own warnings (page-size footguns etc.), not interpreter noise
+    # (ResourceWarning from HTTP teardown).
+    user_warnings = [str(w.message) for w in caught
+                     if issubclass(w.category, UserWarning)]
+    iso = None
+    if (colocated['latency']['ttft_ms_p90']
+            and disagg['latency']['ttft_ms_p90']):
+        iso = round(disagg['latency']['ttft_ms_p90']
+                    / colocated['latency']['ttft_ms_p90'], 3)
+    return {
+        'workload': {'latency_requests': n_lat,
+                     'burst_throughput_requests': n_burst,
+                     'latency_gen': lat_gen, 'burst_gen': burst_gen,
+                     'burst_prompt_tokens': 32,
+                     'burst_settle_s': burst_settle_s,
+                     'prefill_chunk_tokens': 16,
+                     'ttft_slo_ms': ttft_slo_ms,
+                     'model': 'tiny', 'chips_per_fleet': 2},
+        'colocated': colocated,
+        'disaggregated': disagg,
+        # < 1.0 = the decode worker's isolation beat colocated's
+        # interleaved prefill under the same burst (the acceptance
+        # target is <= 0.5 on the TPU anchor workload).
+        'latency_ttft_p90_isolation_ratio': iso,
+        'warnings': user_warnings,
     }
 
 

@@ -555,6 +555,79 @@ def audit_llama_forward() -> AuditReport:
     return report
 
 
+def audit_disagg() -> AuditReport:
+    """Disaggregated prefill→decode handoff steady state (int8 wire).
+
+    Two tiny paged engines play prefill worker and decode worker:
+    each round the prefill engine admits + chunk-prefills a fixed
+    prompt set, exports every request's KV snapshot at its first
+    token (the sanctioned ``host_sync`` readback — the rows LEAVE the
+    process by design), the wire codec round-trips it, and the decode
+    engine ingests + decodes to completion. After a warmup round the
+    audited rounds must show:
+
+    - the DECODE worker compiles **zero prefill programs** — phase
+      isolation is real, not just routing (its only programs are the
+      ingest merge and the decode chain);
+    - ingest causes **zero extra recompiles** (the ingest fn cache and
+      decode jit caches stay at their warmup size) and **zero
+      unsanctioned d2h transfers**;
+    - the prefill worker's export adds no unsanctioned transfers
+      either (every readback rides ``host_sync``)."""
+    from skypilot_tpu.inference import kv_transfer
+    report = AuditReport(
+        name='disagg prefill→decode handoff (paged, int8 wire)')
+    prefill = _tiny_engine('paged', chunked=True,
+                           kv_cache_dtype='int8')
+    decode = _tiny_engine('paged', chunked=True, kv_cache_dtype='int8')
+    prompts = [[1, 2, 3] * 9, [4, 5] * 10, [7] * 21]
+
+    def one_round() -> None:
+        rids = [prefill.add_request(list(p), max_new_tokens=24,
+                                    hold=True) for p in prompts]
+        first: Dict[int, int] = {}
+        waiting = set(rids)
+        while waiting:
+            for rid, token, _fin in prefill.step(horizon=4):
+                if rid in waiting:
+                    first[rid] = token
+                    waiting.discard(rid)
+        for rid in rids:
+            snap, _events = prefill.export_kv_snapshot(rid)
+            assert snap is not None, f'export failed for {rid}'
+            prefill.cancel(rid)
+            snap = kv_transfer.decode_handoff(
+                kv_transfer.encode_handoff(snap))
+            decode.ingest_kv_snapshot(snap)
+        decode.run_to_completion(horizon=8)
+        prefill.run_to_completion(horizon=8)
+
+    one_round()                                   # warmup: compiles
+    decode_jits = _jit_fns(decode._decode_fn)
+    labels = {
+        'decode-worker decode': lambda: (sum(
+            _cache_size(f) for f in decode_jits)
+            if decode_jits else -1),
+        'decode-worker ingest': lambda: len(decode._ingest_fns),
+        # Phase isolation: the decode worker must never compile a
+        # prefill program — not at warmup, not ever. Recorded with a
+        # ZERO baseline so any prefill compile (warmup included)
+        # fails ok() as cache growth.
+        'decode-worker prefill programs (must stay 0)': lambda: len(
+            decode._prefill_fns),
+        'prefill-worker export': lambda: len(prefill._export_fns),
+        'prefill-worker prefill': lambda: len(prefill._prefill_fns),
+    }
+    before = {k: get() for k, get in labels.items()}
+    before['decode-worker prefill programs (must stay 0)'] = 0
+    with intercept_host_transfers(report.transfers):
+        for _ in range(2):
+            one_round()
+    report.compile_counts = {
+        k: (before[k], get()) for k, get in labels.items()}
+    return report
+
+
 def audit_telemetry_parity(kind: str = 'slot') -> AuditReport:
     """Prove telemetry is free at the device boundary: a
     telemetry-ENABLED engine run performs zero unsanctioned d2h
@@ -628,6 +701,10 @@ PRESETS: Dict[str, Callable[[], AuditReport]] = {
     'paged-tp-int8': lambda: audit_engine('paged', chunked=True,
                                           mesh_tp=2,
                                           kv_cache_dtype='int8'),
+    # Disaggregated prefill→decode handoff: the decode worker's steady
+    # state compiles ZERO prefill programs, and ingest adds zero
+    # recompiles / unsanctioned d2h (int8 KV rides the wire codec).
+    'disagg': audit_disagg,
     'llama': audit_llama_forward,
 }
 
@@ -641,7 +718,8 @@ MULTI_DEVICE_PRESETS: Dict[str, int] = {
 
 DEFAULT_PRESETS: List[str] = [
     'slot', 'paged', 'slot-spec', 'paged-spec', 'telemetry',
-    'kv-int8', 'kv-int8-slot', 'paged-tp', 'paged-tp-int8', 'llama']
+    'kv-int8', 'kv-int8-slot', 'paged-tp', 'paged-tp-int8', 'disagg',
+    'llama']
 
 
 def run_presets(names: Optional[List[str]] = None) -> List[AuditReport]:

@@ -58,6 +58,13 @@ Concurrency / control-plane hygiene (GC1xx):
   on an ``Event`` with a timeout. The delay counts as dynamic when
   its expression contains a ``random``-module/RNG call or any name
   reassigned within the loop.
+- **GC114 wide-float-kv-on-wire** — ``.astype`` to a wide float dtype
+  (bfloat16/float16/float32/float64) or any ``dequant*`` call inside a
+  KV transfer path (``inference/kv_transfer.py``, ``serve/disagg.py``).
+  Disaggregated handoffs move int8 KV as codes + absmax scales in the
+  STORED dtype; the wire codec never converts — widening KV for the
+  wire doubles handoff bytes and silently defeats the whole
+  disaggregation economics.
 
 TPU hot-path hygiene (GC2xx), applied to the compute layer
 (``inference/``, ``models/``, ``ops/``, ``train/``):
@@ -136,6 +143,12 @@ RULES: Dict[str, str] = {
              'operands go through utils.host.device_upload; placement '
              '(construction-time sharding) belongs in prepare_params '
              'or engine __init__',
+    'GC114': 'wide-float-kv-on-wire: bf16/float32 conversion (or a '
+             'dequantize call) on a KV transfer path — int8 KV must '
+             'stay int8 codes + scales end to end (the wire codec '
+             'helpers in inference/kv_transfer.py are the sanctioned '
+             'spelling); dequantizing for the wire doubles handoff '
+             'bytes and silently defeats the disaggregation win',
     'GC201': 'impure-jit: impure or host-synchronizing call inside a '
              '@jax.jit body',
     'GC202': 'host-sync: device->host readback outside the '
@@ -156,6 +169,21 @@ HOST_HELPER_SUFFIX = 'utils/host.py'
 QUANT_HELPER_SUFFIX = 'models/quantization.py'
 # Spellings of the int8 dtype as an astype argument.
 _INT8_DTYPES = {'jnp.int8', 'jax.numpy.int8', 'np.int8', 'numpy.int8'}
+
+# --------------------------------------------------------------------- GC114
+# KV transfer paths: the disaggregated-serving wire codec and handoff
+# plumbing. int8 KV rides the wire as codes + scales; ANY wide-float
+# conversion (or dequantize call) here is a silent 2x on handoff
+# bytes — the codec never changes dtype, so these files stay free of
+# both spellings entirely.
+TRANSFER_PATH_SUFFIXES = ('inference/kv_transfer.py', 'serve/disagg.py')
+_WIDE_FLOAT_DTYPES = {
+    'jnp.bfloat16', 'jax.numpy.bfloat16', 'jnp.float32',
+    'jax.numpy.float32', 'jnp.float16', 'jax.numpy.float16',
+    'np.float32', 'numpy.float32', 'np.float16', 'numpy.float16',
+    'np.float64', 'numpy.float64', 'ml_dtypes.bfloat16',
+}
+_WIDE_FLOAT_NAMES = {'bfloat16', 'float16', 'float32', 'float64'}
 
 _SUPPRESS_RE = re.compile(r'graftcheck:\s*disable=([A-Za-z0-9,\s]+)')
 
@@ -373,7 +401,8 @@ class _Checker(ast.NodeVisitor):
                  is_inference: bool = False,
                  is_quant_helper: bool = False,
                  is_serve: bool = False,
-                 is_retryloop_dir: bool = False):
+                 is_retryloop_dir: bool = False,
+                 is_transfer_path: bool = False):
         self.rel = rel
         self.lines = lines
         self.is_compute = is_compute
@@ -381,6 +410,7 @@ class _Checker(ast.NodeVisitor):
         self.is_quant_helper = is_quant_helper
         self.is_serve = is_serve
         self.is_retryloop_dir = is_retryloop_dir
+        self.is_transfer_path = is_transfer_path
         self._flagged_sleeps: Set[int] = set()   # node ids (GC112 dedupe)
         self.violations: List[Violation] = []
         self._scope: List[str] = []
@@ -640,6 +670,8 @@ class _Checker(ast.NodeVisitor):
             self._check_int8_write(node, method)
         if self.is_inference:
             self._check_device_put(node, name)
+        if self.is_transfer_path:
+            self._check_wire_dtype(node, name, method)
         if self.is_serve and self._in_async:
             self._check_async_engine_call(node, name, method)
         if self._any_lock_held():
@@ -675,6 +707,36 @@ class _Checker(ast.NodeVisitor):
                   'from_pretrained) — use utils.host.device_upload '
                   'for per-step host uploads; resharding committed '
                   'state in the step path is banned')
+
+    def _check_wire_dtype(self, node: ast.Call, name: str,
+                          method: str) -> None:
+        """GC114: wide-float conversion or dequantize call on a KV
+        transfer path. The wire codec moves KV in its STORED dtype —
+        int8 codes + fp32 scales stay exactly as resident — so a
+        ``.astype(bfloat16/float32/...)`` (or anything spelled
+        ``dequant*``) in these files means someone is widening KV for
+        the wire: 2x the handoff bytes, silently."""
+        leaf = (method or name.rsplit('.', 1)[-1]).lower()
+        if 'dequant' in leaf:
+            self._add('GC114', node,
+                      f'{leaf}() on a KV transfer path — handoffs move '
+                      'int8 KV as codes + scales (the kv_transfer wire '
+                      'codec); dequantizing for the wire doubles the '
+                      'bytes')
+            return
+        if method != 'astype' or not node.args:
+            return
+        arg = node.args[0]
+        dtype = _dotted(arg)
+        wide = (dtype in _WIDE_FLOAT_DTYPES
+                or (isinstance(arg, ast.Constant)
+                    and arg.value in _WIDE_FLOAT_NAMES))
+        if wide:
+            self._add('GC114', node,
+                      '.astype(wide float) on a KV transfer path — '
+                      'int8 KV must stay int8 codes + scales end to '
+                      'end; serialize with the kv_transfer wire codec '
+                      '(no dtype conversion)')
 
     def _check_int8_write(self, node: ast.Call, method: str) -> None:
         """GC110: ``x.astype(jnp.int8)`` / ``x.astype('int8')`` outside
@@ -861,7 +923,9 @@ def check_source(rel: str, source: str) -> List[Violation]:
                        is_serve=f'/{SERVE_DIR}/' in f'/{norm}',
                        is_retryloop_dir=any(
                            f'/{d}/' in f'/{norm}'
-                           for d in RETRYLOOP_DIRS))
+                           for d in RETRYLOOP_DIRS),
+                       is_transfer_path=norm.endswith(
+                           TRANSFER_PATH_SUFFIXES))
     checker.visit(tree)
     suppressed = _line_suppressions(source)
     out = []

@@ -599,6 +599,19 @@ def serve_logs(service_name, no_follow):
 @click.option('--fault-spec', default=None,
               help='Deterministic fault-injection spec (JSON or '
                    '@/path; default SKYTPU_FAULT_SPEC env var).')
+@click.option('--role', default=None,
+              type=click.Choice(['colocated', 'prefill', 'decode']),
+              help='Disaggregated-serving phase role: prefill workers '
+                   'hand each finished prefill\'s KV (int8 stays int8 '
+                   'on the wire) to a decode worker via POST '
+                   '/kv/ingest and relay its token stream; decode '
+                   'workers run high-batch decode without prefill '
+                   'stalls. Default: SKYTPU_ROLE env, else colocated.')
+@click.option('--handoff-targets', default=None,
+              help='Comma-separated decode-worker base URLs a prefill '
+                   'replica may hand off to when no router supplied '
+                   'X-Handoff-Target (picked by live KV-pool '
+                   'headroom). Default: SKYTPU_HANDOFF_TARGETS env.')
 @click.option('--max-batch', type=int, default=8)
 @click.option('--max-seq', type=int, default=1024)
 @click.option('--port', type=int, default=8081)
@@ -606,8 +619,8 @@ def model_server(model, model_path, quantize, tp, dp, kv_cache,
                  kv_cache_dtype, page_size, prefill_chunk_tokens,
                  decode_priority_ratio, prefill_w8a8, speculate_k,
                  slo_tier_default, max_queue_tokens, latency_admit_frac,
-                 drain_deadline_s, fault_spec, max_batch, max_seq,
-                 port):
+                 drain_deadline_s, fault_spec, role, handoff_targets,
+                 max_batch, max_seq, port):
     """Run the in-tree replica model server on this host (the process
     a service task's ``run`` command starts on each replica; same
     knobs as ``python -m skypilot_tpu.serve.server``)."""
@@ -629,10 +642,13 @@ def model_server(model, model_path, quantize, tp, dp, kv_cache,
                          max_queue_tokens=max_queue_tokens,
                          latency_admit_frac=latency_admit_frac,
                          drain_deadline_s=drain_deadline_s,
-                         fault_spec=fault_spec)
+                         fault_spec=fault_spec,
+                         role=role,
+                         handoff_targets=(handoff_targets.split(',')
+                                          if handoff_targets else None))
     click.echo(f'Model server on :{port} '
                f'(kv_cache={kv_cache}, speculate_k={speculate_k}, '
-               f'tp={server.tp}, dp={server.dp})')
+               f'tp={server.tp}, dp={server.dp}, role={server.role})')
     server.start(block=True)
 
 

@@ -65,6 +65,13 @@ class Request:
     # (paged preemption backoff) cannot park a latency-tier request
     # behind newly queued throughput work.
     priority: int = 0
+    # Disaggregated prefill: a held request runs admission + prefill
+    # and samples its first token, then TAKES NO DECODE STEPS (every
+    # decode-phase ready mask skips it) until the serve layer exports
+    # its KV to a decode worker — or releases the hold on handoff
+    # failure (colocated fallback). Keeps a prefill worker's chips on
+    # prefill instead of racing the handoff with local decode.
+    hold: bool = False
     # filled by the engine
     output: List[int] = dataclasses.field(default_factory=list)
     submit_time: float = 0.0
@@ -106,6 +113,11 @@ def _ring_row_bytes(cfg, batch: int, mesh=None) -> int:
 
 
 _RING_BYTES_CAP = int(1e9)
+
+
+# Re-exported for engine-side callers; defined in kv_transfer so the
+# serve layer can catch it without importing this jax-heavy module.
+from skypilot_tpu.inference.kv_transfer import HandoffCapacityError  # noqa: E402,F401 pylint: disable=wrong-import-position
 
 
 def resolve_kv_cache_dtype(kv_cache_dtype: Optional[str],
@@ -360,7 +372,7 @@ class _EngineBase:
                     temperature: float = 0.0, top_k: int = 0,
                     top_p: float = 1.0, eos_id: Optional[int] = None,
                     stop: Optional[List[List[int]]] = None,
-                    priority: int = 0) -> int:
+                    priority: int = 0, hold: bool = False) -> int:
         if not prompt:
             raise ValueError('empty prompt')
         if not 0.0 < top_p <= 1.0:
@@ -372,7 +384,7 @@ class _EngineBase:
                       max_new_tokens=max_new_tokens, temperature=temperature,
                       top_k=top_k, top_p=top_p, eos_id=eos_id,
                       stop=stop or None, priority=int(priority),
-                      submit_time=clock.now())
+                      hold=bool(hold), submit_time=clock.now())
         if self.telemetry_enabled:
             req.trace = tracing.RequestTrace(req.request_id)
             req.trace.begin('queue', prompt_tokens=len(prompt),
@@ -392,6 +404,37 @@ class _EngineBase:
     def has_work(self) -> bool:
         return (len(self._queue) > 0
                 or any(r is not None for r in self._slots))
+
+    def has_runnable_work(self) -> bool:
+        """``has_work`` minus parked state: False when everything live
+        is a HELD slot awaiting a KV handoff — stepping then does
+        nothing, so the serve loop sleeps until a wake (submit /
+        release_hold / drain all set it) instead of spinning."""
+        if self._queue or self._pending:
+            return True
+        if getattr(self, '_lagging', None):
+            return True
+        return any(r is not None and not r.hold for r in self._slots)
+
+    def _decode_ready(self) -> List[Optional['Request']]:
+        """Per-slot request list for decode-phase programs: None for
+        empty slots, mid-prefill slots, and HELD slots (a prefill-role
+        handoff candidate stops after its prefill-sampled first token
+        — it must not race the handoff with local decode steps)."""
+        return [None if (r is None or s in self._prefill_off or r.hold)
+                else r for s, r in enumerate(self._slots)]
+
+    def release_hold(self, request_id: int) -> bool:
+        """Resume local decoding of a held request (handoff failed or
+        no decode worker available — the colocated fallback). True when
+        a hold was actually cleared."""
+        for r in list(self._queue) + [r for r in self._slots
+                                      if r is not None]:
+            if r.request_id == request_id and r.hold:
+                r.hold = False
+                self._meta_dirty = True
+                return True
+        return False
 
     # Pool-pressure recompute requeues. The slot engine reserves
     # max_seq rows per slot up front so it never preempts; the paged
@@ -567,6 +610,184 @@ class _EngineBase:
             })
         return out
 
+    # ------------------------------------------------- disaggregation
+    # KV handoff (disaggregated prefill/decode serving): a prefill
+    # worker exports a live request's context rows in the cache's
+    # STORED dtype (int8 codes+scales stay int8 — the wire codec never
+    # dequantizes); a decode worker ingests them and resumes decoding
+    # at the exact original bytes. Engine-specific gather/land live in
+    # the subclasses (_gather_kv_rows / _land_kv_rows).
+
+    def export_kv_snapshot(self, request_id: int):
+        """Resumable handoff snapshot of a live DECODING request:
+        (snapshot dict, drained events). The async pipeline is drained
+        first so the host view (output tokens, row counts) is complete
+        and the device rows are final — the drained token events are
+        RETURNED, not dropped; the caller must route them to its
+        consumers exactly like ``step()`` events. Returns
+        ``(None, events)`` when the request is not in a decodable slot
+        (finished, cancelled, still mid-prefill, or only queued)."""
+        events: List[Tuple[int, int, bool]] = []
+        while self._pending:
+            events.extend(self._process_one())
+        slot = next((s for s, r in enumerate(self._slots)
+                     if r is not None and r.request_id == request_id),
+                    None)
+        if slot is None or slot in getattr(self, '_prefill_off', {}):
+            return None, events
+        req = self._slots[slot]
+        if not req.output:
+            return None, events        # no first token yet
+        n_rows = int(self._slot_len[slot])
+        if n_rows != len(req.prompt) + len(req.output) - 1:
+            # Row/token bookkeeping out of sync (should not happen in
+            # the greedy serving path): refuse the handoff rather than
+            # ship an inconsistent snapshot.
+            return None, events
+        k, v, ks, vs = self._gather_kv_rows(slot, n_rows)
+        cfg = self.cfg
+        snapshot = {
+            'kv_cache_dtype': self.kv_cache_dtype,
+            'n_rows': n_rows,
+            'model': {'n_layers': cfg.n_layers,
+                      'n_kv_heads': cfg.n_kv_heads,
+                      'head_dim': cfg.head_dim},
+            'prompt': list(req.prompt),
+            'output': list(req.output),
+            'max_new_tokens': req.max_new_tokens,
+            'temperature': req.temperature,
+            'top_k': req.top_k,
+            'top_p': req.top_p,
+            'eos_id': req.eos_id,
+            'stop': ([list(s) for s in req.stop] if req.stop else None),
+            'priority': req.priority,
+            'k': k, 'v': v, 'k_scale': ks, 'v_scale': vs,
+        }
+        return snapshot, events
+
+    def _gather_kv_rows(self, slot: int, n_rows: int):
+        """Engine-specific: the slot's first ``n_rows`` context rows as
+        host numpy (k, v, k_scale|None, v_scale|None), token-major
+        [L, n, hkv, d] (scales [L, n, hkv])."""
+        raise NotImplementedError
+
+    def _validate_ingest(self, snap: Dict[str, Any]) -> None:
+        """Shared ingest validation: model shape, kv dtype (no
+        transcoding — int8 stays int8 end to end), row-count
+        consistency, and the engine's own request limits. Raises
+        ``ValueError`` (permanent refusal)."""
+        cfg = self.cfg
+        model = snap.get('model') or {}
+        for key, want in (('n_layers', cfg.n_layers),
+                          ('n_kv_heads', cfg.n_kv_heads),
+                          ('head_dim', cfg.head_dim)):
+            if int(model.get(key, -1)) != want:
+                raise ValueError(
+                    f'handoff model mismatch: {key}='
+                    f'{model.get(key)} != engine {want}')
+        if snap.get('kv_cache_dtype') != self.kv_cache_dtype:
+            raise ValueError(
+                'handoff kv_cache_dtype '
+                f'{snap.get("kv_cache_dtype")!r} != engine '
+                f'{self.kv_cache_dtype!r} (no wire transcoding: int8 '
+                'KV must land in an int8 pool)')
+        prompt, output = snap['prompt'], snap['output']
+        if not output:
+            raise ValueError('handoff carries no generated token')
+        n_rows = int(snap['n_rows'])
+        if n_rows != len(prompt) + len(output) - 1:
+            raise ValueError(
+                f'handoff n_rows {n_rows} != context rows '
+                f'{len(prompt) + len(output) - 1}')
+        if len(output) >= int(snap['max_new_tokens']):
+            raise ValueError('handoff request is already complete')
+        self._validate_request(prompt, int(snap['max_new_tokens']))
+        for arr, name in ((snap['k'], 'k'), (snap['v'], 'v')):
+            shape = tuple(np.shape(arr))
+            want_shape = (cfg.n_layers, n_rows, cfg.n_kv_heads,
+                          cfg.head_dim)
+            if shape != want_shape:
+                raise ValueError(f'handoff {name} rows shape {shape} '
+                                 f'!= {want_shape}')
+        if self.kv_cache_dtype == 'int8':
+            for arr, name in ((snap['k_scale'], 'k_scale'),
+                              (snap['v_scale'], 'v_scale')):
+                shape = tuple(np.shape(arr))
+                if shape != (cfg.n_layers, n_rows, cfg.n_kv_heads):
+                    raise ValueError(
+                        f'handoff {name} shape {shape} != '
+                        f'{(cfg.n_layers, n_rows, cfg.n_kv_heads)}')
+            for arr, name in ((snap['k'], 'k'), (snap['v'], 'v')):
+                if np.dtype(getattr(arr, 'dtype', None)) != np.int8:
+                    raise ValueError(
+                        f'handoff {name} codes are '
+                        f'{getattr(arr, "dtype", None)}, expected int8 '
+                        '(int8 KV never widens on the wire)')
+
+    def _ingest_request(self, snap: Dict[str, Any]) -> Request:
+        """Recreate the engine Request a handoff snapshot describes
+        (output prepopulated; finish checks then behave exactly as if
+        the tokens had been generated here)."""
+        req = Request(
+            request_id=self._next_id, prompt=list(snap['prompt']),
+            max_new_tokens=int(snap['max_new_tokens']),
+            temperature=float(snap.get('temperature') or 0.0),
+            top_k=int(snap.get('top_k') or 0),
+            top_p=float(snap.get('top_p') or 1.0),
+            eos_id=snap.get('eos_id'),
+            stop=([list(s) for s in snap['stop']]
+                  if snap.get('stop') else None),
+            priority=int(snap.get('priority') or 0),
+            output=list(snap['output']),
+            submit_time=clock.now())
+        # The first token happened on the prefill worker; set the
+        # timestamp so per-token bookkeeping (and the slot engine's
+        # readback guard) treats the slot as live. The serve layer
+        # skips TTFT observation for handoff continuations.
+        req.first_token_time = req.submit_time
+        req._enq_out = len(req.output)
+        if self.telemetry_enabled:
+            req.trace = tracing.RequestTrace(self._next_id)
+            req.trace.begin('decode', handoff=True,
+                            context_tokens=len(req.prompt)
+                            + len(req.output))
+        self._next_id += 1
+        return req
+
+    def ingest_kv_snapshot(self, snap: Dict[str, Any]) -> int:
+        """Land a handoff: validate, seat the request in a free slot
+        with its KV rows written at the exact original bytes, and
+        return the new request id. Raises ``ValueError`` for
+        malformed/mismatched handoffs (permanent) and
+        :class:`HandoffCapacityError` when no slot or KV capacity is
+        free (retryable — the router picks another decode worker)."""
+        self._validate_ingest(snap)
+        slot = next((s for s in range(self.max_batch)
+                     if self._slots[s] is None), None)
+        if slot is None:
+            raise HandoffCapacityError('no free decode slot')
+        req = self._ingest_request(snap)
+        self._land_kv_rows(slot, req, snap)
+        ctx = req.prompt + req.output
+        self._slots[slot] = req
+        self._slot_len[slot] = int(snap['n_rows'])
+        # Current token = the last generated one; decode resumes on
+        # the very next horizon without a host round trip.
+        slot_d, tok_d = device_upload(
+            (np.array([slot], np.int32),
+             np.array([ctx[-1]], np.int32)))
+        self._tok_dev = self._merge_tokens_drop(self._tok_dev, slot_d,
+                                                tok_d)
+        self._meta_dirty = True
+        return req.request_id
+
+    def _land_kv_rows(self, slot: int, req: Request,
+                      snap: Dict[str, Any]) -> None:
+        """Engine-specific: write the snapshot's rows into this slot's
+        cache storage (raises ``HandoffCapacityError`` on pool
+        pressure)."""
+        raise NotImplementedError
+
     def get_finished(self, request_id: int) -> Optional[Request]:
         return self._finished.get(request_id)
 
@@ -719,6 +940,10 @@ class InferenceEngine(SpeculativeMixin, _EngineBase):
         self._merge_tokens_drop = jax.jit(
             lambda tok, slots, vals: tok.at[slots].set(vals,
                                                        mode='drop'))
+        # KV handoff programs (disaggregated serving): export gathers
+        # keyed by context bucket, ingest scatters keyed by row bucket.
+        self._export_fns: Dict[int, Any] = {}
+        self._ingest_fns: Dict[int, Any] = {}
         # Speculative decoding (0 = off): n-gram propose + batched
         # verify instead of the fused decode horizon.
         self._init_spec(speculate_k)
@@ -766,6 +991,115 @@ class InferenceEngine(SpeculativeMixin, _EngineBase):
                 self.cfg, self.cache.quantized, mesh=self.mesh),
             'kv_shards': kv_shard_degree(self.cfg, self.mesh),
         }
+
+    # -------------------------------------------------- KV handoff
+    def _get_export(self, bucket: int):
+        """Compiled context-row gather for one slot (handoff export):
+        [L, bucket, hkv, d] rows (+ scales) straight off the slot
+        cache, in the STORED dtype — int8 codes and fp32 scales come
+        out exactly as resident, never dequantized."""
+        if bucket in self._export_fns:
+            return self._export_fns[bucket]
+        quantized = self.cache.quantized
+
+        @jax.jit
+        def export(cache, slot):
+            k = cache.k[:, slot, :bucket]
+            v = cache.v[:, slot, :bucket]
+            if quantized:
+                return (k, v, cache.k_scale[:, slot, :bucket],
+                        cache.v_scale[:, slot, :bucket])
+            return k, v
+
+        self._export_fns[bucket] = export
+        return export
+
+    def _gather_kv_rows(self, slot: int, n_rows: int):
+        bucket = min(_bucket_len(max(1, n_rows)), self.max_seq)
+        slot_d = device_upload(np.array(slot, np.int32))
+        out = self._get_export(bucket)(self.cache, slot_d)
+        # Sanctioned d2h: the handoff export IS a host readback by
+        # design (the rows leave this process on the wire).
+        host = host_sync(out)
+        if self.cache.quantized:
+            k, v, ks, vs = host
+            return (k[:, :n_rows], v[:, :n_rows],
+                    ks[:, :n_rows, :, 0], vs[:, :n_rows, :, 0])
+        k, v = host
+        return k[:, :n_rows], v[:, :n_rows], None, None
+
+    def _get_ingest(self, nb: int):
+        """Compiled handoff scatter: land [L, 1, nb, hkv, d] rows (+
+        scales) into one slot's reservation at positions [0, valid),
+        padding rows dropping at the max_seq sentinel."""
+        if nb in self._ingest_fns:
+            return self._ingest_fns[nb]
+        quantized = self.cache.quantized
+        max_seq = self.max_seq
+
+        def _scatter(c, r, slots_arr, pos):
+            return c.at[:, slots_arr[:, None], pos].set(
+                r.astype(c.dtype), mode='drop')
+
+        if quantized:
+            @functools.partial(jax.jit, donate_argnums=(0,),
+                               **self._step_out_shardings(0))
+            def ingest(cache, kq, ks, vq, vs, slots_arr, valid):
+                pos = jnp.arange(nb)[None, :]
+                pos = jnp.where(pos < valid[:, None], pos, max_seq)
+                length = cache.length.at[slots_arr].set(valid,
+                                                        mode='drop')
+                return llama.KVCache(
+                    k=_scatter(cache.k, kq, slots_arr, pos),
+                    v=_scatter(cache.v, vq, slots_arr, pos),
+                    length=length,
+                    k_scale=_scatter(cache.k_scale, ks, slots_arr, pos),
+                    v_scale=_scatter(cache.v_scale, vs, slots_arr, pos))
+        else:
+            @functools.partial(jax.jit, donate_argnums=(0,),
+                               **self._step_out_shardings(0))
+            def ingest(cache, kr, vr, slots_arr, valid):
+                pos = jnp.arange(nb)[None, :]
+                pos = jnp.where(pos < valid[:, None], pos, max_seq)
+                length = cache.length.at[slots_arr].set(valid,
+                                                        mode='drop')
+                return llama.KVCache(
+                    k=_scatter(cache.k, kr, slots_arr, pos),
+                    v=_scatter(cache.v, vr, slots_arr, pos),
+                    length=length)
+
+        self._ingest_fns[nb] = ingest
+        return ingest
+
+    def _land_kv_rows(self, slot: int, req: Request,
+                      snap: Dict[str, Any]) -> None:
+        cfg = self.cfg
+        n_rows = int(snap['n_rows'])
+        nb = min(_bucket_len(max(1, n_rows)), self.max_seq)
+
+        def pad(arr, tail):
+            out = np.zeros((cfg.n_layers, 1, nb, cfg.n_kv_heads)
+                           + tail, dtype=arr.dtype)
+            out[:, 0, :n_rows] = arr.reshape(
+                (cfg.n_layers, n_rows, cfg.n_kv_heads) + tail)
+            return out
+
+        slots_arr = np.array([slot], np.int32)
+        valid = np.array([n_rows], np.int32)
+        ingest = self._get_ingest(nb)
+        if self.cache.quantized:
+            (kq, ks, vq, vs, slots_d, valid_d) = device_upload(
+                (pad(snap['k'], (cfg.head_dim,)),
+                 pad(snap['k_scale'], (1,)),
+                 pad(snap['v'], (cfg.head_dim,)),
+                 pad(snap['v_scale'], (1,)), slots_arr, valid))
+            self.cache = ingest(self.cache, kq, ks, vq, vs, slots_d,
+                                valid_d)
+        else:
+            kr, vr, slots_d, valid_d = device_upload(
+                (pad(snap['k'], (cfg.head_dim,)),
+                 pad(snap['v'], (cfg.head_dim,)), slots_arr, valid))
+            self.cache = ingest(self.cache, kr, vr, slots_d, valid_d)
 
     # ------------------------------------------------------------------
     # Compiled steps
@@ -1333,8 +1667,7 @@ class InferenceEngine(SpeculativeMixin, _EngineBase):
         admission cursors still advancing) are masked inactive: their
         cache lengths are mid-prompt and their token-vector entries
         stale until the completing chunk merges the first token."""
-        ready = [r if s not in self._prefill_off else None
-                 for s, r in enumerate(self._slots)]
+        ready = self._decode_ready()
         active = np.array([r is not None for r in ready])
         if not active.any():
             return False

@@ -1,0 +1,232 @@
+"""KV wire codec for disaggregated prefill/decode serving.
+
+A *handoff* moves one request's finished-prefill state from a prefill
+worker to a decode worker: the original prompt, the tokens generated so
+far, the sampling/finish parameters, and the KV rows for every context
+position except the current token (whose row the next decode step
+writes). The decode worker lands the rows into its own pool/cache and
+resumes at the EXACT original bytes — the greedy continuation is
+byte-identical to a colocated run.
+
+Wire format (version 1)::
+
+    b'SKKV' | uint32_be header_len | header JSON |
+    for each header['buffers'] entry: uint64_be byte_len | raw bytes
+
+The header carries the request (prompt/output/sampling/budget), the
+model shape fields the receiver validates against its own config, and
+the buffer manifest (name/dtype/shape). Buffers are raw C-order array
+bytes:
+
+- ``kv_cache_dtype='int8'``: ``k_codes``/``v_codes`` int8
+  ``[L, n, hkv, d]`` plus ``k_scales``/``v_scales`` float32
+  ``[L, n, hkv]`` — the pool's native (codes, absmax/127 scales)
+  representation. **int8 stays int8 on the wire**: the codec never
+  dequantizes (graftcheck GC114 bans any wide-float ``astype`` /
+  ``dequant`` spelling on transfer paths), so an int8 handoff moves
+  ~half the bytes of a bf16 one — the saving that makes disaggregation
+  cheap enough to win.
+- ``kv_cache_dtype='bf16'``: ``k_rows``/``v_rows`` bfloat16
+  ``[L, n, hkv, d]`` (``ml_dtypes.bfloat16`` raw bytes).
+
+Decoding is strict: magic/version/header/manifest/shape mismatches all
+raise ``ValueError`` with the reason — a truncated or corrupt handoff
+must be rejected loudly at the wire (and again at
+``PageAllocator.register_prefix``), never landed as garbage KV.
+"""
+from __future__ import annotations
+
+import json
+import struct
+from typing import Any, Dict, List, Tuple
+
+import numpy as np
+
+MAGIC = b'SKKV'
+WIRE_VERSION = 1
+
+
+class HandoffCapacityError(RuntimeError):
+    """A KV-handoff ingest found no free slot / no pool pages. The
+    serving layer answers it with a RETRYABLE refusal (HTTP 503 +
+    Retry-After) — the router then picks another decode worker or the
+    prefill worker falls back to decoding locally. Distinct from
+    ``ValueError`` (malformed/mismatched handoff: permanent, HTTP
+    400). Lives here (not ``engine.py``) so the serve layer can catch
+    it without importing the jax-heavy engine module."""
+
+# Buffer manifest per kv dtype: (name, numpy dtype string, rank).
+_INT8_BUFFERS: Tuple[Tuple[str, str, int], ...] = (
+    ('k_codes', 'int8', 4), ('v_codes', 'int8', 4),
+    ('k_scales', 'float32', 3), ('v_scales', 'float32', 3))
+_BF16_BUFFERS: Tuple[Tuple[str, str, int], ...] = (
+    ('k_rows', 'bfloat16', 4), ('v_rows', 'bfloat16', 4))
+
+# Request fields carried verbatim through the handoff (the decode
+# worker recreates the engine Request from exactly these).
+REQUEST_FIELDS = ('prompt', 'output', 'max_new_tokens', 'temperature',
+                  'top_k', 'top_p', 'eos_id', 'stop', 'priority')
+
+
+def _np_dtype(name: str):
+    if name == 'bfloat16':
+        import ml_dtypes
+        return np.dtype(ml_dtypes.bfloat16)
+    if name in ('int8', 'float32'):
+        return np.dtype(name)
+    raise ValueError(f'unsupported wire buffer dtype {name!r}')
+
+
+def _manifest(kv_cache_dtype: str) -> Tuple[Tuple[str, str, int], ...]:
+    if kv_cache_dtype == 'int8':
+        return _INT8_BUFFERS
+    if kv_cache_dtype == 'bf16':
+        return _BF16_BUFFERS
+    raise ValueError(
+        f'unsupported kv_cache_dtype on the wire: {kv_cache_dtype!r}')
+
+
+def snapshot_buffers(snapshot: Dict[str, Any]) -> Dict[str, np.ndarray]:
+    """The snapshot's KV arrays keyed by wire buffer name."""
+    if snapshot['kv_cache_dtype'] == 'int8':
+        return {'k_codes': snapshot['k'], 'v_codes': snapshot['v'],
+                'k_scales': snapshot['k_scale'],
+                'v_scales': snapshot['v_scale']}
+    return {'k_rows': snapshot['k'], 'v_rows': snapshot['v']}
+
+
+def encode_handoff(snapshot: Dict[str, Any]) -> bytes:
+    """Serialize an engine ``export_kv_snapshot`` dict to wire bytes.
+
+    The KV arrays ride in their STORED dtype — int8 codes are written
+    as int8 (scales as their native fp32), bf16 rows as bf16; no
+    dtype conversion happens here (the GC114 contract)."""
+    kv_dtype = snapshot['kv_cache_dtype']
+    manifest = _manifest(kv_dtype)
+    arrays = snapshot_buffers(snapshot)
+    buffers: List[bytes] = []
+    buf_meta: List[Dict[str, Any]] = []
+    for name, dtype, rank in manifest:
+        arr = np.ascontiguousarray(arrays[name], dtype=_np_dtype(dtype))
+        if arr.ndim != rank:
+            raise ValueError(
+                f'{name}: expected rank {rank}, got shape {arr.shape}')
+        buffers.append(arr.tobytes())
+        buf_meta.append({'name': name, 'dtype': dtype,
+                         'shape': list(arr.shape)})
+    header = {
+        'version': WIRE_VERSION,
+        'kv_cache_dtype': kv_dtype,
+        'n_rows': int(snapshot['n_rows']),
+        'model': {k: int(v) for k, v in snapshot['model'].items()},
+        'request': {k: snapshot[k] for k in REQUEST_FIELDS},
+        'buffers': buf_meta,
+    }
+    hj = json.dumps(header).encode()
+    out = [MAGIC, struct.pack('>I', len(hj)), hj]
+    for b in buffers:
+        out.append(struct.pack('>Q', len(b)))
+        out.append(b)
+    return b''.join(out)
+
+
+def _check(cond: bool, msg: str) -> None:
+    if not cond:
+        raise ValueError(f'malformed KV handoff: {msg}')
+
+
+def decode_handoff(data: bytes) -> Dict[str, Any]:
+    """Parse wire bytes back into a snapshot dict (numpy arrays).
+
+    Strict: every structural claim the header makes is validated
+    against the actual payload before anything is returned — a
+    truncated row batch or a shape lie raises ``ValueError`` here, so
+    the receiver never lands partial rows into its pool."""
+    _check(len(data) >= len(MAGIC) + 4, 'short blob')
+    _check(data[:len(MAGIC)] == MAGIC,
+           f'bad magic {data[:len(MAGIC)]!r}')
+    off = len(MAGIC)
+    (hlen,) = struct.unpack_from('>I', data, off)
+    off += 4
+    _check(len(data) >= off + hlen, 'truncated header')
+    try:
+        header = json.loads(data[off:off + hlen])
+    except ValueError as e:
+        raise ValueError(f'malformed KV handoff: bad header JSON ({e})'
+                         ) from None
+    off += hlen
+    _check(isinstance(header, dict), 'header is not an object')
+    _check(header.get('version') == WIRE_VERSION,
+           f'unsupported wire version {header.get("version")!r}')
+    kv_dtype = header.get('kv_cache_dtype')
+    manifest = _manifest(kv_dtype)
+    buf_meta = header.get('buffers')
+    _check(isinstance(buf_meta, list)
+           and [b.get('name') for b in buf_meta]
+           == [name for name, _, _ in manifest],
+           f'buffer manifest does not match {kv_dtype} layout')
+    req = header.get('request')
+    _check(isinstance(req, dict)
+           and all(k in req for k in REQUEST_FIELDS),
+           'incomplete request fields')
+    prompt = req['prompt']
+    output = req['output']
+    _check(isinstance(prompt, list) and prompt
+           and all(isinstance(t, int) for t in prompt),
+           'prompt must be a non-empty token-id list')
+    _check(isinstance(output, list) and output
+           and all(isinstance(t, int) for t in output),
+           'output must carry at least the first generated token')
+    n_rows = header.get('n_rows')
+    _check(isinstance(n_rows, int) and n_rows >= 1, 'bad n_rows')
+    _check(n_rows == len(prompt) + len(output) - 1,
+           f'n_rows {n_rows} != context rows '
+           f'{len(prompt) + len(output) - 1} '
+           '(truncated or inconsistent row batch)')
+    model = header.get('model')
+    _check(isinstance(model, dict) and all(
+        isinstance(model.get(k), int)
+        for k in ('n_layers', 'n_kv_heads', 'head_dim')),
+        'missing model shape fields')
+    arrays: Dict[str, np.ndarray] = {}
+    for (name, dtype, rank), meta in zip(manifest, buf_meta):
+        _check(meta.get('dtype') == dtype,
+               f'{name}: dtype {meta.get("dtype")!r} != {dtype}')
+        shape = meta.get('shape')
+        _check(isinstance(shape, list) and len(shape) == rank
+               and all(isinstance(s, int) and s > 0 for s in shape),
+               f'{name}: bad shape {shape!r}')
+        expect = [model['n_layers'], n_rows, model['n_kv_heads']]
+        if rank == 4:
+            expect.append(model['head_dim'])
+        _check(shape == expect,
+               f'{name}: shape {shape} != expected {expect}')
+        _check(len(data) >= off + 8, f'{name}: truncated length prefix')
+        (blen,) = struct.unpack_from('>Q', data, off)
+        off += 8
+        np_dtype = _np_dtype(dtype)
+        want = int(np.prod(shape)) * np_dtype.itemsize
+        _check(blen == want,
+               f'{name}: {blen} bytes on the wire != {want} for shape '
+               f'{shape} ({dtype})')
+        _check(len(data) >= off + blen, f'{name}: truncated payload')
+        arrays[name] = np.frombuffer(
+            data, dtype=np_dtype, count=int(np.prod(shape)),
+            offset=off).reshape(shape)
+        off += blen
+    _check(off == len(data), f'{len(data) - off} trailing bytes')
+    snap: Dict[str, Any] = {
+        'kv_cache_dtype': kv_dtype,
+        'n_rows': n_rows,
+        'model': {k: int(model[k])
+                  for k in ('n_layers', 'n_kv_heads', 'head_dim')},
+    }
+    snap.update({k: req[k] for k in REQUEST_FIELDS})
+    if kv_dtype == 'int8':
+        snap.update(k=arrays['k_codes'], v=arrays['v_codes'],
+                    k_scale=arrays['k_scales'],
+                    v_scale=arrays['v_scales'])
+    else:
+        snap.update(k=arrays['k_rows'], v=arrays['v_rows'],
+                    k_scale=None, v_scale=None)
+    return snap

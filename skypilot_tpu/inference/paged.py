@@ -734,8 +734,22 @@ class PageAllocator:
                         n_matched: int) -> None:
         """Content-address the full pages a prefill just wrote (pages
         beyond ``n_matched``); an existing entry for the same hash keeps
-        the older page (already shared)."""
+        the older page (already shared).
+
+        Validates BEFORE touching the index that ``pages`` actually
+        covers every full page of ``prompt``: callers used to be
+        locally-written pages only (count always matched by
+        construction), but a KV-handoff ingest registers pages built
+        from wire bytes — a truncated row batch whose token length
+        claims more pages than were landed would otherwise
+        content-address pages that hold other (or no) data, silently
+        poisoning every future prefix hit on that chain."""
         max_full = (len(prompt) - 1) // self.page_size
+        if len(pages) < max_full:
+            raise ValueError(
+                f'register_prefix: {len(pages)} page(s) cannot cover '
+                f'the {max_full} full page(s) of a {len(prompt)}-token '
+                'context (truncated row batch?)')
         for i, h in self._chain_hashes(prompt, max_full):
             if i < n_matched:
                 continue
@@ -979,6 +993,10 @@ class PagedInferenceEngine(SpeculativeMixin, _EngineBase):
                 self._prefill_n_max = b
         self.chunks_prefilled = 0          # diagnostics (prefix-hit wins)
         self.preemptions = 0               # pool-pressure recomputes
+        # KV handoff programs (disaggregated serving): export page
+        # gathers keyed by P bucket, ingest merges keyed by (rows, P).
+        self._export_fns: Dict[int, Any] = {}
+        self._ingest_fns: Dict[Tuple[int, int], Any] = {}
         # Speculative decoding (0 = off): n-gram propose + batched
         # verify with masked page-pool commits.
         self._init_spec(speculate_k)
@@ -1591,6 +1609,145 @@ class PagedInferenceEngine(SpeculativeMixin, _EngineBase):
                 self._maybe_early_free(slot, req)
         return []
 
+    # ---------------------------------------------------- KV handoff
+    def _get_export(self, P: int):
+        """Compiled page gather for one slot's handoff export: the
+        first ``P`` pages as token-major [L, P*page, hkv, d] rows (+
+        [L, P*page, hkv] scales), in the pool's STORED dtype — int8
+        codes and fp32 scales leave exactly as resident, never
+        dequantized (the int8-on-the-wire contract GC114 gates)."""
+        if P in self._export_fns:
+            return self._export_fns[P]
+        page = self.page
+        quantized = self.cache.quantized
+
+        @jax.jit
+        def export(cache, table):          # table [P] page ids
+            def tok_major(pool):
+                g = pool[:, table]         # [L, P, hkv, page(, d)]
+                if g.ndim == 5:
+                    g = g.transpose(0, 1, 3, 2, 4)
+                else:
+                    g = g.transpose(0, 1, 3, 2)
+                return g.reshape((g.shape[0], P * page) + g.shape[3:])
+
+            k, v = tok_major(cache.pool_k), tok_major(cache.pool_v)
+            if quantized:
+                return (k, v, tok_major(cache.k_scale),
+                        tok_major(cache.v_scale))
+            return k, v
+
+        self._export_fns[P] = export
+        return export
+
+    def _gather_kv_rows(self, slot: int, n_rows: int):
+        from skypilot_tpu.inference.engine import _bucket_len
+        P = _bucket_len(self._pages_needed(max(1, n_rows)), minimum=1)
+        table = np.zeros((P,), np.int32)
+        ps = self._pages[slot][:P]
+        table[:len(ps)] = ps
+        table_d = device_upload(table)
+        out = self._get_export(P)(self.cache, table_d)
+        # Sanctioned d2h: the handoff export IS a host readback by
+        # design (the rows leave this process on the wire).
+        host = host_sync(out)
+        if self.cache.quantized:
+            k, v, ks, vs = host
+            return (k[:, :n_rows], v[:, :n_rows], ks[:, :n_rows],
+                    vs[:, :n_rows])
+        k, v = host
+        return k[:, :n_rows], v[:, :n_rows], None, None
+
+    def _get_ingest(self, nb: int, P: int):
+        """Compiled handoff merge: land a [L, 1, nb, hkv(, d)] row
+        batch into the pool through a [1, P] page table (padding rows
+        past ``valid`` redirect to the trash page). Donates the pool —
+        the scatter runs in place like every other merge."""
+        key = (nb, P)
+        if key in self._ingest_fns:
+            return self._ingest_fns[key]
+        quantized = self.cache.quantized
+        mesh = self.mesh
+
+        if quantized:
+            @functools.partial(jax.jit, donate_argnums=(0,),
+                               **self._step_out_shardings(0))
+            def ingest(cache, kq, ks, vq, vs, table, starts, valid):
+                return merge_rows_into_pool(cache, (kq, ks), (vq, vs),
+                                            table, starts, valid,
+                                            mesh=mesh)
+        else:
+            @functools.partial(jax.jit, donate_argnums=(0,),
+                               **self._step_out_shardings(0))
+            def ingest(cache, kr, vr, table, starts, valid):
+                return merge_rows_into_pool(cache, kr, vr, table,
+                                            starts, valid, mesh=mesh)
+
+        self._ingest_fns[key] = ingest
+        return ingest
+
+    def _land_kv_rows(self, slot: int, req, snap) -> None:
+        from skypilot_tpu.inference.engine import (HandoffCapacityError,
+                                                   _bucket_len)
+        cfg = self.cfg
+        n_rows = int(snap['n_rows'])
+        ctx = req.prompt + req.output
+        self._pages[slot] = []
+        if not self._ensure_pages(slot, max(1, n_rows)):
+            raise HandoffCapacityError(
+                f'KV page pool exhausted ({self.alloc.available} '
+                f'page(s) free, {self._pages_needed(n_rows)} needed)')
+        try:
+            P = _bucket_len(self._pages_needed(max(1, n_rows)),
+                            minimum=1)
+            # Row bucket: bounded compiled-program count. nb may exceed
+            # P*page for non-power-of-two page sizes; padding rows past
+            # ``valid`` mask to the trash page (their clamped table
+            # lookups are discarded), so the overshoot is harmless.
+            nb = _bucket_len(n_rows, minimum=8)
+            table = np.zeros((1, P), np.int32)
+            table[0, :len(self._pages[slot])] = self._pages[slot]
+
+            def pad(arr, tail):
+                out = np.zeros((cfg.n_layers, 1, nb, cfg.n_kv_heads)
+                               + tail, dtype=arr.dtype)
+                out[:, 0, :n_rows] = arr.reshape(
+                    (cfg.n_layers, n_rows, cfg.n_kv_heads) + tail)
+                return out
+
+            starts = np.zeros(1, np.int32)
+            valid = np.array([n_rows], np.int32)
+            ingest = self._get_ingest(nb, P)
+            if self.cache.quantized:
+                (kq, ks, vq, vs, table_d, starts_d,
+                 valid_d) = device_upload(
+                    (pad(snap['k'], (cfg.head_dim,)),
+                     pad(snap['k_scale'], (1,)),
+                     pad(snap['v'], (cfg.head_dim,)),
+                     pad(snap['v_scale'], (1,)), table, starts, valid))
+                self.cache = ingest(self.cache, kq, ks, vq, vs,
+                                    table_d, starts_d, valid_d)
+            else:
+                kr, vr, table_d, starts_d, valid_d = device_upload(
+                    (pad(snap['k'], (cfg.head_dim,)),
+                     pad(snap['v'], (cfg.head_dim,)), table, starts,
+                     valid))
+                self.cache = ingest(self.cache, kr, vr, table_d,
+                                    starts_d, valid_d)
+            # Content-address the landed full pages: future prompts
+            # sharing the prefix hit them, and a preempt/resume of
+            # THIS request re-matches the original bytes.
+            # register_prefix validates page-count vs token-length —
+            # the truncated-handoff guard.
+            self.alloc.register_prefix(ctx, self._pages[slot], 0)
+        except Exception:
+            for p in self._pages[slot]:
+                self.alloc.release(p)
+            self._pages[slot] = []
+            raise
+        req._ctx = ctx
+        req._n_matched = 0
+
     # ------------------------------------------------------- speculative
     def _spec_room(self, slot: int) -> int:
         """Proposal cap from page availability: reserve pages for
@@ -1731,10 +1888,12 @@ class PagedInferenceEngine(SpeculativeMixin, _EngineBase):
     def _enqueue_decode(self, horizon: int = 1) -> bool:
         # _await_first slots DO decode: their device-sampled first
         # token was merged into the token vector at prefill enqueue;
-        # only the first-token EVENT is still in flight.
+        # only the first-token EVENT is still in flight. Held slots
+        # (disaggregated handoff pending) never decode.
         active_slots = [s for s in range(self.max_batch)
                         if self._slots[s] is not None
-                        and s not in self._prefill_off]
+                        and s not in self._prefill_off
+                        and not self._slots[s].hold]
         if not active_slots:
             return False
         cap = int(self.max_seq - 1 -
@@ -1806,8 +1965,7 @@ class PagedInferenceEngine(SpeculativeMixin, _EngineBase):
             if victim in active_slots:
                 active_slots.remove(victim)
 
-        ready = [r if s not in self._prefill_off else None
-                 for s, r in enumerate(self._slots)]
+        ready = self._decode_ready()
         temps_d, topks_d, topps_d, active_d, sample = \
             self._slot_meta(ready)
         from skypilot_tpu.inference.engine import _bucket_len

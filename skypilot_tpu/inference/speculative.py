@@ -208,7 +208,8 @@ class SpeculativeMixin:
         prep: Dict[str, Dict[int, Any]] = {'key': {}, 'prop': {}}
         off = set(self._prefill_off)
         for slot, req in enumerate(list(self._slots)):
-            if req is None or slot in off or req.finish_time is not None:
+            if req is None or slot in off or req.hold \
+                    or req.finish_time is not None:
                 continue
             prep['key'][slot] = (req.request_id, len(req.output))
             prep['prop'][slot] = ngram_propose(
@@ -280,8 +281,7 @@ class SpeculativeMixin:
         with self._prof.phase('readback'):
             while self._pending:
                 events.extend(self._process_one())
-        ready = [r if s not in self._prefill_off else None
-                 for s, r in enumerate(self._slots)]
+        ready = self._decode_ready()
         if not any(r is not None for r in ready):
             return events
         round_t0 = clock.monotonic()
@@ -290,8 +290,7 @@ class SpeculativeMixin:
                 self._spec_build_proposals(ready)
             if starved:
                 self._spec_starved(starved)
-                ready = [r if s not in self._prefill_off else None
-                         for s, r in enumerate(self._slots)]
+                ready = self._decode_ready()
                 if not any(r is not None for r in ready):
                     return events
             commit, n_commit = self._spec_verify_call(ready, proposals,

@@ -196,6 +196,11 @@ class ServeController:
                         # per-replica mesh probes.
                         'replica_parallelism':
                             controller.parallelism_payload(),
+                        # Disaggregation roles (url -> prefill/decode/
+                        # colocated): the phase-aware LB policy's
+                        # cold-probe fallback.
+                        'replica_roles':
+                            controller.replica_manager.replica_roles(),
                     })
                 elif self.path == '/controller/update':
                     try:
@@ -234,6 +239,7 @@ class ServeController:
                 'url': i.url,
                 'version': i.version,
                 'is_spot': i.is_spot,
+                'role': i.role,
                 'mesh': {'tp': par['tp'], 'dp': par['dp']},
             } for i in self.replica_manager.replicas()],
         }

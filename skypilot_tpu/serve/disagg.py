@@ -1,0 +1,185 @@
+"""Disaggregated prefill/decode serving: roles, handoff targets,
+telemetry.
+
+ThunderServe-style phase disaggregation (PAPERS.md): dedicated
+*prefill* workers run admission + chunked prefill, then stream each
+finished request's KV — int8 codes + scales staying int8 on the wire
+(``inference/kv_transfer.py``) — to high-batch *decode* workers through
+a ``POST /kv/ingest`` handoff whose response IS the continuation token
+stream. A *colocated* replica (the default) interleaves both phases on
+one chip exactly as before.
+
+This module holds the pieces shared across the serve stack:
+
+- **Roles.** ``resolve_role`` maps the ``--role`` flag / ``SKYTPU_ROLE``
+  launch env to one of :data:`ROLES`. The controller assigns roles per
+  replica from the service spec's ``disaggregation:`` block
+  (``serve/placement.py::role_for_new_replica``) and exports them via
+  the launch env, the same contract as the adaptive-TP plan.
+- **Handoff targets.** A prefill worker sends each finished prefill to
+  the decode worker named by the LB's ``X-Handoff-Target`` header (the
+  phase-aware routing policy picks it by live KV-pool headroom), or —
+  absent an LB — to the best of its static ``--handoff-targets`` /
+  ``SKYTPU_HANDOFF_TARGETS`` peers, ranked by the same
+  ``/metrics?format=json`` headroom probe. No target ⇒ the request
+  simply decodes locally (colocated fallback).
+- **Telemetry.** The stable-schema disagg series, registered up front
+  so every label renders as zero from the first scrape:
+  ``skytpu_kv_transfer_bytes_total{direction}``,
+  ``skytpu_kv_transfer_seconds``,
+  ``skytpu_disagg_handoff_total{outcome}``, and
+  ``skytpu_replica_role{role}`` (1 on the active role, 0 elsewhere).
+"""
+from __future__ import annotations
+
+import json
+import os
+import urllib.request
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from skypilot_tpu import telemetry
+from skypilot_tpu import tpu_logging
+
+logger = tpu_logging.init_logger(__name__)
+
+ROLES: Tuple[str, ...] = ('colocated', 'prefill', 'decode')
+ROLE_ENV = 'SKYTPU_ROLE'
+TARGETS_ENV = 'SKYTPU_HANDOFF_TARGETS'
+
+# Stable label set of skytpu_disagg_handoff_total{outcome}. Prefill
+# side: sent (ingest accepted), completed (continuation relayed to the
+# client's end), failed (target unreachable / stream broke),
+# fallback_local (no target or refused — decoded locally). Decode
+# side: ingested (seated), rejected (malformed/mismatched — HTTP 400),
+# no_capacity (retryable refusal — HTTP 503).
+HANDOFF_OUTCOMES: Tuple[str, ...] = (
+    'sent', 'completed', 'failed', 'fallback_local',
+    'ingested', 'rejected', 'no_capacity')
+
+KV_TRANSFER_DIRECTIONS: Tuple[str, ...] = ('export', 'ingest')
+
+
+def resolve_role(role: Optional[str]) -> str:
+    """Effective replica role: explicit argument wins, then the
+    ``SKYTPU_ROLE`` launch env (the controller's disaggregation plan),
+    else ``colocated``. Unknown values raise ``ValueError``."""
+    if role in (None, ''):
+        role = os.environ.get(ROLE_ENV) or 'colocated'
+    if role not in ROLES:
+        raise ValueError(f'unknown replica role {role!r}; supported: '
+                         f'{", ".join(ROLES)}')
+    return role
+
+
+def static_targets(targets: Optional[Sequence[str]] = None) -> List[str]:
+    """Normalized static handoff-target URLs: the explicit list, else
+    the comma-separated ``SKYTPU_HANDOFF_TARGETS`` env."""
+    if targets is None:
+        raw = os.environ.get(TARGETS_ENV, '')
+        targets = [t for t in raw.split(',') if t.strip()]
+    return [t.strip().rstrip('/') for t in targets if t.strip()]
+
+
+def probe_headroom(url: str, timeout: float = 0.5
+                   ) -> Optional[Dict[str, object]]:
+    """One replica's live ``/metrics?format=json`` disagg view:
+    ``{'role', 'kv_free', 'queue_tokens'}`` — or None when the probe
+    fails (the replica is dead or not a model server)."""
+    try:
+        with urllib.request.urlopen(f'{url}/metrics?format=json',
+                                    timeout=timeout) as resp:
+            payload = json.loads(resp.read())
+        return {
+            'role': (payload.get('disagg') or {}).get('role'),
+            'kv_free': int(payload.get('kv_pool_tokens_free', 0)),
+            'queue_tokens': int(payload.get('queue_tokens_total', 0)),
+        }
+    except Exception as e:  # pylint: disable=broad-except
+        logger.debug(f'handoff headroom probe failed for {url}: '
+                     f'{type(e).__name__}: {e}')
+        return None
+
+
+def pick_target(header_value: Optional[str],
+                targets: Sequence[str]) -> Optional[str]:
+    """The decode worker one handoff should go to: the router's
+    ``X-Handoff-Target`` header wins (the phase-aware LB policy already
+    ranked the decode pool by KV headroom); otherwise the static peer
+    with the most free KV-pool tokens by live probe. None ⇒ decode
+    locally."""
+    if header_value:
+        return header_value.strip().rstrip('/')
+    best, best_free = None, -1
+    for url in targets:
+        info = probe_headroom(url)
+        if info is None:
+            continue
+        free = int(info['kv_free'])
+        if free > best_free:
+            best, best_free = url, free
+    return best
+
+
+def register_metrics(role: Optional[str] = None) -> None:
+    """Register the stable-schema disagg series (zeros from the first
+    scrape). With ``role`` given, the ``skytpu_replica_role`` gauge is
+    set to 1 on that role's series and 0 on the others."""
+    reg = telemetry.get_registry()
+    for direction in KV_TRANSFER_DIRECTIONS:
+        reg.counter('skytpu_kv_transfer_bytes_total',
+                    'KV handoff bytes moved on the wire',
+                    direction=direction)
+    reg.histogram('skytpu_kv_transfer_seconds',
+                  'KV handoff transfer time: encode + POST to first '
+                  'response byte (export) / receive + land (ingest)',
+                  buckets=telemetry.registry.DEFAULT_SECONDS_BUCKETS)
+    for outcome in HANDOFF_OUTCOMES:
+        reg.counter('skytpu_disagg_handoff_total',
+                    'Prefill→decode KV handoffs by outcome',
+                    outcome=outcome)
+    for r in ROLES:
+        g = reg.gauge('skytpu_replica_role',
+                      'Replica phase role (1 = active role)', role=r)
+        if role is not None:
+            g.set(1.0 if r == role else 0.0)
+
+
+def handoff_counter(outcome: str) -> 'telemetry.Counter':
+    return telemetry.get_registry().counter(
+        'skytpu_disagg_handoff_total',
+        'Prefill→decode KV handoffs by outcome', outcome=outcome)
+
+
+def transfer_bytes_counter(direction: str) -> 'telemetry.Counter':
+    return telemetry.get_registry().counter(
+        'skytpu_kv_transfer_bytes_total',
+        'KV handoff bytes moved on the wire', direction=direction)
+
+
+def transfer_seconds() -> 'telemetry.Histogram':
+    return telemetry.get_registry().histogram(
+        'skytpu_kv_transfer_seconds',
+        'KV handoff transfer time: encode + POST to first '
+        'response byte (export) / receive + land (ingest)',
+        buckets=telemetry.registry.DEFAULT_SECONDS_BUCKETS)
+
+
+def json_block(role: str) -> Dict[str, object]:
+    """The stable-schema ``disagg`` block of ``/metrics?format=json``:
+    every key always present and numeric (zeros when the feature is
+    idle), so scrapers and the phase-aware LB policy see one schema
+    from the first request."""
+    reg = telemetry.get_registry()
+
+    def count(name: str, **labels: str) -> int:
+        m = reg.get(name, **labels)
+        return int(m.value) if m is not None else 0
+
+    return {
+        'role': role,
+        'handoffs': {o: count('skytpu_disagg_handoff_total', outcome=o)
+                     for o in HANDOFF_OUTCOMES},
+        'kv_transfer_bytes': {
+            d: count('skytpu_kv_transfer_bytes_total', direction=d)
+            for d in KV_TRANSFER_DIRECTIONS},
+    }

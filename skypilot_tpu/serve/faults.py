@@ -45,6 +45,10 @@ network or the hardware:
   stream. Kind ``partial_response`` breaks the upstream stream after
   ``after_events`` token events — exercises mid-stream migration with
   a nonzero generated prefix, deterministically.
+- ``handoff`` — a prefill replica's KV-handoff sender
+  (``server.start_handoff``), once per attempted handoff. Kind
+  ``partial_response`` makes the handoff POST "fail" before it is sent
+  — exercises the colocated-fallback path a dead decode worker drives.
 
 Rule matching fields (all optional, combined with OR): ``at`` (fire on
 exactly the Nth invocation of the site, 1-based), ``every`` (fire on
@@ -90,7 +94,7 @@ FAULT_KINDS = ('replica_crash', 'probe_timeout', 'slow_response',
 # Injection sites (for spec validation; the hook call sites are the
 # module docstring's list).
 FAULT_SITES = ('engine_step', 'probe', 'preempt', 'preempt_warning',
-               'proxy', 'proxy_stream', 'http_response')
+               'proxy', 'proxy_stream', 'http_response', 'handoff')
 
 # Outcomes of skytpu_requests_migrated_total{outcome}: a migrated
 # request either completed on a surviving replica or exhausted every

@@ -147,6 +147,10 @@ class SkyServeLoadBalancer:
         # sync (single-writer) — part of the /lb/replicas view next to
         # the live per-replica mesh probes.
         self._replica_parallelism: Dict[str, Any] = {}
+        # Controller-planned disaggregation roles (url -> role),
+        # refreshed on every sync; the phase-aware policy uses them as
+        # the cold-probe fallback and the replica view surfaces them.
+        self._replica_roles: Dict[str, str] = {}
 
     # ------------------------------------------------------------- sync
     def _sync_once(self) -> None:
@@ -168,6 +172,10 @@ class SkyServeLoadBalancer:
             par = payload.get('replica_parallelism')
             if par is not None:
                 self._replica_parallelism = par
+            roles = payload.get('replica_roles')
+            if roles is not None:
+                self._replica_roles = dict(roles)
+                self.policy.set_replica_roles(roles)
         except Exception as e:  # pylint: disable=broad-except
             # Keep serving the last known replica set; re-queue the
             # timestamps so the QPS signal survives controller restarts —
@@ -293,12 +301,17 @@ class SkyServeLoadBalancer:
                     raise _ClientGone(str(e)) from e
 
             def _forward_sse(self, resp, tokens: list,
-                             break_after: Optional[int]) -> bool:
+                             break_after: Optional[int],
+                             info: Optional[dict] = None) -> bool:
                 """Forward one upstream SSE leg, accumulating token ids
                 into ``tokens``. Returns True when the stream finished
                 cleanly (its ``done`` event was forwarded with the full
                 MERGED token list); False when the upstream broke or
                 reported a retryable error — the caller migrates.
+                ``info`` (optional dict) receives the error event's
+                ``failed_upstream`` when present: a disaggregated
+                prefill relay naming its DEAD decode worker — the
+                relay itself is healthy and must stay eligible.
                 Raises :class:`_ClientGone` when the downstream client
                 went away."""
                 events = 0
@@ -315,6 +328,10 @@ class SkyServeLoadBalancer:
                             # drain deadline): migrate, don't forward.
                             logger.warning(
                                 f'upstream stream error: {ev["error"]}')
+                            if (info is not None
+                                    and ev.get('failed_upstream')):
+                                info['failed_upstream'] = \
+                                    str(ev['failed_upstream'])
                             return False
                         if ev.get('done'):
                             done = dict(ev)
@@ -366,16 +383,27 @@ class SkyServeLoadBalancer:
                         break_after = rule.after_events or 1
                 migrated = False
                 leg = resp              # caller's with closes the first
+                cur_url = url           # replica serving the live leg
                 own_leg = None          # legs we opened get closed here
+                info: Dict[str, Any] = {}
                 try:
                     while True:
+                        info.clear()
                         finished = self._forward_sse(leg, tokens,
-                                                     break_after)
+                                                     break_after, info)
                         break_after = None    # injected break fires once
                         if finished:
                             if migrated:
                                 lb._m_migrated['completed'].inc()
                             return
+                        failed = info.get('failed_upstream')
+                        if failed:
+                            # A disagg prefill relay reported its
+                            # DECODE worker dead: exclude that worker,
+                            # keep the (healthy) relay eligible for
+                            # the resubmit.
+                            tried.add(failed.rstrip('/'))
+                            tried.discard(cur_url)
                         t_fail = time.monotonic()
                         if own_leg is not None:
                             try:
@@ -383,7 +411,7 @@ class SkyServeLoadBalancer:
                             except OSError:
                                 pass    # already dead — that's the point
                             own_leg = None
-                        own_leg = self._open_continuation(
+                        own_leg, cur_url = self._open_continuation(
                             payload, tokens, headers, tried)
                         if own_leg is None:
                             # Budget already exhausted -> the request IS
@@ -425,11 +453,12 @@ class SkyServeLoadBalancer:
                                    headers: dict, tried: Set[str]):
                 """Open the continuation stream on a surviving replica
                 (prompt extended with the generated prefix, budget
-                reduced). Returns the live response, or None when no
-                replica could take it (or nothing remains to decode)."""
+                reduced). Returns ``(response, replica_url)``, or
+                ``(None, None)`` when no replica could take it (or
+                nothing remains to decode)."""
                 remaining = lb._remaining_budget(payload, tokens)
                 if remaining <= 0:
-                    return None
+                    return None, None
                 cont = dict(payload)
                 cont['prompt'] = list(payload['prompt']) + list(tokens)
                 cont['max_new_tokens'] = remaining
@@ -438,8 +467,20 @@ class SkyServeLoadBalancer:
                 while True:
                     nxt = lb.policy.select_replica(exclude=tried)
                     if nxt is None or len(tried) >= lb.max_attempts + 2:
-                        return None
+                        return None, None
                     tried.add(nxt)
+                    # Disaggregated fleets: the resubmitted
+                    # prompt+prefix prefills on a surviving prefill
+                    # worker and hands off to a surviving decode
+                    # worker — the dead upstream(s) in ``tried`` must
+                    # not be re-picked as the handoff target.
+                    target = lb.policy.handoff_target(exclude=tried)
+                    if target is not None:
+                        headers = dict(headers,
+                                       **{'X-Handoff-Target': target})
+                    else:
+                        headers = {k: v for k, v in headers.items()
+                                   if k.lower() != 'x-handoff-target'}
                     req = urllib.request.Request(
                         nxt + '/generate', data=body, headers=headers,
                         method='POST')
@@ -454,7 +495,7 @@ class SkyServeLoadBalancer:
                         f'migrated stream to {nxt} with '
                         f'{len(tokens)} generated token(s) '
                         f'({remaining} remaining)')
-                    return leg
+                    return leg, nxt
 
             def _proxy(self, method: str) -> None:
                 t_start = time.monotonic()
@@ -495,6 +536,17 @@ class SkyServeLoadBalancer:
                     if url is None:
                         break
                     tried.add(url)
+                    if recover is not None:
+                        # Phase-aware routing: stamp the decode worker
+                        # this prefill should hand its KV to (picked by
+                        # live KV-pool headroom). Refreshed per attempt
+                        # — a retry must not carry a dead target.
+                        target = lb.policy.handoff_target(
+                            exclude=tried | {url})
+                        if target is not None:
+                            headers['X-Handoff-Target'] = target
+                        else:
+                            headers.pop('X-Handoff-Target', None)
                     req = urllib.request.Request(
                         url + self.path, data=data, headers=headers,
                         method=method)
@@ -638,7 +690,9 @@ class SkyServeLoadBalancer:
         return {
             'ready_replica_urls': urls,
             'replica_parallelism': self._replica_parallelism,
-            'replicas': [{'url': u, 'mesh': meshes.get(u)}
+            'replica_roles': dict(self._replica_roles),
+            'replicas': [{'url': u, 'mesh': meshes.get(u),
+                          'role': self._replica_roles.get(u)}
                          for u in urls],
         }
 

@@ -53,6 +53,21 @@ class LoadBalancingPolicy:
         the LB's replica view then falls back to the controller plan."""
         return {}
 
+    def set_replica_roles(self, roles: Optional[Dict[str, str]]) -> None:
+        """Controller-planned replica roles (url -> prefill/decode/
+        colocated), refreshed on every LB sync. Policies that route by
+        phase use them as the fallback when live probes are cold."""
+        del roles
+
+    def handoff_target(self, exclude: Optional[Set[str]] = None
+                       ) -> Optional[str]:
+        """The decode worker a prefill replica should stream finished
+        KV to (disaggregated serving) — None for phase-unaware
+        policies (the prefill replica then decodes locally or uses its
+        static peer list)."""
+        del exclude
+        return None
+
 
 class RoundRobinPolicy(LoadBalancingPolicy):
 
@@ -137,19 +152,54 @@ class QueueDepthPolicy(LoadBalancingPolicy):
         # url -> last-probed mesh shape block (the same /metrics JSON
         # carries it — the LB's replica view reads this for free).
         self._mesh: Dict[str, Dict] = {}
+        # url -> last-probed disagg view ({'role', 'kv_free'}) — the
+        # phase-aware subclass routes and picks handoff targets from
+        # this; the base policy just keeps it fresh for free.
+        self._disagg: Dict[str, Dict] = {}
 
     def _probe(self, url: str) -> Tuple[Optional[int], Optional[Dict]]:
+        """One replica's /metrics JSON: (queue_tokens_total, payload).
+        ``None`` tokens = probe failed (the replica scores by dispatch
+        count alone)."""
         try:
             with urllib.request.urlopen(
                     f'{url}/metrics?format=json',
                     timeout=self.PROBE_TIMEOUT_S) as resp:
                 payload = json.loads(resp.read())
-            return int(payload['queue_tokens_total']), \
-                payload.get('mesh')
+            return int(payload['queue_tokens_total']), payload
         except Exception as e:  # pylint: disable=broad-except
             logger.debug(f'queue-depth probe failed for {url}: '
                          f'{type(e).__name__}: {e}')
             return None, None
+
+    def _refresh(self, candidates) -> None:
+        """Refresh stale probe caches for ``candidates``. Probes run
+        with the lock RELEASED: a slow replica must not serialize every
+        concurrent select behind its timeout."""
+        with self._lock:
+            now = clock.monotonic()
+            stale = [u for u in candidates
+                     if self._cache.get(u, (0.0, None))[0] <= now]
+        fresh = {u: self._probe(u) for u in stale}
+        with self._lock:
+            expiry = clock.monotonic() + self.PROBE_TTL_S
+            for u, (tokens, payload) in fresh.items():
+                self._cache[u] = (expiry, tokens)
+                if payload is not None:
+                    if payload.get('mesh') is not None:
+                        self._mesh[u] = payload['mesh']
+                    disagg = payload.get('disagg') or {}
+                    self._disagg[u] = {
+                        'role': disagg.get('role'),
+                        'kv_free': int(payload.get(
+                            'kv_pool_tokens_free', 0)),
+                    }
+
+    def _score_locked(self, u: str) -> int:
+        tokens = self._cache.get(u, (0.0, None))[1]
+        return ((tokens if tokens is not None else 0)
+                + self.EST_TOKENS_PER_REQUEST
+                * self._inflight.get(u, 0))
 
     def select_replica(self,
                        exclude: Optional[Set[str]] = None
@@ -157,28 +207,11 @@ class QueueDepthPolicy(LoadBalancingPolicy):
         with self._lock:
             candidates = [u for u in self.ready_replicas
                           if not exclude or u not in exclude]
-            if not candidates:
-                return None
-            now = clock.monotonic()
-            stale = [u for u in candidates
-                     if self._cache.get(u, (0.0, None))[0] <= now]
-        # Probes happen with the lock RELEASED: a slow replica must
-        # not serialize every concurrent select behind its timeout.
-        fresh = {u: self._probe(u) for u in stale}
+        if not candidates:
+            return None
+        self._refresh(candidates)
         with self._lock:
-            expiry = clock.monotonic() + self.PROBE_TTL_S
-            for u, (tokens, mesh) in fresh.items():
-                self._cache[u] = (expiry, tokens)
-                if mesh is not None:
-                    self._mesh[u] = mesh
-
-            def score(u: str) -> int:
-                tokens = self._cache.get(u, (0.0, None))[1]
-                return ((tokens if tokens is not None else 0)
-                        + self.EST_TOKENS_PER_REQUEST
-                        * self._inflight.get(u, 0))
-
-            return min(candidates, key=score)
+            return min(candidates, key=self._score_locked)
 
     def pre_execute(self, url: str) -> None:
         with self._lock:
@@ -193,10 +226,79 @@ class QueueDepthPolicy(LoadBalancingPolicy):
             return dict(self._mesh)
 
 
+class PhaseAwarePolicy(QueueDepthPolicy):
+    """Disaggregation-aware routing (ThunderServe-style): new requests
+    are PREFILL-BOUND — they go to the prefill pool ranked by queued
+    work tokens (the queue-depth score), falling back to colocated
+    replicas when the prefill pool is empty, and to anything ready as
+    the last resort (a decode-only fleet must still answer). The
+    handoff target for a finished prefill is the decode worker with
+    the most free KV-pool tokens (``kv_pool_tokens_free`` from the
+    same ``/metrics?format=json`` probes, haircut by in-flight
+    dispatches) — the LB stamps it on the proxied request as
+    ``X-Handoff-Target``.
+
+    Roles come from the live probes (the ``disagg.role`` block every
+    model server publishes); the controller's planned roles — shipped
+    on every LB sync — are the fallback for replicas whose probe is
+    cold or failing."""
+
+    def __init__(self) -> None:
+        super().__init__()
+        self._planned_roles: Dict[str, str] = {}
+
+    def set_replica_roles(self, roles: Optional[Dict[str, str]]) -> None:
+        with self._lock:
+            self._planned_roles = dict(roles or {})
+
+    def _role_locked(self, u: str) -> Optional[str]:
+        probed = self._disagg.get(u, {}).get('role')
+        return probed or self._planned_roles.get(u)
+
+    def select_replica(self,
+                       exclude: Optional[Set[str]] = None
+                       ) -> Optional[str]:
+        with self._lock:
+            candidates = [u for u in self.ready_replicas
+                          if not exclude or u not in exclude]
+        if not candidates:
+            return None
+        self._refresh(candidates)
+        with self._lock:
+            prefill = [u for u in candidates
+                       if self._role_locked(u) == 'prefill']
+            colocated = [u for u in candidates
+                         if self._role_locked(u) in (None, 'colocated')]
+            pool = prefill or colocated or candidates
+            return min(pool, key=self._score_locked)
+
+    def handoff_target(self, exclude: Optional[Set[str]] = None
+                       ) -> Optional[str]:
+        with self._lock:
+            candidates = [u for u in self.ready_replicas
+                          if not exclude or u not in exclude]
+        if not candidates:
+            return None
+        self._refresh(candidates)
+        with self._lock:
+            decode = [u for u in candidates
+                      if self._role_locked(u) == 'decode']
+            if not decode:
+                return None
+
+            def headroom(u: str) -> int:
+                free = self._disagg.get(u, {}).get('kv_free', 0)
+                return (int(free) - self.EST_TOKENS_PER_REQUEST
+                        * self._inflight.get(u, 0))
+
+            return max(decode, key=headroom)
+
+
 POLICIES = {
     'round_robin': RoundRobinPolicy,
     'least_load': LeastLoadPolicy,
     'queue_depth': QueueDepthPolicy,
+    'phase_aware': PhaseAwarePolicy,
 }
 
 

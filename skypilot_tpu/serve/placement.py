@@ -131,6 +131,29 @@ def plan_for_model(cfg_name: str, chips: int, *,
         slo_tier=slo_tier, hbm_per_chip_gb=hbm_per_chip_gb)
 
 
+def role_for_new_replica(spec, live_roles) -> str:
+    """Disaggregation role for the NEXT replica to launch, given the
+    roles of the replicas already alive (ThunderServe-style phase
+    pools, ``service: disaggregation:`` block): fill the prefill pool
+    first — a decode worker with nobody feeding it is idle capacity,
+    while a prefill worker alone still serves (colocated fallback:
+    with no decode worker taking handoffs it decodes locally) — then
+    the decode pool, then colocated. Replacement after a failure
+    naturally re-fills whichever pool lost a member first."""
+    want_prefill = int(getattr(spec, 'disagg_prefill_replicas', 0))
+    want_decode = int(getattr(spec, 'disagg_decode_replicas', 0))
+    if want_prefill <= 0 and want_decode <= 0:
+        return 'colocated'
+    counts: Dict[str, int] = {}
+    for r in live_roles:
+        counts[r or 'colocated'] = counts.get(r or 'colocated', 0) + 1
+    if counts.get('prefill', 0) < want_prefill:
+        return 'prefill'
+    if counts.get('decode', 0) < want_decode:
+        return 'decode'
+    return 'colocated'
+
+
 def plan_for_spec(spec) -> ParallelismPlan:
     """The controller's entry point: the replica (tp, dp) plan for a
     service spec's ``parallelism`` block. 'fixed' pins the explicit

@@ -82,12 +82,16 @@ class ReplicaInfo:
     """In-memory mirror of one replica row + probe bookkeeping."""
 
     def __init__(self, replica_id: int, cluster_name: str, version: int,
-                 is_spot: bool, port: int):
+                 is_spot: bool, port: int, role: str = 'colocated'):
         self.replica_id = replica_id
         self.cluster_name = cluster_name
         self.version = version
         self.is_spot = is_spot
         self.port = port
+        # Disaggregation phase role (prefill/decode/colocated) — the
+        # pool this replica was launched to fill; rides the launch env
+        # as SKYTPU_ROLE.
+        self.role = role
         self.status = serve_state.ReplicaStatus.PENDING
         self.url: Optional[str] = None
         self.consecutive_failures = 0
@@ -155,6 +159,10 @@ class ReplicaManager:
         # reads SKYTPU_TP/SKYTPU_DP via serving_spec_from_env unless
         # overridden with explicit --tp/--dp.
         envs.update(self.parallelism_plan().as_env())
+        # Disaggregation role (prefill/decode/colocated): same env
+        # contract — the model server reads SKYTPU_ROLE unless started
+        # with an explicit --role.
+        envs['SKYTPU_ROLE'] = info.role
         task.update_envs(envs)
         if info.is_spot:
             task.set_resources([r.copy(use_spot=True)
@@ -170,15 +178,25 @@ class ReplicaManager:
     def scale_up(self, use_spot: bool = False) -> Optional[int]:
         """Start one replica launch in the background; returns its id
         (None once the manager is shutting down)."""
+        from skypilot_tpu.serve import placement
         with self._lock:
             if self._shutdown:
                 return None
             replica_id = self._next_replica_id
             self._next_replica_id += 1
             port = self._pick_port(replica_id)
+            # Disaggregation pool fill: count only replicas that are
+            # not already leaving — a draining/failed prefill worker's
+            # replacement must re-fill the prefill pool.
+            live_roles = [r.role for r in self._replicas.values()
+                          if not r.status.is_terminal()
+                          and r.status not in (
+                              serve_state.ReplicaStatus.SHUTTING_DOWN,
+                              serve_state.ReplicaStatus.DRAINING)]
+            role = placement.role_for_new_replica(self.spec, live_roles)
             info = ReplicaInfo(replica_id,
                                self._replica_cluster_name(replica_id),
-                               self.version, use_spot, port)
+                               self.version, use_spot, port, role=role)
             info.status = serve_state.ReplicaStatus.PROVISIONING
             self._replicas[replica_id] = info
         self._persist(info)
@@ -628,6 +646,14 @@ class ReplicaManager:
             return [r.url for r in self._replicas.values()
                     if r.status == serve_state.ReplicaStatus.READY
                     and r.url is not None]
+
+    def replica_roles(self) -> Dict[str, str]:
+        """url -> disaggregation role for every replica with an
+        address — the LB sync payload (the phase-aware policy's
+        cold-probe fallback)."""
+        with self._lock:
+            return {r.url: r.role for r in self._replicas.values()
+                    if r.url is not None}
 
     def _persist(self, info: ReplicaInfo) -> None:
         """Write the replica row — only while the replica is still

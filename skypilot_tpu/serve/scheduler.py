@@ -149,7 +149,7 @@ class ScheduledRequest:
 
     __slots__ = ('tier', 'prompt', 'max_new_tokens', 'sampling', 'seq',
                  'submit_time', 'admit_time', 'outbox', 'request_id',
-                 'result', 'first_token_time', 'cancelled')
+                 'result', 'first_token_time', 'cancelled', 'handoff')
 
     def __init__(self, tier: str, prompt: List[int],
                  max_new_tokens: int, sampling: Dict[str, Any],
@@ -166,6 +166,12 @@ class ScheduledRequest:
         self.result: Optional[Any] = None
         self.first_token_time: Optional[float] = None
         self.cancelled = False
+        # Adopted KV-handoff continuation (disaggregated serving): the
+        # request was admitted and prefilled on ANOTHER replica, so
+        # this replica's TTFT/queue-wait quantiles skip it — a near-
+        # zero "TTFT" here would poison the latency telemetry the
+        # autoscaler and routing policies read.
+        self.handoff = False
 
     @property
     def work_tokens(self) -> int:
@@ -396,6 +402,32 @@ class RequestScheduler:
         self._wake()
         return sr
 
+    # -------------------------------------------------------- handoff
+    def adopt(self, request_id: int, *, tier: Optional[str],
+              prompt: List[int], output: List[int],
+              max_new_tokens: int) -> ScheduledRequest:
+        """Register a KV-handoff continuation that was seated directly
+        in the engine (``ingest_kv_snapshot``) — admission already
+        happened on the prefill worker, so the request bypasses the
+        tier queues; this wires up the outbox/event routing and the
+        bookkeeping the engine loop relies on. The caller holds the
+        engine lock across ingest+adopt so ``fail_all`` cannot miss
+        the window between them."""
+        tier = self.resolve_tier(tier)
+        if self._failed is not None:
+            raise RuntimeError(f'engine failed: {self._failed}')
+        with self._q_lock:
+            self._seq += 1
+            sr = ScheduledRequest(tier, list(prompt) + list(output),
+                                  max_new_tokens, {}, self._seq)
+            sr.request_id = request_id
+            sr.admit_time = sr.submit_time
+            sr.first_token_time = sr.submit_time
+            sr.handoff = True
+            self._by_rid[request_id] = sr
+        self._c_admitted[tier].inc()
+        return sr
+
     # ------------------------------------------------------- retry-after
     def _retry_after_locked(self, tier: str, work: int) -> int:
         """Retry-After (whole seconds) for a request of ``work`` tokens
@@ -536,7 +568,9 @@ class RequestScheduler:
 
     def _record_finished(self, sr: ScheduledRequest) -> None:
         req = sr.result
-        if req is None:
+        if req is None or sr.handoff:
+            # Handoff continuations: TTFT belongs to the prefill
+            # worker that served the first token, not this replica.
             return
         if req.ttft_ms is not None:
             self._h_ttft[sr.tier].observe(req.ttft_ms)

@@ -53,11 +53,15 @@ import json
 import os
 import threading
 import time
+import urllib.error
 import urllib.parse
-from typing import Any, Dict, Optional
+import urllib.request
+from typing import Any, Dict, List, Optional
 
 from skypilot_tpu import telemetry
 from skypilot_tpu import tpu_logging
+from skypilot_tpu.inference import kv_transfer
+from skypilot_tpu.serve import disagg as disagg_lib
 from skypilot_tpu.serve import faults as faults_lib
 from skypilot_tpu.serve import scheduler as scheduler_lib
 from skypilot_tpu.telemetry import tracing
@@ -84,7 +88,9 @@ class ModelServer:
                  max_queue_tokens: Optional[int] = None,
                  latency_admit_frac: float = 0.7,
                  drain_deadline_s: float = 30.0,
-                 fault_spec: Optional[Any] = None):
+                 fault_spec: Optional[Any] = None,
+                 role: Optional[str] = None,
+                 handoff_targets: Optional[List[str]] = None):
         self.cfg_name = cfg_name
         self.model_path = model_path  # HF checkpoint dir (real weights)
         self.quantize = quantize      # 'int8' => int8 weights
@@ -189,6 +195,21 @@ class ModelServer:
         self._completed_keys: 'collections.OrderedDict[str, Dict]' = \
             collections.OrderedDict()
         self._max_completed_keys = 512
+        # Disaggregated serving (serve/disagg.py): this replica's phase
+        # role (flag > SKYTPU_ROLE launch env > colocated) plus the
+        # static handoff peers a prefill worker may stream finished
+        # KV to when no router supplied an X-Handoff-Target header.
+        # The disagg telemetry series register at construction so the
+        # /metrics schema is stable from the first scrape — zeros on
+        # every outcome/direction whether or not a handoff ever runs.
+        self.role = disagg_lib.resolve_role(role)
+        self.handoff_targets = disagg_lib.static_targets(handoff_targets)
+        disagg_lib.register_metrics(self.role)
+        self._m_handoff = {o: disagg_lib.handoff_counter(o)
+                           for o in disagg_lib.HANDOFF_OUTCOMES}
+        self._m_kv_bytes = {d: disagg_lib.transfer_bytes_counter(d)
+                            for d in disagg_lib.KV_TRANSFER_DIRECTIONS}
+        self._h_kv_transfer = disagg_lib.transfer_seconds()
 
     # ------------------------------------------------------------- engine
     def _load_engine(self) -> None:
@@ -289,7 +310,11 @@ class ModelServer:
                     # admission ORDER is decided here every step, not
                     # at submit time.
                     self.sched.fill_engine(self.engine)
-                    if self.engine.has_work():
+                    # has_runnable_work: a prefill worker whose only
+                    # live slots are HELD (awaiting their KV handoff)
+                    # parks here instead of spinning — release_hold /
+                    # submit / drain all set the wake event.
+                    if self.engine.has_runnable_work():
                         # Adaptive fused horizon: long fused calls
                         # maximize throughput at saturation (dispatch
                         # is pipelined away, but per-call host work
@@ -335,19 +360,38 @@ class ModelServer:
 
     def submit(self, prompt, max_new_tokens: int, temperature: float,
                top_k: int, eos_id: Optional[int], top_p: float = 1.0,
-               stop=None, tier: Optional[str] = None) -> Dict[str, Any]:
+               stop=None, tier: Optional[str] = None,
+               handoff_target: Optional[str] = None) -> Dict[str, Any]:
         """Blocking submit (non-streaming handlers): admission-control
         through the scheduler, then drain the outbox to completion.
         Raises ``scheduler.ShedError`` (→ HTTP 429) when the tier's
-        queue bound would be exceeded."""
+        queue bound would be exceeded. On a prefill-role replica with a
+        ``handoff_target``, the request hands off to the decode worker
+        after prefill and the continuation is collected from its
+        stream (falling back to local decode on any failure)."""
         if self._error is not None:
             raise RuntimeError(f'engine failed: {self._error}')
         sr = self.sched.submit(
             prompt, max_new_tokens=max_new_tokens, tier=tier,
             temperature=temperature, top_k=top_k, top_p=top_p,
-            eos_id=eos_id, stop=stop)
+            eos_id=eos_id, stop=stop,
+            hold=handoff_target is not None)
+        pre = None
+        if handoff_target is not None:
+            pre = sr.outbox.get(timeout=300)
+            if pre[0] is not None and not pre[1]:
+                result = self._collect_handoff(
+                    sr, handoff_target, prompt,
+                    dict(temperature=temperature, top_k=top_k,
+                         top_p=top_p, eos_id=eos_id, stop=stop))
+                if result is not None:
+                    return result
+                self._m_handoff['fallback_local'].inc()
+                self.release_hold(sr)
         while True:
-            token, finished = sr.outbox.get()
+            token, finished = (pre if pre is not None
+                               else sr.outbox.get())
+            pre = None
             if token is None or finished:
                 break
         if sr.outbox.error is not None or sr.result is None:
@@ -369,17 +413,27 @@ class ModelServer:
     def submit_stream(self, prompt, max_new_tokens: int, temperature: float,
                       top_k: int, eos_id: Optional[int],
                       top_p: float = 1.0, stop=None,
-                      tier: Optional[str] = None):
+                      tier: Optional[str] = None, hold: bool = False):
         """Register a streaming request; returns its ScheduledRequest
         (``sr.outbox`` streams ``(token, finished)`` tuples). Callers
         must call ``finish_stream(sr)`` when done. Raises
-        ``scheduler.ShedError`` (→ HTTP 429) on admission refusal."""
+        ``scheduler.ShedError`` (→ HTTP 429) on admission refusal.
+        ``hold``: stop after the prefill-sampled first token (the
+        disaggregated-handoff window; see ``release_hold``)."""
         if self._error is not None:
             raise RuntimeError(f'engine failed: {self._error}')
         return self.sched.submit(
             prompt, max_new_tokens=max_new_tokens, tier=tier,
             temperature=temperature, top_k=top_k, top_p=top_p,
-            eos_id=eos_id, stop=stop)
+            eos_id=eos_id, stop=stop, hold=hold)
+
+    def release_hold(self, sr) -> None:
+        """Resume local decoding of a held (handoff-candidate) request
+        — the colocated fallback when no decode worker took it."""
+        with self._lock:
+            if self.engine is not None and sr.request_id is not None:
+                self.engine.release_hold(sr.request_id)
+        self._work.set()
 
     def finish_stream(self, sr) -> None:
         """Deregister a streaming request. If the client disconnected
@@ -395,6 +449,163 @@ class ModelServer:
             # Finished during the cancel race: cancel() popped the
             # finished request into sr.result instead of aborting.
             self._record_finished(sr.result)
+
+    # ------------------------------------------------------------ handoff
+    def handoff_target(self, header_value: Optional[str]
+                       ) -> Optional[str]:
+        """The decode worker this request should hand off to — None on
+        non-prefill replicas (and when neither the router header nor a
+        live static peer names one), in which case the request decodes
+        locally exactly as before."""
+        if self.role != 'prefill':
+            return None
+        return disagg_lib.pick_target(header_value,
+                                      self.handoff_targets)
+
+    def start_handoff(self, sr, target: str) -> Optional[Dict[str, Any]]:
+        """Export ``sr``'s KV (int8 stays int8 on the wire) and POST it
+        to ``target``'s ``/kv/ingest``; the response IS the decode
+        worker's continuation token stream. On success the LOCAL
+        request is cancelled (the slot frees for more prefill work; its
+        full prefix pages stay cached) and the caller relays the
+        stream. Returns None on ANY failure — the caller keeps serving
+        locally (colocated fallback; the outbox still holds every
+        token)."""
+        if self._faults is not None:
+            # Deterministic handoff failure (site 'handoff', kind
+            # partial_response): the POST "breaks" before it is sent —
+            # drives the exact colocated-fallback path a dead decode
+            # worker would.
+            rule = self._faults.fire('handoff')
+            if rule is not None and rule.kind == 'partial_response':
+                self._m_handoff['failed'].inc()
+                logger.warning('handoff suppressed (injected '
+                               'partial_response); decoding locally')
+                return None
+        with self._lock:
+            if self.engine is None:
+                return None
+            snap, events = self.engine.export_kv_snapshot(
+                sr.request_id)
+        if events:
+            # Tokens drained from the async pipeline during export
+            # belong to their outboxes exactly like step() events.
+            self.sched.on_events(self.engine, events)
+        if snap is None or sr.result is not None:
+            return None          # finished/cancelled during the drain
+        t0 = time.monotonic()
+        try:
+            blob = kv_transfer.encode_handoff(snap)
+            req = urllib.request.Request(
+                target + '/kv/ingest', data=blob,
+                headers={'Content-Type': 'application/octet-stream',
+                         'X-SLO-Tier': sr.tier})
+            resp = urllib.request.urlopen(req, timeout=120)
+        except urllib.error.HTTPError as e:
+            body = e.read()
+            outcome = 'no_capacity' if e.code == 503 else 'failed'
+            self._m_handoff[outcome].inc()
+            logger.warning(
+                f'handoff to {target} refused (HTTP {e.code}: '
+                f'{body[:120]!r}); decoding locally')
+            return None
+        except Exception as e:  # pylint: disable=broad-except
+            self._m_handoff['failed'].inc()
+            logger.warning(f'handoff to {target} failed '
+                           f'({type(e).__name__}: {e}); decoding '
+                           'locally')
+            return None
+        self._m_kv_bytes['export'].inc(len(blob))
+        self._h_kv_transfer.observe(time.monotonic() - t0)
+        self._m_handoff['sent'].inc()
+        # The continuation now lives on the decode worker: release the
+        # local slot. The snapshot's registered prefix pages survive in
+        # the LRU, so a migration resubmit landing back here re-matches
+        # them.
+        self.sched.cancel(sr)
+        return {'prelude': [int(t) for t in snap['output']],
+                'resp': resp, 'target': target}
+
+    def _collect_handoff(self, sr, target: str, prompt,
+                         sampling: Dict[str, Any]
+                         ) -> Optional[Dict[str, Any]]:
+        """Non-streaming handoff: run ``start_handoff`` and drain the
+        decode worker's SSE continuation into one result dict. A
+        decode-side failure mid-continuation resubmits
+        ``prompt + tokens so far`` LOCALLY (the prefix cache makes the
+        recompute cheap) so the caller still gets a complete answer —
+        zero lost requests without an LB in the path."""
+        ho = self.start_handoff(sr, target)
+        if ho is None:
+            return None
+        tokens = list(ho['prelude'])
+        finish_reason = None
+        broke: Optional[str] = None
+        try:
+            with ho['resp'] as resp:
+                for raw in resp:
+                    if not raw.startswith(b'data:'):
+                        continue
+                    try:
+                        ev = json.loads(raw[5:].strip())
+                    except ValueError:
+                        continue
+                    if 'error' in ev:
+                        broke = str(ev['error'])
+                        break
+                    if ev.get('done'):
+                        finish_reason = ev.get('finish_reason',
+                                               'length')
+                        break
+                    if 'token' in ev:
+                        tokens.append(int(ev['token']))
+        except Exception as e:  # pylint: disable=broad-except
+            broke = f'{type(e).__name__}: {e}'
+        if finish_reason is None:
+            # Decode worker died mid-continuation: finish locally from
+            # the generated prefix.
+            self._m_handoff['failed'].inc()
+            logger.warning(f'handoff continuation on {ho["target"]} '
+                           f'broke ({broke}); resuming locally with '
+                           f'{len(tokens)} token(s) generated')
+            remaining = sr.max_new_tokens - len(tokens)
+            if remaining > 0:
+                sr2 = self.sched.submit(
+                    list(prompt) + tokens, max_new_tokens=remaining,
+                    tier=sr.tier, **sampling)
+                while True:
+                    token, finished = sr2.outbox.get()
+                    if token is None:
+                        raise RuntimeError(
+                            f'engine failed: {sr2.outbox.error}')
+                    if finished:
+                        break
+                req2 = sr2.result
+                # req2.output is the authoritative continuation (stop
+                # sequences arrive trimmed).
+                tokens = tokens + list(req2.output
+                                       if req2 is not None else [])
+                hit_eos = (req2 is not None and req2.eos_id is not None
+                           and req2.output
+                           and req2.output[-1] == req2.eos_id)
+                finish_reason = ('stop' if req2 is not None
+                                 and (req2.stop_hit or hit_eos)
+                                 else 'length')
+            else:
+                finish_reason = 'length'
+        else:
+            self._m_handoff['completed'].inc()
+        self._m_served.inc()
+        ttft = (round((sr.first_token_time - sr.submit_time) * 1e3, 3)
+                if sr.first_token_time is not None else None)
+        return {
+            'request_id': sr.request_id,
+            'tokens': tokens,
+            'ttft_ms': ttft,
+            'finish_reason': finish_reason,
+            'prompt_tokens': len(prompt),
+            'handoff': True,
+        }
 
     # -------------------------------------------------------------- drain
     def begin_drain(self, deadline_s: Optional[float] = None
@@ -651,6 +862,11 @@ class ModelServer:
             # replica view and the adaptive-TP policy read this.
             'mesh': dict(self._mesh_axes(),
                          devices=self.tp * self.dp),
+            # Disaggregation block (stable schema: role + every handoff
+            # outcome and transfer direction, zeros when idle). The
+            # phase-aware LB policy routes and picks handoff targets
+            # from this plus kv_pool_tokens_free above.
+            'disagg': disagg_lib.json_block(self.role),
             'scheduler': {
                 'prefill_chunk_tokens': getattr(eng, 'chunk', 0) or 0,
                 'decode_priority_ratio': getattr(
@@ -777,9 +993,20 @@ class ModelServer:
                 passes text/event-stream responses through unbuffered.
                 Tokens arrive through the request's scheduler outbox,
                 fed fire-and-forget off the engine loop: a slow reader
-                here never stalls the step."""
+                here never stalls the step.
+
+                Prefill role: once the first token lands (prefill
+                complete), the request's KV hands off to a decode
+                worker and this handler relays its continuation stream
+                — one client stream either way. Any handoff failure
+                falls back to local decoding seamlessly (the pre-read
+                first token re-enters the loop)."""
                 tok = server.tokenizer
-                sr = server.submit_stream(prompt, **kwargs)
+                target = server.handoff_target(
+                    self.headers.get('X-Handoff-Target'))
+                sr = server.submit_stream(prompt,
+                                          hold=target is not None,
+                                          **kwargs)
                 tokens = []
                 # Everything after registration lives under the finally:
                 # even a client that drops before the headers flush must
@@ -791,17 +1018,108 @@ class ModelServer:
                     self.send_header('Cache-Control', 'no-cache')
                     self.send_header('Connection', 'close')
                     self.end_headers()
-                    self._stream_loop(sr, tokens, is_text, tok, key)
+                    pre = None
+                    if target is not None:
+                        pre = sr.outbox.get(timeout=300)
+                        if pre[0] is not None and not pre[1]:
+                            ho = server.start_handoff(sr, target)
+                            if ho is not None:
+                                self._relay_handoff(ho, sr, tokens,
+                                                    is_text, tok, key)
+                                return
+                            server._m_handoff['fallback_local'].inc()
+                            server.release_hold(sr)
+                    self._stream_loop(sr, tokens, is_text, tok, key,
+                                      pre=pre)
                 except (BrokenPipeError, ConnectionResetError):
                     pass    # client vanished; finish_stream cancels
                 finally:
                     server.finish_stream(sr)
                     self.close_connection = True
 
+            def _relay_handoff(self, ho, sr, tokens, is_text, tok,
+                               key=None) -> None:
+                """Relay a handoff continuation: the snapshot's prelude
+                tokens (generated here during prefill) followed by the
+                decode worker's live SSE events, merged into ONE client
+                stream whose done event carries the full token list. A
+                broken decode leg surfaces as a retryable error event
+                with ``tokens_so_far`` — exactly what the LB's
+                in-flight recovery needs to resubmit
+                ``prompt + prefix`` to a surviving replica."""
+                def emit(ev) -> None:
+                    self.wfile.write(
+                        f'data: {json.dumps(ev)}\n\n'.encode())
+                    self.wfile.flush()
+
+                def token_event(t: int) -> Dict[str, Any]:
+                    ev = {'token': int(t)}
+                    if is_text:
+                        ev['text'] = tok.decode([int(t)])
+                    return ev
+
+                for t in ho['prelude']:
+                    tokens.append(int(t))
+                    emit(token_event(t))
+                broke = None
+                try:
+                    with ho['resp'] as resp:
+                        for raw in resp:
+                            if not raw.startswith(b'data:'):
+                                continue
+                            try:
+                                ev = json.loads(raw[5:].strip())
+                            except ValueError:
+                                continue
+                            if 'error' in ev:
+                                broke = str(ev['error'])
+                                break
+                            if ev.get('done'):
+                                done = {'done': True,
+                                        'request_id': sr.request_id,
+                                        'tokens': list(tokens)}
+                                if 'finish_reason' in ev:
+                                    done['finish_reason'] = \
+                                        ev['finish_reason']
+                                if is_text:
+                                    done['text'] = tok.decode(tokens)
+                                server.record_request_key(
+                                    key, dict(done))
+                                emit(done)
+                                server._m_handoff['completed'].inc()
+                                server._m_served.inc()
+                                return
+                            if 'token' in ev:
+                                tokens.append(int(ev['token']))
+                                emit(token_event(ev['token']))
+                    if broke is None:
+                        broke = 'decode worker stream ended early'
+                except (BrokenPipeError, ConnectionResetError):
+                    raise       # OUR client vanished — outer cleanup
+                except Exception as e:  # pylint: disable=broad-except
+                    broke = f'{type(e).__name__}: {e}'
+                # Decode worker died mid-continuation: a retryable
+                # error event with the generated prefix — the LB
+                # resubmits prompt+prefix to a surviving replica (the
+                # client sees one stream); direct clients retry.
+                server._m_handoff['failed'].inc()
+                logger.warning(f'handoff continuation on '
+                               f'{ho["target"]} broke ({broke})')
+                # failed_upstream names the DEAD replica (the decode
+                # worker) — this relay is healthy, and the LB's
+                # migration must exclude the right one.
+                emit({'error': f'decode worker failed mid-stream: '
+                               f'{broke}',
+                      'retryable': True, 'retry_after_s': 1,
+                      'failed_upstream': ho['target'],
+                      'tokens_so_far': list(tokens)})
+
             def _stream_loop(self, sr, tokens, is_text, tok,
-                             key=None) -> None:
+                             key=None, pre=None) -> None:
+                pending = [] if pre is None else [pre]
                 while True:
-                    token, finished = sr.outbox.get(timeout=300)
+                    token, finished = (pending.pop(0) if pending
+                                       else sr.outbox.get(timeout=300))
                     if token is None:       # engine died / shed
                         # Retryable stream failure: the error event
                         # carries enough for the LB (or a client) to
@@ -911,7 +1229,10 @@ class ModelServer:
                     self._openai_stream(prompt_ids, payload, chat,
                                         kwargs)
                     return
-                result = server.submit(prompt_ids, **kwargs)
+                result = server.submit(
+                    prompt_ids, handoff_target=server.handoff_target(
+                        self.headers.get('X-Handoff-Target')),
+                    **kwargs)
                 out_text = tok.decode(result['tokens'])
                 created = int(time_mod.time())
                 if chat:
@@ -1014,9 +1335,125 @@ class ModelServer:
                     server.finish_stream(sr)
                     self.close_connection = True
 
+            def _kv_ingest(self) -> None:
+                """Land a prefill worker's KV handoff and stream the
+                continuation back ON THIS RESPONSE: the length-prefixed
+                wire blob (``inference/kv_transfer.py``) is decoded,
+                validated, and seated directly in the engine
+                (``ingest_kv_snapshot`` — decode resumes at the exact
+                original KV bytes), then every newly decoded token
+                streams back as an SSE event, ending in a ``done``
+                event carrying the FULL merged token list and
+                finish_reason. Refusals: 400 (malformed/mismatched —
+                permanent), 503 + Retry-After (no slot/pool capacity,
+                or draining — retryable elsewhere)."""
+                length = int(self.headers.get('Content-Length', 0))
+                data = self.rfile.read(length) if length else b''
+                t0 = time.monotonic()
+                try:
+                    snap = kv_transfer.decode_handoff(data)
+                    tier = server.sched.resolve_tier(
+                        self.headers.get('X-SLO-Tier'))
+                except ValueError as e:
+                    server._m_handoff['rejected'].inc()
+                    self._json(400, {'error': {
+                        'message': str(e),
+                        'type': 'invalid_handoff'}})
+                    return
+                if server.sched.draining:
+                    self._json(503, {'error': {
+                        'message': 'replica is draining; hand off to '
+                                   'another decode worker',
+                        'type': 'draining', 'retry_after_s': 5}},
+                        extra_headers={'Retry-After': '5'})
+                    return
+                try:
+                    with server._lock:
+                        rid = server.engine.ingest_kv_snapshot(snap)
+                        # Adopt under the engine lock: fail_all cannot
+                        # slip between seat and registration.
+                        sr = server.sched.adopt(
+                            rid, tier=tier, prompt=snap['prompt'],
+                            output=snap['output'],
+                            max_new_tokens=snap['max_new_tokens'])
+                except kv_transfer.HandoffCapacityError as e:
+                    server._m_handoff['no_capacity'].inc()
+                    retry = server.sched.retry_after_s(
+                        tier, len(snap['prompt'])
+                        + int(snap['max_new_tokens']))
+                    self._json(503, {'error': {
+                        'message': str(e), 'type': 'no_capacity',
+                        'retry_after_s': retry}},
+                        extra_headers={'Retry-After': str(retry)})
+                    return
+                except ValueError as e:
+                    server._m_handoff['rejected'].inc()
+                    self._json(400, {'error': {
+                        'message': str(e),
+                        'type': 'invalid_handoff'}})
+                    return
+                except RuntimeError as e:
+                    self._json(500, {'error': {'message': str(e)}})
+                    return
+                server._m_kv_bytes['ingest'].inc(len(data))
+                server._h_kv_transfer.observe(time.monotonic() - t0)
+                server._m_handoff['ingested'].inc()
+                server._work.set()        # wake the engine loop
+                try:
+                    self.send_response(200)
+                    self.send_header('Content-Type',
+                                     'text/event-stream')
+                    self.send_header('Cache-Control', 'no-cache')
+                    self.send_header('Connection', 'close')
+                    self.end_headers()
+                    while True:
+                        token, finished = sr.outbox.get(timeout=300)
+                        if token is None:
+                            self.wfile.write(
+                                ('data: ' + json.dumps({
+                                    'error': sr.outbox.error
+                                    or 'engine failed',
+                                    'retryable': True,
+                                    'retry_after_s': 1})
+                                 + '\n\n').encode())
+                            break
+                        self.wfile.write(
+                            ('data: '
+                             + json.dumps({'token': int(token)})
+                             + '\n\n').encode())
+                        self.wfile.flush()
+                        if finished:
+                            req = sr.result
+                            hit_eos = (req is not None
+                                       and req.eos_id is not None
+                                       and req.output
+                                       and req.output[-1]
+                                       == req.eos_id)
+                            reason = ('stop' if req is not None
+                                      and (req.stop_hit or hit_eos)
+                                      else 'length')
+                            done = {'done': True, 'request_id': rid,
+                                    'tokens': (list(req.output)
+                                               if req is not None
+                                               else []),
+                                    'finish_reason': reason}
+                            self.wfile.write(
+                                f'data: {json.dumps(done)}\n\n'
+                                .encode())
+                            break
+                except (BrokenPipeError, ConnectionResetError):
+                    pass    # prefill relay vanished; cancel below
+                finally:
+                    if sr.result is None:
+                        # Relay gone mid-continuation: free the slot
+                        # (the prefill side / LB resubmits elsewhere).
+                        server.sched.cancel(sr)
+                    self.close_connection = True
+
             def do_POST(self):  # noqa: N802
                 routes = ('/generate', '/v1/completions',
-                          '/v1/chat/completions', '/drain')
+                          '/v1/chat/completions', '/drain',
+                          '/kv/ingest')
                 if self.path not in routes:
                     self._json(404, {'error': f'no route {self.path}'})
                     return
@@ -1034,6 +1471,9 @@ class ModelServer:
                 if not server._ready.is_set():
                     self._json(503, {'status': 'loading'},
                                extra_headers={'Retry-After': '5'})
+                    return
+                if self.path == '/kv/ingest':
+                    self._kv_ingest()
                     return
                 if self.path != '/generate':
                     length = int(self.headers.get('Content-Length', 0))
@@ -1084,7 +1524,10 @@ class ModelServer:
                         self._stream_generate(prompt, is_text, kwargs,
                                               key)
                         return
-                    result = server.submit(prompt, **kwargs)
+                    result = server.submit(
+                        prompt, handoff_target=server.handoff_target(
+                            self.headers.get('X-Handoff-Target')),
+                        **kwargs)
                     if is_text:
                         result['text'] = tok.decode(result['tokens'])
                     server.record_request_key(key, result)
@@ -1222,6 +1665,25 @@ def main() -> None:
                              'or @/path/to/spec.json; default: the '
                              'SKYTPU_FAULT_SPEC env var). Unset = '
                              'injection compiled out of the hot path')
+    parser.add_argument('--role', default=None,
+                        choices=list(disagg_lib.ROLES),
+                        help='disaggregated-serving phase role: '
+                             'prefill workers hand each finished '
+                             'prefill\'s KV (int8 stays int8 on the '
+                             'wire) to a decode worker via POST '
+                             '/kv/ingest and relay its token stream; '
+                             'decode workers run high-batch decode '
+                             'without prefill stalls; colocated '
+                             '(default) interleaves both phases. '
+                             'Default: SKYTPU_ROLE env (the '
+                             'controller\'s disaggregation plan), '
+                             'else colocated')
+    parser.add_argument('--handoff-targets', default=None,
+                        help='comma-separated decode-worker base URLs '
+                             'a prefill replica may hand off to when '
+                             'no router supplied X-Handoff-Target '
+                             '(picked by live KV-pool headroom). '
+                             'Default: SKYTPU_HANDOFF_TARGETS env')
     parser.add_argument('--max-batch', type=int, default=8)
     parser.add_argument('--max-seq', type=int, default=1024)
     parser.add_argument('--port', type=int,
@@ -1246,7 +1708,11 @@ def main() -> None:
                          max_queue_tokens=args.max_queue_tokens,
                          latency_admit_frac=args.latency_admit_frac,
                          drain_deadline_s=args.drain_deadline_s,
-                         fault_spec=args.fault_spec)
+                         fault_spec=args.fault_spec,
+                         role=args.role,
+                         handoff_targets=(args.handoff_targets.split(',')
+                                          if args.handoff_targets
+                                          else None))
     server.start(block=True)
 
 

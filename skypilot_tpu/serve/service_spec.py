@@ -50,6 +50,18 @@ class SkyServiceSpec:
     hbm_per_chip_gb: float = 16.0
     tp: Optional[int] = None
     dp: Optional[int] = None
+    # Disaggregated prefill/decode serving (``disaggregation:`` block):
+    # dedicate this many replicas to each phase; the rest stay
+    # colocated. Roles reach replicas as the SKYTPU_ROLE launch env
+    # (serve/placement.py::role_for_new_replica assigns them in launch
+    # order: prefill pool first, then decode, then colocated).
+    disagg_prefill_replicas: int = 0
+    disagg_decode_replicas: int = 0
+
+    @property
+    def disagg_enabled(self) -> bool:
+        return (self.disagg_prefill_replicas > 0
+                or self.disagg_decode_replicas > 0)
 
     def __post_init__(self):
         if not self.readiness_path.startswith('/'):
@@ -68,6 +80,16 @@ class SkyServiceSpec:
                 self.target_qps_per_replica <= 0:
             raise exceptions.InvalidServiceSpecError(
                 'target_qps_per_replica must be positive')
+        if self.disagg_prefill_replicas < 0 or \
+                self.disagg_decode_replicas < 0:
+            raise exceptions.InvalidServiceSpecError(
+                'disaggregation replica counts must be >= 0')
+        if self.disagg_enabled and (self.disagg_prefill_replicas == 0
+                                    or self.disagg_decode_replicas == 0):
+            raise exceptions.InvalidServiceSpecError(
+                'disaggregation needs BOTH prefill_replicas and '
+                'decode_replicas >= 1 (a lone pool has nobody to hand '
+                'off to/from)')
 
     @property
     def autoscaling_enabled(self) -> bool:
@@ -96,6 +118,13 @@ class SkyServiceSpec:
         if tls:
             fields.update(tls_certfile=tls.get('certfile'),
                           tls_keyfile=tls.get('keyfile'))
+        disagg = config.get('disaggregation')
+        if disagg:
+            fields.update(
+                disagg_prefill_replicas=int(
+                    disagg.get('prefill_replicas', 0)),
+                disagg_decode_replicas=int(
+                    disagg.get('decode_replicas', 0)))
         par = config.get('parallelism')
         if par:
             fields.update(
@@ -144,6 +173,11 @@ class SkyServiceSpec:
         if self.tls_certfile and self.tls_keyfile:
             cfg['tls'] = {'certfile': self.tls_certfile,
                           'keyfile': self.tls_keyfile}
+        if self.disagg_enabled:
+            cfg['disaggregation'] = {
+                'prefill_replicas': self.disagg_prefill_replicas,
+                'decode_replicas': self.disagg_decode_replicas,
+            }
         if self.autoscaling_enabled or self.target_qps_per_replica:
             cfg['replica_policy'] = {
                 'min_replicas': self.min_replicas,

@@ -108,7 +108,8 @@ SERVICE_SCHEMA: Dict[str, Any] = {
         'port': {'type': 'integer', 'minimum': 1, 'maximum': 65535},
         'load_balancing_policy': {
             'type': 'string',
-            'enum': ['round_robin', 'least_load', 'queue_depth'],
+            'enum': ['round_robin', 'least_load', 'queue_depth',
+                     'phase_aware'],
         },
         'tls': {
             'type': 'object',
@@ -117,6 +118,19 @@ SERVICE_SCHEMA: Dict[str, Any] = {
             'properties': {
                 'certfile': {'type': 'string'},
                 'keyfile': {'type': 'string'},
+            },
+        },
+        # Disaggregated prefill/decode serving: dedicate this many
+        # replicas to each phase (prefill workers hand finished KV to
+        # decode workers over /kv/ingest; remaining replicas stay
+        # colocated). Roles reach replicas as SKYTPU_ROLE launch env;
+        # pair with load_balancing_policy: phase_aware.
+        'disaggregation': {
+            'type': 'object',
+            'additionalProperties': False,
+            'properties': {
+                'prefill_replicas': {'type': 'integer', 'minimum': 0},
+                'decode_replicas': {'type': 'integer', 'minimum': 0},
             },
         },
         # Multi-chip replica parallelism: adaptive picks (tp, dp) per

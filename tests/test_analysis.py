@@ -563,6 +563,66 @@ def test_gc113_whole_repo_clean():
     assert [v for v in new if v.rule == 'GC113'] == []
 
 
+# ------------------------------------------------------------------ GC114
+def test_gc114_wide_float_astype_on_transfer_path_flagged():
+    src = '''
+    import jax.numpy as jnp
+    import numpy as np
+    def encode_rows(codes, scales):
+        wide = codes.astype(jnp.bfloat16) * scales
+        return wide.astype(np.float32)
+    '''
+    assert rule_ids(src, 'skypilot_tpu/inference/kv_transfer.py') == \
+        ['GC114', 'GC114']
+    # String dtype spellings count too.
+    src2 = '''
+    def pack(rows):
+        return rows.astype('float32').tobytes()
+    '''
+    assert rule_ids(src2, 'skypilot_tpu/serve/disagg.py') == ['GC114']
+
+
+def test_gc114_dequantize_call_on_transfer_path_flagged():
+    src = '''
+    from skypilot_tpu.models import quantization
+    def export_rows(codes, scales):
+        return quantization.dequantize_rows(codes, scales)
+    '''
+    assert rule_ids(src, 'skypilot_tpu/serve/disagg.py') == ['GC114']
+
+
+def test_gc114_only_polices_transfer_paths():
+    # The same spellings are legal elsewhere (attention kernels
+    # legitimately widen for compute; GC114 is a WIRE discipline).
+    src = '''
+    import jax.numpy as jnp
+    def attend(codes, scales):
+        return codes.astype(jnp.bfloat16) * scales
+    '''
+    assert rule_ids(src, 'skypilot_tpu/models/x.py') == []
+    assert rule_ids(src, 'skypilot_tpu/serve/server.py') == []
+
+
+def test_gc114_stored_dtype_codec_clean():
+    # The sanctioned codec shape: raw bytes in the stored dtype, no
+    # conversion anywhere.
+    src = '''
+    import numpy as np
+    def encode(arr):
+        return np.ascontiguousarray(arr, dtype=np.int8).tobytes()
+    def decode(buf, shape):
+        return np.frombuffer(buf, dtype=np.int8).reshape(shape)
+    '''
+    assert rule_ids(src, 'skypilot_tpu/inference/kv_transfer.py') == []
+
+
+def test_gc114_whole_repo_clean():
+    # The real wire codec + handoff plumbing never widen KV.
+    from skypilot_tpu.analysis import lint
+    new, _ = lint.lint_paths(None, baseline=lint.load_baseline(None))
+    assert [v for v in new if v.rule == 'GC114'] == []
+
+
 # ------------------------------------------------------------------ GC201
 def test_gc201_impure_calls_inside_jit():
     src = '''

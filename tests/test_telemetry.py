@@ -393,6 +393,31 @@ def test_server_prometheus_metrics_and_debug_requests():
             in prom
         assert 'skytpu_replica_recovery_seconds_bucket{le="+Inf"} 0' \
             in prom
+        # (b4) Disaggregation series (round 9): every handoff outcome,
+        # transfer direction, the transfer-latency histogram and the
+        # per-role gauge register at construction — zeros from the
+        # first scrape on a colocated replica that never hands off.
+        from skypilot_tpu.serve import disagg as disagg_lib
+        assert '# TYPE skytpu_disagg_handoff_total counter' in prom
+        for outcome in disagg_lib.HANDOFF_OUTCOMES:
+            assert (f'skytpu_disagg_handoff_total'
+                    f'{{outcome="{outcome}"}} 0' in prom), outcome
+        for direction in disagg_lib.KV_TRANSFER_DIRECTIONS:
+            assert (f'skytpu_kv_transfer_bytes_total'
+                    f'{{direction="{direction}"}} 0' in prom), direction
+        assert '# TYPE skytpu_kv_transfer_seconds histogram' in prom
+        assert 'skytpu_kv_transfer_seconds_bucket{le="+Inf"} 0' in prom
+        assert 'skytpu_replica_role{role="colocated"} 1' in prom
+        assert 'skytpu_replica_role{role="prefill"} 0' in prom
+        assert 'skytpu_replica_role{role="decode"} 0' in prom
+        # JSON disagg block: stable schema, zeros when idle.
+        assert m['disagg']['role'] == 'colocated'
+        assert set(m['disagg']['handoffs']) == \
+            set(disagg_lib.HANDOFF_OUTCOMES)
+        assert all(v == 0 for v in m['disagg']['handoffs'].values())
+        assert m['disagg']['kv_transfer_bytes'] == {'export': 0,
+                                                    'ingest': 0}
+
         # JSON: per-tier latency quantile keys always present and
         # numeric — zeros for the tier no request used.
         assert set(m['sched']['tiers']) == set(sched_lib.TIERS)
