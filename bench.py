@@ -137,6 +137,8 @@ def main() -> None:
              lambda: _chaos_bench(n_chips)),
             ('disagg',
              lambda: _disagg_bench(n_chips)),
+            ('spot',
+             lambda: _spot_bench(n_chips)),
             ('train',
              lambda: _train_step_bench(on_tpu, n_chips,
                                        chip_peak_tflops))):
@@ -1318,6 +1320,319 @@ def _chaos_bench(n_chips: int) -> dict:
         'zero_lost_contract_held':
             faulted['lost_requests'] == 0
             and clean['lost_requests'] == 0,
+    }
+
+
+def _spot_autoscaler_sim() -> dict:
+    """Forecast-vs-reactive autoscaler replay on one identical diurnal
+    trace (pure, clock-injected — no servers): arrivals beyond
+    (ready replicas x target QPS) in a tick count as modeled sheds.
+    The acceptance bar: forecast pre-scaling sheds STRICTLY fewer."""
+    import numpy as _np
+
+    from skypilot_tpu.serve import autoscalers as asc_lib
+    from skypilot_tpu.serve.autoscalers import (DecisionOperator,
+                                                ReplicaView)
+    from skypilot_tpu.serve.service_spec import SkyServiceSpec
+
+    season, qps_per, provision_s = 300.0, 2.0, 30.0
+    trace = []
+    t = 0.0
+    while t < 4 * season:
+        phase = t % season
+        rate = 8.0 if phase < 60.0 else 0.5
+        trace.append(t)
+        t += 1.0 / rate
+
+    def simulate(asc, lead_known):
+        if lead_known:
+            asc.note_provision_seconds(provision_s)
+        shed, idx, next_id = 0, 0, 2
+        replicas = [ReplicaView(1, True, False)]
+        pending = []
+        replica_ticks = 0
+        for now in _np.arange(0.0, 4 * season, 10.0):
+            batch = []
+            while idx < len(trace) and trace[idx] < now:
+                batch.append(trace[idx])
+                idx += 1
+            asc.collect_request_information(batch)
+            pending = [(rt, v) for rt, v in pending
+                       if rt > now or replicas.append(v)]
+            for d in asc.evaluate_scaling(
+                    replicas + [v for _, v in pending], now=now):
+                if d.operator == DecisionOperator.SCALE_UP:
+                    pending.append((now + provision_s,
+                                    ReplicaView(next_id, True, False)))
+                    next_id += 1
+                else:
+                    rid = d.target['replica_id']
+                    replicas = [v for v in replicas
+                                if v.replica_id != rid]
+            replica_ticks += len(replicas)
+            shed += max(0, len(batch) - int(len(replicas)
+                                            * qps_per * 10.0))
+        return shed, replica_ticks * 10.0
+
+    def spec(**kw):
+        return SkyServiceSpec(
+            readiness_path='/readiness', min_replicas=1, max_replicas=8,
+            target_qps_per_replica=qps_per, upscale_delay_seconds=10.0,
+            downscale_delay_seconds=60.0, **kw)
+
+    shed_r, chip_s_r = simulate(
+        asc_lib.RequestRateAutoscaler(spec()), lead_known=False)
+    shed_f, chip_s_f = simulate(
+        asc_lib.Autoscaler.from_spec(spec(
+            forecast_enabled=True, forecast_bucket_seconds=10.0,
+            forecast_season_seconds=season,
+            forecast_horizon_seconds=60.0)), lead_known=True)
+    return {
+        'trace': {'seasons': 4, 'season_s': season, 'burst_s': 60.0,
+                  'burst_qps': 8.0, 'base_qps': 0.5,
+                  'provision_s': provision_s,
+                  'target_qps_per_replica': qps_per},
+        'reactive': {'shed': shed_r,
+                     'replica_seconds': round(chip_s_r, 1)},
+        'forecast': {'shed': shed_f,
+                     'replica_seconds': round(chip_s_f, 1)},
+        'forecast_sheds_strictly_fewer': shed_f < shed_r,
+    }
+
+
+def _spot_bench(n_chips: int) -> dict:
+    """Spot block (round 10, BENCH_r10): 2 "spot" + 1 on-demand tiny
+    replica behind the real LB, a bursty two-burst replay, and TWO
+    seeded mid-burst spot preemptions driven through the real path
+    (POST /checkpoint -> POST /drain -> out of rotation), with one
+    replica recovered WARM (its checkpoint landed via /kv/warmup
+    before it rejoins) and, in a second identical pass, recovered COLD
+    — the warm-vs-cold recovery TTFT p90 is the headline number.
+    ``lost_requests`` MUST be 0 in both passes. Plus the pure
+    forecast-vs-reactive shed replay (``autoscaler_sim``)."""
+    import json as _json
+    import random
+    import threading
+    import urllib.request
+
+    import http.server as hs
+
+    from skypilot_tpu.serve.load_balancer import SkyServeLoadBalancer
+    from skypilot_tpu.serve.server import ModelServer
+    from skypilot_tpu.utils import common_utils
+
+    gen = 16
+    shared_prefix = [7 + (j % 97) for j in range(96)]
+
+    def make_controller(urls):
+        state = {'urls': list(urls)}
+
+        class H(hs.BaseHTTPRequestHandler):
+            timeout = 30
+
+            def log_message(self, *a):
+                del a
+
+            def do_POST(self):  # noqa: N802
+                body = _json.dumps({'ready_replica_urls': state['urls'],
+                                    'retry_after_s': 2}).encode()
+                self.send_response(200)
+                self.send_header('Content-Length', str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+        port = common_utils.find_free_port(18600)
+        httpd = hs.ThreadingHTTPServer(('127.0.0.1', port), H)
+        threading.Thread(target=httpd.serve_forever, daemon=True).start()
+        return httpd, state, f'http://127.0.0.1:{port}'
+
+    def post(url, data, headers, timeout=120):
+        req = urllib.request.Request(url, data, headers)
+        return urllib.request.urlopen(req, timeout=timeout)
+
+    def run_pass(warm_recovery):
+        ports = [common_utils.find_free_port(18640 + i * 7)
+                 for i in range(3)]
+        servers = [ModelServer('tiny', max_batch=4, max_seq=256,
+                               port=p) for p in ports]
+        for s in servers:
+            s.start(block=False)
+        urls = [f'http://127.0.0.1:{p}' for p in ports]
+        alive_since = {u: time.time() for u in urls}
+        chip_seconds = 0.0
+        httpd = lb = recovered = None
+        try:
+            for s in servers:
+                if not s._ready.wait(600):
+                    raise RuntimeError('spot replicas never ready')
+            httpd, state, ctrl_url = make_controller(urls)
+            lb_port = common_utils.find_free_port(18700)
+            os.environ['SKYTPU_LB_SYNC'] = '3600'
+            lb = SkyServeLoadBalancer(controller_url=ctrl_url,
+                                      port=lb_port, max_attempts=4)
+            lb.start()
+            lb._sync_once()
+            lock = threading.Lock()
+            done, retryable, lost = [], [], []
+
+            def one(prompt):
+                body = _json.dumps({'prompt': prompt,
+                                    'max_new_tokens': gen}).encode()
+                t0, err, retry_ok, ttft = time.time(), None, False, None
+                try:
+                    with post(f'http://127.0.0.1:{lb_port}/generate',
+                              body,
+                              {'Content-Type': 'application/json'},
+                              timeout=300) as r:
+                        out = _json.loads(r.read())
+                    ttft = out.get('ttft_ms')
+                except urllib.error.HTTPError as e:
+                    err = f'HTTP {e.code}'
+                    retry_ok = (e.code in (429, 503)
+                                and 'Retry-After' in e.headers)
+                except Exception as e:  # pylint: disable=broad-except
+                    err = f'{type(e).__name__}: {e}'
+                with lock:
+                    if err is None:
+                        done.append((time.time() - t0, ttft))
+                    elif retry_ok:
+                        retryable.append(err)
+                    else:
+                        lost.append(err)
+
+            def burst(n, seed):
+                rng = random.Random(seed)
+                ths = []
+                for i in range(n):
+                    p = shared_prefix + [11 + seed, 3 + i % 7, i % 5]
+                    th = threading.Thread(target=one, args=(p,))
+                    th.start()
+                    ths.append(th)
+                    time.sleep(rng.expovariate(10.0))
+                return ths
+
+            # Burst 1: steady state, all three replicas serving.
+            ths = burst(10, seed=1)
+            for th in ths:
+                th.join(timeout=300)
+            steady = sorted(t for t, _ in done)
+            steady_p90 = steady[int(len(steady) * 0.9)] if steady \
+                else None
+            steady_ttft = sorted(f for _, f in done if f is not None)
+            steady_ttft_p90 = (steady_ttft[int(len(steady_ttft) * 0.9)]
+                               if steady_ttft else None)
+
+            # Burst 2 with TWO mid-burst spot preemptions: checkpoint
+            # -> drain -> out of rotation (the spot_preemption flow).
+            ths = burst(6, seed=2)
+            blobs = []
+            for kill in (0, 1):
+                with post(urls[kill] + '/checkpoint',
+                          _json.dumps({}).encode(),
+                          {'Content-Type': 'application/json'},
+                          timeout=120) as r:
+                    blobs.append(r.read())
+                post(urls[kill] + '/drain', _json.dumps({}).encode(),
+                     {'Content-Type': 'application/json'},
+                     timeout=60).read()
+                state['urls'] = [u for u in state['urls']
+                                 if u != urls[kill]]
+                lb._sync_once()
+                chip_seconds += time.time() - alive_since.pop(
+                    urls[kill])
+                ths += burst(3, seed=3 + kill)
+            for th in ths:
+                th.join(timeout=300)
+
+            # Recovery: a replacement replica joins — warmed from the
+            # dead replica's checkpoint, or cold (the baseline pass).
+            rec_port = common_utils.find_free_port(18760)
+            recovered = ModelServer('tiny', max_batch=4, max_seq=256,
+                                    port=rec_port)
+            recovered.start(block=False)
+            if not recovered._ready.wait(600):
+                raise RuntimeError('recovered replica never ready')
+            rec_url = f'http://127.0.0.1:{rec_port}'
+            alive_since[rec_url] = time.time()
+            warmed_rows = 0
+            if warm_recovery:
+                with post(rec_url + '/kv/warmup', blobs[0],
+                          {'Content-Type':
+                           'application/octet-stream'},
+                          timeout=120) as r:
+                    warmed_rows = _json.loads(r.read())['warmed_rows']
+            state['urls'] = state['urls'] + [rec_url]
+            lb._sync_once()
+            # Recovery probes: shared-prefix requests pinned at the
+            # recovered replica — warm passes prefix-hit the restored
+            # chains, cold passes re-prefill everything.
+            rec_ttfts = []
+            for i in range(6):
+                p = shared_prefix + [12, 3 + i % 7, i % 5]
+                body = _json.dumps({'prompt': p,
+                                    'max_new_tokens': 4}).encode()
+                with post(rec_url + '/generate', body,
+                          {'Content-Type': 'application/json'},
+                          timeout=120) as r:
+                    out = _json.loads(r.read())
+                if out.get('ttft_ms') is not None:
+                    rec_ttfts.append(out['ttft_ms'])
+            rec_ttfts.sort()
+            for u, t0 in alive_since.items():
+                chip_seconds += time.time() - t0
+            return {
+                'n_requests': 22,
+                'n_completed': len(done),
+                'n_retryable_errors': len(retryable),
+                'lost_requests': len(lost),
+                'lost_detail': lost[:4],
+                'steady_latency_s_p90': (round(steady_p90, 3)
+                                         if steady_p90 else None),
+                'steady_ttft_ms_p90': (round(steady_ttft_p90, 2)
+                                       if steady_ttft_p90 else None),
+                'recovery_ttft_ms_p90': (
+                    round(rec_ttfts[int(len(rec_ttfts) * 0.9)], 2)
+                    if rec_ttfts else None),
+                'warmed_rows': warmed_rows,
+                'checkpoint_bytes': len(blobs[0]),
+                'replica_seconds': round(chip_seconds, 1),
+            }
+        finally:
+            if lb is not None:
+                lb.stop()
+            if httpd is not None:
+                httpd.shutdown()
+            for s in servers:
+                s.stop()
+            if recovered is not None:
+                recovered.stop()
+
+    warm = run_pass(warm_recovery=True)
+    cold = run_pass(warm_recovery=False)
+    ratio = over_steady = None
+    if warm.get('recovery_ttft_ms_p90') and \
+            cold.get('recovery_ttft_ms_p90'):
+        ratio = round(warm['recovery_ttft_ms_p90']
+                      / cold['recovery_ttft_ms_p90'], 3)
+    if warm.get('recovery_ttft_ms_p90') and \
+            warm.get('steady_ttft_ms_p90'):
+        # The acceptance bar: post-warmup recovery TTFT p90 vs the
+        # same pass's steady state (<= 2x on real hardware; CPU runs
+        # record it, compile noise included).
+        over_steady = round(warm['recovery_ttft_ms_p90']
+                            / warm['steady_ttft_ms_p90'], 3)
+    return {
+        'workload': {'model': 'tiny', 'n_chips': n_chips,
+                     'replicas': '2 spot + 1 on-demand',
+                     'injected_preemptions': 2,
+                     'shared_prefix_tokens': 96, 'gen_tokens': gen},
+        'warm_recovery': warm,
+        'cold_recovery': cold,
+        'warm_over_cold_recovery_ttft': ratio,
+        'warm_recovery_ttft_over_steady': over_steady,
+        'zero_lost_contract_held':
+            warm['lost_requests'] == 0 and cold['lost_requests'] == 0,
+        'autoscaler_sim': _spot_autoscaler_sim(),
     }
 
 

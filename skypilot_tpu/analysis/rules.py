@@ -65,6 +65,14 @@ Concurrency / control-plane hygiene (GC1xx):
   STORED dtype; the wire codec never converts — widening KV for the
   wire doubles handoff bytes and silently defeats the whole
   disaggregation economics.
+- **GC115 wallclock-in-scaling-path** — a direct ``time.time()`` /
+  ``time.monotonic()`` call anywhere in ``serve/autoscalers.py`` or
+  ``serve/forecaster.py``. Scaling and forecast decisions are
+  clock-injectable (the ``now`` parameter / constructor ``clock=``)
+  so tests replay recorded traces to identical decisions; one raw
+  wall-clock read re-introduces nondeterminism invisibly. Referencing
+  ``time.time`` as an injectable default argument is the mechanism
+  itself and stays legal — only *calls* are flagged.
 
 TPU hot-path hygiene (GC2xx), applied to the compute layer
 (``inference/``, ``models/``, ``ops/``, ``train/``):
@@ -149,6 +157,13 @@ RULES: Dict[str, str] = {
              'helpers in inference/kv_transfer.py are the sanctioned '
              'spelling); dequantizing for the wire doubles handoff '
              'bytes and silently defeats the disaggregation win',
+    'GC115': 'wallclock-in-scaling-path: direct time.time()/'
+             'time.monotonic() call inside serve/autoscalers.py or '
+             'serve/forecaster.py — scaling/forecast decisions must '
+             'read the injected clock (the `now` parameter / '
+             'self._clock) so recorded traces replay to identical '
+             'decisions under test; referencing time.time as an '
+             'injectable default is fine, calling it is not',
     'GC201': 'impure-jit: impure or host-synchronizing call inside a '
              '@jax.jit body',
     'GC202': 'host-sync: device->host readback outside the '
@@ -244,6 +259,16 @@ RETRYLOOP_DIRS = ('serve', 'jobs')
 _JITTER_METHODS = {'random', 'uniform', 'expovariate', 'gauss',
                    'betavariate', 'triangular', 'randint', 'randrange',
                    'choice', 'rand', 'random_sample'}
+
+# --------------------------------------------------------------------- GC115
+# Scaling-decision modules: every decision path is clock-injectable
+# (`now` parameter / constructor `clock=`), so a direct wall-clock CALL
+# anywhere in them silently breaks deterministic trace replay. Name
+# *references* (`clock=time.time` default args) are the injection
+# mechanism itself and stay legal.
+SCALING_PATH_SUFFIXES = ('serve/autoscalers.py', 'serve/forecaster.py')
+_SCALING_WALLCLOCK = {'time.time', 'time.monotonic'}
+_SCALING_WALLCLOCK_BARE = {'monotonic'}   # from time import monotonic
 
 # --------------------------------------------------------------------- GC109
 # Ad-hoc timing calls banned from inference/ hot paths: telemetry's
@@ -402,7 +427,8 @@ class _Checker(ast.NodeVisitor):
                  is_quant_helper: bool = False,
                  is_serve: bool = False,
                  is_retryloop_dir: bool = False,
-                 is_transfer_path: bool = False):
+                 is_transfer_path: bool = False,
+                 is_scaling_path: bool = False):
         self.rel = rel
         self.lines = lines
         self.is_compute = is_compute
@@ -411,6 +437,7 @@ class _Checker(ast.NodeVisitor):
         self.is_serve = is_serve
         self.is_retryloop_dir = is_retryloop_dir
         self.is_transfer_path = is_transfer_path
+        self.is_scaling_path = is_scaling_path
         self._flagged_sleeps: Set[int] = set()   # node ids (GC112 dedupe)
         self.violations: List[Violation] = []
         self._scope: List[str] = []
@@ -672,6 +699,8 @@ class _Checker(ast.NodeVisitor):
             self._check_device_put(node, name)
         if self.is_transfer_path:
             self._check_wire_dtype(node, name, method)
+        if self.is_scaling_path:
+            self._check_scaling_clock(node, name)
         if self.is_serve and self._in_async:
             self._check_async_engine_call(node, name, method)
         if self._any_lock_held():
@@ -782,6 +811,21 @@ class _Checker(ast.NodeVisitor):
                       f'unbounded .{target}() inside an async '
                       'coroutine parks the event loop — await an '
                       'async primitive or run the wait in an executor')
+
+    def _check_scaling_clock(self, node: ast.Call, name: str) -> None:
+        """GC115: a direct wall-clock CALL in a scaling-decision
+        module. The autoscaler/forecaster decision paths take an
+        explicit ``now`` or draw from the injected ``clock`` — a raw
+        ``time.time()`` makes the decision unreplayable under test
+        (and silently divergent between the test's synthetic trace and
+        production)."""
+        if (name in _SCALING_WALLCLOCK
+                or ('.' not in name and name in _SCALING_WALLCLOCK_BARE)):
+            self._add('GC115', node,
+                      f'{name}() inside a scaling decision path — use '
+                      'the injected clock (the `now` parameter / '
+                      'self._clock) so scaling logic stays '
+                      'deterministic under test')
 
     def _check_adhoc_timing(self, node: ast.Call, name: str) -> None:
         if (name in _ADHOC_TIMING
@@ -925,7 +969,9 @@ def check_source(rel: str, source: str) -> List[Violation]:
                            f'/{d}/' in f'/{norm}'
                            for d in RETRYLOOP_DIRS),
                        is_transfer_path=norm.endswith(
-                           TRANSFER_PATH_SUFFIXES))
+                           TRANSFER_PATH_SUFFIXES),
+                       is_scaling_path=norm.endswith(
+                           SCALING_PATH_SUFFIXES))
     checker.visit(tree)
     suppressed = _line_suppressions(source)
     out = []

@@ -612,6 +612,12 @@ def serve_logs(service_name, no_follow):
                    'replica may hand off to when no router supplied '
                    'X-Handoff-Target (picked by live KV-pool '
                    'headroom). Default: SKYTPU_HANDOFF_TARGETS env.')
+@click.option('--checkpoint-path', default=None,
+              help='Local prefix-cache checkpoint file (default: '
+                   'SKYTPU_KV_CHECKPOINT_PATH env). A drain/preemption '
+                   'warning persists hot prefix chains here; a '
+                   '(re)booting server warms its cache from the file '
+                   'before declaring readiness.')
 @click.option('--max-batch', type=int, default=8)
 @click.option('--max-seq', type=int, default=1024)
 @click.option('--port', type=int, default=8081)
@@ -620,7 +626,7 @@ def model_server(model, model_path, quantize, tp, dp, kv_cache,
                  decode_priority_ratio, prefill_w8a8, speculate_k,
                  slo_tier_default, max_queue_tokens, latency_admit_frac,
                  drain_deadline_s, fault_spec, role, handoff_targets,
-                 max_batch, max_seq, port):
+                 checkpoint_path, max_batch, max_seq, port):
     """Run the in-tree replica model server on this host (the process
     a service task's ``run`` command starts on each replica; same
     knobs as ``python -m skypilot_tpu.serve.server``)."""
@@ -645,7 +651,8 @@ def model_server(model, model_path, quantize, tp, dp, kv_cache,
                          fault_spec=fault_spec,
                          role=role,
                          handoff_targets=(handoff_targets.split(',')
-                                          if handoff_targets else None))
+                                          if handoff_targets else None),
+                         checkpoint_path=checkpoint_path)
     click.echo(f'Model server on :{port} '
                f'(kv_cache={kv_cache}, speculate_k={speculate_k}, '
                f'tp={server.tp}, dp={server.dp}, role={server.role})')

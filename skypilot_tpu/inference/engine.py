@@ -671,13 +671,47 @@ class _EngineBase:
         [L, n, hkv, d] (scales [L, n, hkv])."""
         raise NotImplementedError
 
-    def _validate_ingest(self, snap: Dict[str, Any]) -> None:
-        """Shared ingest validation: model shape, kv dtype (no
-        transcoding — int8 stays int8 end to end), row-count
-        consistency, and the engine's own request limits. Raises
+    def decoding_request_ids(self) -> List[int]:
+        """Request ids currently seated in decode slots (the set
+        ``export_kv_snapshot`` can snapshot). Callers serialize engine
+        access like every other host-side engine call."""
+        return [r.request_id for r in self._slots if r is not None]
+
+    # ---------------------------------------------- prefix checkpoint
+    # Spot resilience: on a preemption warning the serve layer
+    # checkpoints the engine's hottest prefix-cache page chains (plus
+    # in-flight request snapshots) through the SKKV/SKPF wire codec,
+    # and a replacement replica lands them via warm_prefix BEFORE it
+    # enters LB rotation — post-recovery TTFT is near-warm instead of
+    # cold. The slot engine has no prefix cache, so the base
+    # implementations are honest no-ops; the paged engine overrides
+    # both.
+
+    def export_prefix_snapshots(self, max_entries: int = 8):
+        """Hottest prefix-cache page chains as prefix entries
+        (``kv_transfer.encode_prefix_chain`` input dicts), plus any
+        events drained from the async pipeline (routed by the caller
+        exactly like ``step()`` events). Base: no prefix cache —
+        ``([], [])``."""
+        del max_entries
+        return [], []
+
+    def warm_prefix(self, entry: Dict[str, Any]) -> int:
+        """Land a prefix entry (or a request snapshot viewed as one)
+        into the prefix cache WITHOUT seating a request; returns the
+        number of KV rows landed. Base: no prefix cache — 0 rows (the
+        warmup endpoint reports it; callers must not treat 0 as an
+        error)."""
+        del entry
+        return 0
+
+    def _validate_kv_entry(self, entry: Dict[str, Any],
+                           n_rows: int) -> None:
+        """Shared KV-payload validation for ingest/warmup: model
+        shape, kv dtype (no transcoding) and row-array shapes. Raises
         ``ValueError`` (permanent refusal)."""
         cfg = self.cfg
-        model = snap.get('model') or {}
+        model = entry.get('model') or {}
         for key, want in (('n_layers', cfg.n_layers),
                           ('n_kv_heads', cfg.n_kv_heads),
                           ('head_dim', cfg.head_dim)):
@@ -685,12 +719,39 @@ class _EngineBase:
                 raise ValueError(
                     f'handoff model mismatch: {key}='
                     f'{model.get(key)} != engine {want}')
-        if snap.get('kv_cache_dtype') != self.kv_cache_dtype:
+        if entry.get('kv_cache_dtype') != self.kv_cache_dtype:
             raise ValueError(
                 'handoff kv_cache_dtype '
-                f'{snap.get("kv_cache_dtype")!r} != engine '
+                f'{entry.get("kv_cache_dtype")!r} != engine '
                 f'{self.kv_cache_dtype!r} (no wire transcoding: int8 '
                 'KV must land in an int8 pool)')
+        for arr, name in ((entry['k'], 'k'), (entry['v'], 'v')):
+            shape = tuple(np.shape(arr))
+            want_shape = (cfg.n_layers, n_rows, cfg.n_kv_heads,
+                          cfg.head_dim)
+            if shape != want_shape:
+                raise ValueError(f'handoff {name} rows shape {shape} '
+                                 f'!= {want_shape}')
+        if self.kv_cache_dtype == 'int8':
+            for arr, name in ((entry['k_scale'], 'k_scale'),
+                              (entry['v_scale'], 'v_scale')):
+                shape = tuple(np.shape(arr))
+                if shape != (cfg.n_layers, n_rows, cfg.n_kv_heads):
+                    raise ValueError(
+                        f'handoff {name} shape {shape} != '
+                        f'{(cfg.n_layers, n_rows, cfg.n_kv_heads)}')
+            for arr, name in ((entry['k'], 'k'), (entry['v'], 'v')):
+                if np.dtype(getattr(arr, 'dtype', None)) != np.int8:
+                    raise ValueError(
+                        f'handoff {name} codes are '
+                        f'{getattr(arr, "dtype", None)}, expected int8 '
+                        '(int8 KV never widens on the wire)')
+
+    def _validate_ingest(self, snap: Dict[str, Any]) -> None:
+        """Shared ingest validation: model shape, kv dtype (no
+        transcoding — int8 stays int8 end to end), row-count
+        consistency, and the engine's own request limits. Raises
+        ``ValueError`` (permanent refusal)."""
         prompt, output = snap['prompt'], snap['output']
         if not output:
             raise ValueError('handoff carries no generated token')
@@ -702,27 +763,7 @@ class _EngineBase:
         if len(output) >= int(snap['max_new_tokens']):
             raise ValueError('handoff request is already complete')
         self._validate_request(prompt, int(snap['max_new_tokens']))
-        for arr, name in ((snap['k'], 'k'), (snap['v'], 'v')):
-            shape = tuple(np.shape(arr))
-            want_shape = (cfg.n_layers, n_rows, cfg.n_kv_heads,
-                          cfg.head_dim)
-            if shape != want_shape:
-                raise ValueError(f'handoff {name} rows shape {shape} '
-                                 f'!= {want_shape}')
-        if self.kv_cache_dtype == 'int8':
-            for arr, name in ((snap['k_scale'], 'k_scale'),
-                              (snap['v_scale'], 'v_scale')):
-                shape = tuple(np.shape(arr))
-                if shape != (cfg.n_layers, n_rows, cfg.n_kv_heads):
-                    raise ValueError(
-                        f'handoff {name} shape {shape} != '
-                        f'{(cfg.n_layers, n_rows, cfg.n_kv_heads)}')
-            for arr, name in ((snap['k'], 'k'), (snap['v'], 'v')):
-                if np.dtype(getattr(arr, 'dtype', None)) != np.int8:
-                    raise ValueError(
-                        f'handoff {name} codes are '
-                        f'{getattr(arr, "dtype", None)}, expected int8 '
-                        '(int8 KV never widens on the wire)')
+        self._validate_kv_entry(snap, n_rows)
 
     def _ingest_request(self, snap: Dict[str, Any]) -> Request:
         """Recreate the engine Request a handoff snapshot describes

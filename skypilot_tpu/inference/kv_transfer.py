@@ -33,6 +33,21 @@ Decoding is strict: magic/version/header/manifest/shape mismatches all
 raise ``ValueError`` with the reason — a truncated or corrupt handoff
 must be rejected loudly at the wire (and again at
 ``PageAllocator.register_prefix``), never landed as garbage KV.
+
+Spot-resilience additions (PR 10):
+
+- **Prefix-chain blobs** (magic ``SKPF``): a hot prefix-cache page
+  chain — ``tokens`` (exactly ``n_rows + 1`` of them: the rows plus
+  the next token, matching how the paged allocator content-addresses
+  full pages) and the same stored-dtype KV buffers. No request fields:
+  a prefix is cache warmth, not work.
+- **Checkpoint containers** (magic ``SKCK``): a length-prefixed
+  sequence of SKKV and/or SKPF blobs — what a spot replica exports on
+  a preemption warning and a replacement replica lands via
+  ``/kv/warmup`` (``register_prefix`` before it enters rotation, so
+  post-recovery TTFT is near-warm instead of cold). Request entries in
+  a checkpoint are landed as prefix warmth only, never re-executed —
+  the LB's in-flight recovery owns re-execution.
 """
 from __future__ import annotations
 
@@ -44,6 +59,9 @@ import numpy as np
 
 MAGIC = b'SKKV'
 WIRE_VERSION = 1
+PREFIX_MAGIC = b'SKPF'
+CKPT_MAGIC = b'SKCK'
+CKPT_VERSION = 1
 
 
 class HandoffCapacityError(RuntimeError):
@@ -230,3 +248,212 @@ def decode_handoff(data: bytes) -> Dict[str, Any]:
         snap.update(k=arrays['k_rows'], v=arrays['v_rows'],
                     k_scale=None, v_scale=None)
     return snap
+
+
+# ---------------------------------------------------------------------------
+# Prefix-chain blobs + checkpoint containers (spot resilience)
+# ---------------------------------------------------------------------------
+def _kv_arrays(entry: Dict[str, Any]) -> Dict[str, np.ndarray]:
+    """The entry's KV arrays keyed by wire buffer name (same layout as
+    :func:`snapshot_buffers`; prefix entries use the same keys)."""
+    return snapshot_buffers(entry)
+
+
+def as_prefix_entry(snap: Dict[str, Any]) -> Dict[str, Any]:
+    """View a request snapshot (``export_kv_snapshot`` / decoded SKKV)
+    as a prefix entry: tokens = prompt + output (exactly ``n_rows + 1``
+    — the context rows plus the current token), same KV buffers in
+    their stored dtype. Used when a checkpointed in-flight request is
+    landed as cache warmth rather than re-executed."""
+    if 'tokens' in snap:
+        return snap
+    return {
+        'kv_cache_dtype': snap['kv_cache_dtype'],
+        'n_rows': int(snap['n_rows']),
+        'model': dict(snap['model']),
+        'tokens': list(snap['prompt']) + list(snap['output']),
+        'k': snap['k'], 'v': snap['v'],
+        'k_scale': snap.get('k_scale'), 'v_scale': snap.get('v_scale'),
+    }
+
+
+def encode_prefix_chain(entry: Dict[str, Any]) -> bytes:
+    """Serialize a prefix-cache chain to wire bytes (magic ``SKPF``).
+    Same stored-dtype buffer discipline as :func:`encode_handoff` —
+    int8 codes + fp32 scales never widen (GC114)."""
+    kv_dtype = entry['kv_cache_dtype']
+    manifest = _manifest(kv_dtype)
+    arrays = _kv_arrays(entry)
+    tokens = [int(t) for t in entry['tokens']]
+    n_rows = int(entry['n_rows'])
+    if len(tokens) != n_rows + 1:
+        raise ValueError(
+            f'prefix entry carries {len(tokens)} token(s) for {n_rows} '
+            'row(s); exactly n_rows + 1 are required (the rows plus '
+            'the next token)')
+    buffers: List[bytes] = []
+    buf_meta: List[Dict[str, Any]] = []
+    for name, dtype, rank in manifest:
+        arr = np.ascontiguousarray(arrays[name], dtype=_np_dtype(dtype))
+        if arr.ndim != rank:
+            raise ValueError(
+                f'{name}: expected rank {rank}, got shape {arr.shape}')
+        buffers.append(arr.tobytes())
+        buf_meta.append({'name': name, 'dtype': dtype,
+                         'shape': list(arr.shape)})
+    header = {
+        'version': WIRE_VERSION,
+        'kv_cache_dtype': kv_dtype,
+        'n_rows': n_rows,
+        'model': {k: int(v) for k, v in entry['model'].items()},
+        'tokens': tokens,
+        'buffers': buf_meta,
+    }
+    hj = json.dumps(header).encode()
+    out = [PREFIX_MAGIC, struct.pack('>I', len(hj)), hj]
+    for b in buffers:
+        out.append(struct.pack('>Q', len(b)))
+        out.append(b)
+    return b''.join(out)
+
+
+def decode_prefix_chain(data: bytes) -> Dict[str, Any]:
+    """Parse a prefix-chain blob. Strict, like :func:`decode_handoff`:
+    shape/length lies raise ``ValueError`` before anything lands."""
+    _check(len(data) >= len(PREFIX_MAGIC) + 4, 'short prefix blob')
+    _check(data[:len(PREFIX_MAGIC)] == PREFIX_MAGIC,
+           f'bad prefix magic {data[:len(PREFIX_MAGIC)]!r}')
+    off = len(PREFIX_MAGIC)
+    (hlen,) = struct.unpack_from('>I', data, off)
+    off += 4
+    _check(len(data) >= off + hlen, 'truncated prefix header')
+    try:
+        header = json.loads(data[off:off + hlen])
+    except ValueError as e:
+        raise ValueError(f'malformed KV handoff: bad header JSON ({e})'
+                         ) from None
+    off += hlen
+    _check(isinstance(header, dict), 'header is not an object')
+    _check(header.get('version') == WIRE_VERSION,
+           f'unsupported wire version {header.get("version")!r}')
+    kv_dtype = header.get('kv_cache_dtype')
+    manifest = _manifest(kv_dtype)
+    buf_meta = header.get('buffers')
+    _check(isinstance(buf_meta, list)
+           and [b.get('name') for b in buf_meta]
+           == [name for name, _, _ in manifest],
+           f'buffer manifest does not match {kv_dtype} layout')
+    tokens = header.get('tokens')
+    _check(isinstance(tokens, list) and tokens
+           and all(isinstance(t, int) for t in tokens),
+           'tokens must be a non-empty token-id list')
+    n_rows = header.get('n_rows')
+    _check(isinstance(n_rows, int) and n_rows >= 1, 'bad n_rows')
+    _check(len(tokens) == n_rows + 1,
+           f'{len(tokens)} token(s) != n_rows + 1 '
+           f'({n_rows + 1}) (truncated or inconsistent prefix chain)')
+    model = header.get('model')
+    _check(isinstance(model, dict) and all(
+        isinstance(model.get(k), int)
+        for k in ('n_layers', 'n_kv_heads', 'head_dim')),
+        'missing model shape fields')
+    arrays: Dict[str, np.ndarray] = {}
+    for (name, dtype, rank), meta in zip(manifest, buf_meta):
+        _check(meta.get('dtype') == dtype,
+               f'{name}: dtype {meta.get("dtype")!r} != {dtype}')
+        shape = meta.get('shape')
+        _check(isinstance(shape, list) and len(shape) == rank
+               and all(isinstance(s, int) and s > 0 for s in shape),
+               f'{name}: bad shape {shape!r}')
+        expect = [model['n_layers'], n_rows, model['n_kv_heads']]
+        if rank == 4:
+            expect.append(model['head_dim'])
+        _check(shape == expect,
+               f'{name}: shape {shape} != expected {expect}')
+        _check(len(data) >= off + 8, f'{name}: truncated length prefix')
+        (blen,) = struct.unpack_from('>Q', data, off)
+        off += 8
+        np_dtype = _np_dtype(dtype)
+        want = int(np.prod(shape)) * np_dtype.itemsize
+        _check(blen == want,
+               f'{name}: {blen} bytes on the wire != {want} for shape '
+               f'{shape} ({dtype})')
+        _check(len(data) >= off + blen, f'{name}: truncated payload')
+        arrays[name] = np.frombuffer(
+            data, dtype=np_dtype, count=int(np.prod(shape)),
+            offset=off).reshape(shape)
+        off += blen
+    _check(off == len(data), f'{len(data) - off} trailing bytes')
+    entry: Dict[str, Any] = {
+        'kv_cache_dtype': kv_dtype,
+        'n_rows': n_rows,
+        'model': {k: int(model[k])
+                  for k in ('n_layers', 'n_kv_heads', 'head_dim')},
+        'tokens': tokens,
+    }
+    if kv_dtype == 'int8':
+        entry.update(k=arrays['k_codes'], v=arrays['v_codes'],
+                     k_scale=arrays['k_scales'],
+                     v_scale=arrays['v_scales'])
+    else:
+        entry.update(k=arrays['k_rows'], v=arrays['v_rows'],
+                     k_scale=None, v_scale=None)
+    return entry
+
+
+def encode_checkpoint(entries: List[Dict[str, Any]]) -> bytes:
+    """Serialize a prefix-cache checkpoint: a container of SKKV
+    (request snapshot — has ``prompt``) and SKPF (prefix chain — has
+    ``tokens``) blobs. An empty checkpoint is valid (a replica with a
+    cold cache still answers the preemption warning)."""
+    blobs: List[bytes] = []
+    for entry in entries:
+        if 'tokens' in entry:
+            blobs.append(encode_prefix_chain(entry))
+        else:
+            blobs.append(encode_handoff(entry))
+    out = [CKPT_MAGIC, struct.pack('>I', CKPT_VERSION),
+           struct.pack('>I', len(blobs))]
+    for b in blobs:
+        out.append(struct.pack('>Q', len(b)))
+        out.append(b)
+    return b''.join(out)
+
+
+def decode_checkpoint(data: bytes) -> List[Dict[str, Any]]:
+    """Parse a checkpoint container into its entries. Each entry dict
+    gains ``entry_kind``: ``'request'`` (SKKV — a checkpointed
+    in-flight request) or ``'prefix'`` (SKPF — a hot prefix chain).
+    Strict end to end: every embedded blob re-validates fully."""
+    _check(len(data) >= len(CKPT_MAGIC) + 8, 'short checkpoint blob')
+    _check(data[:len(CKPT_MAGIC)] == CKPT_MAGIC,
+           f'bad checkpoint magic {data[:len(CKPT_MAGIC)]!r}')
+    off = len(CKPT_MAGIC)
+    (version,) = struct.unpack_from('>I', data, off)
+    off += 4
+    _check(version == CKPT_VERSION,
+           f'unsupported checkpoint version {version}')
+    (count,) = struct.unpack_from('>I', data, off)
+    off += 4
+    entries: List[Dict[str, Any]] = []
+    for i in range(count):
+        _check(len(data) >= off + 8,
+               f'entry {i}: truncated length prefix')
+        (blen,) = struct.unpack_from('>Q', data, off)
+        off += 8
+        _check(len(data) >= off + blen, f'entry {i}: truncated blob')
+        blob = data[off:off + blen]
+        off += blen
+        if blob[:len(PREFIX_MAGIC)] == PREFIX_MAGIC:
+            entry = decode_prefix_chain(blob)
+            entry['entry_kind'] = 'prefix'
+        elif blob[:len(MAGIC)] == MAGIC:
+            entry = decode_handoff(blob)
+            entry['entry_kind'] = 'request'
+        else:
+            raise ValueError(
+                f'malformed KV handoff: entry {i} has unknown magic '
+                f'{blob[:4]!r}')
+        entries.append(entry)
+    _check(off == len(data), f'{len(data) - off} trailing bytes')
+    return entries

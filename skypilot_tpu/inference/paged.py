@@ -997,6 +997,14 @@ class PagedInferenceEngine(SpeculativeMixin, _EngineBase):
         # gathers keyed by P bucket, ingest merges keyed by (rows, P).
         self._export_fns: Dict[int, Any] = {}
         self._ingest_fns: Dict[Tuple[int, int], Any] = {}
+        # Hot-prefix heat tracker (spot resilience): chain digest ->
+        # {'tokens', 'hits'} for recently registered/matched prefix
+        # chains, bounded LRU-by-heat. The preemption checkpoint
+        # exports the hottest chains' page bytes so a replacement
+        # replica boots near-warm (export_prefix_snapshots /
+        # warm_prefix).
+        self._prefix_heat: Dict[bytes, Dict[str, Any]] = {}
+        self._PREFIX_HEAT_MAX = 64
         # Speculative decoding (0 = off): n-gram propose + batched
         # verify with masked page-pool commits.
         self._init_spec(speculate_k)
@@ -1463,6 +1471,11 @@ class PagedInferenceEngine(SpeculativeMixin, _EngineBase):
             self._slot_len[slot] = len(matched) * self.page
             req._n_matched = len(matched)        # host-only annotations
             req._ctx = ctx
+            if matched:
+                # A prefix HIT is the strongest heat signal — shared
+                # prefixes are exactly what the preemption checkpoint
+                # should carry.
+                self._note_hot_prefix(ctx)
             self._prefill_off[slot] = 0          # tail tokens done so far
             self._trace_sched(req)
             if req.trace is not None and matched:
@@ -1581,6 +1594,7 @@ class PagedInferenceEngine(SpeculativeMixin, _EngineBase):
             self._await_first.add(slot)
             self.alloc.register_prefix(req._ctx, self._pages[slot],
                                        req._n_matched)
+            self._note_hot_prefix(req._ctx)
             done_rows.append((i, slot))
         if done_rows:
             # FIXED [n] shapes for the token gather + merge: a
@@ -1608,6 +1622,140 @@ class PagedInferenceEngine(SpeculativeMixin, _EngineBase):
                 req._enq_out = len(req.output) + 1
                 self._maybe_early_free(slot, req)
         return []
+
+    # ------------------------------------------------ prefix heat
+    def _note_hot_prefix(self, tokens: List[int]) -> None:
+        """Record one use (registration or future-worthy context) of
+        the prefix chain covering ``tokens``' full pages — the
+        preemption checkpoint exports the hottest. Host-side dict ops
+        only; bounded at _PREFIX_HEAT_MAX entries (coldest evicted)."""
+        full = (len(tokens) - 1) // self.page
+        if full < 1:
+            return
+        covered = full * self.page
+        key = hashlib.sha1(np.asarray(
+            tokens[:covered], np.int32).tobytes()).digest()
+        rec = self._prefix_heat.get(key)
+        if rec is not None:
+            rec['hits'] += 1
+            return
+        if len(self._prefix_heat) >= self._PREFIX_HEAT_MAX:
+            coldest = min(self._prefix_heat,
+                          key=lambda k: self._prefix_heat[k]['hits'])
+            del self._prefix_heat[coldest]
+        self._prefix_heat[key] = {'tokens': list(tokens[:covered + 1]),
+                                  'hits': 1}
+
+    def export_prefix_snapshots(self, max_entries: int = 8):
+        """The hottest still-cached prefix chains as prefix entries
+        (``kv_transfer`` SKPF dicts): per chain, re-match its pages in
+        the allocator (a chain evicted since it was hot exports
+        nothing) and gather the page rows in the pool's STORED dtype
+        through the same compiled gather the KV handoff uses. Returns
+        ``(entries, drained_events)`` — the async pipeline is drained
+        first so the pool rows are final; the caller routes the events
+        exactly like ``step()`` events."""
+        from skypilot_tpu.inference.engine import _bucket_len
+        events: List[Tuple[int, int, bool]] = []
+        while self._pending:
+            events.extend(self._process_one())
+        entries: List[Dict[str, Any]] = []
+        cfg = self.cfg
+        by_heat = sorted(self._prefix_heat.values(),
+                         key=lambda r: -r['hits'])
+        for rec in by_heat:
+            if len(entries) >= max_entries:
+                break
+            tokens = rec['tokens']
+            pages = self.alloc.match_prefix(tokens)
+            if not pages:
+                continue
+            n_rows = len(pages) * self.page
+            try:
+                P = _bucket_len(len(pages), minimum=1)
+                table = np.zeros((P,), np.int32)
+                table[:len(pages)] = pages
+                out = self._get_export(P)(self.cache,
+                                          device_upload(table))
+                # Sanctioned d2h: the checkpoint export IS a host
+                # readback by design (the rows leave on the wire or
+                # land in a checkpoint file).
+                host = host_sync(out)
+            finally:
+                for p in pages:
+                    self.alloc.release(p)
+            if self.cache.quantized:
+                k, v, ks, vs = host
+                k, v = k[:, :n_rows], v[:, :n_rows]
+                ks, vs = ks[:, :n_rows], vs[:, :n_rows]
+            else:
+                k, v = host
+                k, v = k[:, :n_rows], v[:, :n_rows]
+                ks = vs = None
+            entries.append({
+                'kv_cache_dtype': self.kv_cache_dtype,
+                'n_rows': n_rows,
+                'model': {'n_layers': cfg.n_layers,
+                          'n_kv_heads': cfg.n_kv_heads,
+                          'head_dim': cfg.head_dim},
+                'tokens': list(tokens[:n_rows + 1]),
+                'k': k, 'v': v, 'k_scale': ks, 'v_scale': vs,
+            })
+        return entries, events
+
+    def warm_prefix(self, entry: Dict[str, Any]) -> int:
+        """Land a prefix entry into the prefix cache without seating a
+        request: allocate pages, scatter the rows at their exact
+        original bytes, ``register_prefix`` the chain, then release
+        the pages into the reusable LRU — future prompts sharing the
+        prefix hit the ORIGINAL KV. Idempotent: a chain already fully
+        cached lands nothing. Returns rows landed; raises
+        ``ValueError`` on mismatch (permanent) and
+        ``HandoffCapacityError`` on pool pressure (retryable)."""
+        from skypilot_tpu.inference.engine import HandoffCapacityError
+        if 'tokens' not in entry:
+            from skypilot_tpu.inference import kv_transfer
+            entry = kv_transfer.as_prefix_entry(entry)
+        n_rows = int(entry['n_rows'])
+        tokens = [int(t) for t in entry['tokens']]
+        if len(tokens) < n_rows + 1:
+            raise ValueError(
+                f'prefix entry carries {len(tokens)} token(s) for '
+                f'{n_rows} row(s); need n_rows + 1')
+        self._validate_kv_entry(entry, n_rows)
+        # Land whole pages only (this engine's page size — normally
+        # identical to the exporter's, but a partial tail page cannot
+        # be content-addressed either way).
+        full = n_rows // self.page
+        if full < 1:
+            return 0
+        rows_used = full * self.page
+        prefix_tokens = tokens[:rows_used + 1]
+        matched = self.alloc.match_prefix(prefix_tokens)
+        already = len(matched)
+        for p in matched:
+            self.alloc.release(p)
+        if already >= full:
+            return 0                       # already warm
+        if self.alloc.available < full:
+            raise HandoffCapacityError(
+                f'KV page pool exhausted ({self.alloc.available} '
+                f'page(s) free, {full} needed for prefix warmup)')
+        pages = [self.alloc.alloc() for _ in range(full)]
+        try:
+            self._scatter_snapshot_rows(pages, entry, rows_used)
+            self.alloc.register_prefix(prefix_tokens, pages, 0)
+        except Exception:
+            for p in pages:
+                self.alloc.release(p)
+            raise
+        # refcount -> 0: freshly hashed pages retire into the
+        # prefix-reusable LRU (warm); pages whose hash already existed
+        # (shared with a cached chain) recycle to the free list.
+        for p in pages:
+            self.alloc.release(p)
+        self._note_hot_prefix(prefix_tokens)
+        return rows_used
 
     # ---------------------------------------------------- KV handoff
     def _get_export(self, P: int):
@@ -1686,10 +1834,53 @@ class PagedInferenceEngine(SpeculativeMixin, _EngineBase):
         self._ingest_fns[key] = ingest
         return ingest
 
-    def _land_kv_rows(self, slot: int, req, snap) -> None:
-        from skypilot_tpu.inference.engine import (HandoffCapacityError,
-                                                   _bucket_len)
+    def _scatter_snapshot_rows(self, pages: List[int], snap,
+                               n_rows: int) -> None:
+        """Compiled scatter of ``n_rows`` stored-dtype snapshot rows
+        into ``pages`` (shared by the KV-handoff land and the prefix
+        warmup — both land wire bytes at their exact original
+        values)."""
+        from skypilot_tpu.inference.engine import _bucket_len
         cfg = self.cfg
+        P = _bucket_len(len(pages), minimum=1)
+        # Row bucket: bounded compiled-program count. nb may exceed
+        # P*page for non-power-of-two page sizes; padding rows past
+        # ``valid`` mask to the trash page (their clamped table
+        # lookups are discarded), so the overshoot is harmless.
+        nb = _bucket_len(n_rows, minimum=8)
+        table = np.zeros((1, P), np.int32)
+        table[0, :len(pages)] = pages
+
+        def pad(arr, tail):
+            out = np.zeros((cfg.n_layers, 1, nb, cfg.n_kv_heads)
+                           + tail, dtype=arr.dtype)
+            out[:, 0, :n_rows] = np.asarray(arr, dtype=arr.dtype)[
+                :, :n_rows].reshape(
+                (cfg.n_layers, n_rows, cfg.n_kv_heads) + tail)
+            return out
+
+        starts = np.zeros(1, np.int32)
+        valid = np.array([n_rows], np.int32)
+        ingest = self._get_ingest(nb, P)
+        if self.cache.quantized:
+            (kq, ks, vq, vs, table_d, starts_d,
+             valid_d) = device_upload(
+                (pad(snap['k'], (cfg.head_dim,)),
+                 pad(snap['k_scale'], (1,)),
+                 pad(snap['v'], (cfg.head_dim,)),
+                 pad(snap['v_scale'], (1,)), table, starts, valid))
+            self.cache = ingest(self.cache, kq, ks, vq, vs,
+                                table_d, starts_d, valid_d)
+        else:
+            kr, vr, table_d, starts_d, valid_d = device_upload(
+                (pad(snap['k'], (cfg.head_dim,)),
+                 pad(snap['v'], (cfg.head_dim,)), table, starts,
+                 valid))
+            self.cache = ingest(self.cache, kr, vr, table_d,
+                                starts_d, valid_d)
+
+    def _land_kv_rows(self, slot: int, req, snap) -> None:
+        from skypilot_tpu.inference.engine import HandoffCapacityError
         n_rows = int(snap['n_rows'])
         ctx = req.prompt + req.output
         self._pages[slot] = []
@@ -1698,48 +1889,14 @@ class PagedInferenceEngine(SpeculativeMixin, _EngineBase):
                 f'KV page pool exhausted ({self.alloc.available} '
                 f'page(s) free, {self._pages_needed(n_rows)} needed)')
         try:
-            P = _bucket_len(self._pages_needed(max(1, n_rows)),
-                            minimum=1)
-            # Row bucket: bounded compiled-program count. nb may exceed
-            # P*page for non-power-of-two page sizes; padding rows past
-            # ``valid`` mask to the trash page (their clamped table
-            # lookups are discarded), so the overshoot is harmless.
-            nb = _bucket_len(n_rows, minimum=8)
-            table = np.zeros((1, P), np.int32)
-            table[0, :len(self._pages[slot])] = self._pages[slot]
-
-            def pad(arr, tail):
-                out = np.zeros((cfg.n_layers, 1, nb, cfg.n_kv_heads)
-                               + tail, dtype=arr.dtype)
-                out[:, 0, :n_rows] = arr.reshape(
-                    (cfg.n_layers, n_rows, cfg.n_kv_heads) + tail)
-                return out
-
-            starts = np.zeros(1, np.int32)
-            valid = np.array([n_rows], np.int32)
-            ingest = self._get_ingest(nb, P)
-            if self.cache.quantized:
-                (kq, ks, vq, vs, table_d, starts_d,
-                 valid_d) = device_upload(
-                    (pad(snap['k'], (cfg.head_dim,)),
-                     pad(snap['k_scale'], (1,)),
-                     pad(snap['v'], (cfg.head_dim,)),
-                     pad(snap['v_scale'], (1,)), table, starts, valid))
-                self.cache = ingest(self.cache, kq, ks, vq, vs,
-                                    table_d, starts_d, valid_d)
-            else:
-                kr, vr, table_d, starts_d, valid_d = device_upload(
-                    (pad(snap['k'], (cfg.head_dim,)),
-                     pad(snap['v'], (cfg.head_dim,)), table, starts,
-                     valid))
-                self.cache = ingest(self.cache, kr, vr, table_d,
-                                    starts_d, valid_d)
+            self._scatter_snapshot_rows(self._pages[slot], snap, n_rows)
             # Content-address the landed full pages: future prompts
             # sharing the prefix hit them, and a preempt/resume of
             # THIS request re-matches the original bytes.
             # register_prefix validates page-count vs token-length —
             # the truncated-handoff guard.
             self.alloc.register_prefix(ctx, self._pages[slot], 0)
+            self._note_hot_prefix(ctx)
         except Exception:
             for p in self._pages[slot]:
                 self.alloc.release(p)

@@ -11,6 +11,22 @@ slices.
 
 Pure, clock-injectable logic (no I/O) so tests drive it with synthetic
 timestamps (reference pattern: ``tests/test_serve_autoscaler.py``).
+Decision paths never read the wall clock directly — ``now`` is passed
+in or drawn from the injected ``clock`` (graftcheck GC115 gates this
+for both this module and ``serve/forecaster.py``), so a recorded trace
+replays to identical decisions.
+
+Forecast-aware scaling (SageServe-style, ``serve/forecaster.py``):
+``ForecastRequestRateAutoscaler`` pre-scales *ahead* of traffic ramps
+by the learned provisioning lead time (EWMA of observed replica READY
+latencies, fed by the controller from
+``skytpu_replica_provision_seconds`` observations), and refuses to
+scale down while the forecast over that same lead window still needs
+the capacity — never drain mid-burst.
+
+Telemetry (stable schema, registered at construction):
+``skytpu_autoscaler_target_replicas{kind}`` for kind in
+``('applied', 'reactive', 'forecast')`` — zeros from the first scrape.
 """
 from __future__ import annotations
 
@@ -19,10 +35,18 @@ import enum
 import math
 import time
 import typing
-from typing import Any, Dict, List, Optional
+from typing import Any, Callable, Dict, List, Optional, Sequence
+
+from skypilot_tpu import telemetry
 
 if typing.TYPE_CHECKING:
     from skypilot_tpu.serve.service_spec import SkyServiceSpec
+
+# Stable label set of skytpu_autoscaler_target_replicas{kind}:
+# 'applied' is the hysteresis-filtered target the controller acts on;
+# 'reactive' the raw QPS-window target; 'forecast' the lead-time-ahead
+# forecast target (0 on non-forecast autoscalers).
+TARGET_KINDS = ('applied', 'reactive', 'forecast')
 
 
 class DecisionOperator(enum.Enum):
@@ -52,23 +76,45 @@ class ReplicaView:
 class Autoscaler:
     """Base: fixed replica count (no QPS signal)."""
 
-    def __init__(self, spec: 'SkyServiceSpec') -> None:
+    def __init__(self, spec: 'SkyServiceSpec',
+                 clock: Callable[[], float] = time.time) -> None:
         self.spec = spec
         self.target_num_replicas = spec.min_replicas
         self.latest_version: int = 1
+        # Injected clock: decision paths call self._clock() (or take an
+        # explicit ``now``), never time.time() directly — GC115.
+        self._clock = clock
+        reg = telemetry.get_registry()
+        self._g_target = {
+            kind: reg.gauge(
+                'skytpu_autoscaler_target_replicas',
+                'Autoscaler replica targets (applied = hysteresis-'
+                'filtered; reactive = raw QPS window; forecast = '
+                'lead-time-ahead forecast)', kind=kind)
+            for kind in TARGET_KINDS}
 
     def update_spec(self, spec: 'SkyServiceSpec', version: int) -> None:
-        """Service update: new spec takes effect on the next evaluation."""
+        """Service update: new spec takes effect on the next evaluation.
+        ``max_replicas is None`` means UNBOUNDED — the current target is
+        only re-clamped from below (min) and, when a bound exists, from
+        above; it must never silently collapse to ``min_replicas``."""
         self.spec = spec
         self.latest_version = version
-        self.target_num_replicas = min(
-            max(self.target_num_replicas, spec.min_replicas),
-            spec.max_replicas if spec.max_replicas is not None
-            else spec.min_replicas)
+        target = max(self.target_num_replicas, spec.min_replicas)
+        if spec.max_replicas is not None:
+            target = min(target, spec.max_replicas)
+        self.target_num_replicas = target
 
     def collect_request_information(
-            self, request_timestamps: List[float]) -> None:
-        del request_timestamps
+            self, request_timestamps: List[float],
+            request_tiers: Optional[Sequence[str]] = None) -> None:
+        del request_timestamps, request_tiers
+
+    def note_provision_seconds(self, seconds: float) -> None:
+        """Observed replica provision latency (scale-up issued ->
+        READY). The forecast autoscaler learns its pre-scaling lead
+        time from these; the base classes ignore them."""
+        del seconds
 
     def evaluate_scaling(
             self, replicas: List[ReplicaView],
@@ -79,6 +125,7 @@ class Autoscaler:
         # enough new ones are READY).
         alive = [r for r in replicas if not r.is_terminal
                  and r.version == self.latest_version]
+        self._g_target['applied'].set(self.target_num_replicas)
         decisions: List[ScalingDecision] = []
         for _ in range(self.target_num_replicas - len(alive)):
             decisions.append(ScalingDecision(
@@ -105,24 +152,37 @@ class Autoscaler:
                                             -r.replica_id))[:count]
 
     @classmethod
-    def from_spec(cls, spec: 'SkyServiceSpec') -> 'Autoscaler':
+    def from_spec(cls, spec: 'SkyServiceSpec',
+                  clock: Callable[[], float] = time.time) -> 'Autoscaler':
         if spec.autoscaling_enabled:
-            if spec.base_ondemand_fallback_replicas > 0 or \
-                    spec.dynamic_ondemand_fallback:
-                return FallbackRequestRateAutoscaler(spec)
-            return RequestRateAutoscaler(spec)
-        return Autoscaler(spec)
+            fallback = (spec.base_ondemand_fallback_replicas > 0
+                        or spec.dynamic_ondemand_fallback)
+            if spec.forecast_enabled:
+                return (ForecastFallbackAutoscaler(spec, clock) if fallback
+                        else ForecastRequestRateAutoscaler(spec, clock))
+            if fallback:
+                return FallbackRequestRateAutoscaler(spec, clock)
+            return RequestRateAutoscaler(spec, clock)
+        return Autoscaler(spec, clock)
 
 
 class RequestRateAutoscaler(Autoscaler):
-    """QPS-driven: target = ceil(qps / target_qps_per_replica), bounded to
-    [min_replicas, max_replicas], applied only after the hysteresis delay
+    """QPS-driven: target = ceil(qps / target_qps_per_replica), bounded
+    below by ``min_replicas`` and above by ``max_replicas`` when one is
+    set (``None`` = unbounded), applied only after the hysteresis delay
     (reference ``sky/serve/autoscalers.py:431``, hysteresis ``:348``)."""
 
     QPS_WINDOW_SECONDS = 60.0
+    # Between-trim bound on the pending timestamp list: a burst between
+    # controller ticks must not hold an unbounded list (the window trim
+    # only runs when the QPS is read). 100k timestamps ≈ 1.6k QPS
+    # sustained over the 60 s window — far past anything one controller
+    # serves; beyond it only the newest are kept.
+    MAX_PENDING_TIMESTAMPS = 100_000
 
-    def __init__(self, spec: 'SkyServiceSpec') -> None:
-        super().__init__(spec)
+    def __init__(self, spec: 'SkyServiceSpec',
+                 clock: Callable[[], float] = time.time) -> None:
+        super().__init__(spec, clock)
         self._request_timestamps: List[float] = []
         # Hysteresis is wall-clock-based (first moment the raw target
         # breached the current one), NOT eval-count-based: the controller
@@ -133,8 +193,18 @@ class RequestRateAutoscaler(Autoscaler):
 
     # ------------------------------------------------------------- signal
     def collect_request_information(
-            self, request_timestamps: List[float]) -> None:
+            self, request_timestamps: List[float],
+            request_tiers: Optional[Sequence[str]] = None) -> None:
+        del request_tiers
         self._request_timestamps.extend(request_timestamps)
+        if len(self._request_timestamps) > self.MAX_PENDING_TIMESTAMPS:
+            # Trim against the newest timestamp seen (no wall-clock
+            # read on this path — GC115): first drop what the window
+            # would drop anyway, then hard-cap to the newest entries.
+            self._trim_window(max(self._request_timestamps))
+            if len(self._request_timestamps) > self.MAX_PENDING_TIMESTAMPS:
+                self._request_timestamps = sorted(
+                    self._request_timestamps)[-self.MAX_PENDING_TIMESTAMPS:]
 
     def _trim_window(self, now: float) -> None:
         cutoff = now - self.QPS_WINDOW_SECONDS
@@ -142,18 +212,36 @@ class RequestRateAutoscaler(Autoscaler):
             t for t in self._request_timestamps if t >= cutoff]
 
     def current_qps(self, now: Optional[float] = None) -> float:
-        now = time.time() if now is None else now
+        now = self._clock() if now is None else now
         self._trim_window(now)
         return len(self._request_timestamps) / self.QPS_WINDOW_SECONDS
 
     # ------------------------------------------------------------ evaluate
-    def _raw_target(self, now: float) -> int:
+    def _bound_target(self, target: int) -> int:
+        """Clamp to [min_replicas, max_replicas]; ``max_replicas is
+        None`` bounds only from below (unbounded scale-up) — it must
+        never collapse the target to ``min_replicas``."""
+        target = max(target, self.spec.min_replicas)
+        if self.spec.max_replicas is not None:
+            target = min(target, self.spec.max_replicas)
+        return target
+
+    def _reactive_target(self, now: float) -> int:
         qps = self.current_qps(now)
         assert self.spec.target_qps_per_replica is not None
-        target = math.ceil(qps / self.spec.target_qps_per_replica)
-        lo = self.spec.min_replicas
-        hi = self.spec.max_replicas
-        return min(max(target, lo), hi if hi is not None else lo)
+        target = self._bound_target(
+            math.ceil(qps / self.spec.target_qps_per_replica))
+        self._g_target['reactive'].set(target)
+        return target
+
+    def _raw_target(self, now: float) -> int:
+        return self._reactive_target(now)
+
+    def _downscale_allowed(self, raw: int, now: float) -> bool:
+        """Hook: the forecast autoscaler vetoes downscales the forecast
+        window still needs (never drain mid-burst)."""
+        del raw, now
+        return True
 
     def _update_target(self, now: float) -> None:
         raw = self._raw_target(now)
@@ -167,6 +255,13 @@ class RequestRateAutoscaler(Autoscaler):
                 self._upscale_breach_since = None
         elif raw < self.target_num_replicas:
             self._upscale_breach_since = None
+            if not self._downscale_allowed(raw, now):
+                # The forecast window still needs this capacity: hold,
+                # and restart the downscale clock so the drain only
+                # begins once the forecast has cleared for the full
+                # hysteresis delay.
+                self._downscale_breach_since = None
+                return
             if self._downscale_breach_since is None:
                 self._downscale_breach_since = now
             if (now - self._downscale_breach_since
@@ -180,7 +275,7 @@ class RequestRateAutoscaler(Autoscaler):
     def evaluate_scaling(
             self, replicas: List[ReplicaView],
             now: Optional[float] = None) -> List[ScalingDecision]:
-        now = time.time() if now is None else now
+        now = self._clock() if now is None else now
         self._update_target(now)
         return super().evaluate_scaling(replicas, now)
 
@@ -200,10 +295,11 @@ class FallbackRequestRateAutoscaler(RequestRateAutoscaler):
     def evaluate_scaling(
             self, replicas: List[ReplicaView],
             now: Optional[float] = None) -> List[ScalingDecision]:
-        now = time.time() if now is None else now
+        now = self._clock() if now is None else now
         self._update_target(now)
         alive = [r for r in replicas if not r.is_terminal
                  and r.version == self.latest_version]
+        self._g_target['applied'].set(self.target_num_replicas)
         base = min(self.spec.base_ondemand_fallback_replicas,
                    self.target_num_replicas)
         want_od = base
@@ -235,3 +331,93 @@ class FallbackRequestRateAutoscaler(RequestRateAutoscaler):
                     DecisionOperator.SCALE_DOWN,
                     {'replica_id': rep.replica_id}))
         return decisions
+
+
+class _ForecastMixin:
+    """Forecast-aware behavior layered over the request-rate
+    autoscalers (SageServe-style): the raw target is the max of the
+    reactive QPS target and the forecast target at ``now + lead``
+    (lead = EWMA of observed replica provision latencies, default the
+    spec's ``initial_delay_seconds``), so scale-up decisions fire
+    *before* the ramp arrives; downscales are vetoed while the peak
+    forecast inside the lead window still needs the capacity."""
+
+    # EWMA weight for provision-latency observations.
+    LEAD_EWMA_ALPHA = 0.3
+
+    def __init__(self, spec: 'SkyServiceSpec',
+                 clock: Callable[[], float] = time.time) -> None:
+        super().__init__(spec, clock)  # type: ignore[call-arg]
+        from skypilot_tpu.serve import forecaster as forecaster_lib
+        self.forecaster = forecaster_lib.TrafficForecaster(
+            bucket_s=spec.forecast_bucket_seconds,
+            season_s=spec.forecast_season_seconds,
+            horizon_s=spec.forecast_horizon_seconds,
+            clock=clock)
+        self._g_forecast = forecaster_lib.register_metrics()
+        self._lead_s: Optional[float] = None
+
+    # ------------------------------------------------------------- signal
+    def collect_request_information(
+            self, request_timestamps: List[float],
+            request_tiers: Optional[Sequence[str]] = None) -> None:
+        super().collect_request_information(  # type: ignore[misc]
+            request_timestamps)
+        self.forecaster.observe(request_timestamps, request_tiers)
+
+    def note_provision_seconds(self, seconds: float) -> None:
+        if self._lead_s is None:
+            self._lead_s = float(seconds)
+        else:
+            a = self.LEAD_EWMA_ALPHA
+            self._lead_s = a * float(seconds) + (1 - a) * self._lead_s
+
+    def provision_lead_s(self) -> float:
+        """The pre-scaling lead time: learned from READY latencies once
+        any replica has provisioned, the spec's probe allowance before
+        that, always at least one forecast bucket (a zero lead would
+        degenerate to reactive scaling)."""
+        lead = (self._lead_s if self._lead_s is not None
+                else self.spec.initial_delay_seconds)
+        return max(lead, self.forecaster.bucket_s)
+
+    # ------------------------------------------------------------ targets
+    def _forecast_target(self, now: float) -> int:
+        lead = self.provision_lead_s()
+        fq = self.forecaster.forecast_qps(lead, 'all', now)
+        for tier in ('all', 'latency', 'throughput'):
+            self._g_forecast['now'][tier].set(
+                self.forecaster.qps(tier, now))
+            self._g_forecast['lead'][tier].set(
+                self.forecaster.forecast_qps(lead, tier, now))
+        assert self.spec.target_qps_per_replica is not None
+        target = self._bound_target(
+            math.ceil(fq / self.spec.target_qps_per_replica))
+        self._g_target['forecast'].set(target)
+        return target
+
+    def _raw_target(self, now: float) -> int:
+        return max(self._reactive_target(now),
+                   self._forecast_target(now))
+
+    def _downscale_allowed(self, raw: int, now: float) -> bool:
+        """Never drain mid-burst: hold the capacity while the PEAK
+        forecast anywhere inside the provisioning lead window still
+        exceeds what the proposed smaller target serves."""
+        assert self.spec.target_qps_per_replica is not None
+        peak = self.forecaster.peak_forecast_qps(
+            self.provision_lead_s(), 'all', now)
+        return peak <= raw * self.spec.target_qps_per_replica
+
+
+class ForecastRequestRateAutoscaler(_ForecastMixin,
+                                    RequestRateAutoscaler):
+    """Forecast-aware QPS autoscaler (single capacity kind)."""
+
+
+class ForecastFallbackAutoscaler(_ForecastMixin,
+                                 FallbackRequestRateAutoscaler):
+    """Forecast-aware spot + on-demand mix: pre-scales ahead of ramps
+    AND keeps the fallback/backfill policy for preemptible capacity —
+    the spot-serving default (``forecast:`` + fallback knobs in the
+    ``replica_policy`` yaml)."""

@@ -61,6 +61,11 @@ class ServeController:
         return views
 
     def _autoscaler_step(self) -> None:
+        # Observed provision latencies (scale-up issued -> READY) feed
+        # the forecast autoscaler's pre-scaling lead time; the base
+        # autoscalers ignore them.
+        for obs in self.replica_manager.pop_provision_observations():
+            self.autoscaler.note_provision_seconds(obs)
         decisions = self.autoscaler.evaluate_scaling(self._replica_views())
         for d in decisions:
             if d.operator == autoscalers.DecisionOperator.SCALE_UP:
@@ -181,7 +186,11 @@ class ServeController:
                     return
                 if self.path == '/controller/load_balancer_sync':
                     ts = payload.get('request_timestamps', [])
-                    controller.autoscaler.collect_request_information(ts)
+                    # Optional parallel SLO-tier tags (the LB reads
+                    # X-SLO-Tier): the forecaster keeps per-tier
+                    # arrival series next to the 'all' series.
+                    controller.autoscaler.collect_request_information(
+                        ts, payload.get('request_tiers'))
                     self._json(200, {
                         'ready_replica_urls':
                             controller.replica_manager.ready_urls(),
@@ -231,6 +240,7 @@ class ServeController:
         return {
             'service_name': self.service_name,
             'target_num_replicas': self.autoscaler.target_num_replicas,
+            'autoscaler': type(self.autoscaler).__name__,
             'replica_parallelism': par,
             'replicas': [{
                 'replica_id': i.replica_id,

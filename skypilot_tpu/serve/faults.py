@@ -37,6 +37,13 @@ network or the hardware:
 - ``preempt_warning`` — the probe sweep, once per swept replica. Kind
   ``preempt_signal`` here is the *advance warning* flavor: the replica
   is drained instead of hard-killed.
+- ``spot_preemption`` — the probe sweep, once per swept SPOT replica
+  only (on-demand replicas never count an invocation, so ``at``/
+  ``every`` rules kill the Nth *spot* sweep deterministically — the
+  chaos suite's and the bench's seeded spot-kill schedule). Kind
+  ``preempt_signal`` routes through the full spot path: prefix-cache
+  checkpoint, graceful drain, teardown, and autoscaler replacement/
+  on-demand backfill.
 - ``proxy`` — ``load_balancer._proxy`` before dispatch. Kinds:
   ``slow_response`` (sleep ``delay_s``), ``partial_response`` (the
   upstream connection "breaks" before the request is sent — exercises
@@ -94,7 +101,8 @@ FAULT_KINDS = ('replica_crash', 'probe_timeout', 'slow_response',
 # Injection sites (for spec validation; the hook call sites are the
 # module docstring's list).
 FAULT_SITES = ('engine_step', 'probe', 'preempt', 'preempt_warning',
-               'proxy', 'proxy_stream', 'http_response', 'handoff')
+               'proxy', 'proxy_stream', 'http_response', 'handoff',
+               'spot_preemption')
 
 # Outcomes of skytpu_requests_migrated_total{outcome}: a migrated
 # request either completed on a surviving replica or exhausted every

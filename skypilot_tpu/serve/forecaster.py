@@ -1,0 +1,205 @@
+"""Short-horizon traffic forecaster for forecast-aware autoscaling.
+
+SageServe-style (PAPERS.md): reactive autoscaling pays the provisioning
+lead time *after* a ramp arrives — every burst eats the full replica
+boot latency as shed (429) or queue-blowout TTFT. A short-horizon
+forecast moves the scale-up decision *ahead* of the ramp by exactly
+that lead time, so capacity is READY when the traffic lands.
+
+Model — deliberately tiny, exact, and clock-injectable (no sklearn, no
+sleeps, GC115 bans wall-clock reads in here):
+
+- Arrivals are bucketed into a bounded ring of per-tier counts
+  (``bucket_s`` seconds per bucket, ``ring_buckets`` buckets retained).
+  The feed is the LB's request timestamps (optionally tier-tagged)
+  relayed through the controller sync — the same signal the reactive
+  QPS window uses, so the two autoscalers are comparable on identical
+  traces.
+- **Seasonal-naive** component: the rate observed one season ago at the
+  forecast target time (``season_s``; diurnal traffic repeats, so
+  yesterday-at-this-time — or ten-minutes-ago for short test seasons —
+  is a strong prior).
+- **EWMA level + trend** (Holt) component over the most recent
+  ``trend_buckets`` complete buckets: captures ramps the season has
+  never seen.
+- The forecast is the **max** of the two: scaling up early is cheap
+  (one replica-hour), scaling up late is an SLO breach — the asymmetry
+  makes the conservative-up combination the right default.
+
+Everything takes an explicit ``now`` (or the injected ``clock``), so
+tests replay synthetic diurnal/bursty traces deterministically —
+``graftcheck`` GC115 gates that no decision path in this module or
+``serve/autoscalers.py`` ever reads the wall clock directly.
+
+Telemetry (stable schema, registered at construction):
+``skytpu_forecast_qps{tier,horizon}`` for every tier in :data:`TIERS`
+and horizon in ``('now', 'lead')`` — zeros from the first scrape.
+"""
+from __future__ import annotations
+
+import collections
+import time
+from typing import Callable, Dict, Iterable, List, Optional, Sequence
+
+from skypilot_tpu import telemetry
+
+# Stable tier label set of skytpu_forecast_qps{tier,horizon}. Every
+# arrival counts into 'all'; arrivals with an explicit SLO tier also
+# count into their own series.
+TIERS = ('all', 'latency', 'throughput')
+HORIZONS = ('now', 'lead')
+
+
+def register_metrics() -> Dict[str, Dict[str, 'telemetry.Gauge']]:
+    """Register the forecast gauge family up front (zeros from the
+    first scrape — the stable-schema contract) and return the
+    ``{horizon: {tier: gauge}}`` table the forecaster writes."""
+    reg = telemetry.get_registry()
+    return {
+        horizon: {
+            tier: reg.gauge(
+                'skytpu_forecast_qps',
+                'Forecast arrival rate (requests/s) at the given '
+                'horizon ("now" = current level, "lead" = the learned '
+                'provisioning lead time ahead)',
+                tier=tier, horizon=horizon)
+            for tier in TIERS
+        } for horizon in HORIZONS
+    }
+
+
+class TrafficForecaster:
+    """Seasonal-naive + EWMA-trend forecaster over a bounded ring of
+    per-tier arrival counts.
+
+    Pure host-side state; not thread-safe by itself — the controller
+    tick (the single caller) serializes ``observe``/``forecast_qps``.
+    """
+
+    def __init__(self, *, bucket_s: float = 10.0,
+                 season_s: float = 600.0,
+                 horizon_s: float = 120.0,
+                 ring_buckets: int = 720,
+                 ewma_alpha: float = 0.4,
+                 trend_buckets: int = 6,
+                 clock: Callable[[], float] = time.time):
+        if bucket_s <= 0:
+            raise ValueError('bucket_s must be positive')
+        if season_s < bucket_s:
+            raise ValueError('season_s must cover at least one bucket')
+        self.bucket_s = float(bucket_s)
+        self.season_s = float(season_s)
+        self.horizon_s = float(horizon_s)
+        self.ring_buckets = int(ring_buckets)
+        self.ewma_alpha = float(ewma_alpha)
+        self.trend_buckets = int(trend_buckets)
+        self._clock = clock
+        # tier -> {bucket_index: count}; bounded to ring_buckets per
+        # tier (oldest evicted), so a long-lived controller holds a
+        # fixed-size signal no matter the traffic volume.
+        self._counts: Dict[str, 'collections.OrderedDict[int, int]'] = {
+            t: collections.OrderedDict() for t in TIERS}
+
+    # --------------------------------------------------------------- feed
+    def _bucket(self, ts: float) -> int:
+        return int(ts // self.bucket_s)
+
+    def observe(self, timestamps: Sequence[float],
+                tiers: Optional[Sequence[str]] = None) -> None:
+        """Fold a batch of arrival timestamps into the ring. ``tiers``
+        (parallel to ``timestamps``) tags arrivals with their SLO tier
+        when the LB knew it; unknown/missing tiers count into 'all'
+        only."""
+        for i, ts in enumerate(timestamps):
+            b = self._bucket(float(ts))
+            self._bump('all', b)
+            tier = tiers[i] if tiers is not None and i < len(tiers) \
+                else None
+            if tier in ('latency', 'throughput'):
+                self._bump(tier, b)
+
+    def _bump(self, tier: str, bucket: int) -> None:
+        ring = self._counts[tier]
+        ring[bucket] = ring.get(bucket, 0) + 1
+        while len(ring) > self.ring_buckets:
+            ring.popitem(last=False)
+
+    # ------------------------------------------------------------ queries
+    def _recent_rates(self, tier: str, now: float,
+                      n: int) -> List[float]:
+        """Rates (req/s) of the last ``n`` COMPLETE buckets, oldest
+        first (the in-progress bucket is excluded — its count is
+        partial and would bias the level down)."""
+        ring = self._counts[tier]
+        cur = self._bucket(now)
+        return [ring.get(cur - i, 0) / self.bucket_s
+                for i in range(n, 0, -1)]
+
+    def level_and_trend(self, tier: str = 'all',
+                        now: Optional[float] = None):
+        """Holt smoothing over the recent complete buckets: (level
+        req/s, trend req/s per bucket)."""
+        now = self._clock() if now is None else now
+        rates = self._recent_rates(tier, now, self.trend_buckets)
+        if not rates:
+            return 0.0, 0.0
+        level = rates[0]
+        trend = 0.0
+        a = self.ewma_alpha
+        for prev, rate in zip(rates, rates[1:]):
+            trend = a * (rate - prev) + (1 - a) * trend
+            level = a * rate + (1 - a) * (level + trend)
+        return level, trend
+
+    def qps(self, tier: str = 'all',
+            now: Optional[float] = None) -> float:
+        """Current smoothed arrival rate (req/s)."""
+        now = self._clock() if now is None else now
+        return max(0.0, self.level_and_trend(tier, now)[0])
+
+    def seasonal_qps(self, horizon_s: float, tier: str = 'all',
+                     now: Optional[float] = None) -> Optional[float]:
+        """The rate observed one season before ``now + horizon_s``
+        (None when that bucket predates the ring / was never seen
+        alongside any neighbor — no seasonal evidence yet)."""
+        now = self._clock() if now is None else now
+        ring = self._counts[tier]
+        if not ring:
+            return None
+        target = self._bucket(now + horizon_s - self.season_s)
+        oldest = next(iter(ring))
+        if target < oldest or target > self._bucket(now):
+            return None
+        # Average over a 3-bucket neighborhood: a single seasonal
+        # bucket is noisy at low rates.
+        vals = [ring.get(target + d, 0) for d in (-1, 0, 1)]
+        return sum(vals) / (3 * self.bucket_s)
+
+    def forecast_qps(self, horizon_s: float, tier: str = 'all',
+                     now: Optional[float] = None) -> float:
+        """Arrival-rate forecast ``horizon_s`` seconds ahead: the max
+        of the seasonal-naive rate and the Holt level+trend projection
+        (conservative-up — see module docstring)."""
+        now = self._clock() if now is None else now
+        level, trend = self.level_and_trend(tier, now)
+        projected = level + trend * (horizon_s / self.bucket_s)
+        seasonal = self.seasonal_qps(horizon_s, tier, now)
+        out = max(0.0, projected)
+        if seasonal is not None:
+            out = max(out, seasonal)
+        return out
+
+    def peak_forecast_qps(self, horizon_s: float, tier: str = 'all',
+                          now: Optional[float] = None,
+                          points: int = 4) -> float:
+        """Max forecast over ``[now, now + horizon_s]`` sampled at
+        ``points`` evenly spaced horizons — the scale-DOWN guard: a
+        replica is only released when no point inside the provisioning
+        lead window forecasts needing it back (never drain mid-burst,
+        since an undone drain pays the full relaunch latency)."""
+        now = self._clock() if now is None else now
+        if points < 2 or horizon_s <= 0:
+            return self.forecast_qps(max(0.0, horizon_s), tier, now)
+        return max(self.forecast_qps(horizon_s * i / (points - 1),
+                                     tier, now)
+                   for i in range(points))

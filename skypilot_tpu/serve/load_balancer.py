@@ -105,6 +105,10 @@ class SkyServeLoadBalancer:
         self.tls_keyfile = tls_keyfile
         self.max_attempts = max_attempts
         self._request_timestamps: List[float] = []
+        # Parallel SLO-tier tags ('' = unknown): the controller-side
+        # forecaster keeps per-tier arrival series so forecast-aware
+        # scaling can see tier mix shifts, not just totals.
+        self._request_tiers: List[str] = []
         self._ts_lock = threading.Lock()
         self._stop = threading.Event()
         self._httpd: Optional[http.server.ThreadingHTTPServer] = None
@@ -157,7 +161,9 @@ class SkyServeLoadBalancer:
         with self._ts_lock:
             timestamps, self._request_timestamps = \
                 self._request_timestamps, []
-        body = json.dumps({'request_timestamps': timestamps}).encode()
+            tiers, self._request_tiers = self._request_tiers, []
+        body = json.dumps({'request_timestamps': timestamps,
+                           'request_tiers': tiers}).encode()
         req = urllib.request.Request(
             self.controller_url + '/controller/load_balancer_sync',
             data=body, headers={'Content-Type': 'application/json'})
@@ -182,10 +188,13 @@ class SkyServeLoadBalancer:
             # but only those still inside the autoscaler's QPS window, or
             # memory grows unboundedly across a long controller outage.
             cutoff = time.time() - 60.0
+            keep = [(t, tr) for t, tr in zip(timestamps, tiers)
+                    if t >= cutoff]
             with self._ts_lock:
                 self._request_timestamps = (
-                    [t for t in timestamps if t >= cutoff]
-                    + self._request_timestamps)
+                    [t for t, _ in keep] + self._request_timestamps)
+                self._request_tiers = (
+                    [tr for _, tr in keep] + self._request_tiers)
             self._m_sync_failures.inc()
             logger.warning(f'LB sync with controller failed: '
                            f'{type(e).__name__}: {e}')
@@ -502,6 +511,8 @@ class SkyServeLoadBalancer:
                 lb._m_requests.inc()
                 with lb._ts_lock:
                     lb._request_timestamps.append(time.time())
+                    lb._request_tiers.append(
+                        self.headers.get('X-SLO-Tier') or '')
                 length = int(self.headers.get('Content-Length', 0))
                 data = self.rfile.read(length) if length else None
                 headers = {k: v for k, v in self.headers.items()

@@ -63,6 +63,20 @@ def _drain_deadline_default() -> float:
     return float(os.environ.get('SKYTPU_SERVE_DRAIN_S', '30'))
 
 
+def _warmup_timeout() -> float:
+    """Bound on the prefix-cache warmup POST against a freshly READY
+    replica (a wedged warmup must not keep capacity out of rotation —
+    past it the replica enters rotation cold)."""
+    return float(os.environ.get('SKYTPU_SERVE_WARMUP_TIMEOUT', '30'))
+
+
+def _ckpt_ttl() -> float:
+    """Checkpoint staleness bound: prefix KV older than this is not
+    worth shipping to a recovered replica (the traffic that made those
+    prefixes hot has moved on)."""
+    return float(os.environ.get('SKYTPU_SERVE_CKPT_TTL', '3600'))
+
+
 def _probe_counter(outcome: str) -> 'telemetry.Counter':
     """Probe-outcome counters in the shared process registry (the
     controller's /metrics surface via the dashboard)."""
@@ -96,6 +110,16 @@ class ReplicaInfo:
         self.url: Optional[str] = None
         self.consecutive_failures = 0
         self.first_probe_time: Optional[float] = None
+        # Spot resilience bookkeeping: when the scale-up was issued
+        # (provision-latency observation — the forecast autoscaler's
+        # pre-scaling lead time learns from these), whether this
+        # replica's prefix cache was already checkpointed on a
+        # preemption warning (idempotence under a racing drain), and
+        # whether its replacement warmup already ran (once per
+        # replica, BEFORE it first enters ready_urls).
+        self.created_time = time.time()
+        self.checkpointed = False
+        self.warmed = False
 
 
 class ReplicaManager:
@@ -131,8 +155,38 @@ class ReplicaManager:
         # once from SKYTPU_FAULT_SPEC; None = hooks are one attribute
         # check. Sites here: 'probe' (probe_timeout), 'preempt'
         # (preempt_signal — hard kill), 'preempt_warning'
-        # (preempt_signal with advance notice — routes through drain).
+        # (preempt_signal with advance notice — routes through drain),
+        # 'spot_preemption' (counted per swept SPOT replica only —
+        # seeded spot-kill schedules for chaos tests and the bench).
         self._faults = faults_lib.get_injector()
+        # Spot resilience: the latest prefix-cache checkpoint exported
+        # by a preemption-warned replica (bytes + export wall time;
+        # latest wins, TTL-bounded), landed into replacement replicas
+        # via /kv/warmup BEFORE they enter ready_urls. _ckpt_lock
+        # serializes the store against concurrent warnings; the HTTP
+        # fetch itself runs outside every lock.
+        self._ckpt_lock = threading.Lock()
+        self._ckpt_bytes: Optional[bytes] = None
+        self._ckpt_time: float = 0.0
+        # Provision-latency observations (scale-up issued -> READY)
+        # not yet consumed by the controller; the forecast autoscaler
+        # learns its pre-scaling lead time from them.
+        self._provision_obs: List[float] = []
+        reg = telemetry.get_registry()
+        self._m_spot_preempt = reg.counter(
+            'skytpu_spot_preemptions_total',
+            'Spot replica preemptions observed (advance warnings and '
+            'hard cluster losses)')
+        self._h_warmup = reg.histogram(
+            'skytpu_prefix_warmup_seconds',
+            'Prefix-cache warmup of a recovered replica: checkpoint '
+            'POST to landed (s)',
+            buckets=telemetry.registry.DEFAULT_SECONDS_BUCKETS)
+        self._h_provision = reg.histogram(
+            'skytpu_replica_provision_seconds',
+            'Replica provision latency: scale-up issued to first '
+            'READY (s)',
+            buckets=telemetry.registry.DEFAULT_SECONDS_BUCKETS)
 
     # ------------------------------------------------------------- update
     def update_version(self, spec: 'SkyServiceSpec', task_config: dict,
@@ -448,12 +502,108 @@ class ReplicaManager:
             self, replica_id: int,
             deadline_s: Optional[float] = None) -> bool:
         """Advance preemption notice (cloud spot warning / injected
-        ``preempt_signal`` at the ``preempt_warning`` site): route
-        through graceful drain so in-flight work finishes (or migrates)
-        before the capacity disappears."""
+        ``preempt_signal`` at the ``preempt_warning`` /
+        ``spot_preemption`` sites): checkpoint the replica's hot
+        prefix-cache chains FIRST (the KV is gone once the capacity
+        is), then route through graceful drain so in-flight work
+        finishes (or migrates) before the capacity disappears.
+
+        Race-free with an in-flight drain: the checkpoint step is
+        guarded by a per-replica flag taken under the manager lock, so
+        a warning that lands while a drain (from a scale-down or an
+        earlier warning) is already running still checkpoints exactly
+        once and never double-drains."""
         logger.info(f'Preemption warning for replica {replica_id}; '
-                    'draining ahead of it.')
+                    'checkpointing and draining ahead of it.')
+        with self._lock:
+            info = self._replicas.get(replica_id)
+            if info is not None and info.is_spot:
+                self._m_spot_preempt.inc()
+        if info is not None:
+            self._checkpoint_replica(info)
         return self.drain(replica_id, deadline_s)
+
+    def _checkpoint_replica(self, info: ReplicaInfo) -> None:
+        """Fetch the replica's prefix-cache checkpoint (``POST
+        /checkpoint`` — the response body is the SKCK container) and
+        store it for replacement warmup. At most once per replica
+        (flag under the lock); best-effort — a failure clears the flag
+        so a later warning may retry, and the drain proceeds either
+        way."""
+        with self._lock:
+            if info.checkpointed or info.url is None:
+                return
+            info.checkpointed = True
+        try:
+            req = urllib.request.Request(
+                info.url + '/checkpoint', data=json.dumps({}).encode(),
+                headers={'Content-Type': 'application/json'})
+            with urllib.request.urlopen(req, timeout=30) as resp:
+                blob = resp.read()
+        except Exception as e:  # pylint: disable=broad-except
+            logger.warning(f'Checkpoint of replica {info.replica_id} '
+                           f'failed ({type(e).__name__}: {e}); its '
+                           'replacement will boot cold')
+            with self._lock:
+                info.checkpointed = False
+            return
+        with self._ckpt_lock:
+            self._ckpt_bytes = blob
+            self._ckpt_time = time.time()
+        logger.info(f'Checkpointed replica {info.replica_id}: '
+                    f'{len(blob)} byte(s) of prefix-cache state.')
+
+    def checkpoint_for_warmup(self) -> Optional[bytes]:
+        """The freshest stored checkpoint, or None (none taken yet, or
+        stale past the TTL — cold traffic has moved on)."""
+        with self._ckpt_lock:
+            if self._ckpt_bytes is None:
+                return None
+            if time.time() - self._ckpt_time > _ckpt_ttl():
+                return None
+            return self._ckpt_bytes
+
+    def _warm_replica(self, info: ReplicaInfo) -> None:
+        """Land the stored checkpoint into a replica that just passed
+        its first probe — BEFORE it is marked READY, so by the time
+        the LB routes to it the prefix cache already holds the
+        preempted replica's hot chains (near-warm recovery TTFT). At
+        most once per replica; best-effort with a bounded timeout —
+        a failed warmup costs only cold-cache latency."""
+        if info.warmed:
+            return
+        info.warmed = True
+        blob = self.checkpoint_for_warmup()
+        if blob is None or info.url is None:
+            return
+        t0 = time.monotonic()
+        try:
+            req = urllib.request.Request(
+                info.url + '/kv/warmup', data=blob,
+                headers={'Content-Type': 'application/octet-stream'})
+            with urllib.request.urlopen(
+                    req, timeout=_warmup_timeout()) as resp:
+                payload = json.loads(resp.read())
+        except Exception as e:  # pylint: disable=broad-except
+            logger.warning(f'Prefix warmup of replica '
+                           f'{info.replica_id} failed '
+                           f'({type(e).__name__}: {e}); entering '
+                           'rotation cold')
+            return
+        dur = time.monotonic() - t0
+        self._h_warmup.observe(dur)
+        logger.info(
+            f'Replica {info.replica_id} prefix-warmed in {dur:.2f}s: '
+            f'{payload.get("warmed_rows", 0)} row(s) across '
+            f'{payload.get("entries", 0)} entr(ies).')
+
+    def pop_provision_observations(self) -> List[float]:
+        """Drain the unconsumed provision-latency observations (the
+        controller feeds them to the forecast autoscaler's lead-time
+        EWMA each tick)."""
+        with self._lock:
+            obs, self._provision_obs = self._provision_obs, []
+        return obs
 
     # ------------------------------------------------------------ teardown
     def scale_down(self, replica_id: int, status: Optional[
@@ -573,11 +723,27 @@ class ReplicaManager:
                 if rule is not None and rule.kind == 'preempt_signal':
                     self.handle_preemption_warning(info.replica_id)
                     continue
+                # Spot-targeted kill schedule: the site counter only
+                # advances for SPOT replicas, so an `at`/`every` rule
+                # deterministically names the Nth spot sweep — the
+                # chaos/bench seeded spot-preemption path (checkpoint
+                # + drain + teardown + backfill).
+                if info.is_spot:
+                    rule = self._faults.fire('spot_preemption')
+                    if rule is not None and \
+                            rule.kind == 'preempt_signal':
+                        self.handle_preemption_warning(info.replica_id)
+                        continue
             # Cluster existence is ground truth, checked BEFORE the HTTP
             # probe: a terminated replica's address can keep answering (IP
             # reuse on clouds; surviving process on the local provider).
             if self._check_preempted(info):
                 logger.info(f'Replica {info.replica_id} preempted.')
+                if info.is_spot:
+                    # Hard loss (no advance warning): counted the same
+                    # as a warned preemption; nothing to checkpoint —
+                    # the capacity is already gone.
+                    self._m_spot_preempt.inc()
                 info.status = serve_state.ReplicaStatus.PREEMPTED
                 _transition_counter('PREEMPTED').inc()
                 self._persist(info)
@@ -588,12 +754,22 @@ class ReplicaManager:
                 _probe_counter('success').inc()
                 info.consecutive_failures = 0
                 if info.status != serve_state.ReplicaStatus.READY:
+                    # First successful probe: prefix-warm from the
+                    # latest preemption checkpoint BEFORE the replica
+                    # is marked READY — it must never enter ready_urls
+                    # (and thus LB rotation) cold when warm state
+                    # exists.
+                    self._warm_replica(info)
                     logger.info(f'Replica {info.replica_id} is READY at '
                                 f'{info.url}.')
                     _transition_counter('READY').inc()
+                    self._h_provision.observe(
+                        max(0.0, time.time() - info.created_time))
                     with self._lock:     # a replica serves: reset backoff
                         self._launch_failures = 0
                         self._backoff_until = 0.0
+                        self._provision_obs.append(
+                            max(0.0, time.time() - info.created_time))
                 info.status = serve_state.ReplicaStatus.READY
                 self._persist(info)
                 continue

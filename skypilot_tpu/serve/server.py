@@ -90,7 +90,8 @@ class ModelServer:
                  drain_deadline_s: float = 30.0,
                  fault_spec: Optional[Any] = None,
                  role: Optional[str] = None,
-                 handoff_targets: Optional[List[str]] = None):
+                 handoff_targets: Optional[List[str]] = None,
+                 checkpoint_path: Optional[str] = None):
         self.cfg_name = cfg_name
         self.model_path = model_path  # HF checkpoint dir (real weights)
         self.quantize = quantize      # 'int8' => int8 weights
@@ -205,6 +206,31 @@ class ModelServer:
         self.role = disagg_lib.resolve_role(role)
         self.handoff_targets = disagg_lib.static_targets(handoff_targets)
         disagg_lib.register_metrics(self.role)
+        # Spot resilience: prefix-cache checkpoint/warmup. On a
+        # preemption warning the controller POSTs /checkpoint (the
+        # response is the SKCK container of hot prefix chains +
+        # in-flight request snapshots) and lands it into the
+        # replacement via /kv/warmup BEFORE it enters rotation. With a
+        # local checkpoint_path (flag > SKYTPU_KV_CHECKPOINT_PATH
+        # env), the server additionally persists a checkpoint when a
+        # drain begins and warms itself from the file at boot — the
+        # standalone / bench restart path. The warmup histogram is
+        # registered at construction (stable schema); this process
+        # observes it only for boot-from-file warmups — HTTP warmups
+        # are observed end-to-end by the controller-side manager.
+        self.checkpoint_path = (checkpoint_path
+                                or os.environ.get(
+                                    'SKYTPU_KV_CHECKPOINT_PATH')
+                                or None)
+        self._h_warmup = reg.histogram(
+            'skytpu_prefix_warmup_seconds',
+            'Prefix-cache warmup of a recovered replica: checkpoint '
+            'POST to landed (s)',
+            buckets=telemetry.registry.DEFAULT_SECONDS_BUCKETS)
+        reg.counter(
+            'skytpu_spot_preemptions_total',
+            'Spot replica preemptions observed (advance warnings and '
+            'hard cluster losses)')
         self._m_handoff = {o: disagg_lib.handoff_counter(o)
                            for o in disagg_lib.HANDOFF_OUTCOMES}
         self._m_kv_bytes = {d: disagg_lib.transfer_bytes_counter(d)
@@ -258,6 +284,24 @@ class ModelServer:
         engine.run_to_completion(horizon=4)
         self.engine = engine
         self.sched.bind_engine(engine)
+        # Prefix-cache warm boot: land a local checkpoint file (written
+        # by a prior drain/preemption) BEFORE readiness — the replica
+        # never serves cold when warm state exists on disk.
+        if self.checkpoint_path and os.path.exists(self.checkpoint_path):
+            t0 = time.monotonic()
+            try:
+                with open(self.checkpoint_path, 'rb') as f:
+                    res = self.warm_from_checkpoint(f.read())
+                self._h_warmup.observe(time.monotonic() - t0)
+                logger.info(
+                    f'Warm boot from {self.checkpoint_path}: '
+                    f'{res["warmed_rows"]} row(s) across '
+                    f'{res["entries"]} entr(ies) in '
+                    f'{time.monotonic() - t0:.2f}s')
+            except Exception as e:  # pylint: disable=broad-except
+                logger.warning(
+                    f'Warm boot from {self.checkpoint_path} failed '
+                    f'({type(e).__name__}: {e}); serving cold')
         self._ready.set()
         logger.info(f'Engine ready: model={self.cfg_name} '
                     f'max_batch={self.max_batch} max_seq={self.max_seq}')
@@ -607,6 +651,90 @@ class ModelServer:
             'handoff': True,
         }
 
+    # --------------------------------------------------- spot checkpoint
+    def export_checkpoint(self, max_entries: int = 8):
+        """The replica's resilience checkpoint as ``(bytes, n_entries)``:
+        the hottest prefix-cache page chains (SKPF) plus snapshots of
+        every in-flight decoding request (SKKV), in one SKCK container.
+        Request entries are landed as prefix WARMTH by the receiver,
+        never re-executed — the LB's in-flight recovery owns
+        re-execution, so a checkpointed request that also migrates is
+        warm on arrival instead of double-run. Safe on a cold/loading
+        engine (empty container)."""
+        entries: List[Dict[str, Any]] = []
+        events: List[Any] = []
+        eng = self.engine
+        if eng is not None:
+            with self._lock:
+                for rid in eng.decoding_request_ids():
+                    if len(entries) >= max_entries:
+                        break
+                    snap, ev = eng.export_kv_snapshot(rid)
+                    events.extend(ev)
+                    if snap is not None:
+                        entries.append(snap)
+                pentries, ev = eng.export_prefix_snapshots(
+                    max_entries=max_entries)
+                events.extend(ev)
+                entries.extend(pentries)
+            if events:
+                # Tokens drained from the async pipeline during the
+                # export belong to their outboxes exactly like step()
+                # events.
+                self.sched.on_events(eng, events)
+        blob = kv_transfer.encode_checkpoint(entries)
+        self._m_kv_bytes['export'].inc(len(blob))
+        return blob, len(entries)
+
+    def warm_from_checkpoint(self, blob: bytes) -> Dict[str, Any]:
+        """Land a checkpoint container into the engine's prefix cache:
+        every entry (request snapshots included) lands as prefix
+        warmth via ``warm_prefix`` — byte-exact KV, content-addressed,
+        no request is seated or re-executed. Best-effort under pool
+        pressure: landing stops at the first capacity refusal (the
+        hottest entries land first). Raises ``ValueError`` on a
+        malformed container and ``RuntimeError`` when no engine is
+        loaded."""
+        entries = kv_transfer.decode_checkpoint(blob)
+        warmed_rows = 0
+        landed = 0
+        skipped_capacity = 0
+        with self._lock:
+            if self.engine is None:
+                raise RuntimeError('engine not loaded')
+            for entry in entries:
+                try:
+                    rows = self.engine.warm_prefix(entry)
+                except kv_transfer.HandoffCapacityError:
+                    skipped_capacity = len(entries) - landed
+                    break
+                if rows:
+                    landed += 1
+                warmed_rows += rows
+        self._m_kv_bytes['ingest'].inc(len(blob))
+        return {'entries': len(entries), 'landed': landed,
+                'warmed_rows': warmed_rows,
+                'skipped_capacity': skipped_capacity,
+                'kv_cache': self.kv_cache}
+
+    def _persist_checkpoint(self) -> None:
+        """Write the resilience checkpoint to ``checkpoint_path``
+        (atomic rename) — the warm-boot source for a restarted
+        standalone replica."""
+        assert self.checkpoint_path is not None
+        try:
+            blob, n = self.export_checkpoint()
+            tmp = self.checkpoint_path + '.tmp'
+            with open(tmp, 'wb') as f:
+                f.write(blob)
+            os.replace(tmp, self.checkpoint_path)
+            logger.info(f'Checkpointed {n} entr(ies) '
+                        f'({len(blob)} bytes) to '
+                        f'{self.checkpoint_path}')
+        except Exception as e:  # pylint: disable=broad-except
+            logger.warning(f'Checkpoint persist failed '
+                           f'({type(e).__name__}: {e})')
+
     # -------------------------------------------------------------- drain
     def begin_drain(self, deadline_s: Optional[float] = None
                     ) -> Dict[str, Any]:
@@ -624,6 +752,13 @@ class ModelServer:
                     self.drain_deadline_s)
                 self.sched.begin_drain()
                 self._work.set()      # wake the loop to run the tail
+                if self.checkpoint_path:
+                    # Persist the prefix-cache checkpoint alongside
+                    # the drain (off-thread: the drain response must
+                    # not wait on the KV gather) — the warm-boot
+                    # source for a restarted replica.
+                    threading.Thread(target=self._persist_checkpoint,
+                                     daemon=True).start()
                 threading.Thread(target=self._drain_monitor,
                                  daemon=True).start()
                 logger.info(
@@ -1450,10 +1585,66 @@ class ModelServer:
                         server.sched.cancel(sr)
                     self.close_connection = True
 
+            def _checkpoint(self) -> None:
+                """Export the spot-resilience checkpoint. The response
+                body IS the SKCK container (octet-stream) — or, with a
+                ``path`` in the JSON body, the container is written to
+                that file and a JSON summary returned (the standalone
+                / shared-filesystem flavor)."""
+                length = int(self.headers.get('Content-Length', 0))
+                try:
+                    payload = (json.loads(self.rfile.read(length))
+                               if length else {})
+                except json.JSONDecodeError:
+                    self._json(400, {'error': 'bad json'})
+                    return
+                try:
+                    blob, n = server.export_checkpoint(
+                        int(payload.get('max_entries', 8)))
+                except Exception as e:  # pylint: disable=broad-except
+                    self._json(500, {'error': {'message':
+                                               f'{type(e).__name__}: '
+                                               f'{e}'}})
+                    return
+                path = payload.get('path')
+                if path:
+                    tmp = path + '.tmp'
+                    with open(tmp, 'wb') as f:
+                        f.write(blob)
+                    os.replace(tmp, path)
+                    self._json(200, {'entries': n, 'bytes': len(blob),
+                                     'path': path})
+                    return
+                self.send_response(200)
+                self.send_header('Content-Type',
+                                 'application/octet-stream')
+                self.send_header('X-Checkpoint-Entries', str(n))
+                self.send_header('Content-Length', str(len(blob)))
+                self.end_headers()
+                self.wfile.write(blob)
+
+            def _kv_warmup(self) -> None:
+                """Land a checkpoint container into this replica's
+                prefix cache (the recovery-warmup half of
+                /checkpoint). 400 on a malformed container; partial
+                landings under pool pressure are reported, not
+                errors."""
+                length = int(self.headers.get('Content-Length', 0))
+                data = self.rfile.read(length) if length else b''
+                try:
+                    self._json(200, server.warm_from_checkpoint(data))
+                except ValueError as e:
+                    self._json(400, {'error': {
+                        'message': str(e),
+                        'type': 'invalid_checkpoint'}})
+                except RuntimeError as e:
+                    self._json(503, {'error': {'message': str(e)}},
+                               extra_headers={'Retry-After': '5'})
+
             def do_POST(self):  # noqa: N802
                 routes = ('/generate', '/v1/completions',
                           '/v1/chat/completions', '/drain',
-                          '/kv/ingest')
+                          '/kv/ingest', '/checkpoint', '/kv/warmup')
                 if self.path not in routes:
                     self._json(404, {'error': f'no route {self.path}'})
                     return
@@ -1474,6 +1665,12 @@ class ModelServer:
                     return
                 if self.path == '/kv/ingest':
                     self._kv_ingest()
+                    return
+                if self.path == '/checkpoint':
+                    self._checkpoint()
+                    return
+                if self.path == '/kv/warmup':
+                    self._kv_warmup()
                     return
                 if self.path != '/generate':
                     length = int(self.headers.get('Content-Length', 0))
@@ -1678,6 +1875,16 @@ def main() -> None:
                              'Default: SKYTPU_ROLE env (the '
                              'controller\'s disaggregation plan), '
                              'else colocated')
+    parser.add_argument('--checkpoint-path', default=None,
+                        help='local prefix-cache checkpoint file '
+                             '(default: SKYTPU_KV_CHECKPOINT_PATH '
+                             'env). When set: a drain/preemption '
+                             'warning persists the hottest prefix '
+                             'chains + in-flight KV snapshots here, '
+                             'and a (re)booting server warms its '
+                             'prefix cache from the file BEFORE '
+                             'declaring readiness — near-warm TTFT '
+                             'after spot recovery instead of cold')
     parser.add_argument('--handoff-targets', default=None,
                         help='comma-separated decode-worker base URLs '
                              'a prefill replica may hand off to when '
@@ -1712,7 +1919,8 @@ def main() -> None:
                          role=args.role,
                          handoff_targets=(args.handoff_targets.split(',')
                                           if args.handoff_targets
-                                          else None))
+                                          else None),
+                         checkpoint_path=args.checkpoint_path)
     server.start(block=True)
 
 

@@ -57,6 +57,16 @@ class SkyServiceSpec:
     # order: prefill pool first, then decode, then colocated).
     disagg_prefill_replicas: int = 0
     disagg_decode_replicas: int = 0
+    # Forecast-aware autoscaling (``forecast:`` under ``replica_policy``,
+    # serve/forecaster.py): pre-scale ahead of traffic ramps by the
+    # learned provisioning lead time instead of reacting after the ramp
+    # lands. The knobs are the forecaster's bucket width, season length
+    # (diurnal period — or minutes for tests/benches), and the default
+    # look-ahead horizon.
+    forecast_enabled: bool = False
+    forecast_bucket_seconds: float = 10.0
+    forecast_season_seconds: float = 600.0
+    forecast_horizon_seconds: float = 120.0
 
     @property
     def disagg_enabled(self) -> bool:
@@ -72,10 +82,21 @@ class SkyServiceSpec:
             raise exceptions.InvalidServiceSpecError(
                 f'max_replicas ({self.max_replicas}) < min_replicas '
                 f'({self.min_replicas})')
-        if self.autoscaling_enabled and self.target_qps_per_replica is None:
+        if self.max_replicas is not None and \
+                self.max_replicas > self.min_replicas and \
+                self.target_qps_per_replica is None:
             raise exceptions.InvalidServiceSpecError(
                 'replica_policy with max_replicas > min_replicas requires '
                 'target_qps_per_replica')
+        if self.forecast_enabled and not self.autoscaling_enabled:
+            raise exceptions.InvalidServiceSpecError(
+                'forecast requires autoscaling (target_qps_per_replica '
+                'with max_replicas > min_replicas, or no max_replicas '
+                'at all = unbounded)')
+        if self.forecast_enabled and \
+                self.forecast_bucket_seconds <= 0:
+            raise exceptions.InvalidServiceSpecError(
+                'forecast bucket_seconds must be positive')
         if self.target_qps_per_replica is not None and \
                 self.target_qps_per_replica <= 0:
             raise exceptions.InvalidServiceSpecError(
@@ -93,8 +114,12 @@ class SkyServiceSpec:
 
     @property
     def autoscaling_enabled(self) -> bool:
-        return (self.max_replicas is not None
-                and self.max_replicas > self.min_replicas)
+        # max_replicas is None with a QPS target = UNBOUNDED
+        # autoscaling (the autoscaler clamps only from below); a policy
+        # without a QPS target stays fixed at min_replicas.
+        return (self.target_qps_per_replica is not None
+                and (self.max_replicas is None
+                     or self.max_replicas > self.min_replicas))
 
     @classmethod
     def from_yaml_config(cls, config: Dict[str, Any]) -> 'SkyServiceSpec':
@@ -153,6 +178,18 @@ class SkyServiceSpec:
                 dynamic_ondemand_fallback=bool(
                     policy.get('dynamic_ondemand_fallback', False)),
             )
+            forecast = policy.get('forecast')
+            if forecast:
+                if forecast is True:
+                    forecast = {}
+                fields.update(
+                    forecast_enabled=True,
+                    forecast_bucket_seconds=float(
+                        forecast.get('bucket_seconds', 10.0)),
+                    forecast_season_seconds=float(
+                        forecast.get('season_seconds', 600.0)),
+                    forecast_horizon_seconds=float(
+                        forecast.get('horizon_seconds', 120.0)))
         else:
             fields['min_replicas'] = int(config.get('replicas', 1))
         return cls(**fields)
@@ -179,11 +216,8 @@ class SkyServiceSpec:
                 'decode_replicas': self.disagg_decode_replicas,
             }
         if self.autoscaling_enabled or self.target_qps_per_replica:
-            cfg['replica_policy'] = {
+            policy: Dict[str, Any] = {
                 'min_replicas': self.min_replicas,
-                'max_replicas': (self.max_replicas
-                                 if self.max_replicas is not None
-                                 else self.min_replicas),
                 'target_qps_per_replica': self.target_qps_per_replica,
                 'upscale_delay_seconds': self.upscale_delay_seconds,
                 'downscale_delay_seconds': self.downscale_delay_seconds,
@@ -191,6 +225,18 @@ class SkyServiceSpec:
                     self.base_ondemand_fallback_replicas,
                 'dynamic_ondemand_fallback': self.dynamic_ondemand_fallback,
             }
+            # None = unbounded: the key is simply omitted (writing
+            # min_replicas here used to silently freeze an unbounded
+            # policy at its floor on round-trip).
+            if self.max_replicas is not None:
+                policy['max_replicas'] = self.max_replicas
+            if self.forecast_enabled:
+                policy['forecast'] = {
+                    'bucket_seconds': self.forecast_bucket_seconds,
+                    'season_seconds': self.forecast_season_seconds,
+                    'horizon_seconds': self.forecast_horizon_seconds,
+                }
+            cfg['replica_policy'] = policy
         else:
             cfg['replicas'] = self.min_replicas
         return cfg

@@ -102,6 +102,22 @@ SERVICE_SCHEMA: Dict[str, Any] = {
                 'downscale_delay_seconds': {'type': 'number'},
                 'base_ondemand_fallback_replicas': {'type': 'integer'},
                 'dynamic_ondemand_fallback': {'type': 'boolean'},
+                # Forecast-aware autoscaling (serve/forecaster.py):
+                # pre-scale ahead of ramps by the learned provisioning
+                # lead time. `forecast: true` takes the defaults; the
+                # object form tunes the forecaster.
+                'forecast': {
+                    'anyOf': [
+                        {'type': 'boolean'},
+                        {'type': 'object',
+                         'additionalProperties': False,
+                         'properties': {
+                             'bucket_seconds': {'type': 'number'},
+                             'season_seconds': {'type': 'number'},
+                             'horizon_seconds': {'type': 'number'},
+                         }},
+                    ]
+                },
             },
         },
         'replicas': {'type': 'integer', 'minimum': 0},

@@ -623,6 +623,65 @@ def test_gc114_whole_repo_clean():
     assert [v for v in new if v.rule == 'GC114'] == []
 
 
+# ------------------------------------------------------------------ GC115
+def test_gc115_wallclock_call_in_autoscaler_flagged():
+    src = '''
+    import time
+    def current_qps(self, now=None):
+        now = time.time() if now is None else now
+        return now
+    def evaluate(self):
+        t = time.monotonic()
+        return t
+    '''
+    ids = rule_ids(src, 'skypilot_tpu/serve/autoscalers.py')
+    assert ids == ['GC115', 'GC115']
+    assert rule_ids(src, 'skypilot_tpu/serve/forecaster.py') == [
+        'GC115', 'GC115']
+
+
+def test_gc115_bare_monotonic_import_flagged():
+    src = '''
+    from time import monotonic
+    def decide(self):
+        return monotonic()
+    '''
+    assert rule_ids(src, 'skypilot_tpu/serve/forecaster.py') == ['GC115']
+
+
+def test_gc115_injected_clock_default_arg_clean():
+    # The injection mechanism itself: referencing time.time (no call)
+    # as the default clock, and calling the injected clock.
+    src = '''
+    import time
+    class Autoscaler:
+        def __init__(self, spec, clock=time.time):
+            self._clock = clock
+        def evaluate(self, now=None):
+            return self._clock() if now is None else now
+    '''
+    assert rule_ids(src, 'skypilot_tpu/serve/autoscalers.py') == []
+
+
+def test_gc115_only_polices_scaling_paths():
+    # The same calls are legal elsewhere in serve/ (servers measure
+    # real wall time; only scaling DECISIONS must be replayable).
+    src = '''
+    import time
+    def handler(self):
+        return time.time()
+    '''
+    assert rule_ids(src, 'skypilot_tpu/serve/server.py') == []
+    assert rule_ids(src, 'skypilot_tpu/serve/replica_managers.py') == []
+
+
+def test_gc115_whole_repo_clean():
+    # The shipped autoscalers/forecaster are fully clock-injected.
+    from skypilot_tpu.analysis import lint
+    new, _ = lint.lint_paths(None, baseline=lint.load_baseline(None))
+    assert [v for v in new if v.rule == 'GC115'] == []
+
+
 # ------------------------------------------------------------------ GC201
 def test_gc201_impure_calls_inside_jit():
     src = '''

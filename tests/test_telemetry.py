@@ -16,6 +16,52 @@ from skypilot_tpu.telemetry import tracing
 jax.config.update('jax_platforms', 'cpu')
 
 
+def test_spot_scaling_series_registered_at_construction(
+        tmp_path, monkeypatch):
+    """Round-10 controller-side stable schema: constructing the
+    forecast autoscaler and the replica manager registers every
+    forecast/target/provision series — zeros from the first scrape,
+    before any traffic, preemption or provision ever happened."""
+    monkeypatch.setenv('SKYTPU_SERVE_DIR', str(tmp_path / 'serve'))
+    from skypilot_tpu import telemetry
+    from skypilot_tpu.serve import autoscalers as asc_lib
+    from skypilot_tpu.serve import forecaster as forecaster_lib
+    from skypilot_tpu.serve.replica_managers import ReplicaManager
+    from skypilot_tpu.serve.service_spec import SkyServiceSpec
+    # Fresh process registry: the zeros-from-first-scrape claim is
+    # about CONSTRUCTION, so earlier tests' legitimate traffic on the
+    # shared registry must not bleed in (get-or-create makes the swap
+    # safe — later servers/engines re-create their handles).
+    registry_lib.reset_registry()
+    try:
+        spec = SkyServiceSpec(
+            readiness_path='/readiness', min_replicas=1, max_replicas=4,
+            target_qps_per_replica=1.0, forecast_enabled=True,
+            dynamic_ondemand_fallback=True)
+        asc = asc_lib.Autoscaler.from_spec(spec)
+        assert isinstance(asc, asc_lib.ForecastFallbackAutoscaler)
+        ReplicaManager('spot-schema-test', spec, {})
+        prom = telemetry.get_registry().render_prometheus()
+    finally:
+        registry_lib.reset_registry()
+    assert '# TYPE skytpu_forecast_qps gauge' in prom
+    for tier in forecaster_lib.TIERS:
+        for horizon in forecaster_lib.HORIZONS:
+            assert ('skytpu_forecast_qps{horizon="%s",tier="%s"} 0'
+                    % (horizon, tier)) in prom, (tier, horizon)
+    assert '# TYPE skytpu_autoscaler_target_replicas gauge' in prom
+    for kind in asc_lib.TARGET_KINDS:
+        assert (f'skytpu_autoscaler_target_replicas{{kind="{kind}"}} 0'
+                in prom), kind
+    assert '# TYPE skytpu_spot_preemptions_total counter' in prom
+    assert 'skytpu_spot_preemptions_total 0' in prom
+    assert '# TYPE skytpu_prefix_warmup_seconds histogram' in prom
+    assert 'skytpu_prefix_warmup_seconds_bucket{le="+Inf"} 0' in prom
+    assert '# TYPE skytpu_replica_provision_seconds histogram' in prom
+    assert 'skytpu_replica_provision_seconds_bucket{le="+Inf"} 0' \
+        in prom
+
+
 # ---------------------------------------------------------------------------
 # Registry: Prometheus exposition golden test
 # ---------------------------------------------------------------------------
@@ -410,6 +456,17 @@ def test_server_prometheus_metrics_and_debug_requests():
         assert 'skytpu_replica_role{role="colocated"} 1' in prom
         assert 'skytpu_replica_role{role="prefill"} 0' in prom
         assert 'skytpu_replica_role{role="decode"} 0' in prom
+        # (b5) Spot-resilience series (round 10): the model server
+        # registers the prefix-warmup histogram and the preemption
+        # counter at construction, so both series render on the first
+        # scrape. (Zeros-from-fresh is pinned by
+        # test_spot_scaling_series_registered_at_construction on a
+        # reset registry — earlier tests in this process may have
+        # legitimately moved the shared series.)
+        assert '# TYPE skytpu_prefix_warmup_seconds histogram' in prom
+        assert 'skytpu_prefix_warmup_seconds_bucket{le="+Inf"}' in prom
+        assert '# TYPE skytpu_spot_preemptions_total counter' in prom
+        assert 'skytpu_spot_preemptions_total ' in prom
         # JSON disagg block: stable schema, zeros when idle.
         assert m['disagg']['role'] == 'colocated'
         assert set(m['disagg']['handoffs']) == \
