@@ -164,6 +164,12 @@ RULES: Dict[str, str] = {
              'self._clock) so recorded traces replay to identical '
              'decisions under test; referencing time.time as an '
              'injectable default is fine, calling it is not',
+    'GC116': 'unbounded-gang-join: a distributed join/barrier/wait in '
+             'the gang layer (serve/gang.py) with no timeout — a rank '
+             'that never comes up (or a dead coordinator) would hang '
+             'the whole gang forever instead of failing it fast; '
+             'every distributed join must carry a timeout (and '
+             'jax.distributed.initialize an initialization_timeout)',
     'GC201': 'impure-jit: impure or host-synchronizing call inside a '
              '@jax.jit body',
     'GC202': 'host-sync: device->host readback outside the '
@@ -269,6 +275,17 @@ _JITTER_METHODS = {'random', 'uniform', 'expovariate', 'gauss',
 SCALING_PATH_SUFFIXES = ('serve/autoscalers.py', 'serve/forecaster.py')
 _SCALING_WALLCLOCK = {'time.time', 'time.monotonic'}
 _SCALING_WALLCLOCK_BARE = {'monotonic'}   # from time import monotonic
+
+# --------------------------------------------------------------------- GC116
+# The gang layer: every distributed join — barrier waits, member
+# joins, follower sync waits — must be BOUNDED, or one rank that never
+# comes up hangs the whole gang (the exact half-alive failure mode
+# gang-atomicity exists to kill). Argless no-timeout wait/join/get/
+# barrier calls are flagged file-wide (not just under locks or in
+# coroutines like GC102/GC111), and jax.distributed.initialize must
+# carry initialization_timeout.
+GANG_PATH_SUFFIXES = ('serve/gang.py',)
+_GANG_JOIN_METHODS = {'wait', 'join', 'get', 'barrier'}
 
 # --------------------------------------------------------------------- GC109
 # Ad-hoc timing calls banned from inference/ hot paths: telemetry's
@@ -428,7 +445,8 @@ class _Checker(ast.NodeVisitor):
                  is_serve: bool = False,
                  is_retryloop_dir: bool = False,
                  is_transfer_path: bool = False,
-                 is_scaling_path: bool = False):
+                 is_scaling_path: bool = False,
+                 is_gang_path: bool = False):
         self.rel = rel
         self.lines = lines
         self.is_compute = is_compute
@@ -438,6 +456,7 @@ class _Checker(ast.NodeVisitor):
         self.is_retryloop_dir = is_retryloop_dir
         self.is_transfer_path = is_transfer_path
         self.is_scaling_path = is_scaling_path
+        self.is_gang_path = is_gang_path
         self._flagged_sleeps: Set[int] = set()   # node ids (GC112 dedupe)
         self.violations: List[Violation] = []
         self._scope: List[str] = []
@@ -701,6 +720,8 @@ class _Checker(ast.NodeVisitor):
             self._check_wire_dtype(node, name, method)
         if self.is_scaling_path:
             self._check_scaling_clock(node, name)
+        if self.is_gang_path:
+            self._check_gang_join(node, name, method)
         if self.is_serve and self._in_async:
             self._check_async_engine_call(node, name, method)
         if self._any_lock_held():
@@ -811,6 +832,32 @@ class _Checker(ast.NodeVisitor):
                       f'unbounded .{target}() inside an async '
                       'coroutine parks the event loop — await an '
                       'async primitive or run the wait in an executor')
+
+    def _check_gang_join(self, node: ast.Call, name: str,
+                         method: str) -> None:
+        """GC116: an unbounded distributed join in the gang layer. A
+        barrier/join/wait/get with neither a positional bound nor a
+        ``timeout=`` hangs the whole gang on one dead rank; the gang
+        contract is fail-fast (join timeout, heartbeat timeout), so
+        every wait must carry one. ``jax.distributed.initialize`` must
+        pass ``initialization_timeout`` for the same reason."""
+        leaf = method or name.rsplit('.', 1)[-1]
+        if name.endswith('distributed.initialize'):
+            if not any(kw.arg == 'initialization_timeout'
+                       for kw in node.keywords):
+                self._add('GC116', node,
+                          'jax.distributed.initialize without '
+                          'initialization_timeout in the gang layer — '
+                          'a member that never starts must fail the '
+                          'gang, not hang its bootstrap forever')
+            return
+        if (leaf in _GANG_JOIN_METHODS and not node.args
+                and not _has_timeout(node)):
+            self._add('GC116', node,
+                      f'unbounded .{leaf}() in the gang layer — a '
+                      'distributed join with no timeout hangs the '
+                      'whole gang on one dead rank; pass timeout= '
+                      '(the gang contract is fail-fast)')
 
     def _check_scaling_clock(self, node: ast.Call, name: str) -> None:
         """GC115: a direct wall-clock CALL in a scaling-decision
@@ -971,7 +1018,8 @@ def check_source(rel: str, source: str) -> List[Violation]:
                        is_transfer_path=norm.endswith(
                            TRANSFER_PATH_SUFFIXES),
                        is_scaling_path=norm.endswith(
-                           SCALING_PATH_SUFFIXES))
+                           SCALING_PATH_SUFFIXES),
+                       is_gang_path=norm.endswith(GANG_PATH_SUFFIXES))
     checker.visit(tree)
     suppressed = _line_suppressions(source)
     out = []

@@ -618,6 +618,20 @@ def serve_logs(service_name, no_follow):
                    'warning persists hot prefix chains here; a '
                    '(re)booting server warms its cache from the file '
                    'before declaring readiness.')
+@click.option('--gang-rank', type=int, default=None,
+              help='Multi-host gang rank (0 = leader: HTTP front end '
+                   '+ scheduler; >0 = follower loop replaying the '
+                   'leader\'s op log). Default: SKYTPU_RANK env.')
+@click.option('--gang-world', type=int, default=None,
+              help='Gang size (processes per replica; 1 = not a '
+                   'gang). Default: SKYTPU_WORLD env.')
+@click.option('--gang-coordinator', default=None,
+              help='Rank 0\'s base URL (the gang bus; required on '
+                   'nonzero ranks). Default: SKYTPU_COORDINATOR env.')
+@click.option('--gang-id', default=None,
+              help='Shared gang identity (the replica manager\'s unit '
+                   'of drain/checkpoint/teardown). Default: '
+                   'SKYTPU_GANG_ID env.')
 @click.option('--max-batch', type=int, default=8)
 @click.option('--max-seq', type=int, default=1024)
 @click.option('--port', type=int, default=8081)
@@ -626,13 +640,36 @@ def model_server(model, model_path, quantize, tp, dp, kv_cache,
                  decode_priority_ratio, prefill_w8a8, speculate_k,
                  slo_tier_default, max_queue_tokens, latency_admit_frac,
                  drain_deadline_s, fault_spec, role, handoff_targets,
-                 checkpoint_path, max_batch, max_seq, port):
+                 checkpoint_path, gang_rank, gang_world,
+                 gang_coordinator, gang_id, max_batch, max_seq, port):
     """Run the in-tree replica model server on this host (the process
     a service task's ``run`` command starts on each replica; same
-    knobs as ``python -m skypilot_tpu.serve.server``)."""
+    knobs as ``python -m skypilot_tpu.serve.server``). With
+    ``--gang-world N`` the replica is a gang of N processes: rank 0
+    serves HTTP, nonzero ranks run follower loops and the whole gang
+    launches, drains, checkpoints, and dies together."""
     if kv_cache != 'paged' and page_size is not None:
         raise click.UsageError(
             '--page-size only applies with --kv-cache paged')
+    from skypilot_tpu.serve import gang as gang_lib
+    gang_spec = gang_lib.GangSpec.from_env(
+        rank=gang_rank, world=gang_world, coordinator=gang_coordinator,
+        gang_id=gang_id)
+    if gang_spec.is_gang and not gang_spec.is_leader:
+        import argparse
+        from skypilot_tpu.serve import server as server_lib
+        click.echo(f'Gang follower rank {gang_spec.rank}/'
+                   f'{gang_spec.world} -> {gang_spec.coordinator}')
+        server_lib.run_follower(gang_spec, argparse.Namespace(
+            model=model, model_path=model_path, quantize=quantize,
+            tp=tp, dp=dp, kv_cache=kv_cache,
+            kv_cache_dtype=kv_cache_dtype, page_size=page_size,
+            prefill_w8a8=prefill_w8a8,
+            prefill_chunk_tokens=prefill_chunk_tokens,
+            decode_priority_ratio=decode_priority_ratio,
+            speculate_k=speculate_k, fault_spec=fault_spec,
+            max_batch=max_batch, max_seq=max_seq))
+        return
     from skypilot_tpu.serve.server import ModelServer
     server = ModelServer(model, max_batch=max_batch, max_seq=max_seq,
                          port=port, model_path=model_path,
@@ -652,10 +689,12 @@ def model_server(model, model_path, quantize, tp, dp, kv_cache,
                          role=role,
                          handoff_targets=(handoff_targets.split(',')
                                           if handoff_targets else None),
-                         checkpoint_path=checkpoint_path)
+                         checkpoint_path=checkpoint_path,
+                         gang=gang_spec)
     click.echo(f'Model server on :{port} '
                f'(kv_cache={kv_cache}, speculate_k={speculate_k}, '
-               f'tp={server.tp}, dp={server.dp}, role={server.role})')
+               f'tp={server.tp}, dp={server.dp}, role={server.role}, '
+               f'gang_world={server.gang.world})')
     server.start(block=True)
 
 

@@ -677,6 +677,37 @@ class _EngineBase:
         access like every other host-side engine call."""
         return [r.request_id for r in self._slots if r is not None]
 
+    # ------------------------------------------------- gang lockstep
+    # Multi-host gang serving (serve/gang.py): rank 0 records every
+    # engine mutation (add/step/cancel/flush/warmup) to an op log and
+    # nonzero ranks replay it verbatim, so every process executes the
+    # same jitted steps in the same order on its mesh shard. These two
+    # entries are the follower side of that contract.
+
+    def drain_pipeline(self) -> List[Tuple[int, int, bool]]:
+        """Flush the async dispatch pipeline completely; returns the
+        drained events (callers route them exactly like ``step()``
+        events). The gang ``flush`` op: rank 0 drains before a
+        checkpoint/handoff export and followers mirror it, so every
+        rank's pipeline depth — and therefore its subsequent event
+        stream — stays aligned."""
+        events: List[Tuple[int, int, bool]] = []
+        while self._pending:
+            events.extend(self._process_one())
+        return events
+
+    def follower_step(self, horizon: int = 1, *,
+                      prepared: bool = False
+                      ) -> List[Tuple[int, int, bool]]:
+        """Gang-follower step entry: execute exactly the step rank 0
+        recorded — the same proposer preparation, the same fused
+        horizon — and return the step's events for finished-request
+        digest verification (the caller reaps finished requests; no
+        scheduler runs on followers)."""
+        if prepared and getattr(self, 'speculate_k', 0):
+            self.prepare_proposals()
+        return self.step(horizon=horizon)
+
     # ---------------------------------------------- prefix checkpoint
     # Spot resilience: on a preemption warning the serve layer
     # checkpoints the engine's hottest prefix-cache page chains (plus

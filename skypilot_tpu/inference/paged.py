@@ -1316,6 +1316,17 @@ class PagedInferenceEngine(SpeculativeMixin, _EngineBase):
         self._purge_lagging()
         return super().has_work() or bool(self._lagging)
 
+    def has_runnable_work(self) -> bool:
+        # Purge finished early-freed stragglers FIRST: a finished
+        # request parked in _lagging is not runnable work, and
+        # counting it busy-spins the serve loop after every
+        # budget-bound completion (and floods the gang op log with
+        # no-op steps) until something else happened to call
+        # has_work() and purge. The base check then sees the live
+        # truth.
+        self._purge_lagging()
+        return super().has_runnable_work()
+
     def cancel(self, request_id: int) -> bool:
         if super().cancel(request_id):
             return True
@@ -1645,6 +1656,20 @@ class PagedInferenceEngine(SpeculativeMixin, _EngineBase):
             del self._prefix_heat[coldest]
         self._prefix_heat[key] = {'tokens': list(tokens[:covered + 1]),
                                   'hits': 1}
+
+    def drain_pipeline(self):
+        """Gang ``flush`` op (see ``_EngineBase.drain_pipeline``): on
+        top of syncing the in-flight device calls, the paged engine
+        must also surface its pool-pressure deferred-event stash —
+        otherwise a leader that flushed before a checkpoint and a
+        follower that didn't would emit the same tokens in different
+        step batches and the finished-digest comparison would be
+        comparing mid-stream states."""
+        events = super().drain_pipeline()
+        if self._deferred_events:
+            events.extend(self._deferred_events)
+            self._deferred_events = []
+        return events
 
     def export_prefix_snapshots(self, max_entries: int = 8):
         """The hottest still-cached prefix chains as prefix entries

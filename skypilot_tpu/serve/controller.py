@@ -50,6 +50,12 @@ class ServeController:
     def _replica_views(self) -> List[autoscalers.ReplicaView]:
         views = []
         for info in self.replica_manager.replicas():
+            if info.gang_rank > 0:
+                # A gang is ONE unit of serving capacity: rank 0
+                # represents it to the autoscaler (counting followers
+                # would make a 4-host gang look like 4 replicas and
+                # freeze scale-up at 1/4 the intended fleet).
+                continue
             views.append(autoscalers.ReplicaView(
                 replica_id=info.replica_id,
                 is_ready=(info.status == serve_state.ReplicaStatus.READY),
@@ -95,6 +101,8 @@ class ServeController:
         if ready_new < self.autoscaler.target_num_replicas:
             return
         for info in infos:
+            if info.gang_rank > 0:
+                continue      # gangs drain through their rank 0
             if info.version < latest and not info.status.is_terminal() \
                     and info.status not in (
                         serve_state.ReplicaStatus.SHUTTING_DOWN,
@@ -210,6 +218,11 @@ class ServeController:
                         # cold-probe fallback.
                         'replica_roles':
                             controller.replica_manager.replica_roles(),
+                        # Gang health blocks (rank0 url -> gang view):
+                        # the LB keeps follower addresses out of probe
+                        # sweeps while accounting every rank's health.
+                        'replica_gangs':
+                            controller.replica_manager.replica_gangs(),
                     })
                 elif self.path == '/controller/update':
                     try:
@@ -251,6 +264,9 @@ class ServeController:
                 'is_spot': i.is_spot,
                 'role': i.role,
                 'mesh': {'tp': par['tp'], 'dp': par['dp']},
+                'gang_id': i.gang_id,
+                'gang_rank': i.gang_rank,
+                'gang_world': i.gang_world,
             } for i in self.replica_manager.replicas()],
         }
 

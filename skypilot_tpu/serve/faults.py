@@ -56,6 +56,18 @@ network or the hardware:
   (``server.start_handoff``), once per attempted handoff. Kind
   ``partial_response`` makes the handoff POST "fail" before it is sent
   — exercises the colocated-fallback path a dead decode worker drives.
+- ``gang_member_crash`` — a gang follower's sync loop
+  (``serve/gang.py::GangFollower.run``), once per loop iteration.
+  Kind ``replica_crash`` kills that rank's process mid-run — the
+  leader loses its heartbeat, fails the WHOLE gang, and the LB's
+  in-flight recovery resubmits to a surviving replica. Rules may be
+  **rank-targeted**: ``{"rank": 1}`` fires only on rank 1 (counters
+  advance per matching invocation regardless, so ``at``/``every``
+  stay deterministic per site).
+- ``gang_join_timeout`` — a gang follower's join path, once at
+  startup. Kind ``replica_crash`` = the rank never joins (the
+  leader's join deadline then fails the partial gang cleanly); kind
+  ``engine_stall`` = the rank joins ``delay_s`` late.
 
 Rule matching fields (all optional, combined with OR): ``at`` (fire on
 exactly the Nth invocation of the site, 1-based), ``every`` (fire on
@@ -102,7 +114,8 @@ FAULT_KINDS = ('replica_crash', 'probe_timeout', 'slow_response',
 # module docstring's list).
 FAULT_SITES = ('engine_step', 'probe', 'preempt', 'preempt_warning',
                'proxy', 'proxy_stream', 'http_response', 'handoff',
-               'spot_preemption')
+               'spot_preemption', 'gang_member_crash',
+               'gang_join_timeout')
 
 # Outcomes of skytpu_requests_migrated_total{outcome}: a migrated
 # request either completed on a surviving replica or exhausted every
@@ -125,6 +138,7 @@ class FaultRule:
     count: Optional[int] = None       # max total fires (None = no cap)
     delay_s: float = 0.25             # stall/slow-response duration
     after_events: int = 0             # proxy_stream: break after N events
+    rank: Optional[int] = None        # gang sites: target this rank only
     fired: int = 0                    # bookkeeping (not a spec field)
 
     @classmethod
@@ -143,7 +157,9 @@ class FaultRule:
                    prob=float(d.get('prob', 0.0)),
                    count=(int(d['count']) if d.get('count') else None),
                    delay_s=float(d.get('delay_s', 0.25)),
-                   after_events=int(d.get('after_events', 0)))
+                   after_events=int(d.get('after_events', 0)),
+                   rank=(int(d['rank']) if 'rank' in d
+                         and d['rank'] is not None else None))
 
 
 class FaultInjector:
@@ -167,14 +183,19 @@ class FaultInjector:
                 'Faults injected by the deterministic fault-injection '
                 'subsystem', kind=kind) for kind in FAULT_KINDS}
 
-    def fire(self, site: str) -> Optional[FaultRule]:
-        """Count one invocation of ``site``; return the first rule that
-        fires there (and record it in telemetry), else None."""
+    def fire(self, site: str,
+             rank: Optional[int] = None) -> Optional[FaultRule]:
+        """Count one invocation of ``site``; return the first rule
+        that fires there (and record it in telemetry), else None.
+        ``rank`` (the gang sites) scopes rank-targeted rules: a rule
+        with ``rank`` set only fires on that rank's invocations."""
         with self._lock:
             n = self._site_counts.get(site, 0) + 1
             self._site_counts[site] = n
             for rule in self._rules:
                 if rule.site != site:
+                    continue
+                if rule.rank is not None and rank != rule.rank:
                     continue
                 if rule.count is not None and rule.fired >= rule.count:
                     continue

@@ -155,6 +155,12 @@ class SkyServeLoadBalancer:
         # refreshed on every sync; the phase-aware policy uses them as
         # the cold-probe fallback and the replica view surfaces them.
         self._replica_roles: Dict[str, str] = {}
+        # Gang health blocks (rank0 url -> gang view), refreshed on
+        # every sync: a gang presents exactly ONE routable endpoint;
+        # the policies use this to keep follower addresses out of
+        # probe sweeps and the replica view carries it for health
+        # accounting.
+        self._replica_gangs: Dict[str, Any] = {}
 
     # ------------------------------------------------------------- sync
     def _sync_once(self) -> None:
@@ -182,6 +188,10 @@ class SkyServeLoadBalancer:
             if roles is not None:
                 self._replica_roles = dict(roles)
                 self.policy.set_replica_roles(roles)
+            gangs = payload.get('replica_gangs')
+            if gangs is not None:
+                self._replica_gangs = dict(gangs)
+                self.policy.set_replica_gangs(gangs)
         except Exception as e:  # pylint: disable=broad-except
             # Keep serving the last known replica set; re-queue the
             # timestamps so the QPS signal survives controller restarts —
@@ -702,8 +712,13 @@ class SkyServeLoadBalancer:
             'ready_replica_urls': urls,
             'replica_parallelism': self._replica_parallelism,
             'replica_roles': dict(self._replica_roles),
+            # Gang health accounting: follower ranks are not routable
+            # endpoints, but their existence and statuses ride the
+            # per-gang block under their rank 0's URL.
+            'replica_gangs': dict(self._replica_gangs),
             'replicas': [{'url': u, 'mesh': meshes.get(u),
-                          'role': self._replica_roles.get(u)}
+                          'role': self._replica_roles.get(u),
+                          'gang': self._replica_gangs.get(u)}
                          for u in urls],
         }
 

@@ -39,6 +39,16 @@ class LoadBalancingPolicy:
         failed this request — the LB's transparent retry)."""
         raise NotImplementedError
 
+    def _candidates_locked(self,
+                           exclude: Optional[Set[str]]) -> List[str]:
+        """Routable candidates (callers hold ``self._lock``): ready
+        replicas minus ``exclude`` minus gang follower URLs — a gang's
+        only endpoint is its rank 0."""
+        followers = self._followers_locked()
+        return [u for u in self.ready_replicas
+                if u not in followers
+                and (not exclude or u not in exclude)]
+
     def pre_execute(self, url: str) -> None:
         """Called when a request is dispatched to ``url``."""
         del url
@@ -58,6 +68,30 @@ class LoadBalancingPolicy:
         colocated), refreshed on every LB sync. Policies that route by
         phase use them as the fallback when live probes are cold."""
         del roles
+
+    def set_replica_gangs(self, gangs: Optional[Dict[str, Dict]]
+                          ) -> None:
+        """Gang health blocks (rank0 url -> {gang_id, world,
+        follower_urls, statuses}), refreshed on every LB sync. A gang
+        presents exactly ONE routable endpoint (rank 0): follower
+        URLs must be excluded from selection and probe sweeps — but
+        stay visible in health accounting (:meth:`gang_view`)."""
+        with self._lock:
+            gangs = gangs or {}
+            self._gangs = dict(gangs)
+            self._follower_urls = {
+                u for g in gangs.values()
+                for u in (g.get('follower_urls') or []) if u}
+
+    def gang_view(self) -> Dict[str, Dict]:
+        """The last-synced gang blocks (health accounting for ranks
+        that have no routable endpoint of their own)."""
+        with self._lock:
+            return dict(getattr(self, '_gangs', {}) or {})
+
+    def _followers_locked(self) -> set:
+        """Follower URLs to exclude (callers hold ``self._lock``)."""
+        return getattr(self, '_follower_urls', set())
 
     def handoff_target(self, exclude: Optional[Set[str]] = None
                        ) -> Optional[str]:
@@ -84,8 +118,7 @@ class RoundRobinPolicy(LoadBalancingPolicy):
                        exclude: Optional[Set[str]] = None
                        ) -> Optional[str]:
         with self._lock:
-            candidates = [u for u in self.ready_replicas
-                          if not exclude or u not in exclude]
+            candidates = self._candidates_locked(exclude)
             if not candidates:
                 return None
             url = candidates[self._index % len(candidates)]
@@ -104,8 +137,7 @@ class LeastLoadPolicy(LoadBalancingPolicy):
                        exclude: Optional[Set[str]] = None
                        ) -> Optional[str]:
         with self._lock:
-            candidates = [u for u in self.ready_replicas
-                          if not exclude or u not in exclude]
+            candidates = self._candidates_locked(exclude)
             if not candidates:
                 return None
             return min(candidates,
@@ -175,11 +207,16 @@ class QueueDepthPolicy(LoadBalancingPolicy):
     def _refresh(self, candidates) -> None:
         """Refresh stale probe caches for ``candidates``. Probes run
         with the lock RELEASED: a slow replica must not serialize every
-        concurrent select behind its timeout."""
+        concurrent select behind its timeout. Gang follower URLs are
+        never probed — a gang's one endpoint is rank 0; sweeping every
+        rank would double-count the gang's load and hammer processes
+        that serve no HTTP at all."""
         with self._lock:
             now = clock.monotonic()
+            followers = self._followers_locked()
             stale = [u for u in candidates
-                     if self._cache.get(u, (0.0, None))[0] <= now]
+                     if u not in followers
+                     and self._cache.get(u, (0.0, None))[0] <= now]
         fresh = {u: self._probe(u) for u in stale}
         with self._lock:
             expiry = clock.monotonic() + self.PROBE_TTL_S
@@ -205,8 +242,7 @@ class QueueDepthPolicy(LoadBalancingPolicy):
                        exclude: Optional[Set[str]] = None
                        ) -> Optional[str]:
         with self._lock:
-            candidates = [u for u in self.ready_replicas
-                          if not exclude or u not in exclude]
+            candidates = self._candidates_locked(exclude)
         if not candidates:
             return None
         self._refresh(candidates)
@@ -259,8 +295,7 @@ class PhaseAwarePolicy(QueueDepthPolicy):
                        exclude: Optional[Set[str]] = None
                        ) -> Optional[str]:
         with self._lock:
-            candidates = [u for u in self.ready_replicas
-                          if not exclude or u not in exclude]
+            candidates = self._candidates_locked(exclude)
         if not candidates:
             return None
         self._refresh(candidates)
@@ -275,8 +310,7 @@ class PhaseAwarePolicy(QueueDepthPolicy):
     def handoff_target(self, exclude: Optional[Set[str]] = None
                        ) -> Optional[str]:
         with self._lock:
-            candidates = [u for u in self.ready_replicas
-                          if not exclude or u not in exclude]
+            candidates = self._candidates_locked(exclude)
         if not candidates:
             return None
         self._refresh(candidates)

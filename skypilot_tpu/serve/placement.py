@@ -40,10 +40,15 @@ _WEIGHT_HBM_FRAC = 0.6
 
 @dataclasses.dataclass(frozen=True)
 class ParallelismPlan:
-    """A replica's mesh shape plus why it was chosen."""
+    """A replica's mesh shape plus why it was chosen. ``hosts`` is the
+    gang size (processes per replica, ``parallelism: hosts:``): the
+    replica manager launches that many ranks per replica, all sharing
+    one gang ID, and the (tp, dp) mesh spans their combined chips on a
+    pod (serve/gang.py owns the lifecycle contract)."""
     tp: int
     dp: int
     reason: str
+    hosts: int = 1
 
     @property
     def chips(self) -> int:
@@ -51,8 +56,13 @@ class ParallelismPlan:
 
     def as_env(self) -> Dict[str, str]:
         """The replica launch env contract
-        (``serving_spec_from_env`` on the model-server side)."""
+        (``serving_spec_from_env`` on the model-server side). Gang
+        identity env (SKYTPU_RANK/WORLD/COORDINATOR/GANG_ID) is
+        per-rank and owned by the replica manager, not the plan."""
         return {'SKYTPU_TP': str(self.tp), 'SKYTPU_DP': str(self.dp)}
+
+    def with_hosts(self, hosts: int) -> 'ParallelismPlan':
+        return dataclasses.replace(self, hosts=max(1, int(hosts)))
 
 
 def model_weight_bytes(cfg_name: str,
@@ -159,10 +169,12 @@ def plan_for_spec(spec) -> ParallelismPlan:
     service spec's ``parallelism`` block. 'fixed' pins the explicit
     shape; 'adaptive' with a model name runs the Nitsum-style policy;
     no block (or a 1-chip replica with no model) stays single-chip."""
+    hosts = int(getattr(spec, 'gang_hosts', 1) or 1)
     if spec.parallelism_policy == 'fixed' or (
             spec.tp is not None or spec.dp is not None):
         return ParallelismPlan(tp=int(spec.tp or 1), dp=int(spec.dp or 1),
-                               reason='fixed by service spec')
+                               reason='fixed by service spec',
+                               hosts=hosts)
     if spec.parallelism_model is None:
         if spec.chips_per_replica > 1:
             # Chips with no model-size signal: a pure-dp split is the
@@ -170,10 +182,13 @@ def plan_for_spec(spec) -> ParallelismPlan:
             # collectives added).
             return ParallelismPlan(tp=1, dp=spec.chips_per_replica,
                                    reason='no model size declared: '
-                                          'chips as dp replicas')
-        return ParallelismPlan(tp=1, dp=1, reason='single-chip replica')
+                                          'chips as dp replicas',
+                                   hosts=hosts)
+        return ParallelismPlan(tp=1, dp=1, reason='single-chip replica',
+                               hosts=hosts)
     return plan_for_model(spec.parallelism_model,
                           spec.chips_per_replica,
                           slo_tier=spec.slo_tier,
                           quantize=spec.parallelism_quantize,
-                          hbm_per_chip_gb=spec.hbm_per_chip_gb)
+                          hbm_per_chip_gb=spec.hbm_per_chip_gb
+                          ).with_hosts(hosts)

@@ -70,6 +70,13 @@ def _warmup_timeout() -> float:
     return float(os.environ.get('SKYTPU_SERVE_WARMUP_TIMEOUT', '30'))
 
 
+def _gang_join_timeout() -> float:
+    """Barrier bound shipped to every gang rank: unless all ranks join
+    rank 0 within this window, the gang fails and is replaced as one
+    unit."""
+    return float(os.environ.get('SKYTPU_GANG_JOIN_TIMEOUT', '120'))
+
+
 def _ckpt_ttl() -> float:
     """Checkpoint staleness bound: prefix KV older than this is not
     worth shipping to a recovered replica (the traffic that made those
@@ -96,7 +103,9 @@ class ReplicaInfo:
     """In-memory mirror of one replica row + probe bookkeeping."""
 
     def __init__(self, replica_id: int, cluster_name: str, version: int,
-                 is_spot: bool, port: int, role: str = 'colocated'):
+                 is_spot: bool, port: int, role: str = 'colocated',
+                 gang_id: Optional[str] = None, gang_rank: int = 0,
+                 gang_world: int = 1):
         self.replica_id = replica_id
         self.cluster_name = cluster_name
         self.version = version
@@ -106,6 +115,17 @@ class ReplicaInfo:
         # pool this replica was launched to fill; rides the launch env
         # as SKYTPU_ROLE.
         self.role = role
+        # Multi-host gang membership (serve/gang.py): members share a
+        # gang_id and come up / drain / checkpoint / die TOGETHER.
+        # Rank 0 owns the replica's one routable endpoint (probed,
+        # routed, drained over HTTP); followers are tracked for health
+        # accounting and cluster lifecycle only — never probed, never
+        # in ready_urls. ``coordinator`` is rank 0's URL, set before a
+        # follower launches (its SKYTPU_COORDINATOR env).
+        self.gang_id = gang_id
+        self.gang_rank = gang_rank
+        self.gang_world = gang_world
+        self.coordinator: Optional[str] = None
         self.status = serve_state.ReplicaStatus.PENDING
         self.url: Optional[str] = None
         self.consecutive_failures = 0
@@ -168,6 +188,13 @@ class ReplicaManager:
         self._ckpt_lock = threading.Lock()
         self._ckpt_bytes: Optional[bytes] = None
         self._ckpt_time: float = 0.0
+        # Checkpoint-once dedupe, keyed by GANG (falling back to the
+        # replica id for singles): a preemption warning re-delivered
+        # to a *different rank* of the same gang must still checkpoint
+        # exactly once — the per-ReplicaInfo flag alone can't see that
+        # the gang already checkpointed through another member. Guarded
+        # by the manager lock like the per-replica flag it generalizes.
+        self._ckpt_done: Dict[str, bool] = {}
         # Provision-latency observations (scale-up issued -> READY)
         # not yet consumed by the controller; the forecast autoscaler
         # learns its pre-scaling lead time from them.
@@ -217,6 +244,17 @@ class ReplicaManager:
         # contract — the model server reads SKYTPU_ROLE unless started
         # with an explicit --role.
         envs['SKYTPU_ROLE'] = info.role
+        # Gang launch env (serve/gang.py): every rank gets the shared
+        # gang identity; nonzero ranks additionally get rank 0's URL
+        # as the coordinator (set by _launch_replica once rank 0's
+        # address resolves).
+        if info.gang_world > 1:
+            envs['SKYTPU_GANG_ID'] = info.gang_id or ''
+            envs['SKYTPU_RANK'] = str(info.gang_rank)
+            envs['SKYTPU_WORLD'] = str(info.gang_world)
+            envs['SKYTPU_GANG_JOIN_TIMEOUT'] = str(_gang_join_timeout())
+            if info.gang_rank > 0 and info.coordinator:
+                envs['SKYTPU_COORDINATOR'] = info.coordinator
         task.update_envs(envs)
         if info.is_spot:
             task.set_resources([r.copy(use_spot=True)
@@ -231,8 +269,12 @@ class ReplicaManager:
 
     def scale_up(self, use_spot: bool = False) -> Optional[int]:
         """Start one replica launch in the background; returns its id
-        (None once the manager is shutting down)."""
+        (None once the manager is shutting down). With
+        ``parallelism: hosts: N`` in the spec, "one replica" is a
+        GANG of N processes sharing a gang ID: rank 0 plus N-1
+        followers, launched together and replaced together."""
         from skypilot_tpu.serve import placement
+        world = max(1, int(self.parallelism_plan().hosts))
         with self._lock:
             if self._shutdown:
                 return None
@@ -243,17 +285,39 @@ class ReplicaManager:
             # not already leaving — a draining/failed prefill worker's
             # replacement must re-fill the prefill pool.
             live_roles = [r.role for r in self._replicas.values()
-                          if not r.status.is_terminal()
+                          if r.gang_rank == 0
+                          and not r.status.is_terminal()
                           and r.status not in (
                               serve_state.ReplicaStatus.SHUTTING_DOWN,
                               serve_state.ReplicaStatus.DRAINING)]
             role = placement.role_for_new_replica(self.spec, live_roles)
+            gang_id = (f'{self.service_name}-gang-{replica_id}'
+                       f'-v{self.version}' if world > 1 else None)
             info = ReplicaInfo(replica_id,
                                self._replica_cluster_name(replica_id),
-                               self.version, use_spot, port, role=role)
+                               self.version, use_spot, port, role=role,
+                               gang_id=gang_id, gang_rank=0,
+                               gang_world=world)
             info.status = serve_state.ReplicaStatus.PROVISIONING
             self._replicas[replica_id] = info
+            followers: List[ReplicaInfo] = []
+            for rank in range(1, world):
+                fid = self._next_replica_id
+                self._next_replica_id += 1
+                fport = self._pick_port(fid)
+                finfo = ReplicaInfo(
+                    fid, self._replica_cluster_name(fid),
+                    self.version, use_spot, fport, role=role,
+                    gang_id=gang_id, gang_rank=rank, gang_world=world)
+                finfo.status = serve_state.ReplicaStatus.PROVISIONING
+                self._replicas[fid] = finfo
+                followers.append(finfo)
         self._persist(info)
+        for finfo in followers:
+            self._persist(finfo)
+        # Rank 0 launches first: followers need its resolved address
+        # as their SKYTPU_COORDINATOR (_launch_replica fans them out
+        # once rank 0 reaches STARTING).
         threading.Thread(target=self._launch_replica,
                          args=(info,), daemon=True).start()
         return replica_id
@@ -360,7 +424,25 @@ class ReplicaManager:
             info.url = f'http://{head_ip}:{info.port}'
             info.status = serve_state.ReplicaStatus.STARTING
             info.first_probe_time = time.time()
-            self._persist(info)
+            followers = ([r for r in self._replicas.values()
+                          if info.gang_id is not None
+                          and r.gang_id == info.gang_id
+                          and r.gang_rank > 0
+                          and r.status ==
+                          serve_state.ReplicaStatus.PROVISIONING]
+                         if info.gang_rank == 0 else [])
+            for f in followers:
+                # Rank 0's address is the gang bus every follower
+                # syncs against; set before their tasks render env.
+                f.coordinator = info.url
+        self._persist(info)
+        # Gang fan-out: rank 0 is up, launch the follower ranks (each
+        # its own cluster, same gang ID). Readiness still waits on the
+        # barrier — rank 0's /readiness stays 503 until every rank
+        # joins within SKYTPU_GANG_JOIN_TIMEOUT.
+        for f in followers:
+            threading.Thread(target=self._launch_replica,
+                             args=(f,), daemon=True).start()
         self._record_launch_result(info, failed=False)
 
     def _record_launch_result(self, info: ReplicaInfo, failed: bool) -> None:
@@ -380,6 +462,13 @@ class ReplicaManager:
             logger.warning(f'Cleanup of failed replica '
                            f'{info.cluster_name} failed: {e}')
         self._bump_backoff()
+        # Gang atomicity at launch: ONE rank failing to provision
+        # fails the whole gang (a partial gang can never pass the
+        # barrier anyway — tear it down now instead of burning the
+        # join timeout).
+        self.scale_down_gang(info.gang_id,
+                             serve_state.ReplicaStatus.FAILED,
+                             except_id=info.replica_id)
 
     def _bump_backoff(self) -> None:
         """One more replica died before ever serving: extend the
@@ -406,6 +495,70 @@ class ReplicaManager:
         for rid in prune:      # outside _lock: _untrack takes _db_lock
             self._untrack(rid)
 
+    # --------------------------------------------------------------- gang
+    def _gang_members_locked(self, gang_id: Optional[str]
+                             ) -> List[ReplicaInfo]:
+        """Every tracked member of ``gang_id`` (callers hold _lock)."""
+        if gang_id is None:
+            return []
+        return [r for r in self._replicas.values()
+                if r.gang_id == gang_id]
+
+    def _gang_leader_locked(self, info: ReplicaInfo) -> ReplicaInfo:
+        """The rank-0 member of ``info``'s gang (``info`` itself for
+        singles/rank 0) — the one routable endpoint every HTTP-side
+        lifecycle action (probe, drain, checkpoint) targets."""
+        if info.gang_id is None or info.gang_rank == 0:
+            return info
+        for r in self._replicas.values():
+            if r.gang_id == info.gang_id and r.gang_rank == 0:
+                return r
+        return info
+
+    def _ckpt_key(self, info: ReplicaInfo) -> str:
+        return info.gang_id or f'replica-{info.replica_id}'
+
+    def scale_down_gang(self, gang_id: Optional[str],
+                        status: Optional[serve_state.ReplicaStatus]
+                        = None, *,
+                        except_id: Optional[int] = None) -> None:
+        """Tear down every member of a gang: one dead rank means the
+        whole gang is dead — the controller then replaces the gang as
+        one unit (its next tick sees all members terminal). No-op for
+        ``gang_id=None`` (singles route through ``scale_down``)."""
+        if gang_id is None:
+            return
+        with self._lock:
+            member_ids = [r.replica_id for r in
+                          self._gang_members_locked(gang_id)
+                          if r.replica_id != except_id
+                          and not r.status.is_terminal()
+                          and r.status !=
+                          serve_state.ReplicaStatus.SHUTTING_DOWN]
+        for rid in member_ids:
+            self._scale_down_one(rid, status)
+
+    def replica_gangs(self) -> Dict[str, Dict[str, object]]:
+        """rank0 url -> gang health block, for the LB sync payload:
+        the policies use it to keep follower addresses out of probe
+        sweeps while still accounting every rank's existence."""
+        with self._lock:
+            out: Dict[str, Dict[str, object]] = {}
+            for r in self._replicas.values():
+                if r.gang_id is None or r.gang_rank != 0 or not r.url:
+                    continue
+                members = self._gang_members_locked(r.gang_id)
+                out[r.url] = {
+                    'gang_id': r.gang_id,
+                    'world': r.gang_world,
+                    'follower_urls': [m.url for m in members
+                                      if m.gang_rank > 0
+                                      and m.url is not None],
+                    'statuses': {str(m.gang_rank): m.status.value
+                                 for m in members},
+                }
+            return out
+
     # -------------------------------------------------------------- drain
     def drain(self, replica_id: int,
               deadline_s: Optional[float] = None) -> bool:
@@ -417,6 +570,12 @@ class ReplicaManager:
         started (False: unknown replica or already leaving)."""
         with self._lock:
             info = self._replicas.get(replica_id)
+            if info is not None:
+                # Gang atomicity: a drain aimed at ANY member drains
+                # the gang — through rank 0, its one HTTP endpoint
+                # (rank 0's /drain fans out on the gang bus and
+                # reports drained only once every rank acked).
+                info = self._gang_leader_locked(info)
             if info is None or info.status in (
                     serve_state.ReplicaStatus.DRAINING,
                     serve_state.ReplicaStatus.SHUTTING_DOWN) or \
@@ -429,6 +588,14 @@ class ReplicaManager:
                 serve_state.ReplicaStatus.NOT_READY))
             if drainable:
                 info.status = serve_state.ReplicaStatus.DRAINING
+                members = self._gang_members_locked(info.gang_id)
+                for m in members:
+                    if m.gang_rank > 0 and not m.status.is_terminal():
+                        # Followers leave rotation bookkeeping with
+                        # their leader (they were never routable, but
+                        # health accounting must show the gang
+                        # leaving as one unit).
+                        m.status = serve_state.ReplicaStatus.DRAINING
         if not drainable:
             self.scale_down(replica_id)
             return False
@@ -436,8 +603,10 @@ class ReplicaManager:
         self._persist(info)
         deadline_s = (float(deadline_s) if deadline_s is not None
                       else _drain_deadline_default())
-        logger.info(f'Draining replica {replica_id} '
-                    f'(deadline {deadline_s:.0f}s).')
+        logger.info(f'Draining replica {info.replica_id}'
+                    + (f' (gang {info.gang_id})' if info.gang_id
+                       else '')
+                    + f' (deadline {deadline_s:.0f}s).')
         threading.Thread(target=self._drain_then_down,
                          args=(info, deadline_s), daemon=True).start()
         return True
@@ -508,31 +677,40 @@ class ReplicaManager:
         is), then route through graceful drain so in-flight work
         finishes (or migrates) before the capacity disappears.
 
-        Race-free with an in-flight drain: the checkpoint step is
-        guarded by a per-replica flag taken under the manager lock, so
-        a warning that lands while a drain (from a scale-down or an
-        earlier warning) is already running still checkpoints exactly
-        once and never double-drains."""
+        Race-free with an in-flight drain AND re-delivery to another
+        rank: the checkpoint step is guarded by a flag keyed by GANG
+        ID (replica id for singles) under the manager lock, so a
+        warning that lands while a drain is already running — or a
+        warning re-delivered to a *different rank of the same gang* —
+        still checkpoints exactly once and never double-drains."""
         logger.info(f'Preemption warning for replica {replica_id}; '
                     'checkpointing and draining ahead of it.')
         with self._lock:
             info = self._replicas.get(replica_id)
+            if info is not None:
+                # Gang-atomic: warnings to any rank checkpoint/drain
+                # the gang through its rank-0 endpoint.
+                info = self._gang_leader_locked(info)
             if info is not None and info.is_spot:
                 self._m_spot_preempt.inc()
         if info is not None:
             self._checkpoint_replica(info)
+            return self.drain(info.replica_id, deadline_s)
         return self.drain(replica_id, deadline_s)
 
     def _checkpoint_replica(self, info: ReplicaInfo) -> None:
         """Fetch the replica's prefix-cache checkpoint (``POST
-        /checkpoint`` — the response body is the SKCK container) and
-        store it for replacement warmup. At most once per replica
-        (flag under the lock); best-effort — a failure clears the flag
-        so a later warning may retry, and the drain proceeds either
-        way."""
+        /checkpoint`` against the gang leader — the response body is
+        the SKCK container; a gang leader's export completes only when
+        every rank acked) and store it for replacement warmup. At most
+        once per gang (flag keyed by gang ID under the lock);
+        best-effort — a failure clears the flag so a later warning may
+        retry, and the drain proceeds either way."""
+        key = self._ckpt_key(info)
         with self._lock:
-            if info.checkpointed or info.url is None:
+            if self._ckpt_done.get(key) or info.url is None:
                 return
+            self._ckpt_done[key] = True
             info.checkpointed = True
         try:
             req = urllib.request.Request(
@@ -545,6 +723,7 @@ class ReplicaManager:
                            f'failed ({type(e).__name__}: {e}); its '
                            'replacement will boot cold')
             with self._lock:
+                self._ckpt_done[key] = False
                 info.checkpointed = False
             return
         with self._ckpt_lock:
@@ -608,7 +787,17 @@ class ReplicaManager:
     # ------------------------------------------------------------ teardown
     def scale_down(self, replica_id: int, status: Optional[
             serve_state.ReplicaStatus] = None) -> None:
-        """Terminate a replica cluster (async; cluster teardown is slow)."""
+        """Terminate a replica (async; cluster teardown is slow). A
+        gang member's teardown tears the WHOLE gang down — one dead
+        rank, dead gang, replaced as one unit."""
+        with self._lock:
+            info = self._replicas.get(replica_id)
+            gang_id = info.gang_id if info is not None else None
+        self._scale_down_one(replica_id, status)
+        self.scale_down_gang(gang_id, status, except_id=replica_id)
+
+    def _scale_down_one(self, replica_id: int, status: Optional[
+            serve_state.ReplicaStatus] = None) -> None:
         with self._lock:
             info = self._replicas.get(replica_id)
             if info is None:
@@ -715,6 +904,25 @@ class ReplicaManager:
                                    serve_state.ReplicaStatus.READY,
                                    serve_state.ReplicaStatus.NOT_READY):
                 continue
+            if info.gang_rank > 0:
+                # Follower ranks have no probe endpoint (rank 0 is the
+                # gang's one routable URL; its readiness already
+                # embeds the barrier and the gang bus covers process
+                # health). Cluster existence is their only direct
+                # signal — and a follower cluster gone means the WHOLE
+                # gang is gone (scale_down is gang-atomic).
+                if self._check_preempted(info):
+                    logger.info(
+                        f'Gang {info.gang_id}: follower rank '
+                        f'{info.gang_rank} (replica '
+                        f'{info.replica_id}) preempted; failing the '
+                        'whole gang.')
+                    if info.is_spot:
+                        self._m_spot_preempt.inc()
+                    _transition_counter('PREEMPTED').inc()
+                    self.scale_down(info.replica_id,
+                                    serve_state.ReplicaStatus.PREEMPTED)
+                continue
             # Advance preemption warning (injected; cloud spot notices
             # would land here too): drain instead of hard-killing.
             if (self._faults is not None
@@ -772,6 +980,7 @@ class ReplicaManager:
                             max(0.0, time.time() - info.created_time))
                 info.status = serve_state.ReplicaStatus.READY
                 self._persist(info)
+                self._mirror_gang_ready(info)
                 continue
             # Probe failed on a live cluster.
             _probe_counter('failure').inc()
@@ -812,24 +1021,47 @@ class ReplicaManager:
                 info.status = serve_state.ReplicaStatus.NOT_READY
                 self._persist(info)
 
+    def _mirror_gang_ready(self, leader: ReplicaInfo) -> None:
+        """Health accounting for follower ranks: rank 0 READY means
+        the barrier completed, which means every rank is up — mirror
+        the status onto the follower rows (they are never probed and
+        never routable, but operators and the autoscaler must see the
+        gang's full health picture)."""
+        if leader.gang_id is None:
+            return
+        with self._lock:
+            members = [m for m in
+                       self._gang_members_locked(leader.gang_id)
+                       if m.gang_rank > 0 and m.status in (
+                           serve_state.ReplicaStatus.STARTING,
+                           serve_state.ReplicaStatus.NOT_READY)]
+            for m in members:
+                m.status = serve_state.ReplicaStatus.READY
+        for m in members:
+            self._persist(m)
+
     # ------------------------------------------------------------- queries
     def replicas(self) -> List[ReplicaInfo]:
         with self._lock:
             return list(self._replicas.values())
 
     def ready_urls(self) -> List[str]:
+        """The routable endpoints: READY replicas' URLs — rank 0 only
+        for gangs. A gang presents exactly ONE endpoint; follower
+        URLs must never reach LB rotation or policy probe sweeps."""
         with self._lock:
             return [r.url for r in self._replicas.values()
                     if r.status == serve_state.ReplicaStatus.READY
-                    and r.url is not None]
+                    and r.url is not None and r.gang_rank == 0]
 
     def replica_roles(self) -> Dict[str, str]:
-        """url -> disaggregation role for every replica with an
-        address — the LB sync payload (the phase-aware policy's
-        cold-probe fallback)."""
+        """url -> disaggregation role for every ROUTABLE replica with
+        an address (gang followers excluded — they are not endpoints)
+        — the LB sync payload (the phase-aware policy's cold-probe
+        fallback)."""
         with self._lock:
             return {r.url: r.role for r in self._replicas.values()
-                    if r.url is not None}
+                    if r.url is not None and r.gang_rank == 0}
 
     def _persist(self, info: ReplicaInfo) -> None:
         """Write the replica row — only while the replica is still

@@ -257,6 +257,15 @@ class RequestScheduler:
         self.latency_admit_frac = latency_admit_frac
         self._max_queue_tokens = max_queue_tokens or None
         self._wake = wake or (lambda: None)
+        # Gang op-log hooks (serve/gang.py): the gang leader records
+        # every engine mutation so followers replay the identical call
+        # stream. ``on_admit(rid, sr)`` fires right after a successful
+        # ``engine.add_request`` (engine lock held — keep it cheap);
+        # ``on_cancel(rid)`` fires after a successful engine-side
+        # cancel. None (the default) costs one attribute check.
+        self.on_admit: Optional[Callable[[int, 'ScheduledRequest'],
+                                         None]] = None
+        self.on_cancel: Optional[Callable[[int], None]] = None
         self._engine: Optional[Any] = None
         # Mesh throughput factor (tp x dp of the bound engine's mesh):
         # scales the WORK-TOKEN RATE estimates — the cold-meter
@@ -521,6 +530,8 @@ class RequestScheduler:
                 continue
             sr.request_id = rid
             sr.admit_time = clock.now()
+            if self.on_admit is not None:
+                self.on_admit(rid, sr)
             with self._q_lock:
                 self._by_rid[rid] = sr
             self._c_admitted[tier].inc()
@@ -615,6 +626,12 @@ class RequestScheduler:
                 return False
             req = engine.pop_finished(sr.request_id)
             cancelled = req is None and engine.cancel(sr.request_id)
+            if cancelled and self.on_cancel is not None:
+                # Under the engine lock on purpose: the gang op log's
+                # order must match engine execution order exactly (a
+                # cancel logged after a step the leader ran post-cancel
+                # would desync follower KV state).
+                self.on_cancel(sr.request_id)
         with self._q_lock:
             self._by_rid.pop(sr.request_id, None)
         if req is not None:

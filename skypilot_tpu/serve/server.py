@@ -63,10 +63,78 @@ from skypilot_tpu import tpu_logging
 from skypilot_tpu.inference import kv_transfer
 from skypilot_tpu.serve import disagg as disagg_lib
 from skypilot_tpu.serve import faults as faults_lib
+from skypilot_tpu.serve import gang as gang_lib
 from skypilot_tpu.serve import scheduler as scheduler_lib
 from skypilot_tpu.telemetry import tracing
 
 logger = tpu_logging.init_logger(__name__)
+
+
+def build_engine(cfg_name: str, *, max_batch: int, max_seq: int,
+                 model_path: Optional[str] = None,
+                 quantize: Optional[str] = None,
+                 kv_cache: str = 'paged',
+                 kv_cache_dtype: Optional[str] = None,
+                 page_size: Optional[int] = None,
+                 prefill_w8a8: bool = False,
+                 prefill_chunk_tokens: Optional[int] = None,
+                 decode_priority_ratio: Optional[float] = None,
+                 speculate_k: int = 0,
+                 tp: int = 1, dp: int = 1,
+                 gang: Optional['gang_lib.GangSpec'] = None):
+    """Construct AND warm one inference engine — the single engine
+    recipe every gang rank shares. Followers must build a
+    byte-identical engine to rank 0's (same config, same warmup
+    request, so request-id counters, prefix-cache state, and compiled
+    programs all align) — which is why this lives outside the
+    ModelServer: rank 0's ``_load_engine`` and the rank-N follower
+    entry both call exactly this."""
+    from skypilot_tpu.inference.engine import InferenceEngine
+    from skypilot_tpu.inference.paged import PagedInferenceEngine
+    from skypilot_tpu.models import configs
+    if gang is not None and gang.is_gang:
+        # Multi-host data plane: on a pod-capable backend the gang
+        # shares one jax.distributed program (the mesh then spans all
+        # processes); on CPU (tests/bench) each rank keeps a full
+        # model replica and lockstep is digest-verified by the gang
+        # bus (the 'replicated' plane).
+        import jax
+        if jax.default_backend() == 'tpu' and gang.coordinator:
+            from skypilot_tpu.parallel import mesh as mesh_lib
+            mesh_lib.initialize_gang_distributed(
+                gang.coordinator, gang.rank, gang.world,
+                timeout_s=gang.join_timeout_s)
+    engine_cls = (PagedInferenceEngine if kv_cache == 'paged'
+                  else InferenceEngine)
+    extra = {}
+    if tp * dp > 1:
+        from skypilot_tpu.parallel import mesh as mesh_lib
+        extra['mesh'] = mesh_lib.serving_mesh(tp, dp)
+    if kv_cache == 'paged' and page_size is not None:
+        extra['page_size'] = page_size
+    if prefill_chunk_tokens is not None:
+        extra['prefill_chunk_tokens'] = prefill_chunk_tokens
+    if decode_priority_ratio is not None:
+        extra['decode_priority_ratio'] = decode_priority_ratio
+    if kv_cache_dtype is not None:
+        extra['kv_cache_dtype'] = kv_cache_dtype
+    extra['prefill_w8a8'] = prefill_w8a8
+    extra['speculate_k'] = speculate_k
+    if model_path:
+        engine = engine_cls.from_pretrained(
+            model_path, max_batch=max_batch, max_seq=max_seq,
+            quantize=quantize, **extra)
+    else:
+        cfg = configs.get_config(cfg_name)
+        engine = engine_cls(cfg, max_batch=max_batch, max_seq=max_seq,
+                            quantize=quantize, **extra)
+    # Warmup: compile prefill+decode before declaring readiness. Part
+    # of the shared recipe — it advances the request-id counter and
+    # (paged) registers prefix pages, so a follower that skipped it
+    # would diverge on its very first replayed op.
+    engine.add_request([1, 2, 3], max_new_tokens=2)
+    engine.run_to_completion(horizon=4)
+    return engine
 
 
 class ModelServer:
@@ -91,7 +159,8 @@ class ModelServer:
                  fault_spec: Optional[Any] = None,
                  role: Optional[str] = None,
                  handoff_targets: Optional[List[str]] = None,
-                 checkpoint_path: Optional[str] = None):
+                 checkpoint_path: Optional[str] = None,
+                 gang: Optional['gang_lib.GangSpec'] = None):
         self.cfg_name = cfg_name
         self.model_path = model_path  # HF checkpoint dir (real weights)
         self.quantize = quantize      # 'int8' => int8 weights
@@ -206,6 +275,39 @@ class ModelServer:
         self.role = disagg_lib.resolve_role(role)
         self.handoff_targets = disagg_lib.static_targets(handoff_targets)
         disagg_lib.register_metrics(self.role)
+        # Multi-host gang serving (serve/gang.py): explicit spec wins,
+        # else the SKYTPU_COORDINATOR/SKYTPU_RANK/SKYTPU_WORLD launch
+        # env; world <= 1 (the default) keeps every hook a None check.
+        # Rank 0 hosts the GangCoordinator on this same HTTP front end
+        # (/gang/sync); nonzero ranks never construct a ModelServer at
+        # all (main() dispatches them to a GangFollower). Gang series
+        # register unconditionally so the /metrics schema is stable
+        # from the first scrape on gang and non-gang replicas alike.
+        gang_lib.register_metrics()
+        self.gang = gang if gang is not None else \
+            gang_lib.GangSpec.from_env()
+        self._gang: Optional[gang_lib.GangCoordinator] = None
+        self._gang_boot_blob: Optional[bytes] = None
+        self._gang_drain_cid: Optional[int] = None
+        if self.gang.is_gang:
+            if not self.gang.is_leader:
+                raise ValueError(
+                    'ModelServer is the rank-0 gang process; run '
+                    'nonzero ranks through the follower entry '
+                    '(python -m skypilot_tpu.serve.server '
+                    '--gang-rank N)')
+            if self.role != 'colocated':
+                logger.warning(
+                    f'gang serving forces role=colocated (was '
+                    f'{self.role}): disaggregated handoff in/out of a '
+                    'gang would desync follower engine state')
+                self.role = 'colocated'
+            self._gang = gang_lib.GangCoordinator(self.gang)
+            # Op-log hooks: every admission/cancel the scheduler
+            # performs is recorded (under the engine lock) so
+            # followers replay the identical engine call stream.
+            self.sched.on_admit = self._gang_record_admit
+            self.sched.on_cancel = self._gang_record_cancel
         # Spot resilience: prefix-cache checkpoint/warmup. On a
         # preemption warning the controller POSTs /checkpoint (the
         # response is the SKCK container of hot prefix chains +
@@ -239,65 +341,51 @@ class ModelServer:
 
     # ------------------------------------------------------------- engine
     def _load_engine(self) -> None:
-        from skypilot_tpu.inference.engine import InferenceEngine
-        from skypilot_tpu.inference.paged import PagedInferenceEngine
-        from skypilot_tpu.models import configs
         from skypilot_tpu.models.tokenizer import load_tokenizer
-        engine_cls = (PagedInferenceEngine if self.kv_cache == 'paged'
-                      else InferenceEngine)
-        extra = {}
-        if self.tp * self.dp > 1:
-            # Multi-chip serving: build the (tp, dp) mesh over the
-            # first tp*dp visible devices and hand it to the engine
-            # (params + KV pool pre-partitioned by logical axes; jitted
-            # steps pin matching output shardings — the zero-resharding
-            # contract the paged-tp jaxpr-audit preset gates).
-            from skypilot_tpu.parallel import mesh as mesh_lib
-            extra['mesh'] = mesh_lib.serving_mesh(self.tp, self.dp)
-        if self.kv_cache == 'paged' and self.page_size is not None:
-            extra['page_size'] = self.page_size
-        if self.prefill_chunk_tokens is not None:
-            extra['prefill_chunk_tokens'] = self.prefill_chunk_tokens
-        if self.decode_priority_ratio is not None:
-            extra['decode_priority_ratio'] = self.decode_priority_ratio
-        if self.kv_cache_dtype is not None:
-            extra['kv_cache_dtype'] = self.kv_cache_dtype
-        extra['prefill_w8a8'] = self.prefill_w8a8
-        extra['speculate_k'] = self.speculate_k
+        # The shared gang recipe: real weights come from an HF
+        # checkpoint dir (config.json + safetensors [+ tokenizer.json])
+        # — the reference serves such checkpoints through
+        # vLLM/JetStream (llm/llama-3/llama3.yaml:109). The (tp, dp)
+        # mesh keeps the zero-resharding contract the paged-tp
+        # jaxpr-audit preset gates.
+        engine = build_engine(
+            self.cfg_name, max_batch=self.max_batch,
+            max_seq=self.max_seq, model_path=self.model_path,
+            quantize=self.quantize, kv_cache=self.kv_cache,
+            kv_cache_dtype=self.kv_cache_dtype,
+            page_size=self.page_size, prefill_w8a8=self.prefill_w8a8,
+            prefill_chunk_tokens=self.prefill_chunk_tokens,
+            decode_priority_ratio=self.decode_priority_ratio,
+            speculate_k=self.speculate_k, tp=self.tp, dp=self.dp,
+            gang=self.gang if self.gang.is_gang else None)
         if self.model_path:
-            # Real weights: HF checkpoint dir (config.json + safetensors
-            # [+ tokenizer.json]) — the reference serves such checkpoints
-            # through vLLM/JetStream (llm/llama-3/llama3.yaml:109).
-            engine = engine_cls.from_pretrained(
-                self.model_path, max_batch=self.max_batch,
-                max_seq=self.max_seq, quantize=self.quantize, **extra)
             self.cfg_name = engine.cfg.name
-        else:
-            cfg = configs.get_config(self.cfg_name)
-            engine = engine_cls(cfg, max_batch=self.max_batch,
-                                max_seq=self.max_seq,
-                                quantize=self.quantize, **extra)
         self.tokenizer = load_tokenizer(
             self.model_path, model_vocab_size=engine.cfg.vocab_size)
-        # Warmup: compile prefill+decode before declaring readiness.
-        engine.add_request([1, 2, 3], max_new_tokens=2)
-        engine.run_to_completion(horizon=4)
         self.engine = engine
         self.sched.bind_engine(engine)
         # Prefix-cache warm boot: land a local checkpoint file (written
         # by a prior drain/preemption) BEFORE readiness — the replica
-        # never serves cold when warm state exists on disk.
+        # never serves cold when warm state exists on disk. A gang
+        # leader DEFERS the landing until the barrier completes and
+        # routes it through the op log, so followers land the identical
+        # entries in the identical order (a warm leader over cold
+        # followers would diverge on prefix-cache hits).
         if self.checkpoint_path and os.path.exists(self.checkpoint_path):
             t0 = time.monotonic()
             try:
                 with open(self.checkpoint_path, 'rb') as f:
-                    res = self.warm_from_checkpoint(f.read())
-                self._h_warmup.observe(time.monotonic() - t0)
-                logger.info(
-                    f'Warm boot from {self.checkpoint_path}: '
-                    f'{res["warmed_rows"]} row(s) across '
-                    f'{res["entries"]} entr(ies) in '
-                    f'{time.monotonic() - t0:.2f}s')
+                    blob = f.read()
+                if self._gang is not None:
+                    self._gang_boot_blob = blob
+                else:
+                    res = self.warm_from_checkpoint(blob)
+                    self._h_warmup.observe(time.monotonic() - t0)
+                    logger.info(
+                        f'Warm boot from {self.checkpoint_path}: '
+                        f'{res["warmed_rows"]} row(s) across '
+                        f'{res["entries"]} entr(ies) in '
+                        f'{time.monotonic() - t0:.2f}s')
             except Exception as e:  # pylint: disable=broad-except
                 logger.warning(
                     f'Warm boot from {self.checkpoint_path} failed '
@@ -324,6 +412,20 @@ class ModelServer:
                 self._work.wait()
                 if self._stopping:
                     break
+                if (self._gang is not None
+                        and self._gang_boot_blob is not None
+                        and self._gang.all_joined):
+                    # Deferred gang warm boot: the barrier is complete,
+                    # so the warmup op now reaches every rank in log
+                    # order (warm_from_checkpoint appends it).
+                    blob, self._gang_boot_blob = \
+                        self._gang_boot_blob, None
+                    try:
+                        self.warm_from_checkpoint(blob)
+                    except Exception as e:  # pylint: disable=broad-except
+                        logger.warning(
+                            f'gang warm boot failed '
+                            f'({type(e).__name__}: {e}); serving cold')
                 if self._faults is not None:
                     # Deterministic fault injection at the point the
                     # loop touches the hardware: a stall sleeps inside
@@ -366,7 +468,22 @@ class ModelServer:
                         # low when the batch is nearly idle.
                         sat = max(2, self.engine.max_batch // 2)
                         h = 32 if self.engine.num_active >= sat else 8
+                        if self._gang is not None:
+                            # Record the step BEFORE running it (op
+                            # order == execution order; the engine
+                            # lock serializes both) so followers run
+                            # the identical fused horizon.
+                            self._gang.append_op(
+                                {'k': 'step', 'h': h,
+                                 'prepared': bool(self.speculate_k)})
                         events = self.engine.step(horizon=h)
+                        if self._gang is not None and events:
+                            # Finished-request digests feed the
+                            # cross-rank byte-identity check; must run
+                            # before on_events pops the finished
+                            # Request objects.
+                            self._gang.digest.update(self.engine,
+                                                     events)
                     else:
                         events = []
                         if not self.sched.backlog:
@@ -396,11 +513,62 @@ class ModelServer:
         """Engine died: drop readiness (the serve probe then pulls this
         replica out of rotation) and fail every queued and in-flight
         request so handler threads return errors instead of blocking
-        forever."""
+        forever. On a gang leader this also fails the whole gang —
+        every follower's next sync gets the error and self-terminates
+        (one dead rank, dead gang; never a half-alive replica)."""
         logger.exception(f'Engine loop died: {type(e).__name__}: {e}')
         self._error = f'{type(e).__name__}: {e}'
+        if self._gang is not None:
+            self._gang.fail(self._error)
         self._ready.clear()
         self.sched.fail_all(self._error)
+
+    # --------------------------------------------------------------- gang
+    def _gang_record_admit(self, rid: int, sr) -> None:
+        """Scheduler admission hook (engine lock held): log the exact
+        ``add_request`` call for follower replay."""
+        s = sr.sampling
+        self._gang.append_op({
+            'k': 'add', 'rid': rid, 'prompt': list(sr.prompt),
+            'max_new_tokens': sr.max_new_tokens,
+            'priority': scheduler_lib.TIERS.index(sr.tier),
+            'temperature': s.get('temperature', 0.0),
+            'top_k': s.get('top_k', 0), 'top_p': s.get('top_p', 1.0),
+            'eos_id': s.get('eos_id'), 'stop': s.get('stop')})
+
+    def _gang_record_cancel(self, rid: int) -> None:
+        self._gang.append_op({'k': 'cancel', 'rid': rid})
+        self._gang.digest.drop(rid)
+
+    def _gang_monitor(self) -> None:
+        """Leader-side gang health loop: join-deadline and follower
+        heartbeat enforcement. Any gang failure routes through
+        ``_fatal`` — the whole replica leaves rotation at once and the
+        LB's in-flight recovery resubmits to a surviving replica."""
+        import random as random_mod
+        rng = random_mod.Random()
+        while not self._stopping and self._error is None:
+            try:
+                self._gang.check()
+            except gang_lib.GangFailure as e:
+                self._gang.count_failure(e.cause)
+                self._gang.fail(str(e))
+                self._fatal(e)
+                return
+            except Exception:  # pylint: disable=broad-except
+                logger.exception('gang monitor error')
+            # Jittered poll (graftcheck GC112: no fixed-sleep loops).
+            time.sleep(self.gang.heartbeat_s * (0.5 + rng.random()))
+
+    def gang_status(self) -> Dict[str, Any]:
+        """The /gang/status payload (also the health-accounting block
+        the controller ships to the LB): stable keys whether or not
+        this replica is a gang."""
+        if self._gang is None:
+            return {'gang_id': self.gang.gang_id, 'world': 1,
+                    'barrier': True, 'join_seconds': None, 'ops': 0,
+                    'failed': self._error, 'members': {}}
+        return self._gang.status()
 
     def submit(self, prompt, max_new_tokens: int, temperature: float,
                top_k: int, eos_id: Optional[int], top_p: float = 1.0,
@@ -666,6 +834,11 @@ class ModelServer:
         eng = self.engine
         if eng is not None:
             with self._lock:
+                if self._gang is not None:
+                    # Gang checkpoint: record the pipeline flush the
+                    # exports below perform, so followers flush at the
+                    # same log position and stay event-aligned.
+                    self._gang.append_op({'k': 'flush'})
                 for rid in eng.decoding_request_ids():
                     if len(entries) >= max_entries:
                         break
@@ -677,12 +850,28 @@ class ModelServer:
                     max_entries=max_entries)
                 events.extend(ev)
                 entries.extend(pentries)
+                if self._gang is not None and events:
+                    self._gang.digest.update(eng, events)
             if events:
                 # Tokens drained from the async pipeline during the
                 # export belong to their outboxes exactly like step()
                 # events.
                 self.sched.on_events(eng, events)
         blob = kv_transfer.encode_checkpoint(entries)
+        if self._gang is not None:
+            # Checkpoint completes only when every rank acks — the
+            # gang-atomic contract: "checkpointed" means the WHOLE
+            # replica reached this state, not just rank 0. Bounded
+            # wait (GC116); stragglers degrade to a leader-only
+            # checkpoint with a loud log, never a hang.
+            cid = self._gang.command('checkpoint')
+            if not self._gang.wait_acked(
+                    cid, timeout=min(10.0,
+                                     4 * self.gang.heartbeat_timeout_s)):
+                logger.warning(
+                    'gang checkpoint: not every rank acked in time '
+                    f'({self._gang.status()["members"]}); exporting '
+                    'leader state anyway')
         self._m_kv_bytes['export'].inc(len(blob))
         return blob, len(entries)
 
@@ -702,6 +891,16 @@ class ModelServer:
         with self._lock:
             if self.engine is None:
                 raise RuntimeError('engine not loaded')
+            if self._gang is not None:
+                # Fan the landing out through the op log (under the
+                # engine lock: op order == execution order) so every
+                # rank's prefix cache warms with the identical entries
+                # — a warm leader over cold followers would diverge on
+                # later prefix-cache hits.
+                import base64
+                self._gang.append_op({
+                    'k': 'warmup',
+                    'blob': base64.b64encode(blob).decode()})
             for entry in entries:
                 try:
                     rows = self.engine.warm_prefix(entry)
@@ -752,6 +951,15 @@ class ModelServer:
                     self.drain_deadline_s)
                 self.sched.begin_drain()
                 self._work.set()      # wake the loop to run the tail
+                if self._gang is not None:
+                    # Gang drain: the command pins the current op-log
+                    # index; a follower acks only once it has applied
+                    # everything up to it, so "gang drained" means
+                    # every rank reached the drained state.
+                    self._gang_drain_cid = self._gang.command(
+                        'drain', {'deadline_s': float(deadline_s)
+                                  if deadline_s else
+                                  self.drain_deadline_s})
                 if self.checkpoint_path:
                     # Persist the prefix-cache checkpoint alongside
                     # the drain (off-thread: the drain response must
@@ -772,12 +980,12 @@ class ModelServer:
         with self._drain_lock:
             started, deadline = self._drain_started, self._drain_deadline
         while time.monotonic() < deadline:
-            if self.sched.drained:
+            if self.sched.drained and self._gang_drain_acked():
                 break
             # Jittered poll (graftcheck GC112: no fixed-sleep loops).
             time.sleep(0.05 * (0.5 + random.random()))
         dur = time.monotonic() - started
-        clean = self.sched.drained
+        clean = self.sched.drained and self._gang_drain_acked()
         self._h_drain.observe(dur)
         self._drained.set()
         if clean:
@@ -793,17 +1001,30 @@ class ModelServer:
             self.sched.fail_all('drain deadline exceeded; retry on '
                                 'another replica')
 
+    def _gang_drain_acked(self) -> bool:
+        """True once every gang rank acked the drain command (always
+        True for non-gang replicas and before a drain started)."""
+        if self._gang is None:
+            return True
+        with self._drain_lock:
+            cid = self._gang_drain_cid
+        return cid is None or self._gang.acked(cid)
+
     def drain_status(self) -> Dict[str, Any]:
         with self._drain_lock:
             started, deadline = self._drain_started, self._drain_deadline
         now = time.monotonic()
-        return {
+        out = {
             'draining': started is not None,
-            'drained': self._drained.is_set() and self.sched.drained,
+            'drained': (self._drained.is_set() and self.sched.drained
+                        and self._gang_drain_acked()),
             'inflight': self.sched.inflight,
             'deadline_remaining_s': (round(max(0.0, deadline - now), 2)
                                      if deadline is not None else None),
         }
+        if self._gang is not None:
+            out['gang_drain_acked'] = self._gang_drain_acked()
+        return out
 
     # -------------------------------------------------------- idempotency
     def lookup_request_key(self, key: Optional[str]
@@ -1002,6 +1223,11 @@ class ModelServer:
             # phase-aware LB policy routes and picks handoff targets
             # from this plus kv_pool_tokens_free above.
             'disagg': disagg_lib.json_block(self.role),
+            # Gang block (stable schema: world 1 / barrier true on a
+            # non-gang replica). The LB's replica view carries it for
+            # health accounting — follower ranks have no routable
+            # endpoint of their own.
+            'gang': self.gang_status(),
             'scheduler': {
                 'prefill_chunk_tokens': getattr(eng, 'chunk', 0) or 0,
                 'decode_priority_ratio': getattr(
@@ -1070,6 +1296,35 @@ class ModelServer:
                     tier = self.headers.get('X-SLO-Tier')
                 return server.sched.resolve_tier(tier)
 
+            def _gang_sync(self) -> None:
+                """One follower heartbeat against the leader's gang
+                bus: registers/refreshes the member, verifies its
+                finished-request digests, returns the op-log tail and
+                pending commands (404 on a non-gang replica)."""
+                if server._gang is None:
+                    self._json(404, {'error': 'not a gang leader'})
+                    return
+                length = int(self.headers.get('Content-Length', 0))
+                try:
+                    payload = json.loads(self.rfile.read(length))
+                    rank = int(payload['rank'])
+                except (KeyError, ValueError, TypeError,
+                        json.JSONDecodeError) as e:
+                    self._json(400, {'error': f'{type(e).__name__}: '
+                                              f'{e}'})
+                    return
+                gid = payload.get('gang_id')
+                if gid and server.gang.gang_id and \
+                        gid != server.gang.gang_id:
+                    self._json(409, {'failed': f'gang id mismatch: '
+                                               f'{gid!r} != '
+                                               f'{server.gang.gang_id!r}'})
+                    return
+                self._json(200, server._gang.sync(
+                    rank, int(payload.get('applied', 0)),
+                    payload.get('acks') or [],
+                    payload.get('finished') or {}))
+
             def do_GET(self):  # noqa: N802
                 parsed = urllib.parse.urlparse(self.path)
                 query = urllib.parse.parse_qs(parsed.query)
@@ -1083,11 +1338,22 @@ class ModelServer:
                         # of in-flight work finishes.
                         self._json(503, dict(
                             server.drain_status(), status='draining'))
+                    elif (server._gang is not None
+                          and not server._gang.all_joined):
+                        # Gang barrier gates readiness: the replica is
+                        # servable only once EVERY rank joined within
+                        # the join timeout — a partial gang never
+                        # enters LB rotation.
+                        self._json(503, dict(server.gang_status(),
+                                             status='gang_joining'))
                     elif server._ready.is_set():
                         self._json(200, {'status': 'ready',
-                                         'model': server.cfg_name})
+                                         'model': server.cfg_name,
+                                         'gang': server.gang_status()})
                     else:
                         self._json(503, {'status': 'loading'})
+                elif parsed.path == '/gang/status':
+                    self._json(200, server.gang_status())
                 elif parsed.path == '/drain':
                     self._json(200, server.drain_status())
                 elif parsed.path == '/metrics':
@@ -1644,9 +1910,13 @@ class ModelServer:
             def do_POST(self):  # noqa: N802
                 routes = ('/generate', '/v1/completions',
                           '/v1/chat/completions', '/drain',
-                          '/kv/ingest', '/checkpoint', '/kv/warmup')
+                          '/kv/ingest', '/checkpoint', '/kv/warmup',
+                          '/gang/sync')
                 if self.path not in routes:
                     self._json(404, {'error': f'no route {self.path}'})
+                    return
+                if self.path == '/gang/sync':
+                    self._gang_sync()
                     return
                 if self.path == '/drain':
                     length = int(self.headers.get('Content-Length', 0))
@@ -1664,6 +1934,17 @@ class ModelServer:
                                extra_headers={'Retry-After': '5'})
                     return
                 if self.path == '/kv/ingest':
+                    if server._gang is not None:
+                        # A gang leader cannot adopt foreign KV: the
+                        # seat would bypass the op log and desync
+                        # every follower. Retryable — phase routing
+                        # picks another decode worker.
+                        self._json(503, {'error': {
+                            'message': 'gang replicas do not accept '
+                                       'KV handoffs',
+                            'type': 'gang', 'retry_after_s': 5}},
+                            extra_headers={'Retry-After': '5'})
+                        return
                     self._kv_ingest()
                     return
                 if self.path == '/checkpoint':
@@ -1743,6 +2024,9 @@ class ModelServer:
         self._engine_thread = threading.Thread(target=self._engine_loop,
                                                daemon=True)
         self._engine_thread.start()
+        if self._gang is not None:
+            threading.Thread(target=self._gang_monitor,
+                             daemon=True).start()
         handler = self._make_handler()
         self._httpd = http.server.ThreadingHTTPServer(('0.0.0.0', self.port),
                                                       handler)
@@ -1759,6 +2043,15 @@ class ModelServer:
         keep the model weights + KV pool alive (on TPU, several GB of
         HBM) for the life of the process."""
         self._stopping = True
+        if self._gang is not None:
+            # Clean gang teardown: followers get the shutdown command
+            # (or, if they miss it, lose the coordinator and
+            # self-terminate — either way nobody outlives the gang).
+            # Bounded grace for the acks (GC116), then shut down
+            # regardless.
+            cid = self._gang.command('shutdown')
+            self._gang.wait_acked(
+                cid, timeout=min(1.0, 2 * self.gang.heartbeat_s))
         self._work.set()                      # wake the loop to exit
         if self._httpd is not None:
             self._httpd.shutdown()
@@ -1891,6 +2184,22 @@ def main() -> None:
                              'no router supplied X-Handoff-Target '
                              '(picked by live KV-pool headroom). '
                              'Default: SKYTPU_HANDOFF_TARGETS env')
+    parser.add_argument('--gang-rank', type=int, default=None,
+                        help='multi-host gang rank (0 = leader: HTTP '
+                             'front end + scheduler; >0 = follower '
+                             'loop executing the leader\'s op log). '
+                             'Default: SKYTPU_RANK env, else 0')
+    parser.add_argument('--gang-world', type=int, default=None,
+                        help='gang size (processes per replica; 1 = '
+                             'not a gang). Default: SKYTPU_WORLD env')
+    parser.add_argument('--gang-coordinator', default=None,
+                        help='rank 0\'s base URL (the gang bus; '
+                             'required on nonzero ranks). Default: '
+                             'SKYTPU_COORDINATOR env')
+    parser.add_argument('--gang-id', default=None,
+                        help='shared gang identity (the replica '
+                             'manager\'s unit of drain/checkpoint/'
+                             'teardown). Default: SKYTPU_GANG_ID env')
     parser.add_argument('--max-batch', type=int, default=8)
     parser.add_argument('--max-seq', type=int, default=1024)
     parser.add_argument('--port', type=int,
@@ -1899,6 +2208,12 @@ def main() -> None:
     args = parser.parse_args()
     if args.kv_cache != 'paged' and args.page_size is not None:
         parser.error('--page-size only applies with --kv-cache paged')
+    gang_spec = gang_lib.GangSpec.from_env(
+        rank=args.gang_rank, world=args.gang_world,
+        coordinator=args.gang_coordinator, gang_id=args.gang_id)
+    if gang_spec.is_gang and not gang_spec.is_leader:
+        run_follower(gang_spec, args)
+        return
     server = ModelServer(args.model, max_batch=args.max_batch,
                          max_seq=args.max_seq, port=args.port,
                          model_path=args.model_path,
@@ -1920,8 +2235,38 @@ def main() -> None:
                          handoff_targets=(args.handoff_targets.split(',')
                                           if args.handoff_targets
                                           else None),
-                         checkpoint_path=args.checkpoint_path)
+                         checkpoint_path=args.checkpoint_path,
+                         gang=gang_spec)
     server.start(block=True)
+
+
+def run_follower(spec: 'gang_lib.GangSpec', args) -> None:
+    """Nonzero-rank gang entry: build the identical engine rank 0
+    builds (same config, same warmup — `build_engine` is the shared
+    recipe), join the coordinator, and replay its op log until
+    shutdown or gang death. The process exit code reflects the cause:
+    0 for a clean shutdown, nonzero when the gang died — the replica
+    manager treats a dead rank as a dead gang either way."""
+    import sys
+    from skypilot_tpu.parallel import mesh as mesh_lib
+    mesh_spec = mesh_lib.serving_spec_from_env(tp=args.tp, dp=args.dp)
+    logger.info(f'gang follower rank {spec.rank}/{spec.world} '
+                f'(gang {spec.gang_id or "?"}) building engine...')
+    engine = build_engine(
+        args.model, max_batch=args.max_batch, max_seq=args.max_seq,
+        model_path=args.model_path, quantize=args.quantize,
+        kv_cache=args.kv_cache, kv_cache_dtype=args.kv_cache_dtype,
+        page_size=args.page_size, prefill_w8a8=args.prefill_w8a8,
+        prefill_chunk_tokens=args.prefill_chunk_tokens,
+        decode_priority_ratio=args.decode_priority_ratio,
+        speculate_k=args.speculate_k,
+        tp=mesh_spec.tp, dp=mesh_spec.dp, gang=spec)
+    follower = gang_lib.GangFollower(
+        spec, engine,
+        faults=faults_lib.make_injector(args.fault_spec))
+    cause = follower.run()
+    logger.info(f'gang follower rank {spec.rank} exiting: {cause}')
+    sys.exit(0 if cause in ('shutdown', 'stopped') else 1)
 
 
 if __name__ == '__main__':

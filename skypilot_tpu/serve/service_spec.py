@@ -50,6 +50,14 @@ class SkyServiceSpec:
     hbm_per_chip_gb: float = 16.0
     tp: Optional[int] = None
     dp: Optional[int] = None
+    # Multi-host gang serving (``parallelism: hosts:``): each replica
+    # is a *gang* of this many processes that launch, drain,
+    # checkpoint, and die together (serve/gang.py). Rank 0 owns the
+    # replica's one routable endpoint; the manager keys every
+    # lifecycle action by gang ID. Reaches replicas as the
+    # SKYTPU_COORDINATOR/SKYTPU_RANK/SKYTPU_WORLD/SKYTPU_GANG_ID
+    # launch env.
+    gang_hosts: int = 1
     # Disaggregated prefill/decode serving (``disaggregation:`` block):
     # dedicate this many replicas to each phase; the rest stay
     # colocated. Roles reach replicas as the SKYTPU_ROLE launch env
@@ -111,6 +119,15 @@ class SkyServiceSpec:
                 'disaggregation needs BOTH prefill_replicas and '
                 'decode_replicas >= 1 (a lone pool has nobody to hand '
                 'off to/from)')
+        if self.gang_hosts < 1:
+            raise exceptions.InvalidServiceSpecError(
+                f'parallelism.hosts must be >= 1, got {self.gang_hosts}')
+        if self.gang_hosts > 1 and self.disagg_enabled:
+            raise exceptions.InvalidServiceSpecError(
+                'multi-host gangs and disaggregated prefill/decode '
+                'cannot combine (a KV handoff in/out of a gang would '
+                'desync its follower ranks); drop one of '
+                'parallelism.hosts / disaggregation')
 
     @property
     def autoscaling_enabled(self) -> bool:
@@ -159,7 +176,8 @@ class SkyServiceSpec:
                 parallelism_model=par.get('model'),
                 parallelism_quantize=par.get('quantize'),
                 hbm_per_chip_gb=float(par.get('hbm_per_chip_gb', 16.0)),
-                tp=par.get('tp'), dp=par.get('dp'))
+                tp=par.get('tp'), dp=par.get('dp'),
+                gang_hosts=int(par.get('hosts', 1)))
         if policy is not None and 'replicas' in config:
             raise exceptions.InvalidServiceSpecError(
                 'Give either replicas (fixed) or replica_policy, not both.')
@@ -215,6 +233,8 @@ class SkyServiceSpec:
                 'prefill_replicas': self.disagg_prefill_replicas,
                 'decode_replicas': self.disagg_decode_replicas,
             }
+        if self.gang_hosts > 1:
+            cfg['parallelism'] = {'hosts': self.gang_hosts}
         if self.autoscaling_enabled or self.target_qps_per_replica:
             policy: Dict[str, Any] = {
                 'min_replicas': self.min_replicas,

@@ -166,6 +166,11 @@ SERVICE_SCHEMA: Dict[str, Any] = {
                 'hbm_per_chip_gb': {'type': 'number'},
                 'tp': {'type': 'integer', 'minimum': 1},
                 'dp': {'type': 'integer', 'minimum': 1},
+                # Multi-host gang serving: processes per replica. The
+                # replica becomes a gang that launches, drains,
+                # checkpoints, and dies together (serve/gang.py);
+                # rank 0 is its one routable endpoint.
+                'hosts': {'type': 'integer', 'minimum': 1},
             },
         },
     },

@@ -682,6 +682,75 @@ def test_gc115_whole_repo_clean():
     assert [v for v in new if v.rule == 'GC115'] == []
 
 
+# ------------------------------------------------------------------ GC116
+def test_gc116_unbounded_gang_joins_flagged():
+    src = '''
+    import threading
+    def barrier_wait(self):
+        self._joined.wait()
+    def drain_gang(self, t):
+        self._acked.wait()
+        self._thread.join()
+    '''
+    ids = rule_ids(src, 'skypilot_tpu/serve/gang.py')
+    assert ids == ['GC116', 'GC116', 'GC116']
+
+
+def test_gc116_bounded_joins_clean():
+    # timeout= kwargs, positional bounds (str.join's iterable counts
+    # as one), and non-join calls are all fine.
+    src = '''
+    def barrier_wait(self, timeout):
+        return self._joined.wait(timeout=timeout)
+    def sleep(self):
+        self._stop.wait(timeout=self.heartbeat_s)
+    def tail(self, parts):
+        return ",".join(parts)
+    def pop_one(self, q):
+        return q.get(timeout=5)
+    '''
+    assert rule_ids(src, 'skypilot_tpu/serve/gang.py') == []
+
+
+def test_gc116_distributed_initialize_needs_timeout():
+    src = '''
+    import jax
+    def boot(self, addr, world, rank):
+        jax.distributed.initialize(coordinator_address=addr,
+                                   num_processes=world,
+                                   process_id=rank)
+    '''
+    assert rule_ids(src, 'skypilot_tpu/serve/gang.py') == ['GC116']
+    bounded = '''
+    import jax
+    def boot(self, addr, world, rank):
+        jax.distributed.initialize(coordinator_address=addr,
+                                   num_processes=world,
+                                   process_id=rank,
+                                   initialization_timeout=120)
+    '''
+    assert rule_ids(bounded, 'skypilot_tpu/serve/gang.py') == []
+
+
+def test_gc116_only_polices_gang_paths():
+    # Unbounded waits elsewhere stay governed by the existing rules
+    # (GC102 under locks, GC111 in coroutines) — GC116 is the gang
+    # layer's file-wide fail-fast contract.
+    src = '''
+    def wait_done(self):
+        self._done.wait()
+    '''
+    assert rule_ids(src, 'skypilot_tpu/serve/controller.py') == []
+    assert rule_ids(src, 'skypilot_tpu/serve/gang.py') == ['GC116']
+
+
+def test_gc116_whole_repo_clean():
+    # The shipped gang layer carries a timeout on every join.
+    from skypilot_tpu.analysis import lint
+    new, _ = lint.lint_paths(None, baseline=lint.load_baseline(None))
+    assert [v for v in new if v.rule == 'GC116'] == []
+
+
 # ------------------------------------------------------------------ GC201
 def test_gc201_impure_calls_inside_jit():
     src = '''

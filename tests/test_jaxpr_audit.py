@@ -235,3 +235,32 @@ def test_paged_tp_presets_registered():
     assert 'paged-tp' in jaxpr_audit.DEFAULT_PRESETS
     assert 'paged-tp-int8' in jaxpr_audit.DEFAULT_PRESETS
     assert jaxpr_audit.MULTI_DEVICE_PRESETS['paged-tp'] == 2
+
+
+@pytest.mark.slow
+def test_paged_gang_audit():
+    """The gang-shaped mesh (tp=2 x dp=2 over 4 devices — standing in
+    for a 2-process gang x 2 chips/process; the compiled HLO is
+    identical whether the dp axis crosses process boundaries):
+    steady-state transfer/recompile gates hold, the decode census
+    shows only the known set, and the dp>1 merge's in-body ring-row
+    all-gathers stay within their explicit budget — no all-to-all /
+    collective-permute anywhere across the process axis."""
+    _need_devices(4)
+    report = jaxpr_audit.PRESETS['paged-gang']()
+    _assert_hot_loop_clean(report)
+    assert report.collectives, 'gang preset must census collectives'
+    assert report.collective_violations() == [], report.format()
+    assert report.collectives['decode'].get('all-to-all', 0) == 0
+    assert report.collectives['decode'].get('collective-permute',
+                                            0) == 0
+    # The dp merge all-gathers ring-rows INSIDE its shard_map body by
+    # design (dp pool replicas must not diverge) — bounded, budgeted.
+    assert 0 < report.collectives['merge'].get('all-gather', 0) <= \
+        report.allowed_all_gathers_by_label['merge']
+
+
+def test_paged_gang_preset_registered():
+    assert 'paged-gang' in jaxpr_audit.PRESETS
+    assert 'paged-gang' in jaxpr_audit.DEFAULT_PRESETS
+    assert jaxpr_audit.MULTI_DEVICE_PRESETS['paged-gang'] == 4

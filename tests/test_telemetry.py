@@ -62,6 +62,39 @@ def test_spot_scaling_series_registered_at_construction(
         in prom
 
 
+def test_gang_series_registered_at_construction():
+    """Round-11 gang stable schema: ``gang.register_metrics()`` alone
+    puts every gang series in the registry — zeros from the first
+    scrape (gang_size 0 = not a gang), every failure cause
+    pre-registered — and a GangCoordinator sets the live world size."""
+    from skypilot_tpu import telemetry
+    from skypilot_tpu.serve import gang as gang_lib
+    registry_lib.reset_registry()
+    try:
+        gang_lib.register_metrics()
+        prom = telemetry.get_registry().render_prometheus()
+    finally:
+        registry_lib.reset_registry()
+    assert '# TYPE skytpu_gang_size gauge' in prom
+    assert 'skytpu_gang_size 0' in prom
+    assert '# TYPE skytpu_gang_join_seconds histogram' in prom
+    assert 'skytpu_gang_join_seconds_bucket{le="+Inf"} 0' in prom
+    assert '# TYPE skytpu_gang_failures_total counter' in prom
+    for cause in gang_lib.FAILURE_CAUSES:
+        assert (f'skytpu_gang_failures_total{{cause="{cause}"}} 0'
+                in prom), cause
+    assert '# TYPE skytpu_gang_heartbeat_age_seconds gauge' in prom
+    assert 'skytpu_gang_heartbeat_age_seconds 0' in prom
+    registry_lib.reset_registry()
+    try:
+        spec = gang_lib.GangSpec(gang_id='g-telemetry', rank=0, world=3)
+        gang_lib.GangCoordinator(spec)
+        prom = telemetry.get_registry().render_prometheus()
+    finally:
+        registry_lib.reset_registry()
+    assert 'skytpu_gang_size 3' in prom
+
+
 # ---------------------------------------------------------------------------
 # Registry: Prometheus exposition golden test
 # ---------------------------------------------------------------------------
@@ -467,6 +500,25 @@ def test_server_prometheus_metrics_and_debug_requests():
         assert 'skytpu_prefix_warmup_seconds_bucket{le="+Inf"}' in prom
         assert '# TYPE skytpu_spot_preemptions_total counter' in prom
         assert 'skytpu_spot_preemptions_total ' in prom
+        # (b6) Gang series (round 11): registered at ModelServer
+        # construction on gang and non-gang replicas alike, every
+        # failure cause pre-registered. (Zeros-from-fresh is pinned by
+        # test_gang_series_registered_at_construction on a reset
+        # registry — earlier tests in this process may have moved the
+        # shared series legitimately.)
+        from skypilot_tpu.serve import gang as gang_lib
+        assert '# TYPE skytpu_gang_size gauge' in prom
+        assert '# TYPE skytpu_gang_join_seconds histogram' in prom
+        assert 'skytpu_gang_join_seconds_bucket{le="+Inf"}' in prom
+        assert '# TYPE skytpu_gang_failures_total counter' in prom
+        for cause in gang_lib.FAILURE_CAUSES:
+            assert (f'skytpu_gang_failures_total{{cause="{cause}"}}'
+                    in prom), cause
+        assert '# TYPE skytpu_gang_heartbeat_age_seconds gauge' in prom
+        # JSON gang block: stable schema, non-gang truth.
+        assert m['gang']['world'] == 1
+        assert m['gang']['barrier'] is True
+        assert m['gang']['members'] == {}
         # JSON disagg block: stable schema, zeros when idle.
         assert m['disagg']['role'] == 'colocated'
         assert set(m['disagg']['handoffs']) == \
