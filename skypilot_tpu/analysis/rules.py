@@ -73,6 +73,13 @@ Concurrency / control-plane hygiene (GC1xx):
   wall-clock read re-introduces nondeterminism invisibly. Referencing
   ``time.time`` as an injectable default argument is the mechanism
   itself and stays legal — only *calls* are flagged.
+- **GC117 wallclock-in-simulator** — any ``time.time()`` /
+  ``time.monotonic()`` / ``time.sleep()`` (and *_ns/perf_counter
+  variants) call anywhere under ``serve/sim/``. The fleet simulator's
+  one time axis is the virtual clock (``EventLoop.now`` /
+  ``EventLoop.sleep``); a single wall-clock read or real sleep makes
+  same-seed runs diverge and silently breaks the byte-identical
+  event-log replay contract.
 
 TPU hot-path hygiene (GC2xx), applied to the compute layer
 (``inference/``, ``models/``, ``ops/``, ``train/``):
@@ -170,6 +177,12 @@ RULES: Dict[str, str] = {
              'the whole gang forever instead of failing it fast; '
              'every distributed join must carry a timeout (and '
              'jax.distributed.initialize an initialization_timeout)',
+    'GC117': 'wallclock-in-simulator: time.time()/time.monotonic()/'
+             'time.sleep() call under serve/sim/ — the fleet '
+             'simulator runs on the virtual clock ONLY (EventLoop.now'
+             '/EventLoop.sleep); one wall-clock read makes same-seed '
+             'runs diverge and silently breaks the byte-identical '
+             'event-log contract',
     'GC201': 'impure-jit: impure or host-synchronizing call inside a '
              '@jax.jit body',
     'GC202': 'host-sync: device->host readback outside the '
@@ -286,6 +299,24 @@ _SCALING_WALLCLOCK_BARE = {'monotonic'}   # from time import monotonic
 # carry initialization_timeout.
 GANG_PATH_SUFFIXES = ('serve/gang.py',)
 _GANG_JOIN_METHODS = {'wait', 'join', 'get', 'barrier'}
+
+# --------------------------------------------------------------------- GC117
+# The fleet simulator: deterministic virtual time ONLY. Any time.*
+# call here (including sleep — virtual sleeps go through
+# EventLoop.sleep / the env seam) desynchronizes same-seed replays.
+# Name references (e.g. passing a clock callable) stay legal, as do
+# method calls like loop.sleep(...) — only the time-module spellings
+# are flagged.
+SIM_PATH_MARKER = '/serve/sim/'
+_SIM_WALLCLOCK = {'time.time', 'time.monotonic', 'time.sleep',
+                  'time.perf_counter', 'time.perf_counter_ns',
+                  'time.time_ns', 'time.monotonic_ns',
+                  'time.process_time'}
+# from-import spellings flagged bare (ambiguous ones like 'sleep' and
+# 'time' are skipped — a sim module has no business importing them
+# from time either, but the dotted form is the realistic miss).
+_SIM_WALLCLOCK_BARE = {'monotonic', 'perf_counter', 'time_ns',
+                       'monotonic_ns'}
 
 # --------------------------------------------------------------------- GC109
 # Ad-hoc timing calls banned from inference/ hot paths: telemetry's
@@ -446,7 +477,8 @@ class _Checker(ast.NodeVisitor):
                  is_retryloop_dir: bool = False,
                  is_transfer_path: bool = False,
                  is_scaling_path: bool = False,
-                 is_gang_path: bool = False):
+                 is_gang_path: bool = False,
+                 is_sim_path: bool = False):
         self.rel = rel
         self.lines = lines
         self.is_compute = is_compute
@@ -457,6 +489,7 @@ class _Checker(ast.NodeVisitor):
         self.is_transfer_path = is_transfer_path
         self.is_scaling_path = is_scaling_path
         self.is_gang_path = is_gang_path
+        self.is_sim_path = is_sim_path
         self._flagged_sleeps: Set[int] = set()   # node ids (GC112 dedupe)
         self.violations: List[Violation] = []
         self._scope: List[str] = []
@@ -720,6 +753,8 @@ class _Checker(ast.NodeVisitor):
             self._check_wire_dtype(node, name, method)
         if self.is_scaling_path:
             self._check_scaling_clock(node, name)
+        if self.is_sim_path:
+            self._check_sim_wallclock(node, name)
         if self.is_gang_path:
             self._check_gang_join(node, name, method)
         if self.is_serve and self._in_async:
@@ -874,6 +909,20 @@ class _Checker(ast.NodeVisitor):
                       'self._clock) so scaling logic stays '
                       'deterministic under test')
 
+    def _check_sim_wallclock(self, node: ast.Call, name: str) -> None:
+        """GC117: a wall-clock read (or real sleep) inside the fleet
+        simulator. The sim's one time axis is the virtual clock
+        (``EventLoop.now``/``EventLoop.sleep``); a single ``time.*``
+        call makes same-seed runs diverge — silently, since the run
+        still *works*, it just stops being byte-replayable."""
+        if (name in _SIM_WALLCLOCK
+                or ('.' not in name and name in _SIM_WALLCLOCK_BARE)):
+            self._add('GC117', node,
+                      f'{name}() inside serve/sim/ — the simulator '
+                      'runs on the virtual clock only (EventLoop.now '
+                      '/ EventLoop.sleep); a wall-clock read breaks '
+                      'the byte-identical same-seed replay contract')
+
     def _check_adhoc_timing(self, node: ast.Call, name: str) -> None:
         if (name in _ADHOC_TIMING
                 or ('.' not in name and name in _ADHOC_TIMING_BARE)):
@@ -1019,7 +1068,8 @@ def check_source(rel: str, source: str) -> List[Violation]:
                            TRANSFER_PATH_SUFFIXES),
                        is_scaling_path=norm.endswith(
                            SCALING_PATH_SUFFIXES),
-                       is_gang_path=norm.endswith(GANG_PATH_SUFFIXES))
+                       is_gang_path=norm.endswith(GANG_PATH_SUFFIXES),
+                       is_sim_path=SIM_PATH_MARKER in f'/{norm}')
     checker.visit(tree)
     suppressed = _line_suppressions(source)
     out = []

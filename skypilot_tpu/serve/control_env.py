@@ -1,0 +1,274 @@
+"""ControlPlaneEnv: the simulator-or-live seam of the serve control
+plane.
+
+Every control-plane policy object (autoscalers, forecaster, placement,
+LB policies) is already pure and clock-injectable (graftcheck GC115).
+The *state machines* around them — the replica manager's launch /
+probe / drain / checkpoint / warmup / backfill flows and the
+controller tick — were not: they read the wall clock, slept, spawned
+threads, spoke HTTP to replica model servers and drove real cluster
+launches inline. This module is the one-time refactor ROADMAP item 5
+names as the unlock: the manager and controller take every one of
+those effects through a :class:`ControlPlaneEnv`, so the SAME
+unmodified state machines run either
+
+- **live** (:class:`LiveControlPlaneEnv`, the default — byte-for-byte
+  the calls the manager made before this refactor), or
+- **simulated** (``serve/sim/``'s ``SimControlPlaneEnv``): a virtual
+  clock, an event heap, synthetic replicas with calibrated service
+  curves, and deterministic seeded fault storms — 1000 replicas and
+  millions of requests in seconds of wall time.
+
+The seam is deliberately *effect-shaped*, not mock-shaped: methods
+are the irreducible outside-world touches (time, sleep, spawn, HTTP
+round-trips, cluster lifecycle, row persistence, fault-injector
+resolution), so the manager's logic — ordering, locking, status
+transitions, dedupe, backoff — is identical in both worlds and a sim
+regression is evidence about production behavior.
+"""
+from __future__ import annotations
+
+import json
+import random
+import threading
+import time
+import typing
+from typing import Any, Callable, Dict, List, Optional, Sequence
+
+if typing.TYPE_CHECKING:
+    from skypilot_tpu.serve import faults as faults_lib
+    from skypilot_tpu.task import Task
+
+
+class ControlPlaneEnv:
+    """Abstract effect surface of the serve control plane. Subclasses
+    implement the actual I/O; the manager/controller never touch the
+    wall clock, a socket, or a cluster API directly."""
+
+    name = 'abstract'
+
+    # ---------------------------------------------------------------- time
+    def time(self) -> float:
+        """Wall-clock seconds (virtual in sim). The autoscaler /
+        forecaster clocks are wired to this, so scaling decisions and
+        replica bookkeeping share one time axis."""
+        raise NotImplementedError
+
+    def monotonic(self) -> float:
+        """Monotonic seconds for durations (same axis as :meth:`time`
+        in sim — the virtual clock never steps backwards)."""
+        raise NotImplementedError
+
+    def sleep(self, seconds: float) -> None:
+        raise NotImplementedError
+
+    # --------------------------------------------------------- concurrency
+    def spawn(self, fn: Callable[..., None], *args: Any) -> None:
+        """Run ``fn(*args)`` as a background task (a daemon thread
+        live; a virtual-time logical thread in sim)."""
+        raise NotImplementedError
+
+    def run_parallel(self, fns: Sequence[Callable[[], None]]) -> None:
+        """Run every fn and return once ALL have finished
+        (terminate_all's fan-out teardown)."""
+        raise NotImplementedError
+
+    def rng(self) -> random.Random:
+        """RNG for jitter (launch backoff). Live: OS-seeded; sim: the
+        scenario seed, so backoff jitter replays deterministically."""
+        return random.Random()
+
+    # ---------------------------------------------------------------- HTTP
+    def http_json(self, url: str, payload: Optional[Dict[str, Any]] = None,
+                  timeout: float = 10.0) -> Any:
+        """One JSON round-trip against a replica model server: GET when
+        ``payload`` is None, else POST. Raises on transport errors and
+        non-2xx, exactly like ``urllib`` — the manager's error handling
+        is part of the state machine under test."""
+        raise NotImplementedError
+
+    def http_post_bytes(self, url: str, data: bytes,
+                        content_type: str = 'application/octet-stream',
+                        timeout: float = 30.0) -> bytes:
+        """POST raw bytes, return the raw response body (checkpoint
+        fetch / warmup push)."""
+        raise NotImplementedError
+
+    def probe_http(self, url: str, post_data: Optional[Dict[str, Any]],
+                   timeout: float) -> bool:
+        """One readiness probe: True iff the endpoint answered 2xx."""
+        raise NotImplementedError
+
+    # ----------------------------------------------------------- clusters
+    def launch_cluster(self, task: 'Task', cluster_name: str) -> None:
+        """Provision the replica's cluster (blocking; raises on
+        failure). In sim this burns the scenario's provision latency
+        on the virtual clock and registers a synthetic replica."""
+        raise NotImplementedError
+
+    def cluster_head_ip(self, cluster_name: str) -> Optional[str]:
+        """Head IP of a launched cluster (None: launch raced a
+        teardown and the handle is already gone)."""
+        raise NotImplementedError
+
+    def down_cluster(self, cluster_name: str) -> None:
+        """Tear the cluster down (raises ClusterDoesNotExist when it
+        is already gone — callers treat that as success)."""
+        raise NotImplementedError
+
+    def cluster_gone(self, cluster_name: str) -> bool:
+        """Preemption ground truth: True when the cluster no longer
+        exists or is not UP; False on a transient status-refresh
+        failure (keep probing)."""
+        raise NotImplementedError
+
+    # -------------------------------------------------------- persistence
+    def persist_replica(self, service_name: str, replica_id: int,
+                        cluster_name: str, status: Any,
+                        url: Optional[str], version: int, is_spot: bool,
+                        port: int) -> None:
+        """Write the replica row (sqlite live; no-op in sim — a
+        simulated fleet must never touch the operator's serve DB)."""
+        raise NotImplementedError
+
+    def remove_replica(self, service_name: str, replica_id: int) -> None:
+        raise NotImplementedError
+
+    # -------------------------------------------------------------- faults
+    def fault_injector(self) -> Optional['faults_lib.FaultInjector']:
+        """The deterministic fault injector components resolve once at
+        construction (None = hooks cost one attribute check). Live:
+        SKYTPU_FAULT_SPEC; sim: the scenario's injector."""
+        raise NotImplementedError
+
+
+class LiveControlPlaneEnv(ControlPlaneEnv):
+    """The production environment: exactly the calls
+    ``replica_managers.py`` made before the env refactor, verbatim."""
+
+    name = 'live'
+
+    # ---------------------------------------------------------------- time
+    def time(self) -> float:
+        return time.time()
+
+    def monotonic(self) -> float:
+        return time.monotonic()
+
+    def sleep(self, seconds: float) -> None:
+        time.sleep(seconds)
+
+    # --------------------------------------------------------- concurrency
+    def spawn(self, fn: Callable[..., None], *args: Any) -> None:
+        threading.Thread(target=fn, args=args, daemon=True).start()
+
+    def run_parallel(self, fns: Sequence[Callable[[], None]]) -> None:
+        threads = [threading.Thread(target=fn) for fn in fns]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+
+    # ---------------------------------------------------------------- HTTP
+    def http_json(self, url: str, payload: Optional[Dict[str, Any]] = None,
+                  timeout: float = 10.0) -> Any:
+        import urllib.request
+        if payload is None:
+            req = urllib.request.Request(url)
+        else:
+            req = urllib.request.Request(
+                url, data=json.dumps(payload).encode(),
+                headers={'Content-Type': 'application/json'})
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            return json.loads(resp.read())
+
+    def http_post_bytes(self, url: str, data: bytes,
+                        content_type: str = 'application/octet-stream',
+                        timeout: float = 30.0) -> bytes:
+        import urllib.request
+        req = urllib.request.Request(
+            url, data=data, headers={'Content-Type': content_type})
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            return resp.read()
+
+    def probe_http(self, url: str, post_data: Optional[Dict[str, Any]],
+                   timeout: float) -> bool:
+        import urllib.request
+        if post_data is not None:
+            req = urllib.request.Request(
+                url, data=json.dumps(post_data).encode(),
+                headers={'Content-Type': 'application/json'})
+        else:
+            req = urllib.request.Request(url)
+        with urllib.request.urlopen(req, timeout=timeout) as r:
+            return 200 <= r.status < 300
+
+    # ----------------------------------------------------------- clusters
+    def launch_cluster(self, task: 'Task', cluster_name: str) -> None:
+        from skypilot_tpu import execution
+        execution.launch(task, cluster_name=cluster_name,
+                         detach_run=True, retry_until_up=False)
+
+    def cluster_head_ip(self, cluster_name: str) -> Optional[str]:
+        from skypilot_tpu import global_state
+        handle = global_state.get_handle_from_cluster_name(cluster_name)
+        if handle is None:
+            return None
+        return handle.cluster_info.hosts[0].internal_ip
+
+    def down_cluster(self, cluster_name: str) -> None:
+        from skypilot_tpu import core
+        core.down(cluster_name)
+
+    def cluster_gone(self, cluster_name: str) -> bool:
+        from skypilot_tpu import global_state
+        from skypilot_tpu import tpu_logging
+        record = global_state.get_cluster_from_name(cluster_name)
+        if record is None:
+            return True
+        from skypilot_tpu.backend import backend_utils
+        try:
+            rec, _ = backend_utils.refresh_cluster_status(cluster_name)
+        except Exception as e:  # pylint: disable=broad-except
+            tpu_logging.init_logger(__name__).debug(
+                f'Status refresh of {cluster_name} failed (transient; '
+                f'keep probing): {type(e).__name__}: {e}')
+            return False
+        from skypilot_tpu import global_state as gs
+        return rec is None or rec['status'] != gs.ClusterStatus.UP
+
+    # -------------------------------------------------------- persistence
+    def persist_replica(self, service_name: str, replica_id: int,
+                        cluster_name: str, status: Any,
+                        url: Optional[str], version: int, is_spot: bool,
+                        port: int) -> None:
+        from skypilot_tpu.serve import serve_state
+        serve_state.add_or_update_replica(
+            service_name, replica_id, cluster_name, status, url,
+            version, is_spot, port=port)
+
+    def remove_replica(self, service_name: str, replica_id: int) -> None:
+        from skypilot_tpu.serve import serve_state
+        serve_state.remove_replica(service_name, replica_id)
+
+    # -------------------------------------------------------------- faults
+    def fault_injector(self) -> Optional['faults_lib.FaultInjector']:
+        from skypilot_tpu.serve import faults as faults_lib
+        return faults_lib.get_injector()
+
+
+_DEFAULT_ENV: Optional[LiveControlPlaneEnv] = None
+_DEFAULT_ENV_LOCK = threading.Lock()
+
+
+def default_env() -> LiveControlPlaneEnv:
+    """The shared live env (stateless; one instance is plenty)."""
+    global _DEFAULT_ENV
+    with _DEFAULT_ENV_LOCK:
+        if _DEFAULT_ENV is None:
+            _DEFAULT_ENV = LiveControlPlaneEnv()
+        return _DEFAULT_ENV
+
+
+def resolve(env: Optional[ControlPlaneEnv]) -> ControlPlaneEnv:
+    return env if env is not None else default_env()

@@ -18,6 +18,7 @@ from typing import Any, Dict, List, Optional
 
 from skypilot_tpu import tpu_logging
 from skypilot_tpu.serve import autoscalers
+from skypilot_tpu.serve import control_env
 from skypilot_tpu.serve import replica_managers
 from skypilot_tpu.serve import serve_state
 from skypilot_tpu.serve.service_spec import SkyServiceSpec
@@ -33,14 +34,22 @@ class ServeController:
 
     def __init__(self, service_name: str, spec: SkyServiceSpec,
                  task_config: Dict[str, Any], port: int,
-                 reserved_ports: Optional[set] = None):
+                 reserved_ports: Optional[set] = None,
+                 env: Optional[control_env.ControlPlaneEnv] = None):
         self.service_name = service_name
         self.spec = spec
         self.port = port
+        # The simulator-or-live seam (control_env.py): the manager's
+        # state machines and the autoscaler/forecaster clocks all draw
+        # from one environment, so a simulated controller tick is the
+        # SAME code on a virtual time axis.
+        self._env = control_env.resolve(env)
         self.replica_manager = replica_managers.ReplicaManager(
             service_name, spec, task_config,
-            reserved_ports=(reserved_ports or set()) | {port})
-        self.autoscaler = autoscalers.Autoscaler.from_spec(spec)
+            reserved_ports=(reserved_ports or set()) | {port},
+            env=self._env)
+        self.autoscaler = autoscalers.Autoscaler.from_spec(
+            spec, clock=self._env.time)
         self._stop = threading.Event()      # stops the autoscaler loop
         self._done = threading.Event()      # teardown fully finished
         self._httpd: Optional[http.server.ThreadingHTTPServer] = None
@@ -145,16 +154,28 @@ class ServeController:
         if status != record['status']:
             serve_state.set_service_status(self.service_name, status)
 
+    def tick(self, *, sync_state: bool = True) -> None:
+        """One controller evaluation: reconcile version, probe every
+        replica, evaluate + apply scaling, refresh the service row.
+        The live loop calls this on a wall-clock cadence; the fleet
+        simulator calls it on the virtual clock (``sync_state=False``
+        skips the sqlite-backed version/status reconciliation — a
+        simulated service has no DB row and must never touch the
+        operator's serve state)."""
+        if sync_state:
+            # Version reconciliation every tick: the update RPC's
+            # POST is only a nudge — if it was missed, the DB version
+            # must not stay permanently ahead of the running service.
+            self.apply_update()
+        self.replica_manager.probe_all()
+        self._autoscaler_step()
+        if sync_state:
+            self._update_service_status()
+
     def _loop(self) -> None:
         while not self._stop.is_set():
             try:
-                # Version reconciliation every tick: the update RPC's
-                # POST is only a nudge — if it was missed, the DB version
-                # must not stay permanently ahead of the running service.
-                self.apply_update()
-                self.replica_manager.probe_all()
-                self._autoscaler_step()
-                self._update_service_status()
+                self.tick()
             except Exception:  # pylint: disable=broad-except
                 logger.exception('controller loop error')
             self._stop.wait(_tick())

@@ -107,15 +107,34 @@ logger = tpu_logging.init_logger(__name__)
 FAULT_SPEC_ENV = 'SKYTPU_FAULT_SPEC'
 
 # The stable label set of skytpu_faults_injected_total{kind}.
+# 'zone_outage' and 'straggler' are the fleet-simulator storm kinds
+# (serve/sim/): a zone outage kills every replica in a zone at once; a
+# straggler degrades a replica's service rate without killing it.
 FAULT_KINDS = ('replica_crash', 'probe_timeout', 'slow_response',
-               'partial_response', 'engine_stall', 'preempt_signal')
+               'partial_response', 'engine_stall', 'preempt_signal',
+               'zone_outage', 'straggler')
 
 # Injection sites (for spec validation; the hook call sites are the
-# module docstring's list).
+# module docstring's list). The ``sim_*`` sites are fired by the fleet
+# simulator's scenario clock (serve/sim/fleet.py), once per storm
+# evaluation interval:
+# - ``sim_storm`` — correlated spot-preemption storm: kind
+#   ``preempt_signal`` with ``n`` kills the n most-recently-launched
+#   SPOT replicas at once (the correlated-failure mode independent
+#   per-replica rules can't express).
+# - ``sim_zone_outage`` — kind ``zone_outage`` with ``zone`` kills
+#   every replica placed in that zone in the same instant.
+# - ``sim_straggler`` — kind ``straggler`` with ``factor`` multiplies
+#   a replica's service time (slow HBM, noisy neighbor) without
+#   killing it — the failure mode load-aware routing must absorb.
+# - ``sim_gang_churn`` — kind ``replica_crash`` kills one gang
+#   FOLLOWER cluster (rank picked by ``rank``, default 1) — the
+#   one-dead-rank-dead-gang path at fleet scale.
 FAULT_SITES = ('engine_step', 'probe', 'preempt', 'preempt_warning',
                'proxy', 'proxy_stream', 'http_response', 'handoff',
                'spot_preemption', 'gang_member_crash',
-               'gang_join_timeout')
+               'gang_join_timeout', 'sim_storm', 'sim_zone_outage',
+               'sim_straggler', 'sim_gang_churn')
 
 # Outcomes of skytpu_requests_migrated_total{outcome}: a migrated
 # request either completed on a surviving replica or exhausted every
@@ -126,6 +145,19 @@ MIGRATION_OUTCOMES = ('completed', 'failed')
 class InjectedFault(RuntimeError):
     """Raised by a ``replica_crash`` rule: the component's normal
     fatal-error path runs, exactly as a real crash would drive it."""
+
+
+# Every key a rule dict may carry. Parse-time strictness matters more
+# here than anywhere else in the repo: a chaos spec with a typo'd
+# trigger field ("att": 3) would otherwise parse into a rule that
+# SILENTLY never fires — the test then passes because nothing was
+# injected, which is the exact false confidence a chaos suite exists
+# to kill.
+_RULE_FIELDS = ('kind', 'site', 'at', 'every', 'prob', 'count',
+                'delay_s', 'after_events', 'rank', 'n', 'zone',
+                'factor')
+# Top-level spec keys.
+_SPEC_FIELDS = ('seed', 'rules')
 
 
 @dataclasses.dataclass
@@ -139,6 +171,9 @@ class FaultRule:
     delay_s: float = 0.25             # stall/slow-response duration
     after_events: int = 0             # proxy_stream: break after N events
     rank: Optional[int] = None        # gang sites: target this rank only
+    n: int = 1                        # sim_storm: replicas per storm
+    zone: Optional[str] = None        # sim_zone_outage: zone to kill
+    factor: float = 4.0               # straggler: service-time multiplier
     fired: int = 0                    # bookkeeping (not a spec field)
 
     @classmethod
@@ -151,15 +186,45 @@ class FaultRule:
         if site not in FAULT_SITES:
             raise ValueError(f'unknown fault site {site!r}; supported: '
                              f'{FAULT_SITES}')
-        return cls(kind=kind, site=site,
-                   at=(int(d['at']) if d.get('at') else None),
-                   every=(int(d['every']) if d.get('every') else None),
+        unknown = sorted(set(d) - set(_RULE_FIELDS))
+        if unknown:
+            raise ValueError(
+                f'unknown fault-rule field(s) {unknown} in rule '
+                f'{{kind={kind!r}, site={site!r}}}; supported: '
+                f'{_RULE_FIELDS} (a typo here would otherwise make '
+                'the rule silently never fire)')
+        def _opt_int(key: str) -> Optional[int]:
+            # Presence-based (not truthiness): an explicit 0 must hit
+            # the range validation below, not silently become "unset".
+            return (int(d[key]) if key in d and d[key] is not None
+                    else None)
+
+        rule = cls(kind=kind, site=site,
+                   at=_opt_int('at'),
+                   every=_opt_int('every'),
                    prob=float(d.get('prob', 0.0)),
-                   count=(int(d['count']) if d.get('count') else None),
+                   count=_opt_int('count'),
                    delay_s=float(d.get('delay_s', 0.25)),
                    after_events=int(d.get('after_events', 0)),
                    rank=(int(d['rank']) if 'rank' in d
-                         and d['rank'] is not None else None))
+                         and d['rank'] is not None else None),
+                   n=max(1, int(d.get('n', 1))),
+                   zone=(str(d['zone']) if d.get('zone') is not None
+                         else None),
+                   factor=float(d.get('factor', 4.0)))
+        if rule.at is None and rule.every is None and rule.prob <= 0.0:
+            raise ValueError(
+                f'fault rule {{kind={kind!r}, site={site!r}}} has no '
+                "trigger: set at least one of 'at' (Nth invocation), "
+                "'every' (every Nth) or 'prob' (seeded probability) — "
+                'a trigger-less rule never fires')
+        if not 0.0 <= rule.prob <= 1.0:
+            raise ValueError(f'prob must be in [0, 1], got {rule.prob}')
+        if rule.at is not None and rule.at < 1:
+            raise ValueError(f'at is 1-based, got {rule.at}')
+        if rule.every is not None and rule.every < 1:
+            raise ValueError(f'every must be >= 1, got {rule.every}')
+        return rule
 
 
 class FaultInjector:
@@ -170,6 +235,11 @@ class FaultInjector:
     drives ``prob``."""
 
     def __init__(self, spec: Dict[str, Any]):
+        unknown = sorted(set(spec) - set(_SPEC_FIELDS))
+        if unknown:
+            raise ValueError(
+                f'unknown fault-spec key(s) {unknown}; supported: '
+                f'{_SPEC_FIELDS}')
         self.seed = int(spec.get('seed', 0))
         self._rng = random.Random(self.seed)
         self._rules: List[FaultRule] = [
@@ -188,14 +258,19 @@ class FaultInjector:
         """Count one invocation of ``site``; return the first rule
         that fires there (and record it in telemetry), else None.
         ``rank`` (the gang sites) scopes rank-targeted rules: a rule
-        with ``rank`` set only fires on that rank's invocations."""
+        with ``rank`` set only fires on that rank's invocations. An
+        UNSCOPED invocation (``rank=None`` — e.g. the fleet
+        simulator's storm clock, which picks the victim rank FROM the
+        rule) matches every rule; only a caller that declares its own
+        rank filters rank-targeted rules."""
         with self._lock:
             n = self._site_counts.get(site, 0) + 1
             self._site_counts[site] = n
             for rule in self._rules:
                 if rule.site != site:
                     continue
-                if rule.rank is not None and rank != rule.rank:
+                if (rule.rank is not None and rank is not None
+                        and rank != rule.rank):
                     continue
                 if rule.count is not None and rule.fired >= rule.count:
                     continue

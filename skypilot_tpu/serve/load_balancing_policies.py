@@ -9,7 +9,7 @@ from __future__ import annotations
 import json
 import threading
 import urllib.request
-from typing import Dict, List, Optional, Set, Tuple
+from typing import Callable, Dict, List, Optional, Set, Tuple
 
 from skypilot_tpu import tpu_logging
 from skypilot_tpu.telemetry import clock
@@ -22,6 +22,26 @@ class LoadBalancingPolicy:
     def __init__(self) -> None:
         self.ready_replicas: List[str] = []
         self._lock = threading.Lock()
+        # Simulator-or-live transport seam (serve/sim/): probing
+        # policies fetch replica /metrics JSON through ``_fetch_json``
+        # and age their probe caches on ``_monotonic``. The defaults
+        # are the live urllib/clock paths; ``configure_transport``
+        # swaps both so the UNMODIFIED selection/scoring logic runs
+        # against simulated replicas on a virtual clock.
+        self._fetch_json: Optional[Callable[[str], Dict]] = None
+        self._monotonic: Callable[[], float] = clock.monotonic
+
+    def configure_transport(
+            self, fetch_json: Optional[Callable[[str], Dict]] = None,
+            monotonic: Optional[Callable[[], float]] = None) -> None:
+        """Inject the probe transport and clock (fleet simulator /
+        tests). ``fetch_json(url)`` returns the parsed JSON a live
+        probe would (and raises on failure); ``monotonic`` must never
+        step backwards."""
+        if fetch_json is not None:
+            self._fetch_json = fetch_json
+        if monotonic is not None:
+            self._monotonic = monotonic
 
     def set_ready_replicas(self, urls: List[str]) -> None:
         with self._lock:
@@ -194,10 +214,13 @@ class QueueDepthPolicy(LoadBalancingPolicy):
         ``None`` tokens = probe failed (the replica scores by dispatch
         count alone)."""
         try:
-            with urllib.request.urlopen(
-                    f'{url}/metrics?format=json',
-                    timeout=self.PROBE_TIMEOUT_S) as resp:
-                payload = json.loads(resp.read())
+            if self._fetch_json is not None:
+                payload = self._fetch_json(f'{url}/metrics?format=json')
+            else:
+                with urllib.request.urlopen(
+                        f'{url}/metrics?format=json',
+                        timeout=self.PROBE_TIMEOUT_S) as resp:
+                    payload = json.loads(resp.read())
             return int(payload['queue_tokens_total']), payload
         except Exception as e:  # pylint: disable=broad-except
             logger.debug(f'queue-depth probe failed for {url}: '
@@ -212,14 +235,14 @@ class QueueDepthPolicy(LoadBalancingPolicy):
         rank would double-count the gang's load and hammer processes
         that serve no HTTP at all."""
         with self._lock:
-            now = clock.monotonic()
+            now = self._monotonic()
             followers = self._followers_locked()
             stale = [u for u in candidates
                      if u not in followers
                      and self._cache.get(u, (0.0, None))[0] <= now]
         fresh = {u: self._probe(u) for u in stale}
         with self._lock:
-            expiry = clock.monotonic() + self.PROBE_TTL_S
+            expiry = self._monotonic() + self.PROBE_TTL_S
             for u, (tokens, payload) in fresh.items():
                 self._cache[u] = (expiry, tokens)
                 if payload is not None:

@@ -13,26 +13,28 @@ TPU-first: a replica is a whole slice; its head IP is the slice's worker-0
 and the in-tree model server (multi-controller JAX) listens there. On the
 local provider each replica gets its own port (many replicas share one
 host) — injected as ``SKYTPU_REPLICA_PORT`` either way.
+
+Environment seam (``serve/control_env.py``): every outside-world touch
+— wall clock, sleeps, background tasks, replica HTTP, cluster
+launch/teardown/status, row persistence, fault-injector resolution —
+routes through the injected :class:`ControlPlaneEnv`. The default
+:class:`LiveControlPlaneEnv` reproduces the pre-refactor behavior
+verbatim; ``serve/sim/`` swaps in a virtual-clock environment so the
+SAME launch/probe/drain/checkpoint/warmup/backfill state machines run
+against 1000 simulated replicas at millions of requests per wall-second
+(ROADMAP item 5's fleet-scale simulator).
 """
 from __future__ import annotations
 
-import json
-import os
-import random
 import threading
 import time
 import typing
 from typing import Dict, List, Optional
-import urllib.error
-import urllib.request
 
-from skypilot_tpu import core
 from skypilot_tpu import exceptions
-from skypilot_tpu import execution
-from skypilot_tpu import global_state
 from skypilot_tpu import telemetry
 from skypilot_tpu import tpu_logging
-from skypilot_tpu.serve import faults as faults_lib
+from skypilot_tpu.serve import control_env
 from skypilot_tpu.serve import serve_state
 from skypilot_tpu.task import Task
 from skypilot_tpu.utils import common_utils
@@ -54,12 +56,14 @@ _BACKOFF_JITTER_FRAC = 0.5
 
 
 def _launch_backoff_base() -> float:
+    import os
     return float(os.environ.get('SKYTPU_SERVE_LAUNCH_BACKOFF', '5'))
 
 
 def _drain_deadline_default() -> float:
     """Graceful-drain deadline before a draining replica is torn down
     regardless (in-flight requests past it fail over via the LB)."""
+    import os
     return float(os.environ.get('SKYTPU_SERVE_DRAIN_S', '30'))
 
 
@@ -67,6 +71,7 @@ def _warmup_timeout() -> float:
     """Bound on the prefix-cache warmup POST against a freshly READY
     replica (a wedged warmup must not keep capacity out of rotation —
     past it the replica enters rotation cold)."""
+    import os
     return float(os.environ.get('SKYTPU_SERVE_WARMUP_TIMEOUT', '30'))
 
 
@@ -74,6 +79,7 @@ def _gang_join_timeout() -> float:
     """Barrier bound shipped to every gang rank: unless all ranks join
     rank 0 within this window, the gang fails and is replaced as one
     unit."""
+    import os
     return float(os.environ.get('SKYTPU_GANG_JOIN_TIMEOUT', '120'))
 
 
@@ -81,6 +87,7 @@ def _ckpt_ttl() -> float:
     """Checkpoint staleness bound: prefix KV older than this is not
     worth shipping to a recovered replica (the traffic that made those
     prefixes hot has moved on)."""
+    import os
     return float(os.environ.get('SKYTPU_SERVE_CKPT_TTL', '3600'))
 
 
@@ -105,7 +112,8 @@ class ReplicaInfo:
     def __init__(self, replica_id: int, cluster_name: str, version: int,
                  is_spot: bool, port: int, role: str = 'colocated',
                  gang_id: Optional[str] = None, gang_rank: int = 0,
-                 gang_world: int = 1):
+                 gang_world: int = 1,
+                 created_time: Optional[float] = None):
         self.replica_id = replica_id
         self.cluster_name = cluster_name
         self.version = version
@@ -132,12 +140,14 @@ class ReplicaInfo:
         self.first_probe_time: Optional[float] = None
         # Spot resilience bookkeeping: when the scale-up was issued
         # (provision-latency observation — the forecast autoscaler's
-        # pre-scaling lead time learns from these), whether this
-        # replica's prefix cache was already checkpointed on a
-        # preemption warning (idempotence under a racing drain), and
-        # whether its replacement warmup already ran (once per
+        # pre-scaling lead time learns from these; the manager stamps
+        # its env clock so simulated fleets observe virtual latencies),
+        # whether this replica's prefix cache was already checkpointed
+        # on a preemption warning (idempotence under a racing drain),
+        # and whether its replacement warmup already ran (once per
         # replica, BEFORE it first enters ready_urls).
-        self.created_time = time.time()
+        self.created_time = (created_time if created_time is not None
+                             else time.time())
         self.checkpointed = False
         self.warmed = False
 
@@ -146,12 +156,17 @@ class ReplicaManager:
 
     def __init__(self, service_name: str, spec: 'SkyServiceSpec',
                  task_config: dict, version: int = 1,
-                 reserved_ports: Optional[set] = None):
+                 reserved_ports: Optional[set] = None,
+                 env: Optional[control_env.ControlPlaneEnv] = None):
         self.service_name = service_name
         self.spec = spec
         self.task_config = task_config
         self.version = version
         self._reserved_ports = set(reserved_ports or ())
+        # The simulator-or-live effect seam: every clock read, sleep,
+        # background task, replica HTTP round-trip, cluster op and row
+        # write below goes through this (control_env.py).
+        self._env = control_env.resolve(env)
         self._replicas: Dict[int, ReplicaInfo] = {}
         self._next_replica_id = 1
         # RLock: _persist checks membership under the lock and is called
@@ -169,16 +184,18 @@ class ReplicaManager:
         self._shutdown = False
         self._launch_failures = 0
         self._backoff_until = 0.0
-        # Backoff jitter source (tests seed it for determinism).
-        self._rng = random.Random()
+        # Backoff jitter source (tests seed it for determinism; the
+        # sim env hands out a scenario-seeded RNG).
+        self._rng = self._env.rng()
         # Deterministic fault injection (serve/faults.py): resolved
-        # once from SKYTPU_FAULT_SPEC; None = hooks are one attribute
-        # check. Sites here: 'probe' (probe_timeout), 'preempt'
+        # once from the env (SKYTPU_FAULT_SPEC live; the scenario's
+        # injector in sim); None = hooks are one attribute check.
+        # Sites here: 'probe' (probe_timeout), 'preempt'
         # (preempt_signal — hard kill), 'preempt_warning'
         # (preempt_signal with advance notice — routes through drain),
         # 'spot_preemption' (counted per swept SPOT replica only —
         # seeded spot-kill schedules for chaos tests and the bench).
-        self._faults = faults_lib.get_injector()
+        self._faults = self._env.fault_injector()
         # Spot resilience: the latest prefix-cache checkpoint exported
         # by a preemption-warned replica (bytes + export wall time;
         # latest wins, TTL-bounded), landed into replacement replicas
@@ -194,6 +211,10 @@ class ReplicaManager:
         # exactly once — the per-ReplicaInfo flag alone can't see that
         # the gang already checkpointed through another member. Guarded
         # by the manager lock like the per-replica flag it generalizes.
+        # BOUNDED: entries are evicted in ``_untrack`` when the replica
+        # (or the last member of the gang) is torn down, so a
+        # long-lived manager churning thousands of spot replicas holds
+        # only live keys.
         self._ckpt_done: Dict[str, bool] = {}
         # Provision-latency observations (scale-up issued -> READY)
         # not yet consumed by the controller; the forecast autoscaler
@@ -297,7 +318,8 @@ class ReplicaManager:
                                self._replica_cluster_name(replica_id),
                                self.version, use_spot, port, role=role,
                                gang_id=gang_id, gang_rank=0,
-                               gang_world=world)
+                               gang_world=world,
+                               created_time=self._env.time())
             info.status = serve_state.ReplicaStatus.PROVISIONING
             self._replicas[replica_id] = info
             followers: List[ReplicaInfo] = []
@@ -308,7 +330,8 @@ class ReplicaManager:
                 finfo = ReplicaInfo(
                     fid, self._replica_cluster_name(fid),
                     self.version, use_spot, fport, role=role,
-                    gang_id=gang_id, gang_rank=rank, gang_world=world)
+                    gang_id=gang_id, gang_rank=rank, gang_world=world,
+                    created_time=self._env.time())
                 finfo.status = serve_state.ReplicaStatus.PROVISIONING
                 self._replicas[fid] = finfo
                 followers.append(finfo)
@@ -318,8 +341,7 @@ class ReplicaManager:
         # Rank 0 launches first: followers need its resolved address
         # as their SKYTPU_COORDINATOR (_launch_replica fans them out
         # once rank 0 reaches STARTING).
-        threading.Thread(target=self._launch_replica,
-                         args=(info,), daemon=True).start()
+        self._env.spawn(self._launch_replica, info)
         return replica_id
 
     def shutdown(self) -> None:
@@ -332,14 +354,14 @@ class ReplicaManager:
         (exponential backoff so a persistent failure — quota, bad image —
         doesn't spin up a doomed launch every controller tick)."""
         with self._lock:
-            return time.time() < self._backoff_until
+            return self._env.time() < self._backoff_until
 
     def backoff_remaining(self) -> float:
         """Seconds until launches resume (0 when not backing off) —
         the controller ships this to the LB as the Retry-After hint on
         the no-ready-replicas 503."""
         with self._lock:
-            return max(0.0, self._backoff_until - time.time())
+            return max(0.0, self._backoff_until - self._env.time())
 
     def retry_after_hint(self) -> int:
         """Whole-second Retry-After for clients hitting the service
@@ -383,8 +405,7 @@ class ReplicaManager:
     def _launch_replica(self, info: ReplicaInfo) -> None:
         task = self._replica_task(info)
         try:
-            execution.launch(task, cluster_name=info.cluster_name,
-                             detach_run=True, retry_until_up=False)
+            self._env.launch_cluster(task, info.cluster_name)
         except Exception as e:  # pylint: disable=broad-except
             logger.warning(f'Replica {info.replica_id} launch failed: '
                            f'{type(e).__name__}: {e}')
@@ -402,7 +423,7 @@ class ReplicaManager:
             logger.info(f'Replica {info.replica_id} was removed during '
                         'launch; tearing its cluster down.')
             try:
-                core.down(info.cluster_name)
+                self._env.down_cluster(info.cluster_name)
             except Exception as e:  # pylint: disable=broad-except
                 logger.warning(
                     f'Teardown of abandoned replica cluster '
@@ -410,11 +431,10 @@ class ReplicaManager:
                     f'{type(e).__name__}: {e}')
             self._untrack(info.replica_id)
             return
-        handle = global_state.get_handle_from_cluster_name(info.cluster_name)
-        if handle is None:
+        head_ip = self._env.cluster_head_ip(info.cluster_name)
+        if head_ip is None:
             self._record_launch_result(info, failed=True)
             return
-        head_ip = handle.cluster_info.hosts[0].internal_ip
         with self._lock:
             # Re-check under the lock: a scale_down between the abandoned
             # check above and here must not have its SHUTTING_DOWN status
@@ -423,7 +443,7 @@ class ReplicaManager:
                 return
             info.url = f'http://{head_ip}:{info.port}'
             info.status = serve_state.ReplicaStatus.STARTING
-            info.first_probe_time = time.time()
+            info.first_probe_time = self._env.time()
             followers = ([r for r in self._replicas.values()
                           if info.gang_id is not None
                           and r.gang_id == info.gang_id
@@ -441,8 +461,7 @@ class ReplicaManager:
         # barrier — rank 0's /readiness stays 503 until every rank
         # joins within SKYTPU_GANG_JOIN_TIMEOUT.
         for f in followers:
-            threading.Thread(target=self._launch_replica,
-                             args=(f,), daemon=True).start()
+            self._env.spawn(self._launch_replica, f)
         self._record_launch_result(info, failed=False)
 
     def _record_launch_result(self, info: ReplicaInfo, failed: bool) -> None:
@@ -455,7 +474,7 @@ class ReplicaManager:
         info.status = serve_state.ReplicaStatus.FAILED
         self._persist(info)
         try:      # a launch can fail after partially creating the cluster
-            core.down(info.cluster_name)
+            self._env.down_cluster(info.cluster_name)
         except exceptions.ClusterDoesNotExist:
             pass
         except Exception as e:  # pylint: disable=broad-except
@@ -485,7 +504,7 @@ class ReplicaManager:
             # (and the cap as a hard ceiling).
             delay *= (_BACKOFF_JITTER_FRAC
                       + (1.0 - _BACKOFF_JITTER_FRAC) * self._rng.random())
-            self._backoff_until = time.time() + delay
+            self._backoff_until = self._env.time() + delay
             # Keep only the newest few FAILED rows (status/debugging);
             # older ones would otherwise accumulate one per retry forever.
             failed_ids = sorted(
@@ -607,8 +626,7 @@ class ReplicaManager:
                     + (f' (gang {info.gang_id})' if info.gang_id
                        else '')
                     + f' (deadline {deadline_s:.0f}s).')
-        threading.Thread(target=self._drain_then_down,
-                         args=(info, deadline_s), daemon=True).start()
+        self._env.spawn(self._drain_then_down, info, deadline_s)
         return True
 
     def _drain_then_down(self, info: ReplicaInfo,
@@ -627,16 +645,15 @@ class ReplicaManager:
         drain status until drained or the deadline. A replica whose
         server doesn't implement the drain contract (no ``draining``
         key in the response) tears down immediately — there is nothing
-        to wait for."""
+        to wait for. Deadline stragglers (a replica that never reports
+        ``drained``) are torn down at exactly the deadline; their
+        in-flight requests fail over through the LB's recovery path."""
         assert info.url is not None
-        deadline = time.monotonic() + deadline_s
+        deadline = self._env.monotonic() + deadline_s
         try:
-            req = urllib.request.Request(
-                info.url + '/drain',
-                data=json.dumps({'deadline_s': deadline_s}).encode(),
-                headers={'Content-Type': 'application/json'})
-            with urllib.request.urlopen(req, timeout=10) as resp:
-                payload = json.loads(resp.read())
+            payload = self._env.http_json(
+                info.url + '/drain', {'deadline_s': deadline_s},
+                timeout=10)
         except Exception as e:  # pylint: disable=broad-except
             logger.warning(f'Drain request to replica '
                            f'{info.replica_id} failed '
@@ -646,11 +663,10 @@ class ReplicaManager:
             logger.info(f'Replica {info.replica_id} has no drain '
                         'support; tearing down immediately.')
             return
-        while time.monotonic() < deadline:
+        while self._env.monotonic() < deadline:
             try:
-                with urllib.request.urlopen(info.url + '/drain',
-                                            timeout=10) as resp:
-                    status = json.loads(resp.read())
+                status = self._env.http_json(info.url + '/drain',
+                                             timeout=10)
                 if status.get('drained'):
                     logger.info(
                         f'Replica {info.replica_id} drained cleanly.')
@@ -661,8 +677,14 @@ class ReplicaManager:
                                f'({type(e).__name__}: {e}); assuming '
                                'gone')
                 return
-            # Jittered poll (graftcheck GC112: no fixed-sleep loops).
-            time.sleep(0.25 * (0.5 + self._rng.random()))
+            # Jittered poll (graftcheck GC112: no fixed-sleep loops),
+            # bounded by the remaining deadline so the teardown lands
+            # AT the deadline, not one poll interval past it.
+            remaining = deadline - self._env.monotonic()
+            if remaining <= 0:
+                break
+            self._env.sleep(min(remaining,
+                                0.25 * (0.5 + self._rng.random())))
         logger.warning(f'Replica {info.replica_id} drain deadline '
                        f'({deadline_s:.0f}s) exceeded; tearing down '
                        '(stragglers fail over through the LB).')
@@ -713,11 +735,9 @@ class ReplicaManager:
             self._ckpt_done[key] = True
             info.checkpointed = True
         try:
-            req = urllib.request.Request(
-                info.url + '/checkpoint', data=json.dumps({}).encode(),
-                headers={'Content-Type': 'application/json'})
-            with urllib.request.urlopen(req, timeout=30) as resp:
-                blob = resp.read()
+            blob = self._env.http_post_bytes(
+                info.url + '/checkpoint', b'{}',
+                content_type='application/json', timeout=30)
         except Exception as e:  # pylint: disable=broad-except
             logger.warning(f'Checkpoint of replica {info.replica_id} '
                            f'failed ({type(e).__name__}: {e}); its '
@@ -728,7 +748,7 @@ class ReplicaManager:
             return
         with self._ckpt_lock:
             self._ckpt_bytes = blob
-            self._ckpt_time = time.time()
+            self._ckpt_time = self._env.time()
         logger.info(f'Checkpointed replica {info.replica_id}: '
                     f'{len(blob)} byte(s) of prefix-cache state.')
 
@@ -738,7 +758,7 @@ class ReplicaManager:
         with self._ckpt_lock:
             if self._ckpt_bytes is None:
                 return None
-            if time.time() - self._ckpt_time > _ckpt_ttl():
+            if self._env.time() - self._ckpt_time > _ckpt_ttl():
                 return None
             return self._ckpt_bytes
 
@@ -755,21 +775,21 @@ class ReplicaManager:
         blob = self.checkpoint_for_warmup()
         if blob is None or info.url is None:
             return
-        t0 = time.monotonic()
+        t0 = self._env.monotonic()
         try:
-            req = urllib.request.Request(
-                info.url + '/kv/warmup', data=blob,
-                headers={'Content-Type': 'application/octet-stream'})
-            with urllib.request.urlopen(
-                    req, timeout=_warmup_timeout()) as resp:
-                payload = json.loads(resp.read())
+            import json as _json
+            body = self._env.http_post_bytes(
+                info.url + '/kv/warmup', blob,
+                content_type='application/octet-stream',
+                timeout=_warmup_timeout())
+            payload = _json.loads(body)
         except Exception as e:  # pylint: disable=broad-except
             logger.warning(f'Prefix warmup of replica '
                            f'{info.replica_id} failed '
                            f'({type(e).__name__}: {e}); entering '
                            'rotation cold')
             return
-        dur = time.monotonic() - t0
+        dur = self._env.monotonic() - t0
         self._h_warmup.observe(dur)
         logger.info(
             f'Replica {info.replica_id} prefix-warmed in {dur:.2f}s: '
@@ -807,7 +827,7 @@ class ReplicaManager:
 
         def _down():
             try:
-                core.down(info.cluster_name)
+                self._env.down_cluster(info.cluster_name)
             except exceptions.ClusterDoesNotExist:
                 pass
             except Exception as e:  # pylint: disable=broad-except
@@ -815,25 +835,22 @@ class ReplicaManager:
                                f'{type(e).__name__}: {e}')
             self._untrack(replica_id)  # atomic vs _persist (see _db_lock)
 
-        threading.Thread(target=_down, daemon=True).start()
+        self._env.spawn(_down)
 
     def terminate_all(self) -> None:
         with self._lock:
             ids = list(self._replicas)
-        threads = []
+        fns = []
         for rid in ids:
             info = self._replicas.get(rid)
             if info is None:
                 continue
-            t = threading.Thread(target=self._sync_down, args=(info,))
-            t.start()
-            threads.append(t)
-        for t in threads:
-            t.join()
+            fns.append(lambda i=info: self._sync_down(i))
+        self._env.run_parallel(fns)
 
     def _sync_down(self, info: ReplicaInfo) -> None:
         try:
-            core.down(info.cluster_name)
+            self._env.down_cluster(info.cluster_name)
         except Exception as e:  # pylint: disable=broad-except
             logger.warning(f'Teardown of {info.cluster_name} during '
                            f'terminate_all failed (it may leak): '
@@ -849,23 +866,16 @@ class ReplicaManager:
                 # Injected probe timeout: burn (a bounded slice of) the
                 # timeout, then report failure — the consecutive-
                 # failure escalation runs exactly as for a real one.
-                time.sleep(min(rule.delay_s,
-                               self.spec.readiness_timeout_seconds))
+                self._env.sleep(min(rule.delay_s,
+                                    self.spec.readiness_timeout_seconds))
                 logger.warning(f'Probe of replica {info.replica_id} '
                                'failed (injected probe_timeout)')
                 return False
         url = info.url + self.spec.readiness_path
         try:
-            if self.spec.post_data is not None:
-                data = json.dumps(self.spec.post_data).encode()
-                req = urllib.request.Request(
-                    url, data=data,
-                    headers={'Content-Type': 'application/json'})
-            else:
-                req = urllib.request.Request(url)
-            with urllib.request.urlopen(
-                    req, timeout=self.spec.readiness_timeout_seconds) as r:
-                return 200 <= r.status < 300
+            return self._env.probe_http(
+                url, self.spec.post_data,
+                self.spec.readiness_timeout_seconds)
         except Exception as e:  # pylint: disable=broad-except
             # Routine while a replica boots; the consecutive-failure
             # counters escalate, but the reason must stay observable.
@@ -882,18 +892,7 @@ class ReplicaManager:
                 logger.warning(f'Replica {info.replica_id} preempted '
                                '(injected preempt_signal)')
                 return True
-        record = global_state.get_cluster_from_name(info.cluster_name)
-        if record is None:
-            return True
-        from skypilot_tpu.backend import backend_utils
-        try:
-            rec, _ = backend_utils.refresh_cluster_status(info.cluster_name)
-        except Exception as e:  # pylint: disable=broad-except
-            logger.debug(f'Status refresh of {info.cluster_name} failed '
-                         f'(transient; keep probing): '
-                         f'{type(e).__name__}: {e}')
-            return False
-        return rec is None or rec['status'] != global_state.ClusterStatus.UP
+        return self._env.cluster_gone(info.cluster_name)
 
     def probe_all(self) -> None:
         """One probe sweep (reference ``_probe_all_replicas`` ``:1026``)."""
@@ -972,12 +971,13 @@ class ReplicaManager:
                                 f'{info.url}.')
                     _transition_counter('READY').inc()
                     self._h_provision.observe(
-                        max(0.0, time.time() - info.created_time))
+                        max(0.0, self._env.time() - info.created_time))
                     with self._lock:     # a replica serves: reset backoff
                         self._launch_failures = 0
                         self._backoff_until = 0.0
                         self._provision_obs.append(
-                            max(0.0, time.time() - info.created_time))
+                            max(0.0,
+                                self._env.time() - info.created_time))
                 info.status = serve_state.ReplicaStatus.READY
                 self._persist(info)
                 self._mirror_gang_ready(info)
@@ -985,7 +985,7 @@ class ReplicaManager:
             # Probe failed on a live cluster.
             _probe_counter('failure').inc()
             if info.status == serve_state.ReplicaStatus.STARTING:
-                elapsed = time.time() - (info.first_probe_time or 0)
+                elapsed = self._env.time() - (info.first_probe_time or 0)
                 if elapsed > self.spec.initial_delay_seconds:
                     logger.warning(
                         f'Replica {info.replica_id} failed to become ready '
@@ -1074,15 +1074,26 @@ class ReplicaManager:
             with self._lock:
                 if self._replicas.get(info.replica_id) is not info:
                     return
-            serve_state.add_or_update_replica(
+            self._env.persist_replica(
                 self.service_name, info.replica_id, info.cluster_name,
                 info.status, info.url, info.version, info.is_spot,
                 port=info.port)
 
     def _untrack(self, replica_id: int) -> None:
         """Atomically drop a replica from the in-memory table AND its
-        DB row (the removal half of the ``_persist`` protocol)."""
+        DB row (the removal half of the ``_persist`` protocol). Also
+        evicts the checkpoint-dedupe key once the replica — or the
+        LAST member of its gang — is gone, so ``_ckpt_done`` stays
+        bounded by the number of LIVE replicas/gangs no matter how
+        many thousands churn through a long-lived manager."""
         with self._db_lock:
             with self._lock:
-                self._replicas.pop(replica_id, None)
-            serve_state.remove_replica(self.service_name, replica_id)
+                info = self._replicas.pop(replica_id, None)
+                if info is not None:
+                    if info.gang_id is None:
+                        self._ckpt_done.pop(f'replica-{replica_id}',
+                                            None)
+                    elif not any(r.gang_id == info.gang_id
+                                 for r in self._replicas.values()):
+                        self._ckpt_done.pop(info.gang_id, None)
+            self._env.remove_replica(self.service_name, replica_id)

@@ -1,0 +1,218 @@
+"""Deterministic discrete-event core: virtual clock, event heap, and
+*logical tasks* that let real blocking-style control-plane code (the
+replica manager's drain polls, launch flows, probe sweeps) run
+unmodified on virtual time.
+
+Two execution shapes share one time axis:
+
+- **Callbacks** — pure event handlers (arrivals, completions, LB
+  syncs, storm checks). Scheduled with :meth:`EventLoop.schedule`; run
+  inline in the loop thread; MUST NOT sleep.
+- **Logical tasks** — real functions containing ``env.sleep`` calls
+  (the manager's ``_drain_then_down``, ``_launch_replica``, the
+  controller tick loop). Spawned with :meth:`EventLoop.spawn`; each
+  runs on its own OS thread, but the loop enforces strict
+  one-runner-at-a-time token handoff: a task runs until it sleeps or
+  finishes, the loop resumes only then, and a sleeping task wakes
+  exactly at its virtual deadline in heap order. Execution is
+  therefore fully serialized and **deterministic** — same seed, same
+  schedule, byte-identical event sequence — while the manager's
+  threading.Lock/RLock discipline keeps working untouched (locks are
+  simply never contended).
+
+Determinism contract: ties on the virtual timestamp break by schedule
+order (a monotone sequence number); no wall-clock reads anywhere
+(graftcheck GC117 gates the whole ``serve/sim/`` package); randomness
+only ever comes from seeds the caller passes in.
+
+The real-time ``timeout=`` arguments on the internal handoff waits are
+deadlock insurance, not a time source: a task that blocks on something
+the loop can never produce raises :class:`SimWedged` instead of
+hanging the test suite.
+"""
+from __future__ import annotations
+
+import heapq
+import threading
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+# Real-seconds bound on one scheduler<->task handoff. Generous: a
+# handoff is one context switch plus the task's pure-Python run slice
+# (no I/O, no device work). Hitting it means a logical task wedged on
+# something outside the loop — a bug, surfaced as SimWedged instead of
+# a hung pytest.
+_HANDOFF_TIMEOUT_S = 120.0
+
+
+class SimShutdown(BaseException):
+    """Raised inside a logical task when the loop shuts down while the
+    task is parked (BaseException so ``except Exception`` retry loops
+    in control-plane code can't swallow the unwind)."""
+
+
+class SimWedged(RuntimeError):
+    """A scheduler<->task handoff timed out in real time."""
+
+
+class _Task:
+    """One logical task: a real thread, token-stepped by the loop."""
+
+    __slots__ = ('name', '_fn', '_args', '_go', '_yielded', 'finished',
+                 'error', '_shutdown', '_thread')
+
+    def __init__(self, name: str, fn: Callable[..., None],
+                 args: Tuple[Any, ...]):
+        self.name = name
+        self._fn = fn
+        self._args = args
+        self._go = threading.Event()        # loop -> task: run
+        self._yielded = threading.Event()   # task -> loop: parked/done
+        self.finished = False
+        self.error: Optional[BaseException] = None
+        self._shutdown = False
+        self._thread = threading.Thread(
+            target=self._body, name=f'sim:{name}', daemon=True)
+        self._thread.start()
+
+    def _body(self) -> None:
+        self._wait_go()
+        try:
+            self._fn(*self._args)
+        except SimShutdown:
+            pass
+        except BaseException as e:  # pylint: disable=broad-except
+            self.error = e
+        self.finished = True
+        self._yielded.set()
+
+    def _wait_go(self) -> None:
+        if not self._go.wait(timeout=_HANDOFF_TIMEOUT_S):
+            # The loop abandoned us (test aborted mid-sim); unwind.
+            raise SimShutdown()
+        self._go.clear()
+        if self._shutdown:
+            raise SimShutdown()
+
+    def park(self) -> None:
+        """Called from the task thread: yield to the loop, then block
+        until the loop hands the token back."""
+        self._yielded.set()
+        self._wait_go()
+
+    def step(self) -> None:
+        """Called from the loop thread: run the task until it parks or
+        finishes."""
+        self._yielded.clear()
+        self._go.set()
+        if not self._yielded.wait(timeout=_HANDOFF_TIMEOUT_S):
+            raise SimWedged(f'logical task {self.name!r} did not yield '
+                            f'within {_HANDOFF_TIMEOUT_S:.0f} real '
+                            'seconds — it is blocked on something the '
+                            'simulator can never produce')
+        if self.error is not None:
+            err, self.error = self.error, None
+            raise err
+
+
+class EventLoop:
+    """The virtual clock + event heap. Single-owner: exactly one
+    thread (the one calling :meth:`run_until`) drives it; logical
+    tasks only touch it through :meth:`sleep`/:meth:`spawn`/
+    :meth:`schedule` while they hold the run token, so no internal
+    locking is needed and ordering is exactly heap order."""
+
+    def __init__(self) -> None:
+        self.now = 0.0
+        self._seq = 0
+        # (time, seq, ('call', fn, args) | ('wake', task))
+        self._heap: List[Tuple[float, int, Tuple]] = []
+        self._tasks_by_ident: Dict[int, _Task] = {}
+        self._live_tasks: List[_Task] = []
+        self._shutdown = False
+
+    # ----------------------------------------------------------- schedule
+    def _push(self, at: float, item: Tuple) -> None:
+        self._seq += 1
+        heapq.heappush(self._heap, (max(at, self.now), self._seq, item))
+
+    def schedule(self, delay: float, fn: Callable[..., None],
+                 *args: Any) -> None:
+        """Run ``fn(*args)`` as a callback at ``now + delay`` (a
+        callback must not sleep — spawn a task for that)."""
+        self._push(self.now + max(0.0, delay), ('call', fn, args))
+
+    def schedule_at(self, at: float, fn: Callable[..., None],
+                    *args: Any) -> None:
+        self._push(at, ('call', fn, args))
+
+    def spawn(self, fn: Callable[..., None], *args: Any,
+              name: str = 'task') -> None:
+        """Start a logical task at the current virtual time (it begins
+        running when its start event pops, in schedule order)."""
+        task = _Task(name, fn, args)
+        self._tasks_by_ident[task._thread.ident] = task
+        self._live_tasks.append(task)
+        self._push(self.now, ('wake', task))
+
+    # -------------------------------------------------------------- sleep
+    def sleep(self, seconds: float) -> None:
+        """Virtual sleep — legal only inside a logical task."""
+        task = self._tasks_by_ident.get(threading.get_ident())
+        if task is None:
+            raise RuntimeError(
+                'EventLoop.sleep called outside a logical task '
+                '(callbacks must not sleep; use spawn for blocking '
+                'flows)')
+        self._push(self.now + max(0.0, seconds), ('wake', task))
+        task.park()
+
+    # ---------------------------------------------------------------- run
+    def run_until(self, t_end: float) -> None:
+        """Process events up to and including virtual time ``t_end``."""
+        while self._heap:
+            at, seq, item = self._heap[0]
+            if at > t_end:
+                break
+            heapq.heappop(self._heap)
+            self.now = max(self.now, at)
+            if item[0] == 'call':
+                _, fn, args = item
+                fn(*args)
+            else:
+                task = item[1]
+                if task.finished:
+                    continue
+                task.step()
+        self.now = max(self.now, t_end)
+        self._reap()
+
+    def run_while(self, cond: Callable[[], bool],
+                  t_limit: float) -> None:
+        """Process events while ``cond()`` holds, up to ``t_limit``
+        (the end-of-run drain: keep going until in-flight work clears
+        or the grace window expires)."""
+        while self._heap and cond():
+            at, _, _ = self._heap[0]
+            if at > t_limit:
+                break
+            self.run_until(at)
+
+    def pending(self) -> int:
+        return len(self._heap)
+
+    def _reap(self) -> None:
+        self._live_tasks = [t for t in self._live_tasks
+                            if not t.finished]
+
+    def shutdown(self) -> None:
+        """Unwind every parked logical task (their threads exit via
+        SimShutdown) — call when a run ends so abandoned drain/launch
+        tasks don't linger for the handoff timeout."""
+        self._shutdown = True
+        for task in self._live_tasks:
+            if task.finished:
+                continue
+            task._shutdown = True
+            task._go.set()
+            task._yielded.wait(timeout=5.0)
+        self._reap()

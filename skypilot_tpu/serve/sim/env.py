@@ -1,0 +1,224 @@
+"""SimControlPlaneEnv + SimWorld: the simulated side of the
+``serve/control_env.py`` seam.
+
+``SimWorld`` owns the synthetic fleet — replica registry, zone
+assignment, provision-latency draws, kill switches for the chaos
+scenarios — and serves the replica HTTP surface in-process.
+``SimControlPlaneEnv`` adapts it to the :class:`ControlPlaneEnv`
+interface the REAL replica manager and controller consume: virtual
+clock reads, virtual sleeps, logical-task spawns, instant HTTP
+round-trips against :class:`SimReplica` handlers, and cluster
+launch/teardown that burns the scenario's provision latency on the
+virtual clock. Persistence is a no-op (a simulated fleet must never
+touch the operator's serve DB) and the fault injector is the
+scenario's seeded one.
+"""
+from __future__ import annotations
+
+import random
+from typing import Any, Callable, Dict, List, Optional, Sequence
+
+from skypilot_tpu import exceptions
+from skypilot_tpu.serve import control_env
+from skypilot_tpu.serve import faults as faults_lib
+from skypilot_tpu.serve.sim import core as sim_core
+from skypilot_tpu.serve.sim import replica as sim_replica
+
+
+class SimWorld:
+    """The synthetic fleet: clusters, replicas, zones, and the
+    scenario knobs that shape them."""
+
+    def __init__(self, loop: sim_core.EventLoop,
+                 curve: sim_replica.ServiceCurve, *, seed: int = 0,
+                 n_zones: int = 3, provision_s: float = 30.0,
+                 provision_jitter: float = 0.3,
+                 never_drain_clusters: Optional[set] = None):
+        self.loop = loop
+        self.curve = curve
+        self.n_zones = max(1, int(n_zones))
+        self.provision_s = float(provision_s)
+        self.provision_jitter = float(provision_jitter)
+        # Scenario knob: cluster names (by launch INDEX spelling
+        # 'idx:N') whose replicas ack /drain but never report drained
+        # — the deadline-straggler path.
+        self.never_drain_clusters = set(never_drain_clusters or ())
+        self.rng = random.Random(seed)
+        self.replicas: Dict[str, sim_replica.SimReplica] = {}  # by url
+        self.by_cluster: Dict[str, sim_replica.SimReplica] = {}
+        self._gone_clusters: set = set()
+        self._launch_index = 0
+        # Fleet hook: called with (replica, jobs) when a replica dies
+        # with in-flight work (the LB migration path).
+        self.on_replica_killed: Optional[Callable[..., None]] = None
+
+    # ------------------------------------------------------------ launch
+    def provision_delay(self) -> float:
+        j = self.provision_jitter
+        return self.provision_s * (1.0 - j + 2.0 * j * self.rng.random())
+
+    def create_replica(self, cluster_name: str,
+                       envs: Dict[str, str], is_spot: bool
+                       ) -> sim_replica.SimReplica:
+        idx = self._launch_index
+        self._launch_index += 1
+        port = int(envs.get('SKYTPU_REPLICA_PORT', '8081'))
+        url = f'http://10.{(idx >> 14) & 0x3f}.' \
+              f'{(idx >> 7) & 0x7f}.{idx & 0x7f}:{port}'
+        never_drain = (cluster_name in self.never_drain_clusters
+                       or f'idx:{idx}' in self.never_drain_clusters)
+        rep = sim_replica.SimReplica(
+            cluster_name, url, self.curve, lambda: self.loop.now,
+            role=envs.get('SKYTPU_ROLE', 'colocated'),
+            zone=f'z{idx % self.n_zones}',
+            is_spot=is_spot,
+            gang_id=envs.get('SKYTPU_GANG_ID') or None,
+            gang_rank=int(envs.get('SKYTPU_RANK', '0')),
+            tp=int(envs.get('SKYTPU_TP', '1')),
+            dp=int(envs.get('SKYTPU_DP', '1')),
+            never_drain=never_drain)
+        self.replicas[url] = rep
+        self.by_cluster[cluster_name] = rep
+        self._gone_clusters.discard(cluster_name)
+        return rep
+
+    # -------------------------------------------------------------- kill
+    def kill_replica(self, rep: sim_replica.SimReplica) -> None:
+        """Hard failure (preemption, zone loss): the cluster is gone
+        and every in-flight job needs LB migration."""
+        if not rep.alive:
+            return
+        jobs = rep.kill()
+        self._gone_clusters.add(rep.cluster_name)
+        if self.on_replica_killed is not None:
+            self.on_replica_killed(rep, jobs)
+
+    def live_replicas(self) -> List[sim_replica.SimReplica]:
+        return [r for r in self.replicas.values() if r.alive]
+
+    # -------------------------------------------------------------- HTTP
+    def request(self, url: str, payload: Optional[Dict[str, Any]],
+                data: Optional[bytes]) -> Any:
+        base, _, path = url.partition('//')[2].partition('/')
+        rep = self.replicas.get(f'http://{base}')
+        if rep is None or not rep.alive:
+            raise sim_replica.SimHTTPError(502, f'no server at {base}')
+        return rep.handle('/' + path.split('?')[0], payload, data)
+
+    def fetch_json(self, url: str) -> Dict[str, Any]:
+        """The LB policies' probe transport
+        (``configure_transport``)."""
+        out = self.request(url, None, None)
+        if not isinstance(out, dict):
+            raise sim_replica.SimHTTPError(500, 'non-JSON response')
+        return out
+
+
+class SimControlPlaneEnv(control_env.ControlPlaneEnv):
+    """Adapts :class:`SimWorld` to the manager/controller seam."""
+
+    name = 'sim'
+
+    def __init__(self, world: SimWorld, *, seed: int = 0,
+                 injector: Optional[faults_lib.FaultInjector] = None):
+        self._world = world
+        self._loop = world.loop
+        self._seed = seed
+        self._injector = injector
+        self._rng_count = 0
+
+    # ---------------------------------------------------------------- time
+    def time(self) -> float:
+        return self._loop.now
+
+    def monotonic(self) -> float:
+        return self._loop.now
+
+    def sleep(self, seconds: float) -> None:
+        self._loop.sleep(seconds)
+
+    # --------------------------------------------------------- concurrency
+    def spawn(self, fn: Callable[..., None], *args: Any) -> None:
+        self._loop.spawn(fn, *args,
+                         name=getattr(fn, '__name__', 'task'))
+
+    def run_parallel(self, fns: Sequence[Callable[[], None]]) -> None:
+        # Serialized: the sim's one-runner-at-a-time discipline makes
+        # parallel teardown equivalent to sequential teardown.
+        for fn in fns:
+            fn()
+
+    def rng(self) -> random.Random:
+        self._rng_count += 1
+        return random.Random(self._seed * 1000003 + self._rng_count)
+
+    # ---------------------------------------------------------------- HTTP
+    def http_json(self, url: str, payload: Optional[Dict[str, Any]] = None,
+                  timeout: float = 10.0) -> Any:
+        del timeout      # virtual round-trips are instantaneous
+        return self._world.request(url, payload, None)
+
+    def http_post_bytes(self, url: str, data: bytes,
+                        content_type: str = 'application/octet-stream',
+                        timeout: float = 30.0) -> bytes:
+        del content_type, timeout
+        out = self._world.request(url, None, data)
+        if isinstance(out, bytes):
+            return out
+        import json as _json
+        return _json.dumps(out).encode()
+
+    def probe_http(self, url: str, post_data: Optional[Dict[str, Any]],
+                   timeout: float) -> bool:
+        del timeout
+        try:
+            self._world.request(url, post_data, None)
+            return True
+        except sim_replica.SimHTTPError:
+            return False
+
+    # ----------------------------------------------------------- clusters
+    def launch_cluster(self, task: Any, cluster_name: str) -> None:
+        # Burn the scenario's provision latency on the virtual clock —
+        # the forecast autoscaler's lead-time EWMA learns from exactly
+        # this (via the manager's provision observations).
+        delay = self._world.provision_delay()
+        self._loop.sleep(delay)
+        envs = dict(task.envs or {})
+        is_spot = any(getattr(r, 'use_spot', False)
+                      for r in (task.resources or []))
+        self._world.create_replica(cluster_name, envs, is_spot)
+
+    def cluster_head_ip(self, cluster_name: str) -> Optional[str]:
+        rep = self._world.by_cluster.get(cluster_name)
+        if rep is None or not rep.alive:
+            return None
+        # url is http://ip:port
+        return rep.url.split('//')[1].rsplit(':', 1)[0]
+
+    def down_cluster(self, cluster_name: str) -> None:
+        rep = self._world.by_cluster.get(cluster_name)
+        if rep is None or cluster_name in self._world._gone_clusters:
+            if rep is None:
+                raise exceptions.ClusterDoesNotExist(cluster_name)
+            return
+        self._world.kill_replica(rep)
+
+    def cluster_gone(self, cluster_name: str) -> bool:
+        rep = self._world.by_cluster.get(cluster_name)
+        return rep is None or not rep.alive
+
+    # -------------------------------------------------------- persistence
+    def persist_replica(self, service_name: str, replica_id: int,
+                        cluster_name: str, status: Any,
+                        url: Optional[str], version: int, is_spot: bool,
+                        port: int) -> None:
+        del (service_name, replica_id, cluster_name, status, url,
+             version, is_spot, port)
+
+    def remove_replica(self, service_name: str, replica_id: int) -> None:
+        del service_name, replica_id
+
+    # -------------------------------------------------------------- faults
+    def fault_injector(self) -> Optional[faults_lib.FaultInjector]:
+        return self._injector

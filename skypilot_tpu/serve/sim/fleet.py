@@ -1,0 +1,432 @@
+"""FleetSimulator: one simulated service end to end.
+
+Wires the REAL control plane — a :class:`ServeController` (autoscaler
++ forecaster + replica manager on the ``SimControlPlaneEnv`` seam) and
+a REAL LB policy object — to a synthetic fleet of
+:class:`SimReplica` queueing models and a deterministic traffic trace,
+then runs the whole thing on the virtual clock:
+
+- a logical task replays the controller loop (``tick`` every
+  ``tick_s`` virtual seconds — probe sweeps, scaling decisions,
+  drains, checkpoint/warmup, backfill, all the real code),
+- an LB-sync callback every ``sync_s`` mirrors the live
+  ``/controller/load_balancer_sync`` round-trip: ready URLs + roles +
+  gang blocks into the policy, arrival timestamps + tiers into the
+  autoscaler/forecaster,
+- arrival callbacks integrate the trace and dispatch batches through
+  ``policy.select_replica`` into the replicas' fluid queues,
+- a storm callback fires the scenario's ``sim_*`` fault sites
+  (correlated spot storms, zone outages, stragglers, gang churn),
+- replica deaths migrate in-flight work to survivors (the LB's
+  recovery contract: ZERO lost requests whenever any replica
+  eventually serves — un-placeable work parks in a retry queue and
+  drains on later syncs).
+
+Every event appends one line to the event log; the report carries its
+SHA-256 — same seed, byte-identical log (the determinism acceptance
+gate). No wall-clock reads anywhere (graftcheck GC117).
+"""
+from __future__ import annotations
+
+import hashlib
+from typing import Any, Dict, List, Optional, Set, Tuple
+
+from skypilot_tpu.serve import controller as controller_lib
+from skypilot_tpu.serve import faults as faults_lib
+from skypilot_tpu.serve import load_balancing_policies as lb_policies
+from skypilot_tpu.serve.service_spec import SkyServiceSpec
+from skypilot_tpu.serve.sim import core as sim_core
+from skypilot_tpu.serve.sim import env as sim_env
+from skypilot_tpu.serve.sim import replica as sim_replica
+from skypilot_tpu.serve.sim import traffic as sim_traffic
+
+# Sim fault sites the storm callback evaluates, in a fixed order (the
+# order is part of the determinism contract).
+SIM_FAULT_SITES = ('sim_storm', 'sim_zone_outage', 'sim_straggler',
+                   'sim_gang_churn')
+
+# Per-tier TTFT SLO targets (seconds) — what "attainment" means.
+DEFAULT_SLO_TTFT = {'latency': 2.0, 'throughput': 10.0}
+
+_MAX_KEPT_LOG_LINES = 200_000
+
+
+def _weighted_percentile(samples: List[Tuple[float, int]],
+                         q: float) -> float:
+    """Percentile of (value, weight) samples (0 when empty)."""
+    if not samples:
+        return 0.0
+    samples = sorted(samples)
+    total = sum(w for _, w in samples)
+    target = q * total
+    acc = 0
+    for v, w in samples:
+        acc += w
+        if acc >= target:
+            return v
+    return samples[-1][0]
+
+
+class FleetSimulator:
+
+    def __init__(self, *, spec: SkyServiceSpec,
+                 trace: sim_traffic.Trace, seed: int = 0,
+                 policy_name: str = 'queue_depth',
+                 curve: Optional[sim_replica.ServiceCurve] = None,
+                 fault_spec: Optional[Dict[str, Any]] = None,
+                 tick_s: float = 10.0, sync_s: float = 5.0,
+                 arrival_dt: float = 1.0, max_chunk: int = 8,
+                 storm_dt: float = 10.0, provision_s: float = 30.0,
+                 provision_jitter: float = 0.3, n_zones: int = 3,
+                 slo_ttft: Optional[Dict[str, float]] = None,
+                 drain_grace_s: float = 300.0,
+                 never_drain_clusters: Optional[set] = None,
+                 keep_log: bool = True,
+                 service_name: str = 'sim-svc'):
+        self.spec = spec
+        self.trace = trace
+        self.seed = seed
+        self.policy_name = policy_name
+        self.tick_s = tick_s
+        self.sync_s = sync_s
+        self.arrival_dt = arrival_dt
+        self.max_chunk = max(1, int(max_chunk))
+        self.storm_dt = storm_dt
+        self.slo_ttft = dict(slo_ttft or DEFAULT_SLO_TTFT)
+        self.drain_grace_s = drain_grace_s
+        self.keep_log = keep_log
+
+        self.loop = sim_core.EventLoop()
+        self.curve = curve or sim_replica.ServiceCurve.from_bench()
+        self.world = sim_env.SimWorld(
+            self.loop, self.curve, seed=seed, n_zones=n_zones,
+            provision_s=provision_s, provision_jitter=provision_jitter,
+            never_drain_clusters=never_drain_clusters)
+        self.injector = (faults_lib.FaultInjector(fault_spec)
+                         if fault_spec and fault_spec.get('rules')
+                         else None)
+        self.env = sim_env.SimControlPlaneEnv(self.world, seed=seed,
+                                              injector=self.injector)
+        self.controller = controller_lib.ServeController(
+            service_name, spec, {'resources': {'cloud': 'sim'}},
+            port=1, env=self.env)
+        self.policy = lb_policies.make_policy(policy_name)
+        self.policy.configure_transport(
+            fetch_json=self.world.fetch_json,
+            monotonic=lambda: self.loop.now)
+        self.world.on_replica_killed = self._on_replica_killed
+
+        # ------------------------------------------------------- metrics
+        self.arrived = 0
+        self.completed = 0
+        self.sheds: Dict[str, int] = {'no_replica': 0, 'overload': 0}
+        self.migrated = 0
+        self.slo_met: Dict[str, int] = {}
+        self.slo_total: Dict[str, int] = {}
+        self.ttft_samples: Dict[str, List[Tuple[float, int]]] = {}
+        self.recovery_samples: List[Tuple[float, int]] = []
+        self.chip_seconds = 0.0
+        self.peak_ready = 0
+        self.ready_now = 0
+        self._inflight = 0
+        self._retry_q: List[Tuple[int, str, float, float,
+                                  Optional[float]]] = []
+        self._pending_ts: List[float] = []
+        self._pending_tiers: List[str] = []
+        self._tier_carry = 0.0
+        self._stop = False
+        self._n_events = 0
+        self._log_hash = hashlib.sha256()
+        self._log_lines: List[str] = []
+        self._log_truncated = False
+
+    # ------------------------------------------------------------ logging
+    def _log(self, kind: str, detail: str) -> None:
+        line = f'{self.loop.now:.6f}|{kind}|{detail}\n'
+        self._n_events += 1
+        self._log_hash.update(line.encode())
+        if self.keep_log:
+            if len(self._log_lines) < _MAX_KEPT_LOG_LINES:
+                self._log_lines.append(line)
+            else:
+                self._log_truncated = True
+
+    # ------------------------------------------------------- control loop
+    def _controller_loop(self) -> None:
+        while not self._stop:
+            self.controller.tick(sync_state=False)
+            self.env.sleep(self.tick_s)
+
+    def _lb_sync(self) -> None:
+        mgr = self.controller.replica_manager
+        urls = mgr.ready_urls()
+        self.policy.set_ready_replicas(urls)
+        self.policy.set_replica_roles(mgr.replica_roles())
+        self.policy.set_replica_gangs(mgr.replica_gangs())
+        self.controller.autoscaler.collect_request_information(
+            self._pending_ts, self._pending_tiers)
+        self._pending_ts, self._pending_tiers = [], []
+        self.ready_now = len(urls)
+        self.peak_ready = max(self.peak_ready, self.ready_now)
+        plan = mgr.parallelism_plan()
+        self.chip_seconds += (self.ready_now * plan.chips
+                              * max(1, plan.hosts) * self.sync_s)
+        self._log('sync', f'ready={self.ready_now}')
+        self._drain_retry_queue()
+        if not self._stop:
+            self.loop.schedule(self.sync_s, self._lb_sync)
+
+    # ------------------------------------------------------------ arrivals
+    def _start_arrivals(self) -> None:
+        self._arrivals = self.trace.arrivals(self.arrival_dt)
+        self._schedule_next_arrival()
+
+    def _schedule_next_arrival(self) -> None:
+        batch = next(self._arrivals, None)
+        if batch is None:
+            return
+        t, n = batch
+        self.loop.schedule_at(t, self._arrive, n)
+
+    def _arrive(self, n: int) -> None:
+        now = self.loop.now
+        self.arrived += n
+        # Deterministic tier split with fractional carry.
+        self._tier_carry += n * self.trace.shape.latency_frac
+        n_lat = int(self._tier_carry)
+        self._tier_carry -= n_lat
+        self._pending_ts.extend([now] * n)
+        self._pending_tiers.extend(
+            ['latency'] * n_lat + ['throughput'] * (n - n_lat))
+        for tier, count in (('latency', n_lat),
+                            ('throughput', n - n_lat)):
+            while count > 0:
+                chunk = min(count, self.max_chunk)
+                count -= chunk
+                self._dispatch(chunk, tier, migrated_from=None,
+                               failed_at=None)
+        self._schedule_next_arrival()
+
+    # ------------------------------------------------------------ dispatch
+    def _dispatch(self, count: int, tier: str, *,
+                  migrated_from: Optional[str],
+                  failed_at: Optional[float],
+                  exclude: Optional[Set[str]] = None) -> None:
+        now = self.loop.now
+        shape = self.trace.shape
+        exclude = set(exclude or ())
+        while True:
+            url = self.policy.select_replica(exclude=exclude or None)
+            if url is None:
+                if migrated_from is not None:
+                    # Zero-lost contract: migrated work is never
+                    # dropped — park it until capacity returns.
+                    self._retry_q.append((count, tier, now,
+                                          shape.gen_tokens, failed_at))
+                    self._log('park', f'n={count} tier={tier}')
+                else:
+                    self.sheds['no_replica'] += count
+                    self._log('shed',
+                              f'reason=no_replica n={count} '
+                              f'tier={tier}')
+                return
+            rep = self.world.replicas.get(url)
+            if rep is None:
+                exclude.add(url)
+                continue
+            try:
+                job = rep.enqueue(now, count, shape.prompt_tokens,
+                                  shape.gen_tokens, tier)
+            except sim_replica.SimHTTPError:
+                # Stale policy view (dead or draining replica): the
+                # live LB's transparent retry — exclude and re-select.
+                exclude.add(url)
+                continue
+            if job is None:
+                self.sheds['overload'] += count
+                self._log('shed', f'reason=overload n={count} '
+                                  f'tier={tier} url={url}')
+                return
+            job.migrated_from = migrated_from
+            job.failed_at = failed_at
+            self.policy.pre_execute(url)
+            self._inflight += count
+            self._log('dispatch',
+                      f'n={count} tier={tier} url={url} '
+                      f'ttft={job.ttft_s:.4f}')
+            self.loop.schedule(max(0.0, job.finish_t - now),
+                               self._complete, url, job)
+            return
+
+    def _complete(self, url: str, job: sim_replica.SimJob) -> None:
+        if job.cancelled:
+            return
+        rep = self.world.replicas.get(url)
+        if rep is not None:
+            rep.complete(job)
+        self.policy.post_execute(url)
+        self._inflight -= job.count
+        self.completed += job.count
+        tier = job.tier
+        target = self.slo_ttft.get(tier, 10.0)
+        self.slo_total[tier] = self.slo_total.get(tier, 0) + job.count
+        if job.ttft_s <= target:
+            self.slo_met[tier] = self.slo_met.get(tier, 0) + job.count
+        self.ttft_samples.setdefault(tier, []).append(
+            (job.ttft_s, job.count))
+        if job.failed_at is not None:
+            self.recovery_samples.append(
+                (self.loop.now - job.failed_at, job.count))
+        self._log('complete', f'n={job.count} tier={tier} url={url}')
+
+    # ----------------------------------------------------------- failures
+    def _on_replica_killed(self, rep: sim_replica.SimReplica,
+                           jobs: List[sim_replica.SimJob]) -> None:
+        self._log('replica_killed',
+                  f'url={rep.url} zone={rep.zone} '
+                  f'inflight_jobs={len(jobs)}')
+        for job in jobs:
+            self.policy.post_execute(rep.url)
+            self._inflight -= job.count
+            self.migrated += job.count
+            failed_at = (job.failed_at if job.failed_at is not None
+                         else self.loop.now)
+            self._dispatch(job.count, job.tier,
+                           migrated_from=rep.url, failed_at=failed_at,
+                           exclude={rep.url})
+
+    def _drain_retry_queue(self) -> None:
+        if not self._retry_q:
+            return
+        pending, self._retry_q = self._retry_q, []
+        for count, tier, _, _, failed_at in pending:
+            self._dispatch(count, tier, migrated_from='retry-queue',
+                           failed_at=failed_at)
+
+    # -------------------------------------------------------------- storms
+    def _storm_check(self) -> None:
+        inj = self.injector
+        assert inj is not None
+        for site in SIM_FAULT_SITES:
+            rule = inj.fire(site)
+            if rule is not None:
+                self._apply_sim_fault(site, rule)
+        if not self._stop:
+            self.loop.schedule(self.storm_dt, self._storm_check)
+
+    def _apply_sim_fault(self, site: str,
+                         rule: faults_lib.FaultRule) -> None:
+        live = self.world.live_replicas()
+        if site == 'sim_storm':
+            # Correlated spot storm: the n newest spot replicas die in
+            # the same instant (registry order = launch order).
+            victims = [r for r in live if r.is_spot][-rule.n:]
+            self._log('storm', f'n={len(victims)}')
+            for r in victims:
+                self.world.kill_replica(r)
+        elif site == 'sim_zone_outage':
+            zone = rule.zone or 'z0'
+            victims = [r for r in live if r.zone == zone]
+            self._log('zone_outage', f'zone={zone} n={len(victims)}')
+            for r in victims:
+                self.world.kill_replica(r)
+        elif site == 'sim_straggler':
+            for r in live:
+                if r.slowdown == 1.0 and r.gang_rank == 0:
+                    r.slowdown = max(1.0, rule.factor)
+                    self._log('straggler',
+                              f'url={r.url} factor={r.slowdown}')
+                    break
+        elif site == 'sim_gang_churn':
+            want_rank = rule.rank if rule.rank is not None else 1
+            for r in live:
+                if r.gang_id is not None and r.gang_rank == want_rank:
+                    self._log('gang_churn',
+                              f'gang={r.gang_id} rank={r.gang_rank}')
+                    self.world.kill_replica(r)
+                    break
+
+    # ----------------------------------------------------------------- run
+    def _outstanding(self) -> int:
+        return self._inflight + sum(c for c, *_ in self._retry_q)
+
+    def run(self) -> Dict[str, Any]:
+        self.loop.spawn(self._controller_loop, name='controller')
+        self.loop.schedule(0.0, self._lb_sync)
+        self._start_arrivals()
+        if self.injector is not None and any(
+                r.site in SIM_FAULT_SITES
+                for r in self.injector._rules):
+            self.loop.schedule(self.storm_dt, self._storm_check)
+        self.loop.run_until(self.trace.duration_s)
+        # End-of-trace drain: no new arrivals; completions, retries,
+        # drains and backfills keep running until outstanding work
+        # clears (or the grace window expires — the remainder is LOST,
+        # which recovery-covered scenarios assert to be zero).
+        t_limit = self.loop.now + self.drain_grace_s
+        self.loop.run_while(lambda: self._outstanding() > 0, t_limit)
+        lost = self._outstanding()
+        self._stop = True
+        virtual_s = self.loop.now
+        self.loop.shutdown()
+        return self._report(lost, virtual_s)
+
+    # -------------------------------------------------------------- report
+    def _report(self, lost: int, virtual_s: float) -> Dict[str, Any]:
+        slo = {}
+        for tier in sorted(self.slo_total):
+            total = self.slo_total[tier]
+            met = self.slo_met.get(tier, 0)
+            samples = self.ttft_samples.get(tier, [])
+            slo[tier] = {
+                'completed': total, 'met': met,
+                'attainment': round(met / total, 4) if total else 1.0,
+                'ttft_p50_s': round(
+                    _weighted_percentile(samples, 0.5), 4),
+                'ttft_p90_s': round(
+                    _weighted_percentile(samples, 0.9), 4),
+            }
+        faults_fired: Dict[str, int] = {}
+        if self.injector is not None:
+            for rule in self.injector._rules:
+                if rule.fired:
+                    key = f'{rule.site}:{rule.kind}'
+                    faults_fired[key] = (faults_fired.get(key, 0)
+                                         + rule.fired)
+        mgr = self.controller.replica_manager
+        return {
+            'seed': self.seed,
+            'policy': self.policy_name,
+            'trace': self.trace.name,
+            'virtual_s': round(virtual_s, 3),
+            'requests': {
+                'arrived': self.arrived,
+                'completed': self.completed,
+                'shed': dict(self.sheds),
+                'migrated': self.migrated,
+                'lost': lost,
+            },
+            'slo': slo,
+            'recovery_s': {
+                'n': sum(w for _, w in self.recovery_samples),
+                'p50': round(_weighted_percentile(
+                    self.recovery_samples, 0.5), 3),
+                'p90': round(_weighted_percentile(
+                    self.recovery_samples, 0.9), 3),
+            },
+            'chip_seconds': round(self.chip_seconds, 1),
+            'replicas': {
+                'launched': self.world._launch_index,
+                'peak_ready': self.peak_ready,
+                'target_final': self.controller.autoscaler
+                                .target_num_replicas,
+                'tracked_final': len(mgr.replicas()),
+            },
+            'faults_fired': faults_fired,
+            'events': self._n_events,
+            'event_log_sha256': self._log_hash.hexdigest(),
+            'event_log_truncated': self._log_truncated,
+        }
+
+    def event_log(self) -> str:
+        return ''.join(self._log_lines)

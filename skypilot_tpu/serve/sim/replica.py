@@ -1,0 +1,280 @@
+"""Simulated replicas: a fluid queueing model of one model server,
+with service curves calibrated from the repo's BENCH engine numbers,
+speaking exactly the HTTP contract the control plane drives — so the
+REAL replica manager probes, drains, checkpoints and warms them
+without knowing they are synthetic.
+
+Service model (deliberately fluid, O(1) per event): a replica with
+``slots`` concurrent decode slots processes ``slots`` service-seconds
+of work per virtual second. One request of ``p`` prompt and ``g``
+generated tokens costs ``svc = ttft_base + p/prefill_rate + g*tpot``
+single-slot seconds; a batch of ``n`` advances the replica's
+``busy_until`` horizon by ``n*svc/slots``, and the queue wait a new
+arrival sees is ``max(0, busy_until - now)``. TTFT = queue wait +
+prefill part (minus the warm-prefix discount when the replica was
+warmed from a checkpoint — the PR-10 recovery contract, visible in
+the sim's recovery-TTFT numbers). Waits beyond ``max_queue_wait_s``
+model the SLO scheduler's token-bounded admission: the request is
+shed with a retryable 429, exactly what the live scheduler does.
+
+Calibration: :meth:`ServiceCurve.from_bench` scans the repo's
+``BENCH_r*.json`` records (newest first) for the serving-path numbers
+— ``tpot_ms_median`` at 0.7 capacity, the prefix-cache hit/miss TTFT
+medians, the paged engine ``batch`` — and falls back to the r05 CPU
+anchors when no record parses. Provision-latency distributions live
+in the scenario (they are a property of the cloud, not the engine).
+"""
+from __future__ import annotations
+
+import dataclasses
+import json
+import re
+from typing import Any, Callable, Dict, List, Optional
+
+from skypilot_tpu import telemetry
+
+# r05 fallback anchors (BENCH_r05.json serving_http.at_0p7_capacity and
+# prefix_cache blocks): tpot 23.22 ms, TTFT hit/miss 254.8/350.5 ms,
+# paged batch 48, ~220-token anchor prompts.
+_FALLBACK = {'tpot_ms': 23.22, 'ttft_hit_ms': 254.8,
+             'ttft_miss_ms': 350.5, 'batch': 48, 'avg_prompt': 220.0}
+
+_NUM = r'([0-9]+(?:\.[0-9]+)?)'
+
+
+@dataclasses.dataclass(frozen=True)
+class ServiceCurve:
+    """Per-replica service parameters (single SLO-tier-independent
+    engine curve; tiers differ in SLO targets and admission, not in
+    silicon speed)."""
+    ttft_base_s: float          # fixed prefill overhead, cold prefix
+    warm_ttft_base_s: float     # ... with a warm prefix cache
+    prefill_tok_per_s: float    # prompt-token throughput
+    tpot_s: float               # seconds per generated token (1 slot)
+    slots: int                  # concurrent decode slots
+    max_queue_wait_s: float     # admission bound (models 429 shedding)
+    kv_pool_tokens: int         # advertised KV capacity (LB handoffs)
+
+    def service_s(self, prompt_tokens: float, gen_tokens: float,
+                  warm: bool = False) -> float:
+        base = self.warm_ttft_base_s if warm else self.ttft_base_s
+        return (base + prompt_tokens / self.prefill_tok_per_s
+                + gen_tokens * self.tpot_s)
+
+    def prefill_s(self, prompt_tokens: float, warm: bool) -> float:
+        base = self.warm_ttft_base_s if warm else self.ttft_base_s
+        return base + prompt_tokens / self.prefill_tok_per_s
+
+    @classmethod
+    def from_bench(cls, bench_texts: Optional[List[str]] = None,
+                   max_queue_wait_s: float = 8.0) -> 'ServiceCurve':
+        """Calibrate from BENCH record texts (newest first; the caller
+        reads the files — this module does no I/O so it stays pure and
+        GC117-clean). Falls back to the r05 anchors per-field."""
+        vals = dict(_FALLBACK)
+        found: Dict[str, float] = {}
+        for text in bench_texts or []:
+            for key, pat in (
+                    ('tpot_ms', rf'"tpot_ms_median":\s*{_NUM}'),
+                    ('ttft_hit_ms', rf'"ttft_ms_hit_median":\s*{_NUM}'),
+                    ('ttft_miss_ms',
+                     rf'"ttft_ms_miss_median":\s*{_NUM}'),
+                    ('batch', rf'"batch":\s*{_NUM}'),
+                    ('avg_prompt', rf'"avg_prompt":\s*{_NUM}')):
+                if key in found:
+                    continue
+                m = re.search(pat, text)
+                if m:
+                    found[key] = float(m.group(1))
+            if len(found) == 5:
+                break
+        vals.update(found)
+        # TTFT decomposition: the miss median is base + avg_prompt /
+        # prefill_rate; the hit median skips the shared-prefix
+        # recompute — treat it as the warm base and attribute the
+        # hit->miss delta to prompt streaming.
+        warm_base = vals['ttft_hit_ms'] / 1e3
+        miss = vals['ttft_miss_ms'] / 1e3
+        prefill_rate = max(500.0,
+                           vals['avg_prompt'] / max(1e-3,
+                                                    miss - warm_base))
+        slots = max(1, int(vals['batch']))
+        return cls(ttft_base_s=miss - vals['avg_prompt'] / prefill_rate,
+                   warm_ttft_base_s=warm_base,
+                   prefill_tok_per_s=prefill_rate,
+                   tpot_s=vals['tpot_ms'] / 1e3,
+                   slots=slots,
+                   max_queue_wait_s=max_queue_wait_s,
+                   kv_pool_tokens=slots * 424)  # ~anchor tokens/slot
+
+
+class SimHTTPError(RuntimeError):
+    """A simulated HTTP failure (dead replica / 4xx-5xx) — the sim
+    env raises it where urllib would raise, so the manager's error
+    handling runs the same branches live and simulated."""
+
+    def __init__(self, code: int, message: str):
+        super().__init__(f'HTTP {code}: {message}')
+        self.code = code
+
+
+@dataclasses.dataclass
+class SimJob:
+    """One dispatched batch (``count`` identical requests riding one
+    event — the fluid model's unit of work)."""
+    job_id: int
+    count: int
+    prompt_tokens: float
+    gen_tokens: float
+    tier: str
+    submit_t: float
+    ttft_s: float               # per-request TTFT (queue wait + prefill)
+    finish_t: float
+    migrated_from: Optional[str] = None   # url of the replica that died
+    failed_at: Optional[float] = None     # when its first replica died
+    cancelled: bool = False
+
+
+class SimReplica:
+    """One synthetic model server. Owns only local state; the fleet
+    wires completion scheduling and death notification."""
+
+    def __init__(self, cluster_name: str, url: str, curve: ServiceCurve,
+                 now_fn: Callable[[], float], *,
+                 role: str = 'colocated', zone: str = 'z0',
+                 is_spot: bool = False, gang_id: Optional[str] = None,
+                 gang_rank: int = 0, tp: int = 1, dp: int = 1,
+                 never_drain: bool = False):
+        self.cluster_name = cluster_name
+        self.url = url
+        self.curve = curve
+        self._now = now_fn
+        self.role = role
+        self.zone = zone
+        self.is_spot = is_spot
+        self.gang_id = gang_id
+        self.gang_rank = gang_rank
+        self.tp = tp
+        self.dp = dp
+        self.alive = True
+        self.draining = False
+        self.drain_started_t: Optional[float] = None
+        self._drain_observed = False
+        # Scenario knob: a straggler that acks /drain but never
+        # reports drained — the deadline-failover path's test double.
+        self.never_drain = never_drain
+        self.warm = False                  # warmed from a checkpoint
+        self.slowdown = 1.0                # straggler fault multiplier
+        self.busy_until = 0.0
+        self.inflight: Dict[int, SimJob] = {}
+        self._next_job = 1
+
+    # ----------------------------------------------------------- service
+    def enqueue(self, now: float, count: int, prompt_tokens: float,
+                gen_tokens: float, tier: str) -> Optional[SimJob]:
+        """Admit a batch; returns the job (with its completion time for
+        the fleet to schedule) or None when admission sheds it (queue
+        wait beyond the scheduler bound — the 429 path)."""
+        if not self.alive:
+            raise SimHTTPError(502, 'replica dead')
+        if self.draining:
+            raise SimHTTPError(503, 'draining')
+        svc = self.curve.service_s(prompt_tokens, gen_tokens,
+                                   self.warm) * self.slowdown
+        wait = max(0.0, self.busy_until - now)
+        if wait > self.curve.max_queue_wait_s:
+            return None
+        self.busy_until = (max(now, self.busy_until)
+                           + count * svc / self.curve.slots)
+        ttft = wait + self.curve.prefill_s(prompt_tokens,
+                                           self.warm) * self.slowdown
+        job = SimJob(job_id=self._next_job, count=count,
+                     prompt_tokens=prompt_tokens,
+                     gen_tokens=gen_tokens, tier=tier, submit_t=now,
+                     ttft_s=ttft, finish_t=now + wait + svc)
+        self._next_job += 1
+        self.inflight[job.job_id] = job
+        return job
+
+    def complete(self, job: SimJob) -> None:
+        self.inflight.pop(job.job_id, None)
+
+    def kill(self) -> List[SimJob]:
+        """Hard death: returns the in-flight jobs the LB must migrate;
+        the replica stops answering anything."""
+        self.alive = False
+        jobs = [j for j in self.inflight.values() if not j.cancelled]
+        for j in jobs:
+            j.cancelled = True
+        self.inflight.clear()
+        return jobs
+
+    def queue_tokens_total(self, now: float) -> int:
+        """The work-token estimate a live scheduler would publish:
+        backlog seconds converted back to decode tokens."""
+        backlog_s = max(0.0, self.busy_until - now)
+        return int(backlog_s * self.curve.slots / self.curve.tpot_s)
+
+    def kv_pool_tokens_free(self) -> int:
+        used = sum(j.count * (j.prompt_tokens + j.gen_tokens)
+                   for j in self.inflight.values())
+        return max(0, int(self.curve.kv_pool_tokens - used))
+
+    # -------------------------------------------------------------- HTTP
+    def handle(self, path: str, payload: Optional[Dict[str, Any]],
+               data: Optional[bytes]) -> Any:
+        """The model-server contract surface the control plane drives
+        (readiness, drain, checkpoint, warmup, metrics JSON)."""
+        if not self.alive:
+            raise SimHTTPError(502, 'connection refused')
+        now = self._now()
+        if path == '/readiness':
+            return {'ready': not self.draining, 'draining': self.draining}
+        if path == '/drain':
+            if payload is not None or data is not None:   # POST: begin
+                if not self.draining:
+                    self.draining = True
+                    self.drain_started_t = now
+                return {'draining': True, 'inflight': len(self.inflight)}
+            drained = (self.draining and not self.never_drain
+                       and self.busy_until <= now
+                       and not self.inflight)
+            if drained and not self._drain_observed:
+                # The live model server's monitor observes the drain
+                # histogram when the scheduler reports drained; the
+                # sim replica honors the same telemetry contract.
+                self._drain_observed = True
+                telemetry.get_registry().histogram(
+                    'skytpu_replica_drain_seconds',
+                    'Graceful-drain duration: drain start to idle (s)',
+                    buckets=telemetry.registry.DEFAULT_SECONDS_BUCKETS,
+                ).observe(max(0.0, now - (self.drain_started_t or now)))
+            return {'draining': self.draining, 'drained': drained,
+                    'inflight': len(self.inflight)}
+        if path == '/checkpoint':
+            blob = json.dumps({
+                'format': 'SIMCKPT', 'source': self.url,
+                'exported_t': now, 'warm': True,
+                'hot_prefixes': 4,
+            }).encode()
+            return blob
+        if path == '/kv/warmup':
+            if not data:
+                raise SimHTTPError(400, 'empty warmup body')
+            try:
+                blob = json.loads(data)
+            except (ValueError, UnicodeDecodeError) as e:
+                raise SimHTTPError(400, f'bad container: {e}') from e
+            if blob.get('format') != 'SIMCKPT':
+                raise SimHTTPError(400, 'unknown container format')
+            self.warm = True
+            return {'warmed_rows': int(blob.get('hot_prefixes', 0))
+                    * 128, 'entries': int(blob.get('hot_prefixes', 0))}
+        if path.startswith('/metrics'):
+            return {
+                'queue_tokens_total': self.queue_tokens_total(now),
+                'kv_pool_tokens_free': self.kv_pool_tokens_free(),
+                'mesh': {'tp': self.tp, 'dp': self.dp},
+                'disagg': {'role': self.role},
+            }
+        raise SimHTTPError(404, f'no route {path}')

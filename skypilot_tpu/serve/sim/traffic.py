@@ -1,0 +1,99 @@
+"""Deterministic traffic traces for the fleet simulator.
+
+A trace is a *rate function* ``qps(t)`` plus request-shape parameters;
+the fleet integrates it with a fractional-carry accumulator (no RNG in
+the arrival counts — byte-identical replays come for free, and the
+PR-10 forecast-vs-reactive comparison needs the two autoscalers to see
+EXACTLY the same arrivals). Shapes mirror the workloads the serving
+benches use: the ShareGPT-like anchor (~220 prompt / ~190 generated
+tokens) with a latency/throughput tier mix.
+
+Shipped shapes:
+
+- ``constant`` — steady load (calibration / straggler scenarios).
+- ``diurnal`` — a smooth day curve (half-sinusoid on a base), period
+  ``season_s``; the forecaster's seasonal-naive component learns it.
+- ``bursty`` — the PR-10 replay shape: ``burst_qps`` for the first
+  ``burst_s`` of every ``season_s`` period, ``base_qps`` otherwise.
+- ``flash_crowd`` — a step to ``peak_qps`` at ``at_s`` (the ramp no
+  season predicts; only the trend term can chase it).
+"""
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Callable, Iterator, Tuple
+
+
+@dataclasses.dataclass(frozen=True)
+class RequestShape:
+    prompt_tokens: float = 220.0
+    gen_tokens: float = 190.0
+    latency_frac: float = 0.3     # fraction routed as the latency tier
+
+
+@dataclasses.dataclass(frozen=True)
+class Trace:
+    """A rate function over [0, duration_s) plus request shapes."""
+    name: str
+    rate_fn: Callable[[float], float]
+    duration_s: float
+    shape: RequestShape = RequestShape()
+
+    def arrivals(self, dt: float) -> Iterator[Tuple[float, int]]:
+        """Yield ``(t, n)`` arrival batches every ``dt`` seconds with
+        fractional carry, so ``sum(n)`` tracks the rate integral
+        exactly (no aliasing at low rates)."""
+        carry = 0.0
+        steps = int(math.ceil(self.duration_s / dt))
+        for i in range(steps):
+            t = i * dt
+            carry += max(0.0, self.rate_fn(t)) * dt
+            n = int(carry)
+            if n > 0:
+                carry -= n
+                yield t, n
+
+    def total_requests(self, dt: float) -> int:
+        return sum(n for _, n in self.arrivals(dt))
+
+
+def constant(qps: float, duration_s: float,
+             shape: RequestShape = RequestShape()) -> Trace:
+    return Trace('constant', lambda t: qps, duration_s, shape)
+
+
+def diurnal(base_qps: float, peak_qps: float, season_s: float,
+            seasons: int,
+            shape: RequestShape = RequestShape()) -> Trace:
+    def rate(t: float) -> float:
+        phase = (t % season_s) / season_s
+        return base_qps + (peak_qps - base_qps) * max(
+            0.0, math.sin(math.pi * phase))
+    return Trace('diurnal', rate, season_s * seasons, shape)
+
+
+def bursty(base_qps: float, burst_qps: float, burst_s: float,
+           season_s: float, seasons: int,
+           shape: RequestShape = RequestShape()) -> Trace:
+    """The PR-10 replay shape (bench ``_spot_autoscaler_sim``):
+    ``burst_qps`` for the first ``burst_s`` of every season."""
+    def rate(t: float) -> float:
+        return burst_qps if (t % season_s) < burst_s else base_qps
+    return Trace('bursty', rate, season_s * seasons, shape)
+
+
+def flash_crowd(base_qps: float, peak_qps: float, at_s: float,
+                duration_s: float,
+                shape: RequestShape = RequestShape()) -> Trace:
+    def rate(t: float) -> float:
+        return peak_qps if t >= at_s else base_qps
+    return Trace('flash_crowd', rate, duration_s, shape)
+
+
+TRACES = {
+    'constant': constant,
+    'diurnal': diurnal,
+    'bursty': bursty,
+    'flash_crowd': flash_crowd,
+}

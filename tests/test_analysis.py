@@ -859,3 +859,61 @@ def test_cli_smoke(capsys):
     out = capsys.readouterr().out
     assert 'GC202' in out
     assert graftcheck_main(['lint']) == 0
+
+
+# ------------------------------------------------------------------ GC117
+def test_gc117_wallclock_in_sim_flagged():
+    src = '''
+    import time
+    def run_until(self, t_end):
+        t0 = time.time()
+        time.sleep(0.1)
+        return time.monotonic() - t0
+    '''
+    ids = rule_ids(src, 'skypilot_tpu/serve/sim/core.py')
+    assert ids == ['GC117', 'GC117', 'GC117']
+
+
+def test_gc117_bare_from_import_spellings_flagged():
+    src = '''
+    from time import monotonic, perf_counter
+    def tick(self):
+        return monotonic() + perf_counter()
+    '''
+    assert rule_ids(src, 'skypilot_tpu/serve/sim/fleet.py') == [
+        'GC117', 'GC117']
+
+
+def test_gc117_virtual_clock_and_references_clean():
+    # The sanctioned spellings: the EventLoop's own virtual clock,
+    # method sleeps routed through the loop/env seam, and passing a
+    # clock CALLABLE (name reference, no call).
+    src = '''
+    import time
+    class EventLoop:
+        def __init__(self):
+            self.now = 0.0
+        def sleep(self, s):
+            self.now += s
+    def drive(loop, env):
+        loop.sleep(1.0)
+        env.sleep(2.0)
+        return loop.now
+    def make_clock(fallback=time.time):
+        return fallback
+    '''
+    assert rule_ids(src, 'skypilot_tpu/serve/sim/env.py') == []
+
+
+def test_gc117_only_polices_sim_paths():
+    # The same wall-clock calls outside serve/sim/ are not GC117's
+    # business (other rules may still apply in their own dirs).
+    src = '''
+    import time
+    def probe(self):
+        return time.time()
+    '''
+    assert 'GC117' not in rule_ids(src,
+                                   'skypilot_tpu/serve/server_x.py')
+    assert rule_ids(src, 'skypilot_tpu/serve/sim/replica.py') == [
+        'GC117']
