@@ -135,6 +135,8 @@ def main() -> None:
              lambda: _serving_tp_bench(n_chips)),
             ('chaos',
              lambda: _chaos_bench(n_chips)),
+            ('gray',
+             lambda: _gray_bench(n_chips)),
             ('disagg',
              lambda: _disagg_bench(n_chips)),
             ('spot',
@@ -1324,6 +1326,218 @@ def _chaos_bench(n_chips: int) -> dict:
         'zero_lost_contract_held':
             faulted['lost_requests'] == 0
             and clean['lost_requests'] == 0,
+    }
+
+
+def _gray_bench(n_chips: int) -> dict:
+    """Gray-failure block (round 13): replay a two-tier workload
+    through the real LB against two replicas while a gray-failure
+    storm runs on replica A — a NaN eviction (one request's logits
+    poisoned) and then a wedged engine step (the loop hangs while HTTP
+    stays up; a 0.5 s watchdog must catch it). The contracts asserted
+    into the block: ``lost_requests`` MUST be 0 in both passes, the
+    deterministic probe stream is byte-identical to the fault-free
+    pass (the NaN-evicted / wedge-orphaned streams migrate and
+    continue at the exact same tokens), and the gray-failure counters
+    tick for both kinds. Fleet-scale reproduction: the
+    ``gray_failure_storm`` sim scenario (wedge + NaN burst + byzantine
+    quarantine + bit-flipped checkpoint at 6+ replicas) embeds its
+    report. Tiny config on any backend — this measures the detection/
+    containment layer, not the model."""
+    import json as _json
+    import random
+    import threading
+    import urllib.request
+
+    import http.server as hs
+
+    from skypilot_tpu import telemetry
+    from skypilot_tpu.serve import faults as faults_lib
+    from skypilot_tpu.serve.load_balancer import SkyServeLoadBalancer
+    from skypilot_tpu.serve.server import ModelServer
+    from skypilot_tpu.utils import common_utils
+
+    n_req, gen, rate = 14, 24, 10.0
+    probe_prompt = [3, 1, 4, 1, 5, 9, 2, 6]
+    probe_gen = 48
+
+    def make_controller(urls):
+        class H(hs.BaseHTTPRequestHandler):
+            timeout = 30
+
+            def log_message(self, *a):
+                del a
+
+            def do_POST(self):  # noqa: N802
+                body = _json.dumps({'ready_replica_urls': urls,
+                                    'retry_after_s': 5}).encode()
+                self.send_response(200)
+                self.send_header('Content-Length', str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+        port = common_utils.find_free_port(18600)
+        httpd = hs.ThreadingHTTPServer(('127.0.0.1', port), H)
+        threading.Thread(target=httpd.serve_forever, daemon=True).start()
+        return httpd, f'http://127.0.0.1:{port}'
+
+    def run_pass(fault_spec):
+        pa = common_utils.find_free_port(18640)
+        pb = common_utils.find_free_port(pa + 1)
+        # Watchdog deadline: must exceed worst-case first-compile step
+        # time (a lazily compiled chunk-prefill variant measured 0.6 s
+        # on CPU — a 0.5 s deadline false-fired on the CLEAN pass), so
+        # 8 s on the storm pass (the injected wedge hangs forever —
+        # any finite deadline catches it) and disabled on the
+        # fault-free baseline.
+        sa = ModelServer('tiny', max_batch=4, max_seq=128, port=pa,
+                         fault_spec=fault_spec,
+                         step_watchdog_s=8.0 if fault_spec else 0,
+                         nan_alarm_threshold=100)
+        sb = ModelServer('tiny', max_batch=4, max_seq=128, port=pb,
+                         step_watchdog_s=0)
+        sa.start(block=False)
+        sb.start(block=False)
+        ctrl = lb = None
+        try:
+            if not (sa._ready.wait(600) and sb._ready.wait(600)):
+                raise RuntimeError('gray replicas never became ready')
+            ctrl, ctrl_url = make_controller(
+                [f'http://127.0.0.1:{pa}', f'http://127.0.0.1:{pb}'])
+            lb_port = common_utils.find_free_port(18680)
+            os.environ['SKYTPU_LB_SYNC'] = '3600'
+            lb = SkyServeLoadBalancer(controller_url=ctrl_url,
+                                      port=lb_port, max_attempts=4)
+            lb.start()
+            lb._sync_once()
+            reg = telemetry.get_registry()
+            gray0 = {k: reg.get('skytpu_gray_failures_total',
+                                kind=k).value
+                     for k in faults_lib.GRAY_FAILURE_KINDS}
+            lock = threading.Lock()
+            done, retryable, lost = [], [], []
+            probe_tokens = []
+
+            def one(prompt, g, tier, sink=None):
+                body = _json.dumps({'prompt': prompt,
+                                    'max_new_tokens': g,
+                                    'stream': True,
+                                    'slo_tier': tier}).encode()
+                req = urllib.request.Request(
+                    f'http://127.0.0.1:{lb_port}/generate', body,
+                    {'Content-Type': 'application/json'})
+                n, err, retry_ok, toks = 0, None, False, []
+                try:
+                    with urllib.request.urlopen(req,
+                                                timeout=300) as resp:
+                        for line in resp:
+                            if not line.startswith(b'data:'):
+                                continue
+                            try:
+                                ev = _json.loads(line[5:].strip())
+                            except ValueError:
+                                continue
+                            if 'token' in ev:
+                                toks.append(int(ev['token']))
+                                n += 1
+                            if 'error' in ev:
+                                err = str(ev['error'])
+                                retry_ok = bool(ev.get('retryable'))
+                                break
+                            if ev.get('done'):
+                                break
+                except urllib.error.HTTPError as e:
+                    err = f'HTTP {e.code}'
+                    retry_ok = (e.code in (429, 503)
+                                and 'Retry-After' in e.headers)
+                except Exception as e:  # pylint: disable=broad-except
+                    err = f'{type(e).__name__}: {e}'
+                with lock:
+                    if sink is not None:
+                        sink.extend(toks)
+                    if err is None and n == g:
+                        done.append(tier)
+                    elif err is not None and retry_ok:
+                        retryable.append((tier, err))
+                    else:
+                        lost.append((tier, err or
+                                     f'short stream ({n}/{g})'))
+
+            rng = random.Random(13)
+            threads = [threading.Thread(
+                target=one, args=(probe_prompt, probe_gen, 'latency',
+                                  probe_tokens))]
+            threads[0].start()
+            for i in range(n_req):
+                tier = 'latency' if rng.random() < 0.3 else 'throughput'
+                prompt = [17 + (i * 11 + j) % 83
+                          for j in range(8 if tier == 'latency' else 20)]
+                th = threading.Thread(target=one,
+                                      args=(prompt, gen, tier))
+                th.start()
+                threads.append(th)
+                time.sleep(rng.expovariate(rate))
+            for th in threads:
+                th.join(timeout=300)
+            gray_delta = {
+                k: int(reg.get('skytpu_gray_failures_total',
+                               kind=k).value - gray0[k])
+                for k in faults_lib.GRAY_FAILURE_KINDS}
+            return {
+                'n_requests': n_req + 1,
+                'n_completed': len(done),
+                'n_retryable_errors': len(retryable),
+                'lost_requests': len(lost),
+                'lost_detail': lost[:4],
+                'probe_tokens': list(probe_tokens),
+                'gray_failures': gray_delta,
+                'replica_a_degraded': sa._degraded,
+                'nan_evictions_a': int(sa.engine.nan_evictions
+                                       if sa.engine is not None else 0),
+            }
+        finally:
+            if lb is not None:
+                lb.stop()
+            if ctrl is not None:
+                ctrl.shutdown()
+            sa.stop()
+            sb.stop()
+
+    clean = run_pass(None)
+    stormy = run_pass({'seed': 0, 'rules': [
+        {'kind': 'nan_logits', 'site': 'engine_step', 'at': 3},
+        {'kind': 'wedged_step', 'site': 'engine_step', 'at': 5}]})
+    # Fleet-scale reproduction on the simulator (wedge + NaN burst +
+    # byzantine quarantine + corrupted checkpoint at 6+ replicas).
+    import logging
+    logging.getLogger('skytpu').setLevel(logging.ERROR)
+    from skypilot_tpu.serve.sim import scenarios as sim_scenarios
+    sim_rep = sim_scenarios.run_scenario('gray_failure_storm', seed=13)
+    byte_identical = (clean['probe_tokens'] == stormy['probe_tokens']
+                      and len(clean['probe_tokens']) == probe_gen)
+    return {
+        'workload': {'n_requests': n_req + 1, 'gen_tokens': gen,
+                     'probe_gen': probe_gen, 'rate_req_s': rate,
+                     'model': 'tiny', 'n_chips': n_chips},
+        'fault_free': {k: v for k, v in clean.items()
+                       if k != 'probe_tokens'},
+        'gray_storm': {k: v for k, v in stormy.items()
+                       if k != 'probe_tokens'},
+        'probe_stream_byte_identical': byte_identical,
+        'wedge_detected': stormy['gray_failures']['wedged_step'] >= 1,
+        'nan_evicted': stormy['gray_failures']['nan_logits'] >= 1,
+        'zero_lost_contract_held':
+            clean['lost_requests'] == 0
+            and stormy['lost_requests'] == 0,
+        'sim_gray_failure_storm': {
+            'arrived': sim_rep['requests']['arrived'],
+            'completed': sim_rep['requests']['completed'],
+            'migrated': sim_rep['requests']['migrated'],
+            'lost': sim_rep['requests']['lost'],
+            'quarantined': sim_rep['replicas']['quarantined'],
+            'faults_fired': sim_rep['faults_fired'],
+            'event_log_sha256': sim_rep['event_log_sha256'],
+        },
     }
 
 

@@ -80,6 +80,13 @@ Concurrency / control-plane hygiene (GC1xx):
   ``EventLoop.sleep``); a single wall-clock read or real sleep makes
   same-seed runs diverge and silently breaks the byte-identical
   event-log replay contract.
+- **GC118 unknown-fault-site** — a ``faults.fire('<site>')`` call
+  whose site string literal is not in the central site registry
+  (``serve/faults.py FAULT_SITES``). A typo'd site parses fine, counts
+  nothing, and SILENTLY never fires — the chaos test then passes
+  because no fault was injected, which is the exact false confidence
+  the fault subsystem exists to kill. Applies under ``serve/``
+  (every injector hook lives there).
 
 TPU hot-path hygiene (GC2xx), applied to the compute layer
 (``inference/``, ``models/``, ``ops/``, ``train/``):
@@ -183,6 +190,11 @@ RULES: Dict[str, str] = {
              '/EventLoop.sleep); one wall-clock read makes same-seed '
              'runs diverge and silently breaks the byte-identical '
              'event-log contract',
+    'GC118': 'unknown-fault-site: .fire(<site>) with a site string '
+             'not in the serve/faults.py FAULT_SITES registry — a '
+             'typo\'d site silently never fires, so the chaos test '
+             'passes WITHOUT injecting anything (register the site '
+             'or fix the spelling)',
     'GC201': 'impure-jit: impure or host-synchronizing call inside a '
              '@jax.jit body',
     'GC202': 'host-sync: device->host readback outside the '
@@ -317,6 +329,26 @@ _SIM_WALLCLOCK = {'time.time', 'time.monotonic', 'time.sleep',
 # from time either, but the dotted form is the realistic miss).
 _SIM_WALLCLOCK_BARE = {'monotonic', 'perf_counter', 'time_ns',
                        'monotonic_ns'}
+
+# --------------------------------------------------------------------- GC118
+# The central fault-site registry, resolved lazily (the faults module
+# imports telemetry; pulling it at import time would make the linter's
+# import graph heavier than it needs to be). Falls back to None when
+# the serve package is unavailable (standalone lint runs) — the rule
+# then skips rather than false-positives.
+_FAULT_SITES_CACHE: Optional[frozenset] = None
+
+
+def _known_fault_sites() -> Optional[frozenset]:
+    global _FAULT_SITES_CACHE
+    if _FAULT_SITES_CACHE is None:
+        try:
+            from skypilot_tpu.serve import faults as _faults
+        except ImportError:
+            return None      # standalone lint run: skip, don't guess
+        _FAULT_SITES_CACHE = frozenset(_faults.FAULT_SITES)
+    return _FAULT_SITES_CACHE
+
 
 # --------------------------------------------------------------------- GC109
 # Ad-hoc timing calls banned from inference/ hot paths: telemetry's
@@ -757,6 +789,8 @@ class _Checker(ast.NodeVisitor):
             self._check_sim_wallclock(node, name)
         if self.is_gang_path:
             self._check_gang_join(node, name, method)
+        if self.is_serve and method == 'fire':
+            self._check_fault_site(node)
         if self.is_serve and self._in_async:
             self._check_async_engine_call(node, name, method)
         if self._any_lock_held():
@@ -908,6 +942,37 @@ class _Checker(ast.NodeVisitor):
                       'the injected clock (the `now` parameter / '
                       'self._clock) so scaling logic stays '
                       'deterministic under test')
+
+    def _check_fault_site(self, node: ast.Call) -> None:
+        """GC118: every literal site string handed to ``.fire()``
+        under ``serve/`` must exist in the central registry
+        (``faults.FAULT_SITES``). A typo'd site is legal Python that
+        counts invocations of a site NO RULE will ever name — the hook
+        silently never fires and the chaos test it was written for
+        passes vacuously. Non-literal sites (a loop over a site tuple,
+        e.g. the simulator's storm sweep) are skipped — their tuples
+        hold registry members the fixture tests pin."""
+        site = None
+        if node.args and isinstance(node.args[0], ast.Constant) \
+                and isinstance(node.args[0].value, str):
+            site = node.args[0].value
+        else:
+            for kw in node.keywords:
+                if kw.arg == 'site' and isinstance(kw.value,
+                                                   ast.Constant) \
+                        and isinstance(kw.value.value, str):
+                    site = kw.value.value
+        if site is None:
+            return
+        known = _known_fault_sites()
+        if known is None or site in known:
+            return
+        self._add('GC118', node,
+                  f'.fire({site!r}) names a site missing from '
+                  'serve/faults.py FAULT_SITES — this hook will '
+                  'SILENTLY never fire (no rule can ever match an '
+                  'unregistered site); register the site or fix the '
+                  'spelling')
 
     def _check_sim_wallclock(self, node: ast.Call, name: str) -> None:
         """GC117: a wall-clock read (or real sleep) inside the fleet

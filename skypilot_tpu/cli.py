@@ -596,6 +596,12 @@ def serve_logs(service_name, no_follow):
               help='Graceful-drain deadline: POST /drain stops '
                    'admission (retryable 503 + Retry-After) and lets '
                    'in-flight requests finish before teardown.')
+@click.option('--step-watchdog-s', type=float, default=None,
+              help='Wedge-watchdog deadline (seconds) on each engine '
+                   'step: a step stuck longer flips /readiness to a '
+                   'degraded 503 and fails in-flight requests over '
+                   '(retryable). Default: SKYTPU_STEP_WATCHDOG_S env, '
+                   'else 120; 0 disables.')
 @click.option('--fault-spec', default=None,
               help='Deterministic fault-injection spec (JSON or '
                    '@/path; default SKYTPU_FAULT_SPEC env var).')
@@ -639,9 +645,10 @@ def model_server(model, model_path, quantize, tp, dp, kv_cache,
                  kv_cache_dtype, page_size, prefill_chunk_tokens,
                  decode_priority_ratio, prefill_w8a8, speculate_k,
                  slo_tier_default, max_queue_tokens, latency_admit_frac,
-                 drain_deadline_s, fault_spec, role, handoff_targets,
-                 checkpoint_path, gang_rank, gang_world,
-                 gang_coordinator, gang_id, max_batch, max_seq, port):
+                 drain_deadline_s, step_watchdog_s, fault_spec, role,
+                 handoff_targets, checkpoint_path, gang_rank,
+                 gang_world, gang_coordinator, gang_id, max_batch,
+                 max_seq, port):
     """Run the in-tree replica model server on this host (the process
     a service task's ``run`` command starts on each replica; same
     knobs as ``python -m skypilot_tpu.serve.server``). With
@@ -690,7 +697,8 @@ def model_server(model, model_path, quantize, tp, dp, kv_cache,
                          handoff_targets=(handoff_targets.split(',')
                                           if handoff_targets else None),
                          checkpoint_path=checkpoint_path,
-                         gang=gang_spec)
+                         gang=gang_spec,
+                         step_watchdog_s=step_watchdog_s)
     click.echo(f'Model server on :{port} '
                f'(kv_cache={kv_cache}, speculate_k={speculate_k}, '
                f'tp={server.tp}, dp={server.dp}, role={server.role}, '

@@ -77,6 +77,10 @@ class Request:
     submit_time: float = 0.0
     first_token_time: Optional[float] = None
     finish_time: Optional[float] = None
+    # NaN blast-radius isolation: the device emitted the non-finite
+    # sentinel for this request's logits row — it was evicted with a
+    # retryable error instead of streaming argmax-of-NaN garbage.
+    nan_evicted: bool = False
     # Paged-engine early slot recycle: output tokens covered by
     # ENQUEUED device calls, and whether the slot was freed before the
     # request's tail tokens surfaced through the async pipeline.
@@ -441,6 +445,28 @@ class _EngineBase:
     # engine overrides this with a live counter. One spelling so the
     # telemetry/bench surfaces read the same attribute off either.
     preemptions = 0
+
+    # Requests evicted because their logits row went non-finite (the
+    # device-side NaN sentinel, llama.NONFINITE_TOKEN). The serve
+    # layer watches the delta to escalate repeated hits to a
+    # replica-level alarm.
+    nan_evictions = 0
+
+    def _evict_nonfinite(self, slot: int,
+                         req: 'Request') -> Tuple[int, int, bool]:
+        """The device emitted the NaN sentinel for this request: evict
+        it (free its slot, finish its trace) WITHOUT recording it as
+        finished — the serve scheduler turns the sentinel event into a
+        retryable per-request error, so co-batched requests continue
+        untouched while this one fails over. Returns the event tuple
+        the caller appends in place of a token event."""
+        req.nan_evicted = True
+        req.finish_time = clock.now()
+        self.nan_evictions += 1
+        self._trace_finish(req, nan_evicted=True)
+        if 0 <= slot < len(self._slots) and self._slots[slot] is req:
+            self._free_slot(slot)
+        return (req.request_id, llama.NONFINITE_TOKEN, True)
 
     def mesh_axes(self) -> Dict[str, int]:
         """{axis: size} of this engine's mesh (all 1s when meshless) —
@@ -1235,7 +1261,8 @@ class InferenceEngine(SpeculativeMixin, _EngineBase):
             last, rows = llama.prefill_rows(
                 params, tokens, true_lens, cfg, attn_impl=attn_impl,
                 quantize_rows=big_cache.quantized, w8a8=w8a8)
-            next_tokens = jnp.argmax(last, -1).astype(jnp.int32)
+            next_tokens = llama.mask_nonfinite_tokens(
+                last, jnp.argmax(last, -1).astype(jnp.int32))
             # Scatter KV rows + lengths into the slot cache.
             length = big_cache.length.at[slots].set(true_lens)
             if big_cache.quantized:
@@ -1484,6 +1511,9 @@ class InferenceEngine(SpeculativeMixin, _EngineBase):
                 first = sample_tokens(last, rng, temps, topks, topps)
             else:
                 first = jnp.argmax(last, -1).astype(jnp.int32)
+            # NaN guard on completing rows (llama.mask_nonfinite_tokens
+            # — the host evicts the poisoned request at readback).
+            first = llama.mask_nonfinite_tokens(last, first)
             pos = starts[:, None] + jnp.arange(chunk_w)[None, :]
             pos = jnp.where(jnp.arange(chunk_w)[None, :] < valid[:, None],
                             pos, max_seq)        # invalid rows drop
@@ -1815,6 +1845,11 @@ class InferenceEngine(SpeculativeMixin, _EngineBase):
                 if req.finish_time is not None:       # cancelled in flight
                     continue
                 token = int(toks[row])
+                if token < 0:
+                    # Non-finite sentinel: the prompt blew up in
+                    # prefill — evict just this request.
+                    events.append(self._evict_nonfinite(slot, req))
+                    continue
                 req.first_token_time = now
                 if req.trace is not None:
                     req.trace.end('prefill')
@@ -1833,6 +1868,14 @@ class InferenceEngine(SpeculativeMixin, _EngineBase):
                 continue
             for i in range(entry['horizon']):
                 token = int(toks[slot, i])
+                if token < 0:
+                    # Non-finite sentinel: this slot's logits row went
+                    # NaN/Inf mid-horizon. Evict exactly this request
+                    # (its remaining horizon tokens are garbage by
+                    # construction); every other slot's tokens land
+                    # normally.
+                    events.append(self._evict_nonfinite(slot, req))
+                    break
                 req.output.append(token)
                 self._slot_len[slot] += 1
                 finished = self._maybe_finish(slot, token)

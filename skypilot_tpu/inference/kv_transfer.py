@@ -34,6 +34,18 @@ raise ``ValueError`` with the reason — a truncated or corrupt handoff
 must be rejected loudly at the wire (and again at
 ``PageAllocator.register_prefix``), never landed as garbage KV.
 
+Integrity (wire version 2, PR 13): every buffer manifest entry carries
+a ``crc32`` of its raw bytes, and the container appends a trailing
+CRC32 of the header JSON — a single flipped bit anywhere (magic,
+header, any buffer, the checksums themselves) is a ``ValueError``, so
+a bit-flipped handoff or checkpoint becomes a *retryable refusal*
+(fallback-local / cold-boot) instead of a byte-wrong continuation.
+Verification is ALL-OR-NOTHING: every structural claim and every
+checksum is validated before a single row is returned to the caller,
+so a corrupt body can never partially land. Version-1 containers
+(pre-checksum) still decode — old checkpoints stay readable — they
+just get no integrity cover.
+
 Spot-resilience additions (PR 10):
 
 - **Prefix-chain blobs** (magic ``SKPF``): a hot prefix-cache page
@@ -53,15 +65,24 @@ from __future__ import annotations
 
 import json
 import struct
+import zlib
 from typing import Any, Dict, List, Tuple
 
 import numpy as np
 
 MAGIC = b'SKKV'
-WIRE_VERSION = 1
+WIRE_VERSION = 2
+# Version 1 (pre-checksum) containers stay decodable: a checkpoint
+# written by an older replica must still warm a new one.
+_SUPPORTED_WIRE_VERSIONS = (1, 2)
 PREFIX_MAGIC = b'SKPF'
 CKPT_MAGIC = b'SKCK'
-CKPT_VERSION = 1
+CKPT_VERSION = 2
+_SUPPORTED_CKPT_VERSIONS = (1, 2)
+
+
+def _crc(data: bytes) -> int:
+    return zlib.crc32(data) & 0xffffffff
 
 
 class HandoffCapacityError(RuntimeError):
@@ -129,9 +150,10 @@ def encode_handoff(snapshot: Dict[str, Any]) -> bytes:
         if arr.ndim != rank:
             raise ValueError(
                 f'{name}: expected rank {rank}, got shape {arr.shape}')
-        buffers.append(arr.tobytes())
+        raw = arr.tobytes()
+        buffers.append(raw)
         buf_meta.append({'name': name, 'dtype': dtype,
-                         'shape': list(arr.shape)})
+                         'shape': list(arr.shape), 'crc32': _crc(raw)})
     header = {
         'version': WIRE_VERSION,
         'kv_cache_dtype': kv_dtype,
@@ -145,6 +167,11 @@ def encode_handoff(snapshot: Dict[str, Any]) -> bytes:
     for b in buffers:
         out.append(struct.pack('>Q', len(b)))
         out.append(b)
+    # v2 trailer: CRC of the header JSON. The buffer CRCs live in the
+    # header, so this closes the integrity cover over the request
+    # fields and the manifest itself — a flipped token id in the header
+    # is as fatal as a flipped KV byte.
+    out.append(struct.pack('>I', _crc(hj)))
     return b''.join(out)
 
 
@@ -172,10 +199,12 @@ def decode_handoff(data: bytes) -> Dict[str, Any]:
     except ValueError as e:
         raise ValueError(f'malformed KV handoff: bad header JSON ({e})'
                          ) from None
+    hj = data[off:off + hlen]
     off += hlen
     _check(isinstance(header, dict), 'header is not an object')
-    _check(header.get('version') == WIRE_VERSION,
+    _check(header.get('version') in _SUPPORTED_WIRE_VERSIONS,
            f'unsupported wire version {header.get("version")!r}')
+    checksummed = int(header['version']) >= 2
     kv_dtype = header.get('kv_cache_dtype')
     manifest = _manifest(kv_dtype)
     buf_meta = header.get('buffers')
@@ -228,11 +257,26 @@ def decode_handoff(data: bytes) -> Dict[str, Any]:
                f'{name}: {blen} bytes on the wire != {want} for shape '
                f'{shape} ({dtype})')
         _check(len(data) >= off + blen, f'{name}: truncated payload')
+        if checksummed:
+            _check(isinstance(meta.get('crc32'), int),
+                   f'{name}: v2 buffer carries no crc32')
+            _check(_crc(data[off:off + blen]) == meta['crc32'],
+                   f'{name}: checksum mismatch (corrupted buffer — '
+                   'refusing to land any row)')
         arrays[name] = np.frombuffer(
             data, dtype=np_dtype, count=int(np.prod(shape)),
             offset=off).reshape(shape)
         off += blen
-    _check(off == len(data), f'{len(data) - off} trailing bytes')
+    if checksummed:
+        _check(len(data) == off + 4,
+               f'{len(data) - off} trailing byte(s) != 4-byte header '
+               'checksum')
+        (hcrc,) = struct.unpack_from('>I', data, off)
+        _check(_crc(hj) == hcrc,
+               'header checksum mismatch (corrupted header — refusing '
+               'to land any row)')
+    else:
+        _check(off == len(data), f'{len(data) - off} trailing bytes')
     snap: Dict[str, Any] = {
         'kv_cache_dtype': kv_dtype,
         'n_rows': n_rows,
@@ -298,9 +342,10 @@ def encode_prefix_chain(entry: Dict[str, Any]) -> bytes:
         if arr.ndim != rank:
             raise ValueError(
                 f'{name}: expected rank {rank}, got shape {arr.shape}')
-        buffers.append(arr.tobytes())
+        raw = arr.tobytes()
+        buffers.append(raw)
         buf_meta.append({'name': name, 'dtype': dtype,
-                         'shape': list(arr.shape)})
+                         'shape': list(arr.shape), 'crc32': _crc(raw)})
     header = {
         'version': WIRE_VERSION,
         'kv_cache_dtype': kv_dtype,
@@ -314,6 +359,7 @@ def encode_prefix_chain(entry: Dict[str, Any]) -> bytes:
     for b in buffers:
         out.append(struct.pack('>Q', len(b)))
         out.append(b)
+    out.append(struct.pack('>I', _crc(hj)))
     return b''.join(out)
 
 
@@ -332,10 +378,12 @@ def decode_prefix_chain(data: bytes) -> Dict[str, Any]:
     except ValueError as e:
         raise ValueError(f'malformed KV handoff: bad header JSON ({e})'
                          ) from None
+    hj = data[off:off + hlen]
     off += hlen
     _check(isinstance(header, dict), 'header is not an object')
-    _check(header.get('version') == WIRE_VERSION,
+    _check(header.get('version') in _SUPPORTED_WIRE_VERSIONS,
            f'unsupported wire version {header.get("version")!r}')
+    checksummed = int(header['version']) >= 2
     kv_dtype = header.get('kv_cache_dtype')
     manifest = _manifest(kv_dtype)
     buf_meta = header.get('buffers')
@@ -379,11 +427,26 @@ def decode_prefix_chain(data: bytes) -> Dict[str, Any]:
                f'{name}: {blen} bytes on the wire != {want} for shape '
                f'{shape} ({dtype})')
         _check(len(data) >= off + blen, f'{name}: truncated payload')
+        if checksummed:
+            _check(isinstance(meta.get('crc32'), int),
+                   f'{name}: v2 buffer carries no crc32')
+            _check(_crc(data[off:off + blen]) == meta['crc32'],
+                   f'{name}: checksum mismatch (corrupted buffer — '
+                   'refusing to land any row)')
         arrays[name] = np.frombuffer(
             data, dtype=np_dtype, count=int(np.prod(shape)),
             offset=off).reshape(shape)
         off += blen
-    _check(off == len(data), f'{len(data) - off} trailing bytes')
+    if checksummed:
+        _check(len(data) == off + 4,
+               f'{len(data) - off} trailing byte(s) != 4-byte header '
+               'checksum')
+        (hcrc,) = struct.unpack_from('>I', data, off)
+        _check(_crc(hj) == hcrc,
+               'header checksum mismatch (corrupted header — refusing '
+               'to land any row)')
+    else:
+        _check(off == len(data), f'{len(data) - off} trailing bytes')
     entry: Dict[str, Any] = {
         'kv_cache_dtype': kv_dtype,
         'n_rows': n_rows,
@@ -415,7 +478,10 @@ def encode_checkpoint(entries: List[Dict[str, Any]]) -> bytes:
     out = [CKPT_MAGIC, struct.pack('>I', CKPT_VERSION),
            struct.pack('>I', len(blobs))]
     for b in blobs:
-        out.append(struct.pack('>Q', len(b)))
+        # v2 per-entry CRC ahead of the blob: catches corruption of
+        # the length prefixes/count words the embedded blobs' own
+        # checksums can't see.
+        out.append(struct.pack('>QI', len(b), _crc(b)))
         out.append(b)
     return b''.join(out)
 
@@ -431,18 +497,26 @@ def decode_checkpoint(data: bytes) -> List[Dict[str, Any]]:
     off = len(CKPT_MAGIC)
     (version,) = struct.unpack_from('>I', data, off)
     off += 4
-    _check(version == CKPT_VERSION,
+    _check(version in _SUPPORTED_CKPT_VERSIONS,
            f'unsupported checkpoint version {version}')
     (count,) = struct.unpack_from('>I', data, off)
     off += 4
+    prefix_len = 12 if version >= 2 else 8
     entries: List[Dict[str, Any]] = []
     for i in range(count):
-        _check(len(data) >= off + 8,
+        _check(len(data) >= off + prefix_len,
                f'entry {i}: truncated length prefix')
-        (blen,) = struct.unpack_from('>Q', data, off)
-        off += 8
+        if version >= 2:
+            blen, crc = struct.unpack_from('>QI', data, off)
+        else:
+            (blen,) = struct.unpack_from('>Q', data, off)
+            crc = None
+        off += prefix_len
         _check(len(data) >= off + blen, f'entry {i}: truncated blob')
         blob = data[off:off + blen]
+        _check(crc is None or _crc(blob) == crc,
+               f'entry {i}: checksum mismatch (corrupted checkpoint '
+               'entry — refusing to land any row)')
         off += blen
         if blob[:len(PREFIX_MAGIC)] == PREFIX_MAGIC:
             entry = decode_prefix_chain(blob)

@@ -447,6 +447,10 @@ def paged_decode_horizon(
             nxt = jnp.argmax(logits, -1).astype(jnp.int32)
         else:
             nxt = sample_fn(logits, rng)
+        # NaN blast-radius isolation: poisoned rows emit the sentinel
+        # (host evicts exactly that request at readback; co-batched
+        # slots continue) — see llama.mask_nonfinite_tokens.
+        nxt = llama.mask_nonfinite_tokens(logits, nxt)
         return (ring_k, ring_v, nxt), nxt
 
     (ring_k, ring_v, _), toks = lax.scan(
@@ -553,6 +557,9 @@ def paged_prefill_chunk(
     else:
         from skypilot_tpu.inference.engine import sample_tokens
         first = sample_tokens(logits, rng, temps, topks, topps)
+    # NaN guard on the first-token sample too: a prompt that blows up
+    # in prefill must evict at readback, not stream argmax-of-NaN.
+    first = llama.mask_nonfinite_tokens(logits, first)
 
     new_cache = merge_rows_into_pool(cache, k_rows, v_rows, table_p,
                                      len0, valid_len=valid, mesh=mesh)
@@ -2209,6 +2216,14 @@ class PagedInferenceEngine(SpeculativeMixin, _EngineBase):
                 if not tenant and not req._early_freed:
                     continue                     # cancelled/preempted
                 token = int(vals[row])
+                if token < 0:
+                    # Non-finite sentinel from prefill: evict exactly
+                    # this request (frees its slot + pages when it is
+                    # still the tenant); the other rows land normally.
+                    if tenant:
+                        self._await_first.discard(slot)
+                    events.append(self._evict_nonfinite(slot, req))
+                    continue
                 if tenant:
                     self._await_first.discard(slot)
                 if req.first_token_time is None:  # not on re-admission
@@ -2233,6 +2248,12 @@ class PagedInferenceEngine(SpeculativeMixin, _EngineBase):
                 continue                         # cancelled/preempted
             for i in range(entry['horizon']):
                 token = int(vals[slot, i])
+                if token < 0:
+                    # Non-finite sentinel mid-horizon: evict exactly
+                    # this request; co-batched slots keep their
+                    # tokens (blast radius = one request).
+                    events.append(self._evict_nonfinite(slot, req))
+                    break
                 req.output.append(token)
                 if tenant:
                     self._slot_len[slot] += 1

@@ -837,6 +837,27 @@ def prefill_rows(
     return last_logits, rows
 
 
+# Sentinel token emitted when a slot's logits row is non-finite
+# (NaN/Inf — numerical blow-up, SDC, poisoned activations). Real token
+# ids are >= 0, so the host readback can evict exactly the poisoned
+# request while its co-batched neighbors continue untouched. The
+# finiteness reduction runs ON DEVICE inside the already-compiled step
+# and the sentinel rides the existing token readback: zero extra
+# device->host transfers, zero new programs (jaxpr-audit-gated).
+NONFINITE_TOKEN = -1
+
+
+def mask_nonfinite_tokens(logits: jax.Array,
+                          tokens: jax.Array) -> jax.Array:
+    """Per-row finiteness guard at a sampling point: rows whose logits
+    contain any NaN/Inf emit :data:`NONFINITE_TOKEN` instead of a
+    sampled id (argmax over all-NaN logits returns 0 — a silently
+    WRONG token that would stream to the client as real output)."""
+    finite = jnp.all(jnp.isfinite(logits), axis=-1)
+    return jnp.where(finite, tokens,
+                     jnp.asarray(NONFINITE_TOKEN, tokens.dtype))
+
+
 def decode_horizon(
     params: Params,
     cache: KVCache,
@@ -936,6 +957,12 @@ def decode_horizon(
             nxt = jnp.argmax(logits, -1).astype(jnp.int32)
         else:
             nxt = sample_fn(logits, rng)
+        # NaN blast-radius isolation: a poisoned row emits the
+        # sentinel; the host evicts that request at readback while the
+        # other slots' tokens land normally. The sentinel also carries
+        # into the next step's token (a wrapped embedding lookup —
+        # deterministic garbage on an already-condemned slot).
+        nxt = mask_nonfinite_tokens(logits, nxt)
         return (ring_k, ring_v, nxt), nxt
 
     (ring_k, ring_v, _), toks = lax.scan(

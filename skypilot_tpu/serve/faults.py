@@ -29,7 +29,13 @@ network or the hardware:
   (``serve/server.py``), once per loop iteration with work. Kinds:
   ``engine_stall`` (sleep ``delay_s`` inside the loop), ``replica_crash``
   (raise :class:`InjectedFault` — the loop's ``_fatal`` path runs,
-  readiness drops, every in-flight request fails over).
+  readiness drops, every in-flight request fails over),
+  ``wedged_step`` (the loop hangs inside the step region FOREVER —
+  the wedge watchdog must detect it, flip readiness to degraded and
+  fail in-flight work over), ``nan_logits`` (one live decoding
+  request is evicted exactly as the device-side non-finite sentinel
+  would evict it — a retryable per-request error while co-batched
+  requests continue).
 - ``probe`` — ``replica_managers._probe_one``. Kind ``probe_timeout``
   makes the readiness probe report failure (after ``delay_s``).
 - ``preempt`` — ``replica_managers._check_preempted``. Kind
@@ -110,9 +116,33 @@ FAULT_SPEC_ENV = 'SKYTPU_FAULT_SPEC'
 # 'zone_outage' and 'straggler' are the fleet-simulator storm kinds
 # (serve/sim/): a zone outage kills every replica in a zone at once; a
 # straggler degrades a replica's service rate without killing it.
+# The gray-failure kinds (PR 13) model failures that do NOT announce
+# themselves — the replica keeps answering HTTP while serving wrong
+# bytes or nothing at all:
+# - 'wedged_step': the engine loop hangs inside a step forever (a
+#   stuck jitted call / dead accelerator) — the wedge watchdog must
+#   flip readiness to degraded and fail in-flight work over.
+# - 'nan_logits': one live request's logits go non-finite — the
+#   on-device sentinel must evict exactly that request (retryable)
+#   while its co-batched neighbors continue.
+# - 'kv_corruption': one byte of an encoded KV container (handoff /
+#   checkpoint) flips in transit — the CRC-checked decoder must refuse
+#   it all-or-nothing (fallback-local / cold-boot, never wrong bytes).
+# - 'byzantine_response': a replica answers the manager's known-digest
+#   canary prompt WRONG — silent data corruption; the manager must
+#   quarantine it before it serves a second wrong response.
 FAULT_KINDS = ('replica_crash', 'probe_timeout', 'slow_response',
                'partial_response', 'engine_stall', 'preempt_signal',
-               'zone_outage', 'straggler')
+               'zone_outage', 'straggler',
+               'wedged_step', 'nan_logits', 'kv_corruption',
+               'byzantine_response')
+
+# The stable label set of skytpu_gray_failures_total{kind}: detections
+# by the gray-failure defense layer (watchdog fire, NaN eviction,
+# checksum refusal, canary mismatch). Distinct from FAULT_KINDS —
+# these count real DETECTIONS whether the cause was injected or not.
+GRAY_FAILURE_KINDS = ('wedged_step', 'nan_logits', 'kv_corruption',
+                      'byzantine_response')
 
 # Injection sites (for spec validation; the hook call sites are the
 # module docstring's list). The ``sim_*`` sites are fired by the fleet
@@ -130,11 +160,28 @@ FAULT_KINDS = ('replica_crash', 'probe_timeout', 'slow_response',
 # - ``sim_gang_churn`` — kind ``replica_crash`` kills one gang
 #   FOLLOWER cluster (rank picked by ``rank``, default 1) — the
 #   one-dead-rank-dead-gang path at fleet scale.
+# - ``kv_wire`` — fired wherever an encoded KV container leaves a
+#   process (the prefill worker's handoff POST, the manager's
+#   checkpoint fetch), once per transfer. Kind ``kv_corruption`` flips
+#   one byte of the blob (offset ``n % len``) — the receiver's CRC
+#   layer must refuse it.
+# - ``canary`` — the manager's byzantine-detection canary probe, once
+#   per canaried replica. Kind ``byzantine_response`` forces the
+#   response digest to mismatch — the quarantine path runs exactly as
+#   for a really-corrupt replica.
+# - ``sim_gray`` — the fleet simulator's gray-failure storm site:
+#   kinds ``wedged_step`` (replica accepts work, never finishes,
+#   readiness degrades), ``nan_logits`` (evicts ``n`` in-flight
+#   requests with retryable errors), ``byzantine_response`` (replica
+#   answers canaries wrong until quarantined), ``kv_corruption``
+#   (replica's next checkpoint export is garbage — its replacement
+#   must boot cold, not byte-wrong).
 FAULT_SITES = ('engine_step', 'probe', 'preempt', 'preempt_warning',
                'proxy', 'proxy_stream', 'http_response', 'handoff',
                'spot_preemption', 'gang_member_crash',
                'gang_join_timeout', 'sim_storm', 'sim_zone_outage',
-               'sim_straggler', 'sim_gang_churn')
+               'sim_straggler', 'sim_gang_churn', 'kv_wire', 'canary',
+               'sim_gray')
 
 # Outcomes of skytpu_requests_migrated_total{outcome}: a migrated
 # request either completed on a surviving replica or exhausted every
@@ -326,12 +373,37 @@ def get_injector() -> Optional[FaultInjector]:
     return make_injector(None)
 
 
+def gray_failure_counter(kind: str) -> 'telemetry.Counter':
+    """The gray-failure DETECTION counter for ``kind`` (one of
+    :data:`GRAY_FAILURE_KINDS`) — ticked by the watchdog, the NaN
+    eviction path, the checksum refusal paths and the canary
+    quarantine, injected or real alike."""
+    return telemetry.get_registry().counter(
+        'skytpu_gray_failures_total',
+        'Gray failures detected by the data-plane defense layer',
+        kind=kind)
+
+
+def corrupt_blob(blob: bytes, rule: 'FaultRule') -> bytes:
+    """Deterministically flip one byte of an encoded container (the
+    ``kv_corruption`` kind at the ``kv_wire`` site): byte at offset
+    ``rule.n % len(blob)`` XOR 0xff — the receiver's CRC layer must
+    turn this into a loud, retryable refusal."""
+    if not blob:
+        return blob
+    off = rule.n % len(blob)
+    out = bytearray(blob)
+    out[off] ^= 0xff
+    return bytes(out)
+
+
 def register_metrics() -> None:
     """Register the robustness series up front — zeros from the first
     scrape whether or not any fault, drain or migration ever happens
     (the stable-schema contract ``tests/test_telemetry.py`` pins):
 
     - ``skytpu_faults_injected_total{kind}`` for every kind,
+    - ``skytpu_gray_failures_total{kind}`` for every gray kind,
     - ``skytpu_requests_migrated_total{outcome}`` for every outcome,
     - ``skytpu_replica_drain_seconds`` (drain start -> idle),
     - ``skytpu_replica_recovery_seconds`` (failure detected -> stream
@@ -342,6 +414,8 @@ def register_metrics() -> None:
         reg.counter('skytpu_faults_injected_total',
                     'Faults injected by the deterministic '
                     'fault-injection subsystem', kind=kind)
+    for kind in GRAY_FAILURE_KINDS:
+        gray_failure_counter(kind)
     for outcome in MIGRATION_OUTCOMES:
         reg.counter('skytpu_requests_migrated_total',
                     'In-flight requests migrated off a failed replica',

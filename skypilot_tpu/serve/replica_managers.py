@@ -26,15 +26,18 @@ against 1000 simulated replicas at millions of requests per wall-second
 """
 from __future__ import annotations
 
+import hashlib
+import json
 import threading
 import time
 import typing
-from typing import Dict, List, Optional
+from typing import Dict, List, Optional, Sequence
 
 from skypilot_tpu import exceptions
 from skypilot_tpu import telemetry
 from skypilot_tpu import tpu_logging
 from skypilot_tpu.serve import control_env
+from skypilot_tpu.serve import faults as faults_lib
 from skypilot_tpu.serve import serve_state
 from skypilot_tpu.task import Task
 from skypilot_tpu.utils import common_utils
@@ -89,6 +92,35 @@ def _ckpt_ttl() -> float:
     prefixes hot has moved on)."""
     import os
     return float(os.environ.get('SKYTPU_SERVE_CKPT_TTL', '3600'))
+
+
+def _canary_interval() -> float:
+    """Byzantine-detection canary cadence per replica (seconds on the
+    env clock); 0 (the default) disables canary probing."""
+    import os
+    return float(os.environ.get('SKYTPU_CANARY_INTERVAL_S', '0'))
+
+
+def _canary_prompt() -> List[int]:
+    """The canary's greedy prompt (comma-separated token ids). Fixed
+    and known, so every healthy replica of one model version answers
+    with the SAME token sequence — the digest the manager compares."""
+    import os
+    raw = os.environ.get('SKYTPU_CANARY_PROMPT', '11,13,17,19')
+    return [int(t) for t in raw.split(',') if t.strip()]
+
+
+def _canary_max_tokens() -> int:
+    import os
+    return int(os.environ.get('SKYTPU_CANARY_TOKENS', '8'))
+
+
+def canary_digest(tokens: Sequence[int]) -> str:
+    """The canonical digest of a canary response's token list — what
+    the manager compares across replicas (and what tests and the
+    simulator compute on the other side)."""
+    return hashlib.sha256(
+        json.dumps([int(t) for t in tokens]).encode()).hexdigest()[:16]
 
 
 def _probe_counter(outcome: str) -> 'telemetry.Counter':
@@ -150,6 +182,9 @@ class ReplicaInfo:
                              else time.time())
         self.checkpointed = False
         self.warmed = False
+        # Byzantine-detection canary bookkeeping: when this replica
+        # was last canaried (env clock; 0 = never).
+        self.last_canary_t = 0.0
 
 
 class ReplicaManager:
@@ -235,6 +270,41 @@ class ReplicaManager:
             'Replica provision latency: scale-up issued to first '
             'READY (s)',
             buckets=telemetry.registry.DEFAULT_SECONDS_BUCKETS)
+        # Byzantine-replica quarantine (round 13): the manager
+        # periodically (env-clock-driven) probes each READY replica
+        # with a canary greedy prompt whose answer digest is known; a
+        # mismatch — silently corrupted replica, the SDC failure mode
+        # clean-failure machinery can't see — moves the replica to
+        # QUARANTINED: out of ready_urls immediately, drained, torn
+        # down, replaced. The reference digest is either configured
+        # (``expected_digest``) or learned from the first healthy
+        # answer per spec version (blue-green rollovers reset it — a
+        # new model version legitimately answers differently).
+        self._canary_interval = _canary_interval()
+        self._canary_prompt = _canary_prompt()
+        self._canary_max_new = _canary_max_tokens()
+        self._canary_expected: Optional[str] = None
+        self._canary_learned: Optional[str] = None
+        self.quarantined_count = 0
+        self._m_quarantined = reg.counter(
+            'skytpu_replicas_quarantined_total',
+            'Replicas quarantined after a byzantine (wrong-digest) '
+            'canary response')
+        faults_lib.register_metrics()
+
+    def configure_canary(self, interval_s: float,
+                         prompt: Optional[List[int]] = None,
+                         max_new_tokens: Optional[int] = None,
+                         expected_digest: Optional[str] = None) -> None:
+        """Enable/override byzantine canary probing (tests and the
+        fleet simulator; live deployments use the SKYTPU_CANARY_*
+        env)."""
+        self._canary_interval = float(interval_s)
+        if prompt is not None:
+            self._canary_prompt = [int(t) for t in prompt]
+        if max_new_tokens is not None:
+            self._canary_max_new = int(max_new_tokens)
+        self._canary_expected = expected_digest
 
     # ------------------------------------------------------------- update
     def update_version(self, spec: 'SkyServiceSpec', task_config: dict,
@@ -245,6 +315,10 @@ class ReplicaManager:
         self.spec = spec
         self.task_config = task_config
         self.version = version
+        # A new version may legitimately answer the canary differently
+        # (new weights): relearn the reference digest from the first
+        # healthy new-version replica.
+        self._canary_learned = None
 
     # ------------------------------------------------------------- launch
     def _replica_cluster_name(self, replica_id: int) -> str:
@@ -746,6 +820,16 @@ class ReplicaManager:
                 self._ckpt_done[key] = False
                 info.checkpointed = False
             return
+        if self._faults is not None:
+            # Deterministic checkpoint corruption (site 'kv_wire', kind
+            # kv_corruption): one byte of the fetched container flips —
+            # the replacement's CRC-checked warmup must refuse it and
+            # boot cold, never byte-wrong warm.
+            rule = self._faults.fire('kv_wire')
+            if rule is not None and rule.kind == 'kv_corruption':
+                blob = faults_lib.corrupt_blob(blob, rule)
+                logger.warning('injected kv_corruption on the stored '
+                               'checkpoint (1 byte flipped)')
         with self._ckpt_lock:
             self._ckpt_bytes = blob
             self._ckpt_time = self._env.time()
@@ -784,6 +868,11 @@ class ReplicaManager:
                 timeout=_warmup_timeout())
             payload = _json.loads(body)
         except Exception as e:  # pylint: disable=broad-except
+            if '400' in str(e) or 'invalid_checkpoint' in str(e):
+                # The warmup target REFUSED the container (malformed /
+                # checksum mismatch): a corrupted checkpoint became a
+                # cold boot instead of byte-wrong warmth.
+                faults_lib.gray_failure_counter('kv_corruption').inc()
             logger.warning(f'Prefix warmup of replica '
                            f'{info.replica_id} failed '
                            f'({type(e).__name__}: {e}); entering '
@@ -981,6 +1070,11 @@ class ReplicaManager:
                 info.status = serve_state.ReplicaStatus.READY
                 self._persist(info)
                 self._mirror_gang_ready(info)
+                # Byzantine canary (env-clock cadence): a READY
+                # replica that answers the known-digest greedy canary
+                # WRONG is quarantined before it can serve a second
+                # wrong response.
+                self._canary_check(info)
                 continue
             # Probe failed on a live cluster.
             _probe_counter('failure').inc()
@@ -1020,6 +1114,105 @@ class ReplicaManager:
                     _transition_counter('NOT_READY').inc()
                 info.status = serve_state.ReplicaStatus.NOT_READY
                 self._persist(info)
+
+    # --------------------------------------------------------- quarantine
+    def _canary_check(self, info: ReplicaInfo) -> bool:
+        """One canary evaluation for a READY replica (no-op unless the
+        cadence elapsed on the env clock). Greedy canary prompt ->
+        digest of the returned tokens -> compare against the
+        configured/learned reference. A mismatch quarantines; a
+        transport failure is IGNORED here (liveness belongs to the
+        readiness-probe escalation — the canary only judges replicas
+        that answer). Returns True when the replica was quarantined."""
+        if (self._canary_interval <= 0 or info.gang_rank > 0
+                or info.url is None):
+            return False
+        now = self._env.time()
+        if now - info.last_canary_t < self._canary_interval:
+            return False
+        info.last_canary_t = now
+        forced = False
+        if self._faults is not None:
+            # Deterministic byzantine injection (site 'canary', kind
+            # byzantine_response): this replica's answer is treated as
+            # wrong-digest — the quarantine path runs exactly as for a
+            # really-corrupted replica.
+            rule = self._faults.fire('canary')
+            if rule is not None and rule.kind == 'byzantine_response':
+                forced = True
+        if not forced:
+            try:
+                resp = self._env.http_json(
+                    info.url + '/generate',
+                    {'prompt': list(self._canary_prompt),
+                     'max_new_tokens': self._canary_max_new,
+                     'temperature': 0.0},
+                    timeout=30)
+                tokens = (resp or {}).get('tokens')
+                if not isinstance(tokens, list):
+                    return False
+                digest = canary_digest(tokens)
+            except Exception as e:  # pylint: disable=broad-except
+                logger.debug(
+                    f'Canary probe of replica {info.replica_id} '
+                    f'failed ({type(e).__name__}: {e}); the readiness '
+                    'probe escalation owns liveness')
+                return False
+            expected = self._canary_expected or self._canary_learned
+            if expected is None:
+                # Quorum-of-first: the reference digest is learned
+                # from the first replica that answers (configure an
+                # expected_digest to close the first-answerer-is-
+                # byzantine window).
+                self._canary_learned = digest
+                logger.info(
+                    f'Canary reference digest learned from replica '
+                    f'{info.replica_id}: {digest}')
+                return False
+            if digest == expected:
+                return False
+            logger.warning(
+                f'Replica {info.replica_id} answered the canary with '
+                f'digest {digest} != expected {expected} (byzantine '
+                'response — silent data corruption).')
+        else:
+            logger.warning(
+                f'Replica {info.replica_id} canary forced byzantine '
+                '(injected byzantine_response).')
+        return self.quarantine_replica(info.replica_id)
+
+    def quarantine_replica(self, replica_id: int) -> bool:
+        """Byzantine containment: move the replica (the WHOLE gang for
+        gang members) to QUARANTINED — out of ``ready_urls``
+        immediately, excluded by every LB policy at its next sync,
+        then drained and torn down; the autoscaler replaces it
+        (QUARANTINED is terminal). Idempotent; returns True when a
+        quarantine was started."""
+        with self._lock:
+            info = self._replicas.get(replica_id)
+            if info is not None:
+                info = self._gang_leader_locked(info)
+            if info is None or info.status.is_terminal() or \
+                    info.status in (
+                        serve_state.ReplicaStatus.SHUTTING_DOWN,):
+                return False
+            info.status = serve_state.ReplicaStatus.QUARANTINED
+            for m in self._gang_members_locked(info.gang_id):
+                if m.gang_rank > 0 and not m.status.is_terminal():
+                    m.status = serve_state.ReplicaStatus.QUARANTINED
+            self.quarantined_count += 1
+        _transition_counter('QUARANTINED').inc()
+        self._m_quarantined.inc()
+        faults_lib.gray_failure_counter('byzantine_response').inc()
+        self._persist(info)
+        logger.warning(
+            f'Replica {info.replica_id}'
+            + (f' (gang {info.gang_id})' if info.gang_id else '')
+            + ' QUARANTINED: out of rotation now, draining, then '
+              'tearing down for replacement.')
+        self._env.spawn(self._drain_then_down, info,
+                        _drain_deadline_default())
+        return True
 
     def _mirror_gang_ready(self, leader: ReplicaInfo) -> None:
         """Health accounting for follower ranks: rank 0 READY means

@@ -562,6 +562,24 @@ class RequestScheduler:
                 sr = self._by_rid.get(rid)
             if sr is None:
                 continue
+            if token is not None and token < 0:
+                # NaN blast-radius isolation: the engine evicted this
+                # request on the device-side non-finite sentinel. Fail
+                # exactly this outbox with a RETRYABLE error (the
+                # stream handler emits `retryable: true`, so the LB's
+                # in-flight recovery resubmits prompt + tokens-so-far
+                # to a surviving replica); co-batched requests in the
+                # same event batch continue untouched.
+                with self._q_lock:
+                    self._by_rid.pop(rid, None)
+                telemetry.get_registry().counter(
+                    'skytpu_gray_failures_total',
+                    'Gray failures detected by the data-plane '
+                    'defense layer', kind='nan_logits').inc()
+                sr.outbox.fail(
+                    'request evicted: non-finite logits (NaN/Inf) '
+                    'detected on device; retry on another replica')
+                continue
             n_tokens += 1
             if sr.first_token_time is None:
                 sr.first_token_time = clock.now()

@@ -43,6 +43,12 @@ class ReplicaStatus(enum.Enum):
     # Graceful scale-down: out of LB rotation, in-flight requests run
     # to completion under a deadline, THEN the cluster tears down.
     DRAINING = 'DRAINING'
+    # Byzantine containment: the replica answered the manager's
+    # known-digest canary prompt WRONG (silent data corruption). Out
+    # of ready_urls IMMEDIATELY (never routable again), then drained
+    # and torn down. Terminal: the autoscaler replaces it like any
+    # failed replica — a corrupt replica is never trusted again.
+    QUARANTINED = 'QUARANTINED'
     SHUTTING_DOWN = 'SHUTTING_DOWN'
     PREEMPTED = 'PREEMPTED'
     FAILED = 'FAILED'
@@ -50,7 +56,8 @@ class ReplicaStatus(enum.Enum):
 
     def is_terminal(self) -> bool:
         return self in (ReplicaStatus.PREEMPTED, ReplicaStatus.FAILED,
-                        ReplicaStatus.FAILED_PROBE)
+                        ReplicaStatus.FAILED_PROBE,
+                        ReplicaStatus.QUARANTINED)
 
 
 def serve_dir() -> str:

@@ -160,7 +160,10 @@ class ModelServer:
                  role: Optional[str] = None,
                  handoff_targets: Optional[List[str]] = None,
                  checkpoint_path: Optional[str] = None,
-                 gang: Optional['gang_lib.GangSpec'] = None):
+                 gang: Optional['gang_lib.GangSpec'] = None,
+                 step_watchdog_s: Optional[float] = None,
+                 watchdog_clock: Optional[Any] = None,
+                 nan_alarm_threshold: Optional[int] = None):
         self.cfg_name = cfg_name
         self.model_path = model_path  # HF checkpoint dir (real weights)
         self.quantize = quantize      # 'int8' => int8 weights
@@ -242,9 +245,44 @@ class ModelServer:
         # keeps the hooks at a single attribute check — zero overhead
         # on the engine loop, nothing in the compute layer.
         self._faults = faults_lib.make_injector(fault_spec)
-        # Robustness series (faults/migrations/drain/recovery) register
-        # up front so they render as zeros from the first scrape.
+        # Robustness series (faults/migrations/drain/recovery/gray)
+        # register up front so they render as zeros from first scrape.
         faults_lib.register_metrics()
+        # Gray-failure defense (round 13). Wedge watchdog: a
+        # clock-injectable per-step deadline on the engine loop. The
+        # loop arms a monotonic stamp before entering the step region
+        # and clears it after; a stamp older than ``step_watchdog_s``
+        # means a step is WEDGED (stuck jitted call, dead accelerator,
+        # deadlocked readback) while the HTTP front end still answers
+        # — the classic gray failure. The watchdog thread then flips
+        # /readiness to a degraded 503 (the manager's probe machinery
+        # fails the replica over) and fails in-flight requests with
+        # retryable errors (the LB's existing in-flight recovery
+        # resubmits them to surviving replicas). ``watchdog_clock`` is
+        # injectable so tests drive virtual time; 0 disables.
+        self.step_watchdog_s = (
+            float(step_watchdog_s) if step_watchdog_s is not None
+            else float(os.environ.get('SKYTPU_STEP_WATCHDOG_S', '120')))
+        self._wd_clock = watchdog_clock or time.monotonic
+        self._wd_lock = threading.Lock()
+        self._step_started: Optional[float] = None
+        # Degraded (gray-failed but process-alive) state: set by the
+        # watchdog and the NaN-storm alarm. Readiness reports 503
+        # status='degraded'; new submits get a retryable 503.
+        self._degraded: Optional[str] = None
+        # NaN blast-radius escalation: single poisoned requests are
+        # evicted per-request (the device sentinel), but this many
+        # total hits mean the REPLICA is sick (bad HBM, corrupted
+        # weights) — escalate to the replica-level degraded alarm.
+        self.nan_alarm_threshold = (
+            int(nan_alarm_threshold) if nan_alarm_threshold is not None
+            else int(os.environ.get('SKYTPU_NAN_ALARM', '8')))
+        self._nan_seen = 0
+        self._nan_evict_pending = False    # latched nan_logits inject
+        self._g_wd_age = reg.gauge(
+            'skytpu_engine_step_watchdog_age_seconds',
+            'Age of the engine step currently in flight (0 when the '
+            'loop is between steps); sustained growth = wedged step')
         self._h_drain = reg.histogram(
             'skytpu_replica_drain_seconds',
             'Graceful-drain duration: drain start to idle (s)',
@@ -440,6 +478,35 @@ class ModelServer:
                             raise faults_lib.InjectedFault(
                                 'injected replica_crash '
                                 f'(engine_step #{self._faults.site_count("engine_step")})')
+                        elif rule.kind == 'wedged_step':
+                            # The gray failure a crash is not: the loop
+                            # hangs INSIDE a step forever while the
+                            # HTTP front end keeps answering. Arm the
+                            # watchdog stamp exactly as a real step
+                            # would, then never progress — detection
+                            # and containment are the watchdog's job.
+                            logger.warning(
+                                'injected wedged_step: engine loop '
+                                'hanging inside the step region')
+                            self._wd_arm()
+                            while (not self._stopping
+                                   and self._degraded is None):
+                                # This loop IS the injected hang (not
+                                # a retry loop — nothing to back off).
+                                time.sleep(0.01)  # graftcheck: disable=GC112
+                            return     # a wedged step never returns
+                        elif rule.kind == 'nan_logits':
+                            # Evict one live decoding request exactly
+                            # as the device-side non-finite sentinel
+                            # would (deterministic stand-in for real
+                            # NaN logits — the device reduction itself
+                            # is unit-tested with poisoned params).
+                            # LATCHED: if the loop iteration the rule
+                            # lands on has no live request yet, the
+                            # eviction applies to the NEXT one — the
+                            # injection is deterministic under any
+                            # arrival timing.
+                            self._nan_evict_pending = True
                 if self.speculate_k and self.engine is not None:
                     # Host-only n-gram matching for the next verify
                     # round, BEFORE taking the engine lock — handler
@@ -476,7 +543,16 @@ class ModelServer:
                             self._gang.append_op(
                                 {'k': 'step', 'h': h,
                                  'prepared': bool(self.speculate_k)})
-                        events = self.engine.step(horizon=h)
+                        # Wedge watchdog window: the stamp covers
+                        # exactly the device-step region — the part a
+                        # stuck jitted call or dead accelerator wedges.
+                        self._wd_arm()
+                        try:
+                            events = self.engine.step(horizon=h)
+                        finally:
+                            self._wd_clear()
+                        if self._nan_evict_pending:
+                            events = self._inject_nan_evict(events)
                         if self._gang is not None and events:
                             # Finished-request digests feed the
                             # cross-rank byte-identity check; must run
@@ -498,6 +574,25 @@ class ModelServer:
                 # lock-free and a slow SSE consumer can never hold the
                 # engine step hostage.
                 self.sched.on_events(self.engine, events)
+                # NaN blast-radius escalation: isolated poisoned
+                # requests are evicted per-request above, but repeated
+                # hits mean the REPLICA is sick (bad HBM, corrupted
+                # weights, SDC) — escalate to the replica-level
+                # degraded alarm so the manager replaces it.
+                eng = self.engine
+                if eng is not None \
+                        and eng.nan_evictions > self._nan_seen:
+                    self._nan_seen = eng.nan_evictions
+                    if (self.nan_alarm_threshold > 0
+                            and self._nan_seen
+                            >= self.nan_alarm_threshold
+                            and self._degraded is None):
+                        self._gray_degrade(
+                            'nan_logits',
+                            f'{self._nan_seen} non-finite-logits '
+                            'evictions (replica-level NaN storm)',
+                            count=False)
+                        return
             except Exception as e:  # pylint: disable=broad-except
                 self._fatal(e)
                 return
@@ -522,6 +617,94 @@ class ModelServer:
             self._gang.fail(self._error)
         self._ready.clear()
         self.sched.fail_all(self._error)
+
+    # ------------------------------------------------- gray-failure defense
+    def _wd_arm(self) -> None:
+        with self._wd_lock:
+            self._step_started = self._wd_clock()
+
+    def _wd_clear(self) -> None:
+        with self._wd_lock:
+            self._step_started = None
+
+    def watchdog_age_s(self) -> float:
+        """Age of the engine step currently in flight (0 between
+        steps) — the ``skytpu_engine_step_watchdog_age_seconds``
+        gauge, on the injectable watchdog clock."""
+        with self._wd_lock:
+            if self._step_started is None:
+                return 0.0
+            return max(0.0, self._wd_clock() - self._step_started)
+
+    def watchdog_check(self) -> bool:
+        """One watchdog evaluation (the monitor thread's body; tests
+        call it directly on a virtual clock): a step older than
+        ``step_watchdog_s`` flips the replica to the degraded state.
+        Returns True when the watchdog fired."""
+        if self.step_watchdog_s <= 0 or self._degraded is not None:
+            return False
+        age = self.watchdog_age_s()
+        if age <= self.step_watchdog_s:
+            return False
+        self._gray_degrade(
+            'wedged_step',
+            f'engine step stuck for {age:.1f}s '
+            f'(deadline {self.step_watchdog_s:.1f}s)')
+        return True
+
+    def _gray_degrade(self, kind: str, detail: str,
+                      count: bool = True) -> None:
+        """Containment for a replica-level gray failure: mark the
+        replica degraded (readiness flips to a 503 the manager's probe
+        escalation acts on), stop admitting, and fail every queued and
+        in-flight request with a retryable error — the LB's in-flight
+        recovery resubmits the streams to surviving replicas. The
+        process stays up (a wedged accelerator does not kill HTTP),
+        which is exactly why the state is 'degraded', not 'failed'."""
+        if count:
+            faults_lib.gray_failure_counter(kind).inc()
+        self._degraded = f'{kind}: {detail}'
+        logger.warning(f'replica degraded ({self._degraded}); failing '
+                       'in-flight work over')
+        if self._error is None:
+            self._error = f'degraded ({kind}): {detail}'
+        self._ready.clear()
+        self.sched.fail_all(
+            f'replica degraded ({kind}); retry on another replica')
+
+    def _watchdog_loop(self) -> None:
+        import random as random_mod
+        rng = random_mod.Random()
+        period = min(5.0, max(0.05, self.step_watchdog_s / 4.0))
+        while not self._stopping and self._degraded is None:
+            try:
+                self.watchdog_check()
+            except Exception:  # pylint: disable=broad-except
+                logger.exception('watchdog check error')
+            # Jittered poll (graftcheck GC112: no fixed-sleep loops).
+            time.sleep(period * (0.5 + rng.random()))
+
+    def _inject_nan_evict(self, events):
+        """Injected ``nan_logits`` (engine lock held): cancel one live
+        decoding request and prepend the non-finite sentinel event —
+        the scheduler then fails exactly that outbox retryably, the
+        same containment a real device-side sentinel drives. Stays
+        latched until a live request exists (deterministic under any
+        arrival timing)."""
+        rids = self.engine.decoding_request_ids()
+        if not rids:
+            return events
+        self._nan_evict_pending = False
+        rid = rids[0]
+        if self._gang is not None:
+            # Keep the op log consistent: followers must drop the
+            # same slot at the same log position.
+            self._gang.append_op({'k': 'cancel', 'rid': rid})
+            self._gang.digest.drop(rid)
+        self.engine.cancel(rid)
+        self.engine.nan_evictions += 1
+        logger.warning(f'injected nan_logits: evicting request {rid}')
+        return [(rid, -1, True)] + list(events)
 
     # --------------------------------------------------------------- gang
     def _gang_record_admit(self, rid: int, sr) -> None:
@@ -708,6 +891,18 @@ class ModelServer:
         t0 = time.monotonic()
         try:
             blob = kv_transfer.encode_handoff(snap)
+            if self._faults is not None:
+                # Deterministic wire corruption (site 'kv_wire', kind
+                # kv_corruption): one byte of the encoded container
+                # flips in transit — the receiver's CRC layer must
+                # refuse it all-or-nothing (a retryable 400 → this
+                # prefill falls back to local decode, never a
+                # byte-wrong continuation).
+                rule = self._faults.fire('kv_wire')
+                if rule is not None and rule.kind == 'kv_corruption':
+                    blob = faults_lib.corrupt_blob(blob, rule)
+                    logger.warning('injected kv_corruption on the '
+                                   'handoff wire (1 byte flipped)')
             req = urllib.request.Request(
                 target + '/kv/ingest', data=blob,
                 headers={'Content-Type': 'application/octet-stream',
@@ -1093,6 +1288,10 @@ class ModelServer:
               len(getattr(eng, '_prefill_off', ())) if eng else 0)
         g('skytpu_max_batch', 'Configured decode batch').set(
             self.max_batch)
+        # Wedge-watchdog age: 0 between steps; sustained growth means
+        # a step is stuck (the gauge operators alert on BEFORE the
+        # watchdog deadline fires).
+        self._g_wd_age.set(round(self.watchdog_age_s(), 3))
         # Serving mesh shape, one series per logical axis — all 1s on
         # a single-chip replica, configured values before the engine
         # loads (stable schema: the series never appear/disappear).
@@ -1329,7 +1528,17 @@ class ModelServer:
                 parsed = urllib.parse.urlparse(self.path)
                 query = urllib.parse.parse_qs(parsed.query)
                 if parsed.path == '/readiness':
-                    if server._error is not None:
+                    if server._degraded is not None:
+                        # Gray failure contained: the process is alive
+                        # (that is the POINT of a gray failure) but the
+                        # data plane is not trustworthy — the manager's
+                        # probe escalation fails the replica over.
+                        self._json(503, {'status': 'degraded',
+                                         'cause': server._degraded,
+                                         'watchdog_age_s': round(
+                                             server.watchdog_age_s(),
+                                             3)})
+                    elif server._error is not None:
                         self._json(503, {'status': 'failed',
                                          'error': server._error})
                     elif server.sched.draining:
@@ -1757,6 +1966,11 @@ class ModelServer:
                         self.headers.get('X-SLO-Tier'))
                 except ValueError as e:
                     server._m_handoff['rejected'].inc()
+                    if 'checksum mismatch' in str(e):
+                        # A bit-flipped wire container, caught by the
+                        # CRC layer before any row landed.
+                        faults_lib.gray_failure_counter(
+                            'kv_corruption').inc()
                     self._json(400, {'error': {
                         'message': str(e),
                         'type': 'invalid_handoff'}})
@@ -1900,6 +2114,9 @@ class ModelServer:
                 try:
                     self._json(200, server.warm_from_checkpoint(data))
                 except ValueError as e:
+                    if 'checksum mismatch' in str(e):
+                        faults_lib.gray_failure_counter(
+                            'kv_corruption').inc()
                     self._json(400, {'error': {
                         'message': str(e),
                         'type': 'invalid_checkpoint'}})
@@ -1928,6 +2145,14 @@ class ModelServer:
                         return
                     self._json(200, server.begin_drain(
                         payload.get('deadline_s')))
+                    return
+                if server._degraded is not None:
+                    # Retryable refusal: the LB treats a replica 503 as
+                    # never-executed and retries on another replica.
+                    self._json(503, {'status': 'degraded',
+                                     'cause': server._degraded,
+                                     'retry_after_s': 5},
+                               extra_headers={'Retry-After': '5'})
                     return
                 if not server._ready.is_set():
                     self._json(503, {'status': 'loading'},
@@ -2026,6 +2251,9 @@ class ModelServer:
         self._engine_thread.start()
         if self._gang is not None:
             threading.Thread(target=self._gang_monitor,
+                             daemon=True).start()
+        if self.step_watchdog_s > 0:
+            threading.Thread(target=self._watchdog_loop,
                              daemon=True).start()
         handler = self._make_handler()
         self._httpd = http.server.ThreadingHTTPServer(('0.0.0.0', self.port),
@@ -2150,6 +2378,15 @@ def main() -> None:
                              '503 + Retry-After while in-flight ones '
                              'run to completion; stragglers past the '
                              'deadline are failed over (retryable)')
+    parser.add_argument('--step-watchdog-s', type=float, default=None,
+                        help='wedge-watchdog deadline (seconds) on '
+                             'each engine step: a step stuck longer '
+                             'flips /readiness to a degraded 503 and '
+                             'fails in-flight requests over '
+                             '(retryable — the LB resubmits them to '
+                             'surviving replicas). Default: '
+                             'SKYTPU_STEP_WATCHDOG_S env, else 120; '
+                             '0 disables')
     parser.add_argument('--fault-spec', default=None,
                         help='deterministic fault-injection spec (JSON '
                              'or @/path/to/spec.json; default: the '
@@ -2236,7 +2473,8 @@ def main() -> None:
                                           if args.handoff_targets
                                           else None),
                          checkpoint_path=args.checkpoint_path,
-                         gang=gang_spec)
+                         gang=gang_spec,
+                         step_watchdog_s=args.step_watchdog_s)
     server.start(block=True)
 
 

@@ -41,9 +41,10 @@ from skypilot_tpu.serve.sim import replica as sim_replica
 from skypilot_tpu.serve.sim import traffic as sim_traffic
 
 # Sim fault sites the storm callback evaluates, in a fixed order (the
-# order is part of the determinism contract).
+# order is part of the determinism contract). ``sim_gray`` carries the
+# gray-failure kinds: wedged_step / nan_logits / byzantine_response.
 SIM_FAULT_SITES = ('sim_storm', 'sim_zone_outage', 'sim_straggler',
-                   'sim_gang_churn')
+                   'sim_gang_churn', 'sim_gray')
 
 # Per-tier TTFT SLO targets (seconds) — what "attainment" means.
 DEFAULT_SLO_TTFT = {'latency': 2.0, 'throughput': 10.0}
@@ -82,6 +83,7 @@ class FleetSimulator:
                  drain_grace_s: float = 300.0,
                  never_drain_clusters: Optional[set] = None,
                  keep_log: bool = True,
+                 canary_s: float = 0.0,
                  service_name: str = 'sim-svc'):
         self.spec = spec
         self.trace = trace
@@ -110,6 +112,12 @@ class FleetSimulator:
         self.controller = controller_lib.ServeController(
             service_name, spec, {'resources': {'cloud': 'sim'}},
             port=1, env=self.env)
+        if canary_s > 0:
+            # Byzantine-detection canary on the virtual clock: the
+            # REAL manager probes each READY replica's /generate with
+            # the known-digest prompt; SimReplica answers through
+            # canary_response_tokens.
+            self.controller.replica_manager.configure_canary(canary_s)
         self.policy = lb_policies.make_policy(policy_name)
         self.policy.configure_transport(
             fetch_json=self.world.fetch_json,
@@ -345,6 +353,50 @@ class FleetSimulator:
                               f'gang={r.gang_id} rank={r.gang_rank}')
                     self.world.kill_replica(r)
                     break
+        elif site == 'sim_gray':
+            self._apply_gray_fault(rule, live)
+
+    def _apply_gray_fault(self, rule: faults_lib.FaultRule,
+                          live) -> None:
+        """Gray failures: the replica stays HTTP-alive while serving
+        wrong bytes or nothing — detection belongs to the watchdog /
+        sentinel / canary layers this storm drills."""
+        if rule.kind == 'wedged_step':
+            for r in live:
+                if (not r.wedged and not r.byzantine
+                        and r.gang_rank == 0):
+                    r.wedged = True
+                    self._log('wedge', f'url={r.url}')
+                    break
+        elif rule.kind == 'nan_logits':
+            # Evict up to ``n`` in-flight requests with retryable
+            # errors (the live path: device sentinel -> per-request
+            # outbox failure -> LB resubmit); the rest of the batch
+            # continues untouched.
+            victims = [r for r in live
+                       if r.inflight and not r.wedged]
+            if not victims:
+                return
+            rep = max(victims, key=lambda r: len(r.inflight))
+            jobs = [j for j in list(rep.inflight.values())
+                    if not j.cancelled][:max(1, rule.n)]
+            self._log('nan_evict', f'url={rep.url} n={len(jobs)}')
+            now = self.loop.now
+            for job in jobs:
+                job.cancelled = True
+                rep.inflight.pop(job.job_id, None)
+                self.policy.post_execute(rep.url)
+                self._inflight -= job.count
+                self.migrated += job.count
+                self._dispatch(job.count, job.tier,
+                               migrated_from=rep.url, failed_at=now)
+        elif rule.kind == 'byzantine_response':
+            for r in live:
+                if (not r.byzantine and not r.wedged
+                        and r.gang_rank == 0):
+                    r.byzantine = True
+                    self._log('byzantine', f'url={r.url}')
+                    break
 
     # ----------------------------------------------------------------- run
     def _outstanding(self) -> int:
@@ -421,6 +473,7 @@ class FleetSimulator:
                 'target_final': self.controller.autoscaler
                                 .target_num_replicas,
                 'tracked_final': len(mgr.replicas()),
+                'quarantined': mgr.quarantined_count,
             },
             'faults_fired': faults_fired,
             'events': self._n_events,

@@ -108,6 +108,15 @@ class ServiceCurve:
                    kv_pool_tokens=slots * 424)  # ~anchor tokens/slot
 
 
+def canary_response_tokens(prompt: List[int], n: int) -> List[int]:
+    """The deterministic greedy 'generation' every HEALTHY simulated
+    replica answers a canary prompt with — same prompt, same tokens,
+    fleet-wide (standing in for greedy decode on identical weights).
+    A byzantine replica perturbs these (silent data corruption)."""
+    seed = sum(int(t) * (i + 1) for i, t in enumerate(prompt))
+    return [(seed * 31 + i * 7 + 3) % 997 for i in range(max(1, n))]
+
+
 class SimHTTPError(RuntimeError):
     """A simulated HTTP failure (dead replica / 4xx-5xx) — the sim
     env raises it where urllib would raise, so the manager's error
@@ -165,6 +174,15 @@ class SimReplica:
         self.never_drain = never_drain
         self.warm = False                  # warmed from a checkpoint
         self.slowdown = 1.0                # straggler fault multiplier
+        # Gray-failure fault switches (round 13):
+        # wedged: the engine loop is stuck — the replica ACCEPTS work
+        # that never finishes and its /readiness reports degraded (the
+        # probe escalation must replace it; in-flight jobs migrate at
+        # teardown). byzantine: silently corrupted — serves normally
+        # but answers the manager's canary prompt WRONG (the
+        # quarantine path must catch it).
+        self.wedged = False
+        self.byzantine = False
         self.busy_until = 0.0
         self.inflight: Dict[int, SimJob] = {}
         self._next_job = 1
@@ -179,6 +197,19 @@ class SimReplica:
             raise SimHTTPError(502, 'replica dead')
         if self.draining:
             raise SimHTTPError(503, 'draining')
+        if self.wedged:
+            # The gray part of a wedged replica: it still ACCEPTS the
+            # work (HTTP alive, queue open) — the job just never
+            # finishes. It migrates when the probe escalation finally
+            # tears the replica down.
+            job = SimJob(job_id=self._next_job, count=count,
+                         prompt_tokens=prompt_tokens,
+                         gen_tokens=gen_tokens, tier=tier,
+                         submit_t=now, ttft_s=float('inf'),
+                         finish_t=now + 1e12)
+            self._next_job += 1
+            self.inflight[job.job_id] = job
+            return job
         svc = self.curve.service_s(prompt_tokens, gen_tokens,
                                    self.warm) * self.slowdown
         wait = max(0.0, self.busy_until - now)
@@ -229,7 +260,23 @@ class SimReplica:
             raise SimHTTPError(502, 'connection refused')
         now = self._now()
         if path == '/readiness':
+            if self.wedged:
+                # The live model server's wedge watchdog flips
+                # readiness to a degraded 503; the probe escalation
+                # (NOT_READY -> FAILED_PROBE) then replaces it.
+                raise SimHTTPError(503, 'degraded: wedged engine step')
             return {'ready': not self.draining, 'draining': self.draining}
+        if path == '/generate':
+            # The canary surface: greedy tokens deterministic in the
+            # prompt, identical on every healthy replica; a byzantine
+            # replica answers perturbed tokens (silent corruption the
+            # manager's digest compare must catch).
+            prompt = [int(t) for t in (payload or {}).get('prompt', [])]
+            n = int((payload or {}).get('max_new_tokens', 8))
+            toks = canary_response_tokens(prompt, n)
+            if self.byzantine:
+                toks = [(t + 1) % 997 for t in toks]
+            return {'tokens': toks, 'request_id': 0}
         if path == '/drain':
             if payload is not None or data is not None:   # POST: begin
                 if not self.draining:

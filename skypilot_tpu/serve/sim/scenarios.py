@@ -278,6 +278,34 @@ _register(Scenario(
 ))
 
 _register(Scenario(
+    name='gray_failure_storm',
+    description='Gray-failure storm: one replica wedges (accepts work '
+                'that never finishes, readiness degrades), a NaN '
+                'burst evicts in-flight requests retryably, a '
+                'byzantine replica answers canaries wrong (quarantined '
+                'before a second wrong response), and a preemption '
+                'checkpoint is bit-flipped in transit (the replacement '
+                'must boot cold, never byte-wrong). Zero lost.',
+    spec_fn=lambda: _spec(min_replicas=6, max_replicas=10,
+                          target_qps_per_replica=2.0),
+    trace_fn=lambda: sim_traffic.constant(8.0, 600.0),
+    fault_rules=[
+        {'kind': 'wedged_step', 'site': 'sim_gray', 'at': 3},
+        {'kind': 'nan_logits', 'site': 'sim_gray', 'at': 8, 'n': 4},
+        {'kind': 'byzantine_response', 'site': 'sim_gray', 'at': 12},
+        # Advance preemption warning -> the manager fetches the
+        # replica's checkpoint -> the kv_wire rule flips one byte of
+        # it -> the replacement's warmup refuses the container (400)
+        # and boots cold.
+        {'kind': 'preempt_signal', 'site': 'preempt_warning',
+         'at': 120},
+        {'kind': 'kv_corruption', 'site': 'kv_wire', 'at': 1},
+    ],
+    sim_kwargs=dict(provision_s=25.0, storm_dt=10.0, canary_s=30.0,
+                    drain_grace_s=400.0),
+))
+
+_register(Scenario(
     name='flash_crowd',
     description='Flash crowd: traffic steps 6x with no seasonal '
                 'precedent — only the trend term can chase it; '

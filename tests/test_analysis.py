@@ -917,3 +917,63 @@ def test_gc117_only_polices_sim_paths():
                                    'skypilot_tpu/serve/server_x.py')
     assert rule_ids(src, 'skypilot_tpu/serve/sim/replica.py') == [
         'GC117']
+
+
+# ------------------------------------------------------------------ GC118
+def test_gc118_unknown_fault_site_flagged():
+    src = '''
+    class M:
+        def loop(self):
+            rule = self._faults.fire('engin_step')
+    '''
+    vs = check(src)
+    assert [v.rule for v in vs] == ['GC118']
+    assert 'engin_step' in vs[0].message
+
+
+def test_gc118_kwarg_spelling_flagged():
+    src = '''
+    class M:
+        def loop(self):
+            rule = self._faults.fire(site='kv_wires')
+    '''
+    assert rule_ids(src) == ['GC118']
+
+
+def test_gc118_registered_sites_clean():
+    # Every registry member is legal, positional or kwarg, and
+    # non-literal sites (the simulator's site-tuple sweep) are skipped
+    # — their tuples hold registry members by construction.
+    src = '''
+    SITES = ('sim_storm', 'sim_gray')
+    class M:
+        def loop(self):
+            a = self._faults.fire('engine_step')
+            b = self._faults.fire(site='canary')
+            c = inj.fire('kv_wire')
+            for s in SITES:
+                inj.fire(s)
+    '''
+    assert rule_ids(src) == []
+
+
+def test_gc118_only_polices_serve():
+    # A .fire() outside serve/ is somebody else's API.
+    src = '''
+    class Gun:
+        def pull(self):
+            self.trigger.fire('bullet')
+    '''
+    assert 'GC118' not in rule_ids(src, 'skypilot_tpu/jobs/gun.py')
+
+
+def test_gc118_every_live_fire_site_is_registered():
+    # The repo-wide gate (test_repo_is_clean_modulo_baseline) enforces
+    # this transitively; pin the registry contents the sim site-tuples
+    # rely on explicitly too.
+    from skypilot_tpu.serve import faults as faults_lib
+    from skypilot_tpu.serve.sim import fleet as sim_fleet
+    for site in sim_fleet.SIM_FAULT_SITES:
+        assert site in faults_lib.FAULT_SITES, site
+    for kind in faults_lib.GRAY_FAILURE_KINDS:
+        assert kind in faults_lib.FAULT_KINDS, kind

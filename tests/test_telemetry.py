@@ -60,6 +60,16 @@ def test_spot_scaling_series_registered_at_construction(
     assert '# TYPE skytpu_replica_provision_seconds histogram' in prom
     assert 'skytpu_replica_provision_seconds_bucket{le="+Inf"} 0' \
         in prom
+    # Round-13 gray-failure series: the quarantine counter and every
+    # gray detection kind register at MANAGER construction — zeros
+    # before any canary mismatch, NaN eviction or checksum refusal.
+    assert '# TYPE skytpu_replicas_quarantined_total counter' in prom
+    assert 'skytpu_replicas_quarantined_total 0' in prom
+    assert '# TYPE skytpu_gray_failures_total counter' in prom
+    from skypilot_tpu.serve import faults as faults_lib
+    for kind in faults_lib.GRAY_FAILURE_KINDS:
+        assert (f'skytpu_gray_failures_total{{kind="{kind}"}} 0'
+                in prom), kind
 
 
 def test_gang_series_registered_at_construction():
@@ -518,6 +528,16 @@ def test_server_prometheus_metrics_and_debug_requests():
         # JSON gang block: stable schema, non-gang truth.
         assert m['gang']['world'] == 1
         assert m['gang']['barrier'] is True
+        # (b7) Gray-failure series (round 13): every detection kind
+        # registers at construction; the wedge-watchdog age gauge is 0
+        # between steps from the first scrape.
+        assert '# TYPE skytpu_gray_failures_total counter' in prom
+        for kind in faults_lib.GRAY_FAILURE_KINDS:
+            assert (f'skytpu_gray_failures_total{{kind="{kind}"}}'
+                    in prom), kind
+        assert ('# TYPE skytpu_engine_step_watchdog_age_seconds '
+                'gauge') in prom
+        assert 'skytpu_engine_step_watchdog_age_seconds 0' in prom
         assert m['gang']['members'] == {}
         # JSON disagg block: stable schema, zeros when idle.
         assert m['disagg']['role'] == 'colocated'
