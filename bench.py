@@ -145,6 +145,10 @@ def main() -> None:
              lambda: _gang_bench(n_chips)),
             ('sim',
              _sim_bench),
+            ('quant4',
+             lambda: _quant4_bench(n_chips, chip_bw)),
+            ('multistep',
+             lambda: _multistep_bench(n_chips)),
             ('train',
              lambda: _train_step_bench(on_tpu, n_chips,
                                        chip_peak_tflops))):
@@ -670,6 +674,14 @@ def _bench_7b_serving(chip_bw: float, n_chips: int) -> dict:
             'phase_ms_per_step': {
                 'total': round(per_step * 1e3, 3),
                 'weights_stream': round(weights_ms, 3),
+                # STORED weight bytes behind the weights_stream split
+                # (quantized leaves count codes + scales at their
+                # packed width — int8 1B/elem, int4 packed nibbles
+                # 0.5B/elem — so the implied GB/s stays honest across
+                # quantize modes instead of assuming bf16).
+                'weights_stream_bytes': int(param_bytes),
+                'weights_stream_gb_s': round(
+                    param_bytes / max(weights_ms, 1e-9) / 1e6, 1),
                 'attn_kv_and_rest': round(per_step * 1e3 - weights_ms,
                                           3),
                 'dispatch_per_call': round(dispatch_ms, 2),
@@ -2689,6 +2701,202 @@ def _bench_1b_modeled(on_tpu: bool, chip_bw: float, n_chips: int) -> dict:
             'gen_len': gen_len,
             'wall_s': round(dt, 2),
         },
+    }
+
+
+def _steady_decode_tok_s(eng, prompt, gen_len, batch,
+                         horizon: int, min_tokens: int = 0) -> float:
+    """Tokens/s of a pure fused-decode window on an already-warm
+    engine (admit everything, time step() calls until ``min_tokens``
+    tokens surfaced — a token-count window so k=1 and k=8 measure over
+    comparable work — then drain)."""
+    min_tokens = min_tokens or 3 * batch * max(
+        horizon, getattr(eng, 'decode_steps_per_call', None) or 1)
+    for _ in range(batch):
+        eng.add_request(list(prompt), max_new_tokens=gen_len)
+    eng.step(horizon=1)                    # admit + prefill all slots
+    tokens = 0
+    t0 = time.time()
+    while tokens < min_tokens and eng.has_work():
+        tokens += len(eng.step(horizon=horizon))
+    window = time.time() - t0
+    eng.run_to_completion(horizon=horizon)
+    return tokens / max(window, 1e-9)
+
+
+def _multistep_bench(n_chips: int) -> dict:
+    """Multi-step on-device decode (``decode_steps_per_call``):
+    sustained decode tok/s at k in {1, 2, 4, 8} at EQUAL batch, plus
+    the greedy byte-identity check (k > 1 reproduces k = 1 exactly;
+    checked on an fp32 twin config — bf16 near-tie argmax flips under
+    the reordered two-block ring softmax are the one documented
+    exception, same caveat as the int8-KV chunked-prefill contract).
+    Tiny model on CPU: per-call host work (dispatch, readback,
+    scheduling) dominates the step at this scale, so the k sweep
+    measures exactly what the knob amortizes — the same cost a remote
+    PJRT tunnel charges ~100 ms/call for on real pods."""
+    import dataclasses
+    import warnings as warnings_mod
+
+    import jax.numpy as jnp
+
+    from skypilot_tpu.inference.paged import PagedInferenceEngine
+    from skypilot_tpu.models import configs
+    cfg = configs.get_config('tiny')
+    batch, gen_len, max_seq = 4, 33, 128
+    prompt = list(range(1, 17))
+    tok_s_by_k = {}
+    outputs_by_k = {}
+    cfg32 = dataclasses.replace(cfg, dtype=jnp.float32)
+    with warnings_mod.catch_warnings(record=True) as caught:
+        warnings_mod.simplefilter('always')
+        for k in (1, 2, 4, 8):
+            eng = PagedInferenceEngine(
+                cfg, max_batch=batch, max_seq=max_seq,
+                decode_steps_per_call=k)
+            # Warmup at measurement shapes (compiles), then measure.
+            _steady_decode_tok_s(eng, prompt, gen_len, batch, horizon=1)
+            tok_s = _steady_decode_tok_s(eng, prompt, gen_len, batch,
+                                         horizon=1)
+            tok_s_by_k[k] = round(tok_s / n_chips, 2)
+            sub = eng.phase_stats()['phases'].get('decode_enqueue', {})
+            per_sub = sub.get('per_substep_ms')
+            del eng
+            # Byte-identity on a FRESH fp32 engine (decisive argmaxes).
+            e32 = PagedInferenceEngine(
+                cfg32, max_batch=batch, max_seq=max_seq,
+                decode_steps_per_call=k)
+            rid = e32.add_request(prompt, max_new_tokens=24)
+            done = e32.run_to_completion(horizon=1)
+            outputs_by_k[k] = list(done[rid].output)
+            del e32
+    best_k = max(tok_s_by_k, key=tok_s_by_k.get)
+    return {
+        'batch': batch,
+        'sustained_decode_tok_s_per_chip_by_k': tok_s_by_k,
+        'best_k': best_k,
+        'speedup_best_k_vs_k1': round(
+            tok_s_by_k[best_k] / max(tok_s_by_k[1], 1e-9), 3),
+        'k4_vs_k1': round(tok_s_by_k[4] / max(tok_s_by_k[1], 1e-9), 3),
+        'greedy_byte_identical_across_k': all(
+            outputs_by_k[k] == outputs_by_k[1] for k in outputs_by_k),
+        'decode_enqueue_per_substep_ms_at_k8': per_sub,
+        # Warning-freeness discipline (page_size_warnings-style).
+        'warnings': [str(w.message) for w in caught
+                     if issubclass(w.category, UserWarning)],
+    }
+
+
+def _quant4_bench(n_chips: int, chip_bw: float) -> dict:
+    """int4 fused-dequant weights: the streamed bytes/token table
+    (bf16 / int8 / int4 stored weight bytes), the int8->int4 stream
+    ratio, and a ``decode_roofline_frac`` measured against the INT4
+    roofline at the best k. On CPU the 'bandwidth' is calibrated from
+    the measured weights-only stream pass over the SAME int4 params
+    (attention stubbed — the roofline-bound share of a decode step),
+    so the frac is achieved-decode-rate over that stream-bound rate:
+    the honest CPU analog of the HBM roofline division the 7B TPU
+    section does. The model is a mid-size GQA config (dim 768, 4
+    layers, 12 q / 3 kv heads) — big enough that the weight stream,
+    not host scheduling, bounds the step, which is the regime the
+    roofline number is ABOUT; the host-bound regime's k scaling is the
+    ``multistep`` block's job."""
+    import warnings as warnings_mod
+
+    import jax
+
+    from skypilot_tpu.inference.paged import PagedInferenceEngine
+    from skypilot_tpu.models import llama, quantization
+    from skypilot_tpu.models.configs import ModelConfig
+    cfg = ModelConfig(name='quant4-bench', vocab_size=8192, dim=768,
+                      n_layers=4, n_heads=12, n_kv_heads=3,
+                      ffn_dim=3072)
+    batch, gen_len, max_seq = 4, 40, 64
+    prompt = list(range(1, 17))
+    base = llama.init_params(jax.random.PRNGKey(0), cfg)
+    trees = {
+        'bf16': base,
+        'int8': quantization.quantize_params(base, mode='int8'),
+        'int4': quantization.quantize_params(base, mode='int4'),
+    }
+
+    def stored(tree):
+        return quantization.quantized_bytes(tree)
+
+    def quantizable(tree):
+        """Stored bytes of the quantize-eligible leaves only (the
+        stream the quantize knob actually shrinks — embeddings/norms
+        ride every mode unchanged)."""
+        total = 0
+        for key, val in tree['layers'].items():
+            if key in quantization.REDUCE_AXES:
+                total += stored({'x': val})
+        if 'unembed' in tree:
+            total += stored({'x': tree['unembed']})
+        return total
+
+    bytes_table = {m: int(stored(t)) for m, t in trees.items()}
+    q_table = {m: int(quantizable(t)) for m, t in trees.items()}
+    # Streamed weight bytes per decode token at this batch (the whole
+    # tree minus the embed table, whose gather reads only batch rows).
+    def stream_bytes(mode):
+        embed = trees[mode]['embed']
+        return (bytes_table[mode] - embed.size * embed.dtype.itemsize
+                + batch * cfg.dim * 2)
+
+    per_tok = {m: round(stream_bytes(m) / batch, 1) for m in trees}
+    with warnings_mod.catch_warnings(record=True) as caught:
+        warnings_mod.simplefilter('always')
+        # Weights-only stream pass over the int4 params: calibrates the
+        # achievable stream rate on THIS host.
+        weights_ms = _weights_only_step_ms(trees['int4'], cfg, batch,
+                                           horizon=16)
+        sb4 = stream_bytes('int4')
+        stream_bw = sb4 / (weights_ms * 1e-3)          # bytes/s
+        # Live int8 KV per step (auto-coupled with int4 weights).
+        avg_ctx = len(prompt) + gen_len / 2
+        live_kv = (batch * avg_ctx * cfg.n_layers * 2 * cfg.n_kv_heads
+                   * (cfg.head_dim + 4))
+        roofline_tok_s = stream_bw / (sb4 + live_kv) * batch
+        tok_s_by_k = {}
+        min_tok = batch * 32            # equal-token windows across k
+        for k in (1, 4, 8):
+            eng = PagedInferenceEngine(
+                cfg, base, max_batch=batch, max_seq=max_seq,
+                quantize='int4', decode_steps_per_call=k,
+                page_size=32)
+            _steady_decode_tok_s(eng, prompt, gen_len, batch,
+                                 horizon=1, min_tokens=min_tok)
+            tok_s_by_k[k] = round(_steady_decode_tok_s(
+                eng, prompt, gen_len, batch, horizon=1,
+                min_tokens=min_tok) / n_chips, 2)
+            del eng
+    best_k = max(tok_s_by_k, key=tok_s_by_k.get)
+    frac = tok_s_by_k[best_k] / roofline_tok_s if roofline_tok_s else 0
+    return {
+        'batch': batch,
+        'stored_weight_bytes': bytes_table,
+        'quantizable_leaf_bytes': q_table,
+        'streamed_weight_bytes_per_token': per_tok,
+        # The acceptance ratio: int4's streamed bytes vs int8's — the
+        # quantizable leaves pack to ~0.53x (0.5x codes + scale
+        # overhead), well under the 0.6x bar.
+        'int4_vs_int8_stream_ratio': round(
+            stream_bytes('int4') / stream_bytes('int8'), 3),
+        'int4_vs_int8_quantizable_ratio': round(
+            q_table['int4'] / q_table['int8'], 3),
+        'capacity_ratio_int8_vs_int4_quantizable': round(
+            q_table['int8'] / q_table['int4'], 2),
+        'weights_only_stream_ms_per_step': round(weights_ms, 3),
+        'calibrated_stream_gb_s': round(stream_bw / 1e9, 3),
+        'int4_roofline_tok_s_per_chip': round(
+            roofline_tok_s / n_chips, 2),
+        'sustained_decode_tok_s_per_chip_by_k': tok_s_by_k,
+        'best_k': best_k,
+        'decode_roofline_frac_int4': round(frac, 3),
+        # Warning-freeness discipline (page_size_warnings-style).
+        'warnings': [str(w.message) for w in caught
+                     if issubclass(w.category, UserWarning)],
     }
 
 

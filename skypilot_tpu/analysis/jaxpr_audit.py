@@ -307,11 +307,17 @@ def _jit_fns(fn) -> List[Any]:
 def _tiny_engine(kind: str, chunked: bool, speculate_k: int = 0,
                  telemetry: bool = True,
                  kv_cache_dtype: Optional[str] = None,
-                 mesh_tp: int = 0, mesh_dp: int = 0):
+                 mesh_tp: int = 0, mesh_dp: int = 0,
+                 quantize: Optional[str] = None,
+                 decode_steps_per_call: Optional[int] = None):
     from skypilot_tpu.models import configs
     cfg = configs.get_config('tiny')
     chunk = 16 if chunked else 0
     extra: Dict[str, Any] = {}
+    if quantize is not None:
+        extra['quantize'] = quantize
+    if decode_steps_per_call is not None:
+        extra['decode_steps_per_call'] = decode_steps_per_call
     if mesh_tp and mesh_tp > 1:
         import jax
 
@@ -456,7 +462,8 @@ def audit_engine(kind: str = 'slot', chunked: bool = True,
                  kv_cache_dtype: Optional[str] = None,
                  mesh_tp: int = 0, mesh_dp: int = 0,
                  warmup_rounds: int = 1,
-                 merge_all_gathers: int = 0) -> AuditReport:
+                 merge_all_gathers: int = 0,
+                 quantize: Optional[str] = None) -> AuditReport:
     """Build a tiny engine, run one warmup wave (compiles allowed),
     then audit ``rounds`` identical same-shaped waves: every compile
     and every unsanctioned host transfer in those waves is a violation.
@@ -481,15 +488,17 @@ def audit_engine(kind: str = 'slot', chunked: bool = True,
     spec_tag = f' + speculate_k={speculate_k}' if speculate_k else ''
     kv_tag = (f' + kv_cache_dtype={kv_cache_dtype}'
               if kv_cache_dtype else '')
+    q_tag = f' + quantize={quantize}' if quantize else ''
     tp_tag = f' + tp={mesh_tp}' if mesh_tp else ''
     tp_tag += f' x dp={mesh_dp}' if mesh_dp else ''
     report = AuditReport(
         name=f'{kind} engine '
              f'({"chunked prefill + " if chunked else ""}decode'
-             f'{spec_tag}{kv_tag}{tp_tag})')
+             f'{spec_tag}{kv_tag}{q_tag}{tp_tag})')
     engine = _tiny_engine(kind, chunked, speculate_k,
                           kv_cache_dtype=kv_cache_dtype,
-                          mesh_tp=mesh_tp, mesh_dp=mesh_dp)
+                          mesh_tp=mesh_tp, mesh_dp=mesh_dp,
+                          quantize=quantize)
     if speculate_k:
         # Repetitive prompts: the n-gram proposer matches, acceptance
         # is nonzero AND per-slot variable — the masked-commit shapes
@@ -551,6 +560,67 @@ def audit_engine(kind: str = 'slot', chunked: bool = True,
                 p for p in report.promotions if 'float64' in p]
     except Exception as e:  # pragma: no cover - trace-shape drift
         report.promotions.append(f'<jaxpr trace failed: {e}>')
+    return report
+
+
+def audit_multistep(k: int = 4,
+                    quantize: Optional[str] = None) -> AuditReport:
+    """Multi-step on-device decode (``decode_steps_per_call=k``): the
+    dispatch-amortization contract, audited.
+
+    A paged engine with the knob pinned serves EQUAL-shape budget-bound
+    requests (no eos/stop — early-free keeps every slot in lockstep),
+    with ``max_new_tokens = 2k + 1``: one first token from prefill plus
+    exactly ``2k`` decode tokens. Steady state must show, per round:
+
+    - exactly TWO decode dispatches — ONE jitted call per k tokens
+      (the whole point of the knob; a partial-k call or an extra
+      tail dispatch fails the count);
+    - every dispatch's static horizon == k (the jit key stays
+      (k, sample, P) — a drifting horizon would both recompile and
+      break the amortization claim);
+    - the usual gates: zero unsanctioned d2h, zero steady-state
+      recompiles."""
+    q_tag = f', quantize={quantize}' if quantize else ''
+    report = AuditReport(
+        name=f'multi-step decode (decode_steps_per_call={k}{q_tag})')
+    engine = _tiny_engine('paged', chunked=True,
+                          quantize=quantize, decode_steps_per_call=k)
+    prompts = [[3 + i, 5, 7, 9, 2, 4, 6, 8, 1, 3, 5, 7]
+               for i in range(4)]               # equal shapes: lockstep
+    max_new = 2 * k + 1
+
+    def one_round() -> None:
+        for p in prompts:
+            engine.add_request(list(p), max_new_tokens=max_new)
+        # Caller horizon 1: the KNOB must fuse k, not the caller.
+        engine.run_to_completion(horizon=1)
+
+    one_round()                                   # warmup: compiles
+    inner = _record_static_keys(engine, report)
+    decode_jits = _jit_fns(inner)
+    labels = {'decode': lambda: (sum(_cache_size(f)
+                                     for f in decode_jits)
+                                 if decode_jits else -1),
+              'prefill': lambda: len(engine._prefill_fns)}
+    before = {name: get() for name, get in labels.items()}
+    rounds = 2
+    with intercept_host_transfers(report.transfers):
+        for _ in range(rounds):
+            one_round()
+    engine._decode_fn = inner
+    report.compile_counts = {
+        name: (before[name], get()) for name, get in labels.items()}
+    # ONE dispatch per k tokens: 2k decode tokens/round at lockstep =
+    # exactly 2 dispatches/round. Recorded as an (expected, actual)
+    # compile_counts pair so a mismatch fails ok() like a recompile.
+    report.compile_counts['decode dispatches (ONE per '
+                          f'{k} tokens)'] = (
+        rounds * 2, len(report.static_keys))
+    bad_h = [key for key in report.static_keys
+             if key.get('horizon') != k]
+    report.compile_counts['dispatches at horizon != k'] = (
+        0, len(bad_h))
     return report
 
 
@@ -738,6 +808,17 @@ PRESETS: Dict[str, Callable[[], AuditReport]] = {
     # state compiles ZERO prefill programs, and ingest adds zero
     # recompiles / unsanctioned d2h (int8 KV rides the wire codec).
     'disagg': audit_disagg,
+    # int4 fused-dequant weights (packed codes + int8 KV via auto):
+    # the unpack-inside-qeinsum path must add zero d2h transfers and
+    # zero steady-state jit-cache growth on both engines' hot loops.
+    'int4': lambda: audit_engine('paged', chunked=True,
+                                 quantize='int4'),
+    'int4-slot': lambda: audit_engine('slot', chunked=True,
+                                      quantize='int4'),
+    # Multi-step on-device decode: exactly ONE dispatch per k tokens,
+    # every dispatch at static horizon k, zero recompiles/d2h.
+    'multistep': audit_multistep,
+    'int4-multistep': lambda: audit_multistep(quantize='int4'),
     'llama': audit_llama_forward,
 }
 
@@ -753,7 +834,8 @@ MULTI_DEVICE_PRESETS: Dict[str, int] = {
 DEFAULT_PRESETS: List[str] = [
     'slot', 'paged', 'slot-spec', 'paged-spec', 'telemetry',
     'kv-int8', 'kv-int8-slot', 'paged-tp', 'paged-tp-int8',
-    'paged-gang', 'disagg', 'llama']
+    'paged-gang', 'disagg', 'int4', 'multistep', 'int4-multistep',
+    'llama']
 
 
 def run_presets(names: Optional[List[str]] = None) -> List[AuditReport]:

@@ -103,6 +103,14 @@ TPU hot-path hygiene (GC2xx), applied to the compute layer
   ±1-integer range and drops the scale — garbage KV that still
   type-checks. (Classed with the 1xx rules because it polices a
   repo-wide write discipline, not a jaxpr property.)
+- **GC119 bare-int4-bit-twiddling** — ``.astype(int4/uint4)`` or a
+  hand-rolled nibble op (``<< 4`` / ``>> 4`` / ``& 0xF``) in the
+  compute layer outside ``models/quantization.py``. Packed int4 has
+  exactly ONE layout contract (pack axis = last contracted, low
+  nibble first, sign-extended codes, absmax/7 scales) defined next to
+  ``pack_int4``/``unpack_int4``/``qeinsum``; a local re-implementation
+  that disagrees on any of those produces numerically-wrong weights
+  that still type-check.
 - **GC202 host-sync** — device->host readbacks outside the sanctioned
   :func:`skypilot_tpu.utils.host.host_sync` helper (bare
   ``np.asarray(x)``, ``.item()``, ``jax.device_get``,
@@ -195,6 +203,13 @@ RULES: Dict[str, str] = {
              'typo\'d site silently never fires, so the chaos test '
              'passes WITHOUT injecting anything (register the site '
              'or fix the spelling)',
+    'GC119': 'bare-int4-bit-twiddling: int4/uint4 astype or manual '
+             'nibble packing (<<4 / >>4 / &0xF) in a compute dir '
+             'outside models/quantization.py — the packed-nibble '
+             'layout (pack axis, sign extension, scale grouping) is '
+             'defined in exactly one place; hand-rolled twiddling '
+             'silently diverges from it (use pack_int4/unpack_int4/'
+             'qeinsum)',
     'GC201': 'impure-jit: impure or host-synchronizing call inside a '
              '@jax.jit body',
     'GC202': 'host-sync: device->host readback outside the '
@@ -215,6 +230,19 @@ HOST_HELPER_SUFFIX = 'utils/host.py'
 QUANT_HELPER_SUFFIX = 'models/quantization.py'
 # Spellings of the int8 dtype as an astype argument.
 _INT8_DTYPES = {'jnp.int8', 'jax.numpy.int8', 'np.int8', 'numpy.int8'}
+
+# --------------------------------------------------------------------- GC119
+# int4 nibble spellings: 4-bit dtypes as astype/asarray args, plus the
+# manual bit-twiddling shapes (shift-by-4 / low-nibble mask) that
+# re-implement the packed layout by hand. The quantization module is
+# the one sanctioned home of both (pack_int4 / unpack_int4 / qeinsum).
+_INT4_DTYPES = {'jnp.int4', 'jax.numpy.int4', 'np.int4', 'numpy.int4',
+                'jnp.uint4', 'jax.numpy.uint4', 'ml_dtypes.int4',
+                'ml_dtypes.uint4'}
+_INT4_DTYPE_STRINGS = {'int4', 'uint4'}
+# Scope names whose functions ARE nibble helpers by construction
+# (mirrors GC110's 'quantize' scope exemption).
+_NIBBLE_SCOPE_MARKERS = ('quantize', 'pack_int4', 'unpack_int4')
 
 # --------------------------------------------------------------------- GC114
 # KV transfer paths: the disaggregated-serving wire codec and handoff
@@ -779,6 +807,7 @@ class _Checker(ast.NodeVisitor):
             # Applies inside jit bodies too — int8 KV writes live in
             # the jitted prefill/decode scans.
             self._check_int8_write(node, method)
+            self._check_int4_write(node, method)
         if self.is_inference:
             self._check_device_put(node, name)
         if self.is_transfer_path:
@@ -880,6 +909,54 @@ class _Checker(ast.NodeVisitor):
                       'silently drops the scale — write int8 KV/weights '
                       'through llama.quantize_kv_rows / '
                       'models.quantization (codes + absmax scales)')
+
+    def _check_int4_write(self, node: ast.Call, method: str) -> None:
+        """GC119 (call half): ``x.astype(jnp.int4/uint4)`` — or the
+        string spellings — outside the quantization module. A bare
+        4-bit astype bypasses the one packed-nibble layout contract
+        (pack axis, sign extension, scale grouping)."""
+        if (self.is_quant_helper or method != 'astype'
+                or not node.args):
+            return
+        if any(m in s for s in self._scope
+               for m in _NIBBLE_SCOPE_MARKERS):
+            return
+        arg = node.args[0]
+        dtype = _dotted(arg)
+        is_int4 = (dtype in _INT4_DTYPES
+                   or (isinstance(arg, ast.Constant)
+                       and arg.value in _INT4_DTYPE_STRINGS))
+        if is_int4:
+            self._add('GC119', node,
+                      '.astype(int4/uint4) outside the quantization '
+                      'helpers — the packed-nibble layout is defined '
+                      'once in models/quantization.py (pack_int4/'
+                      'unpack_int4/qeinsum); a bare 4-bit cast '
+                      'silently diverges from it')
+
+    def visit_BinOp(self, node):
+        """GC119 (operator half): manual nibble twiddling — ``<< 4`` /
+        ``>> 4`` / ``& 0xF`` — in a compute dir outside the
+        quantization module's sanctioned pack/unpack helpers."""
+        if (self.is_compute and not self.is_quant_helper
+                and not any(m in s for s in self._scope
+                            for m in _NIBBLE_SCOPE_MARKERS)):
+            nibble = (
+                (isinstance(node.op, (ast.LShift, ast.RShift))
+                 and isinstance(node.right, ast.Constant)
+                 and node.right.value == 4)
+                or (isinstance(node.op, ast.BitAnd)
+                    and any(isinstance(s, ast.Constant)
+                            and s.value == 0xF
+                            for s in (node.left, node.right))))
+            if nibble:
+                self._add('GC119', node,
+                          'manual nibble bit-twiddling (<<4 / >>4 / '
+                          '&0xF) in a compute dir — int4 packing has '
+                          'exactly one layout, defined in models/'
+                          'quantization.py; use pack_int4/unpack_int4 '
+                          '(or qeinsum for fused dequant)')
+        self.generic_visit(node)
 
     def _check_async_engine_call(self, node: ast.Call, name: str,
                                  method: str) -> None:

@@ -546,9 +546,13 @@ def serve_logs(service_name, no_follow):
               help='Preset config name (random weights).')
 @click.option('--model-path', default=None,
               help='HF checkpoint dir (real weights + tokenizer).')
-@click.option('--quantize', default=None, type=click.Choice(['int8']),
-              help='int8 weights (KV cache follows via '
-                   '--kv-cache-dtype auto; 2x decode).')
+@click.option('--quantize', default=None,
+              type=click.Choice(['int8', 'int4']),
+              help='Weight quantization: int8 halves the decode '
+                   'weight stream (KV cache follows via '
+                   '--kv-cache-dtype auto); int4 packs two codes per '
+                   'byte with fused dequant — half the streamed bytes '
+                   'again on top of int8 (KV stays int8).')
 @click.option('--tp', type=int, default=None,
               help='Tensor-parallel degree (shard weights + KV heads '
                    'over tp chips; ~linear decode TPOT win). Default: '
@@ -572,6 +576,12 @@ def serve_logs(service_name, no_follow):
               help='Chunked-prefill chunk width (0 = monolithic).')
 @click.option('--decode-priority-ratio', type=float, default=None,
               help='Decode share of the interleaved token budget.')
+@click.option('--decode-steps-per-call', type=int, default=None,
+              help='Multi-step on-device decode: fuse EXACTLY this '
+                   'many decode steps (with on-device sampling) into '
+                   'each jitted call — per-step dispatch, readback '
+                   'and sampling host-syncs amortize k x. Default: '
+                   'adaptive horizon.')
 @click.option('--prefill-w8a8', is_flag=True,
               help='int8 activations on the compute-bound prefill.')
 @click.option('--speculate-k', type=int, default=0,
@@ -643,7 +653,8 @@ def serve_logs(service_name, no_follow):
 @click.option('--port', type=int, default=8081)
 def model_server(model, model_path, quantize, tp, dp, kv_cache,
                  kv_cache_dtype, page_size, prefill_chunk_tokens,
-                 decode_priority_ratio, prefill_w8a8, speculate_k,
+                 decode_priority_ratio, decode_steps_per_call,
+                 prefill_w8a8, speculate_k,
                  slo_tier_default, max_queue_tokens, latency_admit_frac,
                  drain_deadline_s, step_watchdog_s, fault_spec, role,
                  handoff_targets, checkpoint_path, gang_rank,
@@ -674,6 +685,7 @@ def model_server(model, model_path, quantize, tp, dp, kv_cache,
             prefill_w8a8=prefill_w8a8,
             prefill_chunk_tokens=prefill_chunk_tokens,
             decode_priority_ratio=decode_priority_ratio,
+            decode_steps_per_call=decode_steps_per_call,
             speculate_k=speculate_k, fault_spec=fault_spec,
             max_batch=max_batch, max_seq=max_seq))
         return
@@ -687,6 +699,7 @@ def model_server(model, model_path, quantize, tp, dp, kv_cache,
                          prefill_w8a8=prefill_w8a8,
                          prefill_chunk_tokens=prefill_chunk_tokens,
                          decode_priority_ratio=decode_priority_ratio,
+                         decode_steps_per_call=decode_steps_per_call,
                          speculate_k=speculate_k,
                          slo_tier_default=slo_tier_default,
                          max_queue_tokens=max_queue_tokens,

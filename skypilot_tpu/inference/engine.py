@@ -134,7 +134,11 @@ def resolve_kv_cache_dtype(kv_cache_dtype: Optional[str],
     capacity) on its own, and bf16 KV over int8 weights is the
     ablation/debug spelling."""
     if kv_cache_dtype in (None, 'auto'):
-        return 'int8' if quantize == 'int8' else 'bf16'
+        # int4 weights keep an int8 KV: the cache's fused-dequant
+        # attention path is int8-native, and KV rows are activations —
+        # 4-bit storage would cost real accuracy for a stream the int8
+        # halving already tamed.
+        return 'int8' if quantize in ('int8', 'int4') else 'bf16'
     if kv_cache_dtype not in ('bf16', 'int8'):
         raise ValueError(
             f'unknown kv_cache_dtype {kv_cache_dtype!r}; supported: '
@@ -212,28 +216,35 @@ def prepare_params(cfg: ModelConfig, params, *, quantize=None, mesh=None,
                                        donate=donate_params)
     if params is None:
         params = llama.init_params(jax.random.PRNGKey(0), cfg)
-    if quantize is not None and quantize != 'int8':
+    if quantize is not None and quantize not in ('int8', 'int4'):
         raise ValueError(f'unknown quantize mode {quantize!r}; '
-                         "supported: 'int8'")
-    prequantized = quantization.is_quantized(params)
+                         "supported: 'int8', 'int4'")
+    premode = quantization.quantized_mode(params)
+    prequantized = premode is not None
     if prequantized:
         # e.g. host-side quantization during checkpoint load
-        # (weights.load_checkpoint(quantize='int8')).
-        quantize = 'int8'
+        # (weights.load_checkpoint(quantize='int8'|'int4')).
+        quantize = premode
     if mesh is not None and not prequantized:
         bf16_sh = mesh_lib.tree_shardings(
             llama.param_logical_axes(cfg), mesh, shapes=params)
         params = jax.device_put(params, bf16_sh)
-    if quantize == 'int8' and not prequantized:
-        # int8 weights AND int8 KV cache: the two biggest decode HBM
-        # streams each halve.
-        params = quantization.quantize_params(params, donate=donate_params)
-    if mesh is not None and quantize == 'int8':
-        # Canonicalize: int8 codes shard like their bf16 parents;
-        # per-channel scales follow the output axes and replicate over
-        # the contracted (unit) dims.
+    if quantize is not None and not prequantized:
+        # int8: the two biggest decode HBM streams each halve (weights
+        # AND the auto-coupled int8 KV). int4: the weight stream halves
+        # AGAIN — packed nibble codes cross HBM, dequant fused into
+        # qeinsum; KV stays int8.
+        params = quantization.quantize_params(params,
+                                              donate=donate_params,
+                                              mode=quantize)
+    if mesh is not None and quantize is not None:
+        # Canonicalize: quantized codes shard like their bf16 parents
+        # (int4's packed axis is halved — the divisibility-aware
+        # spec_for falls back to replication where a shard no longer
+        # divides); per-channel/group scales follow the output axes and
+        # replicate over the contracted dims.
         qaxes = quantization.quantize_logical_axes(
-            llama.param_logical_axes(cfg))
+            llama.param_logical_axes(cfg), mode=quantize)
         params = jax.device_put(params, mesh_lib.tree_shardings(
             qaxes, mesh, shapes=params))
     return cfg, params, quantize
@@ -543,6 +554,32 @@ class _EngineBase:
         want = r / max(1.0 - r, 1e-3) * self.chunk * n / active
         return max(1, int(want))
 
+    # Multi-step on-device decode: when set (k >= 1), every decode
+    # enqueue fuses EXACTLY k steps in one jitted call — on-device
+    # sampling included — so per-call dispatch, readback lag, and
+    # sampling host-syncs amortize k x. None (default) keeps the
+    # caller-driven adaptive horizon. Pinning wins over the interleave
+    # / queue-pressure shrinks (the knob is an explicit throughput
+    # trade) but never over the capacity/ring safety caps; the jit key
+    # stays static at (k, sample, bucket). ``speculate_k > 0`` takes
+    # precedence for the decode path (one verify round per step).
+    decode_steps_per_call: Optional[int] = None
+
+    @staticmethod
+    def _validate_decode_steps(decode_steps_per_call):
+        if decode_steps_per_call is None:
+            return None
+        k = int(decode_steps_per_call)
+        if k < 1:
+            raise ValueError(
+                f'decode_steps_per_call must be >= 1, got {k}')
+        return k
+
+    def _pinned_horizon(self, horizon: int) -> int:
+        """The fused horizon ``step()`` should run: the pinned k when
+        the multi-step knob is set, else the caller's horizon."""
+        return self.decode_steps_per_call or horizon
+
     # Depth of the async dispatch pipeline: device calls kept in flight
     # before the host reads results back. Depth 2 overlaps the per-call
     # dispatch round trip (measured ~100-600 ms through a remote PJRT
@@ -570,7 +607,7 @@ class _EngineBase:
         with self._prof.phase('admit'):
             events.extend(self._admit())
         with self._prof.phase('decode_enqueue'):
-            enqueued = self._enqueue_decode(horizon)
+            enqueued = self._enqueue_decode(self._pinned_horizon(horizon))
         if not enqueued and self._pending:
             # Nothing to enqueue (no active slots, or capacity pinned
             # until in-flight calls land): drain one instead.
@@ -954,6 +991,7 @@ class InferenceEngine(SpeculativeMixin, _EngineBase):
                  prefill_w8a8: bool = False,
                  prefill_chunk_tokens: Optional[int] = 256,
                  decode_priority_ratio: Optional[float] = None,
+                 decode_steps_per_call: Optional[int] = None,
                  speculate_k: int = 0,
                  telemetry: bool = True):
         self._init_telemetry(telemetry)
@@ -961,6 +999,10 @@ class InferenceEngine(SpeculativeMixin, _EngineBase):
         self.max_seq = max_seq
         self.mesh = mesh
         self.attn_impl = attn_impl
+        # Multi-step on-device decode (see _EngineBase): pin every
+        # decode call at exactly k fused steps.
+        self.decode_steps_per_call = self._validate_decode_steps(
+            decode_steps_per_call)
         # Opt-in: quantize prefill activations to int8 (2x MXU rate on
         # the compute-bound prefill; decode unaffected). Off by default
         # — W8A8 adds activation quantization noise to the KV rows.
@@ -1659,7 +1701,13 @@ class InferenceEngine(SpeculativeMixin, _EngineBase):
         if self.speculate_k:
             events.extend(self._spec_step())
             return events
-        if self._prefill_off:
+        if self.decode_steps_per_call:
+            # Multi-step pin: exactly k fused steps per call — the
+            # dispatch-amortization knob wins over the interleave /
+            # queue-pressure shrinks (capacity caps still apply in
+            # _enqueue_decode).
+            horizon = self.decode_steps_per_call
+        elif self._prefill_off:
             horizon = min(horizon, self._interleave_horizon())
         elif self._queue:
             horizon = min(horizon, 32)
@@ -1799,10 +1847,14 @@ class InferenceEngine(SpeculativeMixin, _EngineBase):
         ring_cap = _ring_horizon_cap(self.cfg, self.max_batch,
                                      self._param_bytes, self.mesh)
         horizon = min(horizon, ring_cap)
-        for b in reversed(self._HORIZON_BUCKETS):
-            if b <= horizon:
-                horizon = b
-                break
+        if self.decode_steps_per_call is None:
+            for b in reversed(self._HORIZON_BUCKETS):
+                if b <= horizon:
+                    horizon = b
+                    break
+        # else: multi-step pin — run EXACTLY k (capacity-clamped above)
+        # so the jit key stays (k, sample, kv_bucket) and the audit's
+        # one-dispatch-per-k-tokens contract holds.
 
         temps_d, topks_d, topps_d, active_d, sample = \
             self._slot_meta(ready)
@@ -1815,6 +1867,10 @@ class InferenceEngine(SpeculativeMixin, _EngineBase):
                         _bucket_len(max_live + self._inflight_steps +
                                     horizon))
         self._rng, rng = jax.random.split(self._rng)
+        # Per-substep attribution: one dispatch covers ``horizon``
+        # decode substeps (the multi-step amortization the profiler's
+        # per_substep_ms split makes visible).
+        self._prof.note_substeps('decode_enqueue', horizon)
         with self._prof.jit_key('decode', (horizon, sample, kv_bucket)):
             toks, self.cache = self._decode_fn(
                 self.params, self.cache, self._tok_dev, rng,

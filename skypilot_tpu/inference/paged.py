@@ -814,6 +814,7 @@ class PagedInferenceEngine(SpeculativeMixin, _EngineBase):
                  chunk: int = 256,
                  prefill_chunk_tokens: Optional[int] = None,
                  decode_priority_ratio: Optional[float] = None,
+                 decode_steps_per_call: Optional[int] = None,
                  mesh=None, rng_seed: int = 0, attn_impl: str = 'auto',
                  quantize: Optional[str] = None,
                  kv_cache_dtype: Optional[str] = None,
@@ -842,6 +843,10 @@ class PagedInferenceEngine(SpeculativeMixin, _EngineBase):
         # are mid-prefill (see _EngineBase._interleave_horizon). None
         # keeps this engine's measured-best fixed interleave horizon.
         self.decode_priority_ratio = decode_priority_ratio
+        # Multi-step on-device decode (see _EngineBase): pin every
+        # decode call at exactly k fused steps.
+        self.decode_steps_per_call = self._validate_decode_steps(
+            decode_steps_per_call)
         self.mesh = mesh
         self.attn_impl = attn_impl
         # Opt-in W8A8 prefill (int8 activations on the compute-bound
@@ -2032,7 +2037,12 @@ class PagedInferenceEngine(SpeculativeMixin, _EngineBase):
                 events.extend(self._deferred_events)
                 self._deferred_events = []
             return events
-        if self._prefill_off:
+        if self.decode_steps_per_call:
+            # Multi-step pin: exactly k fused steps per call (the
+            # dispatch-amortization knob wins over interleave/queue
+            # shrinks; capacity caps still apply in _enqueue_decode).
+            horizon = self.decode_steps_per_call
+        elif self._prefill_off:
             # decode_priority_ratio switches the fixed interleave
             # horizon to the Sarathi-style token-budget split (shared
             # with the slot engine); None keeps this engine's
@@ -2102,10 +2112,14 @@ class PagedInferenceEngine(SpeculativeMixin, _EngineBase):
         ring_bytes = (self._RING_BYTES_CAP_PAGED
                       if self._pool_auto_sized else int(512e6))
         horizon = min(horizon, self._ring_horizon_bucket(ring_bytes))
-        for b in reversed(self._HORIZON_BUCKETS):
-            if b <= horizon:
-                horizon = b
-                break
+        if self.decode_steps_per_call is None:
+            for b in reversed(self._HORIZON_BUCKETS):
+                if b <= horizon:
+                    horizon = b
+                    break
+        # else: multi-step pin — run EXACTLY k (capacity-clamped) so
+        # the jit key stays (k, sample, P) and the audit's
+        # one-dispatch-per-k-tokens contract holds.
         # page capacity: every active slot must hold pages for
         # len+inflight+horizon; shrink the horizon under pool pressure,
         # and when even horizon=1 cannot fit, PREEMPT the newest request
@@ -2171,6 +2185,10 @@ class PagedInferenceEngine(SpeculativeMixin, _EngineBase):
         lengths = (self._slot_len + self._slot_inflight).astype(np.int32)
         self._rng, rng = jax.random.split(self._rng)
         table_dd, lengths_dd = device_upload((table_p, lengths))
+        # Per-substep attribution: one dispatch covers ``horizon``
+        # decode substeps (multi-step amortization; the profiler's
+        # per_substep_ms split makes it visible).
+        self._prof.note_substeps('decode_enqueue', horizon)
         with self._prof.jit_key('decode', (horizon, sample, P)):
             toks, self.cache = self._decode_fn(
                 self.params, self.cache, table_dd,

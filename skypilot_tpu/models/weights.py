@@ -234,7 +234,7 @@ def load_hf_params(path: str, cfg: ModelConfig,
     upcast of the stacked 7B MLP leaf alone is ~5.8 GB — quantizing
     on-device after a bf16 load cannot fit a 16 GB v5e.)
     """
-    if quantize is not None and quantize != 'int8':
+    if quantize is not None and quantize not in ('int8', 'int4'):
         # Validate BEFORE streaming gigabytes of tensors.
         raise ValueError(f'unknown quantize mode {quantize!r}')
     key_map = _hf_key_map(cfg)
@@ -315,9 +315,13 @@ def load_hf_params(path: str, cfg: ModelConfig,
         if name in ('attn_norm', 'ffn_norm', 'final_norm',
                     'bq', 'bk', 'bv'):
             return jnp.asarray(a, jnp.float32)
-        if quantize == 'int8' and name in quantization.REDUCE_AXES:
+        if quantize is not None and name in quantization.REDUCE_AXES:
+            # int4 packs the dense leaves; MoE expert leaves stay int8
+            # even in int4 mode (quantization module docstring).
+            int4 = (quantize == 'int4'
+                    and name in quantization.INT4_LEAVES)
             return _host_quantize(a, quantization.REDUCE_AXES[name],
-                                  cfg.dtype)
+                                  cfg.dtype, int4=int4)
         # Cast on host (numpy handles ml_dtypes) so only ONE device
         # buffer per leaf is ever live, not fp16+bf16 copies.
         return jnp.asarray(np.asarray(a, cfg.dtype))
@@ -334,33 +338,70 @@ def load_hf_params(path: str, cfg: ModelConfig,
     return params
 
 
-def _host_quantize(a: np.ndarray, reduce_axes, scale_dtype):
+def _host_quantize(a: np.ndarray, reduce_axes, scale_dtype,
+                   int4: bool = False):
     """Numpy twin of ``quantization._quantize_array`` (same rounded-scale
-    contract): quantizes on the host so only int8 + scales hit the
-    device. Stacked layer leaves quantize one layer-slice at a time —
-    the fp32 transient stays ~1/L of the leaf (a 7B MLP leaf upcast
-    whole is ~5.8 GB), with reduce axes always excluding axis 0."""
-    from skypilot_tpu.models.quantization import QuantizedWeight
+    contract; ``int4=True`` mirrors ``_quantize_array4`` — packed codes
+    + per-channel/group scales): quantizes on the host so only codes +
+    scales hit the device. Stacked layer leaves quantize one
+    layer-slice at a time — the fp32 transient stays ~1/L of the leaf
+    (a 7B MLP leaf upcast whole is ~5.8 GB), with reduce axes always
+    excluding axis 0."""
+    from skypilot_tpu.models.quantization import (QuantizedWeight,
+                                                  QuantizedWeight4)
+    cls = QuantizedWeight4 if int4 else QuantizedWeight
 
     if a.ndim >= 3 and 0 not in reduce_axes:
-        q = np.empty(a.shape, np.int8)
-        scales = []
         sub_axes = tuple(ax - 1 for ax in reduce_axes)
+        codes = []
+        scales = []
         for i in range(a.shape[0]):
-            qi, si = _host_quantize_slice(a[i], sub_axes, scale_dtype)
-            q[i] = qi
+            qi, si = _host_quantize_slice(a[i], sub_axes, scale_dtype,
+                                          int4=int4)
+            codes.append(qi)
             scales.append(si)
-        scale = np.stack(scales)
-        return QuantizedWeight(int8=jnp.asarray(q),
-                               scale=jnp.asarray(scale))
-    q, scale = _host_quantize_slice(a, reduce_axes, scale_dtype)
-    return QuantizedWeight(int8=jnp.asarray(q), scale=jnp.asarray(scale))
+        return cls(jnp.asarray(np.stack(codes)),
+                   jnp.asarray(np.stack(scales)))
+    q, scale = _host_quantize_slice(a, reduce_axes, scale_dtype,
+                                    int4=int4)
+    return cls(jnp.asarray(q), jnp.asarray(scale))
 
 
-def _host_quantize_slice(a: np.ndarray, reduce_axes, scale_dtype):
-    """Round-scale-first int8 quantize of one array (fp32 transient =
-    this slice only)."""
+def _host_quantize_slice(a: np.ndarray, reduce_axes, scale_dtype,
+                         int4: bool = False):
+    """Round-scale-first quantize of one array (fp32 transient = this
+    slice only). int8: codes in [-127, 127]. int4: codes in [-7, 7]
+    packed two-per-byte along the last reduce axis (group-wise scales
+    under SKYTPU_INT4_GROUP), the exact on-device layout."""
+    from skypilot_tpu.models import quantization
     af = np.asarray(a, np.float32)
+    if int4:
+        ax = reduce_axes[-1] % af.ndim
+        group = quantization.int4_group_size()
+        if group:
+            m = af.shape[ax]
+            if m % group or group % 2:
+                raise ValueError(
+                    f'SKYTPU_INT4_GROUP={group} must be even and '
+                    f'divide the packed axis (size {m})')
+            split = af.shape[:ax] + (m // group, group) + af.shape[ax + 1:]
+            ag = af.reshape(split)
+            red = tuple(x if x % af.ndim < ax else x % af.ndim + 1
+                        for x in reduce_axes[:-1]) + (ax + 1,)
+            absmax = np.max(np.abs(ag), axis=red, keepdims=True)
+            scale = (np.maximum(absmax, 1e-8) / 7.0).astype(scale_dtype)
+            q = np.clip(np.rint(ag / scale.astype(np.float32)), -7,
+                        7).astype(np.int8).reshape(af.shape)
+            sshape = tuple(1 if x in [r % af.ndim for r in reduce_axes]
+                           else d for x, d in enumerate(af.shape))
+            sshape = sshape[:ax] + (m // group,) + sshape[ax + 1:]
+            scale = scale.reshape(sshape)
+        else:
+            absmax = np.max(np.abs(af), axis=reduce_axes, keepdims=True)
+            scale = (np.maximum(absmax, 1e-8) / 7.0).astype(scale_dtype)
+            q = np.clip(np.rint(af / scale.astype(np.float32)), -7,
+                        7).astype(np.int8)
+        return quantization.pack_int4(q, axis=ax), scale
     absmax = np.max(np.abs(af), axis=reduce_axes, keepdims=True)
     scale = (np.maximum(absmax, 1e-8) / 127.0).astype(scale_dtype)
     q = np.clip(np.rint(af / scale.astype(np.float32)), -127,
@@ -376,29 +417,33 @@ def load_checkpoint(path: str,
                     ) -> Tuple[ModelConfig, Params]:
     """One-call import: HF dir -> (ModelConfig, params).
 
-    With ``quantize='int8'`` the quantized tree is cached next to the
-    checkpoint (``.int8_cache.bin`` + ``.meta.json`` manifest): the
-    first load pays the full fp16-read + host-quantize pass; reruns
-    mmap the ~2x-smaller int8 tree and device_put leaves in parallel.
-    Best-effort — a read-only checkpoint dir just skips the cache."""
+    With ``quantize='int8'`` (or ``'int4'``) the quantized tree is
+    cached next to the checkpoint (``.int8_cache.bin`` /
+    ``.int4_cache.bin`` + ``.meta.json`` manifest): the first load pays
+    the full fp16-read + host-quantize pass; reruns mmap the smaller
+    quantized tree (packed int4 codes ride as raw uint8) and device_put
+    leaves in parallel. Best-effort — a read-only checkpoint dir just
+    skips the cache."""
     cfg = config_from_hf(_read_hf_config(path), name=name, dtype=dtype)
-    cache_file = os.path.join(path, '.int8_cache.bin')
+    quantized = quantize in ('int8', 'int4')
+    cache_file = os.path.join(path, f'.{quantize}_cache.bin')
     fingerprint = _cache_fingerprint(path, dtype)
-    if quantize == 'int8' and use_cache and os.path.exists(cache_file):
+    if quantized and use_cache and os.path.exists(cache_file):
         try:
             if _read_cache_meta(cache_file) == fingerprint:
                 return cfg, _load_int8_cache(cache_file, cfg)
-            print('[weights] int8 cache stale (checkpoint or dtype '
-                  'changed); requantizing', flush=True)
+            print(f'[weights] {quantize} cache stale (checkpoint or '
+                  'dtype changed); requantizing', flush=True)
         except Exception as e:  # pylint: disable=broad-except
-            print(f'[weights] int8 cache unreadable ({e}); reloading',
-                  flush=True)
+            print(f'[weights] {quantize} cache unreadable ({e}); '
+                  'reloading', flush=True)
     params = load_hf_params(path, cfg, quantize=quantize)
-    if quantize == 'int8' and use_cache:
+    if quantized and use_cache:
         try:
             _save_int8_cache(cache_file, params, fingerprint)
         except OSError as e:
-            print(f'[weights] int8 cache not written: {e}', flush=True)
+            print(f'[weights] {quantize} cache not written: {e}',
+                  flush=True)
     return cfg, params
 
 
@@ -431,12 +476,16 @@ def _read_cache_manifest(cache_file: str) -> Optional[Dict[str, Any]]:
 
 
 def _flatten_leaves(params: Params, prefix: str = ''):
-    from skypilot_tpu.models.quantization import QuantizedWeight
+    from skypilot_tpu.models.quantization import (QuantizedWeight,
+                                                  QuantizedWeight4)
     for k, v in params.items():
         if isinstance(v, dict):
             yield from _flatten_leaves(v, f'{prefix}{k}/')
         elif isinstance(v, QuantizedWeight):
             yield f'{prefix}{k}.int8', v.int8
+            yield f'{prefix}{k}.scale', v.scale
+        elif isinstance(v, QuantizedWeight4):
+            yield f'{prefix}{k}.int4', v.packed
             yield f'{prefix}{k}.scale', v.scale
         else:
             yield f'{prefix}{k}', v
@@ -489,9 +538,12 @@ def _save_int8_cache(cache_file: str, params: Params,
 
 
 def _load_int8_cache(cache_file: str, cfg: ModelConfig) -> Params:
+    """Loads int8 AND int4 quantized-tree caches (the leaf class is
+    recovered from the ``.int8`` / ``.int4`` name suffix)."""
     from concurrent.futures import ThreadPoolExecutor
 
-    from skypilot_tpu.models.quantization import QuantizedWeight
+    from skypilot_tpu.models.quantization import (QuantizedWeight,
+                                                  QuantizedWeight4)
     meta = _read_cache_manifest(cache_file)
     mm = np.memmap(cache_file, dtype=np.uint8, mode='r')
 
@@ -516,7 +568,7 @@ def _load_int8_cache(cache_file: str, cfg: ModelConfig) -> Params:
         for p in parts[:-1]:
             node = node.setdefault(p, {})
         leaf = parts[-1]
-        if leaf.endswith(('.int8', '.scale')):
+        if leaf.endswith(('.int8', '.int4', '.scale')):
             base, field = leaf.rsplit('.', 1)
             slot = pending.setdefault(f'{"/".join(parts[:-1])}/{base}',
                                       {'node': node, 'base': base})
@@ -524,8 +576,12 @@ def _load_int8_cache(cache_file: str, cfg: ModelConfig) -> Params:
         else:
             node[leaf] = arr
     for slot in pending.values():
-        slot['node'][slot['base']] = QuantizedWeight(int8=slot['int8'],
-                                                     scale=slot['scale'])
+        if 'int4' in slot:
+            slot['node'][slot['base']] = QuantizedWeight4(
+                packed=slot['int4'], scale=slot['scale'])
+        else:
+            slot['node'][slot['base']] = QuantizedWeight(
+                int8=slot['int8'], scale=slot['scale'])
     return params
 
 

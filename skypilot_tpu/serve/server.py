@@ -79,6 +79,7 @@ def build_engine(cfg_name: str, *, max_batch: int, max_seq: int,
                  prefill_w8a8: bool = False,
                  prefill_chunk_tokens: Optional[int] = None,
                  decode_priority_ratio: Optional[float] = None,
+                 decode_steps_per_call: Optional[int] = None,
                  speculate_k: int = 0,
                  tp: int = 1, dp: int = 1,
                  gang: Optional['gang_lib.GangSpec'] = None):
@@ -116,6 +117,8 @@ def build_engine(cfg_name: str, *, max_batch: int, max_seq: int,
         extra['prefill_chunk_tokens'] = prefill_chunk_tokens
     if decode_priority_ratio is not None:
         extra['decode_priority_ratio'] = decode_priority_ratio
+    if decode_steps_per_call is not None:
+        extra['decode_steps_per_call'] = decode_steps_per_call
     if kv_cache_dtype is not None:
         extra['kv_cache_dtype'] = kv_cache_dtype
     extra['prefill_w8a8'] = prefill_w8a8
@@ -151,6 +154,7 @@ class ModelServer:
                  prefill_w8a8: bool = False,
                  prefill_chunk_tokens: Optional[int] = None,
                  decode_priority_ratio: Optional[float] = None,
+                 decode_steps_per_call: Optional[int] = None,
                  speculate_k: int = 0,
                  slo_tier_default: str = 'latency',
                  max_queue_tokens: Optional[int] = None,
@@ -166,7 +170,7 @@ class ModelServer:
                  nan_alarm_threshold: Optional[int] = None):
         self.cfg_name = cfg_name
         self.model_path = model_path  # HF checkpoint dir (real weights)
-        self.quantize = quantize      # 'int8' => int8 weights
+        self.quantize = quantize      # 'int8' | 'int4' weights
         # Serving mesh shape: explicit args win, else the controller's
         # adaptive-TP placement env (SKYTPU_TP/SKYTPU_DP), else 1x1.
         # Resolved HERE (not at engine load) so the mesh gauges and the
@@ -189,6 +193,10 @@ class ModelServer:
         # budget while prompts are mid-prefill.
         self.prefill_chunk_tokens = prefill_chunk_tokens
         self.decode_priority_ratio = decode_priority_ratio
+        # Multi-step on-device decode: pin every decode call at
+        # exactly k fused steps (dispatch/readback/sampling host work
+        # amortizes k x). None = the loop's adaptive 8/32 horizon.
+        self.decode_steps_per_call = decode_steps_per_call
         # Speculative decoding: n-gram/prompt-lookup proposer + batched
         # on-device verify (0 = off). Greedy outputs are identical to
         # vanilla decode; sampling keeps the output distribution.
@@ -394,6 +402,7 @@ class ModelServer:
             page_size=self.page_size, prefill_w8a8=self.prefill_w8a8,
             prefill_chunk_tokens=self.prefill_chunk_tokens,
             decode_priority_ratio=self.decode_priority_ratio,
+            decode_steps_per_call=self.decode_steps_per_call,
             speculate_k=self.speculate_k, tp=self.tp, dp=self.dp,
             gang=self.gang if self.gang.is_gang else None)
         if self.model_path:
@@ -534,7 +543,12 @@ class ModelServer:
                         # isn't), short ones keep streaming latency
                         # low when the batch is nearly idle.
                         sat = max(2, self.engine.max_batch // 2)
-                        h = 32 if self.engine.num_active >= sat else 8
+                        # The multi-step knob pins the fused horizon
+                        # (the engine would override anyway — keeping
+                        # the recorded gang op h consistent with what
+                        # actually runs).
+                        h = self.decode_steps_per_call or (
+                            32 if self.engine.num_active >= sat else 8)
                         if self._gang is not None:
                             # Record the step BEFORE running it (op
                             # order == execution order; the engine
@@ -1299,6 +1313,15 @@ class ModelServer:
             g('skytpu_mesh_shape',
               'Serving mesh axis size (1 = axis unused)',
               axis=axis).set(size)
+        # Multi-step decode: the pinned fused steps per jitted decode
+        # call (0 = the loop's adaptive horizon). Registered every
+        # scrape get-or-create: present-and-zero before the knob (or
+        # the engine) exists.
+        g('skytpu_decode_steps_per_call',
+          'Pinned fused decode steps per jitted call '
+          '(0 = adaptive horizon)').set(
+              getattr(eng, 'decode_steps_per_call', None)
+              or self.decode_steps_per_call or 0)
         g('skytpu_speculate_k',
           'Speculative proposal depth (0 = off)').set(
               spec.get('speculate_k', 0))
@@ -1427,10 +1450,18 @@ class ModelServer:
             # health accounting — follower ranks have no routable
             # endpoint of their own.
             'gang': self.gang_status(),
+            # Multi-step decode pin (0 = adaptive horizon) — stable
+            # schema like every other key.
+            'decode_steps_per_call': int(
+                getattr(eng, 'decode_steps_per_call', None)
+                or self.decode_steps_per_call or 0),
             'scheduler': {
                 'prefill_chunk_tokens': getattr(eng, 'chunk', 0) or 0,
                 'decode_priority_ratio': getattr(
                     eng, 'decode_priority_ratio', 0) or 0,
+                'decode_steps_per_call': int(
+                    getattr(eng, 'decode_steps_per_call', None)
+                    or self.decode_steps_per_call or 0),
                 'speculate_k': spec.get('speculate_k', 0),
             },
             # SLO scheduler block (stable schema: every tier and every
@@ -2294,9 +2325,15 @@ def main() -> None:
                         help='preset config name (random weights)')
     parser.add_argument('--model-path', default=None,
                         help='HF checkpoint dir (real weights + tokenizer)')
-    parser.add_argument('--quantize', default=None, choices=['int8'],
-                        help='int8 weights (the KV cache follows via '
-                             '--kv-cache-dtype auto; 2x decode)')
+    parser.add_argument('--quantize', default=None,
+                        choices=['int8', 'int4'],
+                        help='weight quantization: int8 halves the '
+                             'decode weight stream (the KV cache '
+                             'follows via --kv-cache-dtype auto); '
+                             'int4 packs two codes per byte with '
+                             'fused dequant — half the streamed '
+                             'weight bytes again on top of int8 (KV '
+                             'stays int8)')
     parser.add_argument('--tp', type=int, default=None,
                         help='tensor-parallel degree: shard weights + '
                              'KV heads over this many chips (decode '
@@ -2341,6 +2378,16 @@ def main() -> None:
                              'budget while prompts are mid-prefill '
                              '(0..1); higher favors streaming TPOT, '
                              'lower favors TTFT. Default: engine-tuned')
+    parser.add_argument('--decode-steps-per-call', type=int,
+                        default=None,
+                        help='multi-step on-device decode: fuse '
+                             'EXACTLY this many decode steps (with '
+                             'on-device sampling) into each jitted '
+                             'call, so per-step dispatch, readback and '
+                             'sampling host-syncs amortize k x. '
+                             'Default: adaptive horizon (8 idle / 32 '
+                             'saturated). Ignored while --speculate-k '
+                             'drives decode')
     parser.add_argument('--speculate-k', type=int, default=0,
                         help='speculative decoding: propose up to K '
                              'tokens per verify step via prompt-lookup '
@@ -2462,6 +2509,7 @@ def main() -> None:
                          prefill_w8a8=args.prefill_w8a8,
                          prefill_chunk_tokens=args.prefill_chunk_tokens,
                          decode_priority_ratio=args.decode_priority_ratio,
+                         decode_steps_per_call=args.decode_steps_per_call,
                          speculate_k=args.speculate_k,
                          slo_tier_default=args.slo_tier_default,
                          max_queue_tokens=args.max_queue_tokens,
@@ -2497,6 +2545,8 @@ def run_follower(spec: 'gang_lib.GangSpec', args) -> None:
         page_size=args.page_size, prefill_w8a8=args.prefill_w8a8,
         prefill_chunk_tokens=args.prefill_chunk_tokens,
         decode_priority_ratio=args.decode_priority_ratio,
+        decode_steps_per_call=getattr(args, 'decode_steps_per_call',
+                                      None),
         speculate_k=args.speculate_k,
         tp=mesh_spec.tp, dp=mesh_spec.dp, gang=spec)
     follower = gang_lib.GangFollower(

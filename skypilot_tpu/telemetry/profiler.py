@@ -36,6 +36,7 @@ from skypilot_tpu.telemetry import registry as registry_lib
 
 PHASE_METRIC = 'skytpu_engine_step_phase_seconds'
 COMPILE_METRIC = 'skytpu_jit_first_call_seconds'
+SUBSTEP_METRIC = 'skytpu_engine_decode_substeps_total'
 
 
 class NullProfiler:
@@ -52,6 +53,9 @@ class NullProfiler:
     def jit_key(self, fn: str, key: Tuple):
         del fn, key
         yield
+
+    def note_substeps(self, name: str, n: int) -> None:
+        del name, n
 
     def phase_stats(self) -> Dict[str, Any]:
         return {}
@@ -70,6 +74,17 @@ class StepProfiler:
         self._lock = threading.Lock()
         # phase -> [count, total_s, max_s]
         self._acc: Dict[str, List[float]] = {}
+        # phase -> device SUBSTEPS its dispatches covered (multi-step
+        # decode: one decode_enqueue dispatch fuses k substeps, so the
+        # per-substep split = total_s / substeps — the number that
+        # shows dispatch amortization instead of hiding it in a
+        # fatter per-call mean).
+        self._substeps: Dict[str, int] = {}
+        # Registered at construction: zeros from the first scrape.
+        self._substep_counter = self._reg.counter(
+            SUBSTEP_METRIC,
+            'Device decode substeps covered by enqueued dispatches '
+            '(k per call under multi-step decode)')
         self._hists: Dict[str, registry_lib.Histogram] = {}
         self._seen_keys: Dict[str, set] = {}
         self.compile_events: List[Dict[str, Any]] = []
@@ -125,19 +140,36 @@ class StepProfiler:
                     {'fn': fn, 'key': repr(key),
                      'seconds': round(dt, 6)})
 
+    def note_substeps(self, name: str, n: int) -> None:
+        """Record that the NEXT/current ``name`` dispatch covers ``n``
+        device substeps (multi-step decode's per-substep attribution).
+        Host-side counter bump only — nothing touches the device."""
+        if n <= 0:
+            return
+        self._substep_counter.inc(n)
+        with self._lock:
+            self._substeps[name] = self._substeps.get(name, 0) + n
+
     def phase_stats(self) -> Dict[str, Any]:
         """Per-phase summary for THIS engine (bench's latency
-        decomposition): phase -> count/total_s/mean_ms/max_ms, plus
-        the first-compile event list."""
+        decomposition): phase -> count/total_s/mean_ms/max_ms (+
+        substeps/per_substep_ms where dispatches fuse multiple device
+        substeps), plus the first-compile event list."""
         with self._lock:
             acc = {k: list(v) for k, v in self._acc.items()}
+            subs = dict(self._substeps)
             compiles = list(self.compile_events)
         out: Dict[str, Any] = {'phases': {}, 'compiles': compiles}
         for name, (count, total, mx) in sorted(acc.items()):
-            out['phases'][name] = {
+            entry = {
                 'count': int(count),
                 'total_s': round(total, 6),
                 'mean_ms': round(total / count * 1e3, 3) if count else 0.0,
                 'max_ms': round(mx * 1e3, 3),
             }
+            if subs.get(name):
+                entry['substeps'] = int(subs[name])
+                entry['per_substep_ms'] = round(
+                    total / subs[name] * 1e3, 4)
+            out['phases'][name] = entry
         return out

@@ -353,6 +353,60 @@ def test_gc110_quantization_module_and_other_dtypes_exempt():
     assert rule_ids(src_ok, 'skypilot_tpu/inference/x.py') == []
 
 
+# ------------------------------------------------------------------ GC119
+def test_gc119_int4_astype_in_compute_flagged():
+    src = '''
+    import jax.numpy as jnp
+    def write_w(rows, other):
+        a = rows.astype(jnp.int4)
+        b = other.astype('uint4')
+        return a, b
+    '''
+    assert rule_ids(src, 'skypilot_tpu/inference/x.py') == \
+        ['GC119', 'GC119']
+    assert rule_ids(src, 'skypilot_tpu/models/x.py') == \
+        ['GC119', 'GC119']
+
+
+def test_gc119_manual_nibble_twiddling_flagged():
+    src = '''
+    def repack(codes):
+        lo = codes & 0xF
+        hi = codes >> 4
+        return lo | (hi << 4)
+    '''
+    assert rule_ids(src, 'skypilot_tpu/ops/x.py') == \
+        ['GC119', 'GC119', 'GC119']
+    # Outside the compute dirs the operators are unpoliced (bit math
+    # is normal in e.g. serve/ hashing).
+    assert rule_ids(src, 'skypilot_tpu/serve/x.py') == []
+
+
+def test_gc119_sanctioned_helpers_exempt():
+    # The quantization module IS the layout's home.
+    src = '''
+    def repack(codes):
+        return (codes & 0xF) | ((codes >> 4) << 4)
+    '''
+    assert rule_ids(src, 'skypilot_tpu/models/quantization.py') == []
+    # pack_int4/unpack_int4/quantize-named scopes are the sanctioned
+    # spellings wherever they live (mirrors GC110's scope exemption).
+    src_scoped = '''
+    import jax.numpy as jnp
+    def pack_int4(codes):
+        return codes >> 4
+    def _quantize_array4(w):
+        return w.astype(jnp.int4)
+    '''
+    assert rule_ids(src_scoped, 'skypilot_tpu/models/x.py') == []
+    # Non-nibble shifts/masks stay legal in compute dirs.
+    src_ok = '''
+    def hash_mix(x):
+        return (x >> 7) & 0x3F
+    '''
+    assert rule_ids(src_ok, 'skypilot_tpu/inference/x.py') == []
+
+
 # ------------------------------------------------------------------ GC111
 def test_gc111_sync_engine_calls_in_coroutine_flagged():
     src = '''

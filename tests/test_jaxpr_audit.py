@@ -264,3 +264,51 @@ def test_paged_gang_preset_registered():
     assert 'paged-gang' in jaxpr_audit.PRESETS
     assert 'paged-gang' in jaxpr_audit.DEFAULT_PRESETS
     assert jaxpr_audit.MULTI_DEVICE_PRESETS['paged-gang'] == 4
+
+
+# ------------------------------------------------- int4 + multi-step
+def test_int4_paged_audit():
+    """int4 fused-dequant weights: the packed-nibble unpack inside
+    qeinsum adds zero unsanctioned d2h and zero steady-state jit-cache
+    growth on the paged hot loop (the `int4` default preset)."""
+    report = jaxpr_audit.audit_engine('paged', chunked=True,
+                                      quantize='int4')
+    _assert_hot_loop_clean(report)
+    assert report.transfers, 'expected sanctioned pipeline readbacks'
+
+
+@pytest.mark.slow
+def test_int4_slot_audit():
+    report = jaxpr_audit.audit_engine('slot', chunked=True,
+                                      quantize='int4')
+    _assert_hot_loop_clean(report)
+    assert any('kv_bucket' in k for k in report.static_keys)
+
+
+def test_multistep_audit():
+    """decode_steps_per_call pinned at k: a lockstep budget-bound
+    round costs exactly ONE decode dispatch per k tokens, every
+    dispatch at static horizon k, zero recompiles / unsanctioned
+    d2h — ok() fails on any of it (the dispatch counts ride
+    compile_counts as (expected, actual) pairs)."""
+    report = jaxpr_audit.audit_multistep(k=4)
+    _assert_hot_loop_clean(report)
+    assert report.ok(), '\n' + report.format()
+    assert all(key['horizon'] == 4 for key in report.static_keys)
+    expected, actual = report.compile_counts[
+        'decode dispatches (ONE per 4 tokens)']
+    assert expected == actual == 4        # 2 rounds x 2 dispatches
+
+
+@pytest.mark.slow
+def test_int4_multistep_audit():
+    report = jaxpr_audit.audit_multistep(k=4, quantize='int4')
+    _assert_hot_loop_clean(report)
+    assert report.ok(), '\n' + report.format()
+
+
+def test_int4_multistep_presets_registered():
+    for name in ('int4', 'multistep', 'int4-multistep'):
+        assert name in jaxpr_audit.PRESETS, name
+        assert name in jaxpr_audit.DEFAULT_PRESETS, name
+    assert 'int4-slot' in jaxpr_audit.PRESETS

@@ -538,6 +538,24 @@ def test_server_prometheus_metrics_and_debug_requests():
         assert ('# TYPE skytpu_engine_step_watchdog_age_seconds '
                 'gauge') in prom
         assert 'skytpu_engine_step_watchdog_age_seconds 0' in prom
+        # (b8) Multi-step decode series (round 14): the pinned
+        # steps-per-call gauge (0 = adaptive horizon on this server)
+        # and the decode-substeps counter render from the first scrape
+        # — the server's warmup request already drove fused substeps,
+        # so the counter is strictly positive and the per-substep
+        # phase attribution is live.
+        assert '# TYPE skytpu_decode_steps_per_call gauge' in prom
+        assert 'skytpu_decode_steps_per_call 0' in prom
+        assert ('# TYPE skytpu_engine_decode_substeps_total '
+                'counter') in prom
+        sub = [ln for ln in prom.splitlines()
+               if ln.startswith('skytpu_engine_decode_substeps_total ')]
+        assert sub and float(sub[0].rsplit(' ', 1)[1]) > 0
+        assert m['decode_steps_per_call'] == 0
+        assert m['scheduler']['decode_steps_per_call'] == 0
+        phases = server.engine.phase_stats()['phases']
+        assert phases['decode_enqueue']['substeps'] > 0
+        assert phases['decode_enqueue']['per_substep_ms'] >= 0
         assert m['gang']['members'] == {}
         # JSON disagg block: stable schema, zeros when idle.
         assert m['disagg']['role'] == 'colocated'
