@@ -145,6 +145,8 @@ def main() -> None:
              lambda: _gang_bench(n_chips)),
             ('sim',
              _sim_bench),
+            ('ctrl_recovery',
+             lambda: _ctrl_recovery_bench(n_chips)),
             ('quant4',
              lambda: _quant4_bench(n_chips, chip_bw)),
             ('multistep',
@@ -2306,6 +2308,216 @@ def _gang_bench(n_chips: int) -> dict:
         'rank_kill': kill,
         'zero_lost_contract_held': kill['lost_requests'] == 0,
     }
+
+
+def _ctrl_recovery_bench(n_chips: int) -> dict:
+    """Controller crash-safety block (round 15): a REAL
+    ServeController owns a live 3-replica tiny fleet behind the real
+    LB; mid-load the controller is killed (no teardown, journal
+    intact) WITH a drain freshly journaled, the LB serves its stale
+    view, and a new controller boots with recover=True. Contracts
+    asserted into the block: ``lost_requests`` MUST be 0, every
+    healthy replica ADOPTED (zero relaunches), the interrupted drain
+    resumed at its remaining deadline, no cluster torn down twice, and
+    the reconciliation wall time recorded. The fleet-scale
+    reproduction (``controller_crash_storm``, crash mid spot-storm at
+    6+ replicas) embeds its sim report."""
+    import json as _json
+    import tempfile
+    import threading
+    import time as time_lib
+    import urllib.request
+
+    from skypilot_tpu.serve import control_env
+    from skypilot_tpu.serve import controller as controller_lib
+    from skypilot_tpu.serve import serve_state
+    from skypilot_tpu.serve.load_balancer import SkyServeLoadBalancer
+    from skypilot_tpu.serve.replica_managers import ReplicaInfo
+    from skypilot_tpu.serve.server import ModelServer
+    from skypilot_tpu.serve.service_spec import SkyServiceSpec
+    from skypilot_tpu.utils import common_utils
+
+    os.environ['SKYTPU_SERVE_DIR'] = tempfile.mkdtemp(
+        prefix='skytpu-bench-ctrl-')
+    os.environ['SKYTPU_SERVE_TICK'] = '0.5'
+    os.environ['SKYTPU_LB_SYNC'] = '3600'
+
+    class BenchEnv(control_env.LiveControlPlaneEnv):
+        """Live env with recorded cluster-op stubs and suppressible
+        spawns (crashed=True = the process's threads died)."""
+
+        def __init__(self):
+            self.crashed = False
+            self.downs = []
+            self.launches = []
+
+        def spawn(self, fn, *args):
+            if not self.crashed:
+                super().spawn(fn, *args)
+
+        def launch_cluster(self, task, cluster_name):
+            self.launches.append(cluster_name)
+
+        def cluster_head_ip(self, cluster_name):
+            return '127.0.0.1'
+
+        def down_cluster(self, cluster_name):
+            self.downs.append(cluster_name)
+
+        def cluster_gone(self, cluster_name):
+            return False
+
+    n_rep, n_req, gen = 3, 18, 24
+    ports = []
+    servers = []
+    for i in range(n_rep):
+        p = common_utils.find_free_port(18800 + 40 * i)
+        srv = ModelServer('tiny', max_batch=4, max_seq=128, port=p)
+        srv.start(block=False)
+        ports.append(p)
+        servers.append(srv)
+    spec = SkyServiceSpec(readiness_path='/readiness',
+                          min_replicas=n_rep)
+    lb = ctrl1 = ctrl2 = None
+    try:
+        for srv in servers:
+            if not srv._ready.wait(600):
+                raise RuntimeError('bench replica never became ready')
+        env1 = BenchEnv()
+        cport = common_utils.find_free_port(18900)
+        ctrl1 = controller_lib.ServeController(
+            'bench-ctrl', spec, {}, port=cport, env=env1)
+        mgr1 = ctrl1.replica_manager
+        urls = [f'http://127.0.0.1:{p}' for p in ports]
+        for rid, (p, url) in enumerate(zip(ports, urls), start=1):
+            info = ReplicaInfo(rid, f'bench-ctrl-replica-{rid}', 1,
+                               False, p)
+            info.url = url
+            info.status = serve_state.ReplicaStatus.READY
+            with mgr1._lock:
+                mgr1._replicas[rid] = info
+                mgr1._next_replica_id = rid + 1
+            mgr1._persist(info)
+        ctrl1.start()
+        lb_port = common_utils.find_free_port(18950)
+        lb = SkyServeLoadBalancer(
+            controller_url=f'http://127.0.0.1:{cport}', port=lb_port)
+        lb.start()
+        lb._sync_once()
+
+        lock = threading.Lock()
+        done, lost = [], []
+
+        def one(i):
+            body = _json.dumps({
+                'prompt': [11 + i, 3, 5, 7 + (i % 5)],
+                'max_new_tokens': gen, 'stream': True}).encode()
+            req = urllib.request.Request(
+                f'http://127.0.0.1:{lb_port}/generate', body,
+                {'Content-Type': 'application/json'})
+            try:
+                n, err, finished = 0, None, False
+                with urllib.request.urlopen(req, timeout=300) as resp:
+                    for line in resp:
+                        if not line.startswith(b'data:'):
+                            continue
+                        try:
+                            ev = _json.loads(line[5:].strip())
+                        except ValueError:
+                            continue
+                        if 'token' in ev:
+                            n += 1
+                        if 'error' in ev:
+                            err = str(ev['error'])
+                            break
+                        if ev.get('done'):
+                            finished = True
+                            break
+                with lock:
+                    (done if finished and err is None
+                     else lost).append((i, n, err))
+            except Exception as e:  # pylint: disable=broad-except
+                with lock:
+                    lost.append((i, 0, f'{type(e).__name__}: {e}'))
+
+        threads = [threading.Thread(target=one, args=(i,))
+                   for i in range(n_req)]
+        for i, t in enumerate(threads):
+            t.start()
+            time_lib.sleep(0.03)
+            if i == n_req // 3:
+                # --- mid-load: a drain starts (journal + row), then
+                # the controller DIES before its drain thread runs.
+                env1.crashed = True
+                mgr1.drain(1, deadline_s=30.0)
+                ctrl1.crash()
+                lb._sync_once()      # fails -> stale-while-revalidate
+            if i == 2 * n_req // 3 and ctrl2 is None:
+                # --- restart mid-load: reconcile, adopt, resume.
+                env2 = BenchEnv()
+                cport2 = common_utils.find_free_port(19000)
+                t0 = time_lib.monotonic()
+                ctrl2 = controller_lib.ServeController(
+                    'bench-ctrl', spec, {}, port=cport2, env=env2,
+                    recover=True)
+                reconcile_s = time_lib.monotonic() - t0
+                ctrl2.start()
+                lb.controller_url = f'http://127.0.0.1:{cport2}'
+                lb._sync_once()
+        for t in threads:
+            t.join(timeout=300)
+
+        # Let the resumed drain land its teardown.
+        deadline = time_lib.monotonic() + 60
+        while time_lib.monotonic() < deadline and (
+                1 in ctrl2.replica_manager._replicas
+                or serve_state.pending_ops('bench-ctrl')):
+            time_lib.sleep(0.2)
+        downs_per_cluster: dict = {}
+        for c in env1.downs + env2.downs:
+            downs_per_cluster[c] = downs_per_cluster.get(c, 0) + 1
+        from skypilot_tpu.serve.sim import scenarios as sim_scenarios
+        sim_rep = sim_scenarios.run_scenario('controller_crash_storm',
+                                             seed=15, keep_log=False)
+        return {
+            'workload': {'n_requests': n_req, 'gen_tokens': gen,
+                         'replicas': n_rep, 'model': 'tiny',
+                         'n_chips': n_chips},
+            'lost_requests': len(lost),
+            'completed_requests': len(done),
+            'zero_lost_contract_held': len(lost) == 0,
+            'reconcile_wall_s': round(reconcile_s, 4),
+            'reconciled': dict(ctrl2.last_reconcile),
+            # The drained replica's AUTOSCALER replacement may launch
+            # after recovery (that is the control plane working) —
+            # adoption means the healthy survivors were never
+            # relaunched.
+            'adopted_not_relaunched':
+                ctrl2.last_reconcile.get('adopted', 0) == n_rep - 1,
+            'replacement_launches': len(env2.launches),
+            'drain_resumed':
+                ctrl2.last_reconcile.get('drain_resumed', 0) == 1,
+            'max_teardowns_per_cluster':
+                max(downs_per_cluster.values(), default=0),
+            'no_double_teardown':
+                all(v == 1 for v in downs_per_cluster.values()),
+            'journal_drained':
+                [op for op in serve_state.pending_ops('bench-ctrl')
+                 if op['kind'] in ('drain', 'teardown')] == [],
+            'sim_controller_crash_storm': {
+                'lost': sim_rep['requests']['lost'],
+                'controller': sim_rep['controller'],
+                'event_log_sha256': sim_rep['event_log_sha256'],
+            },
+        }
+    finally:
+        if lb is not None:
+            lb.stop()
+        for c in (ctrl1, ctrl2):
+            if c is not None:
+                c.crash()
+        for srv in servers:
+            srv.stop()
 
 
 def _disagg_bench(n_chips: int) -> dict:

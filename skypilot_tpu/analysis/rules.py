@@ -111,6 +111,14 @@ TPU hot-path hygiene (GC2xx), applied to the compute layer
   ``pack_int4``/``unpack_int4``/``qeinsum``; a local re-implementation
   that disagrees on any of those produces numerically-wrong weights
   that still type-check.
+- **GC120 unjournaled-lifecycle-write** — a replica-row / journal /
+  controller-note mutation (``serve_state`` spelling or the
+  ``ControlPlaneEnv`` seam) in ``serve/replica_managers.py`` /
+  ``serve/controller.py`` outside the journaled persist helpers
+  (``_persist`` / ``_untrack`` / ``_journal_start`` /
+  ``_journal_finish`` / ``_put_note`` / ``_del_note`` /
+  ``_persist_autoscaler_state``). Restart reconciliation replays the
+  journal; a write it didn't see is state it cannot rebuild.
 - **GC202 host-sync** — device->host readbacks outside the sanctioned
   :func:`skypilot_tpu.utils.host.host_sync` helper (bare
   ``np.asarray(x)``, ``.item()``, ``jax.device_get``,
@@ -210,6 +218,14 @@ RULES: Dict[str, str] = {
              'defined in exactly one place; hand-rolled twiddling '
              'silently diverges from it (use pack_int4/unpack_int4/'
              'qeinsum)',
+    'GC120': 'unjournaled-lifecycle-write: a replica-row / journal / '
+             'note mutation in serve/replica_managers.py or '
+             'serve/controller.py outside the journaled persist '
+             'helpers (_persist/_untrack/_journal_start/'
+             '_journal_finish/_put_note/_del_note/'
+             '_persist_autoscaler_state) — crash-safe restart '
+             'reconciliation is only sound if the journal can never '
+             'drift from what the state machines actually did',
     'GC201': 'impure-jit: impure or host-synchronizing call inside a '
              '@jax.jit body',
     'GC202': 'host-sync: device->host readback outside the '
@@ -357,6 +373,25 @@ _SIM_WALLCLOCK = {'time.time', 'time.monotonic', 'time.sleep',
 # from time either, but the dotted form is the realistic miss).
 _SIM_WALLCLOCK_BARE = {'monotonic', 'perf_counter', 'time_ns',
                        'monotonic_ns'}
+
+# --------------------------------------------------------------------- GC120
+# The controller failure domain's one invariant: every lifecycle-state
+# mutation (replica rows, journal ops, controller notes — spelled as a
+# direct serve_state call or through the env seam) in the manager/
+# controller modules goes through the journaled persist helpers, so
+# restart reconciliation replays EXACTLY what the state machines did.
+# Reads (get_replicas / pending_ops / get_notes / load_replica_rows)
+# are not gated; service-level rows (set_service_status / ...) belong
+# to the service lifecycle, not the replica journal.
+LIFECYCLE_PATH_SUFFIXES = ('serve/replica_managers.py',
+                           'serve/controller.py')
+_LIFECYCLE_MUTATORS = {'add_or_update_replica', 'set_replica_status',
+                       'remove_replica', 'persist_replica',
+                       'journal_op_start', 'journal_op_finish',
+                       'put_note', 'del_note'}
+_LIFECYCLE_HELPER_SCOPES = ('_persist', '_untrack', '_journal_start',
+                            '_journal_finish', '_put_note',
+                            '_del_note', '_persist_autoscaler_state')
 
 # --------------------------------------------------------------------- GC118
 # The central fault-site registry, resolved lazily (the faults module
@@ -538,7 +573,8 @@ class _Checker(ast.NodeVisitor):
                  is_transfer_path: bool = False,
                  is_scaling_path: bool = False,
                  is_gang_path: bool = False,
-                 is_sim_path: bool = False):
+                 is_sim_path: bool = False,
+                 is_lifecycle_path: bool = False):
         self.rel = rel
         self.lines = lines
         self.is_compute = is_compute
@@ -550,6 +586,7 @@ class _Checker(ast.NodeVisitor):
         self.is_scaling_path = is_scaling_path
         self.is_gang_path = is_gang_path
         self.is_sim_path = is_sim_path
+        self.is_lifecycle_path = is_lifecycle_path
         self._flagged_sleeps: Set[int] = set()   # node ids (GC112 dedupe)
         self.violations: List[Violation] = []
         self._scope: List[str] = []
@@ -820,6 +857,8 @@ class _Checker(ast.NodeVisitor):
             self._check_gang_join(node, name, method)
         if self.is_serve and method == 'fire':
             self._check_fault_site(node)
+        if self.is_lifecycle_path:
+            self._check_lifecycle_write(node, name, method)
         if self.is_serve and self._in_async:
             self._check_async_engine_call(node, name, method)
         if self._any_lock_held():
@@ -1051,6 +1090,27 @@ class _Checker(ast.NodeVisitor):
                   'unregistered site); register the site or fix the '
                   'spelling')
 
+    def _check_lifecycle_write(self, node: ast.Call, name: str,
+                               method: str) -> None:
+        """GC120: a lifecycle-state mutation (replica row / journal op
+        / controller note — via ``serve_state.*`` or the env seam)
+        outside the journaled persist helpers. A write the journal
+        doesn't see is a write restart reconciliation can't replay —
+        the exact drift the controller failure domain exists to
+        kill."""
+        leaf = method or name.rsplit('.', 1)[-1]
+        if leaf not in _LIFECYCLE_MUTATORS:
+            return
+        if any(s in _LIFECYCLE_HELPER_SCOPES for s in self._scope):
+            return
+        self._add('GC120', node,
+                  f'{leaf}() mutates lifecycle state outside the '
+                  'journaled persist helpers '
+                  f'({", ".join(_LIFECYCLE_HELPER_SCOPES)}) — route '
+                  'the write through them so the journal can never '
+                  'drift from the state machine (restart '
+                  'reconciliation replays the journal)')
+
     def _check_sim_wallclock(self, node: ast.Call, name: str) -> None:
         """GC117: a wall-clock read (or real sleep) inside the fleet
         simulator. The sim's one time axis is the virtual clock
@@ -1211,7 +1271,9 @@ def check_source(rel: str, source: str) -> List[Violation]:
                        is_scaling_path=norm.endswith(
                            SCALING_PATH_SUFFIXES),
                        is_gang_path=norm.endswith(GANG_PATH_SUFFIXES),
-                       is_sim_path=SIM_PATH_MARKER in f'/{norm}')
+                       is_sim_path=SIM_PATH_MARKER in f'/{norm}',
+                       is_lifecycle_path=norm.endswith(
+                           LIFECYCLE_PATH_SUFFIXES))
     checker.visit(tree)
     suppressed = _line_suppressions(source)
     out = []

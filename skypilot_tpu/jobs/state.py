@@ -75,6 +75,14 @@ def db_lock() -> filelock.FileLock:
 
 def _conn() -> sqlite3.Connection:
     conn = sqlite3.connect(_db_path(), timeout=10)
+    # WAL + busy timeout (round 15, mirrors serve_state): a restarted
+    # controller racing a straggler writer gets a bounded retry
+    # instead of 'database is locked'.
+    conn.execute('PRAGMA busy_timeout=10000')
+    try:
+        conn.execute('PRAGMA journal_mode=WAL')
+    except sqlite3.OperationalError:
+        pass      # exotic filesystems without WAL: keep the default
     conn.execute("""\
         CREATE TABLE IF NOT EXISTS managed_jobs (
             job_id INTEGER PRIMARY KEY AUTOINCREMENT,

@@ -110,6 +110,26 @@ class Autoscaler:
             request_tiers: Optional[Sequence[str]] = None) -> None:
         del request_timestamps, request_tiers
 
+    # --------------------------------------------------------- snapshots
+    # Crash-safety (round 15): the controller persists this each tick
+    # and restores it on a recovery boot, so a restart never resets
+    # the applied target to min_replicas (scaling the fleet down into
+    # live traffic) and the forecast autoscaler keeps its seasonal
+    # rings + learned provisioning lead.
+    def export_state(self) -> Dict[str, Any]:
+        return {'target_num_replicas': self.target_num_replicas}
+
+    def restore_state(self, state: Dict[str, Any]) -> None:
+        try:
+            target = int(state.get('target_num_replicas',
+                                   self.target_num_replicas))
+        except (TypeError, ValueError):
+            return
+        target = max(target, self.spec.min_replicas)
+        if self.spec.max_replicas is not None:
+            target = min(target, self.spec.max_replicas)
+        self.target_num_replicas = target
+
     def note_provision_seconds(self, seconds: float) -> None:
         """Observed replica provision latency (scale-up issued ->
         READY). The forecast autoscaler learns its pre-scaling lead
@@ -371,6 +391,23 @@ class _ForecastMixin:
         else:
             a = self.LEAD_EWMA_ALPHA
             self._lead_s = a * float(seconds) + (1 - a) * self._lead_s
+
+    def export_state(self) -> Dict[str, Any]:
+        state = super().export_state()  # type: ignore[misc]
+        state['lead_s'] = self._lead_s
+        state['forecaster'] = self.forecaster.snapshot()
+        return state
+
+    def restore_state(self, state: Dict[str, Any]) -> None:
+        super().restore_state(state)  # type: ignore[misc]
+        if state.get('lead_s') is not None:
+            try:
+                self._lead_s = float(state['lead_s'])
+            except (TypeError, ValueError):
+                pass
+        snap = state.get('forecaster')
+        if isinstance(snap, dict):
+            self.forecaster.restore(snap)
 
     def provision_lead_s(self) -> float:
         """The pre-scaling lead time: learned from READY latencies once

@@ -127,11 +127,48 @@ class ControlPlaneEnv:
                         cluster_name: str, status: Any,
                         url: Optional[str], version: int, is_spot: bool,
                         port: int) -> None:
-        """Write the replica row (sqlite live; no-op in sim — a
-        simulated fleet must never touch the operator's serve DB)."""
+        """Write the replica row (sqlite live; a world-local table in
+        sim — a simulated fleet must never touch the operator's serve
+        DB, but a simulated controller RESTART must still find rows to
+        reconcile against)."""
         raise NotImplementedError
 
     def remove_replica(self, service_name: str, replica_id: int) -> None:
+        raise NotImplementedError
+
+    def load_replica_rows(self, service_name: str) -> List[Dict[str, Any]]:
+        """Every persisted replica row (reconciliation input after a
+        controller restart), sorted by replica id."""
+        raise NotImplementedError
+
+    # ----------------------------------------------------------- journal
+    # The WAL-style lifecycle journal (serve_state.lifecycle_ops live;
+    # a world-local list in sim): every multi-step op is journaled
+    # BEFORE it starts and finished when acked, so a controller crash
+    # at any point leaves a pending row the restart replays.
+    def journal_op_start(self, service_name: str, kind: str,
+                         replica_id: int, gang_id: Optional[str],
+                         payload: Optional[Dict[str, Any]] = None,
+                         deadline_at: Optional[float] = None) -> int:
+        raise NotImplementedError
+
+    def journal_op_finish(self, service_name: str, op_id: int) -> None:
+        raise NotImplementedError
+
+    def pending_ops(self, service_name: str) -> List[Dict[str, Any]]:
+        raise NotImplementedError
+
+    # ------------------------------------------------------------- notes
+    # Durable controller facts that are not rows or ops: checkpoint
+    # dedupe keys, learned canary digests, autoscaler/forecaster
+    # snapshots. JSON values.
+    def put_note(self, service_name: str, key: str, value: Any) -> None:
+        raise NotImplementedError
+
+    def del_note(self, service_name: str, key: str) -> None:
+        raise NotImplementedError
+
+    def get_notes(self, service_name: str) -> Dict[str, Any]:
         raise NotImplementedError
 
     # -------------------------------------------------------------- faults
@@ -250,6 +287,42 @@ class LiveControlPlaneEnv(ControlPlaneEnv):
     def remove_replica(self, service_name: str, replica_id: int) -> None:
         from skypilot_tpu.serve import serve_state
         serve_state.remove_replica(service_name, replica_id)
+
+    def load_replica_rows(self, service_name: str
+                          ) -> List[Dict[str, Any]]:
+        from skypilot_tpu.serve import serve_state
+        return serve_state.get_replicas(service_name)
+
+    # ----------------------------------------------------------- journal
+    def journal_op_start(self, service_name: str, kind: str,
+                         replica_id: int, gang_id: Optional[str],
+                         payload: Optional[Dict[str, Any]] = None,
+                         deadline_at: Optional[float] = None) -> int:
+        from skypilot_tpu.serve import serve_state
+        return serve_state.journal_op_start(
+            service_name, kind, replica_id, gang_id, payload,
+            deadline_at=deadline_at)
+
+    def journal_op_finish(self, service_name: str, op_id: int) -> None:
+        from skypilot_tpu.serve import serve_state
+        serve_state.journal_op_finish(service_name, op_id)
+
+    def pending_ops(self, service_name: str) -> List[Dict[str, Any]]:
+        from skypilot_tpu.serve import serve_state
+        return serve_state.pending_ops(service_name)
+
+    # ------------------------------------------------------------- notes
+    def put_note(self, service_name: str, key: str, value: Any) -> None:
+        from skypilot_tpu.serve import serve_state
+        serve_state.put_note(service_name, key, value)
+
+    def del_note(self, service_name: str, key: str) -> None:
+        from skypilot_tpu.serve import serve_state
+        serve_state.del_note(service_name, key)
+
+    def get_notes(self, service_name: str) -> Dict[str, Any]:
+        from skypilot_tpu.serve import serve_state
+        return serve_state.get_notes(service_name)
 
     # -------------------------------------------------------------- faults
     def fault_injector(self) -> Optional['faults_lib.FaultInjector']:

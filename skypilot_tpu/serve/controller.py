@@ -35,7 +35,8 @@ class ServeController:
     def __init__(self, service_name: str, spec: SkyServiceSpec,
                  task_config: Dict[str, Any], port: int,
                  reserved_ports: Optional[set] = None,
-                 env: Optional[control_env.ControlPlaneEnv] = None):
+                 env: Optional[control_env.ControlPlaneEnv] = None,
+                 recover: bool = False):
         self.service_name = service_name
         self.spec = spec
         self.port = port
@@ -54,6 +55,72 @@ class ServeController:
         self._done = threading.Event()      # teardown fully finished
         self._httpd: Optional[http.server.ThreadingHTTPServer] = None
         self._threads: List[threading.Thread] = []
+        # Crash-safety telemetry + the controller's own fault hook
+        # (site 'controller_tick', kind controller_crash — the loop
+        # dies WITHOUT teardown, exactly like a real process crash).
+        from skypilot_tpu import telemetry
+        reg = telemetry.get_registry()
+        self._m_restarts = reg.counter(
+            'skytpu_controller_restarts_total',
+            'Controller boots that found persisted lifecycle state to '
+            'reconcile (restarts; a first boot over an empty journal '
+            'does not count)')
+        self._h_reconcile = reg.histogram(
+            'skytpu_reconcile_seconds',
+            'Restart reconciliation wall time: journal replay + '
+            'adoption probes to manager rebuilt',
+            buckets=telemetry.registry.DEFAULT_SECONDS_BUCKETS)
+        self._faults = self._env.fault_injector()
+        # What the last recovery boot did per persisted replica
+        # (outcome -> count); empty on a fresh boot.
+        self.last_reconcile: Dict[str, int] = {}
+        if recover:
+            self._recover()
+
+    # ----------------------------------------------------------- recovery
+    def _recover(self) -> None:
+        """Recovery boot: restore the autoscaler/forecaster snapshot,
+        then rebuild the replica manager from the journal + live
+        probes (``ReplicaManager.reconcile``). Idempotent over an
+        empty DB — ``serve/service.py`` always boots with
+        ``recover=True`` and a first boot reconciles to a no-op."""
+        t0 = self._env.monotonic()
+        restored = self._restore_autoscaler_state()
+        stats = self.replica_manager.reconcile()
+        self.last_reconcile = stats
+        if restored or any(stats.values()):
+            self._m_restarts.inc()
+            self._h_reconcile.observe(
+                max(0.0, self._env.monotonic() - t0))
+            logger.info(
+                f'Controller for {self.service_name} restarted: '
+                f'reconciled in {self._env.monotonic() - t0:.3f}s '
+                f'({stats}).')
+
+    def _persist_autoscaler_state(self) -> None:
+        """Journaled persist helper (graftcheck GC120): snapshot the
+        autoscaler target + forecaster rings + learned provision lead
+        each tick, so a restart never scales the fleet toward
+        min_replicas while live traffic needs it."""
+        try:
+            self._env.put_note(self.service_name, 'autoscaler_state',
+                               self.autoscaler.export_state())
+        except Exception as e:  # pylint: disable=broad-except
+            logger.debug(f'autoscaler snapshot persist failed: '
+                         f'{type(e).__name__}: {e}')
+
+    def _restore_autoscaler_state(self) -> bool:
+        try:
+            notes = self._env.get_notes(self.service_name)
+        except Exception as e:  # pylint: disable=broad-except
+            logger.warning(f'autoscaler snapshot restore failed: '
+                           f'{type(e).__name__}: {e}')
+            return False
+        state = notes.get('autoscaler_state')
+        if not isinstance(state, dict):
+            return False
+        self.autoscaler.restore_state(state)
+        return True
 
     # ---------------------------------------------------------- scaling
     def _replica_views(self) -> List[autoscalers.ReplicaView]:
@@ -169,12 +236,26 @@ class ServeController:
             self.apply_update()
         self.replica_manager.probe_all()
         self._autoscaler_step()
+        # Snapshot the scaling brain through the env seam (a no-op DB
+        # in sim is still the same code path): restarts restore it.
+        self._persist_autoscaler_state()
         if sync_state:
             self._update_service_status()
 
     def _loop(self) -> None:
         while not self._stop.is_set():
             try:
+                if self._faults is not None:
+                    rule = self._faults.fire('controller_tick')
+                    if rule is not None and \
+                            rule.kind == 'controller_crash':
+                        logger.error(
+                            'injected controller_crash: the control '
+                            'plane dies NOW without teardown '
+                            '(replicas keep serving; the journal '
+                            'stays for the next boot to reconcile)')
+                        self.crash()
+                        return
                 self.tick()
             except Exception:  # pylint: disable=broad-except
                 logger.exception('controller loop error')
@@ -304,6 +385,19 @@ class ServeController:
         self._threads = [t_http, t_loop]
         logger.info(f'Serve controller for {self.service_name} on port '
                     f'{self.port}.')
+
+    def crash(self) -> None:
+        """Die like a crashed process (chaos tests / the bench's
+        ``ctrl_recovery`` block): stop the loop and the HTTP API but
+        tear NOTHING down and touch NO rows — replicas keep serving,
+        the journal and notes stay exactly as written, and the next
+        ``ServeController(..., recover=True)`` must reconcile it all
+        back. The LB sees sync failures and enters its
+        stale-while-revalidate mode."""
+        self._stop.set()
+        if self._httpd is not None:
+            self._httpd.shutdown()
+        self._done.set()
 
     def terminate(self) -> None:
         serve_state.set_service_status(

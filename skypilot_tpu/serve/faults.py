@@ -131,11 +131,20 @@ FAULT_SPEC_ENV = 'SKYTPU_FAULT_SPEC'
 # - 'byzantine_response': a replica answers the manager's known-digest
 #   canary prompt WRONG — silent data corruption; the manager must
 #   quarantine it before it serves a second wrong response.
+# The controller-failure kinds (round 15) target the control plane
+# itself:
+# - 'controller_crash': the ServeController dies WITHOUT teardown —
+#   replicas keep serving, the LB enters stale-while-revalidate, the
+#   journal stays for the next boot.
+# - 'controller_restart': a fresh controller boots with recover=True
+#   and must reconcile the orphaned fleet (adopt, resume drains,
+#   replay teardowns, reap zombies) instead of relaunching it.
 FAULT_KINDS = ('replica_crash', 'probe_timeout', 'slow_response',
                'partial_response', 'engine_stall', 'preempt_signal',
                'zone_outage', 'straggler',
                'wedged_step', 'nan_logits', 'kv_corruption',
-               'byzantine_response')
+               'byzantine_response',
+               'controller_crash', 'controller_restart')
 
 # The stable label set of skytpu_gray_failures_total{kind}: detections
 # by the gray-failure defense layer (watchdog fire, NaN eviction,
@@ -176,12 +185,21 @@ GRAY_FAILURE_KINDS = ('wedged_step', 'nan_logits', 'kv_corruption',
 #   answers canaries wrong until quarantined), ``kv_corruption``
 #   (replica's next checkpoint export is garbage — its replacement
 #   must boot cold, not byte-wrong).
+# - ``controller_tick`` — the live controller's autoscaler loop, once
+#   per iteration. Kind ``controller_crash`` stops the loop + HTTP API
+#   dead (no teardown, no row writes) — the deterministic in-process
+#   stand-in for a controller process crash.
+# - ``sim_controller`` — the fleet simulator's storm clock. Kind
+#   ``controller_crash`` halts the simulated controller's env (its
+#   background tasks unwind, persistence stops landing);
+#   ``controller_restart`` boots a fresh controller over the same
+#   world with recover=True and reconciles.
 FAULT_SITES = ('engine_step', 'probe', 'preempt', 'preempt_warning',
                'proxy', 'proxy_stream', 'http_response', 'handoff',
                'spot_preemption', 'gang_member_crash',
                'gang_join_timeout', 'sim_storm', 'sim_zone_outage',
                'sim_straggler', 'sim_gang_churn', 'kv_wire', 'canary',
-               'sim_gray')
+               'sim_gray', 'controller_tick', 'sim_controller')
 
 # Outcomes of skytpu_requests_migrated_total{outcome}: a migrated
 # request either completed on a surviving replica or exhausted every

@@ -124,6 +124,39 @@ class TrafficForecaster:
         while len(ring) > self.ring_buckets:
             ring.popitem(last=False)
 
+    # --------------------------------------------------------- snapshots
+    def snapshot(self) -> Dict[str, object]:
+        """JSON-able snapshot of the arrival rings — persisted by the
+        controller each tick so a restart keeps its seasonal memory
+        (round 15: a forecaster that reboots empty would scale the
+        fleet DOWN into the very burst it had already learned)."""
+        return {
+            'bucket_s': self.bucket_s,
+            'counts': {tier: [[int(b), int(n)]
+                              for b, n in ring.items()]
+                       for tier, ring in self._counts.items()},
+        }
+
+    def restore(self, snap: Dict[str, object]) -> None:
+        """Merge a :meth:`snapshot` back in (bucket geometry must
+        match — a spec update that changed ``bucket_s`` makes the old
+        ring meaningless and it is dropped)."""
+        if float(snap.get('bucket_s', self.bucket_s)) != self.bucket_s:
+            return
+        for tier, items in (snap.get('counts') or {}).items():
+            if tier not in self._counts:
+                continue
+            ring = self._counts[tier]
+            for bucket, count in items:
+                ring[int(bucket)] = max(ring.get(int(bucket), 0),
+                                        int(count))
+            # Re-sort by bucket so the ring's eviction order stays
+            # oldest-first, then re-bound it.
+            ordered = collections.OrderedDict(sorted(ring.items()))
+            while len(ordered) > self.ring_buckets:
+                ordered.popitem(last=False)
+            self._counts[tier] = ordered
+
     # ------------------------------------------------------------ queries
     def _recent_rates(self, tier: str, now: float,
                       n: int) -> List[float]:

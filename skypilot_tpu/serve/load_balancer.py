@@ -37,6 +37,27 @@ retryable error with ``Retry-After``:
 - **No-replica 503.** The LB's own 503 carries a JSON error body and a
   ``Retry-After`` derived from the controller's probe/launch backoff
   state (shipped on every sync).
+
+LB autonomy during a controller outage (round 15,
+``docs/robustness.md`` "controller failure domain"): the controller
+is a coordinator, not a dependency —
+
+- **Stale-while-revalidate.** A failed sync keeps the last-synced
+  replica set serving; ``skytpu_lb_sync_age_seconds`` gauges how old
+  that view is and ``skytpu_lb_controller_up`` flips to 0 (with one
+  loud bounded-staleness alarm log) once the age crosses
+  ``SKYTPU_LB_MAX_STALENESS`` (default 30 s).
+- **Local eviction.** The LB removes a replica from its OWN rotation —
+  no controller input — when the replica proves dead to the data
+  plane: a connection-level refusal or a mid-stream death that
+  recovery migrated off. Evictions are TTL-bounded
+  (``SKYTPU_LB_EVICT_TTL``, default 120 s), so a false positive costs
+  one TTL, never permanent capacity.
+- **Reconcile, not clobber.** When the controller returns, its list is
+  re-applied MINUS still-live local evictions (the controller's view
+  may predate the deaths the LB watched happen); an eviction record is
+  dropped once the controller itself stops listing the replica (it
+  caught up) or the TTL expires.
 """
 from __future__ import annotations
 
@@ -91,6 +112,21 @@ def _sync_period() -> float:
     return float(os.environ.get('SKYTPU_LB_SYNC', '3'))
 
 
+def _max_staleness() -> float:
+    """Bounded-staleness alarm threshold: how long the LB may serve
+    from its last controller sync before the outage is alarmed (the
+    serving itself continues — the alarm is for operators)."""
+    return float(os.environ.get('SKYTPU_LB_MAX_STALENESS', '30'))
+
+
+def _evict_ttl() -> float:
+    """How long a locally-evicted replica stays out of rotation when
+    the controller keeps listing it (a stale controller view). A false
+    eviction costs at most this; a real death is usually confirmed by
+    the controller's own probes well before it expires."""
+    return float(os.environ.get('SKYTPU_LB_EVICT_TTL', '120'))
+
+
 class SkyServeLoadBalancer:
 
     def __init__(self, controller_url: str, port: int,
@@ -140,6 +176,30 @@ class SkyServeLoadBalancer:
             'Mid-stream migration: replica failure detected to stream '
             'resumed on a surviving replica (s)',
             buckets=telemetry.registry.DEFAULT_SECONDS_BUCKETS)
+        # Controller-outage autonomy (round 15): sync-age/health
+        # gauges, the bounded-staleness alarm latch, and the local
+        # eviction table (url -> monotonic eviction time). The LB's
+        # last-synced controller list is kept separately from the
+        # policy's live set so reconciliation can re-apply it minus
+        # evictions at any time.
+        self._g_sync_age = reg.gauge(
+            'skytpu_lb_sync_age_seconds',
+            'Age of the LB\'s last successful controller sync (the '
+            'staleness of the replica view it is serving from)')
+        self._g_ctrl_up = reg.gauge(
+            'skytpu_lb_controller_up',
+            'Controller health as the LB sees it (1 = syncing; 0 = '
+            'outage past the bounded-staleness alarm)')
+        self._m_local_evict = reg.counter(
+            'skytpu_lb_local_evictions_total',
+            'Replicas the LB evicted from rotation on its own '
+            'data-plane evidence (no controller input)')
+        self._evict_lock = threading.Lock()
+        self._evicted: Dict[str, float] = {}
+        self._last_ready: List[str] = []
+        self._last_sync_ok: Optional[float] = None
+        self._started_at = time.monotonic()
+        self._staleness_alarmed = False
         # Fault injection (serve/faults.py): resolved once; None keeps
         # the hooks at a single attribute check.
         self._faults = faults_lib.get_injector()
@@ -176,8 +236,21 @@ class SkyServeLoadBalancer:
         try:
             with urllib.request.urlopen(req, timeout=5) as resp:
                 payload = json.loads(resp.read())
-            self.policy.set_ready_replicas(
+            self._last_sync_ok = time.monotonic()
+            self._g_sync_age.set(0.0)
+            self._g_ctrl_up.set(1)
+            if self._staleness_alarmed:
+                logger.warning('controller is back; reconciling the '
+                               'replica view (stale-while-revalidate '
+                               'mode ends)')
+                self._staleness_alarmed = False
+            # Reconcile, don't clobber: the controller's list is
+            # authority for MEMBERSHIP, but a replica the LB watched
+            # die stays evicted until the controller stops listing it
+            # or the eviction TTL expires.
+            self._last_ready = list(
                 payload.get('ready_replica_urls', []))
+            self._apply_ready_urls()
             hint = payload.get('retry_after_s')
             if hint:
                 self._retry_after_hint = max(1, int(hint))
@@ -206,6 +279,24 @@ class SkyServeLoadBalancer:
                 self._request_tiers = (
                     [tr for _, tr in keep] + self._request_tiers)
             self._m_sync_failures.inc()
+            # Stale-while-revalidate accounting: the last-synced view
+            # keeps serving; the age gauge tells operators how stale
+            # it is, and one loud alarm fires when the outage crosses
+            # the staleness bound.
+            age = time.monotonic() - (self._last_sync_ok
+                                      if self._last_sync_ok is not None
+                                      else self._started_at)
+            self._g_sync_age.set(age)
+            if age > _max_staleness():
+                self._g_ctrl_up.set(0)
+                if not self._staleness_alarmed:
+                    self._staleness_alarmed = True
+                    logger.error(
+                        f'controller unreachable for {age:.0f}s '
+                        f'(> SKYTPU_LB_MAX_STALENESS='
+                        f'{_max_staleness():.0f}s): serving from the '
+                        'stale replica view; dead replicas are '
+                        'evicted locally from data-plane evidence')
             logger.warning(f'LB sync with controller failed: '
                            f'{type(e).__name__}: {e}')
 
@@ -213,6 +304,42 @@ class SkyServeLoadBalancer:
         while not self._stop.is_set():
             self._sync_once()
             self._stop.wait(_sync_period())
+
+    # ----------------------------------------------- local evictions
+    def _apply_ready_urls(self) -> None:
+        """Install the effective rotation: the controller's last list
+        minus still-live local evictions. Eviction records are dropped
+        when the controller no longer lists the replica (its probes
+        caught up with the death) or their TTL expired."""
+        now = time.monotonic()
+        listed = set(self._last_ready)
+        with self._evict_lock:
+            self._evicted = {
+                u: t for u, t in self._evicted.items()
+                if u in listed and now - t < _evict_ttl()}
+            evicted = set(self._evicted)
+        self.policy.set_ready_replicas(
+            [u for u in self._last_ready if u not in evicted])
+
+    def note_replica_dead(self, url: Optional[str],
+                          reason: str) -> None:
+        """Data-plane death evidence: take ``url`` out of the LB's OWN
+        rotation immediately — no controller round-trip. Called when a
+        replica refuses connections or dies mid-stream (the recovery
+        path already migrated the work); during a controller outage
+        this is the ONLY way dead capacity leaves rotation."""
+        if not url:
+            return
+        url = url.rstrip('/')
+        with self._evict_lock:
+            if url in self._evicted:
+                return
+            self._evicted[url] = time.monotonic()
+        self._m_local_evict.inc()
+        logger.warning(f'locally evicting replica {url} from rotation '
+                       f'({reason}); TTL {_evict_ttl():.0f}s or until '
+                       'the controller confirms')
+        self._apply_ready_urls()
 
     # --------------------------------------------------------- recovery
     @staticmethod
@@ -375,6 +502,11 @@ class SkyServeLoadBalancer:
                 except Exception as e:  # pylint: disable=broad-except
                     logger.warning(f'upstream stream broke: '
                                    f'{type(e).__name__}: {e}')
+                    if info is not None:
+                        # Transport-level death (vs a replica-side
+                        # error EVENT, which an alive replica sent):
+                        # the caller evicts the upstream locally.
+                        info['transport_break'] = True
                     return False
                 return False       # EOF without a done event: broken
 
@@ -423,6 +555,16 @@ class SkyServeLoadBalancer:
                             # the resubmit.
                             tried.add(failed.rstrip('/'))
                             tried.discard(cur_url)
+                            lb.note_replica_dead(
+                                failed, 'relay reported decode '
+                                        'worker dead')
+                        elif info.get('transport_break'):
+                            # The serving replica itself died
+                            # mid-stream: out of the LB's own rotation
+                            # now — controller confirmation can wait
+                            # (or never come, during an outage).
+                            lb.note_replica_dead(
+                                cur_url, 'died mid-stream')
                         t_fail = time.monotonic()
                         if own_leg is not None:
                             try:
@@ -509,6 +651,9 @@ class SkyServeLoadBalancer:
                         logger.warning(
                             f'continuation on {nxt} failed '
                             f'({type(e).__name__}: {e}); trying next')
+                        if _failed_before_send(e):
+                            lb.note_replica_dead(
+                                nxt, 'refused continuation connect')
                         continue
                     logger.info(
                         f'migrated stream to {nxt} with '
@@ -656,6 +801,13 @@ class SkyServeLoadBalancer:
                             return
                         last_err = e
                         lb._m_retries.inc()
+                        if _failed_before_send(e):
+                            # Connection-level refusal: the replica
+                            # process is gone — out of the LB's own
+                            # rotation without waiting for the
+                            # controller (which may be down itself).
+                            lb.note_replica_dead(
+                                url, 'connection refused')
                         logger.warning(
                             f'replica {url} failed before answering '
                             f'({type(e).__name__}: {e}); retrying on '
@@ -708,8 +860,19 @@ class SkyServeLoadBalancer:
         present as the configured truth."""
         meshes = self.policy.replica_meshes()
         urls = list(self.policy.ready_replicas)
+        now = time.monotonic()
+        age = now - (self._last_sync_ok if self._last_sync_ok
+                     is not None else self._started_at)
+        with self._evict_lock:
+            evicted = sorted(self._evicted)
         return {
             'ready_replica_urls': urls,
+            # Controller-outage autonomy surface: how stale the view
+            # is, whether the LB considers the controller up, and what
+            # it evicted on its own evidence.
+            'controller_sync_age_s': round(age, 3),
+            'controller_up': not self._staleness_alarmed,
+            'locally_evicted': evicted,
             'replica_parallelism': self._replica_parallelism,
             'replica_roles': dict(self._replica_roles),
             # Gang health accounting: follower ranks are not routable

@@ -47,6 +47,13 @@ if typing.TYPE_CHECKING:
 
 logger = tpu_logging.init_logger(__name__)
 
+# Stable outcome label set of skytpu_replicas_adopted_total{outcome}:
+# what restart reconciliation did with each persisted replica row /
+# pending journal op it found (docs/robustness.md, controller failure
+# domain).
+ADOPT_OUTCOMES = ('adopted', 'probe_pending', 'drain_resumed',
+                  'teardown_replayed', 'zombie_killed', 'preempted')
+
 _PROBE_FAILURE_GRACE = 3          # consecutive probe failures → NOT_READY
 _PROBE_FAILURE_TERMINATE = 9      # consecutive failures → replace replica
 _MAX_RETAINED_FAILED = 3          # FAILED rows kept for debugging
@@ -185,6 +192,15 @@ class ReplicaInfo:
         # Byzantine-detection canary bookkeeping: when this replica
         # was last canaried (env clock; 0 = never).
         self.last_canary_t = 0.0
+        # Lifecycle-journal bookkeeping (round 15): the pending
+        # journal op ids this replica's in-flight launch / drain carry
+        # (finished when the op acks), and a teardown-started latch so
+        # a replica's cluster is never torn down twice — not by racing
+        # scale_down calls, and not by a restarted controller
+        # replaying an op the dying one already ran.
+        self.launch_op: Optional[int] = None
+        self.drain_op: Optional[int] = None
+        self.teardown_started = False
 
 
 class ReplicaManager:
@@ -290,6 +306,16 @@ class ReplicaManager:
             'skytpu_replicas_quarantined_total',
             'Replicas quarantined after a byzantine (wrong-digest) '
             'canary response')
+        # Restart reconciliation (round 15): what the journal replay
+        # did with each persisted row — registered at construction so
+        # the series render as zeros from the first scrape.
+        self._m_adopted = {
+            outcome: reg.counter(
+                'skytpu_replicas_adopted_total',
+                'Persisted replicas handled by restart reconciliation '
+                '(adopted = healthy and re-owned without relaunch)',
+                outcome=outcome)
+            for outcome in ADOPT_OUTCOMES}
         faults_lib.register_metrics()
 
     def configure_canary(self, interval_s: float,
@@ -312,13 +338,18 @@ class ReplicaManager:
         """Blue-green-lite (reference ``:1172``): new replicas launch with
         the new task; old-version replicas are drained by the controller
         once enough new-version replicas are ready."""
+        old_version = self.version
         self.spec = spec
         self.task_config = task_config
         self.version = version
         # A new version may legitimately answer the canary differently
         # (new weights): relearn the reference digest from the first
-        # healthy new-version replica.
+        # healthy new-version replica. The persisted digest is keyed
+        # by version, so the stale key is dropped and a restart mid-
+        # rollover relearns exactly like the live path.
         self._canary_learned = None
+        if version != old_version:
+            self._del_note(f'canary_digest:v{old_version}')
 
     # ------------------------------------------------------------- launch
     def _replica_cluster_name(self, replica_id: int) -> str:
@@ -409,6 +440,14 @@ class ReplicaManager:
                 finfo.status = serve_state.ReplicaStatus.PROVISIONING
                 self._replicas[fid] = finfo
                 followers.append(finfo)
+        # Journal BEFORE persisting rows or spawning the launch: a
+        # crash at any later point leaves a pending 'launch' op whose
+        # payload carries the full descriptor (role/gang/port), so the
+        # restarted controller can kill the zombie cluster — or adopt
+        # the replica with its role and gang membership intact.
+        for member in [info] + followers:
+            member.launch_op = self._journal_start(
+                'launch', member, payload=self._descriptor(member))
         self._persist(info)
         for finfo in followers:
             self._persist(finfo)
@@ -417,6 +456,22 @@ class ReplicaManager:
         # once rank 0 reaches STARTING).
         self._env.spawn(self._launch_replica, info)
         return replica_id
+
+    @staticmethod
+    def _descriptor(info: ReplicaInfo) -> Dict[str, object]:
+        """The journal payload that lets a restarted controller
+        rebuild this replica's ReplicaInfo without guessing (live
+        probes refine role/gang where the replica still answers)."""
+        return {
+            'cluster_name': info.cluster_name,
+            'port': info.port,
+            'is_spot': info.is_spot,
+            'role': info.role,
+            'gang_id': info.gang_id,
+            'gang_rank': info.gang_rank,
+            'gang_world': info.gang_world,
+            'version': info.version,
+        }
 
     def shutdown(self) -> None:
         """Refuse further scale_up; in-flight launches will self-clean."""
@@ -504,6 +559,8 @@ class ReplicaManager:
                     f'{info.cluster_name} failed (it may leak): '
                     f'{type(e).__name__}: {e}')
             self._untrack(info.replica_id)
+            self._journal_finish(info.launch_op)
+            info.launch_op = None
             return
         head_ip = self._env.cluster_head_ip(info.cluster_name)
         if head_ip is None:
@@ -547,6 +604,10 @@ class ReplicaManager:
             return
         info.status = serve_state.ReplicaStatus.FAILED
         self._persist(info)
+        # The launch op is terminal either way: a FAILED row is kept
+        # for debugging (pruned by _bump_backoff), not replayed.
+        self._journal_finish(info.launch_op)
+        info.launch_op = None
         try:      # a launch can fail after partially creating the cluster
             self._env.down_cluster(info.cluster_name)
         except exceptions.ClusterDoesNotExist:
@@ -693,9 +754,17 @@ class ReplicaManager:
             self.scale_down(replica_id)
             return False
         _transition_counter('DRAINING').inc()
-        self._persist(info)
         deadline_s = (float(deadline_s) if deadline_s is not None
                       else _drain_deadline_default())
+        # Journal the drain with its ABSOLUTE deadline before the
+        # first effect (the /drain POST): a controller that dies
+        # mid-drain restarts and resumes the wait at the REMAINING
+        # budget — in-flight requests get exactly the window they were
+        # promised, not a fresh full deadline and not an instant kill.
+        info.drain_op = self._journal_start(
+            'drain', info, payload={'deadline_s': deadline_s},
+            deadline_at=self._env.time() + deadline_s)
+        self._persist(info)
         logger.info(f'Draining replica {info.replica_id}'
                     + (f' (gang {info.gang_id})' if info.gang_id
                        else '')
@@ -712,6 +781,8 @@ class ReplicaManager:
                            f'({type(e).__name__}: {e}); tearing down '
                            'anyway')
         self.scale_down(info.replica_id)
+        self._journal_finish(info.drain_op)
+        info.drain_op = None
 
     def _await_replica_drain(self, info: ReplicaInfo,
                              deadline_s: float) -> None:
@@ -808,6 +879,10 @@ class ReplicaManager:
                 return
             self._ckpt_done[key] = True
             info.checkpointed = True
+        # Persist the dedupe key: a controller that dies between the
+        # checkpoint and the preemption must never double-checkpoint
+        # the same gang after restart (re-delivered warnings included).
+        self._put_note(f'ckpt_done:{key}', True)
         try:
             blob = self._env.http_post_bytes(
                 info.url + '/checkpoint', b'{}',
@@ -819,6 +894,7 @@ class ReplicaManager:
             with self._lock:
                 self._ckpt_done[key] = False
                 info.checkpointed = False
+            self._del_note(f'ckpt_done:{key}')
             return
         if self._faults is not None:
             # Deterministic checkpoint corruption (site 'kv_wire', kind
@@ -911,8 +987,17 @@ class ReplicaManager:
             info = self._replicas.get(replica_id)
             if info is None:
                 return
+            if info.teardown_started:
+                # Exactly-once teardown: racing scale_down calls (a
+                # drain deadline racing a probe escalation, re-issued
+                # autoscaler decisions, journal replay after restart)
+                # must never run a second down_cluster for the same
+                # replica.
+                return
+            info.teardown_started = True
             info.status = status or serve_state.ReplicaStatus.SHUTTING_DOWN
         self._persist(info)
+        op_id = self._journal_start('teardown', info)
 
         def _down():
             try:
@@ -923,6 +1008,7 @@ class ReplicaManager:
                 logger.warning(f'Teardown of {info.cluster_name} failed: '
                                f'{type(e).__name__}: {e}')
             self._untrack(replica_id)  # atomic vs _persist (see _db_lock)
+            self._journal_finish(op_id)
 
         self._env.spawn(_down)
 
@@ -1059,6 +1145,11 @@ class ReplicaManager:
                     logger.info(f'Replica {info.replica_id} is READY at '
                                 f'{info.url}.')
                     _transition_counter('READY').inc()
+                    # The journaled launch op is acked: the replica
+                    # served a probe — it is no longer a potential
+                    # zombie for restart reconciliation to reap.
+                    self._journal_finish(info.launch_op)
+                    info.launch_op = None
                     self._h_provision.observe(
                         max(0.0, self._env.time() - info.created_time))
                     with self._lock:     # a replica serves: reset backoff
@@ -1163,8 +1254,12 @@ class ReplicaManager:
                 # Quorum-of-first: the reference digest is learned
                 # from the first replica that answers (configure an
                 # expected_digest to close the first-answerer-is-
-                # byzantine window).
+                # byzantine window). Persisted keyed by version: a
+                # restarted controller keeps judging canaries against
+                # the SAME reference instead of relearning from a
+                # possibly-byzantine first answerer.
                 self._canary_learned = digest
+                self._put_note(f'canary_digest:v{self.version}', digest)
                 logger.info(
                     f'Canary reference digest learned from replica '
                     f'{info.replica_id}: {digest}')
@@ -1233,6 +1328,282 @@ class ReplicaManager:
         for m in members:
             self._persist(m)
 
+    # ------------------------------------------------------ reconciliation
+    def reconcile(self) -> Dict[str, int]:
+        """Rebuild the manager after a controller restart from the
+        persisted rows + pending journal ops + controller notes, with
+        live probes as ground truth (docs/robustness.md, controller
+        failure domain). Per discovered replica, exactly one of:
+
+        - **adopted** — healthy (cluster up, probe passes): re-owned
+          in place, role/gang recovered from the journal descriptor
+          and refined by live ``/metrics?format=json`` +
+          ``/gang/status`` probes; never relaunched, never re-warmed.
+        - **probe_pending** — cluster up but the app not answering:
+          re-enters STARTING with a fresh grace window.
+        - **drain_resumed** — an interrupted drain continues at its
+          *remaining* deadline (the journal stored the absolute one).
+        - **teardown_replayed** — an unacked teardown (or a terminal/
+          SHUTTING_DOWN row) runs exactly once.
+        - **zombie_killed** — a crash mid-launch leaked a cluster with
+          no live owner: torn down, row cleared, the autoscaler
+          relaunches fresh.
+        - **preempted** — the cluster vanished during the outage:
+          marked PREEMPTED and cleaned up like any hard loss.
+
+        Also restores the checkpoint-dedupe keys (a preemption warning
+        re-delivered after restart still checkpoints exactly once) and
+        the learned canary digest for the current spec version, and
+        seeds ``_next_replica_id`` / the reserved-port set from the
+        persisted history so an adopted fleet never collides with new
+        launches. Idempotent: an empty DB reconciles to a no-op."""
+        rows = self._env.load_replica_rows(self.service_name)
+        ops = self._env.pending_ops(self.service_name)
+        notes = self._env.get_notes(self.service_name)
+        stats = {outcome: 0 for outcome in ADOPT_OUTCOMES}
+        now = self._env.time()
+        # Durable facts first: dedupe keys + the canary reference.
+        with self._lock:
+            for key, val in notes.items():
+                if key.startswith('ckpt_done:') and val:
+                    self._ckpt_done[key[len('ckpt_done:'):]] = True
+        digest = notes.get(f'canary_digest:v{self.version}')
+        if isinstance(digest, str) and self._canary_learned is None:
+            self._canary_learned = digest
+        launch_ops = {op['replica_id']: op for op in ops
+                      if op['kind'] == 'launch'}
+        drain_ops = {op['replica_id']: op for op in ops
+                     if op['kind'] == 'drain'}
+        teardown_ops = {op['replica_id']: op for op in ops
+                        if op['kind'] == 'teardown'}
+        # Id/port seeding: the counter must clear every id the service
+        # EVER persisted (rows and in-flight ops both), or an adopted
+        # fleet gets a duplicate replica id on the first scale-up.
+        max_id = max(
+            [r['replica_id'] for r in rows]
+            + [op['replica_id'] or 0 for op in ops] + [0])
+        with self._lock:
+            self._next_replica_id = max(self._next_replica_id,
+                                        max_id + 1)
+            self._reserved_ports |= {r['port'] for r in rows
+                                     if r.get('port')}
+        for row in sorted(rows, key=lambda r: r['replica_id']):
+            rid = row['replica_id']
+            self._reconcile_row(
+                row, launch_ops.pop(rid, None),
+                drain_ops.pop(rid, None), teardown_ops.pop(rid, None),
+                stats, now)
+        # Launch ops with no row: the controller died between the
+        # journal write and the row write — the cluster (if the launch
+        # thread got that far) is a zombie with no owner.
+        for rid in sorted(launch_ops):
+            op = launch_ops[rid]
+            cluster = ((op.get('payload') or {}).get('cluster_name')
+                       or self._replica_cluster_name(rid))
+            logger.warning(f'Reconcile: journaled launch of replica '
+                           f'{rid} has no row; reaping zombie cluster '
+                           f'{cluster}.')
+            self._env.spawn(self._reap_zombie, cluster, op['op_id'],
+                            None)
+            stats['zombie_killed'] += 1
+        # Stray drain/teardown ops with no row: the op's teardown
+        # completed but the finish ack was lost in the crash — done.
+        for op in (list(drain_ops.values())
+                   + list(teardown_ops.values())):
+            self._journal_finish(op['op_id'])
+        for outcome, n in stats.items():
+            if n:
+                self._m_adopted[outcome].inc(n)
+        if any(stats.values()):
+            logger.info(
+                'Reconciled persisted state: '
+                + ', '.join(f'{k}={v}' for k, v in sorted(stats.items())
+                            if v))
+        return stats
+
+    def _reconcile_row(self, row: Dict[str, object],
+                       launch_op: Optional[Dict[str, object]],
+                       drain_op: Optional[Dict[str, object]],
+                       teardown_op: Optional[Dict[str, object]],
+                       stats: Dict[str, int], now: float) -> None:
+        rid = int(row['replica_id'])
+        payload = dict((launch_op or {}).get('payload') or {})
+        info = ReplicaInfo(
+            rid, str(row['cluster_name']), int(row['version']),
+            bool(row['is_spot']),
+            int(row.get('port') or self.spec.replica_port),
+            role=str(payload.get('role') or 'colocated'),
+            gang_id=payload.get('gang_id'),
+            gang_rank=int(payload.get('gang_rank') or 0),
+            gang_world=int(payload.get('gang_world') or 1),
+            created_time=now)
+        info.url = row.get('url')
+        # Adopted replicas are already serving traffic: re-warming
+        # them would clobber a hot prefix cache with a stale blob.
+        info.warmed = True
+        status = row['status']
+        if (teardown_op is not None
+                or status == serve_state.ReplicaStatus.SHUTTING_DOWN
+                or status.is_terminal()):
+            # Replay the unacked teardown exactly once (the row alone
+            # is evidence enough: a terminal status only persists on
+            # the way into scale_down).
+            info.status = (status if status.is_terminal()
+                           else serve_state.ReplicaStatus.SHUTTING_DOWN)
+            info.teardown_started = True
+            with self._lock:
+                self._replicas[rid] = info
+            op_id = (teardown_op['op_id'] if teardown_op
+                     else self._journal_start('teardown', info))
+            for op in (drain_op, launch_op):
+                if op:
+                    self._journal_finish(op['op_id'])
+            self._env.spawn(self._reap_zombie, info.cluster_name,
+                            op_id, rid)
+            stats['teardown_replayed'] += 1
+            return
+        if status in (serve_state.ReplicaStatus.PENDING,
+                      serve_state.ReplicaStatus.PROVISIONING):
+            # Crash mid-launch: the launch thread died with the old
+            # controller. Whatever the cloud built is a zombie — tear
+            # it down and let the autoscaler relaunch fresh.
+            info.status = serve_state.ReplicaStatus.SHUTTING_DOWN
+            info.teardown_started = True
+            with self._lock:
+                self._replicas[rid] = info
+            op_id = (launch_op['op_id'] if launch_op
+                     else self._journal_start('teardown', info))
+            self._env.spawn(self._reap_zombie, info.cluster_name,
+                            op_id, rid)
+            stats['zombie_killed'] += 1
+            return
+        # STARTING / READY / NOT_READY / DRAINING: the replica claims
+        # to exist — cluster existence is ground truth, then the probe.
+        if self._env.cluster_gone(info.cluster_name):
+            logger.info(f'Reconcile: replica {rid} lost while the '
+                        'controller was down (preempted).')
+            if info.is_spot:
+                self._m_spot_preempt.inc()
+            info.status = serve_state.ReplicaStatus.PREEMPTED
+            info.teardown_started = True
+            with self._lock:
+                self._replicas[rid] = info
+            self._persist(info)
+            op_id = self._journal_start('teardown', info)
+            for op in (drain_op, launch_op):
+                if op:
+                    self._journal_finish(op['op_id'])
+            self._env.spawn(self._reap_zombie, info.cluster_name,
+                            op_id, rid)
+            stats['preempted'] += 1
+            return
+        if status == serve_state.ReplicaStatus.DRAINING or \
+                drain_op is not None:
+            # Resume the interrupted drain at its REMAINING deadline.
+            deadline_at = (drain_op or {}).get('deadline_at')
+            remaining = max(0.0, float(deadline_at) - now) \
+                if deadline_at is not None else 0.0
+            info.status = serve_state.ReplicaStatus.DRAINING
+            info.drain_op = (drain_op['op_id'] if drain_op
+                             else self._journal_start(
+                                 'drain', info, deadline_at=now))
+            with self._lock:
+                self._replicas[rid] = info
+            self._persist(info)
+            if launch_op:
+                self._journal_finish(launch_op['op_id'])
+            logger.info(f'Reconcile: resuming drain of replica {rid} '
+                        f'with {remaining:.1f}s of its deadline left.')
+            self._env.spawn(self._drain_then_down, info, remaining)
+            stats['drain_resumed'] += 1
+            return
+        if info.gang_rank > 0:
+            # Follower ranks serve no HTTP: their health is the
+            # leader's barrier + cluster existence (checked above).
+            info.status = status
+            with self._lock:
+                self._replicas[rid] = info
+            stats['adopted' if status ==
+                  serve_state.ReplicaStatus.READY else
+                  'probe_pending'] += 1
+            return
+        healthy = info.url is not None and self._probe_one(info)
+        if healthy:
+            # ORPHAN ADOPTION: the replica is alive and serving — own
+            # it again without relaunching (relaunching a healthy
+            # fleet is the scale-to-zero failure mode this exists to
+            # prevent). Role/mesh/gang re-read from the live replica.
+            self._adopt_probe(info)
+            info.status = serve_state.ReplicaStatus.READY
+            info.consecutive_failures = 0
+            with self._lock:
+                self._replicas[rid] = info
+            self._persist(info)
+            if launch_op:
+                self._journal_finish(launch_op['op_id'])
+            logger.info(f'Reconcile: adopted healthy replica {rid} at '
+                        f'{info.url} (role={info.role}'
+                        + (f', gang={info.gang_id}' if info.gang_id
+                           else '') + ').')
+            stats['adopted'] += 1
+            return
+        # Cluster up, app not answering (booting, or it died with the
+        # controller): STARTING with a fresh grace window — the normal
+        # probe escalation replaces it if it never comes back.
+        info.status = serve_state.ReplicaStatus.STARTING
+        info.first_probe_time = now
+        info.launch_op = launch_op['op_id'] if launch_op else None
+        with self._lock:
+            self._replicas[rid] = info
+        self._persist(info)
+        stats['probe_pending'] += 1
+
+    def _adopt_probe(self, info: ReplicaInfo) -> None:
+        """Refine an adopted replica's descriptor from the replica
+        itself: disaggregation role from ``/metrics?format=json``,
+        gang identity from ``/gang/status``. Best-effort — the journal
+        descriptor already seeded both."""
+        assert info.url is not None
+        try:
+            payload = self._env.http_json(
+                info.url + '/metrics?format=json', timeout=10)
+            role = (payload.get('disagg') or {}).get('role') \
+                if isinstance(payload, dict) else None
+            if role:
+                info.role = str(role)
+        except Exception as e:  # pylint: disable=broad-except
+            logger.debug(f'Adopt probe (/metrics) of replica '
+                         f'{info.replica_id} failed: '
+                         f'{type(e).__name__}: {e}')
+        try:
+            payload = self._env.http_json(info.url + '/gang/status',
+                                          timeout=10)
+            if isinstance(payload, dict) and payload.get('gang_id'):
+                info.gang_id = str(payload['gang_id'])
+                info.gang_world = int(payload.get('world',
+                                                  info.gang_world))
+                info.gang_rank = int(payload.get('rank', 0))
+        except Exception as e:  # pylint: disable=broad-except
+            logger.debug(f'Adopt probe (/gang/status) of replica '
+                         f'{info.replica_id} failed: '
+                         f'{type(e).__name__}: {e}')
+
+    def _reap_zombie(self, cluster_name: str, op_id: Optional[int],
+                     replica_id: Optional[int]) -> None:
+        """Tear down a cluster the crashed controller left behind
+        (zombie launch, unacked teardown) and clear its row + op."""
+        try:
+            self._env.down_cluster(cluster_name)
+        except exceptions.ClusterDoesNotExist:
+            pass
+        except Exception as e:  # pylint: disable=broad-except
+            logger.warning(f'Reconcile teardown of {cluster_name} '
+                           f'failed (it may leak): '
+                           f'{type(e).__name__}: {e}')
+        if replica_id is not None:
+            self._untrack(replica_id)
+        self._journal_finish(op_id)
+
     # ------------------------------------------------------------- queries
     def replicas(self) -> List[ReplicaInfo]:
         with self._lock:
@@ -1255,6 +1626,57 @@ class ReplicaManager:
         with self._lock:
             return {r.url: r.role for r in self._replicas.values()
                     if r.url is not None and r.gang_rank == 0}
+
+    # ------------------------------------------- journaled persistence
+    # THE sanctioned lifecycle-state writers (graftcheck GC120): every
+    # replica-row write, journal op and controller note in this file
+    # and controller.py goes through _persist/_untrack/_journal_start/
+    # _journal_finish/_put_note/_del_note — nothing else may touch the
+    # serve DB, so the journal can never drift from what the state
+    # machines actually did.
+    def _journal_start(self, kind: str, info: ReplicaInfo,
+                       payload: Optional[Dict[str, object]] = None,
+                       deadline_at: Optional[float] = None
+                       ) -> Optional[int]:
+        """Journal a multi-step lifecycle op BEFORE its first effect
+        runs; returns the op id (None when the journal write failed —
+        the op still runs, it just won't be resumable)."""
+        body = dict(payload or {})
+        body.setdefault('cluster_name', info.cluster_name)
+        try:
+            return self._env.journal_op_start(
+                self.service_name, kind, info.replica_id,
+                info.gang_id, body, deadline_at=deadline_at)
+        except Exception as e:  # pylint: disable=broad-except
+            logger.warning(
+                f'journal write for {kind} of replica '
+                f'{info.replica_id} failed ({type(e).__name__}: {e}); '
+                'the op will not survive a controller restart')
+            return None
+
+    def _journal_finish(self, op_id: Optional[int]) -> None:
+        if op_id is None:
+            return
+        try:
+            self._env.journal_op_finish(self.service_name, op_id)
+        except Exception as e:  # pylint: disable=broad-except
+            logger.warning(f'journal finish of op {op_id} failed '
+                           f'({type(e).__name__}: {e}); a restart may '
+                           'replay it (replay is idempotent)')
+
+    def _put_note(self, key: str, value: object) -> None:
+        try:
+            self._env.put_note(self.service_name, key, value)
+        except Exception as e:  # pylint: disable=broad-except
+            logger.warning(f'controller note {key!r} write failed '
+                           f'({type(e).__name__}: {e})')
+
+    def _del_note(self, key: str) -> None:
+        try:
+            self._env.del_note(self.service_name, key)
+        except Exception as e:  # pylint: disable=broad-except
+            logger.debug(f'controller note {key!r} delete failed '
+                         f'({type(e).__name__}: {e})')
 
     def _persist(self, info: ReplicaInfo) -> None:
         """Write the replica row — only while the replica is still
@@ -1279,14 +1701,23 @@ class ReplicaManager:
         LAST member of its gang — is gone, so ``_ckpt_done`` stays
         bounded by the number of LIVE replicas/gangs no matter how
         many thousands churn through a long-lived manager."""
+        dead_key: Optional[str] = None
         with self._db_lock:
             with self._lock:
                 info = self._replicas.pop(replica_id, None)
                 if info is not None:
                     if info.gang_id is None:
-                        self._ckpt_done.pop(f'replica-{replica_id}',
-                                            None)
+                        key = f'replica-{replica_id}'
+                        if self._ckpt_done.pop(key, None) is not None:
+                            dead_key = key
                     elif not any(r.gang_id == info.gang_id
                                  for r in self._replicas.values()):
-                        self._ckpt_done.pop(info.gang_id, None)
+                        if self._ckpt_done.pop(info.gang_id,
+                                               None) is not None:
+                            dead_key = info.gang_id
             self._env.remove_replica(self.service_name, replica_id)
+        if dead_key is not None:
+            # The persisted dedupe mirror is bounded the same way the
+            # in-memory dict is: evicted with the (last member of the)
+            # replica/gang it keyed.
+            self._del_note(f'ckpt_done:{dead_key}')

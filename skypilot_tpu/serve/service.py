@@ -44,10 +44,16 @@ def main() -> None:
             tls_certfile=spec.tls_certfile,
             tls_keyfile=spec.tls_keyfile)
         lb.start()
+        # recover=True always: a FIRST boot reconciles an empty
+        # journal to a no-op; a RESTART (controller crashed and the
+        # agent relaunched the service job) adopts the orphaned fleet,
+        # resumes interrupted drains at their remaining deadlines and
+        # replays unacked teardowns instead of scaling to zero.
         controller = controller_lib.ServeController(
             args.service_name, spec, task_config,
             port=record['controller_port'],
-            reserved_ports={record['controller_port'], record['lb_port']})
+            reserved_ports={record['controller_port'], record['lb_port']},
+            recover=True)
         controller.start()
         serve_state.set_service_status(
             args.service_name, serve_state.ServiceStatus.NO_REPLICA)

@@ -9,9 +9,12 @@ interface the REAL replica manager and controller consume: virtual
 clock reads, virtual sleeps, logical-task spawns, instant HTTP
 round-trips against :class:`SimReplica` handlers, and cluster
 launch/teardown that burns the scenario's provision latency on the
-virtual clock. Persistence is a no-op (a simulated fleet must never
-touch the operator's serve DB) and the fault injector is the
-scenario's seeded one.
+virtual clock. Persistence lands in a WORLD-LOCAL virtual serve DB
+(replica rows + lifecycle journal + controller notes — never the
+operator's sqlite) that survives a simulated controller crash
+(:meth:`SimControlPlaneEnv.halt`), so restart reconciliation runs the
+same journal-replay code live and simulated. The fault injector is
+the scenario's seeded one.
 """
 from __future__ import annotations
 
@@ -51,6 +54,15 @@ class SimWorld:
         # Fleet hook: called with (replica, jobs) when a replica dies
         # with in-flight work (the LB migration path).
         self.on_replica_killed: Optional[Callable[..., None]] = None
+        # The simulated serve DB (round 15): replica rows, the
+        # lifecycle journal and controller notes live on the WORLD —
+        # not the env — so they survive a simulated controller crash
+        # (env.halt()) and feed the restarted controller's
+        # reconciliation, exactly like the sqlite tables live.
+        self.db_replicas: Dict[int, Dict[str, Any]] = {}
+        self.db_ops: List[Dict[str, Any]] = []
+        self.db_notes: Dict[str, Any] = {}
+        self._db_op_seq = 0
 
     # ------------------------------------------------------------ launch
     def provision_delay(self) -> float:
@@ -74,6 +86,7 @@ class SimWorld:
             is_spot=is_spot,
             gang_id=envs.get('SKYTPU_GANG_ID') or None,
             gang_rank=int(envs.get('SKYTPU_RANK', '0')),
+            gang_world=int(envs.get('SKYTPU_WORLD', '1')),
             tp=int(envs.get('SKYTPU_TP', '1')),
             dp=int(envs.get('SKYTPU_DP', '1')),
             never_drain=never_drain)
@@ -126,6 +139,22 @@ class SimControlPlaneEnv(control_env.ControlPlaneEnv):
         self._seed = seed
         self._injector = injector
         self._rng_count = 0
+        # Simulated controller death (round 15): once halted, every
+        # effect the dead controller's lingering logical tasks try to
+        # run unwinds them (SimShutdown) or becomes a no-op — a dead
+        # process performs no I/O. The WORLD (fleet, virtual DB) lives
+        # on; a restarted controller gets a FRESH env over it.
+        self._halted = False
+
+    def halt(self) -> None:
+        """Kill the controller this env belongs to: its background
+        tasks (drain polls, launches, teardowns) unwind at their next
+        effect, its persistence writes stop landing."""
+        self._halted = True
+
+    def _check_halted(self) -> None:
+        if self._halted:
+            raise sim_core.SimShutdown()
 
     # ---------------------------------------------------------------- time
     def time(self) -> float:
@@ -135,10 +164,14 @@ class SimControlPlaneEnv(control_env.ControlPlaneEnv):
         return self._loop.now
 
     def sleep(self, seconds: float) -> None:
+        self._check_halted()
         self._loop.sleep(seconds)
+        self._check_halted()
 
     # --------------------------------------------------------- concurrency
     def spawn(self, fn: Callable[..., None], *args: Any) -> None:
+        if self._halted:
+            return      # a dead process spawns nothing
         self._loop.spawn(fn, *args,
                          name=getattr(fn, '__name__', 'task'))
 
@@ -156,12 +189,14 @@ class SimControlPlaneEnv(control_env.ControlPlaneEnv):
     def http_json(self, url: str, payload: Optional[Dict[str, Any]] = None,
                   timeout: float = 10.0) -> Any:
         del timeout      # virtual round-trips are instantaneous
+        self._check_halted()
         return self._world.request(url, payload, None)
 
     def http_post_bytes(self, url: str, data: bytes,
                         content_type: str = 'application/octet-stream',
                         timeout: float = 30.0) -> bytes:
         del content_type, timeout
+        self._check_halted()
         out = self._world.request(url, None, data)
         if isinstance(out, bytes):
             return out
@@ -171,6 +206,7 @@ class SimControlPlaneEnv(control_env.ControlPlaneEnv):
     def probe_http(self, url: str, post_data: Optional[Dict[str, Any]],
                    timeout: float) -> bool:
         del timeout
+        self._check_halted()
         try:
             self._world.request(url, post_data, None)
             return True
@@ -179,6 +215,7 @@ class SimControlPlaneEnv(control_env.ControlPlaneEnv):
 
     # ----------------------------------------------------------- clusters
     def launch_cluster(self, task: Any, cluster_name: str) -> None:
+        self._check_halted()
         # Burn the scenario's provision latency on the virtual clock —
         # the forecast autoscaler's lead-time EWMA learns from exactly
         # this (via the manager's provision observations).
@@ -197,6 +234,7 @@ class SimControlPlaneEnv(control_env.ControlPlaneEnv):
         return rep.url.split('//')[1].rsplit(':', 1)[0]
 
     def down_cluster(self, cluster_name: str) -> None:
+        self._check_halted()
         rep = self._world.by_cluster.get(cluster_name)
         if rep is None or cluster_name in self._world._gone_clusters:
             if rep is None:
@@ -209,15 +247,85 @@ class SimControlPlaneEnv(control_env.ControlPlaneEnv):
         return rep is None or not rep.alive
 
     # -------------------------------------------------------- persistence
+    # The virtual serve DB lives on the WORLD (never the operator's
+    # sqlite): rows, journal ops and notes survive env.halt() so a
+    # restarted simulated controller reconciles against exactly what
+    # the dead one persisted.
     def persist_replica(self, service_name: str, replica_id: int,
                         cluster_name: str, status: Any,
                         url: Optional[str], version: int, is_spot: bool,
                         port: int) -> None:
-        del (service_name, replica_id, cluster_name, status, url,
-             version, is_spot, port)
+        del service_name
+        if self._halted:
+            return      # a dead process writes nothing
+        self._world.db_replicas[replica_id] = {
+            'replica_id': replica_id,
+            'cluster_name': cluster_name,
+            'status': status,
+            'url': url,
+            'version': version,
+            'is_spot': is_spot,
+            'launched_at': self._loop.now,
+            'port': port,
+        }
 
     def remove_replica(self, service_name: str, replica_id: int) -> None:
-        del service_name, replica_id
+        del service_name
+        if self._halted:
+            return
+        self._world.db_replicas.pop(replica_id, None)
+
+    def load_replica_rows(self, service_name: str
+                          ) -> List[Dict[str, Any]]:
+        del service_name
+        return [dict(self._world.db_replicas[rid])
+                for rid in sorted(self._world.db_replicas)]
+
+    # ----------------------------------------------------------- journal
+    def journal_op_start(self, service_name: str, kind: str,
+                         replica_id: int, gang_id: Optional[str],
+                         payload: Optional[Dict[str, Any]] = None,
+                         deadline_at: Optional[float] = None) -> int:
+        del service_name
+        self._check_halted()
+        self._world._db_op_seq += 1
+        op_id = self._world._db_op_seq
+        self._world.db_ops.append({
+            'op_id': op_id, 'kind': kind, 'replica_id': replica_id,
+            'gang_id': gang_id, 'payload': dict(payload or {}),
+            'started_at': self._loop.now, 'deadline_at': deadline_at,
+            'state': 'pending',
+        })
+        return op_id
+
+    def journal_op_finish(self, service_name: str, op_id: int) -> None:
+        del service_name
+        if self._halted:
+            return
+        self._world.db_ops = [op for op in self._world.db_ops
+                              if op['op_id'] != op_id]
+
+    def pending_ops(self, service_name: str) -> List[Dict[str, Any]]:
+        del service_name
+        return [dict(op) for op in self._world.db_ops
+                if op['state'] == 'pending']
+
+    # ------------------------------------------------------------- notes
+    def put_note(self, service_name: str, key: str, value: Any) -> None:
+        del service_name
+        if self._halted:
+            return
+        self._world.db_notes[key] = value
+
+    def del_note(self, service_name: str, key: str) -> None:
+        del service_name
+        if self._halted:
+            return
+        self._world.db_notes.pop(key, None)
+
+    def get_notes(self, service_name: str) -> Dict[str, Any]:
+        del service_name
+        return dict(self._world.db_notes)
 
     # -------------------------------------------------------------- faults
     def fault_injector(self) -> Optional[faults_lib.FaultInjector]:

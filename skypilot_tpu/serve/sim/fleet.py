@@ -43,8 +43,13 @@ from skypilot_tpu.serve.sim import traffic as sim_traffic
 # Sim fault sites the storm callback evaluates, in a fixed order (the
 # order is part of the determinism contract). ``sim_gray`` carries the
 # gray-failure kinds: wedged_step / nan_logits / byzantine_response.
+# ``sim_controller`` carries the control-plane kinds:
+# controller_crash (the controller's env halts — its tasks unwind, its
+# writes stop, the LB serves stale) and controller_restart (a fresh
+# ServeController boots with recover=True over the same world and
+# reconciles the orphaned fleet).
 SIM_FAULT_SITES = ('sim_storm', 'sim_zone_outage', 'sim_straggler',
-                   'sim_gang_churn', 'sim_gray')
+                   'sim_gang_churn', 'sim_gray', 'sim_controller')
 
 # Per-tier TTFT SLO targets (seconds) — what "attainment" means.
 DEFAULT_SLO_TTFT = {'latency': 2.0, 'throughput': 10.0}
@@ -107,6 +112,8 @@ class FleetSimulator:
         self.injector = (faults_lib.FaultInjector(fault_spec)
                          if fault_spec and fault_spec.get('rules')
                          else None)
+        self.canary_s = canary_s
+        self.service_name = service_name
         self.env = sim_env.SimControlPlaneEnv(self.world, seed=seed,
                                               injector=self.injector)
         self.controller = controller_lib.ServeController(
@@ -136,6 +143,11 @@ class FleetSimulator:
         self.chip_seconds = 0.0
         self.peak_ready = 0
         self.ready_now = 0
+        # Controller failure-domain bookkeeping (round 15).
+        self._controller_down = False
+        self.controller_crashes = 0
+        self.controller_restarts = 0
+        self.reconcile_stats: Dict[str, int] = {}
         self._inflight = 0
         self._retry_q: List[Tuple[int, str, float, float,
                                   Optional[float]]] = []
@@ -160,12 +172,74 @@ class FleetSimulator:
                 self._log_truncated = True
 
     # ------------------------------------------------------- control loop
-    def _controller_loop(self) -> None:
+    def _controller_loop(self, controller, env) -> None:
+        """One controller process's tick loop: bound to ITS controller
+        and env, so a crash (env halt) unwinds exactly this loop and a
+        restarted controller gets a fresh one."""
         while not self._stop:
-            self.controller.tick(sync_state=False)
-            self.env.sleep(self.tick_s)
+            controller.tick(sync_state=False)
+            env.sleep(self.tick_s)
+
+    def _crash_controller(self) -> None:
+        if self._controller_down:
+            return
+        self.controller_crashes += 1
+        self._controller_down = True
+        # Halt the dead controller's env: its tick loop and every
+        # background task (drain polls, launches, teardowns) unwind at
+        # their next effect; its persistence writes stop landing. The
+        # WORLD — live replicas, virtual serve DB — survives.
+        self.env.halt()
+        self._log('ctrl_crash', f'ready_at_crash={self.ready_now}')
+
+    def _restart_controller(self) -> None:
+        if not self._controller_down:
+            return
+        self.controller_restarts += 1
+        # A fresh process: new env over the SAME world (the virtual
+        # serve DB it reconciles from), deterministic RNG stream keyed
+        # by the restart ordinal.
+        self.env = sim_env.SimControlPlaneEnv(
+            self.world, seed=self.seed + 7919 * self.controller_restarts,
+            injector=self.injector)
+        self.controller = controller_lib.ServeController(
+            self.service_name, self.spec,
+            {'resources': {'cloud': 'sim'}}, port=1, env=self.env,
+            recover=True)
+        if self.canary_s > 0:
+            self.controller.replica_manager.configure_canary(
+                self.canary_s)
+        stats = dict(self.controller.last_reconcile)
+        for key, val in stats.items():
+            self.reconcile_stats[key] = (
+                self.reconcile_stats.get(key, 0) + val)
+        self._controller_down = False
+        self._log('ctrl_restart',
+                  'reconciled=' + ','.join(
+                      f'{k}:{v}' for k, v in sorted(stats.items())
+                      if v))
+        self.loop.spawn(self._controller_loop, self.controller,
+                        self.env, name='controller')
 
     def _lb_sync(self) -> None:
+        if self._controller_down:
+            # Stale-while-revalidate: the sync fails, the LB keeps
+            # serving its last-synced view (dead replicas leave it
+            # through the dispatch loop's local eviction — the
+            # transparent-retry exclusion), and the arrival signal
+            # queues BOUNDED for when the controller returns.
+            if len(self._pending_ts) > 100_000:
+                self._pending_ts = self._pending_ts[-100_000:]
+                self._pending_tiers = self._pending_tiers[-100_000:]
+            self._log('sync_stale', f'ready={self.ready_now}')
+            self.chip_seconds += (self.ready_now
+                                  * self.controller.replica_manager
+                                  .parallelism_plan().chips
+                                  * self.sync_s)
+            self._drain_retry_queue()
+            if not self._stop:
+                self.loop.schedule(self.sync_s, self._lb_sync)
+            return
         mgr = self.controller.replica_manager
         urls = mgr.ready_urls()
         self.policy.set_ready_replicas(urls)
@@ -355,6 +429,11 @@ class FleetSimulator:
                     break
         elif site == 'sim_gray':
             self._apply_gray_fault(rule, live)
+        elif site == 'sim_controller':
+            if rule.kind == 'controller_crash':
+                self._crash_controller()
+            elif rule.kind == 'controller_restart':
+                self._restart_controller()
 
     def _apply_gray_fault(self, rule: faults_lib.FaultRule,
                           live) -> None:
@@ -403,7 +482,8 @@ class FleetSimulator:
         return self._inflight + sum(c for c, *_ in self._retry_q)
 
     def run(self) -> Dict[str, Any]:
-        self.loop.spawn(self._controller_loop, name='controller')
+        self.loop.spawn(self._controller_loop, self.controller,
+                        self.env, name='controller')
         self.loop.schedule(0.0, self._lb_sync)
         self._start_arrivals()
         if self.injector is not None and any(
@@ -474,6 +554,12 @@ class FleetSimulator:
                                 .target_num_replicas,
                 'tracked_final': len(mgr.replicas()),
                 'quarantined': mgr.quarantined_count,
+            },
+            'controller': {
+                'crashes': self.controller_crashes,
+                'restarts': self.controller_restarts,
+                'reconciled': dict(sorted(
+                    self.reconcile_stats.items())),
             },
             'faults_fired': faults_fired,
             'events': self._n_events,

@@ -152,7 +152,8 @@ class SimReplica:
                  now_fn: Callable[[], float], *,
                  role: str = 'colocated', zone: str = 'z0',
                  is_spot: bool = False, gang_id: Optional[str] = None,
-                 gang_rank: int = 0, tp: int = 1, dp: int = 1,
+                 gang_rank: int = 0, gang_world: int = 1,
+                 tp: int = 1, dp: int = 1,
                  never_drain: bool = False):
         self.cluster_name = cluster_name
         self.url = url
@@ -163,6 +164,7 @@ class SimReplica:
         self.is_spot = is_spot
         self.gang_id = gang_id
         self.gang_rank = gang_rank
+        self.gang_world = gang_world
         self.tp = tp
         self.dp = dp
         self.alive = True
@@ -324,4 +326,11 @@ class SimReplica:
                 'mesh': {'tp': self.tp, 'dp': self.dp},
                 'disagg': {'role': self.role},
             }
+        if path == '/gang/status':
+            # Adoption probe surface (round 15): a restarted manager
+            # recovers gang identity from the live replica.
+            if self.gang_id is None:
+                raise SimHTTPError(404, 'not a gang member')
+            return {'gang_id': self.gang_id, 'rank': self.gang_rank,
+                    'world': self.gang_world}
         raise SimHTTPError(404, f'no route {path}')

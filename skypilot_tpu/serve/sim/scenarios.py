@@ -306,6 +306,37 @@ _register(Scenario(
 ))
 
 _register(Scenario(
+    name='controller_crash_storm',
+    description='Controller crash-safety: a spot storm kills 2 '
+                'replicas, the CONTROLLER then dies mid-recovery '
+                '(env halted: its drains/launches unwind, its writes '
+                'stop), the LB serves stale-while-revalidate for 60 '
+                'virtual seconds, and a fresh controller boots with '
+                'recover=True — journal replay must ADOPT the '
+                'surviving fleet (never relaunch it, never tear a '
+                'replica down twice), then a second storm proves the '
+                'recovered control plane still heals. Zero lost; '
+                'same-seed byte-identical.',
+    spec_fn=lambda: _spec(
+        min_replicas=6, max_replicas=10, target_qps_per_replica=2.0,
+        base_ondemand_fallback_replicas=2,
+        dynamic_ondemand_fallback=True),
+    trace_fn=lambda: sim_traffic.constant(8.0, 600.0),
+    fault_rules=[
+        {'kind': 'preempt_signal', 'site': 'sim_storm', 'at': 8,
+         'n': 2},
+        {'kind': 'controller_crash', 'site': 'sim_controller',
+         'at': 10},
+        {'kind': 'controller_restart', 'site': 'sim_controller',
+         'at': 16},
+        {'kind': 'preempt_signal', 'site': 'sim_storm', 'at': 30,
+         'n': 2},
+    ],
+    sim_kwargs=dict(provision_s=25.0, storm_dt=10.0,
+                    drain_grace_s=400.0),
+))
+
+_register(Scenario(
     name='flash_crowd',
     description='Flash crowd: traffic steps 6x with no seasonal '
                 'precedent — only the trend term can chase it; '

@@ -1031,3 +1031,90 @@ def test_gc118_every_live_fire_site_is_registered():
         assert site in faults_lib.FAULT_SITES, site
     for kind in faults_lib.GRAY_FAILURE_KINDS:
         assert kind in faults_lib.FAULT_KINDS, kind
+
+
+# ------------------------------------------------------------------ GC120
+def test_gc120_direct_row_write_flagged():
+    # A serve_state row write outside the journaled persist helpers.
+    src = '''
+    from skypilot_tpu.serve import serve_state
+    class ReplicaManager:
+        def scale_up(self):
+            serve_state.add_or_update_replica('svc', 1, 'c', 'READY',
+                                              None, 1, False)
+    '''
+    vs = check(src, 'skypilot_tpu/serve/replica_managers.py')
+    assert [v.rule for v in vs] == ['GC120']
+    assert 'add_or_update_replica' in vs[0].message
+
+
+def test_gc120_env_seam_write_flagged():
+    # The env-seam spelling of the same mutation is gated too — the
+    # journal invariant is about the WRITE, not the module it routes
+    # through.
+    src = '''
+    class ReplicaManager:
+        def probe_all(self):
+            self._env.persist_replica('svc', 1, 'c', 'READY', None,
+                                      1, False, 8081)
+        def tick(self):
+            self._env.put_note('svc', 'k', 1)
+    '''
+    assert rule_ids(src, 'skypilot_tpu/serve/controller.py') == [
+        'GC120', 'GC120']
+
+
+def test_gc120_journaled_helpers_clean():
+    # Inside the sanctioned helper scopes (nested closures included)
+    # the same calls are THE implementation, not a violation; reads
+    # are never gated.
+    src = '''
+    class ReplicaManager:
+        def _persist(self, info):
+            self._env.persist_replica('svc', 1, 'c', 'READY', None,
+                                      1, False, 8081)
+        def _untrack(self, rid):
+            self._env.remove_replica('svc', rid)
+        def _journal_start(self, kind, info):
+            return self._env.journal_op_start('svc', kind, 1, None)
+        def _journal_finish(self, op_id):
+            self._env.journal_op_finish('svc', op_id)
+        def _put_note(self, key, value):
+            self._env.put_note('svc', key, value)
+        def _persist_autoscaler_state(self):
+            def retry():
+                self._env.put_note('svc', 'autoscaler_state', {})
+            retry()
+        def reconcile(self):
+            rows = self._env.load_replica_rows('svc')
+            ops = self._env.pending_ops('svc')
+            notes = self._env.get_notes('svc')
+            return rows, ops, notes
+    '''
+    assert rule_ids(src, 'skypilot_tpu/serve/replica_managers.py') == []
+
+
+def test_gc120_only_polices_lifecycle_modules():
+    # control_env.py (the seam's live implementation) and everything
+    # else keep calling serve_state directly — the rule gates the
+    # state machines, not the seam.
+    src = '''
+    from skypilot_tpu.serve import serve_state
+    def persist_replica(service_name, replica_id):
+        serve_state.add_or_update_replica(service_name, replica_id,
+                                          'c', 'READY', None, 1, False)
+    '''
+    assert 'GC120' not in rule_ids(src,
+                                   'skypilot_tpu/serve/control_env.py')
+    assert 'GC120' not in rule_ids(src, 'skypilot_tpu/serve/rpc.py')
+
+
+def test_gc120_journal_kinds_registered():
+    # The manager only journals kinds serve_state validates — a typo'd
+    # kind would raise at journal time, never silently no-op.
+    from skypilot_tpu.serve import serve_state
+    for kind in ('launch', 'drain', 'teardown'):
+        assert kind in serve_state.JOURNAL_OP_KINDS
+    import pytest as _pytest
+    with _pytest.raises(ValueError, match='unknown journal op kind'):
+        serve_state.journal_op_start('svc', 'meteor', 1, None)

@@ -1,0 +1,986 @@
+"""Controller crash-safety (round 15): journaled lifecycle state,
+restart reconciliation with orphan-replica adoption, and LB autonomy
+during a controller outage.
+
+The contract under test: kill the controller at ANY point and bring a
+new one up — zero requests lost, zero replicas torn down twice, every
+healthy replica ADOPTED (never relaunched), interrupted drains resumed
+at their *remaining* deadline, unacked teardowns replayed exactly
+once, zombie clusters reaped, and the LB serving its last-synced view
+(stale-while-revalidate, local dead-replica eviction) the whole time.
+"""
+import json
+import random
+import threading
+import time
+import urllib.request
+
+import pytest
+
+from skypilot_tpu import exceptions
+from skypilot_tpu.serve import control_env
+from skypilot_tpu.serve import replica_managers
+from skypilot_tpu.serve import serve_state
+from skypilot_tpu.serve.replica_managers import ReplicaInfo
+from skypilot_tpu.serve.replica_managers import ReplicaManager
+from skypilot_tpu.serve.service_spec import SkyServiceSpec
+from skypilot_tpu.utils import common_utils
+
+ReplicaStatus = serve_state.ReplicaStatus
+
+
+# ---------------------------------------------------------------- helpers
+class _FakeEnv(control_env.ControlPlaneEnv):
+    """Dict-backed ControlPlaneEnv: a virtual clock, a scripted
+    replica HTTP surface, recorded cluster ops, and a persistence
+    layer that survives "controller restarts" (new managers over the
+    same env — the env IS the serve DB here)."""
+
+    name = 'fake'
+
+    def __init__(self):
+        self.now = 1000.0
+        self.rows = {}
+        self.ops = []
+        self.notes = {}
+        self._op_seq = 0
+        self.spawned = []        # (fn, args) — inspect or run later
+        self.run_spawns = True   # False = "the thread died with us"
+        self.launches = []
+        self.downs = []
+        self.gone = set()        # cluster names whose cluster is gone
+        self.http = {}           # path -> payload (or Exception)
+        self.posts = []          # recorded http_post_bytes paths
+        self.post_responses = {}
+        self.probe_ok = set()    # base urls whose readiness passes
+
+    # ------------------------------------------------------------- time
+    def time(self):
+        return self.now
+
+    def monotonic(self):
+        return self.now
+
+    def sleep(self, seconds):
+        self.now += seconds
+
+    def spawn(self, fn, *args):
+        self.spawned.append((fn, args))
+        if self.run_spawns:
+            fn(*args)
+
+    def run_parallel(self, fns):
+        for fn in fns:
+            fn()
+
+    def rng(self):
+        return random.Random(0)
+
+    # ------------------------------------------------------------- HTTP
+    @staticmethod
+    def _path(url):
+        return '/' + url.split('/', 3)[3]
+
+    def http_json(self, url, payload=None, timeout=10.0):
+        del timeout
+        path = self._path(url)
+        key = (path, 'POST' if payload is not None else 'GET')
+        resp = self.http.get(key, self.http.get(path))
+        if resp is None:
+            raise ConnectionRefusedError(f'no handler for {key}')
+        if isinstance(resp, Exception):
+            raise resp
+        return resp
+
+    def http_post_bytes(self, url, data, content_type='x',
+                        timeout=30.0):
+        del content_type, timeout
+        path = self._path(url)
+        self.posts.append(path)
+        resp = self.post_responses.get(path)
+        if resp is None:
+            raise ConnectionRefusedError(f'no POST handler for {path}')
+        if isinstance(resp, Exception):
+            raise resp
+        return resp
+
+    def probe_http(self, url, post_data, timeout):
+        del post_data, timeout
+        return any(url.startswith(base) for base in self.probe_ok)
+
+    # ---------------------------------------------------------- clusters
+    def launch_cluster(self, task, cluster_name):
+        self.launches.append(cluster_name)
+
+    def cluster_head_ip(self, cluster_name):
+        return '127.0.0.1'
+
+    def down_cluster(self, cluster_name):
+        self.downs.append(cluster_name)
+        if cluster_name in self.gone:
+            raise exceptions.ClusterDoesNotExist(cluster_name)
+
+    def cluster_gone(self, cluster_name):
+        return cluster_name in self.gone
+
+    # ------------------------------------------------------- persistence
+    def persist_replica(self, service_name, replica_id, cluster_name,
+                        status, url, version, is_spot, port):
+        del service_name
+        self.rows[replica_id] = {
+            'replica_id': replica_id, 'cluster_name': cluster_name,
+            'status': status, 'url': url, 'version': version,
+            'is_spot': is_spot, 'launched_at': self.now, 'port': port,
+        }
+
+    def remove_replica(self, service_name, replica_id):
+        del service_name
+        self.rows.pop(replica_id, None)
+
+    def load_replica_rows(self, service_name):
+        del service_name
+        return [dict(self.rows[rid]) for rid in sorted(self.rows)]
+
+    def journal_op_start(self, service_name, kind, replica_id,
+                         gang_id, payload=None, deadline_at=None):
+        del service_name
+        self._op_seq += 1
+        self.ops.append({
+            'op_id': self._op_seq, 'kind': kind,
+            'replica_id': replica_id, 'gang_id': gang_id,
+            'payload': dict(payload or {}),
+            'started_at': self.now, 'deadline_at': deadline_at,
+            'state': 'pending'})
+        return self._op_seq
+
+    def journal_op_finish(self, service_name, op_id):
+        del service_name
+        self.ops = [op for op in self.ops if op['op_id'] != op_id]
+
+    def pending_ops(self, service_name):
+        del service_name
+        return [dict(op) for op in self.ops]
+
+    def put_note(self, service_name, key, value):
+        del service_name
+        self.notes[key] = value
+
+    def del_note(self, service_name, key):
+        del service_name
+        self.notes.pop(key, None)
+
+    def get_notes(self, service_name):
+        del service_name
+        return dict(self.notes)
+
+    def fault_injector(self):
+        return None
+
+
+def _spec(**kw):
+    kw.setdefault('readiness_path', '/readiness')
+    return SkyServiceSpec(**kw)
+
+
+def _mgr(env, **spec_kw):
+    return ReplicaManager('svc', _spec(**spec_kw), {}, env=env)
+
+
+def _seed_replica(mgr, rid, status, url='http://10.0.0.{rid}:8081',
+                  port=None, is_spot=False):
+    """Build the state a live manager would have persisted before the
+    'crash': an in-memory info + its row, through the journaled
+    helpers (the same code path the real flows use)."""
+    info = ReplicaInfo(rid, f'svc-replica-{rid}', 1, is_spot,
+                       port if port is not None else 8000 + rid)
+    info.url = url.format(rid=rid)
+    info.status = status
+    with mgr._lock:
+        mgr._replicas[rid] = info
+        mgr._next_replica_id = max(mgr._next_replica_id, rid + 1)
+    mgr._persist(info)
+    return info
+
+
+# --------------------------------------------------------- WAL satellite
+def test_serve_state_sqlite_wal_and_busy_timeout(tmp_path, monkeypatch):
+    monkeypatch.setenv('SKYTPU_SERVE_DIR', str(tmp_path / 'serve'))
+    conn = serve_state._conn()
+    mode = conn.execute('PRAGMA journal_mode').fetchone()[0]
+    assert mode == 'wal'
+    assert conn.execute('PRAGMA busy_timeout').fetchone()[0] == \
+        serve_state.BUSY_TIMEOUT_MS
+
+
+def test_jobs_state_sqlite_wal_and_busy_timeout(tmp_path, monkeypatch):
+    monkeypatch.setenv('SKYTPU_MANAGED_JOBS_DIR', str(tmp_path / 'jobs'))
+    from skypilot_tpu.jobs import state as jobs_state
+    conn = jobs_state._conn()
+    assert conn.execute('PRAGMA journal_mode').fetchone()[0] == 'wal'
+    assert conn.execute('PRAGMA busy_timeout').fetchone()[0] == 10000
+
+
+# -------------------------------------------------------- journal (live)
+def test_lifecycle_journal_roundtrip(tmp_path, monkeypatch):
+    monkeypatch.setenv('SKYTPU_SERVE_DIR', str(tmp_path / 'serve'))
+    op1 = serve_state.journal_op_start(
+        'svc', 'drain', 3, None, {'deadline_s': 30.0},
+        deadline_at=1234.5)
+    op2 = serve_state.journal_op_start('svc', 'teardown', 4, 'g-1')
+    pending = serve_state.pending_ops('svc')
+    assert [p['op_id'] for p in pending] == [op1, op2]
+    assert pending[0]['kind'] == 'drain'
+    assert pending[0]['deadline_at'] == 1234.5
+    assert pending[0]['payload'] == {'deadline_s': 30.0}
+    assert pending[1]['gang_id'] == 'g-1'
+    serve_state.journal_op_finish('svc', op1)
+    assert [p['op_id'] for p in serve_state.pending_ops('svc')] == [op2]
+    # Other services are isolated.
+    assert serve_state.pending_ops('other') == []
+    # Strict kind validation (a typo'd kind must never silently
+    # journal an op no replay branch handles).
+    with pytest.raises(ValueError, match='unknown journal op kind'):
+        serve_state.journal_op_start('svc', 'lunch', 1, None)
+    # Notes round-trip JSON values.
+    serve_state.put_note('svc', 'ckpt_done:g-1', True)
+    serve_state.put_note('svc', 'autoscaler_state', {'t': 3})
+    assert serve_state.get_notes('svc') == {
+        'ckpt_done:g-1': True, 'autoscaler_state': {'t': 3}}
+    serve_state.del_note('svc', 'ckpt_done:g-1')
+    assert 'ckpt_done:g-1' not in serve_state.get_notes('svc')
+    # Seeding helpers see rows AND journal history.
+    serve_state.add_or_update_replica(
+        'svc', 7, 'c7', ReplicaStatus.READY, 'http://x:1', 1,
+        port=10007)
+    assert serve_state.max_replica_id('svc') == 7
+    assert serve_state.replica_ports('svc') == {10007}
+    # remove_service clears journal + notes with the rows.
+    serve_state.add_service('svc', {}, 1, 2)
+    serve_state.remove_service('svc')
+    assert serve_state.pending_ops('svc') == []
+    assert serve_state.get_notes('svc') == {}
+
+
+# ------------------------------------------------- reconciliation matrix
+def test_reconcile_adopts_healthy_replica_without_relaunch():
+    env = _FakeEnv()
+    mgr1 = _mgr(env)
+    info = _seed_replica(mgr1, 3, ReplicaStatus.READY)
+    env.probe_ok.add(info.url)
+    env.http['/metrics?format=json'] = {'disagg': {'role': 'decode'}}
+    # --- controller restarts: a fresh manager over the same DB.
+    mgr2 = _mgr(env)
+    stats = mgr2.reconcile()
+    assert stats['adopted'] == 1
+    assert sum(stats.values()) == 1
+    assert mgr2.ready_urls() == [info.url]
+    adopted = mgr2.replicas()[0]
+    assert adopted.replica_id == 3
+    assert adopted.role == 'decode'       # recovered from the live probe
+    assert adopted.warmed                 # never re-warmed over live KV
+    assert env.launches == [] and env.downs == []
+    # The counter moved.
+    from skypilot_tpu import telemetry
+    assert telemetry.get_registry().get(
+        'skytpu_replicas_adopted_total', outcome='adopted').value >= 1
+
+
+def test_reconcile_adopt_recovers_gang_identity():
+    env = _FakeEnv()
+    mgr1 = _mgr(env)
+    info = _seed_replica(mgr1, 5, ReplicaStatus.READY)
+    env.probe_ok.add(info.url)
+    env.http['/gang/status'] = {'gang_id': 'svc-gang-5-v1', 'rank': 0,
+                                'world': 2}
+    mgr2 = _mgr(env)
+    assert mgr2.reconcile()['adopted'] == 1
+    adopted = mgr2.replicas()[0]
+    assert adopted.gang_id == 'svc-gang-5-v1'
+    assert adopted.gang_world == 2
+
+
+def test_reconcile_resumes_drain_at_remaining_deadline():
+    env = _FakeEnv()
+    mgr1 = _mgr(env)
+    info = _seed_replica(mgr1, 2, ReplicaStatus.READY)
+    env.probe_ok.add(info.url)
+    # The drain starts (journal + DRAINING row) but its thread "dies
+    # with the controller" before doing anything.
+    env.run_spawns = False
+    assert mgr1.drain(2, deadline_s=30.0) is True
+    assert env.rows[2]['status'] == ReplicaStatus.DRAINING
+    (op,) = env.pending_ops('svc')
+    assert op['kind'] == 'drain'
+    assert op['deadline_at'] == pytest.approx(env.now + 30.0)
+    env.sleep(12.0)          # outage: 12 s of the deadline burn away
+    env.spawned.clear()
+    mgr2 = _mgr(env)
+    stats = mgr2.reconcile()
+    assert stats['drain_resumed'] == 1
+    (fn, args) = env.spawned[-1]
+    assert fn.__name__ == '_drain_then_down'
+    assert args[1] == pytest.approx(18.0)      # REMAINING, not 30
+    # Run the resumed drain to completion: replica acks, drains,
+    # tears down once, journal empties.
+    env.http[('/drain', 'POST')] = {'draining': True, 'inflight': 1}
+    env.http[('/drain', 'GET')] = {'draining': True, 'drained': True,
+                                   'inflight': 0}
+    env.run_spawns = True
+    fn(*args)
+    assert env.downs == ['svc-replica-2']
+    assert env.rows == {} and env.pending_ops('svc') == []
+
+
+def test_reconcile_replays_unacked_teardown_exactly_once():
+    env = _FakeEnv()
+    mgr1 = _mgr(env)
+    info = _seed_replica(mgr1, 4, ReplicaStatus.READY)
+    # Crash between the teardown journal write and the teardown
+    # itself: SHUTTING_DOWN row + pending op, no _down ever ran.
+    env.run_spawns = False
+    mgr1._scale_down_one(4)
+    assert env.rows[4]['status'] == ReplicaStatus.SHUTTING_DOWN
+    assert env.pending_ops('svc')[0]['kind'] == 'teardown'
+    env.run_spawns = True
+    mgr2 = _mgr(env)
+    stats = mgr2.reconcile()
+    assert stats['teardown_replayed'] == 1
+    assert env.downs == ['svc-replica-4']      # exactly once
+    assert env.rows == {} and env.pending_ops('svc') == []
+    del info
+    # A third boot finds nothing: replay is idempotent, not repeated.
+    mgr3 = _mgr(env)
+    assert sum(mgr3.reconcile().values()) == 0
+    assert env.downs == ['svc-replica-4']
+
+
+def test_reconcile_kills_zombie_clusters_from_crashed_launches():
+    env = _FakeEnv()
+    mgr1 = _mgr(env)
+    # A launch that crashed mid-flight: PROVISIONING row + pending
+    # launch op (scale_up journals before it spawns).
+    env.run_spawns = False
+    rid = mgr1.scale_up()
+    assert env.rows[rid]['status'] == ReplicaStatus.PROVISIONING
+    assert env.pending_ops('svc')[0]['kind'] == 'launch'
+    # And a launch the journal recorded but whose row write was lost.
+    env.journal_op_start('svc', 'launch', 99, None,
+                         {'cluster_name': 'svc-replica-99'})
+    env.run_spawns = True
+    mgr2 = _mgr(env)
+    stats = mgr2.reconcile()
+    assert stats['zombie_killed'] == 2
+    assert sorted(env.downs) == [f'svc-replica-{rid}',
+                                 'svc-replica-99']
+    assert env.rows == {} and env.pending_ops('svc') == []
+    assert env.launches == []          # reconcile never launches
+
+
+def test_reconcile_marks_replicas_lost_during_outage_preempted():
+    env = _FakeEnv()
+    mgr1 = _mgr(env)
+    info = _seed_replica(mgr1, 6, ReplicaStatus.READY, is_spot=True)
+    env.gone.add(info.cluster_name)    # vanished during the outage
+    mgr2 = _mgr(env)
+    stats = mgr2.reconcile()
+    assert stats['preempted'] == 1
+    assert env.downs == [info.cluster_name]
+    assert env.rows == {} and env.pending_ops('svc') == []
+
+
+def test_reconcile_unprobeable_replica_reenters_starting_grace():
+    env = _FakeEnv()
+    mgr1 = _mgr(env)
+    info = _seed_replica(mgr1, 8, ReplicaStatus.READY)
+    # Cluster alive, app not answering (it may be rebooting).
+    mgr2 = _mgr(env)
+    stats = mgr2.reconcile()
+    assert stats['probe_pending'] == 1
+    again = mgr2.replicas()[0]
+    assert again.status == ReplicaStatus.STARTING
+    assert again.first_probe_time == env.now
+    assert env.downs == []             # NOT killed: grace window owns it
+    del info
+
+
+def test_reconcile_restores_canary_digest_and_ckpt_dedupe():
+    env = _FakeEnv()
+    mgr1 = _mgr(env)
+    mgr1.configure_canary(1.0)
+    info = _seed_replica(mgr1, 3, ReplicaStatus.READY)
+    env.http[('/generate', 'POST')] = {'tokens': [5, 7, 11]}
+    env.sleep(2.0)
+    assert mgr1._canary_check(info) is False     # learns the reference
+    digest = replica_managers.canary_digest([5, 7, 11])
+    assert env.notes[f'canary_digest:v1'] == digest
+    # Checkpoint-once dedupe key persisted alongside.
+    env.post_responses['/checkpoint'] = b'SKCKblob'
+    mgr1._checkpoint_replica(info)
+    assert env.notes['ckpt_done:replica-3'] is True
+    assert env.posts == ['/checkpoint']
+    # --- restart
+    env.probe_ok.add(info.url)
+    mgr2 = _mgr(env)
+    mgr2.configure_canary(1.0)
+    mgr2.reconcile()
+    assert mgr2._canary_learned == digest
+    # A warning re-delivered after the restart must NOT re-checkpoint.
+    mgr2._checkpoint_replica(mgr2.replicas()[0])
+    assert env.posts == ['/checkpoint']
+    # ... and a byzantine answer is judged against the RESTORED
+    # reference, not relearned from the byzantine first answerer.
+    env.http[('/generate', 'POST')] = {'tokens': [9, 9, 9]}
+    env.sleep(2.0)
+    assert mgr2._canary_check(mgr2.replicas()[0]) is True  # quarantined
+
+
+def test_reconcile_seeds_replica_id_counter_and_ports():
+    env = _FakeEnv()
+    mgr1 = _mgr(env)
+    _seed_replica(mgr1, 3, ReplicaStatus.READY, port=10003)
+    _seed_replica(mgr1, 7, ReplicaStatus.READY, port=10007)
+    mgr2 = _mgr(env)
+    assert mgr2._next_replica_id == 1       # the restart collision bug
+    mgr2.reconcile()
+    assert mgr2._next_replica_id == 8
+    assert {10003, 10007} <= mgr2._reserved_ports
+    env.run_spawns = False
+    assert mgr2.scale_up() == 8             # never a duplicate id
+
+
+def test_double_scale_down_tears_down_once():
+    env = _FakeEnv()
+    mgr = _mgr(env)
+    info = _seed_replica(mgr, 1, ReplicaStatus.READY)
+    mgr._scale_down_one(1)
+    mgr._scale_down_one(1)                 # racing second decision
+    mgr.scale_down(1)
+    assert env.downs == [info.cluster_name]
+
+
+# ------------------------------------------- autoscaler/forecaster state
+def test_autoscaler_state_snapshot_roundtrip():
+    from skypilot_tpu.serve import autoscalers as asc_lib
+    t = [10_000.0]
+    spec = _spec(min_replicas=1, max_replicas=10,
+                 target_qps_per_replica=2.0, forecast_enabled=True,
+                 forecast_bucket_seconds=10.0,
+                 forecast_season_seconds=300.0,
+                 forecast_horizon_seconds=60.0)
+    asc1 = asc_lib.Autoscaler.from_spec(spec, clock=lambda: t[0])
+    assert isinstance(asc1, asc_lib.ForecastRequestRateAutoscaler)
+    asc1.collect_request_information(
+        [t[0] - 40 + i * 0.2 for i in range(200)])
+    asc1.note_provision_seconds(42.0)
+    asc1.target_num_replicas = 5
+    state = json.loads(json.dumps(asc1.export_state()))  # wire trip
+    asc2 = asc_lib.Autoscaler.from_spec(spec, clock=lambda: t[0])
+    asc2.restore_state(state)
+    assert asc2.target_num_replicas == 5
+    assert asc2._lead_s == pytest.approx(42.0)
+    assert asc2.forecaster.forecast_qps(60.0, now=t[0]) == \
+        pytest.approx(asc1.forecaster.forecast_qps(60.0, now=t[0]))
+    # Restore clamps to the CURRENT spec bounds (an update between
+    # crash and restart must win over the stale snapshot).
+    asc3 = asc_lib.Autoscaler.from_spec(
+        _spec(min_replicas=1, max_replicas=3,
+              target_qps_per_replica=2.0), clock=lambda: t[0])
+    asc3.restore_state(state)
+    assert asc3.target_num_replicas == 3
+
+
+def test_controller_recover_restores_autoscaler_and_counts_restart():
+    from skypilot_tpu.serve import controller as controller_lib
+    env = _FakeEnv()
+    spec = _spec(min_replicas=1, max_replicas=10,
+                 target_qps_per_replica=2.0)
+    env.run_spawns = False
+    c1 = controller_lib.ServeController('svc', spec, {}, port=1,
+                                        env=env)
+    c1.autoscaler.target_num_replicas = 6
+    c1._persist_autoscaler_state()
+    info = None
+    mgr1 = c1.replica_manager
+    info = _seed_replica(mgr1, 1, ReplicaStatus.READY)
+    env.probe_ok.add(info.url)
+    # --- restart
+    c2 = controller_lib.ServeController('svc', spec, {}, port=1,
+                                        env=env, recover=True)
+    assert c2.autoscaler.target_num_replicas == 6
+    assert c2.last_reconcile['adopted'] == 1
+    # A fresh boot over an EMPTY db is a no-op and not a "restart".
+    from skypilot_tpu import telemetry
+    restarts = telemetry.get_registry().get(
+        'skytpu_controller_restarts_total')
+    before = restarts.value
+    empty = _FakeEnv()
+    empty.run_spawns = False
+    c3 = controller_lib.ServeController('svc2', spec, {}, port=1,
+                                        env=empty, recover=True)
+    assert sum(c3.last_reconcile.values()) == 0
+    assert restarts.value == before
+
+
+def test_injected_controller_crash_kind_validates():
+    from skypilot_tpu.serve import faults as faults_lib
+    inj = faults_lib.FaultInjector({'rules': [
+        {'kind': 'controller_crash', 'site': 'controller_tick',
+         'at': 2},
+        {'kind': 'controller_restart', 'site': 'sim_controller',
+         'at': 1},
+    ]})
+    assert inj.fire('controller_tick') is None
+    assert inj.fire('controller_tick').kind == 'controller_crash'
+    assert inj.fire('sim_controller').kind == 'controller_restart'
+    with pytest.raises(ValueError, match='unknown fault site'):
+        faults_lib.FaultInjector({'rules': [
+            {'kind': 'controller_crash', 'site': 'contoller_tick',
+             'at': 1}]})
+
+
+# ------------------------------------------------------------ LB autonomy
+class _FakeController:
+    """Settable /controller/load_balancer_sync endpoint."""
+
+    def __init__(self, urls, port=None):
+        import http.server as hs
+        self.urls = list(urls)
+        outer = self
+
+        class H(hs.BaseHTTPRequestHandler):
+            timeout = 30
+
+            def log_message(self, *a):
+                del a
+
+            def do_POST(self):  # noqa: N802
+                body = json.dumps({
+                    'ready_replica_urls': outer.urls,
+                    'retry_after_s': 5}).encode()
+                self.send_response(200)
+                self.send_header('Content-Length', str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+        self.port = port or common_utils.find_free_port(20100)
+        self.httpd = hs.ThreadingHTTPServer(('127.0.0.1', self.port), H)
+        threading.Thread(target=self.httpd.serve_forever,
+                         daemon=True).start()
+
+    @property
+    def url(self):
+        return f'http://127.0.0.1:{self.port}'
+
+    def stop(self):
+        self.httpd.shutdown()
+        self.httpd.server_close()
+
+
+def _make_lb(controller_url, monkeypatch):
+    from skypilot_tpu.serve.load_balancer import SkyServeLoadBalancer
+    monkeypatch.setenv('SKYTPU_LB_SYNC', '3600')
+    port = common_utils.find_free_port(20200)
+    lb = SkyServeLoadBalancer(controller_url=controller_url, port=port)
+    return lb, port
+
+
+def test_lb_stale_while_revalidate_and_alarm(monkeypatch):
+    from skypilot_tpu import telemetry
+    monkeypatch.setenv('SKYTPU_LB_MAX_STALENESS', '0.2')
+    urls = ['http://10.9.9.1:1', 'http://10.9.9.2:1']
+    ctrl = _FakeController(urls)
+    lb, _ = _make_lb(ctrl.url, monkeypatch)
+    try:
+        lb._sync_once()
+        assert lb.policy.ready_replicas == urls
+        reg = telemetry.get_registry()
+        assert reg.get('skytpu_lb_controller_up').value == 1
+        # --- controller dies
+        ctrl.stop()
+        time.sleep(0.3)
+        lb._sync_once()
+        # Stale-while-revalidate: the last view keeps serving.
+        assert lb.policy.ready_replicas == urls
+        assert reg.get('skytpu_lb_controller_up').value == 0
+        assert reg.get('skytpu_lb_sync_age_seconds').value > 0.2
+        view = lb.replica_view()
+        assert view['controller_up'] is False
+        assert view['ready_replica_urls'] == urls
+        # --- controller returns (same port): health recovers.
+        ctrl2 = _FakeController(urls, port=ctrl.port)
+        try:
+            deadline = time.time() + 10
+            while time.time() < deadline:
+                lb._sync_once()
+                if reg.get('skytpu_lb_controller_up').value == 1:
+                    break
+                time.sleep(0.1)
+            assert reg.get('skytpu_lb_controller_up').value == 1
+            assert lb.replica_view()['controller_up'] is True
+        finally:
+            ctrl2.stop()
+    finally:
+        lb.stop()
+
+
+def test_lb_local_eviction_and_reconcile_on_return(monkeypatch):
+    import http.server as hs
+
+    class H(hs.BaseHTTPRequestHandler):
+        timeout = 30
+
+        def log_message(self, *a):
+            del a
+
+        def do_POST(self):  # noqa: N802
+            body = json.dumps({'text': 'ok', 'tokens': [1]}).encode()
+            self.send_response(200)
+            self.send_header('Content-Length', str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+    live_port = common_utils.find_free_port(20300)
+    live = hs.ThreadingHTTPServer(('127.0.0.1', live_port), H)
+    threading.Thread(target=live.serve_forever, daemon=True).start()
+    live_url = f'http://127.0.0.1:{live_port}'
+    dead_url = f'http://127.0.0.1:{common_utils.find_free_port(20350)}'
+    ctrl = _FakeController([dead_url, live_url])
+    lb, lport = _make_lb(ctrl.url, monkeypatch)
+    try:
+        lb.start()
+        lb._sync_once()
+        # Drive requests until the dead replica has provably been
+        # tried: connect-refused ⇒ locally evicted, request retried
+        # transparently on the live one.
+        for _ in range(4):
+            req = urllib.request.Request(
+                f'http://127.0.0.1:{lport}/generate',
+                json.dumps({'text': 'hi'}).encode(),
+                {'Content-Type': 'application/json'})
+            with urllib.request.urlopen(req, timeout=30) as r:
+                assert json.loads(r.read())['text'] == 'ok'
+            if dead_url in lb._evicted:
+                break
+        assert dead_url in lb._evicted
+        assert lb.policy.ready_replicas == [live_url]
+        from skypilot_tpu import telemetry
+        assert telemetry.get_registry().get(
+            'skytpu_lb_local_evictions_total').value >= 1
+        # Controller still lists the dead replica (stale view):
+        # reconcile keeps the local eviction — no clobber.
+        lb._sync_once()
+        assert lb.policy.ready_replicas == [live_url]
+        # Controller catches up (drops the dead replica): the
+        # eviction record is released.
+        ctrl.urls = [live_url]
+        lb._sync_once()
+        assert lb._evicted == {}
+        assert lb.policy.ready_replicas == [live_url]
+        # TTL expiry: a false eviction heals even if the controller
+        # keeps listing the replica.
+        monkeypatch.setenv('SKYTPU_LB_EVICT_TTL', '0.05')
+        ctrl.urls = [dead_url, live_url]
+        lb._sync_once()
+        lb.note_replica_dead(dead_url, 'test')
+        assert lb.policy.ready_replicas == [live_url]
+        time.sleep(0.1)
+        lb._sync_once()
+        assert dead_url in lb.policy.ready_replicas
+    finally:
+        lb.stop()
+        ctrl.stop()
+        live.shutdown()
+
+
+# ----------------------------------------------------------- simulation
+def test_sim_controller_crash_storm_zero_lost_and_adoption():
+    from skypilot_tpu.serve.sim import scenarios
+    rep = scenarios.run_scenario('controller_crash_storm', seed=0)
+    assert rep['requests']['lost'] == 0
+    assert rep['controller']['crashes'] == 1
+    assert rep['controller']['restarts'] == 1
+    rec = rep['controller']['reconciled']
+    # The surviving fleet was ADOPTED, not relaunched...
+    assert rec['adopted'] >= 3
+    # ...and the launches the crash orphaned were reaped as zombies.
+    assert rec['zombie_killed'] >= 1
+    assert rep['faults_fired']['sim_controller:controller_crash'] == 1
+    assert rep['faults_fired']['sim_controller:controller_restart'] == 1
+    # The outage is visible in the event log: stale syncs between the
+    # crash and the restart, adoption detail on the restart line.
+    sim = scenarios.get_scenario('controller_crash_storm').build(seed=0)
+    sim.run()
+    kinds = [line.split('|')[1] for line in
+             sim.event_log().splitlines()]
+    i_crash = kinds.index('ctrl_crash')
+    i_restart = kinds.index('ctrl_restart')
+    assert i_crash < i_restart
+    assert 'sync_stale' in kinds[i_crash:i_restart]
+    assert 'sync_stale' not in kinds[i_restart:]
+
+
+def test_sim_controller_crash_storm_same_seed_byte_identical():
+    from skypilot_tpu.serve.sim import scenarios
+    a = scenarios.run_scenario('controller_crash_storm', seed=11,
+                               keep_log=False)
+    b = scenarios.run_scenario('controller_crash_storm', seed=11,
+                               keep_log=False)
+    assert a['event_log_sha256'] == b['event_log_sha256']
+    assert a['events'] == b['events']
+    assert a['requests'] == b['requests']
+
+
+def test_cli_sim_lists_controller_crash_storm():
+    """Tier-1 CliRunner smoke (seconds): the scenario is registered
+    and discoverable — controller recovery can never silently rot out
+    of the library."""
+    from click.testing import CliRunner
+
+    from skypilot_tpu import cli as cli_mod
+    out = CliRunner().invoke(cli_mod.cli, ['sim', '--list'])
+    assert out.exit_code == 0
+    assert 'controller_crash_storm' in out.output
+
+
+# ------------------------------------------------------------- telemetry
+def test_crash_safety_series_registered_at_construction(tmp_path,
+                                                        monkeypatch):
+    """Stable-schema contract: constructing the controller (its
+    manager) and the LB registers every crash-safety series — zeros
+    from the first scrape, before any restart/adoption/outage."""
+    monkeypatch.setenv('SKYTPU_SERVE_DIR', str(tmp_path / 'serve'))
+    from skypilot_tpu import telemetry
+    from skypilot_tpu.telemetry import registry as registry_lib
+    registry_lib.reset_registry()
+    try:
+        from skypilot_tpu.serve import controller as controller_lib
+        from skypilot_tpu.serve.load_balancer import \
+            SkyServeLoadBalancer
+        env = _FakeEnv()
+        env.run_spawns = False
+        controller_lib.ServeController('svc', _spec(), {}, port=1,
+                                       env=env)
+        SkyServeLoadBalancer('http://127.0.0.1:1', port=1)
+        prom = telemetry.get_registry().render_prometheus()
+    finally:
+        registry_lib.reset_registry()
+    assert '# TYPE skytpu_controller_restarts_total counter' in prom
+    assert 'skytpu_controller_restarts_total 0' in prom
+    assert '# TYPE skytpu_reconcile_seconds histogram' in prom
+    assert 'skytpu_reconcile_seconds_bucket{le="+Inf"} 0' in prom
+    assert '# TYPE skytpu_replicas_adopted_total counter' in prom
+    for outcome in replica_managers.ADOPT_OUTCOMES:
+        assert (f'skytpu_replicas_adopted_total{{outcome="{outcome}"}}'
+                ' 0' in prom), outcome
+    assert '# TYPE skytpu_lb_sync_age_seconds gauge' in prom
+    assert 'skytpu_lb_sync_age_seconds 0' in prom
+    assert '# TYPE skytpu_lb_controller_up gauge' in prom
+    assert '# TYPE skytpu_lb_local_evictions_total counter' in prom
+    assert 'skytpu_lb_local_evictions_total 0' in prom
+
+
+# ------------------------------------------------- live e2e (model srv)
+def test_kill_controller_mid_drain_e2e_zero_lost(tmp_path, monkeypatch):
+    """THE live contract: a REAL controller managing two REAL tiny
+    model servers dies mid-drain while streams run through the live
+    LB. A new controller boots with recover=True: it ADOPTS the
+    healthy replica (no relaunch), RESUMES the interrupted drain at
+    its remaining deadline (in-flight work on the draining replica
+    finishes), and no cluster is ever torn down twice. Every stream
+    completes byte-identical to an uninterrupted run — zero lost."""
+    import jax
+    jax.config.update('jax_platforms', 'cpu')
+    monkeypatch.setenv('SKYTPU_SERVE_DIR', str(tmp_path / 'serve'))
+    monkeypatch.setenv('SKYTPU_SERVE_TICK', '0.5')
+    monkeypatch.setenv('SKYTPU_LB_SYNC', '3600')
+    from skypilot_tpu.serve import controller as controller_lib
+    from skypilot_tpu.serve.load_balancer import SkyServeLoadBalancer
+    from skypilot_tpu.serve.server import ModelServer
+
+    class CrashableEnv(control_env.LiveControlPlaneEnv):
+        """Live env whose cluster ops are recorded stubs and whose
+        spawns can be suppressed — `crashed=True` models the instant
+        the controller process dies (its threads die with it)."""
+
+        def __init__(self):
+            self.crashed = False
+            self.downs = []
+            self.launches = []
+
+        def spawn(self, fn, *args):
+            if self.crashed:
+                return
+            super().spawn(fn, *args)
+
+        def launch_cluster(self, task, cluster_name):
+            self.launches.append(cluster_name)
+
+        def cluster_head_ip(self, cluster_name):
+            return '127.0.0.1'
+
+        def down_cluster(self, cluster_name):
+            self.downs.append(cluster_name)
+
+        def cluster_gone(self, cluster_name):
+            return False
+
+    pa = common_utils.find_free_port(20400)
+    pb = common_utils.find_free_port(pa + 1)
+    sa = ModelServer('tiny', port=pa, max_batch=2, max_seq=128)
+    sb = ModelServer('tiny', port=pb, max_batch=2, max_seq=128)
+    sa.start(block=False)
+    sb.start(block=False)
+    lb = ctrl2 = None
+    spec = _spec(min_replicas=2)
+    try:
+        assert sa._ready.wait(180) and sb._ready.wait(180)
+        env1 = CrashableEnv()
+        cport = common_utils.find_free_port(20450)
+        ctrl1 = controller_lib.ServeController(
+            'e2e-svc', spec, {}, port=cport, env=env1)
+        mgr1 = ctrl1.replica_manager
+        url_a, url_b = (f'http://127.0.0.1:{pa}',
+                        f'http://127.0.0.1:{pb}')
+        ia = _seed_replica(mgr1, 1, ReplicaStatus.READY, url=url_a,
+                           port=pa)
+        ib = _seed_replica(mgr1, 2, ReplicaStatus.READY, url=url_b,
+                           port=pb)
+        ctrl1.start()
+        lbport = common_utils.find_free_port(20500)
+        lb = SkyServeLoadBalancer(
+            controller_url=f'http://127.0.0.1:{cport}', port=lbport)
+        lb.start()
+        lb._sync_once()
+        assert set(lb.policy.ready_replicas) == {url_a, url_b}
+
+        # Byte-identity reference, computed directly on replica B
+        # (gen=24 like test_chaos: long tiny-model generations can hit
+        # documented bf16 near-tie argmax flips under co-batching,
+        # which is a numerics caveat, not a recovery property).
+        prompts = [[11 + i, 3, 5, 7 + i] for i in range(5)]
+        gen = 24
+
+        def generate(base, p):
+            req = urllib.request.Request(
+                base + '/generate',
+                json.dumps({'prompt': p,
+                            'max_new_tokens': gen}).encode(),
+                {'Content-Type': 'application/json'})
+            with urllib.request.urlopen(req, timeout=180) as r:
+                return json.loads(r.read())['tokens']
+
+        reference = {tuple(p): generate(url_b, p) for p in prompts}
+
+        results, errors = {}, {}
+
+        def stream_one(p):
+            try:
+                req = urllib.request.Request(
+                    f'http://127.0.0.1:{lbport}/generate',
+                    json.dumps({'prompt': p, 'max_new_tokens': gen,
+                                'stream': True}).encode(),
+                    {'Content-Type': 'application/json'})
+                tokens, done, error = [], None, None
+                with urllib.request.urlopen(req, timeout=180) as r:
+                    for raw in r:
+                        if not raw.startswith(b'data:'):
+                            continue
+                        ev = json.loads(raw[5:].strip())
+                        if 'token' in ev:
+                            tokens.append(int(ev['token']))
+                        if ev.get('done'):
+                            done = ev
+                        if 'error' in ev:
+                            error = ev
+                results[tuple(p)] = (tokens, done, error)
+            except Exception as e:  # noqa: BLE001 — asserted below
+                errors[tuple(p)] = f'{type(e).__name__}: {e}'
+
+        threads = [threading.Thread(target=stream_one, args=(p,))
+                   for p in prompts]
+        for t in threads:
+            t.start()
+            time.sleep(0.02)
+
+        # --- mid-load: the controller loop dies, then a drain of
+        # replica A gets as far as its journal + row write before its
+        # thread "dies with the process" — the crash-mid-drain moment.
+        env1.crashed = True            # threads die with the process
+        ctrl1.crash()
+        for t in ctrl1._threads:
+            t.join(timeout=10)
+        assert mgr1.drain(1, deadline_s=30.0) is True
+        (op,) = serve_state.pending_ops('e2e-svc')
+        assert op['kind'] == 'drain'
+        # The row usually reads DRAINING; a probe sweep racing the
+        # crash can leave it READY — either way the journaled drain op
+        # is what reconciliation resumes from.
+        assert serve_state.get_replicas('e2e-svc')[0]['status'] in (
+            ReplicaStatus.DRAINING, ReplicaStatus.READY)
+        # The LB's next sync fails: stale-while-revalidate.
+        lb._sync_once()
+        assert set(lb.policy.ready_replicas) == {url_a, url_b}
+
+        # --- a NEW controller boots and reconciles.
+        env2 = CrashableEnv()
+        cport2 = common_utils.find_free_port(20550)
+        ctrl2 = controller_lib.ServeController(
+            'e2e-svc', spec, {}, port=cport2, env=env2, recover=True)
+        stats = ctrl2.last_reconcile
+        assert stats['adopted'] == 1           # B re-owned, no relaunch
+        assert stats['drain_resumed'] == 1     # A's drain continues
+        assert env2.launches == []
+        mgr2 = ctrl2.replica_manager
+        assert mgr2._next_replica_id == 3
+        assert mgr2.ready_urls() == [url_b]
+        ctrl2.start()
+        # Re-point the LB (in production the controller address is
+        # stable; the test re-binds): reconcile, don't clobber.
+        lb.controller_url = f'http://127.0.0.1:{cport2}'
+        lb._sync_once()
+        assert lb.policy.ready_replicas == [url_b]
+
+        for t in threads:
+            t.join(timeout=180)
+        assert not errors, errors
+        lost = []
+        for p in prompts:
+            tokens, done, error = results[tuple(p)]
+            if error is not None or done is None:
+                lost.append((p, error))
+                continue
+            assert tokens == reference[tuple(p)], (p, tokens)
+        assert lost == [], lost
+
+        # The resumed drain runs A to drained and tears it down
+        # EXACTLY once; B is never touched.
+        deadline = time.time() + 60
+        while time.time() < deadline and 1 in mgr2._replicas:
+            time.sleep(0.2)
+        assert 1 not in mgr2._replicas
+        assert env2.downs == [ia.cluster_name]
+        assert env1.downs == []
+
+        # The drain + teardown ops ack shortly after untrack. (A
+        # pending LAUNCH op may legitimately appear: the autoscaler
+        # replaces the drained replica — that is the control plane
+        # working, not a leak.)
+        def recovery_ops():
+            return [op for op in serve_state.pending_ops('e2e-svc')
+                    if op['kind'] in ('drain', 'teardown')]
+
+        deadline = time.time() + 30
+        while time.time() < deadline and recovery_ops():
+            time.sleep(0.1)
+        assert recovery_ops() == []
+        ids = [r['replica_id'] for r in
+               serve_state.get_replicas('e2e-svc')]
+        assert 1 not in ids and 2 in ids
+        del ib
+    finally:
+        if lb is not None:
+            lb.stop()
+        if ctrl2 is not None:
+            ctrl2.crash()
+        sa.stop()
+        sb.stop()
