@@ -149,6 +149,8 @@ def main() -> None:
              lambda: _ctrl_recovery_bench(n_chips)),
             ('quant4',
              lambda: _quant4_bench(n_chips, chip_bw)),
+            ('kv_round2',
+             lambda: _kv_round2_bench(n_chips, chip_bw)),
             ('multistep',
              lambda: _multistep_bench(n_chips)),
             ('train',
@@ -3109,6 +3111,139 @@ def _quant4_bench(n_chips: int, chip_bw: float) -> dict:
         # Warning-freeness discipline (page_size_warnings-style).
         'warnings': [str(w.message) for w in caught
                      if issubclass(w.category, UserWarning)],
+    }
+
+
+def _kv_round2_bench(n_chips: int, chip_bw: float) -> dict:
+    """KV round two: {bf16, int8, int4} KV x {per_layer, cross_layer}
+    decode attention at EQUAL batch and EQUAL multi-step k, against a
+    KV-bytes-AWARE calibrated roofline. The ``quant4`` block divides
+    the calibrated stream rate by weight bytes + a FIXED int8 KV term;
+    here the KV term is ``kv_token_bytes(cfg, kv)`` x live context per
+    step, so the roofline MOVES as the cache shrinks and
+    ``decode_roofline_frac_kv`` is achieved-rate over the combo's OWN
+    byte budget — the number the int4-KV claim is about. Weights ride
+    int4 fused-dequant everywhere (the PR-14 headline); PR-14's best
+    equal-batch cell is {int8 KV, per_layer}, so
+    ``speedup_vs_pr14_best`` is the acceptance ratio for the 1.5x bar.
+    Same CPU-calibration honesty as quant4: the 'bandwidth' is the
+    measured weights-only stream pass on THIS host, and the host-bound
+    regime's caveats transfer verbatim."""
+    import warnings as warnings_mod
+
+    import jax
+
+    from skypilot_tpu.inference.engine import kv_token_bytes
+    from skypilot_tpu.inference.paged import PagedInferenceEngine
+    from skypilot_tpu.models import llama, quantization
+    from skypilot_tpu.models.configs import ModelConfig
+    cfg = ModelConfig(name='kv-round2-bench', vocab_size=8192, dim=768,
+                      n_layers=4, n_heads=12, n_kv_heads=3,
+                      ffn_dim=3072)
+    batch, gen_len, max_seq, k = 4, 40, 64, 4
+    prompt = list(range(1, 17))
+    base = llama.init_params(jax.random.PRNGKey(0), cfg)
+    p4 = quantization.quantize_params(base, mode='int4')
+
+    def stream_bytes():
+        embed = p4['embed']
+        return (quantization.quantized_bytes(p4)
+                - embed.size * embed.dtype.itemsize
+                + batch * cfg.dim * 2)
+
+    avg_ctx = len(prompt) + gen_len / 2
+    tok_bytes = {m: kv_token_bytes(cfg, m)
+                 for m in ('bf16', 'int8', 'int4')}
+    kv_read = {m: int(batch * avg_ctx * tok_bytes[m])
+               for m in tok_bytes}
+    with warnings_mod.catch_warnings(record=True) as caught:
+        warnings_mod.simplefilter('always')
+        weights_ms = _weights_only_step_ms(p4, cfg, batch, horizon=16)
+        sb = stream_bytes()
+        stream_bw = sb / (weights_ms * 1e-3)           # bytes/s
+        roofline = {m: stream_bw / (sb + kv_read[m]) * batch
+                    for m in tok_bytes}
+        tok_s = {}
+        for kv in ('bf16', 'int8', 'int4'):
+            for impl, label in (('gather', 'per_layer'),
+                                ('cross_layer', 'cross_layer')):
+                eng = PagedInferenceEngine(
+                    cfg, base, max_batch=batch, max_seq=max_seq,
+                    quantize='int4', kv_cache_dtype=kv,
+                    decode_impl=impl, decode_steps_per_call=k,
+                    page_size=32)
+                _steady_decode_tok_s(eng, prompt, gen_len, batch,
+                                     horizon=1, min_tokens=batch * 32)
+                tok_s[f'{kv}/{label}'] = round(
+                    _steady_decode_tok_s(
+                        eng, prompt, gen_len, batch, horizon=1,
+                        min_tokens=batch * 32) / n_chips, 2)
+                del eng
+    best = max(tok_s, key=tok_s.get)
+    best_kv = best.split('/')[0]
+    frac = (tok_s[best] * n_chips / roofline[best_kv]
+            if roofline[best_kv] else 0)
+    pr14 = tok_s['int8/per_layer']
+    return {
+        'batch': batch,
+        'decode_steps_per_call': k,
+        'kv_token_bytes': tok_bytes,
+        'kv_read_bytes_per_step': kv_read,
+        'streamed_weight_bytes_per_step': int(sb),
+        'calibrated_stream_gb_s': round(stream_bw / 1e9, 3),
+        'roofline_tok_s_per_chip_by_kv': {
+            m: round(r / n_chips, 2) for m, r in roofline.items()},
+        'sustained_decode_tok_s_per_chip': tok_s,
+        'best_combo': best,
+        'decode_roofline_frac_kv': round(frac, 3),
+        'decode_roofline_frac_kv_by_kv': {
+            m: round(max(tok_s[f'{m}/per_layer'],
+                         tok_s[f'{m}/cross_layer'])
+                     * n_chips / roofline[m], 3)
+            for m in tok_bytes},
+        'speedup_vs_pr14_best': round(
+            tok_s[best] / max(pr14, 1e-9), 3),
+        'int4_vs_bf16_kv_read_ratio': round(
+            kv_read['int4'] / kv_read['bf16'], 3),
+        # Where the 1.5x claim lives: at this bench config the weight
+        # stream is ~98% of the step's bytes, so shrinking the KV can't
+        # move tok/s on THIS host — at serving batch on a 7B the mix
+        # inverts. Byte-transparent roofline projection, same division
+        # as above at llama2-7b / batch 48 / ctx 2048, int4 weights:
+        # speedup(int8 KV -> int4 KV) = (W + KV8) / (W + KV4).
+        'projected_7b_kv_bytes': _kv_round2_7b_projection(),
+        # Warning-freeness discipline (page_size_warnings-style).
+        'warnings': [str(w.message) for w in caught
+                     if issubclass(w.category, UserWarning)],
+    }
+
+
+def _kv_round2_7b_projection(batch: int = 48, ctx: int = 2048) -> dict:
+    """The serving-batch byte mix the kv_round2 acceptance bar is
+    about: per-step streamed bytes at llama2-7b with int4 weights, and
+    the roofline speedup from swapping the KV grid. Pure arithmetic on
+    ``kv_token_bytes`` + stored-bytes math — no measurement, so it
+    belongs next to the measured block, not in place of it."""
+    from skypilot_tpu.inference.engine import kv_token_bytes
+    from skypilot_tpu.models import configs
+    cfg = configs.LLAMA2_7B
+    # int4 quantizable leaves ~= params/2 bytes + per-channel scale
+    # noise; embed/norms ride bf16. Close enough for a byte-mix ratio.
+    n_params = (cfg.vocab_size * cfg.dim * 2
+                + cfg.n_layers * (4 * cfg.dim * cfg.dim
+                                  + 3 * cfg.dim * cfg.ffn_dim))
+    w_bytes = n_params // 2
+    kv = {m: batch * ctx * kv_token_bytes(cfg, m)
+          for m in ('bf16', 'int8', 'int4')}
+    return {
+        'weight_bytes_int4': int(w_bytes),
+        'kv_read_bytes_per_step': {m: int(v) for m, v in kv.items()},
+        'kv_share_of_step_int8': round(
+            kv['int8'] / (w_bytes + kv['int8']), 3),
+        'roofline_speedup_int4_vs_int8_kv': round(
+            (w_bytes + kv['int8']) / (w_bytes + kv['int4']), 3),
+        'roofline_speedup_int4_vs_bf16_kv': round(
+            (w_bytes + kv['bf16']) / (w_bytes + kv['int4']), 3),
     }
 
 

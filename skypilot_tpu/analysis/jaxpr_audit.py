@@ -309,7 +309,8 @@ def _tiny_engine(kind: str, chunked: bool, speculate_k: int = 0,
                  kv_cache_dtype: Optional[str] = None,
                  mesh_tp: int = 0, mesh_dp: int = 0,
                  quantize: Optional[str] = None,
-                 decode_steps_per_call: Optional[int] = None):
+                 decode_steps_per_call: Optional[int] = None,
+                 decode_impl: Optional[str] = None):
     from skypilot_tpu.models import configs
     cfg = configs.get_config('tiny')
     chunk = 16 if chunked else 0
@@ -318,6 +319,10 @@ def _tiny_engine(kind: str, chunked: bool, speculate_k: int = 0,
         extra['quantize'] = quantize
     if decode_steps_per_call is not None:
         extra['decode_steps_per_call'] = decode_steps_per_call
+    if decode_impl is not None:
+        # Paged-only knob ('gather' | 'pallas' | 'cross_layer'); the
+        # slot engine rejects it, so only paged presets may set it.
+        extra['decode_impl'] = decode_impl
     if mesh_tp and mesh_tp > 1:
         import jax
 
@@ -463,7 +468,8 @@ def audit_engine(kind: str = 'slot', chunked: bool = True,
                  mesh_tp: int = 0, mesh_dp: int = 0,
                  warmup_rounds: int = 1,
                  merge_all_gathers: int = 0,
-                 quantize: Optional[str] = None) -> AuditReport:
+                 quantize: Optional[str] = None,
+                 decode_impl: Optional[str] = None) -> AuditReport:
     """Build a tiny engine, run one warmup wave (compiles allowed),
     then audit ``rounds`` identical same-shaped waves: every compile
     and every unsanctioned host transfer in those waves is a violation.
@@ -491,14 +497,15 @@ def audit_engine(kind: str = 'slot', chunked: bool = True,
     q_tag = f' + quantize={quantize}' if quantize else ''
     tp_tag = f' + tp={mesh_tp}' if mesh_tp else ''
     tp_tag += f' x dp={mesh_dp}' if mesh_dp else ''
+    impl_tag = f' + decode_impl={decode_impl}' if decode_impl else ''
     report = AuditReport(
         name=f'{kind} engine '
              f'({"chunked prefill + " if chunked else ""}decode'
-             f'{spec_tag}{kv_tag}{q_tag}{tp_tag})')
+             f'{spec_tag}{kv_tag}{q_tag}{tp_tag}{impl_tag})')
     engine = _tiny_engine(kind, chunked, speculate_k,
                           kv_cache_dtype=kv_cache_dtype,
                           mesh_tp=mesh_tp, mesh_dp=mesh_dp,
-                          quantize=quantize)
+                          quantize=quantize, decode_impl=decode_impl)
     if speculate_k:
         # Repetitive prompts: the n-gram proposer matches, acceptance
         # is nonzero AND per-slot variable — the masked-commit shapes
@@ -621,6 +628,93 @@ def audit_multistep(k: int = 4,
              if key.get('horizon') != k]
     report.compile_counts['dispatches at horizon != k'] = (
         0, len(bad_h))
+    return report
+
+
+def audit_spec_multistep(k: int = 4, steps: int = 3) -> AuditReport:
+    """In-scan speculative verify (``speculate_k`` x
+    ``decode_steps_per_call``): the COMPOSED amortization contract.
+
+    When both knobs are set, ``steps`` propose→verify→commit rounds
+    fuse into ONE jitted dispatch (a lax.scan with the device n-gram
+    proposer); greedy decode is byte-identical to the single-round
+    path, so per-round commit counts — and therefore the number of
+    verify rounds a wave needs — match a reference single-round
+    engine exactly. Steady state must show:
+
+    - fused dispatches == ceil(single-round verify dispatches /
+      ``steps``) per wave: ONE dispatch per ``steps`` verify rounds,
+      with no partial-round or tail dispatches beyond the final
+      ceil;
+    - ZERO single-round fallback dispatches (the pool reservation in
+      ``_spec_can_fuse`` must hold at this scale — a fallback means
+      the fusion silently degraded);
+    - every fused jit key pins rounds == ``steps`` (a drifting rounds
+      count would recompile AND break the amortization claim);
+    - the usual gates: zero unsanctioned d2h (the stacked-commit
+      host_sync is the ONE sanctioned readback per dispatch), zero
+      steady-state growth of the spec program cache."""
+    report = AuditReport(
+        name=f'in-scan speculative verify (speculate_k={k} x '
+             f'decode_steps_per_call={steps})')
+    # Repetitive prompts so the n-gram proposer fires and acceptance
+    # varies per slot (same shapes as the spec presets).
+    prompts = [[1, 2, 3, 4] * 7, [5, 6] * 11, [7, 8, 9] * 7]
+    max_new = 12
+
+    def one_wave(engine) -> None:
+        for p in prompts:
+            engine.add_request(list(p), max_new_tokens=max_new)
+        # Caller horizon 1: the KNOB must fuse the rounds, not the
+        # caller's horizon loop.
+        engine.run_to_completion(horizon=1)
+
+    def count_calls(engine, name: str, counter: List[int]):
+        orig = getattr(engine, name)
+
+        def counting(*args, **kwargs):
+            counter[0] += 1
+            return orig(*args, **kwargs)
+        setattr(engine, name, counting)
+
+    # Reference: identical wave on a single-round verify engine — its
+    # dispatch count is the ground truth the fusion must divide.
+    ref = _tiny_engine('paged', chunked=True, speculate_k=k)
+    single = [0]
+    count_calls(ref, '_spec_verify_call', single)
+    one_wave(ref)                                 # warmup: compiles
+    single[0] = 0
+    one_wave(ref)                                 # counted wave
+
+    engine = _tiny_engine('paged', chunked=True, speculate_k=k,
+                          decode_steps_per_call=steps)
+    fused, fallback = [0], [0]
+    count_calls(engine, '_spec_fused_call', fused)
+    count_calls(engine, '_spec_verify_call', fallback)
+    one_wave(engine)                              # warmup: compiles
+    spec_fns = engine._spec_verify_fns
+    before = len(spec_fns)
+    fused[0] = fallback[0] = 0
+    rounds = 2
+    with intercept_host_transfers(report.transfers):
+        for _ in range(rounds):
+            one_wave(engine)
+    per_wave = -(-single[0] // steps)             # ceil
+    report.compile_counts = {
+        'spec program cache': (before, len(spec_fns)),
+        f'fused dispatches (ONE per {steps} verify rounds; '
+        f'{single[0]} single-round rounds/wave)': (
+            rounds * per_wave, fused[0]),
+        'single-round fallback dispatches': (0, fallback[0]),
+    }
+    names = ('mode', 'k', 'sample', 'P', 'rounds')
+    report.static_keys.extend(
+        dict(zip(names, key)) for key in sorted(spec_fns)
+        if isinstance(key, tuple) and key and key[0] == 'fused')
+    bad_r = [key for key in report.static_keys
+             if key.get('rounds') != steps]
+    report.compile_counts['fused keys at rounds != steps'] = (
+        0, len(bad_r))
     return report
 
 
@@ -778,6 +872,20 @@ PRESETS: Dict[str, Callable[[], AuditReport]] = {
                                     kv_cache_dtype='int8'),
     'kv-int8-slot': lambda: audit_engine('slot', chunked=True,
                                          kv_cache_dtype='int8'),
+    # int4 KV codes (packed nibble rows + absmax/7 scales): quantize-
+    # on-write and fused in-kernel dequant reads must add zero d2h and
+    # zero steady-state jit-cache growth — halving KV bytes must not
+    # buy a single host round-trip.
+    'kv-int4': lambda: audit_engine('paged', chunked=True,
+                                    kv_cache_dtype='int4'),
+    'kv-int4-slot': lambda: audit_engine('slot', chunked=True,
+                                         kv_cache_dtype='int4'),
+    # Cross-layer fused decode attention: the per-layer ring+current-
+    # token merge folded into the kernel's final grid step. Same hot-
+    # loop gates as 'paged' — the fusion must be free at the dispatch
+    # boundary.
+    'fused-attn': lambda: audit_engine('paged', chunked=True,
+                                       decode_impl='cross_layer'),
     # Sharded serving path (tp=2 CPU mesh): chunked prefill + decode +
     # ring merge over the head-sharded pool — zero steady-state
     # recompiles, zero unsanctioned d2h, and no resharding collectives
@@ -819,6 +927,10 @@ PRESETS: Dict[str, Callable[[], AuditReport]] = {
     # every dispatch at static horizon k, zero recompiles/d2h.
     'multistep': audit_multistep,
     'int4-multistep': lambda: audit_multistep(quantize='int4'),
+    # In-scan speculative verify: speculate_k x decode_steps_per_call
+    # compose into ONE dispatch per `steps` verify rounds, pinned
+    # against a single-round reference engine's dispatch count.
+    'spec-multistep': audit_spec_multistep,
     'llama': audit_llama_forward,
 }
 
@@ -833,9 +945,10 @@ MULTI_DEVICE_PRESETS: Dict[str, int] = {
 
 DEFAULT_PRESETS: List[str] = [
     'slot', 'paged', 'slot-spec', 'paged-spec', 'telemetry',
-    'kv-int8', 'kv-int8-slot', 'paged-tp', 'paged-tp-int8',
+    'kv-int8', 'kv-int8-slot', 'kv-int4', 'kv-int4-slot',
+    'fused-attn', 'paged-tp', 'paged-tp-int8',
     'paged-gang', 'disagg', 'int4', 'multistep', 'int4-multistep',
-    'llama']
+    'spec-multistep', 'llama']
 
 
 def run_presets(names: Optional[List[str]] = None) -> List[AuditReport]:

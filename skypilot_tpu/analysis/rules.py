@@ -119,6 +119,17 @@ TPU hot-path hygiene (GC2xx), applied to the compute layer
   ``_journal_finish`` / ``_put_note`` / ``_del_note`` /
   ``_persist_autoscaler_state``). Restart reconciliation replays the
   journal; a write it didn't see is state it cannot rebuild.
+- **GC121 per-layer-pool-read** — a per-layer pool slice
+  (``lax.dynamic_index_in_dim`` over a ``[L, ...]`` KV pool, or a
+  scalar layer subscript) or a ``_gather_layer`` call inside a
+  decode-scoped function in ``inference/``. The paged decode path is
+  KV-bandwidth-bound: slicing the stacked pool makes XLA materialize
+  that layer's whole pool as a fresh operand, and gather-per-layer
+  materializes a full KV copy per layer per step — exactly the
+  traffic the paged-attention kernels (scalar-prefetch layer index,
+  cross-layer fused variant) exist to avoid. Decode code hands the
+  FULL stacked pool to the kernels; prefill/verify-shaped functions
+  (compute-bound, need contiguous rows) are exempt.
 - **GC202 host-sync** — device->host readbacks outside the sanctioned
   :func:`skypilot_tpu.utils.host.host_sync` helper (bare
   ``np.asarray(x)``, ``.item()``, ``jax.device_get``,
@@ -226,6 +237,14 @@ RULES: Dict[str, str] = {
              '_persist_autoscaler_state) — crash-safe restart '
              'reconciliation is only sound if the journal can never '
              'drift from what the state machines actually did',
+    'GC121': 'per-layer-pool-read: per-layer KV-pool slice '
+             '(dynamic_index_in_dim / scalar layer subscript) or '
+             '_gather_layer call in a decode-scoped inference '
+             'function — the paged decode read goes through the '
+             'paged-attention kernels (scalar-prefetch layer index, '
+             'or the cross-layer fused kernel), never a materialized '
+             'per-layer pool copy; prefill/verify-shaped functions '
+             'are exempt (compute-bound, need contiguous rows)',
     'GC201': 'impure-jit: impure or host-synchronizing call inside a '
              '@jax.jit body',
     'GC202': 'host-sync: device->host readback outside the '
@@ -259,6 +278,26 @@ _INT4_DTYPE_STRINGS = {'int4', 'uint4'}
 # Scope names whose functions ARE nibble helpers by construction
 # (mirrors GC110's 'quantize' scope exemption).
 _NIBBLE_SCOPE_MARKERS = ('quantize', 'pack_int4', 'unpack_int4')
+
+# --------------------------------------------------------------------- GC121
+# The paged decode hot path is KV-bandwidth-bound: a per-layer pool
+# slice forces XLA to materialize that layer's whole pool as a fresh
+# operand of the consumer, and a gather-per-layer materializes a full
+# KV copy per layer per step. Decode-scoped functions in inference/
+# hand the FULL stacked pool to the paged-attention kernels
+# (ops/paged_attention.py: the layer rides scalar prefetch; the
+# cross-layer variant runs every layer in one pallas_call). Exempt
+# scopes are the prefill/verify-shaped functions (compute-bound — they
+# legitimately materialize contiguous rows for cached_attention) and
+# the gather helper's own body; the one legacy gather fallback inside
+# paged_decode_horizon is suppressed inline, so any NEW site
+# hard-fails.
+_POOL_SLICE_FNS = {'lax.dynamic_index_in_dim',
+                   'jax.lax.dynamic_index_in_dim',
+                   'dynamic_index_in_dim'}
+_GATHER_LAYER_FNS = {'_gather_layer', 'gather_layer'}
+_POOL_SCALE_NAMES = {'k_scale', 'v_scale'}
+_GC121_EXEMPT_SCOPE_MARKERS = ('prefill', 'verify', '_gather_layer')
 
 # --------------------------------------------------------------------- GC114
 # KV transfer paths: the disaggregated-serving wire codec and handoff
@@ -847,6 +886,7 @@ class _Checker(ast.NodeVisitor):
             self._check_int4_write(node, method)
         if self.is_inference:
             self._check_device_put(node, name)
+            self._check_pool_slice_call(node, name)
         if self.is_transfer_path:
             self._check_wire_dtype(node, name, method)
         if self.is_scaling_path:
@@ -894,6 +934,72 @@ class _Checker(ast.NodeVisitor):
                   'from_pretrained) — use utils.host.device_upload '
                   'for per-step host uploads; resharding committed '
                   'state in the step path is banned')
+
+    # ------------------------------------------------------------- GC121
+    @staticmethod
+    def _is_pool_named(node: ast.AST) -> bool:
+        """A KV pool (or its scale pool) by naming convention: the last
+        identifier segment mentions 'pool' (pool_k / ks_pool /
+        cache.pool_v) or is a scale-pool field (cache.k_scale)."""
+        dotted = _dotted(node)
+        if not dotted:
+            return False
+        seg = dotted.rsplit('.', 1)[-1]
+        return 'pool' in seg or seg in _POOL_SCALE_NAMES
+
+    def _gc121_applies(self) -> bool:
+        """GC121 polices DECODE-scoped inference functions only:
+        prefill/verify-shaped scopes legitimately materialize
+        contiguous rows (compute-bound), and the gather helper is the
+        one sanctioned materializer."""
+        if any(m in s for s in self._scope
+               for m in _GC121_EXEMPT_SCOPE_MARKERS):
+            return False
+        return any('decode' in s for s in self._scope)
+
+    def _check_pool_slice_call(self, node: ast.Call, name: str) -> None:
+        """GC121 (call half): ``lax.dynamic_index_in_dim(pool, li)``
+        or ``_gather_layer(...)`` in a decode scope — a materialized
+        per-layer pool read on the KV-bandwidth-bound path."""
+        if not self._gc121_applies():
+            return
+        short = name.rsplit('.', 1)[-1]
+        if (name in _POOL_SLICE_FNS and node.args
+                and self._is_pool_named(node.args[0])):
+            self._add('GC121', node,
+                      'per-layer pool slice on the paged decode path '
+                      '— dynamic_index_in_dim materializes a copy of '
+                      'the layer\'s whole pool per step; hand the '
+                      'FULL stacked pool to the paged-attention '
+                      'kernels (layer via scalar prefetch, or the '
+                      'cross-layer fused kernel)')
+        elif short in _GATHER_LAYER_FNS:
+            self._add('GC121', node,
+                      'gather-per-layer on the paged decode path — '
+                      '_gather_layer materializes a full KV copy per '
+                      'layer per step; decode reads go through the '
+                      'paged-attention kernels instead')
+
+    def visit_Subscript(self, node):
+        """GC121 (subscript half): a scalar layer subscript of a pool
+        (``pool_k[li]`` / ``pool_k[0]`` / ``pool_k[li, ...]``) in a
+        decode scope — the same materialized per-layer read as the
+        dynamic_index_in_dim spelling."""
+        if (self.is_inference and self._gc121_applies()
+                and self._is_pool_named(node.value)):
+            idx = node.slice
+            if isinstance(idx, ast.Tuple) and idx.elts:
+                idx = idx.elts[0]
+            scalar = (isinstance(idx, ast.Name)
+                      or (isinstance(idx, ast.Constant)
+                          and isinstance(idx.value, int)))
+            if scalar:
+                self._add('GC121', node,
+                          'scalar layer subscript of a KV pool on the '
+                          'paged decode path — a materialized '
+                          'per-layer pool read; hand the FULL stacked '
+                          'pool to the paged-attention kernels')
+        self.generic_visit(node)
 
     def _check_wire_dtype(self, node: ast.Call, name: str,
                           method: str) -> None:

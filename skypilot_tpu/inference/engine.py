@@ -126,24 +126,22 @@ from skypilot_tpu.inference.kv_transfer import HandoffCapacityError  # noqa: E40
 
 def resolve_kv_cache_dtype(kv_cache_dtype: Optional[str],
                            quantize: Optional[str]) -> str:
-    """Effective KV storage dtype ('bf16' | 'int8') from the engine
-    flag. ``None``/``'auto'`` follows the WEIGHT quantization mode (the
-    historical coupling: int8 weights => int8 KV); an explicit value
-    decouples them in either direction — int8 KV over bf16 weights
-    halves the dominant decode HBM stream (and ~doubles pool token
-    capacity) on its own, and bf16 KV over int8 weights is the
-    ablation/debug spelling."""
+    """Effective KV storage dtype ('bf16' | 'int8' | 'int4') from the
+    engine flag. ``None``/``'auto'`` follows the WEIGHT quantization
+    mode (int8 weights => int8 KV, int4 weights => int4 KV — with
+    weights already 4-bit the KV stream is the dominant decode HBM
+    traffic, so auto matches its width); an explicit value decouples
+    them in either direction — int8/int4 KV over bf16 weights shrinks
+    the dominant decode HBM stream (and grows pool token capacity) on
+    its own, and bf16 KV over quantized weights is the ablation/debug
+    spelling."""
     if kv_cache_dtype in (None, 'auto'):
-        # int4 weights keep an int8 KV: the cache's fused-dequant
-        # attention path is int8-native, and KV rows are activations —
-        # 4-bit storage would cost real accuracy for a stream the int8
-        # halving already tamed.
-        return 'int8' if quantize in ('int8', 'int4') else 'bf16'
-    if kv_cache_dtype not in ('bf16', 'int8'):
+        return {'int8': 'int8', 'int4': 'int4'}.get(quantize, 'bf16')
+    if kv_cache_dtype not in ('bf16', 'int8', 'int4'):
         raise ValueError(
             f'unknown kv_cache_dtype {kv_cache_dtype!r}; supported: '
-            "'bf16', 'int8' (None/'auto' follows the weight quantize "
-            'mode)')
+            "'bf16', 'int8', 'int4' (None/'auto' follows the weight "
+            'quantize mode)')
     return kv_cache_dtype
 
 
@@ -172,11 +170,26 @@ def kv_token_bytes(cfg, quantized: bool, mesh=None) -> int:
     rows. HBM-budget decisions (pool auto-sizing, prefill stack caps)
     must pass the mesh; token-capacity surfaces (pool stats, scheduler
     bounds) stay global — a token is a token regardless of how many
-    chips hold its rows."""
-    row_w = (cfg.head_dim + 4 if quantized
-             else cfg.head_dim * jnp.dtype(cfg.dtype).itemsize)
+    chips hold its rows.
+
+    ``quantized`` accepts the historical bool (True == int8) or a kv
+    dtype string: int4 rows are PACKED — two nibble codes per byte
+    (head_dim/2) plus the same fp32 row scale."""
+    if quantized == 'int4':
+        row_w = cfg.head_dim // 2 + 4
+    elif quantized and quantized != 'bf16':
+        row_w = cfg.head_dim + 4
+    else:
+        row_w = cfg.head_dim * jnp.dtype(cfg.dtype).itemsize
     return (cfg.n_layers * cfg.n_kv_heads * row_w * 2
             ) // kv_shard_degree(cfg, mesh)
+
+
+# Telemetry series every engine registers at construction (zeros from
+# the first scrape): the decode step's KV read traffic and the
+# attention-impl attribution of its wall time.
+KV_READ_METRIC = 'skytpu_kv_read_bytes_per_step'
+ATTN_MS_METRIC = 'skytpu_attn_kernel_ms'
 
 
 def _ring_horizon_cap(cfg, batch: int, param_bytes: int,
@@ -269,6 +282,45 @@ class _EngineBase:
         self._prof = (profiler_lib.StepProfiler(
             engine=type(self).__name__) if self.telemetry_enabled
             else profiler_lib.NullProfiler())
+        # KV-round-two gauges, registered AT CONSTRUCTION so both
+        # series sit on the very first scrape (zeros) — the stable-
+        # schema contract: dashboards never join against a series that
+        # appears only after the first decode.
+        self._kv_read_gauge = None
+        self._attn_ms_gauges: Dict[str, Any] = {}
+        if self.telemetry_enabled:
+            from skypilot_tpu.telemetry import registry as registry_lib
+            reg = registry_lib.get_registry()
+            self._kv_read_gauge = reg.gauge(
+                KV_READ_METRIC,
+                'KV-cache bytes one decode substep streams from HBM '
+                '(live context rows x per-token stored cost, per '
+                'shard) — the bandwidth-wall numerator')
+            for impl in ('per_layer', 'cross_layer'):
+                self._attn_ms_gauges[impl] = reg.gauge(
+                    ATTN_MS_METRIC,
+                    'Host wall ms per decode substep attributed to '
+                    'the attention impl serving the dispatch',
+                    impl=impl)
+
+    def _note_decode_step(self, live_tokens: int, substeps: int,
+                          dt_s: float) -> None:
+        """Per-dispatch attribution behind the two KV-round-two
+        gauges: the HBM bytes the step's attention reads stream (live
+        context rows x the same per-token cost every capacity decision
+        uses) and host wall ms per device substep, labeled by the
+        attention impl that served it (per_layer | cross_layer — the
+        phase split the cross-layer fusion is supposed to flip). Host
+        arithmetic only; nothing here touches the device."""
+        if self._kv_read_gauge is None:
+            return
+        per_tok = kv_token_bytes(self.cfg, self.kv_cache_dtype,
+                                 mesh=getattr(self, 'mesh', None))
+        self._kv_read_gauge.set(live_tokens * per_tok)
+        impl = ('cross_layer'
+                if getattr(self, 'decode_impl', None) == 'cross_layer'
+                else 'per_layer')
+        self._attn_ms_gauges[impl].set(dt_s / max(1, substeps) * 1e3)
 
     def phase_stats(self) -> Dict[str, Any]:
         """Step-phase latency decomposition + first-compile events for
@@ -561,8 +613,11 @@ class _EngineBase:
     # caller-driven adaptive horizon. Pinning wins over the interleave
     # / queue-pressure shrinks (the knob is an explicit throughput
     # trade) but never over the capacity/ring safety caps; the jit key
-    # stays static at (k, sample, bucket). ``speculate_k > 0`` takes
-    # precedence for the decode path (one verify round per step).
+    # stays static at (k, sample, bucket). Composes with
+    # ``speculate_k``: when both are set the two knobs fuse into
+    # in-scan speculative verify (``_spec_step_fused`` — k verify
+    # rounds per dispatch); with ``decode_steps_per_call`` unset or 1,
+    # speculation runs one synchronous verify round per step.
     decode_steps_per_call: Optional[int] = None
 
     @staticmethod
@@ -817,16 +872,19 @@ class _EngineBase:
             raise ValueError(
                 'handoff kv_cache_dtype '
                 f'{entry.get("kv_cache_dtype")!r} != engine '
-                f'{self.kv_cache_dtype!r} (no wire transcoding: int8 '
-                'KV must land in an int8 pool)')
+                f'{self.kv_cache_dtype!r} (no wire transcoding: '
+                'quantized KV must land in a same-dtype pool)')
+        # int4 rows travel PACKED: two nibble codes per byte along
+        # head_dim (uint8, head_dim/2) — exactly the resident layout.
+        row_d = (cfg.head_dim // 2 if self.kv_cache_dtype == 'int4'
+                 else cfg.head_dim)
         for arr, name in ((entry['k'], 'k'), (entry['v'], 'v')):
             shape = tuple(np.shape(arr))
-            want_shape = (cfg.n_layers, n_rows, cfg.n_kv_heads,
-                          cfg.head_dim)
+            want_shape = (cfg.n_layers, n_rows, cfg.n_kv_heads, row_d)
             if shape != want_shape:
                 raise ValueError(f'handoff {name} rows shape {shape} '
                                  f'!= {want_shape}')
-        if self.kv_cache_dtype == 'int8':
+        if self.kv_cache_dtype in ('int8', 'int4'):
             for arr, name in ((entry['k_scale'], 'k_scale'),
                               (entry['v_scale'], 'v_scale')):
                 shape = tuple(np.shape(arr))
@@ -834,12 +892,15 @@ class _EngineBase:
                     raise ValueError(
                         f'handoff {name} shape {shape} != '
                         f'{(cfg.n_layers, n_rows, cfg.n_kv_heads)}')
+            want_np = (np.uint8 if self.kv_cache_dtype == 'int4'
+                       else np.int8)
             for arr, name in ((entry['k'], 'k'), (entry['v'], 'v')):
-                if np.dtype(getattr(arr, 'dtype', None)) != np.int8:
+                if np.dtype(getattr(arr, 'dtype', None)) != want_np:
                     raise ValueError(
                         f'handoff {name} codes are '
-                        f'{getattr(arr, "dtype", None)}, expected int8 '
-                        '(int8 KV never widens on the wire)')
+                        f'{getattr(arr, "dtype", None)}, expected '
+                        f'{np.dtype(want_np).name} (quantized KV '
+                        'never widens on the wire)')
 
     def _validate_ingest(self, snap: Dict[str, Any]) -> None:
         """Shared ingest validation: model shape, kv dtype (no
@@ -971,6 +1032,71 @@ class _EngineBase:
         return done
 
 
+def _slot_spec_verify(params, big_cache, tokens, proposals, n_prop,
+                      temps, topks, topps, active, rng, *, cfg,
+                      attn_impl, kv_bucket, max_seq, k, sample):
+    """One speculative verify round over the slot cache — the traced
+    body shared by the single-round jit (``_get_spec_verify``) and the
+    fused in-scan rounds (``_get_spec_fused``): one forward over the
+    k+1 positions [t0, d1..dk] per slot, device acceptance, and a
+    MASKED sentinel scatter of the accepted rows. Returns
+    ``(commit, n_commit, new_tok, new_cache)``."""
+    from skypilot_tpu.inference import speculative
+    b = tokens.shape[0]
+    len0 = big_cache.length
+    # Length-aware cache read, same policy as decode_horizon: slice
+    # only when it at least halves the stream (the sliced prefix
+    # materializes as a program temp).
+    ck = big_cache.k[:, :, :kv_bucket]
+    cv = big_cache.v[:, :, :kv_bucket]
+    if big_cache.quantized:
+        cache_kv = (ck, cv, big_cache.k_scale[:, :, :kv_bucket],
+                    big_cache.v_scale[:, :, :kv_bucket])
+    else:
+        cache_kv = (ck, cv)
+    seq = jnp.concatenate([tokens[:, None], proposals], axis=1)
+    logits, rows = llama.prefill_rows(
+        params, seq, jnp.full((b,), k + 1, jnp.int32), cfg,
+        attn_impl=attn_impl,
+        quantize_rows=('int4' if big_cache.packed
+                       else big_cache.quantized),
+        cache_kv=cache_kv, cache_len=len0, all_logits=True)
+    commit, n_commit = speculative.verify_tokens(
+        logits, proposals, n_prop, rng, temps, topks, topps,
+        sample=sample)
+    n_commit = jnp.where(active, n_commit, 0)
+    # Masked commit: rows past each slot's accepted count (and every
+    # row of inactive slots) scatter to the max_seq sentinel and drop.
+    pos = len0[:, None] + jnp.arange(k + 1)[None, :]
+    pos = jnp.where(jnp.arange(k + 1)[None, :]
+                    < n_commit[:, None], pos, max_seq)
+    slots = jnp.arange(b)
+    length = len0 + n_commit
+
+    def scatter(c, r):
+        return c.at[:, slots[:, None], pos].set(
+            r.astype(c.dtype), mode='drop')
+
+    if big_cache.quantized:
+        kq, vq, ks, vs = rows
+        new_cache = llama.KVCache(
+            k=scatter(big_cache.k, kq),
+            v=scatter(big_cache.v, vq), length=length,
+            k_scale=scatter(big_cache.k_scale, ks),
+            v_scale=scatter(big_cache.v_scale, vs))
+    else:
+        k_rows, v_rows = rows
+        new_cache = llama.KVCache(
+            k=scatter(big_cache.k, k_rows),
+            v=scatter(big_cache.v, v_rows), length=length)
+    # Next round's t0 = the last committed token per slot.
+    nxt = jnp.take_along_axis(
+        commit, jnp.maximum(n_commit - 1, 0)[:, None],
+        axis=1)[:, 0]
+    new_tok = jnp.where(active, nxt, tokens)
+    return commit, n_commit, new_tok, new_cache
+
+
 class InferenceEngine(SpeculativeMixin, _EngineBase):
     """Slot-cache engine core: callers drive ``step()``; the serve layer
     wraps it in an HTTP loop. Decode/prefill calls dispatch through the
@@ -1043,7 +1169,7 @@ class InferenceEngine(SpeculativeMixin, _EngineBase):
                                                      quantize)
         self.cache = llama.KVCache.create(
             cfg, batch=max_batch, max_seq=max_seq,
-            quantized=self.kv_cache_dtype == 'int8')
+            kv_dtype=self.kv_cache_dtype)
         # Pre-partitioned cache + pinned output shardings: the cache is
         # device_put ONCE with its logical-axis shardings, and every
         # jitted step that returns it pins the SAME tree as its
@@ -1123,12 +1249,12 @@ class InferenceEngine(SpeculativeMixin, _EngineBase):
             'tokens_free': cap - used,
             'preemptions': int(self.preemptions),
             'kv_token_bytes': kv_token_bytes(self.cfg,
-                                             self.cache.quantized),
+                                             self.kv_cache_dtype),
             # Bytes ONE device stores per token (kv heads shard over
             # tp) — the per-shard HBM view; token counts above stay
             # GLOBAL (a token is a token however many chips hold it).
             'kv_token_bytes_per_shard': kv_token_bytes(
-                self.cfg, self.cache.quantized, mesh=self.mesh),
+                self.cfg, self.kv_cache_dtype, mesh=self.mesh),
             'kv_shards': kv_shard_degree(self.cfg, self.mesh),
         }
 
@@ -1227,11 +1353,13 @@ class InferenceEngine(SpeculativeMixin, _EngineBase):
         slots_arr = np.array([slot], np.int32)
         valid = np.array([n_rows], np.int32)
         ingest = self._get_ingest(nb)
+        code_d = (cfg.head_dim // 2 if self.cache.packed
+                  else cfg.head_dim)
         if self.cache.quantized:
             (kq, ks, vq, vs, slots_d, valid_d) = device_upload(
-                (pad(snap['k'], (cfg.head_dim,)),
+                (pad(snap['k'], (code_d,)),
                  pad(snap['k_scale'], (1,)),
-                 pad(snap['v'], (cfg.head_dim,)),
+                 pad(snap['v'], (code_d,)),
                  pad(snap['v_scale'], (1,)), slots_arr, valid))
             self.cache = ingest(self.cache, kq, ks, vq, vs, slots_d,
                                 valid_d)
@@ -1302,7 +1430,8 @@ class InferenceEngine(SpeculativeMixin, _EngineBase):
             """tokens [n, bucket]; true_lens [n]; slots [n] target rows."""
             last, rows = llama.prefill_rows(
                 params, tokens, true_lens, cfg, attn_impl=attn_impl,
-                quantize_rows=big_cache.quantized, w8a8=w8a8)
+                quantize_rows=('int4' if big_cache.packed
+                               else big_cache.quantized), w8a8=w8a8)
             next_tokens = llama.mask_nonfinite_tokens(
                 last, jnp.argmax(last, -1).astype(jnp.int32))
             # Scatter KV rows + lengths into the slot cache.
@@ -1398,7 +1527,7 @@ class InferenceEngine(SpeculativeMixin, _EngineBase):
         # Per-DEVICE token cost: the stacked chunk transient shards
         # its kv-head dim over tp, so a tp=2 engine admits twice the
         # wave within the same per-chip scratch budget.
-        scratch_tok = kv_token_bytes(self.cfg, self.cache.quantized,
+        scratch_tok = kv_token_bytes(self.cfg, self.kv_cache_dtype,
                                      mesh=self.mesh)
 
         def shapes(batch):
@@ -1546,7 +1675,8 @@ class InferenceEngine(SpeculativeMixin, _EngineBase):
             last_idx = jnp.clip(want_idx, 0, chunk_w - 1)
             last, rows = llama.prefill_rows(
                 params, tokens, last_idx + 1, cfg, attn_impl=attn_impl,
-                quantize_rows=big_cache.quantized, w8a8=w8a8,
+                quantize_rows=('int4' if big_cache.packed
+                               else big_cache.quantized), w8a8=w8a8,
                 cache_kv=cache_kv,
                 cache_len=starts if kv_bucket else None)
             if sample:
@@ -1594,7 +1724,6 @@ class InferenceEngine(SpeculativeMixin, _EngineBase):
         key = (self.speculate_k, sample, kv_bucket)
         if key in self._spec_verify_fns:
             return self._spec_verify_fns[key]
-        from skypilot_tpu.inference import speculative
         cfg, attn_impl = self.cfg, self.attn_impl
         k = self.speculate_k
         max_seq = self.max_seq
@@ -1603,61 +1732,72 @@ class InferenceEngine(SpeculativeMixin, _EngineBase):
                            **self._step_out_shardings(3))
         def verify(params, big_cache, tokens, proposals, n_prop, temps,
                    topks, topps, active, rng):
-            b = tokens.shape[0]
-            len0 = big_cache.length
-            # Length-aware cache read, same policy as decode_horizon:
-            # slice only when it at least halves the stream (the sliced
-            # prefix materializes as a program temp).
-            ck = big_cache.k[:, :, :kv_bucket]
-            cv = big_cache.v[:, :, :kv_bucket]
-            if big_cache.quantized:
-                cache_kv = (ck, cv, big_cache.k_scale[:, :, :kv_bucket],
-                            big_cache.v_scale[:, :, :kv_bucket])
-            else:
-                cache_kv = (ck, cv)
-            seq = jnp.concatenate([tokens[:, None], proposals], axis=1)
-            logits, rows = llama.prefill_rows(
-                params, seq, jnp.full((b,), k + 1, jnp.int32), cfg,
-                attn_impl=attn_impl, quantize_rows=big_cache.quantized,
-                cache_kv=cache_kv, cache_len=len0, all_logits=True)
-            commit, n_commit = speculative.verify_tokens(
-                logits, proposals, n_prop, rng, temps, topks, topps,
-                sample=sample)
-            n_commit = jnp.where(active, n_commit, 0)
-            # Masked commit: rows past each slot's accepted count (and
-            # every row of inactive slots) scatter to the max_seq
-            # sentinel and drop.
-            pos = len0[:, None] + jnp.arange(k + 1)[None, :]
-            pos = jnp.where(jnp.arange(k + 1)[None, :]
-                            < n_commit[:, None], pos, max_seq)
-            slots = jnp.arange(b)
-            length = len0 + n_commit
-
-            def scatter(c, r):
-                return c.at[:, slots[:, None], pos].set(
-                    r.astype(c.dtype), mode='drop')
-
-            if big_cache.quantized:
-                kq, vq, ks, vs = rows
-                new_cache = llama.KVCache(
-                    k=scatter(big_cache.k, kq),
-                    v=scatter(big_cache.v, vq), length=length,
-                    k_scale=scatter(big_cache.k_scale, ks),
-                    v_scale=scatter(big_cache.v_scale, vs))
-            else:
-                k_rows, v_rows = rows
-                new_cache = llama.KVCache(
-                    k=scatter(big_cache.k, k_rows),
-                    v=scatter(big_cache.v, v_rows), length=length)
-            # Next round's t0 = the last committed token per slot.
-            nxt = jnp.take_along_axis(
-                commit, jnp.maximum(n_commit - 1, 0)[:, None],
-                axis=1)[:, 0]
-            new_tok = jnp.where(active, nxt, tokens)
-            return commit, n_commit, new_tok, new_cache
+            return _slot_spec_verify(
+                params, big_cache, tokens, proposals, n_prop, temps,
+                topks, topps, active, rng, cfg=cfg,
+                attn_impl=attn_impl, kv_bucket=kv_bucket,
+                max_seq=max_seq, k=k, sample=sample)
 
         self._spec_verify_fns[key] = verify
         return verify
+
+    def _get_spec_fused(self, sample: bool, kv_bucket: int,
+                        rounds: int):
+        """Compiled in-scan speculative rounds: ``rounds`` x (device
+        n-gram propose → verify forward → masked commit) fused into ONE
+        program via lax.scan. The verify body is exactly
+        ``_slot_spec_verify`` (greedy byte-identity inherited), the
+        proposer reads a gather-carried right-aligned history window,
+        and the ``rem`` budget carry reproduces the host budget cap so
+        commits never overshoot ``max_new_tokens`` or the sequence
+        capacity. jit key: (k, sample, kv_bucket, rounds)."""
+        key = ('fused', self.speculate_k, sample, kv_bucket, rounds)
+        if key in self._spec_verify_fns:
+            return self._spec_verify_fns[key]
+        from skypilot_tpu.inference import speculative
+        cfg, attn_impl = self.cfg, self.attn_impl
+        k = self.speculate_k
+        max_seq = self.max_seq
+        max_ngram = self.spec_max_ngram
+        H = self.spec_hist_window
+
+        @functools.partial(jax.jit, donate_argnums=(1,),
+                           **self._step_out_shardings(4))
+        def fused(params, big_cache, tokens, hist, rem, temps, topks,
+                  topps, active, rngs):
+            def round_body(carry, rng):
+                cache, tok, hist, rem = carry
+                prop, n_prop = speculative.ngram_propose_device(
+                    hist, k, max_ngram=max_ngram)
+                # Budget carry: at most ``rem`` tokens may still commit
+                # (n_commit <= n_prop + 1) — _spec_build_proposals's
+                # cap, applied round by round on device.
+                n_prop = jnp.minimum(n_prop, jnp.maximum(rem - 1, 0))
+                act = active & (rem >= 1)
+                commit, n_commit, new_tok, new_cache = \
+                    _slot_spec_verify(
+                        params, cache, tok, prop, n_prop, temps,
+                        topks, topps, act, rng, cfg=cfg,
+                        attn_impl=attn_impl, kv_bucket=kv_bucket,
+                        max_seq=max_seq, k=k, sample=sample)
+                # History carry: append the commit row and re-right-
+                # align (shift left by n_commit; uncommitted positions
+                # land past the window and are never gathered).
+                combined = jnp.concatenate([hist, commit], axis=1)
+                gidx = (jnp.arange(H, dtype=jnp.int32)[None, :]
+                        + n_commit[:, None])
+                new_hist = jnp.take_along_axis(combined, gidx, axis=1)
+                return ((new_cache, new_tok, new_hist,
+                         rem - n_commit),
+                        (commit, n_commit, n_prop))
+
+            (big_cache, tokens, hist, rem), stacked = jax.lax.scan(
+                round_body, (big_cache, tokens, hist, rem), rngs)
+            commits, n_commits, n_props = stacked
+            return commits, n_commits, n_props, tokens, big_cache
+
+        self._spec_verify_fns[key] = fused
+        return fused
 
     def _spec_verify_call(self, ready, proposals, n_prop):
         temps_d, topks_d, topps_d, active_d, sample = \
@@ -1679,6 +1819,35 @@ class InferenceEngine(SpeculativeMixin, _EngineBase):
                 temps_d, topks_d, topps_d, active_d, rng)
         return commit, n_commit
 
+    def _spec_fused_call(self, ready, rounds):
+        """Dispatch ``rounds`` fused propose→verify→commit rounds in one
+        jitted call (``_spec_step_fused``). The kv bucket covers the
+        worst-case growth ``rounds * (k + 1)`` so every in-scan round
+        reads a long-enough cache slice."""
+        temps_d, topks_d, topps_d, active_d, sample = \
+            self._slot_meta(ready)
+        k = self.speculate_k
+        max_live = int(max(self._slot_len[s]
+                           for s in range(self.max_batch)
+                           if self._slots[s] is not None))
+        kv_bucket = min(self.max_seq,
+                        _bucket_len(max_live + rounds * (k + 1)))
+        if kv_bucket > self.max_seq // 2:
+            kv_bucket = self.max_seq
+        hist, rem = self._spec_hist_state(ready)
+        keys = jax.random.split(self._rng, rounds + 1)
+        self._rng = keys[0]
+        hist_d, rem_d = device_upload((hist, rem))
+        fused = self._get_spec_fused(sample, kv_bucket, rounds)
+        with self._prof.jit_key('spec_fused',
+                                (self.speculate_k, sample, kv_bucket,
+                                 rounds)):
+            commits, n_commits, n_props, self._tok_dev, self.cache = \
+                fused(self.params, self.cache, self._tok_dev, hist_d,
+                      rem_d, temps_d, topks_d, topps_d, active_d,
+                      keys[1:])
+        return commits, n_commits, n_props
+
     def step(self, horizon: int = 1) -> List[Tuple[int, int, bool]]:
         """Chunked scheduling loop: admit (one chunk batch max), then
         enqueue decode through the async pipeline. While prompts are
@@ -1689,7 +1858,9 @@ class InferenceEngine(SpeculativeMixin, _EngineBase):
         Monolithic mode keeps _EngineBase.step semantics unchanged.
         ``speculate_k > 0`` replaces the fused decode horizon with one
         synchronous propose→verify→commit round per step (admission —
-        chunked or monolithic — is unchanged)."""
+        chunked or monolithic — is unchanged); adding
+        ``decode_steps_per_call > 1`` fuses that many rounds into one
+        dispatch instead (in-scan speculative verify)."""
         if not self.chunked and not self.speculate_k:
             return super().step(horizon)
         events: List[Tuple[int, int, bool]] = []
@@ -1699,7 +1870,10 @@ class InferenceEngine(SpeculativeMixin, _EngineBase):
         with self._prof.phase('admit'):
             events.extend(self._admit())
         if self.speculate_k:
-            events.extend(self._spec_step())
+            if (self.decode_steps_per_call or 0) > 1:
+                events.extend(self._spec_step_fused())
+            else:
+                events.extend(self._spec_step())
             return events
         if self.decode_steps_per_call:
             # Multi-step pin: exactly k fused steps per call — the
@@ -1750,7 +1924,7 @@ class InferenceEngine(SpeculativeMixin, _EngineBase):
         # overflow requeues at the FRONT (keeps FIFO) for the next step.
         bucket = min(_bucket_len(max(len(r.prompt) for _, r in batch)),
                      self.max_seq)
-        scratch_tok = kv_token_bytes(self.cfg, self.cache.quantized,
+        scratch_tok = kv_token_bytes(self.cfg, self.kv_cache_dtype,
                                      mesh=self.mesh)
         fit = int(0.75e9) // max(1, bucket * scratch_tok)
         cap = 1
@@ -1871,11 +2045,16 @@ class InferenceEngine(SpeculativeMixin, _EngineBase):
         # decode substeps (the multi-step amortization the profiler's
         # per_substep_ms split makes visible).
         self._prof.note_substeps('decode_enqueue', horizon)
+        t0 = clock.monotonic()
         with self._prof.jit_key('decode', (horizon, sample, kv_bucket)):
             toks, self.cache = self._decode_fn(
                 self.params, self.cache, self._tok_dev, rng,
                 temps_d, topks_d, topps_d, active_d, horizon, sample,
                 kv_bucket)
+        live = int(sum(self._slot_len[s] + self._inflight_steps
+                       for s in range(self.max_batch)
+                       if ready[s] is not None))
+        self._note_decode_step(live, horizon, clock.monotonic() - t0)
         self._tok_dev = toks[:, -1]
         self._inflight_steps += horizon
         self._pending.append({'kind': 'decode', 'toks': toks,

@@ -98,6 +98,12 @@ class HandoffCapacityError(RuntimeError):
 _INT8_BUFFERS: Tuple[Tuple[str, str, int], ...] = (
     ('k_codes', 'int8', 4), ('v_codes', 'int8', 4),
     ('k_scales', 'float32', 3), ('v_scales', 'float32', 3))
+# int4 rides the int8 wire layout with PACKED uint8 nibble codes
+# (head_dim/2 bytes per row) — codes+scales ship verbatim (GC114),
+# never unpacked or widened on the wire.
+_INT4_BUFFERS: Tuple[Tuple[str, str, int], ...] = (
+    ('k_codes', 'uint8', 4), ('v_codes', 'uint8', 4),
+    ('k_scales', 'float32', 3), ('v_scales', 'float32', 3))
 _BF16_BUFFERS: Tuple[Tuple[str, str, int], ...] = (
     ('k_rows', 'bfloat16', 4), ('v_rows', 'bfloat16', 4))
 
@@ -111,7 +117,7 @@ def _np_dtype(name: str):
     if name == 'bfloat16':
         import ml_dtypes
         return np.dtype(ml_dtypes.bfloat16)
-    if name in ('int8', 'float32'):
+    if name in ('int8', 'uint8', 'float32'):
         return np.dtype(name)
     raise ValueError(f'unsupported wire buffer dtype {name!r}')
 
@@ -119,6 +125,8 @@ def _np_dtype(name: str):
 def _manifest(kv_cache_dtype: str) -> Tuple[Tuple[str, str, int], ...]:
     if kv_cache_dtype == 'int8':
         return _INT8_BUFFERS
+    if kv_cache_dtype == 'int4':
+        return _INT4_BUFFERS
     if kv_cache_dtype == 'bf16':
         return _BF16_BUFFERS
     raise ValueError(
@@ -127,7 +135,7 @@ def _manifest(kv_cache_dtype: str) -> Tuple[Tuple[str, str, int], ...]:
 
 def snapshot_buffers(snapshot: Dict[str, Any]) -> Dict[str, np.ndarray]:
     """The snapshot's KV arrays keyed by wire buffer name."""
-    if snapshot['kv_cache_dtype'] == 'int8':
+    if snapshot['kv_cache_dtype'] in ('int8', 'int4'):
         return {'k_codes': snapshot['k'], 'v_codes': snapshot['v'],
                 'k_scales': snapshot['k_scale'],
                 'v_scales': snapshot['v_scale']}
@@ -245,7 +253,9 @@ def decode_handoff(data: bytes) -> Dict[str, Any]:
                f'{name}: bad shape {shape!r}')
         expect = [model['n_layers'], n_rows, model['n_kv_heads']]
         if rank == 4:
-            expect.append(model['head_dim'])
+            # Packed int4 code rows carry head_dim/2 bytes.
+            expect.append(model['head_dim'] // 2 if kv_dtype == 'int4'
+                          else model['head_dim'])
         _check(shape == expect,
                f'{name}: shape {shape} != expected {expect}')
         _check(len(data) >= off + 8, f'{name}: truncated length prefix')
@@ -284,7 +294,7 @@ def decode_handoff(data: bytes) -> Dict[str, Any]:
                   for k in ('n_layers', 'n_kv_heads', 'head_dim')},
     }
     snap.update({k: req[k] for k in REQUEST_FIELDS})
-    if kv_dtype == 'int8':
+    if kv_dtype in ('int8', 'int4'):
         snap.update(k=arrays['k_codes'], v=arrays['v_codes'],
                     k_scale=arrays['k_scales'],
                     v_scale=arrays['v_scales'])
@@ -415,7 +425,9 @@ def decode_prefix_chain(data: bytes) -> Dict[str, Any]:
                f'{name}: bad shape {shape!r}')
         expect = [model['n_layers'], n_rows, model['n_kv_heads']]
         if rank == 4:
-            expect.append(model['head_dim'])
+            # Packed int4 code rows carry head_dim/2 bytes.
+            expect.append(model['head_dim'] // 2 if kv_dtype == 'int4'
+                          else model['head_dim'])
         _check(shape == expect,
                f'{name}: shape {shape} != expected {expect}')
         _check(len(data) >= off + 8, f'{name}: truncated length prefix')
@@ -454,7 +466,7 @@ def decode_prefix_chain(data: bytes) -> Dict[str, Any]:
                   for k in ('n_layers', 'n_kv_heads', 'head_dim')},
         'tokens': tokens,
     }
-    if kv_dtype == 'int8':
+    if kv_dtype in ('int8', 'int4'):
         entry.update(k=arrays['k_codes'], v=arrays['v_codes'],
                      k_scale=arrays['k_scales'],
                      v_scale=arrays['v_scales'])

@@ -69,6 +69,20 @@ class PagedKVCache(NamedTuple):
         return self.k_scale is not None
 
     @property
+    def packed(self) -> bool:
+        """int4 pools: two nibble codes per byte along head_dim
+        (uint8, head_dim/2); scales ride the int8 layout."""
+        return self.pool_k.dtype == jnp.uint8
+
+    @property
+    def quant_mode(self):
+        """False | True (int8) | 'int4' — the mode every write-side
+        quantizer keys on (``_maybe_quantize_rows``)."""
+        if self.packed:
+            return 'int4'
+        return self.quantized
+
+    @property
     def page_size(self) -> int:
         return self.pool_k.shape[3]
 
@@ -78,11 +92,22 @@ class PagedKVCache(NamedTuple):
 
     @classmethod
     def create(cls, cfg: ModelConfig, *, n_pages: int,
-               page_size: int = 128, quantized: bool = False
-               ) -> 'PagedKVCache':
+               page_size: int = 128, quantized: bool = False,
+               kv_dtype: Optional[str] = None) -> 'PagedKVCache':
+        if kv_dtype is None:
+            kv_dtype = 'int8' if quantized else 'bf16'
         shape = (cfg.n_layers, n_pages, cfg.n_kv_heads, page_size,
                  cfg.head_dim)
-        if quantized:
+        if kv_dtype == 'int4':
+            if cfg.head_dim % 2:
+                raise ValueError('int4 KV needs an even head_dim')
+            pshape = shape[:-1] + (cfg.head_dim // 2,)
+            sshape = shape[:-1]
+            return cls(pool_k=jnp.zeros(pshape, jnp.uint8),
+                       pool_v=jnp.zeros(pshape, jnp.uint8),
+                       k_scale=jnp.zeros(sshape, jnp.float32),
+                       v_scale=jnp.zeros(sshape, jnp.float32))
+        if kv_dtype == 'int8' or quantized:
             sshape = shape[:-1]
             return cls(pool_k=jnp.zeros(shape, jnp.int8),
                        pool_v=jnp.zeros(shape, jnp.int8),
@@ -308,14 +333,17 @@ def _merge_rows_sharded(cache: PagedKVCache, k_rows, v_rows,
     return cache._replace(pool_k=out[0], pool_v=out[1])
 
 
-def _maybe_quantize_rows(new_kv, quantized: bool):
+def _maybe_quantize_rows(new_kv, quantized):
     """(k_rows, v_rows) bf16 -> ((kq, ks), (vq, vs)) when the pool is
-    int8; identity otherwise. Runs INSIDE the per-layer scan."""
+    quantized (``quantized``: False | True/int8 | 'int4' — the cache's
+    ``quant_mode``); identity otherwise. Runs INSIDE the per-layer
+    scan."""
     if not quantized:
         return new_kv
+    quant = (llama.quantize_kv_rows4 if quantized == 'int4'
+             else llama.quantize_kv_rows)
     k_rows, v_rows = new_kv
-    return (llama.quantize_kv_rows(k_rows),
-            llama.quantize_kv_rows(v_rows))
+    return (quant(k_rows), quant(v_rows))
 
 
 def _gather_layer(pool_layer: jax.Array, scale_layer, table_p: jax.Array):
@@ -350,7 +378,7 @@ def paged_decode_horizon(
     sample_fn=None,
     rngs: Optional[jax.Array] = None,
     active: Optional[jax.Array] = None,
-    decode_impl: str = 'gather',       # 'gather' | 'pallas'
+    decode_impl: str = 'gather',       # 'gather' | 'pallas' | 'cross_layer'
     pages_per_block: int = 1,          # pallas path: K pages per DMA loop
 ):
     """``horizon`` fused decode steps over the paged pool — the twin of
@@ -411,19 +439,42 @@ def paged_decode_horizon(
                         pages_per_block=pages_per_block)
                     return merge_partial_with_ring_self(
                         partial, q, k, v, rk, rv, i)
+            elif decode_impl == 'cross_layer':
+                # Fused-merge kernel: the ring + current-token blocks
+                # fold into the cache softmax INSIDE the kernel, so the
+                # per-layer XLA merge program (and its f32 partial
+                # triple bouncing through HBM every layer of every
+                # step) disappears from the scan. Same scalar-prefetch
+                # pool discipline as 'pallas'.
+                from skypilot_tpu.ops.paged_attention import (
+                    paged_decode_attention_fused)
+                interp = jax.default_backend() != 'tpu'
+
+                def attn_fn(q, k, v):
+                    out = paged_decode_attention_fused(
+                        q[:, 0], k[:, 0], v[:, 0], rk, rv, i,
+                        pool_k, pool_v, table_p, len0,
+                        ks_pool, vs_pool, layer=li, interpret=interp)
+                    return out[:, None]
             else:
-                pk = lax.dynamic_index_in_dim(pool_k, li, 0,
+                # The ONE grandfathered per-layer gather on the decode
+                # path (GC121): the XLA-only fallback for backends /
+                # head_dims the kernels don't cover. Every suppression
+                # below is deliberate — a new gather-per-layer site
+                # anywhere else on the decode path hard-fails
+                # graftcheck.
+                pk = lax.dynamic_index_in_dim(pool_k, li, 0,  # graftcheck: disable=GC121
                                               keepdims=False)
-                pv = lax.dynamic_index_in_dim(pool_v, li, 0,
+                pv = lax.dynamic_index_in_dim(pool_v, li, 0,  # graftcheck: disable=GC121
                                               keepdims=False)
-                sk = (lax.dynamic_index_in_dim(ks_pool, li, 0,
+                sk = (lax.dynamic_index_in_dim(ks_pool, li, 0,  # graftcheck: disable=GC121
                                                keepdims=False)
                       if cache.quantized else None)
-                sv = (lax.dynamic_index_in_dim(vs_pool, li, 0,
+                sv = (lax.dynamic_index_in_dim(vs_pool, li, 0,  # graftcheck: disable=GC121
                                                keepdims=False)
                       if cache.quantized else None)
-                ck, sck = _gather_layer(pk, sk, table_p)
-                cv, scv = _gather_layer(pv, sv, table_p)
+                ck, sck = _gather_layer(pk, sk, table_p)  # graftcheck: disable=GC121
+                cv, scv = _gather_layer(pv, sv, table_p)  # graftcheck: disable=GC121
 
                 def attn_fn(q, k, v):
                     return ring_decode_attention(q, k, v, ck, cv, len0,
@@ -471,7 +522,7 @@ def merge_ring_into_pool(cache: PagedKVCache, ring_k, ring_v,
     horizon = ring_k.shape[2]
     act = (active.astype(jnp.int32) if active is not None
            else jnp.ones_like(lengths))
-    rk, rv = _maybe_quantize_rows((ring_k, ring_v), cache.quantized)
+    rk, rv = _maybe_quantize_rows((ring_k, ring_v), cache.quant_mode)
     return merge_rows_into_pool(cache, rk, rv, table_p, lengths,
                                 valid_len=act * horizon, mesh=mesh)
 
@@ -536,7 +587,7 @@ def paged_prefill_chunk(
                                           attn_fn)
         # Quantize inside the scan: the stacked [L, n, chunk] ys stay
         # int8 (the bf16 stack is the 7B prefill's biggest transient).
-        return xc, _maybe_quantize_rows(new_kv, cache.quantized)
+        return xc, _maybe_quantize_rows(new_kv, cache.quant_mode)
 
     import contextlib
     from skypilot_tpu.models.quantization import w8a8_region
@@ -623,7 +674,7 @@ def paged_spec_verify(
 
         xc, new_kv, _ = llama._layer_core(layer, xc, cfg, positions,
                                           attn_fn)
-        return xc, _maybe_quantize_rows(new_kv, cache.quantized)
+        return xc, _maybe_quantize_rows(new_kv, cache.quant_mode)
 
     import contextlib
     from skypilot_tpu.models.quantization import w8a8_region
@@ -921,7 +972,7 @@ class PagedInferenceEngine(SpeculativeMixin, _EngineBase):
         self.alloc = PageAllocator(n_pages, page_size)
         self.cache = PagedKVCache.create(cfg, n_pages=n_pages,
                                          page_size=page_size,
-                                         quantized=kv_int8)
+                                         kv_dtype=self.kv_cache_dtype)
         # Pre-partitioned pool + pinned output shardings: the pool is
         # device_put ONCE (kv heads over tp; pages replicated — the
         # page table indexes them dynamically, so a page-sharded pool
@@ -948,9 +999,14 @@ class PagedInferenceEngine(SpeculativeMixin, _EngineBase):
         if decode_impl == 'auto':
             # The Pallas kernel needs 128-lane head_dim; on CPU its
             # interpret mode is correct but slow, so auto picks it only
-            # on a real TPU backend (tests opt in explicitly).
+            # on a real TPU backend (tests opt in explicitly). int4
+            # pools stay on the gather path under auto for now: the
+            # packed uint8 page blocks halve the minor dim below the
+            # 128-lane tile (explicit 'pallas'/'cross_layer' still
+            # work — interpret-validated — for users who opt in).
             decode_impl = ('pallas' if cfg.head_dim % 128 == 0
                            and jax.default_backend() == 'tpu'
+                           and self.kv_cache_dtype != 'int4'
                            and mesh is None else 'gather')
         self.decode_impl = decode_impl
 
@@ -995,7 +1051,7 @@ class PagedInferenceEngine(SpeculativeMixin, _EngineBase):
         # _PREFILL_STACK_BUDGET (at n=32 x chunk=256 on a 7B the two
         # stacks alone are 2 GB — the compile OOM'd the chip).
         # _auto_n_pages reserves the same budget.
-        tok_bytes = self._page_bytes(self.cfg, 1, self.cache.quantized,
+        tok_bytes = self._page_bytes(self.cfg, 1, self.kv_cache_dtype,
                                      mesh=self.mesh)
         n_fit = int(self._PREFILL_STACK_BUDGET // max(1, chunk *
                                                       tok_bytes))
@@ -1056,7 +1112,7 @@ class PagedInferenceEngine(SpeculativeMixin, _EngineBase):
 
     @staticmethod
     def _page_bytes(cfg: ModelConfig, page_size: int,
-                    quantized: bool, mesh=None) -> int:
+                    quantized, mesh=None) -> int:
         """Stored bytes of one page; with ``mesh``, PER-DEVICE bytes
         (kv heads shard over tp — the pool's pages replicate over dp,
         so dp never divides). HBM sizing passes the mesh; reporting
@@ -1079,7 +1135,7 @@ class PagedInferenceEngine(SpeculativeMixin, _EngineBase):
         # dtype — with the flags decoupled (int8 weights + bf16 KV or
         # vice versa) sizing the pool off the params would mis-state
         # capacity by 2x in either direction.
-        quantized = self.kv_cache_dtype == 'int8'
+        quantized = self.kv_cache_dtype
         try:
             stats = jax.devices()[0].memory_stats()
             limit = stats['bytes_limit']
@@ -1248,7 +1304,7 @@ class PagedInferenceEngine(SpeculativeMixin, _EngineBase):
 
     def memory_stats(self) -> Dict[str, Any]:
         page_bytes = self._page_bytes(self.cfg, self.page,
-                                      self.cache.quantized)
+                                      self.kv_cache_dtype)
         used = self.alloc.n_pages - 1 - len(self.alloc.free) \
             - len(self.alloc.retained)
         return {
@@ -1282,13 +1338,13 @@ class PagedInferenceEngine(SpeculativeMixin, _EngineBase):
             'tokens_free': cap - used,
             'preemptions': int(self.preemptions),
             'kv_token_bytes': kv_token_bytes(self.cfg,
-                                             self.cache.quantized),
+                                             self.kv_cache_dtype),
             # Per-DEVICE byte view (kv heads shard over tp; pages
             # replicate over dp): token counts above stay GLOBAL so
             # scheduler bounds and preemption pressure mean the same
             # thing at any mesh shape.
             'kv_token_bytes_per_shard': kv_token_bytes(
-                self.cfg, self.cache.quantized, mesh=self.mesh),
+                self.cfg, self.kv_cache_dtype, mesh=self.mesh),
             'kv_shards': kv_shard_degree(self.cfg, self.mesh),
         }
 
@@ -1480,6 +1536,31 @@ class PagedInferenceEngine(SpeculativeMixin, _EngineBase):
             # and the completed-prefill logits ARE its next token.
             ctx = req.prompt + req.output
             matched = self.alloc.match_prefix(ctx)
+            # Quantize the resume point to the canonical chunk grid.
+            # A cold prefill chunks from offset 0, so its boundaries are
+            # exact multiples of ``self.chunk``; resuming a prefix hit at
+            # an arbitrary page boundary regroups the same attention
+            # terms across cached_attention's two softmax blocks
+            # (cache-sum + in-chunk-sum), and the few-ULP denominator
+            # difference flips greedy argmax on near-tie logits — the
+            # hit path would emit different bytes than the cold path for
+            # the SAME request. Keeping only matched pages up to a
+            # chunk-multiple boundary makes every hit-path chunk run the
+            # byte-identical program on byte-identical operands (same
+            # rationale as _preempt_slot registering original bytes).
+            # ``alloc.prefix_hits`` still counts the match; surplus
+            # pages return to the retained LRU, not the free list.
+            # Preemption re-entry (req.output non-empty) is exempt: it
+            # resumes from its OWN pages registered by _preempt_slot
+            # with the original bytes, so the restore is exact and the
+            # uninterrupted-run contract needs the mid-grid resume.
+            if not req.output:
+                keep = len(matched)
+                while keep and (keep * self.page) % self.chunk:
+                    keep -= 1
+                for p in matched[keep:]:
+                    self.alloc.release(p)
+                matched = matched[:keep]
             self._pages[slot] = list(matched)
             if not self._ensure_pages(slot, len(ctx)):
                 # Pool pressure: back to the FRONT of the queue (tail
@@ -1899,12 +1980,15 @@ class PagedInferenceEngine(SpeculativeMixin, _EngineBase):
         starts = np.zeros(1, np.int32)
         valid = np.array([n_rows], np.int32)
         ingest = self._get_ingest(nb, P)
+        # Packed int4 rows carry head_dim/2 code bytes per row — the
+        # scatter is tail-shape-generic, only the pad buffer cares.
+        code_d = cfg.head_dim // 2 if self.cache.packed else cfg.head_dim
         if self.cache.quantized:
             (kq, ks, vq, vs, table_d, starts_d,
              valid_d) = device_upload(
-                (pad(snap['k'], (cfg.head_dim,)),
+                (pad(snap['k'], (code_d,)),
                  pad(snap['k_scale'], (1,)),
-                 pad(snap['v'], (cfg.head_dim,)),
+                 pad(snap['v'], (code_d,)),
                  pad(snap['v_scale'], (1,)), table, starts, valid))
             self.cache = ingest(self.cache, kq, ks, vq, vs,
                                 table_d, starts_d, valid_d)
@@ -2014,6 +2098,106 @@ class PagedInferenceEngine(SpeculativeMixin, _EngineBase):
                 rng)
         return commit, n_commit
 
+    def _spec_can_fuse(self, slot: int, rounds: int) -> bool:
+        """Up-front page reservation for the fused in-scan rounds: the
+        device commits up to ``rounds * (k + 1)`` rows with no host
+        between rounds, so every covering page must exist BEFORE
+        dispatch. Returning False sends the mixin to the single-round
+        ``_spec_step`` (which shrinks its cover per round under pool
+        pressure). Pages reserved here stay with the slot either way
+        and release at slot free."""
+        base = int(self._slot_len[slot])
+        return self._ensure_pages(
+            slot, base + rounds * (self.speculate_k + 1))
+
+    def _get_spec_fused(self, n: int, P: int, sample: bool,
+                        rounds: int):
+        """Compiled in-scan speculative rounds over the paged pool:
+        ``rounds`` x (device n-gram propose → ``paged_spec_verify`` →
+        masked merge) fused into ONE program via lax.scan, with the
+        per-slot lengths, history window, and remaining-token budgets
+        carried between rounds. jit key: (k, sample, P, rounds)."""
+        key = ('fused', self.speculate_k, sample, P, rounds)
+        if key not in self._spec_verify_fns:
+            from skypilot_tpu.inference import speculative
+            cfg = self.cfg
+            w8a8 = self.prefill_w8a8
+            mesh = self.mesh
+            k = self.speculate_k
+            max_ngram = self.spec_max_ngram
+            H = self.spec_hist_window
+
+            @functools.partial(jax.jit, donate_argnums=(1,),
+                               **self._step_out_shardings(4))
+            def fused(params, cache, table_p, tokens, hist, rem,
+                      lengths, active, temps, topks, topps, rngs):
+                def round_body(carry, rng):
+                    cache, tok, hist, rem, lens = carry
+                    prop, n_prop = speculative.ngram_propose_device(
+                        hist, k, max_ngram=max_ngram)
+                    # Budget carry: _spec_build_proposals's cap,
+                    # applied round by round on device (n_commit <=
+                    # n_prop + 1 <= rem never overshoots).
+                    n_prop = jnp.minimum(n_prop,
+                                         jnp.maximum(rem - 1, 0))
+                    act = active & (rem >= 1)
+                    commit, n_commit, new_tok, new_cache = \
+                        paged_spec_verify(
+                            params, cache, table_p, tok, prop, n_prop,
+                            lens, act, cfg, sample=sample, temps=temps,
+                            topks=topks, topps=topps, rng=rng,
+                            w8a8=w8a8, mesh=mesh)
+                    # History carry: append the commit row and
+                    # re-right-align (shift left by n_commit).
+                    combined = jnp.concatenate([hist, commit], axis=1)
+                    gidx = (jnp.arange(H, dtype=jnp.int32)[None, :]
+                            + n_commit[:, None])
+                    new_hist = jnp.take_along_axis(combined, gidx,
+                                                   axis=1)
+                    return ((new_cache, new_tok, new_hist,
+                             rem - n_commit, lens + n_commit),
+                            (commit, n_commit, n_prop))
+
+                (cache, tokens, hist, rem, lengths), stacked = \
+                    lax.scan(round_body,
+                             (cache, tokens, hist, rem, lengths), rngs)
+                commits, n_commits, n_props = stacked
+                return commits, n_commits, n_props, tokens, cache
+
+            self._spec_verify_fns[key] = fused
+        return self._spec_verify_fns[key]
+
+    def _spec_fused_call(self, ready, rounds):
+        """Dispatch ``rounds`` fused propose→verify→commit rounds in
+        one jitted call (``_spec_step_fused``). ``_spec_can_fuse``
+        already reserved pages covering the worst-case growth, so the
+        page table built here spans every in-scan commit."""
+        from skypilot_tpu.inference.engine import _bucket_len
+        temps_d, topks_d, topps_d, active_d, sample = \
+            self._slot_meta(ready)
+        P_needed = max(max((len(self._pages[s])
+                            for s, r in enumerate(ready)
+                            if r is not None), default=1), 1)
+        P = _bucket_len(P_needed, minimum=1)
+        table_p = np.zeros((self.max_batch, P), np.int32)
+        for s in range(self.max_batch):
+            ps = self._pages[s][:P]
+            table_p[s, :len(ps)] = ps
+        lengths = self._slot_len.astype(np.int32)
+        hist, rem = self._spec_hist_state(ready)
+        keys = jax.random.split(self._rng, rounds + 1)
+        self._rng = keys[0]
+        table_d, hist_d, rem_d, lengths_d = device_upload(
+            (table_p, hist, rem, lengths))
+        fused = self._get_spec_fused(self.max_batch, P, sample, rounds)
+        with self._prof.jit_key('spec_fused',
+                                (self.speculate_k, sample, P, rounds)):
+            commits, n_commits, n_props, self._tok_dev, self.cache = \
+                fused(self.params, self.cache, table_d, self._tok_dev,
+                      hist_d, rem_d, lengths_d, active_d, temps_d,
+                      topks_d, topps_d, keys[1:])
+        return commits, n_commits, n_props
+
     def step(self, horizon: int = 1) -> List[Tuple[int, int, bool]]:
         """Admit (one chunk max), then enqueue decode through the async
         pipeline (_EngineBase semantics: results lag enqueues by up to
@@ -2024,7 +2208,9 @@ class PagedInferenceEngine(SpeculativeMixin, _EngineBase):
         queue is non-empty so freed slots are noticed promptly. Steady
         state (no queue, no prefill) runs the caller's full horizon.
         ``speculate_k > 0`` replaces the fused decode horizon with one
-        synchronous propose→verify→commit round per step."""
+        synchronous propose→verify→commit round per step; adding
+        ``decode_steps_per_call > 1`` fuses that many rounds into one
+        dispatch instead (in-scan speculative verify)."""
         events: List[Tuple[int, int, bool]] = []
         with self._prof.phase('readback'):
             while len(self._pending) >= self._PIPELINE_DEPTH:
@@ -2032,7 +2218,10 @@ class PagedInferenceEngine(SpeculativeMixin, _EngineBase):
         with self._prof.phase('admit'):
             events.extend(self._admit())
         if self.speculate_k:
-            events.extend(self._spec_step())
+            if (self.decode_steps_per_call or 0) > 1:
+                events.extend(self._spec_step_fused())
+            else:
+                events.extend(self._spec_step())
             if self._deferred_events:
                 events.extend(self._deferred_events)
                 self._deferred_events = []
@@ -2189,11 +2378,14 @@ class PagedInferenceEngine(SpeculativeMixin, _EngineBase):
         # decode substeps (multi-step amortization; the profiler's
         # per_substep_ms split makes it visible).
         self._prof.note_substeps('decode_enqueue', horizon)
+        t0 = clock.monotonic()
         with self._prof.jit_key('decode', (horizon, sample, P)):
             toks, self.cache = self._decode_fn(
                 self.params, self.cache, table_dd,
                 self._tok_dev, lengths_dd, rng,
                 temps_d, topks_d, topps_d, active_d, horizon, sample)
+        live = int(sum(int(lengths[s]) for s in active_slots))
+        self._note_decode_step(live, horizon, clock.monotonic() - t0)
         self._tok_dev = toks[:, -1]
         # Snapshot the epochs BEFORE any early free below bumps them:
         # the entry must record the epochs its tokens were produced

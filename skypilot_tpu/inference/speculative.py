@@ -64,6 +64,53 @@ def ngram_propose(hist, k: int, max_ngram: int = 3,
     return np.zeros((0,), np.int32)
 
 
+def ngram_propose_device(hist, k: int, max_ngram: int = 3,
+                         min_ngram: int = 1):
+    """Device-side prompt-lookup proposal over a RIGHT-ALIGNED history
+    window — the in-scan analogue of :func:`ngram_propose`, used by the
+    fused speculative rounds (``_spec_step_fused``) where the host
+    cannot see mid-scan commits to propose from.
+
+    ``hist`` is ``[b, H]`` int32, left-padded with ``-1`` (token ids
+    are non-negative, so pad never matches), last column = the current
+    (not yet cache-consumed) token. Matching is limited to the window —
+    matches the host proposer would find further back are missed, which
+    only costs acceptance rate, never correctness (greedy commits are
+    the verify model's own argmax regardless of what was proposed).
+
+    Returns ``(proposals [b, k] int32, n_prop [b] int32)`` with
+    positions past each row's ``n_prop`` zeroed. Runs INSIDE the
+    engines' jitted fused-rounds programs (traced, fixed shapes)."""
+    import jax.numpy as jnp
+
+    b, H = hist.shape
+    best_begin = jnp.zeros((b,), jnp.int32)
+    best_found = jnp.zeros((b,), bool)
+    for m in range(min(max_ngram, H - 1), min_ngram - 1, -1):
+        gram = hist[:, H - m:]                               # [b, m]
+        # Every window hist[:, p:p+m] as stacked static slices (m is
+        # tiny and static, so this is a handful of cheap views).
+        win = jnp.stack([hist[:, j:H - m + 1 + j]
+                         for j in range(m)], axis=-1)        # [b, W, m]
+        p_idx = jnp.arange(H - m + 1, dtype=jnp.int32)
+        # Usable: full match, continuation strictly before the trailing
+        # gram itself (p + m < H), window clear of the left pad.
+        ok = (jnp.all(win == gram[:, None, :], axis=-1)
+              & (p_idx[None, :] + m < H) & (win[:, :, 0] >= 0))
+        p_best = jnp.max(jnp.where(ok, p_idx[None, :], -1), axis=1)
+        found_m = p_best >= 0
+        take = found_m & ~best_found
+        best_begin = jnp.where(take, p_best + m, best_begin)
+        best_found = best_found | found_m
+    idx = jnp.clip(best_begin[:, None] + jnp.arange(k)[None, :],
+                   0, H - 1)
+    prop = jnp.take_along_axis(hist, idx, axis=1).astype(jnp.int32)
+    n_prop = jnp.where(best_found, jnp.minimum(k, H - best_begin),
+                       0).astype(jnp.int32)
+    prop = jnp.where(jnp.arange(k)[None, :] < n_prop[:, None], prop, 0)
+    return prop, n_prop
+
+
 # --------------------------------------------------------------------------
 # Device-side acceptance (shared by both engines' verify programs)
 # --------------------------------------------------------------------------
@@ -154,11 +201,24 @@ class SpeculativeMixin:
     round): the proposer needs the committed tokens on the host before
     it can propose the next continuation, so the verify readback cannot
     lag like the fused-decode pipeline. Each round still amortizes the
-    weight stream over up to k+1 tokens per slot."""
+    weight stream over up to k+1 tokens per slot.
+
+    With ``decode_steps_per_call > 1`` set alongside ``speculate_k``,
+    engines that also implement ``_spec_fused_call(ready, rounds)``
+    route through ``_spec_step_fused()`` instead: the proposer moves ON
+    DEVICE (``ngram_propose_device``) and ``rounds`` whole
+    propose→verify→commit rounds fuse into one dispatch, so the
+    host_sync amortizes ``rounds`` x on top of speculation's k+1 x."""
 
     # Longest n-gram the proposer tries to match (host-side knob; not
     # part of any jit key).
     spec_max_ngram = 3
+
+    # History window the DEVICE proposer sees in fused rounds
+    # (``_spec_step_fused``); host uploads the trailing ``H`` tokens
+    # per slot each dispatch. Shapes a jitted program, so it is a
+    # class-level constant, not a jit key.
+    spec_hist_window = 64
 
     def _init_spec(self, speculate_k: Optional[int]) -> None:
         self.speculate_k = int(speculate_k or 0)
@@ -320,6 +380,111 @@ class SpeculativeMixin:
                 self._slot_len[slot] += 1
                 finished = self._finish_req(slot, req, token)
                 events.append((req.request_id, token, finished))
+                if finished:
+                    break
+        return events
+
+    # ------------------------------------------------- fused (in-scan)
+    def _spec_can_fuse(self, slot: int, rounds: int) -> bool:
+        """Hook: can ``slot`` absorb ``rounds`` fused verify rounds of
+        KV growth (up to ``rounds * (k + 1)`` rows) with no host
+        intervention between rounds? Default yes — the slot engine's
+        sentinel-masked scatter plus the in-scan ``rem`` budget carry
+        already bound writes; the paged engine overrides this with an
+        up-front page reservation."""
+        del slot, rounds
+        return True
+
+    def _spec_hist_state(self, ready) -> Tuple[np.ndarray, np.ndarray]:
+        """Device-proposer inputs for the fused rounds: right-aligned
+        history window ``[b, H]`` (left-padded with -1) and per-slot
+        remaining-token budgets ``[b]``. ``rem`` is the most tokens the
+        slot may still emit (generation budget and sequence capacity),
+        so the in-scan cap ``n_prop <= rem - 1`` reproduces
+        ``_spec_build_proposals``'s budget math round by round and
+        commits never overshoot."""
+        H = self.spec_hist_window
+        b = self.max_batch
+        hist = np.full((b, H), -1, np.int32)
+        rem = np.zeros((b,), np.int32)
+        for slot, req in enumerate(ready):
+            if req is None:
+                continue
+            toks = (req.prompt + req.output)[-H:]
+            hist[slot, H - len(toks):] = toks
+            out = len(req.output)
+            rem[slot] = max(0, min(req.max_new_tokens - out,
+                                   self.max_seq - len(req.prompt) - out))
+        return hist, rem
+
+    def _spec_step_fused(self) -> List[Tuple[int, int, bool]]:
+        """In-scan speculative verify: ``decode_steps_per_call`` rounds
+        of propose→verify→commit fused into ONE jitted dispatch (a
+        ``lax.scan`` over rounds with the DEVICE n-gram proposer and a
+        gather-carried history window), then one sanctioned host_sync
+        for the stacked commits. Composes the two amortization knobs:
+        speculation's up-to-``k+1`` tokens per weight stream AND the
+        multi-step pin's one dispatch per ``rounds`` verify rounds.
+
+        Tokens a slot commits after finishing mid-scan (EOS hit in an
+        earlier round — the device cannot see host finish state) are
+        discarded at readback, same as vanilla multi-step decode past
+        EOS; the ``rem`` carry guarantees the device never writes past
+        ``max_new_tokens`` or the sequence capacity. Falls back to the
+        synchronous single-round ``_spec_step`` when any active slot
+        cannot reserve the fused KV growth up front (``_spec_can_fuse``
+        — paged pool pressure)."""
+        from skypilot_tpu.telemetry import clock
+        from skypilot_tpu.utils.host import host_sync
+        rounds = self.decode_steps_per_call or 1
+        if rounds <= 1:
+            return self._spec_step()
+        events: List[Tuple[int, int, bool]] = []
+        with self._prof.phase('readback'):
+            while self._pending:
+                events.extend(self._process_one())
+        ready = self._decode_ready()
+        if not any(r is not None for r in ready):
+            return events
+        if not all(self._spec_can_fuse(slot, rounds)
+                   for slot, r in enumerate(ready) if r is not None):
+            events.extend(self._spec_step())
+            return events
+        round_t0 = clock.monotonic()
+        with self._prof.phase('spec_verify'):
+            commits, n_commits, n_props = \
+                self._spec_fused_call(ready, rounds)
+            # THE sanctioned readback: one host_sync per ``rounds``
+            # verify rounds (vs one per round in _spec_step).
+            commits_h = host_sync(commits)
+            n_commits_h = host_sync(n_commits)
+            n_props_h = host_sync(n_props)
+        round_t1 = clock.monotonic()
+        self._spec_rounds += rounds
+        for slot, req in enumerate(ready):
+            if req is None or req.finish_time is not None:
+                continue
+            finished = False
+            for r in range(rounds):
+                m = int(n_commits_h[r, slot])
+                if m <= 0:
+                    continue
+                self._spec_slot_steps += 1
+                self._spec_proposed += int(n_props_h[r, slot])
+                self._spec_accepted += m - 1
+                self._spec_committed += m
+                if req.trace is not None:
+                    req.trace.add('spec_round', round_t0, round_t1,
+                                  proposed=int(n_props_h[r, slot]),
+                                  committed=m)
+                for j in range(m):
+                    token = int(commits_h[r, slot, j])
+                    req.output.append(token)
+                    self._slot_len[slot] += 1
+                    finished = self._finish_req(slot, req, token)
+                    events.append((req.request_id, token, finished))
+                    if finished:
+                        break
                 if finished:
                     break
         return events

@@ -160,12 +160,31 @@ class KVCache(NamedTuple):
     def quantized(self) -> bool:
         return self.k_scale is not None
 
+    @property
+    def packed(self) -> bool:
+        """int4 mode: k/v hold two nibble codes per byte (uint8,
+        head_dim halved); scales ride the int8 layout unchanged."""
+        return self.k.dtype == jnp.uint8
+
     @classmethod
     def create(cls, cfg: ModelConfig, batch: int, max_seq: int,
-               quantized: bool = False) -> 'KVCache':
+               quantized: bool = False,
+               kv_dtype: Optional[str] = None) -> 'KVCache':
+        if kv_dtype is None:
+            kv_dtype = 'int8' if quantized else 'bf16'
         shape = (cfg.n_layers, batch, max_seq, cfg.n_kv_heads, cfg.head_dim)
         length = jnp.zeros((batch,), jnp.int32)
-        if quantized:
+        if kv_dtype == 'int4':
+            if cfg.head_dim % 2:
+                raise ValueError('int4 KV needs an even head_dim')
+            pshape = shape[:-1] + (cfg.head_dim // 2,)
+            sshape = shape[:-1] + (1,)
+            return cls(k=jnp.zeros(pshape, jnp.uint8),
+                       v=jnp.zeros(pshape, jnp.uint8),
+                       length=length,
+                       k_scale=jnp.zeros(sshape, jnp.float32),
+                       v_scale=jnp.zeros(sshape, jnp.float32))
+        if kv_dtype == 'int8' or quantized:
             sshape = shape[:-1] + (1,)
             return cls(k=jnp.zeros(shape, jnp.int8),
                        v=jnp.zeros(shape, jnp.int8),
@@ -196,6 +215,22 @@ def quantize_kv_rows(rows: jax.Array) -> Tuple[jax.Array, jax.Array]:
     return q, scale
 
 
+def quantize_kv_rows4(rows: jax.Array) -> Tuple[jax.Array, jax.Array]:
+    """[..., d] bf16 rows -> (packed uint8 [..., d//2] nibble rows,
+    [..., 1] fp32 scales). Same absmax discipline as int8 at 4-bit
+    range (absmax/7, clip +-7); packing rides
+    :func:`quantization.pack_int4` along the HEAD_DIM axis so every
+    token row stays self-contained — single-row appends (decode ring
+    merges, spec commits) never straddle a byte boundary the way a
+    page-axis packing would."""
+    from skypilot_tpu.models import quantization
+    rf = rows.astype(jnp.float32)
+    absmax = jnp.max(jnp.abs(rf), axis=-1, keepdims=True)
+    scale = jnp.maximum(absmax, 1e-8) / 7.0
+    q = jnp.clip(jnp.round(rf / scale), -7, 7).astype(jnp.int8)
+    return quantization.pack_int4(q, axis=-1), scale
+
+
 def merge_rows_into_cache(cache: KVCache, k_rows: jax.Array,
                           v_rows: jax.Array, starts: jax.Array,
                           new_length: jax.Array) -> KVCache:
@@ -212,8 +247,9 @@ def merge_rows_into_cache(cache: KVCache, k_rows: jax.Array,
             c, rows.astype(c.dtype), starts)
 
     if cache.quantized:
-        kq, ks = quantize_kv_rows(k_rows)
-        vq, vs = quantize_kv_rows(v_rows)
+        quant = quantize_kv_rows4 if cache.packed else quantize_kv_rows
+        kq, ks = quant(k_rows)
+        vq, vs = quant(v_rows)
         return KVCache(k=scatter(cache.k, kq), v=scatter(cache.v, vq),
                        length=new_length,
                        k_scale=scatter(cache.k_scale, ks),
@@ -726,7 +762,7 @@ def prefill_rows(
     cfg: ModelConfig,
     *,
     attn_impl: str = 'auto',
-    quantize_rows: bool = False,
+    quantize_rows=False,               # False | True (int8) | 'int4'
     w8a8: bool = False,
     cache_kv=None,                     # per-row cache stacks (chunked
                                        # prefill): ([L, n, S, hkv, d] k,
@@ -778,8 +814,10 @@ def prefill_rows(
 
     def emit_rows(k, v):
         if quantize_rows:
-            kq, ks = quantize_kv_rows(k)
-            vq, vs = quantize_kv_rows(v)
+            quant = (quantize_kv_rows4 if quantize_rows == 'int4'
+                     else quantize_kv_rows)
+            kq, ks = quant(k)
+            vq, vs = quant(v)
             return (kq, vq, ks, vs)
         return (k, v)
 

@@ -78,6 +78,19 @@ def _scale_bhk(s: Optional[jax.Array]) -> Optional[jax.Array]:
     return jnp.transpose(s[..., 0], (0, 2, 1))[:, :, None, None, :]
 
 
+def _unpack_kv(cache_k: jax.Array, cache_v: jax.Array):
+    """int4 KV caches arrive as packed uint8 nibble rows ([..., d//2]);
+    unpack to int8 CODES so the downstream contraction + scale-fold
+    math is byte-for-byte the int8 path's (absmax/7 scales instead of
+    absmax/127 — the fold is scale-agnostic). The unpack is VPU work
+    XLA fuses into the operand read; the HBM stream stays packed."""
+    if cache_k.dtype != jnp.uint8:
+        return cache_k, cache_v
+    from skypilot_tpu.models import quantization
+    return (quantization.unpack_int4(cache_k, axis=-1),
+            quantization.unpack_int4(cache_v, axis=-1))
+
+
 def cached_attention(
     q: jax.Array,                      # [b, s, h, d] new-token queries
     k_new: jax.Array,                  # [b, s, hkv, d] new-token keys
@@ -104,7 +117,9 @@ def cached_attention(
     int8 caches pass CODES + per-row scales: the codes are contracted
     directly (int8 stays int8 across HBM — a pre-dequantized operand
     streams ~30% slower, see quantization.qeinsum) and the row scales
-    fold into the fp32 logits (K) / probabilities (V) exactly."""
+    fold into the fp32 logits (K) / probabilities (V) exactly. int4
+    caches pass PACKED uint8 nibble rows (see ``_unpack_kv``)."""
+    cache_k, cache_v = _unpack_kv(cache_k, cache_v)
     b, s, h, d = q.shape
     hkv = k_new.shape[2]
     group = h // hkv
@@ -211,7 +226,9 @@ def ring_decode_attention(
     produced by the previous steps of this horizon, and the current
     token. Keeping the main cache out of the loop carry is the point:
     XLA then streams it instead of re-materializing it every step.
-    int8 caches pass codes + scales (see cached_attention)."""
+    int8 caches pass codes + scales (see cached_attention); int4
+    caches pass packed uint8 nibble rows (see ``_unpack_kv``)."""
+    cache_k, cache_v = _unpack_kv(cache_k, cache_v)
     b, _, h, d = q.shape
     hkv = k_self.shape[2]
     group = h // hkv

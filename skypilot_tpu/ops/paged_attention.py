@@ -40,14 +40,84 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+# jax renamed TPUCompilerParams -> CompilerParams across 0.4.x/0.5.x.
+_CompilerParams = getattr(pltpu, 'CompilerParams',
+                          getattr(pltpu, 'TPUCompilerParams', None))
+
 _NEG_INF = -1e30
+
+
+def _dequantize_unpack_int4(x):
+    """In-kernel int4 unpack: uint8 nibble bytes -> sign-extended int8
+    codes with the minor dim doubled (low nibble first — the exact
+    inverse of ``quantization.pack_int4(axis=-1)``). VPU bit-ops the
+    compiler folds into the operand read; the HBM/VMEM stream stays
+    packed at head_dim/2 bytes per row."""
+    lo = (x & 0xF).astype(jnp.int8)
+    lo = jnp.where(lo >= 8, lo - 16, lo)
+    hi = (x >> 4).astype(jnp.int8)
+    hi = jnp.where(hi >= 8, hi - 16, hi)
+    return jnp.stack([lo, hi], axis=-1).reshape(
+        x.shape[:-1] + (x.shape[-1] * 2,))
+
+
+def _flash_page_update(qg, k_raw, v_raw, ks, vs, pos0, length,
+                       m_s, l_s, acc_s, *, page: int, quantized: bool,
+                       packed: bool):
+    """One page block's online-softmax update against the VMEM scratch
+    triple (m_s, l_s, acc_s) — the body shared by the per-layer,
+    all-layer and fused-merge grid kernels.
+
+    qg: [hkv, g, d] f32 PRE-SCALED queries; k_raw/v_raw: the DMA'd
+    head-major page block ([hkv, page, d]; packed int4 pools arrive as
+    [hkv, page, d/2] uint8 nibbles and unpack HERE, so the HBM stream
+    stays packed); ks/vs: [hkv, page] f32 scale rows or None; pos0:
+    the block's first absolute cache position (for the length mask)."""
+    if packed:
+        k_raw = _dequantize_unpack_int4(k_raw)
+        v_raw = _dequantize_unpack_int4(v_raw)
+    k = k_raw.astype(jnp.float32)                     # [hkv, page, d]
+    v = v_raw.astype(jnp.float32)
+    hkv, g, d = qg.shape
+    hq = hkv * g
+    # logits[h, g, p] = sum_d q[h,g,d] * k[h,p,d]: batched (over
+    # hkv) A.B^T dots, both operands contracting their MINOR dim —
+    # the head-major page layout feeds the MXU with no relayout.
+    # Quantized pools: the per-row scales ride HEAD-MAJOR [hkv, page]
+    # blocks and fold into the LOGITS (and into p for the v side).
+    logits = jax.lax.dot_general(
+        qg, k, (((2,), (2,)), ((0,), (0,))),
+        preferred_element_type=jnp.float32)           # [hkv, g, page]
+    if quantized:
+        logits = logits * ks[:, None, :]
+    logits = logits.reshape(hq, page)
+    pos = pos0 + jax.lax.broadcasted_iota(
+        jnp.int32, (hq, page), 1)
+    logits = jnp.where(pos < length, logits, _NEG_INF)
+    m_prev = m_s[:, :1]                               # [hq, 1]
+    m_page = jnp.max(logits, axis=1, keepdims=True)
+    m_new = jnp.maximum(m_prev, m_page)
+    p = jnp.exp(logits - m_new)                       # [hq, page]
+    p = jnp.where(pos < length, p, 0.0)
+    corr = jnp.exp(m_prev - m_new)                    # [hq, 1]
+    l_s[:] = l_s[:] * corr + jnp.broadcast_to(
+        jnp.sum(p, axis=1, keepdims=True), l_s.shape)
+    m_s[:] = jnp.broadcast_to(m_new, m_s.shape)
+    # pv[h,g,d] = sum_p p[h,g,p] * v[h,p,d]: batched over hkv.
+    pg = p.reshape(hkv, g, page)
+    if quantized:
+        pg = pg * vs[:, None, :]
+    pv = jax.lax.dot_general(
+        pg, v, (((2,), (1,)), ((0,), (0,))),
+        preferred_element_type=jnp.float32)           # [hkv, g, d]
+    acc_s[:] = acc_s[:] * corr + pv.reshape(hq, d)
 
 
 def _kernel(li_ref, table_ref, lens_ref,         # scalar prefetch
             q_ref, k_ref, v_ref,                 # inputs (VMEM blocks)
             *refs,                               # [ks, vs,] outs, scratch
             page: int, pages_per_slot: int, scale: float,
-            quantized: bool):
+            quantized: bool, packed: bool = False):
     # li_ref carries the layer index: the pool stays [L, ...] and the
     # block specs index straight into it, so the per-layer slice is a
     # DMA address, never a materialized copy (feeding
@@ -88,50 +158,165 @@ def _kernel(li_ref, table_ref, lens_ref,         # scalar prefetch
         # implicit dimension"); m/l ride [hq, LANES] broadcast columns,
         # the same trick the flash kernel's lse uses.
         q = q_ref[0].astype(jnp.float32) * scale          # [hq, d]
-        k = k_ref[0, 0].astype(jnp.float32)               # [hkv, page, d]
-        v = v_ref[0, 0].astype(jnp.float32)
         hq, d = q.shape
-        hkv = k.shape[0]
+        hkv = k_ref.shape[2]
         g = hq // hkv
-        qg = q.reshape(hkv, g, d)
-        # logits[h, g, p] = sum_d q[h,g,d] * k[h,p,d]: batched (over
-        # hkv) A.B^T dots, both operands contracting their MINOR dim —
-        # the head-major page layout feeds the MXU with no relayout.
-        # int8 pools: the per-row scales ride HEAD-MAJOR [hkv, page]
-        # blocks and fold into the LOGITS (and into p for the v side).
-        logits = jax.lax.dot_general(
-            qg, k, (((2,), (2,)), ((0,), (0,))),
-            preferred_element_type=jnp.float32)           # [hkv, g, page]
-        if quantized:
-            logits = logits * ks_ref[0, 0].astype(
-                jnp.float32)[:, None, :]
-        logits = logits.reshape(hq, page)
-        pos = j * page + jax.lax.broadcasted_iota(
-            jnp.int32, (hq, page), 1)
-        logits = jnp.where(pos < length, logits, _NEG_INF)
-        m_prev = m_s[:, :1]                               # [hq, 1]
-        m_page = jnp.max(logits, axis=1, keepdims=True)
-        m_new = jnp.maximum(m_prev, m_page)
-        p = jnp.exp(logits - m_new)                       # [hq, page]
-        p = jnp.where(pos < length, p, 0.0)
-        corr = jnp.exp(m_prev - m_new)                    # [hq, 1]
-        l_s[:] = l_s[:] * corr + jnp.broadcast_to(
-            jnp.sum(p, axis=1, keepdims=True), l_s.shape)
-        m_s[:] = jnp.broadcast_to(m_new, m_s.shape)
-        # pv[h,g,d] = sum_p p[h,g,p] * v[h,p,d]: batched over hkv.
-        pg = p.reshape(hkv, g, page)
-        if quantized:
-            pg = pg * vs_ref[0, 0].astype(jnp.float32)[:, None, :]
-        pv = jax.lax.dot_general(
-            pg, v, (((2,), (1,)), ((0,), (0,))),
-            preferred_element_type=jnp.float32)           # [hkv, g, d]
-        acc_s[:] = acc_s[:] * corr + pv.reshape(hq, d)
+        _flash_page_update(
+            q.reshape(hkv, g, d), k_ref[0, 0], v_ref[0, 0],
+            ks_ref[0, 0].astype(jnp.float32) if quantized else None,
+            vs_ref[0, 0].astype(jnp.float32) if quantized else None,
+            j * page, length, m_s, l_s, acc_s,
+            page=page, quantized=quantized, packed=packed)
 
     @pl.when(j == pages_per_slot - 1)
     def _finish():
         acc_ref[0] = acc_s[:]
         m_ref[0] = m_s[:]
         l_ref[0] = l_s[:]
+
+
+def _kernel_all(table_ref, lens_ref,             # scalar prefetch
+                q_ref, k_ref, v_ref,             # inputs (VMEM blocks)
+                *refs,                           # [ks, vs,] outs, scratch
+                page: int, pages_per_slot: int, scale: float,
+                quantized: bool, packed: bool = False):
+    """All-layer variant of ``_kernel``: the layer axis rides the GRID
+    (``(slots, L, pages)``) instead of scalar prefetch, so ONE
+    pallas_call streams every layer's pages — the per-call dispatch
+    and pipeline-warmup cost is paid once instead of L times per step.
+    Queries for ALL layers must exist up front (stacked
+    [L, slots, hq, d]); the decode layer chain cannot provide that
+    (layer l's query depends on layer l-1's output), so the decode hot
+    path keeps per-layer calls — this kernel serves the paths where
+    the full query stack IS known: the kv_round2 bandwidth probe and
+    any cross-layer scoring pass."""
+    if quantized:
+        ks_ref, vs_ref = refs[0], refs[1]
+        refs = refs[2:]
+    else:
+        ks_ref = vs_ref = None
+    acc_ref, m_ref, l_ref, m_s, l_s, acc_s = refs
+    i = pl.program_id(0)                         # slot
+    j = pl.program_id(2)                         # page index within slot
+
+    @pl.when(j == 0)
+    def _init():
+        m_s[:] = jnp.full_like(m_s, _NEG_INF)
+        l_s[:] = jnp.zeros_like(l_s)
+        acc_s[:] = jnp.zeros_like(acc_s)
+
+    length = lens_ref[i]
+    needed = (length + page - 1) // page
+
+    @pl.when(j < needed)
+    def _compute():
+        q = q_ref[0, 0].astype(jnp.float32) * scale       # [hq, d]
+        hq, d = q.shape
+        hkv = k_ref.shape[2]
+        g = hq // hkv
+        _flash_page_update(
+            q.reshape(hkv, g, d), k_ref[0, 0], v_ref[0, 0],
+            ks_ref[0, 0].astype(jnp.float32) if quantized else None,
+            vs_ref[0, 0].astype(jnp.float32) if quantized else None,
+            j * page, length, m_s, l_s, acc_s,
+            page=page, quantized=quantized, packed=packed)
+
+    @pl.when(j == pages_per_slot - 1)
+    def _finish():
+        acc_ref[0, 0] = acc_s[:]
+        m_ref[0, 0] = m_s[:]
+        l_ref[0, 0] = l_s[:]
+
+
+def _kernel_fused(li_ref, rl_ref, table_ref, lens_ref,  # scalar prefetch
+                  q_ref, ksf_ref, vsf_ref, rk_ref, rv_ref,
+                  k_ref, v_ref,
+                  *refs,                         # [ks, vs,] out, scratch
+                  page: int, pages_per_slot: int, scale: float,
+                  quantized: bool, packed: bool = False):
+    """Fused-merge variant of ``_kernel``: after the cache pages, the
+    final grid step folds the fused-horizon ring rows and the current
+    token into the SAME online softmax and emits the normalized
+    per-layer attention output directly — the separate XLA
+    ``merge_partial_with_ring_self`` program (and its [b, hq, d] f32
+    partial triple round-tripping through HBM every layer of every
+    decode step) disappears. The merge replicates the XLA three-block
+    softmax op-for-op, so greedy decode stays byte-identical."""
+    del li_ref                                   # consumed by index maps
+    if quantized:
+        ks_ref, vs_ref = refs[0], refs[1]
+        refs = refs[2:]
+    else:
+        ks_ref = vs_ref = None
+    out_ref, m_s, l_s, acc_s = refs
+    i = pl.program_id(0)                         # slot
+    j = pl.program_id(1)                         # page index within slot
+
+    @pl.when(j == 0)
+    def _init():
+        m_s[:] = jnp.full_like(m_s, _NEG_INF)
+        l_s[:] = jnp.zeros_like(l_s)
+        acc_s[:] = jnp.zeros_like(acc_s)
+
+    length = lens_ref[i]
+    needed = (length + page - 1) // page
+
+    @pl.when(j < needed)
+    def _compute():
+        q = q_ref[0].astype(jnp.float32) * scale          # [hq, d]
+        hq, d = q.shape
+        hkv = k_ref.shape[2]
+        g = hq // hkv
+        _flash_page_update(
+            q.reshape(hkv, g, d), k_ref[0, 0], v_ref[0, 0],
+            ks_ref[0, 0].astype(jnp.float32) if quantized else None,
+            vs_ref[0, 0].astype(jnp.float32) if quantized else None,
+            j * page, length, m_s, l_s, acc_s,
+            page=page, quantized=quantized, packed=packed)
+
+    @pl.when(j == pages_per_slot - 1)
+    def _finish():
+        # Ring + self merge: the exact op sequence of
+        # ``merge_partial_with_ring_self`` on this slot's row, with the
+        # kernel scratch standing in for the cache partial.
+        q = q_ref[0].astype(jnp.float32) * scale          # [hq, d]
+        hq, d = q.shape
+        hkv = rk_ref.shape[2]
+        g = hq // hkv
+        qg = q.reshape(hkv, g, d)
+        rk = rk_ref[0].astype(jnp.float32)                # [H, hkv, d]
+        rv = rv_ref[0].astype(jnp.float32)
+        H = rk.shape[0]
+        ring_len = rl_ref[0]
+        # lr[h, g, kk] = sum_d qg[h,g,d] * rk[kk,h,d]
+        lr = jax.lax.dot_general(
+            qg, rk, (((2,), (2,)), ((0,), (1,))),
+            preferred_element_type=jnp.float32)           # [hkv, g, H]
+        ridx = jax.lax.broadcasted_iota(jnp.int32, (hkv, g, H), 2)
+        lr = jnp.where(ridx < ring_len, lr, _NEG_INF)
+        ksf = ksf_ref[0].astype(jnp.float32)              # [hkv, d]
+        vsf = vsf_ref[0].astype(jnp.float32)
+        lself = jnp.sum(qg * ksf[:, None, :], axis=-1,
+                        keepdims=True)                    # [hkv, g, 1]
+        m_rs = jnp.maximum(jnp.max(lr, -1, keepdims=True), lself)
+        p_r = jnp.exp(lr - m_rs)
+        p_s = jnp.exp(lself - m_rs)
+        l_rs = jnp.sum(p_r, -1, keepdims=True) + p_s
+        # acc_rs[h,g,d] = sum_kk p_r[h,g,kk] * rv[kk,h,d] + p_s * v_self
+        acc_rs = jax.lax.dot_general(
+            p_r, rv, (((2,), (0,)), ((0,), (1,))),
+            preferred_element_type=jnp.float32) \
+            + p_s * vsf[:, None, :]                       # [hkv, g, d]
+        m_cg = m_s[:, :1].reshape(hkv, g, 1)
+        l_cg = l_s[:, :1].reshape(hkv, g, 1)
+        acc_cg = acc_s[:].reshape(hkv, g, d)
+        m = jnp.maximum(m_cg, m_rs)
+        c_c = jnp.exp(m_cg - m)
+        c_rs = jnp.exp(m_rs - m)
+        l = l_cg * c_c + l_rs * c_rs
+        acc = acc_cg * c_c + acc_rs * c_rs
+        out = acc / jnp.maximum(l, 1e-30)                 # [hkv, g, d]
+        out_ref[0] = out.reshape(hq, d).astype(out_ref.dtype)
 
 
 def _kernel_manual(li_ref, table_ref, lens_ref,   # scalar prefetch
@@ -339,12 +524,17 @@ def paged_decode_attention(
     no-op for them.
     """
     slots, hq, d = q.shape
-    _, n_pages, hkv, page, _ = pool_k.shape
+    _, n_pages, hkv, page, dc = pool_k.shape
     P = table_p.shape[1]
     g = hq // hkv
     if scale is None:
         scale = d ** -0.5
     quantized = k_scale is not None
+    # Packed int4 pools: uint8 nibble rows, dc == d/2 — the grid
+    # kernel unpacks in VMEM (the HBM stream stays packed). The manual
+    # path is excluded: its per-page DMA buffers would need a 64-lane
+    # minor dim, below Mosaic's 128-lane tile.
+    packed = pool_k.dtype == jnp.uint8
 
     LANES = 128
     li = jnp.asarray(layer, jnp.int32).reshape(1)
@@ -358,7 +548,8 @@ def paged_decode_attention(
     # Mosaic — int8 pools need page % 128 == 0 (the engine's default
     # page is 128 for exactly this reason); bf16 pools have no scale
     # operand and run at any page size.
-    if not interpret and (k_scale is None or page % 128 == 0):
+    if not interpret and not packed \
+            and (k_scale is None or page % 128 == 0):
         # Compiled path: manual double-buffered K-page block DMA, one
         # grid step per slot (the per-page grid pays pipeline overhead
         # on hundreds of tiny steps; interpret mode has no DMA
@@ -412,14 +603,15 @@ def paged_decode_attention(
             # MHA shapes (hq=32, d=128, K-page blocks) put outputs +
             # double buffers a few MB past Mosaic's default 16M scoped
             # vmem; the v5e has 128M physical VMEM.
-            compiler_params=pltpu.CompilerParams(
+            compiler_params=_CompilerParams(
                 vmem_limit_bytes=48 * 1024 * 1024),
         )(*args)
         return acc, m[..., 0], l[..., 0]
 
     grid = (slots, P)
     kernel = functools.partial(_kernel, page=page, pages_per_slot=P,
-                               scale=scale, quantized=quantized)
+                               scale=scale, quantized=quantized,
+                               packed=packed)
     out_shape = out_shape_m
 
     def page_idx(i, j, lens):
@@ -430,9 +622,9 @@ def paged_decode_attention(
 
     in_specs = [
         pl.BlockSpec((1, hq, d), lambda i, j, li, tab, lens: (i, 0, 0)),
-        pl.BlockSpec((1, 1, hkv, page, d), lambda i, j, li, tab, lens:
+        pl.BlockSpec((1, 1, hkv, page, dc), lambda i, j, li, tab, lens:
                      (li[0], tab[i, page_idx(i, j, lens)], 0, 0, 0)),
-        pl.BlockSpec((1, 1, hkv, page, d), lambda i, j, li, tab, lens:
+        pl.BlockSpec((1, 1, hkv, page, dc), lambda i, j, li, tab, lens:
                      (li[0], tab[i, page_idx(i, j, lens)], 0, 0, 0)),
     ]
     args = [li, table_p, lengths, q, pool_k, pool_v]
@@ -470,6 +662,199 @@ def paged_decode_attention(
         interpret=interpret,
     )(*args)
     return acc, m[..., 0], l[..., 0]
+
+
+def paged_decode_attention_all_layers(
+    q: jax.Array,                      # [L, slots, hq, d] stacked queries
+    pool_k: jax.Array,                 # [L, n_pages, hkv, page, d]
+    pool_v: jax.Array,
+    table_p: jax.Array,                # [slots, P] page ids
+    lengths: jax.Array,                # [slots] valid cache rows
+    k_scale: Optional[jax.Array] = None,  # [L, n_pages, hkv, page]
+    v_scale: Optional[jax.Array] = None,
+    *,
+    scale: Optional[float] = None,
+    interpret: bool = False,
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """ALL layers' cache partials in ONE pallas_call: the layer axis
+    rides the grid (``(slots, L, P)``) so per-call dispatch and
+    pipeline warmup are paid once per step instead of once per layer
+    — the cross-layer batching front (a) of the KV round. Requires
+    the full query stack up front, so the decode layer chain (where
+    layer l's query depends on layer l-1) cannot use it; callers with
+    all queries in hand (the kv_round2 bandwidth probe, cross-layer
+    scoring) get L-for-1 dispatch amortization. Byte-identical to L
+    stacked :func:`paged_decode_attention` calls.
+
+    Returns (acc [L, slots, hq, d] f32 unnormalized, m, l
+    [L, slots, hq] f32)."""
+    L, slots, hq, d = q.shape
+    _, n_pages, hkv, page, dc = pool_k.shape
+    P = table_p.shape[1]
+    if scale is None:
+        scale = d ** -0.5
+    quantized = k_scale is not None
+    packed = pool_k.dtype == jnp.uint8
+    LANES = 128
+
+    kernel = functools.partial(_kernel_all, page=page, pages_per_slot=P,
+                               scale=scale, quantized=quantized,
+                               packed=packed)
+
+    def page_idx(i, j, lens):
+        needed = (lens[i] + page - 1) // page
+        return jnp.minimum(j, jnp.maximum(needed - 1, 0))
+
+    in_specs = [
+        pl.BlockSpec((1, 1, hq, d),
+                     lambda i, l, j, tab, lens: (l, i, 0, 0)),
+        pl.BlockSpec((1, 1, hkv, page, dc),
+                     lambda i, l, j, tab, lens:
+                     (l, tab[i, page_idx(i, j, lens)], 0, 0, 0)),
+        pl.BlockSpec((1, 1, hkv, page, dc),
+                     lambda i, l, j, tab, lens:
+                     (l, tab[i, page_idx(i, j, lens)], 0, 0, 0)),
+    ]
+    args = [table_p, lengths, q, pool_k, pool_v]
+    if quantized:
+        in_specs += [
+            pl.BlockSpec((1, 1, hkv, page),
+                         lambda i, l, j, tab, lens:
+                         (l, tab[i, page_idx(i, j, lens)], 0, 0)),
+            pl.BlockSpec((1, 1, hkv, page),
+                         lambda i, l, j, tab, lens:
+                         (l, tab[i, page_idx(i, j, lens)], 0, 0)),
+        ]
+        args += [k_scale, v_scale]
+    acc, m, l = pl.pallas_call(
+        kernel,
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=2,               # table, lengths
+            grid=(slots, L, P),
+            in_specs=in_specs,
+            out_specs=[
+                pl.BlockSpec((1, 1, hq, d),
+                             lambda i, l, j, tab, lens: (l, i, 0, 0)),
+                pl.BlockSpec((1, 1, hq, LANES),
+                             lambda i, l, j, tab, lens: (l, i, 0, 0)),
+                pl.BlockSpec((1, 1, hq, LANES),
+                             lambda i, l, j, tab, lens: (l, i, 0, 0)),
+            ],
+            scratch_shapes=[
+                pltpu.VMEM((hq, LANES), jnp.float32),
+                pltpu.VMEM((hq, LANES), jnp.float32),
+                pltpu.VMEM((hq, d), jnp.float32),
+            ],
+        ),
+        out_shape=[
+            jax.ShapeDtypeStruct((L, slots, hq, d), jnp.float32),
+            jax.ShapeDtypeStruct((L, slots, hq, LANES), jnp.float32),
+            jax.ShapeDtypeStruct((L, slots, hq, LANES), jnp.float32),
+        ],
+        compiler_params=_CompilerParams(
+            vmem_limit_bytes=48 * 1024 * 1024),
+        interpret=interpret,
+    )(*args)
+    return acc, m[..., 0], l[..., 0]
+
+
+def paged_decode_attention_fused(
+    q: jax.Array,                      # [slots, hq, d] current-token queries
+    k_self: jax.Array,                 # [slots, hkv, d] current-token rows
+    v_self: jax.Array,
+    ring_k: jax.Array,                 # [slots, H, hkv, d] fused-horizon ring
+    ring_v: jax.Array,
+    ring_len,                          # scalar: valid ring rows
+    pool_k: jax.Array,                 # [L, n_pages, hkv, page, d]
+    pool_v: jax.Array,
+    table_p: jax.Array,                # [slots, P] page ids
+    lengths: jax.Array,                # [slots] valid cache rows
+    k_scale: Optional[jax.Array] = None,
+    v_scale: Optional[jax.Array] = None,
+    *,
+    layer: jax.Array | int = 0,
+    scale: Optional[float] = None,
+    interpret: bool = False,
+) -> jax.Array:
+    """The complete decode attention for one layer in ONE kernel:
+    cache pages (online softmax, length-exact) THEN the ring + current
+    token folded into the same accumulator on the final grid step —
+    the normalized [slots, hq, d] output comes back in q's dtype and
+    the XLA merge program (``merge_partial_with_ring_self``) plus its
+    HBM round-trip of the f32 partial triple disappears from the layer
+    scan. This is ``decode_impl='cross_layer'``'s kernel."""
+    slots, hq, d = q.shape
+    _, n_pages, hkv, page, dc = pool_k.shape
+    P = table_p.shape[1]
+    H = ring_k.shape[1]
+    if scale is None:
+        scale = d ** -0.5
+    quantized = k_scale is not None
+    packed = pool_k.dtype == jnp.uint8
+    LANES = 128
+    li = jnp.asarray(layer, jnp.int32).reshape(1)
+    rl = jnp.asarray(ring_len, jnp.int32).reshape(1)
+
+    kernel = functools.partial(_kernel_fused, page=page,
+                               pages_per_slot=P, scale=scale,
+                               quantized=quantized, packed=packed)
+
+    def page_idx(i, j, lens):
+        needed = (lens[i] + page - 1) // page
+        return jnp.minimum(j, jnp.maximum(needed - 1, 0))
+
+    in_specs = [
+        pl.BlockSpec((1, hq, d),
+                     lambda i, j, li, rl, tab, lens: (i, 0, 0)),
+        pl.BlockSpec((1, hkv, d),
+                     lambda i, j, li, rl, tab, lens: (i, 0, 0)),
+        pl.BlockSpec((1, hkv, d),
+                     lambda i, j, li, rl, tab, lens: (i, 0, 0)),
+        pl.BlockSpec((1, H, hkv, d),
+                     lambda i, j, li, rl, tab, lens: (i, 0, 0, 0)),
+        pl.BlockSpec((1, H, hkv, d),
+                     lambda i, j, li, rl, tab, lens: (i, 0, 0, 0)),
+        pl.BlockSpec((1, 1, hkv, page, dc),
+                     lambda i, j, li, rl, tab, lens:
+                     (li[0], tab[i, page_idx(i, j, lens)], 0, 0, 0)),
+        pl.BlockSpec((1, 1, hkv, page, dc),
+                     lambda i, j, li, rl, tab, lens:
+                     (li[0], tab[i, page_idx(i, j, lens)], 0, 0, 0)),
+    ]
+    args = [li, rl, table_p, lengths, q, k_self, v_self,
+            ring_k, ring_v, pool_k, pool_v]
+    if quantized:
+        in_specs += [
+            pl.BlockSpec((1, 1, hkv, page),
+                         lambda i, j, li, rl, tab, lens:
+                         (li[0], tab[i, page_idx(i, j, lens)], 0, 0)),
+            pl.BlockSpec((1, 1, hkv, page),
+                         lambda i, j, li, rl, tab, lens:
+                         (li[0], tab[i, page_idx(i, j, lens)], 0, 0)),
+        ]
+        args += [k_scale, v_scale]
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=4,          # layer, ring_len, table, lens
+            grid=(slots, P),
+            in_specs=in_specs,
+            out_specs=[
+                pl.BlockSpec((1, hq, d),
+                             lambda i, j, li, rl, tab, lens: (i, 0, 0)),
+            ],
+            scratch_shapes=[
+                pltpu.VMEM((hq, LANES), jnp.float32),
+                pltpu.VMEM((hq, LANES), jnp.float32),
+                pltpu.VMEM((hq, d), jnp.float32),
+            ],
+        ),
+        out_shape=[jax.ShapeDtypeStruct((slots, hq, d), q.dtype)],
+        compiler_params=_CompilerParams(
+            vmem_limit_bytes=48 * 1024 * 1024),
+        interpret=interpret,
+    )(*args)[0]
+    return out
 
 
 def merge_partial_with_ring_self(

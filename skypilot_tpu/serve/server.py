@@ -76,6 +76,7 @@ def build_engine(cfg_name: str, *, max_batch: int, max_seq: int,
                  kv_cache: str = 'paged',
                  kv_cache_dtype: Optional[str] = None,
                  page_size: Optional[int] = None,
+                 decode_impl: Optional[str] = None,
                  prefill_w8a8: bool = False,
                  prefill_chunk_tokens: Optional[int] = None,
                  decode_priority_ratio: Optional[float] = None,
@@ -113,6 +114,8 @@ def build_engine(cfg_name: str, *, max_batch: int, max_seq: int,
         extra['mesh'] = mesh_lib.serving_mesh(tp, dp)
     if kv_cache == 'paged' and page_size is not None:
         extra['page_size'] = page_size
+    if kv_cache == 'paged' and decode_impl is not None:
+        extra['decode_impl'] = decode_impl
     if prefill_chunk_tokens is not None:
         extra['prefill_chunk_tokens'] = prefill_chunk_tokens
     if decode_priority_ratio is not None:
@@ -151,6 +154,7 @@ class ModelServer:
                  kv_cache: str = 'paged',
                  kv_cache_dtype: Optional[str] = None,
                  page_size: Optional[int] = None,
+                 decode_impl: Optional[str] = None,
                  prefill_w8a8: bool = False,
                  prefill_chunk_tokens: Optional[int] = None,
                  decode_priority_ratio: Optional[float] = None,
@@ -187,6 +191,10 @@ class ModelServer:
         # decode HBM stream (and ~doubles pool capacity) on its own.
         self.kv_cache_dtype = kv_cache_dtype
         self.page_size = page_size    # paged granularity (None = auto)
+        # Paged decode attention path ('gather' | 'pallas' |
+        # 'cross_layer'); None = the engine's 'auto' pick. cross_layer
+        # walks each slot's pages ONCE per step for all layers.
+        self.decode_impl = decode_impl
         self.prefill_w8a8 = prefill_w8a8  # int8 activations on prefill
         # Chunked-prefill scheduler knobs (None = engine defaults):
         # chunk width and the decode share of the interleaved token
@@ -399,7 +407,8 @@ class ModelServer:
             max_seq=self.max_seq, model_path=self.model_path,
             quantize=self.quantize, kv_cache=self.kv_cache,
             kv_cache_dtype=self.kv_cache_dtype,
-            page_size=self.page_size, prefill_w8a8=self.prefill_w8a8,
+            page_size=self.page_size, decode_impl=self.decode_impl,
+            prefill_w8a8=self.prefill_w8a8,
             prefill_chunk_tokens=self.prefill_chunk_tokens,
             decode_priority_ratio=self.decode_priority_ratio,
             decode_steps_per_call=self.decode_steps_per_call,
@@ -2333,7 +2342,7 @@ def main() -> None:
                              'int4 packs two codes per byte with '
                              'fused dequant — half the streamed '
                              'weight bytes again on top of int8 (KV '
-                             'stays int8)')
+                             'follows to int4 under auto)')
     parser.add_argument('--tp', type=int, default=None,
                         help='tensor-parallel degree: shard weights + '
                              'KV heads over this many chips (decode '
@@ -2348,12 +2357,24 @@ def main() -> None:
                              'SKYTPU_DP env, else 1. The mesh uses '
                              'tp*dp visible devices')
     parser.add_argument('--kv-cache-dtype', default=None,
-                        choices=['bf16', 'int8'],
+                        choices=['bf16', 'int8', 'int4'],
                         help='KV cache storage dtype; default follows '
-                             '--quantize (int8 weights => int8 KV). '
+                             '--quantize (int8 weights => int8 KV, '
+                             'int4 weights => int4 KV). '
                              'int8 halves KV HBM traffic in decode and '
                              '~doubles paged pool token capacity, with '
-                             'dequant fused into the attention kernels')
+                             'dequant fused into the attention kernels; '
+                             'int4 packs two nibble codes per byte — '
+                             '~4x bf16 pool capacity at a further '
+                             'bounded accuracy cost')
+    parser.add_argument('--decode-impl', default=None,
+                        choices=['gather', 'pallas', 'cross_layer'],
+                        help='paged decode attention path (paged '
+                             'cache only; default = engine auto). '
+                             'cross_layer batches ALL layers\' KV '
+                             'page reads per page visit — one kernel '
+                             'pass per decode step instead of one '
+                             'per layer')
     parser.add_argument('--kv-cache', default='paged',
                         choices=['slot', 'paged'],
                         help='paged (default) = shared page pool with '
@@ -2492,6 +2513,8 @@ def main() -> None:
     args = parser.parse_args()
     if args.kv_cache != 'paged' and args.page_size is not None:
         parser.error('--page-size only applies with --kv-cache paged')
+    if args.kv_cache != 'paged' and args.decode_impl is not None:
+        parser.error('--decode-impl only applies with --kv-cache paged')
     gang_spec = gang_lib.GangSpec.from_env(
         rank=args.gang_rank, world=args.gang_world,
         coordinator=args.gang_coordinator, gang_id=args.gang_id)
@@ -2506,6 +2529,7 @@ def main() -> None:
                          kv_cache=args.kv_cache,
                          kv_cache_dtype=args.kv_cache_dtype,
                          page_size=args.page_size,
+                         decode_impl=args.decode_impl,
                          prefill_w8a8=args.prefill_w8a8,
                          prefill_chunk_tokens=args.prefill_chunk_tokens,
                          decode_priority_ratio=args.decode_priority_ratio,
@@ -2542,7 +2566,9 @@ def run_follower(spec: 'gang_lib.GangSpec', args) -> None:
         args.model, max_batch=args.max_batch, max_seq=args.max_seq,
         model_path=args.model_path, quantize=args.quantize,
         kv_cache=args.kv_cache, kv_cache_dtype=args.kv_cache_dtype,
-        page_size=args.page_size, prefill_w8a8=args.prefill_w8a8,
+        page_size=args.page_size,
+        decode_impl=getattr(args, 'decode_impl', None),
+        prefill_w8a8=args.prefill_w8a8,
         prefill_chunk_tokens=args.prefill_chunk_tokens,
         decode_priority_ratio=args.decode_priority_ratio,
         decode_steps_per_call=getattr(args, 'decode_steps_per_call',

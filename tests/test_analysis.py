@@ -407,6 +407,74 @@ def test_gc119_sanctioned_helpers_exempt():
     assert rule_ids(src_ok, 'skypilot_tpu/inference/x.py') == []
 
 
+# ------------------------------------------------------------------ GC121
+def test_gc121_per_layer_pool_slice_in_decode_flagged():
+    src = '''
+    from jax import lax
+    def paged_decode_horizon(cache, li, table_p):
+        pool_k = cache.pool_k
+        pk = lax.dynamic_index_in_dim(pool_k, li, 0, keepdims=False)
+        sk = lax.dynamic_index_in_dim(cache.k_scale, li, 0)
+        ck, sck = _gather_layer(pk, sk, table_p)
+        return ck, sck
+    '''
+    assert rule_ids(src, 'skypilot_tpu/inference/paged.py') == \
+        ['GC121', 'GC121', 'GC121']
+
+
+def test_gc121_scalar_pool_subscript_in_decode_flagged():
+    src = '''
+    def decode_step(cache, li):
+        a = cache.pool_k[li]
+        b = cache.pool_v[0]
+        c = cache.k_scale[li, :, :]
+        return a, b, c
+    '''
+    assert rule_ids(src, 'skypilot_tpu/inference/paged.py') == \
+        ['GC121', 'GC121', 'GC121']
+
+
+def test_gc121_prefill_verify_and_helper_scopes_exempt():
+    # Prefill/verify-shaped functions are compute-bound and
+    # legitimately materialize contiguous rows; the gather helper is
+    # the sanctioned materializer; non-pool slices stay legal in
+    # decode scopes (the ring is per-horizon, not the pool).
+    src = '''
+    from jax import lax
+    def paged_prefill_chunk(cache, li, table_p):
+        pk = lax.dynamic_index_in_dim(cache.pool_k, li, 0)
+        return _gather_layer(pk, None, table_p)
+    def paged_spec_verify(cache, li, table_p):
+        pv = cache.pool_v[li]
+        return _gather_layer(pv, None, table_p)
+    def _gather_layer(pool_layer, scale_layer, table_p):
+        return pool_layer[table_p], scale_layer
+    def paged_decode_horizon(ring_k, li, lengths):
+        rk = lax.dynamic_index_in_dim(ring_k, li, 0)
+        n = lengths[li]
+        return rk, n
+    '''
+    assert rule_ids(src, 'skypilot_tpu/inference/paged.py') == []
+
+
+def test_gc121_outside_inference_and_suppressions_clean():
+    # The rule is scoped to inference/ (the ops kernels are the
+    # sanctioned home of pool indexing), and the grandfathered legacy
+    # fallback rides inline suppressions.
+    src = '''
+    from jax import lax
+    def paged_decode_kernel(pool_k, li):
+        return lax.dynamic_index_in_dim(pool_k, li, 0)
+    '''
+    assert rule_ids(src, 'skypilot_tpu/ops/x.py') == []
+    src_sup = '''
+    from jax import lax
+    def paged_decode_horizon(pool_k, li):
+        return lax.dynamic_index_in_dim(pool_k, li, 0)  # graftcheck: disable=GC121
+    '''
+    assert rule_ids(src_sup, 'skypilot_tpu/inference/paged.py') == []
+
+
 # ------------------------------------------------------------------ GC111
 def test_gc111_sync_engine_calls_in_coroutine_flagged():
     src = '''

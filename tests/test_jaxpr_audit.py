@@ -312,3 +312,58 @@ def test_int4_multistep_presets_registered():
         assert name in jaxpr_audit.PRESETS, name
         assert name in jaxpr_audit.DEFAULT_PRESETS, name
     assert 'int4-slot' in jaxpr_audit.PRESETS
+
+# ------------------------------------------------------ KV round two
+def test_kv_int4_paged_audit():
+    """int4 KV codes (packed nibble rows + absmax/7 scales):
+    quantize-on-write plus the in-kernel fused-dequant reads add zero
+    unsanctioned d2h and zero steady-state jit-cache growth — halving
+    KV bytes must not buy a single host round-trip."""
+    report = jaxpr_audit.audit_engine('paged', chunked=True,
+                                      kv_cache_dtype='int4')
+    _assert_hot_loop_clean(report)
+    assert report.transfers, 'expected sanctioned pipeline readbacks'
+
+
+@pytest.mark.slow
+def test_kv_int4_slot_audit():
+    report = jaxpr_audit.audit_engine('slot', chunked=True,
+                                      kv_cache_dtype='int4')
+    _assert_hot_loop_clean(report)
+    assert any('kv_bucket' in k for k in report.static_keys)
+
+
+def test_fused_attn_audit():
+    """Cross-layer fused decode attention (decode_impl='cross_layer'):
+    folding the ring+current-token merge into the kernel's final grid
+    step must be free at the dispatch boundary — same transfer and
+    recompile gates as the stock paged preset."""
+    report = jaxpr_audit.audit_engine('paged', chunked=True,
+                                      decode_impl='cross_layer')
+    _assert_hot_loop_clean(report)
+    assert report.transfers, 'expected sanctioned pipeline readbacks'
+
+
+def test_spec_multistep_audit():
+    """In-scan speculative verify: speculate_k x decode_steps_per_call
+    compose into ONE dispatch per `steps` verify rounds — pinned
+    against a single-round reference engine's dispatch count (greedy
+    byte-identity makes the round counts comparable), with zero
+    single-round fallbacks and every fused jit key at rounds=steps."""
+    report = jaxpr_audit.audit_spec_multistep(k=4, steps=3)
+    _assert_hot_loop_clean(report)
+    assert report.ok(), '\n' + report.format()
+    key = next(k for k in report.compile_counts
+               if k.startswith('fused dispatches'))
+    expected, actual = report.compile_counts[key]
+    assert expected == actual > 0
+    assert report.compile_counts[
+        'single-round fallback dispatches'] == (0, 0)
+    assert all(k['rounds'] == 3 for k in report.static_keys)
+
+
+def test_kv_round2_presets_registered():
+    for name in ('kv-int4', 'kv-int4-slot', 'fused-attn',
+                 'spec-multistep'):
+        assert name in jaxpr_audit.PRESETS, name
+        assert name in jaxpr_audit.DEFAULT_PRESETS, name

@@ -1,0 +1,457 @@
+"""KV round two: cross-layer fused paged attention, int4 KV codes,
+and in-scan speculative verify.
+
+Contracts pinned here:
+
+- **Cross-layer batching (op level).** ``paged_decode_attention_all_layers``
+  (one pallas_call, layer axis on the grid) is byte-identical to L
+  stacked per-layer ``paged_decode_attention`` calls — bf16, int8 AND
+  packed-int4 pools; the packed grid kernel's in-VMEM nibble unpack
+  exactly equals the unpacked int8-codes reference.
+- **Fused merge (op level).** ``paged_decode_attention_fused`` (cache
+  pages + ring + current token in ONE kernel) matches the per-layer
+  partial + ``merge_partial_with_ring_self`` XLA merge to float ulps.
+- **``decode_impl='cross_layer'`` (engine level).** Greedy decode is
+  byte-identical to ``gather`` and ``pallas`` across every KV dtype.
+- **int4 KV.** Packed uint8 nibble pools (head_dim/2 minor) with
+  absmax/7 scales serve byte-identically to bf16 KV on the tiny model,
+  and the full divergence matrix (chunked prefill, prefix-cache reuse,
+  speculative commits) holds in the slow tier.
+- **In-scan speculative verify.** ``speculate_k`` composed with
+  ``decode_steps_per_call > 1`` fuses that many propose→verify→commit
+  rounds into ONE dispatch; greedy output stays byte-identical to
+  vanilla decode AND to single-round speculation on both engines; the
+  device n-gram proposer matches the host proposer on the windowed
+  history; paged pool pressure falls back to single-round verify with
+  no output change.
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from skypilot_tpu.inference.engine import (InferenceEngine,
+                                           kv_token_bytes,
+                                           resolve_kv_cache_dtype)
+from skypilot_tpu.inference.paged import PagedInferenceEngine, PagedKVCache
+from skypilot_tpu.models import configs, llama
+from skypilot_tpu.models import quantization as q
+from skypilot_tpu.ops.paged_attention import (
+    merge_partial_with_ring_self, paged_decode_attention,
+    paged_decode_attention_all_layers, paged_decode_attention_fused)
+
+jax.config.update('jax_platforms', 'cpu')
+
+PROMPTS = [[3, 1, 4, 1, 5, 9, 2], [2, 7]]
+REPETITIVE = [3, 1, 4, 1, 5, 9, 2, 6] * 4
+
+
+@pytest.fixture(scope='module')
+def setup():
+    cfg = configs.TINY
+    params = llama.init_params(jax.random.PRNGKey(0), cfg)
+    return cfg, params
+
+
+def _greedy(engcls, cfg, params, prompts, n_new, **kw):
+    eng = engcls(cfg, params, max_batch=len(prompts), max_seq=64,
+                 attn_impl='xla', **kw)
+    rids = [eng.add_request(list(p), max_new_tokens=n_new)
+            for p in prompts]
+    done = eng.run_to_completion(horizon=2)
+    return [done[r].output for r in rids], eng
+
+
+# ---------------------------------------------------------------------------
+# int4 KV plumbing (fast tier)
+# ---------------------------------------------------------------------------
+def test_resolve_and_token_bytes_int4():
+    """int4 weights pull the KV to int4 under auto; explicit dtypes
+    always win; the per-token byte math (packed codes at head_dim/2
+    plus a 4-byte fp32 scale per head) clears 3x vs bf16 at serving
+    head dims and feeds page sizing exactly."""
+    assert resolve_kv_cache_dtype('int4', None) == 'int4'
+    assert resolve_kv_cache_dtype(None, 'int4') == 'int4'
+    assert resolve_kv_cache_dtype('auto', 'int4') == 'int4'
+    assert resolve_kv_cache_dtype('int8', 'int4') == 'int8'
+    cfg = configs.LLAMA3_8B
+    bf16 = kv_token_bytes(cfg, quantized=False)
+    i4 = kv_token_bytes(cfg, 'int4')
+    assert i4 == cfg.n_layers * cfg.n_kv_heads * (cfg.head_dim // 2
+                                                  + 4) * 2
+    assert bf16 / i4 >= 3.0
+    assert PagedInferenceEngine._page_bytes(cfg, 128, 'int4') == i4 * 128
+
+
+def test_packed_pool_layout():
+    """Packed pools are uint8 at head_dim/2 with fp32 scales; the
+    ``packed`` / ``quant_mode`` detection is dtype-driven on both cache
+    kinds; odd head_dim is refused loudly."""
+    cfg = configs.TINY
+    pc = PagedKVCache.create(cfg, n_pages=4, page_size=8,
+                             kv_dtype='int4')
+    assert pc.pool_k.dtype == jnp.uint8
+    assert pc.pool_k.shape[-1] == cfg.head_dim // 2
+    assert pc.k_scale is not None and pc.k_scale.dtype == jnp.float32
+    assert pc.packed and pc.quant_mode == 'int4'
+    sc = llama.KVCache.create(cfg, 2, 16, kv_dtype='int4')
+    assert sc.k.dtype == jnp.uint8
+    assert sc.k.shape[-1] == cfg.head_dim // 2
+    assert sc.packed and sc.quantized
+    import dataclasses
+    odd = dataclasses.replace(cfg, head_dim_override=3)
+    with pytest.raises(ValueError):
+        llama.KVCache.create(odd, 2, 16, kv_dtype='int4')
+
+
+def test_quantize_kv_rows4_round_trip():
+    """absmax/7 row quantization: codes stay in [-7, 7], packed low
+    nibble first along head_dim, and unpack x scale reconstructs to
+    within half a quantization step."""
+    rng = np.random.default_rng(0)
+    rows = jnp.asarray(rng.standard_normal((2, 5, 3, 8))
+                       .astype(np.float32))
+    codes, scale = llama.quantize_kv_rows4(rows)
+    assert codes.dtype == jnp.uint8 and codes.shape[-1] == 4
+    unpacked = q.unpack_int4(np.asarray(codes), axis=-1)
+    assert unpacked.min() >= -7 and unpacked.max() <= 7
+    recon = unpacked.astype(np.float32) * np.asarray(scale)
+    err = np.abs(recon - np.asarray(rows))
+    assert (err <= 0.5 * np.asarray(scale) + 1e-6).all()
+
+
+# ---------------------------------------------------------------------------
+# Cross-layer / fused kernels (op level, interpret mode)
+# ---------------------------------------------------------------------------
+def _make_pools(seed, L=2, n_pages=9, hkv=2, page=8, d=8, slots=3,
+                P=2, mode='bf16'):
+    rng = np.random.default_rng(seed)
+    hq = 2 * hkv
+    q_all = jnp.asarray(rng.standard_normal((L, slots, hq, d))
+                        .astype(np.float32))
+    # Distinct pages per slot (page 0 reserved, engine-style).
+    ids = rng.permutation(np.arange(1, n_pages))[:slots * P]
+    table = jnp.asarray(ids.reshape(slots, P).astype(np.int32))
+    lengths = jnp.asarray(
+        rng.integers(1, page * P + 1, slots).astype(np.int32))
+    shape = (L, n_pages, hkv, page, d)
+    if mode == 'bf16':
+        pk = jnp.asarray(rng.standard_normal(shape).astype(np.float32))
+        pv = jnp.asarray(rng.standard_normal(shape).astype(np.float32))
+        return q_all, pk, pv, None, None, table, lengths
+    lo = -7 if mode == 'int4' else -127
+    hi = 8 if mode == 'int4' else 128
+    ck = rng.integers(lo, hi, shape).astype(np.int8)
+    cv = rng.integers(lo, hi, shape).astype(np.int8)
+    ks = jnp.asarray(rng.random(shape[:-1]).astype(np.float32) + 0.1)
+    vs = jnp.asarray(rng.random(shape[:-1]).astype(np.float32) + 0.1)
+    if mode == 'int4':
+        return (q_all, jnp.asarray(q.pack_int4(ck, axis=-1)),
+                jnp.asarray(q.pack_int4(cv, axis=-1)), ks, vs,
+                table, lengths), (jnp.asarray(ck), jnp.asarray(cv))
+    return q_all, jnp.asarray(ck), jnp.asarray(cv), ks, vs, table, lengths
+
+
+@pytest.mark.parametrize('mode', ['bf16', 'int8'])
+def test_all_layers_kernel_matches_per_layer(mode):
+    """ONE pallas_call over (slots, L, P) == L per-layer calls,
+    bit-for-bit (same op sequence per page block)."""
+    q_all, pk, pv, ks, vs, table, lengths = _make_pools(1, mode=mode)
+    L = q_all.shape[0]
+    acc, m, l = paged_decode_attention_all_layers(
+        q_all, pk, pv, table, lengths, ks, vs, interpret=True)
+    for li in range(L):
+        a1, m1, l1 = paged_decode_attention(
+            q_all[li], pk, pv, table, lengths, ks, vs, layer=li,
+            interpret=True)
+        np.testing.assert_array_equal(np.asarray(acc[li]),
+                                      np.asarray(a1))
+        np.testing.assert_array_equal(np.asarray(m[li]), np.asarray(m1))
+        np.testing.assert_array_equal(np.asarray(l[li]), np.asarray(l1))
+
+
+def test_all_layers_kernel_int4_packed_exact():
+    """The packed-int4 grid kernel's in-VMEM nibble unpack is EXACTLY
+    the unpacked int8-codes computation (scale-agnostic integer code
+    math before the fold)."""
+    (q_all, pk4, pv4, ks, vs, table, lengths), (ck, cv) = \
+        _make_pools(2, mode='int4')
+    got = paged_decode_attention_all_layers(
+        q_all, pk4, pv4, table, lengths, ks, vs, interpret=True)
+    want = paged_decode_attention_all_layers(
+        q_all, ck, cv, table, lengths, ks, vs, interpret=True)
+    for g, w in zip(got, want):
+        np.testing.assert_array_equal(np.asarray(g), np.asarray(w))
+
+
+@pytest.mark.parametrize('mode', ['bf16', 'int8'])
+def test_fused_kernel_matches_xla_merge(mode):
+    """The fused kernel (pages + ring + current token, one kernel) ==
+    per-layer partial then ``merge_partial_with_ring_self`` to float
+    ulps (the merge runs elementwise sums where XLA uses dots)."""
+    q_all, pk, pv, ks, vs, table, lengths = _make_pools(3, mode=mode)
+    rng = np.random.default_rng(4)
+    L, slots, hq, d = q_all.shape
+    hkv = pk.shape[2]
+    H = 4
+    k_self = jnp.asarray(rng.standard_normal((slots, hkv, d))
+                         .astype(np.float32))
+    v_self = jnp.asarray(rng.standard_normal((slots, hkv, d))
+                         .astype(np.float32))
+    ring_k = jnp.asarray(rng.standard_normal((slots, H, hkv, d))
+                         .astype(np.float32))
+    ring_v = jnp.asarray(rng.standard_normal((slots, H, hkv, d))
+                         .astype(np.float32))
+    for ring_len in (0, 2):
+        for li in range(L):
+            got = paged_decode_attention_fused(
+                q_all[li], k_self, v_self, ring_k, ring_v, ring_len,
+                pk, pv, table, lengths, ks, vs, layer=li,
+                interpret=True)
+            partial = paged_decode_attention(
+                q_all[li], pk, pv, table, lengths, ks, vs, layer=li,
+                interpret=True)
+            want = merge_partial_with_ring_self(
+                partial, q_all[li][:, None], k_self[:, None],
+                v_self[:, None], ring_k, ring_v, ring_len)[:, 0]
+            np.testing.assert_allclose(np.asarray(got),
+                                       np.asarray(want),
+                                       rtol=1e-5, atol=1e-6)
+
+
+@pytest.mark.parametrize('dtype', ['bf16', 'int8', 'int4'])
+def test_cross_layer_engine_identity(setup, dtype):
+    """``decode_impl='cross_layer'`` greedy decode is byte-identical to
+    ``gather`` and ``pallas`` for every KV dtype (the fused kernel is
+    the same math, one dispatch fewer per layer)."""
+    cfg, params = setup
+    outs = {}
+    for impl in ('gather', 'pallas', 'cross_layer'):
+        outs[impl], _ = _greedy(
+            PagedInferenceEngine, cfg, params, PROMPTS, 5,
+            page_size=8, kv_cache_dtype=dtype, decode_impl=impl)
+    assert outs['cross_layer'] == outs['gather'], dtype
+    assert outs['pallas'] == outs['gather'], dtype
+
+
+def test_int4_greedy_smoke(setup):
+    """Tier-1 smoke: int4 KV greedy decode matches bf16 KV on both
+    engines (tiny model; the divergence matrix rides the slow tier)."""
+    cfg, params = setup
+    for engcls, kw in ((InferenceEngine, {}),
+                       (PagedInferenceEngine, {'page_size': 8})):
+        bf, _ = _greedy(engcls, cfg, params, PROMPTS, 8,
+                        kv_cache_dtype='bf16', **kw)
+        i4, eng = _greedy(engcls, cfg, params, PROMPTS, 8,
+                          kv_cache_dtype='int4', **kw)
+        assert i4 == bf, engcls.__name__
+        assert eng.cache.packed and eng.kv_cache_dtype == 'int4'
+
+
+# ---------------------------------------------------------------------------
+# In-scan speculative verify (fast tier)
+# ---------------------------------------------------------------------------
+def test_ngram_propose_device_matches_host():
+    """The device proposer == the host proposer run on the windowed
+    (right-aligned, H-token) history — same match, same continuation,
+    same count."""
+    from skypilot_tpu.inference.speculative import (ngram_propose,
+                                                    ngram_propose_device)
+    rng = np.random.RandomState(0)
+    H, k = 64, 4
+    for _ in range(50):
+        n = rng.randint(2, 80)
+        vocab = int(rng.choice([3, 5, 50]))
+        hist = rng.randint(0, vocab, size=n).tolist()
+        row = np.full((1, H), -1, np.int32)
+        t = hist[-H:]
+        row[0, H - len(t):] = t
+        prop, n_prop = ngram_propose_device(jnp.asarray(row), k)
+        m = int(n_prop[0])
+        want = ngram_propose(hist[-H:], k)
+        assert m == len(want)
+        assert np.asarray(prop)[0, :m].tolist() == want[:m].tolist()
+        # Positions past n_prop are zeroed (fixed-shape contract).
+        assert (np.asarray(prop)[0, m:] == 0).all()
+
+
+@pytest.mark.parametrize('engcls,kw', [
+    (InferenceEngine, {}),
+    (PagedInferenceEngine, {'page_size': 8, 'decode_impl': 'gather'}),
+])
+def test_spec_fused_byte_identity(setup, engcls, kw):
+    """THE composition contract: speculate_k x decode_steps_per_call
+    fused rounds commit byte-identically to vanilla greedy decode AND
+    to single-round speculation — the in-scan device proposer and
+    budget carry change dispatch count only, never tokens."""
+    cfg, params = setup
+    prompts = [REPETITIVE[:16], [2, 7, 2, 7, 2, 7, 2, 7]]
+    base, _ = _greedy(engcls, cfg, params, prompts, 12, **kw)
+    single, e1 = _greedy(engcls, cfg, params, prompts, 12,
+                         speculate_k=3, **kw)
+    fused, e2 = _greedy(engcls, cfg, params, prompts, 12,
+                        speculate_k=3, decode_steps_per_call=3, **kw)
+    assert single == base
+    assert fused == base
+    # Both paths accept drafts on the repetitive prompts, and the
+    # stable metrics schema keeps reporting.
+    assert e1.spec_metrics()['spec_accepted'] > 0
+    assert e2.spec_metrics()['spec_accepted'] > 0
+    assert e2.spec_metrics()['spec_rounds'] >= e2.spec_metrics()[
+        'speculate_k']
+
+
+def test_spec_fused_int4_composes(setup):
+    """All three fronts at once: int4 KV + fused spec rounds still
+    match the bf16 vanilla output on the tiny model."""
+    cfg, params = setup
+    prompts = [REPETITIVE[:16], [2, 7, 2, 7, 2, 7, 2, 7]]
+    want, _ = _greedy(PagedInferenceEngine, cfg, params, prompts, 10,
+                      page_size=8, decode_impl='gather')
+    got, eng = _greedy(PagedInferenceEngine, cfg, params, prompts, 10,
+                       page_size=8, decode_impl='gather',
+                       kv_cache_dtype='int4', speculate_k=3,
+                       decode_steps_per_call=3)
+    assert got == want
+    assert eng.cache.packed
+
+
+def test_spec_fused_pool_pressure_fallback(setup):
+    """When the pool cannot reserve rounds x (k+1) rows up front, the
+    fused step falls back to single-round verify — output unchanged,
+    requests complete."""
+    cfg, params = setup
+    prompts = [REPETITIVE[:16], [2, 7, 2, 7, 2, 7, 2, 7]]
+    want, _ = _greedy(PagedInferenceEngine, cfg, params, prompts, 10,
+                      page_size=8, decode_impl='gather')
+    eng = PagedInferenceEngine(cfg, params, max_batch=2, max_seq=64,
+                               page_size=8, n_pages=10,
+                               attn_impl='xla', decode_impl='gather',
+                               speculate_k=3, decode_steps_per_call=4)
+    rids = [eng.add_request(list(p), max_new_tokens=10)
+            for p in prompts]
+    done = eng.run_to_completion(horizon=2)
+    assert [done[r].output for r in rids] == want
+
+
+def test_spec_fused_budget_respected(setup):
+    """The in-scan ``rem`` carry never overshoots ``max_new_tokens``
+    even when rounds x (k+1) far exceeds the remaining budget."""
+    cfg, params = setup
+    got, _ = _greedy(InferenceEngine, cfg, params, [REPETITIVE[:16]],
+                     3, speculate_k=4, decode_steps_per_call=4)
+    want, _ = _greedy(InferenceEngine, cfg, params, [REPETITIVE[:16]],
+                      3)
+    assert got == want and len(got[0]) == 3
+
+
+# ---------------------------------------------------------------------------
+# Slow tier: the int4-vs-bf16 divergence matrix (mirrors test_kv_int8)
+# ---------------------------------------------------------------------------
+MATRIX_PROMPTS = [[3, 1, 4, 1, 5], [2, 7, 1, 8, 2, 8, 1, 8],
+                  [(i * 7 + 3) % 256 for i in range(60)]]
+
+
+@pytest.mark.slow
+class TestKVInt4Equivalence:
+
+    def _greedy4(self, engcls, cfg, params, prompts, n_new, **kw):
+        eng = engcls(cfg, params, max_batch=4, max_seq=256,
+                     attn_impl='xla', **kw)
+        rids = [eng.add_request(list(p), max_new_tokens=n_new)
+                for p in prompts]
+        done = eng.run_to_completion(horizon=4)
+        return [done[r].output for r in rids], eng
+
+    def test_slot_chunked_prefill(self, setup):
+        """Chunking contract under int4: prompts that fit in ONE chunk
+        are byte-identical chunked vs monolithic (chunking is a no-op);
+        for longer prompts later chunks attend over already-quantized
+        rows where monolithic prefill rides full precision in-window —
+        a REAL int4 perturbation, so the pin is first-token agreement
+        and completion, not byte identity. (int8's finer grid kept the
+        tiny model's argmax stable; int4's 15-level grid does not —
+        divergence on random-init weights is the quantization error
+        itself, same philosophy as test_int4.)"""
+        cfg, params = setup
+        i4, _ = self._greedy4(InferenceEngine, cfg, params,
+                              MATRIX_PROMPTS, 12,
+                              kv_cache_dtype='int4',
+                              prefill_chunk_tokens=16)
+        mono, _ = self._greedy4(InferenceEngine, cfg, params,
+                                MATRIX_PROMPTS, 12,
+                                kv_cache_dtype='int4',
+                                prefill_chunk_tokens=0)
+        assert i4[0] == mono[0] and i4[1] == mono[1]   # <= one chunk
+        assert i4[2][0] == mono[2][0]                  # 60-token prompt
+        assert all(len(o) == 12 for o in i4)
+        # Against bf16 KV the short prompts keep a long exact prefix.
+        bf, _ = self._greedy4(InferenceEngine, cfg, params,
+                              MATRIX_PROMPTS, 12,
+                              kv_cache_dtype='bf16',
+                              prefill_chunk_tokens=16)
+        for a, b in zip(i4[:2], bf[:2]):
+            agree = sum(x == y for x, y in zip(a, b))
+            assert agree >= 8, (a, b)
+
+    def test_paged_chunked_prefill(self, setup):
+        """Same contract on the paged pool: chunk-size invariance for
+        sub-chunk prompts, first-token agreement beyond, and the chunk
+        counter proves the 60-token prompt actually chunked."""
+        cfg, params = setup
+        c16, eng = self._greedy4(PagedInferenceEngine, cfg, params,
+                                 MATRIX_PROMPTS, 12,
+                                 kv_cache_dtype='int4', page_size=8,
+                                 chunk=16)
+        c8, _ = self._greedy4(PagedInferenceEngine, cfg, params,
+                              MATRIX_PROMPTS, 12,
+                              kv_cache_dtype='int4', page_size=8,
+                              chunk=8)
+        assert c16[0] == c8[0]                 # 5 tokens: <= any chunk
+        assert c16[2][0] == c8[2][0]
+        assert all(len(o) == 12 for o in c16)
+        assert eng.chunks_prefilled >= 4       # 60-token prompt, chunk 16
+
+    def test_prefix_cache_reuse(self, setup):
+        """THE reuse contract: a prefix HIT serving from already-packed
+        pages is byte-identical to a COLD run of the same request on
+        the same engine config — reuse changes where bytes come from,
+        never what they are."""
+        cfg, params = setup
+        shared = [(i * 5 + 2) % 256 for i in range(64)]
+        p1, p2 = shared + [11, 12], shared + [13, 14, 15]
+        cold, _ = self._greedy4(PagedInferenceEngine, cfg, params,
+                                [p2], 8, kv_cache_dtype='int4',
+                                page_size=8, chunk=16)
+        eng = PagedInferenceEngine(cfg, params, max_batch=1,
+                                   max_seq=256, page_size=8, chunk=16,
+                                   attn_impl='xla',
+                                   kv_cache_dtype='int4')
+        eng.add_request(p1, max_new_tokens=4)
+        eng.run_to_completion(horizon=4)
+        assert eng.alloc.prefix_misses == 1
+        r2 = eng.add_request(p2, max_new_tokens=8)
+        done = eng.run_to_completion(horizon=4)
+        assert eng.alloc.prefix_hits >= 1
+        assert done[r2].output == cold[0]
+
+    def test_speculative_commits(self, setup):
+        """Spec verify with int4 KV: bounded divergence (in-window
+        verify rows ride full precision vs requantized vanilla rows —
+        same contract as int8 KV), nonzero acceptance."""
+        cfg, params = setup
+        for engcls, kw in ((InferenceEngine, {}),
+                           (PagedInferenceEngine, {'page_size': 8})):
+            want, _ = self._greedy4(engcls, cfg, params,
+                                    [REPETITIVE, MATRIX_PROMPTS[2]],
+                                    16, kv_cache_dtype='int4', **kw)
+            got, eng = self._greedy4(engcls, cfg, params,
+                                     [REPETITIVE, MATRIX_PROMPTS[2]],
+                                     16, kv_cache_dtype='int4',
+                                     speculate_k=4, **kw)
+            for a, b in zip(want, got):
+                assert a[:10] == b[:10], engcls.__name__
+                agree = sum(x == y for x, y in zip(a, b))
+                assert agree >= int(0.85 * len(a)), (engcls.__name__,
+                                                     a, b)
+            assert eng.spec_metrics()['spec_accepted'] > 0

@@ -125,6 +125,48 @@ class TestPrefixCache:
         assert delta == 1, delta
         assert done[r2].output == want
 
+    def test_prefix_hit_byte_identical_to_cold(self, setup):
+        """A prefix-cache hit must emit byte-identical output to a cold
+        run of the same request. Resuming chunked prefill at an
+        arbitrary page boundary (instead of the cold run's chunk grid)
+        regroups cached_attention's two softmax partial sums, and the
+        few-ULP denominator drift flips greedy argmax on near-tie
+        logits — the engine quantizes resume points to chunk-multiple
+        boundaries to keep both paths bitwise equal. Prompt [14]+S+[8]
+        below is a known near-tie under TINY init: without the
+        quantization its hit-path bytes diverge from cold."""
+        cfg, _ = setup
+        shared = [7 + (j % 50) for j in range(40)]
+        for lead, tail in [(11, 5), (14, 8)]:
+            prompt = [lead] + shared + [tail]
+            eng = PagedInferenceEngine(cfg, max_batch=2, max_seq=256)
+            r1 = eng.add_request(list(prompt), max_new_tokens=6)
+            cold = eng.run_to_completion()[r1].output
+            r2 = eng.add_request(list(prompt), max_new_tokens=6)
+            hit = eng.run_to_completion()[r2].output
+            assert eng.alloc.prefix_hits == 1
+            assert hit == cold, (lead, hit, cold)
+
+    def test_aligned_prefix_hit_keeps_reuse_and_identity(self, setup):
+        """When the matched prefix covers whole chunk multiples, resume
+        quantization keeps the pages: chunk work drops AND the output
+        stays byte-identical to the cold run."""
+        cfg, params = setup
+        shared = [(i * 5 + 2) % cfg.vocab_size for i in range(64)]
+        prompt = shared + [21, 22, 23]
+        eng = PagedInferenceEngine(cfg, params, max_batch=1, max_seq=256,
+                                   page_size=8, chunk=16,
+                                   attn_impl='xla')
+        r1 = eng.add_request(list(prompt), max_new_tokens=6)
+        cold = eng.run_to_completion(horizon=4)[r1].output
+        before = eng.chunks_prefilled
+        r2 = eng.add_request(list(prompt), max_new_tokens=6)
+        done = eng.run_to_completion(horizon=4)
+        # 64 shared tokens = 4 chunk-aligned boundaries survive
+        # quantization; only the tail re-prefills.
+        assert eng.chunks_prefilled - before <= 1
+        assert done[r2].output == cold
+
     def test_prefix_pages_survive_slot_free_until_pressure(self, setup):
         cfg, params = setup
         eng = PagedInferenceEngine(cfg, params, max_batch=1, max_seq=128,

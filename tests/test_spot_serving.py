@@ -907,9 +907,19 @@ class _FakeController:
 
 def test_seeded_spot_kills_zero_lost_through_lb(monkeypatch):
     """2 spot + 1 on-demand replica behind the LB; both spot replicas
-    die on a seeded schedule mid-run (checkpoint -> drain -> gone,
-    exactly the spot_preemption path). Every request completes with
-    the byte-identical greedy answer — zero lost."""
+    die mid-run (checkpoint -> drain -> gone, exactly the
+    spot_preemption path). Every request completes with the
+    byte-identical greedy answer — zero lost.
+
+    Ordering is event-gated, not wall-clock-raced: each kill fires
+    only after the LB has observably served at least one request of
+    the current wave (a Condition on completion counts), and each
+    victim drains with a completion-gated deadline so accepted
+    requests are never failed over on a 30s wall clock under
+    full-suite CPU load. Wall-clock timeouts remain only as generous
+    hang insurance. Whether the remaining wave requests are still in
+    flight at kill time is load-dependent — the zero-lost contract
+    must hold either way."""
     from skypilot_tpu.serve.load_balancer import SkyServeLoadBalancer
     monkeypatch.setenv('SKYTPU_LB_SYNC', '3600')
     ports = [common_utils.find_free_port(20200 + i * 37)
@@ -935,42 +945,67 @@ def test_seeded_spot_kills_zero_lost_through_lb(monkeypatch):
 
         results = [None] * len(prompts)
         errors = []
+        cv = threading.Condition()
+        wave_done = [0, 0]            # completions per wave (A, B)
 
         def one(i):
             try:
                 results[i] = _generate(
                     lb_base, {'prompt': prompts[i],
                               'max_new_tokens': 6},
-                    timeout=120)['tokens']
+                    timeout=300)['tokens']
             except Exception as e:  # pylint: disable=broad-except
                 errors.append((i, repr(e)))
+            finally:
+                with cv:
+                    wave_done[0 if i < 4 else 1] += 1
+                    cv.notify_all()
+
+        def await_wave(wave, n):
+            """Event gate: block until ``n`` wave completions landed
+            (deadline is hang insurance only, never the scheduler)."""
+            with cv:
+                assert cv.wait_for(lambda: wave_done[wave] >= n,
+                                   timeout=300), (wave, n, wave_done)
+
+        def spot_preempt(kill):
+            """The spot_preemption flow a manager drives: checkpoint
+            -> completion-gated drain -> out of the controller list.
+            The drain deadline is generous so stragglers accepted by
+            the victim run to completion instead of being failed over
+            on a wall clock mid-assert."""
+            victim = urls[kill]
+            req = urllib.request.Request(
+                victim + '/checkpoint', json.dumps({}).encode(),
+                {'Content-Type': 'application/json'})
+            with urllib.request.urlopen(req, timeout=120):
+                pass
+            req = urllib.request.Request(
+                victim + '/drain',
+                json.dumps({'deadline_s': 600}).encode(),
+                {'Content-Type': 'application/json'})
+            with urllib.request.urlopen(req, timeout=120):
+                pass
+            ctrl.replica_urls = urls[kill + 1:]
+            lb._sync_once()
 
         threads = [threading.Thread(target=one, args=(i,))
                    for i in range(len(prompts))]
         for t in threads[:4]:
             t.start()
-        # Seeded spot kill #1 and #2 mid-run: checkpoint -> drain ->
-        # out of the controller list -> process gone (the
-        # spot_preemption flow a manager drives).
-        for kill in (0, 1):
-            victim = urls[kill]
-            req = urllib.request.Request(
-                victim + '/checkpoint', json.dumps({}).encode(),
-                {'Content-Type': 'application/json'})
-            with urllib.request.urlopen(req, timeout=60):
-                pass
-            req = urllib.request.Request(
-                victim + '/drain', json.dumps({}).encode(),
-                {'Content-Type': 'application/json'})
-            with urllib.request.urlopen(req, timeout=30):
-                pass
-            ctrl.replica_urls = urls[kill + 1:]
-            lb._sync_once()
-            if kill == 0:
-                for t in threads[4:]:
-                    t.start()
+        # Kill #1 only once the LB has demonstrably served wave A
+        # traffic — never racing replica warmup/compilation.
+        await_wave(0, 1)
+        spot_preempt(0)
+        for t in threads[4:]:
+            t.start()
+        # Kill #2 gated on wave B progress the same way.
+        await_wave(1, 1)
+        spot_preempt(1)
+        await_wave(0, 4)
+        await_wave(1, 4)
         for t in threads:
-            t.join(timeout=180)
+            t.join(timeout=30)        # all done per the gates above
         servers[0].stop()
         servers[1].stop()
         assert not errors, errors

@@ -334,6 +334,86 @@ def test_step_phase_profiler_and_compile_events():
     assert len(eng.phase_stats()['compiles']) == n_compiles
 
 
+def test_kv_round2_series_registered_at_construction():
+    """KV-round-two stable schema: constructing an engine alone puts
+    the KV read-traffic gauge and BOTH attention-impl attribution
+    series in the registry — zeros from the first scrape, before any
+    decode dispatch."""
+    from skypilot_tpu import telemetry
+    from skypilot_tpu.inference.engine import InferenceEngine
+    from skypilot_tpu.models import configs
+    registry_lib.reset_registry()
+    try:
+        InferenceEngine(configs.get_config('tiny'), max_batch=2,
+                        max_seq=64)
+        prom = telemetry.get_registry().render_prometheus()
+    finally:
+        registry_lib.reset_registry()
+    assert '# TYPE skytpu_kv_read_bytes_per_step gauge' in prom
+    assert 'skytpu_kv_read_bytes_per_step 0' in prom
+    assert '# TYPE skytpu_attn_kernel_ms gauge' in prom
+    for impl in ('per_layer', 'cross_layer'):
+        assert f'skytpu_attn_kernel_ms{{impl="{impl}"}} 0' in prom, impl
+
+
+@pytest.mark.parametrize('kind', ['slot', 'paged'])
+def test_kv_round2_series_updated_by_decode(kind):
+    """After decode traffic the KV read gauge carries live-context x
+    per-token bytes and exactly the attention impl that served the
+    dispatches is non-zero (per_layer here — the slot engine has no
+    cross-layer path and the paged engine defaults off it on CPU)."""
+    from skypilot_tpu import telemetry
+    from skypilot_tpu.inference.engine import kv_token_bytes
+    from skypilot_tpu.models import configs
+    registry_lib.reset_registry()
+    try:
+        cfg = configs.get_config('tiny')
+        if kind == 'paged':
+            from skypilot_tpu.inference.paged import PagedInferenceEngine
+            eng = PagedInferenceEngine(cfg, max_batch=2, max_seq=64,
+                                       decode_impl='gather')
+        else:
+            from skypilot_tpu.inference.engine import InferenceEngine
+            eng = InferenceEngine(cfg, max_batch=2, max_seq=64)
+        eng.add_request([1, 2, 3, 4, 5], max_new_tokens=4)
+        eng.run_to_completion(horizon=4)
+        reg = telemetry.get_registry()
+        kv_gauge = reg.get('skytpu_kv_read_bytes_per_step')
+        per_layer = reg.get('skytpu_attn_kernel_ms', impl='per_layer')
+        cross = reg.get('skytpu_attn_kernel_ms', impl='cross_layer')
+        assert kv_gauge is not None and kv_gauge.value > 0
+        # live context x per-token stored cost: bounded by the full
+        # sequence capacity of the whole batch.
+        assert kv_gauge.value <= kv_token_bytes(cfg, None) * 2 * 64
+        assert per_layer is not None and per_layer.value > 0
+        assert cross is not None and cross.value == 0
+    finally:
+        registry_lib.reset_registry()
+
+
+def test_kv_round2_cross_layer_attribution():
+    """decode_impl='cross_layer' routes the wall-time attribution to
+    the cross_layer series — the per_layer series stays zero."""
+    from skypilot_tpu import telemetry
+    from skypilot_tpu.inference.paged import PagedInferenceEngine
+    from skypilot_tpu.models import configs
+    registry_lib.reset_registry()
+    try:
+        eng = PagedInferenceEngine(configs.get_config('tiny'),
+                                   max_batch=2, max_seq=64,
+                                   decode_impl='cross_layer')
+        eng.add_request([1, 2, 3, 4, 5], max_new_tokens=4)
+        eng.run_to_completion(horizon=4)
+        reg = telemetry.get_registry()
+        assert reg.get('skytpu_attn_kernel_ms',
+                       impl='cross_layer').value > 0
+        assert reg.get('skytpu_attn_kernel_ms',
+                       impl='per_layer').value == 0
+        assert reg.get('skytpu_kv_read_bytes_per_step').value > 0
+    finally:
+        registry_lib.reset_registry()
+
+
 # ---------------------------------------------------------------------------
 # Model server: Prometheus /metrics + /debug/requests over HTTP
 # ---------------------------------------------------------------------------
