@@ -28,11 +28,18 @@ BASELINE_TOK_S_PER_CHIP = 2147.98 / 8          # JetStream Llama-2-7B, v6e-8
 V6E_HBM_BW = 1640.0
 
 
-def _model_traffic_bytes(n_params: float, n_layers: int, n_kv: int,
-                         head_dim: int, batch: int, avg_ctx: float) -> float:
-    param_bytes = 2.0 * n_params
-    kv_bytes = batch * avg_ctx * n_layers * 2 * n_kv * head_dim * 2.0
-    return param_bytes + kv_bytes
+def _model_traffic_bytes(cfg, batch: int, avg_ctx: float,
+                         quantize=None, kv_cache_dtype=None) -> float:
+    """One decode step's HBM byte budget (weight stream + live-context
+    KV read) from the static cost model: the decode program is traced
+    abstractly and priced eqn-by-eqn (analysis/costmodel.py), so
+    quantized packing, scales and pool layout are accounted where they
+    actually live instead of re-derived by hand here."""
+    from skypilot_tpu.analysis import costmodel
+    rb = costmodel.roofline_step_bytes(
+        cfg, batch=batch, avg_ctx=int(avg_ctx), quantize=quantize,
+        kv_cache_dtype=kv_cache_dtype)
+    return rb['step_bytes']
 
 
 def main() -> None:
@@ -635,11 +642,36 @@ def _bench_7b_serving(chip_bw: float, n_chips: int) -> dict:
         roof_batch = batch
 
     # int8 roofline at the headline batch: weight + scale stream +
-    # live KV.
+    # live KV, both priced by the static cost model's traced decode
+    # program (analysis/costmodel.py) — bench no longer hand-multiplies
+    # byte math it doesn't own. Cross-checked against the
+    # skytpu_kv_read_bytes_per_step gauge basis within KV_TOLERANCE.
     avg_ctx = 220 + 160 / 2                  # steady-window shapes
-    live_kv = (roof_batch * avg_ctx * cfg.n_layers * 2 *
-               cfg.n_kv_heads * (cfg.head_dim * 1.0 + 4.0))
-    roofline_tok_s = chip_bw * 1e9 / (param_bytes + live_kv) * roof_batch
+    kv_dtype = paged_detail['kv_cache_dtype']
+    try:
+        from skypilot_tpu.analysis import costmodel
+        from skypilot_tpu.inference.engine import kv_token_bytes
+        _rb = costmodel.roofline_step_bytes(
+            cfg, batch=roof_batch, avg_ctx=int(avg_ctx),
+            quantize='int8', kv_cache_dtype=kv_dtype)
+        step_bytes = _rb['step_bytes']
+        # Same denominator at the paged batch (the spec comparison
+        # runs there): weights are batch-invariant, KV scales with
+        # live tokens.
+        spec_step_bytes = (_rb['weight_bytes'] +
+                           _rb['kv_bytes'] * batch / roof_batch)
+        kv_check = costmodel.kv_static_check(
+            cfg, kv_dtype, kv_token_bytes(cfg, kv_dtype))
+    except Exception as e:  # pylint: disable=broad-except
+        # Hand fallback so a cost-model regression can't hide the
+        # measurement; the parity record carries the error.
+        live_kv = (roof_batch * avg_ctx * cfg.n_layers * 2 *
+                   cfg.n_kv_heads * (cfg.head_dim * 1.0 + 4.0))
+        step_bytes = param_bytes + live_kv
+        spec_step_bytes = param_bytes + live_kv * batch / roof_batch
+        _rb = None
+        kv_check = {'ok': False, 'error': f'{type(e).__name__}: {e}'}
+    roofline_tok_s = chip_bw * 1e9 / step_bytes * roof_batch
     # Speculative-decoding comparison (paged engine, repetitive-text
     # workload — the prompt-lookup proposer's favorable case). Runs
     # LAST in this section so the pool/caches above are freed first;
@@ -650,8 +682,8 @@ def _bench_7b_serving(chip_bw: float, n_chips: int) -> dict:
             max_seq=max_seq, n_chips=n_chips,
             speculate_k=int(os.environ.get('BENCH_SPECULATE_K', '4')),
             horizon=horizon,
-            roofline_tok_s=chip_bw * 1e9 / (param_bytes + live_kv)
-            * batch, engine_kwargs={'prefill_w8a8': True})
+            roofline_tok_s=chip_bw * 1e9 / spec_step_bytes * batch,
+            engine_kwargs={'prefill_w8a8': True})
     except Exception as e:  # pylint: disable=broad-except
         spec_detail = {'error': f'{type(e).__name__}: {e}'}
     vs_baseline = headline / BASELINE_TOK_S_PER_CHIP
@@ -675,6 +707,14 @@ def _bench_7b_serving(chip_bw: float, n_chips: int) -> dict:
             'decode_tok_s_per_chip': round(headline_decode, 2),
             'decode_roofline_frac': round(headline_decode /
                                           roofline_tok_s, 3),
+            # Static cost-model attribution behind the roofline
+            # denominator, plus the KV parity record (static
+            # stored-bytes/token vs the telemetry gauge basis).
+            'roofline_step_bytes': int(step_bytes),
+            'roofline_bytes_by_class': (
+                {k: int(v) for k, v in _rb['read_by_class'].items()}
+                if _rb else None),
+            'kv_static_check': kv_check,
             'phase_ms_per_step': {
                 'total': round(per_step * 1e3, 3),
                 'weights_stream': round(weights_ms, 3),
@@ -2864,16 +2904,15 @@ def _bench_1b_modeled(on_tpu: bool, chip_bw: float, n_chips: int) -> dict:
     steady_decode_window()                  # compile every kv bucket hit
     decode_tok_s = steady_decode_window() / n_chips
 
-    param_bytes = 2.0 * cfg.num_params
-    live_kv = (batch * (prompt_len + gen_len / 2) * cfg.n_layers * 2 *
-               cfg.n_kv_heads * cfg.head_dim * 2.0)
-    roofline_tok_s = chip_bw * 1e9 / (param_bytes + live_kv) * batch
-    roofline_frac = decode_tok_s / roofline_tok_s
-
+    # Static cost-model byte budgets (bf16 weights + bf16 KV at this
+    # scale) drive both the roofline and the 7B-equivalence ratio —
+    # the same traced-jaxpr accounting as the audit byte gates.
+    from skypilot_tpu.models import configs as _configs
     avg_ctx = prompt_len + gen_len / 2
-    ours = _model_traffic_bytes(cfg.num_params, cfg.n_layers,
-                                cfg.n_kv_heads, cfg.head_dim, batch, avg_ctx)
-    ref7b = _model_traffic_bytes(6.74e9, 32, 32, 128, batch, avg_ctx)
+    ours = _model_traffic_bytes(cfg, batch, avg_ctx)
+    ref7b = _model_traffic_bytes(_configs.LLAMA2_7B, batch, avg_ctx)
+    roofline_tok_s = chip_bw * 1e9 / ours * batch
+    roofline_frac = decode_tok_s / roofline_tok_s
     equiv_7b = tok_s_chip * ours / ref7b
     vs_baseline = (equiv_7b * V6E_HBM_BW / chip_bw) / BASELINE_TOK_S_PER_CHIP
 
@@ -2885,7 +2924,7 @@ def _bench_1b_modeled(on_tpu: bool, chip_bw: float, n_chips: int) -> dict:
     # Speculative comparison at this scale too (slot engine; tiny on
     # the CPU fallback so the spec block always rides the trajectory).
     try:
-        roofline_spec = chip_bw * 1e9 / (param_bytes + live_kv) * batch
+        roofline_spec = roofline_tok_s
         spec_detail = _spec_bench(
             InferenceEngine, cfg, None, batch=batch, max_seq=max_seq,
             n_chips=n_chips,
@@ -2910,6 +2949,9 @@ def _bench_1b_modeled(on_tpu: bool, chip_bw: float, n_chips: int) -> dict:
             'raw_tok_s_per_chip': round(tok_s_chip, 2),
             'decode_tok_s_per_chip': round(decode_tok_s, 2),
             'decode_roofline_frac': round(roofline_frac, 3),
+            # Static cost-model byte budgets behind the equivalence.
+            'roofline_step_bytes': int(ours),
+            'ref_7b_step_bytes': int(ref7b),
             'batch': batch,
             'prompt_len': prompt_len,
             'gen_len': gen_len,
@@ -3067,10 +3109,17 @@ def _quant4_bench(n_chips: int, chip_bw: float) -> dict:
                                            horizon=16)
         sb4 = stream_bytes('int4')
         stream_bw = sb4 / (weights_ms * 1e-3)          # bytes/s
-        # Live int8 KV per step (auto-coupled with int4 weights).
+        # Live int8 KV per step (auto-coupled with int4 weights) from
+        # the static cost model's traced decode program; the weight
+        # term stays the measured stored stream the bandwidth was
+        # calibrated against. ``weights_static_ratio`` cross-checks
+        # the two weight accountings.
         avg_ctx = len(prompt) + gen_len / 2
-        live_kv = (batch * avg_ctx * cfg.n_layers * 2 * cfg.n_kv_heads
-                   * (cfg.head_dim + 4))
+        from skypilot_tpu.analysis import costmodel
+        _rb4 = costmodel.roofline_step_bytes(
+            cfg, batch=batch, avg_ctx=int(avg_ctx), quantize='int4',
+            kv_cache_dtype='int8')
+        live_kv = _rb4['kv_bytes']
         roofline_tok_s = stream_bw / (sb4 + live_kv) * batch
         tok_s_by_k = {}
         min_tok = batch * 32            # equal-token windows across k
@@ -3103,6 +3152,11 @@ def _quant4_bench(n_chips: int, chip_bw: float) -> dict:
             q_table['int8'] / q_table['int4'], 2),
         'weights_only_stream_ms_per_step': round(weights_ms, 3),
         'calibrated_stream_gb_s': round(stream_bw / 1e9, 3),
+        # Static cost-model KV term behind the roofline + the static
+        # weight stream vs the measured stored stream (should sit near
+        # 1.0 — the model reads packed codes + scales, not bf16).
+        'live_kv_bytes_static': int(live_kv),
+        'weights_static_ratio': round(_rb4['weight_bytes'] / sb4, 3),
         'int4_roofline_tok_s_per_chip': round(
             roofline_tok_s / n_chips, 2),
         'sustained_decode_tok_s_per_chip_by_k': tok_s_by_k,
@@ -3152,10 +3206,21 @@ def _kv_round2_bench(n_chips: int, chip_bw: float) -> dict:
                 + batch * cfg.dim * 2)
 
     avg_ctx = len(prompt) + gen_len / 2
-    tok_bytes = {m: kv_token_bytes(cfg, m)
-                 for m in ('bf16', 'int8', 'int4')}
-    kv_read = {m: int(batch * avg_ctx * tok_bytes[m])
-               for m in tok_bytes}
+    # Per-token KV cost and per-step KV read from the static cost
+    # model (traced paged-decode jaxpr, pool avals / capacity), cross-
+    # checked against the runtime ``kv_token_bytes`` basis of the
+    # skytpu_kv_read_bytes_per_step gauge within KV_TOLERANCE — the
+    # parity record rides the result as ``kv_static_check``.
+    from skypilot_tpu.analysis import costmodel
+    static_cost = {m: costmodel.abstract_decode_cost(
+        cfg, batch=batch, avg_ctx=int(avg_ctx), quantize='int4',
+        kv_cache_dtype=m) for m in ('bf16', 'int8', 'int4')}
+    tok_bytes = {m: static_cost[m].kv_bytes_per_token
+                 for m in static_cost}
+    kv_read = {m: int(c.kv_read_bytes_per_step(batch * avg_ctx))
+               for m, c in static_cost.items()}
+    kv_parity = {m: costmodel.kv_static_check(
+        cfg, m, kv_token_bytes(cfg, m)) for m in static_cost}
     with warnings_mod.catch_warnings(record=True) as caught:
         warnings_mod.simplefilter('always')
         weights_ms = _weights_only_step_ms(p4, cfg, batch, horizon=16)
@@ -3189,6 +3254,7 @@ def _kv_round2_bench(n_chips: int, chip_bw: float) -> dict:
         'decode_steps_per_call': k,
         'kv_token_bytes': tok_bytes,
         'kv_read_bytes_per_step': kv_read,
+        'kv_static_check': kv_parity,
         'streamed_weight_bytes_per_step': int(sb),
         'calibrated_stream_gb_s': round(stream_bw / 1e9, 3),
         'roofline_tok_s_per_chip_by_kv': {
@@ -3221,20 +3287,19 @@ def _kv_round2_bench(n_chips: int, chip_bw: float) -> dict:
 def _kv_round2_7b_projection(batch: int = 48, ctx: int = 2048) -> dict:
     """The serving-batch byte mix the kv_round2 acceptance bar is
     about: per-step streamed bytes at llama2-7b with int4 weights, and
-    the roofline speedup from swapping the KV grid. Pure arithmetic on
-    ``kv_token_bytes`` + stored-bytes math — no measurement, so it
-    belongs next to the measured block, not in place of it."""
-    from skypilot_tpu.inference.engine import kv_token_bytes
+    the roofline speedup from swapping the KV grid. Statically derived
+    from the cost model's traced 7B decode program (packed int4 codes
+    + scales + bf16 riders for the weight stream, pool avals for the
+    KV term) — no measurement, so it belongs next to the measured
+    block, not in place of it."""
+    from skypilot_tpu.analysis import costmodel
     from skypilot_tpu.models import configs
     cfg = configs.LLAMA2_7B
-    # int4 quantizable leaves ~= params/2 bytes + per-channel scale
-    # noise; embed/norms ride bf16. Close enough for a byte-mix ratio.
-    n_params = (cfg.vocab_size * cfg.dim * 2
-                + cfg.n_layers * (4 * cfg.dim * cfg.dim
-                                  + 3 * cfg.dim * cfg.ffn_dim))
-    w_bytes = n_params // 2
-    kv = {m: batch * ctx * kv_token_bytes(cfg, m)
-          for m in ('bf16', 'int8', 'int4')}
+    rb = {m: costmodel.roofline_step_bytes(
+        cfg, batch=batch, avg_ctx=ctx, quantize='int4',
+        kv_cache_dtype=m) for m in ('bf16', 'int8', 'int4')}
+    w_bytes = rb['int8']['weight_bytes']
+    kv = {m: rb[m]['kv_bytes'] for m in rb}
     return {
         'weight_bytes_int4': int(w_bytes),
         'kv_read_bytes_per_step': {m: int(v) for m, v in kv.items()},

@@ -78,6 +78,17 @@ class AuditReport:
     # label explicitly instead of loosening the decode gate.
     allowed_all_gathers_by_label: Dict[str, int] = dataclasses.field(
         default_factory=dict)
+    # Static per-dispatch cost model (analysis/costmodel.py): label ->
+    # DispatchCost priced from the captured steady-state arg structs.
+    # ``byte_budget`` is the preset's declared per-class read ceiling
+    # (costmodel.BYTE_BUDGETS via run_preset); exceeding it fails ok()
+    # with per-eqn byte attribution, same as a recompile would.
+    preset: str = ''
+    dispatch_costs: Dict[str, Any] = dataclasses.field(
+        default_factory=dict)
+    byte_budget: Dict[str, Dict[str, int]] = dataclasses.field(
+        default_factory=dict)
+    cost_error: str = ''
 
     @property
     def unsanctioned_transfers(self) -> List[TransferEvent]:
@@ -102,12 +113,30 @@ class AuditReport:
                            f'{allowed} known')
         return out
 
+    def byte_budget_violations(self) -> List[str]:
+        """The byte-budget gate: only armed when a budget is declared
+        for this preset. A declared budget with NO captured costs is a
+        loud failure (the capture path regressed), never a silent
+        pass."""
+        if not self.byte_budget:
+            return []
+        if self.cost_error:
+            return ['byte budget declared but the cost model failed: '
+                    f'{self.cost_error}']
+        if not self.dispatch_costs:
+            return ['byte budget declared but no dispatch costs were '
+                    'captured (decode never fired through the shim?)']
+        from skypilot_tpu.analysis import costmodel
+        return costmodel.check_budget(self.dispatch_costs,
+                                      self.byte_budget)
+
     def ok(self) -> bool:
         return (not self.unsanctioned_transfers
                 and not any(self.recompiles.values())
                 and not self.callback_prims
                 and not self.f64_promotions
-                and not self.collective_violations())
+                and not self.collective_violations()
+                and not self.byte_budget_violations())
 
     def format(self) -> str:
         lines = [f'jaxpr audit: {self.name} — '
@@ -141,7 +170,41 @@ class AuditReport:
                          f'{dict(sorted(counts.items())) or "none"}')
         for v in self.collective_violations():
             lines.append(f'  RESHARDING COLLECTIVE: {v}')
+        for label, cost in self.dispatch_costs.items():
+            lines.append(f'  cost [{label}]: {cost.read_total:,} B '
+                         f'read, {cost.written_total:,} B written, '
+                         f'{cost.flops:,} FLOPs')
+        if self.cost_error:
+            lines.append(f'  cost model error: {self.cost_error}')
+        for v in self.byte_budget_violations():
+            lines.append(f'  BYTE BUDGET: {v}')
         return '\n'.join(lines)
+
+    def to_json(self) -> Dict[str, Any]:
+        """Machine-readable report (the ``graftcheck --json`` schema;
+        see docs/analysis.md)."""
+        return {
+            'name': self.name,
+            'preset': self.preset,
+            'ok': self.ok(),
+            'transfers': {
+                'total': len(self.transfers),
+                'unsanctioned': [str(t) for t in
+                                 self.unsanctioned_transfers],
+            },
+            'recompiles': dict(self.recompiles),
+            'static_keys': self.static_keys,
+            'callback_prims': list(self.callback_prims),
+            'f64_promotions': list(self.f64_promotions),
+            'collectives': {k: dict(v)
+                            for k, v in self.collectives.items()},
+            'collective_violations': self.collective_violations(),
+            'dispatch_costs': {k: c.to_json()
+                               for k, c in self.dispatch_costs.items()},
+            'byte_budget': self.byte_budget,
+            'byte_budget_violations': self.byte_budget_violations(),
+            'cost_error': self.cost_error,
+        }
 
 
 # ------------------------------------------------------------------ intercept
@@ -391,6 +454,64 @@ def _record_static_keys(engine, report: AuditReport,
     return inner
 
 
+def _capture_spec_args(engine, capture: Dict[str, Any]) -> None:
+    """Shim the spec jit getters so the verify/fused dispatch's args
+    are captured for pricing: spec steady state never touches
+    ``_decode_fn``, so the decode shim alone would leave speculative
+    presets without dispatch costs. The spec jits take all-array args
+    (sample/kv_bucket are baked into the closure), so the capture is
+    (arg structs, jit fn) — directly traceable."""
+    for getter_name, label in (('_get_spec_verify', 'spec_verify'),
+                               ('_get_spec_fused', 'spec_fused')):
+        getter = getattr(engine, getter_name, None)
+        if getter is None:
+            continue
+
+        def shim(*gargs, _getter=getter, _label=label, **gkw):
+            fn = _getter(*gargs, **gkw)
+
+            def wrapped(*args, **kwargs):
+                capture[_label] = (_arg_structs(args), fn)
+                return fn(*args, **kwargs)
+            return wrapped
+
+        setattr(engine, getter_name, shim)
+
+
+def _capture_decode_args(engine, capture: Dict[str, Any]):
+    """Minimal capture shim (no static-key recording) for audits that
+    track dispatch counts through other entry points."""
+    inner = engine._decode_fn
+
+    def shim(*args, **kwargs):
+        capture['args'] = _arg_structs(args)
+        return inner(*args, **kwargs)
+
+    engine._decode_fn = shim
+    return inner
+
+
+def _attach_costs(report: AuditReport, engine, inner,
+                  capture: Dict[str, Any]) -> None:
+    """Price the captured steady-state dispatches with the static cost
+    model. Failures land in ``cost_error`` — fatal only for presets
+    that declare a byte budget (see byte_budget_violations)."""
+    try:
+        from skypilot_tpu.analysis import costmodel
+        report.dispatch_costs = costmodel.engine_dispatch_costs(
+            engine, _jit_fns(inner), capture.get('args'))
+        for label in ('spec_verify', 'spec_fused'):
+            got = capture.get(label)
+            if got is None:
+                continue
+            sargs, sfn = got
+            classes = engine.decode_operand_classes(sargs)
+            report.dispatch_costs[label] = costmodel.trace_dispatch(
+                sfn, sargs, classes, label=label)
+    except Exception as e:  # pragma: no cover - trace-shape drift
+        report.cost_error = f'{type(e).__name__}: {e}'
+
+
 def _arg_structs(args):
     """args -> ShapeDtypeStructs carrying mesh shardings. Committed
     NamedSharding args (params, cache, the pinned ring) keep their
@@ -516,8 +637,9 @@ def audit_engine(kind: str = 'slot', chunked: bool = True,
     for _ in range(max(1, warmup_rounds)):              # warmup: compiles
         _drive(engine, prompts)
     capture: Dict[str, Any] = {}
-    inner = _record_static_keys(engine, report,
-                                capture if mesh_tp else None)
+    inner = _record_static_keys(engine, report, capture)
+    if speculate_k:
+        _capture_spec_args(engine, capture)
     decode_jits = _jit_fns(inner)
     labels = {'decode': lambda: (sum(_cache_size(f)
                                      for f in decode_jits)
@@ -552,6 +674,7 @@ def audit_engine(kind: str = 'slot', chunked: bool = True,
         if merge_all_gathers:
             report.allowed_all_gathers_by_label['merge'] = \
                 merge_all_gathers
+    _attach_costs(report, engine, inner, capture)
     # Jaxpr of the fused decode step itself (the hot program).
     try:
         import jax
@@ -604,7 +727,8 @@ def audit_multistep(k: int = 4,
         engine.run_to_completion(horizon=1)
 
     one_round()                                   # warmup: compiles
-    inner = _record_static_keys(engine, report)
+    capture: Dict[str, Any] = {}
+    inner = _record_static_keys(engine, report, capture)
     decode_jits = _jit_fns(inner)
     labels = {'decode': lambda: (sum(_cache_size(f)
                                      for f in decode_jits)
@@ -618,6 +742,7 @@ def audit_multistep(k: int = 4,
     engine._decode_fn = inner
     report.compile_counts = {
         name: (before[name], get()) for name, get in labels.items()}
+    _attach_costs(report, engine, inner, capture)
     # ONE dispatch per k tokens: 2k decode tokens/round at lockstep =
     # exactly 2 dispatches/round. Recorded as an (expected, actual)
     # compile_counts pair so a mismatch fails ok() like a recompile.
@@ -692,6 +817,9 @@ def audit_spec_multistep(k: int = 4, steps: int = 3) -> AuditReport:
     count_calls(engine, '_spec_fused_call', fused)
     count_calls(engine, '_spec_verify_call', fallback)
     one_wave(engine)                              # warmup: compiles
+    capture: Dict[str, Any] = {}
+    inner = _capture_decode_args(engine, capture)
+    _capture_spec_args(engine, capture)
     spec_fns = engine._spec_verify_fns
     before = len(spec_fns)
     fused[0] = fallback[0] = 0
@@ -699,6 +827,8 @@ def audit_spec_multistep(k: int = 4, steps: int = 3) -> AuditReport:
     with intercept_host_transfers(report.transfers):
         for _ in range(rounds):
             one_wave(engine)
+    engine._decode_fn = inner
+    _attach_costs(report, engine, inner, capture)
     per_wave = -(-single[0] // steps)             # ceil
     report.compile_counts = {
         'spec program cache': (before, len(spec_fns)),
@@ -733,6 +863,15 @@ def audit_llama_forward() -> AuditReport:
     report.callback_prims, report.promotions = walk_jaxpr(jx)
     report.f64_promotions = [p for p in report.promotions
                              if 'float64' in p]
+    try:
+        from skypilot_tpu.analysis import costmodel
+        classes = jax.tree.leaves(costmodel.classify_params(params))
+        classes.append(costmodel.TABLE)           # the token ids
+        report.dispatch_costs['forward'] = \
+            costmodel.analyze_closed_jaxpr(jx, classes,
+                                           label='forward')
+    except Exception as e:  # pragma: no cover - trace-shape drift
+        report.cost_error = f'{type(e).__name__}: {e}'
     return report
 
 
@@ -784,7 +923,9 @@ def audit_disagg() -> AuditReport:
         prefill.run_to_completion(horizon=8)
 
     one_round()                                   # warmup: compiles
-    decode_jits = _jit_fns(decode._decode_fn)
+    capture: Dict[str, Any] = {}
+    inner = _capture_decode_args(decode, capture)
+    decode_jits = _jit_fns(inner)
     labels = {
         'decode-worker decode': lambda: (sum(
             _cache_size(f) for f in decode_jits)
@@ -804,8 +945,10 @@ def audit_disagg() -> AuditReport:
     with intercept_host_transfers(report.transfers):
         for _ in range(2):
             one_round()
+    decode._decode_fn = inner
     report.compile_counts = {
         k: (before[k], get()) for k, get in labels.items()}
+    _attach_costs(report, decode, inner, capture)
     return report
 
 
@@ -840,9 +983,13 @@ def audit_telemetry_parity(kind: str = 'slot') -> AuditReport:
         if mode:
             # Transfers recorded only for the telemetry-ON run: the
             # claim under test is that telemetry adds none.
+            capture: Dict[str, Any] = {}
+            inner = _capture_decode_args(engine, capture)
             with intercept_host_transfers(report.transfers):
                 for _ in range(2):
                     _drive(engine, prompts)
+            engine._decode_fn = inner
+            _attach_costs(report, engine, inner, capture)
         else:
             for _ in range(2):
                 _drive(engine, prompts)
@@ -951,6 +1098,21 @@ DEFAULT_PRESETS: List[str] = [
     'spec-multistep', 'llama']
 
 
+def run_preset(name: str) -> AuditReport:
+    """Run one preset and arm its declared byte budget (the gate):
+    presets listed in costmodel.BYTE_BUDGETS fail ok() when a captured
+    dispatch's per-class HBM reads exceed the declared ceiling."""
+    report = PRESETS[name]()
+    report.preset = name
+    try:
+        from skypilot_tpu.analysis import costmodel
+        report.byte_budget = costmodel.budget_for(name) or {}
+    except Exception as e:  # pragma: no cover - import drift
+        report.cost_error = report.cost_error or \
+            f'{type(e).__name__}: {e}'
+    return report
+
+
 def run_presets(names: Optional[List[str]] = None) -> List[AuditReport]:
     names = names or list(DEFAULT_PRESETS)
-    return [PRESETS[n]() for n in names]
+    return [run_preset(n) for n in names]

@@ -627,6 +627,13 @@ class _Checker(ast.NodeVisitor):
         self.is_sim_path = is_sim_path
         self.is_lifecycle_path = is_lifecycle_path
         self._flagged_sleeps: Set[int] = set()   # node ids (GC112 dedupe)
+        # Aliased time-module spellings seen in this file:
+        # ``import time as t`` -> {'t': 'time'};
+        # ``from time import monotonic as mono`` -> {'mono':
+        # 'time.monotonic'}. The timing rules (GC109/GC115/GC117)
+        # canonicalize call names through this map so an alias can't
+        # smuggle a wall-clock read past them.
+        self._time_aliases: Dict[str, str] = {}
         self.violations: List[Violation] = []
         self._scope: List[str] = []
         self._class: List[Tuple[Set[str], Set[str]]] = []  # (locks, guarded)
@@ -726,6 +733,37 @@ class _Checker(ast.NodeVisitor):
 
     def visit_AsyncFunctionDef(self, node):
         self._visit_func(node, is_async=True)
+
+    # ------------------------------------------------- time aliases
+    def visit_Import(self, node):
+        for alias in node.names:
+            if alias.name == 'time' and alias.asname:
+                self._time_aliases[alias.asname] = 'time'
+        self.generic_visit(node)
+
+    def visit_ImportFrom(self, node):
+        if node.module == 'time' and not node.level:
+            for alias in node.names:
+                if alias.asname:
+                    self._time_aliases[alias.asname] = \
+                        f'time.{alias.name}'
+        self.generic_visit(node)
+
+    def _canon_time_name(self, name: str) -> str:
+        """Canonical time.* spelling for an aliased call name:
+        ``t.monotonic`` -> ``time.monotonic`` (import time as t),
+        ``now`` -> ``time.time`` (from time import time as now).
+        Unaliased names pass through untouched, so the bare-name
+        fallbacks in the timing rules keep working."""
+        if not name or not self._time_aliases:
+            return name
+        head, dot, rest = name.partition('.')
+        target = self._time_aliases.get(head)
+        if target is None:
+            return name
+        if dot:
+            return f'time.{rest}' if target == 'time' else name
+        return target
 
     @property
     def _in_async(self) -> bool:
@@ -1157,6 +1195,7 @@ class _Checker(ast.NodeVisitor):
         ``time.time()`` makes the decision unreplayable under test
         (and silently divergent between the test's synthetic trace and
         production)."""
+        name = self._canon_time_name(name)
         if (name in _SCALING_WALLCLOCK
                 or ('.' not in name and name in _SCALING_WALLCLOCK_BARE)):
             self._add('GC115', node,
@@ -1223,6 +1262,7 @@ class _Checker(ast.NodeVisitor):
         (``EventLoop.now``/``EventLoop.sleep``); a single ``time.*``
         call makes same-seed runs diverge — silently, since the run
         still *works*, it just stops being byte-replayable."""
+        name = self._canon_time_name(name)
         if (name in _SIM_WALLCLOCK
                 or ('.' not in name and name in _SIM_WALLCLOCK_BARE)):
             self._add('GC117', node,
@@ -1232,6 +1272,7 @@ class _Checker(ast.NodeVisitor):
                       'the byte-identical same-seed replay contract')
 
     def _check_adhoc_timing(self, node: ast.Call, name: str) -> None:
+        name = self._canon_time_name(name)
         if (name in _ADHOC_TIMING
                 or ('.' not in name and name in _ADHOC_TIMING_BARE)):
             self._add('GC109', node,

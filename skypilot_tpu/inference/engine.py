@@ -322,6 +322,26 @@ class _EngineBase:
                 else 'per_layer')
         self._attn_ms_gauges[impl].set(dt_s / max(1, substeps) * 1e3)
 
+    # ------------------------------------------ cost-model boundary
+    # Operand-class annotation at the decode program boundary: the
+    # static cost model (analysis/costmodel.py) prices each dispatch
+    # by attributing every jaxpr input to a byte stream — weights
+    # (codes/scales split out for quantized trees), the KV pool, and
+    # the per-call control tables. Both engines share the calling
+    # convention (args[0]=params, args[1]=cache, control after), so
+    # the base annotation covers them.
+    def decode_operand_classes(self, args):
+        from skypilot_tpu.analysis import costmodel
+        return costmodel.classify_decode_args(args)
+
+    def kv_token_capacity(self) -> int:
+        """Token rows the resident KV arrays physically hold (the
+        divisor that turns pool avals into stored bytes/token — the
+        cost model's telemetry-comparable KV unit). The slot cache
+        reserves every row up front; the paged pool overrides with
+        its page count."""
+        return self.max_batch * self.max_seq
+
     def phase_stats(self) -> Dict[str, Any]:
         """Step-phase latency decomposition + first-compile events for
         THIS engine (the bench and ``/debug`` surface)."""

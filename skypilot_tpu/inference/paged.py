@@ -1321,6 +1321,13 @@ class PagedInferenceEngine(SpeculativeMixin, _EngineBase):
             'prefix_misses': self.alloc.prefix_misses,
         }
 
+    def kv_token_capacity(self) -> int:
+        """Token rows the pool arrays physically hold (trash page
+        included — the cost model divides pool AVAL bytes, and page 0
+        is part of the aval). Distinct from ``memory_stats``'s
+        allocatable capacity, which excludes the reserved page."""
+        return self.alloc.n_pages * self.page
+
     def kv_pool_stats(self) -> Dict[str, Any]:
         """KV capacity/pressure in TOKENS (page-granular: a partially
         filled page counts as used) — the schema shared with the slot

@@ -41,6 +41,21 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 # jax renamed TPUCompilerParams -> CompilerParams across 0.4.x/0.5.x.
+# Cost-model annotation (analysis/costmodel.py): these KERNEL BODIES
+# (the names pallas_call eqns carry) take the FULL layer-stacked pool
+# ([L, n_pages, ...]) with the layer as a scalar-prefetch block index
+# and DMA one layer's pages per call — so the static analyzer prices
+# their kv_pool/kv_scale operands at aval_bytes / L, not the whole
+# stacked aval. ``_kernel_all`` (the all-layers sweep) is deliberately
+# absent: it really does read every layer. A kernel that starts
+# reading more than its layer must drop itself from this map (and eat
+# the byte budget it then owes).
+COST_KERNEL_KV_TRAFFIC = {
+    '_kernel': 'one_layer_per_call',          # paged_decode_attention
+    '_kernel_manual': 'one_layer_per_call',
+    '_kernel_fused': 'one_layer_per_call',    # ..._fused (cross-layer)
+}
+
 _CompilerParams = getattr(pltpu, 'CompilerParams',
                           getattr(pltpu, 'TPUCompilerParams', None))
 

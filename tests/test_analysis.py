@@ -1186,3 +1186,112 @@ def test_gc120_journal_kinds_registered():
     import pytest as _pytest
     with _pytest.raises(ValueError, match='unknown journal op kind'):
         serve_state.journal_op_start('svc', 'meteor', 1, None)
+
+
+# --------------------------------------------- aliased-import timing
+def test_gc109_aliased_time_imports_flagged():
+    # ``from time import time as now`` / ``import time as t`` must not
+    # smuggle wall-clock reads past the inference timing rule — the
+    # checker canonicalizes aliases before matching.
+    src = '''
+    import time as t
+    from time import time as now
+    def step(self):
+        return now() + t.monotonic()
+    '''
+    ids = rule_ids(src, 'skypilot_tpu/inference/engine_x.py')
+    assert ids == ['GC109', 'GC109']
+
+
+def test_gc115_aliased_time_imports_flagged():
+    src = '''
+    import time as t
+    from time import monotonic as mono
+    def evaluate(self):
+        return t.time() + mono()
+    '''
+    assert rule_ids(src, 'skypilot_tpu/serve/autoscalers.py') == [
+        'GC115', 'GC115']
+
+
+def test_gc117_aliased_time_imports_flagged():
+    src = '''
+    from time import time as wall
+    import time as t
+    def run_until(self, t_end):
+        return wall() - t.perf_counter()
+    '''
+    assert rule_ids(src, 'skypilot_tpu/serve/sim/core_x.py') == [
+        'GC117', 'GC117']
+
+
+def test_time_alias_canonical_name_in_message():
+    src = '''
+    from time import time as now
+    def step(self):
+        return now()
+    '''
+    v = check(src, 'skypilot_tpu/inference/x.py')[0]
+    assert 'time.time' in v.message
+
+
+def test_non_time_aliases_not_canonicalized():
+    # An alias of something that merely LOOKS like a clock must not
+    # trip the rules: only the time module's names canonicalize.
+    src = '''
+    from mylib import time as now
+    def step(self):
+        return now()
+    '''
+    assert rule_ids(src, 'skypilot_tpu/inference/x.py') == []
+
+
+# -------------------------------------------------- graftcheck --json
+def test_cli_lint_json_schema(capsys):
+    import json as json_lib
+    assert graftcheck_main(['lint', '--json']) == 0
+    doc = json_lib.loads(capsys.readouterr().out)
+    assert set(doc) == {'ok', 'violations', 'baselined'}
+    assert doc['ok'] is True and doc['violations'] == []
+    assert isinstance(doc['baselined'], int)
+
+
+def test_cli_lint_json_violation_fields(tmp_path, capsys):
+    import json as json_lib
+    bad = tmp_path / 'skypilot_tpu' / 'serve' / 'x.py'
+    bad.parent.mkdir(parents=True)
+    bad.write_text('try:\n    pass\nexcept:\n    pass\n')
+    assert graftcheck_main(
+        ['lint', '--json', '--baseline', str(tmp_path / 'empty'),
+         str(bad)]) == 1
+    doc = json_lib.loads(capsys.readouterr().out)
+    assert doc['ok'] is False and len(doc['violations']) == 1
+    v = doc['violations'][0]
+    assert set(v) == {'rule', 'path', 'line', 'col', 'func',
+                      'message', 'source'}
+    assert v['rule'] == 'GC104'
+
+
+# ----------------------------------------- byte-budget staleness gate
+def test_byte_budgets_name_only_live_presets():
+    """Same contract as the lint-baseline staleness gate, for byte
+    budgets: a budget entry for a preset that no longer exists would
+    silently gate nothing — fail loudly instead."""
+    from skypilot_tpu.analysis import costmodel, jaxpr_audit
+    stale = sorted(set(costmodel.BYTE_BUDGETS) -
+                   set(jaxpr_audit.PRESETS))
+    assert not stale, f'BYTE_BUDGETS names unknown presets: {stale}'
+
+
+def test_byte_budget_classes_are_known():
+    from skypilot_tpu.analysis import costmodel
+    known = {costmodel.WEIGHT_BF16, costmodel.WEIGHT_INT8,
+             costmodel.WEIGHT_INT4, costmodel.WEIGHT_SCALE,
+             costmodel.KV_POOL, costmodel.KV_SCALE, costmodel.TABLE,
+             costmodel.ACTIVATION, costmodel.CONST}
+    for preset, labels in costmodel.BYTE_BUDGETS.items():
+        for label, caps in labels.items():
+            for key in caps:
+                assert (key in known
+                        or key.startswith('collective.')), (
+                    preset, label, key)
