@@ -152,6 +152,8 @@ def main() -> None:
              lambda: _gang_bench(n_chips)),
             ('sim',
              _sim_bench),
+            ('affinity',
+             lambda: _affinity_bench(n_chips)),
             ('ctrl_recovery',
              lambda: _ctrl_recovery_bench(n_chips)),
             ('quant4',
@@ -1666,6 +1668,218 @@ def _sim_bench() -> dict:
         'wall_s': round(time_lib.monotonic() - t0, 2),
     })
     return out
+
+
+def _affinity_bench(n_chips: int) -> dict:
+    """Prefix-affinity routing block (round 18): the acceptance
+    comparison from the PR-12 simulator — the IDENTICAL multi-turn
+    trace over 1000 replicas under ``queue_depth`` vs
+    ``prefix_affinity`` (digest routing + session stickiness +
+    proactive migration); affinity must win BOTH warm-TTFT hit rate
+    (higher) and prefix-recompute tokens (strictly fewer). Plus the
+    2-LB tier's crash replay (consistent-hash failover, zero lost) and
+    a LIVE 3-replica/2-LB multi-turn replay with one LB killed
+    mid-conversation: every turn completes and every continuation is
+    byte-identical to a direct single-replica reference."""
+    import logging
+    import time as time_lib
+
+    from skypilot_tpu.serve.sim import scenarios as sim_scenarios
+
+    logging.getLogger('skytpu').setLevel(logging.ERROR)
+    t0 = time_lib.monotonic()
+
+    def view(rep):
+        return {'ttft_hit_rate': rep['ttft_hit_rate'],
+                'recompute_tokens': rep['recompute_tokens'],
+                'warm_hits': rep['warm_hits'],
+                'prefix_migrations': rep['prefix_migrations'],
+                'outcomes': rep['outcomes']}
+
+    mta = sim_scenarios.run_scenario('multi_turn_affinity', seed=0)
+    out: dict = {
+        'sim_multi_turn_1000_replicas': {
+            'queue_depth': view(mta['queue_depth']),
+            'prefix_affinity': view(mta['prefix_affinity']),
+            'affinity_beats_queue_depth':
+                mta['affinity_beats_queue_depth'],
+            'lost': mta['requests']['lost'],
+        },
+    }
+    crash = sim_scenarios.run_scenario('lb_crash', seed=1)
+    out['sim_lb_crash'] = {
+        'lbs': crash['lbs'],
+        'lost': crash['requests']['lost'],
+        'completed': crash['requests']['completed'],
+        'ttft_hit_rate': crash['affinity']['ttft_hit_rate'],
+        'faults_fired': crash['faults_fired'],
+        'event_log_sha256': crash['event_log_sha256'],
+    }
+    try:
+        out['live_replay'] = _affinity_live_replay()
+    except Exception as e:  # pylint: disable=broad-except
+        out['live_replay'] = {'error': f'{type(e).__name__}: {e}'}
+    out['wall_s'] = round(time_lib.monotonic() - t0, 2)
+    return out
+
+
+def _affinity_live_replay() -> dict:
+    """The live tier: 3 tiny replicas behind 2 prefix-affinity LBs
+    sharing a consistent-hash ring; 2 sessions replay 3 turns each and
+    LB-A is killed after turn 1. Reported: turns completed (all),
+    lost (0), and byte-identity of every continuation against a
+    direct single-replica greedy reference."""
+    import json as _json
+    import threading
+    import urllib.request
+
+    import http.server as hs
+
+    from skypilot_tpu.serve.load_balancer import SkyServeLoadBalancer
+    from skypilot_tpu.serve.server import ModelServer
+    from skypilot_tpu.utils import common_utils
+
+    saved_env = {k: os.environ.get(k)
+                 for k in ('SKYTPU_LB_SYNC',)}
+    os.environ['SKYTPU_LB_SYNC'] = '3600'        # manual syncs only
+
+    def generate(base, prompt, n, key, timeout=120):
+        body = _json.dumps({'prompt': prompt,
+                            'max_new_tokens': n}).encode()
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            req = urllib.request.Request(
+                base + '/generate', body,
+                {'Content-Type': 'application/json',
+                 'X-Request-ID': key})
+            try:
+                with urllib.request.urlopen(req, timeout=timeout) as r:
+                    return list(_json.loads(r.read())['tokens'])
+            except OSError:
+                time.sleep(0.5)
+        raise RuntimeError('turn lost')
+
+    servers, lbs, httpd = [], {}, None
+    peers: dict = {}
+    lock = threading.Lock()
+    try:
+        for i in range(3):
+            port = common_utils.find_free_port(19500 + i * 17)
+            servers.append(ModelServer('tiny', max_batch=2,
+                                       max_seq=256, port=port,
+                                       step_watchdog_s=0))
+        for s in servers:
+            s.start(block=False)
+        deadline = time.time() + 240
+        while time.time() < deadline and not all(
+                s._ready.is_set() for s in servers):
+            time.sleep(0.2)
+        if not all(s._ready.is_set() for s in servers):
+            raise RuntimeError('replicas not ready')
+        replica_urls = [f'http://127.0.0.1:{s.port}' for s in servers]
+
+        class H(hs.BaseHTTPRequestHandler):
+            def log_message(self, *a):
+                del a
+
+            def do_POST(self):  # noqa: N802
+                n = int(self.headers.get('Content-Length', 0))
+                req = _json.loads(self.rfile.read(n) or b'{}')
+                with lock:
+                    if req.get('lb_id'):
+                        peers[req['lb_id']] = req.get('lb_url')
+                    body = _json.dumps({
+                        'ready_replica_urls': replica_urls,
+                        'lb_peers': dict(peers)}).encode()
+                self.send_response(200)
+                self.send_header('Content-Length', str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+        cport = common_utils.find_free_port(19600)
+        httpd = hs.ThreadingHTTPServer(('127.0.0.1', cport), H)
+        threading.Thread(target=httpd.serve_forever,
+                         daemon=True).start()
+
+        sessions = {'s-alpha': [11, 13, 17, 19, 23, 29, 31, 37],
+                    's-beta': [41, 43, 47, 53, 59, 61, 67, 71]}
+        turns, per_turn = 3, 6
+        reference = {}
+        for key, seed_prompt in sessions.items():
+            prompt = list(seed_prompt)
+            ref_turns = []
+            for t in range(turns):
+                toks = generate(replica_urls[0], prompt, per_turn,
+                                key=f'ref-{key}-{t}')
+                ref_turns.append(toks)
+                prompt = prompt + toks + [101 + t, 103 + t]
+            reference[key] = ref_turns
+
+        for name in ('lb-a', 'lb-b'):
+            port = common_utils.find_free_port(19700 + len(lbs) * 13)
+            lb = SkyServeLoadBalancer(
+                controller_url=f'http://127.0.0.1:{cport}', port=port,
+                policy_name='prefix_affinity', lb_id=name,
+                advertise_url=f'http://127.0.0.1:{port}')
+            lb.start()
+            lb._sync_once()
+            lbs[name] = lb
+        for lb in lbs.values():          # lb-a synced before lb-b
+            lb._sync_once()              # existed: second round
+        lb_a = f'http://127.0.0.1:{lbs["lb-a"].port}'
+        lb_b = f'http://127.0.0.1:{lbs["lb-b"].port}'
+
+        completed, identical = 0, 0
+        prompts = {k: list(p) for k, p in sessions.items()}
+        t0 = time.time()
+        for key in sessions:             # turn 1 through LB-A
+            toks = generate(lb_a, prompts[key], per_turn,
+                            key=f'{key}-t0')
+            completed += 1
+            identical += toks == reference[key][0]
+            prompts[key] = prompts[key] + toks + [101, 103]
+        lbs['lb-a'].stop()               # the kill
+        with lock:
+            peers.pop('lb-a', None)
+        lbs['lb-b']._sync_once()
+        for t in range(1, turns):        # survivors via LB-B
+            for key in sessions:
+                toks = generate(lb_b, prompts[key], per_turn,
+                                key=f'{key}-t{t}')
+                completed += 1
+                identical += toks == reference[key][t]
+                prompts[key] = (prompts[key] + toks
+                                + [101 + t, 103 + t])
+        total = turns * len(sessions)
+        return {
+            'replicas': 3,
+            'lbs': 2,
+            'lb_killed_after_turn': 1,
+            'sessions': len(sessions),
+            'turns_per_session': turns,
+            'turns_total': total,
+            'turns_completed': completed,
+            'turns_lost': total - completed,
+            'turns_byte_identical': identical,
+            'byte_identical': identical == total,
+            'survivor_ring': sorted(lbs['lb-b']._ring.members),
+            'wall_s': round(time.time() - t0, 2),
+        }
+    finally:
+        if httpd is not None:
+            httpd.shutdown()
+        for lb in lbs.values():
+            try:
+                lb.stop()
+            except Exception:  # pylint: disable=broad-except
+                pass           # lb-a already stopped mid-replay
+        for s in servers:
+            s.stop()
+        for k, v in saved_env.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
 
 
 def _spot_autoscaler_sim() -> dict:

@@ -1001,6 +1001,47 @@ def audit_telemetry_parity(kind: str = 'slot') -> AuditReport:
     return report
 
 
+def audit_digest_export() -> AuditReport:
+    """Prefix-digest export on the probe path, audited.
+
+    ``hot_prefix_digest()`` ships the hottest prefix chains to the LB
+    on every ``/metrics`` scrape (prefix-affinity routing). The
+    contract that makes that free: the digest is built from the
+    host-side heat tracker ONLY — no allocator matching, no device
+    gather. Steady state with a scrape after EVERY wave (far hotter
+    than the real ~1 Hz probe cadence) must show zero unsanctioned
+    d2h transfers and zero jit-cache growth, and every scrape must
+    return entries (the chains the waves registered) — an empty
+    export means the heat tracker regressed, recorded as a
+    compile-count mismatch so it fails ``ok()`` loudly."""
+    report = AuditReport(
+        name='hot-prefix digest export (paged probe path)')
+    engine = _tiny_engine('paged', chunked=True)
+    prompts = [[1, 2, 3] * 9, [4, 5] * 10, [7] * 21]  # >= 1 full page
+    _drive(engine, prompts)                       # warmup: compiles
+    capture: Dict[str, Any] = {}
+    inner = _record_static_keys(engine, report, capture)
+    decode_jits = _jit_fns(inner)
+    labels = {'decode': lambda: (sum(_cache_size(f)
+                                     for f in decode_jits)
+                                 if decode_jits else -1),
+              'prefill': lambda: len(engine._prefill_fns)}
+    before = {k: get() for k, get in labels.items()}
+    rounds = 2
+    scrapes: List[List[Dict[str, Any]]] = []
+    with intercept_host_transfers(report.transfers):
+        for _ in range(rounds):
+            _drive(engine, prompts)
+            scrapes.append(engine.hot_prefix_digest())
+    engine._decode_fn = inner
+    report.compile_counts = {
+        k: (before[k], get()) for k, get in labels.items()}
+    report.compile_counts['scrapes returning entries'] = (
+        rounds, sum(1 for d in scrapes if d))
+    _attach_costs(report, engine, inner, capture)
+    return report
+
+
 PRESETS: Dict[str, Callable[[], AuditReport]] = {
     'slot': lambda: audit_engine('slot', chunked=True),
     'slot-monolithic': lambda: audit_engine('slot', chunked=False),
@@ -1078,6 +1119,11 @@ PRESETS: Dict[str, Callable[[], AuditReport]] = {
     # compose into ONE dispatch per `steps` verify rounds, pinned
     # against a single-round reference engine's dispatch count.
     'spec-multistep': audit_spec_multistep,
+    # Prefix-digest export on the LB probe path: a hot_prefix_digest()
+    # scrape after every wave adds zero unsanctioned d2h and zero
+    # jit-cache growth (host-side heat tracker only), and every scrape
+    # returns entries.
+    'digest': audit_digest_export,
     'llama': audit_llama_forward,
 }
 
@@ -1095,7 +1141,7 @@ DEFAULT_PRESETS: List[str] = [
     'kv-int8', 'kv-int8-slot', 'kv-int4', 'kv-int4-slot',
     'fused-attn', 'paged-tp', 'paged-tp-int8',
     'paged-gang', 'disagg', 'int4', 'multistep', 'int4-multistep',
-    'spec-multistep', 'llama']
+    'spec-multistep', 'digest', 'llama']
 
 
 def run_preset(name: str) -> AuditReport:

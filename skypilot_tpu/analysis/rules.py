@@ -130,6 +130,16 @@ TPU hot-path hygiene (GC2xx), applied to the compute layer
   cross-layer fused variant) exist to avoid. Decode code hands the
   FULL stacked pool to the kernels; prefill/verify-shaped functions
   (compute-bound, need contiguous rows) are exempt.
+- **GC122 unbounded-lb-map-growth** — a growth mutation on a
+  ``self.*`` container (``self.x[k] = v``, ``.append``, ``.add``,
+  ``.setdefault``, ``.update``, ...) in
+  ``serve/load_balancing_policies.py`` outside the
+  :class:`BoundedStore` helper. LB policies run for months and see
+  millions of sessions/replicas churn through; a raw per-key insert
+  on a policy attribute is a slow memory leak with no eviction and no
+  telemetry. Every runtime table goes through ``BoundedStore``
+  (TTL + LRU cap, evictions counted loudly); wholesale reassignment
+  (``self.x = dict(...)``) stays legal — it replaces, never grows.
 - **GC202 host-sync** — device->host readbacks outside the sanctioned
   :func:`skypilot_tpu.utils.host.host_sync` helper (bare
   ``np.asarray(x)``, ``.item()``, ``jax.device_get``,
@@ -245,6 +255,13 @@ RULES: Dict[str, str] = {
              'or the cross-layer fused kernel), never a materialized '
              'per-layer pool copy; prefill/verify-shaped functions '
              'are exempt (compute-bound, need contiguous rows)',
+    'GC122': 'unbounded-lb-map-growth: growth mutation on a self.* '
+             'container (subscript-assign / append / add / setdefault '
+             '/ update / ...) in serve/load_balancing_policies.py '
+             'outside the BoundedStore helper — LB-policy tables see '
+             'unbounded session/replica churn, so every runtime map '
+             'goes through BoundedStore (TTL + LRU cap, evictions '
+             'counted); wholesale reassignment stays legal',
     'GC201': 'impure-jit: impure or host-synchronizing call inside a '
              '@jax.jit body',
     'GC202': 'host-sync: device->host readback outside the '
@@ -432,6 +449,19 @@ _LIFECYCLE_HELPER_SCOPES = ('_persist', '_untrack', '_journal_start',
                             '_journal_finish', '_put_note',
                             '_del_note', '_persist_autoscaler_state')
 
+# --------------------------------------------------------------------- GC122
+# The LB-policy module's one sanctioned mutable map is BoundedStore
+# (TTL + LRU cap, loud evictions). Any OTHER growth mutation on a
+# ``self.*`` container there is a slow leak: policies are resident for
+# months while sessions, request keys and replica URLs churn
+# unboundedly beneath them. Wholesale reassignment (``self.x =
+# dict(...)``) replaces rather than grows and stays legal, as do
+# mutations of locals (per-call, garbage-collected).
+LB_POLICY_PATH_SUFFIXES = ('serve/load_balancing_policies.py',)
+_GC122_EXEMPT_SCOPE_MARKERS = ('BoundedStore',)
+_GC122_GROW_METHODS = {'append', 'appendleft', 'add', 'setdefault',
+                       'update', 'extend', 'insert'}
+
 # --------------------------------------------------------------------- GC118
 # The central fault-site registry, resolved lazily (the faults module
 # imports telemetry; pulling it at import time would make the linter's
@@ -613,7 +643,8 @@ class _Checker(ast.NodeVisitor):
                  is_scaling_path: bool = False,
                  is_gang_path: bool = False,
                  is_sim_path: bool = False,
-                 is_lifecycle_path: bool = False):
+                 is_lifecycle_path: bool = False,
+                 is_lb_policy_path: bool = False):
         self.rel = rel
         self.lines = lines
         self.is_compute = is_compute
@@ -626,6 +657,7 @@ class _Checker(ast.NodeVisitor):
         self.is_gang_path = is_gang_path
         self.is_sim_path = is_sim_path
         self.is_lifecycle_path = is_lifecycle_path
+        self.is_lb_policy_path = is_lb_policy_path
         self._flagged_sleeps: Set[int] = set()   # node ids (GC112 dedupe)
         # Aliased time-module spellings seen in this file:
         # ``import time as t`` -> {'t': 'time'};
@@ -867,10 +899,14 @@ class _Checker(ast.NodeVisitor):
                     if isinstance(t, ast.Name))
         for tgt in node.targets:
             self._check_state_write(tgt, node)
+            if self.is_lb_policy_path:
+                self._check_lb_map_growth_target(tgt, node)
         self.generic_visit(node)
 
     def visit_AugAssign(self, node):
         self._check_state_write(node.target, node)
+        if self.is_lb_policy_path:
+            self._check_lb_map_growth_target(node.target, node)
         self.generic_visit(node)
 
     # ----------------------------------------------------------- excepts
@@ -937,6 +973,8 @@ class _Checker(ast.NodeVisitor):
             self._check_fault_site(node)
         if self.is_lifecycle_path:
             self._check_lifecycle_write(node, name, method)
+        if self.is_lb_policy_path:
+            self._check_lb_map_growth_call(node, method)
         if self.is_serve and self._in_async:
             self._check_async_engine_call(node, name, method)
         if self._any_lock_held():
@@ -1256,6 +1294,48 @@ class _Checker(ast.NodeVisitor):
                   'drift from the state machine (restart '
                   'reconciliation replays the journal)')
 
+    def _gc122_exempt(self) -> bool:
+        return any(m in s for s in self._scope
+                   for m in _GC122_EXEMPT_SCOPE_MARKERS)
+
+    def _check_lb_map_growth_target(self, target: ast.AST,
+                                    node: ast.AST) -> None:
+        """GC122 (stores): ``self.x[k] = v`` / ``self.x[k] += v`` in the
+        LB-policy module grows a per-key table keyed by churning
+        sessions/replicas — route it through BoundedStore (put/incr)
+        so TTL + LRU bound it. Plain ``self.x = ...`` (wholesale
+        reassignment) and mutations of locals stay legal."""
+        if not isinstance(target, ast.Subscript):
+            return
+        attr = _self_attr(target)
+        if attr is None or self._gc122_exempt():
+            return
+        self._add('GC122', node,
+                  f'per-key write to self.{attr}[...] in the LB-policy '
+                  'hot path — sessions and replica URLs churn '
+                  'unboundedly, so runtime maps here must be a '
+                  'BoundedStore (put/incr: TTL + LRU cap, evictions '
+                  'counted), not a raw container')
+
+    def _check_lb_map_growth_call(self, node: ast.Call,
+                                  method: str) -> None:
+        """GC122 (methods): a growth-method call (append/add/update/...)
+        on a ``self.*`` container in the LB-policy module — same leak,
+        spelled as a method."""
+        if method not in _GC122_GROW_METHODS:
+            return
+        if not isinstance(node.func, ast.Attribute):
+            return
+        attr = _self_attr(node.func.value)
+        if attr is None or self._gc122_exempt():
+            return
+        self._add('GC122', node,
+                  f'self.{attr}.{method}(...) grows a container in the '
+                  'LB-policy hot path — sessions and replica URLs '
+                  'churn unboundedly, so runtime collections here '
+                  'must go through BoundedStore (TTL + LRU cap, '
+                  'evictions counted)')
+
     def _check_sim_wallclock(self, node: ast.Call, name: str) -> None:
         """GC117: a wall-clock read (or real sleep) inside the fleet
         simulator. The sim's one time axis is the virtual clock
@@ -1420,7 +1500,9 @@ def check_source(rel: str, source: str) -> List[Violation]:
                        is_gang_path=norm.endswith(GANG_PATH_SUFFIXES),
                        is_sim_path=SIM_PATH_MARKER in f'/{norm}',
                        is_lifecycle_path=norm.endswith(
-                           LIFECYCLE_PATH_SUFFIXES))
+                           LIFECYCLE_PATH_SUFFIXES),
+                       is_lb_policy_path=norm.endswith(
+                           LB_POLICY_PATH_SUFFIXES))
     checker.visit(tree)
     suppressed = _line_suppressions(source)
     out = []

@@ -829,6 +829,52 @@ def telemetry_dump(url, fmt, debug_requests, chrome_trace):
         click.echo(f'chrome trace: {out or "no completed traces"}')
 
 
+# ------------------------------------------------------------------- lb
+@cli.command(name='lb')
+@click.option('--controller-url', required=True, metavar='URL',
+              help='Controller to sync the replica set (and the LB '
+                   'peer ring) from.')
+@click.option('--port', required=True, type=int,
+              help='Port this LB listens on.')
+@click.option('--policy', default='prefix_affinity',
+              type=click.Choice(['round_robin', 'least_load',
+                                 'queue_depth', 'phase_aware',
+                                 'prefix_affinity']),
+              help='Load-balancing policy for this LB process.')
+@click.option('--lb-id', default=None, metavar='NAME',
+              help='Stable identity in the consistent-hash ring '
+                   '(default: SKYTPU_LB_ID env or a random id).')
+@click.option('--advertise-url', default=None, metavar='URL',
+              help='URL peer LBs reach this LB at for idempotency-key '
+                   'handoff (default: http://127.0.0.1:<port>).')
+def lb(controller_url, port, policy, lb_id, advertise_url):
+    """Run one load balancer of a horizontal LB tier.
+
+    Every LB started against the same controller registers on the
+    sync feed and joins the consistent-hash ring: session/idempotency
+    keys get exactly one owner, affinity survives any single LB
+    crash, and a replayed request answered via one LB is deduped at
+    every other (docs/serving.md "A horizontal LB tier").
+    """
+    import signal
+    import threading
+
+    from skypilot_tpu.serve import load_balancer as lb_lib
+    balancer = lb_lib.SkyServeLoadBalancer(
+        controller_url=controller_url, port=port, policy_name=policy,
+        lb_id=lb_id, advertise_url=advertise_url)
+    balancer.start()
+    click.echo(f'LB {balancer.lb_id} serving on port {port} '
+               f'(policy {policy}); Ctrl-C to stop.')
+    stop = threading.Event()
+    signal.signal(signal.SIGTERM, lambda *_: stop.set())
+    try:
+        stop.wait()
+    except KeyboardInterrupt:
+        pass
+    balancer.stop()
+
+
 # ------------------------------------------------------------------ sim
 @cli.command()
 @click.option('--scenario', '-s', default='smoke', metavar='NAME',
@@ -838,7 +884,8 @@ def telemetry_dump(url, fmt, debug_requests, chrome_trace):
                    'log (the report carries its SHA-256).')
 @click.option('--policy', default=None,
               type=click.Choice(['round_robin', 'least_load',
-                                 'queue_depth', 'phase_aware']),
+                                 'queue_depth', 'phase_aware',
+                                 'prefix_affinity']),
               help='Override the scenario\'s LB policy (the REAL '
                    'policy object routes every simulated request).')
 @click.option('--list', 'list_scenarios', is_flag=True,

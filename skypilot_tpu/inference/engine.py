@@ -874,6 +874,22 @@ class _EngineBase:
         del entry
         return 0
 
+    def hot_prefix_digest(self, max_entries: int = 16):
+        """Bounded (chain-hash, token-length, hits) digest of the
+        hottest cached prefix chains, for the LB's prefix-affinity
+        routing. Host-side state only — the probe path ships it on
+        every /metrics scrape, so it must never touch the device.
+        Base: no prefix cache — empty."""
+        del max_entries
+        return []
+
+    def export_prefix_entry(self, hash_hex: str):
+        """One digest-named hot chain as ``(entry_or_None, events)``
+        — the proactive affinity-migration export. Base: no prefix
+        cache — ``(None, [])``."""
+        del hash_hex
+        return None, []
+
     def _validate_kv_entry(self, entry: Dict[str, Any],
                            n_rows: int) -> None:
         """Shared KV-payload validation for ingest/warmup: model

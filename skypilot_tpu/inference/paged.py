@@ -1757,6 +1757,24 @@ class PagedInferenceEngine(SpeculativeMixin, _EngineBase):
         self._prefix_heat[key] = {'tokens': list(tokens[:covered + 1]),
                                   'hits': 1}
 
+    def hot_prefix_digest(self, max_entries: int = 16):
+        """The hottest prefix chains as a bounded, wire-cheap digest:
+        ``[{'hash': <sha1 hex of the page-grid token bytes>, 'len':
+        <covered token count>, 'hits': n}, ...]`` hottest-first, at
+        most ``max_entries``. Built from the host-side heat tracker
+        ONLY — no allocator matching, no device gather, zero d2h —
+        so the /metrics probe path can ship it on every scrape. The
+        LB recomputes the same sha1 over a prompt's page-grid
+        prefixes to find the longest match (prefix-affinity
+        routing). A hash may name a chain the allocator has since
+        evicted; affinity is a routing hint, not a guarantee."""
+        by_heat = sorted(self._prefix_heat.items(),
+                         key=lambda kv: -kv[1]['hits'])
+        return [{'hash': key.hex(),
+                 'len': len(rec['tokens']) - 1,
+                 'hits': int(rec['hits'])}
+                for key, rec in by_heat[:max_entries]]
+
     def drain_pipeline(self):
         """Gang ``flush`` op (see ``_EngineBase.drain_pipeline``): on
         top of syncing the in-flight device calls, the paged engine
@@ -1785,48 +1803,78 @@ class PagedInferenceEngine(SpeculativeMixin, _EngineBase):
         while self._pending:
             events.extend(self._process_one())
         entries: List[Dict[str, Any]] = []
-        cfg = self.cfg
         by_heat = sorted(self._prefix_heat.values(),
                          key=lambda r: -r['hits'])
         for rec in by_heat:
             if len(entries) >= max_entries:
                 break
-            tokens = rec['tokens']
-            pages = self.alloc.match_prefix(tokens)
-            if not pages:
-                continue
-            n_rows = len(pages) * self.page
-            try:
-                P = _bucket_len(len(pages), minimum=1)
-                table = np.zeros((P,), np.int32)
-                table[:len(pages)] = pages
-                out = self._get_export(P)(self.cache,
-                                          device_upload(table))
-                # Sanctioned d2h: the checkpoint export IS a host
-                # readback by design (the rows leave on the wire or
-                # land in a checkpoint file).
-                host = host_sync(out)
-            finally:
-                for p in pages:
-                    self.alloc.release(p)
-            if self.cache.quantized:
-                k, v, ks, vs = host
-                k, v = k[:, :n_rows], v[:, :n_rows]
-                ks, vs = ks[:, :n_rows], vs[:, :n_rows]
-            else:
-                k, v = host
-                k, v = k[:, :n_rows], v[:, :n_rows]
-                ks = vs = None
-            entries.append({
-                'kv_cache_dtype': self.kv_cache_dtype,
-                'n_rows': n_rows,
-                'model': {'n_layers': cfg.n_layers,
-                          'n_kv_heads': cfg.n_kv_heads,
-                          'head_dim': cfg.head_dim},
-                'tokens': list(tokens[:n_rows + 1]),
-                'k': k, 'v': v, 'k_scale': ks, 'v_scale': vs,
-            })
+            entry = self._export_prefix_record(rec)
+            if entry is not None:
+                entries.append(entry)
         return entries, events
+
+    def _export_prefix_record(self, rec: Dict[str, Any]
+                              ) -> Optional[Dict[str, Any]]:
+        """Gather one heat record's still-cached chain as a prefix
+        entry (None if the allocator evicted it). Callers own pipeline
+        draining."""
+        from skypilot_tpu.inference.engine import _bucket_len
+        cfg = self.cfg
+        tokens = rec['tokens']
+        pages = self.alloc.match_prefix(tokens)
+        if not pages:
+            return None
+        n_rows = len(pages) * self.page
+        try:
+            P = _bucket_len(len(pages), minimum=1)
+            table = np.zeros((P,), np.int32)
+            table[:len(pages)] = pages
+            out = self._get_export(P)(self.cache,
+                                      device_upload(table))
+            # Sanctioned d2h: the checkpoint export IS a host
+            # readback by design (the rows leave on the wire or
+            # land in a checkpoint file).
+            host = host_sync(out)
+        finally:
+            for p in pages:
+                self.alloc.release(p)
+        if self.cache.quantized:
+            k, v, ks, vs = host
+            k, v = k[:, :n_rows], v[:, :n_rows]
+            ks, vs = ks[:, :n_rows], vs[:, :n_rows]
+        else:
+            k, v = host
+            k, v = k[:, :n_rows], v[:, :n_rows]
+            ks = vs = None
+        return {
+            'kv_cache_dtype': self.kv_cache_dtype,
+            'n_rows': n_rows,
+            'model': {'n_layers': cfg.n_layers,
+                      'n_kv_heads': cfg.n_kv_heads,
+                      'head_dim': cfg.head_dim},
+            'tokens': list(tokens[:n_rows + 1]),
+            'k': k, 'v': v, 'k_scale': ks, 'v_scale': vs,
+        }
+
+    def export_prefix_entry(self, hash_hex: str):
+        """One hot chain — named by its digest hash — as a prefix
+        entry: ``(entry_or_None, drained_events)``. The proactive
+        affinity migration path: the LB asks the source replica for
+        exactly the chain whose digest match lost to load, ships the
+        blob to the target's warmup endpoint, and the prefix is warm
+        there without a single recomputed token. None when the heat
+        record or its pages are gone (the digest was a stale hint)."""
+        try:
+            key = bytes.fromhex(hash_hex)
+        except ValueError:
+            return None, []
+        rec = self._prefix_heat.get(key)
+        if rec is None:
+            return None, []
+        events: List[Tuple[int, int, bool]] = []
+        while self._pending:
+            events.extend(self._process_one())
+        return self._export_prefix_record(rec), events
 
     def warm_prefix(self, entry: Dict[str, Any]) -> int:
         """Land a prefix entry into the prefix cache without seating a

@@ -74,8 +74,35 @@ class ServeController:
         # What the last recovery boot did per persisted replica
         # (outcome -> count); empty on a fresh boot.
         self.last_reconcile: Dict[str, int] = {}
+        # Horizontal LB tier membership: every LB registers its
+        # (lb_id, url) on each sync; the pruned live set ships back as
+        # ``lb_peers`` so all LBs agree on the consistent-hash ring.
+        # Deliberately EPHEMERAL (never journaled): membership is
+        # liveness — a restarted controller relearns it within one
+        # sync period, exactly like the replica probe state.
+        self._lb_lock = threading.Lock()
+        self._lb_registry: Dict[str, Any] = {}
         if recover:
             self._recover()
+
+    # ----------------------------------------------------- LB tier feed
+    def note_lb_sync(self, lb_id: Optional[str],
+                     lb_url: Optional[str]) -> Dict[str, str]:
+        """Register the syncing LB (if it identified itself) and
+        return the live peer map (lb_id -> url). Peers that missed
+        ``SKYTPU_LB_PEER_TTL`` (default 15 s) of syncs age out — a
+        crashed LB leaves the ring within one TTL and session-key
+        ownership converges on the survivors."""
+        now = self._env.monotonic()
+        ttl = float(os.environ.get('SKYTPU_LB_PEER_TTL', '15'))
+        with self._lb_lock:
+            registry = dict(self._lb_registry)
+            if lb_id:
+                registry[str(lb_id)] = (str(lb_url or ''), now)
+            self._lb_registry = {
+                k: v for k, v in registry.items()
+                if now - v[1] < ttl}
+            return {k: v[0] for k, v in self._lb_registry.items()}
 
     # ----------------------------------------------------------- recovery
     def _recover(self) -> None:
@@ -325,6 +352,13 @@ class ServeController:
                         # sweeps while accounting every rank's health.
                         'replica_gangs':
                             controller.replica_manager.replica_gangs(),
+                        # Live LB-tier peers (lb_id -> url): every LB
+                        # builds the same consistent-hash ring from
+                        # this, so session-key ownership is agreed
+                        # without LB-to-LB coordination.
+                        'lb_peers': controller.note_lb_sync(
+                            payload.get('lb_id'),
+                            payload.get('lb_url')),
                     })
                 elif self.path == '/controller/update':
                     try:

@@ -144,7 +144,7 @@ FAULT_KINDS = ('replica_crash', 'probe_timeout', 'slow_response',
                'zone_outage', 'straggler',
                'wedged_step', 'nan_logits', 'kv_corruption',
                'byzantine_response',
-               'controller_crash', 'controller_restart')
+               'controller_crash', 'controller_restart', 'lb_crash')
 
 # The stable label set of skytpu_gray_failures_total{kind}: detections
 # by the gray-failure defense layer (watchdog fire, NaN eviction,
@@ -194,12 +194,20 @@ GRAY_FAILURE_KINDS = ('wedged_step', 'nan_logits', 'kv_corruption',
 #   background tasks unwind, persistence stops landing);
 #   ``controller_restart`` boots a fresh controller over the same
 #   world with recover=True and reconciles.
+# - ``sim_lb_crash`` — the fleet simulator's storm clock, horizontal
+#   LB tier. Kind ``lb_crash`` kills one live load-balancer process
+#   (highest index first): its policy state — probe caches, sticky
+#   sessions, idempotency keys — is gone; the deterministic
+#   client-side re-pick routes its sessions to the survivors, who must
+#   lose ZERO requests (affinity re-forms from the replicas'
+#   advertised digests).
 FAULT_SITES = ('engine_step', 'probe', 'preempt', 'preempt_warning',
                'proxy', 'proxy_stream', 'http_response', 'handoff',
                'spot_preemption', 'gang_member_crash',
                'gang_join_timeout', 'sim_storm', 'sim_zone_outage',
                'sim_straggler', 'sim_gang_churn', 'kv_wire', 'canary',
-               'sim_gray', 'controller_tick', 'sim_controller')
+               'sim_gray', 'controller_tick', 'sim_controller',
+               'sim_lb_crash')
 
 # Outcomes of skytpu_requests_migrated_total{outcome}: a migrated
 # request either completed on a surviving replica or exhausted every

@@ -68,12 +68,14 @@ import threading
 import time
 from typing import Any, Dict, List, Optional, Set
 import urllib.error
+import urllib.parse
 import urllib.request
 import uuid
 
 from skypilot_tpu import telemetry
 from skypilot_tpu import tpu_logging
 from skypilot_tpu.serve import faults as faults_lib
+from skypilot_tpu.serve import lb_ring
 from skypilot_tpu.serve import load_balancing_policies as lb_policies
 
 logger = tpu_logging.init_logger(__name__)
@@ -133,13 +135,38 @@ class SkyServeLoadBalancer:
                  policy_name: str = 'round_robin',
                  tls_certfile: Optional[str] = None,
                  tls_keyfile: Optional[str] = None,
-                 max_attempts: int = 3):
+                 max_attempts: int = 3,
+                 lb_id: Optional[str] = None,
+                 advertise_url: Optional[str] = None):
         self.controller_url = controller_url.rstrip('/')
         self.port = port
         self.policy = lb_policies.make_policy(policy_name)
         self.tls_certfile = tls_certfile
         self.tls_keyfile = tls_keyfile
         self.max_attempts = max_attempts
+        # Horizontal LB tier: this LB's identity in the consistent-
+        # hash ring (and its probe-jitter seed), plus the URL peers
+        # reach it at for idempotency-key handoff. Peers arrive on
+        # every controller sync (``lb_peers``); until then the ring
+        # is just this LB.
+        self.lb_id = (lb_id or os.environ.get('SKYTPU_LB_ID')
+                      or f'lb-{uuid.uuid4().hex[:8]}')
+        self.advertise_url = (
+            advertise_url or os.environ.get('SKYTPU_LB_URL')
+            or f'http://127.0.0.1:{port}').rstrip('/')
+        self._ring = lb_ring.HashRing()
+        self._ring.set_members({self.lb_id: self.advertise_url})
+        # Completed keyed requests (request_key -> answering replica
+        # url): the LB-side idempotency LRU. A replay routes back to
+        # the replica whose own key LRU returns the recorded answer —
+        # and the record lives at the key's RING OWNER, so a replay
+        # landing on a different LB still dedupes.
+        self._completed = lb_policies.BoundedStore(
+            8192, ttl_s=600.0, name='lb_completed')
+        self._completed_lock = threading.Lock()
+        set_ident = getattr(self.policy, 'set_probe_identity', None)
+        if set_ident is not None:
+            set_ident(self.lb_id)
         self._request_timestamps: List[float] = []
         # Parallel SLO-tier tags ('' = unknown): the controller-side
         # forecaster keeps per-tier arrival series so forecast-aware
@@ -194,6 +221,34 @@ class SkyServeLoadBalancer:
             'skytpu_lb_local_evictions_total',
             'Replicas the LB evicted from rotation on its own '
             'data-plane evidence (no controller input)')
+        # Prefix-affinity + horizontal-LB series (PR 18; stable
+        # schema — registered here, zeros from the first scrape).
+        self._m_affinity = {
+            outcome: reg.counter(
+                'skytpu_lb_affinity_hits_total',
+                'Prefix-affinity routing outcomes (hit = routed to '
+                'the longest-match replica; miss = no replica held '
+                'the prefix; migrated = load override with a '
+                'proactive SKPF prefix migration)',
+                outcome=outcome)
+            for outcome in ('hit', 'miss', 'migrated')}
+        self._m_recompute = reg.counter(
+            'skytpu_prefix_recompute_tokens_total',
+            'Prefix tokens the chosen replica recomputes although '
+            'another replica had them cached (affinity routing '
+            'losses, un-migrated)')
+        self._g_ring = reg.gauge(
+            'skytpu_lb_ring_size',
+            'Live LB-tier members in the consistent-hash ring (0 '
+            'until the first controller sync)')
+        self._m_handoff = reg.counter(
+            'skytpu_lb_handoff_total',
+            'Idempotency-key records exchanged with peer LBs '
+            '(ring-owner pushes accepted + remote lookup hits)')
+        if isinstance(self.policy, lb_policies.PrefixAffinityPolicy):
+            self.policy.configure_affinity_observer(
+                self._note_affinity)
+            self.policy.configure_migration(self._migrate_prefix)
         self._evict_lock = threading.Lock()
         self._evicted: Dict[str, float] = {}
         self._last_ready: List[str] = []
@@ -229,7 +284,9 @@ class SkyServeLoadBalancer:
                 self._request_timestamps, []
             tiers, self._request_tiers = self._request_tiers, []
         body = json.dumps({'request_timestamps': timestamps,
-                           'request_tiers': tiers}).encode()
+                           'request_tiers': tiers,
+                           'lb_id': self.lb_id,
+                           'lb_url': self.advertise_url}).encode()
         req = urllib.request.Request(
             self.controller_url + '/controller/load_balancer_sync',
             data=body, headers={'Content-Type': 'application/json'})
@@ -265,6 +322,18 @@ class SkyServeLoadBalancer:
             if gangs is not None:
                 self._replica_gangs = dict(gangs)
                 self.policy.set_replica_gangs(gangs)
+            # Consistent-hash ring membership from the shared sync
+            # feed: a crashed peer ages out of the controller's
+            # registry and key ownership converges on the survivors;
+            # an absent/old controller leaves a single-member ring.
+            peers = payload.get('lb_peers') or {}
+            peers = {str(k): str(v) for k, v in peers.items()}
+            peers.setdefault(self.lb_id, self.advertise_url)
+            if set(peers) != set(self._ring.members):
+                logger.info(
+                    f'LB ring membership now {sorted(peers)}')
+            self._ring.set_members(peers)
+            self._g_ring.set(len(peers))
         except Exception as e:  # pylint: disable=broad-except
             # Keep serving the last known replica set; re-queue the
             # timestamps so the QPS signal survives controller restarts —
@@ -340,6 +409,111 @@ class SkyServeLoadBalancer:
                        f'({reason}); TTL {_evict_ttl():.0f}s or until '
                        'the controller confirms')
         self._apply_ready_urls()
+
+    # ------------------------------------------- affinity + LB tier
+    def _note_affinity(self, outcome: str,
+                       recompute_tokens: int) -> None:
+        """Affinity observer the prefix_affinity policy calls on every
+        routed request (outside its lock)."""
+        counter = self._m_affinity.get(outcome)
+        if counter is not None:
+            counter.inc()
+        if recompute_tokens > 0:
+            self._m_recompute.inc(recompute_tokens)
+
+    def _migrate_prefix(self, src: str, dst: str, chain_hash: str,
+                        n_tokens: int) -> bool:
+        """Proactive prefix migration, fire-and-forget: fetch the
+        chain's CRC-checked SKPF blob from ``src`` and land it on
+        ``dst``'s ``/kv/warmup`` — off-thread, so the request that
+        triggered it routes immediately (it benefits the NEXT turn
+        of the session, not this one)."""
+        def _ship() -> None:
+            try:
+                with urllib.request.urlopen(
+                        f'{src}/kv/prefix/export?hash={chain_hash}',
+                        timeout=30) as resp:
+                    blob = resp.read()
+                req = urllib.request.Request(
+                    dst + '/kv/warmup', data=blob,
+                    headers={'Content-Type':
+                             'application/octet-stream'})
+                with urllib.request.urlopen(req, timeout=30) as resp:
+                    landed = json.loads(resp.read())
+                logger.info(
+                    f'migrated prefix {chain_hash[:12]} '
+                    f'({n_tokens} token(s)) {src} -> {dst}: '
+                    f'{landed.get("warmed_rows", 0)} row(s) warm')
+            except Exception as e:  # pylint: disable=broad-except
+                logger.warning(
+                    f'prefix migration {src} -> {dst} failed: '
+                    f'{type(e).__name__}: {e}')
+        threading.Thread(target=_ship, daemon=True).start()
+        return True
+
+    def record_completed_key(self, key: str,
+                             replica_url: str) -> None:
+        """Record which replica answered ``key`` — locally, and at the
+        key's ring owner when that is a peer (fire-and-forget push;
+        the authoritative dedupe stays replica-side)."""
+        with self._completed_lock:
+            self._completed.put(key, replica_url)
+        owner, owner_url = self._ring.owner_url(key)
+        if owner is None or owner == self.lb_id or not owner_url:
+            return
+
+        def _push() -> None:
+            try:
+                body = json.dumps({'key': key,
+                                   'url': replica_url}).encode()
+                req = urllib.request.Request(
+                    owner_url + '/lb/idempotency', data=body,
+                    headers={'Content-Type': 'application/json'})
+                with urllib.request.urlopen(req, timeout=5):
+                    pass
+            except Exception as e:  # pylint: disable=broad-except
+                logger.debug(
+                    f'idempotency push for {key} to {owner} failed: '
+                    f'{type(e).__name__}: {e}')
+        threading.Thread(target=_push, daemon=True).start()
+
+    def accept_completed_key(self, key: str,
+                             replica_url: str) -> None:
+        """A peer LB pushed a completed key this LB owns on the ring."""
+        with self._completed_lock:
+            self._completed.put(key, replica_url)
+        self._m_handoff.inc()
+
+    def lookup_completed_key(self, key: str) -> Optional[str]:
+        """The replica that already answered ``key``, if any LB in the
+        tier knows: local LRU first, then the key's ring owner. Only
+        called for CLIENT-supplied keys (a freshly minted key cannot
+        be a replay)."""
+        with self._completed_lock:
+            hit = self._completed.get(key)
+        if hit:
+            return hit
+        owner, owner_url = self._ring.owner_url(key)
+        if owner is None or owner == self.lb_id or not owner_url:
+            return None
+        try:
+            q = urllib.parse.urlencode({'key': key})
+            with urllib.request.urlopen(
+                    f'{owner_url}/lb/idempotency?{q}',
+                    timeout=2) as resp:
+                payload = json.loads(resp.read())
+        except Exception as e:  # pylint: disable=broad-except
+            # Owner unreachable is routine during an LB crash window —
+            # fall back to fresh dispatch (at-least-once, idempotent).
+            logger.debug(f'idempotency lookup at {owner_url} failed: {e}')
+            return None
+        url = payload.get('url')
+        if url:
+            self._m_handoff.inc()
+            with self._completed_lock:
+                self._completed.put(key, url)
+            return url
+        return None
 
     # --------------------------------------------------------- recovery
     @staticmethod
@@ -625,8 +799,15 @@ class SkyServeLoadBalancer:
                 cont['max_new_tokens'] = remaining
                 cont.pop('max_tokens', None)
                 body = json.dumps(cont).encode()
+                # The continuation keeps its session identity: the
+                # affinity policy routes the resubmit to whichever
+                # survivor holds the longest piece of the (original
+                # prompt + generated prefix) chain.
+                ctx = {'tokens': cont['prompt'],
+                       'request_key': headers.get('X-Request-ID')}
                 while True:
-                    nxt = lb.policy.select_replica(exclude=tried)
+                    nxt = lb.policy.select_replica(exclude=tried,
+                                                   context=ctx)
                     if nxt is None or len(tried) >= lb.max_attempts + 2:
                         return None, None
                     tried.add(nxt)
@@ -684,10 +865,26 @@ class SkyServeLoadBalancer:
                 # prompt). The LB mints an idempotency key for it, so a
                 # replay on another replica returns one answer.
                 recover = lb._recoverable(method, self.path, data)
+                client_keyed = (
+                    self.headers.get('X-Request-ID') is not None)
                 req_key = self.headers.get('X-Request-ID')
                 if recover is not None and req_key is None:
                     req_key = uuid.uuid4().hex
                     headers['X-Request-ID'] = req_key
+                # Prefix-affinity context: the prompt's token ids let
+                # the policy hash the page-grid prefix; the request
+                # key pins session stickiness.
+                affinity_ctx = None
+                if recover is not None:
+                    affinity_ctx = {'tokens': recover['prompt'],
+                                    'request_key': req_key}
+                # Replay dedupe across the LB tier: a client-supplied
+                # key may have been answered via ANOTHER LB — the
+                # ring owner knows which replica holds the recorded
+                # answer.
+                preferred: Optional[str] = None
+                if client_keyed and req_key:
+                    preferred = lb.lookup_completed_key(req_key)
 
                 # A replica dying mid-connect is retried transparently
                 # on another replica; an HTTP-503 refusal (loading /
@@ -698,7 +895,14 @@ class SkyServeLoadBalancer:
                 last_http = None        # (code, body, headers)
                 responded = False       # bytes already sent to client?
                 for _ in range(lb.max_attempts):
-                    url = lb.policy.select_replica(exclude=tried)
+                    if (preferred is not None
+                            and preferred not in tried
+                            and preferred in lb.policy.ready_replicas):
+                        url: Optional[str] = preferred
+                        preferred = None
+                    else:
+                        url = lb.policy.select_replica(
+                            exclude=tried, context=affinity_ctx)
                     if url is None:
                         break
                     tried.add(url)
@@ -733,6 +937,9 @@ class SkyServeLoadBalancer:
                                     or 'chunked' in (resp.headers.get(
                                         'Transfer-Encoding') or '')):
                                 responded = True
+                                if req_key is not None:
+                                    lb.record_completed_key(req_key,
+                                                            url)
                                 if (recover is not None
                                         and recover.get('stream')):
                                     self._stream_recover(
@@ -747,6 +954,8 @@ class SkyServeLoadBalancer:
                             body = resp.read()
                             status, rheaders = resp.status, resp.headers
                         responded = True
+                        if req_key is not None and status < 300:
+                            lb.record_completed_key(req_key, url)
                         self.send_response(status)
                         for k, v in rheaders.items():
                             if k.lower() not in _HOP_HEADERS:
@@ -845,9 +1054,39 @@ class SkyServeLoadBalancer:
                     # them (queue_depth probes /metrics JSON anyway).
                     self._send_json(200, lb.replica_view())
                     return
+                if self.path.startswith('/lb/idempotency'):
+                    # Peer-LB lookup: which replica answered this key
+                    # (this LB is the key's ring owner).
+                    query = urllib.parse.parse_qs(
+                        urllib.parse.urlparse(self.path).query)
+                    key = (query.get('key') or [''])[0]
+                    with lb._completed_lock:
+                        url = lb._completed.get(key) if key else None
+                    if url:
+                        self._send_json(200, {'key': key, 'url': url})
+                    else:
+                        self._send_json(404, {'key': key, 'url': None})
+                    return
                 self._proxy('GET')
 
             def do_POST(self):  # noqa: N802
+                if self.path == '/lb/idempotency':
+                    # Peer-LB push: a completed key this LB owns.
+                    length = int(
+                        self.headers.get('Content-Length', 0))
+                    try:
+                        payload = json.loads(
+                            self.rfile.read(length) or b'{}')
+                        key = payload.get('key')
+                        url = payload.get('url')
+                    except (ValueError, UnicodeDecodeError):
+                        key = url = None
+                    if key and url:
+                        lb.accept_completed_key(str(key), str(url))
+                        self._send_json(200, {'recorded': True})
+                    else:
+                        self._send_json(400, {'error': 'need key+url'})
+                    return
                 self._proxy('POST')
 
         return Handler
@@ -867,6 +1106,12 @@ class SkyServeLoadBalancer:
             evicted = sorted(self._evicted)
         return {
             'ready_replica_urls': urls,
+            # Horizontal-LB-tier surface: this LB's ring identity and
+            # the agreed membership (session/idempotency keys hash to
+            # exactly one owner here on EVERY member).
+            'lb_id': self.lb_id,
+            'lb_ring': {'members': self._ring.members,
+                        'size': len(self._ring)},
             # Controller-outage autonomy surface: how stale the view
             # is, whether the LB considers the controller up, and what
             # it evicted on its own evidence.

@@ -3,18 +3,134 @@
 ``LeastLoadPolicy`` ``:115``). Pure selection logic over the ready-replica
 URL list the LB syncs from the controller — plus
 :class:`QueueDepthPolicy`, which load-ranks replicas by the work-token
-estimate their SLO scheduler publishes at ``/metrics?format=json``."""
+estimate their SLO scheduler publishes at ``/metrics?format=json``, and
+:class:`PrefixAffinityPolicy`, which routes multi-turn sessions to the
+replica that already holds their KV prefix (longest match against the
+replicas' hot-prefix digests, load-aware tie-breaking, proactive SKPF
+migration when affinity and load disagree too far)."""
 from __future__ import annotations
 
+import collections
+import hashlib
 import json
+import os
 import threading
 import urllib.request
-from typing import Callable, Dict, List, Optional, Set, Tuple
+from typing import (Any, Callable, Dict, List, Optional, Set, Tuple)
+
+import numpy as np
 
 from skypilot_tpu import tpu_logging
 from skypilot_tpu.telemetry import clock
 
 logger = tpu_logging.init_logger(__name__)
+
+# Per-URL maps are bounded by the fleet in practice; the cap is the
+# loud backstop against a controller bug feeding unbounded URL churn.
+_FLEET_CAP = 4096
+
+_MISSING = object()
+
+
+def _env_float(name: str, default: float) -> float:
+    try:
+        return float(os.environ.get(name, '') or default)
+    except ValueError:
+        return default
+
+
+def _seeded_jitter(seed: str, frac: float = 0.2) -> float:
+    """Deterministic per-LB fraction in [-frac, +frac]: sha1 of the
+    LB's identity, no RNG — N LBs probing the same fleet spread their
+    probe-cache expiries instead of stampeding in lockstep, and the
+    same identity always yields the same offset (sim replays stay
+    byte-identical)."""
+    if not seed:
+        return 0.0
+    h = int.from_bytes(hashlib.sha1(seed.encode()).digest()[:4], 'big')
+    return (h / 0xFFFFFFFF * 2.0 - 1.0) * frac
+
+
+class BoundedStore:
+    """The ONE sanctioned mutable map on LB hot paths (graftcheck
+    GC122): TTL aging plus an LRU cap, evictions counted loudly. Every
+    per-request / per-replica table the policies grow at runtime goes
+    through this helper — a raw ``self._x[k] = v`` in this module is a
+    slow memory leak on a box that sees millions of sessions, and the
+    gate hard-fails it. NOT internally locked: callers hold their
+    policy lock, exactly like the plain dicts this replaces."""
+
+    def __init__(self, cap: int, ttl_s: Optional[float] = None,
+                 monotonic: Optional[Callable[[], float]] = None,
+                 name: str = '') -> None:
+        self._cap = max(1, int(cap))
+        self._ttl = ttl_s
+        self._mono = monotonic or clock.monotonic
+        self._name = name or 'store'
+        # key -> (expiry-or-None, value); OrderedDict recency = LRU.
+        self._data: 'collections.OrderedDict[Any, Tuple[Optional[float], Any]]' = (
+            collections.OrderedDict())
+        self.evictions = 0
+
+    def __len__(self) -> int:
+        return len(self._data)
+
+    def __contains__(self, key: Any) -> bool:
+        return self.get(key, _MISSING) is not _MISSING
+
+    def get(self, key: Any, default: Any = None) -> Any:
+        rec = self._data.get(key)
+        if rec is None:
+            return default
+        expiry, value = rec
+        if expiry is not None and expiry <= self._mono():
+            del self._data[key]
+            return default
+        self._data.move_to_end(key)
+        return value
+
+    def put(self, key: Any, value: Any) -> None:
+        if key in self._data:
+            del self._data[key]
+        elif len(self._data) >= self._cap:
+            evicted, _ = self._data.popitem(last=False)
+            self.evictions += 1
+            logger.debug(
+                f'BoundedStore[{self._name}]: cap {self._cap} hit, '
+                f'LRU-evicted {evicted!r} '
+                f'(eviction #{self.evictions})')
+        expiry = (self._mono() + self._ttl
+                  if self._ttl is not None else None)
+        self._data[key] = (expiry, value)
+
+    def pop(self, key: Any, default: Any = None) -> Any:
+        value = self.get(key, _MISSING)
+        if value is _MISSING:
+            return default
+        del self._data[key]
+        return value
+
+    def incr(self, key: Any, delta: int,
+             floor: Optional[int] = None) -> int:
+        value = int(self.get(key, 0)) + delta
+        if floor is not None:
+            value = max(floor, value)
+        self.put(key, value)
+        return value
+
+    def items(self) -> List[Tuple[Any, Any]]:
+        """Live (key, value) snapshot; expired entries pruned."""
+        now = self._mono()
+        out = []
+        for key, (expiry, value) in list(self._data.items()):
+            if expiry is not None and expiry <= now:
+                del self._data[key]
+            else:
+                out.append((key, value))
+        return out
+
+    def clear(self) -> None:
+        self._data.clear()
 
 
 class LoadBalancingPolicy:
@@ -53,10 +169,14 @@ class LoadBalancingPolicy:
         del urls
 
     def select_replica(self,
-                       exclude: Optional[Set[str]] = None
+                       exclude: Optional[Set[str]] = None,
+                       context: Optional[Dict[str, Any]] = None
                        ) -> Optional[str]:
         """Pick a ready replica, skipping ``exclude`` (URLs that already
-        failed this request — the LB's transparent retry)."""
+        failed this request — the LB's transparent retry). ``context``
+        is the optional request identity affinity policies route by
+        (``{'tokens': [...], 'request_key': str}``); load-only
+        policies ignore it."""
         raise NotImplementedError
 
     def _candidates_locked(self,
@@ -135,8 +255,10 @@ class RoundRobinPolicy(LoadBalancingPolicy):
         self._index = 0     # graftcheck: disable=GC101
 
     def select_replica(self,
-                       exclude: Optional[Set[str]] = None
+                       exclude: Optional[Set[str]] = None,
+                       context: Optional[Dict[str, Any]] = None
                        ) -> Optional[str]:
+        del context
         with self._lock:
             candidates = self._candidates_locked(exclude)
             if not candidates:
@@ -151,11 +273,13 @@ class LeastLoadPolicy(LoadBalancingPolicy):
 
     def __init__(self) -> None:
         super().__init__()
-        self._inflight: Dict[str, int] = {}
+        self._inflight = BoundedStore(_FLEET_CAP, name='inflight')
 
     def select_replica(self,
-                       exclude: Optional[Set[str]] = None
+                       exclude: Optional[Set[str]] = None,
+                       context: Optional[Dict[str, Any]] = None
                        ) -> Optional[str]:
+        del context
         with self._lock:
             candidates = self._candidates_locked(exclude)
             if not candidates:
@@ -165,11 +289,11 @@ class LeastLoadPolicy(LoadBalancingPolicy):
 
     def pre_execute(self, url: str) -> None:
         with self._lock:
-            self._inflight[url] = self._inflight.get(url, 0) + 1
+            self._inflight.incr(url, 1)
 
     def post_execute(self, url: str) -> None:
         with self._lock:
-            self._inflight[url] = max(0, self._inflight.get(url, 0) - 1)
+            self._inflight.incr(url, -1, floor=0)
 
 
 class QueueDepthPolicy(LoadBalancingPolicy):
@@ -183,9 +307,12 @@ class QueueDepthPolicy(LoadBalancingPolicy):
     policies miss.
 
     Probes run OUTSIDE the policy lock with a short timeout and are
-    cached for :attr:`PROBE_TTL_S`; between probes the score advances
-    by :attr:`EST_TOKENS_PER_REQUEST` per in-flight dispatch so a
-    burst landing within one TTL window still spreads. A replica whose
+    cached for :attr:`probe_ttl_s` — the ``SKYTPU_LB_PROBE_TTL_S``
+    knob (default :attr:`PROBE_TTL_S`), jittered deterministically
+    per LB identity so a horizontal LB tier doesn't probe the fleet
+    in lockstep. Between probes the score advances by
+    :attr:`EST_TOKENS_PER_REQUEST` per in-flight dispatch so a burst
+    landing within one TTL window still spreads. A replica whose
     probe fails scores by dispatch count alone (graceful least-load
     degradation; the LB's transparent retry covers replicas that are
     actually dead)."""
@@ -198,16 +325,31 @@ class QueueDepthPolicy(LoadBalancingPolicy):
 
     def __init__(self) -> None:
         super().__init__()
-        self._inflight: Dict[str, int] = {}
-        # url -> (monotonic expiry, queue_tokens_total or None=failed)
-        self._cache: Dict[str, Tuple[float, Optional[int]]] = {}
+        self._inflight = BoundedStore(_FLEET_CAP, name='inflight')
+        # url -> (monotonic expiry, queue_tokens_total or None=failed).
+        # Expiry marks STALENESS (reprobe due), not eviction — a stale
+        # score still ranks until its refresh lands.
+        self._cache = BoundedStore(_FLEET_CAP, name='probe_cache')
         # url -> last-probed mesh shape block (the same /metrics JSON
         # carries it — the LB's replica view reads this for free).
-        self._mesh: Dict[str, Dict] = {}
+        self._mesh = BoundedStore(_FLEET_CAP, name='mesh')
         # url -> last-probed disagg view ({'role', 'kv_free'}) — the
         # phase-aware subclass routes and picks handoff targets from
         # this; the base policy just keeps it fresh for free.
-        self._disagg: Dict[str, Dict] = {}
+        self._disagg = BoundedStore(_FLEET_CAP, name='disagg')
+        self._base_probe_ttl_s = _env_float('SKYTPU_LB_PROBE_TTL_S',
+                                            self.PROBE_TTL_S)
+        self.probe_ttl_s = self._base_probe_ttl_s
+        self.set_probe_identity(os.environ.get('SKYTPU_LB_ID', ''))
+
+    def set_probe_identity(self, lb_id: str) -> None:
+        """Derive this LB's jittered probe TTL from its identity
+        (``SKYTPU_LB_ID`` env by default; the multi-LB runner and the
+        simulator set it explicitly). Deterministic — the same id
+        always yields the same TTL."""
+        self.probe_ttl_s = max(
+            0.05,
+            self._base_probe_ttl_s * (1.0 + _seeded_jitter(lb_id)))
 
     def _probe(self, url: str) -> Tuple[Optional[int], Optional[Dict]]:
         """One replica's /metrics JSON: (queue_tokens_total, payload).
@@ -227,6 +369,19 @@ class QueueDepthPolicy(LoadBalancingPolicy):
                          f'{type(e).__name__}: {e}')
             return None, None
 
+    def _note_payload_locked(self, url: str, payload: Dict) -> None:
+        """Stash the non-score blocks a fresh probe carried (callers
+        hold ``self._lock``). Subclasses extend this to harvest their
+        own blocks from the SAME probe — one scrape feeds every
+        policy layer."""
+        if payload.get('mesh') is not None:
+            self._mesh.put(url, payload['mesh'])
+        disagg = payload.get('disagg') or {}
+        self._disagg.put(url, {
+            'role': disagg.get('role'),
+            'kv_free': int(payload.get('kv_pool_tokens_free', 0)),
+        })
+
     def _refresh(self, candidates) -> None:
         """Refresh stale probe caches for ``candidates``. Probes run
         with the lock RELEASED: a slow replica must not serialize every
@@ -242,18 +397,11 @@ class QueueDepthPolicy(LoadBalancingPolicy):
                      and self._cache.get(u, (0.0, None))[0] <= now]
         fresh = {u: self._probe(u) for u in stale}
         with self._lock:
-            expiry = self._monotonic() + self.PROBE_TTL_S
+            expiry = self._monotonic() + self.probe_ttl_s
             for u, (tokens, payload) in fresh.items():
-                self._cache[u] = (expiry, tokens)
+                self._cache.put(u, (expiry, tokens))
                 if payload is not None:
-                    if payload.get('mesh') is not None:
-                        self._mesh[u] = payload['mesh']
-                    disagg = payload.get('disagg') or {}
-                    self._disagg[u] = {
-                        'role': disagg.get('role'),
-                        'kv_free': int(payload.get(
-                            'kv_pool_tokens_free', 0)),
-                    }
+                    self._note_payload_locked(u, payload)
 
     def _score_locked(self, u: str) -> int:
         tokens = self._cache.get(u, (0.0, None))[1]
@@ -262,8 +410,10 @@ class QueueDepthPolicy(LoadBalancingPolicy):
                 * self._inflight.get(u, 0))
 
     def select_replica(self,
-                       exclude: Optional[Set[str]] = None
+                       exclude: Optional[Set[str]] = None,
+                       context: Optional[Dict[str, Any]] = None
                        ) -> Optional[str]:
+        del context
         with self._lock:
             candidates = self._candidates_locked(exclude)
         if not candidates:
@@ -274,15 +424,15 @@ class QueueDepthPolicy(LoadBalancingPolicy):
 
     def pre_execute(self, url: str) -> None:
         with self._lock:
-            self._inflight[url] = self._inflight.get(url, 0) + 1
+            self._inflight.incr(url, 1)
 
     def post_execute(self, url: str) -> None:
         with self._lock:
-            self._inflight[url] = max(0, self._inflight.get(url, 0) - 1)
+            self._inflight.incr(url, -1, floor=0)
 
     def replica_meshes(self) -> Dict[str, Dict]:
         with self._lock:
-            return dict(self._mesh)
+            return dict(self._mesh.items())
 
 
 class PhaseAwarePolicy(QueueDepthPolicy):
@@ -315,8 +465,10 @@ class PhaseAwarePolicy(QueueDepthPolicy):
         return probed or self._planned_roles.get(u)
 
     def select_replica(self,
-                       exclude: Optional[Set[str]] = None
+                       exclude: Optional[Set[str]] = None,
+                       context: Optional[Dict[str, Any]] = None
                        ) -> Optional[str]:
+        del context
         with self._lock:
             candidates = self._candidates_locked(exclude)
         if not candidates:
@@ -351,11 +503,205 @@ class PhaseAwarePolicy(QueueDepthPolicy):
             return max(decode, key=headroom)
 
 
+class PrefixAffinityPolicy(QueueDepthPolicy):
+    """Cache-locality routing for multi-turn sessions: every replica's
+    ``/metrics?format=json`` probe now carries its hot-prefix digest
+    (``prefix_digest``: the engine heat tracker's hottest chains as
+    (sha1-hash, token-length) pairs at the page grid). This policy
+    hashes each request's prompt at the SAME page grid and routes to
+    the replica holding the LONGEST matching chain — the follow-up
+    turn of a 30k-token session lands where its 30k KV rows already
+    sit instead of recomputing them wherever the queue happens to be
+    shortest.
+
+    Load still matters three ways: ties between equally-matched
+    replicas break on the queue-depth score; a request with no match
+    anywhere routes purely by load; and when the affinity winner is
+    overloaded past ``migrate_threshold_tokens`` relative to the load
+    winner, the request routes to the LOAD winner and the prefix is
+    *proactively migrated* — the configured migration executor ships
+    the chain's CRC-checked SKPF blob from the affinity replica's
+    ``/kv/prefix/export`` to the target's ``/kv/warmup``, so the
+    prefix is warm there without recomputation.
+
+    Session stickiness pins on ``request_key``: a key that routed once
+    keeps routing to the same replica while it stays ready (bounded
+    TTL+LRU table — stickiness is a hint, never a leak). Every map
+    here is a :class:`BoundedStore`; graftcheck GC122 gates that."""
+
+    # Longest prompt prefix the LB hashes, in pages: bounds per-select
+    # CPU at ~64 sha1 updates regardless of prompt length.
+    MAX_MATCH_PAGES = 64
+    # Digest entries outlive their probe by this factor — a replica
+    # whose probe is briefly failing keeps its affinity standing.
+    DIGEST_TTL_FACTOR = 10.0
+
+    def __init__(self) -> None:
+        super().__init__()
+        mono = lambda: self._monotonic()  # noqa: E731 — late-bound:
+        # configure_transport swaps _monotonic after construction.
+        self._digests = BoundedStore(
+            _FLEET_CAP,
+            ttl_s=max(self.probe_ttl_s * self.DIGEST_TTL_FACTOR, 10.0),
+            monotonic=mono, name='prefix_digests')
+        self._sessions = BoundedStore(
+            int(_env_float('SKYTPU_LB_SESSION_CAP', 4096)),
+            ttl_s=_env_float('SKYTPU_LB_SESSION_TTL_S', 600.0),
+            monotonic=mono, name='sessions')
+        self.migrate_threshold_tokens = int(_env_float(
+            'SKYTPU_LB_MIGRATE_THRESHOLD', 1600))
+        # (src_url, dst_url, chain_hash, n_tokens) -> bool. Installed
+        # by the LB (live HTTP SKPF ship) or the simulator; None =
+        # never migrate, just eat the recompute.
+        self._migrate: Optional[
+            Callable[[str, str, str, int], bool]] = None
+        # (outcome, recompute_tokens) observer — the LB binds its
+        # affinity counters here; the simulator its report accounting.
+        self._on_affinity: Optional[Callable[[str, int], None]] = None
+
+    def configure_migration(
+            self, migrate: Optional[Callable[[str, str, str, int],
+                                             bool]]) -> None:
+        self._migrate = migrate
+
+    def configure_affinity_observer(
+            self, fn: Optional[Callable[[str, int], None]]) -> None:
+        self._on_affinity = fn
+
+    def _note_payload_locked(self, url: str, payload: Dict) -> None:
+        super()._note_payload_locked(url, payload)
+        digest = payload.get('prefix_digest') or {}
+        try:
+            page = int(digest.get('page') or 0)
+        except (TypeError, ValueError):
+            page = 0
+        if page <= 0:
+            return
+        hashes: Dict[str, int] = {}
+        for entry in (digest.get('entries') or []):
+            try:
+                hashes[str(entry['hash'])] = int(entry['len'])
+            except (KeyError, TypeError, ValueError):
+                continue
+        self._digests.put(url, {'page': page, 'hashes': hashes})
+
+    def _page_grid_hashes(self, tokens: List[int],
+                          page: int) -> Dict[str, int]:
+        """hash-hex -> covered-token-length for every page-grid prefix
+        of ``tokens`` — the engine's exact recipe (sha1 over int32
+        bytes of ``tokens[:k*page]``), computed incrementally: one
+        sha1 update per page, not one pass per prefix."""
+        full = min((len(tokens) - 1) // page, self.MAX_MATCH_PAGES)
+        out: Dict[str, int] = {}
+        h = hashlib.sha1()
+        for k in range(1, full + 1):
+            h.update(np.asarray(tokens[(k - 1) * page:k * page],
+                                np.int32).tobytes())
+            out[h.hexdigest()] = k * page
+        return out
+
+    def select_replica(self,
+                       exclude: Optional[Set[str]] = None,
+                       context: Optional[Dict[str, Any]] = None
+                       ) -> Optional[str]:
+        with self._lock:
+            candidates = self._candidates_locked(exclude)
+        if not candidates:
+            return None
+        self._refresh(candidates)
+        context = context or {}
+        tokens = context.get('tokens')
+        request_key = context.get('request_key')
+        migration = None
+        with self._lock:
+            if not tokens:
+                # No prompt identity (text prompts, health canaries):
+                # pure queue-depth, but stickiness still records so a
+                # later keyed turn finds its session.
+                choice = min(candidates, key=self._score_locked)
+                outcome, recompute = None, 0
+            else:
+                choice, outcome, recompute, migration = (
+                    self._select_affinity_locked(
+                        candidates, list(tokens), request_key))
+            if request_key:
+                self._sessions.put(request_key, choice)
+        observer = self._on_affinity
+        migrate = self._migrate
+        # Migration + observation run OUTSIDE the lock: the executor
+        # may do (simulated or real) network work.
+        if migration is not None and migrate is not None:
+            src, dst, chain_hash, n_tokens = migration
+            try:
+                migrate(src, dst, chain_hash, n_tokens)
+            except Exception as e:  # pylint: disable=broad-except
+                logger.debug(
+                    f'prefix migration {src} -> {dst} failed: '
+                    f'{type(e).__name__}: {e}')
+        if observer is not None and outcome is not None:
+            observer(outcome, recompute)
+        return choice
+
+    def _select_affinity_locked(self, candidates: List[str],
+                                tokens: List[int],
+                                request_key: Optional[str]):
+        """Longest-digest-match selection (callers hold ``self._lock``).
+        Returns ``(choice, outcome, recompute_tokens, migration)``
+        where migration is ``(src, dst, hash, n_tokens)`` or None.
+        ``recompute_tokens`` counts prefix tokens the CHOSEN replica
+        must recompute even though some other replica had them cached
+        (0 on hit/miss; the affinity-vs-load gap only when routing
+        away without a migration executor)."""
+        sticky = (self._sessions.get(request_key)
+                  if request_key else None)
+        if sticky not in candidates:
+            sticky = None
+        grids: Dict[int, Dict[str, int]] = {}
+        best: Dict[str, Tuple[int, Optional[str]]] = {}
+        for u in candidates:
+            m_len, m_hash = 0, None
+            d = self._digests.get(u)
+            if d:
+                page = d['page']
+                if page not in grids:
+                    grids[page] = self._page_grid_hashes(tokens, page)
+                grid = grids[page]
+                # Iterate the replica's digest (<=16 entries), not the
+                # request grid (<=64): per-select cost stays O(fleet)
+                # even on thousand-replica fleets.
+                for hhex in d['hashes']:
+                    length = grid.get(hhex)
+                    if length is not None and length > m_len:
+                        m_len, m_hash = length, hhex
+            if u == sticky:
+                # The session's replica holds its whole prefix by
+                # construction — even before the digest catches up.
+                m_len = max(m_len, len(tokens) - 1)
+            best[u] = (m_len, m_hash)
+        best_len = max(m for m, _ in best.values())
+        load_best = min(candidates, key=self._score_locked)
+        if best_len <= 0:
+            return load_best, 'miss', 0, None
+        aff_pool = [u for u in candidates if best[u][0] == best_len]
+        aff = min(aff_pool, key=self._score_locked)
+        gap = self._score_locked(aff) - self._score_locked(load_best)
+        chain_hash = best[aff][1]
+        if (gap > self.migrate_threshold_tokens
+                and load_best not in aff_pool
+                and chain_hash is not None):
+            migration = (aff, load_best, chain_hash, best_len)
+            recompute = (0 if self._migrate is not None
+                         else best_len - best[load_best][0])
+            return load_best, 'migrated', recompute, migration
+        return aff, 'hit', 0, None
+
+
 POLICIES = {
     'round_robin': RoundRobinPolicy,
     'least_load': LeastLoadPolicy,
     'queue_depth': QueueDepthPolicy,
     'phase_aware': PhaseAwarePolicy,
+    'prefix_affinity': PrefixAffinityPolicy,
 }
 
 

@@ -1093,6 +1093,33 @@ class ModelServer:
         self._m_kv_bytes['export'].inc(len(blob))
         return blob, len(entries)
 
+    def export_prefix_blob(self, hash_hex: str):
+        """One digest-named hot prefix chain as a CRC-checked SKCK
+        container (single SKPF entry) — ``(blob, n_rows)``, or
+        ``(None, 0)`` when the chain is unknown or already evicted.
+        The prefix-affinity LB fetches this from the chain's home
+        replica and POSTs it to the migration target's ``/kv/warmup``
+        instead of letting the target recompute the prefix."""
+        eng = self.engine
+        if eng is None or not hasattr(eng, 'export_prefix_entry'):
+            return None, 0
+        with self._lock:
+            if self._gang is not None:
+                # Record the pipeline flush the export performs so
+                # followers flush at the same op-log position (same
+                # contract as export_checkpoint).
+                self._gang.append_op({'k': 'flush'})
+            entry, events = eng.export_prefix_entry(hash_hex)
+            if self._gang is not None and events:
+                self._gang.digest.update(eng, events)
+        if events:
+            self.sched.on_events(eng, events)
+        if entry is None:
+            return None, 0
+        blob = kv_transfer.encode_checkpoint([entry])
+        self._m_kv_bytes['export'].inc(len(blob))
+        return blob, int(entry['n_rows'])
+
     def warm_from_checkpoint(self, blob: bytes) -> Dict[str, Any]:
         """Land a checkpoint container into the engine's prefix cache:
         every entry (request snapshots included) lands as prefix
@@ -1476,6 +1503,19 @@ class ModelServer:
             # SLO scheduler block (stable schema: every tier and every
             # key present from the first scrape, zeros when idle).
             'sched': sched_stats,
+            # Hot-prefix digest (stable schema: page 0 / empty entries
+            # on a slot engine or before the engine loads). Built from
+            # the engine's HOST-SIDE heat tracker only — shipping it on
+            # every probe adds zero d2h and zero recompiles (pinned by
+            # the jaxpr-audit serve preset). The prefix-affinity LB
+            # policy routes by longest match against these hashes.
+            'prefix_digest': {
+                'page': int(getattr(eng, 'page', 0) or 0),
+                'entries': (eng.hot_prefix_digest()
+                            if eng is not None
+                            and hasattr(eng, 'hot_prefix_digest')
+                            else []),
+            },
         }
 
     # --------------------------------------------------------------- HTTP
@@ -1618,6 +1658,21 @@ class ModelServer:
                     self.send_header('Content-Length', str(len(body)))
                     self.end_headers()
                     self.wfile.write(body)
+                elif parsed.path == '/kv/prefix/export':
+                    h = query.get('hash', [''])[0]
+                    blob, n_rows = server.export_prefix_blob(h)
+                    if blob is None:
+                        self._json(404, {'error': {
+                            'message': f'prefix {h!r} not cached',
+                            'type': 'prefix_not_found'}})
+                        return
+                    self.send_response(200)
+                    self.send_header('Content-Type',
+                                     'application/octet-stream')
+                    self.send_header('X-Prefix-Rows', str(n_rows))
+                    self.send_header('Content-Length', str(len(blob)))
+                    self.end_headers()
+                    self.wfile.write(blob)
                 elif parsed.path == '/debug/requests':
                     try:
                         limit = int(query.get('limit', ['64'])[0])

@@ -31,6 +31,8 @@ from __future__ import annotations
 import hashlib
 from typing import Any, Dict, List, Optional, Set, Tuple
 
+import numpy as np
+
 from skypilot_tpu.serve import controller as controller_lib
 from skypilot_tpu.serve import faults as faults_lib
 from skypilot_tpu.serve import load_balancing_policies as lb_policies
@@ -49,7 +51,8 @@ from skypilot_tpu.serve.sim import traffic as sim_traffic
 # ServeController boots with recover=True over the same world and
 # reconciles the orphaned fleet).
 SIM_FAULT_SITES = ('sim_storm', 'sim_zone_outage', 'sim_straggler',
-                   'sim_gang_churn', 'sim_gray', 'sim_controller')
+                   'sim_gang_churn', 'sim_gray', 'sim_controller',
+                   'sim_lb_crash')
 
 # Per-tier TTFT SLO targets (seconds) — what "attainment" means.
 DEFAULT_SLO_TTFT = {'latency': 2.0, 'throughput': 10.0}
@@ -89,6 +92,7 @@ class FleetSimulator:
                  never_drain_clusters: Optional[set] = None,
                  keep_log: bool = True,
                  canary_s: float = 0.0,
+                 n_lbs: int = 1,
                  service_name: str = 'sim-svc'):
         self.spec = spec
         self.trace = trace
@@ -125,11 +129,37 @@ class FleetSimulator:
             # the known-digest prompt; SimReplica answers through
             # canary_response_tokens.
             self.controller.replica_manager.configure_canary(canary_s)
-        self.policy = lb_policies.make_policy(policy_name)
-        self.policy.configure_transport(
-            fetch_json=self.world.fetch_json,
-            monotonic=lambda: self.loop.now)
+        # Horizontal LB tier: ``n_lbs`` REAL policy instances share the
+        # controller sync feed; each session key picks its LB by a
+        # deterministic client-side hash (standing in for the live
+        # tier's DNS/anycast spread). Single-LB sims keep the exact
+        # pre-tier behavior: one policy, zero probe-TTL jitter.
+        self.n_lbs = max(1, int(n_lbs))
+        self.policies: List[lb_policies.LoadBalancingPolicy] = []
+        for i in range(self.n_lbs):
+            p = lb_policies.make_policy(policy_name)
+            p.configure_transport(fetch_json=self.world.fetch_json,
+                                  monotonic=lambda: self.loop.now)
+            if self.n_lbs > 1 and hasattr(p, 'set_probe_identity'):
+                p.set_probe_identity(f'sim-lb{i}')
+            if isinstance(p, lb_policies.PrefixAffinityPolicy):
+                p.configure_affinity_observer(self._note_affinity)
+                p.configure_migration(self._sim_migrate)
+            self.policies.append(p)
+        self.policy = self.policies[0]
+        self._live_lb_idx: List[int] = list(range(self.n_lbs))
         self.world.on_replica_killed = self._on_replica_killed
+
+        # ---------------------------------------- multi-turn sessions
+        self._sess = trace.sessions
+        self._arrival_seq = 0
+        self._session_turn: Dict[int, int] = {}
+        # sid -> (running sha1 over int32 token bytes, cumulative
+        # page-chain hash hexes) — extended incrementally per turn,
+        # matching the engine recipe the LB policy hashes against.
+        self._session_chain: Dict[int, Tuple[Any, List[str]]] = {}
+        # sid -> pages ever computed anywhere (recompute baseline).
+        self._session_done_pages: Dict[int, int] = {}
 
         # ------------------------------------------------------- metrics
         self.arrived = 0
@@ -148,9 +178,19 @@ class FleetSimulator:
         self.controller_crashes = 0
         self.controller_restarts = 0
         self.reconcile_stats: Dict[str, int] = {}
+        # Prefix-affinity accounting (round 18).
+        self.session_requests = 0
+        self.warm_hits = 0
+        self.recompute_tokens = 0
+        self.affinity_outcomes: Dict[str, int] = {
+            'hit': 0, 'miss': 0, 'migrated': 0}
+        self.prefix_migrations = 0
+        self.lb_crashes = 0
+        self.lb_reroutes = 0
         self._inflight = 0
         self._retry_q: List[Tuple[int, str, float, float,
-                                  Optional[float]]] = []
+                                  Optional[float],
+                                  Optional[Dict[str, Any]]]] = []
         self._pending_ts: List[float] = []
         self._pending_tiers: List[str] = []
         self._tier_carry = 0.0
@@ -242,9 +282,13 @@ class FleetSimulator:
             return
         mgr = self.controller.replica_manager
         urls = mgr.ready_urls()
-        self.policy.set_ready_replicas(urls)
-        self.policy.set_replica_roles(mgr.replica_roles())
-        self.policy.set_replica_gangs(mgr.replica_gangs())
+        roles = mgr.replica_roles()
+        gangs = mgr.replica_gangs()
+        for i in self._live_lb_idx:
+            p = self.policies[i]
+            p.set_ready_replicas(urls)
+            p.set_replica_roles(roles)
+            p.set_replica_gangs(gangs)
         self.controller.autoscaler.collect_request_information(
             self._pending_ts, self._pending_tiers)
         self._pending_ts, self._pending_tiers = [], []
@@ -280,31 +324,104 @@ class FleetSimulator:
         self._pending_ts.extend([now] * n)
         self._pending_tiers.extend(
             ['latency'] * n_lat + ['throughput'] * (n - n_lat))
-        for tier, count in (('latency', n_lat),
-                            ('throughput', n - n_lat)):
-            while count > 0:
-                chunk = min(count, self.max_chunk)
-                count -= chunk
-                self._dispatch(chunk, tier, migrated_from=None,
-                               failed_at=None)
+        if self._sess is not None:
+            # Session traffic dispatches per-request (each turn has its
+            # own prompt identity); the tier split is the same
+            # fractional-carry order as the batched path.
+            for i in range(n):
+                tier = 'latency' if i < n_lat else 'throughput'
+                self._dispatch(1, tier, migrated_from=None,
+                               failed_at=None,
+                               session=self._next_session_turn())
+        else:
+            for tier, count in (('latency', n_lat),
+                                ('throughput', n - n_lat)):
+                while count > 0:
+                    chunk = min(count, self.max_chunk)
+                    count -= chunk
+                    self._dispatch(chunk, tier, migrated_from=None,
+                                   failed_at=None)
         self._schedule_next_arrival()
 
+    def _next_session_turn(self) -> Dict[str, Any]:
+        """Deal the next arrival to its session (round-robin) and
+        materialize that session's next turn: full-conversation prompt
+        tokens plus the cumulative page-chain hashes, extended
+        incrementally with the engine's exact recipe (sha1 over int32
+        page bytes)."""
+        sess = self._sess
+        assert sess is not None
+        # Hash-scrambled session pick (deterministic, no RNG): plain
+        # round-robin would revisit each session at the SAME position
+        # of every arrival batch, and the fluid model's lockstep then
+        # makes ANY load-ranking policy accidentally sticky — the
+        # scramble gives real interleaving, like live traffic.
+        sid = int.from_bytes(
+            hashlib.sha1(str(self._arrival_seq).encode()).digest()[:4],
+            'big') % sess.n_sessions
+        self._arrival_seq += 1
+        turn = self._session_turn.get(sid, 0)
+        self._session_turn[sid] = turn + 1
+        n_tok = sess.turn_tokens * (turn + 1)
+        tokens = sim_traffic.session_tokens(sid, n_tok)
+        page = sim_replica.SimReplica.PAGE
+        full = (n_tok - 1) // page
+        chain = self._session_chain.get(sid)
+        if chain is None:
+            chain = (hashlib.sha1(), [])
+            self._session_chain[sid] = chain
+        h, hashes = chain
+        while len(hashes) < full:
+            k = len(hashes)
+            h.update(np.asarray(tokens[k * page:(k + 1) * page],
+                                np.int32).tobytes())
+            hashes.append(h.hexdigest())
+        return {'sid': sid, 'key': f's{sid}', 'turn': turn,
+                'tokens': tokens, 'hashes': hashes[:full],
+                'n_tok': n_tok, 'page': page}
+
     # ------------------------------------------------------------ dispatch
+    def _lb_for_key(self, key: str) -> int:
+        """The LB a client would hit for ``key``: sha1 spread over the
+        FULL tier; when the home LB is dead, the deterministic re-pick
+        lands on a survivor (counted as a reroute)."""
+        h = int.from_bytes(hashlib.sha1(key.encode()).digest()[:4],
+                           'big')
+        home = h % self.n_lbs
+        if home in self._live_lb_idx:
+            return home
+        self.lb_reroutes += 1
+        return self._live_lb_idx[h % len(self._live_lb_idx)]
+
     def _dispatch(self, count: int, tier: str, *,
                   migrated_from: Optional[str],
                   failed_at: Optional[float],
-                  exclude: Optional[Set[str]] = None) -> None:
+                  exclude: Optional[Set[str]] = None,
+                  session: Optional[Dict[str, Any]] = None) -> None:
         now = self.loop.now
         shape = self.trace.shape
         exclude = set(exclude or ())
+        if session is not None:
+            lb_idx = self._lb_for_key(session['key'])
+            ctx: Optional[Dict[str, Any]] = {
+                'tokens': session['tokens'],
+                'request_key': session['key']}
+            prompt_tokens = float(session['n_tok'])
+        else:
+            lb_idx = self._live_lb_idx[0]
+            ctx = None
+            prompt_tokens = shape.prompt_tokens
+        policy = self.policies[lb_idx]
         while True:
-            url = self.policy.select_replica(exclude=exclude or None)
+            url = policy.select_replica(exclude=exclude or None,
+                                        context=ctx)
             if url is None:
                 if migrated_from is not None:
                     # Zero-lost contract: migrated work is never
                     # dropped — park it until capacity returns.
                     self._retry_q.append((count, tier, now,
-                                          shape.gen_tokens, failed_at))
+                                          shape.gen_tokens, failed_at,
+                                          session))
                     self._log('park', f'n={count} tier={tier}')
                 else:
                     self.sheds['no_replica'] += count
@@ -316,9 +433,15 @@ class FleetSimulator:
             if rep is None:
                 exclude.add(url)
                 continue
+            warm_pages = 0
+            if session is not None and session['hashes']:
+                warm_pages = rep.match_prefix(session['hashes'])
             try:
-                job = rep.enqueue(now, count, shape.prompt_tokens,
-                                  shape.gen_tokens, tier)
+                job = rep.enqueue(now, count, prompt_tokens,
+                                  shape.gen_tokens, tier,
+                                  warm_tokens=float(
+                                      warm_pages * sim_replica
+                                      .SimReplica.PAGE))
             except sim_replica.SimHTTPError:
                 # Stale policy view (dead or draining replica): the
                 # live LB's transparent retry — exclude and re-select.
@@ -331,14 +454,68 @@ class FleetSimulator:
                 return
             job.migrated_from = migrated_from
             job.failed_at = failed_at
-            self.policy.pre_execute(url)
+            job.lb_idx = lb_idx
+            job.session = session
+            policy.pre_execute(url)
             self._inflight += count
-            self._log('dispatch',
-                      f'n={count} tier={tier} url={url} '
-                      f'ttft={job.ttft_s:.4f}')
+            if session is not None:
+                self._account_session_dispatch(session, rep,
+                                               warm_pages)
+                self._log('dispatch',
+                          f'n={count} tier={tier} url={url} '
+                          f'ttft={job.ttft_s:.4f} '
+                          f'key={session["key"]} warm={warm_pages}')
+            else:
+                self._log('dispatch',
+                          f'n={count} tier={tier} url={url} '
+                          f'ttft={job.ttft_s:.4f}')
             self.loop.schedule(max(0.0, job.finish_t - now),
                                self._complete, url, job)
             return
+
+    def _account_session_dispatch(self, session: Dict[str, Any],
+                                  rep: sim_replica.SimReplica,
+                                  warm_pages: int) -> None:
+        """Warm-hit / recompute bookkeeping for one session dispatch,
+        plus the replica-side residency update (after prefill the
+        replica holds the request's whole page-grid chain)."""
+        page = session['page']
+        full = len(session['hashes'])
+        sid = session['sid']
+        self.session_requests += 1
+        if warm_pages > 0:
+            self.warm_hits += 1
+        done = self._session_done_pages.get(sid, 0)
+        # Pages some replica already computed for this session but the
+        # CHOSEN replica has to redo — the waste affinity routing (and
+        # proactive migration) exists to avoid.
+        self.recompute_tokens += max(0, min(done, full)
+                                     - warm_pages) * page
+        if full > 0:
+            rep.note_prefix(session['hashes'][full - 1], full * page)
+        self._session_done_pages[sid] = max(done, full)
+
+    def _note_affinity(self, outcome: str, recompute_tokens: int) -> None:
+        del recompute_tokens   # fleet computes its own (ground truth)
+        if outcome in self.affinity_outcomes:
+            self.affinity_outcomes[outcome] += 1
+
+    def _sim_migrate(self, src: str, dst: str, chain_hash: str,
+                     n_tokens: int) -> bool:
+        """The simulator's migration executor: the live LB ships a
+        CRC-checked SKPF blob src -> dst; here the chain simply becomes
+        resident at ``dst`` (same observable effect: the next matching
+        request prefills warm there)."""
+        src_rep = self.world.replicas.get(src)
+        dst_rep = self.world.replicas.get(dst)
+        if (src_rep is None or dst_rep is None or not dst_rep.alive
+                or src_rep.match_prefix([chain_hash]) == 0):
+            return False
+        dst_rep.note_prefix(chain_hash, n_tokens)
+        self.prefix_migrations += 1
+        self._log('prefix_migrate',
+                  f'src={src} dst={dst} len={n_tokens}')
+        return True
 
     def _complete(self, url: str, job: sim_replica.SimJob) -> None:
         if job.cancelled:
@@ -346,7 +523,7 @@ class FleetSimulator:
         rep = self.world.replicas.get(url)
         if rep is not None:
             rep.complete(job)
-        self.policy.post_execute(url)
+        self.policies[job.lb_idx].post_execute(url)
         self._inflight -= job.count
         self.completed += job.count
         tier = job.tier
@@ -368,22 +545,22 @@ class FleetSimulator:
                   f'url={rep.url} zone={rep.zone} '
                   f'inflight_jobs={len(jobs)}')
         for job in jobs:
-            self.policy.post_execute(rep.url)
+            self.policies[job.lb_idx].post_execute(rep.url)
             self._inflight -= job.count
             self.migrated += job.count
             failed_at = (job.failed_at if job.failed_at is not None
                          else self.loop.now)
             self._dispatch(job.count, job.tier,
                            migrated_from=rep.url, failed_at=failed_at,
-                           exclude={rep.url})
+                           exclude={rep.url}, session=job.session)
 
     def _drain_retry_queue(self) -> None:
         if not self._retry_q:
             return
         pending, self._retry_q = self._retry_q, []
-        for count, tier, _, _, failed_at in pending:
+        for count, tier, _, _, failed_at, session in pending:
             self._dispatch(count, tier, migrated_from='retry-queue',
-                           failed_at=failed_at)
+                           failed_at=failed_at, session=session)
 
     # -------------------------------------------------------------- storms
     def _storm_check(self) -> None:
@@ -434,6 +611,21 @@ class FleetSimulator:
                 self._crash_controller()
             elif rule.kind == 'controller_restart':
                 self._restart_controller()
+        elif site == 'sim_lb_crash':
+            self._crash_lb()
+
+    def _crash_lb(self) -> None:
+        """Kill one live LB process (highest index first): its policy
+        state — probe caches, sticky sessions — is gone; the
+        deterministic client re-pick routes its keys to survivors. The
+        last LB never dies (the scenario would just be an outage)."""
+        if len(self._live_lb_idx) <= 1:
+            self._log('lb_crash', 'skipped: last live lb')
+            return
+        idx = self._live_lb_idx.pop()
+        self.lb_crashes += 1
+        self._log('lb_crash',
+                  f'lb={idx} live={len(self._live_lb_idx)}')
 
     def _apply_gray_fault(self, rule: faults_lib.FaultRule,
                           live) -> None:
@@ -464,11 +656,12 @@ class FleetSimulator:
             for job in jobs:
                 job.cancelled = True
                 rep.inflight.pop(job.job_id, None)
-                self.policy.post_execute(rep.url)
+                self.policies[job.lb_idx].post_execute(rep.url)
                 self._inflight -= job.count
                 self.migrated += job.count
                 self._dispatch(job.count, job.tier,
-                               migrated_from=rep.url, failed_at=now)
+                               migrated_from=rep.url, failed_at=now,
+                               session=job.session)
         elif rule.kind == 'byzantine_response':
             for r in live:
                 if (not r.byzantine and not r.wedged
@@ -560,6 +753,22 @@ class FleetSimulator:
                 'restarts': self.controller_restarts,
                 'reconciled': dict(sorted(
                     self.reconcile_stats.items())),
+            },
+            'affinity': {
+                'session_requests': self.session_requests,
+                'warm_hits': self.warm_hits,
+                'ttft_hit_rate': (
+                    round(self.warm_hits / self.session_requests, 4)
+                    if self.session_requests else 0.0),
+                'recompute_tokens': self.recompute_tokens,
+                'outcomes': dict(self.affinity_outcomes),
+                'prefix_migrations': self.prefix_migrations,
+            },
+            'lbs': {
+                'n': self.n_lbs,
+                'live': len(self._live_lb_idx),
+                'crashed': self.lb_crashes,
+                'reroutes': self.lb_reroutes,
             },
             'faults_fired': faults_fired,
             'events': self._n_events,

@@ -26,6 +26,7 @@ in the scenario (they are a property of the cloud, not the engine).
 """
 from __future__ import annotations
 
+import collections
 import dataclasses
 import json
 import re
@@ -142,11 +143,20 @@ class SimJob:
     migrated_from: Optional[str] = None   # url of the replica that died
     failed_at: Optional[float] = None     # when its first replica died
     cancelled: bool = False
+    lb_idx: int = 0                       # LB that dispatched it
+    session: Optional[Dict[str, Any]] = None   # multi-turn identity
 
 
 class SimReplica:
     """One synthetic model server. Owns only local state; the fleet
     wires completion scheduling and death notification."""
+
+    # Page grid the simulated engine hashes prefix chains at, and the
+    # heat-store bound — both mirror the live paged engine (64-token
+    # pages, ``_PREFIX_HEAT_MAX = 64`` hottest chains).
+    PAGE = 64
+    PREFIX_STORE_CAP = 64
+    DIGEST_MAX_ENTRIES = 16
 
     def __init__(self, cluster_name: str, url: str, curve: ServiceCurve,
                  now_fn: Callable[[], float], *,
@@ -188,13 +198,62 @@ class SimReplica:
         self.busy_until = 0.0
         self.inflight: Dict[int, SimJob] = {}
         self._next_job = 1
+        # Hot-prefix chain store (hash-hex -> [covered_len, hits]),
+        # LRU-bounded like the live engine's heat tracker — session
+        # working sets beyond the cap thrash out, which is exactly the
+        # capacity effect affinity routing is supposed to dodge.
+        self._prefix_store: 'collections.OrderedDict[str, List[int]]' = (
+            collections.OrderedDict())
+
+    # ------------------------------------------------------ prefix cache
+    def note_prefix(self, chain_hash: str, chain_len: int) -> None:
+        """Record that this replica now holds a KV chain covering
+        ``chain_len`` prompt tokens (computed locally or warmed from a
+        migration blob); LRU-evicts beyond the heat-store cap."""
+        rec = self._prefix_store.get(chain_hash)
+        if rec is not None:
+            rec[0] = max(rec[0], int(chain_len))
+            rec[1] += 1
+            self._prefix_store.move_to_end(chain_hash)
+            return
+        while len(self._prefix_store) >= self.PREFIX_STORE_CAP:
+            self._prefix_store.popitem(last=False)
+        self._prefix_store[chain_hash] = [int(chain_len), 1]
+
+    def match_prefix(self, chain_hashes: List[str]) -> int:
+        """Longest resident chain: ``chain_hashes[k-1]`` is the hash of
+        the request's first ``k`` pages; returns the covered page count
+        (0 = fully cold)."""
+        for k in range(len(chain_hashes), 0, -1):
+            rec = self._prefix_store.get(chain_hashes[k - 1])
+            if rec is not None:
+                rec[1] += 1
+                self._prefix_store.move_to_end(chain_hashes[k - 1])
+                return k
+        return 0
+
+    def prefix_digest(self) -> Dict[str, Any]:
+        """The ``prefix_digest`` block a live model server publishes on
+        ``/metrics?format=json``: hottest chains, bounded, determinis-
+        tically ordered by (-hits, hash)."""
+        by_heat = sorted(self._prefix_store.items(),
+                         key=lambda kv: (-kv[1][1], kv[0]))
+        return {'page': self.PAGE,
+                'entries': [{'hash': h, 'len': rec[0], 'hits': rec[1]}
+                            for h, rec
+                            in by_heat[:self.DIGEST_MAX_ENTRIES]]}
 
     # ----------------------------------------------------------- service
     def enqueue(self, now: float, count: int, prompt_tokens: float,
-                gen_tokens: float, tier: str) -> Optional[SimJob]:
+                gen_tokens: float, tier: str,
+                warm_tokens: float = 0.0) -> Optional[SimJob]:
         """Admit a batch; returns the job (with its completion time for
         the fleet to schedule) or None when admission sheds it (queue
-        wait beyond the scheduler bound — the 429 path)."""
+        wait beyond the scheduler bound — the 429 path).
+        ``warm_tokens`` prompt tokens are already resident in this
+        replica's KV pages (a prefix-affinity hit or a migrated chain):
+        they skip prefill entirely and the warm TTFT base applies —
+        the discount the affinity policy's hit-rate numbers measure."""
         if not self.alive:
             raise SimHTTPError(502, 'replica dead')
         if self.draining:
@@ -212,15 +271,17 @@ class SimReplica:
             self._next_job += 1
             self.inflight[job.job_id] = job
             return job
-        svc = self.curve.service_s(prompt_tokens, gen_tokens,
-                                   self.warm) * self.slowdown
+        cold_tokens = max(0.0, prompt_tokens - max(0.0, warm_tokens))
+        warm = self.warm or warm_tokens > 0
+        svc = self.curve.service_s(cold_tokens, gen_tokens,
+                                   warm) * self.slowdown
         wait = max(0.0, self.busy_until - now)
         if wait > self.curve.max_queue_wait_s:
             return None
         self.busy_until = (max(now, self.busy_until)
                            + count * svc / self.curve.slots)
-        ttft = wait + self.curve.prefill_s(prompt_tokens,
-                                           self.warm) * self.slowdown
+        ttft = wait + self.curve.prefill_s(cold_tokens,
+                                           warm) * self.slowdown
         job = SimJob(job_id=self._next_job, count=count,
                      prompt_tokens=prompt_tokens,
                      gen_tokens=gen_tokens, tier=tier, submit_t=now,
@@ -325,6 +386,7 @@ class SimReplica:
                 'kv_pool_tokens_free': self.kv_pool_tokens_free(),
                 'mesh': {'tp': self.tp, 'dp': self.dp},
                 'disagg': {'role': self.role},
+                'prefix_digest': self.prefix_digest(),
             }
         if path == '/gang/status':
             # Adoption probe surface (round 15): a restarted manager

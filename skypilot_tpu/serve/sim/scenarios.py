@@ -182,6 +182,65 @@ def _forecast_vs_reactive_runner(scn: 'Scenario', seed: int,
     }
 
 
+def _multi_turn_affinity_runner(scn: 'Scenario', seed: int,
+                                policy: Optional[str]
+                                ) -> Dict[str, Any]:
+    """The round-18 routing comparison: the IDENTICAL multi-turn trace
+    over the same 1000-replica fleet under ``queue_depth`` (load-only)
+    vs ``prefix_affinity`` (digest routing + stickiness + proactive
+    migration). Affinity must win BOTH ways: strictly higher warm-TTFT
+    hit rate AND strictly fewer prefix-recompute tokens."""
+    del policy     # the policy axis IS the comparison
+
+    def one(policy_name: str) -> Dict[str, Any]:
+        kwargs: Dict[str, Any] = dict(scn.sim_kwargs)
+        kwargs.setdefault('keep_log', False)
+        sim = sim_fleet.FleetSimulator(
+            spec=scn.spec_fn(), trace=scn.trace_fn(), seed=seed,
+            policy_name=policy_name,
+            curve=calibrated_curve(scn.slots), **kwargs)
+        return sim.run()
+
+    qd = one('queue_depth')
+    aff = one('prefix_affinity')
+
+    def view(rep: Dict[str, Any]) -> Dict[str, Any]:
+        return {'ttft_hit_rate': rep['affinity']['ttft_hit_rate'],
+                'recompute_tokens': rep['affinity']['recompute_tokens'],
+                'warm_hits': rep['affinity']['warm_hits'],
+                'outcomes': rep['affinity']['outcomes'],
+                'prefix_migrations': rep['affinity']
+                                        ['prefix_migrations'],
+                'shed': sum(rep['requests']['shed'].values()),
+                'lost': rep['requests']['lost'],
+                'slo': rep['slo']}
+
+    return {
+        'seed': seed,
+        'trace': aff['trace'],
+        'replicas': aff['replicas'],
+        'queue_depth': view(qd),
+        'prefix_affinity': view(aff),
+        'affinity_beats_queue_depth': {
+            'ttft_hit_rate': (aff['affinity']['ttft_hit_rate']
+                              > qd['affinity']['ttft_hit_rate']),
+            'recompute_tokens': (aff['affinity']['recompute_tokens']
+                                 < qd['affinity']['recompute_tokens']),
+        },
+        'requests': {'arrived': aff['requests']['arrived'],
+                     'completed': aff['requests']['completed'],
+                     'shed': aff['requests']['shed'],
+                     'lost': max(aff['requests']['lost'],
+                                 qd['requests']['lost']),
+                     'migrated': aff['requests']['migrated']},
+        'slo': aff['slo'],
+        'events': qd['events'] + aff['events'],
+        'event_log_sha256': aff['event_log_sha256'],
+        'virtual_s': qd['virtual_s'] + aff['virtual_s'],
+        'chip_seconds': qd['chip_seconds'] + aff['chip_seconds'],
+    }
+
+
 SCENARIOS: Dict[str, Scenario] = {}
 
 
@@ -379,6 +438,42 @@ _register(Scenario(
     sim_kwargs=dict(provision_s=30.0, n_zones=10, arrival_dt=0.5,
                     max_chunk=16, keep_log=False, storm_dt=10.0,
                     drain_grace_s=300.0),
+))
+
+
+_register(Scenario(
+    name='multi_turn_affinity',
+    description='Prefix-affinity routing comparison: one multi-turn '
+                'trace (800 sessions, prefix-extending prompts) over '
+                'a 1000-replica fleet under queue_depth vs '
+                'prefix_affinity; affinity must score a strictly '
+                'higher warm-TTFT hit rate AND strictly fewer '
+                'prefix-recompute tokens.',
+    spec_fn=lambda: _spec(min_replicas=1000),
+    trace_fn=lambda: sim_traffic.multi_turn(20.0, 240.0, 800, 192),
+    policy='prefix_affinity',
+    recovery_covered=False,      # nothing is killed; a measurement
+    sim_kwargs=dict(provision_s=20.0, provision_jitter=0.0,
+                    n_zones=10, keep_log=False, drain_grace_s=200.0),
+    runner=_multi_turn_affinity_runner,
+))
+
+_register(Scenario(
+    name='lb_crash',
+    description='Horizontal LB tier under fire: 2 LB processes share '
+                'the sync feed, multi-turn sessions split between '
+                'them by client hash; one LB dies mid-run — its '
+                'sticky sessions and probe caches are gone, the '
+                'survivor re-forms affinity from the replicas\' '
+                'advertised digests, and ZERO requests are lost.',
+    spec_fn=lambda: _spec(min_replicas=3, max_replicas=6,
+                          target_qps_per_replica=2.0),
+    trace_fn=lambda: sim_traffic.multi_turn(4.0, 300.0, 40, 192),
+    policy='prefix_affinity',
+    fault_rules=[{'kind': 'lb_crash', 'site': 'sim_lb_crash',
+                  'at': 12}],
+    sim_kwargs=dict(provision_s=20.0, provision_jitter=0.0,
+                    n_lbs=2, storm_dt=10.0, drain_grace_s=200.0),
 ))
 
 

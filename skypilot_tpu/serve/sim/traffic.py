@@ -17,12 +17,19 @@ Shipped shapes:
   ``burst_s`` of every ``season_s`` period, ``base_qps`` otherwise.
 - ``flash_crowd`` — a step to ``peak_qps`` at ``at_s`` (the ramp no
   season predicts; only the trend term can chase it).
+- ``multi_turn`` — steady load of MULTI-TURN SESSIONS: arrivals are
+  assigned round-robin to ``n_sessions`` conversations, and each visit
+  is that session's next turn — its prompt is the whole conversation
+  so far (strictly prefix-extending, ``turn_tokens`` new tokens per
+  turn). Token ids are a pure function of (session, position): no RNG,
+  byte-identical replays, and the prefix-affinity LB's page-grid
+  hashes see EXACTLY the chains the simulated replicas advertise.
 """
 from __future__ import annotations
 
 import dataclasses
 import math
-from typing import Callable, Iterator, Tuple
+from typing import Callable, Iterator, List, Optional, Tuple
 
 
 @dataclasses.dataclass(frozen=True)
@@ -33,12 +40,32 @@ class RequestShape:
 
 
 @dataclasses.dataclass(frozen=True)
+class SessionMix:
+    """Multi-turn session structure riding on a trace: arrivals are
+    dealt round-robin over ``n_sessions`` conversations whose prompts
+    grow by ``turn_tokens`` tokens per turn (each turn's prompt is a
+    strict prefix of the next — the property prefix-affinity routing
+    exploits)."""
+    n_sessions: int
+    turn_tokens: int = 192
+
+
+def session_tokens(session_id: int, n_tokens: int) -> List[int]:
+    """Token ``i`` of session ``s`` is a pure function of ``(s, i)`` —
+    so turn ``t``'s prompt is automatically a strict prefix of turn
+    ``t+1``'s, with zero stored state and zero RNG."""
+    base = session_id * 1009 + 7
+    return [(base + i * 31) % 50021 for i in range(n_tokens)]
+
+
+@dataclasses.dataclass(frozen=True)
 class Trace:
     """A rate function over [0, duration_s) plus request shapes."""
     name: str
     rate_fn: Callable[[float], float]
     duration_s: float
     shape: RequestShape = RequestShape()
+    sessions: Optional[SessionMix] = None   # multi-turn structure
 
     def arrivals(self, dt: float) -> Iterator[Tuple[float, int]]:
         """Yield ``(t, n)`` arrival batches every ``dt`` seconds with
@@ -91,9 +118,24 @@ def flash_crowd(base_qps: float, peak_qps: float, at_s: float,
     return Trace('flash_crowd', rate, duration_s, shape)
 
 
+def multi_turn(qps: float, duration_s: float, n_sessions: int,
+               turn_tokens: int = 192,
+               shape: RequestShape = RequestShape()) -> Trace:
+    """Steady ``qps`` of multi-turn session traffic: ~``qps *
+    duration_s / n_sessions`` turns per session, prompts growing
+    ``turn_tokens`` per turn. ``shape.prompt_tokens`` is ignored for
+    session requests (the session's own growing prompt wins);
+    ``gen_tokens`` and the tier mix still apply."""
+    return Trace('multi_turn', lambda t: qps, duration_s, shape,
+                 sessions=SessionMix(n_sessions=max(1, int(n_sessions)),
+                                     turn_tokens=max(1,
+                                                     int(turn_tokens))))
+
+
 TRACES = {
     'constant': constant,
     'diurnal': diurnal,
     'bursty': bursty,
     'flash_crowd': flash_crowd,
+    'multi_turn': multi_turn,
 }

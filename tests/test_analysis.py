@@ -1188,6 +1188,71 @@ def test_gc120_journal_kinds_registered():
         serve_state.journal_op_start('svc', 'meteor', 1, None)
 
 
+# ------------------------------------------------------------------ GC122
+LB_POLICY_PATH = 'skypilot_tpu/serve/load_balancing_policies.py'
+
+
+def test_gc122_raw_map_growth_flagged():
+    # Per-key writes and growth-method calls on self.* containers in
+    # the LB-policy module — sessions/replica URLs churn unboundedly,
+    # so every runtime map must be a BoundedStore.
+    src = '''
+    class SomePolicy:
+        def select(self, key):
+            self._sessions[key] = 'url'
+            self._counts[key] += 1
+            self._urls.append(key)
+            self._seen.add(key)
+            self._merged.update({key: 1})
+    '''
+    assert rule_ids(src, LB_POLICY_PATH) == ['GC122'] * 5
+
+
+def test_gc122_bounded_store_and_reassignment_clean():
+    # Inside BoundedStore the raw mutations ARE the implementation;
+    # wholesale reassignment replaces rather than grows; locals are
+    # per-call.
+    src = '''
+    class BoundedStore:
+        def put(self, key, value):
+            self._d[key] = value
+            self._order.append(key)
+    class SomePolicy:
+        def set_ready_replicas(self, urls):
+            self._gangs = dict(self._planned_gangs)
+        def select(self, key):
+            pool = {}
+            pool[key] = 1
+            ranked = []
+            ranked.append(key)
+            return pool, ranked
+    '''
+    assert rule_ids(src, LB_POLICY_PATH) == []
+
+
+def test_gc122_only_polices_lb_policy_module():
+    # The same source elsewhere in serve/ is out of scope — the rule
+    # gates the long-resident policy tables, not every dict in the
+    # tree.
+    src = '''
+    class Tracker:
+        def note(self, key):
+            self._seen[key] = 1
+    '''
+    assert 'GC122' not in rule_ids(src, 'skypilot_tpu/serve/server.py')
+
+
+def test_gc122_real_policy_module_clean():
+    # The shipped module itself holds the invariant: zero GC122 (and
+    # zero anything else) with only explicitly annotated suppressions.
+    import pathlib
+    mod = pathlib.Path(rules_lib.__file__).resolve()
+    repo = mod.parents[2]
+    src = (repo / LB_POLICY_PATH).read_text()
+    vs = rules_lib.check_source(LB_POLICY_PATH, src)
+    assert vs == [], [f'{v.rule}:{v.line}' for v in vs]
+
+
 # --------------------------------------------- aliased-import timing
 def test_gc109_aliased_time_imports_flagged():
     # ``from time import time as now`` / ``import time as t`` must not

@@ -187,6 +187,25 @@ def test_kv_int8_presets_registered():
     assert 'kv-int8-slot' in jaxpr_audit.PRESETS
 
 
+# ----------------------------------------------------- prefix digest
+def test_digest_export_audit():
+    """hot_prefix_digest() on the probe path: a scrape after every
+    wave (hotter than the real ~1 Hz probe cadence) adds zero
+    unsanctioned d2h and zero steady-state recompiles — the digest is
+    built from the host-side heat tracker only — and every scrape
+    returns the chains the waves registered."""
+    report = jaxpr_audit.audit_digest_export()
+    _assert_hot_loop_clean(report)
+    assert report.ok(), report.format()
+    assert report.compile_counts['scrapes returning entries'] == (2, 2)
+
+
+def test_digest_preset_registered():
+    """The digest preset gates CI through the default preset list."""
+    assert 'digest' in jaxpr_audit.PRESETS
+    assert 'digest' in jaxpr_audit.DEFAULT_PRESETS
+
+
 # ------------------------------------------------------------ sharded (tp)
 def _need_devices(n: int) -> None:
     import jax

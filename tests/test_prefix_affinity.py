@@ -1,0 +1,509 @@
+"""Prefix-affinity KV routing + the horizontal LB tier (PR 18).
+
+Units: the consistent-hash ring's ownership stability and bounded key
+movement; the BoundedStore TTL+LRU contract every LB-side map rides;
+the prefix-affinity policy's longest-digest-match routing, load
+tie-breaking, session stickiness and proactive-migration trigger —
+all on fake replicas through the ``configure_transport`` seam, no
+sockets.
+
+Live e2e (slow): a 3-replica / 2-LB tier serving a multi-turn replay
+with one LB killed mid-run — zero lost turns, byte-identical
+continuations against a direct single-replica reference, and ring
+convergence on the survivor.
+"""
+import hashlib
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import jax
+import numpy as np
+import pytest
+
+from skypilot_tpu.serve import load_balancing_policies as lbp
+from skypilot_tpu.serve.lb_ring import HashRing
+from skypilot_tpu.utils import common_utils
+
+jax.config.update('jax_platforms', 'cpu')
+
+
+# ---------------------------------------------------------------------------
+# Consistent-hash ring
+# ---------------------------------------------------------------------------
+def _members(n):
+    return {f'lb-{i}': f'http://10.0.0.{i}:8000' for i in range(n)}
+
+
+def test_ring_ownership_deterministic_and_balanced():
+    """Two independently built rings over the same membership agree on
+    every key (no RNG, no instance state), and ownership is roughly
+    uniform — no member starves."""
+    keys = [f'sess-{i}' for i in range(2000)]
+    a, b = HashRing(), HashRing()
+    a.set_members(_members(4))
+    b.set_members(_members(4))
+    owners = {}
+    for k in keys:
+        o = a.owner(k)
+        assert o == b.owner(k)
+        owners[o] = owners.get(o, 0) + 1
+    assert set(owners) == set(_members(4))
+    for name, n in owners.items():
+        assert n > len(keys) * 0.10, (name, n)   # vnode smoothing
+    name, url = a.owner_url('sess-0')
+    assert name == a.owner('sess-0')
+    assert url == _members(4)[name]
+
+
+def test_ring_ownership_stable_across_rebuilds():
+    """Rebuilding with IDENTICAL membership never moves a key — the
+    stability contract session affinity depends on across controller
+    syncs."""
+    ring = HashRing()
+    ring.set_members(_members(3))
+    keys = [f'k{i}' for i in range(500)]
+    before = {k: ring.owner(k) for k in keys}
+    for _ in range(3):
+        ring.set_members(_members(3))
+    assert {k: ring.owner(k) for k in keys} == before
+
+
+def test_ring_remove_moves_only_the_dead_members_keys():
+    """Removing one LB remaps ONLY the keys it owned; every surviving
+    owner keeps every key — an LB crash never shuffles the survivors'
+    sessions."""
+    ring = HashRing()
+    ring.set_members(_members(4))
+    keys = [f'sess-{i}' for i in range(2000)]
+    before = {k: ring.owner(k) for k in keys}
+    gone = 'lb-3'
+    ring.set_members({n: u for n, u in _members(4).items()
+                      if n != gone})
+    for k in keys:
+        after = ring.owner(k)
+        if before[k] == gone:
+            assert after != gone
+        else:
+            assert after == before[k], k
+
+
+def test_ring_add_moves_bounded_fraction():
+    """Adding a 5th LB moves only keys TO the new member — about 1/5
+    of the space, never a reshuffle between existing members."""
+    ring = HashRing()
+    ring.set_members(_members(4))
+    keys = [f'sess-{i}' for i in range(2000)]
+    before = {k: ring.owner(k) for k in keys}
+    grown = _members(5)
+    ring.set_members(grown)
+    moved = 0
+    for k in keys:
+        after = ring.owner(k)
+        if after != before[k]:
+            assert after == 'lb-4', k     # only toward the newcomer
+            moved += 1
+    assert 0 < moved < len(keys) * 0.40   # ~1/5 + vnode slack
+
+
+def test_ring_empty_and_single():
+    ring = HashRing()
+    assert ring.owner('x') is None
+    assert ring.owner_url('x') == (None, None)
+    ring.set_members({'only': 'http://a'})
+    assert ring.owner('anything') == 'only'
+    assert ring.owner_url('anything') == ('only', 'http://a')
+
+
+# ---------------------------------------------------------------------------
+# BoundedStore (the GC122-sanctioned map)
+# ---------------------------------------------------------------------------
+def test_bounded_store_lru_cap_and_eviction_count():
+    s = lbp.BoundedStore(3, name='t')
+    for i in range(5):
+        s.put(i, i * 10)
+    assert len(s) == 3 and s.evictions == 2
+    assert 0 not in s and 1 not in s
+    # get() refreshes recency: 2 survives the next insert, 3 does not.
+    assert s.get(2) == 20
+    s.put(9, 90)
+    assert 2 in s and 3 not in s
+
+
+def test_bounded_store_ttl_expiry_on_virtual_clock():
+    now = [0.0]
+    s = lbp.BoundedStore(8, ttl_s=10.0, monotonic=lambda: now[0],
+                         name='t')
+    s.put('a', 1)
+    now[0] = 9.0
+    assert s.get('a') == 1
+    now[0] = 10.5
+    assert s.get('a') is None and 'a' not in s
+
+
+def test_bounded_store_incr_floor_and_pop():
+    s = lbp.BoundedStore(8, name='t')
+    assert s.incr('k', 1) == 1
+    assert s.incr('k', -5, floor=0) == 0
+    s.put('x', 7)
+    assert s.pop('x') == 7 and s.pop('x', 'gone') == 'gone'
+
+
+# ---------------------------------------------------------------------------
+# Prefix-affinity policy on fake replicas (configure_transport seam)
+# ---------------------------------------------------------------------------
+PAGE = 64
+
+
+def _hash_chain(tokens, covered):
+    """The engine's digest recipe: sha1 over int32 bytes of the
+    page-grid prefix."""
+    return hashlib.sha1(np.asarray(tokens[:covered],
+                                   np.int32).tobytes()).hexdigest()
+
+
+def _payload(queue_tokens, tokens=None, pages=0, page=PAGE):
+    entries = []
+    if tokens is not None and pages > 0:
+        entries = [{'hash': _hash_chain(tokens, k * page),
+                    'len': k * page, 'hits': 1}
+                   for k in range(1, pages + 1)]
+    return {'queue_tokens_total': queue_tokens,
+            'prefix_digest': {'page': page, 'entries': entries}}
+
+
+def _mk_policy(payloads, now):
+    pol = lbp.make_policy('prefix_affinity')
+    pol.configure_transport(
+        fetch_json=lambda u: payloads[u.split('/metrics')[0]],
+        monotonic=lambda: now[0])
+    pol.set_ready_replicas(sorted(payloads))
+    return pol
+
+
+def test_page_grid_hashes_match_engine_recipe():
+    """The LB recomputes the engine's exact sha1 — any drift in either
+    recipe silently zeroes the hit rate, so parity is pinned here."""
+    tokens = [(i * 31 + 7) % 50021 for i in range(300)]
+    pol = lbp.make_policy('prefix_affinity')
+    grid = pol._page_grid_hashes(tokens, PAGE)
+    full = (len(tokens) - 1) // PAGE
+    assert len(grid) == full > 0
+    for k in range(1, full + 1):
+        assert grid[_hash_chain(tokens, k * PAGE)] == k * PAGE
+
+
+def test_longest_digest_match_wins():
+    tokens = list(range(1, 6 * PAGE + 2))          # 6 full pages
+    payloads = {
+        'http://a': _payload(0, tokens, pages=2),  # shorter match
+        'http://b': _payload(900, tokens, pages=4),  # longest, busier
+        'http://c': _payload(0),                   # no digest
+    }
+    outcomes = []
+    pol = _mk_policy(payloads, [0.0])
+    pol.configure_affinity_observer(lambda o, r: outcomes.append((o, r)))
+    choice = pol.select_replica(
+        context={'tokens': tokens, 'request_key': 's1'})
+    assert choice == 'http://b'                    # match beats load
+    assert outcomes == [('hit', 0)]
+
+
+def test_digest_tie_breaks_on_queue_depth():
+    tokens = list(range(1, 3 * PAGE + 2))
+    payloads = {
+        'http://a': _payload(800, tokens, pages=2),
+        'http://b': _payload(100, tokens, pages=2),  # same match, idle
+    }
+    pol = _mk_policy(payloads, [0.0])
+    assert pol.select_replica(
+        context={'tokens': tokens}) == 'http://b'
+
+
+def test_no_match_routes_by_load_and_counts_miss():
+    tokens = list(range(1, 3 * PAGE + 2))
+    other = list(range(9000, 9000 + 3 * PAGE + 2))
+    payloads = {
+        'http://a': _payload(700, other, pages=2),  # digest, no match
+        'http://b': _payload(50),
+    }
+    outcomes = []
+    pol = _mk_policy(payloads, [0.0])
+    pol.configure_affinity_observer(lambda o, r: outcomes.append((o, r)))
+    assert pol.select_replica(context={'tokens': tokens}) == 'http://b'
+    assert outcomes == [('miss', 0)]
+
+
+def test_session_stickiness_survives_digest_cold_start():
+    """A key that routed once keeps routing to the same replica even
+    before any digest mentions its prefix (the session's replica holds
+    its whole prefix by construction) — and falls back cleanly when
+    that replica leaves the ready set."""
+    tokens = list(range(1, 2 * PAGE + 2))
+    payloads = {'http://a': _payload(500), 'http://b': _payload(0)}
+    pol = _mk_policy(payloads, [0.0])
+    first = pol.select_replica(
+        context={'tokens': tokens, 'request_key': 'sess-9'})
+    assert first == 'http://b'                     # load winner, miss
+    # Load flips — but the session stays pinned to its replica.
+    payloads['http://b']['queue_tokens_total'] = 5000
+    payloads['http://a']['queue_tokens_total'] = 0
+    now = [pol.probe_ttl_s + 1.0]
+    pol.configure_transport(monotonic=lambda: now[0])
+    assert pol.select_replica(
+        context={'tokens': tokens, 'request_key': 'sess-9'}) \
+        == 'http://b'
+    # The pinned replica drains away: the key re-routes by load.
+    pol.set_ready_replicas(['http://a'])
+    assert pol.select_replica(
+        context={'tokens': tokens, 'request_key': 'sess-9'}) \
+        == 'http://a'
+
+
+def test_overload_gap_triggers_proactive_migration():
+    """Affinity winner overloaded past the threshold: the request goes
+    to the LOAD winner and the migration executor ships the chain from
+    the affinity replica — outcome 'migrated', zero recompute (the
+    prefix arrives warm)."""
+    tokens = list(range(1, 4 * PAGE + 2))
+    payloads = {
+        'http://hot': _payload(5000, tokens, pages=4),
+        'http://idle': _payload(0),
+    }
+    outcomes, ships = [], []
+    pol = _mk_policy(payloads, [0.0])
+    pol.migrate_threshold_tokens = 1600
+    pol.configure_affinity_observer(lambda o, r: outcomes.append((o, r)))
+    pol.configure_migration(
+        lambda src, dst, h, n: ships.append((src, dst, h, n)) or True)
+    choice = pol.select_replica(context={'tokens': tokens,
+                                         'request_key': 'sess-m'})
+    assert choice == 'http://idle'
+    assert outcomes == [('migrated', 0)]
+    assert ships == [('http://hot', 'http://idle',
+                      _hash_chain(tokens, 4 * PAGE), 4 * PAGE)]
+
+
+def test_overload_without_executor_counts_recompute_tokens():
+    """Same overload, but no migration executor installed: the policy
+    still routes away (latency beats locality past the threshold) and
+    reports the prefix tokens the chosen replica must recompute."""
+    tokens = list(range(1, 4 * PAGE + 2))
+    payloads = {
+        'http://hot': _payload(5000, tokens, pages=4),
+        'http://idle': _payload(0),
+    }
+    outcomes = []
+    pol = _mk_policy(payloads, [0.0])
+    pol.migrate_threshold_tokens = 1600
+    pol.configure_affinity_observer(lambda o, r: outcomes.append((o, r)))
+    assert pol.select_replica(
+        context={'tokens': tokens}) == 'http://idle'
+    assert outcomes == [('migrated', 4 * PAGE)]
+
+
+def test_gap_under_threshold_keeps_affinity():
+    tokens = list(range(1, 4 * PAGE + 2))
+    payloads = {
+        'http://warm': _payload(1000, tokens, pages=4),
+        'http://idle': _payload(0),
+    }
+    pol = _mk_policy(payloads, [0.0])
+    pol.migrate_threshold_tokens = 1600          # gap 1000 < threshold
+    assert pol.select_replica(
+        context={'tokens': tokens}) == 'http://warm'
+
+
+def test_probe_ttl_knob_and_seeded_jitter(monkeypatch):
+    """SKYTPU_LB_PROBE_TTL_S replaces the hardcoded 1 s TTL, and the
+    per-LB-identity jitter is deterministic and bounded — two LBs with
+    the same id agree, different ids (usually) disagree, the empty id
+    keeps the exact base TTL (existing sims unchanged)."""
+    monkeypatch.setenv('SKYTPU_LB_PROBE_TTL_S', '4.0')
+    a = lbp.make_policy('queue_depth')
+    assert a._base_probe_ttl_s == 4.0
+    assert a.probe_ttl_s == 4.0                  # no identity: no jitter
+    a.set_probe_identity('lb-a')
+    b = lbp.make_policy('queue_depth')
+    b.set_probe_identity('lb-a')
+    assert a.probe_ttl_s == b.probe_ttl_s        # deterministic
+    assert abs(a.probe_ttl_s - 4.0) > 1e-9       # jittered off base
+    assert 4.0 * 0.8 <= a.probe_ttl_s <= 4.0 * 1.2
+    c = lbp.make_policy('queue_depth')
+    c.set_probe_identity('lb-c')
+    assert c.probe_ttl_s != a.probe_ttl_s
+
+
+# ---------------------------------------------------------------------------
+# Live e2e: 3 replicas, 2 LBs, one killed mid-replay
+# ---------------------------------------------------------------------------
+class _PeerController:
+    """Answers the LB sync POST like the real controller: a fixed
+    ready-replica list plus the lb_peers registry built from the
+    syncing LBs' own (lb_id, lb_url) announcements."""
+
+    def __init__(self, replica_urls):
+        import http.server
+        self.replica_urls = list(replica_urls)
+        self.peers = {}
+        self.lock = threading.Lock()
+        outer = self
+
+        class H(http.server.BaseHTTPRequestHandler):
+            def log_message(self, *a):
+                pass
+
+            def do_POST(self):  # noqa: N802
+                n = int(self.headers.get('Content-Length', 0))
+                req = json.loads(self.rfile.read(n) or b'{}')
+                with outer.lock:
+                    if req.get('lb_id'):
+                        outer.peers[req['lb_id']] = req.get('lb_url')
+                    body = json.dumps({
+                        'ready_replica_urls': outer.replica_urls,
+                        'lb_peers': dict(outer.peers)}).encode()
+                self.send_response(200)
+                self.send_header('Content-Length', str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+        import http.server as hs
+        self.port = common_utils.find_free_port(21100)
+        self.httpd = hs.ThreadingHTTPServer(('127.0.0.1', self.port), H)
+        threading.Thread(target=self.httpd.serve_forever,
+                         daemon=True).start()
+
+    @property
+    def url(self):
+        return f'http://127.0.0.1:{self.port}'
+
+    def forget(self, lb_id):
+        with self.lock:
+            self.peers.pop(lb_id, None)
+
+    def stop(self):
+        self.httpd.shutdown()
+
+
+def _generate(base, prompt, n, key, timeout=180):
+    """Non-streaming /generate through ``base``; returns the token
+    list. Retries refusals briefly — 'zero lost' means every turn
+    completes, not that no attempt ever 503s."""
+    body = json.dumps({'prompt': prompt,
+                       'max_new_tokens': n}).encode()
+    deadline = time.time() + timeout
+    last = None
+    while time.time() < deadline:
+        req = urllib.request.Request(
+            base + '/generate', body,
+            {'Content-Type': 'application/json', 'X-Request-ID': key})
+        try:
+            with urllib.request.urlopen(req, timeout=timeout) as r:
+                return list(json.loads(r.read())['tokens'])
+        except (urllib.error.URLError, ConnectionError, OSError) as e:
+            last = e
+            time.sleep(0.5)
+    raise AssertionError(f'turn lost: {last}')
+
+
+@pytest.mark.slow
+def test_live_multi_turn_replay_survives_lb_kill(tmp_path, monkeypatch):
+    """e2e: two sessions replay 3 turns each over 3 live replicas
+    behind a 2-LB prefix-affinity tier; LB-A is killed after turn 1.
+    Every remaining turn completes through LB-B (zero lost), every
+    turn's tokens are byte-identical to a direct single-replica
+    reference (greedy decode — affinity must never change bytes), and
+    the survivor's ring converges to itself once the controller drops
+    the dead peer."""
+    monkeypatch.setenv('SKYTPU_SERVE_DIR', str(tmp_path / 'serve'))
+    monkeypatch.setenv('SKYTPU_LB_SYNC', '3600')   # manual syncs only
+    from skypilot_tpu.serve.load_balancer import SkyServeLoadBalancer
+    from skypilot_tpu.serve.server import ModelServer
+    servers = []
+    for i in range(3):
+        port = common_utils.find_free_port(21200 + i * 17)
+        servers.append(ModelServer('tiny', max_batch=2, max_seq=256,
+                                   port=port, step_watchdog_s=0))
+    lbs = {}
+    ctrl = None
+    try:
+        for s in servers:
+            s.start(block=False)
+        deadline = time.time() + 240
+        while time.time() < deadline and not all(
+                s._ready.is_set() for s in servers):
+            time.sleep(0.2)
+        assert all(s._ready.is_set() for s in servers)
+        replica_urls = [f'http://127.0.0.1:{s.port}' for s in servers]
+        # Reference: the whole conversation directly against ONE
+        # replica — greedy on identical weights, so every replica
+        # (and any routing) must reproduce these bytes exactly.
+        sessions = {
+            's-alpha': [11, 13, 17, 19, 23, 29, 31, 37],
+            's-beta': [41, 43, 47, 53, 59, 61, 67, 71],
+        }
+        turns = 3
+        per_turn = 6
+        reference = {}
+        for key, seed_prompt in sessions.items():
+            prompt = list(seed_prompt)
+            ref_turns = []
+            for t in range(turns):
+                toks = _generate(replica_urls[0], prompt, per_turn,
+                                 key=f'ref-{key}-{t}')
+                assert len(toks) == per_turn
+                ref_turns.append(toks)
+                prompt = prompt + toks + [101 + t, 103 + t]
+            reference[key] = ref_turns
+        ctrl = _PeerController(replica_urls)
+        for name in ('lb-a', 'lb-b'):
+            port = common_utils.find_free_port(21300
+                                               + len(lbs) * 13)
+            lb = SkyServeLoadBalancer(
+                controller_url=ctrl.url, port=port,
+                policy_name='prefix_affinity', lb_id=name,
+                advertise_url=f'http://127.0.0.1:{port}')
+            lb.start()
+            lb._sync_once()
+            lbs[name] = lb
+        # Second sync round: lb-a registered before lb-b existed.
+        for lb in lbs.values():
+            lb._sync_once()
+        for lb in lbs.values():
+            assert set(lb._ring.members) == {'lb-a', 'lb-b'}
+        lb_a_url = f'http://127.0.0.1:{lbs["lb-a"].port}'
+        lb_b_url = f'http://127.0.0.1:{lbs["lb-b"].port}'
+        # Replay: turn 1 through LB-A; then the kill; turns 2..n
+        # through the survivor, same session keys.
+        # Request keys are per-TURN (idempotency: a replayed key
+        # returns the recorded answer); cross-turn affinity rides the
+        # prefix digest, not the key.
+        prompts = {k: list(p) for k, p in sessions.items()}
+        for key in sessions:
+            toks = _generate(lb_a_url, prompts[key], per_turn,
+                             key=f'{key}-t0')
+            assert toks == reference[key][0], key
+            prompts[key] = prompts[key] + toks + [101, 103]
+        lbs['lb-a'].stop()
+        ctrl.forget('lb-a')
+        lbs['lb-b']._sync_once()
+        assert set(lbs['lb-b']._ring.members) == {'lb-b'}
+        for t in range(1, turns):
+            for key in sessions:
+                toks = _generate(lb_b_url, prompts[key], per_turn,
+                                 key=f'{key}-t{t}')
+                assert toks == reference[key][t], (key, t)
+                prompts[key] = (prompts[key] + toks
+                                + [101 + t, 103 + t])
+    finally:
+        if ctrl is not None:
+            ctrl.stop()
+        for lb in lbs.values():
+            try:
+                lb.stop()
+            except Exception:   # already stopped mid-test
+                pass
+        for s in servers:
+            s.stop()

@@ -72,6 +72,37 @@ def test_spot_scaling_series_registered_at_construction(
                 in prom), kind
 
 
+def test_lb_affinity_series_registered_at_construction(tmp_path,
+                                                       monkeypatch):
+    """PR-18 stable schema: constructing a prefix-affinity LB (never
+    started, never synced) registers every affinity / horizontal-tier
+    series — zeros from the first scrape, every outcome label
+    pre-registered."""
+    monkeypatch.setenv('SKYTPU_SERVE_DIR', str(tmp_path / 'serve'))
+    from skypilot_tpu import telemetry
+    from skypilot_tpu.serve.load_balancer import SkyServeLoadBalancer
+    registry_lib.reset_registry()
+    try:
+        SkyServeLoadBalancer(controller_url='http://127.0.0.1:1',
+                             port=1, policy_name='prefix_affinity',
+                             lb_id='lb-telemetry')
+        prom = telemetry.get_registry().render_prometheus()
+    finally:
+        registry_lib.reset_registry()
+    assert '# TYPE skytpu_lb_affinity_hits_total counter' in prom
+    for outcome in ('hit', 'miss', 'migrated'):
+        assert (f'skytpu_lb_affinity_hits_total{{outcome="{outcome}"}}'
+                ' 0' in prom), outcome
+    assert '# TYPE skytpu_prefix_recompute_tokens_total counter' in prom
+    assert 'skytpu_prefix_recompute_tokens_total 0' in prom
+    assert '# TYPE skytpu_lb_ring_size gauge' in prom
+    # Pre-sync the ring is just this LB — the gauge starts at 0 and is
+    # set on the first successful controller sync.
+    assert 'skytpu_lb_ring_size 0' in prom
+    assert '# TYPE skytpu_lb_handoff_total counter' in prom
+    assert 'skytpu_lb_handoff_total 0' in prom
+
+
 def test_gang_series_registered_at_construction():
     """Round-11 gang stable schema: ``gang.register_metrics()`` alone
     puts every gang series in the registry — zeros from the first
