@@ -1042,6 +1042,69 @@ def audit_digest_export() -> AuditReport:
     return report
 
 
+def audit_fleet_obs() -> AuditReport:
+    """Fleet observability scrape path, audited.
+
+    The fleet plane (telemetry/fleet.py) merges per-replica registry
+    exports and completed-trace summaries on the controller and
+    evaluates SLO burn rates — all of it host-side bookkeeping. The
+    contract: a FULL fleet scrape after EVERY wave (registry
+    ``export_wire()`` + trace-buffer drain + ``FleetAggregator``
+    ingest + burn evaluation + a prometheus render, far hotter than
+    the real probe cadence) adds zero unsanctioned d2h transfers and
+    zero jit-cache growth to the engine hot loop. Every scrape must
+    also land series in the aggregator and drain at least one
+    completed trace — an empty scrape means the registry or the
+    trace-buffer wiring regressed, recorded as a compile-count
+    mismatch so it fails ``ok()`` loudly."""
+    from skypilot_tpu.telemetry import clock as clock_lib
+    from skypilot_tpu.telemetry import fleet as fleet_lib
+    from skypilot_tpu.telemetry import registry as registry_lib
+    from skypilot_tpu.telemetry import tracing
+    report = AuditReport(
+        name='fleet observability scrape (registry+trace -> aggregator)')
+    engine = _tiny_engine('paged', chunked=True, telemetry=True)
+    prompts = [[1, 2, 3] * 9, [4, 5] * 10, [7] * 21]
+    _drive(engine, prompts)                       # warmup: compiles
+    capture: Dict[str, Any] = {}
+    inner = _record_static_keys(engine, report, capture)
+    decode_jits = _jit_fns(inner)
+    labels = {'decode': lambda: (sum(_cache_size(f)
+                                     for f in decode_jits)
+                                 if decode_jits else -1),
+              'prefill': lambda: len(engine._prefill_fns)}
+    before = {k: get() for k, get in labels.items()}
+    agg = fleet_lib.FleetAggregator(
+        clock=clock_lib.now,
+        slos=[fleet_lib.TierSLO(tier='latency', ttft_ms=2000.0,
+                                target=0.99)])
+    reg = registry_lib.get_registry()
+    buf = tracing.get_trace_buffer()
+    cursor = len(buf.snapshot())    # other presets' traces: skip them
+    rounds = 2
+    good_scrapes = 0
+    with intercept_host_transfers(report.transfers):
+        for _ in range(rounds):
+            _drive(engine, prompts)
+            cursor, traces = buf.summaries_since(cursor)
+            wire = reg.export_wire()
+            agg.ingest('audit-replica', {
+                'clock': {'wall': clock_lib.now()},
+                'registry': wire, 'traces': traces})
+            rendered = agg.render_prometheus()
+            if wire and traces and rendered:
+                good_scrapes += 1
+    engine._decode_fn = inner
+    report.compile_counts = {
+        k: (before[k], get()) for k, get in labels.items()}
+    report.compile_counts['scrapes ingesting series+traces'] = (
+        rounds, good_scrapes)
+    report.compile_counts['aggregator sources'] = (
+        1, agg.source_count())
+    _attach_costs(report, engine, inner, capture)
+    return report
+
+
 PRESETS: Dict[str, Callable[[], AuditReport]] = {
     'slot': lambda: audit_engine('slot', chunked=True),
     'slot-monolithic': lambda: audit_engine('slot', chunked=False),
@@ -1124,6 +1187,12 @@ PRESETS: Dict[str, Callable[[], AuditReport]] = {
     # jit-cache growth (host-side heat tracker only), and every scrape
     # returns entries.
     'digest': audit_digest_export,
+    # Fleet observability plane: a full controller-style scrape
+    # (registry export + trace drain + aggregator ingest + SLO burn
+    # eval + prometheus render) after every wave adds zero
+    # unsanctioned d2h and zero jit-cache growth, and every scrape
+    # lands series AND completed traces in the aggregator.
+    'fleet-obs': audit_fleet_obs,
     'llama': audit_llama_forward,
 }
 
@@ -1141,7 +1210,7 @@ DEFAULT_PRESETS: List[str] = [
     'kv-int8', 'kv-int8-slot', 'kv-int4', 'kv-int4-slot',
     'fused-attn', 'paged-tp', 'paged-tp-int8',
     'paged-gang', 'disagg', 'int4', 'multistep', 'int4-multistep',
-    'spec-multistep', 'digest', 'llama']
+    'spec-multistep', 'digest', 'fleet-obs', 'llama']
 
 
 def run_preset(name: str) -> AuditReport:

@@ -87,6 +87,13 @@ Concurrency / control-plane hygiene (GC1xx):
   because no fault was injected, which is the exact false confidence
   the fault subsystem exists to kill. Applies under ``serve/``
   (every injector hook lives there).
+- **GC123 untraced-outbound-http** — a body-carrying
+  ``urllib.request.Request``/``urlopen`` under ``serve/`` outside the
+  trace-propagating helper (``serve/wire.py``). Every outbound hop
+  that carries a request body (LB dispatch, KV ingest, gang sync,
+  idempotency handoff) must ride the wire helpers so the
+  ``X-Skytpu-Trace`` header survives the hop; read-only GETs and
+  liveness probes (scope name mentions ``probe``) are exempt.
 
 TPU hot-path hygiene (GC2xx), applied to the compute layer
 (``inference/``, ``models/``, ``ops/``, ``train/``):
@@ -262,6 +269,14 @@ RULES: Dict[str, str] = {
              'unbounded session/replica churn, so every runtime map '
              'goes through BoundedStore (TTL + LRU cap, evictions '
              'counted); wholesale reassignment stays legal',
+    'GC123': 'untraced-outbound-http: body-carrying urllib '
+             'Request/urlopen under serve/ outside serve/wire.py — a '
+             'raw POST drops the X-Skytpu-Trace context at that hop '
+             'and the assembled fleet trace gets a hole exactly where '
+             'the cross-process leg happened; route body-carrying '
+             'calls through the wire helpers (build_request / '
+             'post_json / post_bytes). Read-only GETs and liveness '
+             'probes are exempt',
     'GC201': 'impure-jit: impure or host-synchronizing call inside a '
              '@jax.jit body',
     'GC202': 'host-sync: device->host readback outside the '
@@ -462,6 +477,20 @@ _GC122_EXEMPT_SCOPE_MARKERS = ('BoundedStore',)
 _GC122_GROW_METHODS = {'append', 'appendleft', 'add', 'setdefault',
                        'update', 'extend', 'insert'}
 
+# --------------------------------------------------------------------- GC123
+# The trace-propagating outbound-HTTP helper (serve/wire.py) stamps
+# X-Skytpu-Trace on every body-carrying hop (dispatch, KV ingest,
+# gang sync, idempotency handoff, controller nudges). A raw
+# urllib Request/urlopen WITH a body under serve/ silently drops the
+# trace context at that hop — the assembled fleet trace then has a
+# hole exactly where the interesting cross-process leg happened.
+# Read-only GETs (no body: metrics scrapes, checkpoint exports) and
+# liveness probes carry no causal payload and stay on urllib.
+WIRE_HELPER_SUFFIX = 'serve/wire.py'
+_GC123_HTTP_CALLS = {'urllib.request.urlopen', 'urlopen',
+                     'urllib.request.Request', 'request.Request'}
+_GC123_EXEMPT_SCOPE_MARKERS = ('probe',)
+
 # --------------------------------------------------------------------- GC118
 # The central fault-site registry, resolved lazily (the faults module
 # imports telemetry; pulling it at import time would make the linter's
@@ -644,7 +673,8 @@ class _Checker(ast.NodeVisitor):
                  is_gang_path: bool = False,
                  is_sim_path: bool = False,
                  is_lifecycle_path: bool = False,
-                 is_lb_policy_path: bool = False):
+                 is_lb_policy_path: bool = False,
+                 is_wire_helper: bool = False):
         self.rel = rel
         self.lines = lines
         self.is_compute = is_compute
@@ -658,6 +688,7 @@ class _Checker(ast.NodeVisitor):
         self.is_sim_path = is_sim_path
         self.is_lifecycle_path = is_lifecycle_path
         self.is_lb_policy_path = is_lb_policy_path
+        self.is_wire_helper = is_wire_helper
         self._flagged_sleeps: Set[int] = set()   # node ids (GC112 dedupe)
         # Aliased time-module spellings seen in this file:
         # ``import time as t`` -> {'t': 'time'};
@@ -971,6 +1002,8 @@ class _Checker(ast.NodeVisitor):
             self._check_gang_join(node, name, method)
         if self.is_serve and method == 'fire':
             self._check_fault_site(node)
+        if self.is_serve and not self.is_wire_helper:
+            self._check_untraced_http(node, name)
         if self.is_lifecycle_path:
             self._check_lifecycle_write(node, name, method)
         if self.is_lb_policy_path:
@@ -1273,6 +1306,36 @@ class _Checker(ast.NodeVisitor):
                   'unregistered site); register the site or fix the '
                   'spelling')
 
+    def _check_untraced_http(self, node: ast.Call, name: str) -> None:
+        """GC123: a body-carrying ``urllib`` Request/urlopen under
+        ``serve/`` outside the wire helper. The body is what makes it
+        a causal hop (dispatch, ingest, sync, handoff) — exactly the
+        hops whose missing ``X-Skytpu-Trace`` header leaves a hole in
+        the assembled fleet trace. Liveness probes (scope mentions
+        'probe') and body-less calls (metrics GETs, checkpoint
+        exports) are exempt."""
+        if name not in _GC123_HTTP_CALLS:
+            return
+        data: Optional[ast.AST] = None
+        if len(node.args) >= 2:
+            data = node.args[1]
+        for kw in node.keywords:
+            if kw.arg == 'data':
+                data = kw.value
+        if data is None or (isinstance(data, ast.Constant)
+                            and data.value is None):
+            return
+        if any(m in s.lower() for s in self._scope
+               for m in _GC123_EXEMPT_SCOPE_MARKERS):
+            return
+        short = name.rsplit('.', 1)[-1]
+        self._add('GC123', node,
+                  f'body-carrying {short}() under serve/ bypasses the '
+                  'trace-propagating wire helper — the X-Skytpu-Trace '
+                  'header is dropped at this hop and the assembled '
+                  'fleet trace gets a hole here; use serve/wire.py '
+                  '(build_request / post_json / post_bytes)')
+
     def _check_lifecycle_write(self, node: ast.Call, name: str,
                                method: str) -> None:
         """GC120: a lifecycle-state mutation (replica row / journal op
@@ -1502,7 +1565,9 @@ def check_source(rel: str, source: str) -> List[Violation]:
                        is_lifecycle_path=norm.endswith(
                            LIFECYCLE_PATH_SUFFIXES),
                        is_lb_policy_path=norm.endswith(
-                           LB_POLICY_PATH_SUFFIXES))
+                           LB_POLICY_PATH_SUFFIXES),
+                       is_wire_helper=norm.endswith(
+                           WIRE_HELPER_SUFFIX))
     checker.visit(tree)
     suppressed = _line_suppressions(source)
     out = []

@@ -796,21 +796,52 @@ def telemetry():
 @click.option('--debug-requests', is_flag=True,
               help='With --url: dump /debug/requests (completed '
                    'request span timelines) instead of /metrics.')
+@click.option('--fleet', 'fleet_view', is_flag=True,
+              help='With --url (a controller): dump the aggregated '
+                   'fleet plane (GET /fleet/metrics) instead of the '
+                   'per-process /metrics.')
+@click.option('--trace', 'trace_id', default=None, metavar='TRACE_ID',
+              help='With --url (a controller): dump one assembled '
+                   'cross-process trace (GET /fleet/trace/<id>); '
+                   'combine with --chrome-trace PATH to write it as a '
+                   'chrome://tracing file instead.')
 @click.option('--chrome-trace', default=None, metavar='PATH',
               help='Also export this process\'s completed request '
-                   'traces as a chrome://tracing file.')
-def telemetry_dump(url, fmt, debug_requests, chrome_trace):
+                   'traces as a chrome://tracing file (or, with '
+                   '--trace, the fetched fleet trace).')
+def telemetry_dump(url, fmt, debug_requests, fleet_view, trace_id,
+                   chrome_trace):
     """Dump telemetry: the local process registry, or a remote
-    server's /metrics or /debug/requests."""
+    server's /metrics, /debug/requests, or a controller's fleet
+    plane (/fleet/metrics, /fleet/trace/<id>)."""
     import urllib.request
 
     from skypilot_tpu import telemetry as telemetry_lib
     if debug_requests and not url:
         raise click.UsageError('--debug-requests requires --url')
+    if (fleet_view or trace_id) and not url:
+        raise click.UsageError('--fleet/--trace require --url '
+                               '(a controller URL)')
     if url:
         base = url.rstrip('/')
+        if trace_id:
+            suffix = '?format=chrome' if chrome_trace else ''
+            with urllib.request.urlopen(
+                    f'{base}/fleet/trace/{trace_id}{suffix}',
+                    timeout=10) as r:
+                body = r.read().decode()
+            if chrome_trace:
+                with open(chrome_trace, 'w', encoding='utf-8') as f:
+                    f.write(body)
+                click.echo(f'chrome trace: {chrome_trace}')
+            else:
+                click.echo(body)
+            return
         if debug_requests:
             path = '/debug/requests'
+        elif fleet_view:
+            path = ('/fleet/metrics?format=json' if fmt == 'json'
+                    else '/fleet/metrics')
         elif fmt == 'json':
             path = '/metrics?format=json'
         else:
@@ -827,6 +858,101 @@ def telemetry_dump(url, fmt, debug_requests, chrome_trace):
     if chrome_trace:
         out = telemetry_lib.export_chrome_trace(chrome_trace)
         click.echo(f'chrome trace: {out or "no completed traces"}')
+
+
+# ---------------------------------------------------------------- fleet
+@cli.group()
+def fleet():
+    """Fleet observability plane: aggregated metrics, SLO burn rates,
+    and assembled cross-process request traces from a controller."""
+
+
+def _fleet_get(url: str, path: str):
+    import json as json_lib
+    import urllib.request
+    with urllib.request.urlopen(url.rstrip('/') + path,
+                                timeout=10) as r:
+        return json_lib.loads(r.read().decode())
+
+
+_CONTROLLER_URL_OPT = click.option(
+    '--url', required=True, metavar='http://HOST:PORT',
+    help='Controller URL (the process serving /fleet/metrics).')
+
+
+@fleet.command(name='top')
+@_CONTROLLER_URL_OPT
+def fleet_top(url):
+    """Fleet at a glance: scraped sources, per-tier traffic and
+    latency, SLO attainment and burn."""
+    data = _fleet_get(url, '/fleet/metrics?format=json')
+
+    def gauge(name, default=0.0):
+        series = (data.get(name) or {}).get('series') or []
+        return series[0].get('value', default) if series else default
+
+    click.echo(f'sources   {int(gauge("skytpu_fleet_sources"))}')
+    click.echo(f'scrapes   '
+               f'{int(gauge("skytpu_fleet_scrapes_total"))}')
+    click.echo(f'traces    {int(gauge("skytpu_fleet_traces"))}')
+    rows = []
+    for entry in (data.get('skytpu_request_ttft_ms') or {}) \
+            .get('series') or []:
+        tier = (entry.get('labels') or {}).get('tier', '-')
+        count = int(entry.get('count', 0))
+        mean = entry.get('sum', 0.0) / count if count else 0.0
+        rows.append((tier, count, mean))
+    if rows:
+        click.echo(f'{"TIER":12s} {"REQUESTS":>10s} '
+                   f'{"TTFT_MEAN_MS":>13s}')
+        for tier, count, mean in sorted(rows):
+            click.echo(f'{tier:12s} {count:10d} {mean:13.1f}')
+    slo = data.get('_slo') or {}
+    for tier, vals in sorted(slo.items()):
+        burns = ' '.join(
+            f'burn_{k.split("_", 1)[1]}={v:.2f}'
+            for k, v in sorted(vals.items()) if k.startswith('burn_'))
+        click.echo(f'slo {tier:12s} '
+                   f'attainment={vals.get("attainment", 1.0):.4f} '
+                   f'{burns}')
+
+
+@fleet.command(name='slo')
+@_CONTROLLER_URL_OPT
+def fleet_slo(url):
+    """Per-tier SLO burn rates and attainment, as JSON."""
+    import json as json_lib
+    data = _fleet_get(url, '/fleet/metrics?format=json')
+    click.echo(json_lib.dumps(data.get('_slo') or {}, indent=2))
+
+
+@fleet.command(name='trace')
+@_CONTROLLER_URL_OPT
+@click.argument('trace_id', required=False)
+@click.option('--chrome', default=None, metavar='PATH',
+              help='Write the assembled trace as a chrome://tracing '
+                   'file instead of printing JSON.')
+def fleet_trace(url, trace_id, chrome):
+    """Show one assembled multi-process trace (or, with no TRACE_ID,
+    list the ids the controller holds)."""
+    import json as json_lib
+    if not trace_id:
+        data = _fleet_get(url, '/fleet/traces')
+        for tid in data.get('traces') or []:
+            click.echo(tid)
+        return
+    suffix = '?format=chrome' if chrome else ''
+    try:
+        data = _fleet_get(url, f'/fleet/trace/{trace_id}{suffix}')
+    except Exception as e:  # urllib HTTPError on unknown id
+        raise click.ClickException(
+            f'trace {trace_id!r} not found at {url}: {e}')
+    if chrome:
+        with open(chrome, 'w', encoding='utf-8') as f:
+            json_lib.dump(data, f)
+        click.echo(f'chrome trace: {chrome}')
+        return
+    click.echo(json_lib.dumps(data, indent=2))
 
 
 # ------------------------------------------------------------------- lb

@@ -480,6 +480,25 @@ class _EngineBase:
         self._queue.append(req)
         return req.request_id
 
+    def adopt_trace_context(self, request_id: int,
+                            trace_id: Optional[str] = None,
+                            parent_span: Optional[str] = None
+                            ) -> Optional[str]:
+        """Join a queued/running request to a wire-supplied trace
+        context (the LB's ``X-Skytpu-Trace`` hop header). Returns the
+        request's effective 128-bit trace id — locally minted when no
+        wire context arrived — or None when the request is unknown or
+        telemetry is off. Caller holds the engine lock (same contract
+        as ``add_request``)."""
+        for req in list(self._queue) + [r for r in self._slots
+                                        if r is not None]:
+            if req.request_id == request_id:
+                if req.trace is None:
+                    return None
+                req.trace.adopt_wire_context(trace_id, parent_span)
+                return req.trace.trace_id
+        return None
+
     def _validate_request(self, prompt: List[int],
                           max_new_tokens: int) -> None:
         if len(prompt) + max_new_tokens > self.max_seq:
@@ -979,7 +998,13 @@ class _EngineBase:
         req.first_token_time = req.submit_time
         req._enq_out = len(req.output)
         if self.telemetry_enabled:
-            req.trace = tracing.RequestTrace(self._next_id)
+            # A handoff continuation JOINS the fleet-wide trace the
+            # prefill worker started (the /kv/ingest hop carries
+            # X-Skytpu-Trace; the server parks it in snap['trace']).
+            ctx = snap.get('trace') or {}
+            req.trace = tracing.RequestTrace(
+                self._next_id, trace_id=ctx.get('trace_id'),
+                parent_span=ctx.get('parent_span'))
             req.trace.begin('decode', handoff=True,
                             context_tokens=len(req.prompt)
                             + len(req.output))

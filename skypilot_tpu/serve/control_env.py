@@ -209,23 +209,21 @@ class LiveControlPlaneEnv(ControlPlaneEnv):
     # ---------------------------------------------------------------- HTTP
     def http_json(self, url: str, payload: Optional[Dict[str, Any]] = None,
                   timeout: float = 10.0) -> Any:
-        import urllib.request
+        # Body-carrying control-plane hops ride the trace-propagating
+        # helper (graftcheck GC123); plain GETs read through it too so
+        # the control plane has ONE outbound HTTP seam.
+        from skypilot_tpu.serve import wire
         if payload is None:
-            req = urllib.request.Request(url)
-        else:
-            req = urllib.request.Request(
-                url, data=json.dumps(payload).encode(),
-                headers={'Content-Type': 'application/json'})
-        with urllib.request.urlopen(req, timeout=timeout) as resp:
-            return json.loads(resp.read())
+            return wire.get_json(url, timeout=timeout)
+        return wire.post_json(url, payload, timeout=timeout)
 
     def http_post_bytes(self, url: str, data: bytes,
                         content_type: str = 'application/octet-stream',
                         timeout: float = 30.0) -> bytes:
-        import urllib.request
-        req = urllib.request.Request(
-            url, data=data, headers={'Content-Type': content_type})
-        with urllib.request.urlopen(req, timeout=timeout) as resp:
+        from skypilot_tpu.serve import wire
+        with wire.urlopen(url, data=data,
+                          headers={'Content-Type': content_type},
+                          timeout=timeout) as resp:
             return resp.read()
 
     def probe_http(self, url: str, post_data: Optional[Dict[str, Any]],

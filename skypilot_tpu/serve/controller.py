@@ -15,6 +15,7 @@ import json
 import os
 import threading
 from typing import Any, Dict, List, Optional
+import urllib.parse
 
 from skypilot_tpu import tpu_logging
 from skypilot_tpu.serve import autoscalers
@@ -22,6 +23,7 @@ from skypilot_tpu.serve import control_env
 from skypilot_tpu.serve import replica_managers
 from skypilot_tpu.serve import serve_state
 from skypilot_tpu.serve.service_spec import SkyServiceSpec
+from skypilot_tpu.telemetry import fleet as fleet_lib
 
 logger = tpu_logging.init_logger(__name__)
 
@@ -51,6 +53,16 @@ class ServeController:
             env=self._env)
         self.autoscaler = autoscalers.Autoscaler.from_spec(
             spec, clock=self._env.time)
+        # Fleet telemetry plane: merged per-replica metrics, assembled
+        # cross-process traces, and SLO burn-rate accounting — fed on
+        # the probe path (replica scrapes) and the LB sync body, and
+        # clocked through the env seam so the simulator drives the
+        # identical aggregation code on its virtual clock.
+        self.fleet = fleet_lib.FleetAggregator(
+            clock=self._env.time,
+            slos=fleet_lib.slos_from_config(
+                getattr(spec, 'slos', None)))
+        self.replica_manager.set_telemetry_sink(self.fleet.ingest)
         self._stop = threading.Event()      # stops the autoscaler loop
         self._done = threading.Event()      # teardown fully finished
         self._httpd: Optional[http.server.ThreadingHTTPServer] = None
@@ -229,6 +241,8 @@ class ServeController:
         self.replica_manager.update_version(spec, record['task_config'],
                                             version)
         self.autoscaler.update_spec(spec, version)
+        self.fleet.set_slos(fleet_lib.slos_from_config(
+            getattr(spec, 'slos', None)))
         logger.info(f'Service {self.service_name} updated to v{version}.')
 
     def _update_service_status(self) -> None:
@@ -307,10 +321,45 @@ class ServeController:
                 self.wfile.write(body)
 
             def do_GET(self):  # noqa: N802
-                if self.path == '/controller/ready':
+                parsed = urllib.parse.urlparse(self.path)
+                query = urllib.parse.parse_qs(parsed.query)
+                if parsed.path == '/controller/ready':
                     self._json(200, {'ready': True})
-                elif self.path == '/controller/status':
+                elif parsed.path == '/controller/status':
                     self._json(200, controller.status_payload())
+                elif parsed.path == '/fleet/metrics':
+                    if query.get('format', [''])[0] == 'json':
+                        self._json(200, controller.fleet.render_json())
+                        return
+                    body = (controller.fleet.render_prometheus()
+                            .encode())
+                    self.send_response(200)
+                    self.send_header(
+                        'Content-Type',
+                        'text/plain; version=0.0.4; charset=utf-8')
+                    self.send_header('Content-Length', str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+                elif parsed.path == '/fleet/traces':
+                    self._json(200,
+                               {'traces': controller.fleet.trace_ids()})
+                elif parsed.path.startswith('/fleet/trace/'):
+                    tid = parsed.path[len('/fleet/trace/'):]
+                    if query.get('format', [''])[0] == 'chrome':
+                        events = controller.fleet.chrome_events(tid)
+                        if events is None:
+                            self._json(404, {'error':
+                                             f'trace {tid!r} unknown'})
+                            return
+                        self._json(200, {'traceEvents': events,
+                                         'displayTimeUnit': 'ms'})
+                        return
+                    assembled = controller.fleet.assemble_trace(tid)
+                    if assembled is None:
+                        self._json(404,
+                                   {'error': f'trace {tid!r} unknown'})
+                        return
+                    self._json(200, assembled)
                 else:
                     self._json(404, {'error': f'no route {self.path}'})
 
@@ -328,7 +377,17 @@ class ServeController:
                     # arrival series next to the 'all' series.
                     controller.autoscaler.collect_request_information(
                         ts, payload.get('request_tiers'))
+                    # The LB piggybacks its completed trace legs (and
+                    # its clock, for skew accounting) on the sync it
+                    # already makes.
+                    tel = payload.get('telemetry')
+                    if isinstance(tel, dict):
+                        controller.fleet.ingest(
+                            str(payload.get('lb_id') or 'lb'), tel)
                     self._json(200, {
+                        # Per-tier SLO burn/attainment: LBs surface it
+                        # next to their own health gauges.
+                        'slo': controller.fleet.slo_status(),
                         'ready_replica_urls':
                             controller.replica_manager.ready_urls(),
                         # Retry-After hint for the LB's own 503 while
@@ -391,6 +450,7 @@ class ServeController:
             'target_num_replicas': self.autoscaler.target_num_replicas,
             'autoscaler': type(self.autoscaler).__name__,
             'replica_parallelism': par,
+            'slo': self.fleet.slo_status(),
             'replicas': [{
                 'replica_id': i.replica_id,
                 'cluster_name': i.cluster_name,

@@ -66,11 +66,11 @@ import os
 import random
 import threading
 import time
-import urllib.request
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
 from skypilot_tpu import telemetry
 from skypilot_tpu import tpu_logging
+from skypilot_tpu.serve import wire
 
 logger = tpu_logging.init_logger(__name__)
 
@@ -533,13 +533,8 @@ class GangFollower:
             'finished': {str(r): d
                          for r, d in self._new_finished.items()},
         }
-        req = urllib.request.Request(
-            self.spec.coordinator + '/gang/sync',
-            data=json.dumps(payload).encode(),
-            headers={'Content-Type': 'application/json'})
-        with urllib.request.urlopen(
-                req, timeout=_SYNC_HTTP_TIMEOUT) as resp:
-            out = json.loads(resp.read())
+        out = wire.post_json(self.spec.coordinator + '/gang/sync',
+                             payload, timeout=_SYNC_HTTP_TIMEOUT)
         self._new_finished.clear()
         self._acks.clear()        # delivered; coordinator recorded them
         return out
@@ -568,6 +563,13 @@ class GangFollower:
                     f'rank {self.spec.rank} assigned request id {rid} '
                     f'where leader assigned {op["rid"]} — engine call '
                     'streams diverged')
+            if op.get('trace_id') and hasattr(self.engine,
+                                              'adopt_trace_context'):
+                # Follower spans join the leader's fleet trace: the
+                # op log is a replicated hop, tagged as such.
+                self.engine.adopt_trace_context(
+                    rid, trace_id=op['trace_id'],
+                    parent_span='gang_oplog:rank0')
         elif k == 'step':
             self._note_events(self.engine.follower_step(
                 op.get('h', 1), prepared=op.get('prepared', False)))

@@ -64,6 +64,7 @@ from __future__ import annotations
 import http.server
 import json
 import os
+import random
 import threading
 import time
 from typing import Any, Dict, List, Optional, Set
@@ -77,6 +78,8 @@ from skypilot_tpu import tpu_logging
 from skypilot_tpu.serve import faults as faults_lib
 from skypilot_tpu.serve import lb_ring
 from skypilot_tpu.serve import load_balancing_policies as lb_policies
+from skypilot_tpu.serve import wire
+from skypilot_tpu.telemetry import tracing
 
 logger = tpu_logging.init_logger(__name__)
 
@@ -276,6 +279,21 @@ class SkyServeLoadBalancer:
         # probe sweeps and the replica view carries it for health
         # accounting.
         self._replica_gangs: Dict[str, Any] = {}
+        # Fleet tracing: the LB mints the 128-bit trace id for every
+        # request that arrives without a client-supplied
+        # ``X-Skytpu-Trace`` (seedable for sim determinism), records
+        # its own hop legs (dispatch, retry, migration — with cause)
+        # in a PRIVATE buffer, and ships completed legs to the
+        # controller on the sync path. The buffer is private rather
+        # than the process-global one so an in-process replica's
+        # traces are never double-shipped through the LB.
+        seed = os.environ.get('SKYTPU_TRACE_SEED')
+        self._trace_rng = random.Random(int(seed)) if seed else None
+        self._trace_buf = tracing.TraceBuffer()
+        self._trace_cursor = 0
+        # Controller-computed per-tier SLO burn/attainment (refreshed
+        # on every sync; single-writer sync loop).
+        self._last_slo: Dict[str, Any] = {}
 
     # ------------------------------------------------------------- sync
     def _sync_once(self) -> None:
@@ -283,16 +301,26 @@ class SkyServeLoadBalancer:
             timestamps, self._request_timestamps = \
                 self._request_timestamps, []
             tiers, self._request_tiers = self._request_tiers, []
-        body = json.dumps({'request_timestamps': timestamps,
-                           'request_tiers': tiers,
-                           'lb_id': self.lb_id,
-                           'lb_url': self.advertise_url}).encode()
-        req = urllib.request.Request(
-            self.controller_url + '/controller/load_balancer_sync',
-            data=body, headers={'Content-Type': 'application/json'})
+        # Piggyback the LB's completed trace legs (and its clock, for
+        # controller-side skew accounting) on the sync it already
+        # makes. The cursor advances optimistically: a failed sync
+        # drops that batch (at-most-once) rather than duplicating
+        # legs in the controller's trace store on the retry.
+        self._trace_cursor, lb_traces = \
+            self._trace_buf.summaries_since(self._trace_cursor)
+        sync_body = {'request_timestamps': timestamps,
+                     'request_tiers': tiers,
+                     'lb_id': self.lb_id,
+                     'lb_url': self.advertise_url,
+                     'telemetry': {
+                         'clock': {'wall': time.time(),
+                                   'monotonic': time.monotonic()},
+                         'traces': lb_traces,
+                     }}
         try:
-            with urllib.request.urlopen(req, timeout=5) as resp:
-                payload = json.loads(resp.read())
+            payload = wire.post_json(
+                self.controller_url + '/controller/load_balancer_sync',
+                sync_body, timeout=5)
             self._last_sync_ok = time.monotonic()
             self._g_sync_age.set(0.0)
             self._g_ctrl_up.set(1)
@@ -308,6 +336,9 @@ class SkyServeLoadBalancer:
             self._last_ready = list(
                 payload.get('ready_replica_urls', []))
             self._apply_ready_urls()
+            # Fleet SLO view (per-tier burn/attainment), computed
+            # controller-side; surfaced in the LB's replica view.
+            self._last_slo = payload.get('slo') or {}
             hint = payload.get('retry_after_s')
             if hint:
                 self._retry_after_hint = max(1, int(hint))
@@ -434,12 +465,8 @@ class SkyServeLoadBalancer:
                         f'{src}/kv/prefix/export?hash={chain_hash}',
                         timeout=30) as resp:
                     blob = resp.read()
-                req = urllib.request.Request(
-                    dst + '/kv/warmup', data=blob,
-                    headers={'Content-Type':
-                             'application/octet-stream'})
-                with urllib.request.urlopen(req, timeout=30) as resp:
-                    landed = json.loads(resp.read())
+                landed = wire.post_bytes(dst + '/kv/warmup', blob,
+                                         timeout=30)
                 logger.info(
                     f'migrated prefix {chain_hash[:12]} '
                     f'({n_tokens} token(s)) {src} -> {dst}: '
@@ -451,11 +478,13 @@ class SkyServeLoadBalancer:
         threading.Thread(target=_ship, daemon=True).start()
         return True
 
-    def record_completed_key(self, key: str,
-                             replica_url: str) -> None:
+    def record_completed_key(self, key: str, replica_url: str,
+                             trace: Optional[str] = None) -> None:
         """Record which replica answered ``key`` — locally, and at the
         key's ring owner when that is a peer (fire-and-forget push;
-        the authoritative dedupe stays replica-side)."""
+        the authoritative dedupe stays replica-side). ``trace`` is the
+        answering request's wire trace header: the LB↔LB handoff is a
+        hop of that request's fleet trace."""
         with self._completed_lock:
             self._completed.put(key, replica_url)
         owner, owner_url = self._ring.owner_url(key)
@@ -464,13 +493,9 @@ class SkyServeLoadBalancer:
 
         def _push() -> None:
             try:
-                body = json.dumps({'key': key,
-                                   'url': replica_url}).encode()
-                req = urllib.request.Request(
-                    owner_url + '/lb/idempotency', data=body,
-                    headers={'Content-Type': 'application/json'})
-                with urllib.request.urlopen(req, timeout=5):
-                    pass
+                wire.post_json(owner_url + '/lb/idempotency',
+                               {'key': key, 'url': replica_url},
+                               timeout=5, trace=trace)
             except Exception as e:  # pylint: disable=broad-except
                 logger.debug(
                     f'idempotency push for {key} to {owner} failed: '
@@ -648,10 +673,11 @@ class SkyServeLoadBalancer:
                             # drain deadline): migrate, don't forward.
                             logger.warning(
                                 f'upstream stream error: {ev["error"]}')
-                            if (info is not None
-                                    and ev.get('failed_upstream')):
-                                info['failed_upstream'] = \
-                                    str(ev['failed_upstream'])
+                            if info is not None:
+                                info['error'] = str(ev['error'])
+                                if ev.get('failed_upstream'):
+                                    info['failed_upstream'] = \
+                                        str(ev['failed_upstream'])
                             return False
                         if ev.get('done'):
                             done = dict(ev)
@@ -722,6 +748,17 @@ class SkyServeLoadBalancer:
                                 lb._m_migrated['completed'].inc()
                             return
                         failed = info.get('failed_upstream')
+                        # Cause-tagged migration leg for the fleet
+                        # trace: WHY this request left its replica.
+                        err_text = info.get('error', '')
+                        if failed:
+                            cause = 'decode_worker_dead'
+                        elif info.get('transport_break'):
+                            cause = 'replica_crash'
+                        elif 'nan' in err_text.lower():
+                            cause = 'nan_evicted'
+                        else:
+                            cause = 'replica_error'
                         if failed:
                             # A disagg prefill relay reported its
                             # DECODE worker dead: exclude that worker,
@@ -746,8 +783,19 @@ class SkyServeLoadBalancer:
                             except OSError:
                                 pass    # already dead — that's the point
                             own_leg = None
+                        trace = getattr(self, '_lb_trace', None)
+                        mig = (trace.begin('lb_migrate', cause=cause,
+                                           src=cur_url,
+                                           tokens_so_far=len(tokens))
+                               if trace is not None else None)
                         own_leg, cur_url = self._open_continuation(
                             payload, tokens, headers, tried)
+                        if mig is not None:
+                            mig.meta['dst'] = cur_url
+                            mig.meta['outcome'] = (
+                                'resumed' if own_leg is not None
+                                else 'exhausted')
+                            trace.end('lb_migrate')
                         if own_leg is None:
                             # Budget already exhausted -> the request IS
                             # complete; otherwise: every replica failed.
@@ -823,11 +871,14 @@ class SkyServeLoadBalancer:
                     else:
                         headers = {k: v for k, v in headers.items()
                                    if k.lower() != 'x-handoff-target'}
-                    req = urllib.request.Request(
+                    # ``headers`` already carries the request's
+                    # X-Skytpu-Trace (stamped once in _proxy): the
+                    # continuation leg joins the same fleet trace.
+                    req = wire.build_request(
                         nxt + '/generate', data=body, headers=headers,
                         method='POST')
                     try:
-                        leg = urllib.request.urlopen(req, timeout=120)
+                        leg = wire.urlopen(req, timeout=120)
                     except Exception as e:  # pylint: disable=broad-except
                         logger.warning(
                             f'continuation on {nxt} failed '
@@ -843,6 +894,31 @@ class SkyServeLoadBalancer:
                     return leg, nxt
 
             def _proxy(self, method: str) -> None:
+                """Trace-owning wrapper: every proxied request runs
+                under a fleet trace — adopted from a client-supplied
+                ``X-Skytpu-Trace`` or minted here (the LB is the trace
+                root for ordinary clients). The LB's own hop legs
+                (dispatch, retries, cause-tagged migrations) complete
+                into the private buffer and ship on the next
+                controller sync."""
+                ctx = tracing.parse_trace_header(
+                    self.headers.get(wire.TRACE_HEADER))
+                tid = (ctx['trace_id'] if ctx
+                       else tracing.mint_trace_id(lb._trace_rng))
+                trace = tracing.RequestTrace(
+                    0, trace_id=tid,
+                    parent_span=(ctx or {}).get('parent_span'))
+                trace.begin('lb_proxy', lb=lb.lb_id, path=self.path,
+                            method=method)
+                self._lb_trace = trace
+                try:
+                    self._proxy_dispatch(method, trace)
+                finally:
+                    self._lb_trace = None
+                    trace.finish()
+                    lb._trace_buf.add(trace)
+
+            def _proxy_dispatch(self, method: str, trace) -> None:
                 t_start = time.monotonic()
                 lb._m_requests.inc()
                 with lb._ts_lock:
@@ -852,7 +928,15 @@ class SkyServeLoadBalancer:
                 length = int(self.headers.get('Content-Length', 0))
                 data = self.rfile.read(length) if length else None
                 headers = {k: v for k, v in self.headers.items()
-                           if k.lower() not in _HOP_HEADERS}
+                           if k.lower() not in _HOP_HEADERS
+                           and k.lower() != wire.TRACE_HEADER.lower()}
+                # Stamp the outbound hop header once: every dispatch
+                # attempt AND every continuation leg opened during
+                # mid-stream recovery reuses this dict, so they all
+                # carry the same fleet trace id with this LB as the
+                # parent hop.
+                headers[wire.TRACE_HEADER] = tracing.format_trace_header(
+                    trace.trace_id, f'lb:{lb.lb_id}')
                 forced_break = False
                 if lb._faults is not None:
                     rule = lb._faults.fire('proxy')
@@ -871,6 +955,8 @@ class SkyServeLoadBalancer:
                 if recover is not None and req_key is None:
                     req_key = uuid.uuid4().hex
                     headers['X-Request-ID'] = req_key
+                if req_key is not None:
+                    trace.meta['request_key'] = req_key
                 # Prefix-affinity context: the prompt's token ids let
                 # the policy hash the page-grid prefix; the request
                 # key pins session stickiness.
@@ -917,10 +1003,11 @@ class SkyServeLoadBalancer:
                             headers['X-Handoff-Target'] = target
                         else:
                             headers.pop('X-Handoff-Target', None)
-                    req = urllib.request.Request(
+                    req = wire.build_request(
                         url + self.path, data=data, headers=headers,
                         method=method)
                     lb.policy.pre_execute(url)
+                    dispatch = trace.begin('lb_dispatch', replica=url)
                     try:
                         if forced_break:
                             # Injected partial_response: the connection
@@ -930,16 +1017,17 @@ class SkyServeLoadBalancer:
                             forced_break = False
                             raise ConnectionResetError(
                                 'injected partial_response')
-                        with urllib.request.urlopen(req,
-                                                    timeout=120) as resp:
+                        with wire.urlopen(req, timeout=120) as resp:
                             ctype = resp.headers.get('Content-Type', '')
                             if ('text/event-stream' in ctype
                                     or 'chunked' in (resp.headers.get(
                                         'Transfer-Encoding') or '')):
                                 responded = True
                                 if req_key is not None:
-                                    lb.record_completed_key(req_key,
-                                                            url)
+                                    lb.record_completed_key(
+                                        req_key, url,
+                                        trace=headers.get(
+                                            wire.TRACE_HEADER))
                                 if (recover is not None
                                         and recover.get('stream')):
                                     self._stream_recover(
@@ -955,7 +1043,9 @@ class SkyServeLoadBalancer:
                             status, rheaders = resp.status, resp.headers
                         responded = True
                         if req_key is not None and status < 300:
-                            lb.record_completed_key(req_key, url)
+                            lb.record_completed_key(
+                                req_key, url,
+                                trace=headers.get(wire.TRACE_HEADER))
                         self.send_response(status)
                         for k, v in rheaders.items():
                             if k.lower() not in _HOP_HEADERS:
@@ -974,6 +1064,7 @@ class SkyServeLoadBalancer:
                             # replica; the last refusal passes through
                             # (with Retry-After) if all of them refuse.
                             last_http = (e.code, body, e.headers)
+                            dispatch.meta['outcome'] = 'refused_503'
                             lb._m_retries.inc()
                             logger.warning(
                                 f'replica {url} refused ({e.code}); '
@@ -1010,6 +1101,9 @@ class SkyServeLoadBalancer:
                             return
                         last_err = e
                         lb._m_retries.inc()
+                        dispatch.meta['outcome'] = (
+                            'connect_failed' if _failed_before_send(e)
+                            else 'replica_crash')
                         if _failed_before_send(e):
                             # Connection-level refusal: the replica
                             # process is gone — out of the LB's own
@@ -1022,6 +1116,7 @@ class SkyServeLoadBalancer:
                             f'({type(e).__name__}: {e}); retrying on '
                             f'another replica')
                     finally:
+                        trace.end('lb_dispatch')
                         lb.policy.post_execute(url)
                 if last_http is not None:
                     self._forward_http_error(*last_http)
@@ -1083,6 +1178,20 @@ class SkyServeLoadBalancer:
                         key = url = None
                     if key and url:
                         lb.accept_completed_key(str(key), str(url))
+                        ctx = tracing.parse_trace_header(
+                            self.headers.get(wire.TRACE_HEADER))
+                        if ctx:
+                            # The LB↔LB handoff is a hop of the
+                            # request's fleet trace: one instant leg
+                            # on the ACCEPTING LB, causally under the
+                            # pushing LB's span.
+                            t = tracing.RequestTrace(
+                                0, trace_id=ctx['trace_id'],
+                                parent_span=ctx.get('parent_span'))
+                            t.instant('lb_handoff_accept',
+                                      lb=lb.lb_id, cause='lb_handoff')
+                            t.finish()
+                            lb._trace_buf.add(t)
                         self._send_json(200, {'recorded': True})
                     else:
                         self._send_json(400, {'error': 'need key+url'})
@@ -1120,6 +1229,9 @@ class SkyServeLoadBalancer:
             'locally_evicted': evicted,
             'replica_parallelism': self._replica_parallelism,
             'replica_roles': dict(self._replica_roles),
+            # Controller-computed per-tier SLO burn/attainment from
+            # the last sync (empty until one succeeds).
+            'slo': dict(self._last_slo),
             # Gang health accounting: follower ranks are not routable
             # endpoints, but their existence and statuses ride the
             # per-gang block under their rank 0's URL.

@@ -31,7 +31,7 @@ import json
 import threading
 import time
 import typing
-from typing import Dict, List, Optional, Sequence
+from typing import Any, Dict, List, Optional, Sequence
 
 from skypilot_tpu import exceptions
 from skypilot_tpu import telemetry
@@ -271,6 +271,14 @@ class ReplicaManager:
         # not yet consumed by the controller; the forecast autoscaler
         # learns its pre-scaling lead time from them.
         self._provision_obs: List[float] = []
+        # Fleet-telemetry scrape hook: the controller installs its
+        # FleetAggregator's ``ingest`` here; after each successful
+        # readiness probe the manager pulls the replica's
+        # ``/telemetry/summary`` (resuming from a per-replica trace
+        # cursor) and feeds it through. Best-effort — a scrape failure
+        # never fails the probe.
+        self._telemetry_sink: Optional[Any] = None
+        self._telemetry_cursors: Dict[str, int] = {}
         reg = telemetry.get_registry()
         self._m_spot_preempt = reg.counter(
             'skytpu_spot_preemptions_total',
@@ -1069,6 +1077,43 @@ class ReplicaManager:
                 return True
         return self._env.cluster_gone(info.cluster_name)
 
+    def set_telemetry_sink(self, sink: Any) -> None:
+        """Install the controller's fleet-telemetry ingest callable:
+        ``sink(source, payload)`` receives each scraped
+        ``/telemetry/summary`` body keyed by the replica's URL."""
+        self._telemetry_sink = sink
+
+    def _scrape_telemetry(self, info: ReplicaInfo) -> None:
+        """Pull one replica's telemetry summary right after a
+        successful readiness probe and hand it to the sink. The
+        per-replica cursor makes completed traces ship at most once;
+        any failure is logged at debug and otherwise ignored — the
+        fleet plane must never destabilize the health plane."""
+        if self._telemetry_sink is None or not info.url:
+            return
+        source = info.url.rstrip('/')
+        since = self._telemetry_cursors.get(source, 0)
+        try:
+            payload = self._env.http_json(
+                f'{source}/telemetry/summary?since={since}',
+                timeout=self.spec.readiness_timeout_seconds)
+        except Exception as e:  # pylint: disable=broad-except
+            logger.debug(f'telemetry scrape of replica '
+                         f'{info.replica_id} failed: '
+                         f'{type(e).__name__}: {e}')
+            return
+        if not isinstance(payload, dict):
+            return
+        cursor = payload.get('cursor')
+        if isinstance(cursor, int):
+            self._telemetry_cursors[source] = cursor
+        try:
+            self._telemetry_sink(source, payload)
+        except Exception as e:  # pylint: disable=broad-except
+            logger.debug(f'telemetry ingest for replica '
+                         f'{info.replica_id} failed: '
+                         f'{type(e).__name__}: {e}')
+
     def probe_all(self) -> None:
         """One probe sweep (reference ``_probe_all_replicas`` ``:1026``)."""
         with self._lock:
@@ -1166,6 +1211,9 @@ class ReplicaManager:
                 # WRONG is quarantined before it can serve a second
                 # wrong response.
                 self._canary_check(info)
+                # Fleet-telemetry scrape rides the probe it just
+                # passed (best-effort: never fails the sweep).
+                self._scrape_telemetry(info)
                 continue
             # Probe failed on a live cluster.
             _probe_counter('failure').inc()

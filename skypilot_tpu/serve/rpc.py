@@ -13,11 +13,11 @@ import random
 import sys
 import time
 from typing import Any, Dict
-import urllib.request
 
 from skypilot_tpu import tpu_logging
 from skypilot_tpu.agent import job_lib as agent_job_lib
 from skypilot_tpu.serve import serve_state
+from skypilot_tpu.serve import wire
 from skypilot_tpu.utils import common_utils
 
 logger = tpu_logging.init_logger(__name__)
@@ -141,11 +141,11 @@ def handle(request: Dict[str, Any]) -> Dict[str, Any]:
         # missed nudge must not be reported as a failed update (a retry
         # would double-bump the version).
         try:
-            req = urllib.request.Request(
-                f'http://127.0.0.1:{svc["controller_port"]}'
-                '/controller/update', data=b'{}',
-                headers={'Content-Type': 'application/json'})
-            with urllib.request.urlopen(req, timeout=10):
+            with wire.urlopen(
+                    f'http://127.0.0.1:{svc["controller_port"]}'
+                    '/controller/update', data=b'{}',
+                    headers={'Content-Type': 'application/json'},
+                    timeout=10):
                 pass
         except Exception as e:  # pylint: disable=broad-except
             logger.debug(f'update nudge to controller failed '
@@ -161,11 +161,11 @@ def handle(request: Dict[str, Any]) -> Dict[str, Any]:
         # removes the service row); fall back to direct removal if the
         # controller is unreachable (e.g. it crashed).
         try:
-            req = urllib.request.Request(
-                f'http://127.0.0.1:{svc["controller_port"]}'
-                '/controller/terminate', data=b'{}',
-                headers={'Content-Type': 'application/json'})
-            with urllib.request.urlopen(req, timeout=10):
+            with wire.urlopen(
+                    f'http://127.0.0.1:{svc["controller_port"]}'
+                    '/controller/terminate', data=b'{}',
+                    headers={'Content-Type': 'application/json'},
+                    timeout=10):
                 pass
             # Wait briefly for the row to disappear (terminate is
             # async). Jittered with mild backoff (graftcheck GC112):

@@ -149,11 +149,13 @@ class ScheduledRequest:
 
     __slots__ = ('tier', 'prompt', 'max_new_tokens', 'sampling', 'seq',
                  'submit_time', 'admit_time', 'outbox', 'request_id',
-                 'result', 'first_token_time', 'cancelled', 'handoff')
+                 'result', 'first_token_time', 'cancelled', 'handoff',
+                 'trace_ctx')
 
     def __init__(self, tier: str, prompt: List[int],
                  max_new_tokens: int, sampling: Dict[str, Any],
-                 seq: int):
+                 seq: int,
+                 trace_ctx: Optional[Dict[str, Any]] = None):
         self.tier = tier
         self.prompt = prompt
         self.max_new_tokens = max_new_tokens
@@ -166,6 +168,13 @@ class ScheduledRequest:
         self.result: Optional[Any] = None
         self.first_token_time: Optional[float] = None
         self.cancelled = False
+        # Wire-supplied trace context ({'trace_id', 'parent_span'}) —
+        # the X-Skytpu-Trace hop header this request arrived with. On
+        # admission the engine's RequestTrace adopts it and the
+        # EFFECTIVE trace id (wire-supplied or locally minted) is
+        # written back here, so downstream hops (KV handoff, gang
+        # op-log) propagate the same fleet-wide id.
+        self.trace_ctx = dict(trace_ctx) if trace_ctx else None
         # Adopted KV-handoff continuation (disaggregated serving): the
         # request was admitted and prefilled on ANOTHER replica, so
         # this replica's TTFT/queue-wait quantiles skip it — a near-
@@ -372,6 +381,7 @@ class RequestScheduler:
 
     def submit(self, prompt: List[int], *, max_new_tokens: int,
                tier: Optional[str] = None,
+               trace_ctx: Optional[Dict[str, Any]] = None,
                **sampling: Any) -> ScheduledRequest:
         """Admission-controlled submit from a handler thread. Returns
         the live :class:`ScheduledRequest` (its outbox streams tokens)
@@ -405,7 +415,8 @@ class RequestScheduler:
                     f'queued work tokens); retry in ~{retry}s')
             self._seq += 1
             sr = ScheduledRequest(tier, list(prompt), max_new_tokens,
-                                  sampling, self._seq)
+                                  sampling, self._seq,
+                                  trace_ctx=trace_ctx)
             self._queues[tier].append(sr)
             self._queued_tokens[tier] += work
         self._wake()
@@ -414,7 +425,9 @@ class RequestScheduler:
     # -------------------------------------------------------- handoff
     def adopt(self, request_id: int, *, tier: Optional[str],
               prompt: List[int], output: List[int],
-              max_new_tokens: int) -> ScheduledRequest:
+              max_new_tokens: int,
+              trace_ctx: Optional[Dict[str, Any]] = None
+              ) -> ScheduledRequest:
         """Register a KV-handoff continuation that was seated directly
         in the engine (``ingest_kv_snapshot``) — admission already
         happened on the prefill worker, so the request bypasses the
@@ -428,7 +441,8 @@ class RequestScheduler:
         with self._q_lock:
             self._seq += 1
             sr = ScheduledRequest(tier, list(prompt) + list(output),
-                                  max_new_tokens, {}, self._seq)
+                                  max_new_tokens, {}, self._seq,
+                                  trace_ctx=trace_ctx)
             sr.request_id = request_id
             sr.admit_time = sr.submit_time
             sr.first_token_time = sr.submit_time
@@ -530,6 +544,17 @@ class RequestScheduler:
                 continue
             sr.request_id = rid
             sr.admit_time = clock.now()
+            if hasattr(engine, 'adopt_trace_context'):
+                # The engine trace joins the wire-supplied fleet trace
+                # (or keeps its minted 128-bit id); the EFFECTIVE id is
+                # written back so every downstream hop — KV handoff,
+                # gang op-log, migration legs — carries the same id.
+                ctx = sr.trace_ctx or {}
+                tid = engine.adopt_trace_context(
+                    rid, trace_id=ctx.get('trace_id'),
+                    parent_span=ctx.get('parent_span'))
+                if tid is not None:
+                    sr.trace_ctx = dict(ctx, trace_id=tid)
             if self.on_admit is not None:
                 self.on_admit(rid, sr)
             with self._q_lock:

@@ -65,6 +65,7 @@ from skypilot_tpu.serve import disagg as disagg_lib
 from skypilot_tpu.serve import faults as faults_lib
 from skypilot_tpu.serve import gang as gang_lib
 from skypilot_tpu.serve import scheduler as scheduler_lib
+from skypilot_tpu.serve import wire
 from skypilot_tpu.telemetry import tracing
 
 logger = tpu_logging.init_logger(__name__)
@@ -740,7 +741,10 @@ class ModelServer:
             'priority': scheduler_lib.TIERS.index(sr.tier),
             'temperature': s.get('temperature', 0.0),
             'top_k': s.get('top_k', 0), 'top_p': s.get('top_p', 1.0),
-            'eos_id': s.get('eos_id'), 'stop': s.get('stop')})
+            'eos_id': s.get('eos_id'), 'stop': s.get('stop'),
+            # Fleet trace id: follower ranks attribute their lockstep
+            # replay of this request to the same trace.
+            'trace_id': (sr.trace_ctx or {}).get('trace_id')})
 
     def _gang_record_cancel(self, rid: int) -> None:
         self._gang.append_op({'k': 'cancel', 'rid': rid})
@@ -779,7 +783,9 @@ class ModelServer:
     def submit(self, prompt, max_new_tokens: int, temperature: float,
                top_k: int, eos_id: Optional[int], top_p: float = 1.0,
                stop=None, tier: Optional[str] = None,
-               handoff_target: Optional[str] = None) -> Dict[str, Any]:
+               handoff_target: Optional[str] = None,
+               trace_ctx: Optional[Dict[str, Any]] = None
+               ) -> Dict[str, Any]:
         """Blocking submit (non-streaming handlers): admission-control
         through the scheduler, then drain the outbox to completion.
         Raises ``scheduler.ShedError`` (→ HTTP 429) when the tier's
@@ -791,6 +797,7 @@ class ModelServer:
             raise RuntimeError(f'engine failed: {self._error}')
         sr = self.sched.submit(
             prompt, max_new_tokens=max_new_tokens, tier=tier,
+            trace_ctx=trace_ctx,
             temperature=temperature, top_k=top_k, top_p=top_p,
             eos_id=eos_id, stop=stop,
             hold=handoff_target is not None)
@@ -831,7 +838,8 @@ class ModelServer:
     def submit_stream(self, prompt, max_new_tokens: int, temperature: float,
                       top_k: int, eos_id: Optional[int],
                       top_p: float = 1.0, stop=None,
-                      tier: Optional[str] = None, hold: bool = False):
+                      tier: Optional[str] = None, hold: bool = False,
+                      trace_ctx: Optional[Dict[str, Any]] = None):
         """Register a streaming request; returns its ScheduledRequest
         (``sr.outbox`` streams ``(token, finished)`` tuples). Callers
         must call ``finish_stream(sr)`` when done. Raises
@@ -842,6 +850,7 @@ class ModelServer:
             raise RuntimeError(f'engine failed: {self._error}')
         return self.sched.submit(
             prompt, max_new_tokens=max_new_tokens, tier=tier,
+            trace_ctx=trace_ctx,
             temperature=temperature, top_k=top_k, top_p=top_p,
             eos_id=eos_id, stop=stop, hold=hold)
 
@@ -926,11 +935,18 @@ class ModelServer:
                     blob = faults_lib.corrupt_blob(blob, rule)
                     logger.warning('injected kv_corruption on the '
                                    'handoff wire (1 byte flipped)')
-            req = urllib.request.Request(
+            # The handoff hop carries the fleet trace: the decode
+            # worker's continuation joins this request's trace id with
+            # the prefill span as its causal parent.
+            trace = None
+            if sr.trace_ctx and sr.trace_ctx.get('trace_id'):
+                trace = {'trace_id': sr.trace_ctx['trace_id'],
+                         'parent_span': 'prefill'}
+            resp = wire.urlopen(
                 target + '/kv/ingest', data=blob,
                 headers={'Content-Type': 'application/octet-stream',
-                         'X-SLO-Tier': sr.tier})
-            resp = urllib.request.urlopen(req, timeout=120)
+                         'X-SLO-Tier': sr.tier},
+                trace=trace, timeout=120)
         except urllib.error.HTTPError as e:
             body = e.read()
             outcome = 'no_capacity' if e.code == 503 else 'failed'
@@ -1673,6 +1689,27 @@ class ModelServer:
                     self.send_header('Content-Length', str(len(blob)))
                     self.end_headers()
                     self.wfile.write(blob)
+                elif parsed.path == '/telemetry/summary':
+                    # Fleet-plane scrape: the controller pulls this on
+                    # the probe path. ``since`` is the caller's trace
+                    # cursor (resume semantics — only traces completed
+                    # after it ship); the clock block lets the
+                    # controller compute per-process skew at scrape
+                    # time and apply it at trace assembly.
+                    try:
+                        since = int(query.get('since', ['0'])[0])
+                    except ValueError:
+                        since = 0
+                    server._update_gauges()
+                    cursor, traces = (tracing.get_trace_buffer()
+                                      .summaries_since(since))
+                    self._json(200, {
+                        'clock': {'wall': time.time(),
+                                  'monotonic': time.monotonic()},
+                        'registry': server._reg.export_wire(),
+                        'traces': traces,
+                        'cursor': cursor,
+                    })
                 elif parsed.path == '/debug/requests':
                     try:
                         limit = int(query.get('limit', ['64'])[0])
@@ -1711,6 +1748,7 @@ class ModelServer:
                     self.headers.get('X-Handoff-Target'))
                 sr = server.submit_stream(prompt,
                                           hold=target is not None,
+                                          trace_ctx=self._trace_ctx(),
                                           **kwargs)
                 tokens = []
                 # Everything after registration lives under the finally:
@@ -1901,6 +1939,13 @@ class ModelServer:
                     stop=stop,
                     eos_id=payload.get('eos_id', tok.eos_id))
 
+            def _trace_ctx(self):
+                """Parse the inbound cross-process trace context (LB or
+                client supplied ``X-Skytpu-Trace``); None when absent
+                or malformed — the engine mints a fresh root id."""
+                return tracing.parse_trace_header(
+                    self.headers.get(tracing.TRACE_HEADER))
+
             def _openai_completions(self, payload, chat: bool) -> None:
                 import time as time_mod
                 tok = server.tokenizer
@@ -1937,7 +1982,7 @@ class ModelServer:
                 result = server.submit(
                     prompt_ids, handoff_target=server.handoff_target(
                         self.headers.get('X-Handoff-Target')),
-                    **kwargs)
+                    trace_ctx=self._trace_ctx(), **kwargs)
                 out_text = tok.decode(result['tokens'])
                 created = int(time_mod.time())
                 if chat:
@@ -1969,7 +2014,8 @@ class ModelServer:
                                kwargs) -> None:
                 import time as time_mod
                 tok = server.tokenizer
-                sr = server.submit_stream(prompt_ids, **kwargs)
+                sr = server.submit_stream(
+                    prompt_ids, trace_ctx=self._trace_ctx(), **kwargs)
                 created = int(time_mod.time())
                 obj = ('chat.completion.chunk' if chat
                        else 'text_completion')
@@ -2077,6 +2123,12 @@ class ModelServer:
                         'type': 'draining', 'retry_after_s': 5}},
                         extra_headers={'Retry-After': '5'})
                     return
+                trace_ctx = self._trace_ctx()
+                if trace_ctx:
+                    # The handoff hop carries the trace on the header,
+                    # not in the KV wire container — the decode-side
+                    # request adopts the prefill worker's trace id.
+                    snap['trace'] = trace_ctx
                 try:
                     with server._lock:
                         rid = server.engine.ingest_kv_snapshot(snap)
@@ -2085,7 +2137,8 @@ class ModelServer:
                         sr = server.sched.adopt(
                             rid, tier=tier, prompt=snap['prompt'],
                             output=snap['output'],
-                            max_new_tokens=snap['max_new_tokens'])
+                            max_new_tokens=snap['max_new_tokens'],
+                            trace_ctx=trace_ctx)
                 except kv_transfer.HandoffCapacityError as e:
                     server._m_handoff['no_capacity'].inc()
                     retry = server.sched.retry_after_s(
@@ -2325,7 +2378,7 @@ class ModelServer:
                     result = server.submit(
                         prompt, handoff_target=server.handoff_target(
                             self.headers.get('X-Handoff-Target')),
-                        **kwargs)
+                        trace_ctx=self._trace_ctx(), **kwargs)
                     if is_text:
                         result['text'] = tok.decode(result['tokens'])
                     server.record_request_key(key, result)

@@ -75,6 +75,12 @@ class SkyServiceSpec:
     forecast_bucket_seconds: float = 10.0
     forecast_season_seconds: float = 600.0
     forecast_horizon_seconds: float = 120.0
+    # Per-tier service-level objectives (``slos:`` block): tier name ->
+    # {ttft_ms, tpot_ms, shed_rate, target}. The controller's
+    # FleetAggregator evaluates 5m/1h burn rates against these
+    # (telemetry/fleet.py) and surfaces them in controller status, the
+    # LB sync response and ``GET /fleet/metrics``.
+    slos: Optional[Dict[str, Dict[str, float]]] = None
 
     @property
     def disagg_enabled(self) -> bool:
@@ -128,6 +134,24 @@ class SkyServiceSpec:
                 'cannot combine (a KV handoff in/out of a gang would '
                 'desync its follower ranks); drop one of '
                 'parallelism.hosts / disaggregation')
+        for tier, obj in (self.slos or {}).items():
+            if not isinstance(obj, dict):
+                raise exceptions.InvalidServiceSpecError(
+                    f'slos.{tier} must be a mapping of objectives')
+            target = obj.get('target', 0.99)
+            if not 0.0 < float(target) < 1.0:
+                raise exceptions.InvalidServiceSpecError(
+                    f'slos.{tier}.target must be in (0, 1), got '
+                    f'{target}')
+            for key in ('ttft_ms', 'tpot_ms'):
+                if obj.get(key) is not None and float(obj[key]) <= 0:
+                    raise exceptions.InvalidServiceSpecError(
+                        f'slos.{tier}.{key} must be positive')
+            shed = obj.get('shed_rate')
+            if shed is not None and not 0.0 < float(shed) <= 1.0:
+                raise exceptions.InvalidServiceSpecError(
+                    f'slos.{tier}.shed_rate must be in (0, 1], got '
+                    f'{shed}')
 
     @property
     def autoscaling_enabled(self) -> bool:
@@ -167,6 +191,11 @@ class SkyServiceSpec:
                     disagg.get('prefill_replicas', 0)),
                 disagg_decode_replicas=int(
                     disagg.get('decode_replicas', 0)))
+        slos = config.get('slos')
+        if slos:
+            fields['slos'] = {
+                str(tier): dict(obj or {})
+                for tier, obj in slos.items()}
         par = config.get('parallelism')
         if par:
             fields.update(
@@ -235,6 +264,9 @@ class SkyServiceSpec:
             }
         if self.gang_hosts > 1:
             cfg['parallelism'] = {'hosts': self.gang_hosts}
+        if self.slos:
+            cfg['slos'] = {tier: dict(obj)
+                           for tier, obj in sorted(self.slos.items())}
         if self.autoscaling_enabled or self.target_qps_per_replica:
             policy: Dict[str, Any] = {
                 'min_replicas': self.min_replicas,

@@ -397,7 +397,8 @@ class FleetSimulator:
                   migrated_from: Optional[str],
                   failed_at: Optional[float],
                   exclude: Optional[Set[str]] = None,
-                  session: Optional[Dict[str, Any]] = None) -> None:
+                  session: Optional[Dict[str, Any]] = None,
+                  trace_id: Optional[str] = None) -> None:
         now = self.loop.now
         shape = self.trace.shape
         exclude = set(exclude or ())
@@ -456,6 +457,9 @@ class FleetSimulator:
             job.failed_at = failed_at
             job.lb_idx = lb_idx
             job.session = session
+            if trace_id is not None:
+                # A migration leg joins the original request's trace.
+                job.trace_id = trace_id
             policy.pre_execute(url)
             self._inflight += count
             if session is not None:
@@ -552,7 +556,8 @@ class FleetSimulator:
                          else self.loop.now)
             self._dispatch(job.count, job.tier,
                            migrated_from=rep.url, failed_at=failed_at,
-                           exclude={rep.url}, session=job.session)
+                           exclude={rep.url}, session=job.session,
+                           trace_id=job.trace_id)
 
     def _drain_retry_queue(self) -> None:
         if not self._retry_q:
@@ -661,7 +666,8 @@ class FleetSimulator:
                 self.migrated += job.count
                 self._dispatch(job.count, job.tier,
                                migrated_from=rep.url, failed_at=now,
-                               session=job.session)
+                               session=job.session,
+                               trace_id=job.trace_id)
         elif rule.kind == 'byzantine_response':
             for r in live:
                 if (not r.byzantine and not r.wedged
@@ -769,6 +775,14 @@ class FleetSimulator:
                 'live': len(self._live_lb_idx),
                 'crashed': self.lb_crashes,
                 'reroutes': self.lb_reroutes,
+            },
+            # The controller-side aggregation plane (round 19): what
+            # ``GET /fleet/metrics`` would serve live — sources scraped
+            # over /telemetry/summary on the probe path, SLO burn
+            # rates/attainment evaluated on the virtual clock.
+            'fleet': {
+                'sources': self.controller.fleet.source_count(),
+                'slo': self.controller.fleet.slo_status(),
             },
             'faults_fired': faults_fired,
             'events': self._n_events,

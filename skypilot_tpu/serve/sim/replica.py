@@ -28,11 +28,15 @@ from __future__ import annotations
 
 import collections
 import dataclasses
+import hashlib
 import json
 import re
 from typing import Any, Callable, Dict, List, Optional
 
 from skypilot_tpu import telemetry
+from skypilot_tpu.telemetry import fleet as fleet_lib
+from skypilot_tpu.telemetry import registry as registry_lib
+from skypilot_tpu.telemetry import tracing
 
 # r05 fallback anchors (BENCH_r05.json serving_http.at_0p7_capacity and
 # prefix_cache blocks): tpot 23.22 ms, TTFT hit/miss 254.8/350.5 ms,
@@ -140,6 +144,11 @@ class SimJob:
     submit_t: float
     ttft_s: float               # per-request TTFT (queue wait + prefill)
     finish_t: float
+    wait_s: float = 0.0                   # queue-wait part of the TTFT
+    # 128-bit fleet trace id, minted at first admission and preserved
+    # across migration legs — the controller assembles all legs of a
+    # migrated job under ONE trace.
+    trace_id: Optional[str] = None
     migrated_from: Optional[str] = None   # url of the replica that died
     failed_at: Optional[float] = None     # when its first replica died
     cancelled: bool = False
@@ -204,6 +213,19 @@ class SimReplica:
         # capacity effect affinity routing is supposed to dodge.
         self._prefix_store: 'collections.OrderedDict[str, List[int]]' = (
             collections.OrderedDict())
+        # Fleet-plane telemetry (round 19): each simulated server owns
+        # a PRIVATE registry + trace buffer — never the process-global
+        # one, which thousands of sim replicas would share — scraped
+        # by the REAL replica manager over /telemetry/summary exactly
+        # like a live model server, so the controller-side aggregation
+        # runs identical code on the virtual clock.
+        self._reg = registry_lib.MetricsRegistry()
+        self._trace_buf = tracing.TraceBuffer()
+        # SimWorld.request strips query strings, so the scrape's
+        # ``since`` cursor cannot reach us; a replica-side shipped
+        # cursor gives the same at-most-once delivery (exactly one
+        # controller scrapes a replica).
+        self._trace_shipped = 0
 
     # ------------------------------------------------------ prefix cache
     def note_prefix(self, chain_hash: str, chain_len: int) -> None:
@@ -270,6 +292,11 @@ class SimReplica:
                          finish_t=now + 1e12)
             self._next_job += 1
             self.inflight[job.job_id] = job
+            # Admitted (the gray part: the queue IS open) but no
+            # latency observation — the request never finishes.
+            self._reg.counter(fleet_lib.ADMIT_METRIC,
+                              'Requests admitted by the scheduler',
+                              tier=tier).inc(count)
             return job
         cold_tokens = max(0.0, prompt_tokens - max(0.0, warm_tokens))
         warm = self.warm or warm_tokens > 0
@@ -277,6 +304,9 @@ class SimReplica:
                                    warm) * self.slowdown
         wait = max(0.0, self.busy_until - now)
         if wait > self.curve.max_queue_wait_s:
+            self._reg.counter(fleet_lib.SHED_METRIC,
+                              'Requests shed at admission',
+                              tier=tier, reason='queue_wait').inc(count)
             return None
         self.busy_until = (max(now, self.busy_until)
                            + count * svc / self.curve.slots)
@@ -285,13 +315,70 @@ class SimReplica:
         job = SimJob(job_id=self._next_job, count=count,
                      prompt_tokens=prompt_tokens,
                      gen_tokens=gen_tokens, tier=tier, submit_t=now,
-                     ttft_s=ttft, finish_t=now + wait + svc)
+                     ttft_s=ttft, finish_t=now + wait + svc,
+                     wait_s=wait,
+                     trace_id=self._mint_trace_id(now))
         self._next_job += 1
         self.inflight[job.job_id] = job
+        self._observe_admit(tier, count, ttft)
         return job
+
+    def _mint_trace_id(self, now: float) -> str:
+        """Deterministic 128-bit trace id: same seed, same admissions,
+        same ids — the sim counterpart of the LB's seeded-RNG mint."""
+        raw = f'{self.url}|{self._next_job}|{now:.6f}'.encode()
+        return hashlib.md5(raw).hexdigest()
+
+    def _observe_admit(self, tier: str, count: int,
+                       ttft_s: float) -> None:
+        """Record one admitted batch in the replica's private registry
+        using the exact series names the fleet SLO evaluator reads —
+        the sim and the live scheduler must agree on the schema."""
+        self._reg.counter(fleet_lib.ADMIT_METRIC,
+                          'Requests admitted by the scheduler',
+                          tier=tier).inc(count)
+        ttft_h = self._reg.histogram(fleet_lib.TTFT_METRIC,
+                                     'Time to first token (ms)',
+                                     tier=tier)
+        tpot_h = self._reg.histogram(fleet_lib.TPOT_METRIC,
+                                     'Time per output token (ms)',
+                                     tier=tier)
+        tpot_ms = self.curve.tpot_s * self.slowdown * 1e3
+        for _ in range(max(1, int(count))):
+            ttft_h.observe(ttft_s * 1e3)
+            tpot_h.observe(tpot_ms)
 
     def complete(self, job: SimJob) -> None:
         self.inflight.pop(job.job_id, None)
+        self._record_trace(job)
+
+    def _record_trace(self, job: SimJob) -> None:
+        """One completed-trace leg on the VIRTUAL clock: queue-wait /
+        prefill / decode spans, shipped to the controller on the next
+        ``/telemetry/summary`` scrape. A migrated job keeps its trace
+        id, so the controller assembles the legs from every replica
+        that served it under one trace."""
+        trace = tracing.RequestTrace(job.job_id,
+                                     trace_id=job.trace_id)
+        # Re-anchor the real-clock stamps the constructor took onto
+        # virtual time: span offsets become seconds-since-submit.
+        trace.t0 = 0.0
+        trace.wall0 = job.submit_t
+        prefill_end = min(job.ttft_s, job.finish_t - job.submit_t)
+        for name, t0, t1 in (
+                ('queue_wait', 0.0, job.wait_s),
+                ('prefill', job.wait_s, prefill_end),
+                ('decode', prefill_end, job.finish_t - job.submit_t)):
+            span = tracing.Span(name, t0, job.submit_t + t0)
+            span.t1 = max(t0, t1)
+            trace.spans.append(span)
+        trace.meta.update(tier=job.tier, count=job.count,
+                          replica=self.cluster_name)
+        if job.migrated_from is not None:
+            trace.meta.update(migrated_from=job.migrated_from,
+                              cause='migration')
+        trace.done = True
+        self._trace_buf.add(trace)
 
     def kill(self) -> List[SimJob]:
         """Hard death: returns the in-flight jobs the LB must migrate;
@@ -380,6 +467,16 @@ class SimReplica:
             self.warm = True
             return {'warmed_rows': int(blob.get('hot_prefixes', 0))
                     * 128, 'entries': int(blob.get('hot_prefixes', 0))}
+        if path == '/telemetry/summary':
+            # The fleet scrape surface (round 19): identical shape to
+            # the live server's route; 'wall' is the virtual clock, so
+            # the controller computes a zero skew offset per source.
+            cursor, traces = self._trace_buf.summaries_since(
+                self._trace_shipped)
+            self._trace_shipped = cursor
+            return {'clock': {'wall': now, 'monotonic': now},
+                    'registry': self._reg.export_wire(),
+                    'traces': traces, 'cursor': cursor}
         if path.startswith('/metrics'):
             return {
                 'queue_tokens_total': self.queue_tokens_total(now),

@@ -459,6 +459,33 @@ _register(Scenario(
 ))
 
 _register(Scenario(
+    name='slo_burst',
+    description='SLO burn-rate drill: a fixed 3-replica fleet with a '
+                'declared latency-tier TTFT objective serves 55 '
+                'virtual minutes of healthy traffic, then every '
+                'replica silently degrades 12x for the final stretch. '
+                'The fleet aggregator (fed over /telemetry/summary on '
+                'the probe path) must flip the 5-minute burn rate '
+                'above 1 while the 1-hour window stays below — the '
+                'multi-window page/ticket distinction.',
+    spec_fn=lambda: _spec(
+        min_replicas=3,
+        slos={'latency': {'ttft_ms': 2000.0, 'target': 0.9},
+              'throughput': {'ttft_ms': 10000.0, 'target': 0.9}}),
+    trace_fn=lambda: sim_traffic.constant(4.0, 3600.0),
+    fault_rules=[{'kind': 'straggler', 'site': 'sim_straggler',
+                  'at': 330, 'factor': 12.0},
+                 {'kind': 'straggler', 'site': 'sim_straggler',
+                  'at': 331, 'factor': 12.0},
+                 {'kind': 'straggler', 'site': 'sim_straggler',
+                  'at': 332, 'factor': 12.0}],
+    recovery_covered=False,      # nothing dies; latency IS the drill
+    sim_kwargs=dict(provision_s=20.0, provision_jitter=0.0,
+                    storm_dt=10.0, keep_log=False,
+                    drain_grace_s=300.0),
+))
+
+_register(Scenario(
     name='lb_crash',
     description='Horizontal LB tier under fire: 2 LB processes share '
                 'the sync feed, multi-turn sessions split between '

@@ -1,0 +1,98 @@
+"""The trace-propagating HTTP request helper for the serve tier.
+
+Every outbound HTTP call under ``serve/`` that carries a request body
+to another skytpu process goes through here (graftcheck GC123 gates
+it): the helper is the ONE place the ``X-Skytpu-Trace`` hop header is
+attached, so a hop added later can never silently drop the
+cross-process trace chain. Read-only liveness probes
+(``ControlPlaneEnv.probe_http``) are exempt — they are not requests.
+
+``trace`` accepts the formatted header value (str), a parsed context
+dict (``{'trace_id', 'parent_span'}``), or None (no header — e.g. a
+call that genuinely has no request identity, like a bulk sync).
+"""
+from __future__ import annotations
+
+import json
+import urllib.request
+from typing import Any, Dict, Optional, Union
+
+from skypilot_tpu.telemetry import tracing
+
+TRACE_HEADER = tracing.TRACE_HEADER
+
+TraceArg = Optional[Union[str, Dict[str, Any]]]
+
+
+def trace_header_value(trace: TraceArg) -> Optional[str]:
+    """Normalize a ``trace`` argument to the wire header value."""
+    if not trace:
+        return None
+    if isinstance(trace, str):
+        return trace
+    tid = trace.get('trace_id')
+    if not tid:
+        return None
+    return tracing.format_trace_header(tid, trace.get('parent_span'))
+
+
+def build_request(url: str, *, data: Optional[bytes] = None,
+                  headers: Optional[Dict[str, str]] = None,
+                  method: Optional[str] = None,
+                  trace: TraceArg = None) -> urllib.request.Request:
+    """An outbound request with the trace hop header attached (unless
+    the caller's headers already carry one — a proxied client header
+    wins over a re-mint)."""
+    headers = dict(headers or {})
+    value = trace_header_value(trace)
+    if value is not None and not any(
+            k.lower() == TRACE_HEADER.lower() for k in headers):
+        headers[TRACE_HEADER] = value
+    return urllib.request.Request(url, data=data, headers=headers,
+                                  method=method)
+
+
+def urlopen(url_or_req, *, data: Optional[bytes] = None,
+            headers: Optional[Dict[str, str]] = None,
+            method: Optional[str] = None, trace: TraceArg = None,
+            timeout: float = 30.0):
+    """Open an outbound hop (returns the live response object — the
+    caller streams/closes it). Accepts a prebuilt request from
+    :func:`build_request` or a URL plus the same keywords."""
+    if isinstance(url_or_req, urllib.request.Request):
+        req = url_or_req
+    else:
+        req = build_request(url_or_req, data=data, headers=headers,
+                            method=method, trace=trace)
+    return urllib.request.urlopen(req, timeout=timeout)
+
+
+def post_json(url: str, payload: Dict[str, Any], *,
+              timeout: float = 10.0, trace: TraceArg = None,
+              headers: Optional[Dict[str, str]] = None
+              ) -> Dict[str, Any]:
+    """POST a JSON body to another skytpu process; parsed JSON reply."""
+    headers = dict(headers or {})
+    headers.setdefault('Content-Type', 'application/json')
+    body = json.dumps(payload).encode()
+    with urlopen(url, data=body, headers=headers, trace=trace,
+                 timeout=timeout) as resp:
+        return json.loads(resp.read())
+
+
+def post_bytes(url: str, blob: bytes, *, timeout: float = 30.0,
+               trace: TraceArg = None,
+               headers: Optional[Dict[str, str]] = None
+               ) -> Dict[str, Any]:
+    """POST an opaque blob (KV snapshots, SKPF prefix containers)."""
+    headers = dict(headers or {})
+    headers.setdefault('Content-Type', 'application/octet-stream')
+    with urlopen(url, data=blob, headers=headers, trace=trace,
+                 timeout=timeout) as resp:
+        return json.loads(resp.read())
+
+
+def get_json(url: str, *, timeout: float = 10.0) -> Dict[str, Any]:
+    """GET a JSON document (no body, no trace hop — reads only)."""
+    with urlopen(url, timeout=timeout) as resp:
+        return json.loads(resp.read())

@@ -29,6 +29,8 @@ inference hot paths (graftcheck GC109 bans ad-hoc ``time.time()`` /
 ``perf_counter()`` there).
 """
 from skypilot_tpu.telemetry import clock
+from skypilot_tpu.telemetry.fleet import FleetAggregator
+from skypilot_tpu.telemetry.fleet import TierSLO
 from skypilot_tpu.telemetry.profiler import NullProfiler
 from skypilot_tpu.telemetry.profiler import StepProfiler
 from skypilot_tpu.telemetry.registry import Counter
@@ -37,14 +39,17 @@ from skypilot_tpu.telemetry.registry import Histogram
 from skypilot_tpu.telemetry.registry import MetricsRegistry
 from skypilot_tpu.telemetry.registry import get_registry
 from skypilot_tpu.telemetry.tracing import RequestTrace
+from skypilot_tpu.telemetry.tracing import TRACE_HEADER
 from skypilot_tpu.telemetry.tracing import TraceBuffer
 from skypilot_tpu.telemetry.tracing import export_chrome_trace
 from skypilot_tpu.telemetry.tracing import get_trace_buffer
+from skypilot_tpu.telemetry.tracing import mint_trace_id
 
 __all__ = [
     'clock', 'Counter', 'Gauge', 'Histogram', 'MetricsRegistry',
     'get_registry', 'RequestTrace', 'TraceBuffer', 'get_trace_buffer',
     'export_chrome_trace', 'StepProfiler', 'NullProfiler', 'enabled',
+    'FleetAggregator', 'TierSLO', 'TRACE_HEADER', 'mint_trace_id',
 ]
 
 

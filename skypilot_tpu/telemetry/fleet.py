@@ -1,0 +1,637 @@
+"""Controller-side fleet telemetry aggregation.
+
+The per-process registry (:mod:`skypilot_tpu.telemetry.registry`) and
+trace buffer (:mod:`skypilot_tpu.telemetry.tracing`) answer "what is
+THIS replica doing"; :class:`FleetAggregator` answers the fleet-level
+questions SLO-aware orchestration needs ("what is the latency tier's
+TTFT p90 across all replicas right now?", "where did request X's
+latency go across its LB -> prefill -> handoff -> decode -> migration
+odyssey?"). It lives on the controller and is fed on the existing
+sync/probe path:
+
+- replicas expose ``GET /telemetry/summary`` (registry wire export +
+  completed-trace summaries behind a cursor + their wall clock); the
+  replica manager scrapes it right after each successful readiness
+  probe and hands the payload here,
+- LBs piggyback their own completed trace legs (dispatch/migration
+  spans) on the ``/controller/load_balancer_sync`` body.
+
+Aggregation semantics (the exactness contract tests pin down):
+
+- **counters** sum across replicas, with per-(source, series)
+  high-water marks for reset detection — a rebooted replica's counter
+  restarting at 0 adds its pre-reboot total as a base instead of
+  subtracting from the fleet sum,
+- **histograms** with identical bucket bounds merge EXACTLY
+  (elementwise addition of de-cumulated bucket counts, sums and
+  counts add); quantiles from the merged buckets are within one
+  bucket width of pooled-sample truth,
+- **gauges** are not summable in general — each keeps its source as a
+  ``replica`` label.
+
+Clock skew: every scrape records ``offset = controller_now -
+replica_wall`` and trace assembly applies the per-source offset to
+every span, so a multi-process odyssey renders in causal order even
+when replica clocks disagree.
+
+SLO burn rates: the service spec's ``slos:`` block declares per-tier
+TTFT/TPOT/shed-rate objectives; the aggregator samples per-tier fleet
+totals into a bounded time-series ring on every ingest and evaluates
+multi-window (5 min / 1 h) burn rates — ``burn = bad_fraction /
+(1 - target)``, so burn > 1 means the error budget is being spent
+faster than sustainable. Exposed as
+``skytpu_slo_burn_rate{tier,window}`` + ``skytpu_slo_attainment{tier}``
+and in :meth:`FleetAggregator.slo_status` (controller status + LB
+sync).
+
+Everything is driven through the controller's ``ControlPlaneEnv``
+clock, so the simulator runs the identical code on the virtual clock
+(deterministic same-seed reports) and memory stays bounded at
+1000-replica scale (bounded rings, bounded trace store, capped
+per-source series).
+"""
+from __future__ import annotations
+
+import bisect
+import collections
+import dataclasses
+import threading
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from skypilot_tpu.telemetry import registry as registry_lib
+
+# Burn-rate windows (seconds -> exposition label). Multi-window per
+# Google SRE practice: the short window catches an active burst, the
+# long window filters one-off blips.
+BURN_WINDOWS: Tuple[Tuple[float, str], ...] = ((300.0, '5m'),
+                                               (3600.0, '1h'))
+
+# Bounded-memory caps (1000-replica sims must not grow unboundedly).
+DEFAULT_RING_POINTS = 1024        # covers 1h+ at a 5s sync cadence
+DEFAULT_TRACE_CAPACITY = 512      # assembled-trace store (fleet-wide)
+MAX_SOURCES = 4096                # scraped processes tracked
+MAX_SERIES_PER_SOURCE = 1024      # per-process series kept for merging
+MAX_LEGS_PER_TRACE = 64
+
+# The metric names the SLO evaluator reads (the scheduler emits these
+# on live replicas; SimReplica emits the same names so the identical
+# aggregator code runs in the simulator).
+TTFT_METRIC = 'skytpu_request_ttft_ms'
+TPOT_METRIC = 'skytpu_request_tpot_ms'
+SHED_METRIC = 'skytpu_sched_shed_total'
+ADMIT_METRIC = 'skytpu_sched_admitted_total'
+
+
+@dataclasses.dataclass
+class TierSLO:
+    """One tier's objectives from the service spec ``slos:`` block."""
+    tier: str
+    ttft_ms: Optional[float] = None
+    tpot_ms: Optional[float] = None
+    shed_rate: Optional[float] = None     # max tolerated shed fraction
+    target: float = 0.99                  # attainment objective
+
+    @property
+    def error_budget(self) -> float:
+        return max(1e-9, 1.0 - self.target)
+
+
+def slos_from_config(config: Optional[Dict[str, Any]]) -> List[TierSLO]:
+    """Parse the validated ``slos:`` spec block into :class:`TierSLO`
+    rows (sorted by tier name — iteration order is part of the
+    determinism contract)."""
+    out: List[TierSLO] = []
+    for tier in sorted(config or {}):
+        obj = config[tier] or {}
+        out.append(TierSLO(
+            tier=tier,
+            ttft_ms=obj.get('ttft_ms'),
+            tpot_ms=obj.get('tpot_ms'),
+            shed_rate=obj.get('shed_rate'),
+            target=float(obj.get('target', 0.99))))
+    return out
+
+
+def bucket_quantile(buckets: List[float], cumulative: List[int],
+                    q: float) -> float:
+    """Quantile estimated from cumulative fixed buckets (linear
+    interpolation inside the landing bucket) — within one bucket width
+    of the pooled-sample truth, which is the best any
+    bucket-aggregated store can promise."""
+    total = cumulative[-1] if cumulative else 0
+    if total <= 0:
+        return 0.0
+    target = q * total
+    prev_cum = 0
+    prev_upper = 0.0
+    for upper, cum in zip(buckets, cumulative):
+        if cum >= target:
+            span = cum - prev_cum
+            frac = (target - prev_cum) / span if span else 1.0
+            return prev_upper + (upper - prev_upper) * frac
+        prev_cum = cum
+        prev_upper = upper
+    return buckets[-1] if buckets else 0.0
+
+
+def _series_key(labels: Dict[str, str]) -> Tuple[Tuple[str, str], ...]:
+    return tuple(sorted(labels.items()))
+
+
+class _CounterState:
+    """Reset-proof counter accumulation for one (source, series):
+    ``base`` carries totals from before the last observed reset."""
+    __slots__ = ('base', 'last')
+
+    def __init__(self) -> None:
+        self.base = 0.0
+        self.last = 0.0
+
+    def update(self, value: float) -> None:
+        if value < self.last:        # the source process restarted
+            self.base += self.last
+        self.last = value
+
+    @property
+    def total(self) -> float:
+        return self.base + self.last
+
+
+class _HistogramState:
+    """Reset-proof histogram accumulation for one (source, series)."""
+    __slots__ = ('buckets', 'base_cum', 'base_sum', 'base_count',
+                 'last_cum', 'last_sum', 'last_count')
+
+    def __init__(self, buckets: List[float]) -> None:
+        self.buckets = list(buckets)
+        n = len(buckets) + 1
+        self.base_cum = [0] * n
+        self.base_sum = 0.0
+        self.base_count = 0
+        self.last_cum = [0] * n
+        self.last_sum = 0.0
+        self.last_count = 0
+
+    def update(self, cumulative: List[int], sum_: float,
+               count: int) -> bool:
+        """Returns False (no merge) on a bucket-layout mismatch."""
+        if len(cumulative) != len(self.last_cum):
+            return False
+        if count < self.last_count:          # restart
+            self.base_cum = [b + l for b, l in
+                             zip(self.base_cum, self.last_cum)]
+            self.base_sum += self.last_sum
+            self.base_count += self.last_count
+        self.last_cum = list(cumulative)
+        self.last_sum = float(sum_)
+        self.last_count = int(count)
+        return True
+
+    @property
+    def total_cum(self) -> List[int]:
+        return [b + l for b, l in zip(self.base_cum, self.last_cum)]
+
+    @property
+    def total_sum(self) -> float:
+        return self.base_sum + self.last_sum
+
+    @property
+    def total_count(self) -> int:
+        return self.base_count + self.last_count
+
+
+class FleetAggregator:
+    """Merges scraped per-process telemetry into the fleet view.
+
+    ``clock`` is the controller env's wall-time callable — on the sim
+    seam that is the virtual clock, so burn-rate windows and skew
+    offsets are deterministic under a fixed seed."""
+
+    def __init__(self, *, clock: Callable[[], float],
+                 slos: Optional[List[TierSLO]] = None,
+                 ring_points: int = DEFAULT_RING_POINTS,
+                 trace_capacity: int = DEFAULT_TRACE_CAPACITY):
+        self._clock = clock
+        self._slos = list(slos or [])
+        self._lock = threading.Lock()
+        # source -> series-name -> series-key -> state/value
+        self._counters: Dict[str, Dict[str, Dict[Any, _CounterState]]] \
+            = {}
+        self._hists: Dict[str, Dict[str, Dict[Any, _HistogramState]]] \
+            = {}
+        self._gauges: Dict[str, Dict[str, Dict[Any, float]]] = {}
+        self._families: Dict[str, Tuple[str, str]] = {}
+        self._series_per_source: Dict[str, int] = {}
+        self._skew: Dict[str, float] = {}        # source -> offset (s)
+        self._scrapes = 0
+        self._dropped_series = 0
+        self._merge_skipped = 0
+        # trace_id -> list of leg dicts (insertion-ordered store,
+        # oldest trace evicted first).
+        self._traces: 'collections.OrderedDict[str, List[Dict[str, Any]]]' \
+            = collections.OrderedDict()
+        self._trace_capacity = max(1, int(trace_capacity))
+        self._traces_evicted = 0
+        # Burn-rate rings: tier -> deque of (t, measured, bad, admitted,
+        # shed) cumulative fleet totals.
+        self._rings: Dict[str, 'collections.deque'] = {}
+        self._ring_points = max(8, int(ring_points))
+        self._slo_values: Dict[str, Dict[str, float]] = {}
+
+    # ---------------------------------------------------------- ingest
+    def ingest(self, source: str, payload: Dict[str, Any]) -> None:
+        """One scraped ``/telemetry/summary`` payload (or an LB's sync
+        piggyback): ``{'clock': {'wall': ...}, 'registry': <wire
+        export>, 'traces': [...]}`` — every block optional."""
+        now = self._clock()
+        with self._lock:
+            self._scrapes += 1
+            clk = payload.get('clock') or {}
+            if isinstance(clk.get('wall'), (int, float)):
+                self._skew[source] = now - float(clk['wall'])
+            wire = payload.get('registry')
+            if isinstance(wire, dict):
+                self._ingest_registry_locked(source, wire)
+        # Trace ingestion re-reads the recorded skew under the lock.
+        traces = payload.get('traces')
+        if traces:
+            self.ingest_traces(source, traces)
+        self._sample_slos()
+
+    def _ingest_registry_locked(self, source: str,
+                                wire: Dict[str, Any]) -> None:
+        if (source not in self._skew
+                and len(self._skew) >= MAX_SOURCES):
+            return
+        budget = self._series_per_source
+        for name in sorted(wire):
+            fam = wire[name]
+            if not isinstance(fam, dict):
+                continue
+            kind = fam.get('kind', 'untyped')
+            if name not in self._families or not \
+                    self._families[name][1]:
+                self._families[name] = (kind, fam.get('help', ''))
+            for entry in fam.get('series') or []:
+                if budget.get(source, 0) >= MAX_SERIES_PER_SOURCE:
+                    self._dropped_series += 1
+                    continue
+                labels = entry.get('labels') or {}
+                key = _series_key(labels)
+                if kind == 'counter':
+                    st = self._counters.setdefault(
+                        source, {}).setdefault(name, {})
+                    if key not in st:
+                        budget[source] = budget.get(source, 0) + 1
+                    st.setdefault(key, _CounterState()).update(
+                        float(entry.get('value', 0.0)))
+                elif kind == 'histogram':
+                    st = self._hists.setdefault(
+                        source, {}).setdefault(name, {})
+                    hs = st.get(key)
+                    if hs is None:
+                        hs = _HistogramState(
+                            [float(b) for b in
+                             entry.get('buckets') or []])
+                        st[key] = hs
+                        budget[source] = budget.get(source, 0) + 1
+                    ok = hs.update(entry.get('cumulative') or [],
+                                   float(entry.get('sum', 0.0)),
+                                   int(entry.get('count', 0)))
+                    if not ok:
+                        self._merge_skipped += 1
+                else:                 # gauge / untyped: labelled, not summed
+                    st = self._gauges.setdefault(
+                        source, {}).setdefault(name, {})
+                    if key not in st:
+                        budget[source] = budget.get(source, 0) + 1
+                    st[key] = float(entry.get('value', 0.0))
+
+    def ingest_traces(self, source: str,
+                      traces: List[Dict[str, Any]]) -> None:
+        """Completed-trace summaries from one process. Legs from the
+        same process for the same trace id accumulate; the store is
+        bounded (oldest trace evicted)."""
+        with self._lock:
+            skew = self._skew.get(source, 0.0)
+            for t in traces:
+                if not isinstance(t, dict):
+                    continue
+                tid = t.get('trace_id')
+                if not tid:
+                    continue
+                legs = self._traces.get(tid)
+                if legs is None:
+                    while len(self._traces) >= self._trace_capacity:
+                        self._traces.popitem(last=False)
+                        self._traces_evicted += 1
+                    legs = []
+                    self._traces[tid] = legs
+                if len(legs) >= MAX_LEGS_PER_TRACE:
+                    continue
+                leg = dict(t)
+                leg['source'] = source
+                leg['skew_s'] = skew
+                legs.append(leg)
+
+    def set_slos(self, slos: Optional[List[TierSLO]]) -> None:
+        """Replace the objective set (a service ``update`` changed the
+        ``slos:`` block). Rings for tiers that remain keep their
+        history — burn windows survive a spec bump."""
+        with self._lock:
+            self._slos = list(slos or [])
+            keep = {s.tier for s in self._slos}
+            for tier in [t for t in self._rings if t not in keep]:
+                del self._rings[tier]
+            self._slo_values = {
+                t: v for t, v in self._slo_values.items() if t in keep}
+
+    def source_count(self) -> int:
+        with self._lock:
+            return len(self._skew)
+
+    def forget_source(self, source: str) -> None:
+        """Drop a removed replica's per-source state (its already
+        merged history stays in the rings/trace store)."""
+        with self._lock:
+            self._counters.pop(source, None)
+            self._hists.pop(source, None)
+            self._gauges.pop(source, None)
+            self._series_per_source.pop(source, None)
+            self._skew.pop(source, None)
+
+    # --------------------------------------------------- merged values
+    def _fleet_counter_locked(self, name: str
+                              ) -> Dict[Any, Tuple[Dict[str, str],
+                                                   float]]:
+        out: Dict[Any, Tuple[Dict[str, str], float]] = {}
+        for source in sorted(self._counters):
+            for key, st in self._counters[source].get(name,
+                                                      {}).items():
+                if key in out:
+                    out[key] = (out[key][0], out[key][1] + st.total)
+                else:
+                    out[key] = (dict(key), st.total)
+        return out
+
+    def _fleet_hist_locked(self, name: str
+                           ) -> Dict[Any, Tuple[Dict[str, str],
+                                                List[float],
+                                                List[int], float, int]]:
+        out: Dict[Any, Any] = {}
+        for source in sorted(self._hists):
+            for key, hs in self._hists[source].get(name, {}).items():
+                cur = out.get(key)
+                if cur is None:
+                    out[key] = [dict(key), list(hs.buckets),
+                                hs.total_cum, hs.total_sum,
+                                hs.total_count]
+                elif cur[1] == hs.buckets:
+                    cur[2] = [a + b for a, b in
+                              zip(cur[2], hs.total_cum)]
+                    cur[3] += hs.total_sum
+                    cur[4] += hs.total_count
+                else:
+                    self._merge_skipped += 1
+        return {k: tuple(v) for k, v in out.items()}
+
+    # ------------------------------------------------------------- SLO
+    def _tier_totals_locked(self, slo: TierSLO
+                            ) -> Tuple[float, float, float, float]:
+        """(measured, bad, admitted, shed) cumulative fleet totals for
+        one tier under its objectives. ``measured`` counts latency
+        observations; ``bad`` those over an objective threshold
+        (evaluated at the first bucket bound >= threshold — the
+        resolution a fixed-bucket store affords)."""
+        measured = bad = 0.0
+        for metric, threshold in ((TTFT_METRIC, slo.ttft_ms),
+                                  (TPOT_METRIC, slo.tpot_ms)):
+            for source in sorted(self._hists):
+                for key, hs in self._hists[source].get(metric,
+                                                       {}).items():
+                    if dict(key).get('tier') != slo.tier:
+                        continue
+                    count = hs.total_count
+                    if metric == TTFT_METRIC:
+                        measured += count
+                    if threshold is None or count == 0:
+                        continue
+                    idx = bisect.bisect_left(hs.buckets,
+                                             float(threshold))
+                    cum = hs.total_cum
+                    good = cum[idx] if idx < len(cum) else count
+                    bad += count - good
+        admitted = shed = 0.0
+        for source in sorted(self._counters):
+            for key, st in self._counters[source].get(
+                    ADMIT_METRIC, {}).items():
+                if dict(key).get('tier') == slo.tier:
+                    admitted += st.total
+            for key, st in self._counters[source].get(
+                    SHED_METRIC, {}).items():
+                if dict(key).get('tier') == slo.tier:
+                    shed += st.total
+        return measured, bad, admitted, shed
+
+    def _sample_slos(self) -> None:
+        """Append a ring point per tier and refresh burn gauges."""
+        if not self._slos:
+            return
+        now = self._clock()
+        with self._lock:
+            for slo in self._slos:
+                ring = self._rings.get(slo.tier)
+                if ring is None:
+                    ring = collections.deque(maxlen=self._ring_points)
+                    self._rings[slo.tier] = ring
+                ring.append((now,) + self._tier_totals_locked(slo))
+            values = {slo.tier: self._evaluate_tier_locked(slo)
+                      for slo in self._slos}
+            self._slo_values = values
+
+    def _evaluate_tier_locked(self, slo: TierSLO) -> Dict[str, float]:
+        ring = self._rings.get(slo.tier)
+        out: Dict[str, float] = {}
+        if not ring:
+            out['attainment'] = 1.0
+            for _, label in BURN_WINDOWS:
+                out[f'burn_{label}'] = 0.0
+            return out
+        now, cur_measured, cur_bad, cur_admitted, cur_shed = ring[-1]
+        for window_s, label in BURN_WINDOWS:
+            # Oldest point still inside the window = the baseline the
+            # deltas are taken against (the ring is append-ordered).
+            base = None
+            for point in ring:
+                if point[0] >= now - window_s:
+                    base = point
+                    break
+            if base is None:
+                base = ring[0]
+            d_measured = cur_measured - base[1]
+            d_bad = cur_bad - base[2]
+            d_admitted = cur_admitted - base[3]
+            d_shed = cur_shed - base[4]
+            burn = 0.0
+            if d_measured > 0:
+                burn = (d_bad / d_measured) / slo.error_budget
+            if slo.shed_rate and (d_admitted + d_shed) > 0:
+                shed_frac = d_shed / (d_admitted + d_shed)
+                burn = max(burn, shed_frac / max(1e-9, slo.shed_rate))
+            out[f'burn_{label}'] = burn
+            if label == BURN_WINDOWS[0][1]:
+                out['attainment'] = (1.0 - d_bad / d_measured
+                                     if d_measured > 0 else 1.0)
+        return out
+
+    def slo_status(self) -> Dict[str, Dict[str, float]]:
+        """Per-tier burn/attainment — what controller status and LB
+        sync surface for autoscalers and fleet schedulers."""
+        with self._lock:
+            return {tier: dict(vals)
+                    for tier, vals in sorted(self._slo_values.items())}
+
+    # ----------------------------------------------------- trace views
+    def trace_ids(self) -> List[str]:
+        with self._lock:
+            return list(self._traces)
+
+    def assemble_trace(self, trace_id: str
+                       ) -> Optional[Dict[str, Any]]:
+        """The multi-process odyssey for one trace id: every shipped
+        leg's spans on one skew-adjusted wall-clock axis, in causal
+        order."""
+        with self._lock:
+            legs = self._traces.get(trace_id)
+            if legs is None:
+                return None
+            legs = [dict(leg) for leg in legs]
+        spans: List[Dict[str, Any]] = []
+        for leg in legs:
+            base_wall = (float(leg.get('submitted_at', 0.0))
+                         + float(leg.get('skew_s', 0.0)))
+            for span in leg.get('spans') or []:
+                start = base_wall + float(span.get('start_ms',
+                                                   0.0)) / 1e3
+                out = {'name': span.get('name'),
+                       'source': leg.get('source'),
+                       'request_id': leg.get('request_id'),
+                       't_wall': start}
+                if 'dur_ms' in span:
+                    out['dur_ms'] = span['dur_ms']
+                if span.get('meta'):
+                    out['meta'] = span['meta']
+                spans.append(out)
+        spans.sort(key=lambda s: (s['t_wall'], str(s['name'])))
+        return {'trace_id': trace_id,
+                'legs': legs,
+                'spans': spans}
+
+    def chrome_events(self, trace_id: str
+                      ) -> Optional[List[Dict[str, Any]]]:
+        """Chrome trace-event dicts for one assembled trace (one pid
+        per source process, tid = that leg's request id), feedable to
+        ``utils/timeline.write_trace``."""
+        assembled = self.assemble_trace(trace_id)
+        if assembled is None:
+            return None
+        pids = {leg['source']: i + 1 for i, leg in
+                enumerate({leg['source']: leg
+                           for leg in assembled['legs']}.values())}
+        events: List[Dict[str, Any]] = []
+        for span in assembled['spans']:
+            args = {k: str(v) for k, v in
+                    (span.get('meta') or {}).items()}
+            args['trace_id'] = trace_id
+            args['source'] = str(span.get('source'))
+            events.append({
+                'name': span['name'],
+                'ph': 'X',
+                'ts': span['t_wall'] * 1e6,
+                'dur': float(span.get('dur_ms', 0.0)) * 1e3,
+                'pid': pids.get(span.get('source'), 0),
+                'tid': span.get('request_id') or 0,
+                'args': args,
+            })
+        return events
+
+    # ------------------------------------------------------- rendering
+    def _build_merged(self) -> registry_lib.MetricsRegistry:
+        reg = registry_lib.MetricsRegistry()
+        with self._lock:
+            fam = dict(self._families)
+            counter_names = sorted({n for per in self._counters.values()
+                                    for n in per})
+            hist_names = sorted({n for per in self._hists.values()
+                                 for n in per})
+            gauge_rows: List[Tuple[str, str, Dict[str, str], float]] \
+                = []
+            for source in sorted(self._gauges):
+                for name in sorted(self._gauges[source]):
+                    for key, val in sorted(
+                            self._gauges[source][name].items()):
+                        gauge_rows.append((name, source, dict(key),
+                                           val))
+            counters = {n: self._fleet_counter_locked(n)
+                        for n in counter_names}
+            hists = {n: self._fleet_hist_locked(n)
+                     for n in hist_names}
+            scrapes = self._scrapes
+            n_sources = len(self._skew)
+            n_traces = len(self._traces)
+            evicted = self._traces_evicted
+            dropped = self._dropped_series
+            skipped = self._merge_skipped
+            slo_values = {t: dict(v)
+                          for t, v in self._slo_values.items()}
+        for name in counter_names:
+            help_text = fam.get(name, ('', ''))[1]
+            for key in sorted(counters[name]):
+                labels, total = counters[name][key]
+                reg.counter(name, help_text, **labels).inc(total)
+        for name in hist_names:
+            help_text = fam.get(name, ('', ''))[1]
+            for key in sorted(hists[name]):
+                labels, buckets, cum, sum_, count = hists[name][key]
+                h = reg.histogram(name, help_text, buckets=buckets,
+                                  window=1, **labels)
+                h.merge_cumulative(cum, sum_, count)
+        for name, source, labels, val in gauge_rows:
+            help_text = fam.get(name, ('', ''))[1]
+            reg.gauge(name, help_text, replica=source,
+                      **labels).set(val)
+        # Fleet-plane series of the aggregator itself.
+        reg.gauge('skytpu_fleet_sources',
+                  'Processes contributing to the fleet view'
+                  ).set(n_sources)
+        reg.counter('skytpu_fleet_scrapes_total',
+                    'Telemetry payloads ingested').inc(scrapes)
+        reg.gauge('skytpu_fleet_traces', 'Assembled-trace store size'
+                  ).set(n_traces)
+        reg.counter('skytpu_fleet_traces_evicted_total',
+                    'Traces evicted from the bounded store'
+                    ).inc(evicted)
+        reg.counter('skytpu_fleet_series_dropped_total',
+                    'Series dropped by the per-source cap'
+                    ).inc(dropped)
+        reg.counter('skytpu_fleet_merge_skipped_total',
+                    'Histogram series skipped on bucket-layout '
+                    'mismatch').inc(skipped)
+        for tier, vals in sorted(slo_values.items()):
+            reg.gauge('skytpu_slo_attainment',
+                      'Fleet SLO attainment (short window)',
+                      tier=tier).set(vals.get('attainment', 1.0))
+            for _, label in BURN_WINDOWS:
+                reg.gauge('skytpu_slo_burn_rate',
+                          'Error-budget burn rate (>1 = unsustainable)',
+                          tier=tier, window=label
+                          ).set(vals.get(f'burn_{label}', 0.0))
+        return reg
+
+    def render_prometheus(self) -> str:
+        return self._build_merged().render_prometheus()
+
+    def render_json(self) -> Dict[str, Any]:
+        out = self._build_merged().render_json()
+        out['_slo'] = self.slo_status()
+        return out

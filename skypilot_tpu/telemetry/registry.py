@@ -163,6 +163,25 @@ class Histogram(_Metric):
                     'count': self._count,
                     'window': list(self._window)}
 
+    def merge_cumulative(self, cumulative: Sequence[int], sum_: float,
+                         count: int) -> None:
+        """Merge another histogram's cumulative snapshot (SAME bucket
+        bounds) in: exact elementwise addition of the de-cumulated
+        counts; sum and count add. The raw-observation window is NOT
+        merged — fleet-level quantiles read from the merged buckets
+        (:func:`skypilot_tpu.telemetry.fleet.bucket_quantile`)."""
+        if len(cumulative) != len(self._counts):
+            raise ValueError(
+                f'{self.name}: cannot merge {len(cumulative)} '
+                f'cumulative buckets into {len(self._counts)}')
+        with self._lock:
+            prev = 0
+            for i, cum in enumerate(cumulative):
+                self._counts[i] += cum - prev
+                prev = cum
+            self._sum += float(sum_)
+            self._count += int(count)
+
     def quantile(self, q: float) -> float:
         """Exact quantile over the bounded rolling window (0 when
         empty) — zeros-not-omitted, like every other gauge."""
@@ -280,6 +299,41 @@ class MetricsRegistry:
                     lines.append(f'{name}{_label_str(m.labels)} '
                                  f'{_fmt(m.value)}')
         return '\n'.join(lines) + '\n'
+
+    def export_wire(self) -> Dict[str, Any]:
+        """Merge-ready snapshot for the fleet aggregation plane: every
+        series with its kind, labels and raw values — histograms carry
+        their EXACT bucket bounds plus cumulative counts (unlike
+        :meth:`render_json`, which pre-digests quantiles), so the
+        controller-side merge is exact elementwise addition, not an
+        approximation. Shape::
+
+            {name: {'kind': ..., 'help': ...,
+                    'series': [{'labels': {...},
+                                'value': v}                  # counter/gauge
+                               {'labels': {...},            # histogram
+                                'buckets': [...uppers...],
+                                'cumulative': [...],         # +Inf last
+                                'sum': s, 'count': n}]}}
+        """
+        out: Dict[str, Any] = {}
+        for name, series in self.families().items():
+            kind, help_text = self._families.get(name, ('untyped', ''))
+            entries = []
+            for m in series:
+                entry: Dict[str, Any] = {'labels': dict(m.labels)}
+                if isinstance(m, Histogram):
+                    snap = m.snapshot()
+                    entry.update(buckets=list(m.buckets),
+                                 cumulative=snap['cumulative'],
+                                 sum=snap['sum'],
+                                 count=snap['count'])
+                else:
+                    entry['value'] = m.value
+                entries.append(entry)
+            out[name] = {'kind': kind, 'help': help_text,
+                         'series': entries}
+        return out
 
     def render_json(self) -> Dict[str, Any]:
         out: Dict[str, Any] = {}

@@ -24,14 +24,62 @@ from __future__ import annotations
 import collections
 import itertools
 import os
+import re
 import threading
-from typing import Any, Dict, List, Optional
+from typing import Any, Dict, List, Optional, Tuple
 
 from skypilot_tpu.telemetry import clock
 
 DEFAULT_BUFFER = int(os.environ.get('SKYTPU_TRACE_BUFFER', '256'))
 
 _trace_seq = itertools.count(1)
+
+# ------------------------------------------------- cross-process trace ids
+# The wire header every skytpu process propagates on outbound hops
+# (LB -> replica /generate, prefill -> decode /kv/ingest, LB <-> LB
+# idempotency pushes, migration/retry legs). Value:
+# ``<trace_id>[;<parent_span>]`` — trace_id is 128-bit hex, parent_span
+# names the span on the SENDING process this hop is causally under.
+TRACE_HEADER = 'X-Skytpu-Trace'
+
+_TRACE_ID_RE = re.compile(r'^[0-9a-f]{8,64}$')
+_PARENT_RE = re.compile(r'^[\w.:/-]{1,128}$')
+
+
+def mint_trace_id(rng: Optional[Any] = None) -> str:
+    """A 128-bit hex trace id. Pass a seeded ``random.Random`` (the
+    sim env's RNG stream) for deterministic ids; without one the id is
+    drawn from ``os.urandom`` — pid-recycle-proof, unlike the old
+    ``pid-seq`` locals that collided across replica restarts."""
+    if rng is not None:
+        return f'{rng.getrandbits(128):032x}'
+    return os.urandom(16).hex()
+
+
+def format_trace_header(trace_id: str,
+                        parent_span: Optional[str] = None) -> str:
+    """The ``X-Skytpu-Trace`` header value for one outbound hop."""
+    if parent_span:
+        return f'{trace_id};{parent_span}'
+    return trace_id
+
+
+def parse_trace_header(value: Optional[str]
+                       ) -> Optional[Dict[str, Optional[str]]]:
+    """Parse an incoming ``X-Skytpu-Trace`` value into
+    ``{'trace_id', 'parent_span'}``; None for absent/garbage values
+    (a malformed header must never break request handling — the
+    receiver just mints a fresh local trace)."""
+    if not value or not isinstance(value, str):
+        return None
+    trace_id, _, parent = value.strip().partition(';')
+    trace_id = trace_id.strip().lower()
+    if not _TRACE_ID_RE.match(trace_id):
+        return None
+    parent = parent.strip() or None
+    if parent is not None and not _PARENT_RE.match(parent):
+        parent = None
+    return {'trace_id': trace_id, 'parent_span': parent}
 
 
 class Span:
@@ -55,14 +103,32 @@ class Span:
 class RequestTrace:
     """One request's span timeline. Engine-thread-only mutation."""
 
-    def __init__(self, request_id: int):
+    def __init__(self, request_id: int,
+                 trace_id: Optional[str] = None,
+                 parent_span: Optional[str] = None):
         self.request_id = request_id
-        self.trace_id = f'{os.getpid():x}-{next(_trace_seq):x}'
+        # The process-local id survives one release as ``legacy_id``
+        # (pids recycle across replica restarts, so it is NOT unique
+        # fleet-wide — the controller keys its trace store by the
+        # 128-bit ``trace_id`` only).
+        self.legacy_id = f'{os.getpid():x}-{next(_trace_seq):x}'
+        self.trace_id = trace_id or mint_trace_id()
+        self.parent_span = parent_span
         self.t0 = clock.monotonic()
         self.wall0 = clock.now()
         self.spans: List[Span] = []
         self.done = False
         self.meta: Dict[str, Any] = {}
+
+    def adopt_wire_context(self, trace_id: Optional[str] = None,
+                           parent_span: Optional[str] = None) -> None:
+        """Adopt a wire-supplied trace context (an upstream hop's
+        ``X-Skytpu-Trace``): the request joins the fleet-wide trace
+        instead of keeping its locally minted id."""
+        if trace_id:
+            self.trace_id = trace_id
+        if parent_span:
+            self.parent_span = parent_span
 
     # ------------------------------------------------------------- spans
     def begin(self, name: str, **meta: Any) -> Span:
@@ -121,29 +187,71 @@ class RequestTrace:
             if span.meta:
                 d['meta'] = dict(span.meta)
             spans.append(d)
-        return {'trace_id': self.trace_id,
-                'request_id': self.request_id,
-                'submitted_at': self.wall0,
-                'done': self.done,
-                'meta': dict(self.meta),
-                'spans': spans}
+        d = {'trace_id': self.trace_id,
+             'legacy_id': self.legacy_id,
+             'request_id': self.request_id,
+             'submitted_at': self.wall0,
+             'done': self.done,
+             'meta': dict(self.meta),
+             'spans': spans}
+        if self.parent_span is not None:
+            d['parent_span'] = self.parent_span
+        return d
 
 
 class TraceBuffer:
-    """Bounded ring of COMPLETED traces (oldest evicted first)."""
+    """Bounded ring of COMPLETED traces (oldest evicted first).
+
+    Each added trace gets a monotonically increasing sequence number
+    so the controller's sync-time scrape (``summaries_since``) ships
+    each completed trace at most once — the cursor survives ring
+    eviction (missed traces are simply gone, never re-sent)."""
+
+    # Span cap per shipped summary: a pathological chunked-prefill
+    # request must not blow up the controller's bounded trace store.
+    SUMMARY_MAX_SPANS = 64
 
     def __init__(self, maxlen: int = DEFAULT_BUFFER):
         self._lock = threading.Lock()
         self._traces: 'collections.deque[RequestTrace]' = \
             collections.deque(maxlen=max(1, maxlen))
+        self._seqs: 'collections.deque[int]' = \
+            collections.deque(maxlen=max(1, maxlen))
+        self._next_seq = 1
 
     def add(self, trace: RequestTrace) -> None:
         with self._lock:
             self._traces.append(trace)
+            self._seqs.append(self._next_seq)
+            self._next_seq += 1
 
     def snapshot(self) -> List[RequestTrace]:
         with self._lock:
             return list(self._traces)
+
+    def summaries_since(self, cursor: int,
+                        limit: int = 128
+                        ) -> Tuple[int, List[Dict[str, Any]]]:
+        """(new_cursor, completed-trace dicts added after ``cursor``),
+        oldest first, at most ``limit`` — the bounded payload a replica
+        ships to the controller on the sync/probe path."""
+        with self._lock:
+            pairs = [(s, t) for s, t in zip(self._seqs, self._traces)
+                     if s > cursor]
+            tail_cursor = self._next_seq - 1
+        trimmed = pairs[:max(0, int(limit))]
+        out = []
+        for _, trace in trimmed:
+            d = trace.to_dict()
+            if len(d['spans']) > self.SUMMARY_MAX_SPANS:
+                d['spans'] = d['spans'][:self.SUMMARY_MAX_SPANS]
+                d['meta']['spans_truncated'] = True
+            out.append(d)
+        if len(trimmed) < len(pairs):
+            # ``limit`` trimmed the batch: resume from the last shipped
+            # trace, not the ring head — the rest ships next sync.
+            return trimmed[-1][0] if trimmed else cursor, out
+        return max(cursor, tail_cursor), out
 
     def to_json(self, limit: Optional[int] = None) -> List[Dict[str, Any]]:
         """Newest-first trace dicts (the ``/debug/requests`` body)."""
@@ -155,6 +263,14 @@ class TraceBuffer:
     def find(self, request_id: int) -> Optional[RequestTrace]:
         for t in reversed(self.snapshot()):
             if t.request_id == request_id:
+                return t
+        return None
+
+    def find_trace(self, trace_id: str) -> Optional[RequestTrace]:
+        """Lookup by 128-bit trace id (or, for one release, the old
+        ``pid-seq`` legacy id)."""
+        for t in reversed(self.snapshot()):
+            if t.trace_id == trace_id or t.legacy_id == trace_id:
                 return t
         return None
 

@@ -173,6 +173,29 @@ SERVICE_SCHEMA: Dict[str, Any] = {
                 'hosts': {'type': 'integer', 'minimum': 1},
             },
         },
+        # Per-tier service-level objectives: tier name -> objectives.
+        # The controller's fleet aggregator evaluates 5m/1h burn rates
+        # against these (telemetry/fleet.py) and exports
+        # skytpu_slo_burn_rate{tier,window} / skytpu_slo_attainment.
+        'slos': {
+            'type': 'object',
+            'additionalProperties': {
+                'type': 'object',
+                'additionalProperties': False,
+                'properties': {
+                    'ttft_ms': {'type': 'number',
+                                'exclusiveMinimum': 0},
+                    'tpot_ms': {'type': 'number',
+                                'exclusiveMinimum': 0},
+                    'shed_rate': {'type': 'number',
+                                  'exclusiveMinimum': 0,
+                                  'maximum': 1},
+                    'target': {'type': 'number',
+                               'exclusiveMinimum': 0,
+                               'exclusiveMaximum': 1},
+                },
+            },
+        },
     },
 }
 

@@ -1253,6 +1253,75 @@ def test_gc122_real_policy_module_clean():
     assert vs == [], [f'{v.rule}:{v.line}' for v in vs]
 
 
+# ------------------------------------------------------------------ GC123
+def test_gc123_request_with_body_flagged():
+    # A body-carrying hop built straight on urllib under serve/ cannot
+    # carry the X-Skytpu-Trace header — the trace loses the leg.
+    src = '''
+    import urllib.request
+    def push(url, body):
+        req = urllib.request.Request(url, data=body, method='POST')
+        return urllib.request.urlopen(req, timeout=5)
+    '''
+    vs = check(src)
+    assert [v.rule for v in vs] == ['GC123']
+    assert 'wire' in vs[0].message
+
+
+def test_gc123_positional_data_flagged():
+    # The data arg smuggled positionally is the same untraced hop.
+    src = '''
+    from urllib import request
+    def push(url, body):
+        return request.Request(url, body)
+    '''
+    assert 'GC123' in rule_ids(src)
+
+
+def test_gc123_bodyless_get_clean():
+    # GETs carry no body; probes/scrapes stay on plain urlopen.
+    src = '''
+    import urllib.request
+    def scrape(url):
+        req = urllib.request.Request(url, data=None)
+        with urllib.request.urlopen(url, timeout=2) as resp:
+            return resp.read()
+    '''
+    assert 'GC123' not in rule_ids(src)
+
+
+def test_gc123_probe_scope_exempt():
+    # Readiness probes may POST post_data by spec — they are not part
+    # of any request odyssey, so the helper is not required.
+    src = '''
+    import urllib.request
+    def probe_http(url, post_data):
+        req = urllib.request.Request(url, data=post_data)
+        return urllib.request.urlopen(req, timeout=5)
+    '''
+    assert 'GC123' not in rule_ids(src)
+
+
+def test_gc123_wire_helper_itself_exempt():
+    # serve/wire.py IS the helper — the raw call lives there by design.
+    src = '''
+    import urllib.request
+    def post_json(url, payload):
+        req = urllib.request.Request(url, data=payload)
+        return urllib.request.urlopen(req, timeout=5)
+    '''
+    assert 'GC123' not in rule_ids(src, 'skypilot_tpu/serve/wire.py')
+
+
+def test_gc123_only_polices_serve():
+    src = '''
+    import urllib.request
+    def report(url, body):
+        urllib.request.urlopen(url, body, 5)
+    '''
+    assert 'GC123' not in rule_ids(src, 'skypilot_tpu/usage_lib.py')
+
+
 # --------------------------------------------- aliased-import timing
 def test_gc109_aliased_time_imports_flagged():
     # ``from time import time as now`` / ``import time as t`` must not

@@ -126,6 +126,98 @@ class TestBasics:
         assert 'No existing clusters' in out
 
 
+class TestFleetCli:
+    """`skytpu fleet` / `skytpu telemetry dump --fleet` against a REAL
+    controller whose aggregator was populated by the simulator (the
+    sim drives the identical FleetAggregator code on the virtual
+    clock), served over its real HTTP handler."""
+
+    @pytest.fixture()
+    def fleet_controller_url(self):
+        import http.server as hs
+        import threading
+
+        from skypilot_tpu.serve import replica_managers
+        from skypilot_tpu.serve.service_spec import SkyServiceSpec
+        from skypilot_tpu.serve.sim import replica as sim_replica
+        from skypilot_tpu.serve.sim import traffic as sim_traffic
+        from skypilot_tpu.serve.sim.fleet import FleetSimulator
+        from skypilot_tpu.utils import common_utils
+        sim = FleetSimulator(
+            spec=SkyServiceSpec(
+                readiness_path='/readiness', min_replicas=2,
+                max_replicas=2,
+                slos={'latency': {'ttft_ms': 2000.0, 'target': 0.9}}),
+            trace=sim_traffic.constant(4.0, 120.0), seed=0,
+            curve=sim_replica.ServiceCurve(
+                ttft_base_s=0.1, warm_ttft_base_s=0.05,
+                prefill_tok_per_s=2000.0, tpot_s=0.02, slots=4,
+                max_queue_wait_s=5.0, kv_pool_tokens=4000),
+            provision_s=10.0, provision_jitter=0.0, keep_log=False)
+        sim.run()
+        assert sim.controller.fleet.source_count() > 0
+        port = common_utils.find_free_port(21500)
+        httpd = hs.ThreadingHTTPServer(('127.0.0.1', port),
+                                       sim.controller._make_handler())
+        threading.Thread(target=httpd.serve_forever,
+                         daemon=True).start()
+        try:
+            yield f'http://127.0.0.1:{port}'
+        finally:
+            httpd.shutdown()
+            httpd.server_close()
+
+    def test_fleet_top_smoke(self, runner, fleet_controller_url):
+        out = _ok(runner.invoke(
+            cli.cli, ['fleet', 'top', '--url', fleet_controller_url]))
+        assert 'sources' in out and 'scrapes' in out
+        assert 'TTFT_MEAN_MS' in out           # sim traffic was scraped
+        assert 'slo latency' in out
+        assert 'burn_5m=' in out and 'burn_1h=' in out
+
+    def test_fleet_slo_and_trace_listing(self, runner,
+                                         fleet_controller_url):
+        import json
+        out = _ok(runner.invoke(
+            cli.cli, ['fleet', 'slo', '--url', fleet_controller_url]))
+        slo = json.loads(out)
+        assert 'latency' in slo
+        assert {'attainment', 'burn_5m', 'burn_1h'} <= set(
+            slo['latency'])
+        out = _ok(runner.invoke(
+            cli.cli, ['fleet', 'trace', '--url', fleet_controller_url]))
+        ids = [line for line in out.splitlines() if line]
+        assert ids                              # completed traces shipped
+        assembled = json.loads(_ok(runner.invoke(
+            cli.cli, ['fleet', 'trace', '--url', fleet_controller_url,
+                      ids[0]])))
+        assert assembled['trace_id'] == ids[0]
+        assert assembled['spans']
+
+    def test_fleet_trace_unknown_id_fails(self, runner,
+                                          fleet_controller_url):
+        result = runner.invoke(
+            cli.cli, ['fleet', 'trace', '--url', fleet_controller_url,
+                      'ff' * 16])
+        assert result.exit_code != 0
+        assert 'not found' in result.output
+
+    def test_telemetry_dump_fleet_flags_require_url(self, runner):
+        for args in (['telemetry', 'dump', '--fleet'],
+                     ['telemetry', 'dump', '--trace', 'ab' * 16]):
+            result = runner.invoke(cli.cli, args)
+            assert result.exit_code != 0
+            assert 'require --url' in result.output
+
+    def test_telemetry_dump_fleet_view(self, runner,
+                                       fleet_controller_url):
+        out = _ok(runner.invoke(
+            cli.cli, ['telemetry', 'dump', '--fleet', '--url',
+                      fleet_controller_url]))
+        assert 'skytpu_fleet_sources' in out    # prometheus exposition
+        assert 'skytpu_slo_burn_rate' in out
+
+
 class TestLifecycle:
 
     def test_launch_dryrun(self, runner, task_yaml):
