@@ -373,13 +373,17 @@ def _tiny_engine(kind: str, chunked: bool, speculate_k: int = 0,
                  mesh_tp: int = 0, mesh_dp: int = 0,
                  quantize: Optional[str] = None,
                  decode_steps_per_call: Optional[int] = None,
-                 decode_impl: Optional[str] = None):
+                 decode_impl: Optional[str] = None,
+                 adapter_slots: int = 0, adapter_rank: int = 8):
     from skypilot_tpu.models import configs
     cfg = configs.get_config('tiny')
     chunk = 16 if chunked else 0
     extra: Dict[str, Any] = {}
     if quantize is not None:
         extra['quantize'] = quantize
+    if adapter_slots:
+        extra['adapter_slots'] = adapter_slots
+        extra['adapter_rank'] = adapter_rank
     if decode_steps_per_call is not None:
         extra['decode_steps_per_call'] = decode_steps_per_call
     if decode_impl is not None:
@@ -571,7 +575,9 @@ def _decode_chain_collectives(engine, inner, captured
         except TypeError:
             pass        # the paged merge: different signature
         cache, table, lengths, active = args[1], args[2], args[4], args[9]
-        horizon = args[10]
+        # args[10]/args[11] are the adapter indices / vocab mask; the
+        # merge consumes neither.
+        horizon = args[12]
         cfg = engine.cfg
         ring = jax.ShapeDtypeStruct(
             (cfg.n_layers, engine.max_batch, horizon, cfg.n_kv_heads,
@@ -845,6 +851,92 @@ def audit_spec_multistep(k: int = 4, steps: int = 3) -> AuditReport:
              if key.get('rounds') != steps]
     report.compile_counts['fused keys at rounds != steps'] = (
         0, len(bad_r))
+    return report
+
+
+def audit_adapters(kind: str = 'paged') -> AuditReport:
+    """Batched multi-LoRA decode under adapter-bank churn.
+
+    A tiny engine with a 2-slot adapter bank serves waves where two
+    slots decode under DIFFERENT adapters and one decodes the base
+    model (zero-adapter row) — the gathered bank matmul rides inside
+    the same fused programs. Between audited waves the wave's adapter
+    pair rotates through four registered adapters, so every audited
+    wave LRU-evicts both bank rows and loads two fresh ones. Steady
+    state must show:
+
+    - zero unsanctioned d2h and zero jit-cache growth across the
+      churn waves: load/evict re-uploads bank rows (donated
+      ``set_bank_row`` updates), it NEVER recompiles — the bank lives
+      in params, so the (horizon, sample[, bucket]) jit key does not
+      grow an adapter dimension;
+    - the expected load/evict counts actually happened (2 loads + 2
+      evictions per audited wave) — a silent cache hit would mean the
+      churn, and therefore the gate, never ran;
+    - the armed byte budget (costmodel BYTE_BUDGETS['adapters']): the
+      decode dispatch's ``adapter_bank``-class HBM reads stay at
+      bank-rows-touched bytes — the gather interpreter bills rows
+      actually gathered, so a regression that reads the whole bank
+      (or dequants it into activations) trips the ceiling."""
+    import numpy as np
+
+    from skypilot_tpu.models import multilora
+    report = AuditReport(
+        name=f'{kind} engine (chunked prefill + decode + multi-LoRA '
+             f'bank churn, 2 slots x 4 adapters)')
+    engine = _tiny_engine(kind, chunked=True,
+                          adapter_slots=2, adapter_rank=4)
+    cfg = engine.cfg
+    rng = np.random.default_rng(0)
+    names = [f'ad{i}' for i in range(4)]
+    for i, name in enumerate(names):
+        tree = {}
+        for t in multilora.default_targets(cfg):
+            a_shape, b_shape = multilora.target_shapes(cfg, t, 4)
+            tree[t] = {
+                'a': rng.normal(0, 0.02, (cfg.n_layers,) + a_shape
+                                ).astype(np.float32),
+                'b': rng.normal(0, 0.02, (cfg.n_layers,) + b_shape
+                                ).astype(np.float32)}
+        engine.adapters.register(name, tree, scale=1.0 + i)
+    prompts = [[1, 2, 3] * 9, [4, 5] * 10, [7] * 21]    # >1 chunk
+
+    def wave(pair) -> None:
+        # Two adapter rows + one base row per wave: the zero-adapter
+        # slot rides the SAME gathered dispatch (where-select row).
+        for p, adapter in zip(prompts, (pair[0], pair[1], None)):
+            engine.add_request(list(p), max_new_tokens=8,
+                               adapter=adapter)
+        engine.run_to_completion(horizon=8)
+
+    wave(names[0:2])           # warmup: compiles (incl. set_bank_row)
+    wave(names[2:4])           # warmup: the evict/re-upload path
+    capture: Dict[str, Any] = {}
+    inner = _record_static_keys(engine, report, capture)
+    decode_jits = _jit_fns(inner)
+    labels = {'decode': lambda: (sum(_cache_size(f)
+                                     for f in decode_jits)
+                                 if decode_jits else -1),
+              'prefill': lambda: len(engine._prefill_fns)}
+    chunk_fns = getattr(engine, '_chunk_prefill_fns', None)
+    if chunk_fns is not None:
+        labels['chunk_prefill'] = lambda: len(chunk_fns)
+    before = {k: get() for k, get in labels.items()}
+    reg = engine.adapters
+    loads0, evicts0 = reg.loads_total, reg.evictions_total
+    rounds = 2
+    with intercept_host_transfers(report.transfers):
+        for i in range(rounds):
+            # Rotate the pair: every audited wave evicts both rows.
+            wave(names[0:2] if i % 2 == 0 else names[2:4])
+    engine._decode_fn = inner
+    report.compile_counts = {
+        k: (before[k], get()) for k, get in labels.items()}
+    report.compile_counts['adapter loads per churn wave (x2)'] = (
+        rounds * 2, reg.loads_total - loads0)
+    report.compile_counts['adapter evictions per churn wave (x2)'] = (
+        rounds * 2, reg.evictions_total - evicts0)
+    _attach_costs(report, engine, inner, capture)
     return report
 
 
@@ -1182,6 +1274,12 @@ PRESETS: Dict[str, Callable[[], AuditReport]] = {
     # compose into ONE dispatch per `steps` verify rounds, pinned
     # against a single-round reference engine's dispatch count.
     'spec-multistep': audit_spec_multistep,
+    # Batched multi-LoRA bank churn: loads/evicts between waves
+    # re-upload bank rows with zero recompiles and zero unsanctioned
+    # d2h; the gather matmul bills bank-rows-touched bytes (armed
+    # byte budget on the adapter_bank class).
+    'adapters': audit_adapters,
+    'adapters-slot': lambda: audit_adapters('slot'),
     # Prefix-digest export on the LB probe path: a hot_prefix_digest()
     # scrape after every wave adds zero unsanctioned d2h and zero
     # jit-cache growth (host-side heat tracker only), and every scrape
@@ -1210,7 +1308,8 @@ DEFAULT_PRESETS: List[str] = [
     'kv-int8', 'kv-int8-slot', 'kv-int4', 'kv-int4-slot',
     'fused-attn', 'paged-tp', 'paged-tp-int8',
     'paged-gang', 'disagg', 'int4', 'multistep', 'int4-multistep',
-    'spec-multistep', 'digest', 'fleet-obs', 'llama']
+    'spec-multistep', 'adapters', 'adapters-slot', 'digest',
+    'fleet-obs', 'llama']
 
 
 def run_preset(name: str) -> AuditReport:

@@ -72,6 +72,14 @@ class Request:
     # failure (colocated fallback). Keeps a prefill worker's chips on
     # prefill instead of racing the handoff with local decode.
     hold: bool = False
+    # Multi-tenant serving: which bank adapter this request decodes
+    # with (None = base model, byte-identical to an adapter-less
+    # engine), which tenant submitted it (telemetry label only), and an
+    # optional grammar constraint ('json' | token-id list | [vocab]
+    # bool mask) compiled into a vocab logit mask at admission.
+    adapter: Optional[str] = None
+    tenant: Optional[str] = None
+    grammar: Any = None
     # filled by the engine
     output: List[int] = dataclasses.field(default_factory=list)
     submit_time: float = 0.0
@@ -86,6 +94,13 @@ class Request:
     # request's tail tokens surfaced through the async pipeline.
     _enq_out: int = 0
     _early_freed: bool = False
+    # Adapter-bank pin state: the bank slot this request gathers
+    # (-1 = none), the compiled [vocab] bool mask (host numpy) its
+    # grammar produced, and whether its registry pin was released
+    # (every exit path releases exactly once).
+    _adapter_slot: int = -1
+    _vocab_mask: Optional[Any] = None
+    _adapter_released: bool = False
     # Per-request lifecycle trace (telemetry.tracing.RequestTrace;
     # None when engine telemetry is off).
     trace: Optional[Any] = None
@@ -391,6 +406,16 @@ class _EngineBase:
         self._tok_dev = jnp.zeros((max_batch,), jnp.int32)
         self._merge_tokens = jax.jit(
             lambda tok, slots, vals: tok.at[slots].set(vals))
+        # Multi-LoRA / grammar per-slot state: device adapter indices
+        # ([b] int32, -1 = base) and vocab masks ([b, vocab] bool),
+        # rebuilt with the slot-meta tuple. _vmask_any is STICKY: once
+        # any grammar request is seen, decode programs keep receiving a
+        # mask array (all-True for unconstrained rows) — flipping
+        # None<->array changes the jit treedef, and one recompile per
+        # program shape is the ceiling we accept.
+        self._adp_dev: Optional[Any] = None
+        self._vmask_dev: Optional[Any] = None
+        self._vmask_any = False
 
     def _step_out_shardings(self, n_lead: int) -> Dict[str, Any]:
         """jit kwargs pinning a step program's CACHE output to the
@@ -424,6 +449,16 @@ class _EngineBase:
                             np.float32),
                 jnp.asarray(np.array([r is not None for r in ready])),
                 bool((temps > 0).any()))
+            if getattr(self, 'adapters', None) is not None:
+                self._adp_dev = device_upload(np.array(
+                    [r._adapter_slot if r is not None else -1
+                     for r in ready], np.int32))
+            if self._vmask_any:
+                vm = np.ones((len(ready), self.cfg.vocab_size), bool)
+                for i, r in enumerate(ready):
+                    if r is not None and r._vocab_mask is not None:
+                        vm[i] = r._vocab_mask
+                self._vmask_dev = device_upload(vm)
             self._meta_dirty = False
         return self._meta_dev
 
@@ -459,7 +494,10 @@ class _EngineBase:
                     temperature: float = 0.0, top_k: int = 0,
                     top_p: float = 1.0, eos_id: Optional[int] = None,
                     stop: Optional[List[List[int]]] = None,
-                    priority: int = 0, hold: bool = False) -> int:
+                    priority: int = 0, hold: bool = False,
+                    adapter: Optional[str] = None,
+                    tenant: Optional[str] = None,
+                    grammar: Any = None) -> int:
         if not prompt:
             raise ValueError('empty prompt')
         if not 0.0 < top_p <= 1.0:
@@ -467,11 +505,34 @@ class _EngineBase:
         if stop:
             stop = [list(s) for s in stop if s]
         self._validate_request(prompt, max_new_tokens)
+        registry = getattr(self, 'adapters', None)
+        if adapter is not None and registry is None:
+            raise ValueError(
+                f'request names adapter {adapter!r} but the engine has '
+                f'no adapter bank (adapter_slots=0)')
+        vocab_mask = None
+        if grammar is not None:
+            from skypilot_tpu.inference import adapters as adapters_lib
+            vocab_mask = adapters_lib.compile_grammar(
+                grammar, self.cfg.vocab_size, eos_id)
+        # Pin the adapter BEFORE building the request: a bank-full /
+        # unknown-adapter error must reject at admission, not mid-step.
+        adapter_slot = -1
+        if adapter is not None:
+            adapter_slot = registry.acquire(adapter)
         req = Request(request_id=self._next_id, prompt=list(prompt),
                       max_new_tokens=max_new_tokens, temperature=temperature,
                       top_k=top_k, top_p=top_p, eos_id=eos_id,
                       stop=stop or None, priority=int(priority),
-                      hold=bool(hold), submit_time=clock.now())
+                      hold=bool(hold), adapter=adapter, tenant=tenant,
+                      grammar=grammar, submit_time=clock.now())
+        req._adapter_slot = adapter_slot
+        req._vocab_mask = vocab_mask
+        if vocab_mask is not None:
+            self._vmask_any = True
+            self._meta_dirty = True
+        if registry is not None:
+            registry.note_request(adapter)
         if self.telemetry_enabled:
             req.trace = tracing.RequestTrace(req.request_id)
             req.trace.begin('queue', prompt_tokens=len(prompt),
@@ -565,6 +626,7 @@ class _EngineBase:
         req.nan_evicted = True
         req.finish_time = clock.now()
         self.nan_evictions += 1
+        self._release_adapter(req)
         self._trace_finish(req, nan_evicted=True)
         if 0 <= slot < len(self._slots) and self._slots[slot] is req:
             self._free_slot(slot)
@@ -725,11 +787,13 @@ class _EngineBase:
         self._queue = collections.deque(
             r for r in self._queue if r.request_id != request_id)
         if dropped:
+            self._release_adapter(dropped[0])
             self._trace_finish(dropped[0], cancelled=True)
             return True
         for slot, req in enumerate(self._slots):
             if req is not None and req.request_id == request_id:
                 req.finish_time = clock.now()
+                self._release_adapter(req)
                 self._trace_finish(req, cancelled=True)
                 self._free_slot(slot)
                 return True
@@ -1087,15 +1151,28 @@ class _EngineBase:
         if done:
             req.finish_time = clock.now()
             self._finished[req.request_id] = req
+            self._release_adapter(req)
             self._trace_finish(req, stop_hit=req.stop_hit)
             if self._slots[slot] is req:
                 self._free_slot(slot)
         return done
 
+    def _release_adapter(self, req) -> None:
+        """Drop this request's adapter-bank pin, exactly once per
+        request lifetime (finish, cancel, and NaN eviction all call
+        this; the flag makes overlapping exit paths safe)."""
+        if req.adapter is None or req._adapter_released:
+            return
+        req._adapter_released = True
+        registry = getattr(self, 'adapters', None)
+        if registry is not None:
+            registry.release(req.adapter)
+
 
 def _slot_spec_verify(params, big_cache, tokens, proposals, n_prop,
                       temps, topks, topps, active, rng, *, cfg,
-                      attn_impl, kv_bucket, max_seq, k, sample):
+                      attn_impl, kv_bucket, max_seq, k, sample,
+                      mlora_idx=None, vocab_mask=None):
     """One speculative verify round over the slot cache — the traced
     body shared by the single-round jit (``_get_spec_verify``) and the
     fused in-scan rounds (``_get_spec_fused``): one forward over the
@@ -1121,7 +1198,13 @@ def _slot_spec_verify(params, big_cache, tokens, proposals, n_prop,
         attn_impl=attn_impl,
         quantize_rows=('int4' if big_cache.packed
                        else big_cache.quantized),
-        cache_kv=cache_kv, cache_len=len0, all_logits=True)
+        cache_kv=cache_kv, cache_len=len0, all_logits=True,
+        mlora_idx=mlora_idx)
+    # Grammar masks constrain verification too — the [n, k+1, vocab]
+    # logits mask broadcasts over the k+1 verify positions, so a
+    # proposal outside the grammar is rejected exactly like any other
+    # mismatching draft.
+    logits = llama.apply_vocab_mask(logits, vocab_mask)
     commit, n_commit = speculative.verify_tokens(
         logits, proposals, n_prop, rng, temps, topks, topps,
         sample=sample)
@@ -1180,6 +1263,10 @@ class InferenceEngine(SpeculativeMixin, _EngineBase):
                  decode_priority_ratio: Optional[float] = None,
                  decode_steps_per_call: Optional[int] = None,
                  speculate_k: int = 0,
+                 adapter_slots: int = 0,
+                 adapter_dir: Optional[str] = None,
+                 adapter_rank: int = 8,
+                 adapter_targets: Optional[Any] = None,
                  telemetry: bool = True):
         self._init_telemetry(telemetry)
         self.max_batch = max_batch
@@ -1249,6 +1336,17 @@ class InferenceEngine(SpeculativeMixin, _EngineBase):
         # slot bookkeeping (host side); device cache.length is
         # authoritative for attention masking.
         self._init_slots(max_batch)
+        # Multi-tenant adapter bank (adapter_slots > 0): installs the
+        # stacked multi-LoRA bank into params['layers']['mlora'] BEFORE
+        # the decode programs trace, so every program below carries the
+        # batched gather matmul. adapter_slots=0 leaves the params tree
+        # — and every traced program — byte-identical to before.
+        self.adapters = None
+        if adapter_slots > 0:
+            from skypilot_tpu.inference import adapters as adapters_lib
+            self.adapters = adapters_lib.AdapterRegistry(
+                self, slots=adapter_slots, rank=adapter_rank,
+                adapter_dir=adapter_dir, targets=adapter_targets)
         self._decode_fn = self._build_decode()
         self._prefill_fns: Dict[int, Any] = {}
         # Chunked-prefill scheduler state: slot -> prompt tokens
@@ -1448,7 +1546,7 @@ class InferenceEngine(SpeculativeMixin, _EngineBase):
                                             'kv_bucket'),
                            **self._step_out_shardings(1))
         def decode_steps(params, cache, tokens, rng, temps, topks, topps,
-                         active, horizon, sample, kv_bucket):
+                         active, adp, vmask, horizon, sample, kv_bucket):
             if sample:
                 def sample_fn(logits, step_rng):
                     return sample_tokens(logits, step_rng, temps, topks,
@@ -1458,7 +1556,8 @@ class InferenceEngine(SpeculativeMixin, _EngineBase):
                 sample_fn, rngs = None, None
             toks, cache = llama.decode_horizon(
                 params, cache, tokens, cfg, horizon=horizon,
-                sample_fn=sample_fn, rngs=rngs, kv_bucket=kv_bucket)
+                sample_fn=sample_fn, rngs=rngs, kv_bucket=kv_bucket,
+                mlora_idx=adp, vocab_mask=vmask)
             # inactive slots don't advance their cache length
             new_len = jnp.where(active, cache.length,
                                 cache.length - horizon)
@@ -1487,12 +1586,15 @@ class InferenceEngine(SpeculativeMixin, _EngineBase):
 
         @functools.partial(jax.jit, donate_argnums=(1,),
                            **self._step_out_shardings(1))
-        def prefill(params, big_cache, tokens, true_lens, slots):
+        def prefill(params, big_cache, tokens, true_lens, slots,
+                    adp, vmask):
             """tokens [n, bucket]; true_lens [n]; slots [n] target rows."""
             last, rows = llama.prefill_rows(
                 params, tokens, true_lens, cfg, attn_impl=attn_impl,
                 quantize_rows=('int4' if big_cache.packed
-                               else big_cache.quantized), w8a8=w8a8)
+                               else big_cache.quantized), w8a8=w8a8,
+                mlora_idx=adp)
+            last = llama.apply_vocab_mask(last, vmask)
             next_tokens = llama.mask_nonfinite_tokens(
                 last, jnp.argmax(last, -1).astype(jnp.int32))
             # Scatter KV rows + lengths into the slot cache.
@@ -1635,6 +1737,10 @@ class InferenceEngine(SpeculativeMixin, _EngineBase):
         temps = np.zeros(n, np.float32)
         topks = np.zeros(n, np.int32)
         topps = np.ones(n, np.float32)
+        adp_h = (np.full(n, -1, np.int32)
+                 if self.adapters is not None else None)
+        vm_h = (np.ones((n, self.cfg.vocab_size), bool)
+                if self._vmask_any else None)
         for i, slot in enumerate(batch):
             req = self._slots[slot]
             off = self._prefill_off[slot]
@@ -1648,6 +1754,10 @@ class InferenceEngine(SpeculativeMixin, _EngineBase):
             temps[i] = req.temperature
             topks[i] = req.top_k or 0
             topps[i] = req.top_p
+            if adp_h is not None:
+                adp_h[i] = req._adapter_slot
+            if vm_h is not None and req._vocab_mask is not None:
+                vm_h[i] = req._vocab_mask
         # Sampling variant only when a COMPLETING row needs it (the
         # full-vocab sort costs hundreds of ms on TPU; mid-prompt
         # chunks and greedy completions must not pay it).
@@ -1657,10 +1767,15 @@ class InferenceEngine(SpeculativeMixin, _EngineBase):
         # ONE batched host->device transfer for every host-built
         # operand (each separate jnp.asarray is its own dispatch round
         # trip through a remote tunnel).
-        (tokens_d, starts_d, valid_d, want_d, slots_d, temps_d,
-         topks_d, topps_d) = device_upload(
+        extras = tuple(x for x in (adp_h, vm_h) if x is not None)
+        uploaded = device_upload(
             (tokens, starts, valid, want, slots_arr, temps, topks,
-             topps))
+             topps) + extras)
+        (tokens_d, starts_d, valid_d, want_d, slots_d, temps_d,
+         topks_d, topps_d) = uploaded[:8]
+        rest = list(uploaded[8:])
+        adp_d = rest.pop(0) if adp_h is not None else None
+        vm_d = rest.pop(0) if vm_h is not None else None
         prefill = self._get_chunk_prefill(n, chunk_w, kv_bucket, sample)
         chunk_t0 = clock.monotonic()
         with self._prof.phase('prefill_chunk'), \
@@ -1668,7 +1783,8 @@ class InferenceEngine(SpeculativeMixin, _EngineBase):
                                    (n, chunk_w, kv_bucket, sample)):
             first, self.cache = prefill(
                 self.params, self.cache, tokens_d, starts_d, valid_d,
-                want_d, slots_d, temps_d, topks_d, topps_d, prng)
+                want_d, slots_d, adp_d, vm_d, temps_d, topks_d,
+                topps_d, prng)
         chunk_t1 = clock.monotonic()
         for i, slot in enumerate(batch):
             r = self._slots[slot]
@@ -1721,7 +1837,7 @@ class InferenceEngine(SpeculativeMixin, _EngineBase):
         @functools.partial(jax.jit, donate_argnums=(1,),
                            **self._step_out_shardings(1))
         def prefill(params, big_cache, tokens, starts, valid, want_idx,
-                    slots, temps, topks, topps, rng):
+                    slots, adp, vmask, temps, topks, topps, rng):
             if kv_bucket:
                 ck = big_cache.k[:, slots, :kv_bucket]
                 cv = big_cache.v[:, slots, :kv_bucket]
@@ -1739,7 +1855,10 @@ class InferenceEngine(SpeculativeMixin, _EngineBase):
                 quantize_rows=('int4' if big_cache.packed
                                else big_cache.quantized), w8a8=w8a8,
                 cache_kv=cache_kv,
-                cache_len=starts if kv_bucket else None)
+                cache_len=starts if kv_bucket else None,
+                mlora_idx=adp)
+            # Completing rows' first sampled token honors the grammar.
+            last = llama.apply_vocab_mask(last, vmask)
             if sample:
                 first = sample_tokens(last, rng, temps, topks, topps)
             else:
@@ -1792,12 +1911,13 @@ class InferenceEngine(SpeculativeMixin, _EngineBase):
         @functools.partial(jax.jit, donate_argnums=(1,),
                            **self._step_out_shardings(3))
         def verify(params, big_cache, tokens, proposals, n_prop, temps,
-                   topks, topps, active, rng):
+                   topks, topps, active, adp, vmask, rng):
             return _slot_spec_verify(
                 params, big_cache, tokens, proposals, n_prop, temps,
                 topks, topps, active, rng, cfg=cfg,
                 attn_impl=attn_impl, kv_bucket=kv_bucket,
-                max_seq=max_seq, k=k, sample=sample)
+                max_seq=max_seq, k=k, sample=sample,
+                mlora_idx=adp, vocab_mask=vmask)
 
         self._spec_verify_fns[key] = verify
         return verify
@@ -1825,7 +1945,7 @@ class InferenceEngine(SpeculativeMixin, _EngineBase):
         @functools.partial(jax.jit, donate_argnums=(1,),
                            **self._step_out_shardings(4))
         def fused(params, big_cache, tokens, hist, rem, temps, topks,
-                  topps, active, rngs):
+                  topps, active, adp, vmask, rngs):
             def round_body(carry, rng):
                 cache, tok, hist, rem = carry
                 prop, n_prop = speculative.ngram_propose_device(
@@ -1840,7 +1960,8 @@ class InferenceEngine(SpeculativeMixin, _EngineBase):
                         params, cache, tok, prop, n_prop, temps,
                         topks, topps, act, rng, cfg=cfg,
                         attn_impl=attn_impl, kv_bucket=kv_bucket,
-                        max_seq=max_seq, k=k, sample=sample)
+                        max_seq=max_seq, k=k, sample=sample,
+                        mlora_idx=adp, vocab_mask=vmask)
                 # History carry: append the commit row and re-right-
                 # align (shift left by n_commit; uncommitted positions
                 # land past the window and are never gathered).
@@ -1877,7 +1998,8 @@ class InferenceEngine(SpeculativeMixin, _EngineBase):
                                 (self.speculate_k, sample, kv_bucket)):
             commit, n_commit, self._tok_dev, self.cache = verify(
                 self.params, self.cache, self._tok_dev, prop_d, n_prop_d,
-                temps_d, topks_d, topps_d, active_d, rng)
+                temps_d, topks_d, topps_d, active_d, self._adp_dev,
+                self._vmask_dev, rng)
         return commit, n_commit
 
     def _spec_fused_call(self, ready, rounds):
@@ -1906,7 +2028,7 @@ class InferenceEngine(SpeculativeMixin, _EngineBase):
             commits, n_commits, n_props, self._tok_dev, self.cache = \
                 fused(self.params, self.cache, self._tok_dev, hist_d,
                       rem_d, temps_d, topks_d, topps_d, active_d,
-                      keys[1:])
+                      self._adp_dev, self._vmask_dev, keys[1:])
         return commits, n_commits, n_props
 
     def step(self, horizon: int = 1) -> List[Tuple[int, int, bool]]:
@@ -2012,16 +2134,27 @@ class InferenceEngine(SpeculativeMixin, _EngineBase):
         tokens = np.zeros((n, bucket), np.int32)
         true_lens = np.zeros(n, np.int32)
         slots = np.zeros(n, np.int32)
+        adp_h = (np.full(n, -1, np.int32)
+                 if self.adapters is not None else None)
+        vm_h = (np.ones((n, self.cfg.vocab_size), bool)
+                if self._vmask_any else None)
         for i in range(n):
             slot, req = batch[min(i, len(batch) - 1)]
             tokens[i, :len(req.prompt)] = req.prompt
             true_lens[i] = len(req.prompt)
             slots[i] = slot
+            if adp_h is not None:
+                adp_h[i] = req._adapter_slot
+            if vm_h is not None and req._vocab_mask is not None:
+                vm_h[i] = req._vocab_mask
+        adp_d = jnp.asarray(adp_h) if adp_h is not None else None
+        vm_d = jnp.asarray(vm_h) if vm_h is not None else None
         with self._prof.phase('prefill_chunk'), \
                 self._prof.jit_key('prefill', (bucket, n)):
             next_tokens, self.cache = prefill(
                 self.params, self.cache, jnp.asarray(tokens),
-                jnp.asarray(true_lens), jnp.asarray(slots))
+                jnp.asarray(true_lens), jnp.asarray(slots),
+                adp_d, vm_d)
         # Async: reserve the slots NOW (so the next admission wave and
         # _enqueue_decode see them taken) but defer the token readback —
         # the prefill result rides the pipeline and its events surface
@@ -2110,8 +2243,8 @@ class InferenceEngine(SpeculativeMixin, _EngineBase):
         with self._prof.jit_key('decode', (horizon, sample, kv_bucket)):
             toks, self.cache = self._decode_fn(
                 self.params, self.cache, self._tok_dev, rng,
-                temps_d, topks_d, topps_d, active_d, horizon, sample,
-                kv_bucket)
+                temps_d, topks_d, topps_d, active_d, self._adp_dev,
+                self._vmask_dev, horizon, sample, kv_bucket)
         live = int(sum(self._slot_len[s] + self._inflight_steps
                        for s in range(self.max_batch)
                        if ready[s] is not None))
@@ -2183,14 +2316,18 @@ class InferenceEngine(SpeculativeMixin, _EngineBase):
 
 def sample_tokens(logits: jax.Array, step_rng: jax.Array,
                   temps: jax.Array, topks: jax.Array,
-                  topps: jax.Array) -> jax.Array:
+                  topps: jax.Array,
+                  vocab_mask: Optional[jax.Array] = None) -> jax.Array:
     """Per-slot next-token sampling, shared by the slot and paged
-    engines' fused decode: temperature scaling, then top-k and nucleus
-    (top-p) filtering (``llama.filtered_logits`` — one descending sort
-    of the scaled logits, also the distribution speculative verify
-    rejection-samples against), then categorical draw. Rows with
-    temp <= 0 take the greedy argmax; top-k <= 0 and top-p >= 1 disable
-    their filters."""
+    engines' fused decode: optional grammar vocab mask, then
+    temperature scaling, then top-k and nucleus (top-p) filtering
+    (``llama.filtered_logits`` — one descending sort of the scaled
+    logits, also the distribution speculative verify rejection-samples
+    against), then categorical draw. Rows with temp <= 0 take the
+    greedy argmax; top-k <= 0 and top-p >= 1 disable their filters.
+    The mask applies BEFORE the greedy argmax too — a constrained
+    greedy request picks the best ALLOWED token."""
+    logits = llama.apply_vocab_mask(logits, vocab_mask)
     next_greedy = jnp.argmax(logits, -1).astype(jnp.int32)
     masked = llama.filtered_logits(logits, temps, topks, topps)
     sampled = jax.random.categorical(step_rng, masked).astype(jnp.int32)

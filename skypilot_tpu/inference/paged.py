@@ -380,6 +380,11 @@ def paged_decode_horizon(
     active: Optional[jax.Array] = None,
     decode_impl: str = 'gather',       # 'gather' | 'pallas' | 'cross_layer'
     pages_per_block: int = 1,          # pallas path: K pages per DMA loop
+    mlora_idx: Optional[jax.Array] = None,   # [slots] adapter slot per
+                                       # row (-1 = none): multi-LoRA
+                                       # bank gather inside the scan
+    vocab_mask: Optional[jax.Array] = None,  # [slots, vocab] bool
+                                       # constrained-decoding mask
 ):
     """``horizon`` fused decode steps over the paged pool — the twin of
     ``llama.decode_horizon`` with the contiguous cache read replaced by
@@ -482,7 +487,8 @@ def paged_decode_horizon(
                                                  v_scale=scv)
 
             xc, new_kv, _ = llama._layer_core(layer, xc, cfg, positions,
-                                              attn_fn)
+                                              attn_fn,
+                                              mlora_idx=mlora_idx)
             return xc, new_kv
 
         x, (k_rows, v_rows) = lax.scan(
@@ -494,6 +500,9 @@ def paged_decode_horizon(
         x = llama.rms_norm(x, params['final_norm'], cfg.norm_eps,
                            cfg.norm_plus_one)
         logits = llama._unembed_logits(params, x, cfg)[:, 0]
+        # Constrained decoding at logits production (covers the raw
+        # greedy argmax branch too).
+        logits = llama.apply_vocab_mask(logits, vocab_mask)
         if sample_fn is None:
             nxt = jnp.argmax(logits, -1).astype(jnp.int32)
         else:
@@ -544,6 +553,9 @@ def paged_prefill_chunk(
     rng: jax.Array = None,
     w8a8: bool = False,
     mesh=None,
+    mlora_idx: Optional[jax.Array] = None,   # [n] adapter slot per row
+    vocab_mask: Optional[jax.Array] = None,  # [n, vocab] bool mask for
+                                       # the completing rows' first token
 ):
     """One fixed-size prefill chunk for ``n`` slots: attends against the
     pages written so far (each slot's ``lengths``) plus causal
@@ -584,7 +596,7 @@ def paged_prefill_chunk(
                                     k_scale=sck, v_scale=scv)
 
         xc, new_kv, _ = llama._layer_core(layer, xc, cfg, positions,
-                                          attn_fn)
+                                          attn_fn, mlora_idx=mlora_idx)
         # Quantize inside the scan: the stacked [L, n, chunk] ys stay
         # int8 (the bf16 stack is the 7B prefill's biggest transient).
         return xc, _maybe_quantize_rows(new_kv, cache.quant_mode)
@@ -599,6 +611,7 @@ def paged_prefill_chunk(
     idx = jnp.clip(want_idx, 0, chunk - 1)
     last_x = jnp.take_along_axis(x, idx[:, None, None], axis=1)
     logits = llama._unembed_logits(params, last_x, cfg)[:, 0]
+    logits = llama.apply_vocab_mask(logits, vocab_mask)
     # All-greedy batches (the common case) take the argmax path
     # STATICALLY: sample_tokens sorts the [n, vocab] logits, and a TPU
     # sort over vocab=32k costs hundreds of ms — compiled into every
@@ -635,6 +648,10 @@ def paged_spec_verify(
     rng: jax.Array = None,
     w8a8: bool = False,
     mesh=None,
+    mlora_idx: Optional[jax.Array] = None,   # [n] adapter slot per row
+    vocab_mask: Optional[jax.Array] = None,  # [n, vocab] bool mask
+                                       # (broadcast over the k+1 verify
+                                       # positions)
 ):
     """Speculative verify over the paged pool: one forward over the
     ``k+1`` positions ``[t0, d1..dk]`` per slot against the pages
@@ -673,7 +690,7 @@ def paged_spec_verify(
                                     k_scale=sck, v_scale=scv)
 
         xc, new_kv, _ = llama._layer_core(layer, xc, cfg, positions,
-                                          attn_fn)
+                                          attn_fn, mlora_idx=mlora_idx)
         return xc, _maybe_quantize_rows(new_kv, cache.quant_mode)
 
     import contextlib
@@ -684,6 +701,9 @@ def paged_spec_verify(
     x = llama.rms_norm(x, params['final_norm'], cfg.norm_eps,
                        cfg.norm_plus_one)
     logits = llama._unembed_logits(params, x, cfg)      # [n, k+1, v]
+    # Constrained rows verify against the MASKED distribution: both the
+    # acceptance test and the bonus/resample draw obey the grammar.
+    logits = llama.apply_vocab_mask(logits, vocab_mask)
     commit, n_commit = speculative.verify_tokens(
         logits, proposals, n_prop, rng, temps, topks, topps,
         sample=sample)
@@ -874,6 +894,10 @@ class PagedInferenceEngine(SpeculativeMixin, _EngineBase):
                  prefill_w8a8: bool = False,
                  pages_per_block: int = 1,
                  speculate_k: int = 0,
+                 adapter_slots: int = 0,
+                 adapter_dir: Optional[str] = None,
+                 adapter_rank: int = 8,
+                 adapter_targets: Optional[Any] = None,
                  telemetry: bool = True):
         from skypilot_tpu.inference.engine import prepare_params
         from skypilot_tpu.parallel import mesh as mesh_lib
@@ -1044,6 +1068,16 @@ class PagedInferenceEngine(SpeculativeMixin, _EngineBase):
         # inflight count at processing time.
         self._slot_epoch = np.zeros(max_batch, np.int64)
         self._deferred_events: List[Tuple[int, int, bool]] = []
+        # Multi-tenant adapter bank (adapter_slots > 0): the stacked
+        # multi-LoRA bank installs into params['layers']['mlora']
+        # BEFORE any program traces; adapter_slots=0 leaves the params
+        # tree — and every traced program — byte-identical to before.
+        self.adapters = None
+        if adapter_slots > 0:
+            from skypilot_tpu.inference import adapters as adapters_lib
+            self.adapters = adapters_lib.AdapterRegistry(
+                self, slots=adapter_slots, rank=adapter_rank,
+                adapter_dir=adapter_dir, targets=adapter_targets)
         self._decode_fn = self._build_decode()
         self._prefill_fns: Dict[Tuple[int, int], Any] = {}
         # A prefill chunk-batch stacks [L, n, chunk] KV rows as a scan
@@ -1237,7 +1271,8 @@ class PagedInferenceEngine(SpeculativeMixin, _EngineBase):
                            static_argnames=('horizon', 'sample'),
                            **ring_kwargs)
         def decode_steps(params, cache, table_p, tokens, lengths, rng,
-                         temps, topks, topps, active, horizon, sample):
+                         temps, topks, topps, active, adp, vmask,
+                         horizon, sample):
             if sample:
                 def sample_fn(logits, step_rng):
                     from skypilot_tpu.inference.engine import sample_tokens
@@ -1250,18 +1285,19 @@ class PagedInferenceEngine(SpeculativeMixin, _EngineBase):
                 params, cache, table_p, tokens, lengths, cfg,
                 horizon=horizon, sample_fn=sample_fn, rngs=rngs,
                 active=active, decode_impl=decode_impl,
-                pages_per_block=self.pages_per_block)
+                pages_per_block=self.pages_per_block,
+                mlora_idx=adp, vocab_mask=vmask)
 
         merge = jax.jit(functools.partial(merge_ring_into_pool,
                                           mesh=self.mesh),
                         donate_argnums=(0,), **merge_kwargs)
 
         def decode_and_merge(params, cache, table_p, tokens, lengths,
-                             rng, temps, topks, topps, active, horizon,
-                             sample):
+                             rng, temps, topks, topps, active, adp,
+                             vmask, horizon, sample):
             toks, ring_k, ring_v = decode_steps(
                 params, cache, table_p, tokens, lengths, rng, temps,
-                topks, topps, active, horizon, sample)
+                topks, topps, active, adp, vmask, horizon, sample)
             new_cache = merge(cache, ring_k, ring_v, table_p, lengths,
                               active)
             return toks, new_cache
@@ -1280,12 +1316,12 @@ class PagedInferenceEngine(SpeculativeMixin, _EngineBase):
             @functools.partial(jax.jit, donate_argnums=(1,),
                                **self._step_out_shardings(1))
             def prefill(params, cache, table_p, tokens, lengths, valid,
-                        want_idx, temps, topks, topps, rng):
+                        want_idx, adp, vmask, temps, topks, topps, rng):
                 return paged_prefill_chunk(
                     params, cache, table_p, tokens, lengths, valid,
                     want_idx, cfg, temps=temps if sample else None,
                     topks=topks, topps=topps, rng=rng, w8a8=w8a8,
-                    mesh=mesh)
+                    mesh=mesh, mlora_idx=adp, vocab_mask=vmask)
 
             self._prefill_fns[key] = prefill
         return self._prefill_fns[key]
@@ -1652,21 +1688,34 @@ class PagedInferenceEngine(SpeculativeMixin, _EngineBase):
         temps = np.zeros(n, np.float32)
         topks = np.zeros(n, np.int32)
         topps = np.ones(n, np.float32)
+        adp_h = (np.full(n, -1, np.int32)
+                 if self.adapters is not None else None)
+        vm_h = (np.ones((n, self.cfg.vocab_size), bool)
+                if self._vmask_any else None)
         for i, slot in enumerate(batch):
             req = self._slots[slot]
             temps[i] = req.temperature
             topks[i] = req.top_k or 0
             topps[i] = req.top_p
+            if adp_h is not None:
+                adp_h[i] = req._adapter_slot
+            if vm_h is not None and req._vocab_mask is not None:
+                vm_h[i] = req._vocab_mask
         self._rng, prng = jax.random.split(self._rng)   # device op
         # ONE batched host->device transfer for every host-built
         # operand: each separate jnp.asarray is its own dispatch round
         # trip (~100-600 ms through the remote tunnel) — nine of them
         # measured as multi-second admission spikes that halved
         # sustained throughput.
-        (table_d, tokens_d, lengths_d, valid_d, want_d, temps_d,
-         topks_d, topps_d) = device_upload(
+        extras = tuple(x for x in (adp_h, vm_h) if x is not None)
+        uploaded = device_upload(
             (table_p, tokens, lengths, valid, want, temps, topks,
-             topps))
+             topps) + extras)
+        (table_d, tokens_d, lengths_d, valid_d, want_d, temps_d,
+         topks_d, topps_d) = uploaded[:8]
+        rest = list(uploaded[8:])
+        adp_d = rest.pop(0) if adp_h is not None else None
+        vm_d = rest.pop(0) if vm_h is not None else None
         # Sampling variant only when a row COMPLETING this chunk needs
         # it: sample_tokens sorts the [n, vocab] logits (hundreds of ms
         # on TPU at vocab 32k) — mid-prompt chunks and greedy
@@ -1679,7 +1728,8 @@ class PagedInferenceEngine(SpeculativeMixin, _EngineBase):
                 self._prof.jit_key('prefill', (n, P, sample, chunk_w)):
             first, self.cache = prefill(
                 self.params, self.cache, table_d, tokens_d, lengths_d,
-                valid_d, want_d, temps_d, topks_d, topps_d, prng)
+                valid_d, want_d, adp_d, vm_d, temps_d, topks_d,
+                topps_d, prng)
         chunk_t1 = clock.monotonic()
         self.chunks_prefilled += 1
         for i, slot in enumerate(batch):
@@ -2117,13 +2167,14 @@ class PagedInferenceEngine(SpeculativeMixin, _EngineBase):
             @functools.partial(jax.jit, donate_argnums=(1,),
                                **self._step_out_shardings(3))
             def verify(params, cache, table_p, tokens, proposals,
-                       n_prop, lengths, active, temps, topks, topps,
-                       rng):
+                       n_prop, lengths, active, adp, vmask, temps,
+                       topks, topps, rng):
                 return paged_spec_verify(
                     params, cache, table_p, tokens, proposals, n_prop,
                     lengths, active, cfg, sample=sample,
                     temps=temps, topks=topks, topps=topps, rng=rng,
-                    w8a8=w8a8, mesh=mesh)
+                    w8a8=w8a8, mesh=mesh, mlora_idx=adp,
+                    vocab_mask=vmask)
 
             self._spec_verify_fns[key] = verify
         return self._spec_verify_fns[key]
@@ -2149,8 +2200,8 @@ class PagedInferenceEngine(SpeculativeMixin, _EngineBase):
                                 (self.speculate_k, sample, P)):
             commit, n_commit, self._tok_dev, self.cache = verify(
                 self.params, self.cache, table_d, self._tok_dev, prop_d,
-                n_prop_d, lengths_d, active_d, temps_d, topks_d, topps_d,
-                rng)
+                n_prop_d, lengths_d, active_d, self._adp_dev,
+                self._vmask_dev, temps_d, topks_d, topps_d, rng)
         return commit, n_commit
 
     def _spec_can_fuse(self, slot: int, rounds: int) -> bool:
@@ -2185,7 +2236,8 @@ class PagedInferenceEngine(SpeculativeMixin, _EngineBase):
             @functools.partial(jax.jit, donate_argnums=(1,),
                                **self._step_out_shardings(4))
             def fused(params, cache, table_p, tokens, hist, rem,
-                      lengths, active, temps, topks, topps, rngs):
+                      lengths, active, adp, vmask, temps, topks, topps,
+                      rngs):
                 def round_body(carry, rng):
                     cache, tok, hist, rem, lens = carry
                     prop, n_prop = speculative.ngram_propose_device(
@@ -2201,7 +2253,8 @@ class PagedInferenceEngine(SpeculativeMixin, _EngineBase):
                             params, cache, table_p, tok, prop, n_prop,
                             lens, act, cfg, sample=sample, temps=temps,
                             topks=topks, topps=topps, rng=rng,
-                            w8a8=w8a8, mesh=mesh)
+                            w8a8=w8a8, mesh=mesh, mlora_idx=adp,
+                            vocab_mask=vmask)
                     # History carry: append the commit row and
                     # re-right-align (shift left by n_commit).
                     combined = jnp.concatenate([hist, commit], axis=1)
@@ -2249,8 +2302,9 @@ class PagedInferenceEngine(SpeculativeMixin, _EngineBase):
                                 (self.speculate_k, sample, P, rounds)):
             commits, n_commits, n_props, self._tok_dev, self.cache = \
                 fused(self.params, self.cache, table_d, self._tok_dev,
-                      hist_d, rem_d, lengths_d, active_d, temps_d,
-                      topks_d, topps_d, keys[1:])
+                      hist_d, rem_d, lengths_d, active_d, self._adp_dev,
+                      self._vmask_dev, temps_d, topks_d, topps_d,
+                      keys[1:])
         return commits, n_commits, n_props
 
     def step(self, horizon: int = 1) -> List[Tuple[int, int, bool]]:
@@ -2438,7 +2492,8 @@ class PagedInferenceEngine(SpeculativeMixin, _EngineBase):
             toks, self.cache = self._decode_fn(
                 self.params, self.cache, table_dd,
                 self._tok_dev, lengths_dd, rng,
-                temps_d, topks_d, topps_d, active_d, horizon, sample)
+                temps_d, topks_d, topps_d, active_d, self._adp_dev,
+                self._vmask_dev, horizon, sample)
         live = int(sum(int(lengths[s]) for s in active_slots))
         self._note_decode_step(live, horizon, clock.monotonic() - t0)
         self._tok_dev = toks[:, -1]

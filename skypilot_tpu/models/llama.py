@@ -299,8 +299,31 @@ def _unembed_logits(params: Params, x: jax.Array,
                    out_dtype=jnp.float32)
 
 
+# Constrained decoding masks to a large-negative, FINITE value: -inf
+# would make a fully-masked row all-NaN under softmax and trip the
+# nonfinite-token eviction guard on a healthy request, and masked
+# positions must stay orderable under temperature scaling.
+VOCAB_MASK_NEG = -1e9
+
+
+def apply_vocab_mask(logits: jax.Array,
+                     vocab_mask: Optional[jax.Array]) -> jax.Array:
+    """Constrained-decoding vocab mask (True = token allowed) applied
+    at a sampling point. ``vocab_mask`` is [b, vocab]; extra position
+    axes of ``logits`` (the speculative [b, k+1, vocab] verify and the
+    all-positions prefill) broadcast after the batch axis. None = no
+    constraint (byte-identical logits)."""
+    if vocab_mask is None:
+        return logits
+    while vocab_mask.ndim < logits.ndim:
+        vocab_mask = vocab_mask[:, None]
+    return jnp.where(vocab_mask, logits,
+                     jnp.asarray(VOCAB_MASK_NEG, logits.dtype))
+
+
 def filtered_logits(logits: jax.Array, temps: jax.Array,
-                    topks: jax.Array, topps: jax.Array) -> jax.Array:
+                    topks: jax.Array, topps: jax.Array,
+                    vocab_mask: Optional[jax.Array] = None) -> jax.Array:
     """Temperature-scaled, top-k/top-p-masked logits over the LAST axis:
     kept tokens carry their scaled value, filtered ones -inf, so
     ``jax.random.categorical`` over the result draws from exactly the
@@ -315,7 +338,10 @@ def filtered_logits(logits: jax.Array, temps: jax.Array,
     smallest prefix of the sorted distribution whose mass reaches
     top_p (the top-1 token always survives). Rows with temp <= 0 are
     scaled by 1/1e-6 — callers take the greedy argmax for those rows
-    instead of sampling."""
+    instead of sampling. ``vocab_mask`` (constrained decoding) composes
+    here, at the one shared sampling point, BEFORE temperature/top-k/
+    top-p so the filters act on the constrained distribution."""
+    logits = apply_vocab_mask(logits, vocab_mask)
     shape = logits.shape[:-1]
     temps = jnp.broadcast_to(temps, shape)[..., None]
     topks = jnp.broadcast_to(topks, shape)[..., None]
@@ -456,15 +482,23 @@ def _shard(x: jax.Array, *logical_axes: Optional[str]) -> jax.Array:
     return lax.with_sharding_constraint(x, spec_for(logical_axes))
 
 
-def _ffn(layer: Params, x: jax.Array, cfg: ModelConfig) -> jax.Array:
+def _ffn(layer: Params, x: jax.Array, cfg: ModelConfig,
+         mlora_idx: Optional[jax.Array] = None) -> jax.Array:
     from skypilot_tpu.models.quantization import qeinsum
     lo = layer.get('lora') if isinstance(layer, dict) else None
+    ml = layer.get('mlora') if isinstance(layer, dict) else None
+    if mlora_idx is None:
+        ml = None
     gate = qeinsum('bsd,df->bsf', x, layer['w_gate'])
     up = qeinsum('bsd,df->bsf', x, layer['w_up'])
     if lo is not None:
         from skypilot_tpu.models import lora as lora_lib
         gate = gate + lora_lib.apply(lo, 'w_gate', x, cfg)
         up = up + lora_lib.apply(lo, 'w_up', x, cfg)
+    if ml is not None:
+        from skypilot_tpu.models import multilora
+        gate = multilora.adjusted(ml, 'w_gate', x, gate, mlora_idx)
+        up = multilora.adjusted(ml, 'w_up', x, up, mlora_idx)
     act = jax.nn.silu if cfg.activation == 'silu' else \
         functools.partial(jax.nn.gelu, approximate=True)
     h = act(gate.astype(jnp.float32)).astype(x.dtype) * up
@@ -473,15 +507,22 @@ def _ffn(layer: Params, x: jax.Array, cfg: ModelConfig) -> jax.Array:
     if lo is not None:
         from skypilot_tpu.models import lora as lora_lib
         down = down + lora_lib.apply(lo, 'w_down', h, cfg)
+    if ml is not None:
+        down = multilora.adjusted(ml, 'w_down', h, down, mlora_idx)
     return down
 
 
 def _layer_core(layer: Params, x: jax.Array, cfg: ModelConfig,
-                positions: jax.Array, attn_fn):
+                positions: jax.Array, attn_fn,
+                mlora_idx: Optional[jax.Array] = None):
     """One transformer layer, parameterized by the attention op so every
     path (training full-sequence, prefill/decode against a cache, the
     fused serving loop) shares ONE copy of the layer math. ``attn_fn``
     maps roped (q, k, v) to the attention output.
+
+    ``mlora_idx`` ([b] int32, -1 = none) gathers per-row adapters from
+    the ``layer['mlora']`` bank slice (multi-tenant serving); None (the
+    default, and every training/eval path) leaves the math untouched.
 
     Returns (x, (k, v) new kv rows, moe aux loss)."""
     from jax.ad_checkpoint import checkpoint_name
@@ -489,6 +530,9 @@ def _layer_core(layer: Params, x: jax.Array, cfg: ModelConfig,
                   cfg.norm_plus_one)
     from skypilot_tpu.models.quantization import qeinsum
     lo = layer.get('lora') if isinstance(layer, dict) else None
+    ml = layer.get('mlora') if isinstance(layer, dict) else None
+    if mlora_idx is None:
+        ml = None
     q = qeinsum('bsd,dhk->bshk', h, layer['wq'])
     k = qeinsum('bsd,dhk->bshk', h, layer['wk'])
     v = qeinsum('bsd,dhk->bshk', h, layer['wv'])
@@ -497,6 +541,11 @@ def _layer_core(layer: Params, x: jax.Array, cfg: ModelConfig,
         q = q + lora_lib.apply(lo, 'wq', h, cfg)
         k = k + lora_lib.apply(lo, 'wk', h, cfg)
         v = v + lora_lib.apply(lo, 'wv', h, cfg)
+    if ml is not None:
+        from skypilot_tpu.models import multilora
+        q = multilora.adjusted(ml, 'wq', h, q, mlora_idx)
+        k = multilora.adjusted(ml, 'wk', h, k, mlora_idx)
+        v = multilora.adjusted(ml, 'wv', h, v, mlora_idx)
     if cfg.qkv_bias:
         q = q + layer['bq'].astype(q.dtype)
         k = k + layer['bk'].astype(k.dtype)
@@ -514,6 +563,8 @@ def _layer_core(layer: Params, x: jax.Array, cfg: ModelConfig,
     proj = qeinsum('bshk,hkd->bsd', out, layer['wo'])
     if lo is not None:
         proj = proj + lora_lib.apply(lo, 'wo', out, cfg)
+    if ml is not None:
+        proj = multilora.adjusted(ml, 'wo', out, proj, mlora_idx)
     x = x + proj
     h = rms_norm(x, layer['ffn_norm'], cfg.norm_eps,
                  cfg.norm_plus_one)
@@ -521,7 +572,7 @@ def _layer_core(layer: Params, x: jax.Array, cfg: ModelConfig,
         from skypilot_tpu.models import moe
         ffn_out, aux = moe.moe_ffn(layer, h, cfg)
     else:
-        ffn_out = _ffn(layer, h, cfg)
+        ffn_out = _ffn(layer, h, cfg, mlora_idx=mlora_idx)
         aux = jnp.zeros((), jnp.float32)
     x = x + ffn_out
     x = _shard(x, 'batch', 'seq', 'embed')
@@ -775,6 +826,10 @@ def prefill_rows(
                                        # verify; keep bucket ~k+1 tiny —
                                        # the full tensor is ~0.5 GB at
                                        # n=8 x bucket=512)
+    mlora_idx: Optional[jax.Array] = None,  # [n] per-row adapter slot
+                                       # (-1 = none): prefill rows gather
+                                       # bank adapters exactly like
+                                       # decode — chunked included
 ):
     """Prompt/chunk prefill for the slot engine. Without ``cache_kv``:
     plain causal attention over the padded bucket — flash-eligible on
@@ -827,7 +882,7 @@ def prefill_rows(
                 return attention(q, k, v, causal=True, impl=attn_impl)
 
             xc, (k, v), _ = _layer_core(layer, carry, cfg, positions,
-                                        attn_fn)
+                                        attn_fn, mlora_idx=mlora_idx)
             return xc, emit_rows(k, v)
 
         xs = params['layers']
@@ -852,7 +907,7 @@ def prefill_rows(
                                        v_scale=sv)
 
             xc, (k, v), _ = _layer_core(layer, carry, cfg, positions,
-                                        attn_fn)
+                                        attn_fn, mlora_idx=mlora_idx)
             return xc, emit_rows(k, v)
 
         xs = (params['layers'], jnp.arange(cfg.n_layers))
@@ -909,6 +964,13 @@ def decode_horizon(
                                        # first kv_bucket cache rows; caller
                                        # guarantees max(length)+horizon <=
                                        # kv_bucket (length-aware decode)
+    mlora_idx: Optional[jax.Array] = None,  # [b] per-slot adapter slot
+                                       # (-1 = none): multi-LoRA bank
+                                       # gather inside the fused scan
+    vocab_mask: Optional[jax.Array] = None,  # [b, vocab] bool, True =
+                                       # allowed (constrained decoding);
+                                       # applied at logits production so
+                                       # greedy AND sampled rows obey it
 ):
     """``horizon`` fused autoregressive decode steps in one program.
 
@@ -978,7 +1040,8 @@ def decode_horizon(
                                              rk, rv, i, k_scale=sk,
                                              v_scale=sv)
 
-            xc, new_kv, _ = _layer_core(layer, xc, cfg, positions, attn_fn)
+            xc, new_kv, _ = _layer_core(layer, xc, cfg, positions,
+                                        attn_fn, mlora_idx=mlora_idx)
             return xc, new_kv
 
         x, (k_rows, v_rows) = lax.scan(
@@ -991,6 +1054,9 @@ def decode_horizon(
         x = rms_norm(x, params['final_norm'], cfg.norm_eps,
                  cfg.norm_plus_one)
         logits = _unembed_logits(params, x, cfg)[:, 0]
+        # Constrained decoding composes at logits PRODUCTION, not just
+        # inside filtered_logits: the greedy branch takes a raw argmax.
+        logits = apply_vocab_mask(logits, vocab_mask)
         if sample_fn is None:
             nxt = jnp.argmax(logits, -1).astype(jnp.int32)
         else:

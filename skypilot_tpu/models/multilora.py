@@ -1,0 +1,242 @@
+"""Batched multi-LoRA: a device-resident adapter bank for multi-tenant
+serving on ONE engine.
+
+Single-adapter LoRA (:mod:`skypilot_tpu.models.lora`) merges into the
+base weights at load — one engine per fine-tune, an N× chip-cost
+multiplier at fleet scale. Here the adapters stay UNMERGED in a stacked
+bank and every decode/prefill step applies each slot's own adapter via
+one batched gather-of-adapters matmul (the S-LoRA/Punica consolidation
+result):
+
+- Bank layout: ``params['layers']['mlora'][target]['a'|'b']`` with
+  leaves ``a: [L, A, *in, r]`` / ``b: [L, A, r, *out]`` plus a
+  per-(layer, adapter) ``scale: [L, A]`` — the layer axis leads so the
+  bank rides the existing layer ``lax.scan`` exactly like the base
+  weights and the single-adapter 'lora' subtree before it (each scan
+  step sees ``layer['mlora']`` with the layer axis consumed). ``A`` is
+  the slot axis: the engine's adapter capacity.
+- Per-slot adapter indices (``mlora_idx: [b] int32``, -1 = no adapter)
+  gather each row's factors along the slot axis, so the low-rank
+  correction ``(x·Aᵀ)·Bᵀ`` is two thin BATCHED matmuls riding next to
+  the base projection — the jit program depends only on the bank
+  SHAPE, never on which adapters occupy it. Adapter load/evict
+  re-uploads bank rows (:func:`set_bank_row`, donated, traced slot
+  index); it never recompiles.
+- Zero-adapter rows are BIT-exact base model: :func:`adjusted`
+  where-selects the untouched base projection for rows with idx < 0
+  rather than adding a zero delta (a + 0.0 is not bitwise identity
+  under -0.0/NaN, and the bank rows a row gathers are arbitrary live
+  adapters).
+
+Rank discipline: the bank has ONE static rank; adapters with smaller
+rank zero-pad (sound — zero factor columns contribute nothing), larger
+ranks are rejected at registry load.
+"""
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Any, Dict, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from skypilot_tpu.models import lora as lora_lib
+from skypilot_tpu.models.configs import ModelConfig
+
+Params = Dict[str, Any]
+
+ATTN_TARGETS = lora_lib._ATTN_TARGETS
+MLP_TARGETS = lora_lib._MLP_TARGETS
+
+
+def default_targets(cfg: ModelConfig) -> Tuple[str, ...]:
+    """Bank targets when the service spec doesn't pin them: all
+    attention projections, plus the dense-FFN targets (MoE configs have
+    no dense FFN to adapt — same rule as lora.resolve_targets)."""
+    return ATTN_TARGETS + (() if cfg.is_moe else MLP_TARGETS)
+
+
+def target_shapes(cfg: ModelConfig, target: str, rank: int):
+    """(a_shape, b_shape) without layer/slot axes, at an explicit rank
+    (the bank rank is an engine knob, not cfg.lora_rank)."""
+    return lora_lib._target_shapes(
+        dataclasses.replace(cfg, lora_rank=rank), target)
+
+
+def init_bank(cfg: ModelConfig, slots: int, rank: int, *,
+              targets: Optional[Sequence[str]] = None,
+              dtype=jnp.bfloat16) -> Params:
+    """The ``params['layers']['mlora']`` subtree: all-zero factors (an
+    empty slot is a no-op even if gathered) and zero scales."""
+    if slots <= 0 or rank <= 0:
+        raise ValueError(f'bank needs slots>0, rank>0; got {slots}, {rank}')
+    targets = tuple(targets) if targets is not None \
+        else default_targets(cfg)
+    for t in targets:
+        if t not in ATTN_TARGETS + MLP_TARGETS:
+            raise ValueError(f'unknown multi-LoRA target {t!r}')
+        if t in MLP_TARGETS and cfg.is_moe:
+            raise ValueError(
+                f'multi-LoRA target {t!r} needs a dense FFN; '
+                f'{cfg.name} is MoE')
+    L = cfg.n_layers
+    bank: Params = {}
+    for t in targets:
+        a_shape, b_shape = target_shapes(cfg, t, rank)
+        bank[t] = {
+            'a': jnp.zeros((L, slots) + a_shape, dtype),
+            'b': jnp.zeros((L, slots) + b_shape, dtype),
+        }
+    # Per-layer copies of the per-adapter scale, so the leaf scans the
+    # layer axis like every other xs leaf ([L, A], layer-invariant).
+    bank['scale'] = jnp.zeros((L, slots), jnp.float32)
+    return bank
+
+
+def bank_slots(bank: Params) -> int:
+    return int(bank['scale'].shape[1])
+
+
+def bank_targets(bank: Params) -> Tuple[str, ...]:
+    return tuple(t for t in bank if t != 'scale')
+
+
+def _gather_delta(ml: Params, target: str, x: jax.Array,
+                  idx: jax.Array) -> jax.Array:
+    """The scaled low-rank delta, per-row gathered from the bank slice
+    of ONE layer (slot axis leads; layer axis already consumed by the
+    scan). idx is clipped — negative rows gather slot 0's factors but
+    :func:`adjusted` where-selects their result away."""
+    dt = x.dtype
+    n_slots = ml['scale'].shape[0]
+    g = jnp.clip(idx, 0, n_slots - 1)
+    a = ml[target]['a'][g].astype(dt)          # [b, *in, r]
+    b = ml[target]['b'][g].astype(dt)          # [b, r, *out]
+    if target == 'wo':                         # x: [b, s, h, k]
+        z = jnp.einsum('bshk,bhkr->bsr', x, a)
+        d = jnp.einsum('bsr,brd->bsd', z, b)
+    elif target in ('wq', 'wk', 'wv'):
+        z = jnp.einsum('bsd,bdr->bsr', x, a)
+        d = jnp.einsum('bsr,brhk->bshk', z, b)
+    elif target == 'w_down':                   # x: [b, s, f]
+        z = jnp.einsum('bsf,bfr->bsr', x, a)
+        d = jnp.einsum('bsr,brd->bsd', z, b)
+    else:                                      # w_gate / w_up
+        z = jnp.einsum('bsd,bdr->bsr', x, a)
+        d = jnp.einsum('bsr,brf->bsf', z, b)
+    s = ml['scale'][g]                         # [b] f32
+    return d * s.reshape((-1,) + (1,) * (d.ndim - 1)).astype(dt)
+
+
+def adjusted(ml: Optional[Params], target: str, x: jax.Array,
+             base: jax.Array, idx: Optional[jax.Array]) -> jax.Array:
+    """``base`` with each row's gathered adapter delta applied; rows
+    with idx < 0 return base BIT-exactly (where-select, not +0)."""
+    if ml is None or idx is None or target not in ml:
+        return base
+    delta = _gather_delta(ml, target, x, idx)
+    keep = (idx >= 0).reshape((-1,) + (1,) * (base.ndim - 1))
+    return jnp.where(keep, base + delta.astype(base.dtype), base)
+
+
+@functools.partial(jax.jit, donate_argnums=(0,))
+def set_bank_row(bank: Params, row: Params, slot: jax.Array) -> Params:
+    """Overwrite one bank slot with an adapter's factors. ``slot`` is
+    TRACED (one compile covers every slot) and ``bank`` is DONATED (the
+    update is in-place across churn: no recompile, no transient second
+    bank). ``row`` leaves are the bank leaves minus the slot axis."""
+    return jax.tree.map(
+        lambda b, r: jax.lax.dynamic_update_index_in_dim(
+            b, r.astype(b.dtype), slot, 1),
+        bank, row)
+
+
+def clear_bank_row(bank: Params, slot: jax.Array) -> Params:
+    """Zero one slot (evict): reuses :func:`set_bank_row`'s compiled
+    update with an all-zero row (f32, the same host dtype
+    :func:`adapter_row_from_tree` emits, so load and evict share ONE
+    compiled program)."""
+    zero = jax.tree.map(
+        lambda b: np.zeros(b.shape[:1] + b.shape[2:], np.float32), bank)
+    return set_bank_row(bank, zero, slot)
+
+
+def adapter_row_from_tree(cfg: ModelConfig, lora_tree: Params,
+                          bank_rank: int, scale: float, *,
+                          targets: Sequence[str]) -> Params:
+    """Convert a trainer-format adapter (``lora.split_lora`` layout:
+    ``{target: {'a': [L, *in, r], 'b': [L, r, *out]}}``) into a bank
+    row (host numpy; :func:`set_bank_row` uploads it). Targets the bank
+    carries but the adapter doesn't are zero (no-op); ranks below the
+    bank rank zero-pad; ranks above are a hard error."""
+    L = cfg.n_layers
+    row: Params = {}
+    for t in targets:
+        a_shape, b_shape = target_shapes(cfg, t, bank_rank)
+        if t in lora_tree:
+            a = np.asarray(lora_tree[t]['a'], np.float32)
+            b = np.asarray(lora_tree[t]['b'], np.float32)
+            r = a.shape[-1]
+            if r > bank_rank:
+                raise ValueError(
+                    f'adapter rank {r} exceeds bank rank {bank_rank} '
+                    f'for target {t!r}')
+            if a.shape[0] != L:
+                raise ValueError(
+                    f'adapter {t!r} has {a.shape[0]} layers; '
+                    f'model has {L}')
+            if r < bank_rank:
+                a = np.concatenate(
+                    [a, np.zeros(a.shape[:-1] + (bank_rank - r,),
+                                 np.float32)], axis=-1)
+                b = np.concatenate(
+                    [b, np.zeros((b.shape[0], bank_rank - r)
+                                 + b.shape[2:], np.float32)], axis=1)
+            if a.shape != (L,) + a_shape or b.shape != (L,) + b_shape:
+                raise ValueError(
+                    f'adapter {t!r} shapes {a.shape}/{b.shape} do not '
+                    f'match bank {(L,) + a_shape}/{(L,) + b_shape}')
+            row[t] = {'a': a, 'b': b}
+        else:
+            row[t] = {'a': np.zeros((L,) + a_shape, np.float32),
+                      'b': np.zeros((L,) + b_shape, np.float32)}
+    row['scale'] = np.full((L,), scale, np.float32)
+    return row
+
+
+def save_adapter(path: str, cfg: ModelConfig, lora_tree: Params, *,
+                 scale: Optional[float] = None) -> None:
+    """One adapter -> one ``.npz`` (the registry's checkpoint unit).
+    ``scale`` defaults to the config's alpha/rank fold scale — the same
+    number ``lora.merge`` folds with, so a bank-served adapter and its
+    offline-merged reference agree."""
+    if scale is None:
+        first = next(iter(lora_tree.values()))
+        rank = int(np.shape(first['a'])[-1])
+        scale = float(cfg.lora_alpha) / rank
+    arrays = {'__scale__': np.float32(scale)}
+    for t, ab in lora_tree.items():
+        arrays[f'{t}.a'] = np.asarray(ab['a'], np.float32)
+        arrays[f'{t}.b'] = np.asarray(ab['b'], np.float32)
+    np.savez(path, **arrays)
+
+
+def load_adapter(path: str) -> Tuple[Params, float]:
+    """(trainer-format adapter tree, fold scale) from a ``.npz``."""
+    data = np.load(path)
+    tree: Params = {}
+    # npz entries are host ndarrays, not device values.
+    scale = float(data['__scale__']) if '__scale__' in data else 1.0  # graftcheck: disable=GC202
+    for key in data.files:
+        if key == '__scale__':
+            continue
+        target, _, leaf = key.partition('.')
+        if leaf not in ('a', 'b'):
+            raise ValueError(f'unrecognized adapter array {key!r}')
+        tree.setdefault(target, {})[leaf] = data[key]
+    for t, ab in tree.items():
+        if set(ab) != {'a', 'b'}:
+            raise ValueError(f'adapter target {t!r} missing a/b factors')
+    return tree, scale

@@ -556,6 +556,8 @@ class GangFollower:
                 temperature=op.get('temperature', 0.0),
                 top_k=op.get('top_k', 0), top_p=op.get('top_p', 1.0),
                 eos_id=op.get('eos_id'), stop=op.get('stop'),
+                adapter=op.get('adapter'), tenant=op.get('tenant'),
+                grammar=op.get('grammar'),
                 priority=op.get('priority', 0))
             if rid != op['rid']:
                 raise GangFailure(

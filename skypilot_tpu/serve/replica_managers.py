@@ -378,6 +378,15 @@ class ReplicaManager:
         # contract — the model server reads SKYTPU_ROLE unless started
         # with an explicit --role.
         envs['SKYTPU_ROLE'] = info.role
+        # Multi-tenant LoRA (``adapters:`` spec block): bank size /
+        # checkpoint dir / rank ride the same launch-env contract —
+        # the model server reads SKYTPU_ADAPTER_* unless started with
+        # explicit --adapter-* flags.
+        if self.spec.adapter_slots > 0:
+            envs['SKYTPU_ADAPTER_SLOTS'] = str(self.spec.adapter_slots)
+            envs['SKYTPU_ADAPTER_RANK'] = str(self.spec.adapter_rank)
+            if self.spec.adapter_dir:
+                envs['SKYTPU_ADAPTER_DIR'] = self.spec.adapter_dir
         # Gang launch env (serve/gang.py): every rank gets the shared
         # gang identity; nonzero ranks additionally get rank 0's URL
         # as the coordinator (set by _launch_replica once rank 0's

@@ -521,6 +521,12 @@ class RequestScheduler:
         the tier's engine priority hint, so engine-internal requeues
         (paged preemption) keep tier ordering too."""
         admitted = False
+        # Requests whose adapter could not get a bank slot THIS cycle:
+        # held out of the queues until the loop exits (SRW would
+        # deterministically re-pick them), then requeued — so a
+        # bank-full adapter never head-of-line-blocks base-model or
+        # other-adapter admissions.
+        bank_deferred: List[Tuple[str, ScheduledRequest]] = []
         while True:
             free = (engine.max_batch - engine.num_active
                     - engine.queue_depth)
@@ -538,9 +544,24 @@ class RequestScheduler:
                     priority=TIERS.index(tier), **sr.sampling)
             except ValueError as e:
                 # Invalid for THIS engine (e.g. prompt outgrew max_seq
-                # between front-end validation and admission): fail the
-                # one request, keep admitting.
+                # between front-end validation and admission, or an
+                # unknown adapter name): fail the one request, keep
+                # admitting.
                 sr.outbox.fail(f'rejected: {e}')
+                continue
+            except RuntimeError as e:
+                from skypilot_tpu.inference.adapters import \
+                    AdapterBankFullError
+                if not isinstance(e, AdapterBankFullError):
+                    raise
+                # Every adapter-bank slot is pinned by a live request:
+                # a RETRYABLE capacity condition, not a client error.
+                # Defer just THIS request and keep admitting others;
+                # pins release as requests finish, so it self-recovers
+                # next cycle.
+                with self._q_lock:
+                    self._admitted_tokens[tier] -= sr.work_tokens
+                bank_deferred.append((tier, sr))
                 continue
             sr.request_id = rid
             sr.admit_time = clock.now()
@@ -563,6 +584,11 @@ class RequestScheduler:
             self._h_queue_wait[tier].observe(
                 (sr.admit_time - sr.submit_time) * 1e3)
             admitted = True
+        if bank_deferred:
+            with self._q_lock:
+                for d_tier, d_sr in bank_deferred:
+                    self._queues[d_tier].append(d_sr)
+                    self._queued_tokens[d_tier] += d_sr.work_tokens
         return admitted
 
     @property

@@ -61,6 +61,7 @@ from typing import Any, Dict, List, Optional
 from skypilot_tpu import telemetry
 from skypilot_tpu import tpu_logging
 from skypilot_tpu.inference import kv_transfer
+from skypilot_tpu.models.tokenizer import sanitize_text
 from skypilot_tpu.serve import disagg as disagg_lib
 from skypilot_tpu.serve import faults as faults_lib
 from skypilot_tpu.serve import gang as gang_lib
@@ -83,6 +84,9 @@ def build_engine(cfg_name: str, *, max_batch: int, max_seq: int,
                  decode_priority_ratio: Optional[float] = None,
                  decode_steps_per_call: Optional[int] = None,
                  speculate_k: int = 0,
+                 adapter_slots: int = 0,
+                 adapter_dir: Optional[str] = None,
+                 adapter_rank: int = 8,
                  tp: int = 1, dp: int = 1,
                  gang: Optional['gang_lib.GangSpec'] = None):
     """Construct AND warm one inference engine — the single engine
@@ -127,6 +131,12 @@ def build_engine(cfg_name: str, *, max_batch: int, max_seq: int,
         extra['kv_cache_dtype'] = kv_cache_dtype
     extra['prefill_w8a8'] = prefill_w8a8
     extra['speculate_k'] = speculate_k
+    if adapter_slots:
+        # Multi-tenant LoRA bank: slots rows of rank-r factors live in
+        # params (re-uploaded on load/evict, never recompiled).
+        extra['adapter_slots'] = adapter_slots
+        extra['adapter_dir'] = adapter_dir
+        extra['adapter_rank'] = adapter_rank
     if model_path:
         engine = engine_cls.from_pretrained(
             model_path, max_batch=max_batch, max_seq=max_seq,
@@ -161,6 +171,9 @@ class ModelServer:
                  decode_priority_ratio: Optional[float] = None,
                  decode_steps_per_call: Optional[int] = None,
                  speculate_k: int = 0,
+                 adapter_slots: int = 0,
+                 adapter_dir: Optional[str] = None,
+                 adapter_rank: int = 8,
                  slo_tier_default: str = 'latency',
                  max_queue_tokens: Optional[int] = None,
                  latency_admit_frac: float = 0.7,
@@ -210,6 +223,11 @@ class ModelServer:
         # on-device verify (0 = off). Greedy outputs are identical to
         # vanilla decode; sampling keeps the output distribution.
         self.speculate_k = speculate_k or 0
+        # Multi-tenant LoRA: bank capacity (0 = off), checkpoint dir
+        # for on-demand load-by-name, and the bank's fixed rank.
+        self.adapter_slots = adapter_slots
+        self.adapter_dir = adapter_dir
+        self.adapter_rank = adapter_rank
         self.max_batch = max_batch
         self.max_seq = max_seq
         self.port = port
@@ -413,7 +431,11 @@ class ModelServer:
             prefill_chunk_tokens=self.prefill_chunk_tokens,
             decode_priority_ratio=self.decode_priority_ratio,
             decode_steps_per_call=self.decode_steps_per_call,
-            speculate_k=self.speculate_k, tp=self.tp, dp=self.dp,
+            speculate_k=self.speculate_k,
+            adapter_slots=self.adapter_slots,
+            adapter_dir=self.adapter_dir,
+            adapter_rank=self.adapter_rank,
+            tp=self.tp, dp=self.dp,
             gang=self.gang if self.gang.is_gang else None)
         if self.model_path:
             self.cfg_name = engine.cfg.name
@@ -742,6 +764,11 @@ class ModelServer:
             'temperature': s.get('temperature', 0.0),
             'top_k': s.get('top_k', 0), 'top_p': s.get('top_p', 1.0),
             'eos_id': s.get('eos_id'), 'stop': s.get('stop'),
+            # Multi-tenant LoRA: followers must decode with the same
+            # bank row (and the same logit mask) or their digests
+            # diverge on the first adapter token.
+            'adapter': s.get('adapter'), 'tenant': s.get('tenant'),
+            'grammar': s.get('grammar'),
             # Fleet trace id: follower ranks attribute their lockstep
             # replay of this request to the same trace.
             'trace_id': (sr.trace_ctx or {}).get('trace_id')})
@@ -783,6 +810,9 @@ class ModelServer:
     def submit(self, prompt, max_new_tokens: int, temperature: float,
                top_k: int, eos_id: Optional[int], top_p: float = 1.0,
                stop=None, tier: Optional[str] = None,
+               adapter: Optional[str] = None,
+               tenant: Optional[str] = None,
+               grammar: Optional[Any] = None,
                handoff_target: Optional[str] = None,
                trace_ctx: Optional[Dict[str, Any]] = None
                ) -> Dict[str, Any]:
@@ -800,6 +830,7 @@ class ModelServer:
             trace_ctx=trace_ctx,
             temperature=temperature, top_k=top_k, top_p=top_p,
             eos_id=eos_id, stop=stop,
+            adapter=adapter, tenant=tenant, grammar=grammar,
             hold=handoff_target is not None)
         pre = None
         if handoff_target is not None:
@@ -808,7 +839,9 @@ class ModelServer:
                 result = self._collect_handoff(
                     sr, handoff_target, prompt,
                     dict(temperature=temperature, top_k=top_k,
-                         top_p=top_p, eos_id=eos_id, stop=stop))
+                         top_p=top_p, eos_id=eos_id, stop=stop,
+                         adapter=adapter, tenant=tenant,
+                         grammar=grammar))
                 if result is not None:
                     return result
                 self._m_handoff['fallback_local'].inc()
@@ -838,7 +871,11 @@ class ModelServer:
     def submit_stream(self, prompt, max_new_tokens: int, temperature: float,
                       top_k: int, eos_id: Optional[int],
                       top_p: float = 1.0, stop=None,
-                      tier: Optional[str] = None, hold: bool = False,
+                      tier: Optional[str] = None,
+                      adapter: Optional[str] = None,
+                      tenant: Optional[str] = None,
+                      grammar: Optional[Any] = None,
+                      hold: bool = False,
                       trace_ctx: Optional[Dict[str, Any]] = None):
         """Register a streaming request; returns its ScheduledRequest
         (``sr.outbox`` streams ``(token, finished)`` tuples). Callers
@@ -852,7 +889,8 @@ class ModelServer:
             prompt, max_new_tokens=max_new_tokens, tier=tier,
             trace_ctx=trace_ctx,
             temperature=temperature, top_k=top_k, top_p=top_p,
-            eos_id=eos_id, stop=stop, hold=hold)
+            eos_id=eos_id, stop=stop,
+            adapter=adapter, tenant=tenant, grammar=grammar, hold=hold)
 
     def release_hold(self, sr) -> None:
         """Resume local decoding of a held (handoff-candidate) request
@@ -1433,6 +1471,23 @@ class ModelServer:
             'tokens_free': 0, 'preemptions': 0, 'kv_token_bytes': 0,
         }
 
+    def _lora_stats(self) -> Dict[str, Any]:
+        """The JSON ``lora`` block with a stable all-zeros fallback
+        before the engine loads (or with the adapter bank off) — same
+        keys either way, sized from the configured flags so the schema
+        never flips once serving starts."""
+        eng = self.engine
+        reg = getattr(eng, 'adapters', None) if eng is not None else None
+        if reg is not None:
+            return reg.stats()
+        return {
+            'slots': self.adapter_slots, 'used': 0,
+            'free': self.adapter_slots,
+            'rank': self.adapter_rank if self.adapter_slots else 0,
+            'targets': [], 'loads_total': 0, 'evictions_total': 0,
+            'last_load_ms': 0.0, 'loaded': [], 'pinned': {},
+        }
+
     def _metrics_json_payload(self) -> Dict[str, Any]:
         """The PR-3 stable-schema JSON gauge block, now sourced from
         the telemetry registry (every key ALWAYS present and numeric;
@@ -1519,6 +1574,12 @@ class ModelServer:
             # SLO scheduler block (stable schema: every tier and every
             # key present from the first scrape, zeros when idle).
             'sched': sched_stats,
+            # Multi-tenant LoRA bank (stable schema: zeros/empty with
+            # the bank off or before the engine loads). slots/used/free
+            # are what the LB or an operator watches for bank-pressure
+            # churn; loads/evictions count row re-uploads (never
+            # recompiles).
+            'lora': self._lora_stats(),
             # Hot-prefix digest (stable schema: page 0 / empty entries
             # on a slot engine or before the engine loads). Built from
             # the engine's HOST-SIDE heat tracker only — shipping it on
@@ -1798,7 +1859,7 @@ class ModelServer:
                 def token_event(t: int) -> Dict[str, Any]:
                     ev = {'token': int(t)}
                     if is_text:
-                        ev['text'] = tok.decode([int(t)])
+                        ev['text'] = sanitize_text(tok.decode([int(t)]))
                     return ev
 
                 for t in ho['prelude']:
@@ -1825,7 +1886,7 @@ class ModelServer:
                                     done['finish_reason'] = \
                                         ev['finish_reason']
                                 if is_text:
-                                    done['text'] = tok.decode(tokens)
+                                    done['text'] = sanitize_text(tok.decode(tokens))
                                 server.record_request_key(
                                     key, dict(done))
                                 emit(done)
@@ -1877,7 +1938,7 @@ class ModelServer:
                     tokens.append(int(token))
                     event = {'token': int(token)}
                     if is_text:
-                        event['text'] = tok.decode([int(token)])
+                        event['text'] = sanitize_text(tok.decode([int(token)]))
                     self.wfile.write(
                         f'data: {json.dumps(event)}\n\n'.encode())
                     self.wfile.flush()
@@ -1886,7 +1947,7 @@ class ModelServer:
                                 'request_id': sr.request_id,
                                 'tokens': tokens}
                         if is_text:
-                            done['text'] = tok.decode(tokens)
+                            done['text'] = sanitize_text(tok.decode(tokens))
                         server.record_request_key(key, dict(
                             done, request_id=sr.request_id))
                         self.wfile.write(
@@ -1906,7 +1967,7 @@ class ModelServer:
                     for t in cached.get('tokens', []):
                         event = {'token': int(t)}
                         if is_text:
-                            event['text'] = tok.decode([int(t)])
+                            event['text'] = sanitize_text(tok.decode([int(t)]))
                         self.wfile.write(
                             f'data: {json.dumps(event)}\n\n'.encode())
                     done = dict(cached, done=True, deduped=True)
@@ -1930,7 +1991,7 @@ class ModelServer:
                     stop = [tok.encode(s, bos=False)
                             if isinstance(s, str)
                             else [int(t) for t in s] for s in stop]
-                return dict(
+                kwargs = dict(
                     max_new_tokens=int(payload.get(
                         'max_tokens', payload.get('max_new_tokens', 128))),
                     temperature=float(payload.get('temperature', 0.0)),
@@ -1938,6 +1999,38 @@ class ModelServer:
                     top_p=float(payload.get('top_p', 1.0)),
                     stop=stop,
                     eos_id=payload.get('eos_id', tok.eos_id))
+                # Multi-tenant LoRA + constrained decoding: adapter
+                # name (also OpenAI-style 'model: base:adapter'),
+                # tenant attribution label, and grammar ('json' |
+                # allowed-token-id list). Only forwarded when present
+                # so adapter-free deployments see the exact legacy
+                # call.
+                adapter = payload.get('adapter')
+                model = payload.get('model')
+                if adapter is None and isinstance(model, str) \
+                        and ':' in model:
+                    base, _, suffix = model.partition(':')
+                    # Colon-bearing model ids (e.g. 'llama3:8b' tags)
+                    # were always ignored on adapter-free deployments;
+                    # only read 'base:adapter' when this replica has a
+                    # bank, or the prefix names the served model (an
+                    # unambiguous adapter request either way).
+                    if getattr(server, 'adapter_slots', 0) \
+                            or base == server.cfg_name:
+                        adapter = suffix or None
+                if adapter is not None:
+                    kwargs['adapter'] = str(adapter)
+                if payload.get('tenant') is not None:
+                    kwargs['tenant'] = str(payload['tenant'])
+                grammar = payload.get('grammar',
+                                      payload.get('response_format'))
+                if isinstance(grammar, dict):
+                    # OpenAI response_format: {'type': 'json_object'}.
+                    grammar = ('json' if grammar.get('type')
+                               in ('json_object', 'json') else None)
+                if grammar is not None:
+                    kwargs['grammar'] = grammar
+                return kwargs
 
             def _trace_ctx(self):
                 """Parse the inbound cross-process trace context (LB or
@@ -1983,7 +2076,7 @@ class ModelServer:
                     prompt_ids, handoff_target=server.handoff_target(
                         self.headers.get('X-Handoff-Target')),
                     trace_ctx=self._trace_ctx(), **kwargs)
-                out_text = tok.decode(result['tokens'])
+                out_text = sanitize_text(tok.decode(result['tokens']))
                 created = int(time_mod.time())
                 if chat:
                     choice = {'index': 0,
@@ -2050,7 +2143,7 @@ class ModelServer:
                             emit(json.dumps({'error': {
                                 'message': 'engine failed'}}))
                             break
-                        piece = tok.decode([int(token)])
+                        piece = sanitize_text(tok.decode([int(token)]))
                         if chat:
                             choice = {'index': 0,
                                       'delta': {'content': piece},
@@ -2380,7 +2473,7 @@ class ModelServer:
                             self.headers.get('X-Handoff-Target')),
                         trace_ctx=self._trace_ctx(), **kwargs)
                     if is_text:
-                        result['text'] = tok.decode(result['tokens'])
+                        result['text'] = sanitize_text(tok.decode(result['tokens']))
                     server.record_request_key(key, result)
                     self._json(200, result)
                 except (KeyError, ValueError, TypeError,
@@ -2525,6 +2618,28 @@ def main() -> None:
                              'identical to vanilla decode; sampling '
                              'keeps the output distribution. Biggest '
                              'win on repetitive/extractive text')
+    parser.add_argument('--adapter-slots', type=int,
+                        default=int(os.environ.get(
+                            'SKYTPU_ADAPTER_SLOTS', '0')),
+                        help='Device-resident LoRA adapter bank rows '
+                             '(0 = multi-tenant adapters off). Each '
+                             'request may name an adapter; slots '
+                             'load/evict by LRU with row re-uploads, '
+                             'never recompiles. Env fallback: the '
+                             'controller ships the adapters: spec '
+                             'block as SKYTPU_ADAPTER_*.')
+    parser.add_argument('--adapter-dir',
+                        default=os.environ.get('SKYTPU_ADAPTER_DIR')
+                        or None,
+                        help='Directory of <name>.npz LoRA checkpoints '
+                             '(models/multilora.save_adapter layout) '
+                             'loaded on first use by name.')
+    parser.add_argument('--adapter-rank', type=int,
+                        default=int(os.environ.get(
+                            'SKYTPU_ADAPTER_RANK', '8')),
+                        help='Adapter bank rank: lower-rank '
+                             'checkpoints zero-pad into the bank; '
+                             'higher-rank ones are rejected.')
     parser.add_argument('--prefill-w8a8', action='store_true',
                         help='quantize prefill activations to int8 '
                              '(2x MXU rate on the compute-bound '
@@ -2643,6 +2758,9 @@ def main() -> None:
                          decode_priority_ratio=args.decode_priority_ratio,
                          decode_steps_per_call=args.decode_steps_per_call,
                          speculate_k=args.speculate_k,
+                         adapter_slots=args.adapter_slots,
+                         adapter_dir=args.adapter_dir,
+                         adapter_rank=args.adapter_rank,
                          slo_tier_default=args.slo_tier_default,
                          max_queue_tokens=args.max_queue_tokens,
                          latency_admit_frac=args.latency_admit_frac,
@@ -2682,6 +2800,9 @@ def run_follower(spec: 'gang_lib.GangSpec', args) -> None:
         decode_steps_per_call=getattr(args, 'decode_steps_per_call',
                                       None),
         speculate_k=args.speculate_k,
+        adapter_slots=getattr(args, 'adapter_slots', 0),
+        adapter_dir=getattr(args, 'adapter_dir', None),
+        adapter_rank=getattr(args, 'adapter_rank', 8),
         tp=mesh_spec.tp, dp=mesh_spec.dp, gang=spec)
     follower = gang_lib.GangFollower(
         spec, engine,

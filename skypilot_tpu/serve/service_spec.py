@@ -81,6 +81,14 @@ class SkyServiceSpec:
     # (telemetry/fleet.py) and surfaces them in controller status, the
     # LB sync response and ``GET /fleet/metrics``.
     slos: Optional[Dict[str, Dict[str, float]]] = None
+    # Multi-tenant LoRA serving (``adapters:`` block): each replica
+    # carries a device-resident adapter bank of ``adapter_slots`` rows
+    # at rank ``adapter_rank``, lazily loaded by name from
+    # ``adapter_dir`` (LRU evict under pressure). Reaches replicas as
+    # --adapter-slots/--adapter-dir/--adapter-rank server flags.
+    adapter_slots: int = 0
+    adapter_dir: Optional[str] = None
+    adapter_rank: int = 8
 
     @property
     def disagg_enabled(self) -> bool:
@@ -125,6 +133,12 @@ class SkyServiceSpec:
                 'disaggregation needs BOTH prefill_replicas and '
                 'decode_replicas >= 1 (a lone pool has nobody to hand '
                 'off to/from)')
+        if self.adapter_slots < 0:
+            raise exceptions.InvalidServiceSpecError(
+                f'adapters.slots must be >= 0, got {self.adapter_slots}')
+        if self.adapter_rank < 1:
+            raise exceptions.InvalidServiceSpecError(
+                f'adapters.rank must be >= 1, got {self.adapter_rank}')
         if self.gang_hosts < 1:
             raise exceptions.InvalidServiceSpecError(
                 f'parallelism.hosts must be >= 1, got {self.gang_hosts}')
@@ -191,6 +205,12 @@ class SkyServiceSpec:
                     disagg.get('prefill_replicas', 0)),
                 disagg_decode_replicas=int(
                     disagg.get('decode_replicas', 0)))
+        adapters = config.get('adapters')
+        if adapters:
+            fields.update(
+                adapter_slots=int(adapters.get('slots', 0)),
+                adapter_dir=adapters.get('dir'),
+                adapter_rank=int(adapters.get('rank', 8)))
         slos = config.get('slos')
         if slos:
             fields['slos'] = {
@@ -267,6 +287,12 @@ class SkyServiceSpec:
         if self.slos:
             cfg['slos'] = {tier: dict(obj)
                            for tier, obj in sorted(self.slos.items())}
+        if self.adapter_slots > 0:
+            adapters: Dict[str, Any] = {'slots': self.adapter_slots,
+                                        'rank': self.adapter_rank}
+            if self.adapter_dir:
+                adapters['dir'] = self.adapter_dir
+            cfg['adapters'] = adapters
         if self.autoscaling_enabled or self.target_qps_per_replica:
             policy: Dict[str, Any] = {
                 'min_replicas': self.min_replicas,

@@ -176,6 +176,21 @@ SERVICE_SCHEMA: Dict[str, Any] = {
         # Per-tier service-level objectives: tier name -> objectives.
         # The controller's fleet aggregator evaluates 5m/1h burn rates
         # against these (telemetry/fleet.py) and exports
+        # Multi-tenant LoRA serving (``adapters:`` block,
+        # inference/adapters.py): every replica carries a
+        # device-resident adapter bank of ``slots`` rows at ``rank``,
+        # loading named adapters on demand from ``dir`` (LRU evict
+        # under pressure). Requests pick an adapter by name; slots
+        # re-upload bank rows, never recompile.
+        'adapters': {
+            'type': 'object',
+            'additionalProperties': False,
+            'properties': {
+                'slots': {'type': 'integer', 'minimum': 1},
+                'dir': {'type': 'string'},
+                'rank': {'type': 'integer', 'minimum': 1},
+            },
+        },
         # skytpu_slo_burn_rate{tier,window} / skytpu_slo_attainment.
         'slos': {
             'type': 'object',

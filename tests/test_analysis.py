@@ -1419,10 +1419,7 @@ def test_byte_budgets_name_only_live_presets():
 
 def test_byte_budget_classes_are_known():
     from skypilot_tpu.analysis import costmodel
-    known = {costmodel.WEIGHT_BF16, costmodel.WEIGHT_INT8,
-             costmodel.WEIGHT_INT4, costmodel.WEIGHT_SCALE,
-             costmodel.KV_POOL, costmodel.KV_SCALE, costmodel.TABLE,
-             costmodel.ACTIVATION, costmodel.CONST}
+    known = set(costmodel.ALL_CLASSES)
     for preset, labels in costmodel.BYTE_BUDGETS.items():
         for label, caps in labels.items():
             for key in caps:

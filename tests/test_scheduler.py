@@ -132,6 +132,44 @@ def test_srw_pop_shortest_work_first_fifo_ties(fresh_registry):
     assert b.request_id is not None and b.seq < c.seq  # FIFO tie-break
 
 
+def test_bank_full_defers_only_blocked_request(fresh_registry):
+    """AdapterBankFullError defers exactly the blocked request for the
+    cycle and keeps admitting everything else — a bank-full adapter
+    must not head-of-line-block base-model admission. The deferred
+    request goes back to its queue for the next cycle."""
+    from skypilot_tpu.inference.adapters import AdapterBankFullError
+
+    class BankFullEngine(FakeEngine):
+        def add_request(self, prompt, max_new_tokens=128, priority=0,
+                        **sampling):
+            if sampling.get('adapter') == 'full':
+                raise AdapterBankFullError('all slots pinned')
+            return super().add_request(
+                prompt, max_new_tokens=max_new_tokens,
+                priority=priority, **sampling)
+
+    eng = BankFullEngine(max_batch=4)
+    sched = make_sched(eng)
+    # Shortest work: SRW picks the blocked request FIRST every cycle.
+    blocked = sched.submit([1] * 2, max_new_tokens=2, tier='latency',
+                           adapter='full')
+    base_a = sched.submit([1] * 8, max_new_tokens=8, tier='latency')
+    base_b = sched.submit([1] * 8, max_new_tokens=8,
+                          tier='throughput')
+    sched.fill_engine(eng)
+    admitted = {rid for rid, *_ in eng.added}
+    assert admitted == {base_a.request_id, base_b.request_id}
+    assert blocked.request_id is None
+    assert sched.backlog == 1          # requeued for the next cycle
+    # Pins released: the deferred request admits next cycle.
+    sched.fill_engine(eng)
+    assert blocked.request_id is None  # still full this fake cycle
+    BankFullEngine.add_request = FakeEngine.add_request
+    sched.fill_engine(eng)
+    assert blocked.request_id is not None
+    assert sched.backlog == 0
+
+
 def test_budget_split_deficit_weighted(fresh_registry):
     """With both tiers backlogged and equal request sizes, admitted
     work tracks latency_admit_frac (7/10 at 0.7)."""

@@ -200,15 +200,22 @@ def test_generate_top_p_and_stop_over_http():
                         'stop': [full[2:4]]})['tokens']
         assert boundary == full[:2], (boundary, full)
         # STRING stops ride the tokenizer (byte tokenizer for 'tiny',
-        # 1 char <-> 1 token); encoding must not prepend BOS or they
+        # 1 token <-> 1 byte); encoding must not prepend BOS or they
         # could never match generated output.
         text_full = gen({'prompt': 'ab', 'max_new_tokens': 8})
-        if len(text_full['text']) == len(text_full['tokens']):
-            frag = text_full['text'][2:4]
-            text_stop = gen({'prompt': 'ab', 'max_new_tokens': 8,
-                             'stop': frag})
-            assert text_stop['tokens'] == text_full['tokens'][:2], \
-                (text_stop, text_full)
+        # Response text is sanitized at the JSON boundary (lone
+        # surrogates never reach the wire), so it is always valid
+        # UTF-8 — possibly lossy for raw generated bytes...
+        text_full['text'].encode('utf-8')
+        # ...hence the byte-exact stop fragment comes from the token
+        # ids. The REQUEST path keeps the surrogateescape round trip:
+        # this string re-encodes to exactly those generated bytes.
+        frag = bytes(text_full['tokens'][2:4]).decode(
+            'utf-8', 'surrogateescape')
+        text_stop = gen({'prompt': 'ab', 'max_new_tokens': 8,
+                         'stop': frag})
+        assert text_stop['tokens'] == text_full['tokens'][:2], \
+            (text_stop, text_full)
         # malformed stop payloads return 400, not a dropped connection
         try:
             gen({'prompt': [3, 1, 4], 'max_new_tokens': 4, 'stop': 13})
@@ -311,6 +318,23 @@ def test_openai_compatible_api():
         two = post('/v1/completions', {'prompt': [[3, 1, 4]],
                                        'max_tokens': 2})
         assert two['usage']['prompt_tokens'] == 3
+
+        # colon-bearing model tags (e.g. ollama-style 'llama3:8b')
+        # were always ignored on adapter-free deployments; the
+        # 'base:adapter' spelling must not start rejecting them.
+        tag = post('/v1/completions', {'model': 'llama3:8b',
+                                       'prompt': 'ab', 'max_tokens': 2})
+        assert tag['usage']['completion_tokens'] == 2
+        # ...but a colon tag whose prefix names the SERVED model is an
+        # unambiguous adapter request and fails loudly (no bank here).
+        try:
+            post('/v1/completions', {'model': 'tiny:ad0',
+                                     'prompt': 'ab', 'max_tokens': 2})
+            raise AssertionError('expected adapter rejection')
+        except urllib.error.HTTPError as e:
+            assert e.code in (400, 500)
+            assert 'adapter' in json.loads(
+                e.read())['error']['message']
 
         # bad request -> OpenAI error envelope
         try:

@@ -445,6 +445,112 @@ def test_kv_round2_cross_layer_attribution():
         registry_lib.reset_registry()
 
 
+def test_adapter_series_registered_at_construction():
+    """PR-20 stable schema: an engine built with an adapter bank
+    registers the bank-slot occupancy gauges, the load/eviction
+    counters and the requests_total{adapter="none"} series at
+    CONSTRUCTION — zeros (and full free slots) from the first scrape,
+    before any adapter ever loads."""
+    from skypilot_tpu import telemetry
+    from skypilot_tpu.inference.engine import InferenceEngine
+    from skypilot_tpu.models import configs
+    registry_lib.reset_registry()
+    try:
+        InferenceEngine(configs.get_config('tiny'), max_batch=2,
+                        max_seq=64, adapter_slots=3, adapter_rank=4)
+        prom = telemetry.get_registry().render_prometheus()
+    finally:
+        registry_lib.reset_registry()
+    assert '# TYPE skytpu_adapter_bank_slots gauge' in prom
+    assert 'skytpu_adapter_bank_slots{state="used"} 0' in prom
+    assert 'skytpu_adapter_bank_slots{state="free"} 3' in prom
+    assert '# TYPE skytpu_adapter_loads_total counter' in prom
+    assert 'skytpu_adapter_loads_total 0' in prom
+    assert '# TYPE skytpu_adapter_evictions_total counter' in prom
+    assert 'skytpu_adapter_evictions_total 0' in prom
+    assert '# TYPE skytpu_requests_total counter' in prom
+    assert 'skytpu_requests_total{adapter="none"} 0' in prom
+
+
+def test_adapter_series_updated_by_traffic():
+    """Adapter churn moves every series: loads/evictions count LRU
+    misses/evictions, the occupancy gauges track used+free == slots,
+    and per-adapter request counters appear as adapters are first
+    seen."""
+    import numpy as np
+    from skypilot_tpu import telemetry
+    from skypilot_tpu.inference.engine import InferenceEngine
+    from skypilot_tpu.models import configs, multilora
+    registry_lib.reset_registry()
+    try:
+        cfg = configs.get_config('tiny')
+        eng = InferenceEngine(cfg, max_batch=2, max_seq=64,
+                              adapter_slots=2, adapter_rank=4)
+        reg = eng.adapters
+        rng = np.random.default_rng(0)
+        for i in range(3):
+            tree = {}
+            for t in reg.targets:
+                a_shape, b_shape = multilora.target_shapes(cfg, t, 4)
+                tree[t] = {
+                    'a': rng.normal(0, 0.02, (cfg.n_layers,) + a_shape)
+                    .astype(np.float32),
+                    'b': rng.normal(0, 0.02, (cfg.n_layers,) + b_shape)
+                    .astype(np.float32)}
+            reg.register(f'ad{i}', tree, scale=1.0)
+        rid0 = eng.add_request([1, 2, 3], max_new_tokens=2,
+                               adapter='ad0')
+        rid1 = eng.add_request([4, 5], max_new_tokens=2, adapter='ad1')
+        done = eng.run_to_completion(horizon=4)
+        assert set(done) == {rid0, rid1}
+        # Bank full + both released: ad2 evicts the coldest.
+        rid2 = eng.add_request([6], max_new_tokens=2, adapter='ad2')
+        eng.add_request([7, 8], max_new_tokens=2)   # base-model request
+        done = eng.run_to_completion(horizon=4)
+        assert rid2 in done
+        treg = telemetry.get_registry()
+        assert treg.get('skytpu_adapter_loads_total').value == 3
+        assert treg.get('skytpu_adapter_evictions_total').value == 1
+        used = treg.get('skytpu_adapter_bank_slots', state='used').value
+        free = treg.get('skytpu_adapter_bank_slots', state='free').value
+        assert used == 2 and free == 0
+        for label, want in (('ad0', 1), ('ad1', 1), ('ad2', 1),
+                            ('none', 1)):
+            c = treg.get('skytpu_requests_total', adapter=label)
+            assert c is not None and c.value == want, label
+    finally:
+        registry_lib.reset_registry()
+
+
+def test_adapter_request_labels_bounded():
+    """The requests_total{adapter} label set is BOUNDED: past 4 x slots
+    distinct names, new ones collapse into adapter="other" — a tenant
+    flood cannot grow the metric cardinality without bound."""
+    from skypilot_tpu import telemetry
+    from skypilot_tpu.inference.engine import InferenceEngine
+    from skypilot_tpu.models import configs
+    registry_lib.reset_registry()
+    try:
+        eng = InferenceEngine(configs.get_config('tiny'), max_batch=2,
+                              max_seq=64, adapter_slots=1,
+                              adapter_rank=4)
+        reg = eng.adapters
+        for i in range(12):
+            reg.note_request(f'tenant{i}')
+        treg = telemetry.get_registry()
+        prom = treg.render_prometheus()
+        labels = [ln.split('adapter="')[1].split('"')[0]
+                  for ln in prom.splitlines()
+                  if ln.startswith('skytpu_requests_total{')]
+        # 'none' (pre-registered) + cap(4 x 1 slots) incl. 'other'.
+        assert len(labels) <= 1 + 4 * reg.slots + 1
+        assert 'other' in labels
+        assert treg.get('skytpu_requests_total',
+                        adapter='other').value >= 12 - 4 * reg.slots
+    finally:
+        registry_lib.reset_registry()
+
+
 # ---------------------------------------------------------------------------
 # Model server: Prometheus /metrics + /debug/requests over HTTP
 # ---------------------------------------------------------------------------
@@ -543,6 +649,16 @@ def test_server_prometheus_metrics_and_debug_requests():
         assert m['scheduler']['speculate_k'] == 0
         assert m['requests_served'] >= 1
         assert m['ttft_window'] >= 1
+
+        # (b2b) Multi-LoRA stable schema (PR 20): the `lora` block is
+        # present even with no bank configured — stable zeros, so
+        # dashboards never key-error on bankless replicas.
+        lora = m['lora']
+        for key in ('slots', 'used', 'free', 'rank', 'targets',
+                    'loads_total', 'evictions_total', 'last_load_ms',
+                    'loaded', 'pinned'):
+            assert key in lora, key
+        assert lora['slots'] == 0 and lora['loaded'] == []
 
         # (b3) Serving-mesh shape: one gauge series per logical axis
         # with 1s on a single-chip replica (stable — the series never

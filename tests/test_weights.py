@@ -135,6 +135,25 @@ def test_byte_tokenizer_roundtrip():
     assert tk.vocab_size == 258
 
 
+def test_byte_tokenizer_invalid_utf8_sanitizes_at_boundary():
+    """decode keeps surrogateescape internally (string-stop matching
+    round-trips arbitrary generated bytes), but sanitize_text must
+    strip the lone surrogates before they reach a JSON body — strict
+    client-side parsers reject \\udcXX escapes."""
+    from skypilot_tpu.models.tokenizer import sanitize_text
+    tk = ByteTokenizer()
+    text = tk.decode([0x80, 0xFF, ord('a')])     # invalid UTF-8 bytes
+    # Internal round trip is byte-faithful...
+    assert text.encode('utf-8', 'surrogateescape') == b'\x80\xffa'
+    with pytest.raises(UnicodeEncodeError):
+        text.encode('utf-8')                      # ...but not JSON-safe
+    clean = sanitize_text(text)
+    clean.encode('utf-8')                         # wire-safe now
+    assert clean.endswith('a') and '�' in clean
+    # Valid text passes through untouched.
+    assert sanitize_text('héllo') == 'héllo'
+
+
 def test_load_tokenizer_fallback(tmp_path):
     assert isinstance(load_tokenizer(str(tmp_path)), ByteTokenizer)
     assert isinstance(load_tokenizer(None), ByteTokenizer)
